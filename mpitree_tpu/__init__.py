@@ -1,0 +1,42 @@
+"""mpitree_tpu: a TPU-native decision-tree framework built on JAX/XLA/Pallas.
+
+A from-scratch rebuild of the capabilities of the ``mpitree`` reference
+(scikit-learn-compatible decision trees with a parallel trainer), re-architected
+TPU-first:
+
+- split search is a breadth-first, level-synchronous histogram build over a
+  struct-of-arrays tree (no Python object recursion, no dynamic shapes),
+- rows never move: an on-device ``node_id`` assignment vector replaces the
+  reference's recursive row-partition copies
+  (reference: ``mpitree/tree/decision_tree.py:150-164``),
+- distribution is data-parallel: rows are sharded over a ``jax.sharding.Mesh``
+  and per-node class histograms are reduced with ``jax.lax.psum`` over ICI,
+  replacing the reference's MPI communicator splitting
+  (reference: ``mpitree/tree/decision_tree.py:313-338,456-477``),
+- the hot split-evaluation loop (reference:
+  ``mpitree/tree/decision_tree.py:53-91``) runs as fused XLA ops with an
+  optional Pallas kernel path.
+
+Public estimators mirror and extend the reference API
+(``mpitree/tree/__init__.py:1-3``):
+``DecisionTreeClassifier``, ``ParallelDecisionTreeClassifier`` (TPU-mesh
+backed, no ``mpirun``), plus ``DecisionTreeRegressor`` and bagged random
+forests.
+"""
+
+from mpitree_tpu.models.classifier import (
+    DecisionTreeClassifier,
+    ParallelDecisionTreeClassifier,
+)
+from mpitree_tpu.models.forest import RandomForestClassifier, RandomForestRegressor
+from mpitree_tpu.models.regressor import DecisionTreeRegressor
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DecisionTreeClassifier",
+    "ParallelDecisionTreeClassifier",
+    "DecisionTreeRegressor",
+    "RandomForestClassifier",
+    "RandomForestRegressor",
+]

@@ -1,0 +1,1 @@
+"""Core build machinery: struct-of-arrays tree and the level-synchronous builder."""

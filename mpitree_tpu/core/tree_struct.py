@@ -1,0 +1,125 @@
+"""Struct-of-arrays decision tree + optional linked-``Node`` view.
+
+The reference stores a fitted tree as a graph of Python ``Node`` dataclasses
+(reference: ``mpitree/tree/_base.py:22-101``) — unserializable-by-design and
+interpreter-bound at predict time. Here the tree is six flat arrays with
+JIT-static shapes: trivially saved/loaded (``.npz``), replicated to devices
+once, and traversed by a vectorized gather-descent (``ops/predict.py``).
+
+``Node``/``to_nodes()`` provide a reference-compatible object view for users
+who walked ``clf.tree_`` directly (``value`` overloading per
+``_base.py:50``: feature index on interior nodes, class label on leaves).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class TreeArrays:
+    """A fitted tree as parallel arrays indexed by node id (root = 0).
+
+    Attributes
+    ----------
+    feature : (n_nodes,) int32
+        Split feature per interior node; ``-1`` marks a leaf.
+    threshold : (n_nodes,) float32
+        Split value (``x <= threshold`` goes left); ``nan`` on leaves.
+    left, right : (n_nodes,) int32
+        Child ids; ``-1`` on leaves.
+    parent : (n_nodes,) int32
+        Parent id; ``-1`` on the root.
+    depth : (n_nodes,) int32
+        Edges from the root.
+    value : (n_nodes,) — int32 class index (classification) or float32 mean
+        (regression); defined for interior nodes too (majority/mean), matching
+        the reference's interior ``count`` bookkeeping (``decision_tree.py:146``).
+    count : classification (n_nodes, n_classes) int64 raw class counts
+        (the reference's ``Node.count``, ``_base.py:53``); regression
+        ``(n_nodes, 1)`` float64 node means.
+    n_node_samples : (n_nodes,) int64
+        Training rows routed through each node.
+    """
+
+    feature: np.ndarray
+    threshold: np.ndarray
+    left: np.ndarray
+    right: np.ndarray
+    parent: np.ndarray
+    depth: np.ndarray
+    value: np.ndarray
+    count: np.ndarray
+    n_node_samples: np.ndarray
+
+    @property
+    def n_nodes(self) -> int:
+        return int(self.feature.shape[0])
+
+    @property
+    def max_depth(self) -> int:
+        return int(self.depth.max(initial=0))
+
+    @property
+    def n_leaves(self) -> int:
+        return int((self.feature < 0).sum())
+
+    def is_leaf(self, i: int) -> bool:
+        return self.feature[i] < 0
+
+    def save(self, path) -> None:
+        np.savez(path, **dataclasses.asdict(self))
+
+    @classmethod
+    def load(cls, path) -> "TreeArrays":
+        with np.load(path) as z:
+            return cls(**{k: z[k] for k in z.files})
+
+    def to_nodes(self) -> "Node":
+        """Materialize the reference-style linked-node view (root returned)."""
+        nodes = [
+            Node(
+                value=(int(self.feature[i]) if self.feature[i] >= 0 else self.value[i].item()),
+                threshold=(float(self.threshold[i]) if self.feature[i] >= 0 else None),
+                depth=int(self.depth[i]),
+                count=self.count[i],
+            )
+            for i in range(self.n_nodes)
+        ]
+        for i, node in enumerate(nodes):
+            if self.feature[i] >= 0:
+                node.left = nodes[self.left[i]]
+                node.right = nodes[self.right[i]]
+                node.left.parent = node
+                node.right.parent = node
+        return nodes[0] if nodes else Node(value=0)
+
+
+@dataclasses.dataclass
+class Node:
+    """Reference-compatible linked tree node (view over :class:`TreeArrays`).
+
+    Mirrors the attribute surface of the reference ``Node``
+    (``mpitree/tree/_base.py:50-57``): overloaded ``value``, optional
+    ``threshold``, ``depth``, class-count vector ``count``, and
+    parent/left/right links.
+    """
+
+    value: object
+    threshold: Optional[float] = None
+    depth: int = 0
+    count: object = None
+    parent: Optional["Node"] = dataclasses.field(default=None, repr=False)
+    left: Optional["Node"] = dataclasses.field(default=None, repr=False)
+    right: Optional["Node"] = dataclasses.field(default=None, repr=False)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self.left is None and self.right is None
+
+    @property
+    def children(self) -> list:
+        return [] if self.is_leaf else [self.left, self.right]

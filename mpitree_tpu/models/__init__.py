@@ -1,0 +1,1 @@
+"""Scikit-learn-compatible estimators backed by the TPU builder."""

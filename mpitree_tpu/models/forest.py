@@ -1,0 +1,176 @@
+"""Bagged random forests — ensemble parallelism over the TPU mesh.
+
+The reference has no ensemble; this is a target capability (BASELINE
+config 5: "Bagged random-forest ensemble (N trees sharded across TPU
+chips)"). TPU-first formulation: bootstrap resampling never copies rows —
+each tree reuses the one HBM-resident binned matrix with an integer
+multinomial ``sample_weight`` vector feeding the weighted histogram kernel
+(``ops/histogram.py``), so a forest costs one binning pass plus T weighted
+builds, each data-parallel over the full mesh.
+
+``max_features`` implements per-tree random subspaces (a feature subset drawn
+per tree, masking split candidates); per-node sampling is a planned
+refinement and is documented as such.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import numpy as np
+from sklearn.base import BaseEstimator, ClassifierMixin, RegressorMixin
+from sklearn.utils.validation import check_is_fitted
+
+from mpitree_tpu.core.builder import BuildConfig, build_tree
+from mpitree_tpu.ops.binning import bin_dataset
+from mpitree_tpu.ops.predict import predict_leaf_ids
+from mpitree_tpu.parallel import mesh as mesh_lib
+from mpitree_tpu.utils.validation import validate_fit_data, validate_predict_data
+
+
+def _n_subspace_features(max_features, n_features: int) -> int:
+    if max_features is None:
+        return n_features
+    if max_features == "sqrt":
+        return max(1, int(math.sqrt(n_features)))
+    if max_features == "log2":
+        return max(1, int(math.log2(n_features)))
+    if isinstance(max_features, float):
+        return max(1, int(max_features * n_features))
+    return max(1, min(int(max_features), n_features))
+
+
+class _BaseForest(BaseEstimator):
+    def __init__(self, *, n_estimators=10, max_depth=None, min_samples_split=2,
+                 max_bins=256, binning="auto", bootstrap=True,
+                 max_features=None, random_state=None, n_devices=None,
+                 backend=None):
+        self.n_estimators = n_estimators
+        self.max_depth = max_depth
+        self.min_samples_split = min_samples_split
+        self.max_bins = max_bins
+        self.binning = binning
+        self.bootstrap = bootstrap
+        self.max_features = max_features
+        self.random_state = random_state
+        self.n_devices = n_devices
+        self.backend = backend
+
+    def _fit_forest(self, X, y_enc, *, task, criterion, n_classes=None,
+                    refit_targets=None):
+        n = X.shape[0]
+        rng = np.random.default_rng(self.random_state)
+        binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
+        mesh = mesh_lib.resolve_mesh(backend=self.backend, n_devices=self.n_devices)
+        cfg = BuildConfig(
+            task=task, criterion=criterion, max_depth=self.max_depth,
+            min_samples_split=self.min_samples_split,
+        )
+        k = _n_subspace_features(self.max_features, X.shape[1])
+
+        trees = []
+        for _ in range(self.n_estimators):
+            w = None
+            if self.bootstrap:
+                w = rng.multinomial(n, np.full(n, 1.0 / n)).astype(np.float32)
+            b = binned
+            if k < X.shape[1]:
+                keep = np.sort(rng.choice(X.shape[1], size=k, replace=False))
+                n_cand = np.zeros_like(binned.n_cand)
+                n_cand[keep] = binned.n_cand[keep]
+                b = dataclasses.replace(binned, n_cand=n_cand)
+            trees.append(
+                build_tree(b, y_enc, config=cfg, mesh=mesh,
+                           n_classes=n_classes, sample_weight=w,
+                           refit_targets=refit_targets)
+            )
+        return trees
+
+    def _leaf_ids(self, X: np.ndarray):
+        X_d = jax.device_put(X)
+        for t in self.trees_:
+            dev = tuple(jax.device_put(a)
+                        for a in (t.feature, t.threshold, t.left, t.right))
+            yield t, np.asarray(predict_leaf_ids(X_d, dev, t.max_depth))
+
+    def __sklearn_is_fitted__(self):
+        return hasattr(self, "trees_")
+
+
+class RandomForestClassifier(_BaseForest, ClassifierMixin):
+    """Bagged classification forest (soft voting over per-tree class counts)."""
+
+    def __init__(self, *, n_estimators=10, criterion="entropy", max_depth=None,
+                 min_samples_split=2, max_bins=256, binning="auto",
+                 bootstrap=True, max_features="sqrt", random_state=None,
+                 n_devices=None, backend=None):
+        super().__init__(
+            n_estimators=n_estimators, max_depth=max_depth,
+            min_samples_split=min_samples_split, max_bins=max_bins,
+            binning=binning, bootstrap=bootstrap, max_features=max_features,
+            random_state=random_state, n_devices=n_devices, backend=backend,
+        )
+        self.criterion = criterion
+
+    def fit(self, X, y, sample_weight=None):
+        X, y_enc, classes = validate_fit_data(X, y, task="classification")
+        self.n_features_ = X.shape[1]
+        self.n_features_in_ = X.shape[1]
+        self.classes_ = classes
+        self.trees_ = self._fit_forest(
+            X, y_enc, task="classification", criterion=self.criterion,
+            n_classes=len(classes),
+        )
+        return self
+
+    def predict_proba(self, X):
+        """Mean of per-tree leaf class distributions (normalized — unlike the
+        single tree's raw-count reference quirk, which has no ensemble
+        analogue)."""
+        check_is_fitted(self)
+        X = validate_predict_data(X, self.n_features_)
+        acc = np.zeros((X.shape[0], len(self.classes_)))
+        for t, ids in self._leaf_ids(X):
+            counts = t.count[ids].astype(np.float64)
+            acc += counts / np.maximum(counts.sum(axis=1, keepdims=True), 1.0)
+        return acc / len(self.trees_)
+
+    def predict(self, X):
+        proba = self.predict_proba(X)
+        return self.classes_[proba.argmax(axis=1)]
+
+
+class RandomForestRegressor(_BaseForest, RegressorMixin):
+    """Bagged regression forest (mean of per-tree predictions)."""
+
+    def __init__(self, *, n_estimators=10, max_depth=None,
+                 min_samples_split=2, max_bins=256, binning="auto",
+                 bootstrap=True, max_features=None, random_state=None,
+                 n_devices=None, backend=None):
+        super().__init__(
+            n_estimators=n_estimators, max_depth=max_depth,
+            min_samples_split=min_samples_split, max_bins=max_bins,
+            binning=binning, bootstrap=bootstrap, max_features=max_features,
+            random_state=random_state, n_devices=n_devices, backend=backend,
+        )
+
+    def fit(self, X, y, sample_weight=None):
+        X, y64, _ = validate_fit_data(X, y, task="regression")
+        self.n_features_ = X.shape[1]
+        self.n_features_in_ = X.shape[1]
+        self._y_mean = float(y64.mean()) if len(y64) else 0.0
+        self.trees_ = self._fit_forest(
+            X, (y64 - self._y_mean).astype(np.float32), task="regression",
+            criterion="mse", refit_targets=y64,
+        )
+        return self
+
+    def predict(self, X):
+        check_is_fitted(self)
+        X = validate_predict_data(X, self.n_features_)
+        acc = np.zeros(X.shape[0])
+        for t, ids in self._leaf_ids(X):
+            acc += t.count[ids, 0]
+        return acc / len(self.trees_)

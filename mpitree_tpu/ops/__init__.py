@@ -1,0 +1,1 @@
+"""TPU compute ops: binning, histograms, impurity/gain, prediction kernels."""

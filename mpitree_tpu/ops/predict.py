@@ -1,0 +1,54 @@
+"""Vectorized tree descent — inference without per-row Python recursion.
+
+The reference predicts by a Python closure recursing per row under
+``np.apply_along_axis`` (reference: ``mpitree/tree/decision_tree.py:208-227``)
+— O(rows × depth) interpreter work. Here all rows descend in lockstep with a
+``lax.fori_loop`` of gathers over the struct-of-arrays tree: rows parked on a
+leaf keep their node id, so ``max_depth`` iterations land every row on its
+leaf. Runs fully on device with static shapes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def descend(
+    X: jax.Array,
+    feature: jax.Array,
+    threshold: jax.Array,
+    left: jax.Array,
+    right: jax.Array,
+    *,
+    n_steps: int,
+) -> jax.Array:
+    """Route each row of ``X`` to its leaf; returns (N,) leaf node ids.
+
+    Parameters
+    ----------
+    X : (N, F) float32 raw feature values.
+    feature/threshold/left/right : tree arrays (``feature < 0`` marks leaves).
+    n_steps : static descent depth (tree ``max_depth``).
+    """
+    n = X.shape[0]
+
+    def body(_, node):
+        f = feature[node]
+        is_leaf = f < 0
+        xf = jnp.take_along_axis(X, jnp.maximum(f, 0)[:, None], axis=1)[:, 0]
+        go_left = xf <= threshold[node]
+        nxt = jnp.where(go_left, left[node], right[node])
+        return jnp.where(is_leaf, node, nxt)
+
+    return lax.fori_loop(0, n_steps, body, jnp.zeros(n, dtype=jnp.int32))
+
+
+def predict_leaf_ids(X, tree_dev, n_steps: int) -> jax.Array:
+    """Convenience wrapper: ``tree_dev`` = (feature, threshold, left, right)."""
+    feature, threshold, left, right = tree_dev
+    return descend(X, feature, threshold, left, right, n_steps=max(n_steps, 1))

@@ -1,0 +1,1 @@
+"""Distribution layer: device mesh management and psum-based collectives."""

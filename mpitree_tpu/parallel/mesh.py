@@ -1,0 +1,75 @@
+"""Device-mesh management — the TPU-native replacement for MPI communicators.
+
+The reference bootstraps ``MPI.COMM_WORLD`` at import time and parallelizes by
+recursively splitting communicators (reference:
+``mpitree/tree/decision_tree.py:313-338``). Here the unit of distribution is a
+``jax.sharding.Mesh`` with a single ``"data"`` axis: rows are sharded across
+it, histogram reductions ride ICI via ``lax.psum``, and multi-host (DCN)
+scaling uses the same code after ``jax.distributed.initialize`` — no
+communicator tree, because the breadth-first builder turns the reference's
+subtree task-parallelism into a batch dimension.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def available_devices(backend: str | None = None) -> list:
+    """Devices for ``backend`` (None = JAX default platform)."""
+    return jax.devices() if backend is None else jax.devices(backend)
+
+
+@lru_cache(maxsize=32)
+def _cached_mesh(device_key: tuple, backend: str | None) -> Mesh:
+    devs = available_devices(backend)
+    picked = [devs[i] for i in device_key]
+    return Mesh(np.array(picked), (DATA_AXIS,))
+
+
+def resolve_mesh(*, backend: str | None = None, n_devices=None) -> Mesh:
+    """Build a 1-D ``data`` mesh.
+
+    ``n_devices=None`` -> single device (sequential semantics, like the
+    reference's plain ``DecisionTreeClassifier``); ``n_devices="all"`` or
+    ``-1`` -> every visible device (the ``mpirun -n <world>`` analogue).
+    """
+    devs = available_devices(backend)
+    if n_devices in (None, 1):
+        n = 1
+    elif n_devices in ("all", -1):
+        n = len(devs)
+    else:
+        n = int(n_devices)
+        if n < 1 or n > len(devs):
+            raise ValueError(
+                f"n_devices={n} requested but only {len(devs)} devices are "
+                f"visible for backend={backend!r}"
+            )
+    return _cached_mesh(tuple(range(n)), backend)
+
+
+def shard_rows(mesh: Mesh, *arrays):
+    """device_put each (N, ...) array row-sharded over the mesh's data axis."""
+    out = []
+    for a in arrays:
+        spec = P(DATA_AXIS, *([None] * (a.ndim - 1)))
+        out.append(jax.device_put(a, NamedSharding(mesh, spec)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def replicate(mesh: Mesh, *arrays):
+    """device_put each array fully replicated over the mesh."""
+    out = [jax.device_put(a, NamedSharding(mesh, P())) for a in arrays]
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def pad_rows(n: int, n_devices: int) -> int:
+    """Rows of padding needed so n divides evenly across devices."""
+    return (-n) % n_devices

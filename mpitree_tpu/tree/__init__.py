@@ -1,0 +1,26 @@
+"""Drop-in import surface matching the reference package layout.
+
+The reference exposes its estimators as
+``from mpitree.tree import DecisionTreeClassifier, ParallelDecisionTreeClassifier``
+(reference: ``mpitree/tree/__init__.py:1-3``). This module mirrors that path so
+reference users can switch with a one-line import change, and additionally
+exports the estimators the reference lacks (regressor, forests).
+"""
+
+from mpitree_tpu.core.tree_struct import Node, TreeArrays
+from mpitree_tpu.models.classifier import (
+    DecisionTreeClassifier,
+    ParallelDecisionTreeClassifier,
+)
+from mpitree_tpu.models.forest import RandomForestClassifier, RandomForestRegressor
+from mpitree_tpu.models.regressor import DecisionTreeRegressor
+
+__all__ = [
+    "DecisionTreeClassifier",
+    "ParallelDecisionTreeClassifier",
+    "DecisionTreeRegressor",
+    "RandomForestClassifier",
+    "RandomForestRegressor",
+    "Node",
+    "TreeArrays",
+]

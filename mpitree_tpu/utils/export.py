@@ -1,0 +1,81 @@
+"""Text rendering of a fitted tree — byte-parity with the reference.
+
+Reproduces ``export_text`` from the reference
+(``mpitree/tree/decision_tree.py:250-307``) including its quirks:
+
+- glyphs ``┌──``/``├──``/``└──`` (``mpitree/tree/_base.py:16-20``);
+- edge labels ``[<= t]`` / ``[> t]`` carry the *parent's* threshold, formatted
+  to ``precision`` decimals (``decision_tree.py:270-276``); the root line has
+  no edge label;
+- child print order comes from ``sorted(node.children)`` driven by the
+  side-effecting ``Node.__lt__`` (``_base.py:63-75``). Net behavior (verified
+  against the notebook's stored renderings): if the *right* child is interior
+  it prints first with ``├──`` and the left child follows with ``└──``;
+  otherwise the children print (left ``├──``, right ``└──``);
+- descendants of a node rendered with ``└──`` are indented with three spaces,
+  all others with ``"│  "`` (``decision_tree.py:300-303``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mpitree_tpu.core.tree_struct import TreeArrays
+
+_GLYPH_ROOT = "┌──"
+_GLYPH_INTERIOR = "├──"
+_GLYPH_LEAF = "└──"
+
+
+def export_tree_text(
+    tree: TreeArrays,
+    *,
+    feature_names=None,
+    class_names=None,
+    precision: int = 2,
+    task: str = "classification",
+) -> str:
+    """Render ``tree`` exactly as the reference's ``export_text`` would."""
+    lines: list[str] = []
+
+    def label(i: int) -> str:
+        if tree.feature[i] < 0:  # leaf
+            if task == "regression":
+                return f"value: {float(tree.value[i]):.{precision}f}"
+            v = int(tree.value[i])
+            return class_names[v] if class_names is not None else f"class: {v}"
+        f = int(tree.feature[i])
+        return feature_names[f] if feature_names is not None else f"feature_{f}"
+
+    def emit(i: int, glyph: str, prefix: str) -> None:
+        text = f"{glyph} {label(i)}"
+        p = int(tree.parent[i])
+        if p >= 0:
+            sign = "<=" if int(tree.left[p]) == i else ">"
+            text += f" [{sign} {float(tree.threshold[p]):.{precision}f}]"
+        lines.append(prefix + text)
+
+        if tree.feature[i] < 0:
+            return
+        l, r = int(tree.left[i]), int(tree.right[i])
+        # Reference child ordering via Node.__lt__ side effects (_base.py:63-75):
+        # an interior right child wins the first slot; otherwise (left, right).
+        if tree.feature[r] >= 0:
+            order = [(r, _GLYPH_INTERIOR), (l, _GLYPH_LEAF)]
+        else:
+            order = [(l, _GLYPH_INTERIOR), (r, _GLYPH_LEAF)]
+        child_prefix = prefix + ("   " if glyph == _GLYPH_LEAF else "│  ")
+        for c, g in order:
+            emit(c, g, child_prefix)
+
+    if tree.n_nodes:
+        emit(0, _GLYPH_ROOT, "")
+    return "\n".join(lines)
+
+
+def check_feature_names(names, n_features: int):
+    if names is not None and len(names) < n_features:
+        raise ValueError(
+            f"feature_names has {len(names)} entries; need >= {n_features}"
+        )
+    return np.asarray(names) if names is not None else None

@@ -1,0 +1,44 @@
+"""Input validation — the sklearn contract without sklearn on the hot path.
+
+The reference validates via ``check_X_y(..., dtype=object)`` and keeps X as an
+object array compared with Python-level ``<=``
+(reference: ``mpitree/tree/decision_tree.py:184,205,246``). A TPU build needs
+numeric arrays, so we validate shape/finiteness with sklearn's checkers (host
+side, once per call) and cast to float32. The one behavioral divergence —
+object-dtype string features, which happen to "work" lexicographically in the
+reference — is rejected with a clear error.
+
+Labels: the reference requires contiguous non-negative integer labels
+(``np.bincount(y).argmax()`` leaf rule, ``decision_tree.py:125``; anything else
+crashes in ``predict_proba``'s ragged stacking). We accept arbitrary discrete
+labels by encoding against ``classes_`` — for ``0..C-1`` integer labels this is
+bit-identical to the reference.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from sklearn.utils.validation import check_array, check_X_y
+
+
+def validate_fit_data(X, y, *, task: str = "classification"):
+    """Returns (X float32 (N,F), y_encoded, classes_ or None)."""
+    X, y = check_X_y(X, y, dtype="numeric", y_numeric=(task == "regression"))
+    X = np.ascontiguousarray(X, dtype=np.float32)
+    if task == "classification":
+        classes, y_enc = np.unique(y, return_inverse=True)
+        return X, y_enc.astype(np.int32), classes
+    # Regression targets stay float64 on the host: the estimator centers in
+    # f64 (shift invariance) and casts to f32 only for the device moment
+    # histograms; leaf values are refit exactly in f64 afterwards.
+    return X, np.ascontiguousarray(y, dtype=np.float64), None
+
+
+def validate_predict_data(X, n_features: int):
+    X = check_array(X, dtype="numeric")
+    if X.shape[1] != n_features:
+        raise ValueError(
+            f"X has {X.shape[1]} features, but the estimator was fitted with "
+            f"{n_features} features"
+        )
+    return np.ascontiguousarray(X, dtype=np.float32)

@@ -1,0 +1,38 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before JAX initializes.
+
+This is the JAX-idiomatic replacement for "test multi-node without a cluster"
+(SURVEY.md §4): the same shard_map/psum code that runs over ICI on a TPU pod
+runs here across 8 fake CPU devices. The environment pins JAX_PLATFORMS=axon
+via sitecustomize, so the platform must be overridden in-process.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def iris2():
+    """The reference notebook's workload: iris restricted to 2 features
+    (reference: experiments.ipynb cells 1-2)."""
+    from sklearn.datasets import load_iris
+
+    data = load_iris()
+    return data.data[:, :2], data.target, data
+
+
+@pytest.fixture(scope="session")
+def iris_full():
+    from sklearn.datasets import load_iris
+
+    data = load_iris()
+    return data.data, data.target
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

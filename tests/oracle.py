@@ -1,0 +1,103 @@
+"""Pure-numpy oracle implementing the reference's behavioral contract.
+
+Written fresh from SURVEY.md §2.6 (not a copy of the reference): recursive
+exact-threshold entropy splitting with the reference's tie-breaks, stopping
+rules, leaf rule, raw-count predict_proba, and export_text rendering. Used to
+generate golden trees/renderings that the TPU implementation must match on
+small datasets (where exact binning applies).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def entropy(y: np.ndarray) -> float:
+    if len(y) == 0:
+        return -0.0
+    _, counts = np.unique(y, return_counts=True)
+    p = counts / len(y)
+    return float(-(p * np.log2(p)).sum())
+
+
+def best_split(X: np.ndarray, y: np.ndarray, f: int):
+    """(gain, threshold) for feature f: exhaustive unique-value scan,
+    cost argmin with lowest-threshold tie-break."""
+    thresholds = np.unique(X[:, f])
+    costs = np.empty(len(thresholds))
+    for i, t in enumerate(thresholds):
+        m = X[:, f] <= t
+        nl, nr = m.sum(), (~m).sum()
+        costs[i] = (nl * entropy(y[m]) + nr * entropy(y[~m])) / len(y)
+    i = int(np.argmin(costs))
+    return entropy(y) - costs[i], thresholds[i]
+
+
+def grow(X, y, n_classes, *, max_depth=None, min_samples_split=2, depth=0):
+    """Returns a dict-tree: leaf {'count': ...} or split
+    {'f', 't', 'count', 'left', 'right'}."""
+    count = np.bincount(y, minlength=n_classes)
+    if (
+        len(np.unique(y)) == 1
+        or np.all(X == X[0])
+        or (max_depth is not None and depth == max_depth)
+        or len(X) < min_samples_split
+    ):
+        return {"count": count}
+    gains = np.empty(X.shape[1])
+    ts = np.empty(X.shape[1])
+    for f in range(X.shape[1]):
+        gains[f], ts[f] = best_split(X, y, f)
+    f = int(np.argmax(gains))
+    m = X[:, f] <= ts[f]
+    return {
+        "f": f,
+        "t": ts[f],
+        "count": count,
+        "left": grow(X[m], y[m], n_classes, max_depth=max_depth,
+                     min_samples_split=min_samples_split, depth=depth + 1),
+        "right": grow(X[~m], y[~m], n_classes, max_depth=max_depth,
+                      min_samples_split=min_samples_split, depth=depth + 1),
+    }
+
+
+def predict_counts(node, X):
+    out = np.empty((len(X), len(node["count"])), dtype=np.int64)
+    for i, x in enumerate(X):
+        n = node
+        while "f" in n:
+            n = n["left"] if x[n["f"]] <= n["t"] else n["right"]
+        out[i] = n["count"]
+    return out
+
+
+def render(node, *, feature_names=None, class_names=None, precision=2) -> str:
+    """export_text per the reference's rendering contract (SURVEY.md §2.6 #8)."""
+    lines = []
+
+    def label(n):
+        if "f" not in n:
+            v = int(np.argmax(n["count"]))
+            return class_names[v] if class_names is not None else f"class: {v}"
+        return (feature_names[n["f"]] if feature_names is not None
+                else f"feature_{n['f']}")
+
+    def emit(n, glyph, prefix, parent, is_left):
+        text = f"{glyph} {label(n)}"
+        if parent is not None:
+            sign = "<=" if is_left else ">"
+            text += f" [{sign} {parent['t']:.{precision}f}]"
+        lines.append(prefix + text)
+        if "f" not in n:
+            return
+        l, r = n["left"], n["right"]
+        if "f" in r:  # interior right child prints first
+            order = [(r, "├──", False), (l, "└──", True)]
+        else:
+            order = [(l, "├──", True), (r, "└──", False)]
+        child_prefix = prefix + ("   " if glyph == "└──" else "│  ")
+        for c, g, isl in order:
+            emit(c, g, child_prefix, n, isl)
+
+    emit(node, "┌──", "", None, True)
+    return "\n".join(lines)

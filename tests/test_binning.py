@@ -1,0 +1,54 @@
+import numpy as np
+
+from mpitree_tpu.ops.binning import bin_dataset
+
+
+def test_exact_binning_roundtrip():
+    X = np.array([[3.0, 1.0], [1.0, 1.0], [2.0, 5.0], [3.0, 5.0]], np.float32)
+    b = bin_dataset(X, binning="exact")
+    # feature 0 uniques [1,2,3] -> candidates [1,2]; feature 1 uniques [1,5] -> [1]
+    assert b.n_cand.tolist() == [2, 1]
+    assert b.n_bins == 3
+    np.testing.assert_allclose(b.thresholds[0, :2], [1.0, 2.0])
+    np.testing.assert_allclose(b.thresholds[1, :1], [1.0])
+    assert np.isinf(b.thresholds[1, 1])
+    # x <= thresholds[f, b] <=> x_binned[:, f] <= b
+    for f in range(2):
+        for cand in range(b.n_cand[f]):
+            np.testing.assert_array_equal(
+                X[:, f] <= b.thresholds[f, cand], b.x_binned[:, f] <= cand
+            )
+
+
+def test_constant_feature_has_no_candidates():
+    X = np.column_stack([np.ones(10), np.arange(10)]).astype(np.float32)
+    b = bin_dataset(X, binning="exact")
+    assert b.n_cand[0] == 0
+    assert b.n_cand[1] == 9
+    assert not b.candidate_mask()[0].any()
+
+
+def test_quantile_binning_caps_candidates_and_preserves_order():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(5000, 3)).astype(np.float32)
+    b = bin_dataset(X, max_bins=64, binning="quantile")
+    assert b.n_bins <= 64
+    assert (b.n_cand <= 63).all()
+    # thresholds are actual data values and the bin map is consistent
+    for f in range(3):
+        edges = b.thresholds[f, : b.n_cand[f]]
+        assert np.isin(edges, X[:, f]).all()
+        assert (np.diff(edges) > 0).all()
+        for cand in (0, b.n_cand[f] // 2, b.n_cand[f] - 1):
+            np.testing.assert_array_equal(
+                X[:, f] <= edges[cand], b.x_binned[:, f] <= cand
+            )
+
+
+def test_auto_switches_per_feature():
+    rng = np.random.default_rng(0)
+    few = rng.integers(0, 5, size=2000).astype(np.float32)
+    many = rng.normal(size=2000).astype(np.float32)
+    b = bin_dataset(np.column_stack([few, many]), max_bins=32, binning="auto")
+    assert b.n_cand[0] == 4  # exact: 5 uniques -> 4 candidates
+    assert b.n_cand[1] <= 31  # quantile-capped

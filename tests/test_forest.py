@@ -1,0 +1,71 @@
+import numpy as np
+from sklearn.model_selection import train_test_split
+
+from mpitree_tpu import (
+    DecisionTreeClassifier,
+    RandomForestClassifier,
+    RandomForestRegressor,
+)
+
+
+def _noisy_classification(n=600, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 10))
+    logits = X[:, 0] + X[:, 1] - X[:, 2] + rng.normal(scale=1.5, size=n)
+    y = (logits > 0).astype(int)
+    return X, y
+
+
+def test_forest_beats_single_tree_generalization():
+    X, y = _noisy_classification(800)
+    Xtr, Xte, ytr, yte = train_test_split(X, y, random_state=0)
+    tree = DecisionTreeClassifier(max_depth=8).fit(Xtr, ytr)
+    forest = RandomForestClassifier(
+        n_estimators=15, max_depth=8, random_state=0
+    ).fit(Xtr, ytr)
+    assert forest.score(Xte, yte) >= tree.score(Xte, yte) - 0.01
+
+
+def test_forest_deterministic_with_seed():
+    X, y = _noisy_classification(300)
+    a = RandomForestClassifier(n_estimators=5, max_depth=4, random_state=7).fit(X, y)
+    b = RandomForestClassifier(n_estimators=5, max_depth=4, random_state=7).fit(X, y)
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+    for ta, tb in zip(a.trees_, b.trees_):
+        np.testing.assert_array_equal(ta.feature, tb.feature)
+
+
+def test_forest_proba_normalized():
+    X, y = _noisy_classification(300)
+    f = RandomForestClassifier(n_estimators=4, max_depth=4, random_state=1).fit(X, y)
+    p = f.predict_proba(X)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-9)
+    assert (p >= 0).all()
+
+
+def test_forest_sharded_matches_single_device():
+    X, y = _noisy_classification(250, seed=3)
+    a = RandomForestClassifier(n_estimators=4, max_depth=4, random_state=2,
+                               n_devices=1).fit(X, y)
+    b = RandomForestClassifier(n_estimators=4, max_depth=4, random_state=2,
+                               n_devices=8).fit(X, y)
+    np.testing.assert_array_equal(a.predict(X), b.predict(X))
+
+
+def test_forest_regressor_improves_over_noise():
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2, 2, size=(600, 6))
+    y = np.sin(X[:, 0]) * 2 + X[:, 1] + rng.normal(scale=0.3, size=600)
+    Xtr, Xte, ytr, yte = train_test_split(X, y, random_state=0)
+    f = RandomForestRegressor(n_estimators=10, max_depth=7, random_state=0).fit(Xtr, ytr)
+    assert f.score(Xte, yte) > 0.7
+
+
+def test_max_features_subspace():
+    X, y = _noisy_classification(300)
+    f = RandomForestClassifier(n_estimators=3, max_depth=3, max_features=2,
+                               random_state=0).fit(X, y)
+    # each tree saw only 2 candidate features
+    for t in f.trees_:
+        used = set(t.feature[t.feature >= 0].tolist())
+        assert len(used) <= 2

@@ -1,0 +1,59 @@
+import numpy as np
+from sklearn.tree import DecisionTreeRegressor as SkTree
+
+from mpitree_tpu import DecisionTreeRegressor
+
+
+def _synth(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.uniform(-2, 2, size=(n, 5))
+    y = np.sin(X[:, 0]) * 3 + X[:, 1] ** 2 + 0.5 * X[:, 2]
+    return X, y
+
+
+def test_perfect_fit_unbounded():
+    X, y = _synth(200)
+    r = DecisionTreeRegressor(binning="exact").fit(X, y)
+    pred = r.predict(X)
+    assert np.abs(pred - y).max() < 1e-4
+
+
+def test_r2_close_to_sklearn():
+    X, y = _synth(400)
+    Xt, yt = _synth(200, seed=9)
+    ours = DecisionTreeRegressor(max_depth=6, binning="exact").fit(X, y)
+    theirs = SkTree(max_depth=6, random_state=0).fit(X, y)
+    assert ours.score(Xt, yt) > theirs.score(Xt, yt) - 0.05
+
+
+def test_constant_target():
+    X = np.random.default_rng(0).normal(size=(50, 3))
+    y = np.full(50, 3.25)
+    r = DecisionTreeRegressor().fit(X, y)
+    assert r.tree_.n_nodes == 1
+    np.testing.assert_allclose(r.predict(X), 3.25, rtol=1e-6)
+
+
+def test_mean_shift_invariance():
+    """Centered-moment build must be invariant to large target offsets."""
+    X, y = _synth(300, seed=4)
+    a = DecisionTreeRegressor(max_depth=5).fit(X, y)
+    b = DecisionTreeRegressor(max_depth=5).fit(X, y + 1e4)
+    np.testing.assert_array_equal(a.tree_.feature, b.tree_.feature)
+    np.testing.assert_allclose(a.predict(X), b.predict(X) - 1e4, atol=2e-2)
+
+
+def test_export_text_regression():
+    X, y = _synth(100)
+    r = DecisionTreeRegressor(max_depth=2).fit(X, y)
+    text = r.export_text(precision=2)
+    assert text.startswith("┌── feature_")
+    assert "value:" in text
+
+
+def test_min_samples_split_respected():
+    X, y = _synth(300)
+    r = DecisionTreeRegressor(min_samples_split=100).fit(X, y)
+    leaves = r.tree_.feature < 0
+    interior = ~leaves
+    assert (r.tree_.n_node_samples[interior] >= 100).all()

@@ -1,0 +1,75 @@
+"""Distributed invariants on the 8-device virtual CPU mesh.
+
+The reference's parallel correctness rests on every rank deterministically
+computing the identical split (SURVEY.md §2.4). The TPU restatement: the
+fitted tree must be bit-identical at every mesh size, because integer-valued
+f32 histogram psums are order-independent and split selection runs replicated.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from mpitree_tpu import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    ParallelDecisionTreeClassifier,
+)
+
+
+def _trees_equal(a, b):
+    for f in dataclasses.fields(a):
+        np.testing.assert_array_equal(
+            getattr(a, f.name), getattr(b, f.name), err_msg=f.name
+        )
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_tree_identical_across_mesh_sizes(iris2, n_devices):
+    X, y, _ = iris2
+    seq = DecisionTreeClassifier(max_depth=5, binning="exact").fit(X, y)
+    par = DecisionTreeClassifier(
+        max_depth=5, binning="exact", n_devices=n_devices
+    ).fit(X, y)
+    _trees_equal(seq.tree_, par.tree_)
+
+
+def test_parallel_class_uses_all_devices(iris2):
+    X, y, _ = iris2
+    assert len(jax.devices()) == 8  # conftest forced the virtual mesh
+    par = ParallelDecisionTreeClassifier(max_depth=3, binning="exact").fit(X, y)
+    seq = DecisionTreeClassifier(max_depth=3, binning="exact").fit(X, y)
+    _trees_equal(par.tree_, seq.tree_)
+    np.testing.assert_array_equal(par.predict(X), seq.predict(X))
+
+
+def test_parallel_world_attrs():
+    assert ParallelDecisionTreeClassifier.WORLD_SIZE == 8
+    assert ParallelDecisionTreeClassifier.WORLD_RANK == 0
+
+
+def test_uneven_rows_pad_correctly():
+    # 103 rows over 8 devices exercises the padding path.
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(103, 5))
+    y = rng.integers(0, 2, size=103)
+    seq = DecisionTreeClassifier(max_depth=4).fit(X, y)
+    par = DecisionTreeClassifier(max_depth=4, n_devices=8).fit(X, y)
+    _trees_equal(seq.tree_, par.tree_)
+
+
+def test_regressor_sharded_matches_single():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(200, 4))
+    y = (X[:, 0] * 2 + rng.normal(scale=0.1, size=200)).astype(np.float64)
+    seq = DecisionTreeRegressor(max_depth=5).fit(X, y)
+    par = DecisionTreeRegressor(max_depth=5, n_devices=8).fit(X, y)
+    _trees_equal(seq.tree_, par.tree_)
+
+
+def test_backend_cpu_explicit(iris2):
+    X, y, _ = iris2
+    clf = DecisionTreeClassifier(max_depth=3, backend="cpu", n_devices=2).fit(X, y)
+    assert clf.score(X, y) > 0.7

@@ -1,0 +1,72 @@
+"""sklearn API contract: get_params/set_params/clone/score, inherited the same
+way the reference gets them from BaseEstimator/ClassifierMixin
+(reference: mpitree/tree/decision_tree.py:17)."""
+
+import numpy as np
+import pytest
+from sklearn.base import clone
+
+from mpitree_tpu import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    RandomForestClassifier,
+)
+
+
+def test_get_set_params_roundtrip():
+    clf = DecisionTreeClassifier(max_depth=3, min_samples_split=5)
+    p = clf.get_params()
+    assert p["max_depth"] == 3 and p["min_samples_split"] == 5
+    clf.set_params(max_depth=7, criterion="gini")
+    assert clf.max_depth == 7 and clf.criterion == "gini"
+
+
+def test_clone(iris2):
+    X, y, _ = iris2
+    clf = DecisionTreeClassifier(max_depth=2).fit(X, y)
+    c = clone(clf)
+    assert c.max_depth == 2
+    assert not hasattr(c, "tree_")
+
+
+def test_score_is_accuracy(iris2):
+    X, y, _ = iris2
+    clf = DecisionTreeClassifier(max_depth=3).fit(X, y)
+    assert clf.score(X, y) == (clf.predict(X) == y).mean()
+
+
+def test_unfitted_raises(iris2):
+    X, _, _ = iris2
+    from sklearn.exceptions import NotFittedError
+
+    with pytest.raises(NotFittedError):
+        DecisionTreeClassifier().predict(X)
+
+
+def test_feature_count_mismatch_raises(iris2):
+    X, y, _ = iris2
+    clf = DecisionTreeClassifier(max_depth=2).fit(X, y)
+    with pytest.raises(ValueError):
+        clf.predict(X[:, :1])
+
+
+def test_kwonly_constructor_matches_reference():
+    """Reference hyperparameters are keyword-only (decision_tree.py:33)."""
+    with pytest.raises(TypeError):
+        DecisionTreeClassifier(3)  # positional must fail
+
+
+@pytest.mark.parametrize("est", [DecisionTreeClassifier, DecisionTreeRegressor,
+                                 RandomForestClassifier])
+def test_estimators_cloneable(est):
+    clone(est())
+
+
+def test_regressor_score_is_r2():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(100, 3))
+    y = X[:, 0] * 2.0
+    r = DecisionTreeRegressor(max_depth=8).fit(X, y)
+    from sklearn.metrics import r2_score
+
+    assert r.score(X, y) == pytest.approx(r2_score(y, r.predict(X)))

@@ -1,0 +1,57 @@
+import numpy as np
+
+from mpitree_tpu import DecisionTreeClassifier
+from mpitree_tpu.core.tree_struct import TreeArrays
+
+
+def test_save_load_roundtrip(tmp_path, iris2):
+    X, y, _ = iris2
+    clf = DecisionTreeClassifier(max_depth=4).fit(X, y)
+    path = tmp_path / "tree.npz"
+    clf.tree_.save(path)
+    loaded = TreeArrays.load(path)
+    assert loaded.n_nodes == clf.tree_.n_nodes
+    np.testing.assert_array_equal(loaded.feature, clf.tree_.feature)
+    np.testing.assert_array_equal(loaded.count, clf.tree_.count)
+
+    # A fresh estimator can serve the loaded tree.
+    clf2 = DecisionTreeClassifier(max_depth=4)
+    clf2.n_features_ = clf.n_features_
+    clf2.classes_ = clf.classes_
+    clf2.tree_ = loaded
+    np.testing.assert_array_equal(clf2.predict(X), clf.predict(X))
+
+
+def test_to_nodes_view(iris2):
+    X, y, _ = iris2
+    clf = DecisionTreeClassifier(max_depth=2, binning="exact").fit(X, y)
+    root = clf.nodes_
+    assert root.parent is None
+    assert not root.is_leaf
+    assert root.left.parent is root and root.right.parent is root
+    assert root.depth == 0 and root.left.depth == 1
+    # interior value = feature index; leaf value = class label
+    assert root.value == int(clf.tree_.feature[0])
+    leaf = root.left
+    while not leaf.is_leaf:
+        leaf = leaf.left
+    assert leaf.threshold is None
+    assert leaf.value == int(np.argmax(leaf.count))
+
+
+def test_tree_stats(iris2):
+    X, y, _ = iris2
+    clf = DecisionTreeClassifier(max_depth=3).fit(X, y)
+    t = clf.tree_
+    assert t.max_depth <= 3
+    assert t.n_leaves + (t.feature >= 0).sum() == t.n_nodes
+    # root counts cover the whole training set
+    assert t.n_node_samples[0] == len(X)
+    assert t.count[0].sum() == len(X)
+    # children partition the parent
+    for i in range(t.n_nodes):
+        if t.feature[i] >= 0:
+            assert (
+                t.n_node_samples[t.left[i]] + t.n_node_samples[t.right[i]]
+                == t.n_node_samples[i]
+            )
