@@ -1,0 +1,109 @@
+"""North-star benchmark: depth-20 tree build on covtype-scale data.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <our warm fit seconds>, "unit": "s",
+   "vs_baseline": <estimated 8-rank MPI reference seconds / ours>, ...}
+
+Baseline methodology (the reference never published covtype numbers, and this
+environment has no mpi4py, so the 8-rank baseline is estimated — see
+BASELINE.md):
+
+1. A faithful numpy implementation of the reference's algorithm
+   (`tests/oracle.py` semantics: exhaustive unique-value threshold scan with
+   the full-matrix copies of ``decision_tree.py:73-86``) is timed on
+   subsamples of the same dataset.
+2. A power law ``t = a * n^b`` is fit and extrapolated to the full row count.
+   This extrapolates the *sequential* reference cost.
+3. The 8-rank estimate divides by 8 — the *ideal* speedup, strictly more
+   generous than the reference's published scaling (k=8 beat k=2 by only
+   1.6x at n=241, time_data.csv), so ``vs_baseline`` is an underestimate.
+
+Accuracy parity is checked against sklearn's DecisionTreeClassifier on a
+held-out split and reported alongside.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+
+N_ROWS = 581012
+DEPTH = 20
+SUBSAMPLE_GRID = (300, 600, 1200, 2400)
+
+
+def time_reference_semantics(X, y, n, depth=DEPTH):
+    """One fit of the reference algorithm (oracle semantics) on n rows."""
+    sys.path.insert(0, os.path.join(_HERE, "tests"))
+    import oracle
+
+    t0 = time.time()
+    oracle.grow(X[:n], y[:n], int(y.max()) + 1, max_depth=depth)
+    return time.time() - t0
+
+
+def main():
+    from sklearn.model_selection import train_test_split
+    from sklearn.tree import DecisionTreeClassifier as SkTree
+
+    from mpitree_tpu import DecisionTreeClassifier
+    from mpitree_tpu.utils.datasets import load_covtype
+
+    X, y, name = load_covtype(N_ROWS)
+    Xtr, Xte, ytr, yte = train_test_split(X, y, test_size=50_000, random_state=0)
+
+    # --- ours: warm-timed depth-20 build on the TPU ------------------------
+    def fit_once():
+        clf = DecisionTreeClassifier(max_depth=DEPTH, max_bins=256)
+        t0 = time.time()
+        clf.fit(Xtr, ytr)
+        return time.time() - t0, clf
+
+    cold_s, _ = fit_once()
+    ours_s, clf = fit_once()
+    ours_acc = float((clf.predict(Xte) == yte).mean())
+
+    # --- sklearn parity anchor --------------------------------------------
+    t0 = time.time()
+    sk = SkTree(max_depth=DEPTH, random_state=0).fit(Xtr, ytr)
+    sk_s = time.time() - t0
+    sk_acc = float(sk.score(Xte, yte))
+
+    # --- reference baseline extrapolation ---------------------------------
+    ts = [time_reference_semantics(Xtr, ytr, n) for n in SUBSAMPLE_GRID]
+    b, log_a = np.polyfit(np.log(SUBSAMPLE_GRID), np.log(ts), 1)
+    seq_est_s = float(np.exp(log_a) * len(Xtr) ** b)
+    mpi8_est_s = seq_est_s / 8.0  # ideal speedup — generous to the reference
+
+    result = {
+        "metric": f"{name} ({len(Xtr)}x{X.shape[1]}) depth-{DEPTH} tree build",
+        "value": round(ours_s, 3),
+        "unit": "s",
+        "vs_baseline": round(mpi8_est_s / ours_s, 1),
+        "detail": {
+            "ours_cold_s": round(cold_s, 3),
+            "ours_test_acc": round(ours_acc, 4),
+            "sklearn_s": round(sk_s, 3),
+            "sklearn_test_acc": round(sk_acc, 4),
+            "acc_delta_vs_sklearn": round(ours_acc - sk_acc, 4),
+            "ref_seq_extrapolated_s": round(seq_est_s, 1),
+            "ref_subsample_grid": list(SUBSAMPLE_GRID),
+            "ref_subsample_s": [round(t, 3) for t in ts],
+            "ref_power_law_exponent": round(float(b), 3),
+            "mpi8_baseline_estimate_s": round(mpi8_est_s, 1),
+            "baseline_note": "reference never published covtype numbers; "
+            "estimate = sequential extrapolation / ideal 8x (see BASELINE.md)",
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -29,7 +29,7 @@ import dataclasses
 import math
 from typing import Optional
 
-import jax.numpy as jnp
+import jax
 import numpy as np
 
 from mpitree_tpu.core.tree_struct import TreeArrays
@@ -43,8 +43,9 @@ class BuildConfig:
     criterion: str = "entropy"  # entropy | gini (classification), mse (regression)
     max_depth: Optional[int] = None
     min_samples_split: int = 2
-    hist_budget_bytes: int = 1 << 31  # HBM budget for one histogram chunk
+    hist_budget_bytes: int = 4 << 30  # HBM budget for one histogram chunk
     max_frontier_chunk: int = 4096
+    max_table_slots: int = 1 << 17  # width of per-level update/counts tables
     # Relative tolerance for declaring a regression node pure. Kept below the
     # f32 moment-cancellation noise floor on purpose: a node whose true
     # variance is zero but whose computed variance is noise keeps splitting
@@ -53,21 +54,50 @@ class BuildConfig:
     var_rel_tol: float = 1e-9
 
 
-def _chunk_size(frontier: int, n_feat: int, n_bins: int, n_chan: int,
+def _chunk_size(n_samples: int, n_feat: int, n_bins: int, n_chan: int,
                 cfg: BuildConfig) -> int:
-    per_node = n_feat * n_bins * n_chan * 4 * 4  # x4 for cumsum temporaries
+    """Frontier-chunk slot count, fixed for the whole build.
+
+    One size for every level means exactly one compiled (split, update)
+    executable pair per build — TPU compiles cost tens of seconds through the
+    remote tunnel, and shallow levels wasting idle histogram slots cost only
+    microseconds of VPU time. Bounded by the histogram HBM budget, the widest
+    possible frontier (2^max_depth, or n_samples when unbounded), and a hard
+    cap.
+    """
+    # Live peak per slot: the (K,F,C,B) histogram (C padded to 8 sublanes by
+    # TPU tiling) plus ~8 (K,F,B) f32 accumulators (impurity.py's memory-lean
+    # gain formulation keeps per-class cumsums transient).
+    c_padded = ((n_chan + 7) // 8) * 8
+    per_node = n_feat * n_bins * (c_padded * 4 + 8 * 4)
     cap = max(1, cfg.hist_budget_bytes // max(per_node, 1))
     cap = min(cap, cfg.max_frontier_chunk)
-    # Floor of 32 slots: the first ~5 levels share one compiled executable
-    # (the wasted histogram slots are a few MB at covtype scale).
-    want = 1 << max(5, math.ceil(math.log2(max(frontier, 1))))
+    widest = _widest_frontier(n_samples, cfg)
+    want = 1 << max(0, math.ceil(math.log2(max(widest, 1))))
     return min(want, 1 << int(math.log2(cap)))
+
+
+def _widest_frontier(n_samples: int, cfg: BuildConfig) -> int:
+    widest = n_samples
+    if cfg.max_depth is not None and cfg.max_depth < 31:
+        widest = min(widest, 2 ** cfg.max_depth)
+    return max(widest, 1)
+
+
+def _table_slots(n_samples: int, cfg: BuildConfig) -> int:
+    """Per-level table width for node-assignment updates and terminal counts.
+
+    Tables are O(slots) ints — cheap — so one wide table lets a whole level's
+    update run as a single full-row pass instead of one pass per histogram
+    chunk. Capped so pathological frontiers chunk rather than explode."""
+    widest = min(_widest_frontier(n_samples, cfg), cfg.max_table_slots)
+    return 1 << max(0, math.ceil(math.log2(widest)))
 
 
 class _TreeBuffer:
     """Growable struct-of-arrays node store (host side)."""
 
-    def __init__(self, n_value_cols: int, value_dtype):
+    def __init__(self, n_value_cols: int, value_dtype, count_dtype):
         self.cap = 256
         self.n = 0
         self.feature = np.full(self.cap, -1, np.int32)
@@ -77,7 +107,7 @@ class _TreeBuffer:
         self.parent = np.full(self.cap, -1, np.int32)
         self.depth = np.zeros(self.cap, np.int32)
         self.value = np.zeros(self.cap, value_dtype)
-        self.count = np.zeros((self.cap, n_value_cols), np.int64 if value_dtype == np.int32 else np.float64)
+        self.count = np.zeros((self.cap, n_value_cols), count_dtype)
         self.n_node_samples = np.zeros(self.cap, np.int64)
 
     def ensure(self, n: int) -> None:
@@ -161,86 +191,131 @@ def build_tree(
     xb_d, y_d, w_d, nid_d = mesh_lib.shard_rows(mesh, xb, yy, w, nid)
     cand_mask_d = mesh_lib.replicate(mesh, binned.candidate_mask())
 
+    # Raw class counts stay int64 (the reference's predict_proba contract)
+    # unless fractional sample weights make them genuinely non-integral.
+    fractional_w = sample_weight is not None and not np.array_equal(
+        sample_weight, np.round(sample_weight)
+    )
     tree = _TreeBuffer(
         n_value_cols=(C if task == "classification" else 1),
         value_dtype=np.int32 if task == "classification" else np.float32,
+        count_dtype=(
+            np.float64 if (task != "classification" or fractional_w) else np.int64
+        ),
     )
     tree.ensure(1)
     tree.n = 1  # root
 
+    K = _chunk_size(N, F, B, C, cfg)
+    U = _table_slots(N, cfg)
+    split_fn = collective.make_split_fn(
+        mesh, n_slots=K, n_bins=B, n_classes=C, task=task,
+        criterion=cfg.criterion,
+    )
+    update_fn = collective.make_update_fn(mesh, n_slots=U)
+    counts_fn = collective.make_counts_fn(
+        mesh, n_slots=U, n_classes=C, task=task
+    )
+
     frontier_lo, frontier_size, depth = 0, 1, 0
     while frontier_size > 0:
-        K = _chunk_size(frontier_size, F, B, C, cfg)
-        split_fn = collective.make_split_fn(
-            mesh, n_slots=K, n_bins=B, n_classes=C, task=task,
-            criterion=cfg.criterion,
-        )
-        # Phase A: histogram + split search per chunk (device), gather to host.
-        decs = []
-        for lo in range(frontier_lo, frontier_lo + frontier_size, K):
-            d = split_fn(xb_d, y_d, nid_d, w_d, cand_mask_d, jnp.int32(lo))
-            take = min(K, frontier_lo + frontier_size - lo)
-            decs.append({k: np.asarray(v)[:take] for k, v in d._asdict().items()})
-        dec = {k: np.concatenate([c[k] for c in decs]) for k in decs[0]}
+        terminal = cfg.max_depth is not None and depth == cfg.max_depth
+
+        # Phase A: per-node statistics. Terminal levels (every node becomes a
+        # leaf) need only counts — an O(N) scatter over wide U-slot tables —
+        # while interior levels run the full O(N*F) histogram + split search
+        # in K-node chunks. All chunks are dispatched asynchronously before
+        # any device_get: per-array round trips dominate on high-latency
+        # device transports.
+        if terminal:
+            futures = [
+                (min(U, frontier_lo + frontier_size - lo),
+                 counts_fn(y_d, nid_d, w_d, np.int32(lo)))
+                for lo in range(frontier_lo, frontier_lo + frontier_size, U)
+            ]
+            counts_all = np.concatenate(
+                [jax.device_get(h)[:take] for take, h in futures]
+            )
+            dec = {"counts": counts_all}
+        else:
+            futures = [
+                (min(K, frontier_lo + frontier_size - lo),
+                 split_fn(xb_d, y_d, nid_d, w_d, cand_mask_d, np.int32(lo)))
+                for lo in range(frontier_lo, frontier_lo + frontier_size, K)
+            ]
+            decs = [
+                {k: v[:take] for k, v in jax.device_get(d)._asdict().items()}
+                for take, d in futures
+            ]
+            dec = {k: np.concatenate([c[k] for c in decs]) for k in decs[0]}
 
         # Phase B: stopping rules + node records (host, vectorized).
         ids = frontier_lo + np.arange(frontier_size)
-        n = dec["n"]
         if task == "classification":
             counts = dec["counts"]  # (S, C) integer-valued f32
+            n = counts.sum(axis=1)
             pure = (counts > 0).sum(axis=1) <= 1
             value = counts.argmax(axis=1).astype(np.int32)
         else:
             m = dec["counts"]  # (S, 3) moments
+            n = m[:, 0]
             mean = m[:, 1] / np.maximum(m[:, 0], 1.0)
-            pure = dec["y_range"] <= 0.0  # exact min==max purity
             value = mean.astype(np.float32)
-        stop = pure | dec["constant"] | (n < cfg.min_samples_split) | np.isinf(dec["cost"])
-        if cfg.max_depth is not None and depth == cfg.max_depth:
-            stop[:] = True
+        if terminal:
+            stop = np.ones(frontier_size, bool)
+        else:
+            pure = pure if task == "classification" else dec["y_range"] <= 0.0
+            stop = (
+                pure | dec["constant"] | (n < cfg.min_samples_split)
+                | np.isinf(dec["cost"])
+            )
 
-        tree.feature[ids] = np.where(stop, -1, dec["feature"]).astype(np.int32)
+        tree.feature[ids] = (
+            np.full(frontier_size, -1, np.int32) if terminal
+            else np.where(stop, -1, dec["feature"]).astype(np.int32)
+        )
         tree.value[ids] = value
         tree.n_node_samples[ids] = n.astype(np.int64)
         if task == "classification":
-            tree.count[ids] = counts.astype(np.int64)
+            tree.count[ids] = counts.astype(tree.count.dtype)
         else:
             tree.count[ids, 0] = value
 
         split_ids = ids[~stop]
-        feat = dec["feature"][~stop].astype(np.int32)
-        bins = dec["bin"][~stop].astype(np.int32)
-        tree.threshold[split_ids] = binned.thresholds[feat, bins]
-        lefts, rights = tree.alloc_children(split_ids.astype(np.int32), depth + 1)
-        tree.left[split_ids] = lefts
-        tree.right[split_ids] = rights
-
-        # Phase C: advance on-device row assignments, chunk by chunk.
         if len(split_ids):
-            update_fn = collective.make_update_fn(mesh, n_slots=K)
+            feat = dec["feature"][~stop].astype(np.int32)
+            bins = dec["bin"][~stop].astype(np.int32)
+            tree.threshold[split_ids] = binned.thresholds[feat, bins]
+            lefts, rights = tree.alloc_children(split_ids.astype(np.int32), depth + 1)
+            tree.left[split_ids] = lefts
+            tree.right[split_ids] = rights
+
+            # Phase C: advance on-device row assignments — one full-row pass
+            # per U-slot table (normally one per level). Host tables ride the
+            # jit dispatch (a single transfer) rather than explicit device_puts.
             is_split_full = ~stop
-            for lo in range(frontier_lo, frontier_lo + frontier_size, K):
-                take = min(K, frontier_lo + frontier_size - lo)
+            lr = np.zeros(frontier_size, np.int32)
+            rr = np.zeros(frontier_size, np.int32)
+            lr[np.flatnonzero(is_split_full)] = lefts
+            rr[np.flatnonzero(is_split_full)] = rights
+            for lo in range(frontier_lo, frontier_lo + frontier_size, U):
+                take = min(U, frontier_lo + frontier_size - lo)
                 sl = slice(lo - frontier_lo, lo - frontier_lo + take)
                 if not is_split_full[sl].any():
                     continue
-                is_split = np.zeros(K, bool)
-                feat_t = np.zeros(K, np.int32)
-                bin_t = np.zeros(K, np.int32)
-                left_t = np.zeros(K, np.int32)
-                right_t = np.zeros(K, np.int32)
+                is_split = np.zeros(U, bool)
+                feat_t = np.zeros(U, np.int32)
+                bin_t = np.zeros(U, np.int32)
+                left_t = np.zeros(U, np.int32)
+                right_t = np.zeros(U, np.int32)
                 is_split[:take] = is_split_full[sl]
                 feat_t[:take] = np.where(is_split_full[sl], dec["feature"][sl], 0)
                 bin_t[:take] = np.where(is_split_full[sl], dec["bin"][sl], 0)
-                lr = np.zeros(frontier_size, np.int32)
-                rr = np.zeros(frontier_size, np.int32)
-                lr[np.flatnonzero(~stop)] = lefts
-                rr[np.flatnonzero(~stop)] = rights
                 left_t[:take] = lr[sl]
                 right_t[:take] = rr[sl]
                 nid_d = update_fn(
-                    nid_d, xb_d, jnp.int32(lo),
-                    *mesh_lib.replicate(mesh, is_split, feat_t, bin_t, left_t, right_t),
+                    nid_d, xb_d, np.int32(lo),
+                    is_split, feat_t, bin_t, left_t, right_t,
                 )
 
         frontier_lo = frontier_lo + frontier_size

@@ -32,7 +32,11 @@ from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.predict import predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.utils.export import export_tree_text
-from mpitree_tpu.utils.validation import validate_fit_data, validate_predict_data
+from mpitree_tpu.utils.validation import (
+    validate_fit_data,
+    validate_predict_data,
+    validate_sample_weight,
+)
 
 
 class _ClassProperty:
@@ -96,7 +100,7 @@ class DecisionTreeClassifier(BaseEstimator, ClassifierMixin):
         )
         self.tree_ = build_tree(
             binned, y_enc, config=cfg, mesh=mesh, n_classes=len(classes),
-            sample_weight=sample_weight,
+            sample_weight=validate_sample_weight(sample_weight, X.shape[0]),
         )
         self._predict_cache = None
         return self

@@ -27,7 +27,11 @@ from mpitree_tpu.core.builder import BuildConfig, build_tree
 from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.predict import predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
-from mpitree_tpu.utils.validation import validate_fit_data, validate_predict_data
+from mpitree_tpu.utils.validation import (
+    validate_fit_data,
+    validate_predict_data,
+    validate_sample_weight,
+)
 
 
 def _n_subspace_features(max_features, n_features: int) -> int:
@@ -59,8 +63,9 @@ class _BaseForest(BaseEstimator):
         self.backend = backend
 
     def _fit_forest(self, X, y_enc, *, task, criterion, n_classes=None,
-                    refit_targets=None):
+                    refit_targets=None, sample_weight=None):
         n = X.shape[0]
+        sample_weight = validate_sample_weight(sample_weight, n)
         rng = np.random.default_rng(self.random_state)
         binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
         mesh = mesh_lib.resolve_mesh(backend=self.backend, n_devices=self.n_devices)
@@ -72,9 +77,12 @@ class _BaseForest(BaseEstimator):
 
         trees = []
         for _ in range(self.n_estimators):
-            w = None
+            # Bootstrap multiplicities compose multiplicatively with any
+            # user-provided per-sample weights.
+            w = sample_weight
             if self.bootstrap:
-                w = rng.multinomial(n, np.full(n, 1.0 / n)).astype(np.float32)
+                boot = rng.multinomial(n, np.full(n, 1.0 / n)).astype(np.float32)
+                w = boot if w is None else boot * w
             b = binned
             if k < X.shape[1]:
                 keep = np.sort(rng.choice(X.shape[1], size=k, replace=False))
@@ -121,7 +129,7 @@ class RandomForestClassifier(_BaseForest, ClassifierMixin):
         self.classes_ = classes
         self.trees_ = self._fit_forest(
             X, y_enc, task="classification", criterion=self.criterion,
-            n_classes=len(classes),
+            n_classes=len(classes), sample_weight=sample_weight,
         )
         return self
 
@@ -163,7 +171,7 @@ class RandomForestRegressor(_BaseForest, RegressorMixin):
         self._y_mean = float(y64.mean()) if len(y64) else 0.0
         self.trees_ = self._fit_forest(
             X, (y64 - self._y_mean).astype(np.float32), task="regression",
-            criterion="mse", refit_targets=y64,
+            criterion="mse", refit_targets=y64, sample_weight=sample_weight,
         )
         return self
 

@@ -25,7 +25,11 @@ from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.predict import predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.utils.export import export_tree_text
-from mpitree_tpu.utils.validation import validate_fit_data, validate_predict_data
+from mpitree_tpu.utils.validation import (
+    validate_fit_data,
+    validate_predict_data,
+    validate_sample_weight,
+)
 
 
 class DecisionTreeRegressor(BaseEstimator, RegressorMixin):
@@ -68,7 +72,7 @@ class DecisionTreeRegressor(BaseEstimator, RegressorMixin):
         )
         self.tree_ = build_tree(
             binned, (y64 - y_mean).astype(np.float32), config=cfg, mesh=mesh,
-            sample_weight=sample_weight, refit_targets=y64,
+            sample_weight=validate_sample_weight(sample_weight, X.shape[0]), refit_targets=y64,
         )
         self._predict_cache = None
         return self

@@ -89,21 +89,46 @@ def best_split_classification(
 
     Parameters
     ----------
-    hist : (K, F, B, C) float32 — from :func:`histogram.class_histogram`.
+    hist : (K, F, C, B) float32 — from :func:`histogram.class_histogram`
+        (bins last for TPU lane alignment).
     cand_mask : (F, B) bool — valid candidate bins (from
         :meth:`BinnedData.candidate_mask`).
     """
-    left = jnp.cumsum(hist, axis=2)  # (K, F, B, C)
-    parent = left[:, :, -1, :]  # (K, F, C) — identical across F
-    right = parent[:, :, None, :] - left
+    # Memory-lean formulation: materializing left/right (K,F,B,C) cumsums and
+    # per-side impurity stacks peaks at ~18 histogram-sized buffers under the
+    # AOT allocator and OOMs at covtype scale. Instead accumulate the per-side
+    # impurities class by class (unrolled — class counts are small): only
+    # (K,F,B)-sized accumulators stay live, per-class cumsums are transient,
+    # and the arithmetic on bounded p in [0,1] is float-identical to the
+    # textbook -sum(p*log2 p) form, so reference tie-break parity survives.
+    if criterion not in ("entropy", "gini"):
+        raise ValueError(f"unknown classification criterion: {criterion!r}")
+    hist_sum = hist.sum(axis=2)  # (K, F, B)
+    n_l = jnp.cumsum(hist_sum, axis=2)
+    n_tot = n_l[:, :, -1:]  # (K, F, 1)
+    n_r = n_tot - n_l
+    inv_l = 1.0 / jnp.maximum(n_l, 1.0)
+    inv_r = 1.0 / jnp.maximum(n_r, 1.0)
 
-    n_l = left.sum(axis=-1)
-    n_r = right.sum(axis=-1)
-    n = n_l + n_r  # (K, F, B) — constant across (F, B)
+    C = hist.shape[2]
+    h_l = jnp.zeros_like(n_l)  # accumulates -sum_c p log2 p  (or sum p^2)
+    h_r = jnp.zeros_like(n_l)
+    for c in range(C):
+        l_c = jnp.cumsum(hist[:, :, c, :], axis=2)
+        r_c = l_c[:, :, -1:] - l_c
+        p_l = l_c * inv_l
+        p_r = r_c * inv_r
+        if criterion == "entropy":
+            h_l -= jnp.where(l_c > 0, p_l * jnp.log2(jnp.maximum(p_l, 1e-38)), 0.0)
+            h_r -= jnp.where(r_c > 0, p_r * jnp.log2(jnp.maximum(p_r, 1e-38)), 0.0)
+        else:
+            h_l += p_l * p_l
+            h_r += p_r * p_r
 
-    h_l = class_impurity(left, n_l, criterion)
-    h_r = class_impurity(right, n_r, criterion)
-    cost = (n_l * h_l + n_r * h_r) / jnp.maximum(n, 1.0)
+    if criterion == "gini":
+        h_l = 1.0 - h_l
+        h_r = 1.0 - h_r
+    cost = (n_l * h_l + n_r * h_r) / jnp.maximum(n_tot, 1.0)
 
     valid = cand_mask[None, :, :] & (n_l > 0) & (n_r > 0)
     cost = jnp.where(valid, cost, jnp.inf)
@@ -114,11 +139,11 @@ def best_split_classification(
     best_bin = jnp.take_along_axis(best_bin_f, best_feature[:, None], axis=1)[:, 0]
     best_cost = jnp.take_along_axis(best_cost_f, best_feature[:, None], axis=1)[:, 0]
 
-    parent_counts = parent[:, 0, :]  # (K, C)
+    parent_counts = hist[:, 0, :, :].sum(axis=-1)  # (K, C) — bins summed out
     parent_n = parent_counts.sum(axis=-1)
     parent_impurity = class_impurity(parent_counts, parent_n, criterion)
 
-    occupied = (hist.sum(axis=-1) > 0).sum(axis=2)  # (K, F) occupied bins
+    occupied = (hist_sum > 0).sum(axis=2)  # (K, F) occupied bins
     constant = (occupied <= 1).all(axis=1)
 
     return SplitDecision(
@@ -138,28 +163,28 @@ def best_split_regression(hist: jax.Array, cand_mask: jax.Array) -> SplitDecisio
 
     Parameters
     ----------
-    hist : (K, F, B, 3) float32 — from :func:`histogram.moment_histogram`;
-        channels are (weight, weight*y, weight*y^2).
+    hist : (K, F, 3, B) float32 — from :func:`histogram.moment_histogram`;
+        channels are (weight, weight*y, weight*y^2), bins last for TPU lane
+        alignment.
 
     Cost of a candidate is the weighted child variance
     ``(SSE_left + SSE_right) / n`` where ``SSE = sum(y^2) - sum(y)^2 / n`` —
     the histogram form of sklearn's ``squared_error`` improvement. Parent
     ``impurity`` is the node variance (MSE around the node mean).
     """
-    left = jnp.cumsum(hist, axis=2)  # (K, F, B, 3)
-    parent = left[:, :, -1, :]
-    right = parent[:, :, None, :] - left
+    w_l = jnp.cumsum(hist[:, :, 0, :], axis=2)  # (K, F, B)
+    s_l = jnp.cumsum(hist[:, :, 1, :], axis=2)
+    q_l = jnp.cumsum(hist[:, :, 2, :], axis=2)
+    w_t, s_t, q_t = w_l[:, :, -1:], s_l[:, :, -1:], q_l[:, :, -1:]
+    w_r, s_r, q_r = w_t - w_l, s_t - s_l, q_t - q_l
 
-    def sse(m):
-        w, s, s2 = m[..., 0], m[..., 1], m[..., 2]
-        return jnp.maximum(s2 - s * s / jnp.maximum(w, 1.0), 0.0)
+    def sse(w, s, q):
+        return jnp.maximum(q - s * s / jnp.maximum(w, 1.0), 0.0)
 
-    n_l = left[..., 0]
-    n_r = right[..., 0]
-    n = n_l + n_r
-    cost = (sse(left) + sse(right)) / jnp.maximum(n, 1.0)
+    n = jnp.maximum(w_t, 1.0)
+    cost = (sse(w_l, s_l, q_l) + sse(w_r, s_r, q_r)) / n
 
-    valid = cand_mask[None, :, :] & (n_l > 0) & (n_r > 0)
+    valid = cand_mask[None, :, :] & (w_l > 0) & (w_r > 0)
     cost = jnp.where(valid, cost, jnp.inf)
 
     best_bin_f = jnp.argmin(cost, axis=2)
@@ -168,11 +193,14 @@ def best_split_regression(hist: jax.Array, cand_mask: jax.Array) -> SplitDecisio
     best_bin = jnp.take_along_axis(best_bin_f, best_feature[:, None], axis=1)[:, 0]
     best_cost = jnp.take_along_axis(best_cost_f, best_feature[:, None], axis=1)[:, 0]
 
-    parent_moments = parent[:, 0, :]  # (K, 3)
+    parent_moments = hist[:, 0, :, :].sum(axis=-1)  # (K, 3)
     parent_n = parent_moments[..., 0]
-    parent_impurity = sse(parent_moments) / jnp.maximum(parent_n, 1.0)
+    parent_impurity = (
+        sse(parent_moments[..., 0], parent_moments[..., 1], parent_moments[..., 2])
+        / jnp.maximum(parent_n, 1.0)
+    )
 
-    occupied = (hist[..., 0] > 0).sum(axis=2)
+    occupied = (hist[:, :, 0, :] > 0).sum(axis=2)
     constant = (occupied <= 1).all(axis=1)
 
     return SplitDecision(

@@ -81,6 +81,41 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
 
 
 @lru_cache(maxsize=64)
+def make_counts_fn(mesh, *, n_slots: int, n_classes: int, task: str):
+    """Jitted (y, node_id, weight, chunk_lo) -> per-slot statistics only.
+
+    Terminal tree levels (depth == max_depth) become leaves unconditionally,
+    so the full (slot, feature, bin) split histogram is wasted there — this
+    computes just the per-node class counts (or regression moments), an
+    O(N) scatter instead of O(N*F).
+    """
+
+    def local_counts(y, nid, w, chunk_lo):
+        slot = nid - chunk_lo
+        valid = (slot >= 0) & (slot < n_slots)
+        wv = jnp.where(valid, w, 0.0)
+        if task == "classification":
+            ids = jnp.where(valid, slot * n_classes + y, 0)
+            h = jax.ops.segment_sum(wv, ids, num_segments=n_slots * n_classes)
+            h = h.reshape(n_slots, n_classes)
+        else:
+            y32 = y.astype(jnp.float32)
+            data = jnp.stack([wv, wv * y32, wv * y32 * y32], axis=-1)
+            h = jax.ops.segment_sum(
+                data, jnp.where(valid, slot, 0), num_segments=n_slots
+            )
+        return lax.psum(h, DATA_AXIS)
+
+    sharded = jax.shard_map(
+        local_counts,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+        out_specs=P(),
+    )
+    return jax.jit(sharded)
+
+
+@lru_cache(maxsize=64)
 def make_update_fn(mesh, *, n_slots: int):
     """Jitted node-assignment advance for one frontier chunk.
 

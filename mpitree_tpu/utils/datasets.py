@@ -1,0 +1,89 @@
+"""Benchmark datasets.
+
+The north-star workload is covtype (581012 x 54; 10 quantitative + 44 binary
+one-hot soil/wilderness columns; 7 imbalanced classes — the BASELINE.json
+target). The benchmark environment has no network, so ``covtype_like``
+generates a deterministic stand-in with the same shape and the same
+*structure*: continuous features with heterogeneous scales, one-hot binary
+blocks derived from latent categories, and labels produced by a noisy
+axis-aligned decision structure (so depth-20 trees are meaningfully better
+than shallow ones, as on real covtype). ``load_covtype`` prefers the real
+dataset when a cached copy exists.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def covtype_like(n_samples: int = 581012, seed: int = 0):
+    """Deterministic covtype-shaped classification problem (n x 54, 7 classes)."""
+    rng = np.random.default_rng(seed)
+    n = n_samples
+
+    # 10 quantitative columns with covtype-ish heterogeneous scales.
+    elev = rng.normal(2800, 400, n)
+    aspect = rng.uniform(0, 360, n)
+    slope = rng.gamma(2.0, 7.0, n)
+    h_hydro = rng.gamma(1.5, 180.0, n)
+    v_hydro = rng.normal(45, 60, n)
+    h_road = rng.gamma(1.8, 1300.0, n)
+    hill_9 = np.clip(rng.normal(212, 27, n), 0, 254)
+    hill_noon = np.clip(rng.normal(223, 20, n), 0, 254)
+    hill_3 = np.clip(rng.normal(143, 38, n), 0, 254)
+    h_fire = rng.gamma(1.7, 1100.0, n)
+    quant = np.column_stack(
+        [elev, aspect, slope, h_hydro, v_hydro, h_road, hill_9, hill_noon,
+         hill_3, h_fire]
+    )
+
+    # 4 wilderness-area + 40 soil-type one-hot columns from latent categories
+    # correlated with elevation (as in the real data).
+    wild_logits = rng.normal(size=(n, 4)) + np.column_stack(
+        [elev / 400.0, -elev / 800.0, np.zeros(n), np.zeros(n)]
+    )
+    wild = np.eye(4, dtype=np.float64)[wild_logits.argmax(1)]
+    soil_latent = (elev - 1800) / 250.0 + rng.normal(0, 2.0, n)
+    soil_idx = np.clip(soil_latent.astype(int) % 40, 0, 39)
+    soil = np.zeros((n, 40))
+    soil[np.arange(n), soil_idx] = 1.0
+
+    X = np.column_stack([quant, wild, soil]).astype(np.float32)
+
+    # Labels: noisy axis-aligned rules on several features (tree-learnable,
+    # imbalanced like covtype's 7 cover types).
+    score = np.zeros(n)
+    score += 2.0 * (elev > 3000)
+    score += 1.0 * (elev > 3250)
+    score -= 1.5 * (elev < 2400)
+    score += 1.0 * (h_hydro < 120)
+    score -= 1.0 * (slope > 22)
+    score += 0.8 * (hill_noon > 230)
+    score += 0.6 * wild[:, 0] - 0.7 * wild[:, 3]
+    score += 0.4 * ((soil_idx >= 20) & (soil_idx < 30))
+    score += rng.normal(0, 0.55, n)
+    edges = np.quantile(score, [0.365, 0.852, 0.913, 0.918, 0.934, 0.966])
+    y = np.searchsorted(edges, score).astype(np.int64)
+    return X, y
+
+
+def load_covtype(n_samples: int | None = None, seed: int = 0):
+    """Real covtype when a cached copy exists; covtype_like otherwise.
+
+    Returns (X, y, name) with y relabelled to 0..6.
+    """
+    try:
+        from sklearn.datasets import fetch_covtype
+
+        d = fetch_covtype(download_if_missing=False)
+        X = d.data.astype(np.float32)
+        y = (d.target - 1).astype(np.int64)
+        name = "covtype"
+    except Exception:
+        X, y = covtype_like(581012 if n_samples is None else n_samples, seed)
+        name = "covtype_like"
+    if n_samples is not None and len(X) > n_samples:
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(len(X))[:n_samples]
+        X, y = X[idx], y[idx]
+    return X, y, name

@@ -34,6 +34,19 @@ def validate_fit_data(X, y, *, task: str = "classification"):
     return X, np.ascontiguousarray(y, dtype=np.float64), None
 
 
+def validate_sample_weight(sample_weight, n_samples: int):
+    if sample_weight is None:
+        return None
+    w = np.asarray(sample_weight, dtype=np.float32)
+    if w.shape != (n_samples,):
+        raise ValueError(
+            f"sample_weight has shape {w.shape}, expected ({n_samples},)"
+        )
+    if (w < 0).any() or not np.isfinite(w).all():
+        raise ValueError("sample_weight must be finite and non-negative")
+    return w
+
+
 def validate_predict_data(X, n_features: int):
     X = check_array(X, dtype="numeric")
     if X.shape[1] != n_features:

@@ -219,3 +219,26 @@ def test_quantile_mode_close_to_exact(iris_full):
                                    max_bins=16).fit(X, y)
     agree = (exact.predict(X) == quant.predict(X)).mean()
     assert agree > 0.9
+
+
+def test_fractional_sample_weight_not_truncated():
+    """Float weights must survive into counts (no int64 flooring)."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(100, 3))
+    y = rng.integers(0, 2, size=100)
+    clf = DecisionTreeClassifier(max_depth=3).fit(X, y, sample_weight=np.full(100, 0.5))
+    proba = clf.predict_proba(X)
+    assert proba.dtype == np.float64
+    assert (proba.sum(axis=1) > 0).all()
+    # weighting uniformly by 0.5 must not change the tree shape
+    base = DecisionTreeClassifier(max_depth=3).fit(X, y)
+    np.testing.assert_array_equal(clf.tree_.feature, base.tree_.feature)
+
+
+def test_bad_sample_weight_rejected():
+    X = np.zeros((5, 2))
+    y = np.arange(5) % 2
+    with pytest.raises(ValueError):
+        DecisionTreeClassifier().fit(X, y, sample_weight=np.ones(3))
+    with pytest.raises(ValueError):
+        DecisionTreeClassifier().fit(X, y, sample_weight=-np.ones(5))

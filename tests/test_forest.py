@@ -69,3 +69,15 @@ def test_max_features_subspace():
     for t in f.trees_:
         used = set(t.feature[t.feature >= 0].tolist())
         assert len(used) <= 2
+
+
+def test_forest_sample_weight_has_effect():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(300, 5))
+    y = (X[:, 0] > 0).astype(int)
+    w = np.where(y == 1, 10.0, 0.1)  # drown out class 0
+    f = RandomForestClassifier(n_estimators=5, max_depth=3, random_state=0,
+                               bootstrap=False).fit(X, y, sample_weight=w)
+    base = RandomForestClassifier(n_estimators=5, max_depth=3, random_state=0,
+                                  bootstrap=False).fit(X, y)
+    assert (f.predict(X) == 1).mean() > (base.predict(X) == 1).mean()

@@ -37,10 +37,10 @@ def test_histogram_counts():
     X = np.array([[0, 1], [1, 1], [2, 0], [0, 0]], np.int32)
     y = np.array([0, 1, 1, 0], np.int32)
     h = np.asarray(_hist_for(X, y, 1, 3, 2))
-    assert h.shape == (1, 2, 3, 2)
+    assert h.shape == (1, 2, 2, 3)  # (slots, features, classes, bins)
     assert h[0, 0, 0, 0] == 2  # rows 0,3 in bin 0 of feature 0, class 0
-    assert h[0, 0, 1, 1] == 1
-    assert h[0, 1, 1, 0] == 1  # row 0: feature 1 bin 1 class 0
+    assert h[0, 0, 1, 1] == 1  # row 1: feature 0 bin 1, class 1
+    assert h[0, 1, 0, 1] == 1  # row 0: feature 1 bin 1, class 0
     assert h.sum() == 2 * 4  # every row counted once per feature
 
 
