@@ -1,0 +1,25 @@
+# Build/dev targets — parity-plus with the reference Makefile (reference:
+# Makefile:1-8 offers only `build` (conda env) and `clean`). This framework's
+# dependencies are preinstalled (jax/flax/optax/...); targets cover the dev
+# loop the reference lacked: tests, lint, benchmark.
+
+PY ?= python
+
+.PHONY: test test-cpu lint bench clean
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+# Same suite on a virtual 8-device CPU mesh (what tests/conftest.py forces);
+# alias kept for discoverability on machines with a TPU attached.
+test-cpu: test
+
+lint:
+	ruff check mpitree_tpu tests bench.py
+
+bench:
+	$(PY) bench.py
+
+clean:
+	find . -type d \( -name "__pycache__" -o -name ".pytest_cache" \
+	  -o -name ".ruff_cache" \) -exec rm -rf {} +

@@ -30,6 +30,7 @@ from mpitree_tpu.models.classifier import (
 )
 from mpitree_tpu.models.forest import RandomForestClassifier, RandomForestRegressor
 from mpitree_tpu.models.regressor import DecisionTreeRegressor
+from mpitree_tpu.utils.serialize import load_model, save_model
 
 __version__ = "0.1.0"
 
@@ -39,4 +40,6 @@ __all__ = [
     "DecisionTreeRegressor",
     "RandomForestClassifier",
     "RandomForestRegressor",
+    "save_model",
+    "load_model",
 ]

@@ -35,6 +35,7 @@ import numpy as np
 from mpitree_tpu.core.tree_struct import TreeArrays
 from mpitree_tpu.ops.binning import BinnedData
 from mpitree_tpu.parallel import collective, mesh as mesh_lib
+from mpitree_tpu.utils.profiling import PhaseTimer, debug_checks_enabled
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,6 +53,10 @@ class BuildConfig:
     # and terminates via the singleton/constant rules instead, which preserves
     # exact memorization; classification purity is exact from counts.
     var_rel_tol: float = 1e-9
+    # Runtime determinism check: assert on-device that every mesh device
+    # selected the identical split (SURVEY.md §5). Also forced on by
+    # MPITREE_TPU_DEBUG=1.
+    debug: bool = False
 
 
 def _chunk_size(n_samples: int, n_feat: int, n_bins: int, n_chan: int,
@@ -161,6 +166,7 @@ def build_tree(
     n_classes: int | None = None,
     sample_weight: np.ndarray | None = None,
     refit_targets: np.ndarray | None = None,
+    timer: PhaseTimer | None = None,
 ) -> TreeArrays:
     """Grow one tree level-synchronously; returns host struct-of-arrays.
 
@@ -169,8 +175,13 @@ def build_tree(
     f32 moment histograms drive split *selection*, but leaf/interior means come
     from an exact host-side f64 pass, so predictions carry no cancellation
     noise.
+
+    ``timer``: optional :class:`PhaseTimer` that accumulates per-phase
+    wall-clock (shard / split / counts / update).
     """
     cfg = config
+    timer = timer if timer is not None else PhaseTimer(enabled=False)
+    debug = cfg.debug or debug_checks_enabled()
     task = cfg.task
     N, F = binned.x_binned.shape
     B = binned.n_bins
@@ -188,8 +199,9 @@ def build_tree(
         yy = np.concatenate([yy, np.zeros(pad, yy.dtype)])
         w = np.concatenate([w, np.zeros(pad, np.float32)])
         nid = np.concatenate([nid, np.full(pad, -1, np.int32)])
-    xb_d, y_d, w_d, nid_d = mesh_lib.shard_rows(mesh, xb, yy, w, nid)
-    cand_mask_d = mesh_lib.replicate(mesh, binned.candidate_mask())
+    with timer.phase("shard"):
+        xb_d, y_d, w_d, nid_d = mesh_lib.shard_rows(mesh, xb, yy, w, nid)
+        cand_mask_d = mesh_lib.replicate(mesh, binned.candidate_mask())
 
     # Raw class counts stay int64 (the reference's predict_proba contract)
     # unless fractional sample weights make them genuinely non-integral.
@@ -210,7 +222,7 @@ def build_tree(
     U = _table_slots(N, cfg)
     split_fn = collective.make_split_fn(
         mesh, n_slots=K, n_bins=B, n_classes=C, task=task,
-        criterion=cfg.criterion,
+        criterion=cfg.criterion, debug=debug,
     )
     update_fn = collective.make_update_fn(mesh, n_slots=U)
     counts_fn = collective.make_counts_fn(
@@ -228,25 +240,36 @@ def build_tree(
         # any device_get: per-array round trips dominate on high-latency
         # device transports.
         if terminal:
-            futures = [
-                (min(U, frontier_lo + frontier_size - lo),
-                 counts_fn(y_d, nid_d, w_d, np.int32(lo)))
-                for lo in range(frontier_lo, frontier_lo + frontier_size, U)
-            ]
-            counts_all = np.concatenate(
-                [jax.device_get(h)[:take] for take, h in futures]
-            )
+            with timer.phase("counts"):
+                futures = [
+                    (min(U, frontier_lo + frontier_size - lo),
+                     counts_fn(y_d, nid_d, w_d, np.int32(lo)))
+                    for lo in range(frontier_lo, frontier_lo + frontier_size, U)
+                ]
+                counts_all = np.concatenate(
+                    [jax.device_get(h)[:take] for take, h in futures]
+                )
             dec = {"counts": counts_all}
         else:
-            futures = [
-                (min(K, frontier_lo + frontier_size - lo),
-                 split_fn(xb_d, y_d, nid_d, w_d, cand_mask_d, np.int32(lo)))
-                for lo in range(frontier_lo, frontier_lo + frontier_size, K)
-            ]
-            decs = [
-                {k: v[:take] for k, v in jax.device_get(d)._asdict().items()}
-                for take, d in futures
-            ]
+            with timer.phase("split"):
+                futures = [
+                    (min(K, frontier_lo + frontier_size - lo),
+                     split_fn(xb_d, y_d, nid_d, w_d, cand_mask_d, np.int32(lo)))
+                    for lo in range(frontier_lo, frontier_lo + frontier_size, K)
+                ]
+                if debug:
+                    errs = [float(jax.device_get(e)) for _, (_, e) in futures]
+                    if any(e != 0.0 for e in errs):
+                        raise RuntimeError(
+                            "determinism check failed: split decisions diverged "
+                            f"across mesh devices (level depth={depth}, "
+                            f"errs={errs})"
+                        )
+                    futures = [(take, d) for take, (d, _) in futures]
+                decs = [
+                    {k: v[:take] for k, v in jax.device_get(d)._asdict().items()}
+                    for take, d in futures
+                ]
             dec = {k: np.concatenate([c[k] for c in decs]) for k in decs[0]}
 
         # Phase B: stopping rules + node records (host, vectorized).
@@ -298,25 +321,26 @@ def build_tree(
             rr = np.zeros(frontier_size, np.int32)
             lr[np.flatnonzero(is_split_full)] = lefts
             rr[np.flatnonzero(is_split_full)] = rights
-            for lo in range(frontier_lo, frontier_lo + frontier_size, U):
-                take = min(U, frontier_lo + frontier_size - lo)
-                sl = slice(lo - frontier_lo, lo - frontier_lo + take)
-                if not is_split_full[sl].any():
-                    continue
-                is_split = np.zeros(U, bool)
-                feat_t = np.zeros(U, np.int32)
-                bin_t = np.zeros(U, np.int32)
-                left_t = np.zeros(U, np.int32)
-                right_t = np.zeros(U, np.int32)
-                is_split[:take] = is_split_full[sl]
-                feat_t[:take] = np.where(is_split_full[sl], dec["feature"][sl], 0)
-                bin_t[:take] = np.where(is_split_full[sl], dec["bin"][sl], 0)
-                left_t[:take] = lr[sl]
-                right_t[:take] = rr[sl]
-                nid_d = update_fn(
-                    nid_d, xb_d, np.int32(lo),
-                    is_split, feat_t, bin_t, left_t, right_t,
-                )
+            with timer.phase("update"):
+                for lo in range(frontier_lo, frontier_lo + frontier_size, U):
+                    take = min(U, frontier_lo + frontier_size - lo)
+                    sl = slice(lo - frontier_lo, lo - frontier_lo + take)
+                    if not is_split_full[sl].any():
+                        continue
+                    is_split = np.zeros(U, bool)
+                    feat_t = np.zeros(U, np.int32)
+                    bin_t = np.zeros(U, np.int32)
+                    left_t = np.zeros(U, np.int32)
+                    right_t = np.zeros(U, np.int32)
+                    is_split[:take] = is_split_full[sl]
+                    feat_t[:take] = np.where(is_split_full[sl], dec["feature"][sl], 0)
+                    bin_t[:take] = np.where(is_split_full[sl], dec["bin"][sl], 0)
+                    left_t[:take] = lr[sl]
+                    right_t[:take] = rr[sl]
+                    nid_d = update_fn(
+                        nid_d, xb_d, np.int32(lo),
+                        is_split, feat_t, bin_t, left_t, right_t,
+                    )
 
         frontier_lo = frontier_lo + frontier_size
         frontier_size = 2 * len(split_ids)

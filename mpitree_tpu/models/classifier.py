@@ -32,6 +32,7 @@ from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.predict import predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.utils.export import export_tree_text
+from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
 from mpitree_tpu.utils.validation import (
     validate_fit_data,
     validate_predict_data,
@@ -90,7 +91,9 @@ class DecisionTreeClassifier(BaseEstimator, ClassifierMixin):
         self.n_features_in_ = X.shape[1]
         self.classes_ = classes
 
-        binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
+        timer = PhaseTimer(enabled=profiling_enabled())
+        with timer.phase("bin"):
+            binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
         mesh = mesh_lib.resolve_mesh(backend=self.backend, n_devices=self.n_devices)
         cfg = BuildConfig(
             task="classification",
@@ -101,7 +104,9 @@ class DecisionTreeClassifier(BaseEstimator, ClassifierMixin):
         self.tree_ = build_tree(
             binned, y_enc, config=cfg, mesh=mesh, n_classes=len(classes),
             sample_weight=validate_sample_weight(sample_weight, X.shape[0]),
+            timer=timer,
         )
+        self.fit_stats_ = timer.summary() if timer.enabled else None
         self._predict_cache = None
         return self
 

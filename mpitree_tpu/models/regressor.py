@@ -25,6 +25,7 @@ from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.predict import predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.utils.export import export_tree_text
+from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
 from mpitree_tpu.utils.validation import (
     validate_fit_data,
     validate_predict_data,
@@ -62,7 +63,9 @@ class DecisionTreeRegressor(BaseEstimator, RegressorMixin):
         y_mean = float(y64.mean()) if len(y64) else 0.0
         self._y_mean = y_mean
 
-        binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
+        timer = PhaseTimer(enabled=profiling_enabled())
+        with timer.phase("bin"):
+            binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
         mesh = mesh_lib.resolve_mesh(backend=self.backend, n_devices=self.n_devices)
         cfg = BuildConfig(
             task="regression",
@@ -72,8 +75,10 @@ class DecisionTreeRegressor(BaseEstimator, RegressorMixin):
         )
         self.tree_ = build_tree(
             binned, (y64 - y_mean).astype(np.float32), config=cfg, mesh=mesh,
-            sample_weight=validate_sample_weight(sample_weight, X.shape[0]), refit_targets=y64,
+            sample_weight=validate_sample_weight(sample_weight, X.shape[0]),
+            refit_targets=y64, timer=timer,
         )
+        self.fit_stats_ = timer.summary() if timer.enabled else None
         self._predict_cache = None
         return self
 

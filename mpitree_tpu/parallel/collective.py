@@ -31,12 +31,17 @@ from jax.sharding import PartitionSpec as P
 from mpitree_tpu.ops import histogram as hist_ops
 from mpitree_tpu.ops import impurity as imp_ops
 from mpitree_tpu.parallel.mesh import DATA_AXIS
+from mpitree_tpu.utils import profiling
 
 
 @lru_cache(maxsize=64)
 def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
-                  task: str, criterion: str):
-    """Jitted (x_binned, y, node_id, weight, cand_mask, chunk_lo) -> SplitDecision."""
+                  task: str, criterion: str, debug: bool = False):
+    """Jitted (x_binned, y, node_id, weight, cand_mask, chunk_lo) -> SplitDecision.
+
+    With ``debug=True`` the result is ``(SplitDecision, repl_err)`` where
+    ``repl_err`` must be 0: the determinism check that every device computed
+    the identical split (SURVEY.md §5 race-detection analogue)."""
 
     def local_step(xb, y, nid, w, cand_mask, chunk_lo):
         if task == "classification":
@@ -46,36 +51,43 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                 sample_weight=w,
             )
             h = lax.psum(h, DATA_AXIS)
-            return imp_ops.best_split_classification(h, cand_mask, criterion=criterion)
-        h = hist_ops.moment_histogram(
-            xb, y, nid, chunk_lo, n_slots=n_slots, n_bins=n_bins, sample_weight=w,
-        )
-        h = lax.psum(h, DATA_AXIS)
-        dec = imp_ops.best_split_regression(h, cand_mask)
-        # Exact per-node target spread (pmin/pmax over ICI): the regression
-        # purity stop f32 moment variance cannot provide. Zero-weight rows
-        # (bootstrap out-of-bag) are excluded — they don't affect the fit.
-        slot = nid - chunk_lo
-        valid = (slot >= 0) & (slot < n_slots) & (w > 0)
-        s = jnp.clip(slot, 0, n_slots - 1)
-        y32 = y.astype(jnp.float32)
-        ymin = jax.ops.segment_min(
-            jnp.where(valid, y32, jnp.inf), s, num_segments=n_slots
-        )
-        ymax = jax.ops.segment_max(
-            jnp.where(valid, y32, -jnp.inf), s, num_segments=n_slots
-        )
-        ymin = lax.pmin(ymin, DATA_AXIS)
-        ymax = lax.pmax(ymax, DATA_AXIS)
-        y_range = jnp.where(ymax >= ymin, ymax - ymin, 0.0)
-        return dec._replace(y_range=y_range)
+            dec = imp_ops.best_split_classification(h, cand_mask, criterion=criterion)
+        else:
+            h = hist_ops.moment_histogram(
+                xb, y, nid, chunk_lo, n_slots=n_slots, n_bins=n_bins,
+                sample_weight=w,
+            )
+            h = lax.psum(h, DATA_AXIS)
+            dec = imp_ops.best_split_regression(h, cand_mask)
+            # Exact per-node target spread (pmin/pmax over ICI): the regression
+            # purity stop f32 moment variance cannot provide. Zero-weight rows
+            # (bootstrap out-of-bag) are excluded — they don't affect the fit.
+            slot = nid - chunk_lo
+            valid = (slot >= 0) & (slot < n_slots) & (w > 0)
+            s = jnp.clip(slot, 0, n_slots - 1)
+            y32 = y.astype(jnp.float32)
+            ymin = jax.ops.segment_min(
+                jnp.where(valid, y32, jnp.inf), s, num_segments=n_slots
+            )
+            ymax = jax.ops.segment_max(
+                jnp.where(valid, y32, -jnp.inf), s, num_segments=n_slots
+            )
+            ymin = lax.pmin(ymin, DATA_AXIS)
+            ymax = lax.pmax(ymax, DATA_AXIS)
+            y_range = jnp.where(ymax >= ymin, ymax - ymin, 0.0)
+            dec = dec._replace(y_range=y_range)
+        if debug:
+            fp = profiling.replication_fingerprint(dec.feature, dec.bin, dec.n)
+            return dec, profiling.assert_replicated(fp, DATA_AXIS)
+        return dec
 
+    dec_specs = imp_ops.SplitDecision(*([P()] * 8))
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                   P(), P()),
-        out_specs=imp_ops.SplitDecision(*([P()] * 8)),
+        out_specs=(dec_specs, P()) if debug else dec_specs,
     )
     return jax.jit(sharded)
 

@@ -1,0 +1,110 @@
+"""Tracing/profiling + the debug determinism check the reference lacks.
+
+SURVEY.md §5: the reference's only observability is a hand-run ``time.time()``
+sweep in a notebook, and nothing verifies its replicated-determinism
+correctness invariant. Here:
+
+- :class:`PhaseTimer` collects per-phase wall-clock (bin / shard / split /
+  counts / update) for a build; estimators expose it as ``fit_stats_`` when
+  ``MPITREE_TPU_PROFILE=1``. Library callers can pass their own timer to
+  ``build_tree(..., timer=...)``.
+- :func:`trace` wraps ``jax.profiler.trace`` for device-level traces viewable
+  in TensorBoard/Perfetto.
+- :func:`assert_replicated` is the race-detection analogue: in debug mode the
+  builder asserts that the split decision every device computed is identical
+  (``psum`` of a per-device fingerprint must equal ``n_devices * fingerprint``)
+  — the XLA restatement of the reference's every-rank-agrees contract
+  (reference: ``mpitree/tree/decision_tree.py:408-419``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def profiling_enabled() -> bool:
+    return os.environ.get("MPITREE_TPU_PROFILE", "") not in ("", "0")
+
+
+def debug_checks_enabled() -> bool:
+    return os.environ.get("MPITREE_TPU_DEBUG", "") not in ("", "0")
+
+
+class PhaseTimer:
+    """Accumulates wall-clock seconds and call counts per named phase."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.seconds: dict = defaultdict(float)
+        self.calls: dict = defaultdict(int)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.seconds[name] += time.perf_counter() - t0
+            self.calls[name] += 1
+
+    def summary(self) -> dict:
+        return {
+            name: {"seconds": round(self.seconds[name], 4), "calls": self.calls[name]}
+            for name in sorted(self.seconds)
+        }
+
+    def __repr__(self):
+        total = sum(self.seconds.values())
+        rows = [
+            f"  {name:<12} {self.seconds[name]:8.3f}s  x{self.calls[name]}"
+            for name in sorted(self.seconds, key=self.seconds.get, reverse=True)
+        ]
+        return "PhaseTimer(total={:.3f}s\n{}\n)".format(total, "\n".join(rows))
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Device-level profiler trace (TensorBoard/Perfetto), or no-op if the
+    profiler is unavailable on the current platform. Exceptions raised by the
+    traced block propagate unchanged."""
+    ctx = jax.profiler.trace(log_dir)
+    try:
+        ctx.__enter__()
+        entered = True
+    except Exception:
+        entered = False
+    try:
+        yield
+    finally:
+        if entered:
+            ctx.__exit__(None, None, None)
+
+
+def replication_fingerprint(*arrays) -> jax.Array:
+    """Order-sensitive fingerprint of per-device integer-valued arrays (call
+    inside shard_map). Returns a small integer as f32 (< 2**16) so that
+    ``psum`` over any mesh size and reduction order is *exact* — a float-sum
+    fingerprint would trip the check on benign reduction rounding."""
+    acc = jnp.uint32(0)
+    for a in arrays:
+        ai = a.astype(jnp.int32).ravel().astype(jnp.uint32)
+        weights = (jnp.arange(ai.shape[0], dtype=jnp.uint32) % 8191) + 1
+        acc = acc + jnp.sum(ai * weights)  # wraps mod 2**32, deterministic
+    return (acc % jnp.uint32(1 << 16)).astype(jnp.float32)
+
+
+def assert_replicated(fingerprint: jax.Array, axis: str) -> jax.Array:
+    """Inside shard_map: returns |psum(fp) - n*fp|, which must be 0 when the
+    value is truly replicated. The caller checks the hostside result."""
+    n = lax.psum(jnp.float32(1), axis)
+    return jnp.abs(lax.psum(fingerprint, axis) - n * fingerprint)

@@ -1,0 +1,135 @@
+"""Model persistence — the checkpoint/resume story the reference lacks.
+
+The reference's fitted model exists only as in-memory linked ``Node`` objects
+(reference: ``mpitree/tree/_base.py:22``); nothing saves or loads it
+(SURVEY.md §5). Here the struct-of-arrays tree makes persistence trivial: a
+fitted estimator round-trips through one ``.npz`` file — flat arrays per tree
+plus a JSON header with the constructor params and fit-time attributes.
+
+``save_model(est, path)`` / ``load_model(path)`` cover every estimator in the
+package (trees and forests, classification and regression). Loading never
+executes code from the file (no pickle): arrays come from ``np.load`` with
+``allow_pickle=False`` and the header is JSON.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import warnings
+
+import numpy as np
+
+from mpitree_tpu.core.tree_struct import TreeArrays
+
+_TREE_FIELDS = [f.name for f in dataclasses.fields(TreeArrays)]
+
+# Explicit allowlist: load_model instantiates nothing outside this table.
+_ESTIMATOR_CLASSES = (
+    "DecisionTreeClassifier",
+    "ParallelDecisionTreeClassifier",
+    "DecisionTreeRegressor",
+    "RandomForestClassifier",
+    "RandomForestRegressor",
+)
+
+
+def _npz_path(path) -> str:
+    """np.savez silently appends .npz; make save/load agree on the name."""
+    path = os.fspath(path)
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def _json_params(params: dict) -> dict:
+    """Constructor params with numpy scalars unwrapped; params that cannot be
+    represented in JSON (e.g. a ``np.random.Generator`` random_state) are
+    dropped with a warning — the loaded estimator falls back to the class
+    default for those."""
+    out = {}
+    for k, v in params.items():
+        if isinstance(v, np.generic):
+            v = v.item()
+        try:
+            json.dumps(v)
+        except TypeError:
+            warnings.warn(
+                f"save_model: dropping non-serializable param {k}={v!r}; "
+                "the loaded estimator will use the class default",
+                stacklevel=3,
+            )
+            continue
+        out[k] = v
+    return out
+
+
+def _tree_arrays(prefix: str, tree: TreeArrays) -> dict:
+    return {f"{prefix}{name}": getattr(tree, name) for name in _TREE_FIELDS}
+
+
+def _read_tree(z, prefix: str) -> TreeArrays:
+    return TreeArrays(**{name: z[f"{prefix}{name}"] for name in _TREE_FIELDS})
+
+
+def save_model(estimator, path) -> None:
+    """Serialize a fitted estimator to ``path`` (.npz, no pickle)."""
+    cls = type(estimator)
+    if cls.__name__ not in _ESTIMATOR_CLASSES:
+        raise ValueError(f"cannot serialize {cls.__name__!r}")
+    header = {
+        "format": "mpitree_tpu-model",
+        "version": 1,
+        "class": cls.__name__,
+        "params": _json_params(estimator.get_params()),
+        "attrs": {},
+    }
+    arrays: dict = {}
+
+    for attr in ("n_features_", "n_features_in_", "_y_mean"):
+        if hasattr(estimator, attr):
+            header["attrs"][attr] = getattr(estimator, attr)
+
+    if hasattr(estimator, "classes_"):
+        arrays["classes_"] = np.asarray(estimator.classes_)
+
+    if hasattr(estimator, "trees_"):  # forest
+        header["n_trees"] = len(estimator.trees_)
+        for i, t in enumerate(estimator.trees_):
+            arrays.update(_tree_arrays(f"tree{i}/", t))
+    elif hasattr(estimator, "tree_"):
+        header["n_trees"] = 1
+        arrays.update(_tree_arrays("tree0/", estimator.tree_))
+    else:
+        raise ValueError("estimator is not fitted (no tree_/trees_)")
+
+    arrays["__header__"] = np.frombuffer(
+        json.dumps(header).encode(), dtype=np.uint8
+    )
+    np.savez(_npz_path(path), **arrays)
+
+
+def load_model(path):
+    """Reconstruct the fitted estimator saved by :func:`save_model`."""
+    import mpitree_tpu
+
+    with np.load(_npz_path(path), allow_pickle=False) as z:
+        if "__header__" not in z.files:
+            raise ValueError(f"{path!r} is not an mpitree_tpu model file")
+        header = json.loads(bytes(z["__header__"]).decode())
+        if header.get("format") != "mpitree_tpu-model":
+            raise ValueError(f"{path!r} is not an mpitree_tpu model file")
+        if header["class"] not in _ESTIMATOR_CLASSES:
+            raise ValueError(f"unknown estimator class {header['class']!r}")
+        cls = getattr(mpitree_tpu, header["class"])
+        est = cls(**header["params"])
+        for attr, val in header["attrs"].items():
+            setattr(est, attr, val)
+        if "classes_" in z.files:
+            est.classes_ = z["classes_"]
+        trees = [_read_tree(z, f"tree{i}/") for i in range(header["n_trees"])]
+    if header["class"].startswith("RandomForest"):
+        est.trees_ = trees
+    else:
+        est.tree_ = trees[0]
+        est._predict_cache = None
+    return est

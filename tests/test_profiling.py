@@ -1,0 +1,61 @@
+"""Profiling timers + on-device determinism check (SURVEY.md §5 gaps)."""
+
+import numpy as np
+
+from mpitree_tpu import DecisionTreeClassifier
+from mpitree_tpu.core.builder import BuildConfig, build_tree
+from mpitree_tpu.ops.binning import bin_dataset
+from mpitree_tpu.parallel import mesh as mesh_lib
+from mpitree_tpu.utils.profiling import PhaseTimer
+
+
+def _data():
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(300, 5)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64) + (X[:, 1] > 0.5)
+    return X, y
+
+
+def test_phase_timer_collects_phases():
+    X, y = _data()
+    timer = PhaseTimer()
+    binned = bin_dataset(X, max_bins=32, binning="quantile")
+    mesh = mesh_lib.resolve_mesh(n_devices=None)
+    build_tree(
+        binned, y, config=BuildConfig(max_depth=4), mesh=mesh,
+        n_classes=int(y.max()) + 1, timer=timer,
+    )
+    s = timer.summary()
+    assert {"shard", "split", "update"} <= set(s)
+    assert all(v["seconds"] >= 0 and v["calls"] >= 1 for v in s.values())
+    assert "PhaseTimer" in repr(timer)
+
+
+def test_profile_env_sets_fit_stats(monkeypatch):
+    X, y = _data()
+    monkeypatch.setenv("MPITREE_TPU_PROFILE", "1")
+    clf = DecisionTreeClassifier(max_depth=3).fit(X, y)
+    assert clf.fit_stats_ is not None and "split" in clf.fit_stats_
+    monkeypatch.delenv("MPITREE_TPU_PROFILE")
+    clf = DecisionTreeClassifier(max_depth=3).fit(X, y)
+    assert clf.fit_stats_ is None
+
+
+def test_determinism_check_passes_on_mesh():
+    """The psum-fingerprint tripwire is clean on a real 8-device mesh build,
+    and the debug build returns the identical tree."""
+    X, y = _data()
+    binned = bin_dataset(X, max_bins=32, binning="quantile")
+    mesh = mesh_lib.resolve_mesh(n_devices="all")
+    n_classes = int(y.max()) + 1
+    t_dbg = build_tree(
+        binned, y, config=BuildConfig(max_depth=4, debug=True), mesh=mesh,
+        n_classes=n_classes,
+    )
+    t_ref = build_tree(
+        binned, y, config=BuildConfig(max_depth=4), mesh=mesh,
+        n_classes=n_classes,
+    )
+    np.testing.assert_array_equal(t_dbg.feature, t_ref.feature)
+    np.testing.assert_array_equal(t_dbg.left, t_ref.left)
+    np.testing.assert_array_equal(t_dbg.count, t_ref.count)

@@ -1,0 +1,107 @@
+"""Model persistence round-trips (SURVEY.md §5 checkpoint/resume gap)."""
+
+import numpy as np
+import pytest
+
+from mpitree_tpu import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    RandomForestClassifier,
+    RandomForestRegressor,
+    load_model,
+    save_model,
+)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int) + (X[:, 2] > 1.0)
+    yr = np.sin(X[:, 0]) + X[:, 1]
+    return X, y, yr
+
+
+def _roundtrip(est, path):
+    save_model(est, path)
+    return load_model(path)
+
+
+def test_classifier_roundtrip(tmp_path, data):
+    X, y, _ = data
+    clf = DecisionTreeClassifier(max_depth=5).fit(X, y)
+    clf2 = _roundtrip(clf, tmp_path / "clf.npz")
+    assert type(clf2) is DecisionTreeClassifier
+    assert clf2.get_params() == clf.get_params()
+    np.testing.assert_array_equal(clf2.predict(X), clf.predict(X))
+    np.testing.assert_array_equal(clf2.predict_proba(X), clf.predict_proba(X))
+    assert clf2.export_text() == clf.export_text()
+
+
+def test_regressor_roundtrip(tmp_path, data):
+    X, _, yr = data
+    reg = DecisionTreeRegressor(max_depth=5).fit(X, yr)
+    reg2 = _roundtrip(reg, tmp_path / "reg.npz")
+    np.testing.assert_allclose(reg2.predict(X), reg.predict(X))
+    assert reg2.export_text() == reg.export_text()
+
+
+def test_forest_roundtrips(tmp_path, data):
+    X, y, yr = data
+    rf = RandomForestClassifier(n_estimators=3, max_depth=4, random_state=0).fit(X, y)
+    rf2 = _roundtrip(rf, tmp_path / "rf.npz")
+    assert len(rf2.trees_) == 3
+    np.testing.assert_allclose(rf2.predict_proba(X), rf.predict_proba(X))
+
+    rr = RandomForestRegressor(n_estimators=3, max_depth=4, random_state=0).fit(X, yr)
+    rr2 = _roundtrip(rr, tmp_path / "rr.npz")
+    np.testing.assert_allclose(rr2.predict(X), rr.predict(X))
+
+
+def test_unfitted_raises(tmp_path):
+    with pytest.raises(ValueError, match="not fitted"):
+        save_model(DecisionTreeClassifier(), tmp_path / "x.npz")
+
+
+def test_bad_file_rejected(tmp_path, data):
+    np.savez(tmp_path / "junk.npz", a=np.zeros(3))
+    with pytest.raises((ValueError, KeyError)):
+        load_model(tmp_path / "junk.npz")
+
+
+def test_suffixless_path_roundtrip(tmp_path, data):
+    """np.savez appends .npz silently; save/load must agree on the name."""
+    X, y, _ = data
+    clf = DecisionTreeClassifier(max_depth=3).fit(X, y)
+    clf2 = _roundtrip(clf, tmp_path / "model")
+    np.testing.assert_array_equal(clf2.predict(X), clf.predict(X))
+
+
+def test_nonserializable_param_dropped(tmp_path, data):
+    X, y, _ = data
+    rf = RandomForestClassifier(
+        n_estimators=2, max_depth=3, random_state=np.random.default_rng(0)
+    ).fit(X, y)
+    with pytest.warns(UserWarning, match="random_state"):
+        save_model(rf, tmp_path / "rf.npz")
+    rf2 = load_model(tmp_path / "rf.npz")
+    np.testing.assert_allclose(rf2.predict_proba(X), rf.predict_proba(X))
+
+
+def test_crafted_class_rejected(tmp_path):
+    import json
+
+    header = {
+        "format": "mpitree_tpu-model",
+        "version": 1,
+        "class": "load_model",
+        "params": {},
+        "attrs": {},
+        "n_trees": 0,
+    }
+    np.savez(
+        tmp_path / "evil.npz",
+        __header__=np.frombuffer(json.dumps(header).encode(), dtype=np.uint8),
+    )
+    with pytest.raises(ValueError, match="unknown estimator class"):
+        load_model(tmp_path / "evil.npz")
