@@ -1,0 +1,184 @@
+"""Primitive micro-benchmarks that justify the histogram-kernel design.
+
+Measures, on the current default JAX platform:
+
+1. scatter-add (segment_sum) throughput at covtype-level sizes — the op the
+   v0 builder leans on;
+2. row-gather bandwidth (permutation reorder of the binned matrix / one-hot);
+3. int8 tile matmul throughput (the A @ OH segment-histogram formulation);
+4. sort / cumsum costs for the per-level row reordering.
+
+Run: ``python examples/microbench.py [--n 531012] [--features 54] [--bins 256]``
+Prints one JSON line per measurement.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def timed(fn, *args, reps=3):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=531012)
+    p.add_argument("--features", type=int, default=54)
+    p.add_argument("--bins", type=int, default=256)
+    p.add_argument("--slots", type=int, default=4096)
+    p.add_argument("--classes", type=int, default=8)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    N, F, B, K, C = args.n, args.features, args.bins, args.slots, args.classes
+    rng = np.random.default_rng(0)
+    xb = jnp.asarray(rng.integers(0, B, size=(N, F), dtype=np.int32))
+    y = jnp.asarray(rng.integers(0, C, size=N, dtype=np.int32))
+    nid = jnp.asarray(rng.integers(0, K, size=N, dtype=np.int32))
+    dev = jax.devices()[0].platform
+    results = []
+
+    def report(name, seconds, work, unit):
+        row = {
+            "bench": name, "platform": dev, "seconds": round(seconds, 5),
+            "rate": round(work / seconds / 1e9, 3), "unit": unit,
+        }
+        results.append(row)
+        print(json.dumps(row), flush=True)
+
+    # 1. flattened scatter-add, the v0 histogram op ------------------------
+    @jax.jit
+    def scatter_full(xb, y, nid):
+        feat = jnp.arange(F, dtype=jnp.int32)[None, :]
+        ids = ((nid[:, None] * F + feat) * C + y[:, None]) * B + xb
+        return jax.ops.segment_sum(
+            jnp.ones((N, F), jnp.float32).reshape(-1), ids.reshape(-1),
+            num_segments=K * F * C * B,
+        )
+
+    report("scatter_NxF_to_KFCB", timed(scatter_full, xb, y, nid),
+           N * F, "G updates/s")
+
+    # small table variant: does destination size matter?
+    K2 = 64
+
+    @jax.jit
+    def scatter_small(xb, y, nid):
+        feat = jnp.arange(F, dtype=jnp.int32)[None, :]
+        ids = ((jnp.mod(nid, K2)[:, None] * F + feat) * C + y[:, None]) * B + xb
+        return jax.ops.segment_sum(
+            jnp.ones((N, F), jnp.float32).reshape(-1), ids.reshape(-1),
+            num_segments=K2 * F * C * B,
+        )
+
+    report("scatter_NxF_to_64FCB", timed(scatter_small, xb, y, nid),
+           N * F, "G updates/s")
+
+    # single-column scatter (the node_id/perm-sized op)
+    @jax.jit
+    def scatter_1col(y, nid):
+        return jax.ops.segment_sum(
+            jnp.ones(N, jnp.float32), nid * C + y, num_segments=K * C
+        )
+
+    report("scatter_N_to_KC", timed(scatter_1col, y, nid), N, "G updates/s")
+
+    # 2. row gather (permutation reorder) ----------------------------------
+    perm = jnp.asarray(rng.permutation(N).astype(np.int32))
+
+    @jax.jit
+    def row_gather(xb, perm):
+        return jnp.take(xb, perm, axis=0)
+
+    s = timed(row_gather, xb, perm)
+    report("row_gather_NxF_int32", s, N * F * 4 * 2, "GB/s")
+
+    oh_cols = F * B
+
+    try:
+        oh = jnp.asarray(
+            rng.integers(0, 2, size=(N // 4, oh_cols), dtype=np.int8)
+        )
+        perm4 = perm[: N // 4] % (N // 4)
+
+        @jax.jit
+        def oh_gather(oh, p):
+            return jnp.take(oh, p, axis=0)
+
+        s = timed(oh_gather, oh, perm4)
+        report("row_gather_onehot_int8", s, (N // 4) * oh_cols * 2, "GB/s")
+        del oh
+    except Exception as e:  # OOM on small hosts
+        print(json.dumps({"bench": "row_gather_onehot_int8", "skipped": str(e)}))
+
+    # 3. int8 segment-matmul tiles (A @ OH) --------------------------------
+    T = 256
+    n_tiles = 64
+    A = jnp.asarray(rng.integers(0, 2, size=(n_tiles, T, T), dtype=np.int8))
+    OH = jnp.asarray(rng.integers(0, 2, size=(n_tiles, T, oh_cols), dtype=np.int8))
+
+    @jax.jit
+    def tile_matmul(A, OH):
+        return jax.lax.dot_general(
+            A, OH, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.int32,
+        )
+
+    s = timed(tile_matmul, A, OH)
+    report("int8_tile_matmul_AxOH", s, 2 * n_tiles * T * T * oh_cols, "GFLOP/s")
+
+    Abf = A.astype(jnp.bfloat16)
+    OHbf = OH.astype(jnp.bfloat16)
+
+    @jax.jit
+    def tile_matmul_bf(A, OH):
+        return jax.lax.dot_general(
+            A, OH, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+
+    s = timed(tile_matmul_bf, Abf, OHbf)
+    report("bf16_tile_matmul_AxOH", s, 2 * n_tiles * T * T * oh_cols, "GFLOP/s")
+
+    # 4. reorder bookkeeping: sort and cumsum ------------------------------
+    @jax.jit
+    def argsort_n(nid):
+        return jnp.argsort(nid, stable=True)
+
+    report("argsort_N_int32", timed(argsort_n, nid), N, "G keys/s")
+
+    @jax.jit
+    def cumsum_n(x):
+        return jnp.cumsum(x)
+
+    report("cumsum_N_int32", timed(cumsum_n, nid), N, "G elems/s")
+
+    # one-hot expansion cost (the thing precompute amortizes)
+    @jax.jit
+    def expand_onehot(xb):
+        return (xb[:, :, None] == jnp.arange(B, dtype=jnp.int32)).astype(jnp.int8)
+
+    xb_small = xb[: N // 8]
+    s = timed(expand_onehot, xb_small)
+    report("onehot_expand_int8", s, (N // 8) * F * B, "G cmp/s")
+
+    print(json.dumps({"bench": "ALL", "results": len(results)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,26 @@ class BuildConfig:
     debug: bool = False
 
 
+# Below this many matrix cells, per-level device dispatch latency dominates
+# the arithmetic and the numpy fast path (host_builder.py) wins outright.
+HOST_PATH_MAX_CELLS = 1 << 19
+
+
+def prefer_host_path(n_samples: int, n_features: int, n_devices, backend) -> bool:
+    """Route small single-device fits to the vectorized host builder.
+
+    ``backend="host"`` forces it; any explicit device backend ("tpu", "cpu")
+    or a multi-device mesh forces the device path.
+    """
+    if backend == "host":
+        return True
+    if backend is not None:
+        return False
+    if n_devices not in (None, 1):
+        return False
+    return n_samples * max(n_features, 1) <= HOST_PATH_MAX_CELLS
+
+
 def _chunk_size(n_samples: int, n_feat: int, n_bins: int, n_chan: int,
                 cfg: BuildConfig) -> int:
     """Frontier-chunk slot count, fixed for the whole build.

@@ -1,0 +1,322 @@
+"""Vectorized host (numpy) builder for small inputs — latency fast path.
+
+The device builder (``builder.py``) amortizes beautifully at covtype scale but
+pays fixed per-level dispatch (and per-shape compile) costs that dwarf the
+arithmetic below a few thousand rows — exactly the regime of the reference's
+published benchmark sweep (reference: ``experiments.ipynb`` cell 5,
+``n_samples = arange(1, 250, 10)``, where reference fits take milliseconds).
+This module grows the *same* level-synchronous histogram tree with plain
+numpy: same binning, same stopping rules, same first-min/first-max tie-break
+semantics (reference: ``mpitree/tree/decision_tree.py:88-91,140``), same
+struct-of-arrays result — so estimators can route small fits here (or callers
+can force it with ``backend="host"``) and get an identical tree shape
+contract, with single-digit-millisecond latency.
+
+Float caveat: gains here are computed in float64 (like the reference's numpy
+path) while the device path uses float32. On exact ties the argmin can in
+principle differ between the two paths by floating-point noise; the test
+suite pins identity on the standard fixtures.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mpitree_tpu.core.tree_struct import TreeArrays
+
+
+def _child_impurity_class(hist, criterion: str):
+    """(S,F,C,B) class histogram -> (S,F,B) weighted child cost, f64.
+
+    Mirrors ``ops/impurity.py:best_split_classification`` (device) and the
+    reference's weighted-entropy cost (``decision_tree.py:79-86``).
+    """
+    l = hist.cumsum(axis=3)  # noqa: E741 - left counts per class
+    n_l = l.sum(axis=2)
+    n_t = n_l[:, :, -1:]
+    n_r = n_t - n_l
+    r = l[:, :, :, -1:] - l
+
+    def h(counts, n):
+        with np.errstate(divide="ignore", invalid="ignore"):
+            p = counts / np.maximum(n, 1.0)[:, :, None, :]
+            if criterion == "entropy":
+                t = np.where(counts > 0, p * np.log2(np.maximum(p, 1e-300)), 0.0)
+                return -t.sum(axis=2)
+            return np.where(n > 0, 1.0 - (p * p).sum(axis=2), 0.0)
+
+    cost = (n_l * h(l, n_l) + n_r * h(r, n_r)) / np.maximum(n_t, 1.0)
+    return cost, n_l, n_r
+
+
+def _child_cost_mse(hist):
+    """(S,F,3,B) moment histogram -> (S,F,B) weighted child variance.
+
+    Computed in float32 end to end, mirroring the device kernel
+    (``ops/impurity.py:best_split_regression``) op for op, so host and device
+    builds select identical splits even where f32 moment cancellation makes
+    near-tied costs ambiguous.
+    """
+    h32 = hist.astype(np.float32)
+    w_l = h32[:, :, 0, :].cumsum(axis=2, dtype=np.float32)
+    s_l = h32[:, :, 1, :].cumsum(axis=2, dtype=np.float32)
+    q_l = h32[:, :, 2, :].cumsum(axis=2, dtype=np.float32)
+    w_t, s_t, q_t = w_l[:, :, -1:], s_l[:, :, -1:], q_l[:, :, -1:]
+    w_r, s_r, q_r = w_t - w_l, s_t - s_l, q_t - q_l
+
+    def sse(w, s, q):
+        return np.maximum(q - s * s / np.maximum(w, np.float32(1.0)), np.float32(0.0))
+
+    cost = (sse(w_l, s_l, q_l) + sse(w_r, s_r, q_r)) / np.maximum(w_t, np.float32(1.0))
+    return cost, w_l, w_r
+
+
+def _native_splits(xb, y, nid, sample_weight, binned, cfg, *, frontier_lo,
+                   n_slots, n_classes, task):
+    """Call the C++ sweep (native/__init__.py); None -> use numpy fallback."""
+    from mpitree_tpu import native
+
+    if task == "classification":
+        return native.best_splits_classification(
+            xb, y, nid, sample_weight, n_bins=binned.n_bins,
+            n_classes=n_classes, frontier_lo=frontier_lo, n_slots=n_slots,
+            n_cand=binned.n_cand, criterion=cfg.criterion,
+        )
+    return native.best_splits_regression(
+        xb, np.asarray(y, np.float32), nid, sample_weight,
+        n_bins=binned.n_bins, frontier_lo=frontier_lo, n_slots=n_slots,
+        n_cand=binned.n_cand,
+    )
+
+
+def _record_level(tree, ids, S, terminal, stop, feat_best, value, n, counts,
+                  task):
+    tree.feature[ids] = (
+        np.full(S, -1, np.int32) if terminal
+        else np.where(stop, -1, feat_best).astype(np.int32)
+    )
+    tree.value[ids] = value
+    tree.n_node_samples[ids] = n.astype(np.int64)
+    if task == "classification":
+        tree.count[ids] = counts.astype(tree.count.dtype)
+    else:
+        tree.count[ids, 0] = value
+
+
+def _split_and_advance(tree, binned, xb, nid, ids, stop, feat_best, bin_best,
+                       slot, live, S, frontier_lo, depth):
+    """Create children for splitting nodes and reroute their rows."""
+    split_ids = ids[~stop]
+    if len(split_ids):
+        f_sel = feat_best[~stop].astype(np.int32)
+        b_sel = bin_best[~stop].astype(np.int32)
+        tree.threshold[split_ids] = binned.thresholds[f_sel, b_sel]
+        lefts, rights = tree.alloc_children(split_ids.astype(np.int32),
+                                            depth + 1)
+        tree.left[split_ids] = lefts
+        tree.right[split_ids] = rights
+
+        split_mask = np.zeros(S, bool)
+        split_mask[~stop] = True
+        feat_t = np.zeros(S, np.int32)
+        bin_t = np.zeros(S, np.int32)
+        left_t = np.zeros(S, np.int32)
+        right_t = np.zeros(S, np.int32)
+        feat_t[~stop] = f_sel
+        bin_t[~stop] = b_sel
+        left_t[~stop] = lefts
+        right_t[~stop] = rights
+        N = len(nid)
+        s_cl = np.clip(slot, 0, S - 1)
+        active = live & split_mask[s_cl]
+        xf = xb[np.arange(N), feat_t[s_cl]]
+        go_left = xf <= bin_t[s_cl]
+        nid = np.where(
+            active, np.where(go_left, left_t[s_cl], right_t[s_cl]), nid
+        ).astype(np.int32)
+    return nid, frontier_lo + S, 2 * len(split_ids), depth + 1
+
+
+def build_tree_host(
+    binned,
+    y: np.ndarray,
+    *,
+    config,
+    n_classes: int | None = None,
+    sample_weight: np.ndarray | None = None,
+    refit_targets: np.ndarray | None = None,
+) -> TreeArrays:
+    """Grow one tree on the host; same contract as ``builder.build_tree``."""
+    from mpitree_tpu.core.builder import _TreeBuffer  # shared node store
+
+    cfg = config
+    task = cfg.task
+    xb = binned.x_binned
+    N, F = xb.shape
+    B = binned.n_bins
+    C = n_classes if task == "classification" else 3
+    cand = binned.candidate_mask()  # (F, B)
+    w = np.ones(N) if sample_weight is None else sample_weight.astype(np.float64)
+    if task == "regression":
+        # f32 targets/payloads mirror the device moment path; split selection
+        # then agrees with the device build bit for bit (see _child_cost_mse).
+        y_f = y.astype(np.float32)
+        w32 = w.astype(np.float32)
+
+    fractional_w = sample_weight is not None and not np.array_equal(
+        sample_weight, np.round(sample_weight)
+    )
+    tree = _TreeBuffer(
+        n_value_cols=(C if task == "classification" else 1),
+        value_dtype=np.int32 if task == "classification" else np.float32,
+        count_dtype=(
+            np.float64 if (task != "classification" or fractional_w) else np.int64
+        ),
+    )
+    tree.ensure(1)
+    tree.n = 1
+
+    nid = np.zeros(N, np.int32)
+    rows_feat = np.broadcast_to(np.arange(F, dtype=np.intp)[None, :], (N, F))
+    frontier_lo, frontier_size, depth = 0, 1, 0
+
+    while frontier_size > 0:
+        S = frontier_size
+        terminal = cfg.max_depth is not None and depth == cfg.max_depth
+        slot = nid - frontier_lo  # all rows are in the frontier or parked (<0)
+        live = slot >= 0
+
+        # Fast path: the native C++ sweep computes node stats and best splits
+        # in O(rows + occupied bins) per node (native/split_kernel.cpp); the
+        # numpy blocks below are the portable fallback.
+        nat = None if terminal else _native_splits(
+            xb, y, nid, sample_weight, binned, cfg,
+            frontier_lo=frontier_lo, n_slots=S, n_classes=C, task=task,
+        )
+        if nat is not None:
+            if task == "classification":
+                counts = nat["counts"]
+                n = counts.sum(axis=1)
+                pure = (counts > 0).sum(axis=1) <= 1
+                value = counts.argmax(axis=1).astype(np.int32)
+            else:
+                n = nat["counts"][:, 0]
+                mean = nat["counts"][:, 1] / np.maximum(n, 1.0)
+                value = mean.astype(np.float32)
+                pure = ~(nat["ymax"] > nat["ymin"])
+            feat_best = nat["feature"]
+            bin_best = nat["bin"]
+            ids = frontier_lo + np.arange(S)
+            stop = (
+                pure | nat["constant"] | (n < cfg.min_samples_split)
+                | np.isinf(nat["cost"]) | (feat_best < 0)
+            )
+            _record_level(
+                tree, ids, S, False, stop, feat_best, value, n, counts
+                if task == "classification" else None, task,
+            )
+            nid, frontier_lo, frontier_size, depth = _split_and_advance(
+                tree, binned, xb, nid, ids, stop, feat_best, bin_best,
+                slot, live, S, frontier_lo, depth,
+            )
+            continue
+
+        # Per-node statistics (and, unless terminal, full split histograms).
+        if task == "classification":
+            flat = (slot[live] * C + y[live]).astype(np.intp)
+            counts = np.bincount(flat, weights=w[live], minlength=S * C)
+            counts = counts.reshape(S, C)
+            n = counts.sum(axis=1)
+            pure = (counts > 0).sum(axis=1) <= 1
+            value = counts.argmax(axis=1).astype(np.int32)
+        else:
+            flat = slot[live].astype(np.intp)
+            wv = w[live]
+            n = np.bincount(flat, weights=wv, minlength=S)
+            s1 = np.bincount(flat, weights=wv * y_f[live], minlength=S)
+            mean = s1 / np.maximum(n, 1.0)
+            value = mean.astype(np.float32)
+            live_w = live & (w > 0)
+            ymin = np.full(S, np.inf)
+            ymax = np.full(S, -np.inf)
+            np.minimum.at(ymin, slot[live_w].astype(np.intp), y_f[live_w])
+            np.maximum.at(ymax, slot[live_w].astype(np.intp), y_f[live_w])
+            pure = ~(ymax > ymin)
+
+        ids = frontier_lo + np.arange(S)
+        if terminal:
+            stop = np.ones(S, bool)
+            feat_best = bin_best = None
+        else:
+            ch = C if task == "classification" else 3
+            hist = np.zeros((S, F, ch, B))
+            li = np.flatnonzero(live)
+            sl = slot[li][:, None]
+            xbl = xb[li]
+            if task == "classification":
+                idx = ((sl * F + rows_feat[: len(li)]) * C + y[li][:, None]) * B + xbl
+                np.add.at(
+                    hist.reshape(-1), idx.astype(np.intp).ravel(),
+                    np.broadcast_to(w[li][:, None], xbl.shape).ravel(),
+                )
+                cost, n_l, n_r = _child_impurity_class(hist, cfg.criterion)
+            else:
+                hist = hist.astype(np.float32)
+                base = (sl * F + rows_feat[: len(li)]) * 3 * B + xbl
+                for ci, payload in enumerate(
+                    (w32[li], w32[li] * y_f[li], w32[li] * y_f[li] * y_f[li])
+                ):
+                    np.add.at(
+                        hist.reshape(-1),
+                        (base + ci * B).astype(np.intp).ravel(),
+                        np.broadcast_to(payload[:, None], xbl.shape).ravel(),
+                    )
+                cost, n_l, n_r = _child_cost_mse(hist)
+
+            valid = cand[None, :, :] & (n_l > 0) & (n_r > 0)
+            cost = np.where(valid, cost, np.inf)
+            bin_f = cost.argmin(axis=2)  # first-min = lowest threshold
+            cost_f = np.take_along_axis(cost, bin_f[:, :, None], axis=2)[:, :, 0]
+            feat_best = cost_f.argmin(axis=1).astype(np.int32)  # lowest feature
+            bin_best = np.take_along_axis(
+                bin_f, feat_best[:, None].astype(np.intp), axis=1
+            )[:, 0].astype(np.int32)
+            best_cost = np.take_along_axis(
+                cost_f, feat_best[:, None].astype(np.intp), axis=1
+            )[:, 0]
+            occupied = (hist.sum(axis=2) > 0).sum(axis=2)  # (S, F)
+            constant = (occupied <= 1).all(axis=1)
+            stop = (
+                pure | constant | (n < cfg.min_samples_split)
+                | np.isinf(best_cost)
+            )
+
+        if terminal:
+            feat_best = np.full(S, -1, np.int32)
+            bin_best = np.zeros(S, np.int32)
+        _record_level(
+            tree, ids, S, terminal, stop, feat_best, value, n,
+            counts if task == "classification" else None, task,
+        )
+        nid, frontier_lo, frontier_size, depth = _split_and_advance(
+            tree, binned, xb, nid, ids, stop, feat_best, bin_best,
+            slot, live, S, frontier_lo, depth,
+        )
+
+    out = tree.finalize()
+
+    if task == "regression" and refit_targets is not None:
+        w64 = (np.ones(N) if sample_weight is None else sample_weight).astype(
+            np.float64
+        )
+        s = np.bincount(nid, weights=refit_targets * w64, minlength=out.n_nodes)
+        ww = np.bincount(nid, weights=w64, minlength=out.n_nodes)
+        for i in range(out.n_nodes - 1, 0, -1):
+            p = out.parent[i]
+            s[p] += s[i]
+            ww[p] += ww[i]
+        mean = s / np.maximum(ww, 1e-300)
+        out.value = mean.astype(np.float32)
+        out.count = mean[:, None].copy()
+
+    return out

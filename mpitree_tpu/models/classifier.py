@@ -27,7 +27,8 @@ import numpy as np
 from sklearn.base import BaseEstimator, ClassifierMixin
 from sklearn.utils.validation import check_is_fitted
 
-from mpitree_tpu.core.builder import BuildConfig, build_tree
+from mpitree_tpu.core.builder import BuildConfig, build_tree, prefer_host_path
+from mpitree_tpu.core.host_builder import build_tree_host
 from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.predict import predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
@@ -68,7 +69,10 @@ class DecisionTreeClassifier(BaseEstimator, ClassifierMixin):
     n_devices : int, "all", or None, default=None
         Data-mesh width; ``None`` = single device.
     backend : str, optional
-        JAX platform name ("tpu", "cpu", ...); ``None`` = default platform.
+        ``None`` = auto: small single-device fits run on the vectorized host
+        (numpy) builder, larger ones on the default JAX platform. A platform
+        name ("tpu", "cpu", ...) forces the device path on that platform;
+        ``"host"`` forces the numpy builder.
     """
 
     _task = "classification"
@@ -94,18 +98,27 @@ class DecisionTreeClassifier(BaseEstimator, ClassifierMixin):
         timer = PhaseTimer(enabled=profiling_enabled())
         with timer.phase("bin"):
             binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
-        mesh = mesh_lib.resolve_mesh(backend=self.backend, n_devices=self.n_devices)
         cfg = BuildConfig(
             task="classification",
             criterion=self.criterion,
             max_depth=self.max_depth,
             min_samples_split=self.min_samples_split,
         )
-        self.tree_ = build_tree(
-            binned, y_enc, config=cfg, mesh=mesh, n_classes=len(classes),
-            sample_weight=validate_sample_weight(sample_weight, X.shape[0]),
-            timer=timer,
-        )
+        sw = validate_sample_weight(sample_weight, X.shape[0])
+        if prefer_host_path(*X.shape, self.n_devices, self.backend):
+            with timer.phase("host_build"):
+                self.tree_ = build_tree_host(
+                    binned, y_enc, config=cfg, n_classes=len(classes),
+                    sample_weight=sw,
+                )
+        else:
+            mesh = mesh_lib.resolve_mesh(
+                backend=self.backend, n_devices=self.n_devices
+            )
+            self.tree_ = build_tree(
+                binned, y_enc, config=cfg, mesh=mesh, n_classes=len(classes),
+                sample_weight=sw, timer=timer,
+            )
         self.fit_stats_ = timer.summary() if timer.enabled else None
         self._predict_cache = None
         return self

@@ -23,7 +23,8 @@ import numpy as np
 from sklearn.base import BaseEstimator, ClassifierMixin, RegressorMixin
 from sklearn.utils.validation import check_is_fitted
 
-from mpitree_tpu.core.builder import BuildConfig, build_tree
+from mpitree_tpu.core.builder import BuildConfig, build_tree, prefer_host_path
+from mpitree_tpu.core.host_builder import build_tree_host
 from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.predict import predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
@@ -68,7 +69,10 @@ class _BaseForest(BaseEstimator):
         sample_weight = validate_sample_weight(sample_weight, n)
         rng = np.random.default_rng(self.random_state)
         binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
-        mesh = mesh_lib.resolve_mesh(backend=self.backend, n_devices=self.n_devices)
+        use_host = prefer_host_path(*X.shape, self.n_devices, self.backend)
+        mesh = None if use_host else mesh_lib.resolve_mesh(
+            backend=self.backend, n_devices=self.n_devices
+        )
         cfg = BuildConfig(
             task=task, criterion=criterion, max_depth=self.max_depth,
             min_samples_split=self.min_samples_split,
@@ -89,11 +93,17 @@ class _BaseForest(BaseEstimator):
                 n_cand = np.zeros_like(binned.n_cand)
                 n_cand[keep] = binned.n_cand[keep]
                 b = dataclasses.replace(binned, n_cand=n_cand)
-            trees.append(
-                build_tree(b, y_enc, config=cfg, mesh=mesh,
-                           n_classes=n_classes, sample_weight=w,
-                           refit_targets=refit_targets)
-            )
+            if use_host:
+                trees.append(
+                    build_tree_host(b, y_enc, config=cfg, n_classes=n_classes,
+                                    sample_weight=w, refit_targets=refit_targets)
+                )
+            else:
+                trees.append(
+                    build_tree(b, y_enc, config=cfg, mesh=mesh,
+                               n_classes=n_classes, sample_weight=w,
+                               refit_targets=refit_targets)
+                )
         return trees
 
     def _leaf_ids(self, X: np.ndarray):

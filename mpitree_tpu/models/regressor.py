@@ -20,7 +20,8 @@ import numpy as np
 from sklearn.base import BaseEstimator, RegressorMixin
 from sklearn.utils.validation import check_is_fitted
 
-from mpitree_tpu.core.builder import BuildConfig, build_tree
+from mpitree_tpu.core.builder import BuildConfig, build_tree, prefer_host_path
+from mpitree_tpu.core.host_builder import build_tree_host
 from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.predict import predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
@@ -66,18 +67,28 @@ class DecisionTreeRegressor(BaseEstimator, RegressorMixin):
         timer = PhaseTimer(enabled=profiling_enabled())
         with timer.phase("bin"):
             binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
-        mesh = mesh_lib.resolve_mesh(backend=self.backend, n_devices=self.n_devices)
         cfg = BuildConfig(
             task="regression",
             criterion="mse",
             max_depth=self.max_depth,
             min_samples_split=self.min_samples_split,
         )
-        self.tree_ = build_tree(
-            binned, (y64 - y_mean).astype(np.float32), config=cfg, mesh=mesh,
-            sample_weight=validate_sample_weight(sample_weight, X.shape[0]),
-            refit_targets=y64, timer=timer,
-        )
+        sw = validate_sample_weight(sample_weight, X.shape[0])
+        y_c = (y64 - y_mean).astype(np.float32)
+        if prefer_host_path(*X.shape, self.n_devices, self.backend):
+            with timer.phase("host_build"):
+                self.tree_ = build_tree_host(
+                    binned, y_c, config=cfg, sample_weight=sw,
+                    refit_targets=y64,
+                )
+        else:
+            mesh = mesh_lib.resolve_mesh(
+                backend=self.backend, n_devices=self.n_devices
+            )
+            self.tree_ = build_tree(
+                binned, y_c, config=cfg, mesh=mesh, sample_weight=sw,
+                refit_targets=y64, timer=timer,
+            )
         self.fit_stats_ = timer.summary() if timer.enabled else None
         self._predict_cache = None
         return self

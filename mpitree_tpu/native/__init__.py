@@ -1,0 +1,146 @@
+"""Native host kernel: build-on-first-use C++ split search with ctypes.
+
+No pybind11 in this environment, so the kernel exposes a plain C ABI
+(``split_kernel.cpp``) and this module compiles it with the system ``g++``
+into a cached shared object on first import, then binds it with ctypes.
+Everything degrades gracefully: if no compiler is available (or
+``MPITREE_TPU_NO_NATIVE=1``), ``lib()`` returns None and callers fall back to
+the vectorized numpy implementation in ``core/host_builder.py``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "split_kernel.cpp")
+_LOCK = threading.Lock()
+_LIB: list = []  # [] = not tried, [None] = unavailable, [CDLL] = loaded
+
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+_f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+
+
+def _build() -> str | None:
+    """Compile the kernel; returns the .so path or None."""
+    cache_dir = os.environ.get(
+        "MPITREE_TPU_NATIVE_CACHE", os.path.join(_HERE, "_build")
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    so_path = os.path.join(cache_dir, "split_kernel.so")
+    if os.path.exists(so_path) and os.path.getmtime(so_path) >= os.path.getmtime(_SRC):
+        return so_path
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-march=native",
+        _SRC, "-o", so_path + ".tmp",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except Exception:
+        return None
+    os.replace(so_path + ".tmp", so_path)
+    return so_path
+
+
+def lib():
+    """The loaded CDLL, or None when the native path is unavailable."""
+    if _LIB:
+        return _LIB[0]
+    with _LOCK:
+        if _LIB:
+            return _LIB[0]
+        if os.environ.get("MPITREE_TPU_NO_NATIVE", "") not in ("", "0"):
+            _LIB.append(None)
+            return None
+        so_path = _build()
+        if so_path is None:
+            _LIB.append(None)
+            return None
+        try:
+            cdll = ctypes.CDLL(so_path)
+            cdll.best_splits_classification.argtypes = [
+                _i32p, _i32p, _i32p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32, _i32p, ctypes.c_int32,
+                _i32p, _i32p, _f64p, _f64p, _u8p,
+            ]
+            cdll.best_splits_classification.restype = None
+            cdll.best_splits_regression.argtypes = [
+                _i32p, _f32p, _i32p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32, _i32p,
+                _i32p, _i32p, _f64p, _f64p, _u8p, _f64p, _f64p,
+            ]
+            cdll.best_splits_regression.restype = None
+            _LIB.append(cdll)
+        except Exception:
+            _LIB.append(None)
+        return _LIB[0]
+
+
+def _wptr(w: np.ndarray | None):
+    if w is None:
+        return None
+    return w.ctypes.data_as(ctypes.c_void_p)
+
+
+def best_splits_classification(
+    xb, y, node_id, w, *, n_bins, n_classes, frontier_lo, n_slots, n_cand,
+    criterion,
+):
+    """ctypes wrapper; returns dict of per-slot arrays (or None if no lib)."""
+    cdll = lib()
+    if cdll is None:
+        return None
+    n_rows, n_feat = xb.shape
+    out_feat = np.empty(n_slots, np.int32)
+    out_bin = np.empty(n_slots, np.int32)
+    out_cost = np.empty(n_slots, np.float64)
+    out_counts = np.zeros((n_slots, n_classes), np.float64)
+    out_constant = np.empty(n_slots, np.uint8)
+    w64 = None if w is None else np.ascontiguousarray(w, np.float64)
+    cdll.best_splits_classification(
+        xb, y, node_id, _wptr(w64), n_rows, n_feat, n_bins, n_classes,
+        frontier_lo, n_slots, n_cand, 0 if criterion == "entropy" else 1,
+        out_feat, out_bin, out_cost, out_counts, out_constant,
+    )
+    return {
+        "feature": out_feat, "bin": out_bin, "cost": out_cost,
+        "counts": out_counts, "constant": out_constant.astype(bool),
+    }
+
+
+def best_splits_regression(
+    xb, yv, node_id, w, *, n_bins, frontier_lo, n_slots, n_cand
+):
+    cdll = lib()
+    if cdll is None:
+        return None
+    n_rows, n_feat = xb.shape
+    out_feat = np.empty(n_slots, np.int32)
+    out_bin = np.empty(n_slots, np.int32)
+    out_cost = np.empty(n_slots, np.float64)
+    out_counts = np.zeros((n_slots, 3), np.float64)
+    out_constant = np.empty(n_slots, np.uint8)
+    out_ymin = np.empty(n_slots, np.float64)
+    out_ymax = np.empty(n_slots, np.float64)
+    w64 = None if w is None else np.ascontiguousarray(w, np.float64)
+    cdll.best_splits_regression(
+        xb, np.ascontiguousarray(yv, np.float32), node_id, _wptr(w64),
+        n_rows, n_feat, n_bins, frontier_lo, n_slots, n_cand,
+        out_feat, out_bin, out_cost, out_counts, out_constant,
+        out_ymin, out_ymax,
+    )
+    return {
+        "feature": out_feat, "bin": out_bin, "cost": out_cost,
+        "counts": out_counts, "constant": out_constant.astype(bool),
+        "ymin": out_ymin, "ymax": out_ymax,
+    }

@@ -1,0 +1,305 @@
+// Native host split kernel — the C++ tier of the host fast path.
+//
+// The reference's native substrate is NumPy's C core plus OpenMPI
+// (SURVEY.md §2.2); this framework's host tier replaces both with a
+// first-party kernel: a level-synchronous split search over all frontier
+// nodes that runs in O(rows·features + occupied_bins) per level, using an
+// incremental impurity sweep instead of the dense (nodes × features ×
+// classes × bins) tensor the vectorized numpy fallback materializes
+// (host_builder.py). The win is largest with many classes — e.g. the
+// reference's published benchmark workload, where every sample is its own
+// class (reference: experiments.ipynb cell 5).
+//
+// Exposed via ctypes (no pybind11 in this environment): plain C ABI, arrays
+// passed as raw pointers with explicit shapes. Built by native/build.py.
+//
+// Semantics contract (must match ops/impurity.py and the reference):
+//   - candidate b means "x_binned <= b", thresholds ascending per feature;
+//   - cost = (n_l*H(l) + n_r*H(r)) / n, H = entropy (bits) or Gini;
+//   - per feature: lowest-cost bin wins, ties -> lowest bin;
+//   - across features: lowest cost wins, ties -> lowest feature index
+//     (reference: mpitree/tree/decision_tree.py:88-91,140);
+//   - candidates with an empty side are invalid;
+//   - all accumulation in double; cost comparisons in double.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <vector>
+
+namespace {
+
+inline double xlogx(double x) { return x > 0.0 ? x * std::log2(x) : 0.0; }
+
+// Strictly-better test with relative tolerance: the incremental sweep's cost
+// differs from the reference's dense formula by last-ULP rounding, and exact
+// mathematical ties (symmetric splits) must resolve to the lowest
+// (feature, bin) as the reference's first-argmin does
+// (mpitree/tree/decision_tree.py:88-91,140). 1e-12 relative absorbs ULP
+// noise while never confusing genuinely different costs.
+inline bool better(double cost, double best) {
+  if (std::isinf(best)) return cost < best;
+  return cost < best - 1e-12 * (std::abs(best) + 1.0);
+}
+
+struct Acc {
+  // Running impurity-sweep state for one (node, feature) pass.
+  double sum_xlogx = 0.0;  // sum_c n_c*log2(n_c) (entropy) or sum_c n_c^2 (gini)
+  double n = 0.0;
+};
+
+}  // namespace
+
+extern "C" {
+
+// Per-level split search over one frontier chunk.
+//
+// Inputs (row-major):
+//   xb       : (n_rows, n_feat) int32 bin ids
+//   y        : (n_rows,) int32 class ids in [0, n_classes)
+//   node_id  : (n_rows,) int32 current assignment; rows outside
+//              [frontier_lo, frontier_lo + n_slots) are ignored
+//   w        : (n_rows,) double sample weights (may be null -> all 1)
+//   n_cand   : (n_feat,) int32 valid candidate count per feature
+// Outputs (caller-allocated):
+//   out_feat : (n_slots,) int32 best feature (-1 if no valid candidate)
+//   out_bin  : (n_slots,) int32 best bin
+//   out_cost : (n_slots,) double best cost (+inf if none)
+//   out_counts: (n_slots, n_classes) double class counts
+//   out_constant: (n_slots,) uint8 "all features single-bin" flag
+// criterion: 0 = entropy, 1 = gini.
+void best_splits_classification(
+    const int32_t* xb, const int32_t* y, const int32_t* node_id,
+    const double* w, int64_t n_rows, int32_t n_feat, int32_t n_bins,
+    int32_t n_classes, int32_t frontier_lo, int32_t n_slots,
+    const int32_t* n_cand, int32_t criterion, int32_t* out_feat,
+    int32_t* out_bin, double* out_cost, double* out_counts,
+    uint8_t* out_constant) {
+  const double inf = std::numeric_limits<double>::infinity();
+
+  // Bucket rows by frontier slot (counting sort; parked rows drop out).
+  // Zero-weight rows (bootstrap out-of-bag) are excluded up front: they
+  // contribute nothing to counts or impurity, and the device path's
+  // bin-occupancy ("constant") flag ignores them too.
+  std::vector<int64_t> slot_start(n_slots + 1, 0);
+  std::vector<int32_t> slot_of(n_rows);
+  for (int64_t r = 0; r < n_rows; ++r) {
+    int64_t s = (int64_t)node_id[r] - frontier_lo;
+    bool live = s >= 0 && s < n_slots && (!w || w[r] > 0.0);
+    slot_of[r] = live ? (int32_t)s : -1;
+    if (slot_of[r] >= 0) slot_start[slot_of[r] + 1]++;
+  }
+  for (int32_t s = 0; s < n_slots; ++s) slot_start[s + 1] += slot_start[s];
+  std::vector<int64_t> rows_by_slot(slot_start[n_slots]);
+  {
+    std::vector<int64_t> cur(slot_start.begin(), slot_start.end() - 1);
+    for (int64_t r = 0; r < n_rows; ++r)
+      if (slot_of[r] >= 0) rows_by_slot[cur[slot_of[r]]++] = r;
+  }
+
+  // Scratch reused across (node, feature) passes.
+  std::vector<double> bin_w(n_bins, 0.0);           // weight per bin
+  std::vector<double> cls_in_bin(n_bins, 0.0);      // per-bin Σ_c xlogx-terms
+  std::vector<int32_t> touched_bins;                // occupied bins, unsorted
+  std::vector<double> left_cls(n_classes, 0.0);     // running class counts
+  std::vector<double> node_cls(n_classes, 0.0);
+  // Per-(bin) class lists, CSR-style, rebuilt per (node, feature).
+  std::vector<int64_t> bin_head(n_bins, -1);
+  std::vector<int64_t> row_next;
+  touched_bins.reserve(n_bins);
+
+  for (int32_t s = 0; s < n_slots; ++s) {
+    const int64_t r0 = slot_start[s], r1 = slot_start[s + 1];
+    out_feat[s] = -1;
+    out_bin[s] = 0;
+    out_cost[s] = inf;
+    out_constant[s] = 1;
+    std::fill(node_cls.begin(), node_cls.end(), 0.0);
+    for (int64_t i = r0; i < r1; ++i) {
+      const int64_t r = rows_by_slot[i];
+      node_cls[y[r]] += w ? w[r] : 1.0;
+    }
+    double n_tot = 0.0;
+    for (int32_t c = 0; c < n_classes; ++c) {
+      out_counts[(int64_t)s * n_classes + c] = node_cls[c];
+      n_tot += node_cls[c];
+    }
+    if (r1 == r0) { out_constant[s] = 0; continue; }
+
+    row_next.resize(r1 - r0);
+    for (int32_t f = 0; f < n_feat; ++f) {
+      // Build per-bin chains for this (node, feature).
+      touched_bins.clear();
+      for (int64_t i = r0; i < r1; ++i) {
+        const int64_t r = rows_by_slot[i];
+        const int32_t b = xb[r * n_feat + f];
+        if (bin_head[b] < 0) touched_bins.push_back(b);
+        row_next[i - r0] = bin_head[b];
+        bin_head[b] = i;
+      }
+      if (touched_bins.size() > 1) out_constant[s] = 0;
+
+      if (f < n_feat && n_cand[f] > 0 && touched_bins.size() > 1) {
+        // Ascending sweep over occupied bins only.
+        std::sort(touched_bins.begin(), touched_bins.end());
+        double left_n = 0.0, left_sum = 0.0;   // Σ_c xlogx(n_c) or Σ n_c^2
+        // right_c = node_c - left_c; maintain Σ_c f(right_c) incrementally,
+        // starting with all mass on the right.
+        double right_sum = 0.0;
+        std::fill(left_cls.begin(), left_cls.end(), 0.0);
+        if (criterion == 0) {
+          for (int32_t c = 0; c < n_classes; ++c)
+            right_sum += xlogx(node_cls[c]);
+        } else {
+          for (int32_t c = 0; c < n_classes; ++c)
+            right_sum += node_cls[c] * node_cls[c];
+        }
+
+        for (size_t ti = 0; ti < touched_bins.size(); ++ti) {
+          const int32_t b = touched_bins[ti];
+          // Move bin b's rows from right to left, updating only the
+          // affected classes' contributions.
+          for (int64_t i = bin_head[b]; i >= 0; i = row_next[i - r0]) {
+            const int64_t r = rows_by_slot[i];
+            const int32_t c = y[r];
+            const double wr = w ? w[r] : 1.0;
+            const double lc = left_cls[c];
+            const double rc = node_cls[c] - lc;
+            if (criterion == 0) {
+              left_sum += xlogx(lc + wr) - xlogx(lc);
+              right_sum += xlogx(rc - wr) - xlogx(rc);
+            } else {
+              left_sum += (lc + wr) * (lc + wr) - lc * lc;
+              right_sum += (rc - wr) * (rc - wr) - rc * rc;
+            }
+            left_cls[c] = lc + wr;
+            left_n += wr;
+          }
+          if (b >= n_cand[f]) break;  // past the last valid candidate
+          const double right_n = n_tot - left_n;
+          if (left_n <= 0.0 || right_n <= 0.0) continue;
+          double cost;
+          if (criterion == 0) {
+            // n_l*H_l = n_l*log2(n_l) - Σ_c xlogx(l_c), likewise right.
+            const double hl = xlogx(left_n) - left_sum;
+            const double hr = xlogx(right_n) - right_sum;
+            cost = (hl + hr) / n_tot;
+          } else {
+            const double gl = left_n - left_sum / left_n;
+            const double gr = right_n - right_sum / right_n;
+            cost = (gl + gr) / n_tot;
+          }
+          if (better(cost, out_cost[s])) {
+            out_cost[s] = cost;
+            out_feat[s] = f;
+            out_bin[s] = b;
+          }
+        }
+      }
+      // Reset bin chains for the next feature.
+      for (int32_t b : touched_bins) bin_head[b] = -1;
+    }
+  }
+}
+
+// Regression (squared error) variant: per-node best split from
+// (w, w*y, w*y^2) running sums; same tie-break contract.
+// Outputs: out_counts is (n_slots, 3) = (n, sum_y, sum_y2) with weights.
+void best_splits_regression(
+    const int32_t* xb, const float* yv, const int32_t* node_id,
+    const double* w, int64_t n_rows, int32_t n_feat, int32_t n_bins,
+    int32_t frontier_lo, int32_t n_slots, const int32_t* n_cand,
+    int32_t* out_feat, int32_t* out_bin, double* out_cost,
+    double* out_counts, uint8_t* out_constant, double* out_ymin,
+    double* out_ymax) {
+  const double inf = std::numeric_limits<double>::infinity();
+
+  std::vector<int64_t> slot_start(n_slots + 1, 0);
+  std::vector<int32_t> slot_of(n_rows);
+  for (int64_t r = 0; r < n_rows; ++r) {
+    int64_t s = (int64_t)node_id[r] - frontier_lo;
+    bool live = s >= 0 && s < n_slots && (!w || w[r] > 0.0);
+    slot_of[r] = live ? (int32_t)s : -1;
+    if (slot_of[r] >= 0) slot_start[slot_of[r] + 1]++;
+  }
+  for (int32_t s = 0; s < n_slots; ++s) slot_start[s + 1] += slot_start[s];
+  std::vector<int64_t> rows_by_slot(slot_start[n_slots]);
+  {
+    std::vector<int64_t> cur(slot_start.begin(), slot_start.end() - 1);
+    for (int64_t r = 0; r < n_rows; ++r)
+      if (slot_of[r] >= 0) rows_by_slot[cur[slot_of[r]]++] = r;
+  }
+
+  std::vector<double> bw(n_bins), bs(n_bins), bq(n_bins);
+  std::vector<int32_t> touched;
+  touched.reserve(n_bins);
+
+  for (int32_t s = 0; s < n_slots; ++s) {
+    const int64_t r0 = slot_start[s], r1 = slot_start[s + 1];
+    out_feat[s] = -1;
+    out_bin[s] = 0;
+    out_cost[s] = inf;
+    out_constant[s] = 1;
+    double n_tot = 0.0, s_tot = 0.0, q_tot = 0.0;
+    double ymin = inf, ymax = -inf;
+    for (int64_t i = r0; i < r1; ++i) {
+      const int64_t r = rows_by_slot[i];
+      const double wr = w ? w[r] : 1.0;
+      const double yr = (double)yv[r];
+      n_tot += wr;
+      s_tot += wr * yr;
+      q_tot += wr * yr * yr;
+      if (wr > 0) {
+        if (yr < ymin) ymin = yr;
+        if (yr > ymax) ymax = yr;
+      }
+    }
+    out_counts[(int64_t)s * 3 + 0] = n_tot;
+    out_counts[(int64_t)s * 3 + 1] = s_tot;
+    out_counts[(int64_t)s * 3 + 2] = q_tot;
+    out_ymin[s] = ymin;
+    out_ymax[s] = ymax;
+    if (r1 == r0) { out_constant[s] = 0; continue; }
+
+    for (int32_t f = 0; f < n_feat; ++f) {
+      touched.clear();
+      for (int64_t i = r0; i < r1; ++i) {
+        const int64_t r = rows_by_slot[i];
+        const int32_t b = xb[r * n_feat + f];
+        const double wr = w ? w[r] : 1.0;
+        const double yr = (double)yv[r];
+        if (bw[b] == 0.0 && bs[b] == 0.0 && bq[b] == 0.0) touched.push_back(b);
+        bw[b] += wr;
+        bs[b] += wr * yr;
+        bq[b] += wr * yr * yr;
+      }
+      if (touched.size() > 1) out_constant[s] = 0;
+      if (n_cand[f] > 0 && touched.size() > 1) {
+        std::sort(touched.begin(), touched.end());
+        double wl = 0.0, sl = 0.0, ql = 0.0;
+        for (int32_t b : touched) {
+          wl += bw[b];
+          sl += bs[b];
+          ql += bq[b];
+          if (b >= n_cand[f]) break;
+          const double wr_ = n_tot - wl, sr = s_tot - sl, qr = q_tot - ql;
+          if (wl <= 0.0 || wr_ <= 0.0) continue;
+          const double sse_l = ql - sl * sl / wl;
+          const double sse_r = qr - sr * sr / wr_;
+          const double cost =
+              (std::max(sse_l, 0.0) + std::max(sse_r, 0.0)) / n_tot;
+          if (better(cost, out_cost[s])) {
+            out_cost[s] = cost;
+            out_feat[s] = f;
+            out_bin[s] = b;
+          }
+        }
+      }
+      for (int32_t b : touched) { bw[b] = 0.0; bs[b] = 0.0; bq[b] = 0.0; }
+    }
+  }
+}
+
+}  // extern "C"

@@ -122,8 +122,9 @@ def load_model(path):
             raise ValueError(f"unknown estimator class {header['class']!r}")
         cls = getattr(mpitree_tpu, header["class"])
         est = cls(**header["params"])
-        for attr, val in header["attrs"].items():
-            setattr(est, attr, val)
+        for attr in ("n_features_", "n_features_in_", "_y_mean"):
+            if attr in header["attrs"]:
+                setattr(est, attr, header["attrs"][attr])
         if "classes_" in z.files:
             est.classes_ = z["classes_"]
         trees = [_read_tree(z, f"tree{i}/") for i in range(header["n_trees"])]

@@ -1,0 +1,128 @@
+"""Host (numpy) fast path: identity with the device builder.
+
+The host builder must produce the *same tree* as the device path — same
+splits, thresholds, counts, rendering — on the standard fixtures, so routing
+small fits to it is invisible to users (SURVEY.md §2.6 determinism contract).
+"""
+
+import numpy as np
+import pytest
+
+from mpitree_tpu import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    RandomForestClassifier,
+)
+from mpitree_tpu.core.builder import prefer_host_path
+
+
+def _trees_equal(a, b):
+    np.testing.assert_array_equal(a.tree_.feature, b.tree_.feature)
+    np.testing.assert_array_equal(a.tree_.left, b.tree_.left)
+    np.testing.assert_array_equal(a.tree_.right, b.tree_.right)
+    np.testing.assert_allclose(a.tree_.threshold, b.tree_.threshold)
+    np.testing.assert_array_equal(a.tree_.count, b.tree_.count)
+    np.testing.assert_array_equal(a.tree_.n_node_samples, b.tree_.n_node_samples)
+
+
+def test_routing_policy():
+    assert prefer_host_path(1000, 10, None, None)
+    assert prefer_host_path(10**6, 54, None, "host")
+    assert not prefer_host_path(1000, 10, None, "cpu")
+    assert not prefer_host_path(1000, 10, 8, None)
+    assert not prefer_host_path(10**6, 54, None, None)
+
+
+@pytest.mark.parametrize("criterion", ["entropy", "gini"])
+def test_classifier_host_equals_device(iris2, criterion):
+    X, y, _ = iris2
+    host = DecisionTreeClassifier(
+        max_depth=5, criterion=criterion, backend="host"
+    ).fit(X, y)
+    dev = DecisionTreeClassifier(
+        max_depth=5, criterion=criterion, backend="cpu"
+    ).fit(X, y)
+    _trees_equal(host, dev)
+    assert host.export_text() == dev.export_text()
+
+
+def test_classifier_host_equals_mesh(iris2):
+    X, y, _ = iris2
+    host = DecisionTreeClassifier(max_depth=6, backend="host").fit(X, y)
+    mesh = DecisionTreeClassifier(max_depth=6, n_devices=8, backend="cpu").fit(X, y)
+    _trees_equal(host, mesh)
+
+
+def test_classifier_host_random_data():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(400, 7)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(int) + 2 * (X[:, 1] > 0.3)
+    host = DecisionTreeClassifier(max_depth=8, backend="host").fit(X, y)
+    dev = DecisionTreeClassifier(max_depth=8, backend="cpu").fit(X, y)
+    _trees_equal(host, dev)
+
+
+def test_classifier_host_weighted():
+    rng = np.random.default_rng(11)
+    X = rng.normal(size=(300, 4)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    w = rng.integers(0, 4, size=300).astype(np.float32)
+    host = DecisionTreeClassifier(max_depth=5, backend="host").fit(X, y, w)
+    dev = DecisionTreeClassifier(max_depth=5, backend="cpu").fit(X, y, w)
+    _trees_equal(host, dev)
+
+
+def test_regressor_host_matches_device_quality():
+    """Regression split costs are f32 sums of non-integer moments, so exact
+    cost ties can resolve differently between accumulation orders (host
+    sequential vs device scatter) — unlike classification, whose integer
+    counts make trees bit-identical. The contract is equivalent quality and
+    agreement everywhere costs aren't razor-tied."""
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(350, 5)).astype(np.float32)
+    yr = np.sin(X[:, 0]) * 2 + X[:, 1]
+    host = DecisionTreeRegressor(max_depth=6, backend="host").fit(X, yr)
+    dev = DecisionTreeRegressor(max_depth=6, backend="cpu").fit(X, yr)
+    assert host.tree_.n_nodes == dev.tree_.n_nodes
+    agree = (host.tree_.feature == dev.tree_.feature).mean()
+    assert agree > 0.9, f"only {agree:.0%} of nodes agree"
+    assert abs(host.score(X, yr) - dev.score(X, yr)) < 1e-3
+
+
+def test_regressor_host_memorizes():
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(200, 3)).astype(np.float32)
+    yr = rng.normal(size=200)
+    reg = DecisionTreeRegressor(backend="host").fit(X, yr)
+    np.testing.assert_allclose(reg.predict(X), yr, atol=1e-9)
+
+
+def test_forest_host_equals_device():
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(250, 5)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(int)
+    a = RandomForestClassifier(
+        n_estimators=3, max_depth=4, random_state=0, backend="host"
+    ).fit(X, y)
+    b = RandomForestClassifier(
+        n_estimators=3, max_depth=4, random_state=0, backend="cpu"
+    ).fit(X, y)
+    for ta, tb in zip(a.trees_, b.trees_):
+        np.testing.assert_array_equal(ta.feature, tb.feature)
+        np.testing.assert_array_equal(ta.count, tb.count)
+
+
+def test_host_is_fast_on_reference_sweep():
+    """The reference's benchmark regime (degenerate tiny data,
+    experiments.ipynb cell 5) must run in milliseconds per fit."""
+    import time
+
+    from mpitree_tpu import native
+
+    native.lib()  # one-time g++ build of the kernel happens off the clock
+    for n in (41, 141, 241):
+        X = np.arange(n, dtype=np.float64).reshape(-1, 1)
+        y = np.arange(n)
+        t0 = time.perf_counter()
+        DecisionTreeClassifier().fit(X, y)
+        assert time.perf_counter() - t0 < 0.5

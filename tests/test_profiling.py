@@ -34,8 +34,10 @@ def test_phase_timer_collects_phases():
 def test_profile_env_sets_fit_stats(monkeypatch):
     X, y = _data()
     monkeypatch.setenv("MPITREE_TPU_PROFILE", "1")
-    clf = DecisionTreeClassifier(max_depth=3).fit(X, y)
+    clf = DecisionTreeClassifier(max_depth=3, backend="cpu").fit(X, y)
     assert clf.fit_stats_ is not None and "split" in clf.fit_stats_
+    host = DecisionTreeClassifier(max_depth=3, backend="host").fit(X, y)
+    assert "host_build" in host.fit_stats_
     monkeypatch.delenv("MPITREE_TPU_PROFILE")
     clf = DecisionTreeClassifier(max_depth=3).fit(X, y)
     assert clf.fit_stats_ is None
