@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+import os
 from typing import Optional
 
 import jax
@@ -57,6 +58,13 @@ class BuildConfig:
     # selected the identical split (SURVEY.md §5). Also forced on by
     # MPITREE_TPU_DEBUG=1.
     debug: bool = False
+    # Device build engine: "fused" = whole build in one compiled
+    # lax.while_loop program (fused_builder.py, the default — no per-level
+    # host round trips); "levelwise" = host-orchestrated level loop (keeps
+    # per-phase timers and the on-device determinism check). "auto" picks
+    # fused unless debug mode needs the levelwise instrumentation.
+    # MPITREE_TPU_ENGINE overrides.
+    engine: str = "auto"
 
 
 # Below this many matrix cells, per-level device dispatch latency dominates
@@ -117,6 +125,34 @@ def _table_slots(n_samples: int, cfg: BuildConfig) -> int:
     chunk. Capped so pathological frontiers chunk rather than explode."""
     widest = min(_widest_frontier(n_samples, cfg), cfg.max_table_slots)
     return 1 << max(0, math.ceil(math.log2(widest)))
+
+
+def integer_weights(sample_weight) -> bool:
+    """True when raw class counts can stay integral (the reference's
+    predict_proba contract) — i.e. no fractional sample weights."""
+    return sample_weight is None or np.array_equal(
+        sample_weight, np.round(sample_weight)
+    )
+
+
+def refit_regression_values(tree: TreeArrays, nid_host: np.ndarray,
+                            w64: np.ndarray, refit_targets: np.ndarray) -> None:
+    """Exact f64 node-value refit from final row assignments (in place).
+
+    The on-device f32 moment histograms drive split *selection*; leaf and
+    interior means come from this exact host pass so predictions carry no
+    cancellation noise. Children always have larger ids than their parent, so
+    one descending pass rolls leaf sums up the whole tree."""
+    s = np.bincount(nid_host, weights=refit_targets * w64,
+                    minlength=tree.n_nodes)
+    ww = np.bincount(nid_host, weights=w64, minlength=tree.n_nodes)
+    for i in range(tree.n_nodes - 1, 0, -1):
+        p = tree.parent[i]
+        s[p] += s[i]
+        ww[p] += ww[i]
+    mean = s / np.maximum(ww, 1e-300)
+    tree.value = mean.astype(np.float32)
+    tree.count = mean[:, None].copy()
 
 
 class _TreeBuffer:
@@ -202,37 +238,46 @@ def build_tree(
     cfg = config
     timer = timer if timer is not None else PhaseTimer(enabled=False)
     debug = cfg.debug or debug_checks_enabled()
+
+    engine = os.environ.get("MPITREE_TPU_ENGINE", cfg.engine)
+    if engine not in ("auto", "fused", "levelwise"):
+        raise ValueError(f"unknown build engine {engine!r}")
+    if engine == "fused" or (engine == "auto" and not debug):
+        if debug:
+            import warnings
+
+            warnings.warn(
+                "the fused engine does not run the on-device determinism "
+                "check; use engine='levelwise' (or engine='auto') with "
+                "debug mode",
+                stacklevel=2,
+            )
+        from mpitree_tpu.core.fused_builder import build_tree_fused
+
+        return build_tree_fused(
+            binned, y, config=cfg, mesh=mesh, n_classes=n_classes,
+            sample_weight=sample_weight, refit_targets=refit_targets,
+            timer=timer,
+        )
     task = cfg.task
     N, F = binned.x_binned.shape
     B = binned.n_bins
     C = n_classes if task == "classification" else 3
-    n_dev = mesh.size
 
-    # --- one-time device placement (rows sharded, tables replicated) -------
-    pad = mesh_lib.pad_rows(N, n_dev)
-    xb = binned.x_binned
-    yy = y
-    w = np.ones(N, np.float32) if sample_weight is None else sample_weight.astype(np.float32)
-    nid = np.zeros(N, np.int32)
-    if pad:
-        xb = np.concatenate([xb, np.zeros((pad, F), np.int32)])
-        yy = np.concatenate([yy, np.zeros(pad, yy.dtype)])
-        w = np.concatenate([w, np.zeros(pad, np.float32)])
-        nid = np.concatenate([nid, np.full(pad, -1, np.int32)])
     with timer.phase("shard"):
-        xb_d, y_d, w_d, nid_d = mesh_lib.shard_rows(mesh, xb, yy, w, nid)
-        cand_mask_d = mesh_lib.replicate(mesh, binned.candidate_mask())
+        xb_d, y_d, w_d, nid_d, cand_mask_d = mesh_lib.shard_build_inputs(
+            mesh, binned, y, sample_weight
+        )
 
-    # Raw class counts stay int64 (the reference's predict_proba contract)
-    # unless fractional sample weights make them genuinely non-integral.
-    fractional_w = sample_weight is not None and not np.array_equal(
-        sample_weight, np.round(sample_weight)
-    )
     tree = _TreeBuffer(
         n_value_cols=(C if task == "classification" else 1),
         value_dtype=np.int32 if task == "classification" else np.float32,
+        # Raw class counts stay int64 (the reference's predict_proba
+        # contract) unless fractional sample weights make them non-integral.
         count_dtype=(
-            np.float64 if (task != "classification" or fractional_w) else np.int64
+            np.int64
+            if (task == "classification" and integer_weights(sample_weight))
+            else np.float64
         ),
     )
     tree.ensure(1)
@@ -369,19 +414,8 @@ def build_tree(
     out = tree.finalize()
 
     if task == "regression" and refit_targets is not None:
-        # Exact f64 value refit: rows' final leaf assignments roll up to every
-        # ancestor (children always have larger ids than their parent, so one
-        # descending pass aggregates the whole tree).
-        nid_host = np.asarray(nid_d)[:N]
-        w64 = w[:N].astype(np.float64)
-        s = np.bincount(nid_host, weights=refit_targets * w64, minlength=out.n_nodes)
-        ww = np.bincount(nid_host, weights=w64, minlength=out.n_nodes)
-        for i in range(out.n_nodes - 1, 0, -1):
-            p = out.parent[i]
-            s[p] += s[i]
-            ww[p] += ww[i]
-        mean = s / np.maximum(ww, 1e-300)
-        out.value = mean.astype(np.float32)
-        out.count = mean[:, None].copy()
+        w64 = (np.ones(N) if sample_weight is None
+               else sample_weight).astype(np.float64)
+        refit_regression_values(out, np.asarray(nid_d)[:N], w64, refit_targets)
 
     return out

@@ -34,6 +34,47 @@ from mpitree_tpu.parallel.mesh import DATA_AXIS
 from mpitree_tpu.utils import profiling
 
 
+def node_counts_local(y, nid, w, chunk_lo, *, n_slots, n_classes, task):
+    """Per-slot class counts (or regression moments), psum'd over the mesh.
+
+    Shared by the levelwise counts step and the fused engine's terminal
+    levels; must run inside shard_map over the ``data`` axis.
+    """
+    slot = nid - chunk_lo
+    valid = (slot >= 0) & (slot < n_slots)
+    wv = jnp.where(valid, w, 0.0)
+    if task == "classification":
+        ids = jnp.where(valid, slot * n_classes + y, 0)
+        h = jax.ops.segment_sum(wv, ids, num_segments=n_slots * n_classes)
+        h = h.reshape(n_slots, n_classes)
+    else:
+        y32 = y.astype(jnp.float32)
+        data = jnp.stack([wv, wv * y32, wv * y32 * y32], axis=-1)
+        h = jax.ops.segment_sum(
+            data, jnp.where(valid, slot, 0), num_segments=n_slots
+        )
+    return lax.psum(h, DATA_AXIS)
+
+
+def regression_y_range(y, nid, w, chunk_lo, *, n_slots):
+    """Exact per-slot max(y)-min(y) purity signal over the mesh.
+
+    The f32 moment variance cannot resolve near-zero spreads, so regression
+    purity stops use this instead. Zero-weight rows (bootstrap out-of-bag)
+    are excluded — they don't affect the fit. Returns (ymin, ymax)."""
+    slot = nid - chunk_lo
+    valid = (slot >= 0) & (slot < n_slots) & (w > 0)
+    s = jnp.clip(slot, 0, n_slots - 1)
+    y32 = y.astype(jnp.float32)
+    ymin = jax.ops.segment_min(
+        jnp.where(valid, y32, jnp.inf), s, num_segments=n_slots
+    )
+    ymax = jax.ops.segment_max(
+        jnp.where(valid, y32, -jnp.inf), s, num_segments=n_slots
+    )
+    return lax.pmin(ymin, DATA_AXIS), lax.pmax(ymax, DATA_AXIS)
+
+
 @lru_cache(maxsize=64)
 def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                   task: str, criterion: str, debug: bool = False):
@@ -59,21 +100,9 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
             )
             h = lax.psum(h, DATA_AXIS)
             dec = imp_ops.best_split_regression(h, cand_mask)
-            # Exact per-node target spread (pmin/pmax over ICI): the regression
-            # purity stop f32 moment variance cannot provide. Zero-weight rows
-            # (bootstrap out-of-bag) are excluded — they don't affect the fit.
-            slot = nid - chunk_lo
-            valid = (slot >= 0) & (slot < n_slots) & (w > 0)
-            s = jnp.clip(slot, 0, n_slots - 1)
-            y32 = y.astype(jnp.float32)
-            ymin = jax.ops.segment_min(
-                jnp.where(valid, y32, jnp.inf), s, num_segments=n_slots
+            ymin, ymax = regression_y_range(
+                y, nid, w, chunk_lo, n_slots=n_slots
             )
-            ymax = jax.ops.segment_max(
-                jnp.where(valid, y32, -jnp.inf), s, num_segments=n_slots
-            )
-            ymin = lax.pmin(ymin, DATA_AXIS)
-            ymax = lax.pmax(ymax, DATA_AXIS)
             y_range = jnp.where(ymax >= ymin, ymax - ymin, 0.0)
             dec = dec._replace(y_range=y_range)
         if debug:
@@ -103,20 +132,10 @@ def make_counts_fn(mesh, *, n_slots: int, n_classes: int, task: str):
     """
 
     def local_counts(y, nid, w, chunk_lo):
-        slot = nid - chunk_lo
-        valid = (slot >= 0) & (slot < n_slots)
-        wv = jnp.where(valid, w, 0.0)
-        if task == "classification":
-            ids = jnp.where(valid, slot * n_classes + y, 0)
-            h = jax.ops.segment_sum(wv, ids, num_segments=n_slots * n_classes)
-            h = h.reshape(n_slots, n_classes)
-        else:
-            y32 = y.astype(jnp.float32)
-            data = jnp.stack([wv, wv * y32, wv * y32 * y32], axis=-1)
-            h = jax.ops.segment_sum(
-                data, jnp.where(valid, slot, 0), num_segments=n_slots
-            )
-        return lax.psum(h, DATA_AXIS)
+        return node_counts_local(
+            y, nid, w, chunk_lo, n_slots=n_slots, n_classes=n_classes,
+            task=task,
+        )
 
     sharded = jax.shard_map(
         local_counts,

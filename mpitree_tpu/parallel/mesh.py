@@ -73,3 +73,29 @@ def replicate(mesh: Mesh, *arrays):
 def pad_rows(n: int, n_devices: int) -> int:
     """Rows of padding needed so n divides evenly across devices."""
     return (-n) % n_devices
+
+
+def shard_build_inputs(mesh: Mesh, binned, y, sample_weight):
+    """One-time device placement shared by both build engines.
+
+    Pads rows to the mesh width (padding rows get ``node_id=-1`` / weight 0,
+    so every kernel masks them out), shards (x_binned, y, w, node_id) over
+    the ``data`` axis, and replicates the candidate mask. Returns the four
+    sharded arrays plus the replicated mask.
+    """
+    import numpy as np  # local to keep module import light
+
+    N, F = binned.x_binned.shape
+    pad = pad_rows(N, mesh.size)
+    xb, yy = binned.x_binned, y
+    w = (np.ones(N, np.float32) if sample_weight is None
+         else sample_weight.astype(np.float32))
+    nid = np.zeros(N, np.int32)
+    if pad:
+        xb = np.concatenate([xb, np.zeros((pad, F), np.int32)])
+        yy = np.concatenate([yy, np.zeros(pad, yy.dtype)])
+        w = np.concatenate([w, np.zeros(pad, np.float32)])
+        nid = np.concatenate([nid, np.full(pad, -1, np.int32)])
+    xb_d, y_d, w_d, nid_d = shard_rows(mesh, xb, yy, w, nid)
+    cand_d = replicate(mesh, binned.candidate_mask())
+    return xb_d, y_d, w_d, nid_d, cand_d

@@ -1,0 +1,102 @@
+"""Fused single-program builder: identity with the levelwise engine.
+
+The fused engine (core/fused_builder.py) runs the whole build in one
+lax.while_loop device program; its trees must match the host-orchestrated
+levelwise engine exactly — same splits, counts, depths, rendering — at every
+mesh size (classification exactly; regression up to f32 tie noise).
+"""
+
+import numpy as np
+import pytest
+
+from mpitree_tpu.core.builder import BuildConfig, build_tree
+from mpitree_tpu.core.fused_builder import _node_capacity
+from mpitree_tpu.ops.binning import bin_dataset
+from mpitree_tpu.parallel import mesh as mesh_lib
+
+
+def _build(X, y, engine, *, n_devices=1, task="classification", **kw):
+    binned = bin_dataset(X, max_bins=64, binning="auto")
+    mesh = mesh_lib.resolve_mesh(n_devices=n_devices)
+    cfg = BuildConfig(task=task, criterion=kw.pop("criterion", "entropy")
+                      if task == "classification" else "mse", engine=engine,
+                      **kw)
+    n_classes = int(y.max()) + 1 if task == "classification" else None
+    return build_tree(binned, y, config=cfg, mesh=mesh, n_classes=n_classes)
+
+
+def _assert_same_tree(a, b):
+    np.testing.assert_array_equal(a.feature, b.feature)
+    np.testing.assert_array_equal(a.left, b.left)
+    np.testing.assert_array_equal(a.right, b.right)
+    np.testing.assert_array_equal(a.parent, b.parent)
+    np.testing.assert_array_equal(a.depth, b.depth)
+    np.testing.assert_allclose(a.threshold, b.threshold, equal_nan=True)
+    np.testing.assert_array_equal(a.count, b.count)
+    np.testing.assert_array_equal(a.n_node_samples, b.n_node_samples)
+
+
+@pytest.fixture(scope="module")
+def clf_data():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(900, 6)).astype(np.float32)
+    y = ((X[:, 0] > 0) + 2 * (X[:, 1] > 0.3)).astype(np.int64)
+    return X, y
+
+
+@pytest.mark.parametrize("n_devices", [1, 2, 8])
+@pytest.mark.parametrize("criterion", ["entropy", "gini"])
+def test_fused_equals_levelwise(clf_data, n_devices, criterion):
+    X, y = clf_data
+    a = _build(X, y, "fused", n_devices=n_devices, max_depth=7,
+               criterion=criterion)
+    b = _build(X, y, "levelwise", n_devices=n_devices, max_depth=7,
+               criterion=criterion)
+    _assert_same_tree(a, b)
+
+
+def test_fused_unbounded_depth(clf_data):
+    X, y = clf_data
+    a = _build(X, y, "fused", max_depth=None)
+    b = _build(X, y, "levelwise", max_depth=None)
+    _assert_same_tree(a, b)
+
+
+def test_fused_min_samples_split(clf_data):
+    X, y = clf_data
+    a = _build(X, y, "fused", max_depth=10, min_samples_split=40)
+    b = _build(X, y, "levelwise", max_depth=10, min_samples_split=40)
+    _assert_same_tree(a, b)
+
+
+def test_fused_regression_quality(clf_data):
+    X, _ = clf_data
+    yr = (np.sin(X[:, 0]) + X[:, 1]).astype(np.float32)
+    binned = bin_dataset(X, max_bins=64, binning="auto")
+    mesh = mesh_lib.resolve_mesh(n_devices=8)
+    a = build_tree(binned, yr, config=BuildConfig(
+        task="regression", criterion="mse", max_depth=6, engine="fused"),
+        mesh=mesh, refit_targets=yr.astype(np.float64))
+    b = build_tree(binned, yr, config=BuildConfig(
+        task="regression", criterion="mse", max_depth=6, engine="levelwise"),
+        mesh=mesh, refit_targets=yr.astype(np.float64))
+    assert a.n_nodes == b.n_nodes
+    assert (a.feature == b.feature).mean() > 0.9
+
+
+def test_fused_single_row_and_constant():
+    X = np.ones((5, 3), np.float32)
+    y = np.array([1, 1, 1, 1, 1])
+    t = _build(X, y, "fused")
+    assert t.n_nodes == 1 and t.feature[0] == -1
+    X1 = np.array([[1.0, 2.0]], np.float32)
+    t1 = _build(X1, np.array([0]), "fused")
+    assert t1.n_nodes == 1
+
+
+def test_node_capacity():
+    # True bounds (199, 15, 1) rounded up to powers of two so nearby sample
+    # counts share one compiled executable.
+    assert _node_capacity(100, None) == 256
+    assert _node_capacity(10**6, 3) == 16
+    assert _node_capacity(1, None) == 1

@@ -18,24 +18,36 @@ def _data():
 
 def test_phase_timer_collects_phases():
     X, y = _data()
-    timer = PhaseTimer()
     binned = bin_dataset(X, max_bins=32, binning="quantile")
     mesh = mesh_lib.resolve_mesh(n_devices=None)
+
+    timer = PhaseTimer()
     build_tree(
-        binned, y, config=BuildConfig(max_depth=4), mesh=mesh,
-        n_classes=int(y.max()) + 1, timer=timer,
+        binned, y, config=BuildConfig(max_depth=4, engine="levelwise"),
+        mesh=mesh, n_classes=int(y.max()) + 1, timer=timer,
     )
     s = timer.summary()
     assert {"shard", "split", "update"} <= set(s)
     assert all(v["seconds"] >= 0 and v["calls"] >= 1 for v in s.values())
     assert "PhaseTimer" in repr(timer)
 
+    timer = PhaseTimer()
+    build_tree(
+        binned, y, config=BuildConfig(max_depth=4, engine="fused"),
+        mesh=mesh, n_classes=int(y.max()) + 1, timer=timer,
+    )
+    assert "fused_build" in timer.summary()
+
 
 def test_profile_env_sets_fit_stats(monkeypatch):
     X, y = _data()
     monkeypatch.setenv("MPITREE_TPU_PROFILE", "1")
     clf = DecisionTreeClassifier(max_depth=3, backend="cpu").fit(X, y)
-    assert clf.fit_stats_ is not None and "split" in clf.fit_stats_
+    assert clf.fit_stats_ is not None and "fused_build" in clf.fit_stats_
+    monkeypatch.setenv("MPITREE_TPU_ENGINE", "levelwise")
+    clf = DecisionTreeClassifier(max_depth=3, backend="cpu").fit(X, y)
+    assert "split" in clf.fit_stats_
+    monkeypatch.delenv("MPITREE_TPU_ENGINE")
     host = DecisionTreeClassifier(max_depth=3, backend="host").fit(X, y)
     assert "host_build" in host.fit_stats_
     monkeypatch.delenv("MPITREE_TPU_PROFILE")
