@@ -239,7 +239,11 @@ def build_tree(
     timer = timer if timer is not None else PhaseTimer(enabled=False)
     debug = cfg.debug or debug_checks_enabled()
 
-    engine = os.environ.get("MPITREE_TPU_ENGINE", cfg.engine)
+    # The env var only steers the default ("auto"); an explicit
+    # BuildConfig(engine=...) choice always wins.
+    engine = cfg.engine
+    if engine == "auto":
+        engine = os.environ.get("MPITREE_TPU_ENGINE", "auto")
     if engine not in ("auto", "fused", "levelwise"):
         raise ValueError(f"unknown build engine {engine!r}")
     if engine == "fused" or (engine == "auto" and not debug):

@@ -33,6 +33,7 @@ from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.predict import predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.utils.export import export_tree_text
+from mpitree_tpu.utils.importances import feature_importances
 from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
 from mpitree_tpu.utils.validation import (
     validate_fit_data,
@@ -159,6 +160,24 @@ class DecisionTreeClassifier(BaseEstimator, ClassifierMixin):
         """Reference-style linked ``Node`` view of the fitted tree."""
         check_is_fitted(self)
         return self.tree_.to_nodes()
+
+    @property
+    def feature_importances_(self):
+        """Normalized mean-decrease-in-impurity importances (sklearn idiom;
+        the reference exposes none)."""
+        check_is_fitted(self)
+        return feature_importances(
+            self.tree_, self.n_features_, criterion=self.criterion,
+            task="classification",
+        )
+
+    def get_depth(self):
+        check_is_fitted(self)
+        return self.tree_.max_depth
+
+    def get_n_leaves(self):
+        check_is_fitted(self)
+        return self.tree_.n_leaves
 
     def __sklearn_is_fitted__(self):
         return hasattr(self, "tree_")

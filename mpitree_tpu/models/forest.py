@@ -106,12 +106,62 @@ class _BaseForest(BaseEstimator):
                 )
         return trees
 
+    # Device-memory ceiling for one stacked predict group (4 arrays x int32).
+    _PREDICT_GROUP_BYTES = 256 << 20
+
     def _leaf_ids(self, X: np.ndarray):
+        """Yield (tree, leaf_ids) — trees descend in vmapped device programs
+        over a stacked (tree, node) axis instead of a per-tree Python loop.
+        The stacked arrays are cached host-side and shipped in groups capped
+        at ``_PREDICT_GROUP_BYTES``, so forests of deep trees cannot pin
+        gigabytes of accelerator memory."""
+        cache = getattr(self, "_predict_cache", None)
+        if cache is None:
+            T = len(self.trees_)
+            M = max(t.n_nodes for t in self.trees_)
+            feat = np.full((T, M), -1, np.int32)
+            thr = np.full((T, M), np.nan, np.float32)
+            left = np.full((T, M), -1, np.int32)
+            right = np.full((T, M), -1, np.int32)
+            for i, t in enumerate(self.trees_):
+                feat[i, : t.n_nodes] = t.feature
+                thr[i, : t.n_nodes] = t.threshold
+                left[i, : t.n_nodes] = t.left
+                right[i, : t.n_nodes] = t.right
+            depth = max(max(t.max_depth for t in self.trees_), 1)
+            cache = ((feat, thr, left, right), depth)
+            self._predict_cache = cache
+        (feat, thr, left, right), depth = cache
+        T, M = feat.shape
+        group = max(1, min(T, self._PREDICT_GROUP_BYTES // max(16 * M, 1)))
         X_d = jax.device_put(X)
+        ids = np.empty((T, X.shape[0]), np.int32)
+        for g0 in range(0, T, group):
+            sl = slice(g0, min(g0 + group, T))
+            parts = tuple(jax.device_put(a[sl]) for a in (feat, thr, left, right))
+            ids[sl] = np.asarray(jax.vmap(
+                lambda f, th, l, r: predict_leaf_ids(X_d, (f, th, l, r), depth)
+            )(*parts))
+        for i, t in enumerate(self.trees_):
+            yield t, ids[i]
+
+    @property
+    def feature_importances_(self):
+        """Mean of per-tree normalized importances (sklearn convention)."""
+        check_is_fitted(self)
+        from mpitree_tpu.utils.importances import feature_importances
+
+        task = ("classification" if hasattr(self, "classes_") else "regression")
+        crit = getattr(self, "criterion", "entropy")
+        acc = np.zeros(self.n_features_)
         for t in self.trees_:
-            dev = tuple(jax.device_put(a)
-                        for a in (t.feature, t.threshold, t.left, t.right))
-            yield t, np.asarray(predict_leaf_ids(X_d, dev, t.max_depth))
+            acc += feature_importances(
+                t, self.n_features_, criterion=crit, task=task
+            )
+        # Renormalize so stump trees (all-zero vectors) don't break the
+        # sum-to-1 convention.
+        s = acc.sum()
+        return acc / s if s > 0 else acc
 
     def __sklearn_is_fitted__(self):
         return hasattr(self, "trees_")
@@ -141,6 +191,7 @@ class RandomForestClassifier(_BaseForest, ClassifierMixin):
             X, y_enc, task="classification", criterion=self.criterion,
             n_classes=len(classes), sample_weight=sample_weight,
         )
+        self._predict_cache = None
         return self
 
     def predict_proba(self, X):
@@ -183,6 +234,7 @@ class RandomForestRegressor(_BaseForest, RegressorMixin):
             X, (y64 - self._y_mean).astype(np.float32), task="regression",
             criterion="mse", refit_targets=y64, sample_weight=sample_weight,
         )
+        self._predict_cache = None
         return self
 
     def predict(self, X):

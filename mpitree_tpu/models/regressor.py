@@ -26,6 +26,7 @@ from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.predict import predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.utils.export import export_tree_text
+from mpitree_tpu.utils.importances import feature_importances
 from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
 from mpitree_tpu.utils.validation import (
     validate_fit_data,
@@ -114,6 +115,23 @@ class DecisionTreeRegressor(BaseEstimator, RegressorMixin):
             self.tree_, feature_names=feature_names, precision=precision,
             task="regression",
         )
+
+    @property
+    def feature_importances_(self):
+        """Split-count importances (node variance is not stored; see
+        utils/importances.py)."""
+        check_is_fitted(self)
+        return feature_importances(
+            self.tree_, self.n_features_, task="regression"
+        )
+
+    def get_depth(self):
+        check_is_fitted(self)
+        return self.tree_.max_depth
+
+    def get_n_leaves(self):
+        check_is_fitted(self)
+        return self.tree_.n_leaves
 
     def __sklearn_is_fitted__(self):
         return hasattr(self, "tree_")

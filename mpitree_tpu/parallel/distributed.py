@@ -1,0 +1,71 @@
+"""Multi-host (DCN) initialization — the ``mpirun`` replacement.
+
+The reference scales across processes only via ``mpirun -n k`` + import-time
+``MPI.COMM_WORLD`` bootstrap (reference: ``mpitree/tree/decision_tree.py:
+313-317``). The TPU-native equivalent is ``jax.distributed.initialize``: each
+host process joins a coordination service, after which ``jax.devices()``
+spans every chip in the slice and the SAME mesh/psum build code runs
+unchanged — histogram reductions ride ICI within a host and DCN across
+hosts, with XLA choosing the hierarchical reduction.
+
+Typical multi-host launch (one process per host, e.g. under a TPU pod
+slice's launcher):
+
+    import mpitree_tpu
+    mpitree_tpu.parallel.distributed.initialize()   # env-driven on TPU pods
+    clf = ParallelDecisionTreeClassifier().fit(X, y)  # n_devices="all"
+
+Every process must call :func:`initialize` before touching devices; on
+single-host runs it is a no-op by default.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_initialized = False
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Join the JAX distributed runtime (idempotent).
+
+    With no arguments on a TPU pod, configuration is discovered from the
+    environment (the standard ``jax.distributed.initialize()`` contract).
+    On a single process with no coordinator this is a no-op.
+    """
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None and num_processes in (None, 1):
+        import os
+
+        if not os.environ.get("COORDINATOR_ADDRESS") and not os.environ.get(
+            "JAX_COORDINATOR_ADDRESS"
+        ):
+            return  # single host, nothing to join
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # Devices already touched (or runtime already up): surface the
+        # ordering contract instead of crashing a single-host run.
+        import warnings
+
+        warnings.warn(f"distributed.initialize skipped: {e}", stacklevel=2)
+        return
+    _initialized = True
+
+
+def process_info() -> dict:
+    """Rank/size view mirroring the reference's WORLD_RANK/WORLD_SIZE."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

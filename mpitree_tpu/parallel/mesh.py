@@ -83,8 +83,6 @@ def shard_build_inputs(mesh: Mesh, binned, y, sample_weight):
     the ``data`` axis, and replicates the candidate mask. Returns the four
     sharded arrays plus the replicated mask.
     """
-    import numpy as np  # local to keep module import light
-
     N, F = binned.x_binned.shape
     pad = pad_rows(N, mesh.size)
     xb, yy = binned.x_binned, y

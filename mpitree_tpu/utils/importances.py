@@ -1,0 +1,61 @@
+"""Impurity-based feature importances from the struct-of-arrays tree.
+
+The reference exposes no importances; sklearn users expect
+``feature_importances_`` (mean decrease in impurity). Computed host-side from
+the stored per-node class counts / values: for every interior node,
+
+    importance[feature] += n/N * impurity(node)
+                           - n_l/N * impurity(left) - n_r/N * impurity(right)
+
+normalized to sum to 1 (sklearn's convention). Classification impurity uses
+the tree's training criterion; regression uses variance, which is not
+recoverable from stored node means alone — regression trees therefore use
+weighted split counts (``kind="split"``) unless per-node SSE is available.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mpitree_tpu.core.tree_struct import TreeArrays
+
+
+def _class_impurity(counts: np.ndarray, criterion: str) -> np.ndarray:
+    """(M, C) counts -> (M,) impurity per node."""
+    n = counts.sum(axis=1, keepdims=True).astype(np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        p = counts / np.maximum(n, 1.0)
+        if criterion == "gini":
+            return 1.0 - (p * p).sum(axis=1)
+        t = np.where(counts > 0, p * np.log2(np.maximum(p, 1e-300)), 0.0)
+        return -t.sum(axis=1)
+
+
+def feature_importances(
+    tree: TreeArrays, n_features: int, *, criterion: str = "entropy",
+    task: str = "classification",
+) -> np.ndarray:
+    """Normalized mean-decrease-in-impurity importances, shape (n_features,)."""
+    imp = np.zeros(n_features, np.float64)
+    interior = np.flatnonzero(tree.feature >= 0)
+    if len(interior) == 0:
+        return imp
+    n = tree.n_node_samples.astype(np.float64)
+    total = max(n[0], 1.0)
+
+    if task == "classification":
+        node_imp = _class_impurity(tree.count.astype(np.float64), criterion)
+        left, right = tree.left[interior], tree.right[interior]
+        decrease = (
+            n[interior] * node_imp[interior]
+            - n[left] * node_imp[left]
+            - n[right] * node_imp[right]
+        ) / total
+    else:
+        # Node variance is not stored for regression; weight each split by
+        # the fraction of samples it touches (split-count importance).
+        decrease = n[interior] / total
+
+    np.add.at(imp, tree.feature[interior], np.maximum(decrease, 0.0))
+    s = imp.sum()
+    return imp / s if s > 0 else imp
