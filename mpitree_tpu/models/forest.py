@@ -26,13 +26,23 @@ from sklearn.utils.validation import check_is_fitted
 from mpitree_tpu.core.builder import BuildConfig, build_tree, prefer_host_path
 from mpitree_tpu.core.host_builder import build_tree_host
 from mpitree_tpu.ops.binning import bin_dataset
-from mpitree_tpu.ops.predict import predict_leaf_ids
+from mpitree_tpu.ops.predict import WeakIdCache, predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.utils.validation import (
     validate_fit_data,
     validate_predict_data,
     validate_sample_weight,
 )
+
+
+_stacked_cache = WeakIdCache()
+
+
+class _TreeList(list):
+    """list subclass so the fitted ensemble can anchor weak predict caches
+    (plain lists cannot be weak-referenced)."""
+
+    __slots__ = ("__weakref__",)
 
 
 def _n_subspace_features(max_features, n_features: int) -> int:
@@ -115,8 +125,7 @@ class _BaseForest(BaseEstimator):
         The stacked arrays are cached host-side and shipped in groups capped
         at ``_PREDICT_GROUP_BYTES``, so forests of deep trees cannot pin
         gigabytes of accelerator memory."""
-        cache = getattr(self, "_predict_cache", None)
-        if cache is None:
+        def build_stacked():
             T = len(self.trees_)
             M = max(t.n_nodes for t in self.trees_)
             feat = np.full((T, M), -1, np.int32)
@@ -129,9 +138,11 @@ class _BaseForest(BaseEstimator):
                 left[i, : t.n_nodes] = t.left
                 right[i, : t.n_nodes] = t.right
             depth = max(max(t.max_depth for t in self.trees_), 1)
-            cache = ((feat, thr, left, right), depth)
-            self._predict_cache = cache
-        (feat, thr, left, right), depth = cache
+            return (feat, thr, left, right), depth
+
+        (feat, thr, left, right), depth = _stacked_cache.get_or_build(
+            self.trees_, build_stacked
+        )
         T, M = feat.shape
         group = max(1, min(T, self._PREDICT_GROUP_BYTES // max(16 * M, 1)))
         X_d = jax.device_put(X)
@@ -167,7 +178,7 @@ class _BaseForest(BaseEstimator):
         return hasattr(self, "trees_")
 
 
-class RandomForestClassifier(_BaseForest, ClassifierMixin):
+class RandomForestClassifier(ClassifierMixin, _BaseForest):
     """Bagged classification forest (soft voting over per-tree class counts)."""
 
     def __init__(self, *, n_estimators=10, criterion="entropy", max_depth=None,
@@ -187,11 +198,10 @@ class RandomForestClassifier(_BaseForest, ClassifierMixin):
         self.n_features_ = X.shape[1]
         self.n_features_in_ = X.shape[1]
         self.classes_ = classes
-        self.trees_ = self._fit_forest(
+        self.trees_ = _TreeList(self._fit_forest(
             X, y_enc, task="classification", criterion=self.criterion,
             n_classes=len(classes), sample_weight=sample_weight,
-        )
-        self._predict_cache = None
+        ))
         return self
 
     def predict_proba(self, X):
@@ -199,7 +209,7 @@ class RandomForestClassifier(_BaseForest, ClassifierMixin):
         single tree's raw-count reference quirk, which has no ensemble
         analogue)."""
         check_is_fitted(self)
-        X = validate_predict_data(X, self.n_features_)
+        X = validate_predict_data(X, self.n_features_, type(self).__name__)
         acc = np.zeros((X.shape[0], len(self.classes_)))
         for t, ids in self._leaf_ids(X):
             counts = t.count[ids].astype(np.float64)
@@ -211,7 +221,7 @@ class RandomForestClassifier(_BaseForest, ClassifierMixin):
         return self.classes_[proba.argmax(axis=1)]
 
 
-class RandomForestRegressor(_BaseForest, RegressorMixin):
+class RandomForestRegressor(RegressorMixin, _BaseForest):
     """Bagged regression forest (mean of per-tree predictions)."""
 
     def __init__(self, *, n_estimators=10, max_depth=None,
@@ -230,16 +240,15 @@ class RandomForestRegressor(_BaseForest, RegressorMixin):
         self.n_features_ = X.shape[1]
         self.n_features_in_ = X.shape[1]
         self._y_mean = float(y64.mean()) if len(y64) else 0.0
-        self.trees_ = self._fit_forest(
+        self.trees_ = _TreeList(self._fit_forest(
             X, (y64 - self._y_mean).astype(np.float32), task="regression",
             criterion="mse", refit_targets=y64, sample_weight=sample_weight,
-        )
-        self._predict_cache = None
+        ))
         return self
 
     def predict(self, X):
         check_is_fitted(self)
-        X = validate_predict_data(X, self.n_features_)
+        X = validate_predict_data(X, self.n_features_, type(self).__name__)
         acc = np.zeros(X.shape[0])
         for t, ids in self._leaf_ids(X):
             acc += t.count[ids, 0]

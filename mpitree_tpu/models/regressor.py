@@ -23,7 +23,7 @@ from sklearn.utils.validation import check_is_fitted
 from mpitree_tpu.core.builder import BuildConfig, build_tree, prefer_host_path
 from mpitree_tpu.core.host_builder import build_tree_host
 from mpitree_tpu.ops.binning import bin_dataset
-from mpitree_tpu.ops.predict import predict_leaf_ids
+from mpitree_tpu.ops.predict import device_tree_arrays, predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.utils.export import export_tree_text
 from mpitree_tpu.utils.importances import feature_importances
@@ -35,7 +35,7 @@ from mpitree_tpu.utils.validation import (
 )
 
 
-class DecisionTreeRegressor(BaseEstimator, RegressorMixin):
+class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
     """TPU-native regression tree (squared-error criterion).
 
     Parameters mirror :class:`DecisionTreeClassifier`; ``criterion`` accepts
@@ -91,21 +91,17 @@ class DecisionTreeRegressor(BaseEstimator, RegressorMixin):
                 refit_targets=y64, timer=timer,
             )
         self.fit_stats_ = timer.summary() if timer.enabled else None
-        self._predict_cache = None
         return self
 
     def _leaf_ids(self, X: np.ndarray) -> np.ndarray:
         t = self.tree_
-        if getattr(self, "_predict_cache", None) is None:
-            self._predict_cache = tuple(
-                jax.device_put(a) for a in (t.feature, t.threshold, t.left, t.right)
-            )
-        ids = predict_leaf_ids(jax.device_put(X), self._predict_cache, t.max_depth)
+        dev = device_tree_arrays(t)
+        ids = predict_leaf_ids(jax.device_put(X), dev, t.max_depth)
         return np.asarray(ids)
 
     def predict(self, X):
         check_is_fitted(self)
-        X = validate_predict_data(X, self.n_features_)
+        X = validate_predict_data(X, self.n_features_, type(self).__name__)
         # count[:, 0] holds the exact f64 node means from the refit pass.
         return self.tree_.count[self._leaf_ids(X), 0]
 

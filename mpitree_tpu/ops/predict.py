@@ -10,11 +10,50 @@ leaf. Runs fully on device with static shapes.
 
 from __future__ import annotations
 
+import weakref
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+class WeakIdCache:
+    """id-keyed cache holding values alive only while the key object lives.
+
+    Estimator predict paths use this instead of writing lazily-computed
+    device arrays into ``self.__dict__`` (sklearn's conformance checks
+    require predict to leave the estimator's ``__dict__`` untouched)."""
+
+    def __init__(self):
+        self._store: dict = {}
+
+    def get_or_build(self, key_obj, build):
+        k = id(key_obj)
+        hit = self._store.get(k)
+        if hit is not None and hit[0]() is key_obj:
+            return hit[1]
+        try:
+            ref = weakref.ref(key_obj, lambda _r, k=k: self._store.pop(k, None))
+        except TypeError:  # plain lists etc. aren't weakref-able: no caching
+            return build()
+        val = build()
+        self._store[k] = (ref, val)
+        return val
+
+
+_tree_device_cache = WeakIdCache()
+
+
+def device_tree_arrays(tree):
+    """(feature, threshold, left, right) on device, cached per tree object."""
+    return _tree_device_cache.get_or_build(
+        tree,
+        lambda: tuple(
+            jax.device_put(a)
+            for a in (tree.feature, tree.threshold, tree.left, tree.right)
+        ),
+    )
 
 
 @partial(jax.jit, static_argnames=("n_steps",))

@@ -132,5 +132,4 @@ def load_model(path):
         est.trees_ = trees
     else:
         est.tree_ = trees[0]
-        est._predict_cache = None
     return est

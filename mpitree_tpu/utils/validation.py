@@ -18,6 +18,7 @@ bit-identical to the reference.
 from __future__ import annotations
 
 import numpy as np
+from sklearn.utils.multiclass import check_classification_targets
 from sklearn.utils.validation import check_array, check_X_y
 
 
@@ -26,6 +27,7 @@ def validate_fit_data(X, y, *, task: str = "classification"):
     X, y = check_X_y(X, y, dtype="numeric", y_numeric=(task == "regression"))
     X = np.ascontiguousarray(X, dtype=np.float32)
     if task == "classification":
+        check_classification_targets(y)
         classes, y_enc = np.unique(y, return_inverse=True)
         return X, y_enc.astype(np.int32), classes
     # Regression targets stay float64 on the host: the estimator centers in
@@ -44,14 +46,18 @@ def validate_sample_weight(sample_weight, n_samples: int):
         )
     if (w < 0).any() or not np.isfinite(w).all():
         raise ValueError("sample_weight must be finite and non-negative")
+    if n_samples and not (w > 0).any():
+        raise ValueError("sample_weight is all zero: nothing to fit")
     return w
 
 
-def validate_predict_data(X, n_features: int):
+def validate_predict_data(X, n_features: int, name: str = "estimator"):
     X = check_array(X, dtype="numeric")
     if X.shape[1] != n_features:
+        # sklearn's canonical inconsistent-width message (its estimator
+        # conformance checks match this wording).
         raise ValueError(
-            f"X has {X.shape[1]} features, but the estimator was fitted with "
-            f"{n_features} features"
+            f"X has {X.shape[1]} features, but {name} is expecting "
+            f"{n_features} features as input."
         )
     return np.ascontiguousarray(X, dtype=np.float32)

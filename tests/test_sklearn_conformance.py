@@ -1,0 +1,68 @@
+"""sklearn estimator-conformance suite (check_estimator).
+
+The reference claims sklearn compatibility only by inheritance
+(``decision_tree.py:17``; SURVEY.md §4). Here the full ``check_estimator``
+battery runs against every estimator, with an explicit allowlist for the two
+deliberate deviations:
+
+- ``predict_proba`` returns RAW CLASS COUNTS, not probabilities — the
+  reference's documented quirk (``decision_tree.py:192-227``), which trips
+  sklearn's proba-sums-to-1 assertion;
+- bootstrap forests cannot satisfy weight-vs-row-duplication equivalence
+  (resampling distributions differ; sklearn's own forests are exempted the
+  same way).
+"""
+
+import warnings
+
+import pytest
+from sklearn.utils.estimator_checks import check_estimator
+
+from mpitree_tpu import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    RandomForestClassifier,
+    RandomForestRegressor,
+)
+
+EXPECTED_FAILURES = {
+    "DecisionTreeClassifier": {
+        # raw-count predict_proba (reference parity quirk)
+        "check_classifiers_train",
+    },
+    "DecisionTreeRegressor": set(),
+    "RandomForestClassifier": {
+        "check_sample_weight_equivalence_on_dense_data",  # bootstrap
+    },
+    "RandomForestRegressor": {
+        "check_sample_weight_equivalence_on_dense_data",  # bootstrap
+    },
+}
+
+
+@pytest.mark.parametrize(
+    "estimator",
+    [
+        DecisionTreeClassifier(max_depth=4),
+        DecisionTreeRegressor(max_depth=4),
+        RandomForestClassifier(n_estimators=3, max_depth=3),
+        RandomForestRegressor(n_estimators=3, max_depth=3),
+    ],
+    ids=lambda e: type(e).__name__,
+)
+def test_sklearn_conformance(estimator):
+    name = type(estimator).__name__
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        results = check_estimator(estimator, on_fail=None)
+    unexpected = [
+        r
+        for r in results
+        if r.get("status") not in ("passed", "skipped")
+        and r.get("check_name") not in EXPECTED_FAILURES[name]
+    ]
+    assert not unexpected, [
+        (r.get("check_name"), str(r.get("exception"))[:120]) for r in unexpected
+    ]
+    n_passed = sum(r.get("status") == "passed" for r in results)
+    assert n_passed >= 55  # the battery is substantive, not vacuous
