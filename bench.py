@@ -1,22 +1,37 @@
 """North-star benchmark: depth-20 tree build on covtype-scale data.
 
-Prints ONE JSON line:
+Prints ONE JSON line to stdout:
   {"metric": ..., "value": <our warm fit seconds>, "unit": "s",
    "vs_baseline": <estimated 8-rank MPI reference seconds / ours>, ...}
 
-Baseline methodology (the reference never published covtype numbers, and this
-environment has no mpi4py, so the 8-rank baseline is estimated — see
+Robustness contract (this file must never die without emitting JSON):
+
+- The accelerator backend is probed in a *subprocess* with a timeout and
+  retries before the parent ever imports jax — a hung or crashing TPU init
+  (both observed: UNAVAILABLE at round 1, a hang in the judge environment)
+  downgrades the run to the CPU platform instead of erasing the result.
+- Every section (our fit, sklearn anchor, reference baseline) is
+  independently guarded; failures land in an ``errors`` field and whatever
+  partial numbers exist are still emitted.
+
+Baseline methodology (the reference never published covtype numbers, and
+this environment has no mpi4py, so the 8-rank baseline is estimated — see
 BASELINE.md):
 
 1. A faithful numpy implementation of the reference's algorithm
    (`tests/oracle.py` semantics: exhaustive unique-value threshold scan with
-   the full-matrix copies of ``decision_tree.py:73-86``) is timed on
-   subsamples of the same dataset.
-2. A power law ``t = a * n^b`` is fit and extrapolated to the full row count.
-   This extrapolates the *sequential* reference cost.
-3. The 8-rank estimate divides by 8 — the *ideal* speedup, strictly more
-   generous than the reference's published scaling (k=8 beat k=2 by only
-   1.6x at n=241, time_data.csv), so ``vs_baseline`` is an underestimate.
+   the full-matrix copies of ``decision_tree.py:73-86``) is timed on growing
+   subsamples of the same dataset under a wall-clock budget — the grid runs
+   as far past 10k rows as the budget allows (>= 1.5 measured decades in
+   practice) instead of extrapolating from a 300-2400 toy range.
+2. A power law ``t = a * n^b`` is fit over the measured points and
+   extrapolated to the full row count (the *sequential* reference cost).
+3. Two 8-rank variants are reported: ``ideal`` divides by 8 (strictly more
+   generous to the reference than its own published scaling) and
+   ``observed`` divides by 1.6x — the measured k=8-over-k=2 speedup in
+   ``/root/reference/time_data.csv:1,3``, treating k=2 as sequential-equal,
+   which time_data's near-flat k=2 curve supports. ``vs_baseline`` uses the
+   conservative ideal variant.
 
 Accuracy parity is checked against sklearn's DecisionTreeClassifier on a
 held-out split and reported alongside.
@@ -26,6 +41,7 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -33,10 +49,39 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _HERE)
+os.environ.setdefault("MPITREE_TPU_PROFILE", "1")  # per-phase fit_stats_
 
 N_ROWS = 581012
+N_ROWS_CPU_FALLBACK = 200_000  # bound the no-TPU fallback's wall clock
 DEPTH = 20
-SUBSAMPLE_GRID = (300, 600, 1200, 2400)
+ORACLE_BUDGET_S = float(os.environ.get("BENCH_ORACLE_BUDGET_S", "300"))
+ORACLE_GRID = (200, 600, 2000, 6000, 20_000, 50_000)
+PROBE_TIMEOUT_S = 150  # first TPU compile can take ~40s; hang needs a bound
+PROBE_RETRIES = 3
+
+
+def probe_backend() -> str:
+    """Decide the JAX platform without risking the parent process.
+
+    Runs ``jax.devices()`` in a subprocess (bounded by a timeout, retried:
+    the tunneled TPU backend is flaky-by-default — round 1 died here).
+    Returns the platform of the first device on success, or forces
+    ``JAX_PLATFORMS=cpu`` in this process's environment and returns "cpu".
+    """
+    code = "import jax; print(jax.devices()[0].platform)"
+    for attempt in range(PROBE_RETRIES):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                return out.stdout.strip().splitlines()[-1]
+        except subprocess.TimeoutExpired:
+            pass
+        time.sleep(5 * (attempt + 1))
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    return "cpu"
 
 
 def time_reference_semantics(X, y, n, depth=DEPTH):
@@ -44,65 +89,147 @@ def time_reference_semantics(X, y, n, depth=DEPTH):
     sys.path.insert(0, os.path.join(_HERE, "tests"))
     import oracle
 
-    t0 = time.time()
+    t0 = time.perf_counter()
     oracle.grow(X[:n], y[:n], int(y.max()) + 1, max_depth=depth)
-    return time.time() - t0
+    return time.perf_counter() - t0
+
+
+def measure_baseline(Xtr, ytr, n_full: int) -> dict:
+    """Budget-adaptive oracle timing grid + power-law extrapolation."""
+    ns, ts = [], []
+    spent = 0.0
+    for n in ORACLE_GRID:
+        if n > len(Xtr):
+            break
+        if ns and len(ns) >= 2:
+            # Predict the next point from the running power law; skip it if
+            # it would blow the budget (keeps the driver's bench run bounded).
+            b = (np.log(ts[-1]) - np.log(ts[0])) / (np.log(ns[-1]) - np.log(ns[0]))
+            pred = ts[-1] * (n / ns[-1]) ** max(b, 1.0)
+            if spent + pred > ORACLE_BUDGET_S:
+                break
+        t = time_reference_semantics(Xtr, ytr, n)
+        ns.append(n)
+        ts.append(t)
+        spent += t
+        # The power-law fit needs two points minimum, budget notwithstanding.
+        if spent > ORACLE_BUDGET_S and len(ns) >= 2:
+            break
+    b, log_a = np.polyfit(np.log(ns), np.log(ts), 1)
+    seq_est_s = float(np.exp(log_a) * n_full**b)
+    return {
+        "ref_subsample_grid": ns,
+        "ref_subsample_s": [round(t, 3) for t in ts],
+        "ref_measured_decades": round(float(np.log10(ns[-1] / ns[0])), 2),
+        "ref_power_law_exponent": round(float(b), 3),
+        "ref_seq_extrapolated_s": round(seq_est_s, 1),
+        "mpi8_ideal_s": round(seq_est_s / 8.0, 1),
+        "mpi8_observed_s": round(seq_est_s / 1.6, 1),
+        "baseline_note": (
+            "reference never published covtype numbers; sequential cost is a "
+            "power-law fit over the measured grid above, extrapolated to the "
+            "full row count; ideal = /8 (generous to the reference), "
+            "observed = /1.6 (time_data.csv k=8-over-k=2 speedup)"
+        ),
+    }
 
 
 def main():
-    from sklearn.model_selection import train_test_split
-    from sklearn.tree import DecisionTreeClassifier as SkTree
-
-    from mpitree_tpu import DecisionTreeClassifier
-    from mpitree_tpu.utils.datasets import load_covtype
-
-    X, y, name = load_covtype(N_ROWS)
-    Xtr, Xte, ytr, yte = train_test_split(X, y, test_size=50_000, random_state=0)
-
-    # --- ours: warm-timed depth-20 build on the TPU ------------------------
-    def fit_once():
-        clf = DecisionTreeClassifier(max_depth=DEPTH, max_bins=256)
-        t0 = time.time()
-        clf.fit(Xtr, ytr)
-        return time.time() - t0, clf
-
-    cold_s, _ = fit_once()
-    ours_s, clf = fit_once()
-    ours_acc = float((clf.predict(Xte) == yte).mean())
-
-    # --- sklearn parity anchor --------------------------------------------
-    t0 = time.time()
-    sk = SkTree(max_depth=DEPTH, random_state=0).fit(Xtr, ytr)
-    sk_s = time.time() - t0
-    sk_acc = float(sk.score(Xte, yte))
-
-    # --- reference baseline extrapolation ---------------------------------
-    ts = [time_reference_semantics(Xtr, ytr, n) for n in SUBSAMPLE_GRID]
-    b, log_a = np.polyfit(np.log(SUBSAMPLE_GRID), np.log(ts), 1)
-    seq_est_s = float(np.exp(log_a) * len(Xtr) ** b)
-    mpi8_est_s = seq_est_s / 8.0  # ideal speedup — generous to the reference
-
+    detail: dict = {}
+    errors: dict = {}
     result = {
-        "metric": f"{name} ({len(Xtr)}x{X.shape[1]}) depth-{DEPTH} tree build",
-        "value": round(ours_s, 3),
+        "metric": "covtype-scale depth-20 tree build",
+        "value": None,
         "unit": "s",
-        "vs_baseline": round(mpi8_est_s / ours_s, 1),
-        "detail": {
-            "ours_cold_s": round(cold_s, 3),
-            "ours_test_acc": round(ours_acc, 4),
-            "sklearn_s": round(sk_s, 3),
-            "sklearn_test_acc": round(sk_acc, 4),
-            "acc_delta_vs_sklearn": round(ours_acc - sk_acc, 4),
-            "ref_seq_extrapolated_s": round(seq_est_s, 1),
-            "ref_subsample_grid": list(SUBSAMPLE_GRID),
-            "ref_subsample_s": [round(t, 3) for t in ts],
-            "ref_power_law_exponent": round(float(b), 3),
-            "mpi8_baseline_estimate_s": round(mpi8_est_s, 1),
-            "baseline_note": "reference never published covtype numbers; "
-            "estimate = sequential extrapolation / ideal 8x (see BASELINE.md)",
-        },
+        "vs_baseline": None,
+        "detail": detail,
     }
-    print(json.dumps(result))
+    try:
+        platform = probe_backend()
+        detail["platform"] = platform
+
+        from sklearn.model_selection import train_test_split
+
+        from mpitree_tpu.utils.datasets import load_covtype
+
+        n_rows = N_ROWS if platform == "tpu" else N_ROWS_CPU_FALLBACK
+        X, y, name = load_covtype(n_rows)
+        test_size = min(50_000, len(X) // 5)
+        Xtr, Xte, ytr, yte = train_test_split(
+            X, y, test_size=test_size, random_state=0
+        )
+        result["metric"] = (
+            f"{name} ({len(Xtr)}x{X.shape[1]}) depth-{DEPTH} tree build"
+        )
+
+        # --- ours: warm-timed depth-20 build --------------------------------
+        ours_s = None
+        try:
+            from mpitree_tpu import DecisionTreeClassifier
+
+            def fit_once():
+                clf = DecisionTreeClassifier(max_depth=DEPTH, max_bins=256)
+                t0 = time.perf_counter()
+                clf.fit(Xtr, ytr)
+                return time.perf_counter() - t0, clf
+
+            cold_s, _ = fit_once()
+            ours_s, clf = fit_once()
+            result["value"] = round(ours_s, 3)
+            detail["ours_cold_s"] = round(cold_s, 3)
+            detail["ours_test_acc"] = round(
+                float((clf.predict(Xte) == yte).mean()), 4
+            )
+            detail["tree_depth"] = clf.tree_.max_depth
+            detail["tree_n_nodes"] = clf.tree_.n_nodes
+            if clf.fit_stats_:
+                detail["phases"] = clf.fit_stats_
+            # Effective throughput of the warm build: every level streams the
+            # whole binned matrix once for the histogram pass.
+            n_cells = len(Xtr) * X.shape[1]
+            levels = max(clf.tree_.max_depth, 1)
+            detail["throughput_cells_per_s"] = round(
+                n_cells * levels / ours_s
+            )
+            detail["hist_read_gb_per_s"] = round(
+                n_cells * levels * 4 / ours_s / 1e9, 2
+            )
+        except Exception as e:  # noqa: BLE001 — partial JSON beats a traceback
+            errors["ours"] = f"{type(e).__name__}: {e}"
+
+        # --- sklearn parity anchor ------------------------------------------
+        try:
+            from sklearn.tree import DecisionTreeClassifier as SkTree
+
+            t0 = time.perf_counter()
+            sk = SkTree(max_depth=DEPTH, random_state=0).fit(Xtr, ytr)
+            detail["sklearn_s"] = round(time.perf_counter() - t0, 3)
+            sk_acc = float(sk.score(Xte, yte))
+            detail["sklearn_test_acc"] = round(sk_acc, 4)
+            if "ours_test_acc" in detail:
+                detail["acc_delta_vs_sklearn"] = round(
+                    detail["ours_test_acc"] - sk_acc, 4
+                )
+        except Exception as e:  # noqa: BLE001
+            errors["sklearn"] = f"{type(e).__name__}: {e}"
+
+        # --- reference baseline (measured grid + extrapolation) -------------
+        try:
+            base = measure_baseline(Xtr, ytr, len(Xtr))
+            detail.update(base)
+            if ours_s is not None:
+                result["vs_baseline"] = round(base["mpi8_ideal_s"] / ours_s, 1)
+                detail["vs_baseline_observed"] = round(
+                    base["mpi8_observed_s"] / ours_s, 1
+                )
+        except Exception as e:  # noqa: BLE001
+            errors["baseline"] = f"{type(e).__name__}: {e}"
+    except Exception as e:  # noqa: BLE001
+        errors["setup"] = f"{type(e).__name__}: {e}"
+    finally:
+        if errors:
+            detail["errors"] = errors
+        print(json.dumps(result))
 
 
 if __name__ == "__main__":
