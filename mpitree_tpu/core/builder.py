@@ -36,6 +36,7 @@ import numpy as np
 from mpitree_tpu.core.tree_struct import TreeArrays
 from mpitree_tpu.ops.binning import BinnedData
 from mpitree_tpu.parallel import collective, mesh as mesh_lib
+from mpitree_tpu.utils import importances as imp_utils
 from mpitree_tpu.utils.profiling import PhaseTimer, debug_checks_enabled
 
 
@@ -137,22 +138,27 @@ def integer_weights(sample_weight) -> bool:
 
 def refit_regression_values(tree: TreeArrays, nid_host: np.ndarray,
                             w64: np.ndarray, refit_targets: np.ndarray) -> None:
-    """Exact f64 node-value refit from final row assignments (in place).
+    """Exact f64 node-value/impurity refit from final row assignments (in place).
 
     The on-device f32 moment histograms drive split *selection*; leaf and
-    interior means come from this exact host pass so predictions carry no
-    cancellation noise. Children always have larger ids than their parent, so
-    one descending pass rolls leaf sums up the whole tree."""
+    interior means — and per-node variances for ``feature_importances_`` —
+    come from this exact host pass so neither carries cancellation noise.
+    Children always have larger ids than their parent, so one descending pass
+    rolls leaf sums up the whole tree."""
     s = np.bincount(nid_host, weights=refit_targets * w64,
                     minlength=tree.n_nodes)
+    s2 = np.bincount(nid_host, weights=refit_targets * refit_targets * w64,
+                     minlength=tree.n_nodes)
     ww = np.bincount(nid_host, weights=w64, minlength=tree.n_nodes)
     for i in range(tree.n_nodes - 1, 0, -1):
         p = tree.parent[i]
         s[p] += s[i]
+        s2[p] += s2[i]
         ww[p] += ww[i]
     mean = s / np.maximum(ww, 1e-300)
     tree.value = mean.astype(np.float32)
     tree.count = mean[:, None].copy()
+    tree.impurity = np.maximum(s2 / np.maximum(ww, 1e-300) - mean * mean, 0.0)
 
 
 class _TreeBuffer:
@@ -170,13 +176,14 @@ class _TreeBuffer:
         self.value = np.zeros(self.cap, value_dtype)
         self.count = np.zeros((self.cap, n_value_cols), count_dtype)
         self.n_node_samples = np.zeros(self.cap, np.int64)
+        self.impurity = np.zeros(self.cap, np.float64)
 
     def ensure(self, n: int) -> None:
         if n <= self.cap:
             return
         new_cap = max(n, self.cap * 2)
         for name in ("feature", "threshold", "left", "right", "parent",
-                     "depth", "value", "count", "n_node_samples"):
+                     "depth", "value", "count", "n_node_samples", "impurity"):
             old = getattr(self, name)
             shape = (new_cap,) + old.shape[1:]
             fill = -1 if old.dtype == np.int32 and name != "depth" else 0
@@ -210,6 +217,7 @@ class _TreeBuffer:
             value=self.value[s].copy(),
             count=self.count[s].copy(),
             n_node_samples=self.n_node_samples[s].copy(),
+            impurity=self.impurity[s].copy(),
         )
 
 
@@ -238,6 +246,22 @@ def build_tree(
     cfg = config
     timer = timer if timer is not None else PhaseTimer(enabled=False)
     debug = cfg.debug or debug_checks_enabled()
+
+    if cfg.task == "classification":
+        total_w = (
+            float(binned.x_binned.shape[0]) if sample_weight is None
+            else float(np.sum(sample_weight))
+        )
+        if total_w >= 2**24:
+            import warnings
+
+            warnings.warn(
+                "device class counts accumulate in float32: beyond 2**24 "
+                "total weight the raw-count predict_proba contract can lose "
+                "integer exactness (split selection is unaffected at the "
+                "node sizes where it matters)",
+                stacklevel=2,
+            )
 
     # The env var only steers the default ("auto"); an explicit
     # BuildConfig(engine=...) choice always wins.
@@ -370,8 +394,13 @@ def build_tree(
         tree.n_node_samples[ids] = n.astype(np.int64)
         if task == "classification":
             tree.count[ids] = counts.astype(tree.count.dtype)
+            tree.impurity[ids] = imp_utils.class_node_impurity(
+                counts, cfg.criterion
+            )
         else:
             tree.count[ids, 0] = value
+            # f32-accuracy variance; overwritten exactly by the refit pass.
+            tree.impurity[ids] = imp_utils.moment_node_impurity(dec["counts"])
 
         split_ids = ids[~stop]
         if len(split_ids):

@@ -47,6 +47,7 @@ from mpitree_tpu.ops import impurity as imp_ops
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.parallel.collective import node_counts_local, regression_y_range
 from mpitree_tpu.parallel.mesh import DATA_AXIS
+from mpitree_tpu.utils import importances as imp_utils
 from mpitree_tpu.utils.profiling import PhaseTimer
 
 
@@ -284,10 +285,13 @@ def build_tree_fused(
                 np.int64 if integer_weights(sample_weight) else np.float64
             )
             value = counts.argmax(axis=1).astype(np.int32)
+            impurity = imp_utils.class_node_impurity(counts, cfg.criterion)
         else:
             mean = counts[:, 1] / np.maximum(counts[:, 0], 1.0)
             value = mean.astype(np.float32)
             count_out = mean[:, None].astype(np.float64)
+            # f32-accuracy variance; overwritten exactly by the refit pass.
+            impurity = imp_utils.moment_node_impurity(counts)
 
         tree = TreeArrays(
             feature=feat.astype(np.int32),
@@ -299,6 +303,7 @@ def build_tree_fused(
             value=value,
             count=count_out,
             n_node_samples=nvec.astype(np.int64),
+            impurity=impurity,
         )
 
     if task == "regression" and refit_targets is not None:

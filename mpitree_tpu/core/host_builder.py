@@ -15,7 +15,13 @@ contract, with single-digit-millisecond latency.
 Float caveat: gains here are computed in float64 (like the reference's numpy
 path) while the device path uses float32. On exact ties the argmin can in
 principle differ between the two paths by floating-point noise; the test
-suite pins identity on the standard fixtures.
+suite pins identity on the standard fixtures. A second seam of the same kind:
+the native C++ sweep (split_kernel.cpp) accepts a new minimum only when it
+beats the incumbent by >1e-12 relative (guarding against non-associative
+incremental updates), while this numpy fallback uses strict first-argmin —
+two genuinely distinct costs closer than 1e-12 relative could resolve
+differently depending on whether g++ was available. The cross-engine fuzz
+tests (tests/test_engine_identity.py) pin this seam across many seeds.
 """
 
 from __future__ import annotations
@@ -23,6 +29,10 @@ from __future__ import annotations
 import numpy as np
 
 from mpitree_tpu.core.tree_struct import TreeArrays
+from mpitree_tpu.utils.importances import (
+    class_node_impurity,
+    moment_node_impurity,
+)
 
 
 def _child_impurity_class(hist, criterion: str):
@@ -90,13 +100,14 @@ def _native_splits(xb, y, nid, sample_weight, binned, cfg, *, frontier_lo,
 
 
 def _record_level(tree, ids, S, terminal, stop, feat_best, value, n, counts,
-                  task):
+                  task, node_imp):
     tree.feature[ids] = (
         np.full(S, -1, np.int32) if terminal
         else np.where(stop, -1, feat_best).astype(np.int32)
     )
     tree.value[ids] = value
     tree.n_node_samples[ids] = n.astype(np.int64)
+    tree.impurity[ids] = node_imp
     if task == "classification":
         tree.count[ids] = counts.astype(tree.count.dtype)
     else:
@@ -199,11 +210,13 @@ def build_tree_host(
                 n = counts.sum(axis=1)
                 pure = (counts > 0).sum(axis=1) <= 1
                 value = counts.argmax(axis=1).astype(np.int32)
+                node_imp = class_node_impurity(counts, cfg.criterion)
             else:
                 n = nat["counts"][:, 0]
                 mean = nat["counts"][:, 1] / np.maximum(n, 1.0)
                 value = mean.astype(np.float32)
                 pure = ~(nat["ymax"] > nat["ymin"])
+                node_imp = moment_node_impurity(nat["counts"])
             feat_best = nat["feature"]
             bin_best = nat["bin"]
             ids = frontier_lo + np.arange(S)
@@ -213,7 +226,7 @@ def build_tree_host(
             )
             _record_level(
                 tree, ids, S, False, stop, feat_best, value, n, counts
-                if task == "classification" else None, task,
+                if task == "classification" else None, task, node_imp,
             )
             nid, frontier_lo, frontier_size, depth = _split_and_advance(
                 tree, binned, xb, nid, ids, stop, feat_best, bin_best,
@@ -229,13 +242,16 @@ def build_tree_host(
             n = counts.sum(axis=1)
             pure = (counts > 0).sum(axis=1) <= 1
             value = counts.argmax(axis=1).astype(np.int32)
+            node_imp = class_node_impurity(counts, cfg.criterion)
         else:
             flat = slot[live].astype(np.intp)
             wv = w[live]
             n = np.bincount(flat, weights=wv, minlength=S)
             s1 = np.bincount(flat, weights=wv * y_f[live], minlength=S)
+            s2 = np.bincount(flat, weights=wv * np.square(y_f[live], dtype=np.float64), minlength=S)
             mean = s1 / np.maximum(n, 1.0)
             value = mean.astype(np.float32)
+            node_imp = moment_node_impurity(np.stack([n, s1, s2], axis=1))
             live_w = live & (w > 0)
             ymin = np.full(S, np.inf)
             ymax = np.full(S, -np.inf)
@@ -296,7 +312,7 @@ def build_tree_host(
             bin_best = np.zeros(S, np.int32)
         _record_level(
             tree, ids, S, terminal, stop, feat_best, value, n,
-            counts if task == "classification" else None, task,
+            counts if task == "classification" else None, task, node_imp,
         )
         nid, frontier_lo, frontier_size, depth = _split_and_advance(
             tree, binned, xb, nid, ids, stop, feat_best, bin_best,
@@ -306,17 +322,11 @@ def build_tree_host(
     out = tree.finalize()
 
     if task == "regression" and refit_targets is not None:
+        from mpitree_tpu.core.builder import refit_regression_values
+
         w64 = (np.ones(N) if sample_weight is None else sample_weight).astype(
             np.float64
         )
-        s = np.bincount(nid, weights=refit_targets * w64, minlength=out.n_nodes)
-        ww = np.bincount(nid, weights=w64, minlength=out.n_nodes)
-        for i in range(out.n_nodes - 1, 0, -1):
-            p = out.parent[i]
-            s[p] += s[i]
-            ww[p] += ww[i]
-        mean = s / np.maximum(ww, 1e-300)
-        out.value = mean.astype(np.float32)
-        out.count = mean[:, None].copy()
+        refit_regression_values(out, nid, w64, refit_targets)
 
     return out

@@ -43,6 +43,12 @@ class TreeArrays:
         ``(n_nodes, 1)`` float64 node means.
     n_node_samples : (n_nodes,) int64
         Training rows routed through each node.
+    impurity : (n_nodes,) float64
+        Per-node impurity under the training criterion (entropy/gini for
+        classification, variance for regression) — feeds exact
+        mean-decrease-in-impurity ``feature_importances_``. Regression
+        values come from an exact f64 host pass (``refit_regression_values``);
+        files saved before this field existed load with zeros.
     """
 
     feature: np.ndarray
@@ -54,6 +60,11 @@ class TreeArrays:
     value: np.ndarray
     count: np.ndarray
     n_node_samples: np.ndarray
+    impurity: np.ndarray = None
+
+    def __post_init__(self):
+        if self.impurity is None:
+            self.impurity = np.zeros(self.feature.shape[0], np.float64)
 
     @property
     def n_nodes(self) -> int:

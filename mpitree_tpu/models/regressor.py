@@ -114,8 +114,8 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
 
     @property
     def feature_importances_(self):
-        """Split-count importances (node variance is not stored; see
-        utils/importances.py)."""
+        """Mean-decrease-in-impurity importances from the exact per-node
+        variances stored by the f64 refit pass (utils/importances.py)."""
         check_is_fitted(self)
         return feature_importances(
             self.tree_, self.n_features_, task="regression"

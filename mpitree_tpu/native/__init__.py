@@ -29,25 +29,51 @@ _f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
 _u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
 
 
-def _build() -> str | None:
-    """Compile the kernel; returns the .so path or None."""
-    cache_dir = os.environ.get(
-        "MPITREE_TPU_NATIVE_CACHE", os.path.join(_HERE, "_build")
-    )
-    os.makedirs(cache_dir, exist_ok=True)
-    so_path = os.path.join(cache_dir, "split_kernel.so")
-    if os.path.exists(so_path) and os.path.getmtime(so_path) >= os.path.getmtime(_SRC):
-        return so_path
-    cmd = [
-        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-march=native",
-        _SRC, "-o", so_path + ".tmp",
-    ]
+def _host_tag() -> str:
+    """Cache key component tying a -march=native build to compatible hosts.
+
+    The cache dir can be shared across machines (NFS home, baked container
+    image); a .so compiled for a newer CPU would SIGILL on an older one, so
+    the filename carries the arch plus a hash of the CPU feature flags."""
+    import hashlib
+    import platform
+
+    tag = platform.machine() or "unknown"
     try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    h = hashlib.sha256(line.encode()).hexdigest()[:8]
+                    return f"{tag}-{h}"
+    except OSError:
+        pass
+    return tag
+
+
+def _build() -> str | None:
+    """Compile the kernel; returns the .so path or None (numpy fallback)."""
+    try:
+        cache_dir = os.environ.get(
+            "MPITREE_TPU_NATIVE_CACHE", os.path.join(_HERE, "_build")
+        )
+        os.makedirs(cache_dir, exist_ok=True)
+        so_path = os.path.join(cache_dir, f"split_kernel.{_host_tag()}.so")
+        if os.path.exists(so_path) and (
+            os.path.getmtime(so_path) >= os.path.getmtime(_SRC)
+        ):
+            return so_path
+        # Unique temp name per process: two first-builds racing must not
+        # load each other's half-written .so.
+        tmp = f"{so_path}.{os.getpid()}.tmp"
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-march=native",
+            _SRC, "-o", tmp,
+        ]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so_path)  # atomic on the same filesystem
+        return so_path
     except Exception:
         return None
-    os.replace(so_path + ".tmp", so_path)
-    return so_path
 
 
 def lib():

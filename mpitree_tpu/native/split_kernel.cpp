@@ -11,7 +11,8 @@
 // class (reference: experiments.ipynb cell 5).
 //
 // Exposed via ctypes (no pybind11 in this environment): plain C ABI, arrays
-// passed as raw pointers with explicit shapes. Built by native/build.py.
+// passed as raw pointers with explicit shapes. Compiled on first use by
+// native/__init__.py (g++ into a cached .so).
 //
 // Semantics contract (must match ops/impurity.py and the reference):
 //   - candidate b means "x_binned <= b", thresholds ascending per feature;

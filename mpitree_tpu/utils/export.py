@@ -47,7 +47,12 @@ def export_tree_text(
         f = int(tree.feature[i])
         return feature_names[f] if feature_names is not None else f"feature_{f}"
 
-    def emit(i: int, glyph: str, prefix: str) -> None:
+    # Explicit stack (preorder): recursion depth would equal tree depth, and
+    # the reference's own cell-5 workload (y = arange(n)) grows unbounded
+    # chains past Python's frame limit.
+    stack = [(0, _GLYPH_ROOT, "")] if tree.n_nodes else []
+    while stack:
+        i, glyph, prefix = stack.pop()
         text = f"{glyph} {label(i)}"
         p = int(tree.parent[i])
         if p >= 0:
@@ -56,7 +61,7 @@ def export_tree_text(
         lines.append(prefix + text)
 
         if tree.feature[i] < 0:
-            return
+            continue
         l, r = int(tree.left[i]), int(tree.right[i])
         # Reference child ordering via Node.__lt__ side effects (_base.py:63-75):
         # an interior right child wins the first slot; otherwise (left, right).
@@ -65,11 +70,8 @@ def export_tree_text(
         else:
             order = [(l, _GLYPH_INTERIOR), (r, _GLYPH_LEAF)]
         child_prefix = prefix + ("   " if glyph == _GLYPH_LEAF else "│  ")
-        for c, g in order:
-            emit(c, g, child_prefix)
-
-    if tree.n_nodes:
-        emit(0, _GLYPH_ROOT, "")
+        for c, g in reversed(order):
+            stack.append((c, g, child_prefix))
     return "\n".join(lines)
 
 

@@ -1,16 +1,17 @@
 """Impurity-based feature importances from the struct-of-arrays tree.
 
 The reference exposes no importances; sklearn users expect
-``feature_importances_`` (mean decrease in impurity). Computed host-side from
-the stored per-node class counts / values: for every interior node,
+``feature_importances_`` (mean decrease in impurity). Computed host-side: for
+every interior node,
 
     importance[feature] += n/N * impurity(node)
                            - n_l/N * impurity(left) - n_r/N * impurity(right)
 
-normalized to sum to 1 (sklearn's convention). Classification impurity uses
-the tree's training criterion; regression uses variance, which is not
-recoverable from stored node means alone — regression trees therefore use
-weighted split counts (``kind="split"``) unless per-node SSE is available.
+normalized to sum to 1 (sklearn's convention). Classification impurity is
+recomputed exactly from the stored per-node class counts under the training
+criterion; regression uses the per-node variance stored in
+``TreeArrays.impurity`` (an exact f64 pass over the final row assignments —
+see ``builder.refit_regression_values``).
 """
 
 from __future__ import annotations
@@ -20,15 +21,28 @@ import numpy as np
 from mpitree_tpu.core.tree_struct import TreeArrays
 
 
-def _class_impurity(counts: np.ndarray, criterion: str) -> np.ndarray:
-    """(M, C) counts -> (M,) impurity per node."""
-    n = counts.sum(axis=1, keepdims=True).astype(np.float64)
+def class_node_impurity(counts: np.ndarray, criterion: str) -> np.ndarray:
+    """(M, C) class counts -> (M,) entropy/gini impurity per node, f64."""
+    counts = counts.astype(np.float64)
+    n = counts.sum(axis=1, keepdims=True)
     with np.errstate(divide="ignore", invalid="ignore"):
         p = counts / np.maximum(n, 1.0)
         if criterion == "gini":
-            return 1.0 - (p * p).sum(axis=1)
+            return np.where(n[:, 0] > 0, 1.0 - (p * p).sum(axis=1), 0.0)
         t = np.where(counts > 0, p * np.log2(np.maximum(p, 1e-300)), 0.0)
         return -t.sum(axis=1)
+
+
+def moment_node_impurity(moments: np.ndarray) -> np.ndarray:
+    """(M, 3) ``(w, w*y, w*y^2)`` moments -> (M,) variance per node, f64.
+
+    Only a float32-accuracy fallback for builds without a refit pass; the
+    exact values come from ``builder.refit_regression_values``.
+    """
+    m = moments.astype(np.float64)
+    w = np.maximum(m[:, 0], 1e-300)
+    mean = m[:, 1] / w
+    return np.maximum(m[:, 2] / w - mean * mean, 0.0)
 
 
 def feature_importances(
@@ -44,17 +58,31 @@ def feature_importances(
     total = max(n[0], 1.0)
 
     if task == "classification":
-        node_imp = _class_impurity(tree.count.astype(np.float64), criterion)
-        left, right = tree.left[interior], tree.right[interior]
-        decrease = (
-            n[interior] * node_imp[interior]
-            - n[left] * node_imp[left]
-            - n[right] * node_imp[right]
-        ) / total
+        node_imp = class_node_impurity(tree.count, criterion)
     else:
-        # Node variance is not stored for regression; weight each split by
-        # the fraction of samples it touches (split-count importance).
-        decrease = n[interior] / total
+        node_imp = tree.impurity
+        if not node_imp.any():
+            # Trees saved before the impurity field existed load with zeros;
+            # returning an all-zero vector would silently read as "no
+            # signal". Fall back to the pre-field behavior.
+            import warnings
+
+            warnings.warn(
+                "regression tree has no stored per-node impurity (saved by "
+                "an older version?); falling back to split-count "
+                "importances — refit to get exact MDI",
+                stacklevel=2,
+            )
+            decrease = n[interior] / total
+            np.add.at(imp, tree.feature[interior], decrease)
+            s = imp.sum()
+            return imp / s if s > 0 else imp
+    left, right = tree.left[interior], tree.right[interior]
+    decrease = (
+        n[interior] * node_imp[interior]
+        - n[left] * node_imp[left]
+        - n[right] * node_imp[right]
+    ) / total
 
     np.add.at(imp, tree.feature[interior], np.maximum(decrease, 0.0))
     s = imp.sum()

@@ -68,7 +68,13 @@ def _tree_arrays(prefix: str, tree: TreeArrays) -> dict:
 
 
 def _read_tree(z, prefix: str) -> TreeArrays:
-    return TreeArrays(**{name: z[f"{prefix}{name}"] for name in _TREE_FIELDS})
+    # Fields absent from older files (e.g. impurity) fall back to the
+    # dataclass default.
+    return TreeArrays(**{
+        name: z[f"{prefix}{name}"]
+        for name in _TREE_FIELDS
+        if f"{prefix}{name}" in z.files
+    })
 
 
 def save_model(estimator, path) -> None:
@@ -129,7 +135,11 @@ def load_model(path):
             est.classes_ = z["classes_"]
         trees = [_read_tree(z, f"tree{i}/") for i in range(header["n_trees"])]
     if header["class"].startswith("RandomForest"):
-        est.trees_ = trees
+        # _TreeList (not a plain list) so the weak-ref stacked-predict cache
+        # works on loaded forests exactly as on freshly fitted ones.
+        from mpitree_tpu.models.forest import _TreeList
+
+        est.trees_ = _TreeList(trees)
     else:
         est.tree_ = trees[0]
     return est

@@ -1,0 +1,130 @@
+"""Cross-engine identity fuzzing.
+
+The framework promises one tree regardless of where it is built: host C++
+(native split sweep), host numpy (fallback), device levelwise, device fused —
+at any mesh size. That contract has seams: the native kernel's 1e-12 relative
+tie tolerance vs strict argmin (split_kernel.cpp), f32 device costs vs f64
+host costs, and psum reduction order. These property tests pin the contract
+over many random integer-grid datasets (integer grids maximize exact ties,
+the hardest case for tie-break agreement — the reference's replicated argmax
+correctness story, ``mpitree/tree/decision_tree.py:408-419``, depends on it).
+
+Shapes are held constant across seeds so each engine configuration compiles
+exactly once.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from mpitree_tpu.core.builder import BuildConfig, build_tree
+from mpitree_tpu.core.host_builder import build_tree_host
+from mpitree_tpu.ops.binning import bin_dataset
+from mpitree_tpu.parallel import mesh as mesh_lib
+
+N, F = 128, 4
+N_CLASSES = 3
+MESH_SIZES = (1, 2, 8)
+
+
+def _integer_grid(seed: int):
+    """(N, F) matrix over a 5-value grid; every feature spans all 5 values so
+    the binned shape (and the compiled executable) is seed-independent."""
+    rng = np.random.default_rng(seed)
+    X = rng.integers(0, 5, size=(N, F)).astype(np.float32)
+    X[:5] = np.arange(5, dtype=np.float32)[:, None]  # pin the value range
+    return rng, X
+
+
+def _class_labels(rng):
+    # int32: the builders' encoded-label contract (validate_fit_data)
+    y = rng.integers(0, N_CLASSES, size=N).astype(np.int32)
+    y[:N_CLASSES] = np.arange(N_CLASSES)  # pin the class count
+    return y
+
+
+def _structure(tree):
+    return (
+        tree.feature.tolist(),
+        tree.left.tolist(),
+        tree.right.tolist(),
+        # leaf thresholds are nan; nan != nan would fail self-comparison
+        np.nan_to_num(np.round(tree.threshold, 6), nan=-999.0).tolist(),
+        tree.n_node_samples.tolist(),
+    )
+
+
+def _force_numpy_fallback(monkeypatch):
+    from mpitree_tpu import native
+
+    monkeypatch.setattr(native, "lib", lambda: None)
+
+
+def _device_trees(binned, y, cfg, **kw):
+    out = {}
+    for nd in MESH_SIZES:
+        mesh = mesh_lib.resolve_mesh(n_devices=nd)
+        for engine in ("fused", "levelwise"):
+            c = BuildConfig(**{**cfg.__dict__, "engine": engine})
+            out[f"{engine}@{nd}"] = build_tree(binned, y, config=c, mesh=mesh, **kw)
+    return out
+
+
+@pytest.mark.parametrize("criterion", ["entropy", "gini"])
+@pytest.mark.parametrize("seed", range(13))
+def test_classification_identity_across_engines(seed, criterion, monkeypatch):
+    rng, X = _integer_grid(seed)
+    y = _class_labels(rng)
+    binned = bin_dataset(X, binning="exact")
+    cfg = BuildConfig(task="classification", criterion=criterion, max_depth=5)
+
+    trees = {}
+    trees["host"] = build_tree_host(binned, y, config=cfg, n_classes=N_CLASSES)
+    with pytest.MonkeyPatch.context() as mp:
+        _force_numpy_fallback(mp)
+        trees["host-numpy"] = build_tree_host(
+            binned, y, config=cfg, n_classes=N_CLASSES
+        )
+    trees.update(_device_trees(binned, y, cfg, n_classes=N_CLASSES))
+
+    ref_name, ref = "host", trees["host"]
+    for name, t in trees.items():
+        assert _structure(t) == _structure(ref), f"{name} != {ref_name} (seed={seed})"
+        np.testing.assert_array_equal(
+            t.count, ref.count, err_msg=f"{name} counts (seed={seed})"
+        )
+        np.testing.assert_array_equal(
+            t.value, ref.value, err_msg=f"{name} values (seed={seed})"
+        )
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_regression_split_identity_across_engines(seed, monkeypatch):
+    rng, X = _integer_grid(seed + 100)
+    yr = rng.integers(0, 7, size=N).astype(np.float64)
+    y_c = (yr - yr.mean()).astype(np.float32)
+    binned = bin_dataset(X, binning="exact")
+    cfg = BuildConfig(task="regression", criterion="mse", max_depth=5)
+
+    trees = {}
+    trees["host"] = build_tree_host(binned, y_c, config=cfg, refit_targets=yr)
+    with pytest.MonkeyPatch.context() as mp:
+        _force_numpy_fallback(mp)
+        trees["host-numpy"] = build_tree_host(
+            binned, y_c, config=cfg, refit_targets=yr
+        )
+    trees.update(_device_trees(binned, y_c, cfg, refit_targets=yr))
+
+    ref = trees["host"]
+    for name, t in trees.items():
+        assert _structure(t) == _structure(ref), f"{name} (seed={seed})"
+        # Exact f64 refit from identical partitions -> identical values.
+        np.testing.assert_allclose(
+            t.count[:, 0], ref.count[:, 0], rtol=0, atol=0,
+            err_msg=f"{name} means (seed={seed})",
+        )
+        np.testing.assert_allclose(
+            t.impurity, ref.impurity, rtol=0, atol=0,
+            err_msg=f"{name} impurity (seed={seed})",
+        )

@@ -46,13 +46,119 @@ def test_depth_and_leaves_accessors():
     assert clf.get_n_leaves() == (clf.tree_.feature < 0).sum()
 
 
-def test_regressor_importances_split_counts():
+def test_regressor_importances_identify_signal():
     X, _ = _informative_data()
     yr = X[:, 0] * 2.0 + 0.1 * np.random.default_rng(1).normal(size=len(X))
     reg = DecisionTreeRegressor(max_depth=5).fit(X, yr)
     imp = reg.feature_importances_
     assert abs(imp.sum() - 1.0) < 1e-9
     assert imp.argmax() == 0
+
+
+def _partition_multiset(tree):
+    """Order-free structural fingerprint: (feature, n_samples, depth) per node.
+
+    Lets our breadth-first node order compare against sklearn's depth-first
+    order; leaf markers normalize to -1 (sklearn uses -2).
+    """
+    if hasattr(tree, "children_left"):  # sklearn
+        depth = np.zeros(tree.node_count, int)
+        for i in range(tree.node_count):
+            l, r = tree.children_left[i], tree.children_right[i]
+            if l >= 0:
+                depth[l] = depth[i] + 1
+                depth[r] = depth[i] + 1
+        feats, ns = tree.feature, tree.n_node_samples
+    else:
+        feats, ns, depth = tree.feature, tree.n_node_samples, tree.depth
+    return sorted(
+        (max(int(f), -1), int(n), int(d)) for f, n, d in zip(feats, ns, depth)
+    )
+
+
+def test_regressor_importances_match_sklearn_exactly():
+    """Exact-binning MDI vs sklearn on continuous data (identical partitions).
+
+    sklearn places thresholds at midpoints while we use data values, but on
+    tie-free continuous data both pick the same (feature, partition) at every
+    node, so the mean-decrease-in-impurity vectors must agree to float
+    precision — the per-node variances come from the exact f64 refit pass.
+    The partition precondition is asserted first so a failure distinguishes
+    structure drift (near-tie flipped by our deliberate f32 regression costs)
+    from MDI math. Depths stay <= 4: deeper trees reach few-sample nodes where
+    f32-vs-f64 near-ties genuinely flip splits.
+    """
+    from sklearn.tree import DecisionTreeRegressor as SkReg
+
+    for seed in (0, 7):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(300, 5)).astype(np.float64)
+        yr = (
+            2.0 * X[:, 0] - 1.5 * X[:, 2] + 0.5 * X[:, 1] * X[:, 1]
+            + 0.1 * rng.normal(size=len(X))
+        )
+        for depth in (3, 4):
+            ours = DecisionTreeRegressor(
+                max_depth=depth, binning="exact"
+            ).fit(X, yr)
+            sk = SkReg(max_depth=depth, random_state=0).fit(X, yr)
+            assert _partition_multiset(ours.tree_) == _partition_multiset(
+                sk.tree_
+            ), f"partition drift (seed={seed}, depth={depth})"
+            np.testing.assert_allclose(
+                ours.feature_importances_, sk.feature_importances_,
+                rtol=1e-6, atol=1e-10,
+            )
+
+
+def test_classifier_importances_match_sklearn_exactly():
+    """Same partition-identity argument, classification/gini."""
+    from sklearn.tree import DecisionTreeClassifier as SkTree
+
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(300, 5)).astype(np.float64)
+    y = ((X[:, 0] > 0.3) + 2 * (X[:, 1] + X[:, 3] > 0)).astype(np.int64)
+    ours = DecisionTreeClassifier(
+        max_depth=5, criterion="gini", binning="exact"
+    ).fit(X, y)
+    sk = SkTree(max_depth=5, criterion="gini", random_state=0).fit(X, y)
+    assert _partition_multiset(ours.tree_) == _partition_multiset(sk.tree_)
+    np.testing.assert_allclose(
+        ours.feature_importances_, sk.feature_importances_,
+        rtol=1e-6, atol=1e-10,
+    )
+
+
+def test_impurity_stored_on_all_engines():
+    """Every engine stores per-node impurity; root variance matches y.var()."""
+    from mpitree_tpu.core.builder import BuildConfig, build_tree
+    from mpitree_tpu.core.host_builder import build_tree_host
+    from mpitree_tpu.ops.binning import bin_dataset
+    from mpitree_tpu.parallel import mesh as mesh_lib
+
+    rng = np.random.default_rng(5)
+    X = rng.normal(size=(200, 4)).astype(np.float32)
+    yr = (X[:, 0] - 0.5 * X[:, 1]).astype(np.float64)
+    binned = bin_dataset(X, max_bins=64, binning="exact")
+    cfg = BuildConfig(task="regression", criterion="mse", max_depth=4)
+    mesh = mesh_lib.resolve_mesh(n_devices=2)
+    trees = {
+        "host": build_tree_host(
+            binned, (yr - yr.mean()).astype(np.float32), config=cfg,
+            refit_targets=yr,
+        ),
+        "device": build_tree(
+            binned, (yr - yr.mean()).astype(np.float32), config=cfg,
+            mesh=mesh, refit_targets=yr,
+        ),
+    }
+    for name, t in trees.items():
+        assert t.impurity.shape == (t.n_nodes,), name
+        np.testing.assert_allclose(t.impurity[0], yr.var(), rtol=1e-9)
+        # Leaves of an exact fit on pure nodes have zero variance only if
+        # pure; all impurities are finite and non-negative.
+        assert np.isfinite(t.impurity).all(), name
+        assert (t.impurity >= 0).all(), name
 
 
 def test_forest_importances_and_vectorized_predict():

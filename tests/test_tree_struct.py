@@ -55,3 +55,55 @@ def test_tree_stats(iris2):
                 t.n_node_samples[t.left[i]] + t.n_node_samples[t.right[i]]
                 == t.n_node_samples[i]
             )
+
+
+def test_deep_chain_render_and_nodes_no_recursion_limit():
+    """A depth-3000 right-going chain (the worst case skewed fits approach)
+    must render and materialize the linked-Node view without hitting Python's
+    ~1000-frame recursion limit — both traversals use explicit stacks."""
+    from mpitree_tpu.core.tree_struct import TreeArrays
+    from mpitree_tpu.utils.export import export_tree_text
+
+    depth = 3000
+    m = 2 * depth + 1  # interior chain, one leaf hanging left per level
+    feature = np.full(m, -1, np.int32)
+    threshold = np.full(m, np.nan, np.float32)
+    left = np.full(m, -1, np.int32)
+    right = np.full(m, -1, np.int32)
+    parent = np.full(m, -1, np.int32)
+    depth_a = np.zeros(m, np.int32)
+    for d in range(depth):
+        i, l, r = 2 * d, 2 * d + 1, 2 * d + 2
+        feature[i] = 0
+        threshold[i] = float(d)
+        left[i], right[i] = l, r
+        parent[l] = parent[r] = i
+        depth_a[l] = depth_a[r] = d + 1
+    t = TreeArrays(
+        feature=feature, threshold=threshold, left=left, right=right,
+        parent=parent, depth=depth_a, value=np.zeros(m, np.int32),
+        count=np.ones((m, 2), np.int64),
+        n_node_samples=np.ones(m, np.int64),
+    )
+    text = export_tree_text(t, task="classification")
+    assert text.count("\n") + 1 == m
+    root = t.to_nodes()
+    # walk to the bottom iteratively; the chain goes right
+    node, hops = root, 0
+    while node.right is not None:
+        node, hops = node.right, hops + 1
+    assert hops == depth
+
+
+def test_degenerate_arange_fit_renders():
+    """The reference's cell-5 workload (X = y = arange(n)) at n=5000: fit,
+    render, and link-view all succeed (the entropy-optimal tree is balanced,
+    so this exercises scale rather than depth)."""
+    n = 5000
+    X = np.arange(n, dtype=np.float64).reshape(-1, 1)
+    y = np.arange(n)
+    clf = DecisionTreeClassifier(backend="host", binning="exact").fit(X, y)
+    t = clf.tree_
+    assert t.n_leaves == n  # memorized: every sample its own leaf
+    text = clf.export_text()
+    assert text.count("\n") + 1 == t.n_nodes
