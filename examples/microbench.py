@@ -16,9 +16,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def timed(fn, *args, reps=3):
@@ -154,6 +158,51 @@ def main():
 
     s = timed(tile_matmul_bf, Abf, OHbf)
     report("bf16_tile_matmul_AxOH", s, 2 * n_tiles * T * T * oh_cols, "GFLOP/s")
+
+    # 3b. Pallas one-hot-matmul histogram vs XLA scatter (small frontier) --
+    # The measured justification for ops/pallas_hist.py: both ops compute
+    # the identical (S, F, C, B) histogram a small-frontier level needs.
+    from mpitree_tpu.ops import histogram as hist_ops
+    from mpitree_tpu.ops import pallas_hist as ph
+
+    S_small = 8
+    nid_s = jnp.asarray(rng.integers(0, S_small, size=N, dtype=np.int32))
+    w1 = jnp.ones(N, jnp.float32)
+
+    @jax.jit
+    def xla_small_hist(xb, y, nid_s):
+        return hist_ops.class_histogram(
+            xb, y, nid_s, jnp.int32(0), n_slots=S_small, n_bins=B,
+            n_classes=C, sample_weight=w1,
+        )
+
+    s = timed(xla_small_hist, xb, y, nid_s)
+    report("hist_small_xla_scatter", s, N * F, "G updates/s")
+
+    if ph.pallas_available(dev):
+        payload = ph.class_payload(y, w1, C)
+
+        def pallas_small_hist(xb, payload, nid_s):
+            return ph.histogram_small(
+                xb, payload, nid_s, n_slots=S_small, n_bins=B, n_channels=C
+            )
+
+        s2 = timed(pallas_small_hist, xb, payload, nid_s)
+        report("hist_small_pallas_mxu", s2, N * F, "G updates/s")
+        same = bool(
+            np.allclose(
+                np.asarray(xla_small_hist(xb, y, nid_s)),
+                np.asarray(pallas_small_hist(xb, payload, nid_s)),
+            )
+        )
+        print(json.dumps({
+            "bench": "hist_small_identity", "match": same,
+            "pallas_speedup_x": round(s / s2, 2),
+        }), flush=True)
+    else:
+        print(json.dumps(
+            {"bench": "hist_small_pallas_mxu", "skipped": f"platform={dev}"}
+        ), flush=True)
 
     # 4. reorder bookkeeping: sort and cumsum ------------------------------
     @jax.jit

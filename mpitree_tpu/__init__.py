@@ -14,8 +14,11 @@ TPU-first:
   replacing the reference's MPI communicator splitting
   (reference: ``mpitree/tree/decision_tree.py:313-338,456-477``),
 - the hot split-evaluation loop (reference:
-  ``mpitree/tree/decision_tree.py:53-91``) runs as fused XLA ops with an
-  optional Pallas kernel path.
+  ``mpitree/tree/decision_tree.py:53-91``) runs as fused XLA ops, with a
+  first-party Pallas (Mosaic) one-hot-matmul histogram kernel
+  (``ops/pallas_hist.py``) serving small-frontier levels on TPU — selected
+  automatically, controlled by ``BuildConfig.hist_kernel`` /
+  ``MPITREE_TPU_HIST_KERNEL``.
 
 Public estimators mirror and extend the reference API
 (``mpitree/tree/__init__.py:1-3``):

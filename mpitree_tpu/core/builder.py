@@ -66,6 +66,16 @@ class BuildConfig:
     # fused unless debug mode needs the levelwise instrumentation.
     # MPITREE_TPU_ENGINE overrides.
     engine: str = "auto"
+    # Histogram kernel for the fused engine's small-frontier branch:
+    # "pallas" = the Mosaic one-hot-matmul kernel (ops/pallas_hist.py;
+    # classification on TPU only — raises where unsupported), "xla" = the
+    # segment_sum scatter everywhere, "auto" = pallas where it applies.
+    # MPITREE_TPU_HIST_KERNEL overrides "auto".
+    hist_kernel: str = "auto"
+    # Frontier width served by the small branch (a lax.cond inside the fused
+    # loop): levels this narrow skip the full K-slot histogram + gain sweep.
+    # 8 keeps the Pallas M1 panel sublane-aligned (8*C is a multiple of 8).
+    small_frontier_slots: int = 8
 
 
 # Below this many matrix cells, per-level device dispatch latency dominates

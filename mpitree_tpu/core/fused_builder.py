@@ -28,6 +28,7 @@ more (every split has two non-empty sides).
 from __future__ import annotations
 
 import math
+import os
 from functools import lru_cache
 
 import jax
@@ -44,9 +45,10 @@ from mpitree_tpu.core.builder import (
 from mpitree_tpu.core.tree_struct import TreeArrays
 from mpitree_tpu.ops import histogram as hist_ops
 from mpitree_tpu.ops import impurity as imp_ops
+from mpitree_tpu.ops import pallas_hist
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.parallel.collective import node_counts_local, regression_y_range
-from mpitree_tpu.parallel.mesh import DATA_AXIS
+from mpitree_tpu.parallel.mesh import DATA_AXIS, TREE_AXIS
 from mpitree_tpu.utils import importances as imp_utils
 from mpitree_tpu.utils.profiling import PhaseTimer
 
@@ -65,14 +67,24 @@ def _node_capacity(n_samples: int, max_depth) -> int:
     return 1 << max(0, math.ceil(math.log2(max(cap, 1))))
 
 
-@lru_cache(maxsize=32)
-def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
-                   task: str, criterion: str, max_nodes: int, max_depth: int,
-                   min_samples_split: int):
-    """Jitted (xb, y, nid0, w, cand_mask) -> (tree arrays..., nid, n_nodes).
+def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
+                     task: str, criterion: str, max_nodes: int,
+                     max_depth: int, min_samples_split: int,
+                     small_slots: int = 0, use_pallas: bool = False,
+                     psum_axis: str | None = DATA_AXIS):
+    """Pure per-device build fn (xb, y, nid0, w, cand_mask) -> tree arrays.
 
-    ``max_depth < 0`` means unbounded. All tree outputs are replicated; the
-    final row assignment comes back sharded (for the regression refit pass).
+    ``max_depth < 0`` means unbounded. ``psum_axis`` names the mesh axis that
+    row shards reduce over (None = rows are device-local, e.g. the
+    tree-parallel forest build where data is replicated per device).
+
+    ``small_slots > 0`` adds a small-frontier branch (a ``lax.cond`` in the
+    level body): levels whose frontier fits in ``small_slots`` compute an
+    S-slot histogram + gain sweep instead of the full K-slot one — the first
+    ~log2(small_slots) levels of every build otherwise pay the K=4096-slot
+    sweep for a handful of live nodes. ``use_pallas`` swaps that branch's
+    classification histogram for the Mosaic one-hot-matmul kernel
+    (``ops/pallas_hist.py``; bit-identical — integer-valued f32 counts).
     """
     # K slots of slack past the true capacity: the last chunk's
     # dynamic_update_slice window [chunk_lo, chunk_lo+K) may extend past the
@@ -80,37 +92,57 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     # index and silently overwrite earlier nodes.
     K, C = n_slots, n_classes
     M = max_nodes + n_slots
+    S = small_slots if small_slots and small_slots <= K else 0
+
+    def psum(x):
+        return lax.psum(x, psum_axis) if psum_axis is not None else x
 
     def build(xb, y, nid0, w, cand_mask):
         R, F = xb.shape
+        if S and use_pallas and task == "classification":
+            from mpitree_tpu.ops import pallas_hist as ph
 
-        def chunk_stats(chunk_lo, nid):
-            """Histogram + split search for nodes [chunk_lo, chunk_lo+K)."""
+            payload = ph.class_payload(y, w, C)  # loop-invariant
+
+        def chunk_stats(chunk_lo, nid, n_stat_slots, pallas_ok=False):
+            """Histogram + split search for nodes [chunk_lo, chunk_lo+S_or_K)."""
             if task == "classification":
-                h = hist_ops.class_histogram(
-                    xb, y, nid, chunk_lo, n_slots=K, n_bins=n_bins,
-                    n_classes=C, sample_weight=w,
-                )
-                h = lax.psum(h, DATA_AXIS)
+                if pallas_ok:
+                    from mpitree_tpu.ops import pallas_hist as ph
+
+                    h = ph.histogram_small(
+                        xb, payload, nid - chunk_lo, n_slots=n_stat_slots,
+                        n_bins=n_bins, n_channels=C,
+                        vma=(psum_axis,) if psum_axis is not None else (),
+                    )
+                else:
+                    h = hist_ops.class_histogram(
+                        xb, y, nid, chunk_lo, n_slots=n_stat_slots,
+                        n_bins=n_bins, n_classes=C, sample_weight=w,
+                    )
+                h = psum(h)
                 dec = imp_ops.best_split_classification(
                     h, cand_mask, criterion=criterion
                 )
                 pure = (dec.counts > 0).sum(axis=1) <= 1
             else:
                 h = hist_ops.moment_histogram(
-                    xb, y, nid, chunk_lo, n_slots=K, n_bins=n_bins,
-                    sample_weight=w,
+                    xb, y, nid, chunk_lo, n_slots=n_stat_slots,
+                    n_bins=n_bins, sample_weight=w,
                 )
-                h = lax.psum(h, DATA_AXIS)
+                h = psum(h)
                 dec = imp_ops.best_split_regression(h, cand_mask)
-                ymin, ymax = regression_y_range(y, nid, w, chunk_lo, n_slots=K)
+                ymin, ymax = regression_y_range(
+                    y, nid, w, chunk_lo, n_slots=n_stat_slots, axis=psum_axis
+                )
                 pure = ~(ymax > ymin)
             return dec, pure
 
         def chunk_counts(chunk_lo, nid):
             """Terminal level: per-node counts only (O(R) instead of O(R*F))."""
             return node_counts_local(
-                y, nid, w, chunk_lo, n_slots=K, n_classes=C, task=task
+                y, nid, w, chunk_lo, n_slots=K, n_classes=C, task=task,
+                axis=psum_axis,
             )
 
         def level_body(state):
@@ -119,20 +151,22 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
             terminal = jnp.logical_and(max_depth >= 0, depth == max_depth)
             n_chunks = (fsz + K - 1) // K
 
+            def decide(dec, pure):
+                n = (dec.counts.sum(axis=1) if task == "classification"
+                     else dec.counts[:, 0])
+                stop = (
+                    pure | dec.constant | (n < min_samples_split)
+                    | jnp.isinf(dec.cost)
+                )
+                feat_k = jnp.where(stop, -1, dec.feature).astype(jnp.int32)
+                return feat_k, dec.bin.astype(jnp.int32), dec.counts, n
+
             def chunk_body(c, bufs):
                 feat_a, bin_a, counts_a, n_a = bufs
                 chunk_lo = flo + c * K
 
                 def interior(_):
-                    dec, pure = chunk_stats(chunk_lo, nid)
-                    n = (dec.counts.sum(axis=1) if task == "classification"
-                         else dec.counts[:, 0])
-                    stop = (
-                        pure | dec.constant | (n < min_samples_split)
-                        | jnp.isinf(dec.cost)
-                    )
-                    feat_k = jnp.where(stop, -1, dec.feature).astype(jnp.int32)
-                    return feat_k, dec.bin.astype(jnp.int32), dec.counts, n
+                    return decide(*chunk_stats(chunk_lo, nid, K))
 
                 def term(_):
                     cc = chunk_counts(chunk_lo, nid)
@@ -151,9 +185,28 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                 n_a = lax.dynamic_update_slice(n_a, n_k, (chunk_lo,))
                 return feat_a, bin_a, counts_a, n_a
 
-            feat_a, bin_a, counts_a, n_a = lax.fori_loop(
-                0, n_chunks, chunk_body, (feat_a, bin_a, counts_a, n_a)
-            )
+            def big_level(bufs):
+                return lax.fori_loop(0, n_chunks, chunk_body, bufs)
+
+            def small_level(bufs):
+                feat_a, bin_a, counts_a, n_a = bufs
+                feat_k, bin_k, counts_k, n_k = decide(
+                    *chunk_stats(flo, nid, S, pallas_ok=use_pallas)
+                )
+                feat_a = lax.dynamic_update_slice(feat_a, feat_k, (flo,))
+                bin_a = lax.dynamic_update_slice(bin_a, bin_k, (flo,))
+                counts_a = lax.dynamic_update_slice(counts_a, counts_k, (flo, 0))
+                n_a = lax.dynamic_update_slice(n_a, n_k, (flo,))
+                return feat_a, bin_a, counts_a, n_a
+
+            bufs = (feat_a, bin_a, counts_a, n_a)
+            if S:
+                use_small = jnp.logical_and(fsz <= S, ~terminal)
+                feat_a, bin_a, counts_a, n_a = lax.cond(
+                    use_small, small_level, big_level, bufs
+                )
+            else:
+                feat_a, bin_a, counts_a, n_a = big_level(bufs)
 
             # Child allocation over the frontier window (full-M vectorized;
             # node ids inherit frontier order, so slot arithmetic keeps
@@ -207,6 +260,26 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         feat_a, bin_a, counts_a, n_a, left_a, parent_a, nid, flo, _, _ = out
         return feat_a, bin_a, counts_a, n_a, left_a, parent_a, nid, flo
 
+    return build
+
+
+@lru_cache(maxsize=32)
+def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
+                   task: str, criterion: str, max_nodes: int, max_depth: int,
+                   min_samples_split: int, small_slots: int = 0,
+                   use_pallas: bool = False):
+    """Data-parallel single-tree build: rows sharded, histograms psum'd.
+
+    Jitted (xb, y, nid0, w, cand_mask) -> (tree arrays..., nid, n_nodes);
+    tree outputs replicated, the final row assignment sharded (for the
+    regression refit pass).
+    """
+    build = _make_build_body(
+        n_slots=n_slots, n_bins=n_bins, n_classes=n_classes, task=task,
+        criterion=criterion, max_nodes=max_nodes, max_depth=max_depth,
+        min_samples_split=min_samples_split, small_slots=small_slots,
+        use_pallas=use_pallas, psum_axis=DATA_AXIS,
+    )
     out_specs = (P(), P(), P(), P(), P(), P(), P(DATA_AXIS), P())
     sharded = jax.shard_map(
         build,
@@ -214,6 +287,47 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
                   P(DATA_AXIS), P()),
         out_specs=out_specs,
+    )
+    return jax.jit(sharded)
+
+
+@lru_cache(maxsize=32)
+def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
+                    task: str, criterion: str, max_nodes: int,
+                    max_depth: int, min_samples_split: int,
+                    small_slots: int = 0, use_pallas: bool = False):
+    """Tree-parallel forest build: trees sharded over the mesh, data
+    replicated per device (ensemble parallelism — BASELINE configs[4],
+    "N trees sharded across TPU chips").
+
+    Jitted (xb, y, nid0, ws, cand_masks) with ``ws: (T, N)`` bootstrap
+    weights and ``cand_masks: (T, F, B)`` per-tree candidate masks ->
+    per-tree stacked tree arrays. Each device runs ``T / n_devices`` full
+    single-device builds sequentially (``lax.map``); devices run their tree
+    batches concurrently — the whole forest is ONE device program.
+    """
+    build = _make_build_body(
+        n_slots=n_slots, n_bins=n_bins, n_classes=n_classes, task=task,
+        criterion=criterion, max_nodes=max_nodes, max_depth=max_depth,
+        min_samples_split=min_samples_split, small_slots=small_slots,
+        use_pallas=use_pallas, psum_axis=None,
+    )
+
+    def per_device(xb, y, nid0, ws, cand_masks):
+        return lax.map(
+            lambda wc: build(xb, y, nid0, wc[0], wc[1]), (ws, cand_masks)
+        )
+
+    t = P(TREE_AXIS)
+    sharded = jax.shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(), P(), P(), P(TREE_AXIS, None), P(TREE_AXIS, None, None)),
+        out_specs=(t, t, t, t, t, t, t, t),
+        # No collectives anywhere in the per-device build (psum_axis=None):
+        # vma tracking only flags replicated-vs-varying mixes in lax.cond
+        # branches that are semantically fine here.
+        check_vma=False,
     )
     return jax.jit(sharded)
 
@@ -239,11 +353,18 @@ def build_tree_fused(
 
     K = _chunk_size(N, F, B, C, cfg)
     M = _node_capacity(N, cfg.max_depth)
+    use_pallas = _resolve_hist_kernel(
+        cfg, mesh.devices.flat[0].platform, task,
+        integer_ok=integer_weights(sample_weight),
+    )
+
     fn = _make_fused_fn(
         mesh, n_slots=K, n_bins=B, n_classes=C, task=task,
         criterion=cfg.criterion, max_nodes=M,
         max_depth=-1 if cfg.max_depth is None else int(cfg.max_depth),
         min_samples_split=int(cfg.min_samples_split),
+        small_slots=int(cfg.small_frontier_slots),
+        use_pallas=use_pallas,
     )
 
     with timer.phase("shard"):
@@ -255,55 +376,10 @@ def build_tree_fused(
             jax.device_get(fn(xb_d, y_d, nid_d, w_d, cand_d))
         )
 
-    n_nodes = int(n_nodes)
-    feat = feat[:n_nodes]
-    bins = bins[:n_nodes]
-    counts = counts[:n_nodes]
-    nvec = nvec[:n_nodes]
-    left = left[:n_nodes]
-    parent = parent[:n_nodes]
-
     with timer.phase("host_finalize"):
-        right = np.where(left >= 0, left + 1, -1).astype(np.int32)
-        threshold = np.full(n_nodes, np.nan, np.float32)
-        interior = feat >= 0
-        threshold[interior] = binned.thresholds[feat[interior], bins[interior]]
-        depth = np.zeros(n_nodes, np.int32)
-        has_parent = parent >= 0
-        # Parents precede children in id order; k sweeps settle depth <= k,
-        # so this converges in tree-depth iterations.
-        while True:
-            nd = np.where(
-                has_parent, depth[np.maximum(parent, 0)] + 1, 0
-            ).astype(np.int32)
-            if np.array_equal(nd, depth):
-                break
-            depth = nd
-
-        if task == "classification":
-            count_out = counts.astype(
-                np.int64 if integer_weights(sample_weight) else np.float64
-            )
-            value = counts.argmax(axis=1).astype(np.int32)
-            impurity = imp_utils.class_node_impurity(counts, cfg.criterion)
-        else:
-            mean = counts[:, 1] / np.maximum(counts[:, 0], 1.0)
-            value = mean.astype(np.float32)
-            count_out = mean[:, None].astype(np.float64)
-            # f32-accuracy variance; overwritten exactly by the refit pass.
-            impurity = imp_utils.moment_node_impurity(counts)
-
-        tree = TreeArrays(
-            feature=feat.astype(np.int32),
-            threshold=threshold,
-            left=left.astype(np.int32),
-            right=right,
-            parent=parent.astype(np.int32),
-            depth=depth,
-            value=value,
-            count=count_out,
-            n_node_samples=nvec.astype(np.int64),
-            impurity=impurity,
+        tree = _finalize_tree(
+            binned, task, cfg.criterion, int(n_nodes), feat, bins, counts,
+            nvec, left, parent, integer_counts=integer_weights(sample_weight),
         )
 
     if task == "regression" and refit_targets is not None:
@@ -314,3 +390,186 @@ def build_tree_fused(
         )
 
     return tree
+
+
+def _resolve_hist_kernel(cfg, platform: str, task: str, *,
+                         integer_ok: bool) -> bool:
+    """Shared hist_kernel resolution for single-tree and forest builds.
+
+    ``integer_ok`` gates the Pallas path on integer-valued sample weights:
+    the MXU matmul's f32 reduction order differs from the XLA scatter's, so
+    only integer-valued counts (exact in f32 below 2**24) keep the
+    one-tree-regardless-of-kernel identity contract. Returns whether to use
+    the Pallas kernel; raises on an invalid or unsatisfiable request.
+    """
+    hist_kernel = cfg.hist_kernel
+    if hist_kernel == "auto":
+        hist_kernel = os.environ.get("MPITREE_TPU_HIST_KERNEL", "auto")
+    if hist_kernel not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown hist_kernel {hist_kernel!r}")
+    pallas_ok = (
+        pallas_hist.pallas_available(platform)
+        and task == "classification"
+        and integer_ok
+    )
+    if hist_kernel == "pallas" and not pallas_ok:
+        raise ValueError(
+            "hist_kernel='pallas' needs a TPU backend, a classification "
+            "task, and integer-valued sample weights "
+            f"(platform={platform!r}, task={task!r}, "
+            f"integer_weights={integer_ok})"
+        )
+    return pallas_ok and hist_kernel in ("auto", "pallas")
+
+
+def _finalize_tree(binned, task, criterion, n_nodes, feat, bins, counts,
+                   nvec, left, parent, *, integer_counts: bool) -> TreeArrays:
+    """Device build buffers (full capacity) -> host TreeArrays (trimmed)."""
+    feat = feat[:n_nodes]
+    bins = bins[:n_nodes]
+    counts = counts[:n_nodes]
+    nvec = nvec[:n_nodes]
+    left = left[:n_nodes]
+    parent = parent[:n_nodes]
+
+    right = np.where(left >= 0, left + 1, -1).astype(np.int32)
+    threshold = np.full(n_nodes, np.nan, np.float32)
+    interior = feat >= 0
+    threshold[interior] = binned.thresholds[feat[interior], bins[interior]]
+    depth = np.zeros(n_nodes, np.int32)
+    has_parent = parent >= 0
+    # Parents precede children in id order; k sweeps settle depth <= k,
+    # so this converges in tree-depth iterations.
+    while True:
+        nd = np.where(
+            has_parent, depth[np.maximum(parent, 0)] + 1, 0
+        ).astype(np.int32)
+        if np.array_equal(nd, depth):
+            break
+        depth = nd
+
+    if task == "classification":
+        count_out = counts.astype(np.int64 if integer_counts else np.float64)
+        value = counts.argmax(axis=1).astype(np.int32)
+        impurity = imp_utils.class_node_impurity(counts, criterion)
+    else:
+        mean = counts[:, 1] / np.maximum(counts[:, 0], 1.0)
+        value = mean.astype(np.float32)
+        count_out = mean[:, None].astype(np.float64)
+        # f32-accuracy variance; overwritten exactly by the refit pass.
+        impurity = imp_utils.moment_node_impurity(counts)
+
+    return TreeArrays(
+        feature=feat.astype(np.int32),
+        threshold=threshold,
+        left=left.astype(np.int32),
+        right=right,
+        parent=parent.astype(np.int32),
+        depth=depth,
+        value=value,
+        count=count_out,
+        n_node_samples=nvec.astype(np.int64),
+        impurity=impurity,
+    )
+
+
+def build_forest_fused(
+    binned,
+    y: np.ndarray,
+    *,
+    config,
+    mesh,
+    weights: np.ndarray,
+    cand_masks: np.ndarray,
+    n_classes: int | None = None,
+    refit_targets: np.ndarray | None = None,
+    integer_counts: bool = True,
+    timer: PhaseTimer | None = None,
+) -> list:
+    """Build T trees as ONE device program, trees sharded over the mesh.
+
+    ``weights``: (T, N) per-tree sample weights (bootstrap multiplicities
+    composed with any user weights); ``cand_masks``: (T, F, B) per-tree
+    candidate masks (random subspaces). Data is replicated per device — the
+    tree axis, not the row axis, rides the mesh (the reference's subtree
+    task-parallelism reborn as ensemble parallelism; BASELINE configs[4]).
+
+    Trees are bit-identical to sequential single-device builds with the same
+    weights/masks: the per-device build body is the same program.
+    """
+    cfg = config
+    task = cfg.task
+    timer = timer if timer is not None else PhaseTimer(enabled=False)
+    T, N = weights.shape
+    F = binned.x_binned.shape[1]
+    B = binned.n_bins
+    C = n_classes if task == "classification" else 3
+
+    K = _chunk_size(N, F, B, C, cfg)
+    M = _node_capacity(N, cfg.max_depth)
+    D = mesh.size
+    T_pad = ((T + D - 1) // D) * D
+    tmesh = mesh_lib.as_tree_mesh(mesh)
+    use_pallas = _resolve_hist_kernel(
+        cfg, mesh.devices.flat[0].platform, task, integer_ok=integer_counts
+    )
+
+    if task == "classification" and float(weights.sum(axis=1).max()) >= 2**24:
+        import warnings
+
+        warnings.warn(
+            "device class counts accumulate in float32: beyond 2**24 "
+            "per-tree total weight the raw-count contract can lose integer "
+            "exactness",
+            stacklevel=2,
+        )
+
+    fn = _make_forest_fn(
+        tmesh, n_slots=K, n_bins=B, n_classes=C, task=task,
+        criterion=cfg.criterion, max_nodes=M,
+        max_depth=-1 if cfg.max_depth is None else int(cfg.max_depth),
+        min_samples_split=int(cfg.min_samples_split),
+        small_slots=int(cfg.small_frontier_slots),
+        use_pallas=use_pallas,
+    )
+
+    ws = weights.astype(np.float32)
+    cm = np.asarray(cand_masks)
+    if T_pad != T:  # pad with repeats; surplus trees are dropped after build
+        ws = np.concatenate([ws, np.broadcast_to(ws[-1:], (T_pad - T, N))])
+        cm = np.concatenate(
+            [cm, np.broadcast_to(cm[-1:], (T_pad - T, F, cm.shape[2]))]
+        )
+
+    with timer.phase("shard"):
+        from jax.sharding import NamedSharding
+
+        rep = NamedSharding(tmesh, P())
+        xb_d = jax.device_put(binned.x_binned, rep)
+        y_d = jax.device_put(np.asarray(y), rep)
+        nid_d = jax.device_put(np.zeros(N, np.int32), rep)
+        ws_d = jax.device_put(ws, NamedSharding(tmesh, P(TREE_AXIS, None)))
+        cm_d = jax.device_put(
+            cm, NamedSharding(tmesh, P(TREE_AXIS, None, None))
+        )
+
+    with timer.phase("forest_build"):
+        feat, bins, counts, nvec, left, parent, nid_out, n_nodes = (
+            jax.device_get(fn(xb_d, y_d, nid_d, ws_d, cm_d))
+        )
+
+    trees = []
+    with timer.phase("host_finalize"):
+        for t in range(T):
+            tree = _finalize_tree(
+                binned, task, cfg.criterion, int(n_nodes[t]), feat[t],
+                bins[t], counts[t], nvec[t], left[t], parent[t],
+                integer_counts=integer_counts,
+            )
+            if task == "regression" and refit_targets is not None:
+                refit_regression_values(
+                    tree, np.asarray(nid_out[t])[:N],
+                    weights[t].astype(np.float64), refit_targets,
+                )
+            trees.append(tree)
+    return trees

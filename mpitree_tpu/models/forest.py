@@ -5,8 +5,12 @@ config 5: "Bagged random-forest ensemble (N trees sharded across TPU
 chips)"). TPU-first formulation: bootstrap resampling never copies rows —
 each tree reuses the one HBM-resident binned matrix with an integer
 multinomial ``sample_weight`` vector feeding the weighted histogram kernel
-(``ops/histogram.py``), so a forest costs one binning pass plus T weighted
-builds, each data-parallel over the full mesh.
+(``ops/histogram.py``). Device forests build as ONE tree-sharded program
+(``core/fused_builder.build_forest_fused``): the tree axis rides the mesh
+with data replicated per device, so T trees on D devices cost
+``ceil(T/D)`` sequential builds of wall-clock — the reference's subtree
+task-parallelism (``decision_tree.py:446-466``) reborn at ensemble
+granularity.
 
 ``max_features`` implements per-tree random subspaces (a feature subset drawn
 per tree, masking split candidates); per-node sampling is a planned
@@ -23,7 +27,13 @@ import numpy as np
 from sklearn.base import BaseEstimator, ClassifierMixin, RegressorMixin
 from sklearn.utils.validation import check_is_fitted
 
-from mpitree_tpu.core.builder import BuildConfig, build_tree, prefer_host_path
+from mpitree_tpu.core.builder import (
+    BuildConfig,
+    build_tree,
+    integer_weights,
+    prefer_host_path,
+)
+from mpitree_tpu.core.fused_builder import build_forest_fused
 from mpitree_tpu.core.host_builder import build_tree_host
 from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.predict import WeakIdCache, predict_leaf_ids
@@ -90,6 +100,7 @@ class _BaseForest(BaseEstimator):
         k = _n_subspace_features(self.max_features, X.shape[1])
 
         trees = []
+        weights, masks = [], []
         for _ in range(self.n_estimators):
             # Bootstrap multiplicities compose multiplicatively with any
             # user-provided per-sample weights.
@@ -108,13 +119,39 @@ class _BaseForest(BaseEstimator):
                     build_tree_host(b, y_enc, config=cfg, n_classes=n_classes,
                                     sample_weight=w, refit_targets=refit_targets)
                 )
-            else:
+            elif self._per_tree_device_builds():
+                # levelwise engine / debug mode: per-tree builds keep the
+                # instrumentation and determinism checks build_tree wires up.
                 trees.append(
                     build_tree(b, y_enc, config=cfg, mesh=mesh,
                                n_classes=n_classes, sample_weight=w,
                                refit_targets=refit_targets)
                 )
+            else:
+                # Device trees batch into ONE tree-sharded program below.
+                weights.append(np.ones(n, np.float32) if w is None else w)
+                masks.append(b.candidate_mask())
+        if weights:
+            trees = build_forest_fused(
+                binned, y_enc, config=cfg, mesh=mesh,
+                weights=np.stack(weights), cand_masks=np.stack(masks),
+                n_classes=n_classes, refit_targets=refit_targets,
+                integer_counts=integer_weights(sample_weight),
+            )
         return trees
+
+    @staticmethod
+    def _per_tree_device_builds() -> bool:
+        """True when batched tree-sharding must yield to per-tree builds
+        (explicit levelwise engine or debug determinism checks)."""
+        import os
+
+        from mpitree_tpu.utils.profiling import debug_checks_enabled
+
+        return (
+            os.environ.get("MPITREE_TPU_ENGINE", "") == "levelwise"
+            or debug_checks_enabled()
+        )
 
     # Device-memory ceiling for one stacked predict group (4 arrays x int32).
     _PREDICT_GROUP_BYTES = 256 << 20

@@ -1,0 +1,164 @@
+"""Pallas (Mosaic) histogram kernel for small frontiers — the MXU tier.
+
+The build's hot op is the per-(node, feature, class, bin) histogram
+(``ops/histogram.py``; the TPU-first replacement for the reference's
+per-candidate rescan, ``mpitree/tree/decision_tree.py:73-86``). The XLA path
+lowers to a scatter-add (``segment_sum``), which a TPU executes on the scalar
+unit — no vectorization. This kernel reformulates the histogram as dense
+one-hot contractions on the MXU:
+
+    hist[s, f, c, b] = sum_r  M1[r, s*C + c] * onehot_bin_f[r, b]
+    M1[r, s*C + c]   = payload[r, c] * (slot[r] == s)
+
+i.e. one ``(S*C, Rt) @ (Rt, B)`` matmul per feature per row tile, where
+``payload`` is ``w * onehot(y)`` for classification and ``(w, w*y, w*y^2)``
+for regression — so one kernel serves both tasks. The formulation carries a
+dense ``S*C*B`` factor per row, so it only pays off while the frontier chunk
+``S`` is small; that is exactly the regime where the fused builder's fixed
+chunk width wastes the most (a depth-0..6 frontier occupies a handful of
+slots of the K=4096 chunk). The fused builder therefore routes small
+frontiers here (``fused_builder.py`` small-frontier branch, behind
+``BuildConfig.hist_kernel``) and keeps the XLA scatter for wide frontiers.
+
+Rows whose slot falls outside ``[0, S)`` (parked in leaves, padding, other
+chunks) contribute nothing: their slot one-hot row is all zeros — the mask
+is free.
+
+Shapes are padded for TPU tiling: bins to a multiple of 128 (lanes), rows to
+the tile size. ``S*C`` should be a multiple of 8 (sublanes); callers pick
+``S`` accordingly (the default small-frontier width is 8).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports fail on builds without TPU support
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _hist_kernel(slot_ref, payload_ref, xb_ref, out_ref, *, n_slots, n_bins_pad):
+    """One grid step = one row tile; accumulates into the persistent out block.
+
+    slot_ref    : (Rt, 1) int32   — frontier slot per row (-1 = masked)
+    payload_ref : (Rt, C) float32 — per-channel scatter payload
+    xb_ref      : (Rt, F) int32   — bin ids
+    out_ref     : (F, S*C, Bp) float32 — accumulated histogram
+    """
+    Rt = slot_ref.shape[0]
+    C = payload_ref.shape[1]
+    F = xb_ref.shape[1]
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    # M1[r, s*C+c] = payload[r, c] * (slot[r] == s): rows outside [0, S)
+    # get an all-zero row — masking is free. Built reshape-free (Mosaic
+    # cannot shape-cast (Rt,S,C)->(Rt,S*C)): the slot one-hot comes from an
+    # iota divided by C, the payload from concatenating itself S times.
+    slot = slot_ref[:, 0]
+    sc_iota = jax.lax.broadcasted_iota(jnp.int32, (Rt, n_slots * C), 1)
+    mask_s = (sc_iota // C == slot[:, None]).astype(jnp.float32)
+    tiled = jnp.concatenate([payload_ref[...]] * n_slots, axis=1)
+    m1 = mask_s * tiled  # (Rt, S*C)
+
+    b_iota = jax.lax.broadcasted_iota(jnp.int32, (Rt, n_bins_pad), 1)
+    for f in range(F):  # unrolled: F static, each iteration one MXU matmul
+        onehot_b = (xb_ref[:, f][:, None] == b_iota).astype(jnp.float32)
+        out_ref[f] += jax.lax.dot_general(
+            m1, onehot_b,
+            dimension_numbers=(((0,), (0,)), ((), ())),  # contract rows
+            preferred_element_type=jnp.float32,
+        )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_slots", "n_bins", "n_channels", "row_tile", "interpret", "vma"
+    ),
+)
+def histogram_small(
+    x_binned: jax.Array,
+    payload: jax.Array,
+    slot: jax.Array,
+    *,
+    n_slots: int,
+    n_bins: int,
+    n_channels: int,
+    row_tile: int = 512,
+    interpret: bool = False,
+    vma: tuple = (),
+) -> jax.Array:
+    """(N,F) bins + (N,C) payload + (N,) slot -> (S, F, C, B) histogram.
+
+    ``interpret=True`` runs the kernel in the Pallas interpreter — how the
+    CPU test suite checks kernel semantics without a TPU. ``vma`` names the
+    shard_map mesh axes the output varies over (required when called inside
+    ``shard_map``: the per-shard partial histogram varies over the data axis
+    until the caller's psum).
+    """
+    N, F = x_binned.shape
+    C, S = n_channels, n_slots
+    Bp = _round_up(max(n_bins, 1), 128)
+    Np = _round_up(max(N, 1), row_tile)
+
+    if Np != N:
+        pad = Np - N
+        x_binned = jnp.pad(x_binned, ((0, pad), (0, 0)))
+        payload = jnp.pad(payload, ((0, pad), (0, 0)))
+        slot = jnp.pad(slot, (0, pad), constant_values=-1)
+
+    grid = (Np // row_tile,)
+    out_shape = jax.ShapeDtypeStruct((F, S * C, Bp), jnp.float32)
+    if vma:
+        out_shape = jax.ShapeDtypeStruct(
+            (F, S * C, Bp), jnp.float32, vma=frozenset(vma)
+        )
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, n_slots=S, n_bins_pad=Bp),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
+            pl.BlockSpec((row_tile, C), lambda i: (i, 0)),
+            pl.BlockSpec((row_tile, F), lambda i: (i, 0)),
+        ],
+        # Constant index map: the block persists across the sequential TPU
+        # grid, accumulating one row tile per step.
+        out_specs=pl.BlockSpec((F, S * C, Bp), lambda i: (0, 0, 0)),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(slot[:, None], payload, x_binned)
+    # (F, S*C, Bp) -> (S, F, C, B)
+    return out.reshape(F, S, C, Bp)[:, :, :, :n_bins].transpose(1, 0, 2, 3)
+
+
+def class_payload(y: jax.Array, w: jax.Array, n_classes: int) -> jax.Array:
+    """(N,) labels + weights -> (N, C) one-hot payload for classification."""
+    c_iota = jax.lax.broadcasted_iota(jnp.int32, (y.shape[0], n_classes), 1)
+    return (y[:, None] == c_iota).astype(jnp.float32) * w[:, None]
+
+
+def moment_payload(y: jax.Array, w: jax.Array) -> jax.Array:
+    """(N,) targets + weights -> (N, 3) ``(w, w*y, w*y^2)`` payload."""
+    y32 = y.astype(jnp.float32)
+    return jnp.stack([w, w * y32, w * y32 * y32], axis=1)
+
+
+def pallas_available(platform: str) -> bool:
+    """True when the Mosaic TPU backend can compile this kernel."""
+    return _HAS_PLTPU and platform == "tpu"

@@ -34,11 +34,13 @@ from mpitree_tpu.parallel.mesh import DATA_AXIS
 from mpitree_tpu.utils import profiling
 
 
-def node_counts_local(y, nid, w, chunk_lo, *, n_slots, n_classes, task):
-    """Per-slot class counts (or regression moments), psum'd over the mesh.
+def node_counts_local(y, nid, w, chunk_lo, *, n_slots, n_classes, task,
+                      axis=DATA_AXIS):
+    """Per-slot class counts (or regression moments), psum'd over ``axis``.
 
     Shared by the levelwise counts step and the fused engine's terminal
-    levels; must run inside shard_map over the ``data`` axis.
+    levels. ``axis=None`` skips the reduction (rows device-local, e.g. the
+    tree-parallel forest build).
     """
     slot = nid - chunk_lo
     valid = (slot >= 0) & (slot < n_slots)
@@ -53,15 +55,16 @@ def node_counts_local(y, nid, w, chunk_lo, *, n_slots, n_classes, task):
         h = jax.ops.segment_sum(
             data, jnp.where(valid, slot, 0), num_segments=n_slots
         )
-    return lax.psum(h, DATA_AXIS)
+    return lax.psum(h, axis) if axis is not None else h
 
 
-def regression_y_range(y, nid, w, chunk_lo, *, n_slots):
+def regression_y_range(y, nid, w, chunk_lo, *, n_slots, axis=DATA_AXIS):
     """Exact per-slot max(y)-min(y) purity signal over the mesh.
 
     The f32 moment variance cannot resolve near-zero spreads, so regression
     purity stops use this instead. Zero-weight rows (bootstrap out-of-bag)
-    are excluded — they don't affect the fit. Returns (ymin, ymax)."""
+    are excluded — they don't affect the fit. ``axis=None`` skips the
+    cross-device reduction. Returns (ymin, ymax)."""
     slot = nid - chunk_lo
     valid = (slot >= 0) & (slot < n_slots) & (w > 0)
     s = jnp.clip(slot, 0, n_slots - 1)
@@ -72,7 +75,9 @@ def regression_y_range(y, nid, w, chunk_lo, *, n_slots):
     ymax = jax.ops.segment_max(
         jnp.where(valid, y32, -jnp.inf), s, num_segments=n_slots
     )
-    return lax.pmin(ymin, DATA_AXIS), lax.pmax(ymax, DATA_AXIS)
+    if axis is None:
+        return ymin, ymax
+    return lax.pmin(ymin, axis), lax.pmax(ymax, axis)
 
 
 @lru_cache(maxsize=64)

@@ -19,6 +19,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+TREE_AXIS = "tree"
 
 
 def available_devices(backend: str | None = None) -> list:
@@ -31,6 +32,16 @@ def _cached_mesh(device_key: tuple, backend: str | None) -> Mesh:
     devs = available_devices(backend)
     picked = [devs[i] for i in device_key]
     return Mesh(np.array(picked), (DATA_AXIS,))
+
+
+@lru_cache(maxsize=32)
+def _cached_mesh_named(devices: tuple, axis: str) -> Mesh:
+    return Mesh(np.array(list(devices)), (axis,))
+
+
+def as_tree_mesh(mesh: Mesh) -> Mesh:
+    """Same devices, ``tree`` axis — for ensemble (tree-axis) parallelism."""
+    return _cached_mesh_named(tuple(mesh.devices.flat), TREE_AXIS)
 
 
 def resolve_mesh(*, backend: str | None = None, n_devices=None) -> Mesh:

@@ -81,3 +81,74 @@ def test_forest_sample_weight_has_effect():
     base = RandomForestClassifier(n_estimators=5, max_depth=3, random_state=0,
                                   bootstrap=False).fit(X, y)
     assert (f.predict(X) == 1).mean() > (base.predict(X) == 1).mean()
+
+
+def test_batched_forest_identical_to_per_tree_builds():
+    """The tree-sharded batched program must grow the exact trees a
+    sequential per-tree device build grows from the same weights/masks."""
+    from mpitree_tpu.core.builder import BuildConfig, build_tree
+    from mpitree_tpu.core.fused_builder import build_forest_fused
+    from mpitree_tpu.ops.binning import bin_dataset
+    from mpitree_tpu.parallel import mesh as mesh_lib
+
+    X, y = _noisy_classification(300, seed=5)
+    y = y.astype(np.int32)
+    binned = bin_dataset(X, max_bins=64)
+    cfg = BuildConfig(task="classification", criterion="gini", max_depth=5)
+    mesh = mesh_lib.resolve_mesh(n_devices=8)
+
+    rng = np.random.default_rng(0)
+    T = 5  # deliberately not a multiple of the 8-device mesh (padding path)
+    weights = rng.multinomial(
+        len(X), np.full(len(X), 1 / len(X)), size=T
+    ).astype(np.float32)
+    masks = np.broadcast_to(
+        binned.candidate_mask(), (T,) + binned.candidate_mask().shape
+    )
+
+    batched = build_forest_fused(
+        binned, y, config=cfg, mesh=mesh, weights=weights, cand_masks=masks,
+        n_classes=3,
+    )
+    assert len(batched) == T
+    for t in range(T):
+        single = build_tree(
+            binned, y, config=cfg, mesh=mesh_lib.resolve_mesh(n_devices=1),
+            n_classes=3, sample_weight=weights[t],
+        )
+        np.testing.assert_array_equal(batched[t].feature, single.feature)
+        np.testing.assert_array_equal(batched[t].left, single.left)
+        np.testing.assert_array_equal(batched[t].count, single.count)
+        np.testing.assert_allclose(
+            batched[t].threshold, single.threshold, rtol=0, atol=0
+        )
+
+
+def test_batched_forest_regression_with_refit():
+    from mpitree_tpu.core.builder import BuildConfig
+    from mpitree_tpu.core.fused_builder import build_forest_fused
+    from mpitree_tpu.ops.binning import bin_dataset
+    from mpitree_tpu.parallel import mesh as mesh_lib
+
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(240, 4)).astype(np.float32)
+    yr = (X[:, 0] * 2 - X[:, 1]).astype(np.float64)
+    binned = bin_dataset(X, max_bins=32)
+    cfg = BuildConfig(task="regression", criterion="mse", max_depth=4)
+    mesh = mesh_lib.resolve_mesh(n_devices=2)
+    T = 3
+    weights = rng.multinomial(
+        len(X), np.full(len(X), 1 / len(X)), size=T
+    ).astype(np.float32)
+    masks = np.broadcast_to(
+        binned.candidate_mask(), (T,) + binned.candidate_mask().shape
+    )
+    trees = build_forest_fused(
+        binned, (yr - yr.mean()).astype(np.float32), config=cfg, mesh=mesh,
+        weights=weights, cand_masks=masks, refit_targets=yr,
+    )
+    for t in trees:
+        # refit populated exact means/impurities
+        assert np.isfinite(t.count[:, 0]).all()
+        assert (t.impurity >= 0).all()
+        assert t.n_nodes > 1
