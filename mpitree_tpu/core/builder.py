@@ -280,6 +280,24 @@ def build_tree(
         engine = os.environ.get("MPITREE_TPU_ENGINE", "auto")
     if engine not in ("auto", "fused", "levelwise"):
         raise ValueError(f"unknown build engine {engine!r}")
+    if mesh_lib.feature_shards(mesh) > 1:
+        # Only an explicit config choice is an error; an env-sourced
+        # levelwise (a steerable default) falls back to the one engine that
+        # exists for feature meshes.
+        if cfg.engine == "levelwise":
+            raise ValueError(
+                "the levelwise engine supports 1-D data meshes only; use "
+                "the fused engine (default) for a (data, feature) mesh"
+            )
+        if engine == "levelwise":
+            import warnings
+
+            warnings.warn(
+                "MPITREE_TPU_ENGINE=levelwise ignored on a (data, feature) "
+                "mesh; using the fused engine",
+                stacklevel=2,
+            )
+        engine = "fused"  # feature sharding exists only in the fused body
     if engine == "fused" or (engine == "auto" and not debug):
         if debug:
             import warnings

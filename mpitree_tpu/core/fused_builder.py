@@ -71,12 +71,18 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                      task: str, criterion: str, max_nodes: int,
                      max_depth: int, min_samples_split: int,
                      small_slots: int = 0, use_pallas: bool = False,
-                     psum_axis: str | None = DATA_AXIS):
+                     psum_axis: str | None = DATA_AXIS,
+                     feature_axis: str | None = None):
     """Pure per-device build fn (xb, y, nid0, w, cand_mask) -> tree arrays.
 
     ``max_depth < 0`` means unbounded. ``psum_axis`` names the mesh axis that
     row shards reduce over (None = rows are device-local, e.g. the
     tree-parallel forest build where data is replicated per device).
+    ``feature_axis`` names the tensor-parallel mesh axis sharding the
+    histogram's feature dimension (None = features device-complete): each
+    shard evaluates its own feature block, the winners reduce via a tiny
+    all_gather + first-min (contiguous blocks keep the lowest-global-feature
+    tie-break), and the split owner broadcasts row routing bits with a psum.
 
     ``small_slots > 0`` adds a small-frontier branch (a ``lax.cond`` in the
     level body): levels whose frontier fits in ``small_slots`` compute an
@@ -93,16 +99,51 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
     K, C = n_slots, n_classes
     M = max_nodes + n_slots
     S = small_slots if small_slots and small_slots <= K else 0
+    hist_vma = tuple(a for a in (psum_axis, feature_axis) if a is not None)
 
     def psum(x):
         return lax.psum(x, psum_axis) if psum_axis is not None else x
 
     def build(xb, y, nid0, w, cand_mask):
-        R, F = xb.shape
+        R, F = xb.shape  # F = per-shard feature count on a feature mesh
         if S and use_pallas and task == "classification":
             from mpitree_tpu.ops import pallas_hist as ph
 
             payload = ph.class_payload(y, w, C)  # loop-invariant
+
+        def select_global(dec):
+            """Merge per-feature-shard winners into the global decision."""
+            if feature_axis is None:
+                return dec
+            j = lax.axis_index(feature_axis)
+            f_global = (dec.feature + j * F).astype(jnp.int32)
+            # One stacked gather instead of three: the loop body is
+            # latency-bound on tiny (df, K) payloads.
+            packed = jnp.stack(
+                [dec.cost, f_global.astype(jnp.float32),
+                 dec.bin.astype(jnp.float32)]
+            )  # (3, K)
+            gathered = lax.all_gather(packed, feature_axis)  # (df, 3, K)
+            costs = gathered[:, 0, :]
+            # First-min over shards = lowest shard on cost ties = lowest
+            # global feature (feature blocks are contiguous per shard) —
+            # the reference's np.argmax tie-break (decision_tree.py:140).
+            best = jnp.argmin(costs, axis=0)
+
+            def take(c):
+                return jnp.take_along_axis(
+                    gathered[:, c, :], best[None, :], axis=0
+                )[0]
+
+            nonconst = lax.psum(
+                1.0 - dec.constant.astype(jnp.float32), feature_axis
+            )
+            return dec._replace(
+                feature=take(1).astype(jnp.int32),
+                bin=take(2).astype(jnp.int32),
+                cost=take(0),
+                constant=nonconst == 0,
+            )
 
         def chunk_stats(chunk_lo, nid, n_stat_slots, pallas_ok=False):
             """Histogram + split search for nodes [chunk_lo, chunk_lo+S_or_K)."""
@@ -112,8 +153,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
 
                     h = ph.histogram_small(
                         xb, payload, nid - chunk_lo, n_slots=n_stat_slots,
-                        n_bins=n_bins, n_channels=C,
-                        vma=(psum_axis,) if psum_axis is not None else (),
+                        n_bins=n_bins, n_channels=C, vma=hist_vma,
                     )
                 else:
                     h = hist_ops.class_histogram(
@@ -121,9 +161,9 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                         n_bins=n_bins, n_classes=C, sample_weight=w,
                     )
                 h = psum(h)
-                dec = imp_ops.best_split_classification(
+                dec = select_global(imp_ops.best_split_classification(
                     h, cand_mask, criterion=criterion
-                )
+                ))
                 pure = (dec.counts > 0).sum(axis=1) <= 1
             else:
                 h = hist_ops.moment_histogram(
@@ -131,7 +171,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                     n_bins=n_bins, sample_weight=w,
                 )
                 h = psum(h)
-                dec = imp_ops.best_split_regression(h, cand_mask)
+                dec = select_global(imp_ops.best_split_regression(h, cand_mask))
                 ymin, ymax = regression_y_range(
                     y, nid, w, chunk_lo, n_slots=n_stat_slots, axis=psum_axis
                 )
@@ -231,12 +271,30 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
             node = jnp.clip(nid, 0, M - 1)
             f = feat_a[node]
             active = (nid >= flo) & (nid < flo + fsz) & (f >= 0)
-            xf = jnp.take_along_axis(
-                xb, jnp.maximum(f, 0)[:, None], axis=1
-            )[:, 0]
-            go_left = xf <= bin_a[node]
-            child = jnp.where(go_left, left_a[node], left_a[node] + 1)
-            nid = jnp.where(active, child, nid)
+            if feature_axis is None:
+                xf = jnp.take_along_axis(
+                    xb, jnp.maximum(f, 0)[:, None], axis=1
+                )[:, 0]
+                go_left = xf <= bin_a[node]
+                child = jnp.where(go_left, left_a[node], left_a[node] + 1)
+                nid = jnp.where(active, child, nid)
+            else:
+                # Only the feature shard owning each node's split feature can
+                # read that column; it computes the child id and a psum over
+                # the feature axis delivers it to every shard (each active
+                # row has exactly one owner, others contribute zero).
+                j = lax.axis_index(feature_axis)
+                local = f - j * F
+                owner = active & (local >= 0) & (local < F)
+                xf = jnp.take_along_axis(
+                    xb, jnp.clip(local, 0, F - 1)[:, None], axis=1
+                )[:, 0]
+                go_left = xf <= bin_a[node]
+                child = jnp.where(go_left, left_a[node], left_a[node] + 1)
+                child_all = lax.psum(
+                    jnp.where(owner, child, 0), feature_axis
+                )
+                nid = jnp.where(active, child_all, nid)
 
             return (feat_a, bin_a, counts_a, n_a, left_a, parent_a, nid,
                     flo + fsz, 2 * n_split, depth + 1)
@@ -272,21 +330,29 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
 
     Jitted (xb, y, nid0, w, cand_mask) -> (tree arrays..., nid, n_nodes);
     tree outputs replicated, the final row assignment sharded (for the
-    regression refit pass).
+    regression refit pass). On a 2-D ``(data, feature)`` mesh the histogram's
+    feature dimension shards over the second axis (tensor parallelism).
     """
+    feature_axis = (
+        mesh_lib.FEATURE_AXIS
+        if mesh_lib.feature_shards(mesh) > 1 else None
+    )
     build = _make_build_body(
         n_slots=n_slots, n_bins=n_bins, n_classes=n_classes, task=task,
         criterion=criterion, max_nodes=max_nodes, max_depth=max_depth,
         min_samples_split=min_samples_split, small_slots=small_slots,
         use_pallas=use_pallas, psum_axis=DATA_AXIS,
+        feature_axis=feature_axis,
     )
+    FA = feature_axis  # None on a 1-D mesh -> replicated feature dim
     out_specs = (P(), P(), P(), P(), P(), P(), P(DATA_AXIS), P())
     sharded = jax.shard_map(
         build,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
-                  P(DATA_AXIS), P()),
+        in_specs=(P(DATA_AXIS, FA), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS), P(FA, None)),
         out_specs=out_specs,
+        check_vma=FA is None,  # replicated/varying mixes in the 2-D cond
     )
     return jax.jit(sharded)
 
@@ -372,8 +438,13 @@ def build_tree_fused(
             mesh, binned, y, sample_weight
         )
     with timer.phase("fused_build"):
-        feat, bins, counts, nvec, left, parent, nid_out, n_nodes = (
-            jax.device_get(fn(xb_d, y_d, nid_d, w_d, cand_d))
+        out = fn(xb_d, y_d, nid_d, w_d, cand_d)
+        feat, bins, counts, nvec, left, parent, nid_out, n_nodes = out
+        # Tree outputs are replicated (addressable from any process); the
+        # row-sharded nid_out is only fetched when the refit needs it —
+        # and via a cross-process gather when row shards span hosts.
+        feat, bins, counts, nvec, left, parent, n_nodes = jax.device_get(
+            (feat, bins, counts, nvec, left, parent, n_nodes)
         )
 
     with timer.phase("host_finalize"):
@@ -383,11 +454,17 @@ def build_tree_fused(
         )
 
     if task == "regression" and refit_targets is not None:
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            nid_host = np.asarray(
+                multihost_utils.process_allgather(nid_out, tiled=True)
+            )
+        else:
+            nid_host = np.asarray(nid_out)
         w64 = (np.ones(N) if sample_weight is None
                else sample_weight).astype(np.float64)
-        refit_regression_values(
-            tree, np.asarray(nid_out)[:N], w64, refit_targets
-        )
+        refit_regression_values(tree, nid_host[:N], w64, refit_targets)
 
     return tree
 

@@ -3,9 +3,11 @@
 The reference bootstraps ``MPI.COMM_WORLD`` at import time and parallelizes by
 recursively splitting communicators (reference:
 ``mpitree/tree/decision_tree.py:313-338``). Here the unit of distribution is a
-``jax.sharding.Mesh`` with a single ``"data"`` axis: rows are sharded across
-it, histogram reductions ride ICI via ``lax.psum``, and multi-host (DCN)
-scaling uses the same code after ``jax.distributed.initialize`` — no
+``jax.sharding.Mesh``: a 1-D ``"data"`` axis shards rows (histogram
+reductions ride ICI via ``lax.psum``); an optional 2-D ``(data, feature)``
+mesh additionally shards the histogram's feature dimension (tensor
+parallelism); a ``"tree"`` mesh shards whole ensemble members. Multi-host
+(DCN) scaling uses the same code after ``jax.distributed.initialize`` — no
 communicator tree, because the breadth-first builder turns the reference's
 subtree task-parallelism into a batch dimension.
 """
@@ -20,6 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 TREE_AXIS = "tree"
+FEATURE_AXIS = "feature"
 
 
 def available_devices(backend: str | None = None) -> list:
@@ -44,14 +47,36 @@ def as_tree_mesh(mesh: Mesh) -> Mesh:
     return _cached_mesh_named(tuple(mesh.devices.flat), TREE_AXIS)
 
 
+@lru_cache(maxsize=32)
+def _cached_mesh_2d(device_key: tuple, shape: tuple, backend: str | None) -> Mesh:
+    devs = available_devices(backend)
+    picked = np.array([devs[i] for i in device_key]).reshape(shape)
+    return Mesh(picked, (DATA_AXIS, FEATURE_AXIS))
+
+
 def resolve_mesh(*, backend: str | None = None, n_devices=None) -> Mesh:
-    """Build a 1-D ``data`` mesh.
+    """Build the device mesh.
 
     ``n_devices=None`` -> single device (sequential semantics, like the
     reference's plain ``DecisionTreeClassifier``); ``n_devices="all"`` or
-    ``-1`` -> every visible device (the ``mpirun -n <world>`` analogue).
+    ``-1`` -> every visible device (the ``mpirun -n <world>`` analogue);
+    an int -> that many devices on a 1-D ``data`` axis; a ``(dr, df)``
+    tuple -> a 2-D ``(data, feature)`` mesh — rows shard over ``dr``
+    devices and the histogram's feature dimension over ``df`` (the
+    tensor-parallel option; the reference scans features serially,
+    ``decision_tree.py:411-416``).
     """
     devs = available_devices(backend)
+    if isinstance(n_devices, tuple):
+        dr, df = int(n_devices[0]), int(n_devices[1])
+        if dr < 1 or df < 1 or dr * df > len(devs):
+            raise ValueError(
+                f"mesh shape {n_devices} needs {dr * df} devices but only "
+                f"{len(devs)} are visible for backend={backend!r}"
+            )
+        if df == 1:
+            return _cached_mesh(tuple(range(dr)), backend)
+        return _cached_mesh_2d(tuple(range(dr * df)), (dr, df), backend)
     if n_devices in (None, 1):
         n = 1
     elif n_devices in ("all", -1):
@@ -64,6 +89,17 @@ def resolve_mesh(*, backend: str | None = None, n_devices=None) -> Mesh:
                 f"visible for backend={backend!r}"
             )
     return _cached_mesh(tuple(range(n)), backend)
+
+
+def feature_shards(mesh: Mesh) -> int:
+    """Width of the mesh's feature axis (1 on a 1-D data mesh)."""
+    return (
+        mesh.shape[FEATURE_AXIS] if FEATURE_AXIS in mesh.axis_names else 1
+    )
+
+
+def data_shards(mesh: Mesh) -> int:
+    return mesh.shape[DATA_AXIS] if DATA_AXIS in mesh.axis_names else 1
 
 
 def shard_rows(mesh: Mesh, *arrays):
@@ -89,14 +125,19 @@ def pad_rows(n: int, n_devices: int) -> int:
 def shard_build_inputs(mesh: Mesh, binned, y, sample_weight):
     """One-time device placement shared by both build engines.
 
-    Pads rows to the mesh width (padding rows get ``node_id=-1`` / weight 0,
-    so every kernel masks them out), shards (x_binned, y, w, node_id) over
-    the ``data`` axis, and replicates the candidate mask. Returns the four
-    sharded arrays plus the replicated mask.
+    Pads rows to the data-axis width (padding rows get ``node_id=-1`` /
+    weight 0, so every kernel masks them out) and shards
+    (x_binned, y, w, node_id) over the ``data`` axis. On a 2-D
+    ``(data, feature)`` mesh the binned matrix and candidate mask also
+    shard their feature dimension (padding features have zero candidates —
+    inert). Returns the four sharded arrays plus the candidate mask.
     """
     N, F = binned.x_binned.shape
-    pad = pad_rows(N, mesh.size)
+    dr = data_shards(mesh)
+    df = feature_shards(mesh)
+    pad = pad_rows(N, dr)
     xb, yy = binned.x_binned, y
+    cand = binned.candidate_mask()
     w = (np.ones(N, np.float32) if sample_weight is None
          else sample_weight.astype(np.float32))
     nid = np.zeros(N, np.int32)
@@ -105,6 +146,21 @@ def shard_build_inputs(mesh: Mesh, binned, y, sample_weight):
         yy = np.concatenate([yy, np.zeros(pad, yy.dtype)])
         w = np.concatenate([w, np.zeros(pad, np.float32)])
         nid = np.concatenate([nid, np.full(pad, -1, np.int32)])
-    xb_d, y_d, w_d, nid_d = shard_rows(mesh, xb, yy, w, nid)
-    cand_d = replicate(mesh, binned.candidate_mask())
+    fpad = (-F) % df
+    if fpad:
+        xb = np.concatenate([xb, np.zeros((len(xb), fpad), np.int32)], axis=1)
+        cand = np.concatenate(
+            [cand, np.zeros((fpad, cand.shape[1]), bool)], axis=0
+        )
+    y_d, w_d, nid_d = shard_rows(mesh, yy, w, nid)
+    if df == 1:
+        xb_d = shard_rows(mesh, xb)
+        cand_d = replicate(mesh, cand)
+    else:
+        xb_d = jax.device_put(
+            xb, NamedSharding(mesh, P(DATA_AXIS, FEATURE_AXIS))
+        )
+        cand_d = jax.device_put(
+            cand, NamedSharding(mesh, P(FEATURE_AXIS, None))
+        )
     return xb_d, y_d, w_d, nid_d, cand_d

@@ -1,0 +1,80 @@
+"""2-D (data, feature) mesh: tensor parallelism over the histogram's F axis.
+
+The determinism contract extends to the second mesh axis: the fitted tree
+must be identical for mesh shapes (8,1), (4,2), (2,4), (1,8) — rows and
+features shard differently but the psum'd histograms, the all_gather'd
+split winners, and the owner-broadcast row routing reproduce the exact
+single-device decisions (SURVEY.md §2.3 TP row; the reference scans features
+serially, ``mpitree/tree/decision_tree.py:411-416``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from mpitree_tpu import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+)
+
+MESH_SHAPES = [(8, 1), (4, 2), (2, 4), (1, 8)]
+
+
+def _data(seed=0, n=300, f=10):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = ((X[:, 0] > 0) + 2 * (X[:, 3] + X[:, 7] > 0.5)).astype(np.int64)
+    return X, y
+
+
+@pytest.mark.parametrize("shape", MESH_SHAPES)
+def test_classifier_identical_across_mesh_shapes(shape):
+    X, y = _data()
+    base = DecisionTreeClassifier(max_depth=6, backend="cpu").fit(X, y)
+    meshed = DecisionTreeClassifier(max_depth=6, n_devices=shape).fit(X, y)
+    assert meshed.export_text() == base.export_text()
+    np.testing.assert_array_equal(meshed.tree_.count, base.tree_.count)
+
+
+@pytest.mark.parametrize("shape", [(4, 2), (2, 4)])
+def test_regressor_identical_across_mesh_shapes(shape):
+    X, _ = _data(seed=1)
+    rng = np.random.default_rng(2)
+    yr = (2 * X[:, 0] - X[:, 3] + 0.1 * rng.normal(size=len(X))).astype(
+        np.float64
+    )
+    base = DecisionTreeRegressor(max_depth=5, backend="cpu").fit(X, yr)
+    meshed = DecisionTreeRegressor(max_depth=5, n_devices=shape).fit(X, yr)
+    assert meshed.export_text() == base.export_text()
+    np.testing.assert_allclose(
+        meshed.tree_.count[:, 0], base.tree_.count[:, 0], rtol=0, atol=0
+    )
+
+
+def test_feature_padding_inert():
+    """F=10 over 4 feature shards pads to 12 columns; padding must never
+    be selected and the tree must match the unpadded single-device fit."""
+    X, y = _data(n=257, f=10)  # odd row count: data padding path too
+    base = DecisionTreeClassifier(max_depth=5, backend="cpu").fit(X, y)
+    meshed = DecisionTreeClassifier(max_depth=5, n_devices=(2, 4)).fit(X, y)
+    assert meshed.export_text() == base.export_text()
+    assert int(meshed.tree_.feature.max()) < 10
+
+
+def test_levelwise_rejects_feature_mesh():
+    X, y = _data(n=200)
+    clf = DecisionTreeClassifier(max_depth=3, n_devices=(2, 2))
+    import mpitree_tpu.core.builder as b
+
+    with pytest.raises(ValueError, match="levelwise"):
+        from mpitree_tpu.core.builder import BuildConfig, build_tree
+        from mpitree_tpu.ops.binning import bin_dataset
+        from mpitree_tpu.parallel import mesh as mesh_lib
+
+        binned = bin_dataset(X)
+        build_tree(
+            binned, y.astype(np.int32),
+            config=BuildConfig(engine="levelwise", max_depth=3),
+            mesh=mesh_lib.resolve_mesh(n_devices=(2, 2)), n_classes=4,
+        )
