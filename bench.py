@@ -55,7 +55,7 @@ N_ROWS = 581012
 N_ROWS_CPU_FALLBACK = 200_000  # bound the no-TPU fallback's wall clock
 DEPTH = 20
 ORACLE_BUDGET_S = float(os.environ.get("BENCH_ORACLE_BUDGET_S", "300"))
-ORACLE_GRID = (200, 600, 2000, 6000, 20_000, 50_000)
+ORACLE_GRID = (100, 300, 1000, 3000, 10_000, 30_000)
 PROBE_TIMEOUT_S = 150  # first TPU compile can take ~40s; hang needs a bound
 PROBE_RETRIES = 3
 

@@ -72,15 +72,22 @@ class BuildConfig:
     # segment_sum scatter everywhere, "auto" = pallas where it applies.
     # MPITREE_TPU_HIST_KERNEL overrides "auto".
     hist_kernel: str = "auto"
-    # Frontier width served by the small branch (a lax.cond inside the fused
-    # loop): levels this narrow skip the full K-slot histogram + gain sweep.
-    # 8 keeps the Pallas M1 panel sublane-aligned (8*C is a multiple of 8).
-    small_frontier_slots: int = 8
+    # Frontier-width tiers served by dedicated branches (lax.cond chain in
+    # the fused loop): a level whose frontier fits tier S computes an S-slot
+    # histogram + gain sweep instead of the full K-slot one. Shallow levels
+    # otherwise pay the K=4096-slot sweep for a handful of live nodes. The
+    # smallest eligible tier also hosts the Pallas kernel (VMEM permitting).
+    frontier_tiers: tuple = (8, 64, 512)
 
 
 # Below this many matrix cells, per-level device dispatch latency dominates
 # the arithmetic and the numpy fast path (host_builder.py) wins outright.
 HOST_PATH_MAX_CELLS = 1 << 19
+
+# Above this many cells the per-level compute dwarfs dispatch latency and
+# the host-orchestrated levelwise engine beats the fused while_loop program
+# (measured on the tunneled v5e — see build_tree's engine resolution).
+LEVELWISE_MIN_CELLS = 16 << 20
 
 
 def prefer_host_path(n_samples: int, n_features: int, n_devices, backend) -> bool:
@@ -102,12 +109,12 @@ def _chunk_size(n_samples: int, n_feat: int, n_bins: int, n_chan: int,
                 cfg: BuildConfig) -> int:
     """Frontier-chunk slot count, fixed for the whole build.
 
-    One size for every level means exactly one compiled (split, update)
-    executable pair per build — TPU compiles cost tens of seconds through the
-    remote tunnel, and shallow levels wasting idle histogram slots cost only
-    microseconds of VPU time. Bounded by the histogram HBM budget, the widest
-    possible frontier (2^max_depth, or n_samples when unbounded), and a hard
-    cap.
+    One size covers every non-tier level, so a build compiles one K-slot
+    (split, update) executable pair plus at most the Pallas-eligible tier
+    sizes it actually hits — TPU compiles cost tens of seconds through the
+    remote tunnel, so tier counts are kept deliberately small. Bounded by
+    the histogram HBM budget, the widest possible frontier (2^max_depth, or
+    n_samples when unbounded), and a hard cap.
     """
     # Live peak per slot: the (K,F,C,B) histogram (C padded to 8 sublanes by
     # TPU tiling) plus ~8 (K,F,B) f32 accumulators (impurity.py's memory-lean
@@ -136,6 +143,43 @@ def _table_slots(n_samples: int, cfg: BuildConfig) -> int:
     chunk. Capped so pathological frontiers chunk rather than explode."""
     widest = min(_widest_frontier(n_samples, cfg), cfg.max_table_slots)
     return 1 << max(0, math.ceil(math.log2(widest)))
+
+
+def valid_tiers(tiers, n_slots: int) -> tuple:
+    """Normalize frontier tiers: positive, below the chunk width, sorted."""
+    return tuple(sorted(s for s in set(tiers) if 0 < s < n_slots))
+
+
+def resolve_hist_kernel(cfg: BuildConfig, platform: str, task: str, *,
+                        integer_ok: bool) -> bool:
+    """Shared hist_kernel resolution for every device build path.
+
+    ``integer_ok`` gates the Pallas path on integer-valued sample weights:
+    the MXU matmul's f32 reduction order differs from the XLA scatter's, so
+    only integer-valued counts (exact in f32 below 2**24) keep the
+    one-tree-regardless-of-kernel identity contract. Returns whether to use
+    the Pallas kernel; raises on an invalid or unsatisfiable request.
+    """
+    from mpitree_tpu.ops import pallas_hist
+
+    hist_kernel = cfg.hist_kernel
+    if hist_kernel == "auto":
+        hist_kernel = os.environ.get("MPITREE_TPU_HIST_KERNEL", "auto")
+    if hist_kernel not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown hist_kernel {hist_kernel!r}")
+    pallas_ok = (
+        pallas_hist.pallas_available(platform)
+        and task == "classification"
+        and integer_ok
+    )
+    if hist_kernel == "pallas" and not pallas_ok:
+        raise ValueError(
+            "hist_kernel='pallas' needs a TPU backend, a classification "
+            "task, and integer-valued sample weights "
+            f"(platform={platform!r}, task={task!r}, "
+            f"integer_weights={integer_ok})"
+        )
+    return pallas_ok and hist_kernel in ("auto", "pallas")
 
 
 def integer_weights(sample_weight) -> bool:
@@ -298,7 +342,14 @@ def build_tree(
                 stacklevel=2,
             )
         engine = "fused"  # feature sharding exists only in the fused body
-    if engine == "fused" or (engine == "auto" and not debug):
+    if engine == "auto" and not debug:
+        # Measured crossover on a tunneled v5e (531k x 54 covtype-like,
+        # depth 20): levelwise 18.0s warm vs fused 23.1s — per-level compute
+        # (~0.7s) dwarfs dispatch latency at scale, while small builds are
+        # dispatch-bound and favor the single fused program.
+        N_cells = binned.x_binned.shape[0] * binned.x_binned.shape[1]
+        engine = "levelwise" if N_cells >= LEVELWISE_MIN_CELLS else "fused"
+    if engine == "fused":
         if debug:
             import warnings
 
@@ -341,10 +392,31 @@ def build_tree(
 
     K = _chunk_size(N, F, B, C, cfg)
     U = _table_slots(N, cfg)
-    split_fn = collective.make_split_fn(
-        mesh, n_slots=K, n_bins=B, n_classes=C, task=task,
-        criterion=cfg.criterion, debug=debug,
+    use_pallas = resolve_hist_kernel(
+        cfg, mesh.devices.flat[0].platform, task,
+        integer_ok=integer_weights(sample_weight),
     )
+    # Levelwise keeps only Pallas-eligible tiers: that is where the measured
+    # win lives (the MXU kernel beat the scatter 3.3x at S=8), while XLA
+    # tiers saved <3% warm and cost an extra ~20-40s tunnel compile each.
+    from mpitree_tpu.ops import pallas_hist
+
+    tiers = (
+        tuple(
+            s for s in valid_tiers(cfg.frontier_tiers, K)
+            if pallas_hist.fits_vmem(F, s, C, B)
+        )
+        if use_pallas else ()
+    )
+
+    def split_fn_for(frontier: int):
+        """Narrowest tier the frontier fits (Pallas), else the K-slot sweep."""
+        S = next((s for s in tiers if frontier <= s), K)
+        return S, collective.make_split_fn(
+            mesh, n_slots=S, n_bins=B, n_classes=C, task=task,
+            criterion=cfg.criterion, debug=debug, use_pallas=S in tiers,
+        )
+
     update_fn = collective.make_update_fn(mesh, n_slots=U)
     counts_fn = collective.make_counts_fn(
         mesh, n_slots=U, n_classes=C, task=task
@@ -373,10 +445,13 @@ def build_tree(
             dec = {"counts": counts_all}
         else:
             with timer.phase("split"):
+                S_lvl, split_fn = split_fn_for(frontier_size)
                 futures = [
-                    (min(K, frontier_lo + frontier_size - lo),
+                    (min(S_lvl, frontier_lo + frontier_size - lo),
                      split_fn(xb_d, y_d, nid_d, w_d, cand_mask_d, np.int32(lo)))
-                    for lo in range(frontier_lo, frontier_lo + frontier_size, K)
+                    for lo in range(
+                        frontier_lo, frontier_lo + frontier_size, S_lvl
+                    )
                 ]
                 if debug:
                     errs = [float(jax.device_get(e)) for _, (_, e) in futures]

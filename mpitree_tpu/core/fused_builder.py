@@ -41,6 +41,8 @@ from mpitree_tpu.core.builder import (
     _chunk_size,
     integer_weights,
     refit_regression_values,
+    resolve_hist_kernel,
+    valid_tiers as builder_valid_tiers,
 )
 from mpitree_tpu.core.tree_struct import TreeArrays
 from mpitree_tpu.ops import histogram as hist_ops
@@ -70,7 +72,7 @@ def _node_capacity(n_samples: int, max_depth) -> int:
 def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                      task: str, criterion: str, max_nodes: int,
                      max_depth: int, min_samples_split: int,
-                     small_slots: int = 0, use_pallas: bool = False,
+                     tiers: tuple = (), use_pallas: bool = False,
                      psum_axis: str | None = DATA_AXIS,
                      feature_axis: str | None = None):
     """Pure per-device build fn (xb, y, nid0, w, cand_mask) -> tree arrays.
@@ -84,12 +86,12 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
     all_gather + first-min (contiguous blocks keep the lowest-global-feature
     tie-break), and the split owner broadcasts row routing bits with a psum.
 
-    ``small_slots > 0`` adds a small-frontier branch (a ``lax.cond`` in the
-    level body): levels whose frontier fits in ``small_slots`` compute an
-    S-slot histogram + gain sweep instead of the full K-slot one — the first
-    ~log2(small_slots) levels of every build otherwise pay the K=4096-slot
-    sweep for a handful of live nodes. ``use_pallas`` swaps that branch's
-    classification histogram for the Mosaic one-hot-matmul kernel
+    ``tiers`` adds frontier-width branches (a ``lax.cond`` chain in the
+    level body): a level whose frontier fits tier S computes an S-slot
+    histogram + gain sweep instead of the full K-slot one — otherwise the
+    first ~log2(K) levels of every build pay the K=4096-slot sweep for a
+    handful of live nodes. ``use_pallas`` swaps tier histograms (where the
+    out block fits VMEM) for the Mosaic one-hot-matmul kernel
     (``ops/pallas_hist.py``; bit-identical — integer-valued f32 counts).
     """
     # K slots of slack past the true capacity: the last chunk's
@@ -98,7 +100,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
     # index and silently overwrite earlier nodes.
     K, C = n_slots, n_classes
     M = max_nodes + n_slots
-    S = small_slots if small_slots and small_slots <= K else 0
+    tiers = builder_valid_tiers(tiers, K)
     hist_vma = tuple(a for a in (psum_axis, feature_axis) if a is not None)
 
     def psum(x):
@@ -106,7 +108,12 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
 
     def build(xb, y, nid0, w, cand_mask):
         R, F = xb.shape  # F = per-shard feature count on a feature mesh
-        if S and use_pallas and task == "classification":
+        pallas_tiers = frozenset(
+            s for s in tiers
+            if use_pallas and task == "classification"
+            and pallas_hist.fits_vmem(F, s, C, n_bins)
+        )
+        if pallas_tiers:
             from mpitree_tpu.ops import pallas_hist as ph
 
             payload = ph.class_payload(y, w, C)  # loop-invariant
@@ -228,25 +235,35 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
             def big_level(bufs):
                 return lax.fori_loop(0, n_chunks, chunk_body, bufs)
 
-            def small_level(bufs):
-                feat_a, bin_a, counts_a, n_a = bufs
-                feat_k, bin_k, counts_k, n_k = decide(
-                    *chunk_stats(flo, nid, S, pallas_ok=use_pallas)
-                )
-                feat_a = lax.dynamic_update_slice(feat_a, feat_k, (flo,))
-                bin_a = lax.dynamic_update_slice(bin_a, bin_k, (flo,))
-                counts_a = lax.dynamic_update_slice(counts_a, counts_k, (flo, 0))
-                n_a = lax.dynamic_update_slice(n_a, n_k, (flo,))
-                return feat_a, bin_a, counts_a, n_a
+            def tier_level(s):
+                def branch(bufs):
+                    feat_a, bin_a, counts_a, n_a = bufs
+                    feat_k, bin_k, counts_k, n_k = decide(
+                        *chunk_stats(flo, nid, s, pallas_ok=s in pallas_tiers)
+                    )
+                    feat_a = lax.dynamic_update_slice(feat_a, feat_k, (flo,))
+                    bin_a = lax.dynamic_update_slice(bin_a, bin_k, (flo,))
+                    counts_a = lax.dynamic_update_slice(
+                        counts_a, counts_k, (flo, 0)
+                    )
+                    n_a = lax.dynamic_update_slice(n_a, n_k, (flo,))
+                    return feat_a, bin_a, counts_a, n_a
+
+                return branch
+
+            # Tier chain, smallest first: a level routes to the narrowest
+            # sweep its frontier fits; terminal levels always take the big
+            # path (its per-chunk counts-only branch).
+            dispatch = big_level
+            for s in reversed(tiers):
+                def dispatch(bufs, s=s, nxt=dispatch):
+                    return lax.cond(
+                        jnp.logical_and(fsz <= s, ~terminal),
+                        tier_level(s), nxt, bufs,
+                    )
 
             bufs = (feat_a, bin_a, counts_a, n_a)
-            if S:
-                use_small = jnp.logical_and(fsz <= S, ~terminal)
-                feat_a, bin_a, counts_a, n_a = lax.cond(
-                    use_small, small_level, big_level, bufs
-                )
-            else:
-                feat_a, bin_a, counts_a, n_a = big_level(bufs)
+            feat_a, bin_a, counts_a, n_a = dispatch(bufs)
 
             # Child allocation over the frontier window (full-M vectorized;
             # node ids inherit frontier order, so slot arithmetic keeps
@@ -324,7 +341,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
 @lru_cache(maxsize=32)
 def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                    task: str, criterion: str, max_nodes: int, max_depth: int,
-                   min_samples_split: int, small_slots: int = 0,
+                   min_samples_split: int, tiers: tuple = (),
                    use_pallas: bool = False):
     """Data-parallel single-tree build: rows sharded, histograms psum'd.
 
@@ -340,7 +357,7 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     build = _make_build_body(
         n_slots=n_slots, n_bins=n_bins, n_classes=n_classes, task=task,
         criterion=criterion, max_nodes=max_nodes, max_depth=max_depth,
-        min_samples_split=min_samples_split, small_slots=small_slots,
+        min_samples_split=min_samples_split, tiers=tiers,
         use_pallas=use_pallas, psum_axis=DATA_AXIS,
         feature_axis=feature_axis,
     )
@@ -361,7 +378,7 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
 def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                     task: str, criterion: str, max_nodes: int,
                     max_depth: int, min_samples_split: int,
-                    small_slots: int = 0, use_pallas: bool = False):
+                    tiers: tuple = (), use_pallas: bool = False):
     """Tree-parallel forest build: trees sharded over the mesh, data
     replicated per device (ensemble parallelism — BASELINE configs[4],
     "N trees sharded across TPU chips").
@@ -375,7 +392,7 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     build = _make_build_body(
         n_slots=n_slots, n_bins=n_bins, n_classes=n_classes, task=task,
         criterion=criterion, max_nodes=max_nodes, max_depth=max_depth,
-        min_samples_split=min_samples_split, small_slots=small_slots,
+        min_samples_split=min_samples_split, tiers=tiers,
         use_pallas=use_pallas, psum_axis=None,
     )
 
@@ -419,7 +436,7 @@ def build_tree_fused(
 
     K = _chunk_size(N, F, B, C, cfg)
     M = _node_capacity(N, cfg.max_depth)
-    use_pallas = _resolve_hist_kernel(
+    use_pallas = resolve_hist_kernel(
         cfg, mesh.devices.flat[0].platform, task,
         integer_ok=integer_weights(sample_weight),
     )
@@ -429,7 +446,7 @@ def build_tree_fused(
         criterion=cfg.criterion, max_nodes=M,
         max_depth=-1 if cfg.max_depth is None else int(cfg.max_depth),
         min_samples_split=int(cfg.min_samples_split),
-        small_slots=int(cfg.small_frontier_slots),
+        tiers=tuple(cfg.frontier_tiers),
         use_pallas=use_pallas,
     )
 
@@ -467,36 +484,6 @@ def build_tree_fused(
         refit_regression_values(tree, nid_host[:N], w64, refit_targets)
 
     return tree
-
-
-def _resolve_hist_kernel(cfg, platform: str, task: str, *,
-                         integer_ok: bool) -> bool:
-    """Shared hist_kernel resolution for single-tree and forest builds.
-
-    ``integer_ok`` gates the Pallas path on integer-valued sample weights:
-    the MXU matmul's f32 reduction order differs from the XLA scatter's, so
-    only integer-valued counts (exact in f32 below 2**24) keep the
-    one-tree-regardless-of-kernel identity contract. Returns whether to use
-    the Pallas kernel; raises on an invalid or unsatisfiable request.
-    """
-    hist_kernel = cfg.hist_kernel
-    if hist_kernel == "auto":
-        hist_kernel = os.environ.get("MPITREE_TPU_HIST_KERNEL", "auto")
-    if hist_kernel not in ("auto", "xla", "pallas"):
-        raise ValueError(f"unknown hist_kernel {hist_kernel!r}")
-    pallas_ok = (
-        pallas_hist.pallas_available(platform)
-        and task == "classification"
-        and integer_ok
-    )
-    if hist_kernel == "pallas" and not pallas_ok:
-        raise ValueError(
-            "hist_kernel='pallas' needs a TPU backend, a classification "
-            "task, and integer-valued sample weights "
-            f"(platform={platform!r}, task={task!r}, "
-            f"integer_weights={integer_ok})"
-        )
-    return pallas_ok and hist_kernel in ("auto", "pallas")
 
 
 def _finalize_tree(binned, task, criterion, n_nodes, feat, bins, counts,
@@ -587,7 +574,7 @@ def build_forest_fused(
     D = mesh.size
     T_pad = ((T + D - 1) // D) * D
     tmesh = mesh_lib.as_tree_mesh(mesh)
-    use_pallas = _resolve_hist_kernel(
+    use_pallas = resolve_hist_kernel(
         cfg, mesh.devices.flat[0].platform, task, integer_ok=integer_counts
     )
 
@@ -606,7 +593,7 @@ def build_forest_fused(
         criterion=cfg.criterion, max_nodes=M,
         max_depth=-1 if cfg.max_depth is None else int(cfg.max_depth),
         min_samples_split=int(cfg.min_samples_split),
-        small_slots=int(cfg.small_frontier_slots),
+        tiers=tuple(cfg.frontier_tiers),
         use_pallas=use_pallas,
     )
 

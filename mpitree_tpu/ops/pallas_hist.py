@@ -162,3 +162,15 @@ def moment_payload(y: jax.Array, w: jax.Array) -> jax.Array:
 def pallas_available(platform: str) -> bool:
     """True when the Mosaic TPU backend can compile this kernel."""
     return _HAS_PLTPU and platform == "tpu"
+
+
+# Conservative VMEM ceiling for the kernel's persistent out block plus its
+# per-tile working set (~16 MB/core physical).
+_VMEM_BUDGET_BYTES = 10 << 20
+
+
+def fits_vmem(n_features: int, n_slots: int, n_channels: int,
+              n_bins: int) -> bool:
+    """Whether the (F, S*C, Bpad) f32 out block fits the kernel's budget."""
+    bp = _round_up(max(n_bins, 1), 128)
+    return n_features * n_slots * n_channels * bp * 4 <= _VMEM_BUDGET_BYTES

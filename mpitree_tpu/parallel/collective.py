@@ -82,20 +82,32 @@ def regression_y_range(y, nid, w, chunk_lo, *, n_slots, axis=DATA_AXIS):
 
 @lru_cache(maxsize=64)
 def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
-                  task: str, criterion: str, debug: bool = False):
+                  task: str, criterion: str, debug: bool = False,
+                  use_pallas: bool = False):
     """Jitted (x_binned, y, node_id, weight, cand_mask, chunk_lo) -> SplitDecision.
 
     With ``debug=True`` the result is ``(SplitDecision, repl_err)`` where
     ``repl_err`` must be 0: the determinism check that every device computed
-    the identical split (SURVEY.md §5 race-detection analogue)."""
+    the identical split (SURVEY.md §5 race-detection analogue).
+    ``use_pallas`` routes the classification histogram through the Mosaic
+    one-hot-matmul kernel (callers gate on platform/VMEM/integer weights)."""
 
     def local_step(xb, y, nid, w, cand_mask, chunk_lo):
         if task == "classification":
-            h = hist_ops.class_histogram(
-                xb, y, nid, chunk_lo,
-                n_slots=n_slots, n_bins=n_bins, n_classes=n_classes,
-                sample_weight=w,
-            )
+            if use_pallas:
+                from mpitree_tpu.ops import pallas_hist as ph
+
+                h = ph.histogram_small(
+                    xb, ph.class_payload(y, w, n_classes), nid - chunk_lo,
+                    n_slots=n_slots, n_bins=n_bins, n_channels=n_classes,
+                    vma=(DATA_AXIS,),
+                )
+            else:
+                h = hist_ops.class_histogram(
+                    xb, y, nid, chunk_lo,
+                    n_slots=n_slots, n_bins=n_bins, n_classes=n_classes,
+                    sample_weight=w,
+                )
             h = lax.psum(h, DATA_AXIS)
             dec = imp_ops.best_split_classification(h, cand_mask, criterion=criterion)
         else:

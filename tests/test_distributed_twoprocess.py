@@ -62,7 +62,6 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.timeout(300)
 def test_two_process_coordination_fit(tmp_path):
     worker = tmp_path / "worker.py"
     worker.write_text(_WORKER.format(repo=_REPO))
