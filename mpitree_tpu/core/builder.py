@@ -60,17 +60,18 @@ class BuildConfig:
     # MPITREE_TPU_DEBUG=1.
     debug: bool = False
     # Device build engine: "fused" = whole build in one compiled
-    # lax.while_loop program (fused_builder.py, the default — no per-level
-    # host round trips); "levelwise" = host-orchestrated level loop (keeps
-    # per-phase timers and the on-device determinism check). "auto" picks
-    # fused unless debug mode needs the levelwise instrumentation.
-    # MPITREE_TPU_ENGINE overrides.
+    # lax.while_loop program (fused_builder.py — no per-level host round
+    # trips); "levelwise" = host-orchestrated level loop (keeps per-phase
+    # timers and the on-device determinism check). "auto" picks levelwise
+    # for builds >= LEVELWISE_MIN_CELLS (per-level compute dwarfs dispatch
+    # there — measured) or when debug needs its instrumentation, fused
+    # otherwise. MPITREE_TPU_ENGINE overrides.
     engine: str = "auto"
-    # Histogram kernel for the fused engine's small-frontier branch:
+    # Histogram kernel for frontier-tier levels in BOTH device engines:
     # "pallas" = the Mosaic one-hot-matmul kernel (ops/pallas_hist.py;
-    # classification on TPU only — raises where unsupported), "xla" = the
-    # segment_sum scatter everywhere, "auto" = pallas where it applies.
-    # MPITREE_TPU_HIST_KERNEL overrides "auto".
+    # classification on TPU with integer weights — raises where
+    # unsupported), "xla" = the segment_sum scatter everywhere, "auto" =
+    # pallas where it applies. MPITREE_TPU_HIST_KERNEL overrides "auto".
     hist_kernel: str = "auto"
     # Frontier-width tiers served by dedicated branches (lax.cond chain in
     # the fused loop): a level whose frontier fits tier S computes an S-slot
@@ -146,8 +147,12 @@ def _table_slots(n_samples: int, cfg: BuildConfig) -> int:
 
 
 def valid_tiers(tiers, n_slots: int) -> tuple:
-    """Normalize frontier tiers: positive, below the chunk width, sorted."""
-    return tuple(sorted(s for s in set(tiers) if 0 < s < n_slots))
+    """Normalize frontier tiers: positive, at most the chunk width, sorted.
+
+    ``s == n_slots`` stays eligible: on small builds the chunk width K can
+    equal the smallest tier, and dropping it would silently disable an
+    explicitly requested Pallas kernel."""
+    return tuple(sorted(s for s in set(tiers) if 0 < s <= n_slots))
 
 
 def resolve_hist_kernel(cfg: BuildConfig, platform: str, task: str, *,
@@ -552,6 +557,16 @@ def build_tree(
     if task == "regression" and refit_targets is not None:
         w64 = (np.ones(N) if sample_weight is None
                else sample_weight).astype(np.float64)
-        refit_regression_values(out, np.asarray(nid_d)[:N], w64, refit_targets)
+        if jax.process_count() > 1:
+            # Row shards span hosts: a plain asarray on the global array is
+            # not addressable from one process.
+            from jax.experimental import multihost_utils
+
+            nid_host = np.asarray(
+                multihost_utils.process_allgather(nid_d, tiled=True)
+            )
+        else:
+            nid_host = np.asarray(nid_d)
+        refit_regression_values(out, nid_host[:N], w64, refit_targets)
 
     return out
