@@ -1,0 +1,165 @@
+"""Hybrid device+host build: TPU crown, C++ deep tail.
+
+Quantile-binned device builds lose accuracy in the deep tail: a node at
+depth ~10 spans a narrow slice of each feature, and only a handful of the
+256 *global* quantile edges fall inside it — candidate starvation (measured:
+-0.016 accuracy vs sklearn at covtype scale, where exact candidates close it
+to -0.006). The device is also least efficient exactly there: thousands of
+small nodes, scatter-bound histograms.
+
+The hybrid splits the build at the latency/throughput crossover:
+
+1. the device engines grow the tree to ``refine_depth`` — wide,
+   data-parallel frontiers where psum'd histograms and the MXU kernel
+   dominate;
+2. every still-splittable leaf at that depth becomes the root of a host
+   subtree built by the native C++ sweep (``host_builder.py``) on its own
+   rows with **exact local candidates** — every unique value of the rows
+   actually in the node, the reference's own semantics
+   (``mpitree/tree/decision_tree.py:73``), infeasible device-side at scale
+   but trivial on a few hundred rows;
+3. subtrees graft back into the struct-of-arrays tree (id remap + concat);
+   parent-before-child id order is preserved, so every downstream consumer
+   (predict, export, refit, MDI) works unchanged.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mpitree_tpu.core.tree_struct import TreeArrays
+
+
+def _concat_trees(top: TreeArrays, subtrees: list, attach_at: list) -> TreeArrays:
+    """Graft ``subtrees[i]`` in place of leaf node ``attach_at[i]`` of ``top``.
+
+    The grafted root reuses the top leaf's node id (its arrays overwrite the
+    leaf's entries); descendants append after all existing nodes, offset in
+    discovery order. Children always carry larger ids than their parents
+    afterwards — the invariant the refit/rollup passes rely on.
+    """
+    n_total = top.n_nodes
+    offsets = []
+    for st in subtrees:
+        # subtree node 0 maps onto the attach point; nodes 1.. append
+        offsets.append(n_total - 1)
+        n_total += st.n_nodes - 1
+
+    def alloc(arr, fill):
+        shape = (n_total,) + arr.shape[1:]
+        out = np.full(shape, fill, arr.dtype) if arr.ndim == 1 else np.zeros(
+            shape, arr.dtype
+        )
+        out[: top.n_nodes] = arr
+        return out
+
+    feature = alloc(top.feature, -1)
+    threshold = alloc(top.threshold, np.nan)
+    left = alloc(top.left, -1)
+    right = alloc(top.right, -1)
+    parent = alloc(top.parent, -1)
+    depth = alloc(top.depth, 0)
+    value = alloc(top.value, 0)
+    count = alloc(top.count, 0)
+    n_node_samples = alloc(top.n_node_samples, 0)
+    impurity = alloc(top.impurity, 0)
+
+    for st, at, off in zip(subtrees, attach_at, offsets):
+        dst = np.concatenate(
+            [[at], off + 1 + np.arange(st.n_nodes - 1, dtype=np.int64)]
+        )
+        kids = np.where(st.left >= 0, dst[st.left], -1)
+        rkids = np.where(st.right >= 0, dst[st.right], -1)
+        pars = np.where(st.parent >= 0, dst[st.parent], parent[at])
+        feature[dst] = st.feature
+        threshold[dst] = st.threshold
+        left[dst] = kids
+        right[dst] = rkids
+        # the grafted root keeps the top tree's parent link
+        parent[dst[1:]] = pars[1:]
+        depth[dst] = st.depth + depth[at]
+        value[dst] = st.value.astype(value.dtype)
+        count[dst] = st.count.astype(count.dtype)
+        n_node_samples[dst] = st.n_node_samples
+        impurity[dst] = st.impurity
+
+    return TreeArrays(
+        feature=feature, threshold=threshold, left=left, right=right,
+        parent=parent, depth=depth, value=value, count=count,
+        n_node_samples=n_node_samples, impurity=impurity,
+    )
+
+
+def refine_deep_subtrees(
+    tree: TreeArrays,
+    X: np.ndarray,
+    y_enc: np.ndarray,
+    leaf_ids: np.ndarray,
+    *,
+    config,
+    refine_depth: int,
+    n_classes: int | None = None,
+    sample_weight: np.ndarray | None = None,
+    refit_targets: np.ndarray | None = None,
+) -> TreeArrays:
+    """Host-finish every still-splittable leaf at ``refine_depth``.
+
+    ``tree`` is the device-built crown (grown with
+    ``max_depth=refine_depth``); ``leaf_ids`` the training rows' leaf
+    assignment in it. Leaves shallower than ``refine_depth`` stopped for a
+    real reason (purity / min_samples_split / constancy) and stay leaves.
+    """
+    import dataclasses
+
+    from mpitree_tpu.core.host_builder import build_tree_host
+    from mpitree_tpu.ops.binning import bin_dataset
+
+    cfg = config
+    remaining = (
+        None if cfg.max_depth is None else int(cfg.max_depth) - refine_depth
+    )
+    if remaining is not None and remaining <= 0:
+        return tree
+
+    candidates = np.flatnonzero(
+        (tree.feature < 0)
+        & (tree.depth == refine_depth)
+        & (tree.n_node_samples >= cfg.min_samples_split)
+        # pure leaves (exact 0.0 impurity in every engine) can't split —
+        # skip their exact re-binning outright
+        & (tree.impurity > 0)
+    )
+    if len(candidates) == 0:
+        return tree
+
+    sub_cfg = dataclasses.replace(
+        cfg, max_depth=remaining, engine="auto", frontier_tiers=(),
+    )
+    order = np.argsort(leaf_ids, kind="stable")
+    sorted_leaves = leaf_ids[order]
+    starts = np.searchsorted(sorted_leaves, candidates, side="left")
+    ends = np.searchsorted(sorted_leaves, candidates, side="right")
+
+    subtrees, attach = [], []
+    for leaf, s, e in zip(candidates, starts, ends):
+        rows = order[s:e]
+        if len(rows) == 0:
+            continue
+        # No raw-count gate here: min_samples_split is a WEIGHTED rule and
+        # the subtree build applies it itself (n_nodes <= 1 means it stopped).
+        sw = None if sample_weight is None else sample_weight[rows]
+        rt = None if refit_targets is None else refit_targets[rows]
+        # exact LOCAL candidates: every unique value among this node's rows
+        binned = bin_dataset(X[rows], binning="exact")
+        st = build_tree_host(
+            binned, y_enc[rows], config=sub_cfg, n_classes=n_classes,
+            sample_weight=sw, refit_targets=rt,
+        )
+        if st.n_nodes <= 1:
+            continue  # immediately stopped: keep the original leaf
+        subtrees.append(st)
+        attach.append(int(leaf))
+
+    if not subtrees:
+        return tree
+    return _concat_trees(tree, subtrees, attach)

@@ -38,6 +38,7 @@ from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
 from mpitree_tpu.utils.validation import (
     validate_fit_data,
     validate_predict_data,
+    validate_refine_depth,
     validate_sample_weight,
 )
 
@@ -74,13 +75,20 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         (numpy) builder, larger ones on the default JAX platform. A platform
         name ("tpu", "cpu", ...) forces the device path on that platform;
         ``"host"`` forces the numpy builder.
+    refine_depth : int, optional
+        Hybrid build crossover: the device engines grow the tree to this
+        depth (wide data-parallel frontiers), then each still-splittable
+        leaf is host-finished by the native C++ sweep with **exact local
+        candidates** — recovering the accuracy that global quantile bins
+        lose in the deep tail (``core/hybrid_builder.py``). ``None`` =
+        single-engine build.
     """
 
     _task = "classification"
 
     def __init__(self, *, max_depth=None, min_samples_split=2,
                  criterion="entropy", max_bins=256, binning="auto",
-                 n_devices=None, backend=None):
+                 n_devices=None, backend=None, refine_depth=None):
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
         self.criterion = criterion
@@ -88,6 +96,7 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         self.binning = binning
         self.n_devices = n_devices
         self.backend = backend
+        self.refine_depth = refine_depth
 
     # -- fitting -----------------------------------------------------------
     def fit(self, X, y, sample_weight=None):
@@ -99,14 +108,21 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         timer = PhaseTimer(enabled=profiling_enabled())
         with timer.phase("bin"):
             binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
+        sw = validate_sample_weight(sample_weight, X.shape[0])
+        host = prefer_host_path(*X.shape, self.n_devices, self.backend)
+        rd = validate_refine_depth(self.refine_depth)
+        refine = (
+            not host
+            and rd is not None
+            and (self.max_depth is None or self.max_depth > rd)
+        )
         cfg = BuildConfig(
             task="classification",
             criterion=self.criterion,
-            max_depth=self.max_depth,
+            max_depth=rd if refine else self.max_depth,
             min_samples_split=self.min_samples_split,
         )
-        sw = validate_sample_weight(sample_weight, X.shape[0])
-        if prefer_host_path(*X.shape, self.n_devices, self.backend):
+        if host:
             with timer.phase("host_build"):
                 self.tree_ = build_tree_host(
                     binned, y_enc, config=cfg, n_classes=len(classes),
@@ -120,6 +136,18 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
                 binned, y_enc, config=cfg, mesh=mesh, n_classes=len(classes),
                 sample_weight=sw, timer=timer,
             )
+        if refine:
+            import dataclasses
+
+            from mpitree_tpu.core.hybrid_builder import refine_deep_subtrees
+
+            with timer.phase("refine"):
+                self.tree_ = refine_deep_subtrees(
+                    self.tree_, X, y_enc, self._leaf_ids(X),
+                    config=dataclasses.replace(cfg, max_depth=self.max_depth),
+                    refine_depth=rd, n_classes=len(classes),
+                    sample_weight=sw,
+                )
         self.fit_stats_ = timer.summary() if timer.enabled else None
         return self
 
@@ -198,11 +226,11 @@ class ParallelDecisionTreeClassifier(DecisionTreeClassifier):
 
     def __init__(self, *, max_depth=None, min_samples_split=2,
                  criterion="entropy", max_bins=256, binning="auto",
-                 n_devices="all", backend=None):
+                 n_devices="all", backend=None, refine_depth=None):
         super().__init__(
             max_depth=max_depth, min_samples_split=min_samples_split,
             criterion=criterion, max_bins=max_bins, binning=binning,
-            n_devices=n_devices, backend=backend,
+            n_devices=n_devices, backend=backend, refine_depth=refine_depth,
         )
 
     @_ClassProperty

@@ -31,6 +31,7 @@ from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
 from mpitree_tpu.utils.validation import (
     validate_fit_data,
     validate_predict_data,
+    validate_refine_depth,
     validate_sample_weight,
 )
 
@@ -46,7 +47,7 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
 
     def __init__(self, *, max_depth=None, min_samples_split=2,
                  criterion="squared_error", max_bins=256, binning="auto",
-                 n_devices=None, backend=None):
+                 n_devices=None, backend=None, refine_depth=None):
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
         self.criterion = criterion
@@ -54,6 +55,7 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
         self.binning = binning
         self.n_devices = n_devices
         self.backend = backend
+        self.refine_depth = refine_depth
 
     def fit(self, X, y, sample_weight=None):
         if self.criterion not in ("squared_error", "mse"):
@@ -68,15 +70,22 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
         timer = PhaseTimer(enabled=profiling_enabled())
         with timer.phase("bin"):
             binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
+        sw = validate_sample_weight(sample_weight, X.shape[0])
+        host = prefer_host_path(*X.shape, self.n_devices, self.backend)
+        rd = validate_refine_depth(self.refine_depth)
+        refine = (
+            not host
+            and rd is not None
+            and (self.max_depth is None or self.max_depth > rd)
+        )
         cfg = BuildConfig(
             task="regression",
             criterion="mse",
-            max_depth=self.max_depth,
+            max_depth=rd if refine else self.max_depth,
             min_samples_split=self.min_samples_split,
         )
-        sw = validate_sample_weight(sample_weight, X.shape[0])
         y_c = (y64 - y_mean).astype(np.float32)
-        if prefer_host_path(*X.shape, self.n_devices, self.backend):
+        if host:
             with timer.phase("host_build"):
                 self.tree_ = build_tree_host(
                     binned, y_c, config=cfg, sample_weight=sw,
@@ -90,6 +99,18 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
                 binned, y_c, config=cfg, mesh=mesh, sample_weight=sw,
                 refit_targets=y64, timer=timer,
             )
+        if refine:
+            import dataclasses
+
+            from mpitree_tpu.core.hybrid_builder import refine_deep_subtrees
+
+            with timer.phase("refine"):
+                self.tree_ = refine_deep_subtrees(
+                    self.tree_, X, y_c, self._leaf_ids(X),
+                    config=dataclasses.replace(cfg, max_depth=self.max_depth),
+                    refine_depth=rd,
+                    sample_weight=sw, refit_targets=y64,
+                )
         self.fit_stats_ = timer.summary() if timer.enabled else None
         return self
 

@@ -61,3 +61,21 @@ def validate_predict_data(X, n_features: int, name: str = "estimator"):
             f"{n_features} features as input."
         )
     return np.ascontiguousarray(X, dtype=np.float32)
+
+
+def validate_refine_depth(refine_depth):
+    """Normalize the hybrid-build crossover depth: None or an exact int >= 0.
+
+    A non-integral value would make the crown's ``depth == max_depth``
+    terminal test never fire (unbounded growth) and then match zero
+    refinement candidates — reject it outright.
+    """
+    if refine_depth is None:
+        return None
+    rd = int(refine_depth)
+    if rd != refine_depth or rd < 0:
+        raise ValueError(
+            f"refine_depth must be None or a non-negative integer, "
+            f"got {refine_depth!r}"
+        )
+    return rd

@@ -1,0 +1,113 @@
+"""Hybrid device+host build (core/hybrid_builder.py).
+
+The crown is device-built on quantile bins; still-splittable leaves at
+``refine_depth`` are host-finished with exact local candidates. These tests
+pin graft validity (ids, parents, depths, partition sums), determinism, and
+the accuracy recovery that motivates the feature.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mpitree_tpu import DecisionTreeClassifier, DecisionTreeRegressor
+
+
+def _starved_data(n=6000, seed=0):
+    """Quantile-starved workload: signal lives in a narrow value range, so
+    few of the global bin edges land inside deep nodes."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 6)).astype(np.float64)
+    X[:, 0] = np.where(X[:, 0] > 0, X[:, 0] * 100, X[:, 0])  # heavy tail
+    y = (
+        (np.abs(X[:, 0]) < 0.3).astype(int)
+        + 2 * ((X[:, 1] > 0.1) & (X[:, 1] < 0.6)).astype(int)
+    )
+    return X, y.astype(np.int64)
+
+
+def _check_valid(t):
+    for i in range(t.n_nodes):
+        if t.feature[i] >= 0:
+            l, r = int(t.left[i]), int(t.right[i])
+            assert l > i and r > i
+            assert t.parent[l] == i and t.parent[r] == i
+            assert t.depth[l] == t.depth[i] + 1
+            assert (
+                t.n_node_samples[l] + t.n_node_samples[r]
+                == t.n_node_samples[i]
+            )
+        else:
+            assert t.left[i] == -1 and t.right[i] == -1
+
+
+def test_hybrid_classifier_valid_and_at_least_as_accurate():
+    X, y = _starved_data()
+    plain = DecisionTreeClassifier(
+        max_depth=10, max_bins=8, backend="cpu"
+    ).fit(X, y)
+    hyb = DecisionTreeClassifier(
+        max_depth=10, max_bins=8, backend="cpu", refine_depth=3
+    ).fit(X, y)
+    _check_valid(hyb.tree_)
+    acc_p = (plain.predict(X) == y).mean()
+    acc_h = (hyb.predict(X) == y).mean()
+    assert acc_h >= acc_p  # exact local candidates can only help here
+    assert acc_h > 0.9
+    # rendering and counts stay consistent after the graft
+    assert hyb.export_text().count("\n") + 1 == hyb.tree_.n_nodes
+    assert hyb.tree_.count[0].sum() == len(X)
+
+
+def test_hybrid_deterministic_and_paramized():
+    X, y = _starved_data(seed=3)
+    a = DecisionTreeClassifier(
+        max_depth=8, max_bins=8, backend="cpu", refine_depth=3
+    ).fit(X, y)
+    b = DecisionTreeClassifier(
+        max_depth=8, max_bins=8, backend="cpu", refine_depth=3
+    ).fit(X, y)
+    assert a.export_text() == b.export_text()
+    assert a.get_params()["refine_depth"] == 3
+
+
+def test_hybrid_respects_max_depth_and_noop_cases():
+    X, y = _starved_data(seed=1)
+    h = DecisionTreeClassifier(
+        max_depth=6, max_bins=8, backend="cpu", refine_depth=4
+    ).fit(X, y)
+    assert h.tree_.max_depth <= 6
+    # refine_depth >= max_depth: plain single-engine build
+    p = DecisionTreeClassifier(
+        max_depth=4, max_bins=8, backend="cpu", refine_depth=4
+    ).fit(X, y)
+    q = DecisionTreeClassifier(max_depth=4, max_bins=8, backend="cpu").fit(X, y)
+    assert p.export_text() == q.export_text()
+
+
+def test_hybrid_regressor_improves_fit():
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(5000, 5)).astype(np.float64)
+    yr = np.sin(3 * X[:, 0]) + 0.5 * X[:, 1] ** 2
+    plain = DecisionTreeRegressor(
+        max_depth=10, max_bins=8, backend="cpu"
+    ).fit(X, yr)
+    hyb = DecisionTreeRegressor(
+        max_depth=10, max_bins=8, backend="cpu", refine_depth=3
+    ).fit(X, yr)
+    _check_valid(hyb.tree_)
+    assert hyb.score(X, yr) >= plain.score(X, yr)
+    assert (hyb.tree_.impurity >= 0).all()
+    # exact f64 values survive the graft
+    assert np.isfinite(hyb.tree_.count[:, 0]).all()
+
+
+def test_refine_depth_validation():
+    import pytest
+
+    X, y = _starved_data(seed=4)
+    for bad in (3.5, -1, "x"):
+        with pytest.raises((ValueError, TypeError)):
+            DecisionTreeClassifier(
+                max_depth=8, backend="cpu", refine_depth=bad
+            ).fit(X, y)
