@@ -185,7 +185,7 @@ def main():
             def fit_once():
                 clf = DecisionTreeClassifier(
                     max_depth=DEPTH, max_bins=256, backend=backend,
-                    refine_depth=None if backend == "host" else REFINE_DEPTH,
+                    refine_depth=REFINE_DEPTH,
                 )
                 t0 = time.perf_counter()
                 clf.fit(Xtr, ytr)

@@ -211,6 +211,8 @@ def refit_regression_values(tree: TreeArrays, nid_host: np.ndarray,
     ww = np.bincount(nid_host, weights=w64, minlength=tree.n_nodes)
     for i in range(tree.n_nodes - 1, 0, -1):
         p = tree.parent[i]
+        if p < 0:
+            continue  # multi-root buffer (batched refine): roots end rollup
         s[p] += s[i]
         s2[p] += s2[i]
         ww[p] += ww[i]
@@ -280,6 +282,21 @@ class _TreeBuffer:
         )
 
 
+def fetch_row_nodes(nid_d, N: int) -> np.ndarray:
+    """Final on-device row->node assignments as a host array (first N rows).
+
+    Multi-host aware: when row shards span processes a plain ``asarray`` on
+    the global array is not addressable, so gather across hosts first.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(
+            multihost_utils.process_allgather(nid_d, tiled=True)
+        )[:N]
+    return np.asarray(nid_d)[:N]
+
+
 def build_tree(
     binned: BinnedData,
     y: np.ndarray,
@@ -290,6 +307,7 @@ def build_tree(
     sample_weight: np.ndarray | None = None,
     refit_targets: np.ndarray | None = None,
     timer: PhaseTimer | None = None,
+    return_leaf_ids: bool = False,
 ) -> TreeArrays:
     """Grow one tree level-synchronously; returns host struct-of-arrays.
 
@@ -301,6 +319,11 @@ def build_tree(
 
     ``timer``: optional :class:`PhaseTimer` that accumulates per-phase
     wall-clock (shard / split / counts / update).
+
+    ``return_leaf_ids``: also return the final row->leaf assignment
+    (``(tree, leaf_ids)``). The build maintains it on device anyway, so this
+    is free — callers (the hybrid refine) must not pay a second full-matrix
+    descent, which would re-upload X over a possibly tunneled transport.
     """
     cfg = config
     timer = timer if timer is not None else PhaseTimer(enabled=False)
@@ -369,7 +392,7 @@ def build_tree(
         return build_tree_fused(
             binned, y, config=cfg, mesh=mesh, n_classes=n_classes,
             sample_weight=sample_weight, refit_targets=refit_targets,
-            timer=timer,
+            timer=timer, return_leaf_ids=return_leaf_ids,
         )
     task = cfg.task
     N, F = binned.x_binned.shape
@@ -554,19 +577,15 @@ def build_tree(
 
     out = tree.finalize()
 
+    nid_host = None
     if task == "regression" and refit_targets is not None:
         w64 = (np.ones(N) if sample_weight is None
                else sample_weight).astype(np.float64)
-        if jax.process_count() > 1:
-            # Row shards span hosts: a plain asarray on the global array is
-            # not addressable from one process.
-            from jax.experimental import multihost_utils
+        nid_host = fetch_row_nodes(nid_d, N)
+        refit_regression_values(out, nid_host, w64, refit_targets)
 
-            nid_host = np.asarray(
-                multihost_utils.process_allgather(nid_d, tiled=True)
-            )
-        else:
-            nid_host = np.asarray(nid_d)
-        refit_regression_values(out, nid_host[:N], w64, refit_targets)
-
+    if return_leaf_ids:
+        if nid_host is None:
+            nid_host = fetch_row_nodes(nid_d, N)
+        return out, nid_host
     return out

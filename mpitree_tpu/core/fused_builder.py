@@ -425,6 +425,7 @@ def build_tree_fused(
     sample_weight: np.ndarray | None = None,
     refit_targets: np.ndarray | None = None,
     timer: PhaseTimer | None = None,
+    return_leaf_ids: bool = False,
 ) -> TreeArrays:
     """Same contract as ``builder.build_tree``, one device program per build."""
     cfg = config
@@ -470,19 +471,19 @@ def build_tree_fused(
             nvec, left, parent, integer_counts=integer_weights(sample_weight),
         )
 
-    if task == "regression" and refit_targets is not None:
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
+    from mpitree_tpu.core.builder import fetch_row_nodes
 
-            nid_host = np.asarray(
-                multihost_utils.process_allgather(nid_out, tiled=True)
-            )
-        else:
-            nid_host = np.asarray(nid_out)
+    nid_host = None
+    if task == "regression" and refit_targets is not None:
+        nid_host = fetch_row_nodes(nid_out, N)
         w64 = (np.ones(N) if sample_weight is None
                else sample_weight).astype(np.float64)
-        refit_regression_values(tree, nid_host[:N], w64, refit_targets)
+        refit_regression_values(tree, nid_host, w64, refit_targets)
 
+    if return_leaf_ids:
+        if nid_host is None:
+            nid_host = fetch_row_nodes(nid_out, N)
+        return tree, nid_host
     return tree
 
 

@@ -99,6 +99,59 @@ def _native_splits(xb, y, nid, sample_weight, binned, cfg, *, frontier_lo,
     )
 
 
+def _native_level_decisions(nat, *, task, cfg):
+    """Node stats + stopping decision from one native sweep's outputs.
+
+    Single source of the stop-rule formula for every consumer of the C++
+    kernel (the host builder and the batched hybrid refine) — the two tail
+    engines must not be able to diverge on purity/constancy/min-samples
+    semantics.
+    """
+    if task == "classification":
+        counts = nat["counts"]
+        n = counts.sum(axis=1)
+        pure = (counts > 0).sum(axis=1) <= 1
+        value = counts.argmax(axis=1).astype(np.int32)
+        node_imp = class_node_impurity(counts, cfg.criterion)
+    else:
+        counts = None
+        n = nat["counts"][:, 0]
+        value = (nat["counts"][:, 1] / np.maximum(n, 1.0)).astype(np.float32)
+        pure = ~(nat["ymax"] > nat["ymin"])
+        node_imp = moment_node_impurity(nat["counts"])
+    feat_best = nat["feature"]
+    stop = (
+        pure | nat["constant"] | (n < cfg.min_samples_split)
+        | np.isinf(nat["cost"]) | (feat_best < 0)
+    )
+    return counts, n, value, node_imp, feat_best, nat["bin"], stop
+
+
+def _leaf_stats(slot, live, y, w_dense, S, C, *, task, criterion):
+    """Terminal-level node stats (counts/value/impurity) by plain bincounts."""
+    if task == "classification":
+        flat = (slot[live] * C + y[live]).astype(np.intp)
+        counts = np.bincount(
+            flat, weights=w_dense[live], minlength=S * C
+        ).reshape(S, C)
+        n = counts.sum(axis=1)
+        value = counts.argmax(axis=1).astype(np.int32)
+        node_imp = class_node_impurity(counts, criterion)
+    else:
+        flat = slot[live].astype(np.intp)
+        wv = w_dense[live]
+        counts = None
+        n = np.bincount(flat, weights=wv, minlength=S)
+        s1 = np.bincount(flat, weights=wv * y[live], minlength=S)
+        s2 = np.bincount(
+            flat, weights=wv * np.square(y[live], dtype=np.float64),
+            minlength=S,
+        )
+        value = (s1 / np.maximum(n, 1.0)).astype(np.float32)
+        node_imp = moment_node_impurity(np.stack([n, s1, s2], axis=1))
+    return counts, n, value, node_imp
+
+
 def _record_level(tree, ids, S, terminal, stop, feat_best, value, n, counts,
                   task, node_imp):
     tree.feature[ids] = (
@@ -115,13 +168,21 @@ def _record_level(tree, ids, S, terminal, stop, feat_best, value, n, counts,
 
 
 def _split_and_advance(tree, binned, xb, nid, ids, stop, feat_best, bin_best,
-                       slot, live, S, frontier_lo, depth):
-    """Create children for splitting nodes and reroute their rows."""
+                       slot, live, S, frontier_lo, depth, thr_values=None):
+    """Create children for splitting nodes and reroute their rows.
+
+    ``thr_values`` (len == number of splitting nodes) overrides the shared
+    ``binned.thresholds`` lookup — used by the multi-root batched refine
+    (hybrid_builder.py) where every root carries its own local thresholds.
+    """
     split_ids = ids[~stop]
     if len(split_ids):
         f_sel = feat_best[~stop].astype(np.int32)
         b_sel = bin_best[~stop].astype(np.int32)
-        tree.threshold[split_ids] = binned.thresholds[f_sel, b_sel]
+        tree.threshold[split_ids] = (
+            binned.thresholds[f_sel, b_sel] if thr_values is None
+            else thr_values
+        )
         lefts, rights = tree.alloc_children(split_ids.astype(np.int32),
                                             depth + 1)
         tree.left[split_ids] = lefts
@@ -156,6 +217,7 @@ def build_tree_host(
     n_classes: int | None = None,
     sample_weight: np.ndarray | None = None,
     refit_targets: np.ndarray | None = None,
+    return_leaf_ids: bool = False,
 ) -> TreeArrays:
     """Grow one tree on the host; same contract as ``builder.build_tree``."""
     from mpitree_tpu.core.builder import _TreeBuffer  # shared node store
@@ -205,25 +267,10 @@ def build_tree_host(
             frontier_lo=frontier_lo, n_slots=S, n_classes=C, task=task,
         )
         if nat is not None:
-            if task == "classification":
-                counts = nat["counts"]
-                n = counts.sum(axis=1)
-                pure = (counts > 0).sum(axis=1) <= 1
-                value = counts.argmax(axis=1).astype(np.int32)
-                node_imp = class_node_impurity(counts, cfg.criterion)
-            else:
-                n = nat["counts"][:, 0]
-                mean = nat["counts"][:, 1] / np.maximum(n, 1.0)
-                value = mean.astype(np.float32)
-                pure = ~(nat["ymax"] > nat["ymin"])
-                node_imp = moment_node_impurity(nat["counts"])
-            feat_best = nat["feature"]
-            bin_best = nat["bin"]
-            ids = frontier_lo + np.arange(S)
-            stop = (
-                pure | nat["constant"] | (n < cfg.min_samples_split)
-                | np.isinf(nat["cost"]) | (feat_best < 0)
+            counts, n, value, node_imp, feat_best, bin_best, stop = (
+                _native_level_decisions(nat, task=task, cfg=cfg)
             )
+            ids = frontier_lo + np.arange(S)
             _record_level(
                 tree, ids, S, False, stop, feat_best, value, n, counts
                 if task == "classification" else None, task, node_imp,
@@ -329,4 +376,6 @@ def build_tree_host(
         )
         refit_regression_values(out, nid, w64, refit_targets)
 
+    if return_leaf_ids:
+        return out, nid
     return out

@@ -4,23 +4,37 @@ Quantile-binned device builds lose accuracy in the deep tail: a node at
 depth ~10 spans a narrow slice of each feature, and only a handful of the
 256 *global* quantile edges fall inside it — candidate starvation (measured:
 -0.016 accuracy vs sklearn at covtype scale, where exact candidates close it
-to -0.006). The device is also least efficient exactly there: thousands of
-small nodes, scatter-bound histograms.
+to ~-0.004; see BENCH_r02.json). The device is also least efficient exactly
+there: thousands of small nodes, scatter-bound histograms.
 
 The hybrid splits the build at the latency/throughput crossover:
 
 1. the device engines grow the tree to ``refine_depth`` — wide,
    data-parallel frontiers where psum'd histograms and the MXU kernel
    dominate;
-2. every still-splittable leaf at that depth becomes the root of a host
-   subtree built by the native C++ sweep (``host_builder.py``) on its own
-   rows with **exact local candidates** — every unique value of the rows
+2. every still-splittable leaf at depth <= ``refine_depth`` (impure, enough
+   samples — including leaves the device stopped as "constant under the
+   global bins" shallower than the crown frontier) becomes the root of a
+   host subtree built by the native C++ sweep (``host_builder.py``) on its
+   own rows with **exact local candidates** — every unique value of the rows
    actually in the node, the reference's own semantics
    (``mpitree/tree/decision_tree.py:73``), infeasible device-side at scale
    but trivial on a few hundred rows;
 3. subtrees graft back into the struct-of-arrays tree (id remap + concat);
    parent-before-child id order is preserved, so every downstream consumer
    (predict, export, refit, MDI) works unchanged.
+
+Two tail engines share this module:
+
+- **batched** (default when the native C++ kernel is available): ALL
+  subtrees grow together in one multi-root level-synchronous frontier —
+  one native sweep call per level instead of one per (subtree, level).
+  Per-root exact local bins make the candidate count vary per
+  (node, feature), which the kernel supports via per-slot ``n_cand``
+  (split_kernel.cpp). Identical trees to the per-subtree engine: each
+  frontier slot's result depends only on its own rows.
+- **per-subtree** (portable fallback, no g++): the original loop calling
+  ``build_tree_host`` once per candidate leaf.
 """
 
 from __future__ import annotations
@@ -28,6 +42,35 @@ from __future__ import annotations
 import numpy as np
 
 from mpitree_tpu.core.tree_struct import TreeArrays
+
+
+def _alloc_extended(top: TreeArrays, n_total: int) -> TreeArrays:
+    """Copy ``top`` into freshly allocated arrays of ``n_total`` nodes.
+
+    Shared by both graft engines so a future ``TreeArrays`` field cannot be
+    wired into one and silently dropped from the other.
+    """
+
+    def alloc(arr, fill):
+        shape = (n_total,) + arr.shape[1:]
+        out = np.full(shape, fill, arr.dtype) if arr.ndim == 1 else np.zeros(
+            shape, arr.dtype
+        )
+        out[: top.n_nodes] = arr
+        return out
+
+    return TreeArrays(
+        feature=alloc(top.feature, -1),
+        threshold=alloc(top.threshold, np.nan),
+        left=alloc(top.left, -1),
+        right=alloc(top.right, -1),
+        parent=alloc(top.parent, -1),
+        depth=alloc(top.depth, 0),
+        value=alloc(top.value, 0),
+        count=alloc(top.count, 0),
+        n_node_samples=alloc(top.n_node_samples, 0),
+        impurity=alloc(top.impurity, 0),
+    )
 
 
 def _concat_trees(top: TreeArrays, subtrees: list, attach_at: list) -> TreeArrays:
@@ -45,24 +88,12 @@ def _concat_trees(top: TreeArrays, subtrees: list, attach_at: list) -> TreeArray
         offsets.append(n_total - 1)
         n_total += st.n_nodes - 1
 
-    def alloc(arr, fill):
-        shape = (n_total,) + arr.shape[1:]
-        out = np.full(shape, fill, arr.dtype) if arr.ndim == 1 else np.zeros(
-            shape, arr.dtype
-        )
-        out[: top.n_nodes] = arr
-        return out
-
-    feature = alloc(top.feature, -1)
-    threshold = alloc(top.threshold, np.nan)
-    left = alloc(top.left, -1)
-    right = alloc(top.right, -1)
-    parent = alloc(top.parent, -1)
-    depth = alloc(top.depth, 0)
-    value = alloc(top.value, 0)
-    count = alloc(top.count, 0)
-    n_node_samples = alloc(top.n_node_samples, 0)
-    impurity = alloc(top.impurity, 0)
+    ext = _alloc_extended(top, n_total)
+    feature, threshold, left, right = (
+        ext.feature, ext.threshold, ext.left, ext.right
+    )
+    parent, depth, value, count = ext.parent, ext.depth, ext.value, ext.count
+    n_node_samples, impurity = ext.n_node_samples, ext.impurity
 
     for st, at, off in zip(subtrees, attach_at, offsets):
         dst = np.concatenate(
@@ -83,11 +114,239 @@ def _concat_trees(top: TreeArrays, subtrees: list, attach_at: list) -> TreeArray
         n_node_samples[dst] = st.n_node_samples
         impurity[dst] = st.impurity
 
-    return TreeArrays(
-        feature=feature, threshold=threshold, left=left, right=right,
-        parent=parent, depth=depth, value=value, count=count,
-        n_node_samples=n_node_samples, impurity=impurity,
+    return ext
+
+
+def _bin_per_root(Xr: np.ndarray, starts: np.ndarray, ends: np.ndarray):
+    """Exact local binning per (root, feature) over the gathered row block.
+
+    ``np.unique(col, return_inverse=True)`` yields both the bin ids (the
+    rank of each value among the root's uniques) and the local threshold
+    list ``unique[:-1]`` — the reference's candidate set restricted to the
+    node's own rows (``mpitree/tree/decision_tree.py:73``). Returns the
+    binned matrix, per-(root, feature) candidate counts, and the ragged
+    threshold store (flat array + offsets).
+    """
+    R, F = len(starts), Xr.shape[1]
+    xb = np.empty(Xr.shape, np.int32)
+    ncand = np.zeros((R, F), np.int32)
+    off = np.zeros((R, F), np.int64)
+    chunks = []
+    pos = 0
+    for i in range(R):
+        sl = slice(starts[i], ends[i])
+        for f in range(F):
+            uniq, inv = np.unique(Xr[sl, f], return_inverse=True)
+            xb[sl, f] = inv
+            ncand[i, f] = len(uniq) - 1
+            off[i, f] = pos
+            pos += len(uniq) - 1
+            if len(uniq) > 1:
+                chunks.append(uniq[:-1])
+    thr_flat = (
+        np.concatenate(chunks).astype(np.float32) if chunks
+        else np.empty(0, np.float32)
     )
+    return xb, ncand, off, thr_flat
+
+
+def _refine_batched(
+    top: TreeArrays, X, y_enc, candidates, rows_per, *, cfg_sub,
+    max_depth_total, root_depth, n_classes, sample_weight, refit_targets,
+) -> TreeArrays:
+    """Grow every deep subtree together in one multi-root host frontier.
+
+    ``root_depth[i]`` is candidate ``i``'s depth in the crown — candidates
+    need not share a depth (a leaf the crown stopped as "constant" under
+    global bins at depth 3 refines alongside the depth-8 frontier), so each
+    root gets its own remaining-depth budget
+    ``max_depth_total - root_depth[i]``.
+    """
+    from mpitree_tpu import native
+    from mpitree_tpu.core.builder import (
+        _TreeBuffer,
+        refit_regression_values,
+    )
+    from mpitree_tpu.core.host_builder import (
+        _leaf_stats,
+        _native_level_decisions,
+        _record_level,
+        _split_and_advance,
+    )
+
+    task = cfg_sub.task
+    R = len(candidates)
+    sizes = np.array([len(r) for r in rows_per], np.int64)
+    rows_all = np.concatenate(rows_per)
+    starts = np.zeros(R, np.int64)
+    np.cumsum(sizes[:-1], out=starts[1:])
+    ends = starts + sizes
+    sub_of = np.repeat(np.arange(R, dtype=np.int32), sizes)
+
+    Xr = np.ascontiguousarray(X[rows_all], np.float32)
+    xb, ncand, off, thr_flat = _bin_per_root(Xr, starts, ends)
+    del Xr
+    n_bins = int(ncand.max(initial=0)) + 1
+
+    Nr = len(rows_all)
+    if task == "classification":
+        y_r = np.ascontiguousarray(y_enc[rows_all], np.int32)
+        C = n_classes
+    else:
+        y_r = np.ascontiguousarray(y_enc[rows_all], np.float32)
+        C = 3
+    w = None if sample_weight is None else np.ascontiguousarray(
+        sample_weight[rows_all], np.float64
+    )
+    w_dense = np.ones(Nr) if w is None else w
+
+    from mpitree_tpu.core.builder import integer_weights
+
+    buf = _TreeBuffer(
+        n_value_cols=(C if task == "classification" else 1),
+        value_dtype=np.int32 if task == "classification" else np.float32,
+        # Same dtype rule as the crown builders (builder.py): the graft's
+        # count.astype(...) must never truncate.
+        count_dtype=(
+            np.int64 if (task == "classification" and integer_weights(w))
+            else np.float64
+        ),
+    )
+    buf.ensure(R)
+    buf.n = R
+    root_of = np.arange(R, dtype=np.int32)
+    root_depth = np.asarray(root_depth, np.int32)
+    # Per-root budget of additional levels below its crown leaf.
+    rem = (
+        None if max_depth_total is None
+        else (int(max_depth_total) - root_depth)
+    )
+    nid = sub_of.copy()
+    frontier_lo, frontier_size, depth = 0, R, 0
+
+    while frontier_size > 0:
+        S = frontier_size
+        terminal = rem is not None and depth == int(rem.max())
+        slot = nid - frontier_lo
+        live = slot >= 0
+        ids = frontier_lo + np.arange(S)
+        slot_roots = root_of[frontier_lo:frontier_lo + S]
+
+        if terminal:
+            # Every surviving root is depth-exhausted: leaf stats only.
+            counts, n, value, node_imp = _leaf_stats(
+                slot, live, y_r, w_dense, S, C, task=task,
+                criterion=cfg_sub.criterion,
+            )
+            _record_level(
+                buf, ids, S, True, np.ones(S, bool), None, value, n, counts,
+                task, node_imp,
+            )
+            break
+
+        ncand_slot = np.ascontiguousarray(ncand[slot_roots])
+        if rem is not None:
+            # Budget-exhausted roots' nodes become leaves this level no
+            # matter what the sweep would say — zero their candidate counts
+            # so the kernel takes its counts-only fast path for them.
+            exhausted = rem[slot_roots] <= depth
+            ncand_slot[exhausted] = 0
+        if task == "classification":
+            nat = native.best_splits_classification(
+                xb, y_r, nid, w, n_bins=n_bins, n_classes=C,
+                frontier_lo=frontier_lo, n_slots=S, n_cand=ncand_slot,
+                n_cand_per_slot=True, criterion=cfg_sub.criterion,
+            )
+        else:
+            nat = native.best_splits_regression(
+                xb, y_r, nid, w, n_bins=n_bins, frontier_lo=frontier_lo,
+                n_slots=S, n_cand=ncand_slot, n_cand_per_slot=True,
+            )
+        counts, n, value, node_imp, feat_best, bin_best, stop = (
+            _native_level_decisions(nat, task=task, cfg=cfg_sub)
+        )
+        if rem is not None:
+            # Roots shallower in the crown carry a larger budget; force-stop
+            # the ones whose budget this level exhausts.
+            stop = stop | (rem[slot_roots] <= depth)
+        _record_level(
+            buf, ids, S, False, stop, feat_best, value, n, counts, task,
+            node_imp,
+        )
+        thr_values = thr_flat[
+            off[slot_roots[~stop], feat_best[~stop]] + bin_best[~stop]
+        ]
+        n_split = int((~stop).sum())
+        nid, frontier_lo, frontier_size, depth = _split_and_advance(
+            buf, None, xb, nid, ids, stop, feat_best, bin_best,
+            slot, live, S, frontier_lo, depth, thr_values=thr_values,
+        )
+        root_of = np.concatenate(
+            [root_of, np.repeat(slot_roots[~stop], 2)]
+        ) if n_split else root_of
+
+    bt = buf.finalize()
+    if task == "regression" and refit_targets is not None:
+        refit_regression_values(
+            bt, nid, w_dense, np.asarray(refit_targets)[rows_all]
+        )
+    return _graft_batched(top, bt, candidates, root_depth[root_of])
+
+
+def _graft_batched(
+    top: TreeArrays, bt: TreeArrays, attach, depth_offset: np.ndarray
+) -> TreeArrays:
+    """Vectorized remap of the batched tail tree into the crown.
+
+    Batched node ``i < R`` (a root) reuses attach leaf ``attach[i]``'s id;
+    nodes ``i >= R`` append after the crown in batched order — children keep
+    larger ids than parents, preserving the rollup invariant.
+    ``depth_offset[i]`` is batched node ``i``'s root's depth in the crown.
+    """
+    R = len(attach)
+    extra = bt.n_nodes - R
+    dst = np.empty(bt.n_nodes, np.int64)
+    dst[:R] = np.asarray(attach, np.int64)
+    dst[R:] = top.n_nodes + np.arange(extra, dtype=np.int64)
+
+    ext = _alloc_extended(top, top.n_nodes + extra)
+
+    def remap(child):
+        return np.where(child >= 0, dst[np.clip(child, 0, None)], -1)
+
+    ext.feature[dst] = bt.feature
+    ext.threshold[dst] = bt.threshold
+    ext.left[dst] = remap(bt.left)
+    ext.right[dst] = remap(bt.right)
+    # grafted roots keep the crown's parent link; descendants remap
+    ext.parent[dst[R:]] = dst[np.clip(bt.parent[R:], 0, None)]
+    ext.depth[dst] = bt.depth + depth_offset
+    ext.value[dst] = bt.value.astype(ext.value.dtype)
+    ext.count[dst] = bt.count.astype(ext.count.dtype)
+    ext.n_node_samples[dst] = bt.n_node_samples
+    ext.impurity[dst] = bt.impurity
+
+    return ext
+
+
+def apply_refine(
+    tree, leaf_ids, X, y_build, *, cfg, max_depth, rd, timer,
+    n_classes=None, sample_weight=None, refit_targets=None,
+):
+    """Estimator-side entry: run the hybrid tail under the refine timer.
+
+    Shared by the classifier and regressor so the crossover wiring (depth
+    override, phase accounting, argument plumbing) lives in one place.
+    """
+    import dataclasses
+
+    with timer.phase("refine"):
+        return refine_deep_subtrees(
+            tree, X, y_build, leaf_ids,
+            config=dataclasses.replace(cfg, max_depth=max_depth),
+            refine_depth=rd, n_classes=n_classes,
+            sample_weight=sample_weight, refit_targets=refit_targets,
+        )
 
 
 def refine_deep_subtrees(
@@ -102,12 +361,17 @@ def refine_deep_subtrees(
     sample_weight: np.ndarray | None = None,
     refit_targets: np.ndarray | None = None,
 ) -> TreeArrays:
-    """Host-finish every still-splittable leaf at ``refine_depth``.
+    """Host-finish every still-splittable leaf of the crown.
 
     ``tree`` is the device-built crown (grown with
     ``max_depth=refine_depth``); ``leaf_ids`` the training rows' leaf
-    assignment in it. Leaves shallower than ``refine_depth`` stopped for a
-    real reason (purity / min_samples_split / constancy) and stay leaves.
+    assignment in it. Candidates are selected by *outcome*, not by depth
+    alone: any leaf at depth <= ``refine_depth`` with impurity > 0 and
+    enough samples may be a victim of global-quantile candidate starvation
+    (e.g. the device's "constant" stop means *constant under the global
+    bins*, which exact local candidates can still split). Leaves that truly
+    cannot split (pure, or identical raw rows) refine into a single root
+    and graft back unchanged.
     """
     import dataclasses
 
@@ -115,15 +379,12 @@ def refine_deep_subtrees(
     from mpitree_tpu.ops.binning import bin_dataset
 
     cfg = config
-    remaining = (
-        None if cfg.max_depth is None else int(cfg.max_depth) - refine_depth
-    )
-    if remaining is not None and remaining <= 0:
+    if cfg.max_depth is not None and int(cfg.max_depth) <= refine_depth:
         return tree
 
     candidates = np.flatnonzero(
         (tree.feature < 0)
-        & (tree.depth == refine_depth)
+        & (tree.depth <= refine_depth)
         & (tree.n_node_samples >= cfg.min_samples_split)
         # pure leaves (exact 0.0 impurity in every engine) can't split —
         # skip their exact re-binning outright
@@ -132,23 +393,45 @@ def refine_deep_subtrees(
     if len(candidates) == 0:
         return tree
 
-    sub_cfg = dataclasses.replace(
-        cfg, max_depth=remaining, engine="auto", frontier_tiers=(),
-    )
     order = np.argsort(leaf_ids, kind="stable")
     sorted_leaves = leaf_ids[order]
     starts = np.searchsorted(sorted_leaves, candidates, side="left")
     ends = np.searchsorted(sorted_leaves, candidates, side="right")
 
+    from mpitree_tpu import native
+
+    keep = ends > starts
+    if not keep.any():
+        return tree
+    candidates, starts, ends = candidates[keep], starts[keep], ends[keep]
+
+    if native.lib() is not None:
+        rows_per = [order[s:e] for s, e in zip(starts, ends)]
+        return _refine_batched(
+            tree, X, y_enc, candidates, rows_per,
+            cfg_sub=dataclasses.replace(
+                cfg, engine="auto", frontier_tiers=(),
+            ),
+            max_depth_total=cfg.max_depth,
+            root_depth=tree.depth[candidates],
+            n_classes=n_classes, sample_weight=sample_weight,
+            refit_targets=refit_targets,
+        )
+
     subtrees, attach = [], []
     for leaf, s, e in zip(candidates, starts, ends):
         rows = order[s:e]
-        if len(rows) == 0:
-            continue
         # No raw-count gate here: min_samples_split is a WEIGHTED rule and
         # the subtree build applies it itself (n_nodes <= 1 means it stopped).
         sw = None if sample_weight is None else sample_weight[rows]
         rt = None if refit_targets is None else refit_targets[rows]
+        remaining = (
+            None if cfg.max_depth is None
+            else int(cfg.max_depth) - int(tree.depth[leaf])
+        )
+        sub_cfg = dataclasses.replace(
+            cfg, max_depth=remaining, engine="auto", frontier_tiers=(),
+        )
         # exact LOCAL candidates: every unique value among this node's rows
         binned = bin_dataset(X[rows], binning="exact")
         st = build_tree_host(

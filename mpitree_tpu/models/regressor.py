@@ -31,7 +31,7 @@ from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
 from mpitree_tpu.utils.validation import (
     validate_fit_data,
     validate_predict_data,
-    validate_refine_depth,
+    resolve_refine,
     validate_sample_weight,
 )
 
@@ -72,45 +72,42 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
             binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
         sw = validate_sample_weight(sample_weight, X.shape[0])
         host = prefer_host_path(*X.shape, self.n_devices, self.backend)
-        rd = validate_refine_depth(self.refine_depth)
-        refine = (
-            not host
-            and rd is not None
-            and (self.max_depth is None or self.max_depth > rd)
+        rd, refine, crown_depth = resolve_refine(
+            self.max_depth, self.refine_depth
         )
         cfg = BuildConfig(
             task="regression",
             criterion="mse",
-            max_depth=rd if refine else self.max_depth,
+            max_depth=crown_depth,
             min_samples_split=self.min_samples_split,
         )
         y_c = (y64 - y_mean).astype(np.float32)
         if host:
             with timer.phase("host_build"):
-                self.tree_ = build_tree_host(
+                res = build_tree_host(
                     binned, y_c, config=cfg, sample_weight=sw,
-                    refit_targets=y64,
+                    refit_targets=y64, return_leaf_ids=refine,
                 )
+                self.tree_, leaf_ids = res if refine else (res, None)
         else:
             mesh = mesh_lib.resolve_mesh(
                 backend=self.backend, n_devices=self.n_devices
             )
-            self.tree_ = build_tree(
+            res = build_tree(
                 binned, y_c, config=cfg, mesh=mesh, sample_weight=sw,
-                refit_targets=y64, timer=timer,
+                refit_targets=y64, timer=timer, return_leaf_ids=refine,
             )
+            # Row->leaf ids come straight off the build's device state; a
+            # second full-matrix descent would re-upload X for nothing.
+            self.tree_, leaf_ids = res if refine else (res, None)
         if refine:
-            import dataclasses
+            from mpitree_tpu.core.hybrid_builder import apply_refine
 
-            from mpitree_tpu.core.hybrid_builder import refine_deep_subtrees
-
-            with timer.phase("refine"):
-                self.tree_ = refine_deep_subtrees(
-                    self.tree_, X, y_c, self._leaf_ids(X),
-                    config=dataclasses.replace(cfg, max_depth=self.max_depth),
-                    refine_depth=rd,
-                    sample_weight=sw, refit_targets=y64,
-                )
+            self.tree_ = apply_refine(
+                self.tree_, leaf_ids, X, y_c, cfg=cfg,
+                max_depth=self.max_depth, rd=rd, timer=timer,
+                sample_weight=sw, refit_targets=y64,
+            )
         self.fit_stats_ = timer.summary() if timer.enabled else None
         return self
 

@@ -96,13 +96,13 @@ def lib():
                 _i32p, _i32p, _i32p, ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
                 ctypes.c_int32, ctypes.c_int32, _i32p, ctypes.c_int32,
-                _i32p, _i32p, _f64p, _f64p, _u8p,
+                ctypes.c_int32, _i32p, _i32p, _f64p, _f64p, _u8p,
             ]
             cdll.best_splits_classification.restype = None
             cdll.best_splits_regression.argtypes = [
                 _i32p, _f32p, _i32p, ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-                ctypes.c_int32, _i32p,
+                ctypes.c_int32, _i32p, ctypes.c_int32,
                 _i32p, _i32p, _f64p, _f64p, _u8p, _f64p, _f64p,
             ]
             cdll.best_splits_regression.restype = None
@@ -120,9 +120,14 @@ def _wptr(w: np.ndarray | None):
 
 def best_splits_classification(
     xb, y, node_id, w, *, n_bins, n_classes, frontier_lo, n_slots, n_cand,
-    criterion,
+    criterion, n_cand_per_slot=False,
 ):
-    """ctypes wrapper; returns dict of per-slot arrays (or None if no lib)."""
+    """ctypes wrapper; returns dict of per-slot arrays (or None if no lib).
+
+    ``n_cand_per_slot=True`` marks ``n_cand`` as (n_slots, n_feat) — one
+    candidate count per frontier node, for multi-root frontiers where every
+    node carries its own exact local binning (core/hybrid_builder.py).
+    """
     cdll = lib()
     if cdll is None:
         return None
@@ -133,9 +138,11 @@ def best_splits_classification(
     out_counts = np.zeros((n_slots, n_classes), np.float64)
     out_constant = np.empty(n_slots, np.uint8)
     w64 = None if w is None else np.ascontiguousarray(w, np.float64)
+    n_cand = np.ascontiguousarray(n_cand, np.int32)
     cdll.best_splits_classification(
         xb, y, node_id, _wptr(w64), n_rows, n_feat, n_bins, n_classes,
-        frontier_lo, n_slots, n_cand, 0 if criterion == "entropy" else 1,
+        frontier_lo, n_slots, n_cand, 1 if n_cand_per_slot else 0,
+        0 if criterion == "entropy" else 1,
         out_feat, out_bin, out_cost, out_counts, out_constant,
     )
     return {
@@ -145,7 +152,8 @@ def best_splits_classification(
 
 
 def best_splits_regression(
-    xb, yv, node_id, w, *, n_bins, frontier_lo, n_slots, n_cand
+    xb, yv, node_id, w, *, n_bins, frontier_lo, n_slots, n_cand,
+    n_cand_per_slot=False,
 ):
     cdll = lib()
     if cdll is None:
@@ -159,9 +167,11 @@ def best_splits_regression(
     out_ymin = np.empty(n_slots, np.float64)
     out_ymax = np.empty(n_slots, np.float64)
     w64 = None if w is None else np.ascontiguousarray(w, np.float64)
+    n_cand = np.ascontiguousarray(n_cand, np.int32)
     cdll.best_splits_regression(
         xb, np.ascontiguousarray(yv, np.float32), node_id, _wptr(w64),
         n_rows, n_feat, n_bins, frontier_lo, n_slots, n_cand,
+        1 if n_cand_per_slot else 0,
         out_feat, out_bin, out_cost, out_counts, out_constant,
         out_ymin, out_ymax,
     )

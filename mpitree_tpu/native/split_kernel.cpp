@@ -22,6 +22,12 @@
 //     (reference: mpitree/tree/decision_tree.py:88-91,140);
 //   - candidates with an empty side are invalid;
 //   - all accumulation in double; cost comparisons in double.
+//
+// Multi-root frontiers (the hybrid build's batched deep tail,
+// core/hybrid_builder.py): every frontier node may descend from a different
+// subtree with its own exact local binning, so the valid-candidate count can
+// vary per (node, feature). `n_cand_per_slot != 0` switches `n_cand` from
+// (n_feat,) shared to (n_slots, n_feat) row-major per-slot.
 
 #include <algorithm>
 #include <cmath>
@@ -34,6 +40,27 @@ namespace {
 
 inline double xlogx(double x) { return x > 0.0 ? x * std::log2(x) : 0.0; }
 
+// Integer-count fast path: entropy sweeps spend nearly all their time in
+// log2 (4 calls per row move). When every sample weight is integral (the
+// common unweighted / bootstrap-count case) all running class counts are
+// integers, so n*log2(n) comes from a lazily grown lookup table instead.
+// tab[i] = xlogx((double)i) exactly — results are bit-identical to the
+// direct computation, so tie-breaking cannot drift between the paths.
+// thread_local: ctypes releases the GIL, so concurrent calls from two
+// Python threads must not share the growth.
+constexpr int64_t kXlogxTabCap = int64_t(1) << 22;  // 33 MB ceiling
+thread_local std::vector<double> g_xlogx_tab;
+
+inline const double* xlogx_tab_ensure(int64_t n) {
+  if ((int64_t)g_xlogx_tab.size() < n + 1) {
+    int64_t old = g_xlogx_tab.size();
+    g_xlogx_tab.resize(n + 1);
+    for (int64_t i = old; i < (int64_t)g_xlogx_tab.size(); ++i)
+      g_xlogx_tab[i] = xlogx((double)i);
+  }
+  return g_xlogx_tab.data();
+}
+
 // Strictly-better test with relative tolerance: the incremental sweep's cost
 // differs from the reference's dense formula by last-ULP rounding, and exact
 // mathematical ties (symmetric splits) must resolve to the lowest
@@ -45,11 +72,46 @@ inline bool better(double cost, double best) {
   return cost < best - 1e-12 * (std::abs(best) + 1.0);
 }
 
-struct Acc {
-  // Running impurity-sweep state for one (node, feature) pass.
-  double sum_xlogx = 0.0;  // sum_c n_c*log2(n_c) (entropy) or sum_c n_c^2 (gini)
-  double n = 0.0;
-};
+// Bucket rows by frontier slot (counting sort; parked rows drop out).
+// Zero-weight rows (bootstrap out-of-bag) are excluded up front: they
+// contribute nothing to counts or impurity, and the device path's
+// bin-occupancy ("constant") flag ignores them too.
+void bucket_rows(const int32_t* node_id, const double* w, int64_t n_rows,
+                 int32_t frontier_lo, int32_t n_slots,
+                 std::vector<int64_t>& slot_start,
+                 std::vector<int64_t>& rows_by_slot) {
+  slot_start.assign(n_slots + 1, 0);
+  std::vector<int32_t> slot_of(n_rows);
+  for (int64_t r = 0; r < n_rows; ++r) {
+    int64_t s = (int64_t)node_id[r] - frontier_lo;
+    bool live = s >= 0 && s < n_slots && (!w || w[r] > 0.0);
+    slot_of[r] = live ? (int32_t)s : -1;
+    if (slot_of[r] >= 0) slot_start[slot_of[r] + 1]++;
+  }
+  for (int32_t s = 0; s < n_slots; ++s) slot_start[s + 1] += slot_start[s];
+  rows_by_slot.resize(slot_start[n_slots]);
+  std::vector<int64_t> cur(slot_start.begin(), slot_start.end() - 1);
+  for (int64_t r = 0; r < n_rows; ++r)
+    if (slot_of[r] >= 0) rows_by_slot[cur[slot_of[r]]++] = r;
+}
+
+// Produce the ascending occupied-bin order for one (node, feature) pass.
+// Dense nodes (occupied bins comparable to the bin range, the exact-binned
+// deep-tail case) iterate the range directly; sparse nodes sort the touched
+// list — O(min(range, T log T)) instead of always T log T.
+inline void order_touched(std::vector<int32_t>& touched, int32_t bt_max) {
+  const int64_t T = (int64_t)touched.size();
+  if ((int64_t)bt_max + 1 <= 8 * T) {
+    // touched densely covers [0, bt_max]: counting iteration
+    std::vector<char> seen((size_t)bt_max + 1, 0);
+    for (int32_t b : touched) seen[b] = 1;
+    touched.clear();
+    for (int32_t b = 0; b <= bt_max; ++b)
+      if (seen[b]) touched.push_back(b);
+  } else {
+    std::sort(touched.begin(), touched.end());
+  }
+}
 
 }  // namespace
 
@@ -63,7 +125,8 @@ extern "C" {
 //   node_id  : (n_rows,) int32 current assignment; rows outside
 //              [frontier_lo, frontier_lo + n_slots) are ignored
 //   w        : (n_rows,) double sample weights (may be null -> all 1)
-//   n_cand   : (n_feat,) int32 valid candidate count per feature
+//   n_cand   : valid candidate count per feature — shape (n_feat,) when
+//              n_cand_per_slot == 0, else (n_slots, n_feat) row-major
 // Outputs (caller-allocated):
 //   out_feat : (n_slots,) int32 best feature (-1 if no valid candidate)
 //   out_bin  : (n_slots,) int32 best bin
@@ -75,35 +138,25 @@ void best_splits_classification(
     const int32_t* xb, const int32_t* y, const int32_t* node_id,
     const double* w, int64_t n_rows, int32_t n_feat, int32_t n_bins,
     int32_t n_classes, int32_t frontier_lo, int32_t n_slots,
-    const int32_t* n_cand, int32_t criterion, int32_t* out_feat,
-    int32_t* out_bin, double* out_cost, double* out_counts,
+    const int32_t* n_cand, int32_t n_cand_per_slot, int32_t criterion,
+    int32_t* out_feat, int32_t* out_bin, double* out_cost, double* out_counts,
     uint8_t* out_constant) {
   const double inf = std::numeric_limits<double>::infinity();
 
-  // Bucket rows by frontier slot (counting sort; parked rows drop out).
-  // Zero-weight rows (bootstrap out-of-bag) are excluded up front: they
-  // contribute nothing to counts or impurity, and the device path's
-  // bin-occupancy ("constant") flag ignores them too.
-  std::vector<int64_t> slot_start(n_slots + 1, 0);
-  std::vector<int32_t> slot_of(n_rows);
-  for (int64_t r = 0; r < n_rows; ++r) {
-    int64_t s = (int64_t)node_id[r] - frontier_lo;
-    bool live = s >= 0 && s < n_slots && (!w || w[r] > 0.0);
-    slot_of[r] = live ? (int32_t)s : -1;
-    if (slot_of[r] >= 0) slot_start[slot_of[r] + 1]++;
-  }
-  for (int32_t s = 0; s < n_slots; ++s) slot_start[s + 1] += slot_start[s];
-  std::vector<int64_t> rows_by_slot(slot_start[n_slots]);
-  {
-    std::vector<int64_t> cur(slot_start.begin(), slot_start.end() - 1);
+  std::vector<int64_t> slot_start;
+  std::vector<int64_t> rows_by_slot;
+  bucket_rows(node_id, w, n_rows, frontier_lo, n_slots, slot_start,
+              rows_by_slot);
+
+  // Integral weights -> integer class counts -> xlogx lookup table applies.
+  bool int_w = true;
+  if (w) {
     for (int64_t r = 0; r < n_rows; ++r)
-      if (slot_of[r] >= 0) rows_by_slot[cur[slot_of[r]]++] = r;
+      if (w[r] != std::floor(w[r])) { int_w = false; break; }
   }
 
   // Scratch reused across (node, feature) passes.
-  std::vector<double> bin_w(n_bins, 0.0);           // weight per bin
-  std::vector<double> cls_in_bin(n_bins, 0.0);      // per-bin Σ_c xlogx-terms
-  std::vector<int32_t> touched_bins;                // occupied bins, unsorted
+  std::vector<int32_t> touched_bins;                // occupied bins
   std::vector<double> left_cls(n_classes, 0.0);     // running class counts
   std::vector<double> node_cls(n_classes, 0.0);
   // Per-(bin) class lists, CSR-style, rebuilt per (node, feature).
@@ -113,6 +166,8 @@ void best_splits_classification(
 
   for (int32_t s = 0; s < n_slots; ++s) {
     const int64_t r0 = slot_start[s], r1 = slot_start[s + 1];
+    const int32_t* nc =
+        n_cand + (n_cand_per_slot ? (int64_t)s * n_feat : 0);
     out_feat[s] = -1;
     out_bin[s] = 0;
     out_cost[s] = inf;
@@ -129,28 +184,54 @@ void best_splits_classification(
     }
     if (r1 == r0) { out_constant[s] = 0; continue; }
 
+    // A slot with no candidate features at all (the hybrid refine zeroes
+    // per-slot n_cand for budget-exhausted roots) needs only the counts
+    // above — skip the per-feature chain builds and sweeps outright.
+    {
+      bool any_cand = false;
+      for (int32_t f = 0; f < n_feat; ++f)
+        if (nc[f] > 0) { any_cand = true; break; }
+      if (!any_cand) continue;
+    }
+
+    // mode: 0 = entropy via log2, 1 = gini, 2 = entropy via lookup table
+    int mode = criterion;
+    const double* tab = nullptr;
+    if (criterion == 0 && int_w && n_tot < (double)kXlogxTabCap) {
+      tab = xlogx_tab_ensure((int64_t)n_tot);
+      mode = 2;
+    }
+
     row_next.resize(r1 - r0);
     for (int32_t f = 0; f < n_feat; ++f) {
       // Build per-bin chains for this (node, feature).
       touched_bins.clear();
+      int32_t bt_max = 0;
       for (int64_t i = r0; i < r1; ++i) {
         const int64_t r = rows_by_slot[i];
         const int32_t b = xb[r * n_feat + f];
-        if (bin_head[b] < 0) touched_bins.push_back(b);
+        if (bin_head[b] < 0) {
+          touched_bins.push_back(b);
+          if (b > bt_max) bt_max = b;
+        }
         row_next[i - r0] = bin_head[b];
         bin_head[b] = i;
       }
       if (touched_bins.size() > 1) out_constant[s] = 0;
 
-      if (f < n_feat && n_cand[f] > 0 && touched_bins.size() > 1) {
+      if (nc[f] > 0 && touched_bins.size() > 1) {
         // Ascending sweep over occupied bins only.
-        std::sort(touched_bins.begin(), touched_bins.end());
-        double left_n = 0.0, left_sum = 0.0;   // Σ_c xlogx(n_c) or Σ n_c^2
+        order_touched(touched_bins, bt_max);
+        double left_n = 0.0;
+        double left_sum = 0.0;  // Σ_c xlogx(l_c) (entropy) or Σ_c l_c^2
         // right_c = node_c - left_c; maintain Σ_c f(right_c) incrementally,
         // starting with all mass on the right.
         double right_sum = 0.0;
         std::fill(left_cls.begin(), left_cls.end(), 0.0);
-        if (criterion == 0) {
+        if (mode == 2) {
+          for (int32_t c = 0; c < n_classes; ++c)
+            right_sum += tab[(int64_t)node_cls[c]];
+        } else if (mode == 0) {
           for (int32_t c = 0; c < n_classes; ++c)
             right_sum += xlogx(node_cls[c]);
         } else {
@@ -168,7 +249,10 @@ void best_splits_classification(
             const double wr = w ? w[r] : 1.0;
             const double lc = left_cls[c];
             const double rc = node_cls[c] - lc;
-            if (criterion == 0) {
+            if (mode == 2) {
+              left_sum += tab[(int64_t)(lc + wr)] - tab[(int64_t)lc];
+              right_sum += tab[(int64_t)(rc - wr)] - tab[(int64_t)rc];
+            } else if (mode == 0) {
               left_sum += xlogx(lc + wr) - xlogx(lc);
               right_sum += xlogx(rc - wr) - xlogx(rc);
             } else {
@@ -178,19 +262,22 @@ void best_splits_classification(
             left_cls[c] = lc + wr;
             left_n += wr;
           }
-          if (b >= n_cand[f]) break;  // past the last valid candidate
+          if (b >= nc[f]) break;  // past the last valid candidate
           const double right_n = n_tot - left_n;
           if (left_n <= 0.0 || right_n <= 0.0) continue;
           double cost;
-          if (criterion == 0) {
-            // n_l*H_l = n_l*log2(n_l) - Σ_c xlogx(l_c), likewise right.
-            const double hl = xlogx(left_n) - left_sum;
-            const double hr = xlogx(right_n) - right_sum;
-            cost = (hl + hr) / n_tot;
-          } else {
+          if (mode == 1) {
             const double gl = left_n - left_sum / left_n;
             const double gr = right_n - right_sum / right_n;
             cost = (gl + gr) / n_tot;
+          } else {
+            // n_l*H_l = n_l*log2(n_l) - Σ_c xlogx(l_c), likewise right.
+            const double hl =
+                (mode == 2 ? tab[(int64_t)left_n] : xlogx(left_n)) - left_sum;
+            const double hr =
+                (mode == 2 ? tab[(int64_t)right_n] : xlogx(right_n)) -
+                right_sum;
+            cost = (hl + hr) / n_tot;
           }
           if (better(cost, out_cost[s])) {
             out_cost[s] = cost;
@@ -206,39 +293,30 @@ void best_splits_classification(
 }
 
 // Regression (squared error) variant: per-node best split from
-// (w, w*y, w*y^2) running sums; same tie-break contract.
+// (w, w*y, w*y^2) running sums; same tie-break and n_cand contract.
 // Outputs: out_counts is (n_slots, 3) = (n, sum_y, sum_y2) with weights.
 void best_splits_regression(
     const int32_t* xb, const float* yv, const int32_t* node_id,
     const double* w, int64_t n_rows, int32_t n_feat, int32_t n_bins,
     int32_t frontier_lo, int32_t n_slots, const int32_t* n_cand,
-    int32_t* out_feat, int32_t* out_bin, double* out_cost,
-    double* out_counts, uint8_t* out_constant, double* out_ymin,
-    double* out_ymax) {
+    int32_t n_cand_per_slot, int32_t* out_feat, int32_t* out_bin,
+    double* out_cost, double* out_counts, uint8_t* out_constant,
+    double* out_ymin, double* out_ymax) {
   const double inf = std::numeric_limits<double>::infinity();
 
-  std::vector<int64_t> slot_start(n_slots + 1, 0);
-  std::vector<int32_t> slot_of(n_rows);
-  for (int64_t r = 0; r < n_rows; ++r) {
-    int64_t s = (int64_t)node_id[r] - frontier_lo;
-    bool live = s >= 0 && s < n_slots && (!w || w[r] > 0.0);
-    slot_of[r] = live ? (int32_t)s : -1;
-    if (slot_of[r] >= 0) slot_start[slot_of[r] + 1]++;
-  }
-  for (int32_t s = 0; s < n_slots; ++s) slot_start[s + 1] += slot_start[s];
-  std::vector<int64_t> rows_by_slot(slot_start[n_slots]);
-  {
-    std::vector<int64_t> cur(slot_start.begin(), slot_start.end() - 1);
-    for (int64_t r = 0; r < n_rows; ++r)
-      if (slot_of[r] >= 0) rows_by_slot[cur[slot_of[r]]++] = r;
-  }
+  std::vector<int64_t> slot_start;
+  std::vector<int64_t> rows_by_slot;
+  bucket_rows(node_id, w, n_rows, frontier_lo, n_slots, slot_start,
+              rows_by_slot);
 
-  std::vector<double> bw(n_bins), bs(n_bins), bq(n_bins);
+  std::vector<double> bw(n_bins, 0.0), bs(n_bins, 0.0), bq(n_bins, 0.0);
   std::vector<int32_t> touched;
   touched.reserve(n_bins);
 
   for (int32_t s = 0; s < n_slots; ++s) {
     const int64_t r0 = slot_start[s], r1 = slot_start[s + 1];
+    const int32_t* nc =
+        n_cand + (n_cand_per_slot ? (int64_t)s * n_feat : 0);
     out_feat[s] = -1;
     out_bin[s] = 0;
     out_cost[s] = inf;
@@ -264,27 +342,38 @@ void best_splits_regression(
     out_ymax[s] = ymax;
     if (r1 == r0) { out_constant[s] = 0; continue; }
 
+    {
+      bool any_cand = false;
+      for (int32_t f = 0; f < n_feat; ++f)
+        if (nc[f] > 0) { any_cand = true; break; }
+      if (!any_cand) continue;
+    }
+
     for (int32_t f = 0; f < n_feat; ++f) {
       touched.clear();
+      int32_t bt_max = 0;
       for (int64_t i = r0; i < r1; ++i) {
         const int64_t r = rows_by_slot[i];
         const int32_t b = xb[r * n_feat + f];
         const double wr = w ? w[r] : 1.0;
         const double yr = (double)yv[r];
-        if (bw[b] == 0.0 && bs[b] == 0.0 && bq[b] == 0.0) touched.push_back(b);
+        if (bw[b] == 0.0 && bs[b] == 0.0 && bq[b] == 0.0) {
+          touched.push_back(b);
+          if (b > bt_max) bt_max = b;
+        }
         bw[b] += wr;
         bs[b] += wr * yr;
         bq[b] += wr * yr * yr;
       }
       if (touched.size() > 1) out_constant[s] = 0;
-      if (n_cand[f] > 0 && touched.size() > 1) {
-        std::sort(touched.begin(), touched.end());
+      if (nc[f] > 0 && touched.size() > 1) {
+        order_touched(touched, bt_max);
         double wl = 0.0, sl = 0.0, ql = 0.0;
         for (int32_t b : touched) {
           wl += bw[b];
           sl += bs[b];
           ql += bq[b];
-          if (b >= n_cand[f]) break;
+          if (b >= nc[f]) break;
           const double wr_ = n_tot - wl, sr = s_tot - sl, qr = q_tot - ql;
           if (wl <= 0.0 || wr_ <= 0.0) continue;
           const double sse_l = ql - sl * sl / wl;

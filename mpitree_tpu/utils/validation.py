@@ -79,3 +79,16 @@ def validate_refine_depth(refine_depth):
             f"got {refine_depth!r}"
         )
     return rd
+
+
+def resolve_refine(max_depth, refine_depth):
+    """Shared hybrid-build crossover decision for every estimator.
+
+    Returns ``(rd, refine, crown_max_depth)``: the validated crossover
+    depth, whether the hybrid tail runs at all (it needs room below the
+    crown), and the depth cap the crown build should use. One source of
+    truth so the classifier and regressor cannot diverge on it.
+    """
+    rd = validate_refine_depth(refine_depth)
+    refine = rd is not None and (max_depth is None or max_depth > rd)
+    return rd, refine, (rd if refine else max_depth)

@@ -102,6 +102,72 @@ def test_hybrid_regressor_improves_fit():
     assert np.isfinite(hyb.tree_.count[:, 0]).all()
 
 
+def test_hybrid_regressor_leaf_values_are_exact_means():
+    """Every leaf's value must equal the f64 mean of its training rows.
+
+    Pins the multi-root refit bug: ``refit_regression_values``'s rollup on
+    the batched tail buffer used to add every non-first root's sums into
+    index -1 (the last node), corrupting that leaf's value/impurity."""
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(4000, 5)).astype(np.float64)
+    yr = np.sin(3 * X[:, 0]) + 0.5 * X[:, 1] ** 2
+    hyb = DecisionTreeRegressor(
+        max_depth=10, max_bins=8, backend="cpu", refine_depth=3
+    ).fit(X, yr)
+    t = hyb.tree_
+    ids = hyb._leaf_ids(X)
+    for leaf in np.unique(ids):
+        np.testing.assert_allclose(
+            t.value[leaf], yr[ids == leaf].mean(), rtol=1e-6,
+            err_msg=f"leaf {leaf} value is not the mean of its rows",
+        )
+
+
+def _bin_starved_constant_data():
+    """Global quantile bins (max_bins=4) are exhausted by depth ~2, so the
+    crown stops every leaf as 'constant under the bins' while 250-odd raw
+    values per leaf still carry signal."""
+    rng = np.random.default_rng(0)
+    x = np.concatenate(
+        [rng.uniform(0, 1, 900), np.repeat([1000.0, 1001.0, 1002.0, 1003.0], 25)]
+    )
+    y = np.concatenate(
+        [np.zeros(900, int), np.repeat([0, 1, 0, 1], 25)]
+    )
+    return x.reshape(-1, 1), y
+
+
+def test_refine_reaches_leaves_stopped_constant_above_refine_depth():
+    """Candidate selection is by outcome (impure leaf, depth <= refine_depth),
+    not depth equality: leaves the crown stopped as bin-constant shallower
+    than refine_depth must still be refined with exact local candidates."""
+    X, y = _bin_starved_constant_data()
+    clf = DecisionTreeClassifier(
+        max_depth=10, max_bins=4, backend="cpu", refine_depth=4
+    ).fit(X, y)
+    assert (clf.predict(X) == y).mean() == 1.0
+    _check_valid(clf.tree_)
+    # and the shallow-stop fix keeps identity with a deeper-crown config
+    clf2 = DecisionTreeClassifier(
+        max_depth=10, max_bins=4, backend="cpu", refine_depth=2
+    ).fit(X, y)
+    assert clf.export_text() == clf2.export_text()
+
+
+def test_host_backend_honors_refine_depth():
+    """backend='host' must run the same hybrid tail instead of silently
+    ignoring refine_depth (quantile starvation hits the host build too)."""
+    X, y = _bin_starved_constant_data()
+    clf = DecisionTreeClassifier(
+        max_depth=10, max_bins=4, backend="host", refine_depth=4
+    ).fit(X, y)
+    assert (clf.predict(X) == y).mean() == 1.0
+    dev = DecisionTreeClassifier(
+        max_depth=10, max_bins=4, backend="cpu", refine_depth=4
+    ).fit(X, y)
+    assert clf.export_text() == dev.export_text()
+
+
 def test_refine_depth_validation():
     import pytest
 
