@@ -550,6 +550,7 @@ def build_forest_fused(
     refit_targets: np.ndarray | None = None,
     integer_counts: bool = True,
     timer: PhaseTimer | None = None,
+    return_leaf_ids: bool = False,
 ) -> list:
     """Build T trees as ONE device program, trees sharded over the mesh.
 
@@ -637,4 +638,6 @@ def build_forest_fused(
                     weights[t].astype(np.float64), refit_targets,
                 )
             trees.append(tree)
+    if return_leaf_ids:
+        return trees, np.asarray(nid_out)[:T, :N]
     return trees

@@ -272,8 +272,8 @@ def build_tree_host(
             )
             ids = frontier_lo + np.arange(S)
             _record_level(
-                tree, ids, S, False, stop, feat_best, value, n, counts
-                if task == "classification" else None, task, node_imp,
+                tree, ids, S, False, stop, feat_best, value, n, counts,
+                task, node_imp,
             )
             nid, frontier_lo, frontier_size, depth = _split_and_advance(
                 tree, binned, xb, nid, ids, stop, feat_best, bin_best,

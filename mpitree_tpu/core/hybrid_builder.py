@@ -153,6 +153,7 @@ def _bin_per_root(Xr: np.ndarray, starts: np.ndarray, ends: np.ndarray):
 def _refine_batched(
     top: TreeArrays, X, y_enc, candidates, rows_per, *, cfg_sub,
     max_depth_total, root_depth, n_classes, sample_weight, refit_targets,
+    feature_mask=None,
 ) -> TreeArrays:
     """Grow every deep subtree together in one multi-root host frontier.
 
@@ -186,7 +187,13 @@ def _refine_batched(
     Xr = np.ascontiguousarray(X[rows_all], np.float32)
     xb, ncand, off, thr_flat = _bin_per_root(Xr, starts, ends)
     del Xr
+    # Scratch sizing must cover every bin id present in xb — including
+    # masked features', whose chains the kernel still builds — so compute
+    # it BEFORE the subspace mask zeroes candidate counts.
     n_bins = int(ncand.max(initial=0)) + 1
+    if feature_mask is not None:
+        # Random-subspace trees must not discover masked features in the tail.
+        ncand[:, ~np.asarray(feature_mask, bool)] = 0
 
     Nr = len(rows_all)
     if task == "classification":
@@ -332,11 +339,14 @@ def _graft_batched(
 def apply_refine(
     tree, leaf_ids, X, y_build, *, cfg, max_depth, rd, timer,
     n_classes=None, sample_weight=None, refit_targets=None,
+    feature_mask=None,
 ):
     """Estimator-side entry: run the hybrid tail under the refine timer.
 
-    Shared by the classifier and regressor so the crossover wiring (depth
-    override, phase accounting, argument plumbing) lives in one place.
+    Shared by the classifier, regressor, and forests so the crossover wiring
+    (depth override, phase accounting, argument plumbing) lives in one
+    place. ``feature_mask`` restricts tail splits to a feature subset (a
+    forest tree's random subspace).
     """
     import dataclasses
 
@@ -346,6 +356,7 @@ def apply_refine(
             config=dataclasses.replace(cfg, max_depth=max_depth),
             refine_depth=rd, n_classes=n_classes,
             sample_weight=sample_weight, refit_targets=refit_targets,
+            feature_mask=feature_mask,
         )
 
 
@@ -360,6 +371,7 @@ def refine_deep_subtrees(
     n_classes: int | None = None,
     sample_weight: np.ndarray | None = None,
     refit_targets: np.ndarray | None = None,
+    feature_mask: np.ndarray | None = None,
 ) -> TreeArrays:
     """Host-finish every still-splittable leaf of the crown.
 
@@ -415,7 +427,7 @@ def refine_deep_subtrees(
             max_depth_total=cfg.max_depth,
             root_depth=tree.depth[candidates],
             n_classes=n_classes, sample_weight=sample_weight,
-            refit_targets=refit_targets,
+            refit_targets=refit_targets, feature_mask=feature_mask,
         )
 
     subtrees, attach = [], []
@@ -434,6 +446,9 @@ def refine_deep_subtrees(
         )
         # exact LOCAL candidates: every unique value among this node's rows
         binned = bin_dataset(X[rows], binning="exact")
+        if feature_mask is not None:
+            n_cand = np.where(feature_mask, binned.n_cand, 0).astype(np.int32)
+            binned = dataclasses.replace(binned, n_cand=n_cand)
         st = build_tree_host(
             binned, y_enc[rows], config=sub_cfg, n_classes=n_classes,
             sample_weight=sw, refit_targets=rt,

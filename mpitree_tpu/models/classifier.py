@@ -75,20 +75,22 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         (numpy) builder, larger ones on the default JAX platform. A platform
         name ("tpu", "cpu", ...) forces the device path on that platform;
         ``"host"`` forces the numpy builder.
-    refine_depth : int, optional
+    refine_depth : int, "auto", or None
         Hybrid build crossover: the device engines grow the tree to this
         depth (wide data-parallel frontiers), then each still-splittable
         leaf is host-finished by the native C++ sweep with **exact local
         candidates** — recovering the accuracy that global quantile bins
-        lose in the deep tail (``core/hybrid_builder.py``). ``None`` =
-        single-engine build.
+        lose in the deep tail (``core/hybrid_builder.py``). ``"auto"``
+        (default) engages the hybrid only when quantile binning capped some
+        feature's candidate set and targets ~2k-row crown leaves; ``None``
+        = single-engine build.
     """
 
     _task = "classification"
 
     def __init__(self, *, max_depth=None, min_samples_split=2,
                  criterion="entropy", max_bins=256, binning="auto",
-                 n_devices=None, backend=None, refine_depth=None):
+                 n_devices=None, backend=None, refine_depth="auto"):
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
         self.criterion = criterion
@@ -111,7 +113,8 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         sw = validate_sample_weight(sample_weight, X.shape[0])
         host = prefer_host_path(*X.shape, self.n_devices, self.backend)
         rd, refine, crown_depth = resolve_refine(
-            self.max_depth, self.refine_depth
+            self.max_depth, self.refine_depth,
+            n_rows=X.shape[0], quantized=binned.quantized,
         )
         cfg = BuildConfig(
             task="classification",
@@ -223,7 +226,7 @@ class ParallelDecisionTreeClassifier(DecisionTreeClassifier):
 
     def __init__(self, *, max_depth=None, min_samples_split=2,
                  criterion="entropy", max_bins=256, binning="auto",
-                 n_devices="all", backend=None, refine_depth=None):
+                 n_devices="all", backend=None, refine_depth="auto"):
         super().__init__(
             max_depth=max_depth, min_samples_split=min_samples_split,
             criterion=criterion, max_bins=max_bins, binning=binning,

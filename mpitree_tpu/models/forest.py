@@ -39,6 +39,7 @@ from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.predict import WeakIdCache, predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.utils.validation import (
+    resolve_refine,
     validate_fit_data,
     validate_predict_data,
     validate_sample_weight,
@@ -71,7 +72,7 @@ class _BaseForest(BaseEstimator):
     def __init__(self, *, n_estimators=10, max_depth=None, min_samples_split=2,
                  max_bins=256, binning="auto", bootstrap=True,
                  max_features=None, random_state=None, n_devices=None,
-                 backend=None):
+                 backend=None, refine_depth="auto"):
         self.n_estimators = n_estimators
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
@@ -82,6 +83,7 @@ class _BaseForest(BaseEstimator):
         self.random_state = random_state
         self.n_devices = n_devices
         self.backend = backend
+        self.refine_depth = refine_depth
 
     def _fit_forest(self, X, y_enc, *, task, criterion, n_classes=None,
                     refit_targets=None, sample_weight=None):
@@ -93,13 +95,19 @@ class _BaseForest(BaseEstimator):
         mesh = None if use_host else mesh_lib.resolve_mesh(
             backend=self.backend, n_devices=self.n_devices
         )
+        rd, refine, crown_depth = resolve_refine(
+            self.max_depth, self.refine_depth,
+            n_rows=n, quantized=binned.quantized,
+        )
         cfg = BuildConfig(
-            task=task, criterion=criterion, max_depth=self.max_depth,
+            task=task, criterion=criterion, max_depth=crown_depth,
             min_samples_split=self.min_samples_split,
         )
         k = _n_subspace_features(self.max_features, X.shape[1])
 
         trees = []
+        leaf_ids = []  # per tree, only kept when the hybrid tail runs
+        tree_w, tree_mask = [], []
         weights, masks = [], []
         for _ in range(self.n_estimators):
             # Bootstrap multiplicities compose multiplicatively with any
@@ -109,35 +117,67 @@ class _BaseForest(BaseEstimator):
                 boot = rng.multinomial(n, np.full(n, 1.0 / n)).astype(np.float32)
                 w = boot if w is None else boot * w
             b = binned
+            fmask = None
             if k < X.shape[1]:
                 keep = np.sort(rng.choice(X.shape[1], size=k, replace=False))
+                fmask = np.zeros(X.shape[1], bool)
+                fmask[keep] = True
                 n_cand = np.zeros_like(binned.n_cand)
                 n_cand[keep] = binned.n_cand[keep]
                 b = dataclasses.replace(binned, n_cand=n_cand)
+            tree_w.append(w)
+            tree_mask.append(fmask)
             if use_host:
-                trees.append(
-                    build_tree_host(b, y_enc, config=cfg, n_classes=n_classes,
-                                    sample_weight=w, refit_targets=refit_targets)
+                res = build_tree_host(
+                    b, y_enc, config=cfg, n_classes=n_classes,
+                    sample_weight=w, refit_targets=refit_targets,
+                    return_leaf_ids=refine,
                 )
+                trees.append(res[0] if refine else res)
+                if refine:
+                    leaf_ids.append(res[1])
             elif self._per_tree_device_builds():
                 # levelwise engine / debug mode: per-tree builds keep the
                 # instrumentation and determinism checks build_tree wires up.
-                trees.append(
-                    build_tree(b, y_enc, config=cfg, mesh=mesh,
-                               n_classes=n_classes, sample_weight=w,
-                               refit_targets=refit_targets)
+                res = build_tree(
+                    b, y_enc, config=cfg, mesh=mesh,
+                    n_classes=n_classes, sample_weight=w,
+                    refit_targets=refit_targets, return_leaf_ids=refine,
                 )
+                trees.append(res[0] if refine else res)
+                if refine:
+                    leaf_ids.append(res[1])
             else:
                 # Device trees batch into ONE tree-sharded program below.
                 weights.append(np.ones(n, np.float32) if w is None else w)
                 masks.append(b.candidate_mask())
         if weights:
-            trees = build_forest_fused(
+            res = build_forest_fused(
                 binned, y_enc, config=cfg, mesh=mesh,
                 weights=np.stack(weights), cand_masks=np.stack(masks),
                 n_classes=n_classes, refit_targets=refit_targets,
                 integer_counts=integer_weights(sample_weight),
+                return_leaf_ids=refine,
             )
+            if refine:
+                trees, nid_all = res
+                leaf_ids = list(nid_all)
+            else:
+                trees = res
+        if refine:
+            from mpitree_tpu.core.hybrid_builder import apply_refine
+            from mpitree_tpu.utils.profiling import PhaseTimer
+
+            timer = PhaseTimer(enabled=False)
+            trees = [
+                apply_refine(
+                    t, ids, X, y_enc, cfg=cfg, max_depth=self.max_depth,
+                    rd=rd, timer=timer, n_classes=n_classes,
+                    sample_weight=w, refit_targets=refit_targets,
+                    feature_mask=fm,
+                )
+                for t, ids, w, fm in zip(trees, leaf_ids, tree_w, tree_mask)
+            ]
         return trees
 
     @staticmethod
@@ -216,17 +256,24 @@ class _BaseForest(BaseEstimator):
 
 
 class RandomForestClassifier(ClassifierMixin, _BaseForest):
-    """Bagged classification forest (soft voting over per-tree class counts)."""
+    """Bagged classification forest (soft voting over per-tree class counts).
+
+    ``max_features`` draws the subspace **per tree** (not per node as
+    sklearn does), which weakens individual trees far more aggressively —
+    so the default is ``None`` (pure bagging, every tree sees all
+    features), matching the BASELINE target ("bagged random forest").
+    """
 
     def __init__(self, *, n_estimators=10, criterion="entropy", max_depth=None,
                  min_samples_split=2, max_bins=256, binning="auto",
-                 bootstrap=True, max_features="sqrt", random_state=None,
-                 n_devices=None, backend=None):
+                 bootstrap=True, max_features=None, random_state=None,
+                 n_devices=None, backend=None, refine_depth="auto"):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
             binning=binning, bootstrap=bootstrap, max_features=max_features,
             random_state=random_state, n_devices=n_devices, backend=backend,
+            refine_depth=refine_depth,
         )
         self.criterion = criterion
 
@@ -264,12 +311,13 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
     def __init__(self, *, n_estimators=10, max_depth=None,
                  min_samples_split=2, max_bins=256, binning="auto",
                  bootstrap=True, max_features=None, random_state=None,
-                 n_devices=None, backend=None):
+                 n_devices=None, backend=None, refine_depth="auto"):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
             binning=binning, bootstrap=bootstrap, max_features=max_features,
             random_state=random_state, n_devices=n_devices, backend=backend,
+            refine_depth=refine_depth,
         )
 
     def fit(self, X, y, sample_weight=None):

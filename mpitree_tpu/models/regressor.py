@@ -47,7 +47,7 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
 
     def __init__(self, *, max_depth=None, min_samples_split=2,
                  criterion="squared_error", max_bins=256, binning="auto",
-                 n_devices=None, backend=None, refine_depth=None):
+                 n_devices=None, backend=None, refine_depth="auto"):
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
         self.criterion = criterion
@@ -73,7 +73,8 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
         sw = validate_sample_weight(sample_weight, X.shape[0])
         host = prefer_host_path(*X.shape, self.n_devices, self.backend)
         rd, refine, crown_depth = resolve_refine(
-            self.max_depth, self.refine_depth
+            self.max_depth, self.refine_depth,
+            n_rows=X.shape[0], quantized=binned.quantized,
         )
         cfg = BuildConfig(
             task="regression",

@@ -47,12 +47,18 @@ class BinnedData:
     n_bins : int
         Bucket count ``B`` (max over features of ``n_cand[f] + 1``); bin ids
         live in ``[0, B)``.
+    quantized : bool
+        True when at least one feature's candidate set was capped by quantile
+        binning (i.e. the exact unique-value candidates did not fit
+        ``max_bins``). Deep-tail candidate starvation — the condition the
+        hybrid refine exists for — is only possible when this is set.
     """
 
     x_binned: np.ndarray
     thresholds: np.ndarray
     n_cand: np.ndarray
     n_bins: int
+    quantized: bool = False
 
     @property
     def n_samples(self) -> int:
@@ -102,18 +108,21 @@ def bin_dataset(
     n_samples, n_features = X.shape
 
     per_feature_edges: list[np.ndarray] = []
+    quantized = False
     for f in range(n_features):
         col = X[:, f]
         if binning == "exact":
             edges = _exact_edges(col)
         elif binning == "quantile":
             edges = _quantile_edges(col, max_bins)
+            quantized = True
         else:  # auto
             uniq = np.unique(col)
             if len(uniq) <= max_bins:
                 edges = uniq[:-1]
             else:
                 edges = _quantile_edges(col, max_bins)
+                quantized = True
         per_feature_edges.append(edges.astype(np.float32))
 
     n_cand = np.array([len(e) for e in per_feature_edges], dtype=np.int32)
@@ -126,5 +135,6 @@ def bin_dataset(
         x_binned[:, f] = np.searchsorted(edges, X[:, f], side="left")
 
     return BinnedData(
-        x_binned=x_binned, thresholds=thresholds, n_cand=n_cand, n_bins=n_bins
+        x_binned=x_binned, thresholds=thresholds, n_cand=n_cand,
+        n_bins=n_bins, quantized=quantized,
     )

@@ -68,27 +68,54 @@ def validate_refine_depth(refine_depth):
 
     A non-integral value would make the crown's ``depth == max_depth``
     terminal test never fire (unbounded growth) and then match zero
-    refinement candidates — reject it outright.
+    refinement candidates — reject it outright. The string ``"auto"``
+    passes through; :func:`resolve_refine` grounds it per dataset.
     """
     if refine_depth is None:
         return None
+    if isinstance(refine_depth, str):
+        if refine_depth == "auto":
+            return "auto"
+        raise ValueError(
+            f"refine_depth must be None, 'auto', or a non-negative "
+            f"integer, got {refine_depth!r}"
+        )
     rd = int(refine_depth)
     if rd != refine_depth or rd < 0:
         raise ValueError(
-            f"refine_depth must be None or a non-negative integer, "
-            f"got {refine_depth!r}"
+            f"refine_depth must be None, 'auto', or a non-negative "
+            f"integer, got {refine_depth!r}"
         )
     return rd
 
 
-def resolve_refine(max_depth, refine_depth):
+# Crown leaves of roughly this many rows are where the hybrid crossover
+# pays: small enough that exact local candidates are cheap on the host,
+# large enough that the device still amortizes the levels above.
+_AUTO_REFINE_LEAF_ROWS = 2048
+
+
+def resolve_refine(max_depth, refine_depth, *, n_rows=None, quantized=True):
     """Shared hybrid-build crossover decision for every estimator.
 
-    Returns ``(rd, refine, crown_max_depth)``: the validated crossover
+    Returns ``(rd, refine, crown_max_depth)``: the resolved crossover
     depth, whether the hybrid tail runs at all (it needs room below the
     crown), and the depth cap the crown build should use. One source of
     truth so the classifier and regressor cannot diverge on it.
+
+    ``refine_depth="auto"`` engages the hybrid only when quantile binning
+    actually capped some feature's candidates (``quantized`` — otherwise the
+    exact global candidates already match the reference's semantics and a
+    refine pass would rebuild identical subtrees), and picks the crown depth
+    whose average frontier leaf holds ~2k rows.
     """
     rd = validate_refine_depth(refine_depth)
+    if rd == "auto":
+        if not quantized or not n_rows:
+            rd = None
+        else:
+            rd = max(
+                1, round(np.log2(max(n_rows, 2) / _AUTO_REFINE_LEAF_ROWS))
+            )
     refine = rd is not None and (max_depth is None or max_depth > rd)
     return rd, refine, (rd if refine else max_depth)

@@ -71,15 +71,40 @@ def test_max_features_subspace():
         assert len(used) <= 2
 
 
+def test_max_features_respected_through_refine_tail():
+    """Subspace trees with the hybrid tail engaged: the refine's exact local
+    re-binning covers ALL features, so masked features both (a) must never
+    be selected and (b) must not overflow the kernel's bin scratch (their
+    local bin ids can exceed every kept feature's candidate count)."""
+    X, y = _noisy_classification(400)
+    f = RandomForestClassifier(
+        n_estimators=4, max_depth=6, max_features=1, max_bins=8,
+        refine_depth=2, random_state=0,
+    ).fit(X, y)
+    for t in f.trees_:
+        used = set(t.feature[t.feature >= 0].tolist())
+        assert len(used) <= 1
+    # deterministic under the same seed
+    g = RandomForestClassifier(
+        n_estimators=4, max_depth=6, max_features=1, max_bins=8,
+        refine_depth=2, random_state=0,
+    ).fit(X, y)
+    np.testing.assert_array_equal(f.predict(X), g.predict(X))
+
+
 def test_forest_sample_weight_has_effect():
     rng = np.random.default_rng(0)
     X = rng.normal(size=(300, 5))
     y = (X[:, 0] > 0).astype(int)
     w = np.where(y == 1, 10.0, 0.1)  # drown out class 0
+    # subspace trees ("sqrt") keep some trees away from the separating
+    # feature, so class weights can actually shift their leaf majorities
     f = RandomForestClassifier(n_estimators=5, max_depth=3, random_state=0,
-                               bootstrap=False).fit(X, y, sample_weight=w)
+                               bootstrap=False, max_features="sqrt",
+                               ).fit(X, y, sample_weight=w)
     base = RandomForestClassifier(n_estimators=5, max_depth=3, random_state=0,
-                                  bootstrap=False).fit(X, y)
+                                  bootstrap=False, max_features="sqrt",
+                                  ).fit(X, y)
     assert (f.predict(X) == 1).mean() > (base.predict(X) == 1).mean()
 
 

@@ -77,11 +77,14 @@ def test_hybrid_respects_max_depth_and_noop_cases():
         max_depth=6, max_bins=8, backend="cpu", refine_depth=4
     ).fit(X, y)
     assert h.tree_.max_depth <= 6
-    # refine_depth >= max_depth: plain single-engine build
+    # refine_depth >= max_depth: plain single-engine build (control pins
+    # refine_depth=None — the default "auto" would itself engage the hybrid)
     p = DecisionTreeClassifier(
         max_depth=4, max_bins=8, backend="cpu", refine_depth=4
     ).fit(X, y)
-    q = DecisionTreeClassifier(max_depth=4, max_bins=8, backend="cpu").fit(X, y)
+    q = DecisionTreeClassifier(
+        max_depth=4, max_bins=8, backend="cpu", refine_depth=None
+    ).fit(X, y)
     assert p.export_text() == q.export_text()
 
 
