@@ -67,7 +67,7 @@ def _build() -> str | None:
         tmp = f"{so_path}.{os.getpid()}.tmp"
         cmd = [
             "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-march=native",
-            _SRC, "-o", tmp,
+            "-pthread", _SRC, "-o", tmp,
         ]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, so_path)  # atomic on the same filesystem

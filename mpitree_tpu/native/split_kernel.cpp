@@ -32,8 +32,10 @@
 #include <algorithm>
 #include <cmath>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <limits>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -93,6 +95,47 @@ void bucket_rows(const int32_t* node_id, const double* w, int64_t n_rows,
   std::vector<int64_t> cur(slot_start.begin(), slot_start.end() - 1);
   for (int64_t r = 0; r < n_rows; ++r)
     if (slot_of[r] >= 0) rows_by_slot[cur[slot_of[r]]++] = r;
+}
+
+// Frontier slots are independent, so the per-slot loop parallelizes with no
+// synchronization and no effect on results (tie-breaks are within-slot).
+// Ranges are row-balanced via the slot_start prefix sums: at the root level
+// one slot can hold every row, and an even slot split would leave all but
+// one thread idle. MPITREE_TPU_NATIVE_THREADS overrides the default
+// (hardware concurrency); 1 disables threading.
+template <class Fn>
+void run_slot_ranges(const std::vector<int64_t>& slot_start, int32_t n_slots,
+                     Fn&& worker) {
+  int nt = 0;
+  if (const char* env = std::getenv("MPITREE_TPU_NATIVE_THREADS"))
+    nt = std::atoi(env);
+  if (nt <= 0) nt = (int)std::thread::hardware_concurrency();
+  if (nt < 1) nt = 1;
+  if (nt > n_slots) nt = n_slots;
+  // Tiny levels (the host tier's single-digit-millisecond latency path)
+  // must not pay thread spawn/join: their whole sweep costs less than one
+  // std::thread startup. Threshold in rows of actual work this call.
+  if (slot_start[n_slots] < (int64_t)1 << 15) nt = 1;
+  if (nt <= 1) {
+    worker(0, n_slots);
+    return;
+  }
+  const int64_t total = slot_start[n_slots];
+  std::vector<int32_t> bounds(nt + 1, 0);
+  bounds[nt] = n_slots;
+  for (int t = 1; t < nt; ++t) {
+    const int64_t target = total * t / nt;
+    auto it = std::upper_bound(slot_start.begin(),
+                               slot_start.begin() + n_slots + 1, target);
+    int32_t b = (int32_t)(it - slot_start.begin()) - 1;
+    bounds[t] = std::max(b, bounds[t - 1]);
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(nt);
+  for (int t = 0; t < nt; ++t)
+    if (bounds[t + 1] > bounds[t])
+      threads.emplace_back(worker, bounds[t], bounds[t + 1]);
+  for (auto& th : threads) th.join();
 }
 
 // Produce the ascending occupied-bin order for one (node, feature) pass.
@@ -155,7 +198,8 @@ void best_splits_classification(
       if (w[r] != std::floor(w[r])) { int_w = false; break; }
   }
 
-  // Scratch reused across (node, feature) passes.
+  auto worker = [&](int32_t s_begin, int32_t s_end) {
+  // Scratch reused across (node, feature) passes — one set per thread.
   std::vector<int32_t> touched_bins;                // occupied bins
   std::vector<double> left_cls(n_classes, 0.0);     // running class counts
   std::vector<double> node_cls(n_classes, 0.0);
@@ -164,7 +208,7 @@ void best_splits_classification(
   std::vector<int64_t> row_next;
   touched_bins.reserve(n_bins);
 
-  for (int32_t s = 0; s < n_slots; ++s) {
+  for (int32_t s = s_begin; s < s_end; ++s) {
     const int64_t r0 = slot_start[s], r1 = slot_start[s + 1];
     const int32_t* nc =
         n_cand + (n_cand_per_slot ? (int64_t)s * n_feat : 0);
@@ -290,6 +334,8 @@ void best_splits_classification(
       for (int32_t b : touched_bins) bin_head[b] = -1;
     }
   }
+  };  // worker
+  run_slot_ranges(slot_start, n_slots, worker);
 }
 
 // Regression (squared error) variant: per-node best split from
@@ -309,11 +355,12 @@ void best_splits_regression(
   bucket_rows(node_id, w, n_rows, frontier_lo, n_slots, slot_start,
               rows_by_slot);
 
+  auto worker = [&](int32_t s_begin, int32_t s_end) {
   std::vector<double> bw(n_bins, 0.0), bs(n_bins, 0.0), bq(n_bins, 0.0);
   std::vector<int32_t> touched;
   touched.reserve(n_bins);
 
-  for (int32_t s = 0; s < n_slots; ++s) {
+  for (int32_t s = s_begin; s < s_end; ++s) {
     const int64_t r0 = slot_start[s], r1 = slot_start[s + 1];
     const int32_t* nc =
         n_cand + (n_cand_per_slot ? (int64_t)s * n_feat : 0);
@@ -390,6 +437,8 @@ void best_splits_regression(
       for (int32_t b : touched) { bw[b] = 0.0; bs[b] = 0.0; bq[b] = 0.0; }
     }
   }
+  };  // worker
+  run_slot_ranges(slot_start, n_slots, worker);
 }
 
 }  // extern "C"

@@ -126,3 +126,34 @@ def test_host_is_fast_on_reference_sweep():
         t0 = time.perf_counter()
         DecisionTreeClassifier().fit(X, y)
         assert time.perf_counter() - t0 < 0.5
+
+
+def test_native_kernel_thread_count_does_not_change_trees():
+    """Slots are independent, so the C++ kernel's slot-parallel threading
+    (MPITREE_TPU_NATIVE_THREADS) must be invisible in the fitted tree."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import jax; jax.config.update('jax_platforms','cpu')\n"
+        "import numpy as np, sys\n"
+        "from mpitree_tpu import DecisionTreeClassifier\n"
+        "rng = np.random.default_rng(3)\n"
+        "X = rng.normal(size=(4000, 6))\n"
+        "y = ((X[:,0]*X[:,1]) > 0).astype(int)\n"
+        "clf = DecisionTreeClassifier(max_depth=10, max_bins=16,\n"
+        "                             backend='host').fit(X, y)\n"
+        "sys.stdout.write(clf.export_text())\n"
+    )
+    texts = []
+    for threads in ("1", "4"):
+        env = dict(os.environ, MPITREE_TPU_NATIVE_THREADS=threads)
+        env.pop("PYTEST_CURRENT_TEST", None)
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            env=env, timeout=300,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        texts.append(out.stdout)
+    assert texts[0] == texts[1]
