@@ -95,6 +95,83 @@ def probe_backend() -> str:
     return "cpu"
 
 
+FIT_TIMEOUT_S = 1200  # cold tunnel compile ~40-65s; hang needs a hard bound
+
+
+def fit_and_summarize(Xtr, ytr, Xte, yte, *, backend=None) -> dict:
+    """Cold+warm timed fits and the measurement-protocol summary dict.
+
+    The single source of the protocol — the TPU subprocess worker and the
+    host-tier fallback both call it, so the two rows cannot diverge.
+    """
+    from mpitree_tpu import DecisionTreeClassifier
+
+    def fit_once():
+        clf = DecisionTreeClassifier(
+            max_depth=DEPTH, max_bins=256, backend=backend,
+            refine_depth=REFINE_DEPTH,
+        )
+        t0 = time.perf_counter()
+        clf.fit(Xtr, ytr)
+        return time.perf_counter() - t0, clf
+
+    cold_s, _ = fit_once()
+    ours_s, clf = fit_once()
+    return {
+        "ours_s": round(ours_s, 3),
+        "ours_cold_s": round(cold_s, 3),
+        "ours_test_acc": round(float((clf.predict(Xte) == yte).mean()), 4),
+        "tree_depth": clf.tree_.max_depth,
+        "tree_n_nodes": clf.tree_.n_nodes,
+        "refine_depth": clf.refine_depth,
+        "phases": clf.fit_stats_,
+    }
+
+
+def run_fit_worker(npz_path: str) -> None:
+    """Subprocess body: the TPU fit, emitted as one JSON line on stdout.
+
+    Runs isolated because a mid-fit tunnel hang blocks in native code where
+    signal-based timeouts cannot fire (observed: backend init hung for
+    hours this round); the parent kills the whole process instead.
+    """
+    data = np.load(npz_path)
+    out = fit_and_summarize(
+        data["Xtr"], data["ytr"], data["Xte"], data["yte"]
+    )
+    print("BENCH_WORKER_JSON:" + json.dumps(out))
+
+
+def run_tpu_fit(Xtr, ytr, Xte, yte) -> tuple[dict | None, str | None]:
+    """TPU fit in a bounded subprocess; (summary, error-detail-on-failure)."""
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(suffix=".npz", delete=False) as f:
+        npz_path = f.name
+    try:
+        np.savez(npz_path, Xtr=Xtr, ytr=ytr, Xte=Xte, yte=yte)
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--fit-worker",
+             npz_path],
+            capture_output=True, text=True, timeout=FIT_TIMEOUT_S,
+        )
+        for line in out.stdout.splitlines():
+            if line.startswith("BENCH_WORKER_JSON:"):
+                return json.loads(line[len("BENCH_WORKER_JSON:"):]), None
+        return None, (
+            f"rc={out.returncode}; stderr tail: {out.stderr[-2000:]}"
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timed out after {FIT_TIMEOUT_S}s"
+    except OSError as e:
+        return None, f"OSError: {e}"
+    finally:
+        try:
+            os.unlink(npz_path)
+        except OSError:
+            pass
+
+
 def time_reference_semantics(X, y, n, depth=DEPTH):
     """One fit of the reference algorithm (oracle semantics) on n rows."""
     sys.path.insert(0, os.path.join(_HERE, "tests"))
@@ -163,50 +240,66 @@ def main():
 
         from mpitree_tpu.utils.datasets import load_covtype
 
+        def load_and_split(n_rows):
+            """One split protocol for the primary row and every fallback."""
+            X, y, name = load_covtype(n_rows)
+            test_size = min(50_000, len(X) // 5)
+            Xtr, Xte, ytr, yte = train_test_split(
+                X, y, test_size=test_size, random_state=0
+            )
+            result["metric"] = (
+                f"{name} ({len(Xtr)}x{X.shape[1]}) depth-{DEPTH} tree build"
+            )
+            return X, Xtr, Xte, ytr, yte
+
         n_rows = N_ROWS if platform == "tpu" else N_ROWS_CPU_FALLBACK
-        X, y, name = load_covtype(n_rows)
-        test_size = min(50_000, len(X) // 5)
-        Xtr, Xte, ytr, yte = train_test_split(
-            X, y, test_size=test_size, random_state=0
-        )
-        result["metric"] = (
-            f"{name} ({len(Xtr)}x{X.shape[1]}) depth-{DEPTH} tree build"
-        )
+        X, Xtr, Xte, ytr, yte = load_and_split(n_rows)
 
         # --- ours: warm-timed depth-20 build --------------------------------
+        # TPU fits run in a bounded subprocess (a mid-fit tunnel hang blocks
+        # in native code where no signal can fire); a timeout or crash
+        # downgrades to the in-process C++ host tier on fewer rows.
         ours_s = None
         try:
-            from mpitree_tpu import DecisionTreeClassifier
+            worker = None
+            if platform == "tpu":
+                worker, tpu_err = run_tpu_fit(Xtr, ytr, Xte, yte)
+                if worker is None:
+                    errors["tpu_fit"] = (
+                        f"TPU fit subprocess failed ({tpu_err}); "
+                        f"falling back to the host tier"
+                    )
+                    # The parent has not touched a device yet (the probe and
+                    # fit ran in subprocesses) — pin the CPU platform before
+                    # predict-time jax ops can try the hung tunnel.
+                    import jax
 
-            # No TPU -> the C++ host tier (native/split_kernel.cpp), 20x+
-            # faster than XLA-on-CPU scatter at this scale.
-            backend = None if platform == "tpu" else "host"
+                    jax.config.update("jax_platforms", "cpu")
+                    platform = "cpu"
+                    detail["platform"] = "cpu (tpu fit fell back)"
+                    X, Xtr, Xte, ytr, yte = load_and_split(
+                        N_ROWS_CPU_FALLBACK
+                    )
 
-            def fit_once():
-                clf = DecisionTreeClassifier(
-                    max_depth=DEPTH, max_bins=256, backend=backend,
-                    refine_depth=REFINE_DEPTH,
+            if worker is None:
+                # No TPU -> the C++ host tier (native/split_kernel.cpp),
+                # 20x+ faster than XLA-on-CPU scatter at this scale.
+                worker = fit_and_summarize(
+                    Xtr, ytr, Xte, yte, backend="host"
                 )
-                t0 = time.perf_counter()
-                clf.fit(Xtr, ytr)
-                return time.perf_counter() - t0, clf
 
-            cold_s, _ = fit_once()
-            ours_s, clf = fit_once()
-            result["value"] = round(ours_s, 3)
-            detail["ours_cold_s"] = round(cold_s, 3)
-            detail["ours_test_acc"] = round(
-                float((clf.predict(Xte) == yte).mean()), 4
-            )
-            detail["tree_depth"] = clf.tree_.max_depth
-            detail["tree_n_nodes"] = clf.tree_.n_nodes
-            detail["refine_depth"] = clf.refine_depth
-            if clf.fit_stats_:
-                detail["phases"] = clf.fit_stats_
+            ours_s = worker["ours_s"]
+            result["value"] = ours_s
+            for k in ("ours_cold_s", "ours_test_acc", "tree_depth",
+                      "tree_n_nodes", "refine_depth"):
+                detail[k] = worker[k]
+            if worker.get("phases"):
+                detail["phases"] = worker["phases"]
+            tree_depth = worker["tree_depth"]
             # Effective throughput of the warm build: every level streams the
             # whole binned matrix once for the histogram pass.
             n_cells = len(Xtr) * X.shape[1]
-            levels = max(clf.tree_.max_depth, 1)
+            levels = max(tree_depth, 1)
             detail["throughput_cells_per_s"] = round(
                 n_cells * levels / ours_s
             )
@@ -252,4 +345,8 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--fit-worker":
+        os.environ["MPITREE_TPU_PROFILE"] = "1"
+        run_fit_worker(sys.argv[2])
+    else:
+        main()

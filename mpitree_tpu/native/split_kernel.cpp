@@ -107,15 +107,20 @@ template <class Fn>
 void run_slot_ranges(const std::vector<int64_t>& slot_start, int32_t n_slots,
                      Fn&& worker) {
   int nt = 0;
-  if (const char* env = std::getenv("MPITREE_TPU_NATIVE_THREADS"))
+  bool explicit_nt = false;
+  if (const char* env = std::getenv("MPITREE_TPU_NATIVE_THREADS")) {
     nt = std::atoi(env);
+    explicit_nt = nt > 0;
+  }
   if (nt <= 0) nt = (int)std::thread::hardware_concurrency();
   if (nt < 1) nt = 1;
   if (nt > n_slots) nt = n_slots;
   // Tiny levels (the host tier's single-digit-millisecond latency path)
   // must not pay thread spawn/join: their whole sweep costs less than one
-  // std::thread startup. Threshold in rows of actual work this call.
-  if (slot_start[n_slots] < (int64_t)1 << 15) nt = 1;
+  // std::thread startup. Threshold in rows of actual work this call. An
+  // explicit env request is honored regardless — tests rely on being able
+  // to force the threaded path on small inputs.
+  if (!explicit_nt && slot_start[n_slots] < (int64_t)1 << 15) nt = 1;
   if (nt <= 1) {
     worker(0, n_slots);
     return;
@@ -198,6 +203,22 @@ void best_splits_classification(
       if (w[r] != std::floor(w[r])) { int_w = false; break; }
   }
 
+  // Build the lookup table ONCE in the calling thread (its thread_local
+  // storage persists across calls, amortizing the fill); workers only read
+  // it. Freshly spawned threads would otherwise refill their own empty
+  // thread_local copy every level.
+  const double* shared_tab = nullptr;
+  int64_t tab_size = 0;
+  if (criterion == 0 && int_w) {
+    double total_live = 0.0;
+    for (int64_t i : rows_by_slot) total_live += w ? w[i] : 1.0;
+    // Clamp to the memory cap rather than disabling: above the cap only the
+    // few giant slots fall back to live log2; the deep tail's many small
+    // slots (where the sweep cost concentrates) still hit the table.
+    tab_size = std::min((int64_t)total_live + 1, kXlogxTabCap);
+    shared_tab = xlogx_tab_ensure(tab_size - 1);
+  }
+
   auto worker = [&](int32_t s_begin, int32_t s_end) {
   // Scratch reused across (node, feature) passes — one set per thread.
   std::vector<int32_t> touched_bins;                // occupied bins
@@ -241,8 +262,8 @@ void best_splits_classification(
     // mode: 0 = entropy via log2, 1 = gini, 2 = entropy via lookup table
     int mode = criterion;
     const double* tab = nullptr;
-    if (criterion == 0 && int_w && n_tot < (double)kXlogxTabCap) {
-      tab = xlogx_tab_ensure((int64_t)n_tot);
+    if (shared_tab && n_tot < (double)tab_size) {
+      tab = shared_tab;
       mode = 2;
     }
 

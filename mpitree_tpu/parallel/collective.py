@@ -80,13 +80,48 @@ def regression_y_range(y, nid, w, chunk_lo, *, n_slots, axis=DATA_AXIS):
     return lax.pmin(ymin, axis), lax.pmax(ymax, axis)
 
 
+def _pack_decision(dec) -> "jax.Array":
+    """SplitDecision -> one (K, 7 + C) float32 buffer.
+
+    The levelwise builder fetches the decision every level; a namedtuple
+    fetch is one host transfer per field (8 round trips on a tunneled
+    transport), a packed buffer is one. feature/bin/constant ride as f32 —
+    exact below 2^24, far above any bin or feature count.
+    """
+    head = jnp.stack(
+        [dec.feature.astype(jnp.float32), dec.bin.astype(jnp.float32),
+         dec.cost, dec.impurity, dec.n,
+         dec.constant.astype(jnp.float32), dec.y_range],
+        axis=1,
+    )
+    return jnp.concatenate([head, dec.counts.astype(jnp.float32)], axis=1)
+
+
+def unpack_decision(packed: "np.ndarray") -> dict:
+    """Host-side inverse of :func:`_pack_decision` (numpy dict)."""
+    import numpy as np
+
+    return {
+        "feature": packed[:, 0].astype(np.int32),
+        "bin": packed[:, 1].astype(np.int32),
+        "cost": packed[:, 2],
+        "impurity": packed[:, 3],
+        "n": packed[:, 4],
+        "constant": packed[:, 5] > 0,
+        "y_range": packed[:, 6],
+        "counts": packed[:, 7:],
+    }
+
+
 @lru_cache(maxsize=64)
 def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                   task: str, criterion: str, debug: bool = False,
                   use_pallas: bool = False):
-    """Jitted (x_binned, y, node_id, weight, cand_mask, chunk_lo) -> SplitDecision.
+    """Jitted (x_binned, y, node_id, weight, cand_mask, chunk_lo) -> packed
+    (n_slots, 7 + C) float32 decision buffer (see :func:`_pack_decision`,
+    :func:`unpack_decision`).
 
-    With ``debug=True`` the result is ``(SplitDecision, repl_err)`` where
+    With ``debug=True`` the result is ``(packed, repl_err)`` where
     ``repl_err`` must be 0: the determinism check that every device computed
     the identical split (SURVEY.md §5 race-detection analogue).
     ``use_pallas`` routes the classification histogram through the Mosaic
@@ -124,16 +159,15 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
             dec = dec._replace(y_range=y_range)
         if debug:
             fp = profiling.replication_fingerprint(dec.feature, dec.bin, dec.n)
-            return dec, profiling.assert_replicated(fp, DATA_AXIS)
-        return dec
+            return _pack_decision(dec), profiling.assert_replicated(fp, DATA_AXIS)
+        return _pack_decision(dec)
 
-    dec_specs = imp_ops.SplitDecision(*([P()] * 8))
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                   P(), P()),
-        out_specs=(dec_specs, P()) if debug else dec_specs,
+        out_specs=(P(), P()) if debug else P(),
     )
     return jax.jit(sharded)
 

@@ -67,6 +67,63 @@ def covtype_like(n_samples: int = 581012, seed: int = 0):
     return X, y
 
 
+def california_like(n_samples: int = 20640, seed: int = 0):
+    """Deterministic stand-in for California housing (n x 8, f64 target).
+
+    Mirrors the real dataset's structure (BASELINE config "DecisionTreeRegressor
+    (MSE split criterion) on California housing"): 8 quantitative features
+    with heterogeneous scales and a smooth nonlinear median-house-value
+    target with noise, so deep regression trees meaningfully outperform
+    shallow ones.
+    """
+    rng = np.random.default_rng(seed)
+    n = n_samples
+    med_inc = rng.gamma(2.5, 1.55, n)                 # median income
+    house_age = rng.uniform(1, 52, n)
+    ave_rooms = np.clip(rng.normal(5.4, 2.3, n), 1, None)
+    ave_bedrms = np.clip(ave_rooms / 5 + rng.normal(0, 0.2, n), 0.3, None)
+    population = rng.gamma(1.8, 790.0, n)
+    ave_occup = np.clip(rng.normal(3.0, 1.6, n), 0.7, None)
+    latitude = rng.uniform(32.5, 42.0, n)
+    longitude = rng.uniform(-124.3, -114.3, n)
+    X = np.column_stack(
+        [med_inc, house_age, ave_rooms, ave_bedrms, population, ave_occup,
+         latitude, longitude]
+    ).astype(np.float32)
+    coast = np.hypot(latitude - 34.0, longitude + 118.2)  # LA-ish anchor
+    y = (
+        0.45 * med_inc
+        + 0.7 * np.exp(-coast / 3.0)
+        + 0.004 * house_age
+        + 0.08 * np.log1p(ave_rooms)
+        - 0.12 * np.log1p(ave_occup)
+        + rng.normal(0, 0.35, n)
+    )
+    return X, np.clip(y, 0.15, 5.0).astype(np.float64)
+
+
+def load_california(n_samples: int | None = None, seed: int = 0):
+    """Real California housing when cached; california_like otherwise.
+
+    Returns (X, y, name).
+    """
+    try:
+        from sklearn.datasets import fetch_california_housing
+
+        d = fetch_california_housing(download_if_missing=False)
+        X = d.data.astype(np.float32)
+        y = d.target.astype(np.float64)
+        name = "california_housing"
+    except Exception:
+        X, y = california_like(20640 if n_samples is None else n_samples, seed)
+        name = "california_like"
+    if n_samples is not None and len(X) > n_samples:
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(len(X))[:n_samples]
+        X, y = X[idx], y[idx]
+    return X, y, name
+
+
 def load_covtype(n_samples: int | None = None, seed: int = 0):
     """Real covtype when a cached copy exists; covtype_like otherwise.
 

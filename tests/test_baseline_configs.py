@@ -1,0 +1,95 @@
+"""One test per BASELINE.json config — the driver's target capability list.
+
+Each config names an estimator + dataset; these tests run them end to end
+(offline stand-ins where the real dataset needs a download) and anchor
+accuracy against sklearn on the identical split.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+from sklearn.model_selection import train_test_split
+
+from mpitree_tpu import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    RandomForestClassifier,
+)
+from mpitree_tpu.utils.datasets import load_california, load_covtype
+
+
+def test_config1_entropy_iris_single_process():
+    """configs[0]: DecisionTreeClassifier (entropy) on sklearn iris."""
+    from sklearn.datasets import load_iris
+
+    X, y = load_iris(return_X_y=True)
+    clf = DecisionTreeClassifier(criterion="entropy", max_depth=5).fit(X, y)
+    assert clf.score(X, y) >= 0.99
+    assert clf.get_params()["criterion"] == "entropy"
+
+
+def test_config2_gini_pruning_digits():
+    """configs[1]: Gini + max_depth/min_samples_split pruning on digits."""
+    from sklearn.datasets import load_digits
+    from sklearn.tree import DecisionTreeClassifier as SkTree
+
+    X, y = load_digits(return_X_y=True)
+    Xtr, Xte, ytr, yte = train_test_split(X, y, random_state=0)
+    ours = DecisionTreeClassifier(
+        criterion="gini", max_depth=10, min_samples_split=4
+    ).fit(Xtr, ytr)
+    sk = SkTree(
+        criterion="gini", max_depth=10, min_samples_split=4, random_state=0
+    ).fit(Xtr, ytr)
+    # pruning rules actually bind
+    assert ours.get_depth() <= 10
+    assert (ours.tree_.n_node_samples[ours.tree_.feature >= 0] >= 4).all()
+    # accuracy parity with sklearn on the same split
+    assert ours.score(Xte, yte) >= sk.score(Xte, yte) - 0.03
+
+
+def test_config3_data_parallel_covtype_subsample(cpu_mesh_devices):
+    """configs[2]: data-parallel split search, 8 ranks -> 8-device mesh."""
+    X, y, _ = load_covtype(12000)
+    Xtr, Xte, ytr, yte = train_test_split(X, y, test_size=2000, random_state=0)
+    meshed = DecisionTreeClassifier(
+        max_depth=12, n_devices=len(cpu_mesh_devices)
+    ).fit(Xtr, ytr)
+    single = DecisionTreeClassifier(max_depth=12, n_devices=None).fit(Xtr, ytr)
+    assert meshed.export_text() == single.export_text()
+    assert (meshed.predict(Xte) == yte).mean() > 0.6
+
+
+def test_config4_regressor_mse_california():
+    """configs[3]: DecisionTreeRegressor (MSE) on California housing."""
+    from sklearn.tree import DecisionTreeRegressor as SkReg
+
+    X, y, name = load_california(12000)
+    Xtr, Xte, ytr, yte = train_test_split(X, y, test_size=2000, random_state=0)
+    ours = DecisionTreeRegressor(max_depth=10).fit(Xtr, ytr)
+    sk = SkReg(max_depth=10, random_state=0).fit(Xtr, ytr)
+    assert ours.score(Xte, yte) >= sk.score(Xte, yte) - 0.05
+    assert ours.score(Xte, yte) > 0.5
+
+
+def test_config5_forest_tree_sharded(cpu_mesh_devices):
+    """configs[4]: bagged forest, trees sharded across the device mesh."""
+    X, y, _ = load_covtype(6000)
+    Xtr, Xte, ytr, yte = train_test_split(X, y, test_size=1000, random_state=0)
+    n_dev = len(cpu_mesh_devices)
+    forest = RandomForestClassifier(
+        n_estimators=n_dev, max_depth=10, random_state=0, n_devices=n_dev
+    ).fit(Xtr, ytr)
+    single_tree = DecisionTreeClassifier(max_depth=10).fit(Xtr, ytr)
+    assert forest.score(Xte, yte) >= single_tree.score(Xte, yte) - 0.02
+
+
+@pytest.fixture
+def cpu_mesh_devices():
+    import jax
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        pytest.skip("needs the multi-device CPU mesh (tests/conftest.py)")
+    return devs
