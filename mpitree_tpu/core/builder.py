@@ -308,8 +308,14 @@ def build_tree(
     refit_targets: np.ndarray | None = None,
     timer: PhaseTimer | None = None,
     return_leaf_ids: bool = False,
+    feature_sampler=None,
 ) -> TreeArrays:
     """Grow one tree level-synchronously; returns host struct-of-arrays.
+
+    ``feature_sampler`` (:class:`ops.sampling.NodeFeatureSampler`, optional):
+    per-node random feature subsets, sklearn ``max_features`` semantics.
+    Runs on the levelwise engine (node keys thread through the host level
+    loop); incompatible with a (data, feature) mesh.
 
     ``refit_targets`` (regression only): f64 target vector used to recompute
     every node's value exactly from the final row assignments — the on-device
@@ -352,6 +358,16 @@ def build_tree(
         engine = os.environ.get("MPITREE_TPU_ENGINE", "auto")
     if engine not in ("auto", "fused", "levelwise"):
         raise ValueError(f"unknown build engine {engine!r}")
+    sampling = feature_sampler is not None and feature_sampler.active
+    if sampling:
+        # Per-node keys thread through the host level loop; the fused
+        # while_loop has no host between levels, so sampling pins levelwise.
+        if mesh_lib.feature_shards(mesh) > 1:
+            raise ValueError(
+                "per-node feature sampling is not supported on a "
+                "(data, feature) mesh"
+            )
+        engine = "levelwise"
     if mesh_lib.feature_shards(mesh) > 1:
         # Only an explicit config choice is an error; an env-sourced
         # levelwise (a steerable default) falls back to the one engine that
@@ -418,6 +434,10 @@ def build_tree(
     tree.ensure(1)
     tree.n = 1  # root
 
+    # Path-derived per-node keys (ops/sampling.py): the root hashes the
+    # tree seed, children hash the parent — engine-invariant.
+    keys = feature_sampler.key_store() if sampling else None
+
     K = _chunk_size(N, F, B, C, cfg)
     U = _table_slots(N, cfg)
     use_pallas = resolve_hist_kernel(
@@ -443,7 +463,16 @@ def build_tree(
         return S, collective.make_split_fn(
             mesh, n_slots=S, n_bins=B, n_classes=C, task=task,
             criterion=cfg.criterion, debug=debug, use_pallas=S in tiers,
+            node_mask=sampling,
         )
+
+    def split_args(lo, take, S_lvl):
+        """Positional tail of a split_fn call for the chunk at ``lo``."""
+        if not sampling:
+            return (np.int32(lo),)
+        nmask = np.ones((S_lvl, F), bool)
+        nmask[:take] = keys.masks(lo, lo + take)
+        return (np.int32(lo), nmask)
 
     update_fn = collective.make_update_fn(mesh, n_slots=U)
     counts_fn = collective.make_counts_fn(
@@ -474,12 +503,16 @@ def build_tree(
         else:
             with timer.phase("split"):
                 S_lvl, split_fn = split_fn_for(frontier_size)
+                hi = frontier_lo + frontier_size
+                chunks = [
+                    (lo, min(S_lvl, hi - lo))
+                    for lo in range(frontier_lo, hi, S_lvl)
+                ]
                 futures = [
-                    (min(S_lvl, frontier_lo + frontier_size - lo),
-                     split_fn(xb_d, y_d, nid_d, w_d, cand_mask_d, np.int32(lo)))
-                    for lo in range(
-                        frontier_lo, frontier_lo + frontier_size, S_lvl
-                    )
+                    (take,
+                     split_fn(xb_d, y_d, nid_d, w_d, cand_mask_d,
+                              *split_args(lo, take, S_lvl)))
+                    for lo, take in chunks
                 ]
                 if debug:
                     errs = [float(jax.device_get(e)) for _, (_, e) in futures]
@@ -543,6 +576,8 @@ def build_tree(
             lefts, rights = tree.alloc_children(split_ids.astype(np.int32), depth + 1)
             tree.left[split_ids] = lefts
             tree.right[split_ids] = rights
+            if sampling:
+                keys.assign_children(split_ids, lefts, rights, tree.n)
 
             # Phase C: advance on-device row assignments — one full-row pass
             # per U-slot table (normally one per level). Host tables ride the

@@ -82,20 +82,30 @@ def _child_cost_mse(hist):
 
 
 def _native_splits(xb, y, nid, sample_weight, binned, cfg, *, frontier_lo,
-                   n_slots, n_classes, task):
-    """Call the C++ sweep (native/__init__.py); None -> use numpy fallback."""
+                   n_slots, n_classes, task, node_mask=None):
+    """Call the C++ sweep (native/__init__.py); None -> use numpy fallback.
+
+    ``node_mask`` (n_slots, F) bool routes per-node feature sampling through
+    the kernel's per-slot candidate counts (masked features keep bin chains
+    for the occupancy stop but can never win).
+    """
     from mpitree_tpu import native
 
+    if node_mask is None:
+        n_cand, per_slot = binned.n_cand, False
+    else:
+        n_cand = np.where(node_mask, binned.n_cand[None, :], 0)
+        per_slot = True
     if task == "classification":
         return native.best_splits_classification(
             xb, y, nid, sample_weight, n_bins=binned.n_bins,
             n_classes=n_classes, frontier_lo=frontier_lo, n_slots=n_slots,
-            n_cand=binned.n_cand, criterion=cfg.criterion,
+            n_cand=n_cand, n_cand_per_slot=per_slot, criterion=cfg.criterion,
         )
     return native.best_splits_regression(
         xb, np.asarray(y, np.float32), nid, sample_weight,
         n_bins=binned.n_bins, frontier_lo=frontier_lo, n_slots=n_slots,
-        n_cand=binned.n_cand,
+        n_cand=n_cand, n_cand_per_slot=per_slot,
     )
 
 
@@ -218,8 +228,13 @@ def build_tree_host(
     sample_weight: np.ndarray | None = None,
     refit_targets: np.ndarray | None = None,
     return_leaf_ids: bool = False,
+    feature_sampler=None,
 ) -> TreeArrays:
-    """Grow one tree on the host; same contract as ``builder.build_tree``."""
+    """Grow one tree on the host; same contract as ``builder.build_tree``.
+
+    ``feature_sampler``: per-node random feature subsets (ops/sampling.py) —
+    identical node keys and masks to the device levelwise build.
+    """
     from mpitree_tpu.core.builder import _TreeBuffer  # shared node store
 
     cfg = config
@@ -249,9 +264,21 @@ def build_tree_host(
     tree.ensure(1)
     tree.n = 1
 
+    sampling = feature_sampler is not None and feature_sampler.active
+    keys = feature_sampler.key_store() if sampling else None
+
     nid = np.zeros(N, np.int32)
     rows_feat = np.broadcast_to(np.arange(F, dtype=np.intp)[None, :], (N, F))
     frontier_lo, frontier_size, depth = 0, 1, 0
+
+    def thread_keys(ids, stop):
+        """Hand child nodes their path-derived sampling keys."""
+        split_ids = ids[~stop]
+        if not sampling or not len(split_ids):
+            return
+        keys.assign_children(
+            split_ids, tree.left[split_ids], tree.right[split_ids], tree.n
+        )
 
     while frontier_size > 0:
         S = frontier_size
@@ -259,12 +286,19 @@ def build_tree_host(
         slot = nid - frontier_lo  # all rows are in the frontier or parked (<0)
         live = slot >= 0
 
+        # Terminal levels (the widest frontier) never split — skip the
+        # per-node mask hashing outright.
+        nmask = (
+            keys.masks(frontier_lo, frontier_lo + S)
+            if sampling and not terminal else None
+        )
         # Fast path: the native C++ sweep computes node stats and best splits
         # in O(rows + occupied bins) per node (native/split_kernel.cpp); the
         # numpy blocks below are the portable fallback.
         nat = None if terminal else _native_splits(
             xb, y, nid, sample_weight, binned, cfg,
             frontier_lo=frontier_lo, n_slots=S, n_classes=C, task=task,
+            node_mask=nmask,
         )
         if nat is not None:
             counts, n, value, node_imp, feat_best, bin_best, stop = (
@@ -279,6 +313,7 @@ def build_tree_host(
                 tree, binned, xb, nid, ids, stop, feat_best, bin_best,
                 slot, live, S, frontier_lo, depth,
             )
+            thread_keys(ids, stop)
             continue
 
         # Per-node statistics (and, unless terminal, full split histograms).
@@ -337,6 +372,8 @@ def build_tree_host(
                 cost, n_l, n_r = _child_cost_mse(hist)
 
             valid = cand[None, :, :] & (n_l > 0) & (n_r > 0)
+            if nmask is not None:
+                valid = valid & nmask[:, :, None]
             cost = np.where(valid, cost, np.inf)
             bin_f = cost.argmin(axis=2)  # first-min = lowest threshold
             cost_f = np.take_along_axis(cost, bin_f[:, :, None], axis=2)[:, :, 0]
@@ -365,6 +402,7 @@ def build_tree_host(
             tree, binned, xb, nid, ids, stop, feat_best, bin_best,
             slot, live, S, frontier_lo, depth,
         )
+        thread_keys(ids, stop)
 
     out = tree.finalize()
 

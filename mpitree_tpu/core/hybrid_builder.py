@@ -153,7 +153,7 @@ def _bin_per_root(Xr: np.ndarray, starts: np.ndarray, ends: np.ndarray):
 def _refine_batched(
     top: TreeArrays, X, y_enc, candidates, rows_per, *, cfg_sub,
     max_depth_total, root_depth, n_classes, sample_weight, refit_targets,
-    feature_mask=None,
+    feature_mask=None, feature_sampler=None, root_keys=None,
 ) -> TreeArrays:
     """Grow every deep subtree together in one multi-root host frontier.
 
@@ -222,6 +222,8 @@ def _refine_batched(
     buf.ensure(R)
     buf.n = R
     root_of = np.arange(R, dtype=np.int32)
+    sampling = feature_sampler is not None and feature_sampler.active
+    keys = feature_sampler.key_store(root_keys) if sampling else None
     root_depth = np.asarray(root_depth, np.int32)
     # Per-root budget of additional levels below its crown leaf.
     rem = (
@@ -252,6 +254,11 @@ def _refine_batched(
             break
 
         ncand_slot = np.ascontiguousarray(ncand[slot_roots])
+        if sampling:
+            # Per-node feature subsets: masked features cannot win.
+            ncand_slot = np.where(
+                keys.masks(frontier_lo, frontier_lo + S), ncand_slot, 0,
+            )
         if rem is not None:
             # Budget-exhausted roots' nodes become leaves this level no
             # matter what the sweep would say — zero their candidate counts
@@ -288,9 +295,16 @@ def _refine_batched(
             buf, None, xb, nid, ids, stop, feat_best, bin_best,
             slot, live, S, frontier_lo, depth, thr_values=thr_values,
         )
-        root_of = np.concatenate(
-            [root_of, np.repeat(slot_roots[~stop], 2)]
-        ) if n_split else root_of
+        if n_split:
+            root_of = np.concatenate(
+                [root_of, np.repeat(slot_roots[~stop], 2)]
+            )
+            if sampling:
+                split_ids = ids[~stop]
+                keys.assign_children(
+                    split_ids, buf.left[split_ids], buf.right[split_ids],
+                    buf.n,
+                )
 
     bt = buf.finalize()
     if task == "regression" and refit_targets is not None:
@@ -339,7 +353,7 @@ def _graft_batched(
 def apply_refine(
     tree, leaf_ids, X, y_build, *, cfg, max_depth, rd, timer,
     n_classes=None, sample_weight=None, refit_targets=None,
-    feature_mask=None,
+    feature_mask=None, feature_sampler=None,
 ):
     """Estimator-side entry: run the hybrid tail under the refine timer.
 
@@ -356,7 +370,7 @@ def apply_refine(
             config=dataclasses.replace(cfg, max_depth=max_depth),
             refine_depth=rd, n_classes=n_classes,
             sample_weight=sample_weight, refit_targets=refit_targets,
-            feature_mask=feature_mask,
+            feature_mask=feature_mask, feature_sampler=feature_sampler,
         )
 
 
@@ -372,6 +386,7 @@ def refine_deep_subtrees(
     sample_weight: np.ndarray | None = None,
     refit_targets: np.ndarray | None = None,
     feature_mask: np.ndarray | None = None,
+    feature_sampler=None,
 ) -> TreeArrays:
     """Host-finish every still-splittable leaf of the crown.
 
@@ -417,6 +432,11 @@ def refine_deep_subtrees(
         return tree
     candidates, starts, ends = candidates[keep], starts[keep], ends[keep]
 
+    sampling = feature_sampler is not None and feature_sampler.active
+    root_keys = (
+        feature_sampler.keys_for_tree(tree)[candidates] if sampling else None
+    )
+
     if native.lib() is not None:
         rows_per = [order[s:e] for s, e in zip(starts, ends)]
         return _refine_batched(
@@ -428,10 +448,11 @@ def refine_deep_subtrees(
             root_depth=tree.depth[candidates],
             n_classes=n_classes, sample_weight=sample_weight,
             refit_targets=refit_targets, feature_mask=feature_mask,
+            feature_sampler=feature_sampler, root_keys=root_keys,
         )
 
     subtrees, attach = [], []
-    for leaf, s, e in zip(candidates, starts, ends):
+    for idx, (leaf, s, e) in enumerate(zip(candidates, starts, ends)):
         rows = order[s:e]
         # No raw-count gate here: min_samples_split is a WEIGHTED rule and
         # the subtree build applies it itself (n_nodes <= 1 means it stopped).
@@ -449,9 +470,14 @@ def refine_deep_subtrees(
         if feature_mask is not None:
             n_cand = np.where(feature_mask, binned.n_cand, 0).astype(np.int32)
             binned = dataclasses.replace(binned, n_cand=n_cand)
+        sub_sampler = (
+            dataclasses.replace(
+                feature_sampler, root_key_value=int(root_keys[idx])
+            ) if sampling else None
+        )
         st = build_tree_host(
             binned, y_enc[rows], config=sub_cfg, n_classes=n_classes,
-            sample_weight=sw, refit_targets=rt,
+            sample_weight=sw, refit_targets=rt, feature_sampler=sub_sampler,
         )
         if st.n_nodes <= 1:
             continue  # immediately stopped: keep the original leaf

@@ -12,9 +12,15 @@ with data replicated per device, so T trees on D devices cost
 task-parallelism (``decision_tree.py:446-466``) reborn at ensemble
 granularity.
 
-``max_features`` implements per-tree random subspaces (a feature subset drawn
-per tree, masking split candidates); per-node sampling is a planned
-refinement and is documented as such.
+``max_features`` draws random feature subsets; ``max_features_mode``
+selects the granularity. ``"node"`` (default) is sklearn's granularity — a
+fresh subset at every node, via path-derived hash keys (``ops/sampling.py``)
+that make host and device builds grow identical trees; unlike sklearn, a
+node whose subset admits no valid split becomes a leaf (LightGBM's
+``feature_fraction_bynode`` rule — see ``ops/sampling.py``). ``"tree"``
+draws one subset per tree (cheaper: those trees batch into the fused
+tree-sharded program; node-sampled trees build per tree on the levelwise
+engine, whose host level loop threads the node keys).
 """
 
 from __future__ import annotations
@@ -36,6 +42,7 @@ from mpitree_tpu.core.builder import (
 from mpitree_tpu.core.fused_builder import build_forest_fused
 from mpitree_tpu.core.host_builder import build_tree_host
 from mpitree_tpu.ops.binning import bin_dataset
+from mpitree_tpu.ops.sampling import NodeFeatureSampler
 from mpitree_tpu.ops.predict import WeakIdCache, predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.utils.validation import (
@@ -71,7 +78,8 @@ def _n_subspace_features(max_features, n_features: int) -> int:
 class _BaseForest(BaseEstimator):
     def __init__(self, *, n_estimators=10, max_depth=None, min_samples_split=2,
                  max_bins=256, binning="auto", bootstrap=True,
-                 max_features=None, random_state=None, n_devices=None,
+                 max_features=None, max_features_mode="node",
+                 random_state=None, n_devices=None,
                  backend=None, refine_depth="auto"):
         self.n_estimators = n_estimators
         self.max_depth = max_depth
@@ -80,6 +88,7 @@ class _BaseForest(BaseEstimator):
         self.binning = binning
         self.bootstrap = bootstrap
         self.max_features = max_features
+        self.max_features_mode = max_features_mode
         self.random_state = random_state
         self.n_devices = n_devices
         self.backend = backend
@@ -104,10 +113,20 @@ class _BaseForest(BaseEstimator):
             min_samples_split=self.min_samples_split,
         )
         k = _n_subspace_features(self.max_features, X.shape[1])
+        if self.max_features_mode not in ("node", "tree"):
+            raise ValueError(
+                f"max_features_mode must be 'node' or 'tree', "
+                f"got {self.max_features_mode!r}"
+            )
+        # sklearn semantics: a fresh feature subset at every NODE
+        # (ops/sampling.py). Node keys thread through the host-orchestrated
+        # level loops, so node-sampled trees build per tree, not in the
+        # fused tree-sharded program.
+        node_mode = self.max_features_mode == "node" and k < X.shape[1]
 
         trees = []
         leaf_ids = []  # per tree, only kept when the hybrid tail runs
-        tree_w, tree_mask = [], []
+        tree_w, tree_mask, tree_sampler = [], [], []
         weights, masks = [], []
         for _ in range(self.n_estimators):
             # Bootstrap multiplicities compose multiplicatively with any
@@ -118,7 +137,13 @@ class _BaseForest(BaseEstimator):
                 w = boot if w is None else boot * w
             b = binned
             fmask = None
-            if k < X.shape[1]:
+            sampler = None
+            if node_mode:
+                sampler = NodeFeatureSampler(
+                    k=k, n_features=X.shape[1],
+                    seed=int(rng.integers(2**32)),
+                )
+            elif k < X.shape[1]:
                 keep = np.sort(rng.choice(X.shape[1], size=k, replace=False))
                 fmask = np.zeros(X.shape[1], bool)
                 fmask[keep] = True
@@ -127,22 +152,25 @@ class _BaseForest(BaseEstimator):
                 b = dataclasses.replace(binned, n_cand=n_cand)
             tree_w.append(w)
             tree_mask.append(fmask)
+            tree_sampler.append(sampler)
             if use_host:
                 res = build_tree_host(
                     b, y_enc, config=cfg, n_classes=n_classes,
                     sample_weight=w, refit_targets=refit_targets,
-                    return_leaf_ids=refine,
+                    return_leaf_ids=refine, feature_sampler=sampler,
                 )
                 trees.append(res[0] if refine else res)
                 if refine:
                     leaf_ids.append(res[1])
-            elif self._per_tree_device_builds():
-                # levelwise engine / debug mode: per-tree builds keep the
-                # instrumentation and determinism checks build_tree wires up.
+            elif node_mode or self._per_tree_device_builds():
+                # levelwise engine / debug mode / per-node sampling:
+                # per-tree builds keep the instrumentation, determinism
+                # checks, and node-key threading build_tree wires up.
                 res = build_tree(
                     b, y_enc, config=cfg, mesh=mesh,
                     n_classes=n_classes, sample_weight=w,
                     refit_targets=refit_targets, return_leaf_ids=refine,
+                    feature_sampler=sampler,
                 )
                 trees.append(res[0] if refine else res)
                 if refine:
@@ -174,9 +202,11 @@ class _BaseForest(BaseEstimator):
                     t, ids, X, y_enc, cfg=cfg, max_depth=self.max_depth,
                     rd=rd, timer=timer, n_classes=n_classes,
                     sample_weight=w, refit_targets=refit_targets,
-                    feature_mask=fm,
+                    feature_mask=fm, feature_sampler=sm,
                 )
-                for t, ids, w, fm in zip(trees, leaf_ids, tree_w, tree_mask)
+                for t, ids, w, fm, sm in zip(
+                    trees, leaf_ids, tree_w, tree_mask, tree_sampler
+                )
             ]
         return trees
 
@@ -258,20 +288,23 @@ class _BaseForest(BaseEstimator):
 class RandomForestClassifier(ClassifierMixin, _BaseForest):
     """Bagged classification forest (soft voting over per-tree class counts).
 
-    ``max_features`` draws the subspace **per tree** (not per node as
-    sklearn does), which weakens individual trees far more aggressively —
-    so the default is ``None`` (pure bagging, every tree sees all
-    features), matching the BASELINE target ("bagged random forest").
+    ``max_features=None`` (default) is pure bagging — the BASELINE target
+    ("bagged random forest"). Set e.g. ``max_features="sqrt"`` for sklearn's
+    per-node random subsets (``max_features_mode="node"``), or
+    ``max_features_mode="tree"`` for whole-tree subspaces (those trees
+    batch into the fused tree-sharded device program).
     """
 
     def __init__(self, *, n_estimators=10, criterion="entropy", max_depth=None,
                  min_samples_split=2, max_bins=256, binning="auto",
-                 bootstrap=True, max_features=None, random_state=None,
+                 bootstrap=True, max_features=None, max_features_mode="node",
+                 random_state=None,
                  n_devices=None, backend=None, refine_depth="auto"):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
             binning=binning, bootstrap=bootstrap, max_features=max_features,
+            max_features_mode=max_features_mode,
             random_state=random_state, n_devices=n_devices, backend=backend,
             refine_depth=refine_depth,
         )
@@ -310,12 +343,14 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
 
     def __init__(self, *, n_estimators=10, max_depth=None,
                  min_samples_split=2, max_bins=256, binning="auto",
-                 bootstrap=True, max_features=None, random_state=None,
+                 bootstrap=True, max_features=None, max_features_mode="node",
+                 random_state=None,
                  n_devices=None, backend=None, refine_depth="auto"):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
             binning=binning, bootstrap=bootstrap, max_features=max_features,
+            max_features_mode=max_features_mode,
             random_state=random_state, n_devices=n_devices, backend=backend,
             refine_depth=refine_depth,
         )

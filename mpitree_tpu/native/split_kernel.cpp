@@ -101,26 +101,29 @@ void bucket_rows(const int32_t* node_id, const double* w, int64_t n_rows,
 // synchronization and no effect on results (tie-breaks are within-slot).
 // Ranges are row-balanced via the slot_start prefix sums: at the root level
 // one slot can hold every row, and an even slot split would leave all but
-// one thread idle. MPITREE_TPU_NATIVE_THREADS overrides the default
-// (hardware concurrency); 1 disables threading.
+// one thread idle. MPITREE_TPU_NATIVE_THREADS caps the thread count
+// (default: hardware concurrency; 1 disables threading); a NEGATIVE value
+// forces |value| threads even below the small-work threshold — a test
+// hook, so the cap semantics never cost users the tiny-fit latency path.
 template <class Fn>
 void run_slot_ranges(const std::vector<int64_t>& slot_start, int32_t n_slots,
                      Fn&& worker) {
   int nt = 0;
-  bool explicit_nt = false;
+  bool force = false;
   if (const char* env = std::getenv("MPITREE_TPU_NATIVE_THREADS")) {
     nt = std::atoi(env);
-    explicit_nt = nt > 0;
+    if (nt < 0) {
+      nt = -nt;
+      force = true;
+    }
   }
   if (nt <= 0) nt = (int)std::thread::hardware_concurrency();
   if (nt < 1) nt = 1;
   if (nt > n_slots) nt = n_slots;
   // Tiny levels (the host tier's single-digit-millisecond latency path)
   // must not pay thread spawn/join: their whole sweep costs less than one
-  // std::thread startup. Threshold in rows of actual work this call. An
-  // explicit env request is honored regardless — tests rely on being able
-  // to force the threaded path on small inputs.
-  if (!explicit_nt && slot_start[n_slots] < (int64_t)1 << 15) nt = 1;
+  // std::thread startup. Threshold in rows of actual work this call.
+  if (!force && slot_start[n_slots] < (int64_t)1 << 15) nt = 1;
   if (nt <= 1) {
     worker(0, n_slots);
     return;

@@ -83,7 +83,8 @@ def class_impurity(counts: jax.Array, n: jax.Array, criterion: str) -> jax.Array
 
 
 def best_split_classification(
-    hist: jax.Array, cand_mask: jax.Array, *, criterion: str = "entropy"
+    hist: jax.Array, cand_mask: jax.Array, *, criterion: str = "entropy",
+    node_mask: jax.Array | None = None,
 ) -> SplitDecision:
     """Pick the best (feature, bin) per frontier slot from a class histogram.
 
@@ -93,6 +94,9 @@ def best_split_classification(
         (bins last for TPU lane alignment).
     cand_mask : (F, B) bool — valid candidate bins (from
         :meth:`BinnedData.candidate_mask`).
+    node_mask : (K, F) bool, optional — per-node allowed features
+        (``ops/sampling.py``); masked features cannot win but still feed
+        the ``constant`` occupancy stop, matching the host tiers.
     """
     # Memory-lean formulation: materializing left/right (K,F,B,C) cumsums and
     # per-side impurity stacks peaks at ~18 histogram-sized buffers under the
@@ -131,6 +135,8 @@ def best_split_classification(
     cost = (n_l * h_l + n_r * h_r) / jnp.maximum(n_tot, 1.0)
 
     valid = cand_mask[None, :, :] & (n_l > 0) & (n_r > 0)
+    if node_mask is not None:
+        valid = valid & node_mask[:, :, None]
     cost = jnp.where(valid, cost, jnp.inf)
 
     best_bin_f = jnp.argmin(cost, axis=2)  # (K, F) first-min = lowest threshold
@@ -158,7 +164,10 @@ def best_split_classification(
     )
 
 
-def best_split_regression(hist: jax.Array, cand_mask: jax.Array) -> SplitDecision:
+def best_split_regression(
+    hist: jax.Array, cand_mask: jax.Array,
+    node_mask: jax.Array | None = None,
+) -> SplitDecision:
     """Pick the best MSE split per frontier slot from a moment histogram.
 
     Parameters
@@ -185,6 +194,8 @@ def best_split_regression(hist: jax.Array, cand_mask: jax.Array) -> SplitDecisio
     cost = (sse(w_l, s_l, q_l) + sse(w_r, s_r, q_r)) / n
 
     valid = cand_mask[None, :, :] & (w_l > 0) & (w_r > 0)
+    if node_mask is not None:
+        valid = valid & node_mask[:, :, None]
     cost = jnp.where(valid, cost, jnp.inf)
 
     best_bin_f = jnp.argmin(cost, axis=2)

@@ -1,0 +1,156 @@
+"""Per-node random feature subsampling — sklearn's ``max_features`` granularity.
+
+The reference has no ensembles; sklearn's random forests draw a fresh
+feature subset at every *node*. Reproducing that under this framework's
+engine-identity contract (host numpy build == device build, any mesh size)
+needs randomness that is a pure function of tree structure, not of engine
+or visitation order.
+
+One deliberate divergence from sklearn: a node whose k sampled features
+admit no valid split becomes a leaf — sklearn keeps drawing features past
+``max_features`` until it finds a valid partition. The no-redraw rule is
+LightGBM's ``feature_fraction_bynode`` semantics, and it is what a
+batched level-synchronous search can evaluate in one pass.
+
+Scheme:
+
+- every node carries a uint32 **key**; the root key hashes the tree seed,
+  and children hash the parent key with side-distinct constants — keys are
+  derived from the node's *path*, so any engine that walks the same tree
+  computes the same keys;
+- the node's feature subset is the first ``k`` entries of a permutation of
+  features, obtained by a stable argsort of per-(node, feature) hash
+  scores. ``numpy`` (host tier, level loops) and ``jnp`` (a future fused
+  in-jit variant) implement the identical uint32 arithmetic.
+
+The hash is the 32-bit PCG output permutation (``pcg_hash``) — cheap,
+well-avalanched, and exactly reproducible in wrap-around uint32 arithmetic
+everywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+_MULT = np.uint32(747796405)
+_INC = np.uint32(2891336453)
+_FIN = np.uint32(277803737)
+_LEFT_SALT = np.uint32(0x9E3779B9)
+_RIGHT_SALT = np.uint32(0xC2B2AE35)
+_FEAT_SALT = np.uint32(0x85EBCA6B)
+
+
+def pcg_hash(x: np.ndarray) -> np.ndarray:
+    """Vectorized PCG-XSH-RR style u32 -> u32 hash (wrap-around arithmetic)."""
+    with np.errstate(over="ignore"):
+        x = (x.astype(np.uint32) * _MULT + _INC).astype(np.uint32)
+        shift = ((x >> np.uint32(28)) + np.uint32(4)).astype(np.uint32)
+        word = (((x >> shift) ^ x) * _FIN).astype(np.uint32)
+        return ((word >> np.uint32(22)) ^ word).astype(np.uint32)
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeFeatureSampler:
+    """Draws the per-node feature subset; engines thread keys alongside nodes.
+
+    Parameters
+    ----------
+    k : int
+        Features allowed per node (``1 <= k <= n_features``).
+    n_features : int
+    seed : int
+        Tree-level seed (a forest derives one per tree).
+    """
+
+    k: int
+    n_features: int
+    seed: int
+    root_key_value: int | None = None  # subtree builds start mid-path
+
+    @property
+    def active(self) -> bool:
+        return self.k < self.n_features
+
+    def root_key(self) -> np.uint32:
+        if self.root_key_value is not None:
+            return np.uint32(self.root_key_value)
+        return pcg_hash(np.uint32(self.seed & 0xFFFFFFFF))
+
+    def child_keys(self, parent_keys: np.ndarray):
+        """(left_keys, right_keys) for an array of parent keys."""
+        p = parent_keys.astype(np.uint32)
+        return pcg_hash(p ^ _LEFT_SALT), pcg_hash(p ^ _RIGHT_SALT)
+
+    def node_masks(self, keys: np.ndarray) -> np.ndarray:
+        """(S,) keys -> (S, F) bool — True on the node's k allowed features.
+
+        Stable ascending argsort of per-(node, feature) hash scores; the
+        first k positions of the permutation win. Stability makes hash
+        collisions resolve to the lowest feature index identically in every
+        implementation.
+        """
+        f = np.arange(self.n_features, dtype=np.uint32)
+        with np.errstate(over="ignore"):
+            scores = pcg_hash(
+                keys.astype(np.uint32)[:, None]
+                ^ ((f[None, :] + np.uint32(1)) * _FEAT_SALT).astype(np.uint32)
+            )
+        order = np.argsort(scores, axis=1, kind="stable")
+        mask = np.zeros((len(keys), self.n_features), bool)
+        np.put_along_axis(mask, order[:, : self.k], True, axis=1)
+        return mask
+
+    def key_store(self, root_keys=None) -> "KeyStore":
+        return KeyStore(self, root_keys)
+
+    def keys_for_tree(self, tree) -> np.ndarray:
+        """Recompute every node's key from tree structure (parents first).
+
+        Lets the hybrid refine seed its subtree roots with the crown
+        leaves' keys — structural paths, not build order, define keys, so
+        any engine that grew the same crown agrees.
+        """
+        keys = np.zeros(tree.n_nodes, np.uint32)
+        keys[0] = self.root_key()
+        for i in range(tree.n_nodes):
+            li, ri = int(tree.left[i]), int(tree.right[i])
+            if li >= 0:
+                lk, rk = self.child_keys(keys[i:i + 1])
+                keys[li] = lk[0]
+                keys[ri] = rk[0]
+        return keys
+
+
+class KeyStore:
+    """Growable per-node key array — the ONE key-threading bookkeeping.
+
+    Every level-loop engine (device levelwise, host numpy/C++, batched
+    refine) threads keys through this store so the engine-identity contract
+    cannot be broken by divergent hand-rolled copies.
+    """
+
+    def __init__(self, sampler: NodeFeatureSampler, root_keys=None):
+        self._sampler = sampler
+        if root_keys is None:
+            self.keys = np.zeros(256, np.uint32)
+            self.keys[0] = sampler.root_key()
+        else:
+            self.keys = np.asarray(root_keys, np.uint32).copy()
+
+    def slice(self, lo: int, hi: int) -> np.ndarray:
+        return self.keys[lo:hi]
+
+    def masks(self, lo: int, hi: int) -> np.ndarray:
+        return self._sampler.node_masks(self.keys[lo:hi])
+
+    def assign_children(self, parent_ids, left_ids, right_ids, n_total: int):
+        """Hand children their path-derived keys (growing the store)."""
+        if n_total > len(self.keys):
+            grown = np.zeros(max(n_total, 2 * len(self.keys)), np.uint32)
+            grown[: len(self.keys)] = self.keys
+            self.keys = grown
+        lk, rk = self._sampler.child_keys(self.keys[parent_ids])
+        self.keys[left_ids] = lk
+        self.keys[right_ids] = rk

@@ -116,18 +116,21 @@ def unpack_decision(packed: "np.ndarray") -> dict:
 @lru_cache(maxsize=64)
 def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                   task: str, criterion: str, debug: bool = False,
-                  use_pallas: bool = False):
-    """Jitted (x_binned, y, node_id, weight, cand_mask, chunk_lo) -> packed
-    (n_slots, 7 + C) float32 decision buffer (see :func:`_pack_decision`,
-    :func:`unpack_decision`).
+                  use_pallas: bool = False, node_mask: bool = False):
+    """Jitted (x_binned, y, node_id, weight, cand_mask, chunk_lo[, nmask])
+    -> packed (n_slots, 7 + C) float32 decision buffer (see
+    :func:`_pack_decision`, :func:`unpack_decision`).
 
     With ``debug=True`` the result is ``(packed, repl_err)`` where
     ``repl_err`` must be 0: the determinism check that every device computed
     the identical split (SURVEY.md §5 race-detection analogue).
     ``use_pallas`` routes the classification histogram through the Mosaic
-    one-hot-matmul kernel (callers gate on platform/VMEM/integer weights)."""
+    one-hot-matmul kernel (callers gate on platform/VMEM/integer weights).
+    ``node_mask=True`` adds a trailing (n_slots, F) bool input of per-node
+    allowed features (sklearn per-node ``max_features``; ops/sampling.py)."""
 
-    def local_step(xb, y, nid, w, cand_mask, chunk_lo):
+    def local_step(xb, y, nid, w, cand_mask, chunk_lo, *nm):
+        nmask = nm[0] if nm else None
         if task == "classification":
             if use_pallas:
                 from mpitree_tpu.ops import pallas_hist as ph
@@ -144,14 +147,16 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                     sample_weight=w,
                 )
             h = lax.psum(h, DATA_AXIS)
-            dec = imp_ops.best_split_classification(h, cand_mask, criterion=criterion)
+            dec = imp_ops.best_split_classification(
+                h, cand_mask, criterion=criterion, node_mask=nmask
+            )
         else:
             h = hist_ops.moment_histogram(
                 xb, y, nid, chunk_lo, n_slots=n_slots, n_bins=n_bins,
                 sample_weight=w,
             )
             h = lax.psum(h, DATA_AXIS)
-            dec = imp_ops.best_split_regression(h, cand_mask)
+            dec = imp_ops.best_split_regression(h, cand_mask, node_mask=nmask)
             ymin, ymax = regression_y_range(
                 y, nid, w, chunk_lo, n_slots=n_slots
             )
@@ -162,11 +167,14 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
             return _pack_decision(dec), profiling.assert_replicated(fp, DATA_AXIS)
         return _pack_decision(dec)
 
+    in_specs = (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                P(), P())
+    if node_mask:
+        in_specs = in_specs + (P(),)
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
-                  P(), P()),
+        in_specs=in_specs,
         out_specs=(P(), P()) if debug else P(),
     )
     return jax.jit(sharded)

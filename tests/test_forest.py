@@ -64,6 +64,7 @@ def test_forest_regressor_improves_over_noise():
 def test_max_features_subspace():
     X, y = _noisy_classification(300)
     f = RandomForestClassifier(n_estimators=3, max_depth=3, max_features=2,
+                               max_features_mode="tree",
                                random_state=0).fit(X, y)
     # each tree saw only 2 candidate features
     for t in f.trees_:
@@ -79,7 +80,7 @@ def test_max_features_respected_through_refine_tail():
     X, y = _noisy_classification(400)
     f = RandomForestClassifier(
         n_estimators=4, max_depth=6, max_features=1, max_bins=8,
-        refine_depth=2, random_state=0,
+        max_features_mode="tree", refine_depth=2, random_state=0,
     ).fit(X, y)
     for t in f.trees_:
         used = set(t.feature[t.feature >= 0].tolist())
@@ -87,7 +88,7 @@ def test_max_features_respected_through_refine_tail():
     # deterministic under the same seed
     g = RandomForestClassifier(
         n_estimators=4, max_depth=6, max_features=1, max_bins=8,
-        refine_depth=2, random_state=0,
+        max_features_mode="tree", refine_depth=2, random_state=0,
     ).fit(X, y)
     np.testing.assert_array_equal(f.predict(X), g.predict(X))
 
@@ -101,9 +102,11 @@ def test_forest_sample_weight_has_effect():
     # feature, so class weights can actually shift their leaf majorities
     f = RandomForestClassifier(n_estimators=5, max_depth=3, random_state=0,
                                bootstrap=False, max_features="sqrt",
+                               max_features_mode="tree",
                                ).fit(X, y, sample_weight=w)
     base = RandomForestClassifier(n_estimators=5, max_depth=3, random_state=0,
                                   bootstrap=False, max_features="sqrt",
+                                  max_features_mode="tree",
                                   ).fit(X, y)
     assert (f.predict(X) == 1).mean() > (base.predict(X) == 1).mean()
 
@@ -177,3 +180,89 @@ def test_batched_forest_regression_with_refit():
         assert np.isfinite(t.count[:, 0]).all()
         assert (t.impurity >= 0).all()
         assert t.n_nodes > 1
+
+
+def test_node_mode_feature_sampling():
+    """sklearn-semantics max_features: a fresh subset at every NODE.
+
+    A k=1 node-mode tree must still reach many distinct features (each node
+    draws its own), host and device engines must grow identical trees from
+    the identical path-derived keys, and the same seed must reproduce."""
+    from mpitree_tpu.core.builder import BuildConfig, build_tree
+    from mpitree_tpu.core.host_builder import build_tree_host
+    from mpitree_tpu.ops.binning import bin_dataset
+    from mpitree_tpu.ops.sampling import NodeFeatureSampler
+    from mpitree_tpu.parallel import mesh as mesh_lib
+
+    X, y = _noisy_classification(600)
+    y32 = y.astype(np.int32)
+    binned = bin_dataset(X, max_bins=32)
+    cfg = BuildConfig(
+        task="classification", criterion="entropy", max_depth=8,
+        min_samples_split=2,
+    )
+    sam = NodeFeatureSampler(k=3, n_features=10, seed=42)
+    th = build_tree_host(binned, y32, config=cfg, n_classes=2,
+                         feature_sampler=sam)
+    td = build_tree(
+        binned, y32, config=cfg, mesh=mesh_lib.resolve_mesh(n_devices=8),
+        n_classes=2, feature_sampler=sam,
+    )
+    np.testing.assert_array_equal(th.feature, td.feature)
+    np.testing.assert_allclose(th.threshold, td.threshold, rtol=0, atol=0)
+    # per-node draws: far more distinct features than any single subset
+    assert len(set(th.feature[th.feature >= 0].tolist())) > 3
+
+
+def test_node_mode_forest_beats_per_tree_subspaces():
+    """Per-node draws keep every tree strong; per-tree draws starve trees
+    that never see an informative feature."""
+    X, y = _noisy_classification(800)
+    Xtr, Xte, ytr, yte = train_test_split(X, y, random_state=0)
+    node = RandomForestClassifier(
+        n_estimators=15, max_depth=8, max_features="sqrt",
+        max_features_mode="node", random_state=0,
+    ).fit(Xtr, ytr)
+    tree_mode = RandomForestClassifier(
+        n_estimators=15, max_depth=8, max_features="sqrt",
+        max_features_mode="tree", random_state=0,
+    ).fit(Xtr, ytr)
+    assert node.score(Xte, yte) >= tree_mode.score(Xte, yte)
+    # deterministic under the same seed
+    again = RandomForestClassifier(
+        n_estimators=15, max_depth=8, max_features="sqrt",
+        max_features_mode="node", random_state=0,
+    ).fit(Xtr, ytr)
+    np.testing.assert_array_equal(node.predict(Xte), again.predict(Xte))
+
+
+def test_node_mode_with_refine_tail_valid():
+    """Node-sampled trees survive the hybrid refine: masks follow the
+    path-derived keys into the exact-candidate tail."""
+    X, y = _noisy_classification(500, seed=9)
+    f = RandomForestClassifier(
+        n_estimators=3, max_depth=8, max_features=3, max_bins=8,
+        max_features_mode="node", refine_depth=2, random_state=1,
+    ).fit(X, y)
+    assert f.score(X, y) > 0.7
+    for t in f.trees_:
+        interior = t.feature >= 0
+        assert (t.n_node_samples[interior] >= 2).all()
+        # graft validity: children after parents, partition sums hold
+        for i in np.flatnonzero(interior):
+            li, ri = int(t.left[i]), int(t.right[i])
+            assert li > i and ri > i
+            assert (
+                t.n_node_samples[li] + t.n_node_samples[ri]
+                == t.n_node_samples[i]
+            )
+
+
+def test_node_mode_mask_invalid_value():
+    import pytest
+
+    X, y = _noisy_classification(200)
+    with pytest.raises(ValueError):
+        RandomForestClassifier(
+            n_estimators=2, max_features=2, max_features_mode="bogus"
+        ).fit(X, y)

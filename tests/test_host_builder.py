@@ -147,7 +147,8 @@ def test_native_kernel_thread_count_does_not_change_trees():
         "sys.stdout.write(clf.export_text())\n"
     )
     texts = []
-    for threads in ("1", "4"):
+    # negative value = force threading below the small-work threshold
+    for threads in ("1", "-4"):
         env = dict(os.environ, MPITREE_TPU_NATIVE_THREADS=threads)
         env.pop("PYTEST_CURRENT_TEST", None)
         out = subprocess.run(
