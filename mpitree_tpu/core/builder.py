@@ -367,6 +367,19 @@ def build_tree(
                 "per-node feature sampling is not supported on a "
                 "(data, feature) mesh"
             )
+        if cfg.engine == "fused":
+            raise ValueError(
+                "engine='fused' cannot run per-node feature sampling; "
+                "use engine='auto' or 'levelwise' with max_features"
+            )
+        if engine == "fused":  # env-sourced default: downgrade with a signal
+            import warnings
+
+            warnings.warn(
+                "MPITREE_TPU_ENGINE=fused ignored with per-node feature "
+                "sampling; using the levelwise engine",
+                stacklevel=2,
+            )
         engine = "levelwise"
     if mesh_lib.feature_shards(mesh) > 1:
         # Only an explicit config choice is an error; an env-sourced

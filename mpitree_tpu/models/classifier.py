@@ -68,6 +68,12 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         Candidate-threshold cap per feature in quantile binning.
     binning : {"auto", "exact", "quantile"}, default="auto"
         "exact" reproduces the reference's every-unique-value candidate set.
+    max_features : int, float, "sqrt", "log2", or None, default=None
+        Per-node random feature subsets, sklearn's grammar
+        (``ops/sampling.py``; LightGBM-style no-redraw rule).
+    random_state : int, optional
+        Seed for ``max_features`` draws; fits are deterministic either way
+        (``None`` reads as seed 0).
     n_devices : int, "all", or None, default=None
         Data-mesh width; ``None`` = single device.
     backend : str, optional
@@ -90,12 +96,15 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
 
     def __init__(self, *, max_depth=None, min_samples_split=2,
                  criterion="entropy", max_bins=256, binning="auto",
+                 max_features=None, random_state=None,
                  n_devices=None, backend=None, refine_depth="auto"):
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
         self.criterion = criterion
         self.max_bins = max_bins
         self.binning = binning
+        self.max_features = max_features
+        self.random_state = random_state
         self.n_devices = n_devices
         self.backend = backend
         self.refine_depth = refine_depth
@@ -122,11 +131,17 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
             max_depth=crown_depth,
             min_samples_split=self.min_samples_split,
         )
+        from mpitree_tpu.ops.sampling import sampler_for
+
+        sampler = sampler_for(
+            self.max_features, self.random_state, X.shape[1]
+        )
         if host:
             with timer.phase("host_build"):
                 res = build_tree_host(
                     binned, y_enc, config=cfg, n_classes=len(classes),
                     sample_weight=sw, return_leaf_ids=refine,
+                    feature_sampler=sampler,
                 )
                 self.tree_, leaf_ids = res if refine else (res, None)
         else:
@@ -136,6 +151,7 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
             res = build_tree(
                 binned, y_enc, config=cfg, mesh=mesh, n_classes=len(classes),
                 sample_weight=sw, timer=timer, return_leaf_ids=refine,
+                feature_sampler=sampler,
             )
             # The build maintains row->leaf ids on device; fetching them here
             # spares the refine a second full-matrix descent (and X upload).
@@ -147,6 +163,7 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
                 self.tree_, leaf_ids, X, y_enc, cfg=cfg,
                 max_depth=self.max_depth, rd=rd, timer=timer,
                 n_classes=len(classes), sample_weight=sw,
+                feature_sampler=sampler,
             )
         self.fit_stats_ = timer.summary() if timer.enabled else None
         return self
@@ -226,10 +243,12 @@ class ParallelDecisionTreeClassifier(DecisionTreeClassifier):
 
     def __init__(self, *, max_depth=None, min_samples_split=2,
                  criterion="entropy", max_bins=256, binning="auto",
+                 max_features=None, random_state=None,
                  n_devices="all", backend=None, refine_depth="auto"):
         super().__init__(
             max_depth=max_depth, min_samples_split=min_samples_split,
             criterion=criterion, max_bins=max_bins, binning=binning,
+            max_features=max_features, random_state=random_state,
             n_devices=n_devices, backend=backend, refine_depth=refine_depth,
         )
 

@@ -26,7 +26,6 @@ engine, whose host level loop threads the node keys).
 from __future__ import annotations
 
 import dataclasses
-import math
 
 import jax
 import numpy as np
@@ -42,7 +41,7 @@ from mpitree_tpu.core.builder import (
 from mpitree_tpu.core.fused_builder import build_forest_fused
 from mpitree_tpu.core.host_builder import build_tree_host
 from mpitree_tpu.ops.binning import bin_dataset
-from mpitree_tpu.ops.sampling import NodeFeatureSampler
+from mpitree_tpu.ops.sampling import NodeFeatureSampler, n_subspace_features
 from mpitree_tpu.ops.predict import WeakIdCache, predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.utils.validation import (
@@ -61,18 +60,6 @@ class _TreeList(list):
     (plain lists cannot be weak-referenced)."""
 
     __slots__ = ("__weakref__",)
-
-
-def _n_subspace_features(max_features, n_features: int) -> int:
-    if max_features is None:
-        return n_features
-    if max_features == "sqrt":
-        return max(1, int(math.sqrt(n_features)))
-    if max_features == "log2":
-        return max(1, int(math.log2(n_features)))
-    if isinstance(max_features, float):
-        return max(1, int(max_features * n_features))
-    return max(1, min(int(max_features), n_features))
 
 
 class _BaseForest(BaseEstimator):
@@ -112,7 +99,7 @@ class _BaseForest(BaseEstimator):
             task=task, criterion=criterion, max_depth=crown_depth,
             min_samples_split=self.min_samples_split,
         )
-        k = _n_subspace_features(self.max_features, X.shape[1])
+        k = n_subspace_features(self.max_features, X.shape[1])
         if self.max_features_mode not in ("node", "tree"):
             raise ValueError(
                 f"max_features_mode must be 'node' or 'tree', "

@@ -47,12 +47,15 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
 
     def __init__(self, *, max_depth=None, min_samples_split=2,
                  criterion="squared_error", max_bins=256, binning="auto",
+                 max_features=None, random_state=None,
                  n_devices=None, backend=None, refine_depth="auto"):
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
         self.criterion = criterion
         self.max_bins = max_bins
         self.binning = binning
+        self.max_features = max_features
+        self.random_state = random_state
         self.n_devices = n_devices
         self.backend = backend
         self.refine_depth = refine_depth
@@ -83,11 +86,17 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
             min_samples_split=self.min_samples_split,
         )
         y_c = (y64 - y_mean).astype(np.float32)
+        from mpitree_tpu.ops.sampling import sampler_for
+
+        sampler = sampler_for(
+            self.max_features, self.random_state, X.shape[1]
+        )
         if host:
             with timer.phase("host_build"):
                 res = build_tree_host(
                     binned, y_c, config=cfg, sample_weight=sw,
                     refit_targets=y64, return_leaf_ids=refine,
+                    feature_sampler=sampler,
                 )
                 self.tree_, leaf_ids = res if refine else (res, None)
         else:
@@ -97,6 +106,7 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
             res = build_tree(
                 binned, y_c, config=cfg, mesh=mesh, sample_weight=sw,
                 refit_targets=y64, timer=timer, return_leaf_ids=refine,
+                feature_sampler=sampler,
             )
             # Row->leaf ids come straight off the build's device state; a
             # second full-matrix descent would re-upload X for nothing.
@@ -108,6 +118,7 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
                 self.tree_, leaf_ids, X, y_c, cfg=cfg,
                 max_depth=self.max_depth, rd=rd, timer=timer,
                 sample_weight=sw, refit_targets=y64,
+                feature_sampler=sampler,
             )
         self.fit_stats_ = timer.summary() if timer.enabled else None
         return self

@@ -34,6 +34,35 @@ import dataclasses
 
 import numpy as np
 
+def sampler_for(max_features, random_state, n_features: int):
+    """Estimator-side constructor: sampler for the params, or None.
+
+    sklearn's single-tree estimators accept the same ``max_features``
+    grammar; ``random_state=None`` reads as seed 0 — this framework never
+    fits nondeterministically.
+    """
+    k = n_subspace_features(max_features, n_features)
+    if k >= n_features:
+        return None
+    seed = 0 if random_state is None else int(random_state)
+    return NodeFeatureSampler(k=k, n_features=n_features, seed=seed)
+
+
+def n_subspace_features(max_features, n_features: int) -> int:
+    """sklearn's ``max_features`` grammar -> a concrete subset size k."""
+    import math
+
+    if max_features is None:
+        return n_features
+    if max_features == "sqrt":
+        return max(1, int(math.sqrt(n_features)))
+    if max_features == "log2":
+        return max(1, int(math.log2(n_features)))
+    if isinstance(max_features, float):
+        return max(1, int(max_features * n_features))
+    return max(1, min(int(max_features), n_features))
+
+
 _MULT = np.uint32(747796405)
 _INC = np.uint32(2891336453)
 _FIN = np.uint32(277803737)
@@ -114,12 +143,15 @@ class NodeFeatureSampler:
         """
         keys = np.zeros(tree.n_nodes, np.uint32)
         keys[0] = self.root_key()
-        for i in range(tree.n_nodes):
-            li, ri = int(tree.left[i]), int(tree.right[i])
-            if li >= 0:
-                lk, rk = self.child_keys(keys[i:i + 1])
-                keys[li] = lk[0]
-                keys[ri] = rk[0]
+        # Breadth-first over depth levels: every level's parents hash in one
+        # vectorized call (parents always precede children in id order).
+        for d in range(int(tree.depth.max(initial=0)) + 1):
+            parents = np.flatnonzero((tree.depth == d) & (tree.left >= 0))
+            if not len(parents):
+                continue
+            lk, rk = self.child_keys(keys[parents])
+            keys[tree.left[parents]] = lk
+            keys[tree.right[parents]] = rk
         return keys
 
 

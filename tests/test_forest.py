@@ -266,3 +266,23 @@ def test_node_mode_mask_invalid_value():
         RandomForestClassifier(
             n_estimators=2, max_features=2, max_features_mode="bogus"
         ).fit(X, y)
+
+
+def test_single_tree_max_features():
+    """Single-tree estimators accept sklearn's max_features grammar —
+    per-node subsets, deterministic per random_state, engine-identical."""
+    X, y = _noisy_classification(500, seed=2)
+    a = DecisionTreeClassifier(
+        max_depth=7, max_features="sqrt", random_state=5, backend="cpu"
+    ).fit(X, y)
+    b = DecisionTreeClassifier(
+        max_depth=7, max_features="sqrt", random_state=5, backend="host"
+    ).fit(X, y)
+    assert a.export_text() == b.export_text()
+    # per-node draws reach more features than one sqrt-sized subset
+    used = set(a.tree_.feature[a.tree_.feature >= 0].tolist())
+    assert len(used) > 3
+    c = DecisionTreeClassifier(
+        max_depth=7, max_features="sqrt", random_state=6, backend="cpu"
+    ).fit(X, y)
+    assert a.export_text() != c.export_text()  # seed matters
