@@ -226,7 +226,43 @@ def main():
     s = timed(expand_onehot, xb_small)
     report("onehot_expand_int8", s, (N // 8) * F * B, "G cmp/s")
 
+    host_tier(report, n=min(args.n, 200_000))
+
     print(json.dumps({"bench": "ALL", "results": len(results)}))
+
+
+def host_tier(report, n: int):
+    """C++ host-tier primitives: the incremental sweep and the hybrid tail."""
+    from mpitree_tpu import DecisionTreeClassifier, native
+    from mpitree_tpu.utils.datasets import load_covtype
+
+    if native.lib() is None:
+        print(json.dumps({"bench": "host_tier", "skipped": "no g++"}))
+        return
+    X, y, _ = load_covtype(n)
+    F = X.shape[1]
+
+    for criterion in ("entropy", "gini"):
+        clf = DecisionTreeClassifier(
+            max_depth=12, max_bins=256, backend="host", refine_depth=None,
+            criterion=criterion,
+        )
+        t0 = time.perf_counter()
+        clf.fit(X, y)
+        dt = time.perf_counter() - t0
+        # ~rows*features of sweep work per level
+        report(
+            f"host_cpp_sweep_{criterion}", dt,
+            n * F * max(clf.tree_.max_depth, 1), "G cell/s",
+        )
+
+    clf = DecisionTreeClassifier(
+        max_depth=20, max_bins=256, backend="host", refine_depth=8,
+    )
+    t0 = time.perf_counter()
+    clf.fit(X, y)
+    dt = time.perf_counter() - t0
+    report("host_hybrid_depth20", dt, n * F * 20, "G cell/s")
 
 
 if __name__ == "__main__":

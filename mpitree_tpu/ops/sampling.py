@@ -34,33 +34,76 @@ import dataclasses
 
 import numpy as np
 
+def seed_from(random_state) -> int:
+    """Accept sklearn's random_state idioms: None, int, Generator, RandomState.
+
+    ``None`` reads as seed 0 — this framework never fits
+    nondeterministically.
+    """
+    if random_state is None:
+        return 0
+    if isinstance(random_state, np.random.Generator):
+        return int(random_state.integers(2**32))
+    if isinstance(random_state, np.random.RandomState):
+        return int(random_state.randint(2**32))
+    try:
+        return int(random_state)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"random_state must be None, an int, or a numpy "
+            f"Generator/RandomState, got {random_state!r}"
+        ) from None
+
+
 def sampler_for(max_features, random_state, n_features: int):
     """Estimator-side constructor: sampler for the params, or None.
 
     sklearn's single-tree estimators accept the same ``max_features``
-    grammar; ``random_state=None`` reads as seed 0 — this framework never
-    fits nondeterministically.
+    grammar.
     """
     k = n_subspace_features(max_features, n_features)
     if k >= n_features:
         return None
-    seed = 0 if random_state is None else int(random_state)
-    return NodeFeatureSampler(k=k, n_features=n_features, seed=seed)
+    return NodeFeatureSampler(
+        k=k, n_features=n_features, seed=seed_from(random_state)
+    )
 
 
 def n_subspace_features(max_features, n_features: int) -> int:
-    """sklearn's ``max_features`` grammar -> a concrete subset size k."""
+    """sklearn's ``max_features`` grammar -> a concrete subset size k.
+
+    Invalid values raise (as sklearn's do) rather than silently disabling
+    or over-tightening the sampling.
+    """
     import math
+    import numbers
 
     if max_features is None:
         return n_features
-    if max_features == "sqrt":
-        return max(1, int(math.sqrt(n_features)))
-    if max_features == "log2":
-        return max(1, int(math.log2(n_features)))
-    if isinstance(max_features, float):
+    if isinstance(max_features, str):
+        if max_features == "sqrt":
+            return max(1, int(math.sqrt(n_features)))
+        if max_features == "log2":
+            return max(1, int(math.log2(n_features)))
+        raise ValueError(
+            f"max_features must be 'sqrt', 'log2', an int, a float in "
+            f"(0, 1], or None, got {max_features!r}"
+        )
+    if isinstance(max_features, numbers.Real) and not isinstance(
+        max_features, numbers.Integral
+    ):
+        if not 0.0 < max_features <= 1.0:
+            raise ValueError(
+                f"float max_features must be in (0, 1], got {max_features!r}"
+            )
         return max(1, int(max_features * n_features))
-    return max(1, min(int(max_features), n_features))
+    k = int(max_features)
+    if not 0 < k <= n_features:
+        raise ValueError(
+            f"int max_features must be in [1, n_features={n_features}], "
+            f"got {max_features!r}"
+        )
+    return k
 
 
 _MULT = np.uint32(747796405)

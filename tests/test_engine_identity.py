@@ -128,3 +128,37 @@ def test_regression_split_identity_across_engines(seed, monkeypatch):
             t.impurity, ref.impurity, rtol=0, atol=0,
             err_msg=f"{name} impurity (seed={seed})",
         )
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_node_sampling_identity_across_engines(seed):
+    """Per-node feature sampling: path-derived keys (ops/sampling.py) must
+    give bit-identical trees on the host C++ sweep, the numpy fallback, and
+    the device levelwise engine at every mesh size."""
+    from mpitree_tpu.ops.sampling import NodeFeatureSampler
+
+    rng, X = _integer_grid(seed + 300)
+    y = _class_labels(rng)
+    binned = bin_dataset(X, binning="exact")
+    cfg = BuildConfig(task="classification", criterion="entropy", max_depth=5)
+    sam = NodeFeatureSampler(k=2, n_features=F, seed=seed)
+
+    trees = {
+        "host": build_tree_host(
+            binned, y, config=cfg, n_classes=N_CLASSES, feature_sampler=sam
+        )
+    }
+    with pytest.MonkeyPatch.context() as mp:
+        _force_numpy_fallback(mp)
+        trees["host-numpy"] = build_tree_host(
+            binned, y, config=cfg, n_classes=N_CLASSES, feature_sampler=sam
+        )
+    for n_dev in MESH_SIZES:
+        trees[f"mesh{n_dev}"] = build_tree(
+            binned, y, config=cfg, n_classes=N_CLASSES,
+            mesh=mesh_lib.resolve_mesh(n_devices=n_dev), feature_sampler=sam,
+        )
+
+    ref = trees["host"]
+    for name, t in trees.items():
+        assert _structure(t) == _structure(ref), f"{name} (seed={seed})"

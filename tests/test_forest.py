@@ -286,3 +286,21 @@ def test_single_tree_max_features():
         max_depth=7, max_features="sqrt", random_state=6, backend="cpu"
     ).fit(X, y)
     assert a.export_text() != c.export_text()  # seed matters
+
+
+def test_max_features_validation_matches_sklearn_grammar():
+    import pytest
+
+    X, y = _noisy_classification(100)
+    for bad in (1.5, 0.0, 0, -3, 99, "bogus"):
+        with pytest.raises(ValueError):
+            DecisionTreeClassifier(max_depth=3, max_features=bad).fit(X, y)
+    # Generator/RandomState random_state idioms work
+    DecisionTreeClassifier(
+        max_depth=3, max_features="sqrt",
+        random_state=np.random.default_rng(0),
+    ).fit(X, y)
+    DecisionTreeClassifier(
+        max_depth=3, max_features="sqrt",
+        random_state=np.random.RandomState(0),
+    ).fit(X, y)
