@@ -26,6 +26,7 @@ engine, whose host level loop threads the node keys).
 from __future__ import annotations
 
 import dataclasses
+import warnings
 
 import jax
 import numpy as np
@@ -66,6 +67,7 @@ class _BaseForest(BaseEstimator):
     def __init__(self, *, n_estimators=10, max_depth=None, min_samples_split=2,
                  max_bins=256, binning="auto", bootstrap=True,
                  max_features=None, max_features_mode="node",
+                 oob_score=False,
                  random_state=None, n_devices=None,
                  backend=None, refine_depth="auto"):
         self.n_estimators = n_estimators
@@ -76,10 +78,26 @@ class _BaseForest(BaseEstimator):
         self.bootstrap = bootstrap
         self.max_features = max_features
         self.max_features_mode = max_features_mode
+        self.oob_score = oob_score
         self.random_state = random_state
         self.n_devices = n_devices
         self.backend = backend
         self.refine_depth = refine_depth
+
+    def _pop_oob_masks(self):
+        """Consume the fit-time bootstrap OOB masks (they must not persist —
+        they would pin n_estimators x n_samples of memory on the model)."""
+        masks = self._oob_masks
+        del self._oob_masks
+        return masks
+
+    @staticmethod
+    def _warn_no_oob() -> float:
+        warnings.warn(
+            "no out-of-bag rows (too few trees); oob_score_ is nan",
+            stacklevel=3,
+        )
+        return float("nan")
 
     def _fit_forest(self, X, y_enc, *, task, criterion, n_classes=None,
                     refit_targets=None, sample_weight=None):
@@ -111,16 +129,22 @@ class _BaseForest(BaseEstimator):
         # fused tree-sharded program.
         node_mode = self.max_features_mode == "node" and k < X.shape[1]
 
+        if self.oob_score and not self.bootstrap:
+            raise ValueError("oob_score=True requires bootstrap=True")
+
         trees = []
         leaf_ids = []  # per tree, only kept when the hybrid tail runs
         tree_w, tree_mask, tree_sampler = [], [], []
         weights, masks = [], []
+        self._oob_masks = [] if self.oob_score else None
         for _ in range(self.n_estimators):
             # Bootstrap multiplicities compose multiplicatively with any
             # user-provided per-sample weights.
             w = sample_weight
             if self.bootstrap:
                 boot = rng.multinomial(n, np.full(n, 1.0 / n)).astype(np.float32)
+                if self._oob_masks is not None:
+                    self._oob_masks.append(boot == 0)
                 w = boot if w is None else boot * w
             b = binned
             fmask = None
@@ -285,13 +309,13 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
     def __init__(self, *, n_estimators=10, criterion="entropy", max_depth=None,
                  min_samples_split=2, max_bins=256, binning="auto",
                  bootstrap=True, max_features=None, max_features_mode="node",
-                 random_state=None,
+                 oob_score=False, random_state=None,
                  n_devices=None, backend=None, refine_depth="auto"):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
             binning=binning, bootstrap=bootstrap, max_features=max_features,
-            max_features_mode=max_features_mode,
+            max_features_mode=max_features_mode, oob_score=oob_score,
             random_state=random_state, n_devices=n_devices, backend=backend,
             refine_depth=refine_depth,
         )
@@ -306,6 +330,29 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
             X, y_enc, task="classification", criterion=self.criterion,
             n_classes=len(classes), sample_weight=sample_weight,
         ))
+        if self.oob_score:
+            # Each row is scored only by trees whose bootstrap left it out —
+            # an unbiased generalization estimate without a held-out split.
+            votes = np.zeros((len(X), len(classes)))
+            seen = np.zeros(len(X), bool)
+            for (t, ids), oob in zip(self._leaf_ids(X), self._pop_oob_masks()):
+                counts = t.count[ids[oob]].astype(np.float64)
+                votes[oob] += counts / np.maximum(
+                    counts.sum(axis=1, keepdims=True), 1.0
+                )
+                seen |= oob
+            if not seen.any():
+                self.oob_score_ = self._warn_no_oob()
+                self.oob_decision_function_ = np.full(
+                    (len(X), len(classes)), np.nan
+                )
+            else:
+                self.oob_decision_function_ = votes / np.maximum(
+                    votes.sum(axis=1, keepdims=True), 1e-300
+                )
+                self.oob_score_ = float(
+                    (votes[seen].argmax(axis=1) == y_enc[seen]).mean()
+                )
         return self
 
     def predict_proba(self, X):
@@ -331,13 +378,13 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
     def __init__(self, *, n_estimators=10, max_depth=None,
                  min_samples_split=2, max_bins=256, binning="auto",
                  bootstrap=True, max_features=None, max_features_mode="node",
-                 random_state=None,
+                 oob_score=False, random_state=None,
                  n_devices=None, backend=None, refine_depth="auto"):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
             binning=binning, bootstrap=bootstrap, max_features=max_features,
-            max_features_mode=max_features_mode,
+            max_features_mode=max_features_mode, oob_score=oob_score,
             random_state=random_state, n_devices=n_devices, backend=backend,
             refine_depth=refine_depth,
         )
@@ -351,6 +398,23 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
             X, (y64 - self._y_mean).astype(np.float32), task="regression",
             criterion="mse", refit_targets=y64, sample_weight=sample_weight,
         ))
+        if self.oob_score:
+            pred = np.zeros(len(X))
+            cnt = np.zeros(len(X))
+            for (t, ids), oob in zip(self._leaf_ids(X), self._pop_oob_masks()):
+                pred[oob] += t.count[ids[oob], 0]
+                cnt[oob] += 1
+            seen = cnt > 0
+            if not seen.any():
+                self.oob_score_ = self._warn_no_oob()
+                self.oob_prediction_ = np.full(len(X), np.nan)
+            else:
+                self.oob_prediction_ = np.where(seen, pred / np.maximum(cnt, 1), np.nan)
+                resid = y64[seen] - self.oob_prediction_[seen]
+                tot = y64[seen] - y64[seen].mean()
+                self.oob_score_ = float(
+                    1.0 - (resid @ resid) / max(tot @ tot, 1e-300)
+                )
         return self
 
     def predict(self, X):

@@ -304,3 +304,31 @@ def test_max_features_validation_matches_sklearn_grammar():
         max_depth=3, max_features="sqrt",
         random_state=np.random.RandomState(0),
     ).fit(X, y)
+
+
+def test_oob_score_classifier():
+    """oob_score_ estimates generalization without a held-out split and
+    tracks the held-out accuracy."""
+    import pytest
+
+    X, y = _noisy_classification(800)
+    Xtr, Xte, ytr, yte = train_test_split(X, y, random_state=0)
+    f = RandomForestClassifier(
+        n_estimators=20, max_depth=8, oob_score=True, random_state=0
+    ).fit(Xtr, ytr)
+    assert 0.0 <= f.oob_score_ <= 1.0
+    assert abs(f.oob_score_ - f.score(Xte, yte)) < 0.12
+    assert f.oob_decision_function_.shape == (len(Xtr), 2)
+    with pytest.raises(ValueError):
+        RandomForestClassifier(oob_score=True, bootstrap=False).fit(Xtr, ytr)
+
+
+def test_oob_score_regressor():
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2, 2, size=(600, 6))
+    y = np.sin(X[:, 0]) * 2 + X[:, 1] + rng.normal(scale=0.3, size=600)
+    f = RandomForestRegressor(
+        n_estimators=20, max_depth=7, oob_score=True, random_state=0
+    ).fit(X, y)
+    assert 0.4 < f.oob_score_ <= 1.0
+    assert f.oob_prediction_.shape == (len(X),)
