@@ -46,6 +46,10 @@ class BuildConfig:
     criterion: str = "entropy"  # entropy | gini (classification), mse (regression)
     max_depth: Optional[int] = None
     min_samples_split: int = 2
+    # Absolute weight floor for each side of a split (the estimator computes
+    # it as min_weight_fraction_leaf * total fit weight, sklearn semantics);
+    # 0.0 = unconstrained.
+    min_child_weight: float = 0.0
     hist_budget_bytes: int = 4 << 30  # HBM budget for one histogram chunk
     max_frontier_chunk: int = 4096
     max_table_slots: int = 1 << 17  # width of per-level update/counts tables
@@ -476,7 +480,7 @@ def build_tree(
         return S, collective.make_split_fn(
             mesh, n_slots=S, n_bins=B, n_classes=C, task=task,
             criterion=cfg.criterion, debug=debug, use_pallas=S in tiers,
-            node_mask=sampling,
+            node_mask=sampling, min_child_weight=cfg.min_child_weight,
         )
 
     def split_args(lo, take, S_lvl):

@@ -72,6 +72,7 @@ def _node_capacity(n_samples: int, max_depth) -> int:
 def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                      task: str, criterion: str, max_nodes: int,
                      max_depth: int, min_samples_split: int,
+                     min_child_weight: float = 0.0,
                      tiers: tuple = (), use_pallas: bool = False,
                      psum_axis: str | None = DATA_AXIS,
                      feature_axis: str | None = None):
@@ -169,7 +170,8 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                     )
                 h = psum(h)
                 dec = select_global(imp_ops.best_split_classification(
-                    h, cand_mask, criterion=criterion
+                    h, cand_mask, criterion=criterion,
+                    min_child_weight=min_child_weight,
                 ))
                 pure = (dec.counts > 0).sum(axis=1) <= 1
             else:
@@ -178,7 +180,9 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                     n_bins=n_bins, sample_weight=w,
                 )
                 h = psum(h)
-                dec = select_global(imp_ops.best_split_regression(h, cand_mask))
+                dec = select_global(imp_ops.best_split_regression(
+                    h, cand_mask, min_child_weight=min_child_weight,
+                ))
                 ymin, ymax = regression_y_range(
                     y, nid, w, chunk_lo, n_slots=n_stat_slots, axis=psum_axis
                 )
@@ -341,8 +345,8 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
 @lru_cache(maxsize=32)
 def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                    task: str, criterion: str, max_nodes: int, max_depth: int,
-                   min_samples_split: int, tiers: tuple = (),
-                   use_pallas: bool = False):
+                   min_samples_split: int, min_child_weight: float = 0.0,
+                   tiers: tuple = (), use_pallas: bool = False):
     """Data-parallel single-tree build: rows sharded, histograms psum'd.
 
     Jitted (xb, y, nid0, w, cand_mask) -> (tree arrays..., nid, n_nodes);
@@ -357,7 +361,8 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     build = _make_build_body(
         n_slots=n_slots, n_bins=n_bins, n_classes=n_classes, task=task,
         criterion=criterion, max_nodes=max_nodes, max_depth=max_depth,
-        min_samples_split=min_samples_split, tiers=tiers,
+        min_samples_split=min_samples_split,
+        min_child_weight=min_child_weight, tiers=tiers,
         use_pallas=use_pallas, psum_axis=DATA_AXIS,
         feature_axis=feature_axis,
     )
@@ -378,6 +383,7 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
 def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                     task: str, criterion: str, max_nodes: int,
                     max_depth: int, min_samples_split: int,
+                    min_child_weight: float = 0.0,
                     tiers: tuple = (), use_pallas: bool = False):
     """Tree-parallel forest build: trees sharded over the mesh, data
     replicated per device (ensemble parallelism — BASELINE configs[4],
@@ -392,7 +398,8 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     build = _make_build_body(
         n_slots=n_slots, n_bins=n_bins, n_classes=n_classes, task=task,
         criterion=criterion, max_nodes=max_nodes, max_depth=max_depth,
-        min_samples_split=min_samples_split, tiers=tiers,
+        min_samples_split=min_samples_split,
+        min_child_weight=min_child_weight, tiers=tiers,
         use_pallas=use_pallas, psum_axis=None,
     )
 
@@ -447,6 +454,7 @@ def build_tree_fused(
         criterion=cfg.criterion, max_nodes=M,
         max_depth=-1 if cfg.max_depth is None else int(cfg.max_depth),
         min_samples_split=int(cfg.min_samples_split),
+        min_child_weight=float(cfg.min_child_weight),
         tiers=tuple(cfg.frontier_tiers),
         use_pallas=use_pallas,
     )
@@ -595,6 +603,7 @@ def build_forest_fused(
         criterion=cfg.criterion, max_nodes=M,
         max_depth=-1 if cfg.max_depth is None else int(cfg.max_depth),
         min_samples_split=int(cfg.min_samples_split),
+        min_child_weight=float(cfg.min_child_weight),
         tiers=tuple(cfg.frontier_tiers),
         use_pallas=use_pallas,
     )

@@ -101,11 +101,13 @@ def _native_splits(xb, y, nid, sample_weight, binned, cfg, *, frontier_lo,
             xb, y, nid, sample_weight, n_bins=binned.n_bins,
             n_classes=n_classes, frontier_lo=frontier_lo, n_slots=n_slots,
             n_cand=n_cand, n_cand_per_slot=per_slot, criterion=cfg.criterion,
+            min_child_weight=cfg.min_child_weight,
         )
     return native.best_splits_regression(
         xb, np.asarray(y, np.float32), nid, sample_weight,
         n_bins=binned.n_bins, frontier_lo=frontier_lo, n_slots=n_slots,
         n_cand=n_cand, n_cand_per_slot=per_slot,
+        min_child_weight=cfg.min_child_weight,
     )
 
 
@@ -372,6 +374,11 @@ def build_tree_host(
                 cost, n_l, n_r = _child_cost_mse(hist)
 
             valid = cand[None, :, :] & (n_l > 0) & (n_r > 0)
+            if cfg.min_child_weight > 0.0:
+                valid = valid & (
+                    (n_l >= cfg.min_child_weight)
+                    & (n_r >= cfg.min_child_weight)
+                )
             if nmask is not None:
                 valid = valid & nmask[:, :, None]
             cost = np.where(valid, cost, np.inf)

@@ -270,11 +270,13 @@ def _refine_batched(
                 xb, y_r, nid, w, n_bins=n_bins, n_classes=C,
                 frontier_lo=frontier_lo, n_slots=S, n_cand=ncand_slot,
                 n_cand_per_slot=True, criterion=cfg_sub.criterion,
+                min_child_weight=cfg_sub.min_child_weight,
             )
         else:
             nat = native.best_splits_regression(
                 xb, y_r, nid, w, n_bins=n_bins, frontier_lo=frontier_lo,
                 n_slots=S, n_cand=ncand_slot, n_cand_per_slot=True,
+                min_child_weight=cfg_sub.min_child_weight,
             )
         counts, n, value, node_imp, feat_best, bin_best, stop = (
             _native_level_decisions(nat, task=task, cfg=cfg_sub)

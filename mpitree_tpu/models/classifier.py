@@ -36,6 +36,8 @@ from mpitree_tpu.utils.export import export_tree_text
 from mpitree_tpu.utils.importances import feature_importances
 from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
 from mpitree_tpu.utils.validation import (
+    apply_class_weight,
+    min_child_weight,
     validate_fit_data,
     validate_predict_data,
     resolve_refine,
@@ -71,6 +73,12 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
     max_features : int, float, "sqrt", "log2", or None, default=None
         Per-node random feature subsets, sklearn's grammar
         (``ops/sampling.py``; LightGBM-style no-redraw rule).
+    class_weight : "balanced", dict, or None, default=None
+        sklearn-style class weighting, composed into the per-sample weights
+        feeding the weighted histograms (``utils/validation.py``).
+    min_weight_fraction_leaf : float, default=0.0
+        sklearn's leaf-weight floor: a split is invalid unless both sides
+        carry at least this fraction of the total fit weight.
     random_state : int, optional
         Seed for ``max_features`` draws; fits are deterministic either way
         (``None`` reads as seed 0).
@@ -96,7 +104,8 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
 
     def __init__(self, *, max_depth=None, min_samples_split=2,
                  criterion="entropy", max_bins=256, binning="auto",
-                 max_features=None, random_state=None,
+                 max_features=None, class_weight=None,
+                 min_weight_fraction_leaf=0.0, random_state=None,
                  n_devices=None, backend=None, refine_depth="auto"):
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
@@ -104,6 +113,8 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         self.max_bins = max_bins
         self.binning = binning
         self.max_features = max_features
+        self.class_weight = class_weight
+        self.min_weight_fraction_leaf = min_weight_fraction_leaf
         self.random_state = random_state
         self.n_devices = n_devices
         self.backend = backend
@@ -120,6 +131,7 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         with timer.phase("bin"):
             binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
         sw = validate_sample_weight(sample_weight, X.shape[0])
+        sw = apply_class_weight(self.class_weight, y_enc, classes, sw)
         host = prefer_host_path(*X.shape, self.n_devices, self.backend)
         rd, refine, crown_depth = resolve_refine(
             self.max_depth, self.refine_depth,
@@ -130,6 +142,9 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
             criterion=self.criterion,
             max_depth=crown_depth,
             min_samples_split=self.min_samples_split,
+            min_child_weight=min_child_weight(
+                self.min_weight_fraction_leaf, sw, X.shape[0]
+            ),
         )
         from mpitree_tpu.ops.sampling import sampler_for
 
@@ -243,12 +258,15 @@ class ParallelDecisionTreeClassifier(DecisionTreeClassifier):
 
     def __init__(self, *, max_depth=None, min_samples_split=2,
                  criterion="entropy", max_bins=256, binning="auto",
-                 max_features=None, random_state=None,
+                 max_features=None, class_weight=None,
+                 min_weight_fraction_leaf=0.0, random_state=None,
                  n_devices="all", backend=None, refine_depth="auto"):
         super().__init__(
             max_depth=max_depth, min_samples_split=min_samples_split,
             criterion=criterion, max_bins=max_bins, binning=binning,
-            max_features=max_features, random_state=random_state,
+            max_features=max_features, class_weight=class_weight,
+            min_weight_fraction_leaf=min_weight_fraction_leaf,
+            random_state=random_state,
             n_devices=n_devices, backend=backend, refine_depth=refine_depth,
         )
 

@@ -46,6 +46,8 @@ from mpitree_tpu.ops.sampling import NodeFeatureSampler, n_subspace_features
 from mpitree_tpu.ops.predict import WeakIdCache, predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.utils.validation import (
+    apply_class_weight,
+    min_child_weight,
     resolve_refine,
     validate_fit_data,
     validate_predict_data,
@@ -67,7 +69,7 @@ class _BaseForest(BaseEstimator):
     def __init__(self, *, n_estimators=10, max_depth=None, min_samples_split=2,
                  max_bins=256, binning="auto", bootstrap=True,
                  max_features=None, max_features_mode="node",
-                 oob_score=False,
+                 oob_score=False, min_weight_fraction_leaf=0.0,
                  random_state=None, n_devices=None,
                  backend=None, refine_depth="auto"):
         self.n_estimators = n_estimators
@@ -79,6 +81,7 @@ class _BaseForest(BaseEstimator):
         self.max_features = max_features
         self.max_features_mode = max_features_mode
         self.oob_score = oob_score
+        self.min_weight_fraction_leaf = min_weight_fraction_leaf
         self.random_state = random_state
         self.n_devices = n_devices
         self.backend = backend
@@ -92,6 +95,15 @@ class _BaseForest(BaseEstimator):
         return masks
 
     @staticmethod
+    def _warn_partial_oob(seen) -> None:
+        if not seen.all():
+            warnings.warn(
+                "Some inputs do not have OOB scores (too few trees); their "
+                "OOB estimates are NaN",
+                stacklevel=3,
+            )
+
+    @staticmethod
     def _warn_no_oob() -> float:
         warnings.warn(
             "no out-of-bag rows (too few trees); oob_score_ is nan",
@@ -102,6 +114,8 @@ class _BaseForest(BaseEstimator):
     def _fit_forest(self, X, y_enc, *, task, criterion, n_classes=None,
                     refit_targets=None, sample_weight=None):
         n = X.shape[0]
+        if self.oob_score and not self.bootstrap:
+            raise ValueError("oob_score=True requires bootstrap=True")
         sample_weight = validate_sample_weight(sample_weight, n)
         rng = np.random.default_rng(self.random_state)
         binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
@@ -116,6 +130,13 @@ class _BaseForest(BaseEstimator):
         cfg = BuildConfig(
             task=task, criterion=criterion, max_depth=crown_depth,
             min_samples_split=self.min_samples_split,
+            # fraction of the base fit weight (bootstrap preserves the
+            # total in expectation; sklearn recomputes per bootstrap —
+            # differences are O(1/sqrt(n)) and only matter at extreme
+            # fractions)
+            min_child_weight=min_child_weight(
+                self.min_weight_fraction_leaf, sample_weight, n
+            ),
         )
         k = n_subspace_features(self.max_features, X.shape[1])
         if self.max_features_mode not in ("node", "tree"):
@@ -128,9 +149,6 @@ class _BaseForest(BaseEstimator):
         # level loops, so node-sampled trees build per tree, not in the
         # fused tree-sharded program.
         node_mode = self.max_features_mode == "node" and k < X.shape[1]
-
-        if self.oob_score and not self.bootstrap:
-            raise ValueError("oob_score=True requires bootstrap=True")
 
         trees = []
         leaf_ids = []  # per tree, only kept when the hybrid tail runs
@@ -309,23 +327,30 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
     def __init__(self, *, n_estimators=10, criterion="entropy", max_depth=None,
                  min_samples_split=2, max_bins=256, binning="auto",
                  bootstrap=True, max_features=None, max_features_mode="node",
-                 oob_score=False, random_state=None,
+                 oob_score=False, class_weight=None,
+                 min_weight_fraction_leaf=0.0, random_state=None,
                  n_devices=None, backend=None, refine_depth="auto"):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
             binning=binning, bootstrap=bootstrap, max_features=max_features,
             max_features_mode=max_features_mode, oob_score=oob_score,
+            min_weight_fraction_leaf=min_weight_fraction_leaf,
             random_state=random_state, n_devices=n_devices, backend=backend,
             refine_depth=refine_depth,
         )
         self.criterion = criterion
+        self.class_weight = class_weight
 
     def fit(self, X, y, sample_weight=None):
         X, y_enc, classes = validate_fit_data(X, y, task="classification")
         self.n_features_ = X.shape[1]
         self.n_features_in_ = X.shape[1]
         self.classes_ = classes
+        sample_weight = apply_class_weight(
+            self.class_weight, y_enc, classes,
+            validate_sample_weight(sample_weight, X.shape[0]),
+        )
         self.trees_ = _TreeList(self._fit_forest(
             X, y_enc, task="classification", criterion=self.criterion,
             n_classes=len(classes), sample_weight=sample_weight,
@@ -347,9 +372,12 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
                     (len(X), len(classes)), np.nan
                 )
             else:
-                self.oob_decision_function_ = votes / np.maximum(
+                self._warn_partial_oob(seen)
+                df = votes / np.maximum(
                     votes.sum(axis=1, keepdims=True), 1e-300
                 )
+                df[~seen] = np.nan  # sklearn marks uncovered rows NaN
+                self.oob_decision_function_ = df
                 self.oob_score_ = float(
                     (votes[seen].argmax(axis=1) == y_enc[seen]).mean()
                 )
@@ -378,13 +406,15 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
     def __init__(self, *, n_estimators=10, max_depth=None,
                  min_samples_split=2, max_bins=256, binning="auto",
                  bootstrap=True, max_features=None, max_features_mode="node",
-                 oob_score=False, random_state=None,
+                 oob_score=False, min_weight_fraction_leaf=0.0,
+                 random_state=None,
                  n_devices=None, backend=None, refine_depth="auto"):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
             binning=binning, bootstrap=bootstrap, max_features=max_features,
             max_features_mode=max_features_mode, oob_score=oob_score,
+            min_weight_fraction_leaf=min_weight_fraction_leaf,
             random_state=random_state, n_devices=n_devices, backend=backend,
             refine_depth=refine_depth,
         )
@@ -409,6 +439,7 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
                 self.oob_score_ = self._warn_no_oob()
                 self.oob_prediction_ = np.full(len(X), np.nan)
             else:
+                self._warn_partial_oob(seen)
                 self.oob_prediction_ = np.where(seen, pred / np.maximum(cnt, 1), np.nan)
                 resid = y64[seen] - self.oob_prediction_[seen]
                 tot = y64[seen] - y64[seen].mean()

@@ -29,6 +29,7 @@ from mpitree_tpu.utils.export import export_tree_text
 from mpitree_tpu.utils.importances import feature_importances
 from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
 from mpitree_tpu.utils.validation import (
+    min_child_weight,
     validate_fit_data,
     validate_predict_data,
     resolve_refine,
@@ -47,7 +48,8 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
 
     def __init__(self, *, max_depth=None, min_samples_split=2,
                  criterion="squared_error", max_bins=256, binning="auto",
-                 max_features=None, random_state=None,
+                 max_features=None, min_weight_fraction_leaf=0.0,
+                 random_state=None,
                  n_devices=None, backend=None, refine_depth="auto"):
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
@@ -55,6 +57,7 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
         self.max_bins = max_bins
         self.binning = binning
         self.max_features = max_features
+        self.min_weight_fraction_leaf = min_weight_fraction_leaf
         self.random_state = random_state
         self.n_devices = n_devices
         self.backend = backend
@@ -84,6 +87,9 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
             criterion="mse",
             max_depth=crown_depth,
             min_samples_split=self.min_samples_split,
+            min_child_weight=min_child_weight(
+                self.min_weight_fraction_leaf, sw, X.shape[0]
+            ),
         )
         y_c = (y64 - y_mean).astype(np.float32)
         from mpitree_tpu.ops.sampling import sampler_for

@@ -96,13 +96,14 @@ def lib():
                 _i32p, _i32p, _i32p, ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
                 ctypes.c_int32, ctypes.c_int32, _i32p, ctypes.c_int32,
-                ctypes.c_int32, _i32p, _i32p, _f64p, _f64p, _u8p,
+                ctypes.c_int32, ctypes.c_double,
+                _i32p, _i32p, _f64p, _f64p, _u8p,
             ]
             cdll.best_splits_classification.restype = None
             cdll.best_splits_regression.argtypes = [
                 _i32p, _f32p, _i32p, ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-                ctypes.c_int32, _i32p, ctypes.c_int32,
+                ctypes.c_int32, _i32p, ctypes.c_int32, ctypes.c_double,
                 _i32p, _i32p, _f64p, _f64p, _u8p, _f64p, _f64p,
             ]
             cdll.best_splits_regression.restype = None
@@ -120,7 +121,7 @@ def _wptr(w: np.ndarray | None):
 
 def best_splits_classification(
     xb, y, node_id, w, *, n_bins, n_classes, frontier_lo, n_slots, n_cand,
-    criterion, n_cand_per_slot=False,
+    criterion, n_cand_per_slot=False, min_child_weight=0.0,
 ):
     """ctypes wrapper; returns dict of per-slot arrays (or None if no lib).
 
@@ -142,7 +143,7 @@ def best_splits_classification(
     cdll.best_splits_classification(
         xb, y, node_id, _wptr(w64), n_rows, n_feat, n_bins, n_classes,
         frontier_lo, n_slots, n_cand, 1 if n_cand_per_slot else 0,
-        0 if criterion == "entropy" else 1,
+        0 if criterion == "entropy" else 1, float(min_child_weight),
         out_feat, out_bin, out_cost, out_counts, out_constant,
     )
     return {
@@ -153,7 +154,7 @@ def best_splits_classification(
 
 def best_splits_regression(
     xb, yv, node_id, w, *, n_bins, frontier_lo, n_slots, n_cand,
-    n_cand_per_slot=False,
+    n_cand_per_slot=False, min_child_weight=0.0,
 ):
     cdll = lib()
     if cdll is None:
@@ -171,7 +172,7 @@ def best_splits_regression(
     cdll.best_splits_regression(
         xb, np.ascontiguousarray(yv, np.float32), node_id, _wptr(w64),
         n_rows, n_feat, n_bins, frontier_lo, n_slots, n_cand,
-        1 if n_cand_per_slot else 0,
+        1 if n_cand_per_slot else 0, float(min_child_weight),
         out_feat, out_bin, out_cost, out_counts, out_constant,
         out_ymin, out_ymax,
     )

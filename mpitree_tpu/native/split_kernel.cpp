@@ -190,8 +190,8 @@ void best_splits_classification(
     const double* w, int64_t n_rows, int32_t n_feat, int32_t n_bins,
     int32_t n_classes, int32_t frontier_lo, int32_t n_slots,
     const int32_t* n_cand, int32_t n_cand_per_slot, int32_t criterion,
-    int32_t* out_feat, int32_t* out_bin, double* out_cost, double* out_counts,
-    uint8_t* out_constant) {
+    double min_child_w, int32_t* out_feat, int32_t* out_bin, double* out_cost,
+    double* out_counts, uint8_t* out_constant) {
   const double inf = std::numeric_limits<double>::infinity();
 
   std::vector<int64_t> slot_start;
@@ -333,6 +333,7 @@ void best_splits_classification(
           if (b >= nc[f]) break;  // past the last valid candidate
           const double right_n = n_tot - left_n;
           if (left_n <= 0.0 || right_n <= 0.0) continue;
+          if (left_n < min_child_w || right_n < min_child_w) continue;
           double cost;
           if (mode == 1) {
             const double gl = left_n - left_sum / left_n;
@@ -369,9 +370,9 @@ void best_splits_regression(
     const int32_t* xb, const float* yv, const int32_t* node_id,
     const double* w, int64_t n_rows, int32_t n_feat, int32_t n_bins,
     int32_t frontier_lo, int32_t n_slots, const int32_t* n_cand,
-    int32_t n_cand_per_slot, int32_t* out_feat, int32_t* out_bin,
-    double* out_cost, double* out_counts, uint8_t* out_constant,
-    double* out_ymin, double* out_ymax) {
+    int32_t n_cand_per_slot, double min_child_w, int32_t* out_feat,
+    int32_t* out_bin, double* out_cost, double* out_counts,
+    uint8_t* out_constant, double* out_ymin, double* out_ymax) {
   const double inf = std::numeric_limits<double>::infinity();
 
   std::vector<int64_t> slot_start;
@@ -447,6 +448,7 @@ void best_splits_regression(
           if (b >= nc[f]) break;
           const double wr_ = n_tot - wl, sr = s_tot - sl, qr = q_tot - ql;
           if (wl <= 0.0 || wr_ <= 0.0) continue;
+          if (wl < min_child_w || wr_ < min_child_w) continue;
           const double sse_l = ql - sl * sl / wl;
           const double sse_r = qr - sr * sr / wr_;
           const double cost =

@@ -116,7 +116,8 @@ def unpack_decision(packed: "np.ndarray") -> dict:
 @lru_cache(maxsize=64)
 def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                   task: str, criterion: str, debug: bool = False,
-                  use_pallas: bool = False, node_mask: bool = False):
+                  use_pallas: bool = False, node_mask: bool = False,
+                  min_child_weight: float = 0.0):
     """Jitted (x_binned, y, node_id, weight, cand_mask, chunk_lo[, nmask])
     -> packed (n_slots, 7 + C) float32 decision buffer (see
     :func:`_pack_decision`, :func:`unpack_decision`).
@@ -148,7 +149,8 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                 )
             h = lax.psum(h, DATA_AXIS)
             dec = imp_ops.best_split_classification(
-                h, cand_mask, criterion=criterion, node_mask=nmask
+                h, cand_mask, criterion=criterion, node_mask=nmask,
+                min_child_weight=min_child_weight,
             )
         else:
             h = hist_ops.moment_histogram(
@@ -156,7 +158,10 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                 sample_weight=w,
             )
             h = lax.psum(h, DATA_AXIS)
-            dec = imp_ops.best_split_regression(h, cand_mask, node_mask=nmask)
+            dec = imp_ops.best_split_regression(
+                h, cand_mask, node_mask=nmask,
+                min_child_weight=min_child_weight,
+            )
             ymin, ymax = regression_y_range(
                 y, nid, w, chunk_lo, n_slots=n_slots
             )

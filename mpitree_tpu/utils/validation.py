@@ -51,6 +51,48 @@ def validate_sample_weight(sample_weight, n_samples: int):
     return w
 
 
+def min_child_weight(min_weight_fraction_leaf, sample_weight, n_samples):
+    """sklearn's min_weight_fraction_leaf -> an absolute per-child floor.
+
+    The fraction is of the TOTAL fit weight (sklearn semantics); 0.0 (the
+    default) disables the constraint.
+    """
+    frac = float(min_weight_fraction_leaf)
+    if not 0.0 <= frac <= 0.5:
+        raise ValueError(
+            f"min_weight_fraction_leaf must be in [0, 0.5], got {frac!r}"
+        )
+    if frac == 0.0:
+        return 0.0
+    total = float(n_samples) if sample_weight is None else float(
+        np.sum(sample_weight)
+    )
+    return frac * total
+
+
+def apply_class_weight(class_weight, y_enc, classes, sample_weight):
+    """Compose sklearn-style ``class_weight`` into per-sample weights.
+
+    Delegates to ``sklearn.utils.class_weight.compute_sample_weight`` (the
+    exact routine sklearn's own trees use — "balanced" formula, dict over
+    ORIGINAL labels with missing labels defaulting to 1, sklearn's
+    validation errors). Returns float32 weights, or ``sample_weight``
+    unchanged when ``class_weight`` is None.
+    """
+    if class_weight is None:
+        return sample_weight
+    from sklearn.utils.class_weight import compute_sample_weight
+
+    try:
+        cw = compute_sample_weight(
+            class_weight, np.asarray(classes)[y_enc]
+        ).astype(np.float32)
+    except (ValueError, TypeError) as e:
+        # normalize sklearn's InvalidParameterError variants to ValueError
+        raise ValueError(f"invalid class_weight: {e}") from e
+    return cw if sample_weight is None else cw * sample_weight
+
+
 def validate_predict_data(X, n_features: int, name: str = "estimator"):
     X = check_array(X, dtype="numeric")
     if X.shape[1] != n_features:

@@ -332,3 +332,61 @@ def test_oob_score_regressor():
     ).fit(X, y)
     assert 0.4 < f.oob_score_ <= 1.0
     assert f.oob_prediction_.shape == (len(X),)
+
+
+def test_class_weight_balanced_and_dict():
+    """class_weight composes into the weighted histograms: 'balanced' lifts
+    the minority class; a dict maps ORIGINAL labels (sklearn grammar)."""
+    import pytest
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(600, 5))
+    y = (X[:, 0] + rng.normal(scale=2.0, size=600) > 1.2).astype(int)  # ~12% ones
+    plain = DecisionTreeClassifier(max_depth=4).fit(X, y)
+    bal = DecisionTreeClassifier(max_depth=4, class_weight="balanced").fit(X, y)
+    # balanced weighting must raise minority recall
+    rec = lambda m: (m.predict(X[y == 1]) == 1).mean()  # noqa: E731
+    assert rec(bal) > rec(plain)
+    # dict grammar on original labels; unknown keys raise
+    DecisionTreeClassifier(max_depth=3, class_weight={0: 1.0, 1: 5.0}).fit(X, y)
+    with pytest.raises(ValueError):
+        DecisionTreeClassifier(class_weight={7: 2.0}).fit(X, y)
+    with pytest.raises(ValueError):
+        DecisionTreeClassifier(class_weight="bogus").fit(X, y)
+    f = RandomForestClassifier(
+        n_estimators=5, max_depth=4, class_weight="balanced", random_state=0
+    ).fit(X, y)
+    assert rec(f) > rec(plain)
+
+
+def test_min_weight_fraction_leaf():
+    """Every leaf must carry >= frac * total weight; identical across
+    engines; validated range."""
+    import pytest
+
+    X, y = _noisy_classification(600)
+    frac = 0.05
+    a = DecisionTreeClassifier(
+        max_depth=10, min_weight_fraction_leaf=frac, backend="host"
+    ).fit(X, y)
+    b = DecisionTreeClassifier(
+        max_depth=10, min_weight_fraction_leaf=frac, backend="cpu"
+    ).fit(X, y)
+    assert a.export_text() == b.export_text()
+    t = a.tree_
+    leaves = t.feature < 0
+    assert (t.n_node_samples[leaves] >= frac * len(X)).all()
+    # constrained tree is a strict pruning of the unconstrained one
+    full = DecisionTreeClassifier(max_depth=10, backend="host").fit(X, y)
+    assert t.n_nodes <= full.tree_.n_nodes
+    with pytest.raises(ValueError):
+        DecisionTreeClassifier(min_weight_fraction_leaf=0.7).fit(X, y)
+    # extreme class weights + the floor: the sklearn conformance scenario
+    from sklearn.datasets import make_blobs
+
+    Xb, yb = make_blobs(centers=2, random_state=0, cluster_std=20)
+    clf = DecisionTreeClassifier(
+        max_depth=4, class_weight={0: 1000, 1: 0.0001},
+        min_weight_fraction_leaf=0.01,
+    ).fit(Xb, yb)
+    assert (clf.predict(Xb) == 0).mean() > 0.87
