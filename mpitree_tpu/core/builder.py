@@ -480,16 +480,18 @@ def build_tree(
         return S, collective.make_split_fn(
             mesh, n_slots=S, n_bins=B, n_classes=C, task=task,
             criterion=cfg.criterion, debug=debug, use_pallas=S in tiers,
-            node_mask=sampling, min_child_weight=cfg.min_child_weight,
+            node_mask=sampling,
         )
+
+    mcw32 = np.float32(cfg.min_child_weight)
 
     def split_args(lo, take, S_lvl):
         """Positional tail of a split_fn call for the chunk at ``lo``."""
         if not sampling:
-            return (np.int32(lo),)
+            return (np.int32(lo), mcw32)
         nmask = np.ones((S_lvl, F), bool)
         nmask[:take] = keys.masks(lo, lo + take)
-        return (np.int32(lo), nmask)
+        return (np.int32(lo), mcw32, nmask)
 
     update_fn = collective.make_update_fn(mesh, n_slots=U)
     counts_fn = collective.make_counts_fn(

@@ -72,7 +72,6 @@ def _node_capacity(n_samples: int, max_depth) -> int:
 def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                      task: str, criterion: str, max_nodes: int,
                      max_depth: int, min_samples_split: int,
-                     min_child_weight: float = 0.0,
                      tiers: tuple = (), use_pallas: bool = False,
                      psum_axis: str | None = DATA_AXIS,
                      feature_axis: str | None = None):
@@ -107,7 +106,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
     def psum(x):
         return lax.psum(x, psum_axis) if psum_axis is not None else x
 
-    def build(xb, y, nid0, w, cand_mask):
+    def build(xb, y, nid0, w, cand_mask, mcw):
         R, F = xb.shape  # F = per-shard feature count on a feature mesh
         pallas_tiers = frozenset(
             s for s in tiers
@@ -171,7 +170,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                 h = psum(h)
                 dec = select_global(imp_ops.best_split_classification(
                     h, cand_mask, criterion=criterion,
-                    min_child_weight=min_child_weight,
+                    min_child_weight=mcw,
                 ))
                 pure = (dec.counts > 0).sum(axis=1) <= 1
             else:
@@ -181,7 +180,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                 )
                 h = psum(h)
                 dec = select_global(imp_ops.best_split_regression(
-                    h, cand_mask, min_child_weight=min_child_weight,
+                    h, cand_mask, min_child_weight=mcw,
                 ))
                 ymin, ymax = regression_y_range(
                     y, nid, w, chunk_lo, n_slots=n_stat_slots, axis=psum_axis
@@ -345,8 +344,8 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
 @lru_cache(maxsize=32)
 def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                    task: str, criterion: str, max_nodes: int, max_depth: int,
-                   min_samples_split: int, min_child_weight: float = 0.0,
-                   tiers: tuple = (), use_pallas: bool = False):
+                   min_samples_split: int, tiers: tuple = (),
+                   use_pallas: bool = False):
     """Data-parallel single-tree build: rows sharded, histograms psum'd.
 
     Jitted (xb, y, nid0, w, cand_mask) -> (tree arrays..., nid, n_nodes);
@@ -361,8 +360,7 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     build = _make_build_body(
         n_slots=n_slots, n_bins=n_bins, n_classes=n_classes, task=task,
         criterion=criterion, max_nodes=max_nodes, max_depth=max_depth,
-        min_samples_split=min_samples_split,
-        min_child_weight=min_child_weight, tiers=tiers,
+        min_samples_split=min_samples_split, tiers=tiers,
         use_pallas=use_pallas, psum_axis=DATA_AXIS,
         feature_axis=feature_axis,
     )
@@ -372,7 +370,7 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         build,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, FA), P(DATA_AXIS), P(DATA_AXIS),
-                  P(DATA_AXIS), P(FA, None)),
+                  P(DATA_AXIS), P(FA, None), P()),
         out_specs=out_specs,
         check_vma=FA is None,  # replicated/varying mixes in the 2-D cond
     )
@@ -383,7 +381,6 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
 def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                     task: str, criterion: str, max_nodes: int,
                     max_depth: int, min_samples_split: int,
-                    min_child_weight: float = 0.0,
                     tiers: tuple = (), use_pallas: bool = False):
     """Tree-parallel forest build: trees sharded over the mesh, data
     replicated per device (ensemble parallelism — BASELINE configs[4],
@@ -398,21 +395,22 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     build = _make_build_body(
         n_slots=n_slots, n_bins=n_bins, n_classes=n_classes, task=task,
         criterion=criterion, max_nodes=max_nodes, max_depth=max_depth,
-        min_samples_split=min_samples_split,
-        min_child_weight=min_child_weight, tiers=tiers,
+        min_samples_split=min_samples_split, tiers=tiers,
         use_pallas=use_pallas, psum_axis=None,
     )
 
-    def per_device(xb, y, nid0, ws, cand_masks):
+    def per_device(xb, y, nid0, ws, cand_masks, mcw):
         return lax.map(
-            lambda wc: build(xb, y, nid0, wc[0], wc[1]), (ws, cand_masks)
+            lambda wc: build(xb, y, nid0, wc[0], wc[1], mcw),
+            (ws, cand_masks),
         )
 
     t = P(TREE_AXIS)
     sharded = jax.shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(TREE_AXIS, None), P(TREE_AXIS, None, None)),
+        in_specs=(P(), P(), P(), P(TREE_AXIS, None),
+                  P(TREE_AXIS, None, None), P()),
         out_specs=(t, t, t, t, t, t, t, t),
         # No collectives anywhere in the per-device build (psum_axis=None):
         # vma tracking only flags replicated-vs-varying mixes in lax.cond
@@ -454,7 +452,6 @@ def build_tree_fused(
         criterion=cfg.criterion, max_nodes=M,
         max_depth=-1 if cfg.max_depth is None else int(cfg.max_depth),
         min_samples_split=int(cfg.min_samples_split),
-        min_child_weight=float(cfg.min_child_weight),
         tiers=tuple(cfg.frontier_tiers),
         use_pallas=use_pallas,
     )
@@ -464,7 +461,8 @@ def build_tree_fused(
             mesh, binned, y, sample_weight
         )
     with timer.phase("fused_build"):
-        out = fn(xb_d, y_d, nid_d, w_d, cand_d)
+        out = fn(xb_d, y_d, nid_d, w_d, cand_d,
+                 np.float32(cfg.min_child_weight))
         feat, bins, counts, nvec, left, parent, nid_out, n_nodes = out
         # Tree outputs are replicated (addressable from any process); the
         # row-sharded nid_out is only fetched when the refit needs it —
@@ -603,7 +601,6 @@ def build_forest_fused(
         criterion=cfg.criterion, max_nodes=M,
         max_depth=-1 if cfg.max_depth is None else int(cfg.max_depth),
         min_samples_split=int(cfg.min_samples_split),
-        min_child_weight=float(cfg.min_child_weight),
         tiers=tuple(cfg.frontier_tiers),
         use_pallas=use_pallas,
     )
@@ -630,7 +627,10 @@ def build_forest_fused(
 
     with timer.phase("forest_build"):
         feat, bins, counts, nvec, left, parent, nid_out, n_nodes = (
-            jax.device_get(fn(xb_d, y_d, nid_d, ws_d, cm_d))
+            jax.device_get(
+                fn(xb_d, y_d, nid_d, ws_d, cm_d,
+                   np.float32(cfg.min_child_weight))
+            )
         )
 
     trees = []

@@ -79,6 +79,11 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
     min_weight_fraction_leaf : float, default=0.0
         sklearn's leaf-weight floor: a split is invalid unless both sides
         carry at least this fraction of the total fit weight.
+    min_samples_leaf : int or float, default=1
+        sklearn's leaf-size floor (int = rows, float = fraction of rows,
+        ceil'd). Counted in weighted rows — identical to sklearn for
+        unweighted fits and integer bootstrap multiplicities; diverges
+        under fractional sample weights (``utils/validation.py``).
     random_state : int, optional
         Seed for ``max_features`` draws; fits are deterministic either way
         (``None`` reads as seed 0).
@@ -105,7 +110,8 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
     def __init__(self, *, max_depth=None, min_samples_split=2,
                  criterion="entropy", max_bins=256, binning="auto",
                  max_features=None, class_weight=None,
-                 min_weight_fraction_leaf=0.0, random_state=None,
+                 min_weight_fraction_leaf=0.0, min_samples_leaf=1,
+                 random_state=None,
                  n_devices=None, backend=None, refine_depth="auto"):
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
@@ -115,6 +121,7 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         self.max_features = max_features
         self.class_weight = class_weight
         self.min_weight_fraction_leaf = min_weight_fraction_leaf
+        self.min_samples_leaf = min_samples_leaf
         self.random_state = random_state
         self.n_devices = n_devices
         self.backend = backend
@@ -143,7 +150,8 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
             max_depth=crown_depth,
             min_samples_split=self.min_samples_split,
             min_child_weight=min_child_weight(
-                self.min_weight_fraction_leaf, sw, X.shape[0]
+                self.min_weight_fraction_leaf, sw, X.shape[0],
+                self.min_samples_leaf,
             ),
         )
         from mpitree_tpu.ops.sampling import sampler_for
@@ -259,14 +267,15 @@ class ParallelDecisionTreeClassifier(DecisionTreeClassifier):
     def __init__(self, *, max_depth=None, min_samples_split=2,
                  criterion="entropy", max_bins=256, binning="auto",
                  max_features=None, class_weight=None,
-                 min_weight_fraction_leaf=0.0, random_state=None,
+                 min_weight_fraction_leaf=0.0, min_samples_leaf=1,
+                 random_state=None,
                  n_devices="all", backend=None, refine_depth="auto"):
         super().__init__(
             max_depth=max_depth, min_samples_split=min_samples_split,
             criterion=criterion, max_bins=max_bins, binning=binning,
             max_features=max_features, class_weight=class_weight,
             min_weight_fraction_leaf=min_weight_fraction_leaf,
-            random_state=random_state,
+            min_samples_leaf=min_samples_leaf, random_state=random_state,
             n_devices=n_devices, backend=backend, refine_depth=refine_depth,
         )
 

@@ -70,6 +70,7 @@ class _BaseForest(BaseEstimator):
                  max_bins=256, binning="auto", bootstrap=True,
                  max_features=None, max_features_mode="node",
                  oob_score=False, min_weight_fraction_leaf=0.0,
+                 min_samples_leaf=1,
                  random_state=None, n_devices=None,
                  backend=None, refine_depth="auto"):
         self.n_estimators = n_estimators
@@ -82,6 +83,7 @@ class _BaseForest(BaseEstimator):
         self.max_features_mode = max_features_mode
         self.oob_score = oob_score
         self.min_weight_fraction_leaf = min_weight_fraction_leaf
+        self.min_samples_leaf = min_samples_leaf
         self.random_state = random_state
         self.n_devices = n_devices
         self.backend = backend
@@ -135,7 +137,8 @@ class _BaseForest(BaseEstimator):
             # differences are O(1/sqrt(n)) and only matter at extreme
             # fractions)
             min_child_weight=min_child_weight(
-                self.min_weight_fraction_leaf, sample_weight, n
+                self.min_weight_fraction_leaf, sample_weight, n,
+                self.min_samples_leaf,
             ),
         )
         k = n_subspace_features(self.max_features, X.shape[1])
@@ -328,7 +331,8 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
                  min_samples_split=2, max_bins=256, binning="auto",
                  bootstrap=True, max_features=None, max_features_mode="node",
                  oob_score=False, class_weight=None,
-                 min_weight_fraction_leaf=0.0, random_state=None,
+                 min_weight_fraction_leaf=0.0, min_samples_leaf=1,
+                 random_state=None,
                  n_devices=None, backend=None, refine_depth="auto"):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
@@ -336,6 +340,7 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
             binning=binning, bootstrap=bootstrap, max_features=max_features,
             max_features_mode=max_features_mode, oob_score=oob_score,
             min_weight_fraction_leaf=min_weight_fraction_leaf,
+            min_samples_leaf=min_samples_leaf,
             random_state=random_state, n_devices=n_devices, backend=backend,
             refine_depth=refine_depth,
         )
@@ -407,7 +412,7 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
                  min_samples_split=2, max_bins=256, binning="auto",
                  bootstrap=True, max_features=None, max_features_mode="node",
                  oob_score=False, min_weight_fraction_leaf=0.0,
-                 random_state=None,
+                 min_samples_leaf=1, random_state=None,
                  n_devices=None, backend=None, refine_depth="auto"):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
@@ -415,6 +420,7 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
             binning=binning, bootstrap=bootstrap, max_features=max_features,
             max_features_mode=max_features_mode, oob_score=oob_score,
             min_weight_fraction_leaf=min_weight_fraction_leaf,
+            min_samples_leaf=min_samples_leaf,
             random_state=random_state, n_devices=n_devices, backend=backend,
             refine_depth=refine_depth,
         )

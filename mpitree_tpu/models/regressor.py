@@ -49,7 +49,7 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
     def __init__(self, *, max_depth=None, min_samples_split=2,
                  criterion="squared_error", max_bins=256, binning="auto",
                  max_features=None, min_weight_fraction_leaf=0.0,
-                 random_state=None,
+                 min_samples_leaf=1, random_state=None,
                  n_devices=None, backend=None, refine_depth="auto"):
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
@@ -58,6 +58,7 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
         self.binning = binning
         self.max_features = max_features
         self.min_weight_fraction_leaf = min_weight_fraction_leaf
+        self.min_samples_leaf = min_samples_leaf
         self.random_state = random_state
         self.n_devices = n_devices
         self.backend = backend
@@ -88,7 +89,8 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
             max_depth=crown_depth,
             min_samples_split=self.min_samples_split,
             min_child_weight=min_child_weight(
-                self.min_weight_fraction_leaf, sw, X.shape[0]
+                self.min_weight_fraction_leaf, sw, X.shape[0],
+                self.min_samples_leaf,
             ),
         )
         y_c = (y64 - y_mean).astype(np.float32)

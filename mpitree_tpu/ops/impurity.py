@@ -84,7 +84,7 @@ def class_impurity(counts: jax.Array, n: jax.Array, criterion: str) -> jax.Array
 
 def best_split_classification(
     hist: jax.Array, cand_mask: jax.Array, *, criterion: str = "entropy",
-    node_mask: jax.Array | None = None, min_child_weight: float = 0.0,
+    node_mask: jax.Array | None = None, min_child_weight=None,
 ) -> SplitDecision:
     """Pick the best (feature, bin) per frontier slot from a class histogram.
 
@@ -135,7 +135,9 @@ def best_split_classification(
     cost = (n_l * h_l + n_r * h_r) / jnp.maximum(n_tot, 1.0)
 
     valid = cand_mask[None, :, :] & (n_l > 0) & (n_r > 0)
-    if min_child_weight > 0.0:
+    if min_child_weight is not None:
+        # accepts a traced scalar (0.0 is a no-op) — keeping it a runtime
+        # operand avoids a recompile per distinct total fit weight
         valid = valid & (n_l >= min_child_weight) & (n_r >= min_child_weight)
     if node_mask is not None:
         valid = valid & node_mask[:, :, None]
@@ -168,7 +170,7 @@ def best_split_classification(
 
 def best_split_regression(
     hist: jax.Array, cand_mask: jax.Array,
-    node_mask: jax.Array | None = None, min_child_weight: float = 0.0,
+    node_mask: jax.Array | None = None, min_child_weight=None,
 ) -> SplitDecision:
     """Pick the best MSE split per frontier slot from a moment histogram.
 
@@ -196,7 +198,7 @@ def best_split_regression(
     cost = (sse(w_l, s_l, q_l) + sse(w_r, s_r, q_r)) / n
 
     valid = cand_mask[None, :, :] & (w_l > 0) & (w_r > 0)
-    if min_child_weight > 0.0:
+    if min_child_weight is not None:
         valid = valid & (w_l >= min_child_weight) & (w_r >= min_child_weight)
     if node_mask is not None:
         valid = valid & node_mask[:, :, None]

@@ -116,11 +116,12 @@ def unpack_decision(packed: "np.ndarray") -> dict:
 @lru_cache(maxsize=64)
 def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                   task: str, criterion: str, debug: bool = False,
-                  use_pallas: bool = False, node_mask: bool = False,
-                  min_child_weight: float = 0.0):
-    """Jitted (x_binned, y, node_id, weight, cand_mask, chunk_lo[, nmask])
+                  use_pallas: bool = False, node_mask: bool = False):
+    """Jitted (x_binned, y, node_id, weight, cand_mask, chunk_lo, mcw[, nmask])
     -> packed (n_slots, 7 + C) float32 decision buffer (see
-    :func:`_pack_decision`, :func:`unpack_decision`).
+    :func:`_pack_decision`, :func:`unpack_decision`). ``mcw`` is the
+    min-child-weight floor as a RUNTIME scalar (a traced constant would
+    recompile per distinct total fit weight).
 
     With ``debug=True`` the result is ``(packed, repl_err)`` where
     ``repl_err`` must be 0: the determinism check that every device computed
@@ -130,7 +131,7 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     ``node_mask=True`` adds a trailing (n_slots, F) bool input of per-node
     allowed features (sklearn per-node ``max_features``; ops/sampling.py)."""
 
-    def local_step(xb, y, nid, w, cand_mask, chunk_lo, *nm):
+    def local_step(xb, y, nid, w, cand_mask, chunk_lo, mcw, *nm):
         nmask = nm[0] if nm else None
         if task == "classification":
             if use_pallas:
@@ -150,7 +151,7 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
             h = lax.psum(h, DATA_AXIS)
             dec = imp_ops.best_split_classification(
                 h, cand_mask, criterion=criterion, node_mask=nmask,
-                min_child_weight=min_child_weight,
+                min_child_weight=mcw,
             )
         else:
             h = hist_ops.moment_histogram(
@@ -159,8 +160,7 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
             )
             h = lax.psum(h, DATA_AXIS)
             dec = imp_ops.best_split_regression(
-                h, cand_mask, node_mask=nmask,
-                min_child_weight=min_child_weight,
+                h, cand_mask, node_mask=nmask, min_child_weight=mcw,
             )
             ymin, ymax = regression_y_range(
                 y, nid, w, chunk_lo, n_slots=n_slots
@@ -173,7 +173,7 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         return _pack_decision(dec)
 
     in_specs = (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
-                P(), P())
+                P(), P(), P())
     if node_mask:
         in_specs = in_specs + (P(),)
     sharded = jax.shard_map(

@@ -51,23 +51,49 @@ def validate_sample_weight(sample_weight, n_samples: int):
     return w
 
 
-def min_child_weight(min_weight_fraction_leaf, sample_weight, n_samples):
-    """sklearn's min_weight_fraction_leaf -> an absolute per-child floor.
+def min_child_weight(min_weight_fraction_leaf, sample_weight, n_samples,
+                     min_samples_leaf=1):
+    """sklearn's leaf floors -> one absolute per-child weight floor.
 
-    The fraction is of the TOTAL fit weight (sklearn semantics); 0.0 (the
-    default) disables the constraint.
+    ``min_weight_fraction_leaf`` is a fraction of the TOTAL fit weight
+    (sklearn semantics); ``min_samples_leaf`` is a sample count. Both bound
+    the same weighted child total here, so the effective floor is their
+    max. Caveat (documented): with fractional sample weights the count
+    floor reads weighted counts, whereas sklearn counts raw rows — for
+    unweighted fits and integer bootstrap multiplicities (where sklearn
+    materializes duplicated rows) the two coincide exactly.
     """
     frac = float(min_weight_fraction_leaf)
     if not 0.0 <= frac <= 0.5:
         raise ValueError(
             f"min_weight_fraction_leaf must be in [0, 0.5], got {frac!r}"
         )
-    if frac == 0.0:
-        return 0.0
-    total = float(n_samples) if sample_weight is None else float(
-        np.sum(sample_weight)
-    )
-    return frac * total
+    import numbers
+
+    if isinstance(min_samples_leaf, numbers.Real) and not isinstance(
+        min_samples_leaf, numbers.Integral
+    ):
+        # sklearn's fractional form: ceil(fraction * n_samples) rows
+        if not 0.0 < min_samples_leaf < 1.0:
+            raise ValueError(
+                f"float min_samples_leaf must be in (0, 1), "
+                f"got {min_samples_leaf!r}"
+            )
+        msl = int(np.ceil(min_samples_leaf * n_samples))
+    else:
+        msl = int(min_samples_leaf)
+        if msl != min_samples_leaf or msl < 1:
+            raise ValueError(
+                f"int min_samples_leaf must be a positive integer, "
+                f"got {min_samples_leaf!r}"
+            )
+    floor = 0.0 if msl == 1 else float(msl)
+    if frac > 0.0:
+        total = float(n_samples) if sample_weight is None else float(
+            np.sum(sample_weight)
+        )
+        floor = max(floor, frac * total)
+    return floor
 
 
 def apply_class_weight(class_weight, y_enc, classes, sample_weight):

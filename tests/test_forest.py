@@ -390,3 +390,30 @@ def test_min_weight_fraction_leaf():
         min_weight_fraction_leaf=0.01,
     ).fit(Xb, yb)
     assert (clf.predict(Xb) == 0).mean() > 0.87
+
+
+def test_min_samples_leaf():
+    """Every leaf holds >= min_samples_leaf rows (unweighted: exact sklearn
+    semantics); shared floor machinery with min_weight_fraction_leaf."""
+    import pytest
+
+    X, y = _noisy_classification(600)
+    clf = DecisionTreeClassifier(
+        max_depth=12, min_samples_leaf=20, backend="host"
+    ).fit(X, y)
+    t = clf.tree_
+    assert (t.n_node_samples[t.feature < 0] >= 20).all()
+    from sklearn.tree import DecisionTreeClassifier as SkT
+
+    sk = SkT(max_depth=12, min_samples_leaf=20, random_state=0).fit(X, y)
+    # two-sided comparable pruning strength (shapes differ: binned candidates)
+    assert sk.get_n_leaves() / 2 <= t.n_leaves <= 2 * sk.get_n_leaves()
+    # sklearn's fractional grammar: ceil(frac * n) rows per leaf
+    g = DecisionTreeClassifier(
+        max_depth=12, min_samples_leaf=0.05, backend="host"
+    ).fit(X, y)
+    leaves_g = g.tree_.feature < 0
+    assert (g.tree_.n_node_samples[leaves_g] >= int(np.ceil(0.05 * len(X)))).all()
+    for bad in (0, -1, 2.7, 1.0):
+        with pytest.raises(ValueError):
+            DecisionTreeClassifier(min_samples_leaf=bad).fit(X, y)
