@@ -52,7 +52,17 @@ sys.path.insert(0, _HERE)
 os.environ.setdefault("MPITREE_TPU_PROFILE", "1")  # per-phase fit_stats_
 
 N_ROWS = 581012
-N_ROWS_CPU_FALLBACK = 200_000  # bound the no-TPU fallback's wall clock
+
+
+def cpu_fallback_rows() -> int:
+    """No-TPU fallback size: the FULL north-star workload when the C++ host
+    tier is available (fits 581k x 54 depth-20 in ~10-15 s single-threaded),
+    else a 200k cap — the numpy fallback has no other wall-clock bound."""
+    from mpitree_tpu import native
+
+    return N_ROWS if native.lib() is not None else 200_000
+
+
 DEPTH = 20
 # Hybrid crossover: device engines grow the data-parallel crown to this
 # depth, the C++ tier finishes subtrees with exact local candidates —
@@ -252,7 +262,7 @@ def main():
             )
             return X, Xtr, Xte, ytr, yte
 
-        n_rows = N_ROWS if platform == "tpu" else N_ROWS_CPU_FALLBACK
+        n_rows = N_ROWS if platform == "tpu" else cpu_fallback_rows()
         X, Xtr, Xte, ytr, yte = load_and_split(n_rows)
 
         # --- ours: warm-timed depth-20 build --------------------------------
@@ -277,9 +287,10 @@ def main():
                     jax.config.update("jax_platforms", "cpu")
                     platform = "cpu"
                     detail["platform"] = "cpu (tpu fit fell back)"
-                    X, Xtr, Xte, ytr, yte = load_and_split(
-                        N_ROWS_CPU_FALLBACK
-                    )
+                    if cpu_fallback_rows() != n_rows:
+                        X, Xtr, Xte, ytr, yte = load_and_split(
+                            cpu_fallback_rows()
+                        )
 
             if worker is None:
                 # No TPU -> the C++ host tier (native/split_kernel.cpp),
