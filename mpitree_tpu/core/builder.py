@@ -72,10 +72,13 @@ class BuildConfig:
     # otherwise. MPITREE_TPU_ENGINE overrides.
     engine: str = "auto"
     # Histogram kernel for frontier-tier levels in BOTH device engines:
-    # "pallas" = the Mosaic one-hot-matmul kernel (ops/pallas_hist.py;
-    # classification on TPU with integer weights — raises where
-    # unsupported), "xla" = the segment_sum scatter everywhere, "auto" =
-    # pallas where it applies. MPITREE_TPU_HIST_KERNEL overrides "auto".
+    # "auto" = the Mosaic one-hot-matmul kernel (ops/pallas_hist.py) where
+    # it is bit-identical to the scatter (TPU + classification + integer
+    # weights), the segment_sum scatter otherwise; "xla" = the scatter
+    # everywhere; "pallas" = the Mosaic kernel for ALL payloads (raises off
+    # TPU) — an explicit opt-out of kernel-exactness for regression moments
+    # and fractional weights (see resolve_hist_kernel).
+    # MPITREE_TPU_HIST_KERNEL overrides "auto".
     hist_kernel: str = "auto"
     # Frontier-width tiers served by dedicated branches (lax.cond chain in
     # the fused loop): a level whose frontier fits tier S computes an S-slot
@@ -163,11 +166,19 @@ def resolve_hist_kernel(cfg: BuildConfig, platform: str, task: str, *,
                         integer_ok: bool) -> bool:
     """Shared hist_kernel resolution for every device build path.
 
-    ``integer_ok`` gates the Pallas path on integer-valued sample weights:
-    the MXU matmul's f32 reduction order differs from the XLA scatter's, so
-    only integer-valued counts (exact in f32 below 2**24) keep the
-    one-tree-regardless-of-kernel identity contract. Returns whether to use
-    the Pallas kernel; raises on an invalid or unsatisfiable request.
+    Exactness policy: under ``"auto"`` the Pallas kernel is used only where
+    it is bit-identical to the XLA scatter — classification with
+    integer-valued sample weights (integer f32 counts below 2**24 sum
+    exactly in any order). Regression moments and fractional weights are
+    non-integer f32, where the MXU matmul's reduction order differs from
+    the scatter's, so those run Pallas only on an explicit
+    ``hist_kernel="pallas"`` opt-out of the one-tree-regardless-of-kernel
+    identity contract: split *selection* may differ in FP ties; regression
+    leaf values are still exact (the f64 host refit,
+    :func:`refit_regression_values`), while classification leaf counts
+    under fractional weights come straight from the device f32 histogram
+    and can carry reduction-order noise. Returns whether to use the
+    Pallas kernel; raises on an invalid or unsatisfiable request.
     """
     from mpitree_tpu.ops import pallas_hist
 
@@ -176,19 +187,17 @@ def resolve_hist_kernel(cfg: BuildConfig, platform: str, task: str, *,
         hist_kernel = os.environ.get("MPITREE_TPU_HIST_KERNEL", "auto")
     if hist_kernel not in ("auto", "xla", "pallas"):
         raise ValueError(f"unknown hist_kernel {hist_kernel!r}")
-    pallas_ok = (
-        pallas_hist.pallas_available(platform)
-        and task == "classification"
-        and integer_ok
-    )
-    if hist_kernel == "pallas" and not pallas_ok:
-        raise ValueError(
-            "hist_kernel='pallas' needs a TPU backend, a classification "
-            "task, and integer-valued sample weights "
-            f"(platform={platform!r}, task={task!r}, "
-            f"integer_weights={integer_ok})"
-        )
-    return pallas_ok and hist_kernel in ("auto", "pallas")
+    if hist_kernel == "xla":
+        return False
+    exact = task == "classification" and integer_ok
+    if hist_kernel == "pallas":
+        if not pallas_hist.pallas_available(platform):
+            raise ValueError(
+                "hist_kernel='pallas' needs a TPU backend "
+                f"(platform={platform!r})"
+            )
+        return True
+    return pallas_hist.pallas_available(platform) and exact
 
 
 def integer_weights(sample_weight) -> bool:

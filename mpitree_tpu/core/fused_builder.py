@@ -92,7 +92,9 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
     first ~log2(K) levels of every build pay the K=4096-slot sweep for a
     handful of live nodes. ``use_pallas`` swaps tier histograms (where the
     out block fits VMEM) for the Mosaic one-hot-matmul kernel
-    (``ops/pallas_hist.py``; bit-identical — integer-valued f32 counts).
+    (``ops/pallas_hist.py``) — bit-identical for integer-valued class
+    counts, explicit-opt-in-only for non-integer payloads (the exactness
+    policy in ``builder.resolve_hist_kernel``).
     """
     # K slots of slack past the true capacity: the last chunk's
     # dynamic_update_slice window [chunk_lo, chunk_lo+K) may extend past the
@@ -108,15 +110,18 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
 
     def build(xb, y, nid0, w, cand_mask, mcw):
         R, F = xb.shape  # F = per-shard feature count on a feature mesh
+        # C == n_classes for classification, 3 (moment channels) for
+        # regression — the VMEM check covers both payload widths.
         pallas_tiers = frozenset(
             s for s in tiers
-            if use_pallas and task == "classification"
-            and pallas_hist.fits_vmem(F, s, C, n_bins)
+            if use_pallas and pallas_hist.fits_vmem(F, s, C, n_bins)
         )
         if pallas_tiers:
-            from mpitree_tpu.ops import pallas_hist as ph
-
-            payload = ph.class_payload(y, w, C)  # loop-invariant
+            payload = (  # loop-invariant
+                pallas_hist.class_payload(y, w, C)
+                if task == "classification"
+                else pallas_hist.moment_payload(y, w)
+            )
 
         def select_global(dec):
             """Merge per-feature-shard winners into the global decision."""
@@ -156,9 +161,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
             """Histogram + split search for nodes [chunk_lo, chunk_lo+S_or_K)."""
             if task == "classification":
                 if pallas_ok:
-                    from mpitree_tpu.ops import pallas_hist as ph
-
-                    h = ph.histogram_small(
+                    h = pallas_hist.histogram_small(
                         xb, payload, nid - chunk_lo, n_slots=n_stat_slots,
                         n_bins=n_bins, n_channels=C, vma=hist_vma,
                     )
@@ -174,10 +177,16 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                 ))
                 pure = (dec.counts > 0).sum(axis=1) <= 1
             else:
-                h = hist_ops.moment_histogram(
-                    xb, y, nid, chunk_lo, n_slots=n_stat_slots,
-                    n_bins=n_bins, sample_weight=w,
-                )
+                if pallas_ok:
+                    h = pallas_hist.histogram_small(
+                        xb, payload, nid - chunk_lo, n_slots=n_stat_slots,
+                        n_bins=n_bins, n_channels=3, vma=hist_vma,
+                    )
+                else:
+                    h = hist_ops.moment_histogram(
+                        xb, y, nid, chunk_lo, n_slots=n_stat_slots,
+                        n_bins=n_bins, sample_weight=w,
+                    )
                 h = psum(h)
                 dec = select_global(imp_ops.best_split_regression(
                     h, cand_mask, min_child_weight=mcw,

@@ -106,8 +106,9 @@ def histogram_small(
 ) -> jax.Array:
     """(N,F) bins + (N,C) payload + (N,) slot -> (S, F, C, B) histogram.
 
-    ``interpret=True`` runs the kernel in the Pallas interpreter — how the
-    CPU test suite checks kernel semantics without a TPU. ``vma`` names the
+    ``interpret=True`` runs the kernel in the Pallas interpreter —
+    ``tests/test_pallas_hist.py`` uses it to check exact equality against
+    the XLA scatter histogram on CPU, without a TPU. ``vma`` names the
     shard_map mesh axes the output varies over (required when called inside
     ``shard_map``: the per-shard partial histogram varies over the data axis
     until the caller's psum).

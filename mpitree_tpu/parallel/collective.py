@@ -126,8 +126,10 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     With ``debug=True`` the result is ``(packed, repl_err)`` where
     ``repl_err`` must be 0: the determinism check that every device computed
     the identical split (SURVEY.md §5 race-detection analogue).
-    ``use_pallas`` routes the classification histogram through the Mosaic
-    one-hot-matmul kernel (callers gate on platform/VMEM/integer weights).
+    ``use_pallas`` routes the histogram (class counts or regression
+    moments) through the Mosaic one-hot-matmul kernel; callers gate on
+    platform/VMEM and on the exactness policy in
+    :func:`mpitree_tpu.core.builder.resolve_hist_kernel`.
     ``node_mask=True`` adds a trailing (n_slots, F) bool input of per-node
     allowed features (sklearn per-node ``max_features``; ops/sampling.py)."""
 
@@ -154,10 +156,19 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                 min_child_weight=mcw,
             )
         else:
-            h = hist_ops.moment_histogram(
-                xb, y, nid, chunk_lo, n_slots=n_slots, n_bins=n_bins,
-                sample_weight=w,
-            )
+            if use_pallas:
+                from mpitree_tpu.ops import pallas_hist as ph
+
+                h = ph.histogram_small(
+                    xb, ph.moment_payload(y, w), nid - chunk_lo,
+                    n_slots=n_slots, n_bins=n_bins, n_channels=3,
+                    vma=(DATA_AXIS,),
+                )
+            else:
+                h = hist_ops.moment_histogram(
+                    xb, y, nid, chunk_lo, n_slots=n_slots, n_bins=n_bins,
+                    sample_weight=w,
+                )
             h = lax.psum(h, DATA_AXIS)
             dec = imp_ops.best_split_regression(
                 h, cand_mask, node_mask=nmask, min_child_weight=mcw,

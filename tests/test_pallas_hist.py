@@ -1,0 +1,282 @@
+"""Pallas histogram kernel semantics, checked on CPU via interpret mode.
+
+The kernel (``ops/pallas_hist.py``) is routed into production classification
+builds whenever the platform is TPU (``core/builder.py:resolve_hist_kernel``),
+so its bit-identity contract with the XLA scatter histogram
+(``ops/histogram.py:class_histogram``) must hold under CI without a TPU.
+``interpret=True`` runs the same kernel body through the Pallas interpreter;
+counts are integer-valued f32 (< 2**24), so equality is exact, not allclose.
+
+These tests are also the tripwire for version-sensitive JAX surfaces the
+kernel touches: ``jax.ShapeDtypeStruct(..., vma=...)`` (exercised by the
+shard_map test) and Mosaic-adjacent Pallas APIs — if a jaxlib bump changes
+either, this file fails on CPU before a TPU run can corrupt trees.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mpitree_tpu.core.builder import (
+    BuildConfig,
+    integer_weights,
+    resolve_hist_kernel,
+)
+from mpitree_tpu.ops import histogram as hist_ops
+from mpitree_tpu.ops import pallas_hist as ph
+
+
+def _fuzz_case(seed, n, f, c, b, s, *, weights=None, slot_lo=-1):
+    """Random (x_binned, y, slot, w) with out-of-range slots included."""
+    rng = np.random.default_rng(seed)
+    xb = rng.integers(0, b, size=(n, f)).astype(np.int32)
+    y = rng.integers(0, c, size=n).astype(np.int32)
+    # slots below 0 and at/above S must contribute nothing
+    slot = rng.integers(slot_lo, s + 2, size=n).astype(np.int32)
+    if weights == "integer":
+        w = rng.integers(0, 4, size=n).astype(np.float32)
+    else:
+        w = np.ones(n, np.float32)
+    return xb, y, slot, w
+
+
+def _pallas(xb, y, slot, w, *, c, b, s, row_tile=128):
+    payload = ph.class_payload(jnp.asarray(y), jnp.asarray(w), c)
+    return np.asarray(
+        ph.histogram_small(
+            jnp.asarray(xb), payload, jnp.asarray(slot),
+            n_slots=s, n_bins=b, n_channels=c, row_tile=row_tile,
+            interpret=True,
+        )
+    )
+
+def _xla(xb, y, slot, w, *, c, b, s):
+    return np.asarray(
+        hist_ops.class_histogram(
+            jnp.asarray(xb), jnp.asarray(y), jnp.asarray(slot),
+            jnp.int32(0), n_slots=s, n_bins=b, n_classes=c,
+            sample_weight=jnp.asarray(w),
+        )
+    )
+
+
+# (n, f, c, b, s, row_tile): covers B > 128 lane padding (130 -> 256),
+# B == 128 exactly, non-divisible row tiles (300 % 128 != 0), a single
+# slot/class/bin degenerate case, and a wide-ish frontier.
+CASES = [
+    (300, 5, 3, 16, 8, 128),
+    (1000, 3, 7, 130, 8, 256),
+    (257, 2, 2, 128, 4, 128),
+    (64, 1, 1, 1, 1, 512),
+    (500, 4, 5, 32, 16, 128),
+]
+
+
+@pytest.mark.parametrize("n,f,c,b,s,row_tile", CASES)
+def test_exact_equality_vs_xla_histogram(n, f, c, b, s, row_tile):
+    xb, y, slot, w = _fuzz_case(0, n, f, c, b, s)
+    got = _pallas(xb, y, slot, w, c=c, b=b, s=s, row_tile=row_tile)
+    want = _xla(xb, y, slot, w, c=c, b=b, s=s)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_exact_equality_fuzz_integer_weights(seed):
+    rng = np.random.default_rng(100 + seed)
+    n = int(rng.integers(1, 800))
+    f = int(rng.integers(1, 8))
+    c = int(rng.integers(1, 9))
+    b = int(rng.integers(2, 200))
+    s = int(rng.integers(1, 17))
+    xb, y, slot, w = _fuzz_case(seed, n, f, c, b, s, weights="integer")
+    got = _pallas(xb, y, slot, w, c=c, b=b, s=s)
+    want = _xla(xb, y, slot, w, c=c, b=b, s=s)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_all_rows_masked_gives_zero_histogram():
+    xb, y, _, w = _fuzz_case(1, 200, 3, 4, 8, 4)
+    slot = np.full(200, -1, np.int32)
+    got = _pallas(xb, y, slot, w, c=4, b=8, s=4)
+    assert got.shape == (4, 3, 4, 8)
+    assert (got == 0).all()
+
+
+def test_chunk_lo_offset_matches_slot_arithmetic():
+    """The fused builder passes ``nid - chunk_lo`` as the slot; the XLA path
+    takes (nid, chunk_lo). Both must address the same frontier window."""
+    xb, y, nid, w = _fuzz_case(2, 400, 3, 4, 16, 7, slot_lo=0)
+    chunk_lo = 3
+    payload = ph.class_payload(jnp.asarray(y), jnp.asarray(w), 4)
+    got = np.asarray(
+        ph.histogram_small(
+            jnp.asarray(xb), payload, jnp.asarray(nid) - chunk_lo,
+            n_slots=4, n_bins=16, n_channels=4, row_tile=128,
+            interpret=True,
+        )
+    )
+    want = np.asarray(
+        hist_ops.class_histogram(
+            jnp.asarray(xb), jnp.asarray(y), jnp.asarray(nid),
+            jnp.int32(chunk_lo), n_slots=4, n_bins=16, n_classes=4,
+            sample_weight=jnp.asarray(w),
+        )
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_shard_map_vma_path_on_virtual_mesh():
+    """The production call site (fused_builder chunk_stats) runs the kernel
+    inside shard_map with ``vma=(data_axis,)``; the psum'd result must equal
+    the single-device histogram. Exercises the version-sensitive
+    ``jax.ShapeDtypeStruct(..., vma=...)`` construction. ``check_vma=False``
+    because the interpreter decomposes pallas_call into slicing ops the vma
+    checker can't type — on TPU the call is opaque and the check passes.
+    """
+    n, f, c, b, s = 1024, 4, 3, 16, 8
+    xb, y, slot, w = _fuzz_case(3, n, f, c, b, s)
+    mesh = Mesh(np.array(jax.devices("cpu")), ("data",))
+
+    @partial(
+        jax.shard_map, mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
+        out_specs=P(), check_vma=False,
+    )
+    def sharded_hist(xb, y, slot):
+        payload = ph.class_payload(y, jnp.ones(y.shape[0], jnp.float32), c)
+        h = ph.histogram_small(
+            xb, payload, slot, n_slots=s, n_bins=b, n_channels=c,
+            row_tile=128, interpret=True, vma=("data",),
+        )
+        return jax.lax.psum(h, "data")
+
+    got = np.asarray(
+        sharded_hist(jnp.asarray(xb), jnp.asarray(y), jnp.asarray(slot))
+    )
+    want = _xla(xb, y, slot, w, c=c, b=b, s=s)
+    np.testing.assert_array_equal(got, want)
+
+
+# ------------------------------------------------- regression moment payload
+
+def _moment_case(seed, n, f, b, s, *, integer_y):
+    rng = np.random.default_rng(seed)
+    xb = rng.integers(0, b, size=(n, f)).astype(np.int32)
+    y = (
+        rng.integers(0, 30, size=n).astype(np.float32)
+        if integer_y else rng.normal(size=n).astype(np.float32)
+    )
+    slot = rng.integers(-1, s + 2, size=n).astype(np.int32)
+    w = rng.integers(0, 3, size=n).astype(np.float32)
+    return xb, y, slot, w
+
+
+def _pallas_moments(xb, y, slot, w, *, b, s):
+    payload = ph.moment_payload(jnp.asarray(y), jnp.asarray(w))
+    return np.asarray(
+        ph.histogram_small(
+            jnp.asarray(xb), payload, jnp.asarray(slot),
+            n_slots=s, n_bins=b, n_channels=3, row_tile=128,
+            interpret=True,
+        )
+    )
+
+
+def _xla_moments(xb, y, slot, w, *, b, s):
+    return np.asarray(
+        hist_ops.moment_histogram(
+            jnp.asarray(xb), jnp.asarray(y), jnp.asarray(slot),
+            jnp.int32(0), n_slots=s, n_bins=b,
+            sample_weight=jnp.asarray(w),
+        )
+    )
+
+
+def test_moment_payload_exact_for_integer_targets():
+    """Integer y and w make all three moment channels integer-valued f32
+    (< 2**24), so matmul and scatter sums agree bit-for-bit."""
+    xb, y, slot, w = _moment_case(0, 500, 4, 24, 8, integer_y=True)
+    got = _pallas_moments(xb, y, slot, w, b=24, s=8)
+    want = _xla_moments(xb, y, slot, w, b=24, s=8)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_moment_payload_close_for_float_targets(seed):
+    """Float targets: reduction order differs between the MXU contraction
+    and the scatter, so agreement is allclose, not exact — the reason the
+    regression route is opt-in (resolve_hist_kernel exactness policy)."""
+    xb, y, slot, w = _moment_case(10 + seed, 700, 3, 32, 8, integer_y=False)
+    got = _pallas_moments(xb, y, slot, w, b=32, s=8)
+    want = _xla_moments(xb, y, slot, w, b=32, s=8)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-4)
+
+
+# ---------------------------------------------------------------- routing
+
+def test_resolve_routes_pallas_exactly_when_admissible():
+    """Under "auto", Pallas runs exactly where it is bit-identical to the
+    scatter: TPU platform + classification + integer weights."""
+    cfg = BuildConfig()
+    assert resolve_hist_kernel(
+        cfg, "tpu", "classification", integer_ok=True
+    ) is ph.pallas_available("tpu")
+    # CPU platform, regression task, or fractional weights: never under auto.
+    assert not resolve_hist_kernel(
+        cfg, "cpu", "classification", integer_ok=True)
+    assert not resolve_hist_kernel(
+        cfg, "tpu", "regression", integer_ok=True)
+    assert not resolve_hist_kernel(
+        cfg, "tpu", "classification", integer_ok=False)
+
+
+def test_resolve_explicit_xla_disables_pallas():
+    cfg = BuildConfig(hist_kernel="xla")
+    assert not resolve_hist_kernel(
+        cfg, "tpu", "classification", integer_ok=True)
+
+
+@pytest.mark.skipif(
+    not ph.pallas_available("tpu"), reason="jaxlib built without pltpu"
+)
+def test_resolve_explicit_pallas_opts_into_inexact_payloads():
+    """hist_kernel="pallas" is the documented opt-out of the
+    one-tree-regardless-of-kernel contract: regression moments and
+    fractional weights are allowed (f32 reduction order may differ)."""
+    cfg = BuildConfig(hist_kernel="pallas")
+    assert resolve_hist_kernel(cfg, "tpu", "regression", integer_ok=True)
+    assert resolve_hist_kernel(
+        cfg, "tpu", "classification", integer_ok=False)
+
+
+def test_resolve_explicit_pallas_raises_when_unsatisfiable():
+    cfg = BuildConfig(hist_kernel="pallas")
+    with pytest.raises(ValueError, match="hist_kernel='pallas'"):
+        resolve_hist_kernel(cfg, "cpu", "classification", integer_ok=True)
+
+
+def test_resolve_env_override(monkeypatch):
+    monkeypatch.setenv("MPITREE_TPU_HIST_KERNEL", "xla")
+    assert not resolve_hist_kernel(
+        BuildConfig(), "tpu", "classification", integer_ok=True)
+    monkeypatch.setenv("MPITREE_TPU_HIST_KERNEL", "bogus")
+    with pytest.raises(ValueError, match="unknown hist_kernel"):
+        resolve_hist_kernel(
+            BuildConfig(), "tpu", "classification", integer_ok=True)
+
+
+def test_integer_weights_gate():
+    assert integer_weights(None)
+    assert integer_weights(np.array([1.0, 2.0, 0.0]))
+    assert not integer_weights(np.array([1.0, 0.5]))
+
+
+def test_fits_vmem_boundary():
+    # (F, S*C, round_up(B,128)) f32 block vs the 10 MB budget
+    assert ph.fits_vmem(54, 8, 7, 128)       # covtype-shaped: ~1.5 MB
+    assert not ph.fits_vmem(54, 512, 7, 128)  # ~99 MB
