@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-cpu lint bench clean
+.PHONY: test test-cpu lint bench bench-tpu clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -19,6 +19,13 @@ lint:
 
 bench:
 	$(PY) bench.py
+
+# Durable TPU capture: run whenever the accelerator tunnel is up; appends a
+# timestamped line (device-engine phases, throughput, HBM GB/s vs roofline)
+# to the committed BENCH_TPU.jsonl. bench.py embeds the newest line as
+# tpu_last_known when its own live probe fails.
+bench-tpu:
+	$(PY) bench_tpu.py
 
 clean:
 	find . -type d \( -name "__pycache__" -o -name ".pytest_cache" \

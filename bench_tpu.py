@@ -1,0 +1,415 @@
+"""Durable TPU perf capture: append one timestamped JSON line per run.
+
+The round-1/round-2 lesson (VERDICT.md round 2, "What's missing" #1): the
+driver's end-of-round ``bench.py`` run is hostage to bench-time tunnel
+health, so after two rounds no committed artifact contained a TPU number.
+This script is the fix — run it whenever the accelerator is reachable
+(``make bench-tpu``) and it appends a self-contained measurement line to
+``BENCH_TPU.jsonl``, which is committed. ``bench.py`` embeds the newest
+line as ``tpu_last_known`` whenever its own live probe fails, so the
+repo's perf story survives tunnel death.
+
+Sections (each an isolated bounded subprocess, like bench.py's fit worker,
+because a mid-fit tunnel hang blocks in native code where signal timeouts
+cannot fire; a section timing out costs that section, not the line):
+
+- ``north_star``   — the BASELINE.json workload: covtype-scale depth-20
+                     fit through the DEVICE engine (no host fallback; the
+                     hybrid C++ tail still runs, itemized under ``refine``),
+                     cold + warm, per-phase breakdown, held-out accuracy.
+- ``engine_fused`` / ``engine_levelwise`` — the same workload forced
+                     through each device engine with no refine tail: the
+                     measured input for the LEVELWISE_MIN_CELLS crossover
+                     (core/builder.py) on the live transport.
+- ``hist_tput``    — the K-slot histogram op at covtype shape: achieved
+                     G updates/s and HBM GB/s vs the chip roofline, so
+                     bandwidth efficiency is judgeable from the artifact.
+- ``refine_sweep`` — (``--sweep-refine``) warm fits at refine_depth
+                     {7,8,9,10}: the measured input for bench.py's
+                     REFINE_DEPTH constant.
+
+Usage::
+
+    python bench_tpu.py                # all default sections, append line
+    python bench_tpu.py --sweep-refine # include the refine_depth sweep
+    python bench_tpu.py --rows 100000  # smaller workload (smoke)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+
+OUT_PATH = os.path.join(_HERE, "BENCH_TPU.jsonl")
+DEPTH = 20
+REFINE_DEPTH = 8
+SECTION_TIMEOUT_S = 1500
+
+# Public per-chip HBM bandwidth rooflines (GB/s), for the efficiency line.
+# Source: vendor-published specs (v5e: 819 GB/s, v4: 1228 GB/s).
+HBM_ROOFLINE_GBPS = {"tpu v5 lite": 819.0, "tpu v5e": 819.0, "tpu v4": 1228.0}
+
+
+def _git_head() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=_HERE,
+            capture_output=True, text=True, timeout=10,
+        )
+        return out.stdout.strip() or "unknown"
+    except OSError:
+        return "unknown"
+
+
+# --------------------------------------------------------------------------
+# Section workers (run in subprocesses; each prints one tagged JSON line)
+# --------------------------------------------------------------------------
+
+def _load(npz_path: str):
+    data = np.load(npz_path)
+    return data["Xtr"], data["ytr"], data["Xte"], data["yte"]
+
+
+def _pin_platform(platform: str) -> None:
+    """Pin the JAX platform in-process before any jax op runs.
+
+    This environment's sitecustomize registers the tunneled accelerator
+    and sets ``jax_platforms`` via jax.config at interpreter startup —
+    overriding the JAX_PLATFORMS env var — so a CPU-targeted worker that
+    merely sets the env var still tries (and, tunnel down, hangs) to
+    initialize the accelerator client on its first op. Only
+    ``jax.config.update`` sticks (same lesson as bench.py's probe).
+    Accelerator platforms keep the environment default untouched.
+    """
+    import jax
+
+    if platform not in ("tpu", "axon"):
+        jax.config.update("jax_platforms", platform)
+
+
+def _device_platform() -> str:
+    import jax
+
+    return jax.devices()[0].platform
+
+
+def _timed_fit(Xtr, ytr, *, backend, refine_depth, engine_env=None,
+               warm=True):
+    """One (optionally cold+warm) timed fit through the device path."""
+    from mpitree_tpu import DecisionTreeClassifier
+
+    if engine_env:
+        os.environ["MPITREE_TPU_ENGINE"] = engine_env
+
+    def once():
+        clf = DecisionTreeClassifier(
+            max_depth=DEPTH, max_bins=256, backend=backend,
+            refine_depth=refine_depth,
+        )
+        t0 = time.perf_counter()
+        clf.fit(Xtr, ytr)
+        return time.perf_counter() - t0, clf
+
+    cold_s, clf = once()
+    out = {"cold_s": round(cold_s, 3)}
+    if warm:
+        warm_s, clf = once()
+        out["warm_s"] = round(warm_s, 3)
+    out["tree_depth"] = clf.tree_.max_depth
+    out["tree_n_nodes"] = clf.tree_.n_nodes
+    out["phases"] = clf.fit_stats_
+    return out, clf
+
+
+def worker_north_star(npz_path: str) -> dict:
+    Xtr, ytr, Xte, yte = _load(npz_path)
+    platform = _device_platform()
+    out, clf = _timed_fit(
+        Xtr, ytr, backend=platform, refine_depth=REFINE_DEPTH
+    )
+    out["platform"] = platform
+    out["test_acc"] = round(float((clf.predict(Xte) == yte).mean()), 4)
+    n_cells = Xtr.shape[0] * Xtr.shape[1]
+    levels = max(out["tree_depth"], 1)
+    out["throughput_cells_per_s"] = round(n_cells * levels / out["warm_s"])
+    return out
+
+
+def worker_engine(npz_path: str, engine: str) -> dict:
+    Xtr, ytr, _, _ = _load(npz_path)
+    platform = _device_platform()
+    out, _ = _timed_fit(
+        Xtr, ytr, backend=platform, refine_depth=None, engine_env=engine
+    )
+    out["engine"] = engine
+    out["n_cells"] = int(Xtr.shape[0] * Xtr.shape[1])
+    return out
+
+
+def worker_refine_sweep(npz_path: str) -> dict:
+    Xtr, ytr, Xte, yte = _load(npz_path)
+    platform = _device_platform()
+    from mpitree_tpu import DecisionTreeClassifier
+
+    rows = []
+    for rd in (7, 8, 9, 10):
+        clf = DecisionTreeClassifier(
+            max_depth=DEPTH, max_bins=256, backend=platform,
+            refine_depth=rd,
+        )
+        clf.fit(Xtr, ytr)  # compile warm-up for this config
+        t0 = time.perf_counter()
+        clf.fit(Xtr, ytr)
+        warm_s = time.perf_counter() - t0
+        rows.append({
+            "refine_depth": rd, "warm_s": round(warm_s, 3),
+            "test_acc": round(float((clf.predict(Xte) == yte).mean()), 4),
+        })
+    return {"sweep": rows}
+
+
+def worker_hist_tput(npz_path: str) -> dict:
+    """K-slot and small-frontier histogram throughput at covtype shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from mpitree_tpu.ops import histogram as hist_ops
+    from mpitree_tpu.ops import pallas_hist as ph
+
+    Xtr, ytr, _, _ = _load(npz_path)
+    N, F = Xtr.shape
+    B, C, K = 256, int(ytr.max()) + 1, 4096
+    rng = np.random.default_rng(0)
+    xb = jnp.asarray(rng.integers(0, B, size=(N, F), dtype=np.int32))
+    y = jnp.asarray(ytr.astype(np.int32))
+    w1 = jnp.ones(N, jnp.float32)
+    platform = jax.devices()[0].platform
+    kind = jax.devices()[0].device_kind.lower()
+
+    def timed(fn, *args, reps=5):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / reps
+
+    res: dict = {"platform": platform, "device_kind": kind}
+
+    nid = jnp.asarray(rng.integers(0, K, size=N, dtype=np.int32))
+
+    @jax.jit
+    def big_hist(xb, y, nid):
+        return hist_ops.class_histogram(
+            xb, y, nid, jnp.int32(0), n_slots=K, n_bins=B, n_classes=C,
+            sample_weight=w1,
+        )
+
+    s = timed(big_hist, xb, y, nid)
+    # The op reads the (N, F) int32 matrix once; write traffic (K*F*C*B f32
+    # accumulator) is the same order — count read-side only, conservative.
+    gbps = N * F * 4 / s / 1e9
+    res["hist_K4096"] = {
+        "seconds": round(s, 5),
+        "g_updates_per_s": round(N * F / s / 1e9, 3),
+        "read_gb_per_s": round(gbps, 1),
+    }
+    roof = next(
+        (v for k, v in HBM_ROOFLINE_GBPS.items() if k in kind), None
+    )
+    if roof:
+        res["hist_K4096"]["hbm_roofline_gbps"] = roof
+        res["hist_K4096"]["roofline_frac"] = round(gbps / roof, 3)
+
+    S = 8
+    nid_s = jnp.asarray(rng.integers(0, S, size=N, dtype=np.int32))
+
+    @jax.jit
+    def small_hist(xb, y, nid_s):
+        return hist_ops.class_histogram(
+            xb, y, nid_s, jnp.int32(0), n_slots=S, n_bins=B, n_classes=C,
+            sample_weight=w1,
+        )
+
+    s_xla = timed(small_hist, xb, y, nid_s)
+    res["hist_S8_xla"] = {
+        "seconds": round(s_xla, 5),
+        "g_updates_per_s": round(N * F / s_xla / 1e9, 3),
+    }
+    if ph.pallas_available(platform):
+        payload = ph.class_payload(y, w1, C)
+
+        def pallas_hist_fn(xb, payload, nid_s):
+            return ph.histogram_small(
+                xb, payload, nid_s, n_slots=S, n_bins=B, n_channels=C
+            )
+
+        s_pl = timed(pallas_hist_fn, xb, payload, nid_s)
+        res["hist_S8_pallas"] = {
+            "seconds": round(s_pl, 5),
+            "g_updates_per_s": round(N * F / s_pl / 1e9, 3),
+            "speedup_vs_xla": round(s_xla / s_pl, 2),
+        }
+    return res
+
+
+WORKERS = {
+    "north_star": worker_north_star,
+    "engine_fused": lambda p: worker_engine(p, "fused"),
+    "engine_levelwise": lambda p: worker_engine(p, "levelwise"),
+    "hist_tput": worker_hist_tput,
+    "refine_sweep": worker_refine_sweep,
+}
+
+
+# --------------------------------------------------------------------------
+# Parent orchestration
+# --------------------------------------------------------------------------
+
+def run_tagged_subprocess(argv: list, timeout_s: int,
+                          tag: str = "SECTION_JSON:") -> tuple:
+    """(parsed-dict-or-None, error-or-None) for one bounded worker.
+
+    The one copy of the tempfile/subprocess/tagged-JSON-line/timeout
+    scaffold — bench.py's fit workers use it too, so a protocol fix lands
+    once. Bounded because a mid-fit tunnel hang blocks in native code
+    where in-process signal timeouts cannot fire.
+    """
+    try:
+        out = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout_s,
+        )
+        for line in out.stdout.splitlines():
+            if line.startswith(tag):
+                return json.loads(line[len(tag):]), None
+        return None, f"rc={out.returncode}; stderr: {out.stderr[-1500:]}"
+    except subprocess.TimeoutExpired:
+        return None, f"timed out after {timeout_s}s"
+    except OSError as e:
+        return None, f"OSError: {e}"
+
+
+def run_section(name: str, npz_path: str, timeout_s: int,
+                platform: str) -> tuple:
+    """(result-dict-or-None, error-or-None) for one bounded section."""
+    return run_tagged_subprocess(
+        [sys.executable, os.path.abspath(__file__), "--section-worker",
+         name, npz_path, platform],
+        timeout_s,
+    )
+
+
+def latest_line(path: str = OUT_PATH) -> dict | None:
+    """Newest GENUINE TPU capture, or None — bench.py's tpu_last_known.
+
+    CPU-fallback and all-sections-failed runs are appended to the file too
+    (they are honest history), but they must never displace the last real
+    TPU measurement this feature exists to preserve — filter to records
+    that succeeded on an accelerator platform.
+    """
+    try:
+        with open(path) as f:
+            records = [json.loads(ln) for ln in f if ln.strip()]
+    except (OSError, json.JSONDecodeError):
+        return None
+    for rec in reversed(records):
+        if rec.get("ok") and rec.get("platform_probe") in ("tpu", "axon"):
+            return rec
+    return None
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=None,
+                   help="cap training rows (default: full dataset)")
+    p.add_argument("--out", default=OUT_PATH)
+    p.add_argument("--sweep-refine", action="store_true")
+    p.add_argument("--sections", default="north_star,engine_fused,"
+                   "engine_levelwise,hist_tput")
+    p.add_argument("--timeout", type=int, default=SECTION_TIMEOUT_S)
+    p.add_argument("--platform", default="auto",
+                   help="jax platform for every section (auto = probe, "
+                        "falling back to cpu when the accelerator hangs)")
+    args = p.parse_args()
+
+    sections = [s for s in args.sections.split(",") if s]
+    if args.sweep_refine:
+        sections.append("refine_sweep")
+
+    if args.platform == "auto":
+        from bench import probe_backend
+
+        platform = probe_backend()
+    else:
+        platform = args.platform
+    print(f"[bench-tpu] platform: {platform}", file=sys.stderr)
+
+    from sklearn.model_selection import train_test_split
+
+    from mpitree_tpu.utils.datasets import load_covtype
+
+    X, y, name = load_covtype(args.rows)
+    test_size = min(50_000, len(X) // 5)
+    Xtr, Xte, ytr, yte = train_test_split(
+        X, y, test_size=test_size, random_state=0
+    )
+
+    record: dict = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git": _git_head(),
+        "platform_probe": platform,
+        "dataset": f"{name} ({len(Xtr)}x{X.shape[1]})",
+        "depth": DEPTH,
+        "refine_depth": REFINE_DEPTH,
+    }
+    errors: dict = {}
+
+    with tempfile.NamedTemporaryFile(suffix=".npz", delete=False) as f:
+        npz_path = f.name
+    try:
+        np.savez(npz_path, Xtr=Xtr, ytr=ytr, Xte=Xte, yte=yte)
+        for sec in sections:
+            t0 = time.perf_counter()
+            res, err = run_section(sec, npz_path, args.timeout, platform)
+            took = round(time.perf_counter() - t0, 1)
+            if res is not None:
+                record[sec] = res
+                print(f"[bench-tpu] {sec}: ok in {took}s", file=sys.stderr)
+            else:
+                errors[sec] = err
+                print(f"[bench-tpu] {sec}: FAILED ({err})", file=sys.stderr)
+    finally:
+        try:
+            os.unlink(npz_path)
+        except OSError:
+            pass
+
+    if errors:
+        record["errors"] = errors
+    record["ok"] = not errors
+    with open(args.out, "a") as f:
+        f.write(json.dumps(record) + "\n")
+    print(json.dumps(record))
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 4 and sys.argv[1] == "--section-worker":
+        os.environ["MPITREE_TPU_PROFILE"] = "1"
+        if len(sys.argv) >= 5:
+            _pin_platform(sys.argv[4])
+        result = WORKERS[sys.argv[2]](sys.argv[3])
+        print("SECTION_JSON:" + json.dumps(result))
+    else:
+        sys.exit(main())

@@ -409,9 +409,12 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     )
 
     def per_device(xb, y, nid0, ws, cand_masks, mcw):
+        # mcw: (T_local,) per-tree leaf floors — sklearn recomputes
+        # min_weight_fraction_leaf from each tree's composed bootstrap
+        # weight total, so the floor rides the tree axis with the weights.
         return lax.map(
-            lambda wc: build(xb, y, nid0, wc[0], wc[1], mcw),
-            (ws, cand_masks),
+            lambda wcm: build(xb, y, nid0, wcm[0], wcm[1], wcm[2]),
+            (ws, cand_masks, mcw),
         )
 
     t = P(TREE_AXIS)
@@ -419,7 +422,7 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         per_device,
         mesh=mesh,
         in_specs=(P(), P(), P(), P(TREE_AXIS, None),
-                  P(TREE_AXIS, None, None), P()),
+                  P(TREE_AXIS, None, None), P(TREE_AXIS)),
         out_specs=(t, t, t, t, t, t, t, t),
         # No collectives anywhere in the per-device build (psum_axis=None):
         # vma tracking only flags replicated-vs-varying mixes in lax.cond
@@ -566,6 +569,7 @@ def build_forest_fused(
     integer_counts: bool = True,
     timer: PhaseTimer | None = None,
     return_leaf_ids: bool = False,
+    min_child_weights: np.ndarray | None = None,
 ) -> list:
     """Build T trees as ONE device program, trees sharded over the mesh.
 
@@ -616,11 +620,19 @@ def build_forest_fused(
 
     ws = weights.astype(np.float32)
     cm = np.asarray(cand_masks)
+    # Per-tree leaf floors (sklearn recomputes min_weight_fraction_leaf per
+    # bootstrap); a shared scalar floor broadcasts when none are given.
+    mcw = (
+        np.full(T, np.float32(cfg.min_child_weight))
+        if min_child_weights is None
+        else np.asarray(min_child_weights, np.float32)
+    )
     if T_pad != T:  # pad with repeats; surplus trees are dropped after build
         ws = np.concatenate([ws, np.broadcast_to(ws[-1:], (T_pad - T, N))])
         cm = np.concatenate(
             [cm, np.broadcast_to(cm[-1:], (T_pad - T, F, cm.shape[2]))]
         )
+        mcw = np.concatenate([mcw, np.broadcast_to(mcw[-1:], (T_pad - T,))])
 
     with timer.phase("shard"):
         from jax.sharding import NamedSharding
@@ -633,13 +645,11 @@ def build_forest_fused(
         cm_d = jax.device_put(
             cm, NamedSharding(tmesh, P(TREE_AXIS, None, None))
         )
+        mcw_d = jax.device_put(mcw, NamedSharding(tmesh, P(TREE_AXIS)))
 
     with timer.phase("forest_build"):
         feat, bins, counts, nvec, left, parent, nid_out, n_nodes = (
-            jax.device_get(
-                fn(xb_d, y_d, nid_d, ws_d, cm_d,
-                   np.float32(cfg.min_child_weight))
-            )
+            jax.device_get(fn(xb_d, y_d, nid_d, ws_d, cm_d, mcw_d))
         )
 
     trees = []

@@ -337,17 +337,25 @@ def _graft_batched(
     def remap(child):
         return np.where(child >= 0, dst[np.clip(child, 0, None)], -1)
 
-    ext.feature[dst] = bt.feature
-    ext.threshold[dst] = bt.threshold
-    ext.left[dst] = remap(bt.left)
-    ext.right[dst] = remap(bt.right)
+    # A root whose candidate subtree immediately stopped (no children) keeps
+    # the crown leaf byte-for-byte — matching the per-subtree fallback path,
+    # which skips such candidates entirely (the host rebuild's f64 stats
+    # could otherwise nudge the leaf's low-order value/count/impurity).
+    keep = np.ones(bt.n_nodes, bool)
+    keep[:R] = np.asarray(bt.left[:R]) >= 0
+    src, d = np.arange(bt.n_nodes)[keep], dst[keep]
+
+    ext.feature[d] = bt.feature[src]
+    ext.threshold[d] = bt.threshold[src]
+    ext.left[d] = remap(bt.left)[src]
+    ext.right[d] = remap(bt.right)[src]
     # grafted roots keep the crown's parent link; descendants remap
     ext.parent[dst[R:]] = dst[np.clip(bt.parent[R:], 0, None)]
-    ext.depth[dst] = bt.depth + depth_offset
-    ext.value[dst] = bt.value.astype(ext.value.dtype)
-    ext.count[dst] = bt.count.astype(ext.count.dtype)
-    ext.n_node_samples[dst] = bt.n_node_samples
-    ext.impurity[dst] = bt.impurity
+    ext.depth[d] = (bt.depth + depth_offset)[src]
+    ext.value[d] = bt.value[src].astype(ext.value.dtype)
+    ext.count[d] = bt.count[src].astype(ext.count.dtype)
+    ext.n_node_samples[d] = bt.n_node_samples[src]
+    ext.impurity[d] = bt.impurity[src]
 
     return ext
 

@@ -132,15 +132,27 @@ class _BaseForest(BaseEstimator):
         cfg = BuildConfig(
             task=task, criterion=criterion, max_depth=crown_depth,
             min_samples_split=self.min_samples_split,
-            # fraction of the base fit weight (bootstrap preserves the
-            # total in expectation; sklearn recomputes per bootstrap —
-            # differences are O(1/sqrt(n)) and only matter at extreme
-            # fractions)
             min_child_weight=min_child_weight(
                 self.min_weight_fraction_leaf, sample_weight, n,
                 self.min_samples_leaf,
             ),
         )
+
+        def tree_cfg(w):
+            """Per-tree leaf floor, as sklearn computes it: the
+            min_weight_fraction_leaf floor reads each tree's COMPOSED
+            bootstrap x user weight total, not the base fit weight (the
+            two differ only when a user sample_weight rides a bootstrap —
+            multinomial totals are exactly n)."""
+            if w is sample_weight:
+                return cfg
+            return dataclasses.replace(
+                cfg,
+                min_child_weight=min_child_weight(
+                    self.min_weight_fraction_leaf, w, n,
+                    self.min_samples_leaf,
+                ),
+            )
         k = n_subspace_features(self.max_features, X.shape[1])
         if self.max_features_mode not in ("node", "tree"):
             raise ValueError(
@@ -156,7 +168,7 @@ class _BaseForest(BaseEstimator):
         trees = []
         leaf_ids = []  # per tree, only kept when the hybrid tail runs
         tree_w, tree_mask, tree_sampler = [], [], []
-        weights, masks = [], []
+        weights, masks, floors = [], [], []
         self._oob_masks = [] if self.oob_score else None
         for _ in range(self.n_estimators):
             # Bootstrap multiplicities compose multiplicatively with any
@@ -187,7 +199,7 @@ class _BaseForest(BaseEstimator):
             tree_sampler.append(sampler)
             if use_host:
                 res = build_tree_host(
-                    b, y_enc, config=cfg, n_classes=n_classes,
+                    b, y_enc, config=tree_cfg(w), n_classes=n_classes,
                     sample_weight=w, refit_targets=refit_targets,
                     return_leaf_ids=refine, feature_sampler=sampler,
                 )
@@ -199,7 +211,7 @@ class _BaseForest(BaseEstimator):
                 # per-tree builds keep the instrumentation, determinism
                 # checks, and node-key threading build_tree wires up.
                 res = build_tree(
-                    b, y_enc, config=cfg, mesh=mesh,
+                    b, y_enc, config=tree_cfg(w), mesh=mesh,
                     n_classes=n_classes, sample_weight=w,
                     refit_targets=refit_targets, return_leaf_ids=refine,
                     feature_sampler=sampler,
@@ -211,6 +223,7 @@ class _BaseForest(BaseEstimator):
                 # Device trees batch into ONE tree-sharded program below.
                 weights.append(np.ones(n, np.float32) if w is None else w)
                 masks.append(b.candidate_mask())
+                floors.append(tree_cfg(w).min_child_weight)
         if weights:
             res = build_forest_fused(
                 binned, y_enc, config=cfg, mesh=mesh,
@@ -218,6 +231,7 @@ class _BaseForest(BaseEstimator):
                 n_classes=n_classes, refit_targets=refit_targets,
                 integer_counts=integer_weights(sample_weight),
                 return_leaf_ids=refine,
+                min_child_weights=np.asarray(floors, np.float32),
             )
             if refine:
                 trees, nid_all = res
@@ -231,7 +245,8 @@ class _BaseForest(BaseEstimator):
             timer = PhaseTimer(enabled=False)
             trees = [
                 apply_refine(
-                    t, ids, X, y_enc, cfg=cfg, max_depth=self.max_depth,
+                    t, ids, X, y_enc, cfg=tree_cfg(w),
+                    max_depth=self.max_depth,
                     rd=rd, timer=timer, n_classes=n_classes,
                     sample_weight=w, refit_targets=refit_targets,
                     feature_mask=fm, feature_sampler=sm,

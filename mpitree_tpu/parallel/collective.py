@@ -86,7 +86,12 @@ def _pack_decision(dec) -> "jax.Array":
     The levelwise builder fetches the decision every level; a namedtuple
     fetch is one host transfer per field (8 round trips on a tunneled
     transport), a packed buffer is one. feature/bin/constant ride as f32 —
-    exact below 2^24, far above any bin or feature count.
+    exact below 2^24, far above any bin or feature count. ``n`` and the
+    class ``counts`` share that 2^24 integer-exactness ceiling: today they
+    arrive as f32 device histograms anyway, so packing loses nothing, but a
+    future f64-histogram path must widen this buffer or it would silently
+    truncate node totals past 16.7M weighted rows (tree.count contract,
+    min_samples_split tests).
     """
     head = jnp.stack(
         [dec.feature.astype(jnp.float32), dec.bin.astype(jnp.float32),

@@ -175,15 +175,24 @@ def resolve_refine(max_depth, refine_depth, *, n_rows=None, quantized=True):
     actually capped some feature's candidates (``quantized`` — otherwise the
     exact global candidates already match the reference's semantics and a
     refine pass would rebuild identical subtrees), and picks the crown depth
-    whose average frontier leaf holds ~2k rows.
+    whose average frontier leaf holds ~2k rows. It also requires the C++
+    tail kernel: without it the pure-numpy fallback re-bins and rebuilds one
+    candidate subtree at a time (~n_rows/2048 of them), a large default-fit
+    regression on hosts with no compiler. An explicit integer
+    ``refine_depth`` still opts in to the numpy fallback.
     """
     rd = validate_refine_depth(refine_depth)
     if rd == "auto":
         if not quantized or not n_rows:
             rd = None
         else:
-            rd = max(
-                1, round(np.log2(max(n_rows, 2) / _AUTO_REFINE_LEAF_ROWS))
-            )
+            from mpitree_tpu import native
+
+            if native.lib() is None:
+                rd = None
+            else:
+                rd = max(
+                    1, round(np.log2(max(n_rows, 2) / _AUTO_REFINE_LEAF_ROWS))
+                )
     refine = rd is not None and (max_depth is None or max_depth > rd)
     return rd, refine, (rd if refine else max_depth)
