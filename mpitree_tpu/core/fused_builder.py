@@ -55,6 +55,16 @@ from mpitree_tpu.utils import importances as imp_utils
 from mpitree_tpu.utils.profiling import PhaseTimer
 
 
+# Per-device budget for the replicated binned matrix in the tree-sharded
+# forest build (a v5e chip carries 16 GB HBM; half is left for histograms,
+# candidate masks, and XLA scratch). When the matrix would exceed it, the
+# forest mesh trades tree-axis width for a data axis — rows shard and
+# histograms psum inside each tree group (mesh_lib.tree_data_shape).
+FOREST_HBM_BUDGET_BYTES = int(
+    os.environ.get("MPITREE_TPU_FOREST_HBM_BUDGET", 8 << 30)
+)
+
+
 def _node_capacity(n_samples: int, max_depth) -> int:
     """Upper bound on allocatable nodes, rounded up to a power of two.
 
@@ -390,22 +400,30 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
 def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                     task: str, criterion: str, max_nodes: int,
                     max_depth: int, min_samples_split: int,
-                    tiers: tuple = (), use_pallas: bool = False):
-    """Tree-parallel forest build: trees sharded over the mesh, data
-    replicated per device (ensemble parallelism — BASELINE configs[4],
-    "N trees sharded across TPU chips").
+                    tiers: tuple = (), use_pallas: bool = False,
+                    data_sharded: bool = False):
+    """Tree-parallel forest build: trees sharded over the mesh (ensemble
+    parallelism — BASELINE configs[4], "N trees sharded across TPU chips").
 
     Jitted (xb, y, nid0, ws, cand_masks) with ``ws: (T, N)`` bootstrap
     weights and ``cand_masks: (T, F, B)`` per-tree candidate masks ->
-    per-tree stacked tree arrays. Each device runs ``T / n_devices`` full
-    single-device builds sequentially (``lax.map``); devices run their tree
-    batches concurrently — the whole forest is ONE device program.
+    per-tree stacked tree arrays. Each device runs its tree batch
+    sequentially (``lax.map``); devices run their batches concurrently —
+    the whole forest is ONE device program.
+
+    ``data_sharded=False``: 1-D tree mesh, data replicated per device.
+    ``data_sharded=True``: 2-D ``(tree, data)`` mesh — rows shard over the
+    data axis inside each tree group and histograms psum over it (the same
+    collective path as the single-tree build), so forests scale past
+    one device's HBM per tree and surplus devices stop idling when
+    ``n_trees < n_devices``.
     """
     build = _make_build_body(
         n_slots=n_slots, n_bins=n_bins, n_classes=n_classes, task=task,
         criterion=criterion, max_nodes=max_nodes, max_depth=max_depth,
         min_samples_split=min_samples_split, tiers=tiers,
-        use_pallas=use_pallas, psum_axis=None,
+        use_pallas=use_pallas,
+        psum_axis=DATA_AXIS if data_sharded else None,
     )
 
     def per_device(xb, y, nid0, ws, cand_masks, mcw):
@@ -418,15 +436,25 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         )
 
     t = P(TREE_AXIS)
+    if data_sharded:
+        in_specs = (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                    P(TREE_AXIS, DATA_AXIS), P(TREE_AXIS, None, None),
+                    P(TREE_AXIS))
+        # tree outputs are replicated across each tree group after the
+        # psum'd decisions; the row assignment stays sharded
+        out_specs = (t, t, t, t, t, t, P(TREE_AXIS, DATA_AXIS), t)
+    else:
+        in_specs = (P(), P(), P(), P(TREE_AXIS, None),
+                    P(TREE_AXIS, None, None), P(TREE_AXIS))
+        out_specs = (t, t, t, t, t, t, t, t)
     sharded = jax.shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(), P(), P(), P(TREE_AXIS, None),
-                  P(TREE_AXIS, None, None), P(TREE_AXIS)),
-        out_specs=(t, t, t, t, t, t, t, t),
-        # No collectives anywhere in the per-device build (psum_axis=None):
+        in_specs=in_specs,
+        out_specs=out_specs,
         # vma tracking only flags replicated-vs-varying mixes in lax.cond
-        # branches that are semantically fine here.
+        # branches that are semantically fine here (same stance as the
+        # single-tree fused fn on a feature mesh).
         check_vma=False,
     )
     return jax.jit(sharded)
@@ -575,9 +603,13 @@ def build_forest_fused(
 
     ``weights``: (T, N) per-tree sample weights (bootstrap multiplicities
     composed with any user weights); ``cand_masks``: (T, F, B) per-tree
-    candidate masks (random subspaces). Data is replicated per device — the
-    tree axis, not the row axis, rides the mesh (the reference's subtree
-    task-parallelism reborn as ensemble parallelism; BASELINE configs[4]).
+    candidate masks (random subspaces). The mesh is 2-D ``(tree, data)``
+    (``mesh_lib.tree_data_shape``): the tree axis carries ensemble
+    parallelism (the reference's subtree task-parallelism reborn; BASELINE
+    configs[4]) and the data axis — engaged when trees are fewer than
+    devices, or when the binned matrix would blow the per-device HBM budget
+    — row-shards each tree group's build with psum'd histograms, the same
+    collective path as the single-tree engine.
 
     Trees are bit-identical to sequential single-device builds with the same
     weights/masks: the per-device build body is the same program.
@@ -592,9 +624,16 @@ def build_forest_fused(
 
     K = _chunk_size(N, F, B, C, cfg)
     M = _node_capacity(N, cfg.max_depth)
-    D = mesh.size
-    T_pad = ((T + D - 1) // D) * D
-    tmesh = mesh_lib.as_tree_mesh(mesh)
+    Dt, Dd = mesh_lib.tree_data_shape(
+        mesh.size, T, dataset_bytes=binned.x_binned.nbytes,
+        hbm_budget=FOREST_HBM_BUDGET_BYTES,
+    )
+    T_pad = ((T + Dt - 1) // Dt) * Dt
+    data_sharded = Dd > 1
+    tmesh = (
+        mesh_lib.as_tree_data_mesh(mesh, (Dt, Dd))
+        if data_sharded else mesh_lib.as_tree_mesh(mesh)
+    )
     use_pallas = resolve_hist_kernel(
         cfg, mesh.devices.flat[0].platform, task, integer_ok=integer_counts
     )
@@ -616,6 +655,7 @@ def build_forest_fused(
         min_samples_split=int(cfg.min_samples_split),
         tiers=tuple(cfg.frontier_tiers),
         use_pallas=use_pallas,
+        data_sharded=data_sharded,
     )
 
     ws = weights.astype(np.float32)
@@ -637,11 +677,19 @@ def build_forest_fused(
     with timer.phase("shard"):
         from jax.sharding import NamedSharding
 
-        rep = NamedSharding(tmesh, P())
-        xb_d = jax.device_put(binned.x_binned, rep)
-        y_d = jax.device_put(np.asarray(y), rep)
-        nid_d = jax.device_put(np.zeros(N, np.int32), rep)
-        ws_d = jax.device_put(ws, NamedSharding(tmesh, P(TREE_AXIS, None)))
+        xb_h, y_h, ws, nid_h = mesh_lib.pad_row_arrays(
+            binned.x_binned, np.asarray(y), ws, np.zeros(N, np.int32), Dd
+        )
+        if data_sharded:
+            row_spec, xb_spec = P(DATA_AXIS), P(DATA_AXIS, None)
+            ws_spec = P(TREE_AXIS, DATA_AXIS)
+        else:
+            row_spec, xb_spec = P(), P()
+            ws_spec = P(TREE_AXIS, None)
+        xb_d = jax.device_put(xb_h, NamedSharding(tmesh, xb_spec))
+        y_d = jax.device_put(y_h, NamedSharding(tmesh, row_spec))
+        nid_d = jax.device_put(nid_h, NamedSharding(tmesh, row_spec))
+        ws_d = jax.device_put(ws, NamedSharding(tmesh, ws_spec))
         cm_d = jax.device_put(
             cm, NamedSharding(tmesh, P(TREE_AXIS, None, None))
         )

@@ -47,6 +47,39 @@ def as_tree_mesh(mesh: Mesh) -> Mesh:
     return _cached_mesh_named(tuple(mesh.devices.flat), TREE_AXIS)
 
 
+def tree_data_shape(n_devices: int, n_trees: int, *, dataset_bytes: int = 0,
+                    hbm_budget: int | None = None) -> tuple:
+    """(tree_shards, data_shards) for the forest's 2-D ensemble mesh.
+
+    Policy: give the tree axis the widest divisor of ``n_devices`` that the
+    ensemble can fill (``<= n_trees``) — surplus devices become a data axis
+    that row-shards each tree's build (psum inside the tree group), so a
+    2-tree forest on 8 chips runs each tree data-parallel over 4 instead of
+    idling 6. Then the HBM guard: while the replicated binned matrix would
+    exceed ``hbm_budget`` per device, trade tree-axis width for more row
+    sharding. With ``tree_shards < n_trees`` each device builds its tree
+    batch sequentially (``lax.map``), exactly as before.
+    """
+    d = max(int(n_devices), 1)
+    divisors = [k for k in range(1, d + 1) if d % k == 0]
+    t = max(k for k in divisors if k <= max(int(n_trees), 1))
+    if hbm_budget:
+        while t > 1 and dataset_bytes > hbm_budget * (d // t):
+            t = max(k for k in divisors if k < t)
+    return t, d // t
+
+
+@lru_cache(maxsize=32)
+def _cached_mesh_tree_data(devices: tuple, shape: tuple) -> Mesh:
+    picked = np.array(list(devices)).reshape(shape)
+    return Mesh(picked, (TREE_AXIS, DATA_AXIS))
+
+
+def as_tree_data_mesh(mesh: Mesh, shape: tuple) -> Mesh:
+    """Same devices on a 2-D ``(tree, data)`` mesh of the given shape."""
+    return _cached_mesh_tree_data(tuple(mesh.devices.flat), tuple(shape))
+
+
 @lru_cache(maxsize=32)
 def _cached_mesh_2d(device_key: tuple, shape: tuple, backend: str | None) -> Mesh:
     devs = available_devices(backend)
@@ -122,6 +155,30 @@ def pad_rows(n: int, n_devices: int) -> int:
     return (-n) % n_devices
 
 
+def pad_row_arrays(xb, y, w, nid, n_shards: int):
+    """Pad (xb, y, w, nid) so rows divide ``n_shards`` evenly.
+
+    THE one copy of the padding contract both the single-tree and forest
+    engines rely on: padding rows carry ``node_id=-1`` and weight 0, so
+    every kernel masks them out. ``w`` may be 1-D (N,) or a stacked
+    (T, N) per-tree weight matrix — padding lands on the row axis either
+    way.
+    """
+    pad = pad_rows(len(y), n_shards)
+    if not pad:
+        return xb, y, w, nid
+    xb = np.concatenate([xb, np.zeros((pad, xb.shape[1]), xb.dtype)])
+    y = np.concatenate([y, np.zeros(pad, y.dtype)])
+    if w.ndim == 1:
+        w = np.concatenate([w, np.zeros(pad, np.float32)])
+    else:
+        w = np.concatenate(
+            [w, np.zeros((w.shape[0], pad), np.float32)], axis=1
+        )
+    nid = np.concatenate([nid, np.full(pad, -1, np.int32)])
+    return xb, y, w, nid
+
+
 def shard_build_inputs(mesh: Mesh, binned, y, sample_weight):
     """One-time device placement shared by both build engines.
 
@@ -135,17 +192,12 @@ def shard_build_inputs(mesh: Mesh, binned, y, sample_weight):
     N, F = binned.x_binned.shape
     dr = data_shards(mesh)
     df = feature_shards(mesh)
-    pad = pad_rows(N, dr)
-    xb, yy = binned.x_binned, y
     cand = binned.candidate_mask()
     w = (np.ones(N, np.float32) if sample_weight is None
          else sample_weight.astype(np.float32))
-    nid = np.zeros(N, np.int32)
-    if pad:
-        xb = np.concatenate([xb, np.zeros((pad, F), np.int32)])
-        yy = np.concatenate([yy, np.zeros(pad, yy.dtype)])
-        w = np.concatenate([w, np.zeros(pad, np.float32)])
-        nid = np.concatenate([nid, np.full(pad, -1, np.int32)])
+    xb, yy, w, nid = pad_row_arrays(
+        binned.x_binned, y, w, np.zeros(N, np.int32), dr
+    )
     fpad = (-F) % df
     if fpad:
         xb = np.concatenate([xb, np.zeros((len(xb), fpad), np.int32)], axis=1)
