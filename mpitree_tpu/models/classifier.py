@@ -32,6 +32,7 @@ from mpitree_tpu.core.host_builder import build_tree_host
 from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.predict import device_tree_arrays, predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
+from mpitree_tpu.utils.elastic import device_failover
 from mpitree_tpu.utils.export import export_tree_text
 from mpitree_tpu.utils.importances import feature_importances
 from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
@@ -171,14 +172,33 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
             mesh = mesh_lib.resolve_mesh(
                 backend=self.backend, n_devices=self.n_devices
             )
-            res = build_tree(
-                binned, y_enc, config=cfg, mesh=mesh, n_classes=len(classes),
-                sample_weight=sw, timer=timer, return_leaf_ids=refine,
-                feature_sampler=sampler,
+
+            def _dev():
+                res = build_tree(
+                    binned, y_enc, config=cfg, mesh=mesh,
+                    n_classes=len(classes), sample_weight=sw, timer=timer,
+                    return_leaf_ids=refine, feature_sampler=sampler,
+                )
+                # The build maintains row->leaf ids on device; fetching them
+                # here spares the refine a second full-matrix descent (and X
+                # upload).
+                return res if refine else (res, None)
+
+            def _host():
+                # Elastic recovery (utils/elastic.py): the host tier
+                # consumes the same binned matrix and produces the identical
+                # tree, so a lost accelerator costs wall-clock, not the fit.
+                with timer.phase("host_build"):
+                    res = build_tree_host(
+                        binned, y_enc, config=cfg, n_classes=len(classes),
+                        sample_weight=sw, return_leaf_ids=refine,
+                        feature_sampler=sampler,
+                    )
+                    return res if refine else (res, None)
+
+            self.tree_, leaf_ids = device_failover(
+                _dev, _host, what=f"{type(self).__name__}.fit device build"
             )
-            # The build maintains row->leaf ids on device; fetching them here
-            # spares the refine a second full-matrix descent (and X upload).
-            self.tree_, leaf_ids = res if refine else (res, None)
         if refine:
             from mpitree_tpu.core.hybrid_builder import apply_refine
 

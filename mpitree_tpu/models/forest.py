@@ -45,6 +45,7 @@ from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.sampling import NodeFeatureSampler, n_subspace_features
 from mpitree_tpu.ops.predict import WeakIdCache, predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
+from mpitree_tpu.utils.elastic import ForestCheckpoint, device_failover
 from mpitree_tpu.utils.validation import (
     apply_class_weight,
     min_child_weight,
@@ -72,7 +73,7 @@ class _BaseForest(BaseEstimator):
                  oob_score=False, min_weight_fraction_leaf=0.0,
                  min_samples_leaf=1,
                  random_state=None, n_devices=None,
-                 backend=None, refine_depth="auto"):
+                 backend=None, refine_depth="auto", checkpoint=None):
         self.n_estimators = n_estimators
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
@@ -88,6 +89,10 @@ class _BaseForest(BaseEstimator):
         self.n_devices = n_devices
         self.backend = backend
         self.refine_depth = refine_depth
+        # Optional .npz path for incremental checkpoint/resume of the
+        # forest build (utils/elastic.py) — the recovery story SURVEY §5
+        # lists as absent from the reference.
+        self.checkpoint = checkpoint
 
     def _pop_oob_masks(self):
         """Consume the fit-time bootstrap OOB masks (they must not persist —
@@ -165,10 +170,12 @@ class _BaseForest(BaseEstimator):
         # fused tree-sharded program.
         node_mode = self.max_features_mode == "node" and k < X.shape[1]
 
-        trees = []
-        leaf_ids = []  # per tree, only kept when the hybrid tail runs
-        tree_w, tree_mask, tree_sampler = [], [], []
-        weights, masks, floors = [], [], []
+        # ---- phase A: every per-tree RNG draw happens up front -----------
+        # (bootstrap multiplicities, OOB masks, feature subspaces). The
+        # build phase below then only consumes indices — which is what
+        # makes checkpoint/resume bit-identical to an uninterrupted fit:
+        # a resumed run replays the same draws and skips finished trees.
+        tree_w, tree_b, tree_mask, tree_sampler = [], [], [], []
         self._oob_masks = [] if self.oob_score else None
         for _ in range(self.n_estimators):
             # Bootstrap multiplicities compose multiplicatively with any
@@ -195,66 +202,171 @@ class _BaseForest(BaseEstimator):
                 n_cand[keep] = binned.n_cand[keep]
                 b = dataclasses.replace(binned, n_cand=n_cand)
             tree_w.append(w)
+            tree_b.append(b)
             tree_mask.append(fmask)
             tree_sampler.append(sampler)
-            if use_host:
-                res = build_tree_host(
-                    b, y_enc, config=tree_cfg(w), n_classes=n_classes,
-                    sample_weight=w, refit_targets=refit_targets,
-                    return_leaf_ids=refine, feature_sampler=sampler,
-                )
-                trees.append(res[0] if refine else res)
-                if refine:
-                    leaf_ids.append(res[1])
-            elif node_mode or self._per_tree_device_builds():
-                # levelwise engine / debug mode / per-node sampling:
-                # per-tree builds keep the instrumentation, determinism
-                # checks, and node-key threading build_tree wires up.
-                res = build_tree(
-                    b, y_enc, config=tree_cfg(w), mesh=mesh,
-                    n_classes=n_classes, sample_weight=w,
-                    refit_targets=refit_targets, return_leaf_ids=refine,
-                    feature_sampler=sampler,
-                )
-                trees.append(res[0] if refine else res)
-                if refine:
-                    leaf_ids.append(res[1])
-            else:
-                # Device trees batch into ONE tree-sharded program below.
-                weights.append(np.ones(n, np.float32) if w is None else w)
-                masks.append(b.candidate_mask())
-                floors.append(tree_cfg(w).min_child_weight)
-        if weights:
-            res = build_forest_fused(
-                binned, y_enc, config=cfg, mesh=mesh,
-                weights=np.stack(weights), cand_masks=np.stack(masks),
-                n_classes=n_classes, refit_targets=refit_targets,
-                integer_counts=integer_weights(sample_weight),
-                return_leaf_ids=refine,
-                min_child_weights=np.asarray(floors, np.float32),
-            )
-            if refine:
-                trees, nid_all = res
-                leaf_ids = list(nid_all)
-            else:
-                trees = res
-        if refine:
+
+        # ---- phase B: grouped builds with failover + checkpointing -------
+        def finish(i, tree, ids):
+            """Per-tree hybrid refine tail (final form, checkpoint-safe)."""
+            if not refine:
+                return tree
             from mpitree_tpu.core.hybrid_builder import apply_refine
             from mpitree_tpu.utils.profiling import PhaseTimer
 
-            timer = PhaseTimer(enabled=False)
-            trees = [
-                apply_refine(
-                    t, ids, X, y_enc, cfg=tree_cfg(w),
-                    max_depth=self.max_depth,
-                    rd=rd, timer=timer, n_classes=n_classes,
-                    sample_weight=w, refit_targets=refit_targets,
-                    feature_mask=fm, feature_sampler=sm,
+            return apply_refine(
+                tree, ids, X, y_enc, cfg=tree_cfg(tree_w[i]),
+                max_depth=self.max_depth, rd=rd,
+                timer=PhaseTimer(enabled=False), n_classes=n_classes,
+                sample_weight=tree_w[i], refit_targets=refit_targets,
+                feature_mask=tree_mask[i], feature_sampler=tree_sampler[i],
+            )
+
+        def host_raw(i):
+            """The one host-tier build call every path (primary host mode
+            and both failover sites) shares: (tree, leaf_ids-or-None)."""
+            res = build_tree_host(
+                tree_b[i], y_enc, config=tree_cfg(tree_w[i]),
+                n_classes=n_classes, sample_weight=tree_w[i],
+                refit_targets=refit_targets, return_leaf_ids=refine,
+                feature_sampler=tree_sampler[i],
+            )
+            return res if refine else (res, None)
+
+        def build_one_host(i):
+            return finish(i, *host_raw(i))
+
+        def build_one_device(i):
+            # levelwise engine / debug mode / per-node sampling: per-tree
+            # builds keep the instrumentation, determinism checks, and
+            # node-key threading build_tree wires up. A lost accelerator
+            # costs wall-clock, not the fit (utils/elastic.py).
+            def dev():
+                res = build_tree(
+                    tree_b[i], y_enc, config=tree_cfg(tree_w[i]), mesh=mesh,
+                    n_classes=n_classes, sample_weight=tree_w[i],
+                    refit_targets=refit_targets, return_leaf_ids=refine,
+                    feature_sampler=tree_sampler[i],
                 )
-                for t, ids, w, fm, sm in zip(
-                    trees, leaf_ids, tree_w, tree_mask, tree_sampler
+                return res if refine else (res, None)
+
+            t, ids = device_failover(
+                dev, lambda: host_raw(i),
+                what=f"forest tree {i} device build",
+            )
+            return finish(i, t, ids)
+
+        def build_group(idxs):
+            """Device trees batch into ONE tree-sharded program."""
+            ws = np.stack([
+                np.ones(n, np.float32) if tree_w[i] is None else tree_w[i]
+                for i in idxs
+            ])
+            cms = np.stack([tree_b[i].candidate_mask() for i in idxs])
+            fls = np.asarray(
+                [tree_cfg(tree_w[i]).min_child_weight for i in idxs],
+                np.float32,
+            )
+
+            def dev():
+                return build_forest_fused(
+                    binned, y_enc, config=cfg, mesh=mesh, weights=ws,
+                    cand_masks=cms, n_classes=n_classes,
+                    refit_targets=refit_targets,
+                    integer_counts=integer_weights(sample_weight),
+                    return_leaf_ids=refine, min_child_weights=fls,
                 )
-            ]
+
+            def host():
+                out = [host_raw(i) for i in idxs]
+                if refine:
+                    return [o[0] for o in out], [o[1] for o in out]
+                return [o[0] for o in out]
+
+            res = device_failover(dev, host, what="forest group device build")
+            if refine:
+                gtrees, nid_all = res
+                return [
+                    finish(i, t, ids)
+                    for i, t, ids in zip(idxs, gtrees, list(nid_all))
+                ]
+            return [finish(i, t, None) for i, t in zip(idxs, res)]
+
+        ck = None
+        start = 0
+        trees: list = []
+        if getattr(self, "checkpoint", None):
+            import numbers
+
+            if not isinstance(self.random_state, numbers.Integral):
+                # Resume replays phase A's draws; with random_state=None
+                # (fresh entropy) or a stateful Generator the re-run's
+                # draws differ, and resuming would silently mix two
+                # forests (and mispair OOB masks with trees).
+                warnings.warn(
+                    "forest checkpointing requires a fixed integer "
+                    "random_state so a resumed fit replays the same "
+                    "bootstrap/feature draws; checkpoint disabled",
+                    stacklevel=3,
+                )
+            else:
+                params = {
+                    k_: v for k_, v in self.get_params().items()
+                    if k_ != "checkpoint"  # moving the file must not restart
+                }
+                params["task"] = task
+                ck = ForestCheckpoint.open(
+                    self.checkpoint, params, X, y_enc, sample_weight
+                )
+                start = min(len(ck.trees), self.n_estimators)
+                trees = list(ck.trees[:start])
+
+        batched = not (
+            use_host or node_mode or self._per_tree_device_builds()
+        )
+        remaining = list(range(start, self.n_estimators))
+        if batched:
+            if ck is not None and remaining:
+                # Checkpoint granularity = the tree-axis width the fused
+                # builder will actually pick (same dataset_bytes/HBM-guard
+                # inputs): each group is one device program, persisted as
+                # it lands, so a preemption costs at most one group.
+                from mpitree_tpu.core import fused_builder as _fb
+
+                g, _ = mesh_lib.tree_data_shape(
+                    mesh.size, self.n_estimators,
+                    dataset_bytes=binned.x_binned.nbytes,
+                    hbm_budget=_fb.FOREST_HBM_BUDGET_BYTES,
+                )
+                groups = [
+                    remaining[j:j + g] for j in range(0, len(remaining), g)
+                ]
+            else:
+                groups = [remaining] if remaining else []
+            for idxs in groups:
+                new = build_group(idxs)
+                trees.extend(new)
+                if ck is not None:
+                    ck.append(new)
+        else:
+            # Flush the checkpoint per batch of trees, not per tree: each
+            # append rewrites the whole file, so per-tree flushes would
+            # cost O(T^2) write traffic (ForestCheckpoint.append).
+            g = 8
+            chunks = (
+                [remaining] if ck is None
+                else [remaining[j:j + g] for j in range(0, len(remaining), g)]
+            )
+            for chunk in chunks:
+                new = [
+                    build_one_host(i) if use_host else build_one_device(i)
+                    for i in chunk
+                ]
+                trees.extend(new)
+                if ck is not None:
+                    ck.append(new)
+        if ck is not None:
+            ck.done()
         return trees
 
     @staticmethod
@@ -348,7 +460,8 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
                  oob_score=False, class_weight=None,
                  min_weight_fraction_leaf=0.0, min_samples_leaf=1,
                  random_state=None,
-                 n_devices=None, backend=None, refine_depth="auto"):
+                 n_devices=None, backend=None, refine_depth="auto",
+                 checkpoint=None):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
@@ -357,7 +470,7 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
             min_weight_fraction_leaf=min_weight_fraction_leaf,
             min_samples_leaf=min_samples_leaf,
             random_state=random_state, n_devices=n_devices, backend=backend,
-            refine_depth=refine_depth,
+            refine_depth=refine_depth, checkpoint=checkpoint,
         )
         self.criterion = criterion
         self.class_weight = class_weight
@@ -428,7 +541,8 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
                  bootstrap=True, max_features=None, max_features_mode="node",
                  oob_score=False, min_weight_fraction_leaf=0.0,
                  min_samples_leaf=1, random_state=None,
-                 n_devices=None, backend=None, refine_depth="auto"):
+                 n_devices=None, backend=None, refine_depth="auto",
+                 checkpoint=None):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
@@ -437,7 +551,7 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
             min_weight_fraction_leaf=min_weight_fraction_leaf,
             min_samples_leaf=min_samples_leaf,
             random_state=random_state, n_devices=n_devices, backend=backend,
-            refine_depth=refine_depth,
+            refine_depth=refine_depth, checkpoint=checkpoint,
         )
 
     def fit(self, X, y, sample_weight=None):

@@ -25,6 +25,7 @@ from mpitree_tpu.core.host_builder import build_tree_host
 from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.predict import device_tree_arrays, predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
+from mpitree_tpu.utils.elastic import device_failover
 from mpitree_tpu.utils.export import export_tree_text
 from mpitree_tpu.utils.importances import feature_importances
 from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
@@ -111,14 +112,31 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
             mesh = mesh_lib.resolve_mesh(
                 backend=self.backend, n_devices=self.n_devices
             )
-            res = build_tree(
-                binned, y_c, config=cfg, mesh=mesh, sample_weight=sw,
-                refit_targets=y64, timer=timer, return_leaf_ids=refine,
-                feature_sampler=sampler,
+
+            def _dev():
+                res = build_tree(
+                    binned, y_c, config=cfg, mesh=mesh, sample_weight=sw,
+                    refit_targets=y64, timer=timer, return_leaf_ids=refine,
+                    feature_sampler=sampler,
+                )
+                # Row->leaf ids come straight off the build's device state;
+                # a second full-matrix descent would re-upload X for nothing.
+                return res if refine else (res, None)
+
+            def _host():
+                # Elastic recovery (utils/elastic.py): same binned inputs,
+                # identical tree — a lost accelerator costs wall-clock only.
+                with timer.phase("host_build"):
+                    res = build_tree_host(
+                        binned, y_c, config=cfg, sample_weight=sw,
+                        refit_targets=y64, return_leaf_ids=refine,
+                        feature_sampler=sampler,
+                    )
+                    return res if refine else (res, None)
+
+            self.tree_, leaf_ids = device_failover(
+                _dev, _host, what=f"{type(self).__name__}.fit device build"
             )
-            # Row->leaf ids come straight off the build's device state; a
-            # second full-matrix descent would re-upload X for nothing.
-            self.tree_, leaf_ids = res if refine else (res, None)
         if refine:
             from mpitree_tpu.core.hybrid_builder import apply_refine
 

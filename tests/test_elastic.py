@@ -1,0 +1,230 @@
+"""Failure detection + elastic recovery (SURVEY §5's absent subsystem).
+
+The reference aborts the whole job when a rank dies inside
+``comm.allgather`` (``decision_tree.py:456``). Here a lost accelerator is
+detected (``utils/elastic.py``), the build falls over to the host tier
+(identical tree — the engine-identity contract), and forest fits can
+checkpoint/resume. These tests simulate device loss by raising the same
+exception shapes PJRT produces.
+"""
+
+import numpy as np
+import pytest
+
+from mpitree_tpu import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    RandomForestClassifier,
+)
+from mpitree_tpu.utils import elastic
+
+
+class FakeXlaRuntimeError(Exception):
+    """Stands in for jaxlib's XlaRuntimeError (same type name matching)."""
+
+
+FakeXlaRuntimeError.__name__ = "XlaRuntimeError"
+
+
+def _data(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = ((X[:, 0] > 0) + 2 * (X[:, 1] > 0.3)).astype(np.int64)
+    return X, y
+
+
+def test_is_device_failure_classification():
+    assert elastic.is_device_failure(
+        FakeXlaRuntimeError("UNAVAILABLE: tunnel lost")
+    )
+    assert elastic.is_device_failure(
+        FakeXlaRuntimeError("INTERNAL: compiler crash")
+    )
+    assert elastic.is_device_failure(RuntimeError("UNAVAILABLE: socket closed"))
+    assert elastic.is_device_failure(RuntimeError("DEADLINE_EXCEEDED"))
+    assert elastic.is_device_failure(OSError("PJRT transport reset"))
+    # program bugs and user errors must never be swallowed
+    assert not elastic.is_device_failure(
+        FakeXlaRuntimeError("INVALID_ARGUMENT: shape mismatch")
+    )
+    assert not elastic.is_device_failure(
+        OSError("No space left on device")
+    )
+    assert not elastic.is_device_failure(ValueError("bad input"))
+    assert not elastic.is_device_failure(RuntimeError("some logic bug"))
+    assert not elastic.is_device_failure(KeyError("x"))
+
+
+def test_single_tree_failover_builds_identical_tree(monkeypatch):
+    """A device loss mid-fit falls over to the host tier and produces the
+    identical tree a healthy device build would have."""
+    X, y = _data()
+    healthy = DecisionTreeClassifier(max_depth=6, backend="cpu").fit(X, y)
+
+    from mpitree_tpu.models import classifier as clf_mod
+
+    def dying_build(*a, **k):
+        raise FakeXlaRuntimeError("UNAVAILABLE: tunnel lost")
+
+    monkeypatch.setattr(clf_mod, "build_tree", dying_build)
+    with pytest.warns(UserWarning, match="device failure"):
+        recovered = DecisionTreeClassifier(max_depth=6, backend="cpu").fit(X, y)
+    assert recovered.export_text() == healthy.export_text()
+    np.testing.assert_array_equal(
+        recovered.tree_.count, healthy.tree_.count
+    )
+
+
+def test_single_tree_failover_regressor(monkeypatch):
+    X, y = _data()
+    yr = (X[:, 0] * 2 + np.sin(X[:, 1])).astype(np.float64)
+    healthy = DecisionTreeRegressor(max_depth=5, backend="cpu").fit(X, yr)
+
+    from mpitree_tpu.models import regressor as reg_mod
+
+    monkeypatch.setattr(
+        reg_mod, "build_tree",
+        lambda *a, **k: (_ for _ in ()).throw(
+            FakeXlaRuntimeError("DATA_LOSS")
+        ),
+    )
+    with pytest.warns(UserWarning, match="device failure"):
+        rec = DecisionTreeRegressor(max_depth=5, backend="cpu").fit(X, yr)
+    np.testing.assert_array_equal(rec.predict(X), healthy.predict(X))
+
+
+def test_user_errors_never_fail_over():
+    X, y = _data()
+    with pytest.raises(ValueError):
+        DecisionTreeClassifier(min_samples_leaf=-3, backend="cpu").fit(X, y)
+
+
+def test_elastic_opt_out(monkeypatch):
+    X, y = _data()
+    from mpitree_tpu.models import classifier as clf_mod
+
+    monkeypatch.setattr(
+        clf_mod, "build_tree",
+        lambda *a, **k: (_ for _ in ()).throw(
+            FakeXlaRuntimeError("UNAVAILABLE")
+        ),
+    )
+    monkeypatch.setenv("MPITREE_TPU_ELASTIC", "0")
+    with pytest.raises(FakeXlaRuntimeError):
+        DecisionTreeClassifier(max_depth=4, backend="cpu").fit(X, y)
+
+
+def test_forest_group_failover(monkeypatch):
+    """Losing the device during the batched forest build falls over to
+    per-tree host builds — same trees."""
+    X, y = _data(600)
+    kw = dict(n_estimators=3, max_depth=5, random_state=0, backend="cpu")
+    healthy = RandomForestClassifier(**kw).fit(X, y)
+
+    from mpitree_tpu.models import forest as f_mod
+
+    monkeypatch.setattr(
+        f_mod, "build_forest_fused",
+        lambda *a, **k: (_ for _ in ()).throw(
+            FakeXlaRuntimeError("ABORTED: device reset")
+        ),
+    )
+    with pytest.warns(UserWarning, match="device failure"):
+        rec = RandomForestClassifier(**kw).fit(X, y)
+    assert len(rec.trees_) == len(healthy.trees_)
+    for a, b in zip(rec.trees_, healthy.trees_):
+        np.testing.assert_array_equal(a.feature, b.feature)
+        np.testing.assert_allclose(a.count, b.count, rtol=1e-6)
+
+
+def test_forest_checkpoint_resume_bit_identical(tmp_path):
+    """A fit interrupted after k groups resumes and finishes with trees
+    bit-identical to an uninterrupted fit."""
+    X, y = _data(600, seed=1)
+    ckpt = str(tmp_path / "forest.ckpt.npz")
+    kw = dict(n_estimators=6, max_depth=5, random_state=7, backend="cpu")
+
+    ref = RandomForestClassifier(**kw).fit(X, y)
+
+    # Interrupt: let two checkpoint appends land, then die.
+    from mpitree_tpu.utils.elastic import ForestCheckpoint
+
+    orig_append = ForestCheckpoint.append
+    calls = {"n": 0}
+
+    def dying_append(self, new_trees):
+        orig_append(self, new_trees)
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise KeyboardInterrupt("preempted")
+
+    ForestCheckpoint.append = dying_append
+    try:
+        with pytest.raises(KeyboardInterrupt):
+            RandomForestClassifier(checkpoint=ckpt, **kw).fit(X, y)
+    finally:
+        ForestCheckpoint.append = orig_append
+
+    import os
+
+    assert os.path.exists(ckpt), "checkpoint must survive the crash"
+
+    resumed = RandomForestClassifier(checkpoint=ckpt, **kw).fit(X, y)
+    assert not os.path.exists(ckpt), "finished fit removes its checkpoint"
+    assert len(resumed.trees_) == len(ref.trees_)
+    for a, b in zip(resumed.trees_, ref.trees_):
+        np.testing.assert_array_equal(a.feature, b.feature)
+        np.testing.assert_allclose(a.threshold, b.threshold, equal_nan=True)
+        np.testing.assert_allclose(a.count, b.count, rtol=1e-6)
+    np.testing.assert_array_equal(resumed.predict(X), ref.predict(X))
+
+
+def test_forest_checkpoint_fingerprint_guards_inputs(tmp_path):
+    """Resuming onto different data/params restarts instead of mixing."""
+    X, y = _data(500, seed=2)
+    ckpt = str(tmp_path / "f.npz")
+    kw = dict(n_estimators=2, max_depth=4, random_state=0, backend="cpu")
+
+    from mpitree_tpu.utils.elastic import ForestCheckpoint, _fingerprint
+
+    rf = RandomForestClassifier(checkpoint=ckpt, **kw)
+    rf.fit(X, y)  # completes -> checkpoint removed
+    # craft a stale checkpoint with a wrong fingerprint
+    ck = ForestCheckpoint(ckpt, "deadbeef")
+    ck.append(list(rf.trees_))
+    with pytest.warns(UserWarning, match="not resumable"):
+        fresh = RandomForestClassifier(checkpoint=ckpt, **kw).fit(X, y)
+    for a, b in zip(fresh.trees_, rf.trees_):
+        np.testing.assert_array_equal(a.feature, b.feature)
+    # fingerprint is sensitive to both params and data
+    p = {"a": 1}
+    assert _fingerprint(p, X, y, None) != _fingerprint(p, X, y + 1, None)
+    assert _fingerprint({"a": 2}, X, y, None) != _fingerprint(p, X, y, None)
+
+
+def test_checkpoint_requires_fixed_seed(tmp_path):
+    """random_state=None draws fresh entropy per fit, so a resume would
+    silently mix two forests — checkpointing refuses and warns."""
+    X, y = _data(300, seed=4)
+    ckpt = str(tmp_path / "no-seed.npz")
+    import os
+
+    with pytest.warns(UserWarning, match="fixed integer random_state"):
+        RandomForestClassifier(
+            n_estimators=2, max_depth=3, checkpoint=ckpt, backend="cpu"
+        ).fit(X, y)
+    assert not os.path.exists(ckpt)
+
+
+def test_checkpointed_equals_uncheckpointed(tmp_path):
+    """The checkpoint path (grouped builds) and the plain path (one fused
+    program) produce identical forests."""
+    X, y = _data(500, seed=3)
+    kw = dict(n_estimators=5, max_depth=5, random_state=1, backend="cpu")
+    plain = RandomForestClassifier(**kw).fit(X, y)
+    ck = RandomForestClassifier(
+        checkpoint=str(tmp_path / "c.npz"), **kw
+    ).fit(X, y)
+    for a, b in zip(plain.trees_, ck.trees_):
+        np.testing.assert_array_equal(a.feature, b.feature)
+        np.testing.assert_allclose(a.count, b.count, rtol=1e-6)
