@@ -88,6 +88,10 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
     random_state : int, optional
         Seed for ``max_features`` draws; fits are deterministic either way
         (``None`` reads as seed 0).
+    ccp_alpha : float, default=0.0
+        Minimal cost-complexity pruning strength (sklearn semantics,
+        ``utils/pruning.py``) — applied host-side to the finished tree, so
+        every build engine prunes identically.
     n_devices : int, "all", or None, default=None
         Data-mesh width; ``None`` = single device.
     backend : str, optional
@@ -113,7 +117,8 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
                  max_features=None, class_weight=None,
                  min_weight_fraction_leaf=0.0, min_samples_leaf=1,
                  random_state=None,
-                 n_devices=None, backend=None, refine_depth="auto"):
+                 n_devices=None, backend=None, refine_depth="auto",
+                 ccp_alpha=0.0):
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
         self.criterion = criterion
@@ -127,6 +132,7 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         self.n_devices = n_devices
         self.backend = backend
         self.refine_depth = refine_depth
+        self.ccp_alpha = ccp_alpha
 
     # -- fitting -----------------------------------------------------------
     def fit(self, X, y, sample_weight=None):
@@ -208,8 +214,30 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
                 n_classes=len(classes), sample_weight=sw,
                 feature_sampler=sampler,
             )
+        if self.ccp_alpha:
+            from mpitree_tpu.utils.pruning import ccp_prune
+
+            with timer.phase("prune"):
+                self.tree_ = ccp_prune(
+                    self.tree_, self.ccp_alpha, task="classification"
+                )
         self.fit_stats_ = timer.summary() if timer.enabled else None
         return self
+
+    def cost_complexity_pruning_path(self, X, y, sample_weight=None):
+        """sklearn's diagnostic: effective alphas and total leaf
+        impurities along the minimal cost-complexity pruning path of the
+        tree this estimator would grow (``utils/pruning.py``)."""
+        from sklearn.base import clone
+        from sklearn.utils import Bunch
+
+        from mpitree_tpu.utils.pruning import pruning_path
+
+        est = clone(self)
+        est.ccp_alpha = 0.0
+        est.fit(X, y, sample_weight=sample_weight)
+        alphas, impurities = pruning_path(est.tree_, task=self._task)
+        return Bunch(ccp_alphas=alphas, impurities=impurities)
 
     # -- inference ---------------------------------------------------------
     def _leaf_ids(self, X: np.ndarray) -> np.ndarray:

@@ -73,7 +73,8 @@ class _BaseForest(BaseEstimator):
                  oob_score=False, min_weight_fraction_leaf=0.0,
                  min_samples_leaf=1,
                  random_state=None, n_devices=None,
-                 backend=None, refine_depth="auto", checkpoint=None):
+                 backend=None, refine_depth="auto", checkpoint=None,
+                 ccp_alpha=0.0):
         self.n_estimators = n_estimators
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
@@ -93,6 +94,7 @@ class _BaseForest(BaseEstimator):
         # forest build (utils/elastic.py) — the recovery story SURVEY §5
         # lists as absent from the reference.
         self.checkpoint = checkpoint
+        self.ccp_alpha = ccp_alpha
 
     def _pop_oob_masks(self):
         """Consume the fit-time bootstrap OOB masks (they must not persist —
@@ -208,19 +210,25 @@ class _BaseForest(BaseEstimator):
 
         # ---- phase B: grouped builds with failover + checkpointing -------
         def finish(i, tree, ids):
-            """Per-tree hybrid refine tail (final form, checkpoint-safe)."""
-            if not refine:
-                return tree
-            from mpitree_tpu.core.hybrid_builder import apply_refine
-            from mpitree_tpu.utils.profiling import PhaseTimer
+            """Per-tree hybrid refine tail + ccp pruning (final form,
+            checkpoint-safe)."""
+            if refine:
+                from mpitree_tpu.core.hybrid_builder import apply_refine
+                from mpitree_tpu.utils.profiling import PhaseTimer
 
-            return apply_refine(
-                tree, ids, X, y_enc, cfg=tree_cfg(tree_w[i]),
-                max_depth=self.max_depth, rd=rd,
-                timer=PhaseTimer(enabled=False), n_classes=n_classes,
-                sample_weight=tree_w[i], refit_targets=refit_targets,
-                feature_mask=tree_mask[i], feature_sampler=tree_sampler[i],
-            )
+                tree = apply_refine(
+                    tree, ids, X, y_enc, cfg=tree_cfg(tree_w[i]),
+                    max_depth=self.max_depth, rd=rd,
+                    timer=PhaseTimer(enabled=False), n_classes=n_classes,
+                    sample_weight=tree_w[i], refit_targets=refit_targets,
+                    feature_mask=tree_mask[i],
+                    feature_sampler=tree_sampler[i],
+                )
+            if getattr(self, "ccp_alpha", 0.0):
+                from mpitree_tpu.utils.pruning import ccp_prune
+
+                tree = ccp_prune(tree, self.ccp_alpha, task=task)
+            return tree
 
         def host_raw(i):
             """The one host-tier build call every path (primary host mode
@@ -338,6 +346,11 @@ class _BaseForest(BaseEstimator):
                     dataset_bytes=binned.x_binned.nbytes,
                     hbm_budget=_fb.FOREST_HBM_BUDGET_BYTES,
                 )
+                # Floor the group width: on a narrow tree axis (e.g. one
+                # device, where the fused builder lax.maps the whole batch
+                # in one program anyway) per-tree groups would mean O(T^2)
+                # checkpoint rewrites and one program launch per tree.
+                g = max(g, 8)
                 groups = [
                     remaining[j:j + g] for j in range(0, len(remaining), g)
                 ]
@@ -461,7 +474,7 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
                  min_weight_fraction_leaf=0.0, min_samples_leaf=1,
                  random_state=None,
                  n_devices=None, backend=None, refine_depth="auto",
-                 checkpoint=None):
+                 checkpoint=None, ccp_alpha=0.0):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
@@ -471,6 +484,7 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
             min_samples_leaf=min_samples_leaf,
             random_state=random_state, n_devices=n_devices, backend=backend,
             refine_depth=refine_depth, checkpoint=checkpoint,
+            ccp_alpha=ccp_alpha,
         )
         self.criterion = criterion
         self.class_weight = class_weight
@@ -542,7 +556,7 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
                  oob_score=False, min_weight_fraction_leaf=0.0,
                  min_samples_leaf=1, random_state=None,
                  n_devices=None, backend=None, refine_depth="auto",
-                 checkpoint=None):
+                 checkpoint=None, ccp_alpha=0.0):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
@@ -552,6 +566,7 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
             min_samples_leaf=min_samples_leaf,
             random_state=random_state, n_devices=n_devices, backend=backend,
             refine_depth=refine_depth, checkpoint=checkpoint,
+            ccp_alpha=ccp_alpha,
         )
 
     def fit(self, X, y, sample_weight=None):

@@ -51,7 +51,8 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
                  criterion="squared_error", max_bins=256, binning="auto",
                  max_features=None, min_weight_fraction_leaf=0.0,
                  min_samples_leaf=1, random_state=None,
-                 n_devices=None, backend=None, refine_depth="auto"):
+                 n_devices=None, backend=None, refine_depth="auto",
+                 ccp_alpha=0.0):
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
         self.criterion = criterion
@@ -64,6 +65,7 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
         self.n_devices = n_devices
         self.backend = backend
         self.refine_depth = refine_depth
+        self.ccp_alpha = ccp_alpha
 
     def fit(self, X, y, sample_weight=None):
         if self.criterion not in ("squared_error", "mse"):
@@ -146,8 +148,30 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
                 sample_weight=sw, refit_targets=y64,
                 feature_sampler=sampler,
             )
+        if self.ccp_alpha:
+            from mpitree_tpu.utils.pruning import ccp_prune
+
+            with timer.phase("prune"):
+                self.tree_ = ccp_prune(
+                    self.tree_, self.ccp_alpha, task="regression"
+                )
         self.fit_stats_ = timer.summary() if timer.enabled else None
         return self
+
+    def cost_complexity_pruning_path(self, X, y, sample_weight=None):
+        """sklearn's diagnostic: effective alphas and total leaf
+        impurities along the minimal cost-complexity pruning path
+        (``utils/pruning.py``)."""
+        from sklearn.base import clone
+        from sklearn.utils import Bunch
+
+        from mpitree_tpu.utils.pruning import pruning_path
+
+        est = clone(self)
+        est.ccp_alpha = 0.0
+        est.fit(X, y, sample_weight=sample_weight)
+        alphas, impurities = pruning_path(est.tree_, task=self._task)
+        return Bunch(ccp_alphas=alphas, impurities=impurities)
 
     def _leaf_ids(self, X: np.ndarray) -> np.ndarray:
         t = self.tree_

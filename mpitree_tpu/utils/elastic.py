@@ -72,11 +72,14 @@ def is_device_failure(exc: BaseException) -> bool:
     friends — user errors — never do.
     """
     name = type(exc).__name__
-    msg = str(exc)
+    msg = str(exc).lower()
     if name in ("XlaRuntimeError", "JaxRuntimeError"):
-        return any(m in msg for m in _TRANSPORT_MARKERS + ("INTERNAL",))
-    if isinstance(exc, (RuntimeError, OSError, ConnectionError)):
-        return any(m in msg for m in _TRANSPORT_MARKERS)
+        markers = _TRANSPORT_MARKERS + ("INTERNAL",)
+        return any(m.lower() in msg for m in markers)
+    if isinstance(exc, ConnectionError):
+        return True  # ConnectionReset/Refused/Aborted ARE transport losses
+    if isinstance(exc, (RuntimeError, OSError)):
+        return any(m.lower() in msg for m in _TRANSPORT_MARKERS)
     return False
 
 

@@ -1,0 +1,183 @@
+"""Minimal cost-complexity pruning (sklearn's ``ccp_alpha``), engine-free.
+
+One host-side implementation serves every build engine: pruning operates on
+the finished struct-of-arrays tree (``TreeArrays``), whose per-node f64
+impurities, interior counts/means, and row counts all engines already
+populate — so a pruned device tree equals the pruned host tree by
+construction.
+
+Semantics follow sklearn's weakest-link algorithm: with node risk
+``R(t) = (w_t / w_root) * impurity(t)`` and subtree risk ``R(T_t)`` (sum of
+leaf risks below ``t``), the effective alpha of an interior node is
+``(R(t) - R(T_t)) / (|leaves(T_t)| - 1)``; nodes are collapsed weakest
+first while their effective alpha is ``<= ccp_alpha``. Node weight ``w_t``
+is the weighted class mass for classification and the training row count
+for regression (per-node sample weights are not persisted — identical when
+fits are unweighted, documented divergence otherwise).
+"""
+
+from __future__ import annotations
+
+import heapq
+
+import numpy as np
+
+from mpitree_tpu.core.tree_struct import TreeArrays
+
+
+def _node_weights(tree: TreeArrays, task: str) -> np.ndarray:
+    if task == "classification":
+        return tree.count.sum(axis=1).astype(np.float64)
+    return tree.n_node_samples.astype(np.float64)
+
+
+def _subtree_stats(tree: TreeArrays, r: np.ndarray):
+    """(r_subtree, n_leaves) per node, one reverse pass (children ids are
+    always larger than their parent's — every engine's allocation order)."""
+    n = tree.n_nodes
+    leaf = tree.feature < 0
+    r_sub = np.where(leaf, r, 0.0)
+    leaves = np.where(leaf, 1, 0).astype(np.int64)
+    for i in range(n - 1, 0, -1):
+        p = tree.parent[i]
+        if p >= 0:
+            r_sub[p] += r_sub[i]
+            leaves[p] += leaves[i]
+    return r_sub, leaves
+
+
+def _descendants(tree: TreeArrays, t: int) -> list:
+    out, stack = [], [t]
+    while stack:
+        i = stack.pop()
+        l_, r_ = int(tree.left[i]), int(tree.right[i])
+        for c in (l_, r_):
+            if c >= 0:
+                out.append(c)
+                stack.append(c)
+    return out
+
+
+def ccp_prune(tree: TreeArrays, ccp_alpha: float, *, task: str) -> TreeArrays:
+    """Return the minimal cost-complexity pruning of ``tree`` at
+    ``ccp_alpha`` (the tree itself when ``ccp_alpha <= 0`` or it is a
+    single leaf)."""
+    if ccp_alpha < 0:
+        raise ValueError(f"ccp_alpha must be >= 0, got {ccp_alpha!r}")
+    if ccp_alpha == 0 or tree.n_nodes <= 1:
+        return tree
+    return _prune_impl(tree, ccp_alpha, task)
+
+
+def _prune_impl(tree: TreeArrays, ccp_alpha: float, task: str) -> TreeArrays:
+    """Weakest-link pruning at ``ccp_alpha`` WITHOUT the public zero
+    short-circuit: collapses every node whose effective alpha is
+    ``<= ccp_alpha``, including exactly zero — ``pruning_path`` relies on
+    that to make progress when a split has zero impurity gain."""
+    n = tree.n_nodes
+    w = _node_weights(tree, task)
+    r = (w / max(w[0], 1e-300)) * np.asarray(tree.impurity, np.float64)
+    r_sub, leaves = _subtree_stats(tree, r)
+
+    interior = np.nonzero(tree.feature >= 0)[0]
+    removed = np.zeros(n, bool)   # node no longer exists (inside a cut)
+    collapsed = np.zeros(n, bool)  # interior node turned leaf
+
+    def alpha_eff(t: int) -> float:
+        return (r[t] - r_sub[t]) / max(leaves[t] - 1, 1)
+
+    # Lazy heap: stale entries (outdated alpha, removed/collapsed nodes)
+    # are dropped at pop time by re-checking the current value.
+    heap = [(alpha_eff(t), int(t)) for t in interior]
+    heapq.heapify(heap)
+    while heap:
+        a, t = heapq.heappop(heap)
+        if removed[t] or collapsed[t] or tree.feature[t] < 0:
+            continue
+        cur = alpha_eff(t)
+        if a != cur:  # stale — ancestors' stats moved since this push
+            heapq.heappush(heap, (cur, t))
+            continue
+        if a > ccp_alpha:
+            break
+        collapsed[t] = True
+        for d in _descendants(tree, t):
+            removed[d] = True
+        d_r, d_leaves = r[t] - r_sub[t], 1 - leaves[t]
+        p = int(tree.parent[t])
+        while p >= 0:
+            r_sub[p] += d_r
+            leaves[p] += d_leaves
+            if not (removed[p] or collapsed[p]):
+                heapq.heappush(heap, (alpha_eff(p), p))
+            p = int(tree.parent[p])
+
+    if not collapsed.any():
+        return tree
+
+    # Compact: drop removed nodes, keep original order (preserves the
+    # children-after-parent invariant every consumer relies on).
+    keep = ~removed
+    new_id = np.cumsum(keep) - 1
+    feature = tree.feature[keep].copy()
+    left = tree.left[keep].copy()
+    right = tree.right[keep].copy()
+    threshold = tree.threshold[keep].copy()
+    is_cut = collapsed[keep]
+    feature[is_cut] = -1
+    left[is_cut] = -1
+    right[is_cut] = -1
+    threshold[is_cut] = np.nan
+    remap = np.where(
+        (left >= 0), new_id[np.clip(left, 0, None)], -1
+    ).astype(np.int32)
+    left = remap
+    right = np.where(
+        (right >= 0), new_id[np.clip(right, 0, None)], -1
+    ).astype(np.int32)
+    parent = tree.parent[keep]
+    parent = np.where(
+        parent >= 0, new_id[np.clip(parent, 0, None)], -1
+    ).astype(np.int32)
+
+    return TreeArrays(
+        feature=feature.astype(np.int32),
+        threshold=threshold,
+        left=left,
+        right=right,
+        parent=parent,
+        depth=tree.depth[keep].copy(),
+        value=tree.value[keep].copy(),
+        count=tree.count[keep].copy(),
+        n_node_samples=tree.n_node_samples[keep].copy(),
+        impurity=tree.impurity[keep].copy(),
+    )
+
+
+def pruning_path(tree: TreeArrays, *, task: str):
+    """(ccp_alphas, impurities) — sklearn's ``cost_complexity_pruning_path``
+    analogue: the sequence of effective alphas at which the tree collapses,
+    and the total leaf impurity after each collapse."""
+
+    def stats(t):
+        w = _node_weights(t, task)
+        r = (w / max(w[0], 1e-300)) * np.asarray(t.impurity, np.float64)
+        rs, lv = _subtree_stats(t, r)
+        return r, rs, lv
+
+    cur = tree
+    r, rs, lv = stats(cur)
+    alphas, impurities = [0.0], [float(rs[0])]
+    while cur.n_leaves > 1:
+        interior = np.nonzero(cur.feature >= 0)[0]
+        eff = (r[interior] - rs[interior]) / np.maximum(lv[interior] - 1, 1)
+        # Zero-gain splits give eff == 0 (float noise can dip negative);
+        # clamp and use the internal impl, which collapses <= a inclusive —
+        # guaranteed progress, where the public zero short-circuit would
+        # loop forever.
+        a = max(float(eff.min()), 0.0)
+        cur = _prune_impl(cur, a, task)
+        alphas.append(a)
+        r, rs, lv = stats(cur)
+        impurities.append(float(rs[0]))
+    return np.asarray(alphas), np.asarray(impurities)

@@ -142,7 +142,9 @@ def test_forest_checkpoint_resume_bit_identical(tmp_path):
     bit-identical to an uninterrupted fit."""
     X, y = _data(600, seed=1)
     ckpt = str(tmp_path / "forest.ckpt.npz")
-    kw = dict(n_estimators=6, max_depth=5, random_state=7, backend="cpu")
+    # 18 trees span three checkpoint groups (flush floor = 8), so the
+    # simulated preemption lands with real resumable state behind it.
+    kw = dict(n_estimators=18, max_depth=4, random_state=7, backend="cpu")
 
     ref = RandomForestClassifier(**kw).fit(X, y)
 

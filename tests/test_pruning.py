@@ -1,0 +1,147 @@
+"""Minimal cost-complexity pruning (``ccp_alpha``) — sklearn semantics,
+one host-side implementation serving every engine (utils/pruning.py)."""
+
+import numpy as np
+import pytest
+
+from mpitree_tpu import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    RandomForestClassifier,
+)
+from mpitree_tpu.utils.pruning import ccp_prune, pruning_path
+
+
+def _data(n=500, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = ((X[:, 0] > 0) + 2 * (X[:, 1] > 0.3) + (rng.random(n) < 0.2)).astype(
+        np.int64
+    ) % 3
+    return X, y
+
+
+def _weakest_alpha(tree, task):
+    from mpitree_tpu.utils.pruning import _node_weights, _subtree_stats
+
+    w = _node_weights(tree, task)
+    r = (w / w[0]) * tree.impurity
+    rs, lv = _subtree_stats(tree, r)
+    interior = np.nonzero(tree.feature >= 0)[0]
+    if not len(interior):
+        return np.inf
+    return float(
+        ((r[interior] - rs[interior]) / np.maximum(lv[interior] - 1, 1)).min()
+    )
+
+
+def test_alpha_zero_is_identity():
+    X, y = _data()
+    a = DecisionTreeClassifier(max_depth=8, backend="host").fit(X, y)
+    b = DecisionTreeClassifier(
+        max_depth=8, backend="host", ccp_alpha=0.0
+    ).fit(X, y)
+    assert a.tree_.n_nodes == b.tree_.n_nodes
+
+
+def test_pruning_monotone_and_collapses():
+    X, y = _data()
+    leaves = []
+    for alpha in (0.0, 1e-4, 1e-3, 1e-2, 1e-1, 10.0):
+        clf = DecisionTreeClassifier(
+            max_depth=10, backend="host", ccp_alpha=alpha
+        ).fit(X, y)
+        leaves.append(clf.tree_.n_leaves)
+        # weakest-link invariant: every surviving interior node's
+        # effective alpha exceeds the pruning strength
+        assert _weakest_alpha(clf.tree_, "classification") > alpha
+    assert leaves == sorted(leaves, reverse=True)
+    assert leaves[-1] == 1  # huge alpha collapses to the root leaf
+
+
+def test_pruned_tree_structurally_sound():
+    X, y = _data(seed=1)
+    clf = DecisionTreeClassifier(
+        max_depth=10, backend="host", ccp_alpha=3e-3
+    ).fit(X, y)
+    t = clf.tree_
+    for i in range(t.n_nodes):
+        l_, r_ = int(t.left[i]), int(t.right[i])
+        if t.feature[i] < 0:
+            assert l_ == -1 and r_ == -1 and np.isnan(t.threshold[i])
+        else:
+            # children exist, come after their parent, and link back
+            assert l_ > i and r_ > i
+            assert t.parent[l_] == i and t.parent[r_] == i
+    # predictions still well-formed
+    assert clf.predict(X).shape == y.shape
+    assert clf.score(X, y) > 0.5
+
+
+def test_pruning_engine_invariant():
+    """Device and host builds prune to the same tree — the pruning pass
+    consumes only the per-node stats every engine populates identically."""
+    X, y = _data(seed=2)
+    a = DecisionTreeClassifier(
+        max_depth=8, backend="host", ccp_alpha=2e-3, binning="exact"
+    ).fit(X, y)
+    b = DecisionTreeClassifier(
+        max_depth=8, backend="cpu", ccp_alpha=2e-3, binning="exact"
+    ).fit(X, y)
+    np.testing.assert_array_equal(a.tree_.feature, b.tree_.feature)
+    np.testing.assert_allclose(
+        a.tree_.threshold, b.tree_.threshold, equal_nan=True
+    )
+
+
+def test_regressor_pruning():
+    X, _ = _data(seed=3)
+    yr = (X[:, 0] * 2 + np.sin(3 * X[:, 1])).astype(np.float64)
+    full = DecisionTreeRegressor(max_depth=10, backend="host").fit(X, yr)
+    pruned = DecisionTreeRegressor(
+        max_depth=10, backend="host", ccp_alpha=1e-3
+    ).fit(X, yr)
+    assert pruned.tree_.n_leaves < full.tree_.n_leaves
+    assert pruned.score(X, yr) > 0.5
+
+
+def test_pruning_path_matches_refits():
+    """Each path alpha, refit with ccp_alpha just above it, gives the next
+    tree in the path (sklearn's cost_complexity_pruning_path contract)."""
+    X, y = _data(300, seed=4)
+    clf = DecisionTreeClassifier(max_depth=6, backend="host")
+    path = clf.cost_complexity_pruning_path(X, y)
+    assert len(path.ccp_alphas) == len(path.impurities)
+    assert (np.diff(path.ccp_alphas) >= 0).all()
+    assert (np.diff(path.impurities) >= -1e-12).all()
+    # pruning at the largest path alpha leaves the root only
+    top = DecisionTreeClassifier(
+        max_depth=6, backend="host", ccp_alpha=float(path.ccp_alphas[-1])
+    ).fit(X, y)
+    assert top.tree_.n_leaves == 1
+
+
+def test_prune_function_validates():
+    X, y = _data(200, seed=5)
+    clf = DecisionTreeClassifier(max_depth=4, backend="host").fit(X, y)
+    with pytest.raises(ValueError):
+        ccp_prune(clf.tree_, -0.1, task="classification")
+    same = ccp_prune(clf.tree_, 0.0, task="classification")
+    assert same is clf.tree_
+    alphas, _ = pruning_path(clf.tree_, task="classification")
+    assert alphas[0] == 0.0
+
+
+def test_forest_ccp_alpha():
+    X, y = _data(seed=6)
+    plain = RandomForestClassifier(
+        n_estimators=3, max_depth=8, random_state=0, backend="cpu"
+    ).fit(X, y)
+    pruned = RandomForestClassifier(
+        n_estimators=3, max_depth=8, random_state=0, backend="cpu",
+        ccp_alpha=0.02,
+    ).fit(X, y)
+    assert sum(t.n_leaves for t in pruned.trees_) < sum(
+        t.n_leaves for t in plain.trees_
+    )
+    assert pruned.score(X, y) > 0.5
