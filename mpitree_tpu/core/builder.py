@@ -50,6 +50,12 @@ class BuildConfig:
     # it as min_weight_fraction_leaf * total fit weight, sklearn semantics);
     # 0.0 = unconstrained.
     min_child_weight: float = 0.0
+    # sklearn's min_impurity_decrease, pre-scaled by the TOTAL fit weight
+    # (decrease_global = (n_t / W) * (imp_t - cost_t) >= threshold becomes
+    # n_t * (imp_t - cost_t) >= threshold * W = this field). Pre-scaling
+    # makes the rule exact inside hybrid-refine subtree rebuilds, whose
+    # local n_t are already global weights. 0.0 = unconstrained.
+    min_decrease_scaled: float = 0.0
     hist_budget_bytes: int = 4 << 30  # HBM budget for one histogram chunk
     max_frontier_chunk: int = 4096
     max_table_slots: int = 1 << 17  # width of per-level update/counts tables
@@ -579,6 +585,13 @@ def build_tree(
                 pure | dec["constant"] | (n < cfg.min_samples_split)
                 | np.isinf(dec["cost"])
             )
+            if cfg.min_decrease_scaled > 0.0:
+                # sklearn's min_impurity_decrease on the BEST split only
+                with np.errstate(invalid="ignore"):
+                    stop |= (
+                        n * (dec["impurity"] - dec["cost"])
+                        < cfg.min_decrease_scaled
+                    )
 
         tree.feature[ids] = (
             np.full(frontier_size, -1, np.int32) if terminal

@@ -118,7 +118,10 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
     def psum(x):
         return lax.psum(x, psum_axis) if psum_axis is not None else x
 
-    def build(xb, y, nid0, w, cand_mask, mcw):
+    def build(xb, y, nid0, w, cand_mask, mcw, mid):
+        # mid: sklearn's min_impurity_decrease pre-scaled by the total fit
+        # weight (BuildConfig.min_decrease_scaled), a runtime operand so
+        # distinct thresholds share one executable.
         R, F = xb.shape  # F = per-shard feature count on a feature mesh
         # C == n_classes for classification, 3 (moment channels) for
         # regression — the VMEM check covers both payload widths.
@@ -226,6 +229,10 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                 stop = (
                     pure | dec.constant | (n < min_samples_split)
                     | jnp.isinf(dec.cost)
+                    # min_impurity_decrease on the best split; gated on
+                    # mid > 0 so the default never trips on float noise
+                    | ((mid > 0)
+                       & (n * (dec.impurity - dec.cost) < mid))
                 )
                 feat_k = jnp.where(stop, -1, dec.feature).astype(jnp.int32)
                 return feat_k, dec.bin.astype(jnp.int32), dec.counts, n
@@ -389,7 +396,7 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         build,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, FA), P(DATA_AXIS), P(DATA_AXIS),
-                  P(DATA_AXIS), P(FA, None), P()),
+                  P(DATA_AXIS), P(FA, None), P(), P()),
         out_specs=out_specs,
         check_vma=FA is None,  # replicated/varying mixes in the 2-D cond
     )
@@ -426,26 +433,29 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         psum_axis=DATA_AXIS if data_sharded else None,
     )
 
-    def per_device(xb, y, nid0, ws, cand_masks, mcw):
-        # mcw: (T_local,) per-tree leaf floors — sklearn recomputes
-        # min_weight_fraction_leaf from each tree's composed bootstrap
-        # weight total, so the floor rides the tree axis with the weights.
+    def per_device(xb, y, nid0, ws, cand_masks, mcw, mid):
+        # mcw/mid: (T_local,) per-tree leaf floors and decrease gates —
+        # sklearn recomputes both min_weight_fraction_leaf and the
+        # min_impurity_decrease scaling from each tree's composed bootstrap
+        # weight total, so both ride the tree axis with the weights (and
+        # the host failover path, which uses tree_cfg per tree, stays
+        # bit-identical to this program).
         return lax.map(
-            lambda wcm: build(xb, y, nid0, wcm[0], wcm[1], wcm[2]),
-            (ws, cand_masks, mcw),
+            lambda wcm: build(xb, y, nid0, wcm[0], wcm[1], wcm[2], wcm[3]),
+            (ws, cand_masks, mcw, mid),
         )
 
     t = P(TREE_AXIS)
     if data_sharded:
         in_specs = (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
                     P(TREE_AXIS, DATA_AXIS), P(TREE_AXIS, None, None),
-                    P(TREE_AXIS))
+                    P(TREE_AXIS), P(TREE_AXIS))
         # tree outputs are replicated across each tree group after the
         # psum'd decisions; the row assignment stays sharded
         out_specs = (t, t, t, t, t, t, P(TREE_AXIS, DATA_AXIS), t)
     else:
         in_specs = (P(), P(), P(), P(TREE_AXIS, None),
-                    P(TREE_AXIS, None, None), P(TREE_AXIS))
+                    P(TREE_AXIS, None, None), P(TREE_AXIS), P(TREE_AXIS))
         out_specs = (t, t, t, t, t, t, t, t)
     sharded = jax.shard_map(
         per_device,
@@ -502,7 +512,8 @@ def build_tree_fused(
         )
     with timer.phase("fused_build"):
         out = fn(xb_d, y_d, nid_d, w_d, cand_d,
-                 np.float32(cfg.min_child_weight))
+                 np.float32(cfg.min_child_weight),
+                 np.float32(cfg.min_decrease_scaled))
         feat, bins, counts, nvec, left, parent, nid_out, n_nodes = out
         # Tree outputs are replicated (addressable from any process); the
         # row-sharded nid_out is only fetched when the refit needs it —
@@ -598,6 +609,7 @@ def build_forest_fused(
     timer: PhaseTimer | None = None,
     return_leaf_ids: bool = False,
     min_child_weights: np.ndarray | None = None,
+    min_decrease_scaleds: np.ndarray | None = None,
 ) -> list:
     """Build T trees as ONE device program, trees sharded over the mesh.
 
@@ -667,12 +679,18 @@ def build_forest_fused(
         if min_child_weights is None
         else np.asarray(min_child_weights, np.float32)
     )
+    mid = (
+        np.full(T, np.float32(cfg.min_decrease_scaled))
+        if min_decrease_scaleds is None
+        else np.asarray(min_decrease_scaleds, np.float32)
+    )
     if T_pad != T:  # pad with repeats; surplus trees are dropped after build
         ws = np.concatenate([ws, np.broadcast_to(ws[-1:], (T_pad - T, N))])
         cm = np.concatenate(
             [cm, np.broadcast_to(cm[-1:], (T_pad - T, F, cm.shape[2]))]
         )
         mcw = np.concatenate([mcw, np.broadcast_to(mcw[-1:], (T_pad - T,))])
+        mid = np.concatenate([mid, np.broadcast_to(mid[-1:], (T_pad - T,))])
 
     with timer.phase("shard"):
         from jax.sharding import NamedSharding
@@ -694,10 +712,11 @@ def build_forest_fused(
             cm, NamedSharding(tmesh, P(TREE_AXIS, None, None))
         )
         mcw_d = jax.device_put(mcw, NamedSharding(tmesh, P(TREE_AXIS)))
+        mid_d = jax.device_put(mid, NamedSharding(tmesh, P(TREE_AXIS)))
 
     with timer.phase("forest_build"):
         feat, bins, counts, nvec, left, parent, nid_out, n_nodes = (
-            jax.device_get(fn(xb_d, y_d, nid_d, ws_d, cm_d, mcw_d))
+            jax.device_get(fn(xb_d, y_d, nid_d, ws_d, cm_d, mcw_d, mid_d))
         )
 
     trees = []

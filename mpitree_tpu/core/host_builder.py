@@ -136,6 +136,10 @@ def _native_level_decisions(nat, *, task, cfg):
         pure | nat["constant"] | (n < cfg.min_samples_split)
         | np.isinf(nat["cost"]) | (feat_best < 0)
     )
+    if cfg.min_decrease_scaled > 0.0:
+        # sklearn's min_impurity_decrease on the best split only
+        with np.errstate(invalid="ignore"):
+            stop |= n * (node_imp - nat["cost"]) < cfg.min_decrease_scaled
     return counts, n, value, node_imp, feat_best, nat["bin"], stop
 
 
@@ -397,6 +401,11 @@ def build_tree_host(
                 pure | constant | (n < cfg.min_samples_split)
                 | np.isinf(best_cost)
             )
+            if cfg.min_decrease_scaled > 0.0:
+                with np.errstate(invalid="ignore"):
+                    stop |= (
+                        n * (node_imp - best_cost) < cfg.min_decrease_scaled
+                    )
 
         if terminal:
             feat_best = np.full(S, -1, np.int32)

@@ -49,6 +49,7 @@ from mpitree_tpu.utils.elastic import ForestCheckpoint, device_failover
 from mpitree_tpu.utils.validation import (
     apply_class_weight,
     min_child_weight,
+    min_decrease_scaled,
     resolve_refine,
     validate_fit_data,
     validate_predict_data,
@@ -74,7 +75,7 @@ class _BaseForest(BaseEstimator):
                  min_samples_leaf=1,
                  random_state=None, n_devices=None,
                  backend=None, refine_depth="auto", checkpoint=None,
-                 ccp_alpha=0.0):
+                 ccp_alpha=0.0, min_impurity_decrease=0.0):
         self.n_estimators = n_estimators
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
@@ -95,6 +96,7 @@ class _BaseForest(BaseEstimator):
         # lists as absent from the reference.
         self.checkpoint = checkpoint
         self.ccp_alpha = ccp_alpha
+        self.min_impurity_decrease = min_impurity_decrease
 
     def _pop_oob_masks(self):
         """Consume the fit-time bootstrap OOB masks (they must not persist —
@@ -143,6 +145,9 @@ class _BaseForest(BaseEstimator):
                 self.min_weight_fraction_leaf, sample_weight, n,
                 self.min_samples_leaf,
             ),
+            min_decrease_scaled=min_decrease_scaled(
+                self.min_impurity_decrease, sample_weight, n
+            ),
         )
 
         def tree_cfg(w):
@@ -158,6 +163,9 @@ class _BaseForest(BaseEstimator):
                 min_child_weight=min_child_weight(
                     self.min_weight_fraction_leaf, w, n,
                     self.min_samples_leaf,
+                ),
+                min_decrease_scaled=min_decrease_scaled(
+                    self.min_impurity_decrease, w, n
                 ),
             )
         k = n_subspace_features(self.max_features, X.shape[1])
@@ -271,9 +279,12 @@ class _BaseForest(BaseEstimator):
                 for i in idxs
             ])
             cms = np.stack([tree_b[i].candidate_mask() for i in idxs])
+            cfgs = [tree_cfg(tree_w[i]) for i in idxs]
             fls = np.asarray(
-                [tree_cfg(tree_w[i]).min_child_weight for i in idxs],
-                np.float32,
+                [c.min_child_weight for c in cfgs], np.float32
+            )
+            mids = np.asarray(
+                [c.min_decrease_scaled for c in cfgs], np.float32
             )
 
             def dev():
@@ -283,6 +294,7 @@ class _BaseForest(BaseEstimator):
                     refit_targets=refit_targets,
                     integer_counts=integer_weights(sample_weight),
                     return_leaf_ids=refine, min_child_weights=fls,
+                    min_decrease_scaleds=mids,
                 )
 
             def host():
@@ -474,7 +486,8 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
                  min_weight_fraction_leaf=0.0, min_samples_leaf=1,
                  random_state=None,
                  n_devices=None, backend=None, refine_depth="auto",
-                 checkpoint=None, ccp_alpha=0.0):
+                 checkpoint=None, ccp_alpha=0.0,
+                 min_impurity_decrease=0.0):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
@@ -484,7 +497,7 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
             min_samples_leaf=min_samples_leaf,
             random_state=random_state, n_devices=n_devices, backend=backend,
             refine_depth=refine_depth, checkpoint=checkpoint,
-            ccp_alpha=ccp_alpha,
+            ccp_alpha=ccp_alpha, min_impurity_decrease=min_impurity_decrease,
         )
         self.criterion = criterion
         self.class_weight = class_weight
@@ -556,7 +569,8 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
                  oob_score=False, min_weight_fraction_leaf=0.0,
                  min_samples_leaf=1, random_state=None,
                  n_devices=None, backend=None, refine_depth="auto",
-                 checkpoint=None, ccp_alpha=0.0):
+                 checkpoint=None, ccp_alpha=0.0,
+                 min_impurity_decrease=0.0):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
@@ -566,7 +580,7 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
             min_samples_leaf=min_samples_leaf,
             random_state=random_state, n_devices=n_devices, backend=backend,
             refine_depth=refine_depth, checkpoint=checkpoint,
-            ccp_alpha=ccp_alpha,
+            ccp_alpha=ccp_alpha, min_impurity_decrease=min_impurity_decrease,
         )
 
     def fit(self, X, y, sample_weight=None):

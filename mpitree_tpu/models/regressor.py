@@ -31,6 +31,7 @@ from mpitree_tpu.utils.importances import feature_importances
 from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
 from mpitree_tpu.utils.validation import (
     min_child_weight,
+    min_decrease_scaled,
     validate_fit_data,
     validate_predict_data,
     resolve_refine,
@@ -52,7 +53,7 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
                  max_features=None, min_weight_fraction_leaf=0.0,
                  min_samples_leaf=1, random_state=None,
                  n_devices=None, backend=None, refine_depth="auto",
-                 ccp_alpha=0.0):
+                 ccp_alpha=0.0, min_impurity_decrease=0.0):
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
         self.criterion = criterion
@@ -66,6 +67,7 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
         self.backend = backend
         self.refine_depth = refine_depth
         self.ccp_alpha = ccp_alpha
+        self.min_impurity_decrease = min_impurity_decrease
 
     def fit(self, X, y, sample_weight=None):
         if self.criterion not in ("squared_error", "mse"):
@@ -94,6 +96,9 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
             min_child_weight=min_child_weight(
                 self.min_weight_fraction_leaf, sw, X.shape[0],
                 self.min_samples_leaf,
+            ),
+            min_decrease_scaled=min_decrease_scaled(
+                self.min_impurity_decrease, sw, X.shape[0]
             ),
         )
         y_c = (y64 - y_mean).astype(np.float32)
@@ -161,17 +166,10 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
     def cost_complexity_pruning_path(self, X, y, sample_weight=None):
         """sklearn's diagnostic: effective alphas and total leaf
         impurities along the minimal cost-complexity pruning path
-        (``utils/pruning.py``)."""
-        from sklearn.base import clone
-        from sklearn.utils import Bunch
+        (one shared weakest-link sweep, ``utils/pruning.py``)."""
+        from mpitree_tpu.utils.pruning import pruning_path_for
 
-        from mpitree_tpu.utils.pruning import pruning_path
-
-        est = clone(self)
-        est.ccp_alpha = 0.0
-        est.fit(X, y, sample_weight=sample_weight)
-        alphas, impurities = pruning_path(est.tree_, task=self._task)
-        return Bunch(ccp_alphas=alphas, impurities=impurities)
+        return pruning_path_for(self, X, y, sample_weight=sample_weight)
 
     def _leaf_ids(self, X: np.ndarray) -> np.ndarray:
         t = self.tree_

@@ -43,12 +43,17 @@ import numpy as np
 # (INVALID_ARGUMENT shape errors, ENOSPC, arbitrary RuntimeErrors) must
 # re-raise, or a device-engine regression would silently pass CI on the
 # 10-100x slower host tier.
+# Matching is CASE-SENSITIVE on purpose: the uppercase entries are gRPC
+# status codes exactly as PJRT prints them — lowercasing would make
+# ordinary prose ("Resource temporarily unavailable", "launch aborted")
+# classify as transport loss.
 _TRANSPORT_MARKERS = (
     "UNAVAILABLE",
     "DEADLINE_EXCEEDED",
     "DATA_LOSS",
     "ABORTED",
     "CANCELLED",
+    "Connection",
     "connection",
     "socket",
     "PJRT",
@@ -72,14 +77,13 @@ def is_device_failure(exc: BaseException) -> bool:
     friends — user errors — never do.
     """
     name = type(exc).__name__
-    msg = str(exc).lower()
+    msg = str(exc)
     if name in ("XlaRuntimeError", "JaxRuntimeError"):
-        markers = _TRANSPORT_MARKERS + ("INTERNAL",)
-        return any(m.lower() in msg for m in markers)
+        return any(m in msg for m in _TRANSPORT_MARKERS + ("INTERNAL",))
     if isinstance(exc, ConnectionError):
         return True  # ConnectionReset/Refused/Aborted ARE transport losses
     if isinstance(exc, (RuntimeError, OSError)):
-        return any(m.lower() in msg for m in _TRANSPORT_MARKERS)
+        return any(m in msg for m in _TRANSPORT_MARKERS)
     return False
 
 

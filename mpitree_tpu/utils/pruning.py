@@ -69,11 +69,18 @@ def ccp_prune(tree: TreeArrays, ccp_alpha: float, *, task: str) -> TreeArrays:
     return _prune_impl(tree, ccp_alpha, task)
 
 
-def _prune_impl(tree: TreeArrays, ccp_alpha: float, task: str) -> TreeArrays:
+def _prune_impl(tree: TreeArrays, ccp_alpha: float, task: str,
+                path_out: list | None = None) -> TreeArrays:
     """Weakest-link pruning at ``ccp_alpha`` WITHOUT the public zero
     short-circuit: collapses every node whose effective alpha is
     ``<= ccp_alpha``, including exactly zero — ``pruning_path`` relies on
-    that to make progress when a split has zero impurity gain."""
+    that to make progress when a split has zero impurity gain.
+
+    ``path_out``: when given, every collapse appends
+    ``(effective_alpha, total_leaf_risk_after)`` — the heap already pops
+    collapses in ascending effective alpha, so one sweep with
+    ``ccp_alpha=inf`` yields the whole pruning path.
+    """
     n = tree.n_nodes
     w = _node_weights(tree, task)
     r = (w / max(w[0], 1e-300)) * np.asarray(tree.impurity, np.float64)
@@ -104,6 +111,8 @@ def _prune_impl(tree: TreeArrays, ccp_alpha: float, task: str) -> TreeArrays:
         for d in _descendants(tree, t):
             removed[d] = True
         d_r, d_leaves = r[t] - r_sub[t], 1 - leaves[t]
+        r_sub[t] = r[t]
+        leaves[t] = 1
         p = int(tree.parent[t])
         while p >= 0:
             r_sub[p] += d_r
@@ -111,6 +120,8 @@ def _prune_impl(tree: TreeArrays, ccp_alpha: float, task: str) -> TreeArrays:
             if not (removed[p] or collapsed[p]):
                 heapq.heappush(heap, (alpha_eff(p), p))
             p = int(tree.parent[p])
+        if path_out is not None:
+            path_out.append((max(a, 0.0), float(r_sub[0])))
 
     if not collapsed.any():
         return tree
@@ -157,27 +168,36 @@ def _prune_impl(tree: TreeArrays, ccp_alpha: float, task: str) -> TreeArrays:
 def pruning_path(tree: TreeArrays, *, task: str):
     """(ccp_alphas, impurities) — sklearn's ``cost_complexity_pruning_path``
     analogue: the sequence of effective alphas at which the tree collapses,
-    and the total leaf impurity after each collapse."""
+    and the total leaf impurity after each collapse.
 
-    def stats(t):
-        w = _node_weights(t, task)
-        r = (w / max(w[0], 1e-300)) * np.asarray(t.impurity, np.float64)
-        rs, lv = _subtree_stats(t, r)
-        return r, rs, lv
-
-    cur = tree
-    r, rs, lv = stats(cur)
+    ONE weakest-link sweep (``_prune_impl`` at ``inf`` with ``path_out``)
+    produces the whole path — collapses with equal effective alpha merge
+    into one step, keeping the last (fully collapsed) impurity.
+    """
+    w = _node_weights(tree, task)
+    r = (w / max(w[0], 1e-300)) * np.asarray(tree.impurity, np.float64)
+    rs, _ = _subtree_stats(tree, r)
     alphas, impurities = [0.0], [float(rs[0])]
-    while cur.n_leaves > 1:
-        interior = np.nonzero(cur.feature >= 0)[0]
-        eff = (r[interior] - rs[interior]) / np.maximum(lv[interior] - 1, 1)
-        # Zero-gain splits give eff == 0 (float noise can dip negative);
-        # clamp and use the internal impl, which collapses <= a inclusive —
-        # guaranteed progress, where the public zero short-circuit would
-        # loop forever.
-        a = max(float(eff.min()), 0.0)
-        cur = _prune_impl(cur, a, task)
-        alphas.append(a)
-        r, rs, lv = stats(cur)
-        impurities.append(float(rs[0]))
+    steps: list = []
+    _prune_impl(tree, np.inf, task, path_out=steps)
+    for a, imp in steps:
+        if alphas and abs(a - alphas[-1]) <= 1e-300:
+            impurities[-1] = imp  # simultaneous collapse at equal alpha
+        else:
+            alphas.append(a)
+            impurities.append(imp)
     return np.asarray(alphas), np.asarray(impurities)
+
+
+def pruning_path_for(estimator, X, y, sample_weight=None):
+    """Shared body of the estimators\' ``cost_complexity_pruning_path``:
+    fit an unpruned clone, return sklearn\'s Bunch of path alphas and
+    impurities."""
+    from sklearn.base import clone
+    from sklearn.utils import Bunch
+
+    est = clone(estimator)
+    est.ccp_alpha = 0.0
+    est.fit(X, y, sample_weight=sample_weight)
+    alphas, impurities = pruning_path(est.tree_, task=estimator._task)
+    return Bunch(ccp_alphas=alphas, impurities=impurities)

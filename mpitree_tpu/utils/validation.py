@@ -96,6 +96,28 @@ def min_child_weight(min_weight_fraction_leaf, sample_weight, n_samples,
     return floor
 
 
+def min_decrease_scaled(min_impurity_decrease, sample_weight, n_samples):
+    """sklearn's ``min_impurity_decrease`` -> the pre-scaled engine gate.
+
+    The engines compare ``n_t * (imp_t - cost_t)`` (global weighted
+    decrease x total weight) against this value, so scaling by the total
+    fit weight here makes the rule exact everywhere, including inside
+    hybrid-refine subtree rebuilds.
+    """
+    d = float(min_impurity_decrease)
+    if d < 0.0:
+        raise ValueError(
+            f"min_impurity_decrease must be >= 0, got {min_impurity_decrease!r}"
+        )
+    if d == 0.0:
+        return 0.0
+    total = (
+        float(n_samples) if sample_weight is None
+        else float(np.sum(sample_weight))
+    )
+    return d * total
+
+
 def apply_class_weight(class_weight, y_enc, classes, sample_weight):
     """Compose sklearn-style ``class_weight`` into per-sample weights.
 
