@@ -63,6 +63,37 @@ inline const double* xlogx_tab_ensure(int64_t n) {
   return g_xlogx_tab.data();
 }
 
+// Compact feature-major uint16 gather of the LIVE rows in bucket order:
+// per-(slot, feature) sweep passes then read a contiguous run of 2-byte
+// bins (indexed by bucket position) instead of 216-byte-strided int32
+// loads — the row-major layout costs a full cache line per row per
+// feature. Gathering only live rows keeps the rebuild proportional to the
+// level\'s work (the hybrid refine\'s live set shrinks every level; a
+// full-matrix transpose there cost more than it saved). nullptr when bins
+// exceed uint16 (exact binning on very-high-cardinality data); callers
+// fall back to the strided int32 reads.
+// Cap mirrors g_xlogx_tab's: the buffer persists thread_local between
+// levels (reallocating 60 MB per level would thrash); past the cap the
+// callers simply keep their strided-int32 fallback reads.
+constexpr int64_t kXbtCapBytes = int64_t(1) << 27;  // 128 MB ceiling
+thread_local std::vector<uint16_t> g_xbt;
+
+inline const uint16_t* gather_bins(const int32_t* xb,
+                                   const std::vector<int64_t>& rows_by_slot,
+                                   int32_t n_feat, int32_t n_bins) {
+  if (n_bins > 65535) return nullptr;
+  const int64_t live = (int64_t)rows_by_slot.size();
+  if (live * n_feat * 2 > kXbtCapBytes) return nullptr;
+  g_xbt.resize((size_t)live * n_feat);
+  uint16_t* out = g_xbt.data();
+  for (int64_t i = 0; i < live; ++i) {
+    const int32_t* row = xb + rows_by_slot[i] * n_feat;
+    for (int32_t f = 0; f < n_feat; ++f)
+      out[(size_t)f * live + i] = (uint16_t)row[f];
+  }
+  return out;
+}
+
 // Strictly-better test with relative tolerance: the incremental sweep's cost
 // differs from the reference's dense formula by last-ULP rounding, and exact
 // mathematical ties (symmetric splits) must resolve to the lowest
@@ -222,12 +253,25 @@ void best_splits_classification(
     shared_tab = xlogx_tab_ensure(tab_size - 1);
   }
 
+  const uint16_t* xbt = gather_bins(xb, rows_by_slot, n_feat, n_bins);
+  const int64_t live = (int64_t)rows_by_slot.size();
+
   auto worker = [&](int32_t s_begin, int32_t s_end) {
   // Scratch reused across (node, feature) passes — one set per thread.
   std::vector<int32_t> touched_bins;                // occupied bins
   std::vector<double> left_cls(n_classes, 0.0);     // running class counts
   std::vector<double> node_cls(n_classes, 0.0);
-  // Per-(bin) class lists, CSR-style, rebuilt per (node, feature).
+  // DENSE slots (rows >> bins — the main build, 256 quantile bins) sweep a
+  // per-(bin, class) histogram, zeroed lazily at first touch (occ stamp):
+  // the old chain sweep\'s 2 impurity updates per ROW collapse into 2 per
+  // (occupied bin, class), a ~rows/bins-fold reduction, and per-class LUT
+  // deltas telescope to the identical totals for integer counts. SPARSE
+  // slots (the hybrid refine: ~2k-row subtrees with exact local binning,
+  // occupied bins ~ rows) keep the per-bin chain walk — there the
+  // histogram\'s per-bin class scan would cost n_classes x the row count.
+  std::vector<double> hist;  // sized on the first dense slot only
+  std::vector<int32_t> occ_stamp(n_bins, -1);
+  int32_t stamp = 0;
   std::vector<int64_t> bin_head(n_bins, -1);
   std::vector<int64_t> row_next;
   touched_bins.reserve(n_bins);
@@ -270,20 +314,49 @@ void best_splits_classification(
       mode = 2;
     }
 
-    row_next.resize(r1 - r0);
+    // Dense-path cost is rows + occupied_bins * n_classes per (slot,
+    // feature); with many classes (the reference's every-sample-its-own-
+    // class benchmark: n_classes == n_rows) that regresses far past the
+    // chain walk's 2-updates-per-row, so the class term gates too.
+    const bool use_hist =
+        (r1 - r0) >= 2 * (int64_t)n_bins
+        && (int64_t)n_bins * n_classes <= (r1 - r0);
+    if (use_hist && hist.empty())
+      hist.resize((size_t)n_bins * n_classes, 0.0);
+    if (!use_hist) row_next.resize(r1 - r0);
     for (int32_t f = 0; f < n_feat; ++f) {
-      // Build per-bin chains for this (node, feature).
+      // Accumulate the (bin, class) histogram (dense) or per-bin row
+      // chains (sparse) for this (node, feature).
       touched_bins.clear();
       int32_t bt_max = 0;
-      for (int64_t i = r0; i < r1; ++i) {
-        const int64_t r = rows_by_slot[i];
-        const int32_t b = xb[r * n_feat + f];
-        if (bin_head[b] < 0) {
-          touched_bins.push_back(b);
-          if (b > bt_max) bt_max = b;
+      ++stamp;
+      const uint16_t* col = xbt ? xbt + (size_t)f * live : nullptr;
+      if (use_hist) {
+        for (int64_t i = r0; i < r1; ++i) {
+          const int64_t r = rows_by_slot[i];
+          const int32_t b = col ? col[i] : xb[r * n_feat + f];
+          if (occ_stamp[b] != stamp) {
+            occ_stamp[b] = stamp;
+            touched_bins.push_back(b);
+            if (b > bt_max) bt_max = b;
+            double* hb = &hist[(size_t)b * n_classes];
+            for (int32_t c = 0; c < n_classes; ++c) hb[c] = 0.0;
+          }
+          hist[(size_t)b * n_classes + y[r]] += w ? w[r] : 1.0;
         }
-        row_next[i - r0] = bin_head[b];
-        bin_head[b] = i;
+      } else {
+        for (int64_t i = r0; i < r1; ++i) {
+          const int64_t r = rows_by_slot[i];
+          const int32_t b = col ? col[i] : xb[r * n_feat + f];
+          if (occ_stamp[b] != stamp) {
+            occ_stamp[b] = stamp;
+            touched_bins.push_back(b);
+            if (b > bt_max) bt_max = b;
+            bin_head[b] = -1;
+          }
+          row_next[i - r0] = bin_head[b];
+          bin_head[b] = i;
+        }
       }
       if (touched_bins.size() > 1) out_constant[s] = 0;
 
@@ -307,28 +380,36 @@ void best_splits_classification(
             right_sum += node_cls[c] * node_cls[c];
         }
 
+        // One shared impurity-delta update for both sweep strategies —
+        // the moved mass is a whole bin-class total (dense path; per-row
+        // deltas telescope to exactly this) or one row's weight (chains).
+        auto apply_mass = [&](int32_t c, double m) {
+          const double lc = left_cls[c];
+          const double rc = node_cls[c] - lc;
+          if (mode == 2) {
+            left_sum += tab[(int64_t)(lc + m)] - tab[(int64_t)lc];
+            right_sum += tab[(int64_t)(rc - m)] - tab[(int64_t)rc];
+          } else if (mode == 0) {
+            left_sum += xlogx(lc + m) - xlogx(lc);
+            right_sum += xlogx(rc - m) - xlogx(rc);
+          } else {
+            left_sum += (lc + m) * (lc + m) - lc * lc;
+            right_sum += (rc - m) * (rc - m) - rc * rc;
+          }
+          left_cls[c] = lc + m;
+          left_n += m;
+        };
         for (size_t ti = 0; ti < touched_bins.size(); ++ti) {
           const int32_t b = touched_bins[ti];
-          // Move bin b's rows from right to left, updating only the
-          // affected classes' contributions.
-          for (int64_t i = bin_head[b]; i >= 0; i = row_next[i - r0]) {
-            const int64_t r = rows_by_slot[i];
-            const int32_t c = y[r];
-            const double wr = w ? w[r] : 1.0;
-            const double lc = left_cls[c];
-            const double rc = node_cls[c] - lc;
-            if (mode == 2) {
-              left_sum += tab[(int64_t)(lc + wr)] - tab[(int64_t)lc];
-              right_sum += tab[(int64_t)(rc - wr)] - tab[(int64_t)rc];
-            } else if (mode == 0) {
-              left_sum += xlogx(lc + wr) - xlogx(lc);
-              right_sum += xlogx(rc - wr) - xlogx(rc);
-            } else {
-              left_sum += (lc + wr) * (lc + wr) - lc * lc;
-              right_sum += (rc - wr) * (rc - wr) - rc * rc;
+          if (use_hist) {
+            const double* hb = &hist[(size_t)b * n_classes];
+            for (int32_t c = 0; c < n_classes; ++c)
+              if (hb[c] != 0.0) apply_mass(c, hb[c]);
+          } else {
+            for (int64_t i = bin_head[b]; i >= 0; i = row_next[i - r0]) {
+              const int64_t r = rows_by_slot[i];
+              apply_mass(y[r], w ? w[r] : 1.0);
             }
-            left_cls[c] = lc + wr;
-            left_n += wr;
           }
           if (b >= nc[f]) break;  // past the last valid candidate
           const double right_n = n_tot - left_n;
@@ -355,8 +436,6 @@ void best_splits_classification(
           }
         }
       }
-      // Reset bin chains for the next feature.
-      for (int32_t b : touched_bins) bin_head[b] = -1;
     }
   }
   };  // worker
@@ -379,6 +458,8 @@ void best_splits_regression(
   std::vector<int64_t> rows_by_slot;
   bucket_rows(node_id, w, n_rows, frontier_lo, n_slots, slot_start,
               rows_by_slot);
+  const uint16_t* xbt = gather_bins(xb, rows_by_slot, n_feat, n_bins);
+  const int64_t live = (int64_t)rows_by_slot.size();
 
   auto worker = [&](int32_t s_begin, int32_t s_end) {
   std::vector<double> bw(n_bins, 0.0), bs(n_bins, 0.0), bq(n_bins, 0.0);
@@ -424,9 +505,10 @@ void best_splits_regression(
     for (int32_t f = 0; f < n_feat; ++f) {
       touched.clear();
       int32_t bt_max = 0;
+      const uint16_t* col = xbt ? xbt + (size_t)f * live : nullptr;
       for (int64_t i = r0; i < r1; ++i) {
         const int64_t r = rows_by_slot[i];
-        const int32_t b = xb[r * n_feat + f];
+        const int32_t b = col ? col[i] : xb[r * n_feat + f];
         const double wr = w ? w[r] : 1.0;
         const double yr = (double)yv[r];
         if (bw[b] == 0.0 && bs[b] == 0.0 && bq[b] == 0.0) {
