@@ -67,8 +67,13 @@ DEPTH = 20
 # Hybrid crossover: device engines grow the data-parallel crown to this
 # depth, the C++ tier finishes subtrees with exact local candidates —
 # recovers the deep-tail accuracy quantile bins lose (measured: delta vs
-# sklearn -0.016 -> -0.004 at covtype scale).
-REFINE_DEPTH = 8
+# sklearn -0.016 -> -0.004 at covtype scale). Round-3 host-tier sweep at
+# the full workload (warm_s / test_acc): 7 -> 10.5/0.7445, 8 -> 14.0/0.7424,
+# 9 -> 9.7/0.7407, 10 -> 10.5/0.7403 — the shallower crown hands the
+# exact-candidate tail more rows and wins on accuracy at equal time, so 7.
+# (TPU-transport crossover re-measurement still owed: bench_tpu.py
+# --sweep-refine appends it to BENCH_TPU.jsonl when the tunnel is up.)
+REFINE_DEPTH = 7
 # 750 s reaches the 30k grid point (measured r02: grid to 10k spent ~116 s,
 # exponent 1.269 predicts ~380 s for 30k) — >= 2.5 measured decades, so the
 # extrapolation to 531k spans <= 1.3 decades (round-2 verdict asked for this).

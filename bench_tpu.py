@@ -52,7 +52,7 @@ sys.path.insert(0, _HERE)
 
 OUT_PATH = os.path.join(_HERE, "BENCH_TPU.jsonl")
 DEPTH = 20
-REFINE_DEPTH = 8
+REFINE_DEPTH = 7  # measured: see bench.py's REFINE_DEPTH sweep note
 SECTION_TIMEOUT_S = 1500
 
 # Public per-chip HBM bandwidth rooflines (GB/s), for the efficiency line.
