@@ -86,11 +86,33 @@ inline const uint16_t* gather_bins(const int32_t* xb,
   if (live * n_feat * 2 > kXbtCapBytes) return nullptr;
   g_xbt.resize((size_t)live * n_feat);
   uint16_t* out = g_xbt.data();
-  for (int64_t i = 0; i < live; ++i) {
-    const int32_t* row = xb + rows_by_slot[i] * n_feat;
-    for (int32_t f = 0; f < n_feat; ++f)
-      out[(size_t)f * live + i] = (uint16_t)row[f];
+  auto gather_range = [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const int32_t* row = xb + rows_by_slot[i] * n_feat;
+      for (int32_t f = 0; f < n_feat; ++f)
+        out[(size_t)f * live + i] = (uint16_t)row[f];
+    }
+  };
+  // Same thread budget as the sweep (the gather is the sweep's serial
+  // prologue — leaving it single-threaded would Amdahl-cap multicore
+  // hosts now that the dense sweep itself is cheap). Row ranges write
+  // disjoint [lo, hi) runs of every column, so no synchronization.
+  int nt = 0;
+  if (const char* env = std::getenv("MPITREE_TPU_NATIVE_THREADS")) {
+    nt = std::abs(std::atoi(env));
   }
+  if (nt <= 0) nt = (int)std::thread::hardware_concurrency();
+  if (nt < 1) nt = 1;
+  if (live < (int64_t)1 << 16) nt = 1;  // below this, spawn cost dominates
+  if (nt == 1) {
+    gather_range(0, live);
+    return out;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(nt);
+  for (int t = 0; t < nt; ++t)
+    threads.emplace_back(gather_range, live * t / nt, live * (t + 1) / nt);
+  for (auto& th : threads) th.join();
   return out;
 }
 
