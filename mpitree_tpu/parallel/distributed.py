@@ -28,12 +28,22 @@ _initialized = False
 
 def initialize(coordinator_address: str | None = None,
                num_processes: int | None = None,
-               process_id: int | None = None) -> None:
+               process_id: int | None = None,
+               **timeouts) -> None:
     """Join the JAX distributed runtime (idempotent).
 
     With no arguments on a TPU pod, configuration is discovered from the
     environment (the standard ``jax.distributed.initialize()`` contract).
     On a single process with no coordinator this is a no-op.
+
+    ``timeouts`` passes through the runtime's failure-detection knobs
+    (``initialization_timeout``, ``heartbeat_timeout_seconds``, ...): a
+    host that never arrives fails the join within the bound, and a host
+    that dies mid-fit fails the survivors' next collective after the
+    heartbeat window — a bounded, catchable error where the reference's
+    MPI job deadlocks in ``comm.allgather`` (``decision_tree.py:456``;
+    SURVEY §5 failure detection). Pinned by
+    ``tests/test_distributed_failures.py``.
     """
     global _initialized
     if _initialized:
@@ -50,6 +60,7 @@ def initialize(coordinator_address: str | None = None,
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
+            **timeouts,
         )
     except RuntimeError as e:
         # Devices already touched (or runtime already up): surface the

@@ -1,0 +1,147 @@
+"""Multi-host failure detection: bounded errors where MPI would deadlock.
+
+The reference's failure mode (SURVEY §5): a rank dying inside
+``comm.allgather`` hangs or aborts the whole ``mpirun`` job with no bound.
+Here the coordination service's timeouts make both canonical failures
+finite and observable:
+
+- a host that never arrives fails every present host's ``initialize``
+  within ``initialization_timeout``;
+- a host that dies after joining fails the survivors within the heartbeat
+  window — the survivor process TERMINATES (error, not deadlock).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+_LONE_WORKER = """
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+sys.path.insert(0, {repo!r})
+from mpitree_tpu.parallel import distributed
+
+port = sys.argv[1]
+try:
+    distributed.initialize(
+        f"localhost:{{port}}", 2, 0, initialization_timeout=15
+    )
+except Exception as e:  # noqa: BLE001 — the bounded failure IS the test
+    print(f"CLEAN_INIT_FAILURE {{type(e).__name__}}")
+    sys.exit(3)
+print("UNEXPECTED_SUCCESS")
+"""
+
+
+def test_missing_peer_fails_init_within_bound(tmp_path):
+    """Process 0 of a declared 2-process job, peer never arrives: the join
+    FAILS within initialization_timeout instead of waiting forever.
+
+    Depending on the jaxlib version the bound surfaces as a catchable
+    Python exception or as the runtime's own fatal teardown
+    (DEADLINE_EXCEEDED on RegisterTask) — both are bounded detections;
+    the reference's analogue is an indefinite mpirun hang."""
+    worker = tmp_path / "lone.py"
+    worker.write_text(_LONE_WORKER.format(repo=_REPO))
+    t0 = time.monotonic()
+    out = subprocess.run(
+        [sys.executable, str(worker), str(_free_port())],
+        capture_output=True, text=True, timeout=120, cwd=str(tmp_path),
+    )
+    took = time.monotonic() - t0
+    blob = out.stdout + out.stderr
+    assert out.returncode != 0, blob[-2000:]
+    assert "UNEXPECTED_SUCCESS" not in blob
+    assert (
+        "CLEAN_INIT_FAILURE" in blob
+        or "DEADLINE_EXCEEDED" in blob
+        or "distributed service" in blob
+    ), blob[-2000:]
+    assert took < 110, f"init failure took {took:.0f}s — not bounded"
+
+
+_SURVIVOR = """
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+sys.path.insert(0, {repo!r})
+from mpitree_tpu.parallel import distributed
+
+port, pid = sys.argv[1], int(sys.argv[2])
+distributed.initialize(
+    f"localhost:{{port}}", 2, pid,
+    initialization_timeout=60, heartbeat_timeout_seconds=10,
+)
+print(f"PROC{{pid}} JOINED", flush=True)
+
+if pid == 1:
+    import os, time
+    time.sleep(3)
+    os._exit(9)  # simulated host loss AFTER joining
+
+import time
+time.sleep(6)  # let the peer die first
+import numpy as np
+from mpitree_tpu.tree import ParallelDecisionTreeClassifier
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(200, 4)).astype(np.float32)
+y = ((X[:, 0] > 0) + (X[:, 1] > 0.3)).astype(np.int64)
+try:
+    # Collective fit over a mesh that includes the dead host's devices.
+    ParallelDecisionTreeClassifier(max_depth=4).fit(X, y)
+    print("UNEXPECTED_FIT_SUCCESS", flush=True)
+except BaseException as e:  # noqa: BLE001
+    print(f"CLEAN_MIDFIT_FAILURE {{type(e).__name__}}", flush=True)
+    sys.exit(4)
+"""
+
+
+def test_peer_death_after_join_is_bounded(tmp_path):
+    """A host dying after the join must leave the survivor with a bounded
+    TERMINATION (python-level error or runtime abort) — never the
+    reference's indefinite allgather deadlock."""
+    worker = tmp_path / "survivor.py"
+    worker.write_text(_SURVIVOR.format(repo=_REPO))
+    port = _free_port()
+    t0 = time.monotonic()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(port), str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=str(tmp_path),
+        )
+        for pid in (0, 1)
+    ]
+    try:
+        out0, _ = procs[0].communicate(timeout=300)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("survivor hung past the heartbeat bound — deadlock")
+    procs[1].wait(timeout=30)
+    took = time.monotonic() - t0
+    assert "PROC0 JOINED" in out0, out0[-2000:]
+    # Either the fit raised a catchable error (preferred) or the runtime
+    # tore the process down — both are bounded detections, not deadlock.
+    assert procs[0].returncode != 0, f"survivor exited 0?\n{out0[-2000:]}"
+    assert "UNEXPECTED_FIT_SUCCESS" not in out0
+    assert took < 280, f"detection took {took:.0f}s"
