@@ -173,11 +173,20 @@ def main() -> None:
         "--skip-covtype", action="store_true", help="omit the covtype-scale run"
     )
     p.add_argument(
-        "--platform", default=None,
-        help="JAX platform override (e.g. cpu); must be set before first use",
+        "--platform", default="auto",
+        help="JAX platform: 'auto' probes the accelerator with a bounded "
+             "timeout and falls back to cpu when it hangs (this "
+             "environment's sitecustomize overrides JAX_PLATFORMS, so only "
+             "an in-process pin sticks); or an explicit name (cpu, tpu)",
     )
     args = p.parse_args()
-    if args.platform:
+    if args.platform == "auto":
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from bench import probe_backend
+
+        probe_backend()  # pins cpu in-process when the accelerator hangs
+    elif args.platform:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
