@@ -82,9 +82,16 @@ def _exact_edges(col: np.ndarray) -> np.ndarray:
 def _quantile_edges(col: np.ndarray, max_bins: int) -> np.ndarray:
     # Edges are actual data values (method="lower") so predict-time `x <= t`
     # comparisons agree bit-for-bit with the training partition.
+    return _quantile_edges_sorted(np.sort(col), max_bins)
+
+
+def _quantile_edges_sorted(col_sorted: np.ndarray, max_bins: int) -> np.ndarray:
+    # np.quantile(col, q, method="lower") == sorted[floor((n-1)*q)] — taking
+    # the indices directly lets one sort serve both the uniqueness probe and
+    # the edges (np.unique + np.quantile would each sort the column).
     qs = np.arange(1, max_bins, dtype=np.float64) / max_bins
-    edges = np.quantile(col, qs, method="lower")
-    return np.unique(edges)
+    idx = np.floor((len(col_sorted) - 1) * qs).astype(np.int64)
+    return np.unique(col_sorted[idx])
 
 
 def bin_dataset(
@@ -106,22 +113,45 @@ def bin_dataset(
         raise ValueError(f"unknown binning mode: {binning!r}")
     X = np.ascontiguousarray(X, dtype=np.float32)
     n_samples, n_features = X.shape
+    # One transpose up front: every per-feature op below (sort, unique
+    # probe, searchsorted) runs on a contiguous column instead of a
+    # 4*n_features-byte-strided view — strided reads/writes dominated this
+    # function's profile at covtype scale, not the sorts.
+    Xt = np.ascontiguousarray(X.T)
 
     per_feature_edges: list[np.ndarray] = []
     quantized = False
     for f in range(n_features):
-        col = X[:, f]
+        col = Xt[f]
         if binning == "exact":
             edges = _exact_edges(col)
         elif binning == "quantile":
             edges = _quantile_edges(col, max_bins)
             quantized = True
         else:  # auto
-            uniq = np.unique(col)
-            if len(uniq) <= max_bins:
-                edges = uniq[:-1]
+            # One sort answers both questions (np.unique + np.quantile
+            # would each sort the full column; numpy's vectorized f32 sort
+            # makes the sort itself nearly free — np.partition is slower).
+            col_sorted = np.sort(col)
+            n = len(col_sorted)
+            new_val = np.empty(n, bool)
+            if n:
+                new_val[0] = True
+                np.not_equal(
+                    col_sorted[1:], col_sorted[:-1], out=new_val[1:]
+                )
+                # NaN != NaN would count every NaN as distinct; collapse
+                # the trailing NaN run to one, like np.unique (NaNs sort
+                # past +inf, so the run is the suffix). Estimator
+                # entrypoints reject NaN, but bin_dataset is also a direct
+                # API and the exact mode's np.unique already collapses.
+                nan_start = np.searchsorted(col_sorted, np.inf, side="right")
+                if nan_start < n - 1:
+                    new_val[nan_start + 1:] = False
+            if int(new_val.sum()) <= max_bins:
+                edges = col_sorted[new_val][:-1]
             else:
-                edges = _quantile_edges(col, max_bins)
+                edges = _quantile_edges_sorted(col_sorted, max_bins)
                 quantized = True
         per_feature_edges.append(edges.astype(np.float32))
 
@@ -129,10 +159,11 @@ def bin_dataset(
     n_bins = int(n_cand.max(initial=0)) + 1
 
     thresholds = np.full((n_features, max(n_bins - 1, 1)), np.inf, dtype=np.float32)
-    x_binned = np.empty((n_samples, n_features), dtype=np.int32)
+    xbt = np.empty((n_features, n_samples), dtype=np.int32)
     for f, edges in enumerate(per_feature_edges):
         thresholds[f, : len(edges)] = edges
-        x_binned[:, f] = np.searchsorted(edges, X[:, f], side="left")
+        xbt[f] = np.searchsorted(edges, Xt[f], side="left")
+    x_binned = np.ascontiguousarray(xbt.T)
 
     return BinnedData(
         x_binned=x_binned, thresholds=thresholds, n_cand=n_cand,
