@@ -257,7 +257,7 @@ def host_tier(report, n: int):
         )
 
     clf = DecisionTreeClassifier(
-        max_depth=20, max_bins=256, backend="host", refine_depth=8,
+        max_depth=20, max_bins=256, backend="host", refine_depth=7,
     )
     t0 = time.perf_counter()
     clf.fit(X, y)

@@ -251,6 +251,15 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         X = validate_predict_data(X, self.n_features_, type(self).__name__)
         return self.tree_.count[self._leaf_ids(X)]
 
+    def apply(self, X):
+        """sklearn's ``tree.apply``: the leaf index each sample lands in
+        (vectorized gather-descent over the struct-of-arrays tree — the
+        reference walks a Python recursion per row,
+        ``decision_tree.py:208-225``)."""
+        check_is_fitted(self)
+        X = validate_predict_data(X, self.n_features_, type(self).__name__)
+        return self._leaf_ids(X).astype(np.int64)
+
     def predict(self, X):
         check_is_fitted(self)
         X = validate_predict_data(X, self.n_features_, type(self).__name__)

@@ -177,6 +177,15 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
         ids = predict_leaf_ids(jax.device_put(X), dev, t.max_depth)
         return np.asarray(ids)
 
+    def apply(self, X):
+        """sklearn's ``tree.apply``: the leaf index each sample lands in
+        (vectorized gather-descent over the struct-of-arrays tree — the
+        reference walks a Python recursion per row,
+        ``decision_tree.py:208-225``)."""
+        check_is_fitted(self)
+        X = validate_predict_data(X, self.n_features_, type(self).__name__)
+        return self._leaf_ids(X).astype(np.int64)
+
     def predict(self, X):
         check_is_fitted(self)
         X = validate_predict_data(X, self.n_features_, type(self).__name__)

@@ -242,3 +242,21 @@ def test_bad_sample_weight_rejected():
         DecisionTreeClassifier().fit(X, y, sample_weight=np.ones(3))
     with pytest.raises(ValueError):
         DecisionTreeClassifier().fit(X, y, sample_weight=-np.ones(5))
+
+
+def test_apply_returns_leaf_indices(iris2):
+    X, y, _ = iris2
+    from mpitree_tpu import DecisionTreeClassifier, DecisionTreeRegressor
+
+    clf = DecisionTreeClassifier(max_depth=4).fit(X, y)
+    ids = clf.apply(X)
+    t = clf.tree_
+    assert ids.dtype == np.int64 and ids.shape == (len(X),)
+    # every returned index is a leaf, and its counts argmax is the prediction
+    assert (t.feature[ids] < 0).all()
+    np.testing.assert_array_equal(
+        clf.classes_[t.count[ids].argmax(axis=1)], clf.predict(X)
+    )
+    reg = DecisionTreeRegressor(max_depth=4).fit(X, y.astype(np.float64))
+    rids = reg.apply(X)
+    assert (reg.tree_.feature[rids] < 0).all()
