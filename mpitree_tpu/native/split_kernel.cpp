@@ -78,6 +78,37 @@ inline const double* xlogx_tab_ensure(int64_t n) {
 constexpr int64_t kXbtCapBytes = int64_t(1) << 27;  // 128 MB ceiling
 thread_local std::vector<uint16_t> g_xbt;
 
+// y / w companions to the bin gather: the sweep touches each row's label
+// and weight once per FEATURE (54x per level at covtype), so leaving them
+// at their original indices costs 54 random reads per row into
+// multi-megabyte arrays; in bucket order the per-slot slices are
+// L1-resident. Filled once per call, beside the bins.
+thread_local std::vector<int32_t> g_y_local;
+thread_local std::vector<float> g_yv_local;
+thread_local std::vector<double> g_w_local;
+
+inline const int32_t* gather_labels(const int32_t* y,
+                                    const std::vector<int64_t>& rows) {
+  g_y_local.resize(rows.size());
+  for (size_t i = 0; i < rows.size(); ++i) g_y_local[i] = y[rows[i]];
+  return g_y_local.data();
+}
+
+inline const float* gather_targets(const float* yv,
+                                   const std::vector<int64_t>& rows) {
+  g_yv_local.resize(rows.size());
+  for (size_t i = 0; i < rows.size(); ++i) g_yv_local[i] = yv[rows[i]];
+  return g_yv_local.data();
+}
+
+inline const double* gather_weights(const double* w,
+                                    const std::vector<int64_t>& rows) {
+  if (!w) return nullptr;
+  g_w_local.resize(rows.size());
+  for (size_t i = 0; i < rows.size(); ++i) g_w_local[i] = w[rows[i]];
+  return g_w_local.data();
+}
+
 inline const uint16_t* gather_bins(const int32_t* xb,
                                    const std::vector<int64_t>& rows_by_slot,
                                    int32_t n_feat, int32_t n_bins) {
@@ -276,6 +307,8 @@ void best_splits_classification(
   }
 
   const uint16_t* xbt = gather_bins(xb, rows_by_slot, n_feat, n_bins);
+  const int32_t* yl = gather_labels(y, rows_by_slot);
+  const double* wl = gather_weights(w, rows_by_slot);
   const int64_t live = (int64_t)rows_by_slot.size();
 
   auto worker = [&](int32_t s_begin, int32_t s_end) {
@@ -307,10 +340,8 @@ void best_splits_classification(
     out_cost[s] = inf;
     out_constant[s] = 1;
     std::fill(node_cls.begin(), node_cls.end(), 0.0);
-    for (int64_t i = r0; i < r1; ++i) {
-      const int64_t r = rows_by_slot[i];
-      node_cls[y[r]] += w ? w[r] : 1.0;
-    }
+    for (int64_t i = r0; i < r1; ++i)
+      node_cls[yl[i]] += wl ? wl[i] : 1.0;
     double n_tot = 0.0;
     for (int32_t c = 0; c < n_classes; ++c) {
       out_counts[(int64_t)s * n_classes + c] = node_cls[c];
@@ -355,8 +386,7 @@ void best_splits_classification(
       const uint16_t* col = xbt ? xbt + (size_t)f * live : nullptr;
       if (use_hist) {
         for (int64_t i = r0; i < r1; ++i) {
-          const int64_t r = rows_by_slot[i];
-          const int32_t b = col ? col[i] : xb[r * n_feat + f];
+          const int32_t b = col ? col[i] : xb[rows_by_slot[i] * n_feat + f];
           if (occ_stamp[b] != stamp) {
             occ_stamp[b] = stamp;
             touched_bins.push_back(b);
@@ -364,12 +394,12 @@ void best_splits_classification(
             double* hb = &hist[(size_t)b * n_classes];
             for (int32_t c = 0; c < n_classes; ++c) hb[c] = 0.0;
           }
-          hist[(size_t)b * n_classes + y[r]] += w ? w[r] : 1.0;
+          hist[(size_t)b * n_classes + yl[i]] += wl ? wl[i] : 1.0;
         }
       } else {
         for (int64_t i = r0; i < r1; ++i) {
-          const int64_t r = rows_by_slot[i];
-          const int32_t b = col ? col[i] : xb[r * n_feat + f];
+          const int32_t b =
+              col ? col[i] : xb[rows_by_slot[i] * n_feat + f];
           if (occ_stamp[b] != stamp) {
             occ_stamp[b] = stamp;
             touched_bins.push_back(b);
@@ -428,10 +458,8 @@ void best_splits_classification(
             for (int32_t c = 0; c < n_classes; ++c)
               if (hb[c] != 0.0) apply_mass(c, hb[c]);
           } else {
-            for (int64_t i = bin_head[b]; i >= 0; i = row_next[i - r0]) {
-              const int64_t r = rows_by_slot[i];
-              apply_mass(y[r], w ? w[r] : 1.0);
-            }
+            for (int64_t i = bin_head[b]; i >= 0; i = row_next[i - r0])
+              apply_mass(yl[i], wl ? wl[i] : 1.0);
           }
           if (b >= nc[f]) break;  // past the last valid candidate
           const double right_n = n_tot - left_n;
@@ -481,6 +509,8 @@ void best_splits_regression(
   bucket_rows(node_id, w, n_rows, frontier_lo, n_slots, slot_start,
               rows_by_slot);
   const uint16_t* xbt = gather_bins(xb, rows_by_slot, n_feat, n_bins);
+  const float* yvl = gather_targets(yv, rows_by_slot);
+  const double* wl = gather_weights(w, rows_by_slot);
   const int64_t live = (int64_t)rows_by_slot.size();
 
   auto worker = [&](int32_t s_begin, int32_t s_end) {
@@ -499,9 +529,8 @@ void best_splits_regression(
     double n_tot = 0.0, s_tot = 0.0, q_tot = 0.0;
     double ymin = inf, ymax = -inf;
     for (int64_t i = r0; i < r1; ++i) {
-      const int64_t r = rows_by_slot[i];
-      const double wr = w ? w[r] : 1.0;
-      const double yr = (double)yv[r];
+      const double wr = wl ? wl[i] : 1.0;
+      const double yr = (double)yvl[i];
       n_tot += wr;
       s_tot += wr * yr;
       q_tot += wr * yr * yr;
@@ -529,10 +558,9 @@ void best_splits_regression(
       int32_t bt_max = 0;
       const uint16_t* col = xbt ? xbt + (size_t)f * live : nullptr;
       for (int64_t i = r0; i < r1; ++i) {
-        const int64_t r = rows_by_slot[i];
-        const int32_t b = col ? col[i] : xb[r * n_feat + f];
-        const double wr = w ? w[r] : 1.0;
-        const double yr = (double)yv[r];
+        const int32_t b = col ? col[i] : xb[rows_by_slot[i] * n_feat + f];
+        const double wr = wl ? wl[i] : 1.0;
+        const double yr = (double)yvl[i];
         if (bw[b] == 0.0 && bs[b] == 0.0 && bq[b] == 0.0) {
           touched.push_back(b);
           if (b > bt_max) bt_max = b;
