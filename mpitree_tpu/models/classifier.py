@@ -251,6 +251,15 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         X = validate_predict_data(X, self.n_features_, type(self).__name__)
         return self.tree_.count[self._leaf_ids(X)]
 
+    def decision_path(self, X):
+        """sklearn's ``decision_path``: CSR indicator of the nodes each
+        sample traverses (``utils/export.py``)."""
+        check_is_fitted(self)
+        X = validate_predict_data(X, self.n_features_, type(self).__name__)
+        from mpitree_tpu.utils.export import tree_decision_path
+
+        return tree_decision_path(self.tree_, self._leaf_ids(X))
+
     def apply(self, X):
         """sklearn's ``tree.apply``: the leaf index each sample lands in
         (vectorized gather-descent over the struct-of-arrays tree — the
@@ -267,6 +276,19 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         return self.classes_[idx]
 
     # -- introspection -----------------------------------------------------
+    def export_dot(self, *, feature_names=None, class_names=None,
+                   precision=2):
+        """Graphviz source of the fitted tree (sklearn's export_graphviz
+        idiom; ``utils/export.py``)."""
+        check_is_fitted(self)
+        from mpitree_tpu.utils.export import export_tree_dot
+
+        return export_tree_dot(
+            self.tree_, feature_names=feature_names,
+            class_names=class_names, precision=precision,
+            task="classification", n_features=self.n_features_,
+        )
+
     def export_text(self, *, feature_names=None, class_names=None, precision=2):
         check_is_fitted(self)
         return export_tree_text(

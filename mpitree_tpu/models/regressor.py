@@ -177,6 +177,15 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
         ids = predict_leaf_ids(jax.device_put(X), dev, t.max_depth)
         return np.asarray(ids)
 
+    def decision_path(self, X):
+        """sklearn's ``decision_path``: CSR indicator of the nodes each
+        sample traverses (``utils/export.py``)."""
+        check_is_fitted(self)
+        X = validate_predict_data(X, self.n_features_, type(self).__name__)
+        from mpitree_tpu.utils.export import tree_decision_path
+
+        return tree_decision_path(self.tree_, self._leaf_ids(X))
+
     def apply(self, X):
         """sklearn's ``tree.apply``: the leaf index each sample lands in
         (vectorized gather-descent over the struct-of-arrays tree — the
@@ -191,6 +200,16 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
         X = validate_predict_data(X, self.n_features_, type(self).__name__)
         # count[:, 0] holds the exact f64 node means from the refit pass.
         return self.tree_.count[self._leaf_ids(X), 0]
+
+    def export_dot(self, *, feature_names=None, precision=2):
+        """Graphviz source of the fitted tree (``utils/export.py``)."""
+        check_is_fitted(self)
+        from mpitree_tpu.utils.export import export_tree_dot
+
+        return export_tree_dot(
+            self.tree_, feature_names=feature_names, precision=precision,
+            task="regression", n_features=self.n_features_,
+        )
 
     def export_text(self, *, feature_names=None, precision=2):
         check_is_fitted(self)

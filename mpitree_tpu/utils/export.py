@@ -81,3 +81,108 @@ def check_feature_names(names, n_features: int):
             f"feature_names has {len(names)} entries; need >= {n_features}"
         )
     return np.asarray(names) if names is not None else None
+
+
+def export_tree_dot(
+    tree: TreeArrays, *, feature_names=None, class_names=None,
+    precision: int = 2, task: str = "classification",
+    n_features: int | None = None,
+) -> str:
+    """Graphviz ``digraph`` source for a fitted tree (sklearn's
+    ``export_graphviz`` idiom, adapted to this framework's node stats).
+
+    Interior nodes show the split (``f <= t``), weighted sample count, and
+    impurity; leaves show the class (or mean) and counts. Edge labels mark
+    the True/False branches like sklearn's rendering.
+    """
+    width = (
+        n_features if n_features is not None
+        else int(tree.feature.max(initial=-1)) + 1
+    )
+    names = check_feature_names(feature_names, width)
+
+    def esc(s) -> str:
+        # DOT label strings: backslash first, then the quote delimiter.
+        return str(s).replace("\\", "\\\\").replace('"', '\\"')
+
+    def fname(f: int) -> str:
+        return esc(names[f]) if names is not None else f"x[{f}]"
+
+    lines = [
+        "digraph Tree {",
+        'node [shape=box, style="rounded", fontname="helvetica"];',
+        'edge [fontname="helvetica"];',
+    ]
+    for i in range(tree.n_nodes):
+        imp = float(tree.impurity[i])
+        if tree.feature[i] >= 0:
+            head = (
+                f"{fname(int(tree.feature[i]))} <= "
+                f"{float(tree.threshold[i]):.{precision}f}"
+            )
+        elif task == "classification":
+            c = int(tree.value[i])
+            head = (
+                f"class = {esc(class_names[c])}" if class_names is not None
+                else f"class = {c}"
+            )
+        else:
+            head = f"value = {float(tree.count[i, 0]):.{precision}f}"
+        if task == "classification":
+            counts = ", ".join(
+                str(int(v)) if float(v).is_integer() else f"{float(v):.4f}"
+                for v in np.asarray(tree.count[i], dtype=float)
+            )
+            body = f"impurity = {imp:.{precision}f}\\ncounts = [{counts}]"
+        else:
+            body = (
+                f"impurity = {imp:.{precision}f}\\n"
+                f"n = {int(tree.n_node_samples[i])}"
+            )
+        lines.append(f'{i} [label="{head}\\n{body}"];')
+        l_, r_ = int(tree.left[i]), int(tree.right[i])
+        if l_ >= 0:
+            extra = (
+                ' [labeldistance=2.5, labelangle=45, headlabel="True"]'
+                if i == 0 else ""
+            )
+            lines.append(f"{i} -> {l_}{extra};")
+            extra = (
+                ' [labeldistance=2.5, labelangle=-45, headlabel="False"]'
+                if i == 0 else ""
+            )
+            lines.append(f"{i} -> {r_}{extra};")
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def tree_decision_path(tree: TreeArrays, X_binned_ids: np.ndarray):
+    """CSR indicator of the nodes each sample traverses (sklearn's
+    ``decision_path``), from per-sample LEAF ids: the parent chain is
+    reconstructed host-side (parents always have smaller ids).
+    """
+    from scipy import sparse
+
+    n = len(X_binned_ids)
+    depth = tree.depth
+    lens = depth[X_binned_ids] + 1
+    indptr = np.zeros(n + 1, np.int64)
+    np.cumsum(lens, out=indptr[1:])
+    indices = np.empty(int(indptr[-1]), np.int64)
+    cur = np.asarray(X_binned_ids, np.int64).copy()
+    # walk leaf -> root, filling each sample's segment from the back
+    pos = indptr[1:].copy() - 1
+    alive = np.ones(n, bool)
+    while alive.any():
+        indices[pos[alive]] = cur[alive]
+        pos[alive] -= 1
+        parents = tree.parent[cur[alive]]
+        up = parents >= 0
+        nxt = cur[alive]
+        nxt[up] = parents[up]
+        cur[alive] = nxt
+        alive[alive] = up  # refine the mask to rows still below the root
+    data = np.ones(len(indices), np.int8)
+    return sparse.csr_matrix(
+        (data, indices, indptr), shape=(n, tree.n_nodes)
+    )

@@ -1,0 +1,83 @@
+"""Graphviz export and decision_path (sklearn-surface accessors)."""
+
+import numpy as np
+
+from mpitree_tpu import DecisionTreeClassifier, DecisionTreeRegressor
+
+
+def _data(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 4)).astype(np.float32)
+    y = ((X[:, 0] > 0) + 2 * (X[:, 1] > 0.3)).astype(np.int64)
+    return X, y
+
+
+def test_export_dot_structure():
+    X, y = _data()
+    clf = DecisionTreeClassifier(max_depth=3, backend="host").fit(X, y)
+    dot = clf.export_dot(feature_names=["a", "b", "c", "d"],
+                         class_names=["u", "v", "w", "x"])
+    assert dot.startswith("digraph Tree {") and dot.endswith("}")
+    t = clf.tree_
+    n_interior = int((t.feature >= 0).sum())
+    assert dot.count(" -> ") == 2 * n_interior
+    # every node appears with a label; leaves name classes, splits features
+    for i in range(t.n_nodes):
+        assert f'{i} [label="' in dot
+    assert "class = " in dot and " <= " in dot
+    assert 'headlabel="True"' in dot  # root edge annotations
+
+
+def test_export_dot_regression():
+    X, _ = _data(seed=1)
+    yr = (X[:, 0] * 2).astype(np.float64)
+    reg = DecisionTreeRegressor(max_depth=3, backend="host").fit(X, yr)
+    dot = reg.export_dot()
+    assert "value = " in dot and dot.count(" -> ") == 2 * int(
+        (reg.tree_.feature >= 0).sum()
+    )
+
+
+def test_decision_path_matches_manual_walk():
+    X, y = _data(seed=2)
+    clf = DecisionTreeClassifier(max_depth=4, backend="host").fit(X, y)
+    paths = clf.decision_path(X)
+    t = clf.tree_
+    assert paths.shape == (len(X), t.n_nodes)
+    leaf_ids = clf.apply(X)
+    for i in rng_rows(len(X)):
+        # manual root->leaf walk
+        expect = []
+        node = 0
+        while True:
+            expect.append(node)
+            if t.feature[node] < 0:
+                break
+            node = int(
+                t.left[node]
+                if X[i, t.feature[node]] <= t.threshold[node]
+                else t.right[node]
+            )
+        got = paths.indices[paths.indptr[i]:paths.indptr[i + 1]]
+        assert list(got) == expect
+        assert expect[-1] == leaf_ids[i]
+    # every row visits the root; row sums are path lengths (depth+1)
+    assert (paths[:, 0].toarray().ravel() == 1).all()
+    np.testing.assert_array_equal(
+        np.asarray(paths.sum(axis=1)).ravel(), t.depth[leaf_ids] + 1
+    )
+
+
+def rng_rows(n, k=25, seed=3):
+    return np.random.default_rng(seed).choice(n, size=min(k, n), replace=False)
+
+
+def test_export_dot_escaping_and_validation():
+    import pytest
+
+    X, y = _data(seed=4)
+    clf = DecisionTreeClassifier(max_depth=2, backend="host").fit(X, y)
+    dot = clf.export_dot(feature_names=['si"ze', "b\\w", "c", "d"])
+    assert '\\"' in dot and "\\\\" in dot  # quotes and backslashes escaped
+    with pytest.raises(ValueError):
+        clf.export_dot(feature_names=["only", "two"])
