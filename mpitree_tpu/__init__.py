@@ -31,7 +31,12 @@ from mpitree_tpu.models.classifier import (
     DecisionTreeClassifier,
     ParallelDecisionTreeClassifier,
 )
-from mpitree_tpu.models.forest import RandomForestClassifier, RandomForestRegressor
+from mpitree_tpu.models.forest import (
+    ExtraTreesClassifier,
+    ExtraTreesRegressor,
+    RandomForestClassifier,
+    RandomForestRegressor,
+)
 from mpitree_tpu.models.regressor import DecisionTreeRegressor
 from mpitree_tpu.utils.serialize import load_model, save_model
 
@@ -43,6 +48,8 @@ __all__ = [
     "DecisionTreeRegressor",
     "RandomForestClassifier",
     "RandomForestRegressor",
+    "ExtraTreesClassifier",
+    "ExtraTreesRegressor",
     "save_model",
     "load_model",
 ]

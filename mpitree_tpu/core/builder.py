@@ -496,6 +496,7 @@ def build_tree(
             mesh, n_slots=S, n_bins=B, n_classes=C, task=task,
             criterion=cfg.criterion, debug=debug, use_pallas=S in tiers,
             node_mask=sampling,
+            random_split=sampling and feature_sampler.random_split,
         )
 
     mcw32 = np.float32(cfg.min_child_weight)
@@ -506,7 +507,11 @@ def build_tree(
             return (np.int32(lo), mcw32)
         nmask = np.ones((S_lvl, F), bool)
         nmask[:take] = keys.masks(lo, lo + take)
-        return (np.int32(lo), mcw32, nmask)
+        if not feature_sampler.random_split:
+            return (np.int32(lo), mcw32, nmask)
+        draws = np.zeros((S_lvl, F), np.uint32)
+        draws[:take] = keys.draws(lo, lo + take)
+        return (np.int32(lo), mcw32, nmask, draws)
 
     update_fn = collective.make_update_fn(mesh, n_slots=U)
     counts_fn = collective.make_counts_fn(

@@ -271,6 +271,7 @@ def build_tree_host(
     tree.n = 1
 
     sampling = feature_sampler is not None and feature_sampler.active
+    rand_split = sampling and feature_sampler.random_split
     keys = feature_sampler.key_store() if sampling else None
 
     nid = np.zeros(N, np.int32)
@@ -301,7 +302,9 @@ def build_tree_host(
         # Fast path: the native C++ sweep computes node stats and best splits
         # in O(rows + occupied bins) per node (native/split_kernel.cpp); the
         # numpy blocks below are the portable fallback.
-        nat = None if terminal else _native_splits(
+        # splitter="random" stays on the numpy sweep: the C++ kernel has
+        # no drawn-bin mode (the draw replaces its incremental argmin).
+        nat = None if (terminal or rand_split) else _native_splits(
             xb, y, nid, sample_weight, binned, cfg,
             frontier_lo=frontier_lo, n_slots=S, n_classes=C, task=task,
             node_mask=nmask,
@@ -386,7 +389,17 @@ def build_tree_host(
             if nmask is not None:
                 valid = valid & nmask[:, :, None]
             cost = np.where(valid, cost, np.inf)
-            bin_f = cost.argmin(axis=2)  # first-min = lowest threshold
+            if rand_split:
+                # splitter="random": one uniform pick among the VALID bins
+                # per (node, feature) — same keyed draw as the device
+                # engine (ops/impurity._drawn_bins), so trees agree.
+                draws = keys.draws(frontier_lo, frontier_lo + S)
+                cnt = valid.sum(axis=2)
+                j = (draws % np.maximum(cnt, 1).astype(np.uint32))
+                csum = np.cumsum(valid, axis=2)
+                bin_f = (csum > j[:, :, None].astype(np.int64)).argmax(axis=2)
+            else:
+                bin_f = cost.argmin(axis=2)  # first-min = lowest threshold
             cost_f = np.take_along_axis(cost, bin_f[:, :, None], axis=2)[:, :, 0]
             feat_best = cost_f.argmin(axis=1).astype(np.int32)  # lowest feature
             bin_best = np.take_along_axis(

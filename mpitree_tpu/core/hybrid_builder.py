@@ -447,7 +447,9 @@ def refine_deep_subtrees(
         feature_sampler.keys_for_tree(tree)[candidates] if sampling else None
     )
 
-    if native.lib() is not None:
+    if native.lib() is not None and not (
+        feature_sampler is not None and feature_sampler.random_split
+    ):
         rows_per = [order[s:e] for s, e in zip(starts, ends)]
         return _refine_batched(
             tree, X, y_enc, candidates, rows_per,

@@ -68,6 +68,14 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
     criterion : {"entropy", "gini"}, default="entropy"
         The reference implements entropy only; Gini is a target capability
         (BASELINE config 2).
+    splitter : {"best", "random"}, default="best"
+        "random" draws ONE uniform candidate per (node, feature) and keeps
+        the best feature (sklearn's extremely-randomized splitter,
+        quantized to this framework's candidate grammar: uniform over the
+        node's valid candidate bins). Draws derive from path-keyed hashes
+        (``ops/sampling.py``), so every engine and mesh size grows the
+        identical tree; like per-node ``max_features``, this runs on the
+        levelwise device engine and the numpy host tier.
     max_bins : int, default=256
         Candidate-threshold cap per feature in quantile binning.
     binning : {"auto", "exact", "quantile"}, default="auto"
@@ -114,7 +122,8 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
     _task = "classification"
 
     def __init__(self, *, max_depth=None, min_samples_split=2,
-                 criterion="entropy", max_bins=256, binning="auto",
+                 criterion="entropy", splitter="best", max_bins=256,
+                 binning="auto",
                  max_features=None, class_weight=None,
                  min_weight_fraction_leaf=0.0, min_samples_leaf=1,
                  random_state=None,
@@ -123,6 +132,7 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
         self.criterion = criterion
+        self.splitter = splitter
         self.max_bins = max_bins
         self.binning = binning
         self.max_features = max_features
@@ -169,7 +179,8 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         from mpitree_tpu.ops.sampling import sampler_for
 
         sampler = sampler_for(
-            self.max_features, self.random_state, X.shape[1]
+            self.max_features, self.random_state, X.shape[1],
+            splitter=getattr(self, "splitter", "best"),
         )
         if host:
             with timer.phase("host_build"):
@@ -342,18 +353,22 @@ class ParallelDecisionTreeClassifier(DecisionTreeClassifier):
     """
 
     def __init__(self, *, max_depth=None, min_samples_split=2,
-                 criterion="entropy", max_bins=256, binning="auto",
+                 criterion="entropy", splitter="best", max_bins=256,
+                 binning="auto",
                  max_features=None, class_weight=None,
                  min_weight_fraction_leaf=0.0, min_samples_leaf=1,
                  random_state=None,
-                 n_devices="all", backend=None, refine_depth="auto"):
+                 n_devices="all", backend=None, refine_depth="auto",
+                 ccp_alpha=0.0, min_impurity_decrease=0.0):
         super().__init__(
             max_depth=max_depth, min_samples_split=min_samples_split,
-            criterion=criterion, max_bins=max_bins, binning=binning,
+            criterion=criterion, splitter=splitter, max_bins=max_bins,
+            binning=binning,
             max_features=max_features, class_weight=class_weight,
             min_weight_fraction_leaf=min_weight_fraction_leaf,
             min_samples_leaf=min_samples_leaf, random_state=random_state,
             n_devices=n_devices, backend=backend, refine_depth=refine_depth,
+            ccp_alpha=ccp_alpha, min_impurity_decrease=min_impurity_decrease,
         )
 
     @_ClassProperty

@@ -75,7 +75,8 @@ class _BaseForest(BaseEstimator):
                  min_samples_leaf=1,
                  random_state=None, n_devices=None,
                  backend=None, refine_depth="auto", checkpoint=None,
-                 ccp_alpha=0.0, min_impurity_decrease=0.0):
+                 ccp_alpha=0.0, min_impurity_decrease=0.0,
+                 splitter="best"):
         self.n_estimators = n_estimators
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
@@ -97,6 +98,7 @@ class _BaseForest(BaseEstimator):
         self.checkpoint = checkpoint
         self.ccp_alpha = ccp_alpha
         self.min_impurity_decrease = min_impurity_decrease
+        self.splitter = splitter
 
     def _pop_oob_masks(self):
         """Consume the fit-time bootstrap OOB masks (they must not persist —
@@ -174,11 +176,18 @@ class _BaseForest(BaseEstimator):
                 f"max_features_mode must be 'node' or 'tree', "
                 f"got {self.max_features_mode!r}"
             )
+        if self.splitter not in ("best", "random"):
+            raise ValueError(
+                f"splitter must be 'best' or 'random', got {self.splitter!r}"
+            )
+        rand_split = self.splitter == "random"
         # sklearn semantics: a fresh feature subset at every NODE
         # (ops/sampling.py). Node keys thread through the host-orchestrated
-        # level loops, so node-sampled trees build per tree, not in the
-        # fused tree-sharded program.
-        node_mode = self.max_features_mode == "node" and k < X.shape[1]
+        # level loops, so node-sampled trees — and splitter="random" trees,
+        # whose per-node candidate draws ride the same keys — build per
+        # tree, not in the fused tree-sharded program.
+        node_sampling = self.max_features_mode == "node" and k < X.shape[1]
+        node_mode = node_sampling or rand_split
 
         # ---- phase A: every per-tree RNG draw happens up front -----------
         # (bootstrap multiplicities, OOB masks, feature subspaces). The
@@ -199,12 +208,21 @@ class _BaseForest(BaseEstimator):
             b = binned
             fmask = None
             sampler = None
-            if node_mode:
+            if node_sampling:
                 sampler = NodeFeatureSampler(
                     k=k, n_features=X.shape[1],
                     seed=int(rng.integers(2**32)),
+                    random_split=rand_split,
                 )
-            elif k < X.shape[1]:
+            elif rand_split:
+                # max_features_mode="tree" keeps its fixed per-tree subset
+                # (the fmask branch below); the sampler only carries the
+                # candidate draws.
+                sampler = NodeFeatureSampler(
+                    k=X.shape[1], n_features=X.shape[1],
+                    seed=int(rng.integers(2**32)), random_split=True,
+                )
+            if not node_sampling and k < X.shape[1]:
                 keep = np.sort(rng.choice(X.shape[1], size=k, replace=False))
                 fmask = np.zeros(X.shape[1], bool)
                 fmask[keep] = True
@@ -487,7 +505,7 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
                  random_state=None,
                  n_devices=None, backend=None, refine_depth="auto",
                  checkpoint=None, ccp_alpha=0.0,
-                 min_impurity_decrease=0.0):
+                 min_impurity_decrease=0.0, splitter="best"):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
@@ -498,6 +516,7 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
             random_state=random_state, n_devices=n_devices, backend=backend,
             refine_depth=refine_depth, checkpoint=checkpoint,
             ccp_alpha=ccp_alpha, min_impurity_decrease=min_impurity_decrease,
+            splitter=splitter,
         )
         self.criterion = criterion
         self.class_weight = class_weight
@@ -570,7 +589,7 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
                  min_samples_leaf=1, random_state=None,
                  n_devices=None, backend=None, refine_depth="auto",
                  checkpoint=None, ccp_alpha=0.0,
-                 min_impurity_decrease=0.0):
+                 min_impurity_decrease=0.0, splitter="best"):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
@@ -581,6 +600,7 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
             random_state=random_state, n_devices=n_devices, backend=backend,
             refine_depth=refine_depth, checkpoint=checkpoint,
             ccp_alpha=ccp_alpha, min_impurity_decrease=min_impurity_decrease,
+            splitter=splitter,
         )
 
     def fit(self, X, y, sample_weight=None):
@@ -619,3 +639,61 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
         for t, ids in self._leaf_ids(X):
             acc += t.count[ids, 0]
         return acc / len(self.trees_)
+
+
+class ExtraTreesClassifier(RandomForestClassifier):
+    """Extremely-randomized classification forest (sklearn's ExtraTrees).
+
+    Differences from :class:`RandomForestClassifier`, per sklearn's
+    grammar: ``splitter="random"`` (one keyed uniform candidate per
+    (node, feature) — quantized to this framework's candidate bins),
+    ``bootstrap=False`` (whole-sample fits), and per-node
+    ``max_features="sqrt"``. Draw keys derive from structural node paths
+    (``ops/sampling.py``), so refits and mesh sizes agree exactly.
+    """
+
+    def __init__(self, *, n_estimators=10, criterion="entropy",
+                 max_depth=None, min_samples_split=2, max_bins=256,
+                 binning="auto", bootstrap=False, max_features="sqrt",
+                 max_features_mode="node", oob_score=False, class_weight=None,
+                 min_weight_fraction_leaf=0.0, min_samples_leaf=1,
+                 random_state=None, n_devices=None, backend=None,
+                 refine_depth="auto", checkpoint=None, ccp_alpha=0.0,
+                 min_impurity_decrease=0.0):
+        super().__init__(
+            n_estimators=n_estimators, criterion=criterion,
+            max_depth=max_depth, min_samples_split=min_samples_split,
+            max_bins=max_bins, binning=binning, bootstrap=bootstrap,
+            max_features=max_features, max_features_mode=max_features_mode,
+            oob_score=oob_score, class_weight=class_weight,
+            min_weight_fraction_leaf=min_weight_fraction_leaf,
+            min_samples_leaf=min_samples_leaf, random_state=random_state,
+            n_devices=n_devices, backend=backend, refine_depth=refine_depth,
+            checkpoint=checkpoint, ccp_alpha=ccp_alpha,
+            min_impurity_decrease=min_impurity_decrease,
+            splitter="random",
+        )
+
+
+class ExtraTreesRegressor(RandomForestRegressor):
+    """Extremely-randomized regression forest (sklearn's ExtraTrees)."""
+
+    def __init__(self, *, n_estimators=10, max_depth=None,
+                 min_samples_split=2, max_bins=256, binning="auto",
+                 bootstrap=False, max_features=1.0, max_features_mode="node",
+                 oob_score=False, min_weight_fraction_leaf=0.0,
+                 min_samples_leaf=1, random_state=None, n_devices=None,
+                 backend=None, refine_depth="auto", checkpoint=None,
+                 ccp_alpha=0.0, min_impurity_decrease=0.0):
+        super().__init__(
+            n_estimators=n_estimators, max_depth=max_depth,
+            min_samples_split=min_samples_split, max_bins=max_bins,
+            binning=binning, bootstrap=bootstrap, max_features=max_features,
+            max_features_mode=max_features_mode, oob_score=oob_score,
+            min_weight_fraction_leaf=min_weight_fraction_leaf,
+            min_samples_leaf=min_samples_leaf, random_state=random_state,
+            n_devices=n_devices, backend=backend, refine_depth=refine_depth,
+            checkpoint=checkpoint, ccp_alpha=ccp_alpha,
+            min_impurity_decrease=min_impurity_decrease,
+            splitter="random",
+        )

@@ -49,7 +49,8 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
     _task = "regression"
 
     def __init__(self, *, max_depth=None, min_samples_split=2,
-                 criterion="squared_error", max_bins=256, binning="auto",
+                 criterion="squared_error", splitter="best", max_bins=256,
+                 binning="auto",
                  max_features=None, min_weight_fraction_leaf=0.0,
                  min_samples_leaf=1, random_state=None,
                  n_devices=None, backend=None, refine_depth="auto",
@@ -57,6 +58,7 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
         self.criterion = criterion
+        self.splitter = splitter
         self.max_bins = max_bins
         self.binning = binning
         self.max_features = max_features
@@ -105,7 +107,8 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
         from mpitree_tpu.ops.sampling import sampler_for
 
         sampler = sampler_for(
-            self.max_features, self.random_state, X.shape[1]
+            self.max_features, self.random_state, X.shape[1],
+            splitter=getattr(self, "splitter", "best"),
         )
         if host:
             with timer.phase("host_build"):

@@ -85,6 +85,7 @@ def class_impurity(counts: jax.Array, n: jax.Array, criterion: str) -> jax.Array
 def best_split_classification(
     hist: jax.Array, cand_mask: jax.Array, *, criterion: str = "entropy",
     node_mask: jax.Array | None = None, min_child_weight=None,
+    forced_draw: jax.Array | None = None,
 ) -> SplitDecision:
     """Pick the best (feature, bin) per frontier slot from a class histogram.
 
@@ -143,7 +144,10 @@ def best_split_classification(
         valid = valid & node_mask[:, :, None]
     cost = jnp.where(valid, cost, jnp.inf)
 
-    best_bin_f = jnp.argmin(cost, axis=2)  # (K, F) first-min = lowest threshold
+    if forced_draw is None:
+        best_bin_f = jnp.argmin(cost, axis=2)  # (K, F) first-min = lowest threshold
+    else:
+        best_bin_f = _drawn_bins(valid, forced_draw)
     best_cost_f = jnp.take_along_axis(cost, best_bin_f[:, :, None], axis=2)[:, :, 0]
     best_feature = jnp.argmin(best_cost_f, axis=1)  # (K,) first-min = lowest feature
     best_bin = jnp.take_along_axis(best_bin_f, best_feature[:, None], axis=1)[:, 0]
@@ -168,9 +172,23 @@ def best_split_classification(
     )
 
 
+def _drawn_bins(valid: jax.Array, draw: jax.Array) -> jax.Array:
+    """splitter="random": per (slot, feature), one uniform pick among the
+    VALID candidate bins (sklearn's ExtraTrees threshold draw, quantized to
+    the candidate grammar). ``draw`` is (K, F) uint32 from the path-derived
+    node keys (ops/sampling.py), so every engine — and every mesh size —
+    draws identically. Features with no valid candidate fall to bin 0,
+    whose cost is already +inf."""
+    cnt = valid.sum(axis=2)  # (K, F)
+    j = (draw % jnp.maximum(cnt, 1).astype(jnp.uint32)).astype(jnp.int32)
+    csum = jnp.cumsum(valid.astype(jnp.int32), axis=2)
+    return jnp.argmax(csum > j[:, :, None], axis=2)
+
+
 def best_split_regression(
     hist: jax.Array, cand_mask: jax.Array,
     node_mask: jax.Array | None = None, min_child_weight=None,
+    forced_draw: jax.Array | None = None,
 ) -> SplitDecision:
     """Pick the best MSE split per frontier slot from a moment histogram.
 
@@ -204,7 +222,10 @@ def best_split_regression(
         valid = valid & node_mask[:, :, None]
     cost = jnp.where(valid, cost, jnp.inf)
 
-    best_bin_f = jnp.argmin(cost, axis=2)
+    if forced_draw is None:
+        best_bin_f = jnp.argmin(cost, axis=2)
+    else:
+        best_bin_f = _drawn_bins(valid, forced_draw)
     best_cost_f = jnp.take_along_axis(cost, best_bin_f[:, :, None], axis=2)[:, :, 0]
     best_feature = jnp.argmin(best_cost_f, axis=1)
     best_bin = jnp.take_along_axis(best_bin_f, best_feature[:, None], axis=1)[:, 0]

@@ -55,17 +55,23 @@ def seed_from(random_state) -> int:
         ) from None
 
 
-def sampler_for(max_features, random_state, n_features: int):
+def sampler_for(max_features, random_state, n_features: int,
+                splitter: str = "best"):
     """Estimator-side constructor: sampler for the params, or None.
 
     sklearn's single-tree estimators accept the same ``max_features``
     grammar.
     """
+    if splitter not in ("best", "random"):
+        raise ValueError(
+            f"splitter must be 'best' or 'random', got {splitter!r}"
+        )
     k = n_subspace_features(max_features, n_features)
-    if k >= n_features:
+    if k >= n_features and splitter == "best":
         return None
     return NodeFeatureSampler(
-        k=k, n_features=n_features, seed=seed_from(random_state)
+        k=min(k, n_features), n_features=n_features,
+        seed=seed_from(random_state), random_split=(splitter == "random"),
     )
 
 
@@ -112,6 +118,7 @@ _FIN = np.uint32(277803737)
 _LEFT_SALT = np.uint32(0x9E3779B9)
 _RIGHT_SALT = np.uint32(0xC2B2AE35)
 _FEAT_SALT = np.uint32(0x85EBCA6B)
+_DRAW_SALT = np.uint32(0x27D4EB2F)  # random-split bin draws (ExtraTrees)
 
 
 def pcg_hash(x: np.ndarray) -> np.ndarray:
@@ -140,10 +147,15 @@ class NodeFeatureSampler:
     n_features: int
     seed: int
     root_key_value: int | None = None  # subtree builds start mid-path
+    # ExtraTrees mode: per-(node, feature) uniform candidate draws replace
+    # the exhaustive per-feature argmin (sklearn's splitter="random",
+    # quantized to the candidate grammar: uniform over the node's VALID
+    # candidate bins rather than the continuous value range).
+    random_split: bool = False
 
     @property
     def active(self) -> bool:
-        return self.k < self.n_features
+        return self.k < self.n_features or self.random_split
 
     def root_key(self) -> np.uint32:
         if self.root_key_value is not None:
@@ -161,8 +173,11 @@ class NodeFeatureSampler:
         Stable ascending argsort of per-(node, feature) hash scores; the
         first k positions of the permutation win. Stability makes hash
         collisions resolve to the lowest feature index identically in every
-        implementation.
+        implementation. ``k >= n_features`` (splitter="random" with no
+        subsetting — the ExtraTreesRegressor default) skips the scoring.
         """
+        if self.k >= self.n_features:
+            return np.ones((len(keys), self.n_features), bool)
         f = np.arange(self.n_features, dtype=np.uint32)
         with np.errstate(over="ignore"):
             scores = pcg_hash(
@@ -173,6 +188,16 @@ class NodeFeatureSampler:
         mask = np.zeros((len(keys), self.n_features), bool)
         np.put_along_axis(mask, order[:, : self.k], True, axis=1)
         return mask
+
+    def node_draws(self, keys: np.ndarray) -> np.ndarray:
+        """(S,) keys -> (S, F) uint32 — the per-(node, feature) draw used
+        by splitter="random" (independent salt from the subset scores)."""
+        f = np.arange(self.n_features, dtype=np.uint32)
+        with np.errstate(over="ignore"):
+            return pcg_hash(
+                keys.astype(np.uint32)[:, None]
+                ^ ((f[None, :] + np.uint32(1)) * _DRAW_SALT).astype(np.uint32)
+            )
 
     def key_store(self, root_keys=None) -> "KeyStore":
         return KeyStore(self, root_keys)
@@ -219,6 +244,9 @@ class KeyStore:
 
     def masks(self, lo: int, hi: int) -> np.ndarray:
         return self._sampler.node_masks(self.keys[lo:hi])
+
+    def draws(self, lo: int, hi: int) -> np.ndarray:
+        return self._sampler.node_draws(self.keys[lo:hi])
 
     def assign_children(self, parent_ids, left_ids, right_ids, n_total: int):
         """Hand children their path-derived keys (growing the store)."""

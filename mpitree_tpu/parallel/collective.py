@@ -121,7 +121,8 @@ def unpack_decision(packed: "np.ndarray") -> dict:
 @lru_cache(maxsize=64)
 def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                   task: str, criterion: str, debug: bool = False,
-                  use_pallas: bool = False, node_mask: bool = False):
+                  use_pallas: bool = False, node_mask: bool = False,
+                  random_split: bool = False):
     """Jitted (x_binned, y, node_id, weight, cand_mask, chunk_lo, mcw[, nmask])
     -> packed (n_slots, 7 + C) float32 decision buffer (see
     :func:`_pack_decision`, :func:`unpack_decision`). ``mcw`` is the
@@ -136,10 +137,14 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     platform/VMEM and on the exactness policy in
     :func:`mpitree_tpu.core.builder.resolve_hist_kernel`.
     ``node_mask=True`` adds a trailing (n_slots, F) bool input of per-node
-    allowed features (sklearn per-node ``max_features``; ops/sampling.py)."""
+    allowed features (sklearn per-node ``max_features``; ops/sampling.py).
+    ``random_split=True`` adds a further (n_slots, F) uint32 input of
+    per-(node, feature) candidate draws (ExtraTrees; the drawn bin replaces
+    the per-feature argmin)."""
 
     def local_step(xb, y, nid, w, cand_mask, chunk_lo, mcw, *nm):
         nmask = nm[0] if nm else None
+        draws = nm[1] if random_split else None
         if task == "classification":
             if use_pallas:
                 from mpitree_tpu.ops import pallas_hist as ph
@@ -158,7 +163,7 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
             h = lax.psum(h, DATA_AXIS)
             dec = imp_ops.best_split_classification(
                 h, cand_mask, criterion=criterion, node_mask=nmask,
-                min_child_weight=mcw,
+                min_child_weight=mcw, forced_draw=draws,
             )
         else:
             if use_pallas:
@@ -177,6 +182,7 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
             h = lax.psum(h, DATA_AXIS)
             dec = imp_ops.best_split_regression(
                 h, cand_mask, node_mask=nmask, min_child_weight=mcw,
+                forced_draw=draws,
             )
             ymin, ymax = regression_y_range(
                 y, nid, w, chunk_lo, n_slots=n_slots
@@ -191,6 +197,8 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     in_specs = (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                 P(), P(), P())
     if node_mask:
+        in_specs = in_specs + (P(),)
+    if random_split:
         in_specs = in_specs + (P(),)
     sharded = jax.shard_map(
         local_step,

@@ -32,6 +32,8 @@ _ESTIMATOR_CLASSES = (
     "DecisionTreeRegressor",
     "RandomForestClassifier",
     "RandomForestRegressor",
+    "ExtraTreesClassifier",
+    "ExtraTreesRegressor",
 )
 
 
@@ -134,7 +136,7 @@ def load_model(path):
         if "classes_" in z.files:
             est.classes_ = z["classes_"]
         trees = [_read_tree(z, f"tree{i}/") for i in range(header["n_trees"])]
-    if header["class"].startswith("RandomForest"):
+    if header["class"].startswith(("RandomForest", "ExtraTrees")):
         # _TreeList (not a plain list) so the weak-ref stacked-predict cache
         # works on loaded forests exactly as on freshly fitted ones.
         from mpitree_tpu.models.forest import _TreeList

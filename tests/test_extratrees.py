@@ -1,0 +1,148 @@
+"""splitter="random" and the ExtraTrees forests.
+
+sklearn's extremely-randomized splitter, quantized to this framework's
+candidate grammar: per (node, feature) ONE uniform pick among the node's
+valid candidate bins, best feature kept. Draws derive from path-keyed
+hashes (ops/sampling.py), so every engine — host numpy tier and the
+levelwise device engine, at any mesh size — grows the identical tree.
+"""
+
+import numpy as np
+import pytest
+
+from mpitree_tpu import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    ExtraTreesClassifier,
+    ExtraTreesRegressor,
+)
+
+
+def _data(n=500, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    y = ((X[:, 0] > 0) + 2 * (X[:, 1] > 0.3)).astype(np.int64)
+    return X, y
+
+
+def test_random_splitter_engine_identity():
+    """Host numpy tier == levelwise device engine == 8-device mesh."""
+    X, y = _data()
+    kw = dict(max_depth=6, splitter="random", random_state=3,
+              refine_depth=None)
+    host = DecisionTreeClassifier(backend="host", **kw).fit(X, y)
+    dev1 = DecisionTreeClassifier(backend="cpu", **kw).fit(X, y)
+    dev8 = DecisionTreeClassifier(backend="cpu", n_devices="all", **kw).fit(
+        X, y
+    )
+    assert host.export_text() == dev1.export_text() == dev8.export_text()
+
+
+def test_random_splitter_is_deterministic_and_seed_sensitive():
+    X, y = _data(seed=1)
+    kw = dict(max_depth=6, splitter="random", backend="host",
+              refine_depth=None)
+    a = DecisionTreeClassifier(random_state=0, **kw).fit(X, y)
+    b = DecisionTreeClassifier(random_state=0, **kw).fit(X, y)
+    c = DecisionTreeClassifier(random_state=1, **kw).fit(X, y)
+    assert a.export_text() == b.export_text()
+    assert a.export_text() != c.export_text()  # different draws
+    # and random differs from exhaustive best-split search
+    best = DecisionTreeClassifier(
+        max_depth=6, backend="host", refine_depth=None
+    ).fit(X, y)
+    assert a.export_text() != best.export_text()
+
+
+def test_random_splitter_trees_are_valid_and_learn():
+    X, y = _data(seed=2)
+    clf = DecisionTreeClassifier(
+        max_depth=10, splitter="random", random_state=0, backend="host",
+        min_samples_leaf=2,
+    ).fit(X, y)
+    t = clf.tree_
+    # structural soundness + floors hold under drawn candidates
+    interior = np.nonzero(t.feature >= 0)[0]
+    for i in interior:
+        assert t.left[i] > i and t.right[i] > i
+        assert t.n_node_samples[t.left[i]] >= 2
+        assert t.n_node_samples[t.right[i]] >= 2
+    assert clf.score(X, y) > 0.8  # randomized but still learns
+
+
+def test_random_splitter_with_max_features():
+    X, y = _data(seed=3)
+    clf = DecisionTreeClassifier(
+        max_depth=8, splitter="random", max_features="sqrt",
+        random_state=0, backend="host", refine_depth=None,
+    ).fit(X, y)
+    dev = DecisionTreeClassifier(
+        max_depth=8, splitter="random", max_features="sqrt",
+        random_state=0, backend="cpu", refine_depth=None,
+    ).fit(X, y)
+    assert clf.export_text() == dev.export_text()
+
+
+def test_random_splitter_regressor():
+    X, _ = _data(seed=4)
+    yr = (X[:, 0] * 2 + np.sin(3 * X[:, 1])).astype(np.float64)
+    kw = dict(max_depth=8, splitter="random", random_state=0,
+              refine_depth=None)
+    host = DecisionTreeRegressor(backend="host", **kw).fit(X, yr)
+    dev = DecisionTreeRegressor(backend="cpu", **kw).fit(X, yr)
+    np.testing.assert_array_equal(host.predict(X), dev.predict(X))
+    assert host.score(X, yr) > 0.5
+
+
+def test_splitter_validation():
+    X, y = _data(200, seed=5)
+    with pytest.raises(ValueError):
+        DecisionTreeClassifier(splitter="bogus").fit(X, y)
+
+
+def test_extratrees_classifier_ensemble():
+    X, y = _data(800, seed=6)
+    et = ExtraTreesClassifier(
+        n_estimators=8, max_depth=8, random_state=0
+    ).fit(X, y)
+    assert len(et.trees_) == 8
+    assert et.score(X, y) > 0.9
+    # bootstrap=False default: refits are identical (all randomness keyed)
+    et2 = ExtraTreesClassifier(
+        n_estimators=8, max_depth=8, random_state=0
+    ).fit(X, y)
+    for a, b in zip(et.trees_, et2.trees_):
+        np.testing.assert_array_equal(a.feature, b.feature)
+    # trees differ from one another (per-tree seeds)
+    assert any(
+        et.trees_[0].n_nodes != t.n_nodes
+        or not np.array_equal(et.trees_[0].feature, t.feature)
+        for t in et.trees_[1:]
+    )
+    # accuracy in the same league as sklearn's ExtraTrees
+    from sklearn.ensemble import ExtraTreesClassifier as SkET
+
+    sk = SkET(n_estimators=8, max_depth=8, random_state=0).fit(X, y)
+    assert et.score(X, y) > sk.score(X, y) - 0.07
+
+
+def test_extratrees_regressor_ensemble():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(800, 6)).astype(np.float32)
+    yr = (X[:, 0] * 2 + np.sin(3 * X[:, 1]) + 0.1 * rng.normal(size=800))
+    et = ExtraTreesRegressor(
+        n_estimators=8, max_depth=8, random_state=0
+    ).fit(X, yr)
+    assert et.score(X, yr) > 0.7
+
+
+def test_extratrees_serialize_roundtrip(tmp_path):
+    from mpitree_tpu import load_model, save_model
+
+    X, y = _data(300, seed=8)
+    et = ExtraTreesClassifier(n_estimators=3, max_depth=4, random_state=0)
+    et.fit(X, y)
+    p = tmp_path / "et.npz"
+    save_model(et, p)
+    back = load_model(p)
+    np.testing.assert_array_equal(back.predict(X), et.predict(X))
