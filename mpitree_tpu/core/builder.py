@@ -333,8 +333,10 @@ def build_tree(
 
     ``feature_sampler`` (:class:`ops.sampling.NodeFeatureSampler`, optional):
     per-node random feature subsets, sklearn ``max_features`` semantics.
-    Runs on the levelwise engine (node keys thread through the host level
-    loop); incompatible with a (data, feature) mesh.
+    Both engines run it — the levelwise loop threads node keys host-side,
+    the fused program evaluates the identical PCG arithmetic in-jit
+    (``ops/sampling.py`` jnp twins) — so trees are engine-invariant.
+    Incompatible with a (data, feature) mesh.
 
     ``refit_targets`` (regression only): f64 target vector used to recompute
     every node's value exactly from the final row assignments — the on-device
@@ -378,28 +380,16 @@ def build_tree(
     if engine not in ("auto", "fused", "levelwise"):
         raise ValueError(f"unknown build engine {engine!r}")
     sampling = feature_sampler is not None and feature_sampler.active
-    if sampling:
-        # Per-node keys thread through the host level loop; the fused
-        # while_loop has no host between levels, so sampling pins levelwise.
-        if mesh_lib.feature_shards(mesh) > 1:
-            raise ValueError(
-                "per-node feature sampling is not supported on a "
-                "(data, feature) mesh"
-            )
-        if cfg.engine == "fused":
-            raise ValueError(
-                "engine='fused' cannot run per-node feature sampling; "
-                "use engine='auto' or 'levelwise' with max_features"
-            )
-        if engine == "fused":  # env-sourced default: downgrade with a signal
-            import warnings
-
-            warnings.warn(
-                "MPITREE_TPU_ENGINE=fused ignored with per-node feature "
-                "sampling; using the levelwise engine",
-                stacklevel=2,
-            )
-        engine = "levelwise"
+    if sampling and mesh_lib.feature_shards(mesh) > 1:
+        # Neither engine evaluates per-node masks across feature shards
+        # (the subset straddles blocks; the first-min merge would need
+        # mask-aware rerouting). Both 1-D engines support sampling: the
+        # levelwise loop threads keys host-side, the fused program runs
+        # the jnp twin of the same arithmetic in its while_loop body.
+        raise ValueError(
+            "per-node feature sampling is not supported on a "
+            "(data, feature) mesh"
+        )
     if mesh_lib.feature_shards(mesh) > 1:
         # Only an explicit config choice is an error; an env-sourced
         # levelwise (a steerable default) falls back to the one engine that
@@ -441,6 +431,7 @@ def build_tree(
             binned, y, config=cfg, mesh=mesh, n_classes=n_classes,
             sample_weight=sample_weight, refit_targets=refit_targets,
             timer=timer, return_leaf_ids=return_leaf_ids,
+            feature_sampler=feature_sampler,
         )
     task = cfg.task
     N, F = binned.x_binned.shape

@@ -48,6 +48,7 @@ from mpitree_tpu.core.tree_struct import TreeArrays
 from mpitree_tpu.ops import histogram as hist_ops
 from mpitree_tpu.ops import impurity as imp_ops
 from mpitree_tpu.ops import pallas_hist
+from mpitree_tpu.ops import sampling as sampling_ops
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.parallel.collective import node_counts_local, regression_y_range
 from mpitree_tpu.parallel.mesh import DATA_AXIS, TREE_AXIS
@@ -79,12 +80,31 @@ def _node_capacity(n_samples: int, max_depth) -> int:
     return 1 << max(0, math.ceil(math.log2(max(cap, 1))))
 
 
+def _sampler_statics(feature_sampler, n_features: int):
+    """(sample_k, random_split, root_key operand) for a NodeFeatureSampler.
+
+    ``sample_k=None`` disables per-node masks (k >= F subsets everything);
+    the root key is a uint32 scalar operand so subtree rebuilds (hybrid
+    refine roots carry ``root_key_value``) reuse the compiled executable.
+    """
+    if feature_sampler is None or not feature_sampler.active:
+        return None, False, np.uint32(0)
+    k = feature_sampler.k
+    return (
+        k if k < n_features else None,
+        bool(feature_sampler.random_split),
+        np.uint32(feature_sampler.root_key()),
+    )
+
+
 def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                      task: str, criterion: str, max_nodes: int,
                      max_depth: int, min_samples_split: int,
                      tiers: tuple = (), use_pallas: bool = False,
                      psum_axis: str | None = DATA_AXIS,
-                     feature_axis: str | None = None):
+                     feature_axis: str | None = None,
+                     sample_k: int | None = None,
+                     random_split: bool = False):
     """Pure per-device build fn (xb, y, nid0, w, cand_mask) -> tree arrays.
 
     ``max_depth < 0`` means unbounded. ``psum_axis`` names the mesh axis that
@@ -105,6 +125,16 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
     (``ops/pallas_hist.py``) — bit-identical for integer-valued class
     counts, explicit-opt-in-only for non-integer payloads (the exactness
     policy in ``builder.resolve_hist_kernel``).
+
+    ``sample_k`` enables sklearn's per-NODE random feature subsets inside the
+    fused program: a uint32 path-key array rides the while_loop state, each
+    level slices its frontier's keys and derives (slot, F) feature masks
+    with the jnp twin of the host tier's PCG arithmetic
+    (``ops/sampling.py:node_masks_jnp``), and splitting nodes hash child
+    keys into their slots — the same keys every other engine computes, so
+    the engine-identity contract holds. ``random_split`` likewise derives
+    per-(node, feature) candidate draws (ExtraTrees, splitter="random").
+    The build fn then takes a trailing ``root_key`` uint32 operand.
     """
     # K slots of slack past the true capacity: the last chunk's
     # dynamic_update_slice window [chunk_lo, chunk_lo+K) may extend past the
@@ -114,14 +144,21 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
     M = max_nodes + n_slots
     tiers = builder_valid_tiers(tiers, K)
     hist_vma = tuple(a for a in (psum_axis, feature_axis) if a is not None)
+    sampling = sample_k is not None or random_split
+    if sampling and feature_axis is not None:
+        raise ValueError(
+            "per-node feature sampling is not supported on a "
+            "(data, feature) mesh"
+        )
 
     def psum(x):
         return lax.psum(x, psum_axis) if psum_axis is not None else x
 
-    def build(xb, y, nid0, w, cand_mask, mcw, mid):
+    def build(xb, y, nid0, w, cand_mask, mcw, mid, root_key):
         # mid: sklearn's min_impurity_decrease pre-scaled by the total fit
         # weight (BuildConfig.min_decrease_scaled), a runtime operand so
-        # distinct thresholds share one executable.
+        # distinct thresholds share one executable. root_key: the tree's
+        # path-key seed (unused scalar when sampling is off).
         R, F = xb.shape  # F = per-shard feature count on a feature mesh
         # C == n_classes for classification, 3 (moment channels) for
         # regression — the VMEM check covers both payload widths.
@@ -170,8 +207,24 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                 constant=nonconst == 0,
             )
 
-        def chunk_stats(chunk_lo, nid, n_stat_slots, pallas_ok=False):
+        def node_subsets(chunk_lo, n_stat_slots, key_a):
+            """Per-node feature masks + candidate draws for a frontier window."""
+            if not sampling:
+                return None, None
+            kw = lax.dynamic_slice(key_a, (chunk_lo,), (n_stat_slots,))
+            nmask = (
+                sampling_ops.node_masks_jnp(kw, sample_k, F)
+                if sample_k is not None else None
+            )
+            draws = (
+                sampling_ops.node_draws_jnp(kw, F) if random_split else None
+            )
+            return nmask, draws
+
+        def chunk_stats(chunk_lo, nid, n_stat_slots, pallas_ok=False,
+                        key_a=None):
             """Histogram + split search for nodes [chunk_lo, chunk_lo+S_or_K)."""
+            nmask, draws = node_subsets(chunk_lo, n_stat_slots, key_a)
             if task == "classification":
                 if pallas_ok:
                     h = pallas_hist.histogram_small(
@@ -186,7 +239,8 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                 h = psum(h)
                 dec = select_global(imp_ops.best_split_classification(
                     h, cand_mask, criterion=criterion,
-                    min_child_weight=mcw,
+                    min_child_weight=mcw, node_mask=nmask,
+                    forced_draw=draws,
                 ))
                 pure = (dec.counts > 0).sum(axis=1) <= 1
             else:
@@ -202,7 +256,8 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                     )
                 h = psum(h)
                 dec = select_global(imp_ops.best_split_regression(
-                    h, cand_mask, min_child_weight=mcw,
+                    h, cand_mask, min_child_weight=mcw, node_mask=nmask,
+                    forced_draw=draws,
                 ))
                 ymin, ymax = regression_y_range(
                     y, nid, w, chunk_lo, n_slots=n_stat_slots, axis=psum_axis
@@ -219,7 +274,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
 
         def level_body(state):
             (feat_a, bin_a, counts_a, n_a, left_a, parent_a, nid, flo, fsz,
-             depth) = state
+             depth, key_a) = state
             terminal = jnp.logical_and(max_depth >= 0, depth == max_depth)
             n_chunks = (fsz + K - 1) // K
 
@@ -242,7 +297,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                 chunk_lo = flo + c * K
 
                 def interior(_):
-                    return decide(*chunk_stats(chunk_lo, nid, K))
+                    return decide(*chunk_stats(chunk_lo, nid, K, key_a=key_a))
 
                 def term(_):
                     cc = chunk_counts(chunk_lo, nid)
@@ -268,7 +323,8 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                 def branch(bufs):
                     feat_a, bin_a, counts_a, n_a = bufs
                     feat_k, bin_k, counts_k, n_k = decide(
-                        *chunk_stats(flo, nid, s, pallas_ok=s in pallas_tiers)
+                        *chunk_stats(flo, nid, s, pallas_ok=s in pallas_tiers,
+                                     key_a=key_a)
                     )
                     feat_a = lax.dynamic_update_slice(feat_a, feat_k, (flo,))
                     bin_a = lax.dynamic_update_slice(bin_a, bin_k, (flo,))
@@ -310,7 +366,20 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
             parent_pad = parent_pad.at[scat + 1].set(
                 jnp.where(is_split, idx, -1)
             )
-            parent_a = jnp.where(parent_pad[:M] >= 0, parent_pad[:M], parent_a)
+            newly = parent_pad[:M] >= 0
+            parent_a = jnp.where(newly, parent_pad[:M], parent_a)
+            if sampling:
+                # Children inherit path-hashed keys through the same scatter
+                # pattern the parent links use (ops/sampling.py arithmetic).
+                lk, rk = sampling_ops.child_keys_jnp(key_a)
+                key_pad = jnp.zeros(M + 2, jnp.uint32)
+                key_pad = key_pad.at[scat].set(
+                    jnp.where(is_split, lk, jnp.uint32(0))
+                )
+                key_pad = key_pad.at[scat + 1].set(
+                    jnp.where(is_split, rk, jnp.uint32(0))
+                )
+                key_a = jnp.where(newly, key_pad[:M], key_a)
 
             # Reroute rows of splitting nodes (on-device mask partition —
             # the reference's recursive X[region] copies, decision_tree.py:150-164).
@@ -343,7 +412,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                 nid = jnp.where(active, child_all, nid)
 
             return (feat_a, bin_a, counts_a, n_a, left_a, parent_a, nid,
-                    flo + fsz, 2 * n_split, depth + 1)
+                    flo + fsz, 2 * n_split, depth + 1, key_a)
 
         def level_cond(state):
             return state[8] > 0
@@ -359,9 +428,10 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
             jnp.int32(0),                          # frontier_lo
             jnp.int32(1),                          # frontier_size
             jnp.int32(0),                          # depth
+            jnp.zeros(M, jnp.uint32).at[0].set(root_key.astype(jnp.uint32)),
         )
         out = lax.while_loop(level_cond, level_body, state0)
-        feat_a, bin_a, counts_a, n_a, left_a, parent_a, nid, flo, _, _ = out
+        feat_a, bin_a, counts_a, n_a, left_a, parent_a, nid, flo = out[:8]
         return feat_a, bin_a, counts_a, n_a, left_a, parent_a, nid, flo
 
     return build
@@ -371,13 +441,15 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
 def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                    task: str, criterion: str, max_nodes: int, max_depth: int,
                    min_samples_split: int, tiers: tuple = (),
-                   use_pallas: bool = False):
+                   use_pallas: bool = False, sample_k: int | None = None,
+                   random_split: bool = False):
     """Data-parallel single-tree build: rows sharded, histograms psum'd.
 
-    Jitted (xb, y, nid0, w, cand_mask) -> (tree arrays..., nid, n_nodes);
-    tree outputs replicated, the final row assignment sharded (for the
-    regression refit pass). On a 2-D ``(data, feature)`` mesh the histogram's
-    feature dimension shards over the second axis (tensor parallelism).
+    Jitted (xb, y, nid0, w, cand_mask, mcw, mid, root_key) ->
+    (tree arrays..., nid, n_nodes); tree outputs replicated, the final row
+    assignment sharded (for the regression refit pass). On a 2-D
+    ``(data, feature)`` mesh the histogram's feature dimension shards over
+    the second axis (tensor parallelism).
     """
     feature_axis = (
         mesh_lib.FEATURE_AXIS
@@ -388,7 +460,8 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         criterion=criterion, max_nodes=max_nodes, max_depth=max_depth,
         min_samples_split=min_samples_split, tiers=tiers,
         use_pallas=use_pallas, psum_axis=DATA_AXIS,
-        feature_axis=feature_axis,
+        feature_axis=feature_axis, sample_k=sample_k,
+        random_split=random_split,
     )
     FA = feature_axis  # None on a 1-D mesh -> replicated feature dim
     out_specs = (P(), P(), P(), P(), P(), P(), P(DATA_AXIS), P())
@@ -396,7 +469,7 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         build,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, FA), P(DATA_AXIS), P(DATA_AXIS),
-                  P(DATA_AXIS), P(FA, None), P(), P()),
+                  P(DATA_AXIS), P(FA, None), P(), P(), P()),
         out_specs=out_specs,
         check_vma=FA is None,  # replicated/varying mixes in the 2-D cond
     )
@@ -408,7 +481,9 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                     task: str, criterion: str, max_nodes: int,
                     max_depth: int, min_samples_split: int,
                     tiers: tuple = (), use_pallas: bool = False,
-                    data_sharded: bool = False):
+                    data_sharded: bool = False,
+                    sample_k: int | None = None,
+                    random_split: bool = False):
     """Tree-parallel forest build: trees sharded over the mesh (ensemble
     parallelism — BASELINE configs[4], "N trees sharded across TPU chips").
 
@@ -431,31 +506,35 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         min_samples_split=min_samples_split, tiers=tiers,
         use_pallas=use_pallas,
         psum_axis=DATA_AXIS if data_sharded else None,
+        sample_k=sample_k, random_split=random_split,
     )
 
-    def per_device(xb, y, nid0, ws, cand_masks, mcw, mid):
+    def per_device(xb, y, nid0, ws, cand_masks, mcw, mid, root_keys):
         # mcw/mid: (T_local,) per-tree leaf floors and decrease gates —
         # sklearn recomputes both min_weight_fraction_leaf and the
         # min_impurity_decrease scaling from each tree's composed bootstrap
         # weight total, so both ride the tree axis with the weights (and
         # the host failover path, which uses tree_cfg per tree, stays
-        # bit-identical to this program).
+        # bit-identical to this program). root_keys: (T_local,) per-tree
+        # path-key seeds (per-node feature subsets / random splits).
         return lax.map(
-            lambda wcm: build(xb, y, nid0, wcm[0], wcm[1], wcm[2], wcm[3]),
-            (ws, cand_masks, mcw, mid),
+            lambda wcm: build(xb, y, nid0, wcm[0], wcm[1], wcm[2], wcm[3],
+                              wcm[4]),
+            (ws, cand_masks, mcw, mid, root_keys),
         )
 
     t = P(TREE_AXIS)
     if data_sharded:
         in_specs = (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
                     P(TREE_AXIS, DATA_AXIS), P(TREE_AXIS, None, None),
-                    P(TREE_AXIS), P(TREE_AXIS))
+                    P(TREE_AXIS), P(TREE_AXIS), P(TREE_AXIS))
         # tree outputs are replicated across each tree group after the
         # psum'd decisions; the row assignment stays sharded
         out_specs = (t, t, t, t, t, t, P(TREE_AXIS, DATA_AXIS), t)
     else:
         in_specs = (P(), P(), P(), P(TREE_AXIS, None),
-                    P(TREE_AXIS, None, None), P(TREE_AXIS), P(TREE_AXIS))
+                    P(TREE_AXIS, None, None), P(TREE_AXIS), P(TREE_AXIS),
+                    P(TREE_AXIS))
         out_specs = (t, t, t, t, t, t, t, t)
     sharded = jax.shard_map(
         per_device,
@@ -481,14 +560,23 @@ def build_tree_fused(
     refit_targets: np.ndarray | None = None,
     timer: PhaseTimer | None = None,
     return_leaf_ids: bool = False,
+    feature_sampler=None,
 ) -> TreeArrays:
-    """Same contract as ``builder.build_tree``, one device program per build."""
+    """Same contract as ``builder.build_tree``, one device program per build.
+
+    ``feature_sampler`` (:class:`ops.sampling.NodeFeatureSampler`): per-node
+    feature subsets and/or splitter="random" draws, evaluated entirely
+    inside the compiled while_loop (the jnp path-key arithmetic) — the same
+    trees every host/levelwise engine builds from the same sampler.
+    """
     cfg = config
     task = cfg.task
     timer = timer if timer is not None else PhaseTimer(enabled=False)
     N, F = binned.x_binned.shape
     B = binned.n_bins
     C = n_classes if task == "classification" else 3
+
+    sample_k, random_split, root_key = _sampler_statics(feature_sampler, F)
 
     K = _chunk_size(N, F, B, C, cfg)
     M = _node_capacity(N, cfg.max_depth)
@@ -503,7 +591,7 @@ def build_tree_fused(
         max_depth=-1 if cfg.max_depth is None else int(cfg.max_depth),
         min_samples_split=int(cfg.min_samples_split),
         tiers=tuple(cfg.frontier_tiers),
-        use_pallas=use_pallas,
+        use_pallas=use_pallas, sample_k=sample_k, random_split=random_split,
     )
 
     with timer.phase("shard"):
@@ -513,7 +601,8 @@ def build_tree_fused(
     with timer.phase("fused_build"):
         out = fn(xb_d, y_d, nid_d, w_d, cand_d,
                  np.float32(cfg.min_child_weight),
-                 np.float32(cfg.min_decrease_scaled))
+                 np.float32(cfg.min_decrease_scaled),
+                 root_key)
         feat, bins, counts, nvec, left, parent, nid_out, n_nodes = out
         # Tree outputs are replicated (addressable from any process); the
         # row-sharded nid_out is only fetched when the refit needs it —
@@ -610,12 +699,19 @@ def build_forest_fused(
     return_leaf_ids: bool = False,
     min_child_weights: np.ndarray | None = None,
     min_decrease_scaleds: np.ndarray | None = None,
+    root_keys: np.ndarray | None = None,
+    sample_k: int | None = None,
+    random_split: bool = False,
 ) -> list:
     """Build T trees as ONE device program, trees sharded over the mesh.
 
     ``weights``: (T, N) per-tree sample weights (bootstrap multiplicities
     composed with any user weights); ``cand_masks``: (T, F, B) per-tree
-    candidate masks (random subspaces). The mesh is 2-D ``(tree, data)``
+    candidate masks (random subspaces). ``root_keys``: (T,) uint32 per-tree
+    path-key seeds with ``sample_k``/``random_split`` — sklearn's per-NODE
+    ``max_features`` subsets and ExtraTrees random splits, evaluated inside
+    the one compiled forest program (``ops/sampling.py`` jnp twins).
+    The mesh is 2-D ``(tree, data)``
     (``mesh_lib.tree_data_shape``): the tree axis carries ensemble
     parallelism (the reference's subtree task-parallelism reborn; BASELINE
     configs[4]) and the data axis — engaged when trees are fewer than
@@ -668,6 +764,7 @@ def build_forest_fused(
         tiers=tuple(cfg.frontier_tiers),
         use_pallas=use_pallas,
         data_sharded=data_sharded,
+        sample_k=sample_k, random_split=random_split,
     )
 
     ws = weights.astype(np.float32)
@@ -684,6 +781,10 @@ def build_forest_fused(
         if min_decrease_scaleds is None
         else np.asarray(min_decrease_scaleds, np.float32)
     )
+    rks = (
+        np.zeros(T, np.uint32) if root_keys is None
+        else np.asarray(root_keys, np.uint32)
+    )
     if T_pad != T:  # pad with repeats; surplus trees are dropped after build
         ws = np.concatenate([ws, np.broadcast_to(ws[-1:], (T_pad - T, N))])
         cm = np.concatenate(
@@ -691,6 +792,7 @@ def build_forest_fused(
         )
         mcw = np.concatenate([mcw, np.broadcast_to(mcw[-1:], (T_pad - T,))])
         mid = np.concatenate([mid, np.broadcast_to(mid[-1:], (T_pad - T,))])
+        rks = np.concatenate([rks, np.broadcast_to(rks[-1:], (T_pad - T,))])
 
     with timer.phase("shard"):
         from jax.sharding import NamedSharding
@@ -713,10 +815,13 @@ def build_forest_fused(
         )
         mcw_d = jax.device_put(mcw, NamedSharding(tmesh, P(TREE_AXIS)))
         mid_d = jax.device_put(mid, NamedSharding(tmesh, P(TREE_AXIS)))
+        rk_d = jax.device_put(rks, NamedSharding(tmesh, P(TREE_AXIS)))
 
     with timer.phase("forest_build"):
         feat, bins, counts, nvec, left, parent, nid_out, n_nodes = (
-            jax.device_get(fn(xb_d, y_d, nid_d, ws_d, cm_d, mcw_d, mid_d))
+            jax.device_get(
+                fn(xb_d, y_d, nid_d, ws_d, cm_d, mcw_d, mid_d, rk_d)
+            )
         )
 
     trees = []

@@ -182,12 +182,12 @@ class _BaseForest(BaseEstimator):
             )
         rand_split = self.splitter == "random"
         # sklearn semantics: a fresh feature subset at every NODE
-        # (ops/sampling.py). Node keys thread through the host-orchestrated
-        # level loops, so node-sampled trees — and splitter="random" trees,
-        # whose per-node candidate draws ride the same keys — build per
-        # tree, not in the fused tree-sharded program.
+        # (ops/sampling.py). Path-derived node keys make the draws a pure
+        # function of tree structure, so node-sampled trees — and
+        # splitter="random" trees, whose per-node candidate draws ride the
+        # same keys — build in the fused tree-sharded program too (the jnp
+        # key arithmetic runs inside its while_loop body).
         node_sampling = self.max_features_mode == "node" and k < X.shape[1]
-        node_mode = node_sampling or rand_split
 
         # ---- phase A: every per-tree RNG draw happens up front -----------
         # (bootstrap multiplicities, OOB masks, feature subspaces). The
@@ -271,10 +271,10 @@ class _BaseForest(BaseEstimator):
             return finish(i, *host_raw(i))
 
         def build_one_device(i):
-            # levelwise engine / debug mode / per-node sampling: per-tree
-            # builds keep the instrumentation, determinism checks, and
-            # node-key threading build_tree wires up. A lost accelerator
-            # costs wall-clock, not the fit (utils/elastic.py).
+            # levelwise engine / debug mode: per-tree builds keep the
+            # instrumentation and determinism checks build_tree wires up.
+            # A lost accelerator costs wall-clock, not the fit
+            # (utils/elastic.py).
             def dev():
                 res = build_tree(
                     tree_b[i], y_enc, config=tree_cfg(tree_w[i]), mesh=mesh,
@@ -304,6 +304,10 @@ class _BaseForest(BaseEstimator):
             mids = np.asarray(
                 [c.min_decrease_scaled for c in cfgs], np.float32
             )
+            rks = np.asarray(
+                [0 if tree_sampler[i] is None else tree_sampler[i].root_key()
+                 for i in idxs], np.uint32
+            )
 
             def dev():
                 return build_forest_fused(
@@ -313,6 +317,9 @@ class _BaseForest(BaseEstimator):
                     integer_counts=integer_weights(sample_weight),
                     return_leaf_ids=refine, min_child_weights=fls,
                     min_decrease_scaleds=mids,
+                    root_keys=rks,
+                    sample_k=k if node_sampling else None,
+                    random_split=rand_split,
                 )
 
             def host():
@@ -359,9 +366,7 @@ class _BaseForest(BaseEstimator):
                 start = min(len(ck.trees), self.n_estimators)
                 trees = list(ck.trees[:start])
 
-        batched = not (
-            use_host or node_mode or self._per_tree_device_builds()
-        )
+        batched = not (use_host or self._per_tree_device_builds())
         remaining = list(range(start, self.n_estimators))
         if batched:
             if ck is not None and remaining:

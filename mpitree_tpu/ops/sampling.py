@@ -20,8 +20,10 @@ Scheme:
   computes the same keys;
 - the node's feature subset is the first ``k`` entries of a permutation of
   features, obtained by a stable argsort of per-(node, feature) hash
-  scores. ``numpy`` (host tier, level loops) and ``jnp`` (a future fused
-  in-jit variant) implement the identical uint32 arithmetic.
+  scores. ``numpy`` (host tier, level loops) and ``jnp`` (the fused
+  in-jit variant: :func:`pcg_hash_jnp`, :func:`node_masks_jnp`,
+  :func:`node_draws_jnp`, consumed inside the fused engine's
+  ``lax.while_loop`` body) implement the identical uint32 arithmetic.
 
 The hash is the 32-bit PCG output permutation (``pcg_hash``) — cheap,
 well-avalanched, and exactly reproducible in wrap-around uint32 arithmetic
@@ -128,6 +130,66 @@ def pcg_hash(x: np.ndarray) -> np.ndarray:
         shift = ((x >> np.uint32(28)) + np.uint32(4)).astype(np.uint32)
         word = (((x >> shift) ^ x) * _FIN).astype(np.uint32)
         return ((word >> np.uint32(22)) ^ word).astype(np.uint32)
+
+
+def pcg_hash_jnp(x):
+    """jnp twin of :func:`pcg_hash` — identical uint32 wrap-around arithmetic.
+
+    Runs inside jitted programs (the fused engine threads node keys through
+    its ``lax.while_loop`` state); uint32 ops wrap silently under XLA, so no
+    errstate dance is needed.
+    """
+    import jax.numpy as jnp
+
+    x = x.astype(jnp.uint32) * jnp.uint32(_MULT) + jnp.uint32(_INC)
+    shift = (x >> jnp.uint32(28)) + jnp.uint32(4)
+    word = ((x >> shift) ^ x) * jnp.uint32(_FIN)
+    return (word >> jnp.uint32(22)) ^ word
+
+
+def node_masks_jnp(keys, k: int, n_features: int):
+    """jnp twin of :meth:`NodeFeatureSampler.node_masks`.
+
+    (S,) uint32 keys -> (S, F) bool of each node's k allowed features. Uses
+    the same stable ascending argsort of per-(node, feature) hash scores, so
+    ties at equal scores resolve to the lowest feature index exactly as the
+    numpy tier does; membership is rank < k via the inverse permutation
+    (argsort of a permutation is exact, no second stability requirement).
+    """
+    import jax.numpy as jnp
+
+    if k >= n_features:
+        return jnp.ones((keys.shape[0], n_features), bool)
+    f = jnp.arange(n_features, dtype=jnp.uint32)
+    scores = pcg_hash_jnp(
+        keys.astype(jnp.uint32)[:, None] ^ ((f[None, :] + jnp.uint32(1))
+                                            * jnp.uint32(_FEAT_SALT))
+    )
+    order = jnp.argsort(scores, axis=1, stable=True)
+    rank = jnp.argsort(order, axis=1)
+    return rank < k
+
+
+def node_draws_jnp(keys, n_features: int):
+    """jnp twin of :meth:`NodeFeatureSampler.node_draws` ((S, F) uint32)."""
+    import jax.numpy as jnp
+
+    f = jnp.arange(n_features, dtype=jnp.uint32)
+    return pcg_hash_jnp(
+        keys.astype(jnp.uint32)[:, None] ^ ((f[None, :] + jnp.uint32(1))
+                                            * jnp.uint32(_DRAW_SALT))
+    )
+
+
+def child_keys_jnp(keys):
+    """jnp twin of :meth:`NodeFeatureSampler.child_keys`."""
+    import jax.numpy as jnp
+
+    p = keys.astype(jnp.uint32)
+    return (
+        pcg_hash_jnp(p ^ jnp.uint32(_LEFT_SALT)),
+        pcg_hash_jnp(p ^ jnp.uint32(_RIGHT_SALT)),
+    )
 
 
 @dataclasses.dataclass(frozen=True)

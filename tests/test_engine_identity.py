@@ -130,18 +130,24 @@ def test_regression_split_identity_across_engines(seed, monkeypatch):
         )
 
 
+@pytest.mark.parametrize("random_split", [False, True],
+                         ids=["best", "random"])
 @pytest.mark.parametrize("seed", range(6))
-def test_node_sampling_identity_across_engines(seed):
-    """Per-node feature sampling: path-derived keys (ops/sampling.py) must
-    give bit-identical trees on the host C++ sweep, the numpy fallback, and
-    the device levelwise engine at every mesh size."""
+def test_node_sampling_identity_across_engines(seed, random_split):
+    """Per-node feature sampling (and splitter="random" draws): path-derived
+    keys (ops/sampling.py) must give bit-identical trees on the host C++
+    sweep, the numpy fallback, and BOTH device engines at every mesh size —
+    the fused engine runs the jnp twin of the key arithmetic inside its
+    while_loop body."""
     from mpitree_tpu.ops.sampling import NodeFeatureSampler
 
     rng, X = _integer_grid(seed + 300)
     y = _class_labels(rng)
     binned = bin_dataset(X, binning="exact")
     cfg = BuildConfig(task="classification", criterion="entropy", max_depth=5)
-    sam = NodeFeatureSampler(k=2, n_features=F, seed=seed)
+    sam = NodeFeatureSampler(
+        k=2, n_features=F, seed=seed, random_split=random_split
+    )
 
     trees = {
         "host": build_tree_host(
@@ -153,12 +159,37 @@ def test_node_sampling_identity_across_engines(seed):
         trees["host-numpy"] = build_tree_host(
             binned, y, config=cfg, n_classes=N_CLASSES, feature_sampler=sam
         )
-    for n_dev in MESH_SIZES:
-        trees[f"mesh{n_dev}"] = build_tree(
-            binned, y, config=cfg, n_classes=N_CLASSES,
-            mesh=mesh_lib.resolve_mesh(n_devices=n_dev), feature_sampler=sam,
-        )
+    trees.update(
+        _device_trees(binned, y, cfg, n_classes=N_CLASSES, feature_sampler=sam)
+    )
 
+    ref = trees["host"]
+    for name, t in trees.items():
+        assert _structure(t) == _structure(ref), f"{name} (seed={seed})"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_regression_random_split_identity_across_engines(seed):
+    """splitter="random" on the MSE criterion, both engines, every mesh."""
+    from mpitree_tpu.ops.sampling import NodeFeatureSampler
+
+    rng, X = _integer_grid(seed + 400)
+    yr = rng.integers(0, 7, size=N).astype(np.float64)
+    y_c = (yr - yr.mean()).astype(np.float32)
+    binned = bin_dataset(X, binning="exact")
+    cfg = BuildConfig(task="regression", criterion="mse", max_depth=5)
+    sam = NodeFeatureSampler(
+        k=F, n_features=F, seed=seed, random_split=True
+    )
+
+    trees = {
+        "host": build_tree_host(
+            binned, y_c, config=cfg, refit_targets=yr, feature_sampler=sam
+        )
+    }
+    trees.update(
+        _device_trees(binned, y_c, cfg, refit_targets=yr, feature_sampler=sam)
+    )
     ref = trees["host"]
     for name, t in trees.items():
         assert _structure(t) == _structure(ref), f"{name} (seed={seed})"

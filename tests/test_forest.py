@@ -152,6 +152,25 @@ def test_batched_forest_identical_to_per_tree_builds():
         )
 
 
+def test_batched_node_sampling_forest_matches_per_tree_builds(monkeypatch):
+    """sklearn's default forest shape — per-NODE max_features — now rides
+    the ONE-program tree-sharded build; it must grow bit-identical trees to
+    the per-tree levelwise path (which threads node keys host-side)."""
+    X, y = _noisy_classification(300, seed=9)
+    kw = dict(
+        n_estimators=5, max_depth=5, max_features="sqrt",
+        max_features_mode="node", splitter="random", random_state=3,
+    )
+    batched = RandomForestClassifier(**kw).fit(X, y)
+    monkeypatch.setenv("MPITREE_TPU_ENGINE", "levelwise")
+    per_tree = RandomForestClassifier(**kw).fit(X, y)
+    assert len(batched.trees_) == len(per_tree.trees_)
+    for tb, tp in zip(batched.trees_, per_tree.trees_):
+        np.testing.assert_array_equal(tb.feature, tp.feature)
+        np.testing.assert_array_equal(tb.left, tp.left)
+        np.testing.assert_array_equal(tb.count, tp.count)
+
+
 def test_batched_forest_regression_with_refit():
     from mpitree_tpu.core.builder import BuildConfig
     from mpitree_tpu.core.fused_builder import build_forest_fused
