@@ -248,6 +248,179 @@ def run_device_engine_fit(Xtr, ytr, platform) -> tuple[dict | None, str | None]:
             pass
 
 
+# BASELINE configs[4]-shaped forest measurement ("bagged random forest,
+# trees sharded across chips"). The device comparison pits the SAME fused
+# build body run two ways — T trees as ONE tree-sharded program
+# (build_forest_fused) vs T sequential single-tree programs — so the
+# speedup isolates exactly the one-program orchestration claim. Workload
+# scales by platform: XLA-on-CPU histogram scatters are ~50x slower than
+# the C++ host tier, so the CPU fallback shrinks the workload rather than
+# blowing the bench budget (recorded in the artifact as scaled_down).
+FOREST_SHAPES = {
+    "tpu": {"trees": 50, "rows": 200_000, "depth": 12},
+    "cpu": {"trees": 16, "rows": 20_000, "depth": 8},
+}
+# The host tier runs the full configs[4] shape regardless of platform so
+# the host-vs-device comparison stays like-for-like with the TPU shape.
+FOREST_HOST_SHAPE = FOREST_SHAPES["tpu"]
+FOREST_TIMEOUT_S = 1800
+
+
+def _forest_shape(platform: str) -> dict:
+    shape = dict(FOREST_SHAPES.get(platform, FOREST_SHAPES["cpu"]))
+    for key in shape:
+        env = os.environ.get(f"BENCH_FOREST_{key.upper()}")
+        if env:
+            shape[key] = int(env)
+    return shape
+
+
+def run_forest_worker(npz_path: str, platform: str) -> None:
+    """Subprocess body: the one-program-vs-T-sequential device comparison."""
+    from bench_tpu import _pin_platform
+
+    _pin_platform(platform)
+    if platform == "cpu":
+        # 8 virtual devices: the comparison then runs the real tree-sharded
+        # program (trees distributed over the mesh), not a 1-device lax.map.
+        # No wall-clock parallelism on one core — the honest CPU story is
+        # the orchestration delta, recorded as such via scaled_down.
+        import jax
+
+        jax.config.update("jax_num_cpu_devices", 8)
+    from mpitree_tpu.core.builder import BuildConfig
+    from mpitree_tpu.core.fused_builder import (
+        build_forest_fused,
+        build_tree_fused,
+    )
+    from mpitree_tpu.ops.binning import bin_dataset
+    from mpitree_tpu.parallel import mesh as mesh_lib
+    from mpitree_tpu.utils.profiling import PhaseTimer
+
+    data = np.load(npz_path)
+    Xtr, ytr = data["Xtr"], data["ytr"].astype(np.int32)
+    shape = _forest_shape(platform)
+    T, n, depth = shape["trees"], min(shape["rows"], len(Xtr)), shape["depth"]
+    Xtr, ytr = Xtr[:n], ytr[:n]
+    n_classes = int(ytr.max()) + 1
+
+    binned = bin_dataset(Xtr, max_bins=256)
+    rng = np.random.default_rng(0)
+    weights = rng.multinomial(n, np.full(n, 1.0 / n), size=T).astype(
+        np.float32
+    )
+    masks = np.broadcast_to(
+        binned.candidate_mask(), (T,) + binned.candidate_mask().shape
+    ).copy()
+    cfg = BuildConfig(task="classification", criterion="entropy",
+                      max_depth=depth)
+    mesh_all = mesh_lib.resolve_mesh(backend=platform, n_devices="all")
+    mesh_one = mesh_lib.resolve_mesh(backend=platform, n_devices=1)
+
+    def one_program():
+        timer = PhaseTimer(enabled=True)
+        t0 = time.perf_counter()
+        trees = build_forest_fused(
+            binned, ytr, config=cfg, mesh=mesh_all, weights=weights,
+            cand_masks=masks, n_classes=n_classes, timer=timer,
+        )
+        return time.perf_counter() - t0, trees, timer.summary()
+
+    def one_tree(t):
+        return build_tree_fused(
+            binned, ytr, config=cfg, mesh=mesh_one,
+            n_classes=n_classes, sample_weight=weights[t],
+        )
+
+    def sequential():
+        t0 = time.perf_counter()
+        trees = [one_tree(t) for t in range(T)]
+        return time.perf_counter() - t0, trees
+
+    cold_one_s, _, _ = one_program()
+    one_s, trees_one, phases = one_program()
+    # One build warms the single-tree executable; timing all T twice would
+    # double the dominant cost of the bench for no extra information.
+    t0 = time.perf_counter()
+    one_tree(0)
+    cold_seq_s = time.perf_counter() - t0
+    seq_s, trees_seq = sequential()
+    identical = all(
+        np.array_equal(a.feature, b.feature)
+        and np.array_equal(a.count, b.count)
+        for a, b in zip(trees_one, trees_seq)
+    )
+    out = {
+        "trees": T,
+        "rows": n,
+        "depth": depth,
+        "backend": platform,
+        "n_devices": int(mesh_all.size),
+        "scaled_down": platform != "tpu",
+        "one_program": {
+            "cold_s": round(cold_one_s, 3),
+            "warm_s": round(one_s, 3),
+            "phases": phases,
+        },
+        "t_sequential": {
+            "cold_s": round(cold_seq_s, 3),
+            "warm_s": round(seq_s, 3),
+        },
+        "one_program_speedup": round(seq_s / one_s, 2),
+        "trees_identical": bool(identical),
+    }
+    print("BENCH_WORKER_JSON:" + json.dumps(out))
+
+
+def run_forest_bench(Xtr, ytr, platform) -> tuple[dict | None, str | None]:
+    """Bounded-subprocess forest comparison; (summary, error-on-failure)."""
+    import tempfile
+
+    from bench_tpu import run_tagged_subprocess
+
+    with tempfile.NamedTemporaryFile(suffix=".npz", delete=False) as f:
+        npz_path = f.name
+    try:
+        shape = _forest_shape(platform)
+        n = min(len(Xtr), shape["rows"])
+        np.savez(npz_path, Xtr=Xtr[:n], ytr=ytr[:n])
+        return run_tagged_subprocess(
+            [sys.executable, os.path.abspath(__file__), "--forest-worker",
+             npz_path, platform],
+            FOREST_TIMEOUT_S, tag="BENCH_WORKER_JSON:",
+        )
+    finally:
+        try:
+            os.unlink(npz_path)
+        except OSError:
+            pass
+
+
+def run_forest_host(Xtr, ytr) -> dict:
+    """The C++ host tier fitting a configs[4]-scale forest (in process)."""
+    from mpitree_tpu import RandomForestClassifier
+
+    shape = FOREST_HOST_SHAPE
+    n = min(shape["rows"], len(Xtr))
+    t0 = time.perf_counter()
+    f = RandomForestClassifier(
+        n_estimators=shape["trees"], max_depth=shape["depth"],
+        max_bins=256, backend="host", refine_depth=None, random_state=0,
+    ).fit(Xtr[:n], ytr[:n])
+    fit_s = time.perf_counter() - t0
+    return {
+        "trees": shape["trees"],
+        "rows": n,
+        "depth": shape["depth"],
+        "backend": "host (C++ tier, per-tree builds)",
+        "fit_s": round(fit_s, 3),
+        "s_per_tree": round(fit_s / shape["trees"], 3),
+        "mean_n_nodes": round(
+            float(np.mean([t.n_nodes for t in f.trees_])), 1
+        ),
+    }
+
+
 def time_reference_semantics(X, y, n, depth=DEPTH):
     """One fit of the reference algorithm (oracle semantics) on n rows."""
     sys.path.insert(0, os.path.join(_HERE, "tests"))
@@ -256,6 +429,43 @@ def time_reference_semantics(X, y, n, depth=DEPTH):
     t0 = time.perf_counter()
     oracle.grow(X[:n], y[:n], int(y.max()) + 1, max_depth=depth)
     return time.perf_counter() - t0
+
+
+def load_mpi8_measured(n_full: int) -> dict | None:
+    """The measured 8-rank baseline (tools/measure_mpi8.py artifact), if any.
+
+    ``MPI8_BASELINE.json`` holds wall-clock of the reference's UNMODIFIED
+    ``ParallelDecisionTreeClassifier`` at 8 ranks over the mpi4py shim
+    (``tools/mpi_shim.py``) on this machine — a real run of the parallel
+    path (``decision_tree.py:310-479``), not a ratio from time_data.csv.
+    Rescales its full-size extrapolation to ``n_full`` with the measured
+    exponent when the row counts differ.
+    """
+    path = os.path.join(_HERE, "MPI8_BASELINE.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        art = json.load(f)
+    m = art.get("mpi8")
+    if not m or len(m.get("grid", [])) < 2:
+        return None
+    # The artifact records the row count its extrapolation refers to; the
+    # 531012 fallback covers artifacts captured before the field existed.
+    measured_n_full = art.get("n_full", 531012)
+    scale = (n_full / measured_n_full) ** m["exponent"]
+    return {
+        "mpi8_observed_s": round(m["extrapolated_full_s"] * scale, 1),
+        "mpi8_observed_source": {
+            "artifact": "MPI8_BASELINE.json",
+            "grid": m["grid"],
+            "times_s": m["times_s"],
+            "exponent": m["exponent"],
+            "rms_log_residual": m["rms_log_residual"],
+            "cpu_cores": art.get("cpu_cores"),
+            "par_over_seq_at_shared_n": art.get("par_over_seq_at_shared_n"),
+            "note": art.get("note"),
+        },
+    }
 
 
 def measure_baseline(Xtr, ytr, n_full: int) -> dict:
@@ -282,7 +492,7 @@ def measure_baseline(Xtr, ytr, n_full: int) -> dict:
     b, log_a = np.polyfit(np.log(ns), np.log(ts), 1)
     seq_est_s = float(np.exp(log_a) * n_full**b)
     resid = np.log(ts) - (log_a + b * np.log(ns))
-    return {
+    out = {
         "ref_subsample_grid": ns,
         "ref_subsample_s": [round(t, 3) for t in ts],
         "ref_measured_max_n": ns[-1],
@@ -292,14 +502,33 @@ def measure_baseline(Xtr, ytr, n_full: int) -> dict:
         "ref_power_law_exponent": round(float(b), 3),
         "ref_seq_extrapolated_s": round(seq_est_s, 1),
         "mpi8_ideal_s": round(seq_est_s / 8.0, 1),
-        "mpi8_observed_s": round(seq_est_s / 1.6, 1),
-        "baseline_note": (
+    }
+    measured = load_mpi8_measured(n_full)
+    if measured is not None:
+        out.update(measured)
+        out["baseline_note"] = (
+            "ideal = oracle sequential power-law extrapolation / 8 "
+            "(generous: the oracle is a numpy reimplementation, faster than "
+            "the reference's object-dtype code, and /8 assumes perfect "
+            "scaling the reference's own time_data.csv contradicts); "
+            "observed = power-law extrapolation of MEASURED 8-rank runs of "
+            "the unmodified reference over tools/mpi_shim.py on this "
+            "machine (MPI8_BASELINE.json; 8 ranks timesharing "
+            f"{measured['mpi8_observed_source'].get('cpu_cores')} core(s) — "
+            "an upper bound on real 8-way hardware). vs_baseline uses ideal."
+        )
+    else:
+        # No measured artifact (tools/measure_mpi8.py not yet run here):
+        # fall back to the labeled time_data.csv ratio.
+        out["mpi8_observed_s"] = round(seq_est_s / 1.6, 1)
+        out["baseline_note"] = (
             "reference never published covtype numbers; sequential cost is a "
             "power-law fit over the measured grid above, extrapolated to the "
             "full row count; ideal = /8 (generous to the reference), "
-            "observed = /1.6 (time_data.csv k=8-over-k=2 speedup)"
-        ),
-    }
+            "observed = /1.6 (time_data.csv k=8-over-k=2 speedup; "
+            "MPI8_BASELINE.json absent)"
+        )
+    return out
 
 
 def main():
@@ -404,6 +633,22 @@ def main():
         except Exception as e:  # noqa: BLE001
             errors["device_engine"] = f"{type(e).__name__}: {e}"
 
+        # --- forest section (BASELINE configs[4]) ---------------------------
+        # One-program tree-sharded build vs T sequential builds of the same
+        # fused body (bounded subprocess), plus the C++ host tier fitting a
+        # 50-tree forest in-process (round-3 verdict, Weak #5).
+        try:
+            forest: dict = {}
+            detail["forest"] = forest  # keep partial results on late errors
+            f_dev, f_err = run_forest_bench(Xtr, ytr, platform)
+            if f_dev is not None:
+                forest["device"] = f_dev
+            else:
+                errors["forest_device"] = f_err
+            forest["host"] = run_forest_host(Xtr, ytr)
+        except Exception as e:  # noqa: BLE001
+            errors["forest"] = f"{type(e).__name__}: {e}"
+
         # --- last committed TPU measurement (BENCH_TPU.jsonl) ---------------
         # When the live platform is not a TPU the round's artifact would
         # otherwise carry no TPU number at all; embed the newest committed
@@ -460,5 +705,7 @@ if __name__ == "__main__":
     elif len(sys.argv) >= 4 and sys.argv[1] == "--device-worker":
         os.environ["MPITREE_TPU_PROFILE"] = "1"
         run_device_engine_worker(sys.argv[2], sys.argv[3])
+    elif len(sys.argv) >= 4 and sys.argv[1] == "--forest-worker":
+        run_forest_worker(sys.argv[2], sys.argv[3])
     else:
         main()

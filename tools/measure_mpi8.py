@@ -1,0 +1,263 @@
+"""Measure the reference's ParallelDecisionTreeClassifier at 8 ranks, for real.
+
+SURVEY.md §6 requires the 8-rank MPI baseline to be *measured*, not inferred
+from ``time_data.csv`` ratios. This launcher runs the reference's own
+unmodified parallel code (``/root/reference``, imported read-only) at 8
+ranks over the mpi4py shim in ``tools/mpi_shim.py``, on growing subsamples
+of the bench dataset, under a wall-clock budget — plus the reference's
+sequential class on the same grid for the measured parallel/sequential
+shape. Results land in ``MPI8_BASELINE.json`` at the repo root, which
+``bench.py`` embeds as the ``mpi8_observed_s`` source (replacing the old
+/1.6 heuristic).
+
+Honesty notes recorded in the artifact:
+
+- This box has ONE CPU core: 8 ranks timeshare it, so the measured 8-rank
+  wall-clock is an upper bound on what the reference would cost on real
+  8-way hardware. ``bench.py``'s headline ``vs_baseline`` therefore keeps
+  using the *ideal* variant (oracle sequential cost / 8), which is strictly
+  generous to the reference; the measured curve is reported alongside.
+- The reference validates with ``dtype=object`` (``decision_tree.py:184``),
+  so its real cost is far above the numpy oracle's — that is the actual
+  code a user of the reference runs.
+
+Usage: ``python tools/measure_mpi8.py [--budget-s 900] [--seq-budget-s 600]``
+(or ``--worker`` internally).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+_REFERENCE = "/root/reference"
+
+N_FULL = 531012  # bench.py's training-split row count (581012 - 50k test)
+DEPTH = 20
+GRID = (100, 300, 1000, 3000, 10_000, 30_000)
+RANKS = 8
+
+
+def _power_law(ns, ts):
+    b, log_a = np.polyfit(np.log(ns), np.log(ts), 1)
+    resid = np.log(ts) - (log_a + b * np.log(ns))
+    return {
+        "exponent": round(float(b), 3),
+        "rms_log_residual": round(float(np.sqrt((resid**2).mean())), 4),
+        "extrapolated_full_s": round(float(np.exp(log_a) * N_FULL**b), 1),
+        "measured_decades": round(float(np.log10(ns[-1] / ns[0])), 2),
+        "extrapolated_decades": round(float(np.log10(N_FULL / ns[-1])), 2),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Worker (one rank; also the sequential single-process mode)
+# ---------------------------------------------------------------------------
+
+
+def run_worker() -> None:
+    sys.path.insert(0, _REPO)
+    from tools import mpi_shim
+
+    pkg = mpi_shim.fake_mpi4py()
+    sys.modules["mpi4py"] = pkg
+    sys.modules["mpi4py.MPI"] = pkg.MPI
+    sys.path.insert(0, _REFERENCE)
+    from mpitree.tree import (  # noqa: E501 — reference import, post-shim
+        DecisionTreeClassifier,
+        ParallelDecisionTreeClassifier,
+    )
+
+    world = pkg.MPI.COMM_WORLD
+    data = np.load(os.environ["MPI_SHIM_DATA"])
+    X, y = data["X"], data["y"]
+    budget = float(os.environ["MPI_SHIM_BUDGET_S"])
+    seq_mode = os.environ.get("MPI_SHIM_SEQ") == "1"
+    cls = DecisionTreeClassifier if seq_mode else ParallelDecisionTreeClassifier
+
+    ns: list[int] = []
+    ts: list[float] = []
+    spent = 0.0
+    for n in GRID:
+        if n > len(X):
+            break
+        if len(ns) >= 2:
+            b = (np.log(ts[-1]) - np.log(ts[0])) / (np.log(ns[-1]) - np.log(ns[0]))
+            pred = ts[-1] * (n / ns[-1]) ** max(b, 1.0)
+            if spent + pred > budget:
+                break
+        world.barrier()
+        t0 = time.perf_counter()
+        cls(max_depth=DEPTH).fit(X[:n], y[:n])
+        dt = time.perf_counter() - t0
+        # max over ranks = the collective completion time; identical on
+        # every rank, so the adaptive grid decisions stay in lockstep
+        t = max(world.allgather(dt))
+        ns.append(n)
+        ts.append(t)
+        spent += t
+        if spent > budget and len(ns) >= 2:
+            break
+    if world.Get_rank() == 0:
+        print("MPI8_WORKER_JSON:" + json.dumps(
+            {"grid": ns, "times_s": [round(t, 3) for t in ts]}
+        ), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Launcher
+# ---------------------------------------------------------------------------
+
+
+def _parse_worker_json(text: str):
+    for line in reversed(text.splitlines()):
+        if line.startswith("MPI8_WORKER_JSON:"):
+            return json.loads(line[len("MPI8_WORKER_JSON:"):])
+    return None
+
+
+def run_sequential(npz: str, budget_s: float, timeout_s: float):
+    env = dict(os.environ, MPI_SHIM_DATA=npz, MPI_SHIM_SEQ="1",
+               MPI_SHIM_BUDGET_S=str(budget_s))
+    env.pop("MPI_SHIM_SOCKET", None)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--worker"],
+        env=env, capture_output=True, text=True, timeout=timeout_s,
+    )
+    return _parse_worker_json(out.stdout), out.stderr[-2000:]
+
+
+def run_parallel(npz: str, budget_s: float, timeout_s: float):
+    from tools import mpi_shim
+
+    sock_path = os.path.join(
+        tempfile.mkdtemp(prefix="mpi8_"), "router.sock"
+    )
+    router = mpi_shim.Router(sock_path, RANKS)
+    accept_t = threading.Thread(target=router.accept_all, daemon=True)
+    accept_t.start()
+    procs = []
+    try:
+        for r in range(RANKS):
+            env = dict(
+                os.environ, MPI_SHIM_DATA=npz, MPI_SHIM_SOCKET=sock_path,
+                MPI_SHIM_RANK=str(r), MPI_SHIM_SIZE=str(RANKS),
+                MPI_SHIM_BUDGET_S=str(budget_s),
+            )
+            env.pop("MPI_SHIM_SEQ", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--worker"],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            ))
+        deadline = time.time() + timeout_s
+        outs = []
+        for p in procs:
+            left = max(5.0, deadline - time.time())
+            try:
+                outs.append(p.communicate(timeout=left))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs.append(p.communicate())
+        res = _parse_worker_json(outs[0][0] or "")
+        err = (outs[0][1] or "")[-2000:]
+        return res, err
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        router.close()
+
+
+def main() -> None:
+    budget_s = 900.0
+    seq_budget_s = 600.0
+    args = sys.argv[1:]
+    if "--budget-s" in args:
+        budget_s = float(args[args.index("--budget-s") + 1])
+    if "--seq-budget-s" in args:
+        seq_budget_s = float(args[args.index("--seq-budget-s") + 1])
+
+    sys.path.insert(0, _REPO)
+    from mpitree_tpu.utils.datasets import load_covtype
+
+    X, y, name = load_covtype(40_000)
+    with tempfile.NamedTemporaryFile(suffix=".npz", delete=False) as f:
+        npz = f.name
+    np.savez(npz, X=X, y=y)
+
+    result = {
+        "dataset": name,
+        "max_depth": DEPTH,
+        "n_full": N_FULL,  # the row count extrapolated_full_s refers to
+        "ranks": RANKS,
+        "cpu_cores": os.cpu_count(),
+        "transport": "tools/mpi_shim.py unix-socket router "
+                     "(mpi4py API; no mpirun/mpi4py in this environment)",
+        "code_under_test": "/root/reference mpitree.tree."
+                           "ParallelDecisionTreeClassifier, unmodified",
+        "note": (
+            f"{RANKS} ranks timeshare {os.cpu_count()} CPU core(s): the "
+            "parallel wall-clock is an UPPER bound on real 8-way hardware; "
+            "bench.py's headline vs_baseline keeps the ideal (sequential/8) "
+            "variant and reports this measured curve as mpi8_observed"
+        ),
+        "captured_unix": int(time.time()),
+    }
+    try:
+        seq, seq_err = run_sequential(npz, seq_budget_s, seq_budget_s * 3)
+        if seq and len(seq["grid"]) >= 2:
+            result["sequential"] = {
+                **seq, **_power_law(seq["grid"], seq["times_s"]),
+            }
+        elif seq_err:
+            result["sequential_error"] = seq_err
+    except Exception as e:  # noqa: BLE001
+        result["sequential_error"] = f"{type(e).__name__}: {e}"
+    try:
+        par, par_err = run_parallel(npz, budget_s, budget_s * 3)
+        if par and len(par["grid"]) >= 2:
+            result["mpi8"] = {
+                **par, **_power_law(par["grid"], par["times_s"]),
+            }
+        elif par_err:
+            result["mpi8_error"] = par_err
+    except Exception as e:  # noqa: BLE001
+        result["mpi8_error"] = f"{type(e).__name__}: {e}"
+    finally:
+        os.unlink(npz)
+
+    if "sequential" in result and "mpi8" in result:
+        shared = [
+            (n, s, p)
+            for (n, s) in zip(result["sequential"]["grid"],
+                              result["sequential"]["times_s"])
+            for (m, p) in zip(result["mpi8"]["grid"],
+                              result["mpi8"]["times_s"])
+            if n == m
+        ]
+        if shared:
+            result["par_over_seq_at_shared_n"] = {
+                str(n): round(p / s, 2) for n, s, p in shared
+            }
+
+    out_path = os.path.join(_REPO, "MPI8_BASELINE.json")
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result, indent=1))
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv:
+        run_worker()
+    else:
+        main()

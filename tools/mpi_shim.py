@@ -1,0 +1,242 @@
+"""Minimal mpi4py-compatible shim: run the reference's parallel path for real.
+
+This environment has OpenMPI's shared libraries but no launcher (``mpirun``)
+and no mpi4py, so the reference's ``ParallelDecisionTreeClassifier``
+(reference: ``mpitree/tree/decision_tree.py:310-479``) could never be
+*measured* at 8 ranks — its baseline was a heuristic. This shim implements
+the exact mpi4py surface that class touches — ``MPI.COMM_WORLD``,
+``Get_rank``, ``Get_size``, ``Split(color, key)``, pickle-based
+``allgather``, ``Free`` (``decision_tree.py:315-317,338,456,477``) — over
+local unix-domain sockets to a router in the launcher process
+(``tools/measure_mpi8.py``), with MPI's collective semantics:
+
+- ``Split`` is collective on the communicator: the router matches the k-th
+  collective call per member, partitions by color, orders each group by
+  (key, parent rank), and assigns a fresh communicator id.
+- ``allgather`` is collective and pickle-framed exactly like mpi4py's
+  lowercase path: the payload bytes are opaque to the router, so whole
+  pickled ``Node`` subtrees travel just as they do over real MPI.
+
+The transport is local sockets rather than OpenMPI's shared-memory BTL —
+the same single-node transport class the reference's own published numbers
+used (``time_data.csv`` rows were captured over OpenMPI ``sm`` on one
+laptop, per the notebook's stream output).
+
+Workers install the shim before importing the reference:
+``sys.modules["mpi4py"] = mpi_shim.fake_mpi4py()``. With no
+``MPI_SHIM_SOCKET`` in the env, ``COMM_WORLD`` degrades to a size-1
+self-communicator so the reference module (whose class body initializes
+MPI at import) stays importable for sequential timing.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import types
+
+
+def _sendmsg(sock: socket.socket, obj) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recvn(sock: socket.socket, n: int) -> bytes:
+    parts = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            raise EOFError("router connection closed")
+        parts.append(chunk)
+        got += len(chunk)
+    return b"".join(parts)
+
+
+def _recvmsg(sock: socket.socket):
+    (n,) = struct.unpack("<Q", _recvn(sock, 8))
+    return pickle.loads(_recvn(sock, n))
+
+
+class _Client:
+    """One socket to the launcher's router; one in-flight call at a time."""
+
+    def __init__(self) -> None:
+        path = os.environ["MPI_SHIM_SOCKET"]
+        self.rank = int(os.environ["MPI_SHIM_RANK"])
+        self.size = int(os.environ["MPI_SHIM_SIZE"])
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.connect(path)
+        self._lock = threading.Lock()
+        _sendmsg(self._sock, {"op": "hello", "rank": self.rank})
+
+    def call(self, msg: dict) -> dict:
+        with self._lock:
+            _sendmsg(self._sock, msg)
+            return _recvmsg(self._sock)
+
+
+class Intracomm:
+    """The slice of mpi4py's Intracomm the reference exercises."""
+
+    def __init__(self, client, cid: int, rank: int, size: int) -> None:
+        self._client = client
+        self._cid = cid
+        self._rank = rank
+        self._size = size
+
+    def Get_rank(self) -> int:  # noqa: N802 — mpi4py surface
+        return self._rank
+
+    def Get_size(self) -> int:  # noqa: N802
+        return self._size
+
+    def Split(self, color: int, key: int = 0) -> "Intracomm":  # noqa: N802
+        if self._client is None:  # size-1 degenerate comm
+            return Intracomm(None, self._cid + 1, 0, 1)
+        r = self._client.call({
+            "op": "split", "cid": self._cid, "rank": self._rank,
+            "color": int(color), "key": int(key),
+        })
+        return Intracomm(self._client, r["cid"], r["rank"], r["size"])
+
+    def allgather(self, obj) -> list:
+        if self._client is None:
+            return [obj]
+        r = self._client.call({
+            "op": "allgather", "cid": self._cid, "rank": self._rank,
+            "payload": pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL),
+        })
+        return [pickle.loads(b) for b in r["payloads"]]
+
+    def barrier(self) -> None:
+        self.allgather(None)
+
+    def Free(self) -> None:  # noqa: N802
+        if self._client is not None:
+            self._client.call({
+                "op": "free", "cid": self._cid, "rank": self._rank,
+            })
+
+
+def _make_world() -> Intracomm:
+    if "MPI_SHIM_SOCKET" in os.environ:
+        c = _Client()
+        return Intracomm(c, 0, c.rank, c.size)
+    return Intracomm(None, 0, 0, 1)
+
+
+def fake_mpi4py() -> types.ModuleType:
+    """A module object satisfying ``from mpi4py import MPI``."""
+    mpi = types.ModuleType("mpi4py.MPI")
+    mpi.COMM_WORLD = _make_world()
+    mpi.Intracomm = Intracomm
+    pkg = types.ModuleType("mpi4py")
+    pkg.MPI = mpi
+    return pkg
+
+
+# ---------------------------------------------------------------------------
+# Router (runs in the launcher process)
+# ---------------------------------------------------------------------------
+
+
+class Router:
+    """Collective matcher: thread per worker connection, state per comm id.
+
+    Communicator state: ``members`` maps comm rank -> connection; matching
+    uses per-(cid, member) arrival counters — every member issues the same
+    collectives in the same order on a given communicator (the SPMD
+    contract the reference itself relies on), so the k-th call per member
+    belongs to the k-th collective on that communicator.
+    """
+
+    def __init__(self, path: str, size: int) -> None:
+        self.path = path
+        self.size = size
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(path)
+        self._listener.listen(size)
+        self._lock = threading.Lock()
+        self._conns: dict[int, socket.socket] = {}
+        self._comms: dict[int, list[int]] = {}  # cid -> world rank per comm rank
+        self._arrivals: dict[tuple[int, int], int] = {}
+        self._pending: dict[tuple[int, int], dict[int, dict]] = {}
+        self._next_cid = 1
+        self._threads: list[threading.Thread] = []
+
+    def accept_all(self) -> None:
+        for _ in range(self.size):
+            conn, _ = self._listener.accept()
+            hello = _recvmsg(conn)
+            assert hello["op"] == "hello"
+            self._conns[hello["rank"]] = conn
+        self._comms[0] = list(range(self.size))
+        for rank, conn in self._conns.items():
+            t = threading.Thread(
+                target=self._serve, args=(rank, conn), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _serve(self, world_rank: int, conn: socket.socket) -> None:
+        try:
+            while True:
+                msg = _recvmsg(conn)
+                if msg["op"] == "free":
+                    _sendmsg(conn, {"ok": True})
+                    continue
+                with self._lock:
+                    self._collect(world_rank, msg)
+        except EOFError:
+            pass
+
+    def _collect(self, world_rank: int, msg: dict) -> None:
+        cid = msg["cid"]
+        comm_rank = msg["rank"]
+        idx = self._arrivals.get((cid, comm_rank), 0)
+        self._arrivals[(cid, comm_rank)] = idx + 1
+        slot = self._pending.setdefault((cid, idx), {})
+        slot[comm_rank] = msg
+        if len(slot) < len(self._comms[cid]):
+            return
+        del self._pending[(cid, idx)]
+        ops = {m["op"] for m in slot.values()}
+        assert len(ops) == 1, f"mismatched collectives on comm {cid}: {ops}"
+        members = self._comms[cid]
+        if ops == {"allgather"}:
+            payloads = [slot[r]["payload"] for r in range(len(members))]
+            for r, wr in enumerate(members):
+                _sendmsg(self._conns[wr], {"payloads": payloads})
+        else:  # split
+            by_color: dict[int, list[tuple[int, int]]] = {}
+            for r in range(len(members)):
+                m = slot[r]
+                by_color.setdefault(m["color"], []).append((m["key"], r))
+            replies: dict[int, dict] = {}
+            for color in sorted(by_color):
+                group = sorted(by_color[color])  # (key, parent rank) order
+                cid_new = self._next_cid
+                self._next_cid += 1
+                self._comms[cid_new] = [members[r] for _, r in group]
+                for new_rank, (_, r) in enumerate(group):
+                    replies[r] = {
+                        "cid": cid_new, "rank": new_rank, "size": len(group),
+                    }
+            for r, wr in enumerate(members):
+                _sendmsg(self._conns[wr], replies[r])
+
+    def close(self) -> None:
+        for conn in self._conns.values():
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._listener.close()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
