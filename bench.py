@@ -267,7 +267,9 @@ FOREST_TIMEOUT_S = 1800
 
 
 def _forest_shape(platform: str) -> dict:
-    shape = dict(FOREST_SHAPES.get(platform, FOREST_SHAPES["cpu"]))
+    # Anything that is not the CPU fallback is accelerator-class — the
+    # tunneled TPU registers as platform "axon", not "tpu".
+    shape = dict(FOREST_SHAPES["cpu" if platform == "cpu" else "tpu"])
     for key in shape:
         env = os.environ.get(f"BENCH_FOREST_{key.upper()}")
         if env:
@@ -280,6 +282,13 @@ def run_forest_worker(npz_path: str, platform: str) -> None:
     from bench_tpu import _pin_platform
 
     _pin_platform(platform)
+    data = np.load(npz_path)
+    out = forest_compare(data["Xtr"], data["ytr"], platform)
+    print("BENCH_WORKER_JSON:" + json.dumps(out))
+
+
+def forest_compare(Xtr, ytr, platform: str) -> dict:
+    """BASELINE configs[4] measurement core (shared with bench_tpu.py)."""
     if platform == "cpu":
         # 8 virtual devices: the comparison then runs the real tree-sharded
         # program (trees distributed over the mesh), not a 1-device lax.map.
@@ -287,7 +296,13 @@ def run_forest_worker(npz_path: str, platform: str) -> None:
         # the orchestration delta, recorded as such via scaled_down.
         import jax
 
-        jax.config.update("jax_num_cpu_devices", 8)
+        try:
+            jax.config.update("jax_num_cpu_devices", 8)
+        except RuntimeError:
+            # Backend already initialized (a caller touched jax.devices()):
+            # proceed on however many devices exist — the comparison still
+            # runs, n_devices in the artifact records the actual width.
+            pass
     from mpitree_tpu.core.builder import BuildConfig
     from mpitree_tpu.core.fused_builder import (
         build_forest_fused,
@@ -297,8 +312,7 @@ def run_forest_worker(npz_path: str, platform: str) -> None:
     from mpitree_tpu.parallel import mesh as mesh_lib
     from mpitree_tpu.utils.profiling import PhaseTimer
 
-    data = np.load(npz_path)
-    Xtr, ytr = data["Xtr"], data["ytr"].astype(np.int32)
+    Xtr, ytr = np.asarray(Xtr), np.asarray(ytr).astype(np.int32)
     shape = _forest_shape(platform)
     T, n, depth = shape["trees"], min(shape["rows"], len(Xtr)), shape["depth"]
     Xtr, ytr = Xtr[:n], ytr[:n]
@@ -356,7 +370,7 @@ def run_forest_worker(npz_path: str, platform: str) -> None:
         "depth": depth,
         "backend": platform,
         "n_devices": int(mesh_all.size),
-        "scaled_down": platform != "tpu",
+        "scaled_down": platform == "cpu",
         "one_program": {
             "cold_s": round(cold_one_s, 3),
             "warm_s": round(one_s, 3),
@@ -369,7 +383,7 @@ def run_forest_worker(npz_path: str, platform: str) -> None:
         "one_program_speedup": round(seq_s / one_s, 2),
         "trees_identical": bool(identical),
     }
-    print("BENCH_WORKER_JSON:" + json.dumps(out))
+    return out
 
 
 def run_forest_bench(Xtr, ytr, platform) -> tuple[dict | None, str | None]:
@@ -561,7 +575,10 @@ def main():
             )
             return X, Xtr, Xte, ytr, yte
 
-        n_rows = N_ROWS if platform == "tpu" else cpu_fallback_rows()
+        # The tunneled accelerator registers as platform "axon" — every
+        # TPU-vs-fallback routing decision must treat it as TPU-class.
+        is_accel = platform in ("tpu", "axon")
+        n_rows = N_ROWS if is_accel else cpu_fallback_rows()
         X, Xtr, Xte, ytr, yte = load_and_split(n_rows)
 
         # --- ours: warm-timed depth-20 build --------------------------------
@@ -571,7 +588,7 @@ def main():
         ours_s = None
         try:
             worker = None
-            if platform == "tpu":
+            if is_accel:
                 worker, tpu_err = run_tpu_fit(Xtr, ytr, Xte, yte)
                 if worker is None:
                     errors["tpu_fit"] = (
@@ -585,6 +602,7 @@ def main():
 
                     jax.config.update("jax_platforms", "cpu")
                     platform = "cpu"
+                    is_accel = False  # downstream gates: embed tpu_last_known
                     detail["platform"] = "cpu (tpu fit fell back)"
                     if cpu_fallback_rows() != n_rows:
                         X, Xtr, Xte, ytr, yte = load_and_split(
@@ -653,7 +671,7 @@ def main():
         # When the live platform is not a TPU the round's artifact would
         # otherwise carry no TPU number at all; embed the newest committed
         # line captured by bench_tpu.py while the tunnel was up.
-        if platform != "tpu":
+        if not is_accel:
             try:
                 from bench_tpu import latest_line
 

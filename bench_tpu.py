@@ -264,12 +264,29 @@ def worker_hist_tput(npz_path: str) -> dict:
     return res
 
 
+def worker_forest(npz_path: str) -> dict:
+    """BASELINE configs[4] on the live platform (core shared with bench.py:
+    one-program tree-sharded forest vs T sequential fused builds)."""
+    import jax
+
+    from bench import forest_compare
+
+    # forest_compare's cpu branch must set jax_num_cpu_devices BEFORE any
+    # backend initializes — read the pinned platform from config (set by
+    # _pin_platform for cpu) instead of jax.devices(), which would
+    # initialize the backend and make that update raise.
+    platform = jax.config.jax_platforms or _device_platform()
+    Xtr, ytr, _, _ = _load(npz_path)
+    return forest_compare(Xtr, ytr, platform)
+
+
 WORKERS = {
     "north_star": worker_north_star,
     "engine_fused": lambda p: worker_engine(p, "fused"),
     "engine_levelwise": lambda p: worker_engine(p, "levelwise"),
     "hist_tput": worker_hist_tput,
     "refine_sweep": worker_refine_sweep,
+    "forest": worker_forest,
 }
 
 
@@ -336,7 +353,7 @@ def main() -> int:
     p.add_argument("--out", default=OUT_PATH)
     p.add_argument("--sweep-refine", action="store_true")
     p.add_argument("--sections", default="north_star,engine_fused,"
-                   "engine_levelwise,hist_tput")
+                   "engine_levelwise,hist_tput,forest")
     p.add_argument("--timeout", type=int, default=SECTION_TIMEOUT_S)
     p.add_argument("--platform", default="auto",
                    help="jax platform for every section (auto = probe, "

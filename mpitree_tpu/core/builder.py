@@ -328,8 +328,15 @@ def build_tree(
     timer: PhaseTimer | None = None,
     return_leaf_ids: bool = False,
     feature_sampler=None,
+    mono_cst: np.ndarray | None = None,
 ) -> TreeArrays:
     """Grow one tree level-synchronously; returns host struct-of-arrays.
+
+    ``mono_cst`` ((F,) int8, optional): INTERNAL monotonicity signs
+    (sklearn's convention — the estimator flips user signs for
+    classification; ``utils/monotonic.py``). Candidates violating the
+    ordering or the node's propagated value bounds are rejected in split
+    selection; children of a constrained split receive mid-value bounds.
 
     ``feature_sampler`` (:class:`ops.sampling.NodeFeatureSampler`, optional):
     per-node random feature subsets, sklearn ``max_features`` semantics.
@@ -379,6 +386,13 @@ def build_tree(
         engine = os.environ.get("MPITREE_TPU_ENGINE", "auto")
     if engine not in ("auto", "fused", "levelwise"):
         raise ValueError(f"unknown build engine {engine!r}")
+    mono = mono_cst is not None and bool(np.any(np.asarray(mono_cst) != 0))
+    if not mono:
+        mono_cst = None
+    if mono and mesh_lib.feature_shards(mesh) > 1:
+        raise ValueError(
+            "monotonic_cst is not supported on a (data, feature) mesh"
+        )
     sampling = feature_sampler is not None and feature_sampler.active
     if sampling and mesh_lib.feature_shards(mesh) > 1:
         # Neither engine evaluates per-node masks across feature shards
@@ -431,7 +445,7 @@ def build_tree(
             binned, y, config=cfg, mesh=mesh, n_classes=n_classes,
             sample_weight=sample_weight, refit_targets=refit_targets,
             timer=timer, return_leaf_ids=return_leaf_ids,
-            feature_sampler=feature_sampler,
+            feature_sampler=feature_sampler, mono_cst=mono_cst,
         )
     task = cfg.task
     N, F = binned.x_binned.shape
@@ -461,6 +475,14 @@ def build_tree(
     # tree seed, children hash the parent — engine-invariant.
     keys = feature_sampler.key_store() if sampling else None
 
+    # Per-node monotonic value bounds (utils/monotonic.py BoundsStore —
+    # the one host-side propagation implementation), grown with the tree.
+    if mono:
+        from mpitree_tpu.utils.monotonic import BoundsStore
+
+        mono_cst32 = np.ascontiguousarray(mono_cst, np.int32)
+        bounds = BoundsStore()
+
     K = _chunk_size(N, F, B, C, cfg)
     U = _table_slots(N, cfg)
     use_pallas = resolve_hist_kernel(
@@ -488,21 +510,25 @@ def build_tree(
             criterion=cfg.criterion, debug=debug, use_pallas=S in tiers,
             node_mask=sampling,
             random_split=sampling and feature_sampler.random_split,
+            monotonic=mono,
         )
 
     mcw32 = np.float32(cfg.min_child_weight)
 
     def split_args(lo, take, S_lvl):
         """Positional tail of a split_fn call for the chunk at ``lo``."""
-        if not sampling:
-            return (np.int32(lo), mcw32)
-        nmask = np.ones((S_lvl, F), bool)
-        nmask[:take] = keys.masks(lo, lo + take)
-        if not feature_sampler.random_split:
-            return (np.int32(lo), mcw32, nmask)
-        draws = np.zeros((S_lvl, F), np.uint32)
-        draws[:take] = keys.draws(lo, lo + take)
-        return (np.int32(lo), mcw32, nmask, draws)
+        args = (np.int32(lo), mcw32)
+        if sampling:
+            nmask = np.ones((S_lvl, F), bool)
+            nmask[:take] = keys.masks(lo, lo + take)
+            args = args + (nmask,)
+            if feature_sampler.random_split:
+                draws = np.zeros((S_lvl, F), np.uint32)
+                draws[:take] = keys.draws(lo, lo + take)
+                args = args + (draws,)
+        if mono:
+            args = args + (mono_cst32, *bounds.window(lo, take, S_lvl))
+        return args
 
     update_fn = collective.make_update_fn(mesh, n_slots=U)
     counts_fn = collective.make_counts_fn(
@@ -615,6 +641,12 @@ def build_tree(
             tree.right[split_ids] = rights
             if sampling:
                 keys.assign_children(split_ids, lefts, rights, tree.n)
+            if mono:
+                bounds.assign_children(
+                    split_ids, lefts, rights,
+                    dec["v_left"][~stop], dec["v_right"][~stop],
+                    mono_cst32[feat], tree.n,
+                )
 
             # Phase C: advance on-device row assignments — one full-row pass
             # per U-slot table (normally one per level). Host tables ride the

@@ -104,7 +104,8 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                      psum_axis: str | None = DATA_AXIS,
                      feature_axis: str | None = None,
                      sample_k: int | None = None,
-                     random_split: bool = False):
+                     random_split: bool = False,
+                     monotonic: bool = False):
     """Pure per-device build fn (xb, y, nid0, w, cand_mask) -> tree arrays.
 
     ``max_depth < 0`` means unbounded. ``psum_axis`` names the mesh axis that
@@ -135,6 +136,13 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
     the engine-identity contract holds. ``random_split`` likewise derives
     per-(node, feature) candidate draws (ExtraTrees, splitter="random").
     The build fn then takes a trailing ``root_key`` uint32 operand.
+
+    ``monotonic`` threads per-node value bounds (f32 lo/hi arrays) through
+    the while_loop state and rejects constraint-violating candidates in
+    split selection (sklearn ``monotonic_cst``; ``ops/impurity.py``). The
+    build fn takes a further trailing ``mono_cst`` (F,) int32 operand of
+    INTERNAL signs; children of a constrained split receive mid-value
+    bounds through the same allocation scatter as the parent links.
     """
     # K slots of slack past the true capacity: the last chunk's
     # dynamic_update_slice window [chunk_lo, chunk_lo+K) may extend past the
@@ -150,15 +158,22 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
             "per-node feature sampling is not supported on a "
             "(data, feature) mesh"
         )
+    if monotonic and feature_axis is not None:
+        raise ValueError(
+            "monotonic_cst is not supported on a (data, feature) mesh"
+        )
 
     def psum(x):
         return lax.psum(x, psum_axis) if psum_axis is not None else x
 
-    def build(xb, y, nid0, w, cand_mask, mcw, mid, root_key):
+    def build(xb, y, nid0, w, cand_mask, mcw, mid, root_key, mono_cst):
         # mid: sklearn's min_impurity_decrease pre-scaled by the total fit
         # weight (BuildConfig.min_decrease_scaled), a runtime operand so
         # distinct thresholds share one executable. root_key: the tree's
-        # path-key seed (unused scalar when sampling is off).
+        # path-key seed (unused scalar when sampling is off). mono_cst:
+        # (F,) int32 internal monotonicity signs (unused when monotonic is
+        # off — riding as an operand keeps distinct constraint vectors on
+        # one compiled executable).
         R, F = xb.shape  # F = per-shard feature count on a feature mesh
         # C == n_classes for classification, 3 (moment channels) for
         # regression — the VMEM check covers both payload widths.
@@ -222,9 +237,21 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
             return nmask, draws
 
         def chunk_stats(chunk_lo, nid, n_stat_slots, pallas_ok=False,
-                        key_a=None):
+                        key_a=None, bounds=None):
             """Histogram + split search for nodes [chunk_lo, chunk_lo+S_or_K)."""
             nmask, draws = node_subsets(chunk_lo, n_stat_slots, key_a)
+            mono = {}
+            if monotonic:
+                lo_a, hi_a = bounds
+                mono = {
+                    "mono_cst": mono_cst,
+                    "mono_lo": lax.dynamic_slice(
+                        lo_a, (chunk_lo,), (n_stat_slots,)
+                    ),
+                    "mono_hi": lax.dynamic_slice(
+                        hi_a, (chunk_lo,), (n_stat_slots,)
+                    ),
+                }
             if task == "classification":
                 if pallas_ok:
                     h = pallas_hist.histogram_small(
@@ -240,7 +267,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                 dec = select_global(imp_ops.best_split_classification(
                     h, cand_mask, criterion=criterion,
                     min_child_weight=mcw, node_mask=nmask,
-                    forced_draw=draws,
+                    forced_draw=draws, **mono,
                 ))
                 pure = (dec.counts > 0).sum(axis=1) <= 1
             else:
@@ -257,7 +284,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                 h = psum(h)
                 dec = select_global(imp_ops.best_split_regression(
                     h, cand_mask, min_child_weight=mcw, node_mask=nmask,
-                    forced_draw=draws,
+                    forced_draw=draws, **mono,
                 ))
                 ymin, ymax = regression_y_range(
                     y, nid, w, chunk_lo, n_slots=n_stat_slots, axis=psum_axis
@@ -274,7 +301,8 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
 
         def level_body(state):
             (feat_a, bin_a, counts_a, n_a, left_a, parent_a, nid, flo, fsz,
-             depth, key_a) = state
+             depth, key_a) = state[:11]
+            bounds = (state[11], state[12]) if monotonic else None
             terminal = jnp.logical_and(max_depth >= 0, depth == max_depth)
             n_chunks = (fsz + K - 1) // K
 
@@ -290,49 +318,57 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                        & (n * (dec.impurity - dec.cost) < mid))
                 )
                 feat_k = jnp.where(stop, -1, dec.feature).astype(jnp.int32)
-                return feat_k, dec.bin.astype(jnp.int32), dec.counts, n
+                out = (feat_k, dec.bin.astype(jnp.int32), dec.counts, n)
+                if monotonic:
+                    # sklearn's middle_value of the winning candidate —
+                    # the child-bound pin below.
+                    out = out + ((dec.v_left + dec.v_right) * 0.5,)
+                return out
+
+            def write_bufs(bufs, pieces, at):
+                feat_a, bin_a, counts_a, n_a = bufs[:4]
+                feat_a = lax.dynamic_update_slice(feat_a, pieces[0], (at,))
+                bin_a = lax.dynamic_update_slice(bin_a, pieces[1], (at,))
+                counts_a = lax.dynamic_update_slice(
+                    counts_a, pieces[2], (at, 0)
+                )
+                n_a = lax.dynamic_update_slice(n_a, pieces[3], (at,))
+                out = (feat_a, bin_a, counts_a, n_a)
+                if monotonic:
+                    out = out + (
+                        lax.dynamic_update_slice(bufs[4], pieces[4], (at,)),
+                    )
+                return out
 
             def chunk_body(c, bufs):
-                feat_a, bin_a, counts_a, n_a = bufs
                 chunk_lo = flo + c * K
 
                 def interior(_):
-                    return decide(*chunk_stats(chunk_lo, nid, K, key_a=key_a))
+                    return decide(*chunk_stats(chunk_lo, nid, K, key_a=key_a,
+                                               bounds=bounds))
 
                 def term(_):
                     cc = chunk_counts(chunk_lo, nid)
                     n = cc.sum(axis=1) if task == "classification" else cc[:, 0]
-                    return (jnp.full(K, -1, jnp.int32),
-                            jnp.zeros(K, jnp.int32), cc, n)
+                    out = (jnp.full(K, -1, jnp.int32),
+                           jnp.zeros(K, jnp.int32), cc, n)
+                    if monotonic:
+                        out = out + (jnp.zeros(K, jnp.float32),)
+                    return out
 
-                feat_k, bin_k, counts_k, n_k = lax.cond(
-                    terminal, term, interior, None
-                )
-                feat_a = lax.dynamic_update_slice(feat_a, feat_k, (chunk_lo,))
-                bin_a = lax.dynamic_update_slice(bin_a, bin_k, (chunk_lo,))
-                counts_a = lax.dynamic_update_slice(
-                    counts_a, counts_k, (chunk_lo, 0)
-                )
-                n_a = lax.dynamic_update_slice(n_a, n_k, (chunk_lo,))
-                return feat_a, bin_a, counts_a, n_a
+                pieces = lax.cond(terminal, term, interior, None)
+                return write_bufs(bufs, pieces, chunk_lo)
 
             def big_level(bufs):
                 return lax.fori_loop(0, n_chunks, chunk_body, bufs)
 
             def tier_level(s):
                 def branch(bufs):
-                    feat_a, bin_a, counts_a, n_a = bufs
-                    feat_k, bin_k, counts_k, n_k = decide(
+                    pieces = decide(
                         *chunk_stats(flo, nid, s, pallas_ok=s in pallas_tiers,
-                                     key_a=key_a)
+                                     key_a=key_a, bounds=bounds)
                     )
-                    feat_a = lax.dynamic_update_slice(feat_a, feat_k, (flo,))
-                    bin_a = lax.dynamic_update_slice(bin_a, bin_k, (flo,))
-                    counts_a = lax.dynamic_update_slice(
-                        counts_a, counts_k, (flo, 0)
-                    )
-                    n_a = lax.dynamic_update_slice(n_a, n_k, (flo,))
-                    return feat_a, bin_a, counts_a, n_a
+                    return write_bufs(bufs, pieces, flo)
 
                 return branch
 
@@ -348,7 +384,11 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                     )
 
             bufs = (feat_a, bin_a, counts_a, n_a)
-            feat_a, bin_a, counts_a, n_a = dispatch(bufs)
+            if monotonic:
+                bufs = bufs + (jnp.zeros(M, jnp.float32),)  # winner mids
+            bufs = dispatch(bufs)
+            feat_a, bin_a, counts_a, n_a = bufs[:4]
+            mid_a = bufs[4] if monotonic else None
 
             # Child allocation over the frontier window (full-M vectorized;
             # node ids inherit frontier order, so slot arithmetic keeps
@@ -380,6 +420,32 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                     jnp.where(is_split, rk, jnp.uint32(0))
                 )
                 key_a = jnp.where(newly, key_pad[:M], key_a)
+            if monotonic:
+                # sklearn bound propagation: a split on a constrained
+                # feature pins mid between the children (same scatter
+                # pattern as the parent links / sampling keys).
+                lo_a, hi_a = bounds
+                cstf = mono_cst[jnp.clip(feat_a, 0, None)]  # (M,) signs
+                llo = jnp.where(cstf == -1, mid_a, lo_a)
+                lhi = jnp.where(cstf == 1, mid_a, hi_a)
+                rlo = jnp.where(cstf == 1, mid_a, lo_a)
+                rhi = jnp.where(cstf == -1, mid_a, hi_a)
+
+                def scatter_children(lvals, rvals, fill):
+                    pad = jnp.full(M + 2, fill, jnp.float32)
+                    pad = pad.at[scat].set(jnp.where(is_split, lvals, fill))
+                    pad = pad.at[scat + 1].set(
+                        jnp.where(is_split, rvals, fill)
+                    )
+                    return pad[:M]
+
+                lo_a = jnp.where(
+                    newly, scatter_children(llo, rlo, -jnp.inf), lo_a
+                )
+                hi_a = jnp.where(
+                    newly, scatter_children(lhi, rhi, jnp.inf), hi_a
+                )
+                bounds = (lo_a, hi_a)
 
             # Reroute rows of splitting nodes (on-device mask partition —
             # the reference's recursive X[region] copies, decision_tree.py:150-164).
@@ -411,8 +477,11 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                 )
                 nid = jnp.where(active, child_all, nid)
 
-            return (feat_a, bin_a, counts_a, n_a, left_a, parent_a, nid,
-                    flo + fsz, 2 * n_split, depth + 1, key_a)
+            out = (feat_a, bin_a, counts_a, n_a, left_a, parent_a, nid,
+                   flo + fsz, 2 * n_split, depth + 1, key_a)
+            if monotonic:
+                out = out + bounds
+            return out
 
         def level_cond(state):
             return state[8] > 0
@@ -430,6 +499,11 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
             jnp.int32(0),                          # depth
             jnp.zeros(M, jnp.uint32).at[0].set(root_key.astype(jnp.uint32)),
         )
+        if monotonic:
+            state0 = state0 + (
+                jnp.full(M, -jnp.inf, jnp.float32),  # node lower bounds
+                jnp.full(M, jnp.inf, jnp.float32),   # node upper bounds
+            )
         out = lax.while_loop(level_cond, level_body, state0)
         feat_a, bin_a, counts_a, n_a, left_a, parent_a, nid, flo = out[:8]
         return feat_a, bin_a, counts_a, n_a, left_a, parent_a, nid, flo
@@ -442,10 +516,10 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                    task: str, criterion: str, max_nodes: int, max_depth: int,
                    min_samples_split: int, tiers: tuple = (),
                    use_pallas: bool = False, sample_k: int | None = None,
-                   random_split: bool = False):
+                   random_split: bool = False, monotonic: bool = False):
     """Data-parallel single-tree build: rows sharded, histograms psum'd.
 
-    Jitted (xb, y, nid0, w, cand_mask, mcw, mid, root_key) ->
+    Jitted (xb, y, nid0, w, cand_mask, mcw, mid, root_key, mono_cst) ->
     (tree arrays..., nid, n_nodes); tree outputs replicated, the final row
     assignment sharded (for the regression refit pass). On a 2-D
     ``(data, feature)`` mesh the histogram's feature dimension shards over
@@ -461,7 +535,7 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         min_samples_split=min_samples_split, tiers=tiers,
         use_pallas=use_pallas, psum_axis=DATA_AXIS,
         feature_axis=feature_axis, sample_k=sample_k,
-        random_split=random_split,
+        random_split=random_split, monotonic=monotonic,
     )
     FA = feature_axis  # None on a 1-D mesh -> replicated feature dim
     out_specs = (P(), P(), P(), P(), P(), P(), P(DATA_AXIS), P())
@@ -469,7 +543,7 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         build,
         mesh=mesh,
         in_specs=(P(DATA_AXIS, FA), P(DATA_AXIS), P(DATA_AXIS),
-                  P(DATA_AXIS), P(FA, None), P(), P(), P()),
+                  P(DATA_AXIS), P(FA, None), P(), P(), P(), P()),
         out_specs=out_specs,
         check_vma=FA is None,  # replicated/varying mixes in the 2-D cond
     )
@@ -483,7 +557,8 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                     tiers: tuple = (), use_pallas: bool = False,
                     data_sharded: bool = False,
                     sample_k: int | None = None,
-                    random_split: bool = False):
+                    random_split: bool = False,
+                    monotonic: bool = False):
     """Tree-parallel forest build: trees sharded over the mesh (ensemble
     parallelism — BASELINE configs[4], "N trees sharded across TPU chips").
 
@@ -506,10 +581,11 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         min_samples_split=min_samples_split, tiers=tiers,
         use_pallas=use_pallas,
         psum_axis=DATA_AXIS if data_sharded else None,
-        sample_k=sample_k, random_split=random_split,
+        sample_k=sample_k, random_split=random_split, monotonic=monotonic,
     )
 
-    def per_device(xb, y, nid0, ws, cand_masks, mcw, mid, root_keys):
+    def per_device(xb, y, nid0, ws, cand_masks, mcw, mid, root_keys,
+                   mono_cst):
         # mcw/mid: (T_local,) per-tree leaf floors and decrease gates —
         # sklearn recomputes both min_weight_fraction_leaf and the
         # min_impurity_decrease scaling from each tree's composed bootstrap
@@ -517,9 +593,11 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         # the host failover path, which uses tree_cfg per tree, stays
         # bit-identical to this program). root_keys: (T_local,) per-tree
         # path-key seeds (per-node feature subsets / random splits).
+        # mono_cst: (F,) shared constraint signs (sklearn forests apply one
+        # monotonic_cst to every tree).
         return lax.map(
             lambda wcm: build(xb, y, nid0, wcm[0], wcm[1], wcm[2], wcm[3],
-                              wcm[4]),
+                              wcm[4], mono_cst),
             (ws, cand_masks, mcw, mid, root_keys),
         )
 
@@ -527,14 +605,14 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     if data_sharded:
         in_specs = (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
                     P(TREE_AXIS, DATA_AXIS), P(TREE_AXIS, None, None),
-                    P(TREE_AXIS), P(TREE_AXIS), P(TREE_AXIS))
+                    P(TREE_AXIS), P(TREE_AXIS), P(TREE_AXIS), P())
         # tree outputs are replicated across each tree group after the
         # psum'd decisions; the row assignment stays sharded
         out_specs = (t, t, t, t, t, t, P(TREE_AXIS, DATA_AXIS), t)
     else:
         in_specs = (P(), P(), P(), P(TREE_AXIS, None),
                     P(TREE_AXIS, None, None), P(TREE_AXIS), P(TREE_AXIS),
-                    P(TREE_AXIS))
+                    P(TREE_AXIS), P())
         out_specs = (t, t, t, t, t, t, t, t)
     sharded = jax.shard_map(
         per_device,
@@ -561,6 +639,7 @@ def build_tree_fused(
     timer: PhaseTimer | None = None,
     return_leaf_ids: bool = False,
     feature_sampler=None,
+    mono_cst: np.ndarray | None = None,
 ) -> TreeArrays:
     """Same contract as ``builder.build_tree``, one device program per build.
 
@@ -568,6 +647,8 @@ def build_tree_fused(
     feature subsets and/or splitter="random" draws, evaluated entirely
     inside the compiled while_loop (the jnp path-key arithmetic) — the same
     trees every host/levelwise engine builds from the same sampler.
+    ``mono_cst``: (F,) INTERNAL monotonicity signs (see
+    ``builder.build_tree``); bounds thread through the while_loop state.
     """
     cfg = config
     task = cfg.task
@@ -577,6 +658,11 @@ def build_tree_fused(
     C = n_classes if task == "classification" else 3
 
     sample_k, random_split, root_key = _sampler_statics(feature_sampler, F)
+    monotonic = mono_cst is not None and bool(np.any(np.asarray(mono_cst)))
+    cst_op = (
+        np.ascontiguousarray(mono_cst, np.int32) if monotonic
+        else np.zeros(F, np.int32)
+    )
 
     K = _chunk_size(N, F, B, C, cfg)
     M = _node_capacity(N, cfg.max_depth)
@@ -592,6 +678,7 @@ def build_tree_fused(
         min_samples_split=int(cfg.min_samples_split),
         tiers=tuple(cfg.frontier_tiers),
         use_pallas=use_pallas, sample_k=sample_k, random_split=random_split,
+        monotonic=monotonic,
     )
 
     with timer.phase("shard"):
@@ -602,7 +689,7 @@ def build_tree_fused(
         out = fn(xb_d, y_d, nid_d, w_d, cand_d,
                  np.float32(cfg.min_child_weight),
                  np.float32(cfg.min_decrease_scaled),
-                 root_key)
+                 root_key, cst_op)
         feat, bins, counts, nvec, left, parent, nid_out, n_nodes = out
         # Tree outputs are replicated (addressable from any process); the
         # row-sharded nid_out is only fetched when the refit needs it —
@@ -702,6 +789,7 @@ def build_forest_fused(
     root_keys: np.ndarray | None = None,
     sample_k: int | None = None,
     random_split: bool = False,
+    mono_cst: np.ndarray | None = None,
 ) -> list:
     """Build T trees as ONE device program, trees sharded over the mesh.
 
@@ -765,6 +853,7 @@ def build_forest_fused(
         use_pallas=use_pallas,
         data_sharded=data_sharded,
         sample_k=sample_k, random_split=random_split,
+        monotonic=mono_cst is not None and bool(np.any(np.asarray(mono_cst))),
     )
 
     ws = weights.astype(np.float32)
@@ -816,11 +905,16 @@ def build_forest_fused(
         mcw_d = jax.device_put(mcw, NamedSharding(tmesh, P(TREE_AXIS)))
         mid_d = jax.device_put(mid, NamedSharding(tmesh, P(TREE_AXIS)))
         rk_d = jax.device_put(rks, NamedSharding(tmesh, P(TREE_AXIS)))
+        cst_op = (
+            np.zeros(F, np.int32) if mono_cst is None
+            else np.ascontiguousarray(mono_cst, np.int32)
+        )
+        cst_d = jax.device_put(cst_op, NamedSharding(tmesh, P()))
 
     with timer.phase("forest_build"):
         feat, bins, counts, nvec, left, parent, nid_out, n_nodes = (
             jax.device_get(
-                fn(xb_d, y_d, nid_d, ws_d, cm_d, mcw_d, mid_d, rk_d)
+                fn(xb_d, y_d, nid_d, ws_d, cm_d, mcw_d, mid_d, rk_d, cst_d)
             )
         )
 

@@ -235,11 +235,16 @@ def build_tree_host(
     refit_targets: np.ndarray | None = None,
     return_leaf_ids: bool = False,
     feature_sampler=None,
+    mono_cst: np.ndarray | None = None,
 ) -> TreeArrays:
     """Grow one tree on the host; same contract as ``builder.build_tree``.
 
     ``feature_sampler``: per-node random feature subsets (ops/sampling.py) —
     identical node keys and masks to the device levelwise build.
+    ``mono_cst``: (F,) INTERNAL monotonicity signs (utils/monotonic.py) —
+    runs on the numpy sweep (the C++ kernel has no constraint mode); the
+    value gate uses the same f32 reciprocal-multiply arithmetic as the
+    device engines, so integer-weight fits stay engine-identical.
     """
     from mpitree_tpu.core.builder import _TreeBuffer  # shared node store
 
@@ -274,6 +279,13 @@ def build_tree_host(
     rand_split = sampling and feature_sampler.random_split
     keys = feature_sampler.key_store() if sampling else None
 
+    mono = mono_cst is not None and bool(np.any(np.asarray(mono_cst) != 0))
+    if mono:
+        from mpitree_tpu.utils.monotonic import BoundsStore
+
+        cst32 = np.ascontiguousarray(mono_cst, np.int32)
+        bounds = BoundsStore()
+
     nid = np.zeros(N, np.int32)
     rows_feat = np.broadcast_to(np.arange(F, dtype=np.intp)[None, :], (N, F))
     frontier_lo, frontier_size, depth = 0, 1, 0
@@ -304,7 +316,9 @@ def build_tree_host(
         # numpy blocks below are the portable fallback.
         # splitter="random" stays on the numpy sweep: the C++ kernel has
         # no drawn-bin mode (the draw replaces its incremental argmin).
-        nat = None if (terminal or rand_split) else _native_splits(
+        # Monotonic constraints likewise: the value gate lives in the
+        # numpy candidate mask below.
+        nat = None if (terminal or rand_split or mono) else _native_splits(
             xb, y, nid, sample_weight, binned, cfg,
             frontier_lo=frontier_lo, n_slots=S, n_classes=C, task=task,
             node_mask=nmask,
@@ -388,6 +402,33 @@ def build_tree_host(
                 )
             if nmask is not None:
                 valid = valid & nmask[:, :, None]
+            if mono:
+                # sklearn's monotonic gate in the device's exact f32
+                # reciprocal-multiply form (ops/impurity._monotonic_ok).
+                f1 = np.float32(1.0)
+                if task == "classification":
+                    m_l = hist[:, :, 0, :].cumsum(axis=2)
+                else:
+                    m_l = hist[:, :, 1, :].cumsum(axis=2, dtype=np.float32)
+                nl32 = n_l.astype(np.float32)
+                nr32 = n_r.astype(np.float32)
+                vl_all = m_l.astype(np.float32) * (
+                    f1 / np.maximum(nl32, f1)
+                )
+                vr_all = (m_l[:, :, -1:] - m_l).astype(np.float32) * (
+                    f1 / np.maximum(nr32, f1)
+                )
+                bounds.ensure(frontier_lo + S)
+                lo_w, hi_w = bounds.window(frontier_lo, S, S)
+                b_lo = lo_w[:, None, None]
+                b_hi = hi_w[:, None, None]
+                sgn = cst32[None, :, None].astype(np.float32)
+                ok = (
+                    ((vl_all - vr_all) * sgn <= 0)
+                    & (vl_all >= b_lo) & (vl_all <= b_hi)
+                    & (vr_all >= b_lo) & (vr_all <= b_hi)
+                )
+                valid = valid & ((sgn == 0) | ok)
             cost = np.where(valid, cost, np.inf)
             if rand_split:
                 # splitter="random": one uniform pick among the VALID bins
@@ -432,6 +473,17 @@ def build_tree_host(
             slot, live, S, frontier_lo, depth,
         )
         thread_keys(ids, stop)
+        if mono and not terminal and (~stop).any():
+            # Children of a constrained split are pinned by the winning
+            # candidate's mid value (utils/monotonic.py BoundsStore).
+            split_ids = ids[~stop]
+            sel = np.flatnonzero(~stop)
+            bounds.assign_children(
+                split_ids, tree.left[split_ids], tree.right[split_ids],
+                vl_all[sel, feat_best[sel], bin_best[sel]],
+                vr_all[sel, feat_best[sel], bin_best[sel]],
+                cst32[feat_best[sel]], tree.n,
+            )
 
     out = tree.finalize()
 

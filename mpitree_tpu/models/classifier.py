@@ -101,6 +101,14 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         Minimal cost-complexity pruning strength (sklearn semantics,
         ``utils/pruning.py``) — applied host-side to the finished tree, so
         every build engine prunes identically.
+    monotonic_cst : array-like of int of shape (n_features,), optional
+        sklearn's monotonicity constraints (+1 increasing, -1 decreasing,
+        0 none; positive-class probability for this binary-only classifier).
+        Enforced in split selection on every engine (``utils/monotonic.py``);
+        ``predict`` reflects the bound-clipped values. Divergences from
+        sklearn, documented: ``predict_proba`` keeps returning RAW counts
+        (the reference contract), so the monotone guarantee applies to
+        ``predict``; constrained fits skip the hybrid refine tail.
     n_devices : int, "all", or None, default=None
         Data-mesh width; ``None`` = single device.
     backend : str, optional
@@ -128,7 +136,8 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
                  min_weight_fraction_leaf=0.0, min_samples_leaf=1,
                  random_state=None,
                  n_devices=None, backend=None, refine_depth="auto",
-                 ccp_alpha=0.0, min_impurity_decrease=0.0):
+                 ccp_alpha=0.0, min_impurity_decrease=0.0,
+                 monotonic_cst=None):
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
         self.criterion = criterion
@@ -145,6 +154,7 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         self.refine_depth = refine_depth
         self.ccp_alpha = ccp_alpha
         self.min_impurity_decrease = min_impurity_decrease
+        self.monotonic_cst = monotonic_cst
 
     # -- fitting -----------------------------------------------------------
     def fit(self, X, y, sample_weight=None):
@@ -152,6 +162,13 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         self.n_features_ = X.shape[1]
         self.n_features_in_ = X.shape[1]
         self.classes_ = classes
+
+        from mpitree_tpu.utils.monotonic import validate_monotonic_cst
+
+        mono = validate_monotonic_cst(
+            self.monotonic_cst, X.shape[1], task="classification",
+            n_classes=len(classes),
+        )
 
         timer = PhaseTimer(enabled=profiling_enabled())
         with timer.phase("bin"):
@@ -163,6 +180,11 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
             self.max_depth, self.refine_depth,
             n_rows=X.shape[0], quantized=binned.quantized,
         )
+        if mono is not None:
+            # Constrained fits single-engine the whole depth: the hybrid
+            # tail would need crown bounds threaded across the graft seam;
+            # constraint semantics take precedence over tail perf here.
+            rd, refine, crown_depth = None, False, self.max_depth
         cfg = BuildConfig(
             task="classification",
             criterion=self.criterion,
@@ -187,7 +209,7 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
                 res = build_tree_host(
                     binned, y_enc, config=cfg, n_classes=len(classes),
                     sample_weight=sw, return_leaf_ids=refine,
-                    feature_sampler=sampler,
+                    feature_sampler=sampler, mono_cst=mono,
                 )
                 self.tree_, leaf_ids = res if refine else (res, None)
         else:
@@ -200,6 +222,7 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
                     binned, y_enc, config=cfg, mesh=mesh,
                     n_classes=len(classes), sample_weight=sw, timer=timer,
                     return_leaf_ids=refine, feature_sampler=sampler,
+                    mono_cst=mono,
                 )
                 # The build maintains row->leaf ids on device; fetching them
                 # here spares the refine a second full-matrix descent (and X
@@ -214,7 +237,7 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
                     res = build_tree_host(
                         binned, y_enc, config=cfg, n_classes=len(classes),
                         sample_weight=sw, return_leaf_ids=refine,
-                        feature_sampler=sampler,
+                        feature_sampler=sampler, mono_cst=mono,
                     )
                     return res if refine else (res, None)
 
@@ -237,6 +260,10 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
                 self.tree_ = ccp_prune(
                     self.tree_, self.ccp_alpha, task="classification"
                 )
+        if mono is not None:
+            from mpitree_tpu.utils.monotonic import clip_tree_values
+
+            clip_tree_values(self.tree_, mono, "classification")
         self.fit_stats_ = timer.summary() if timer.enabled else None
         return self
 
@@ -283,6 +310,13 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
     def predict(self, X):
         check_is_fitted(self)
         X = validate_predict_data(X, self.n_features_, type(self).__name__)
+        if getattr(self, "monotonic_cst", None) is not None:
+            # Constrained fits predict from the bound-CLIPPED leaf labels
+            # (clip_tree_values wrote them into tree_.value) — the raw-count
+            # argmax below would ignore the clip and can break the monotone
+            # guarantee exactly where a bound binds. predict_proba stays on
+            # raw counts by reference contract (documented divergence).
+            return self.classes_[self.tree_.value[self._leaf_ids(X)]]
         idx = self.tree_.count[self._leaf_ids(X)].argmax(axis=1)
         return self.classes_[idx]
 
@@ -359,7 +393,8 @@ class ParallelDecisionTreeClassifier(DecisionTreeClassifier):
                  min_weight_fraction_leaf=0.0, min_samples_leaf=1,
                  random_state=None,
                  n_devices="all", backend=None, refine_depth="auto",
-                 ccp_alpha=0.0, min_impurity_decrease=0.0):
+                 ccp_alpha=0.0, min_impurity_decrease=0.0,
+                 monotonic_cst=None):
         super().__init__(
             max_depth=max_depth, min_samples_split=min_samples_split,
             criterion=criterion, splitter=splitter, max_bins=max_bins,
@@ -369,6 +404,7 @@ class ParallelDecisionTreeClassifier(DecisionTreeClassifier):
             min_samples_leaf=min_samples_leaf, random_state=random_state,
             n_devices=n_devices, backend=backend, refine_depth=refine_depth,
             ccp_alpha=ccp_alpha, min_impurity_decrease=min_impurity_decrease,
+            monotonic_cst=monotonic_cst,
         )
 
     @_ClassProperty

@@ -76,7 +76,7 @@ class _BaseForest(BaseEstimator):
                  random_state=None, n_devices=None,
                  backend=None, refine_depth="auto", checkpoint=None,
                  ccp_alpha=0.0, min_impurity_decrease=0.0,
-                 splitter="best"):
+                 splitter="best", monotonic_cst=None):
         self.n_estimators = n_estimators
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
@@ -99,6 +99,7 @@ class _BaseForest(BaseEstimator):
         self.ccp_alpha = ccp_alpha
         self.min_impurity_decrease = min_impurity_decrease
         self.splitter = splitter
+        self.monotonic_cst = monotonic_cst
 
     def _pop_oob_masks(self):
         """Consume the fit-time bootstrap OOB masks (they must not persist —
@@ -140,6 +141,15 @@ class _BaseForest(BaseEstimator):
             self.max_depth, self.refine_depth,
             n_rows=n, quantized=binned.quantized,
         )
+        from mpitree_tpu.utils.monotonic import validate_monotonic_cst
+
+        mono = validate_monotonic_cst(
+            self.monotonic_cst, X.shape[1], task=task, n_classes=n_classes
+        )
+        if mono is not None:
+            # Single-engine full-depth builds under constraints (same
+            # stance as the tree estimators: no hybrid tail).
+            rd, refine, crown_depth = None, False, self.max_depth
         cfg = BuildConfig(
             task=task, criterion=criterion, max_depth=crown_depth,
             min_samples_split=self.min_samples_split,
@@ -254,6 +264,10 @@ class _BaseForest(BaseEstimator):
                 from mpitree_tpu.utils.pruning import ccp_prune
 
                 tree = ccp_prune(tree, self.ccp_alpha, task=task)
+            if mono is not None:
+                from mpitree_tpu.utils.monotonic import clip_tree_values
+
+                clip_tree_values(tree, mono, task)
             return tree
 
         def host_raw(i):
@@ -263,7 +277,7 @@ class _BaseForest(BaseEstimator):
                 tree_b[i], y_enc, config=tree_cfg(tree_w[i]),
                 n_classes=n_classes, sample_weight=tree_w[i],
                 refit_targets=refit_targets, return_leaf_ids=refine,
-                feature_sampler=tree_sampler[i],
+                feature_sampler=tree_sampler[i], mono_cst=mono,
             )
             return res if refine else (res, None)
 
@@ -280,7 +294,7 @@ class _BaseForest(BaseEstimator):
                     tree_b[i], y_enc, config=tree_cfg(tree_w[i]), mesh=mesh,
                     n_classes=n_classes, sample_weight=tree_w[i],
                     refit_targets=refit_targets, return_leaf_ids=refine,
-                    feature_sampler=tree_sampler[i],
+                    feature_sampler=tree_sampler[i], mono_cst=mono,
                 )
                 return res if refine else (res, None)
 
@@ -320,6 +334,7 @@ class _BaseForest(BaseEstimator):
                     root_keys=rks,
                     sample_k=k if node_sampling else None,
                     random_split=rand_split,
+                    mono_cst=mono,
                 )
 
             def host():
@@ -510,7 +525,8 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
                  random_state=None,
                  n_devices=None, backend=None, refine_depth="auto",
                  checkpoint=None, ccp_alpha=0.0,
-                 min_impurity_decrease=0.0, splitter="best"):
+                 min_impurity_decrease=0.0, splitter="best",
+                 monotonic_cst=None):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
@@ -521,7 +537,7 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
             random_state=random_state, n_devices=n_devices, backend=backend,
             refine_depth=refine_depth, checkpoint=checkpoint,
             ccp_alpha=ccp_alpha, min_impurity_decrease=min_impurity_decrease,
-            splitter=splitter,
+            splitter=splitter, monotonic_cst=monotonic_cst,
         )
         self.criterion = criterion
         self.class_weight = class_weight
@@ -539,6 +555,7 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
             X, y_enc, task="classification", criterion=self.criterion,
             n_classes=len(classes), sample_weight=sample_weight,
         ))
+        self._mono_p0 = None  # predict_proba's clipped-probability cache
         if self.oob_score:
             # Each row is scored only by trees whose bootstrap left it out —
             # an unbiased generalization estimate without a held-out split.
@@ -570,13 +587,41 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
     def predict_proba(self, X):
         """Mean of per-tree leaf class distributions (normalized — unlike the
         single tree's raw-count reference quirk, which has no ensemble
-        analogue)."""
+        analogue). Under ``monotonic_cst`` the per-tree distributions are
+        the bound-clipped probabilities (sklearn's forests average their
+        trees' clipped stored values), which is what makes the averaged
+        ``predict_proba`` monotone."""
         check_is_fitted(self)
         X = validate_predict_data(X, self.n_features_, type(self).__name__)
+        from mpitree_tpu.utils.monotonic import (
+            clipped_class0,
+            validate_monotonic_cst,
+        )
+
+        mono = validate_monotonic_cst(
+            self.monotonic_cst, self.n_features_, task="classification",
+            n_classes=len(self.classes_),
+        )
+        if mono is not None:
+            # Clipped p0 is fit-time-constant per tree; cache it so
+            # repeated predict calls don't redo the bound propagation.
+            cache = getattr(self, "_mono_p0", None)
+            if cache is None or len(cache) != len(self.trees_):
+                cache = [
+                    clipped_class0(t, mono).astype(np.float64)
+                    for t in self.trees_
+                ]
+                self._mono_p0 = cache
         acc = np.zeros((X.shape[0], len(self.classes_)))
-        for t, ids in self._leaf_ids(X):
-            counts = t.count[ids].astype(np.float64)
-            acc += counts / np.maximum(counts.sum(axis=1, keepdims=True), 1.0)
+        for i, (t, ids) in enumerate(self._leaf_ids(X)):
+            if mono is not None:
+                p0 = cache[i][ids]
+                acc += np.stack([p0, 1.0 - p0], axis=1)
+            else:
+                counts = t.count[ids].astype(np.float64)
+                acc += counts / np.maximum(
+                    counts.sum(axis=1, keepdims=True), 1.0
+                )
         return acc / len(self.trees_)
 
     def predict(self, X):
@@ -594,7 +639,8 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
                  min_samples_leaf=1, random_state=None,
                  n_devices=None, backend=None, refine_depth="auto",
                  checkpoint=None, ccp_alpha=0.0,
-                 min_impurity_decrease=0.0, splitter="best"):
+                 min_impurity_decrease=0.0, splitter="best",
+                 monotonic_cst=None):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
@@ -605,7 +651,7 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
             random_state=random_state, n_devices=n_devices, backend=backend,
             refine_depth=refine_depth, checkpoint=checkpoint,
             ccp_alpha=ccp_alpha, min_impurity_decrease=min_impurity_decrease,
-            splitter=splitter,
+            splitter=splitter, monotonic_cst=monotonic_cst,
         )
 
     def fit(self, X, y, sample_weight=None):
@@ -664,7 +710,7 @@ class ExtraTreesClassifier(RandomForestClassifier):
                  min_weight_fraction_leaf=0.0, min_samples_leaf=1,
                  random_state=None, n_devices=None, backend=None,
                  refine_depth="auto", checkpoint=None, ccp_alpha=0.0,
-                 min_impurity_decrease=0.0):
+                 min_impurity_decrease=0.0, monotonic_cst=None):
         super().__init__(
             n_estimators=n_estimators, criterion=criterion,
             max_depth=max_depth, min_samples_split=min_samples_split,
@@ -676,7 +722,7 @@ class ExtraTreesClassifier(RandomForestClassifier):
             n_devices=n_devices, backend=backend, refine_depth=refine_depth,
             checkpoint=checkpoint, ccp_alpha=ccp_alpha,
             min_impurity_decrease=min_impurity_decrease,
-            splitter="random",
+            splitter="random", monotonic_cst=monotonic_cst,
         )
 
 
@@ -689,7 +735,8 @@ class ExtraTreesRegressor(RandomForestRegressor):
                  oob_score=False, min_weight_fraction_leaf=0.0,
                  min_samples_leaf=1, random_state=None, n_devices=None,
                  backend=None, refine_depth="auto", checkpoint=None,
-                 ccp_alpha=0.0, min_impurity_decrease=0.0):
+                 ccp_alpha=0.0, min_impurity_decrease=0.0,
+                 monotonic_cst=None):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
@@ -700,5 +747,5 @@ class ExtraTreesRegressor(RandomForestRegressor):
             n_devices=n_devices, backend=backend, refine_depth=refine_depth,
             checkpoint=checkpoint, ccp_alpha=ccp_alpha,
             min_impurity_decrease=min_impurity_decrease,
-            splitter="random",
+            splitter="random", monotonic_cst=monotonic_cst,
         )

@@ -54,7 +54,8 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
                  max_features=None, min_weight_fraction_leaf=0.0,
                  min_samples_leaf=1, random_state=None,
                  n_devices=None, backend=None, refine_depth="auto",
-                 ccp_alpha=0.0, min_impurity_decrease=0.0):
+                 ccp_alpha=0.0, min_impurity_decrease=0.0,
+                 monotonic_cst=None):
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
         self.criterion = criterion
@@ -70,6 +71,7 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
         self.refine_depth = refine_depth
         self.ccp_alpha = ccp_alpha
         self.min_impurity_decrease = min_impurity_decrease
+        self.monotonic_cst = monotonic_cst
 
     def fit(self, X, y, sample_weight=None):
         if self.criterion not in ("squared_error", "mse"):
@@ -81,6 +83,12 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
         y_mean = float(y64.mean()) if len(y64) else 0.0
         self._y_mean = y_mean
 
+        from mpitree_tpu.utils.monotonic import validate_monotonic_cst
+
+        mono = validate_monotonic_cst(
+            self.monotonic_cst, X.shape[1], task="regression"
+        )
+
         timer = PhaseTimer(enabled=profiling_enabled())
         with timer.phase("bin"):
             binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
@@ -90,6 +98,11 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
             self.max_depth, self.refine_depth,
             n_rows=X.shape[0], quantized=binned.quantized,
         )
+        if mono is not None:
+            # Constrained fits single-engine the whole depth: the hybrid
+            # tail would need crown bounds threaded across the graft seam;
+            # constraint semantics take precedence over tail perf here.
+            rd, refine, crown_depth = None, False, self.max_depth
         cfg = BuildConfig(
             task="regression",
             criterion="mse",
@@ -115,7 +128,7 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
                 res = build_tree_host(
                     binned, y_c, config=cfg, sample_weight=sw,
                     refit_targets=y64, return_leaf_ids=refine,
-                    feature_sampler=sampler,
+                    feature_sampler=sampler, mono_cst=mono,
                 )
                 self.tree_, leaf_ids = res if refine else (res, None)
         else:
@@ -127,7 +140,7 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
                 res = build_tree(
                     binned, y_c, config=cfg, mesh=mesh, sample_weight=sw,
                     refit_targets=y64, timer=timer, return_leaf_ids=refine,
-                    feature_sampler=sampler,
+                    feature_sampler=sampler, mono_cst=mono,
                 )
                 # Row->leaf ids come straight off the build's device state;
                 # a second full-matrix descent would re-upload X for nothing.
@@ -140,7 +153,7 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
                     res = build_tree_host(
                         binned, y_c, config=cfg, sample_weight=sw,
                         refit_targets=y64, return_leaf_ids=refine,
-                        feature_sampler=sampler,
+                        feature_sampler=sampler, mono_cst=mono,
                     )
                     return res if refine else (res, None)
 
@@ -163,6 +176,10 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
                 self.tree_ = ccp_prune(
                     self.tree_, self.ccp_alpha, task="regression"
                 )
+        if mono is not None:
+            from mpitree_tpu.utils.monotonic import clip_tree_values
+
+            clip_tree_values(self.tree_, mono, "regression")
         self.fit_stats_ = timer.summary() if timer.enabled else None
         return self
 

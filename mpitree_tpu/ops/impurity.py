@@ -58,6 +58,12 @@ class SplitDecision(NamedTuple):
     counts: jax.Array
     constant: jax.Array
     y_range: jax.Array
+    # Winning candidate's child values (class-0 fraction for classification,
+    # mean target for regression) — zeros unless monotonic constraints are
+    # active. The builder derives children's bounds from their average
+    # (sklearn's middle_value, sklearn/tree/_tree.pyx bound propagation).
+    v_left: jax.Array = None
+    v_right: jax.Array = None
 
 
 def _entropy(counts: jax.Array, n: jax.Array) -> jax.Array:
@@ -86,6 +92,9 @@ def best_split_classification(
     hist: jax.Array, cand_mask: jax.Array, *, criterion: str = "entropy",
     node_mask: jax.Array | None = None, min_child_weight=None,
     forced_draw: jax.Array | None = None,
+    mono_cst: jax.Array | None = None,
+    mono_lo: jax.Array | None = None,
+    mono_hi: jax.Array | None = None,
 ) -> SplitDecision:
     """Pick the best (feature, bin) per frontier slot from a class histogram.
 
@@ -98,6 +107,16 @@ def best_split_classification(
     node_mask : (K, F) bool, optional — per-node allowed features
         (``ops/sampling.py``); masked features cannot win but still feed
         the ``constant`` occupancy stop, matching the host tiers.
+    mono_cst : (F,) int32, optional — INTERNAL monotonicity signs (the
+        estimator flips user signs for classification, sklearn's
+        class-0-fraction convention): a candidate on feature f with
+        ``mono_cst[f] != 0`` is valid only when
+        ``(v_l - v_r) * mono_cst[f] <= 0`` and both child values lie in
+        the node's ``[mono_lo, mono_hi]`` (K,) bounds
+        (sklearn/tree/_criterion.pyx ``_check_monotonicity``). Child
+        values are ``f32(count_0) * f32(1/n)`` — the reciprocal-multiply
+        form every engine reproduces bit-identically for integer weights.
+        Requires binary classification (validated estimator-side).
     """
     # Memory-lean formulation: materializing left/right (K,F,B,C) cumsums and
     # per-side impurity stacks peaks at ~18 histogram-sized buffers under the
@@ -142,6 +161,13 @@ def best_split_classification(
         valid = valid & (n_l >= min_child_weight) & (n_r >= min_child_weight)
     if node_mask is not None:
         valid = valid & node_mask[:, :, None]
+    if mono_cst is not None:
+        l0 = jnp.cumsum(hist[:, :, 0, :], axis=2)  # class-0 left mass
+        v_l_all = l0 * inv_l
+        v_r_all = (l0[:, :, -1:] - l0) * inv_r
+        valid = valid & _monotonic_ok(
+            v_l_all, v_r_all, mono_cst, mono_lo, mono_hi
+        )
     cost = jnp.where(valid, cost, jnp.inf)
 
     if forced_draw is None:
@@ -160,6 +186,13 @@ def best_split_classification(
     occupied = (hist_sum > 0).sum(axis=2)  # (K, F) occupied bins
     constant = (occupied <= 1).all(axis=1)
 
+    if mono_cst is not None:
+        v_left, v_right = _winner_values(
+            v_l_all, v_r_all, best_feature, best_bin
+        )
+    else:
+        v_left = v_right = jnp.zeros_like(parent_n)
+
     return SplitDecision(
         feature=best_feature.astype(jnp.int32),
         bin=best_bin.astype(jnp.int32),
@@ -169,6 +202,37 @@ def best_split_classification(
         counts=parent_counts,
         constant=constant,
         y_range=jnp.zeros_like(parent_n),
+        v_left=v_left,
+        v_right=v_right,
+    )
+
+
+def _monotonic_ok(v_l, v_r, mono_cst, mono_lo, mono_hi) -> jax.Array:
+    """sklearn's per-candidate monotonicity gate (_check_monotonicity).
+
+    ``v_l``/``v_r`` are (K, F, B) child values; ``mono_cst`` (F,) internal
+    signs; ``mono_lo``/``mono_hi`` (K,) node bounds. Unconstrained features
+    (sign 0) pass unconditionally — sklearn only applies the check (bounds
+    included) when the split feature carries a constraint.
+    """
+    cst = mono_cst.astype(v_l.dtype)[None, :, None]
+    lo = mono_lo[:, None, None]
+    hi = mono_hi[:, None, None]
+    ok = (
+        ((v_l - v_r) * cst <= 0)
+        & (v_l >= lo) & (v_l <= hi)
+        & (v_r >= lo) & (v_r <= hi)
+    )
+    return (cst == 0) | ok
+
+
+def _winner_values(v_l, v_r, best_feature, best_bin):
+    """Gather the winning candidate's (v_left, v_right) per slot."""
+    vl_f = jnp.take_along_axis(v_l, best_bin[:, None, None], axis=2)[:, :, 0]
+    vr_f = jnp.take_along_axis(v_r, best_bin[:, None, None], axis=2)[:, :, 0]
+    return (
+        jnp.take_along_axis(vl_f, best_feature[:, None], axis=1)[:, 0],
+        jnp.take_along_axis(vr_f, best_feature[:, None], axis=1)[:, 0],
     )
 
 
@@ -189,6 +253,9 @@ def best_split_regression(
     hist: jax.Array, cand_mask: jax.Array,
     node_mask: jax.Array | None = None, min_child_weight=None,
     forced_draw: jax.Array | None = None,
+    mono_cst: jax.Array | None = None,
+    mono_lo: jax.Array | None = None,
+    mono_hi: jax.Array | None = None,
 ) -> SplitDecision:
     """Pick the best MSE split per frontier slot from a moment histogram.
 
@@ -220,6 +287,14 @@ def best_split_regression(
         valid = valid & (w_l >= min_child_weight) & (w_r >= min_child_weight)
     if node_mask is not None:
         valid = valid & node_mask[:, :, None]
+    if mono_cst is not None:
+        # child means via reciprocal-multiply (see the classification
+        # docstring: the form every engine reproduces bit-identically)
+        v_l_all = s_l * (1.0 / jnp.maximum(w_l, 1.0))
+        v_r_all = s_r * (1.0 / jnp.maximum(w_r, 1.0))
+        valid = valid & _monotonic_ok(
+            v_l_all, v_r_all, mono_cst, mono_lo, mono_hi
+        )
     cost = jnp.where(valid, cost, jnp.inf)
 
     if forced_draw is None:
@@ -241,6 +316,13 @@ def best_split_regression(
     occupied = (hist[:, :, 0, :] > 0).sum(axis=2)
     constant = (occupied <= 1).all(axis=1)
 
+    if mono_cst is not None:
+        v_left, v_right = _winner_values(
+            v_l_all, v_r_all, best_feature, best_bin
+        )
+    else:
+        v_left = v_right = jnp.zeros_like(parent_n)
+
     return SplitDecision(
         feature=best_feature.astype(jnp.int32),
         bin=best_bin.astype(jnp.int32),
@@ -250,4 +332,6 @@ def best_split_regression(
         counts=parent_moments,
         constant=constant,
         y_range=jnp.zeros_like(parent_n),
+        v_left=v_left,
+        v_right=v_right,
     )

@@ -81,22 +81,26 @@ def regression_y_range(y, nid, w, chunk_lo, *, n_slots, axis=DATA_AXIS):
 
 
 def _pack_decision(dec) -> "jax.Array":
-    """SplitDecision -> one (K, 7 + C) float32 buffer.
+    """SplitDecision -> one (K, 9 + C) float32 buffer.
 
     The levelwise builder fetches the decision every level; a namedtuple
-    fetch is one host transfer per field (8 round trips on a tunneled
+    fetch is one host transfer per field (10 round trips on a tunneled
     transport), a packed buffer is one. feature/bin/constant ride as f32 —
     exact below 2^24, far above any bin or feature count. ``n`` and the
     class ``counts`` share that 2^24 integer-exactness ceiling: today they
     arrive as f32 device histograms anyway, so packing loses nothing, but a
     future f64-histogram path must widen this buffer or it would silently
     truncate node totals past 16.7M weighted rows (tree.count contract,
-    min_samples_split tests).
+    min_samples_split tests). ``v_left``/``v_right`` (monotonic
+    constraints; zeros otherwise) feed the host's child-bound propagation.
     """
+    zeros = jnp.zeros_like(dec.n)
     head = jnp.stack(
         [dec.feature.astype(jnp.float32), dec.bin.astype(jnp.float32),
          dec.cost, dec.impurity, dec.n,
-         dec.constant.astype(jnp.float32), dec.y_range],
+         dec.constant.astype(jnp.float32), dec.y_range,
+         dec.v_left if dec.v_left is not None else zeros,
+         dec.v_right if dec.v_right is not None else zeros],
         axis=1,
     )
     return jnp.concatenate([head, dec.counts.astype(jnp.float32)], axis=1)
@@ -114,7 +118,9 @@ def unpack_decision(packed: "np.ndarray") -> dict:
         "n": packed[:, 4],
         "constant": packed[:, 5] > 0,
         "y_range": packed[:, 6],
-        "counts": packed[:, 7:],
+        "v_left": packed[:, 7],
+        "v_right": packed[:, 8],
+        "counts": packed[:, 9:],
     }
 
 
@@ -122,9 +128,9 @@ def unpack_decision(packed: "np.ndarray") -> dict:
 def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                   task: str, criterion: str, debug: bool = False,
                   use_pallas: bool = False, node_mask: bool = False,
-                  random_split: bool = False):
+                  random_split: bool = False, monotonic: bool = False):
     """Jitted (x_binned, y, node_id, weight, cand_mask, chunk_lo, mcw[, nmask])
-    -> packed (n_slots, 7 + C) float32 decision buffer (see
+    -> packed (n_slots, 9 + C) float32 decision buffer (see
     :func:`_pack_decision`, :func:`unpack_decision`). ``mcw`` is the
     min-child-weight floor as a RUNTIME scalar (a traced constant would
     recompile per distinct total fit weight).
@@ -140,9 +146,17 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     allowed features (sklearn per-node ``max_features``; ops/sampling.py).
     ``random_split=True`` adds a further (n_slots, F) uint32 input of
     per-(node, feature) candidate draws (ExtraTrees; the drawn bin replaces
-    the per-feature argmin)."""
+    the per-feature argmin). ``monotonic=True`` adds three trailing inputs
+    — (F,) int32 internal constraint signs and (n_slots,) f32 lower/upper
+    node bounds (sklearn ``monotonic_cst``; ops/impurity.py)."""
 
     def local_step(xb, y, nid, w, cand_mask, chunk_lo, mcw, *nm):
+        nm = list(nm)
+        mono = {}
+        if monotonic:  # trailing operands: ..., cst, lo, hi
+            hi = nm.pop()
+            lo = nm.pop()
+            mono = {"mono_cst": nm.pop(), "mono_lo": lo, "mono_hi": hi}
         nmask = nm[0] if nm else None
         draws = nm[1] if random_split else None
         if task == "classification":
@@ -163,7 +177,7 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
             h = lax.psum(h, DATA_AXIS)
             dec = imp_ops.best_split_classification(
                 h, cand_mask, criterion=criterion, node_mask=nmask,
-                min_child_weight=mcw, forced_draw=draws,
+                min_child_weight=mcw, forced_draw=draws, **mono,
             )
         else:
             if use_pallas:
@@ -182,7 +196,7 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
             h = lax.psum(h, DATA_AXIS)
             dec = imp_ops.best_split_regression(
                 h, cand_mask, node_mask=nmask, min_child_weight=mcw,
-                forced_draw=draws,
+                forced_draw=draws, **mono,
             )
             ymin, ymax = regression_y_range(
                 y, nid, w, chunk_lo, n_slots=n_slots
@@ -200,6 +214,8 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         in_specs = in_specs + (P(),)
     if random_split:
         in_specs = in_specs + (P(),)
+    if monotonic:
+        in_specs = in_specs + (P(), P(), P())
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,
