@@ -82,12 +82,15 @@ def _child_cost_mse(hist):
 
 
 def _native_splits(xb, y, nid, sample_weight, binned, cfg, *, frontier_lo,
-                   n_slots, n_classes, task, node_mask=None):
+                   n_slots, n_classes, task, node_mask=None, mono=None):
     """Call the C++ sweep (native/__init__.py); None -> use numpy fallback.
 
     ``node_mask`` (n_slots, F) bool routes per-node feature sampling through
     the kernel's per-slot candidate counts (masked features keep bin chains
-    for the occupancy stop but can never win).
+    for the occupancy stop but can never win). ``mono``: a
+    ``(cst_int32, BoundsStore)`` pair engaging the kernel's monotonic gate
+    for this frontier window; the result then carries winner
+    ``v_left``/``v_right`` for the child-bound propagation.
     """
     from mpitree_tpu import native
 
@@ -96,18 +99,26 @@ def _native_splits(xb, y, nid, sample_weight, binned, cfg, *, frontier_lo,
     else:
         n_cand = np.where(node_mask, binned.n_cand[None, :], 0)
         per_slot = True
+    mono_kw = {}
+    if mono is not None:
+        cst32, bounds = mono
+        bounds.ensure(frontier_lo + n_slots)
+        lo_w, hi_w = bounds.window(frontier_lo, n_slots, n_slots)
+        mono_kw = dict(
+            mono_cst=cst32.astype(np.int8), mono_lo=lo_w, mono_hi=hi_w
+        )
     if task == "classification":
         return native.best_splits_classification(
             xb, y, nid, sample_weight, n_bins=binned.n_bins,
             n_classes=n_classes, frontier_lo=frontier_lo, n_slots=n_slots,
             n_cand=n_cand, n_cand_per_slot=per_slot, criterion=cfg.criterion,
-            min_child_weight=cfg.min_child_weight,
+            min_child_weight=cfg.min_child_weight, **mono_kw,
         )
     return native.best_splits_regression(
         xb, np.asarray(y, np.float32), nid, sample_weight,
         n_bins=binned.n_bins, frontier_lo=frontier_lo, n_slots=n_slots,
         n_cand=n_cand, n_cand_per_slot=per_slot,
-        min_child_weight=cfg.min_child_weight,
+        min_child_weight=cfg.min_child_weight, **mono_kw,
     )
 
 
@@ -316,12 +327,18 @@ def build_tree_host(
         # numpy blocks below are the portable fallback.
         # splitter="random" stays on the numpy sweep: the C++ kernel has
         # no drawn-bin mode (the draw replaces its incremental argmin).
-        # Monotonic constraints likewise: the value gate lives in the
-        # numpy candidate mask below.
-        nat = None if (terminal or rand_split or mono) else _native_splits(
+        # Monotonic CLASSIFICATION runs the kernel's constraint gate
+        # (integer counts keep its f32 child values bit-identical to the
+        # device engines); monotonic REGRESSION stays on the numpy sweep,
+        # whose f32 cumsums mirror the device moment arithmetic op for op —
+        # the kernel's f64 accumulators cannot.
+        mono_native = mono and task == "classification"
+        skip_native = terminal or rand_split or (mono and not mono_native)
+        nat = None if skip_native else _native_splits(
             xb, y, nid, sample_weight, binned, cfg,
             frontier_lo=frontier_lo, n_slots=S, n_classes=C, task=task,
             node_mask=nmask,
+            mono=(cst32, bounds) if mono_native else None,
         )
         if nat is not None:
             counts, n, value, node_imp, feat_best, bin_best, stop = (
@@ -337,6 +354,14 @@ def build_tree_host(
                 slot, live, S, frontier_lo, depth,
             )
             thread_keys(ids, stop)
+            if mono_native and (~stop).any():
+                sel = np.flatnonzero(~stop)
+                split_ids = ids[~stop]
+                bounds.assign_children(
+                    split_ids, tree.left[split_ids], tree.right[split_ids],
+                    nat["v_left"][sel], nat["v_right"][sel],
+                    cst32[feat_best[sel]], tree.n,
+                )
             continue
 
         # Per-node statistics (and, unless terminal, full split histograms).

@@ -23,7 +23,11 @@ from sklearn.utils.validation import check_is_fitted
 from mpitree_tpu.core.builder import BuildConfig, build_tree, prefer_host_path
 from mpitree_tpu.core.host_builder import build_tree_host
 from mpitree_tpu.ops.binning import bin_dataset
-from mpitree_tpu.ops.predict import device_tree_arrays, predict_leaf_ids
+from mpitree_tpu.ops.predict import (
+    device_tree_arrays,
+    predict_leaf_ids,
+    predict_mesh,
+)
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.utils.elastic import device_failover
 from mpitree_tpu.utils.export import export_tree_text
@@ -193,9 +197,9 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
 
     def _leaf_ids(self, X: np.ndarray) -> np.ndarray:
         t = self.tree_
-        dev = device_tree_arrays(t)
-        ids = predict_leaf_ids(jax.device_put(X), dev, t.max_depth)
-        return np.asarray(ids)
+        return np.asarray(predict_leaf_ids(
+            X, device_tree_arrays(t), t.max_depth, predict_mesh(self)
+        ))
 
     def decision_path(self, X):
         """sklearn's ``decision_path``: CSR indicator of the nodes each

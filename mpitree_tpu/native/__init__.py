@@ -97,14 +97,18 @@ def lib():
                 ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
                 ctypes.c_int32, ctypes.c_int32, _i32p, ctypes.c_int32,
                 ctypes.c_int32, ctypes.c_double,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 _i32p, _i32p, _f64p, _f64p, _u8p,
+                ctypes.c_void_p, ctypes.c_void_p,
             ]
             cdll.best_splits_classification.restype = None
             cdll.best_splits_regression.argtypes = [
                 _i32p, _f32p, _i32p, ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
                 ctypes.c_int32, _i32p, ctypes.c_int32, ctypes.c_double,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 _i32p, _i32p, _f64p, _f64p, _u8p, _f64p, _f64p,
+                ctypes.c_void_p, ctypes.c_void_p,
             ]
             cdll.best_splits_regression.restype = None
             _LIB.append(cdll)
@@ -119,15 +123,40 @@ def _wptr(w: np.ndarray | None):
     return w.ctypes.data_as(ctypes.c_void_p)
 
 
+def _mono_args(mono_cst, mono_lo, mono_hi, n_slots):
+    """(cst_ptr, lo_ptr, hi_ptr, out_vl, out_vr, keepalive) for the kernel.
+
+    ``mono_cst=None`` passes nulls (unconstrained — the fast path is
+    untouched); otherwise the per-slot f32 bounds windows and the winner
+    child-value outputs ride along (utils/monotonic.py semantics).
+    """
+    if mono_cst is None:
+        return None, None, None, None, None, ()
+    cst8 = np.ascontiguousarray(mono_cst, np.int8)
+    lo32 = np.ascontiguousarray(mono_lo, np.float32)
+    hi32 = np.ascontiguousarray(mono_hi, np.float32)
+    out_vl = np.zeros(n_slots, np.float32)
+    out_vr = np.zeros(n_slots, np.float32)
+    return (
+        cst8.ctypes.data_as(ctypes.c_void_p),
+        lo32.ctypes.data_as(ctypes.c_void_p),
+        hi32.ctypes.data_as(ctypes.c_void_p),
+        out_vl, out_vr, (cst8, lo32, hi32),
+    )
+
+
 def best_splits_classification(
     xb, y, node_id, w, *, n_bins, n_classes, frontier_lo, n_slots, n_cand,
     criterion, n_cand_per_slot=False, min_child_weight=0.0,
+    mono_cst=None, mono_lo=None, mono_hi=None,
 ):
     """ctypes wrapper; returns dict of per-slot arrays (or None if no lib).
 
     ``n_cand_per_slot=True`` marks ``n_cand`` as (n_slots, n_feat) — one
     candidate count per frontier node, for multi-root frontiers where every
     node carries its own exact local binning (core/hybrid_builder.py).
+    ``mono_cst``/``mono_lo``/``mono_hi`` engage the kernel's monotonic
+    gate; the result then carries ``v_left``/``v_right`` winner values.
     """
     cdll = lib()
     if cdll is None:
@@ -140,21 +169,31 @@ def best_splits_classification(
     out_constant = np.empty(n_slots, np.uint8)
     w64 = None if w is None else np.ascontiguousarray(w, np.float64)
     n_cand = np.ascontiguousarray(n_cand, np.int32)
+    cst_p, lo_p, hi_p, out_vl, out_vr, _keep = _mono_args(
+        mono_cst, mono_lo, mono_hi, n_slots
+    )
     cdll.best_splits_classification(
         xb, y, node_id, _wptr(w64), n_rows, n_feat, n_bins, n_classes,
         frontier_lo, n_slots, n_cand, 1 if n_cand_per_slot else 0,
         0 if criterion == "entropy" else 1, float(min_child_weight),
+        cst_p, lo_p, hi_p,
         out_feat, out_bin, out_cost, out_counts, out_constant,
+        _wptr(out_vl), _wptr(out_vr),
     )
-    return {
+    out = {
         "feature": out_feat, "bin": out_bin, "cost": out_cost,
         "counts": out_counts, "constant": out_constant.astype(bool),
     }
+    if out_vl is not None:
+        out["v_left"] = out_vl
+        out["v_right"] = out_vr
+    return out
 
 
 def best_splits_regression(
     xb, yv, node_id, w, *, n_bins, frontier_lo, n_slots, n_cand,
     n_cand_per_slot=False, min_child_weight=0.0,
+    mono_cst=None, mono_lo=None, mono_hi=None,
 ):
     cdll = lib()
     if cdll is None:
@@ -169,15 +208,24 @@ def best_splits_regression(
     out_ymax = np.empty(n_slots, np.float64)
     w64 = None if w is None else np.ascontiguousarray(w, np.float64)
     n_cand = np.ascontiguousarray(n_cand, np.int32)
+    cst_p, lo_p, hi_p, out_vl, out_vr, _keep = _mono_args(
+        mono_cst, mono_lo, mono_hi, n_slots
+    )
     cdll.best_splits_regression(
         xb, np.ascontiguousarray(yv, np.float32), node_id, _wptr(w64),
         n_rows, n_feat, n_bins, frontier_lo, n_slots, n_cand,
         1 if n_cand_per_slot else 0, float(min_child_weight),
+        cst_p, lo_p, hi_p,
         out_feat, out_bin, out_cost, out_counts, out_constant,
         out_ymin, out_ymax,
+        _wptr(out_vl), _wptr(out_vr),
     )
-    return {
+    out = {
         "feature": out_feat, "bin": out_bin, "cost": out_cost,
         "counts": out_counts, "constant": out_constant.astype(bool),
         "ymin": out_ymin, "ymax": out_ymax,
     }
+    if out_vl is not None:
+        out["v_left"] = out_vl
+        out["v_right"] = out_vr
+    return out

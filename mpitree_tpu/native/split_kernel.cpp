@@ -262,20 +262,32 @@ extern "C" {
 //   w        : (n_rows,) double sample weights (may be null -> all 1)
 //   n_cand   : valid candidate count per feature — shape (n_feat,) when
 //              n_cand_per_slot == 0, else (n_slots, n_feat) row-major
+//   mono_cst : (n_feat,) int8 INTERNAL monotonicity signs (nullable):
+//              a candidate on a signed feature is valid only when
+//              (v_l - v_r)*sign <= 0 and both child class-0 fractions lie
+//              in the slot's [mono_lo, mono_hi] (n_slots float32) bounds.
+//              Child values are computed as f32(mass) * f32(1/n) —
+//              reciprocal-multiply, matching the device engines bit for
+//              bit on integer counts (utils/monotonic.py).
 // Outputs (caller-allocated):
 //   out_feat : (n_slots,) int32 best feature (-1 if no valid candidate)
 //   out_bin  : (n_slots,) int32 best bin
 //   out_cost : (n_slots,) double best cost (+inf if none)
 //   out_counts: (n_slots, n_classes) double class counts
 //   out_constant: (n_slots,) uint8 "all features single-bin" flag
+//   out_vl/out_vr: (n_slots,) float32 winning candidate's child values
+//              (only written when mono_cst is non-null; may be null
+//              otherwise)
 // criterion: 0 = entropy, 1 = gini.
 void best_splits_classification(
     const int32_t* xb, const int32_t* y, const int32_t* node_id,
     const double* w, int64_t n_rows, int32_t n_feat, int32_t n_bins,
     int32_t n_classes, int32_t frontier_lo, int32_t n_slots,
     const int32_t* n_cand, int32_t n_cand_per_slot, int32_t criterion,
-    double min_child_w, int32_t* out_feat, int32_t* out_bin, double* out_cost,
-    double* out_counts, uint8_t* out_constant) {
+    double min_child_w, const int8_t* mono_cst, const float* mono_lo,
+    const float* mono_hi, int32_t* out_feat, int32_t* out_bin,
+    double* out_cost, double* out_counts, uint8_t* out_constant,
+    float* out_vl, float* out_vr) {
   const double inf = std::numeric_limits<double>::infinity();
 
   std::vector<int64_t> slot_start;
@@ -465,6 +477,20 @@ void best_splits_classification(
           const double right_n = n_tot - left_n;
           if (left_n <= 0.0 || right_n <= 0.0) continue;
           if (left_n < min_child_w || right_n < min_child_w) continue;
+          // Monotonic gate in the device's exact f32 reciprocal-multiply
+          // form (ops/impurity._monotonic_ok; utils/monotonic.py).
+          float vl_f = 0.0f, vr_f = 0.0f;
+          if (mono_cst && mono_cst[f] != 0) {
+            vl_f = (float)left_cls[0] *
+                   (1.0f / std::max((float)left_n, 1.0f));
+            vr_f = (float)(node_cls[0] - left_cls[0]) *
+                   (1.0f / std::max((float)right_n, 1.0f));
+            const float sgn = (float)mono_cst[f];
+            if ((vl_f - vr_f) * sgn > 0.0f) continue;
+            if (vl_f < mono_lo[s] || vl_f > mono_hi[s] ||
+                vr_f < mono_lo[s] || vr_f > mono_hi[s])
+              continue;
+          }
           double cost;
           if (mode == 1) {
             const double gl = left_n - left_sum / left_n;
@@ -483,6 +509,10 @@ void best_splits_classification(
             out_cost[s] = cost;
             out_feat[s] = f;
             out_bin[s] = b;
+            if (mono_cst) {
+              out_vl[s] = vl_f;
+              out_vr[s] = vr_f;
+            }
           }
         }
       }
@@ -499,9 +529,11 @@ void best_splits_regression(
     const int32_t* xb, const float* yv, const int32_t* node_id,
     const double* w, int64_t n_rows, int32_t n_feat, int32_t n_bins,
     int32_t frontier_lo, int32_t n_slots, const int32_t* n_cand,
-    int32_t n_cand_per_slot, double min_child_w, int32_t* out_feat,
+    int32_t n_cand_per_slot, double min_child_w, const int8_t* mono_cst,
+    const float* mono_lo, const float* mono_hi, int32_t* out_feat,
     int32_t* out_bin, double* out_cost, double* out_counts,
-    uint8_t* out_constant, double* out_ymin, double* out_ymax) {
+    uint8_t* out_constant, double* out_ymin, double* out_ymax,
+    float* out_vl, float* out_vr) {
   const double inf = std::numeric_limits<double>::infinity();
 
   std::vector<int64_t> slot_start;
@@ -581,6 +613,24 @@ void best_splits_regression(
           const double wr_ = n_tot - wl, sr = s_tot - sl, qr = q_tot - ql;
           if (wl <= 0.0 || wr_ <= 0.0) continue;
           if (wl < min_child_w || wr_ < min_child_w) continue;
+          // Monotonic gate — ABI symmetry with the classification kernel.
+          // CAVEAT: these child means come from f64 accumulators cast to
+          // f32, which is NOT bit-matched to the device engines' f32
+          // cumsum arithmetic; host_builder.py therefore routes
+          // constrained REGRESSION to its numpy sweep (which mirrors the
+          // device op for op) and never passes mono_cst here. A caller
+          // wiring this path accepts engine-identity drift on near-tied
+          // child means.
+          float vl_f = 0.0f, vr_f = 0.0f;
+          if (mono_cst && mono_cst[f] != 0) {
+            vl_f = (float)sl * (1.0f / std::max((float)wl, 1.0f));
+            vr_f = (float)sr * (1.0f / std::max((float)wr_, 1.0f));
+            const float sgn = (float)mono_cst[f];
+            if ((vl_f - vr_f) * sgn > 0.0f) continue;
+            if (vl_f < mono_lo[s] || vl_f > mono_hi[s] ||
+                vr_f < mono_lo[s] || vr_f > mono_hi[s])
+              continue;
+          }
           const double sse_l = ql - sl * sl / wl;
           const double sse_r = qr - sr * sr / wr_;
           const double cost =
@@ -589,6 +639,10 @@ void best_splits_regression(
             out_cost[s] = cost;
             out_feat[s] = f;
             out_bin[s] = b;
+            if (mono_cst) {
+              out_vl[s] = vl_f;
+              out_vr[s] = vr_f;
+            }
           }
         }
       }

@@ -196,3 +196,36 @@ def test_extratrees_regressor_monotone():
     ).fit(X, y)
     for anchor in (3, 7):
         _assert_monotone(f.predict(_sweep(X, 2, anchor)), -1)
+
+
+def test_native_and_numpy_constrained_sweeps_agree():
+    """The C++ kernel's monotonic gate (f32 reciprocal-multiply child
+    values) must grow the same constrained classification tree as the
+    numpy sweep — the same twin contract the unconstrained engines keep."""
+    from mpitree_tpu import native
+    from mpitree_tpu.core.builder import BuildConfig
+    from mpitree_tpu.core.host_builder import build_tree_host
+    from mpitree_tpu.ops.binning import bin_dataset
+
+    if native.lib() is None:
+        pytest.skip("no C++ toolchain")
+    rng = np.random.default_rng(11)
+    X = rng.integers(0, 6, size=(300, 4)).astype(np.float32)
+    X[:6] = np.arange(6, dtype=np.float32)[:, None]
+    y = (X[:, 0] + rng.normal(scale=1.5, size=300) > 2.5).astype(np.int32)
+    cst = np.array([-1, 0, 1, 0], np.int8)  # internal signs
+    binned = bin_dataset(X, binning="exact")
+    cfg = BuildConfig(task="classification", criterion="entropy", max_depth=6)
+    nat = build_tree_host(
+        binned, y, config=cfg, n_classes=2, mono_cst=cst
+    )
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(native, "lib", lambda: None)
+        fallback = build_tree_host(
+            binned, y, config=cfg, n_classes=2, mono_cst=cst
+        )
+    np.testing.assert_array_equal(nat.feature, fallback.feature)
+    np.testing.assert_array_equal(nat.left, fallback.left)
+    np.testing.assert_allclose(nat.threshold, fallback.threshold,
+                               equal_nan=True)
+    np.testing.assert_array_equal(nat.count, fallback.count)
