@@ -252,10 +252,12 @@ def build_tree_host(
 
     ``feature_sampler``: per-node random feature subsets (ops/sampling.py) —
     identical node keys and masks to the device levelwise build.
-    ``mono_cst``: (F,) INTERNAL monotonicity signs (utils/monotonic.py) —
-    runs on the numpy sweep (the C++ kernel has no constraint mode); the
-    value gate uses the same f32 reciprocal-multiply arithmetic as the
-    device engines, so integer-weight fits stay engine-identical.
+    ``mono_cst``: (F,) INTERNAL monotonicity signs (utils/monotonic.py).
+    Integer-weight classification runs the C++ kernel's constraint gate
+    (integer counts make its f32 child values bit-identical to the numpy
+    and device engines); fractional-weight classification and all
+    regression stay on the numpy sweep, whose f32 arithmetic mirrors the
+    device op for op where the kernel's f64 accumulation order cannot.
     """
     from mpitree_tpu.core.builder import _TreeBuffer  # shared node store
 
@@ -327,12 +329,14 @@ def build_tree_host(
         # numpy blocks below are the portable fallback.
         # splitter="random" stays on the numpy sweep: the C++ kernel has
         # no drawn-bin mode (the draw replaces its incremental argmin).
-        # Monotonic CLASSIFICATION runs the kernel's constraint gate
-        # (integer counts keep its f32 child values bit-identical to the
-        # device engines); monotonic REGRESSION stays on the numpy sweep,
-        # whose f32 cumsums mirror the device moment arithmetic op for op —
-        # the kernel's f64 accumulators cannot.
-        mono_native = mono and task == "classification"
+        # Monotonic INTEGER-WEIGHT classification runs the kernel's
+        # constraint gate (integer counts keep its f32 child values
+        # bit-identical to the device engines); fractional weights (e.g.
+        # class_weight="balanced") and all regression stay on the numpy
+        # sweep, whose f32 cumsums mirror the device arithmetic op for op
+        # — the kernel's f64 accumulation order cannot, and the gate is a
+        # hard binary (no tie tolerance absorbs a 1-ULP value flip).
+        mono_native = mono and task == "classification" and not fractional_w
         skip_native = terminal or rand_split or (mono and not mono_native)
         nat = None if skip_native else _native_splits(
             xb, y, nid, sample_weight, binned, cfg,

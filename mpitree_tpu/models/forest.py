@@ -474,14 +474,26 @@ class _BaseForest(BaseEstimator):
         )
         T, M = feat.shape
         group = max(1, min(T, self._PREDICT_GROUP_BYTES // max(16 * M, 1)))
-        X_d = jax.device_put(X)
-        ids = np.empty((T, X.shape[0]), np.int32)
+        n = X.shape[0]
+        from mpitree_tpu.ops.predict import predict_mesh, shard_rows
+
+        mesh = predict_mesh(self)
+        if mesh is not None:
+            # Distributed inference: query rows shard over the mesh's data
+            # axis, the stacked tree arrays replicate, and the vmapped
+            # descent partitions across chips (GSPMD propagates the input
+            # sharding) — single-tree estimators do the same, the
+            # reference's ranks each predicted the full set redundantly.
+            X_d, n = shard_rows(X, mesh)
+        else:
+            X_d = jax.device_put(X)
+        ids = np.empty((T, n), np.int32)
         for g0 in range(0, T, group):
             sl = slice(g0, min(g0 + group, T))
             parts = tuple(jax.device_put(a[sl]) for a in (feat, thr, left, right))
             ids[sl] = np.asarray(jax.vmap(
                 lambda f, th, l, r: predict_leaf_ids(X_d, (f, th, l, r), depth)
-            )(*parts))
+            )(*parts))[:, :n]
         for i, t in enumerate(self.trees_):
             yield t, ids[i]
 

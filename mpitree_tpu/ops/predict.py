@@ -121,19 +121,7 @@ def predict_leaf_ids(X, tree_dev, n_steps: int, mesh=None) -> jax.Array:
     """
     feature, threshold, left, right = tree_dev
     if mesh is not None and mesh.size > 1:
-        import numpy as np
-        from jax.sharding import NamedSharding
-        from jax.sharding import PartitionSpec as P
-
-        from mpitree_tpu.parallel.mesh import DATA_AXIS
-
-        Xh = np.asarray(X)
-        n = Xh.shape[0]
-        shards = int(dict(mesh.shape).get(DATA_AXIS, 1))
-        pad = (-n) % max(shards, 1)
-        if pad:
-            Xh = np.concatenate([Xh, np.broadcast_to(Xh[-1:], (pad,) + Xh.shape[1:])])
-        Xd = jax.device_put(Xh, NamedSharding(mesh, P(DATA_AXIS)))
+        Xd, n = shard_rows(X, mesh)
         ids = descend(
             Xd, feature, threshold, left, right, n_steps=max(n_steps, 1)
         )
@@ -141,3 +129,27 @@ def predict_leaf_ids(X, tree_dev, n_steps: int, mesh=None) -> jax.Array:
     if not isinstance(X, jax.Array):
         X = jax.device_put(X)
     return descend(X, feature, threshold, left, right, n_steps=max(n_steps, 1))
+
+
+def shard_rows(X, mesh):
+    """(X sharded over the mesh's data axis, original row count).
+
+    Rows pad to the shard grid by repeating the last row (the caller trims
+    results back to ``n``). The one copy of the pad-and-place recipe —
+    single-tree inference and the forests' stacked descent both use it.
+    """
+    import numpy as np
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from mpitree_tpu.parallel.mesh import DATA_AXIS
+
+    Xh = np.asarray(X)
+    n = Xh.shape[0]
+    shards = int(dict(mesh.shape).get(DATA_AXIS, 1))
+    pad = (-n) % max(shards, 1)
+    if pad:
+        Xh = np.concatenate(
+            [Xh, np.broadcast_to(Xh[-1:], (pad,) + Xh.shape[1:])]
+        )
+    return jax.device_put(Xh, NamedSharding(mesh, P(DATA_AXIS))), n

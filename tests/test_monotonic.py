@@ -229,3 +229,17 @@ def test_native_and_numpy_constrained_sweeps_agree():
     np.testing.assert_allclose(nat.threshold, fallback.threshold,
                                equal_nan=True)
     np.testing.assert_array_equal(nat.count, fallback.count)
+
+
+def test_fractional_weights_route_to_numpy_and_stay_monotone():
+    """class_weight makes weights fractional: constrained classification
+    must take the numpy sweep (the kernel's f64 accumulation order cannot
+    match the device f32 values bit for bit, and the gate has no tie
+    tolerance) and still satisfy the property."""
+    X, y = _clf_data(seed=13)
+    clf = DecisionTreeClassifier(
+        max_depth=7, monotonic_cst=[1, 0, 0, 0], backend="host",
+        class_weight="balanced",
+    ).fit(X, y)
+    for anchor in (3, 11):
+        _assert_monotone(clf.predict(_sweep(X, 0, anchor)), 1)

@@ -104,3 +104,25 @@ def test_predict_sharded_regressor_matches():
     par = DecisionTreeRegressor(max_depth=5, n_devices=8).fit(X, y)
     single = DecisionTreeRegressor(max_depth=5).fit(X, y)
     np.testing.assert_array_equal(par.predict(X), single.predict(X))
+
+
+def test_forest_predict_sharded_matches_single():
+    """Forests predict with query rows sharded over the mesh too; the
+    vmapped stacked descent must match single-device inference exactly
+    (uneven rows exercise the pad-and-trim path)."""
+    from mpitree_tpu import RandomForestClassifier
+
+    rng = np.random.default_rng(9)
+    X = rng.normal(size=(203, 5))
+    y = rng.integers(0, 2, size=203)
+    par = RandomForestClassifier(
+        n_estimators=5, max_depth=5, random_state=0, n_devices=8
+    ).fit(X, y)
+    single = RandomForestClassifier(
+        n_estimators=5, max_depth=5, random_state=0, n_devices=1
+    ).fit(X, y)
+    Xq = rng.normal(size=(157, 5))
+    np.testing.assert_array_equal(par.predict(Xq), single.predict(Xq))
+    np.testing.assert_allclose(
+        par.predict_proba(Xq), single.predict_proba(Xq), rtol=0, atol=0
+    )
