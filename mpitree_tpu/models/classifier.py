@@ -42,8 +42,10 @@ from mpitree_tpu.utils.importances import feature_importances
 from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
 from mpitree_tpu.utils.validation import (
     apply_class_weight,
+    feature_names_of,
     min_child_weight,
     min_decrease_scaled,
+    record_sklearn_attributes,
     validate_fit_data,
     validate_predict_data,
     resolve_refine,
@@ -162,10 +164,14 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
 
     # -- fitting -----------------------------------------------------------
     def fit(self, X, y, sample_weight=None):
+        names = feature_names_of(X)
         X, y_enc, classes = validate_fit_data(X, y, task="classification")
         self.n_features_ = X.shape[1]
         self.n_features_in_ = X.shape[1]
         self.classes_ = classes
+        record_sklearn_attributes(
+            self, names, X.shape[1], n_classes=len(classes)
+        )
 
         from mpitree_tpu.utils.monotonic import validate_monotonic_cst
 

@@ -48,8 +48,10 @@ from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.utils.elastic import ForestCheckpoint, device_failover
 from mpitree_tpu.utils.validation import (
     apply_class_weight,
+    feature_names_of,
     min_child_weight,
     min_decrease_scaled,
+    record_sklearn_attributes,
     resolve_refine,
     validate_fit_data,
     validate_predict_data,
@@ -555,10 +557,14 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
         self.class_weight = class_weight
 
     def fit(self, X, y, sample_weight=None):
+        names = feature_names_of(X)
         X, y_enc, classes = validate_fit_data(X, y, task="classification")
         self.n_features_ = X.shape[1]
         self.n_features_in_ = X.shape[1]
         self.classes_ = classes
+        record_sklearn_attributes(
+            self, names, X.shape[1], n_classes=len(classes)
+        )
         sample_weight = apply_class_weight(
             self.class_weight, y_enc, classes,
             validate_sample_weight(sample_weight, X.shape[0]),
@@ -667,8 +673,10 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
         )
 
     def fit(self, X, y, sample_weight=None):
+        names = feature_names_of(X)
         X, y64, _ = validate_fit_data(X, y, task="regression")
         self.n_features_ = X.shape[1]
+        record_sklearn_attributes(self, names, X.shape[1])
         self.n_features_in_ = X.shape[1]
         self._y_mean = float(y64.mean()) if len(y64) else 0.0
         self.trees_ = _TreeList(self._fit_forest(

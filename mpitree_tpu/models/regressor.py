@@ -34,8 +34,10 @@ from mpitree_tpu.utils.export import export_tree_text
 from mpitree_tpu.utils.importances import feature_importances
 from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
 from mpitree_tpu.utils.validation import (
+    feature_names_of,
     min_child_weight,
     min_decrease_scaled,
+    record_sklearn_attributes,
     validate_fit_data,
     validate_predict_data,
     resolve_refine,
@@ -80,9 +82,11 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
     def fit(self, X, y, sample_weight=None):
         if self.criterion not in ("squared_error", "mse"):
             raise ValueError(f"unknown regression criterion: {self.criterion!r}")
+        names = feature_names_of(X)
         X, y64, _ = validate_fit_data(X, y, task="regression")
         self.n_features_ = X.shape[1]
         self.n_features_in_ = X.shape[1]
+        record_sklearn_attributes(self, names, X.shape[1])
 
         y_mean = float(y64.mean()) if len(y64) else 0.0
         self._y_mean = y_mean

@@ -22,6 +22,25 @@ from sklearn.utils.multiclass import check_classification_targets
 from sklearn.utils.validation import check_array, check_X_y
 
 
+def feature_names_of(X):
+    """sklearn's ``feature_names_in_`` source: DataFrame column names
+    (object dtype, sklearn's storage), or None for plain arrays. Mixed
+    string/non-string columns raise, as sklearn's validation does."""
+    cols = getattr(X, "columns", None)
+    if cols is None:
+        return None
+    names = np.asarray(cols, dtype=object)
+    str_mask = [isinstance(c, str) for c in names]
+    if all(str_mask):
+        return names
+    if any(str_mask):
+        raise TypeError(
+            "Feature names are only supported if all input features have "
+            "string names, but your input has mixed types."
+        )
+    return None
+
+
 def validate_fit_data(X, y, *, task: str = "classification"):
     """Returns (X float32 (N,F), y_encoded, classes_ or None)."""
     X, y = check_X_y(X, y, dtype="numeric", y_numeric=(task == "regression"))
@@ -34,6 +53,27 @@ def validate_fit_data(X, y, *, task: str = "classification"):
     # f64 (shift invariance) and casts to f32 only for the device moment
     # histograms; leaf values are refit exactly in f64 afterwards.
     return X, np.ascontiguousarray(y, dtype=np.float64), None
+
+
+def record_sklearn_attributes(est, names, n_features, *,
+                              n_classes=None) -> None:
+    """The sklearn fitted-attribute surface every estimator exposes.
+
+    ``feature_names_in_`` (DataFrame fits only, deleted otherwise — the
+    sklearn convention), ``n_outputs_`` (always 1 here), ``n_classes_``
+    (classifiers), and ``max_features_`` (the estimator's ``max_features``
+    grammar resolved to a count).
+    """
+    if names is not None:
+        est.feature_names_in_ = names
+    elif hasattr(est, "feature_names_in_"):
+        del est.feature_names_in_
+    est.n_outputs_ = 1
+    if n_classes is not None:
+        est.n_classes_ = n_classes
+    from mpitree_tpu.ops.sampling import n_subspace_features
+
+    est.max_features_ = n_subspace_features(est.max_features, n_features)
 
 
 def validate_sample_weight(sample_weight, n_samples: int):

@@ -70,3 +70,33 @@ def test_regressor_score_is_r2():
     from sklearn.metrics import r2_score
 
     assert r.score(X, y) == pytest.approx(r2_score(y, r.predict(X)))
+
+
+def test_fitted_attribute_surface():
+    """sklearn's fitted attributes: n_classes_, n_outputs_, max_features_,
+    and feature_names_in_ (DataFrame fits only, deleted on array refits —
+    the sklearn convention)."""
+    import pandas as pd
+
+    from mpitree_tpu import DecisionTreeClassifier, RandomForestRegressor
+
+    rng = np.random.default_rng(0)
+    Xdf = pd.DataFrame(
+        rng.normal(size=(80, 3)), columns=["alpha", "beta", "gamma"]
+    )
+    y = (Xdf["alpha"] > 0).astype(int).values
+    clf = DecisionTreeClassifier(max_depth=3, max_features="sqrt").fit(Xdf, y)
+    assert clf.feature_names_in_.tolist() == ["alpha", "beta", "gamma"]
+    assert clf.n_classes_ == 2
+    assert clf.n_outputs_ == 1
+    assert clf.max_features_ == 1  # sqrt(3) -> 1
+    # refit on a plain array deletes the names, as sklearn does
+    clf.fit(Xdf.values, y)
+    assert not hasattr(clf, "feature_names_in_")
+    assert clf.max_features_ == 1
+
+    f = RandomForestRegressor(
+        n_estimators=3, max_depth=3, random_state=0
+    ).fit(Xdf, Xdf["beta"].values)
+    assert f.feature_names_in_.tolist() == ["alpha", "beta", "gamma"]
+    assert f.max_features_ == 3 and f.n_outputs_ == 1
