@@ -296,14 +296,14 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         """Raw per-class leaf counts — the reference's quirk
         (``decision_tree.py:192-227`` returns occurrences, not probabilities)."""
         check_is_fitted(self)
-        X = validate_predict_data(X, self.n_features_, type(self).__name__)
+        X = validate_predict_data(X, self)
         return self.tree_.count[self._leaf_ids(X)]
 
     def decision_path(self, X):
         """sklearn's ``decision_path``: CSR indicator of the nodes each
         sample traverses (``utils/export.py``)."""
         check_is_fitted(self)
-        X = validate_predict_data(X, self.n_features_, type(self).__name__)
+        X = validate_predict_data(X, self)
         from mpitree_tpu.utils.export import tree_decision_path
 
         return tree_decision_path(self.tree_, self._leaf_ids(X))
@@ -314,12 +314,12 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         reference walks a Python recursion per row,
         ``decision_tree.py:208-225``)."""
         check_is_fitted(self)
-        X = validate_predict_data(X, self.n_features_, type(self).__name__)
+        X = validate_predict_data(X, self)
         return self._leaf_ids(X).astype(np.int64)
 
     def predict(self, X):
         check_is_fitted(self)
-        X = validate_predict_data(X, self.n_features_, type(self).__name__)
+        X = validate_predict_data(X, self)
         if getattr(self, "monotonic_cst", None) is not None:
             # Constrained fits predict from the bound-CLIPPED leaf labels
             # (clip_tree_values wrote them into tree_.value) — the raw-count

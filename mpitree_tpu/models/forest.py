@@ -610,7 +610,7 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
         trees' clipped stored values), which is what makes the averaged
         ``predict_proba`` monotone."""
         check_is_fitted(self)
-        X = validate_predict_data(X, self.n_features_, type(self).__name__)
+        X = validate_predict_data(X, self)
         from mpitree_tpu.utils.monotonic import (
             clipped_class0,
             validate_monotonic_cst,
@@ -705,7 +705,7 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
 
     def predict(self, X):
         check_is_fitted(self)
-        X = validate_predict_data(X, self.n_features_, type(self).__name__)
+        X = validate_predict_data(X, self)
         acc = np.zeros(X.shape[0])
         for t, ids in self._leaf_ids(X):
             acc += t.count[ids, 0]

@@ -209,7 +209,7 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
         """sklearn's ``decision_path``: CSR indicator of the nodes each
         sample traverses (``utils/export.py``)."""
         check_is_fitted(self)
-        X = validate_predict_data(X, self.n_features_, type(self).__name__)
+        X = validate_predict_data(X, self)
         from mpitree_tpu.utils.export import tree_decision_path
 
         return tree_decision_path(self.tree_, self._leaf_ids(X))
@@ -220,12 +220,12 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
         reference walks a Python recursion per row,
         ``decision_tree.py:208-225``)."""
         check_is_fitted(self)
-        X = validate_predict_data(X, self.n_features_, type(self).__name__)
+        X = validate_predict_data(X, self)
         return self._leaf_ids(X).astype(np.int64)
 
     def predict(self, X):
         check_is_fitted(self)
-        X = validate_predict_data(X, self.n_features_, type(self).__name__)
+        X = validate_predict_data(X, self)
         # count[:, 0] holds the exact f64 node means from the refit pass.
         return self.tree_.count[self._leaf_ids(X), 0]
 

@@ -181,7 +181,45 @@ def apply_class_weight(class_weight, y_enc, classes, sample_weight):
     return cw if sample_weight is None else cw * sample_weight
 
 
-def validate_predict_data(X, n_features: int, name: str = "estimator"):
+def validate_predict_data(X, estimator):
+    """Width + feature-name consistency checks, sklearn's wording.
+
+    Takes the fitted estimator so every predict-time entrypoint gets the
+    same checks from one call — ``n_features_``, the class name for
+    messages, and ``feature_names_in_`` all come off it. Name handling
+    follows sklearn: both sides named and different -> ValueError; named
+    on one side only -> UserWarning; mixed-type columns -> TypeError
+    (raised by :func:`feature_names_of`, same as the fit path).
+    """
+    import warnings
+
+    n_features = estimator.n_features_
+    name = type(estimator).__name__
+    fitted_names = getattr(estimator, "feature_names_in_", None)
+    pred_names = feature_names_of(X)
+    if fitted_names is not None and pred_names is not None:
+        if list(pred_names) != list(fitted_names):
+            raise ValueError(
+                "The feature names should match those that were passed "
+                "during fit.\n"
+                f"Feature names seen at fit time: {list(fitted_names)}\n"
+                f"Feature names seen now: {list(pred_names)}"
+            )
+    elif fitted_names is not None and pred_names is None:
+        # stacklevel 2 points at the estimator method uniformly (direct
+        # predict and forest predict->predict_proba differ in user-frame
+        # depth, so no constant reaches the user's line in both).
+        warnings.warn(
+            f"X does not have valid feature names, but {name} was fitted "
+            "with feature names",
+            stacklevel=2,
+        )
+    elif fitted_names is None and pred_names is not None:
+        warnings.warn(
+            f"X has feature names, but {name} was fitted without feature "
+            "names",
+            stacklevel=2,
+        )
     X = check_array(X, dtype="numeric")
     if X.shape[1] != n_features:
         # sklearn's canonical inconsistent-width message (its estimator

@@ -100,3 +100,28 @@ def test_fitted_attribute_surface():
     ).fit(Xdf, Xdf["beta"].values)
     assert f.feature_names_in_.tolist() == ["alpha", "beta", "gamma"]
     assert f.max_features_ == 3 and f.n_outputs_ == 1
+
+
+def test_predict_feature_name_checks():
+    """sklearn's predict-time name consistency: reordered names raise,
+    one-sided names warn."""
+    import warnings
+
+    import pandas as pd
+
+    from mpitree_tpu import DecisionTreeClassifier
+
+    rng = np.random.default_rng(1)
+    X = pd.DataFrame(rng.normal(size=(60, 3)), columns=["a", "b", "c"])
+    y = (X["a"] > 0).astype(int).values
+    clf = DecisionTreeClassifier(max_depth=3).fit(X, y)
+    with pytest.raises(ValueError, match="should match"):
+        clf.predict(X[["b", "a", "c"]])
+    with pytest.warns(UserWarning, match="does not have valid feature"):
+        clf.predict(X.values)
+    unnamed = DecisionTreeClassifier(max_depth=3).fit(X.values, y)
+    with pytest.warns(UserWarning, match="fitted without feature names"):
+        unnamed.predict(X)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        clf.predict(X)  # matching names: silent
