@@ -78,7 +78,7 @@ class _BaseForest(BaseEstimator):
                  random_state=None, n_devices=None,
                  backend=None, refine_depth="auto", checkpoint=None,
                  ccp_alpha=0.0, min_impurity_decrease=0.0,
-                 splitter="best", monotonic_cst=None):
+                 splitter="best", monotonic_cst=None, warm_start=False):
         self.n_estimators = n_estimators
         self.max_depth = max_depth
         self.min_samples_split = min_samples_split
@@ -102,6 +102,7 @@ class _BaseForest(BaseEstimator):
         self.min_impurity_decrease = min_impurity_decrease
         self.splitter = splitter
         self.monotonic_cst = monotonic_cst
+        self.warm_start = warm_start
 
     def _pop_oob_masks(self):
         """Consume the fit-time bootstrap OOB masks (they must not persist —
@@ -127,11 +128,58 @@ class _BaseForest(BaseEstimator):
         )
         return float("nan")
 
+    def _warm_start_trees(self):
+        """Previously fitted trees to keep, or None (sklearn warm_start).
+
+        Phase A below replays every per-tree RNG draw from the seed, so
+        kept trees stay paired with their bootstrap/OOB draws — the same
+        replay contract the checkpoint resume relies on, hence the same
+        integer-random_state requirement.
+        """
+        if getattr(self, "warm_start", False) and getattr(
+            self, "checkpoint", None
+        ):
+            # Rejected up front (even on the FIRST fit, before trees_
+            # exists): both define where a fit resumes from, and letting
+            # the first step succeed would fail the pipeline on step two.
+            raise ValueError(
+                "warm_start and checkpoint are mutually exclusive: both "
+                "define where a fit resumes from"
+            )
+        if not getattr(self, "warm_start", False) or not hasattr(
+            self, "trees_"
+        ):
+            return None
+        import numbers
+
+        if not isinstance(self.random_state, numbers.Integral):
+            raise ValueError(
+                "warm_start requires a fixed integer random_state so the "
+                "continued fit replays the prior trees' bootstrap/feature "
+                "draws before drawing new ones"
+            )
+        prev = list(self.trees_)
+        if self.n_estimators < len(prev):
+            raise ValueError(
+                f"n_estimators={self.n_estimators} must be larger or "
+                f"equal to len(trees_)={len(prev)} when warm_start==True"
+            )
+        if self.n_estimators == len(prev):
+            # stacklevel 4: user -> fit -> _fit_forest -> here (one frame
+            # deeper than _fit_forest's own checkpoint warning).
+            warnings.warn(
+                "Warm-start fitting without increasing n_estimators does "
+                "not fit new trees.",
+                stacklevel=4,
+            )
+        return prev
+
     def _fit_forest(self, X, y_enc, *, task, criterion, n_classes=None,
                     refit_targets=None, sample_weight=None):
         n = X.shape[0]
         if self.oob_score and not self.bootstrap:
             raise ValueError("oob_score=True requires bootstrap=True")
+        prev_trees = self._warm_start_trees()
         sample_weight = validate_sample_weight(sample_weight, n)
         rng = np.random.default_rng(self.random_state)
         binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
@@ -357,6 +405,9 @@ class _BaseForest(BaseEstimator):
         ck = None
         start = 0
         trees: list = []
+        if prev_trees is not None:
+            start = min(len(prev_trees), self.n_estimators)
+            trees = list(prev_trees[:start])
         if getattr(self, "checkpoint", None):
             import numbers
 
@@ -540,7 +591,7 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
                  n_devices=None, backend=None, refine_depth="auto",
                  checkpoint=None, ccp_alpha=0.0,
                  min_impurity_decrease=0.0, splitter="best",
-                 monotonic_cst=None):
+                 monotonic_cst=None, warm_start=False):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
@@ -552,6 +603,7 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
             refine_depth=refine_depth, checkpoint=checkpoint,
             ccp_alpha=ccp_alpha, min_impurity_decrease=min_impurity_decrease,
             splitter=splitter, monotonic_cst=monotonic_cst,
+            warm_start=warm_start,
         )
         self.criterion = criterion
         self.class_weight = class_weight
@@ -658,7 +710,7 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
                  n_devices=None, backend=None, refine_depth="auto",
                  checkpoint=None, ccp_alpha=0.0,
                  min_impurity_decrease=0.0, splitter="best",
-                 monotonic_cst=None):
+                 monotonic_cst=None, warm_start=False):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
@@ -670,6 +722,7 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
             refine_depth=refine_depth, checkpoint=checkpoint,
             ccp_alpha=ccp_alpha, min_impurity_decrease=min_impurity_decrease,
             splitter=splitter, monotonic_cst=monotonic_cst,
+            warm_start=warm_start,
         )
 
     def fit(self, X, y, sample_weight=None):
@@ -730,7 +783,8 @@ class ExtraTreesClassifier(RandomForestClassifier):
                  min_weight_fraction_leaf=0.0, min_samples_leaf=1,
                  random_state=None, n_devices=None, backend=None,
                  refine_depth="auto", checkpoint=None, ccp_alpha=0.0,
-                 min_impurity_decrease=0.0, monotonic_cst=None):
+                 min_impurity_decrease=0.0, monotonic_cst=None,
+                 warm_start=False):
         super().__init__(
             n_estimators=n_estimators, criterion=criterion,
             max_depth=max_depth, min_samples_split=min_samples_split,
@@ -743,6 +797,7 @@ class ExtraTreesClassifier(RandomForestClassifier):
             checkpoint=checkpoint, ccp_alpha=ccp_alpha,
             min_impurity_decrease=min_impurity_decrease,
             splitter="random", monotonic_cst=monotonic_cst,
+            warm_start=warm_start,
         )
 
 
@@ -756,7 +811,7 @@ class ExtraTreesRegressor(RandomForestRegressor):
                  min_samples_leaf=1, random_state=None, n_devices=None,
                  backend=None, refine_depth="auto", checkpoint=None,
                  ccp_alpha=0.0, min_impurity_decrease=0.0,
-                 monotonic_cst=None):
+                 monotonic_cst=None, warm_start=False):
         super().__init__(
             n_estimators=n_estimators, max_depth=max_depth,
             min_samples_split=min_samples_split, max_bins=max_bins,
@@ -768,4 +823,5 @@ class ExtraTreesRegressor(RandomForestRegressor):
             checkpoint=checkpoint, ccp_alpha=ccp_alpha,
             min_impurity_decrease=min_impurity_decrease,
             splitter="random", monotonic_cst=monotonic_cst,
+            warm_start=warm_start,
         )

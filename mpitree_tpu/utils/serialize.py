@@ -93,9 +93,14 @@ def save_model(estimator, path) -> None:
     }
     arrays: dict = {}
 
-    for attr in ("n_features_", "n_features_in_", "_y_mean"):
+    for attr in ("n_features_", "n_features_in_", "_y_mean", "n_classes_",
+                 "n_outputs_", "max_features_"):
         if hasattr(estimator, attr):
             header["attrs"][attr] = getattr(estimator, attr)
+    if hasattr(estimator, "feature_names_in_"):
+        header["attrs"]["feature_names_in_"] = [
+            str(c) for c in estimator.feature_names_in_
+        ]
 
     if hasattr(estimator, "classes_"):
         arrays["classes_"] = np.asarray(estimator.classes_)
@@ -130,9 +135,14 @@ def load_model(path):
             raise ValueError(f"unknown estimator class {header['class']!r}")
         cls = getattr(mpitree_tpu, header["class"])
         est = cls(**header["params"])
-        for attr in ("n_features_", "n_features_in_", "_y_mean"):
+        for attr in ("n_features_", "n_features_in_", "_y_mean", "n_classes_",
+                     "n_outputs_", "max_features_"):
             if attr in header["attrs"]:
                 setattr(est, attr, header["attrs"][attr])
+        if "feature_names_in_" in header["attrs"]:
+            est.feature_names_in_ = np.asarray(
+                header["attrs"]["feature_names_in_"], dtype=object
+            )
         if "classes_" in z.files:
             est.classes_ = z["classes_"]
         trees = [_read_tree(z, f"tree{i}/") for i in range(header["n_trees"])]

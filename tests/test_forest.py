@@ -436,3 +436,36 @@ def test_min_samples_leaf():
     for bad in (0, -1, 2.7, 1.0):
         with pytest.raises(ValueError):
             DecisionTreeClassifier(min_samples_leaf=bad).fit(X, y)
+
+
+def test_warm_start_adds_trees_and_matches_cold_fit():
+    """sklearn warm_start: a 4-tree fit warm-extended to 8 must equal the
+    8-tree cold fit bit for bit (phase A replays the RNG stream), and the
+    validation (shrink, non-integer seed, checkpoint clash) must raise."""
+    import pytest
+
+    X, y = _noisy_classification(300, seed=8)
+    warm = RandomForestClassifier(
+        n_estimators=4, max_depth=5, random_state=3, warm_start=True
+    ).fit(X, y)
+    first4 = [t.feature.copy() for t in warm.trees_]
+    warm.set_params(n_estimators=8)
+    warm.fit(X, y)
+    assert len(warm.trees_) == 8
+    for kept, orig in zip(warm.trees_[:4], first4):
+        np.testing.assert_array_equal(kept.feature, orig)
+    cold = RandomForestClassifier(
+        n_estimators=8, max_depth=5, random_state=3
+    ).fit(X, y)
+    for a, b in zip(warm.trees_, cold.trees_):
+        np.testing.assert_array_equal(a.feature, b.feature)
+        np.testing.assert_array_equal(a.count, b.count)
+
+    with pytest.raises(ValueError, match="must be larger or equal"):
+        warm.set_params(n_estimators=2).fit(X, y)
+    with pytest.warns(UserWarning, match="does not fit new trees"):
+        warm.set_params(n_estimators=8).fit(X, y)
+    with pytest.raises(ValueError, match="integer random_state"):
+        RandomForestClassifier(
+            n_estimators=2, max_depth=3, warm_start=True
+        ).fit(X, y).set_params(n_estimators=3).fit(X, y)
