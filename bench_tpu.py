@@ -328,22 +328,51 @@ def run_section(name: str, npz_path: str, timeout_s: int,
 
 
 def latest_line(path: str = OUT_PATH) -> dict | None:
-    """Newest GENUINE TPU capture, or None — bench.py's tpu_last_known.
+    """Newest genuine TPU data, merged per-section — bench.py's tpu_last_known.
 
-    CPU-fallback and all-sections-failed runs are appended to the file too
-    (they are honest history), but they must never displace the last real
-    TPU measurement this feature exists to preserve — filter to records
-    that succeeded on an accelerator platform.
+    The tunnel is flaky mid-run: one line may carry north_star while a later
+    retry line carries only the sections that hung the first time (each
+    watcher retry appends its own line). Requiring ``ok`` (every section
+    succeeded) would discard all of them. Instead, merge section payloads
+    newest-wins across records that measured on an accelerator platform.
+    Only records sharing the NEWEST record's workload key (dataset, depth,
+    refine_depth) participate — a ``--rows`` smoke run must never be fused
+    with (or mislabeled as) full-workload numbers. CPU-fallback lines
+    (``platform_probe`` != tpu/axon) and lines with no successful section
+    contribute nothing.
     """
     try:
         with open(path) as f:
             records = [json.loads(ln) for ln in f if ln.strip()]
     except (OSError, json.JSONDecodeError):
         return None
-    for rec in reversed(records):
-        if rec.get("ok") and rec.get("platform_probe") in ("tpu", "axon"):
-            return rec
-    return None
+    genuine = [
+        rec for rec in records
+        if rec.get("platform_probe") in ("tpu", "axon")
+        and any(k in rec for k in WORKERS)
+    ]
+    if not genuine:
+        return None
+
+    def workload(rec):
+        return (rec.get("dataset"), rec.get("depth"),
+                rec.get("refine_depth"))
+
+    key = workload(genuine[-1])
+    merged: dict = {"dataset": key[0], "depth": key[1],
+                    "refine_depth": key[2], "merged_from": []}
+    for rec in genuine:  # oldest -> newest, so later updates win
+        if workload(rec) != key:
+            continue
+        secs = {k: rec[k] for k in WORKERS if k in rec}
+        merged.update(secs)
+        merged["ts"] = rec.get("ts")
+        merged["git"] = rec.get("git")
+        merged["platform_probe"] = rec.get("platform_probe")
+        merged["merged_from"].append(
+            {"ts": rec.get("ts"), "git": rec.get("git"),
+             "sections": sorted(secs)})
+    return merged
 
 
 def main() -> int:

@@ -1,0 +1,108 @@
+"""latest_line merges TPU capture lines per-section, newest-wins.
+
+The tunnel drops mid-run, so one BENCH_TPU.jsonl line can carry north_star
+while a later watcher retry carries only the sections that hung the first
+time. bench.py's tpu_last_known embed must see the union, not just the
+newest (or the newest fully-``ok``) line.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from bench_tpu import latest_line  # noqa: E402
+
+
+def _write(tmp_path, records):
+    p = tmp_path / "BENCH_TPU.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in records))
+    return str(p)
+
+
+def test_missing_file_is_none(tmp_path):
+    assert latest_line(str(tmp_path / "nope.jsonl")) is None
+
+
+def test_cpu_fallback_lines_contribute_nothing(tmp_path):
+    p = _write(tmp_path, [
+        {"ts": "t1", "platform_probe": "cpu",
+         "north_star": {"warm_s": 99.0}, "ok": True},
+    ])
+    assert latest_line(p) is None
+
+
+def test_partial_ok_false_line_still_counts(tmp_path):
+    # The real round-4 shape: north_star + engine_fused succeeded, three
+    # sections died when the tunnel hung -> ok=false. The data is genuine.
+    p = _write(tmp_path, [
+        {"ts": "t1", "git": "abc", "platform_probe": "tpu",
+         "dataset": "covtype_like", "depth": 20, "refine_depth": 7,
+         "north_star": {"warm_s": 20.5}, "engine_fused": {"warm_s": 17.5},
+         "errors": {"engine_levelwise": "rc=-15"}, "ok": False},
+    ])
+    got = latest_line(p)
+    assert got is not None
+    assert got["north_star"]["warm_s"] == 20.5
+    assert got["engine_fused"]["warm_s"] == 17.5
+    assert got["depth"] == 20
+
+
+def test_sections_merge_newest_wins(tmp_path):
+    p = _write(tmp_path, [
+        {"ts": "t1", "git": "abc", "platform_probe": "tpu",
+         "north_star": {"warm_s": 20.5}, "engine_fused": {"warm_s": 17.5},
+         "ok": False},
+        # all-failed retry: contributes nothing, must not reset anything
+        {"ts": "t2", "git": "abc", "platform_probe": "tpu",
+         "errors": {"forest": "rc=-15"}, "ok": False},
+        # single-section retry succeeds later
+        {"ts": "t3", "git": "def", "platform_probe": "tpu",
+         "engine_levelwise": {"warm_s": 30.0}, "ok": True},
+        # re-measured north_star supersedes the older one
+        {"ts": "t4", "git": "def", "platform_probe": "tpu",
+         "north_star": {"warm_s": 19.0}, "ok": True},
+    ])
+    got = latest_line(p)
+    assert got["north_star"]["warm_s"] == 19.0        # t4 wins over t1
+    assert got["engine_fused"]["warm_s"] == 17.5      # only t1 had it
+    assert got["engine_levelwise"]["warm_s"] == 30.0  # from t3
+    assert got["ts"] == "t4" and got["git"] == "def"
+    assert [m["ts"] for m in got["merged_from"]] == ["t1", "t3", "t4"]
+
+
+FULL = {"dataset": "covtype_like (531012x54)", "depth": 20,
+        "refine_depth": 7}
+SMOKE = {"dataset": "covtype_like (100000x54)", "depth": 20,
+         "refine_depth": 7}
+
+
+def test_smoke_run_never_fuses_with_full_workload(tmp_path):
+    # An older --rows smoke line must not contribute sections to (or be
+    # mislabeled as) the full-workload merge.
+    p = _write(tmp_path, [
+        {"ts": "t1", "platform_probe": "tpu", **SMOKE,
+         "north_star": {"warm_s": 4.0}, "engine_fused": {"warm_s": 3.0}},
+        {"ts": "t2", "platform_probe": "tpu", **FULL,
+         "north_star": {"warm_s": 20.5}},
+    ])
+    got = latest_line(p)
+    assert got["dataset"] == FULL["dataset"]
+    assert got["north_star"]["warm_s"] == 20.5
+    assert "engine_fused" not in got  # smoke section stays out
+    assert [m["ts"] for m in got["merged_from"]] == ["t2"]
+
+
+def test_newest_smoke_run_defines_its_own_group(tmp_path):
+    # If the newest genuine line IS a smoke run, the merge is that smoke
+    # run, honestly labeled — never full numbers stamped with smoke ts.
+    p = _write(tmp_path, [
+        {"ts": "t1", "platform_probe": "tpu", **FULL,
+         "north_star": {"warm_s": 20.5}},
+        {"ts": "t2", "platform_probe": "tpu", **SMOKE,
+         "north_star": {"warm_s": 4.0}},
+    ])
+    got = latest_line(p)
+    assert got["dataset"] == SMOKE["dataset"]
+    assert got["north_star"]["warm_s"] == 4.0

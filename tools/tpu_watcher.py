@@ -1,0 +1,141 @@
+"""Retry missing BENCH_TPU.jsonl sections whenever the tunnel is healthy.
+
+The tunneled accelerator drops without warning mid-run (round 3: down all
+round; round 4: hung 20 minutes into the first capture). This watcher probes
+the device in a bounded subprocess and, on a healthy window, runs ONE
+missing bench_tpu.py section at a time (each run appends its own line;
+bench_tpu.latest_line merges per-section newest-wins). A hang costs one
+section budget, not the whole capture.
+
+Usage:  python tools/tpu_watcher.py [--sections a,b,c] [--deadline-s N]
+Log:    TPU_WATCHER.log at the repo root — committed as evidence of tunnel
+        health over the round either way.
+While a section is measuring, flag file /tmp/tpu_bench_running exists —
+long CPU-heavy jobs in the same box should wait on it to avoid distorting
+the host-side phases of the measurement.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "TPU_WATCHER.log")
+JSONL = os.path.join(REPO, "BENCH_TPU.jsonl")
+FLAG = "/tmp/tpu_bench_running"
+
+PROBE_SRC = (
+    "import jax, jax.numpy as jnp;"
+    "d = jax.devices();"
+    "assert d and d[0].platform in ('tpu', 'axon'), d;"
+    "x = jnp.ones((512, 512));"
+    "(x @ x).block_until_ready();"
+    "print('PROBE_OK', d[0].device_kind)"
+)
+
+# Per-section wall budgets (s). engine_levelwise is dispatch-bound on the
+# tunnel (2-4 round trips x 20 levels + per-tier compiles); refine_sweep is
+# 4 configs x (cold + warm) fits.
+BUDGET = {
+    "engine_levelwise": 1500,
+    "hist_tput": 900,
+    "forest": 1800,
+    "refine_sweep": 1800,
+    "north_star": 900,
+    "engine_fused": 900,
+}
+
+
+def log(msg: str) -> None:
+    line = f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())} {msg}"
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def probe_ok(timeout_s: int = 75) -> bool:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", PROBE_SRC], capture_output=True,
+            text=True, timeout=timeout_s,
+        )
+        return r.returncode == 0 and "PROBE_OK" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def section_done(sec: str) -> bool:
+    """True if the merged TPU picture bench.py will embed carries it.
+
+    Delegates to bench_tpu.latest_line so the watcher's notion of "done"
+    can never drift from what the embed actually includes (same accelerator
+    filter, same workload-key grouping).
+    """
+    sys.path.insert(0, REPO)
+    from bench_tpu import latest_line
+
+    return sec in (latest_line(JSONL) or {})
+
+
+def run_section(sec: str) -> bool:
+    budget = BUDGET.get(sec, 1200)
+    log(f"run {sec} (budget {budget}s)")
+    open(FLAG, "w").close()
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench_tpu.py"),
+             "--sections", sec, "--timeout", str(budget),
+             "--platform", "tpu"],
+            capture_output=True, text=True, timeout=budget + 300,
+            cwd=REPO,
+        )
+        tail = (r.stdout or "").strip().splitlines()[-3:]
+        log(f"{sec}: rc={r.returncode} | " + " / ".join(tail))
+    except subprocess.TimeoutExpired:
+        log(f"{sec}: parent timeout (budget {budget}+300s) — tunnel hung")
+    finally:
+        try:
+            os.remove(FLAG)
+        except OSError:
+            pass
+    done = section_done(sec)
+    log(f"{sec}: {'captured' if done else 'NOT captured'}")
+    return done
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--sections",
+                   default="engine_levelwise,hist_tput,forest,refine_sweep")
+    p.add_argument("--deadline-s", type=int, default=6 * 3600)
+    p.add_argument("--probe-every-s", type=int, default=150)
+    args = p.parse_args()
+
+    todo = [s for s in args.sections.split(",")
+            if s and not section_done(s)]
+    t_end = time.time() + args.deadline_s
+    log(f"watcher start, todo={todo}")
+    while todo and time.time() < t_end:
+        if not probe_ok():
+            log("probe: tunnel down/hung")
+            time.sleep(args.probe_every_s)
+            continue
+        log("probe: healthy")
+        sec = todo[0]
+        if run_section(sec):
+            todo.pop(0)
+        else:
+            # Rotate so one persistently-failing section cannot starve the
+            # rest for the whole deadline; a hang mid-section usually means
+            # the tunnel dropped again, so back off before reprobing.
+            todo.append(todo.pop(0))
+            time.sleep(args.probe_every_s)
+    log(f"watcher exit, remaining={todo}")
+    return 0 if not todo else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
