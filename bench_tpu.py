@@ -145,6 +145,27 @@ def worker_north_star(npz_path: str) -> dict:
     return out
 
 
+def worker_north_star_fused(npz_path: str) -> dict:
+    """North-star config with the crown pinned to the fused engine.
+
+    Round-4 TPU line: fused full-depth (17.5s warm) beat the levelwise
+    crown + refine hybrid (20.5s) on tunnel transport — per-level dispatch
+    costs ~1.8s there (north_star split phase: 12.9s / 7 levels). This
+    section measures the remaining candidate routing: one fused program for
+    the depth-7 crown, C++ exact refine for the tail.
+    """
+    Xtr, ytr, Xte, yte = _load(npz_path)
+    platform = _device_platform()
+    out, clf = _timed_fit(
+        Xtr, ytr, backend=platform, refine_depth=REFINE_DEPTH,
+        engine_env="fused",
+    )
+    out["platform"] = platform
+    out["engine"] = "fused"
+    out["test_acc"] = round(float((clf.predict(Xte) == yte).mean()), 4)
+    return out
+
+
 def worker_engine(npz_path: str, engine: str) -> dict:
     Xtr, ytr, _, _ = _load(npz_path)
     platform = _device_platform()
@@ -232,35 +253,42 @@ def worker_hist_tput(npz_path: str) -> dict:
         res["hist_K4096"]["hbm_roofline_gbps"] = roof
         res["hist_K4096"]["roofline_frac"] = round(gbps / roof, 3)
 
-    S = 8
-    nid_s = jnp.asarray(rng.integers(0, S, size=N, dtype=np.int32))
+    # Tier sweep: XLA scatter vs the Pallas kernel (whichever layout its
+    # auto-dispatch picks — one-block at S=8, feature-gridded above) at the
+    # frontier widths the builders actually route (frontier_tiers plus the
+    # capped-out 512 for the scatter side). This is the measurement the
+    # tier set and the _FGRID_MAX_SLOT_CHANNELS cap must cite.
+    for S in (8, 64, 128, 256, 512):
+        nid_s = jnp.asarray(rng.integers(0, S, size=N, dtype=np.int32))
 
-    @jax.jit
-    def small_hist(xb, y, nid_s):
-        return hist_ops.class_histogram(
-            xb, y, nid_s, jnp.int32(0), n_slots=S, n_bins=B, n_classes=C,
-            sample_weight=w1,
-        )
-
-    s_xla = timed(small_hist, xb, y, nid_s)
-    res["hist_S8_xla"] = {
-        "seconds": round(s_xla, 5),
-        "g_updates_per_s": round(N * F / s_xla / 1e9, 3),
-    }
-    if ph.pallas_available(platform):
-        payload = ph.class_payload(y, w1, C)
-
-        def pallas_hist_fn(xb, payload, nid_s):
-            return ph.histogram_small(
-                xb, payload, nid_s, n_slots=S, n_bins=B, n_channels=C
+        @jax.jit
+        def small_hist(xb, y, nid_s, S=S):
+            return hist_ops.class_histogram(
+                xb, y, nid_s, jnp.int32(0), n_slots=S, n_bins=B,
+                n_classes=C, sample_weight=w1,
             )
 
-        s_pl = timed(pallas_hist_fn, xb, payload, nid_s)
-        res["hist_S8_pallas"] = {
-            "seconds": round(s_pl, 5),
-            "g_updates_per_s": round(N * F / s_pl / 1e9, 3),
-            "speedup_vs_xla": round(s_xla / s_pl, 2),
+        s_xla = timed(small_hist, xb, y, nid_s)
+        res[f"hist_S{S}_xla"] = {
+            "seconds": round(s_xla, 5),
+            "g_updates_per_s": round(N * F / s_xla / 1e9, 3),
         }
+        if ph.pallas_available(platform) and ph.fits_vmem(F, S, C, B):
+            payload = ph.class_payload(y, w1, C)
+
+            def pallas_hist_fn(xb, payload, nid_s, S=S):
+                return ph.histogram_small(
+                    xb, payload, nid_s, n_slots=S, n_bins=B, n_channels=C
+                )
+
+            s_pl = timed(pallas_hist_fn, xb, payload, nid_s)
+            res[f"hist_S{S}_pallas"] = {
+                "seconds": round(s_pl, 5),
+                "layout": ("single" if ph._fits_single(F, S, C, B)
+                           else "fgrid"),
+                "g_updates_per_s": round(N * F / s_pl / 1e9, 3),
+                "speedup_vs_xla": round(s_xla / s_pl, 2),
+            }
     return res
 
 
@@ -282,6 +310,7 @@ def worker_forest(npz_path: str) -> dict:
 
 WORKERS = {
     "north_star": worker_north_star,
+    "north_star_fused": worker_north_star_fused,
     "engine_fused": lambda p: worker_engine(p, "fused"),
     "engine_levelwise": lambda p: worker_engine(p, "levelwise"),
     "hist_tput": worker_hist_tput,
@@ -327,7 +356,7 @@ def run_section(name: str, npz_path: str, timeout_s: int,
     )
 
 
-def latest_line(path: str = OUT_PATH) -> dict | None:
+def latest_line(path: str = OUT_PATH, *, full_only: bool = False) -> dict | None:
     """Newest genuine TPU data, merged per-section — bench.py's tpu_last_known.
 
     The tunnel is flaky mid-run: one line may carry north_star while a later
@@ -350,6 +379,11 @@ def latest_line(path: str = OUT_PATH) -> dict | None:
         rec for rec in records
         if rec.get("platform_probe") in ("tpu", "axon")
         and any(k in rec for k in WORKERS)
+        # full_only (the watcher's done-check): ignore --rows smoke lines
+        # entirely, so a newest smoke capture can neither satisfy nor
+        # reset the full-workload queue. Records predating the rows_cap
+        # field were all full-workload runs.
+        and not (full_only and rec.get("rows_cap") is not None)
     ]
     if not genuine:
         return None
@@ -416,6 +450,7 @@ def main() -> int:
         "git": _git_head(),
         "platform_probe": platform,
         "dataset": f"{name} ({len(Xtr)}x{X.shape[1]})",
+        "rows_cap": args.rows,  # None = the full dataset (watcher's target)
         "depth": DEPTH,
         "refine_depth": REFINE_DEPTH,
     }

@@ -20,6 +20,14 @@ slots of the K=4096 chunk). The fused builder therefore routes small
 frontiers here (``fused_builder.py`` small-frontier branch, behind
 ``BuildConfig.hist_kernel``) and keeps the XLA scatter for wide frontiers.
 
+Two layouts serve different ``S`` ranges: the one-block kernel keeps the
+whole ``(F, S*C, Bp)`` histogram persistent in VMEM (fastest, but S <= ~8
+at covtype shape), and a feature-gridded variant keeps one feature's
+``(1, S*C, Bp)`` block persistent while the grid walks (feature, row-tile)
+pairs — reaching the S=64..128 middle tiers that otherwise fell back to
+the scatter. ``histogram_small`` picks the layout automatically; both are
+bit-identical to the XLA path for integer-valued payloads.
+
 Rows whose slot falls outside ``[0, S)`` (parked in leaves, padding, other
 chunks) contribute nothing: their slot one-hot row is all zeros — the mask
 is free.
@@ -50,6 +58,22 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _m1(slot_ref, payload_ref, n_slots):
+    """M1[r, s*C+c] = payload[r, c] * (slot[r] == s).
+
+    Rows outside [0, S) get an all-zero row — masking is free. Built
+    reshape-free (Mosaic cannot shape-cast (Rt,S,C)->(Rt,S*C)): the slot
+    one-hot comes from an iota divided by C, the payload from concatenating
+    itself S times.
+    """
+    Rt, C = payload_ref.shape
+    slot = slot_ref[:, 0]
+    sc_iota = jax.lax.broadcasted_iota(jnp.int32, (Rt, n_slots * C), 1)
+    mask_s = (sc_iota // C == slot[:, None]).astype(jnp.float32)
+    tiled = jnp.concatenate([payload_ref[...]] * n_slots, axis=1)
+    return mask_s * tiled  # (Rt, S*C)
+
+
 def _hist_kernel(slot_ref, payload_ref, xb_ref, out_ref, *, n_slots, n_bins_pad):
     """One grid step = one row tile; accumulates into the persistent out block.
 
@@ -59,23 +83,13 @@ def _hist_kernel(slot_ref, payload_ref, xb_ref, out_ref, *, n_slots, n_bins_pad)
     out_ref     : (F, S*C, Bp) float32 — accumulated histogram
     """
     Rt = slot_ref.shape[0]
-    C = payload_ref.shape[1]
     F = xb_ref.shape[1]
 
     @pl.when(pl.program_id(0) == 0)
     def _():
         out_ref[...] = jnp.zeros_like(out_ref)
 
-    # M1[r, s*C+c] = payload[r, c] * (slot[r] == s): rows outside [0, S)
-    # get an all-zero row — masking is free. Built reshape-free (Mosaic
-    # cannot shape-cast (Rt,S,C)->(Rt,S*C)): the slot one-hot comes from an
-    # iota divided by C, the payload from concatenating itself S times.
-    slot = slot_ref[:, 0]
-    sc_iota = jax.lax.broadcasted_iota(jnp.int32, (Rt, n_slots * C), 1)
-    mask_s = (sc_iota // C == slot[:, None]).astype(jnp.float32)
-    tiled = jnp.concatenate([payload_ref[...]] * n_slots, axis=1)
-    m1 = mask_s * tiled  # (Rt, S*C)
-
+    m1 = _m1(slot_ref, payload_ref, n_slots)
     b_iota = jax.lax.broadcasted_iota(jnp.int32, (Rt, n_bins_pad), 1)
     for f in range(F):  # unrolled: F static, each iteration one MXU matmul
         onehot_b = (xb_ref[:, f][:, None] == b_iota).astype(jnp.float32)
@@ -86,10 +100,45 @@ def _hist_kernel(slot_ref, payload_ref, xb_ref, out_ref, *, n_slots, n_bins_pad)
         )
 
 
+def _hist_kernel_fgrid(slot_ref, payload_ref, xb_ref, out_ref, *, n_slots,
+                       n_bins_pad):
+    """Feature-gridded variant: one grid step = (one feature, one row tile).
+
+    The single-block kernel's persistent out block is (F, S*C, Bp) — at
+    covtype shape (F=54, C=7, B=256) it exceeds the VMEM budget for any
+    S > 8, so frontiers of 9..512 nodes fell back to the XLA scatter (the
+    scalar-unit path this kernel exists to avoid). Gridding features out
+    shrinks the persistent block to (1, S*C, Bp) — S=64 is ~460KB — at the
+    cost of recomputing M1 once per feature (VPU-cheap next to the MXU
+    contraction). Grid iterates (F outer, row tiles inner) so each
+    feature's block accumulates across its row sweep.
+
+    slot_ref    : (Rt, 1) int32   — frontier slot per row (-1 = masked)
+    payload_ref : (Rt, C) float32 — per-channel scatter payload
+    xb_ref      : (Rt, 1) int32   — bin ids, ONE feature column
+    out_ref     : (1, S*C, Bp) float32 — this feature's histogram
+    """
+    Rt = slot_ref.shape[0]
+
+    @pl.when(pl.program_id(1) == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    m1 = _m1(slot_ref, payload_ref, n_slots)
+    b_iota = jax.lax.broadcasted_iota(jnp.int32, (Rt, n_bins_pad), 1)
+    onehot_b = (xb_ref[:, 0][:, None] == b_iota).astype(jnp.float32)
+    out_ref[0] += jax.lax.dot_general(
+        m1, onehot_b,
+        dimension_numbers=(((0,), (0,)), ((), ())),  # contract rows
+        preferred_element_type=jnp.float32,
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "n_slots", "n_bins", "n_channels", "row_tile", "interpret", "vma"
+        "n_slots", "n_bins", "n_channels", "row_tile", "interpret", "vma",
+        "mode",
     ),
 )
 def histogram_small(
@@ -100,9 +149,10 @@ def histogram_small(
     n_slots: int,
     n_bins: int,
     n_channels: int,
-    row_tile: int = 512,
+    row_tile: int | None = None,
     interpret: bool = False,
     vma: tuple = (),
+    mode: str = "auto",
 ) -> jax.Array:
     """(N,F) bins + (N,C) payload + (N,) slot -> (S, F, C, B) histogram.
 
@@ -116,6 +166,26 @@ def histogram_small(
     N, F = x_binned.shape
     C, S = n_channels, n_slots
     Bp = _round_up(max(n_bins, 1), 128)
+    if mode == "auto":
+        if _fits_single(F, S, C, n_bins):
+            mode = "single"
+        elif _fgrid_eligible(S, C, n_bins):
+            mode = "fgrid"
+        else:
+            raise ValueError(
+                f"pallas histogram not eligible at F={F} S={S} C={C} "
+                f"B={n_bins}; gate callers on fits_vmem()"
+            )
+    if row_tile is None:
+        # fgrid trades one M1 recompute per feature for a per-feature
+        # persistent block; a bigger row tile amortizes the extra grid
+        # steps where the working set allows. An explicit row_tile is
+        # always respected (test seam: small tiles exercise the
+        # cross-row-tile accumulation on small N).
+        row_tile = (
+            (_fgrid_row_tile(S, C, n_bins) or 512) if mode == "fgrid"
+            else 512
+        )
     Np = _round_up(max(N, 1), row_tile)
 
     if Np != N:
@@ -124,26 +194,42 @@ def histogram_small(
         payload = jnp.pad(payload, ((0, pad), (0, 0)))
         slot = jnp.pad(slot, (0, pad), constant_values=-1)
 
-    grid = (Np // row_tile,)
     out_shape = jax.ShapeDtypeStruct((F, S * C, Bp), jnp.float32)
     if vma:
         out_shape = jax.ShapeDtypeStruct(
             (F, S * C, Bp), jnp.float32, vma=frozenset(vma)
         )
-    out = pl.pallas_call(
-        functools.partial(_hist_kernel, n_slots=S, n_bins_pad=Bp),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
-            pl.BlockSpec((row_tile, C), lambda i: (i, 0)),
-            pl.BlockSpec((row_tile, F), lambda i: (i, 0)),
-        ],
-        # Constant index map: the block persists across the sequential TPU
-        # grid, accumulating one row tile per step.
-        out_specs=pl.BlockSpec((F, S * C, Bp), lambda i: (0, 0, 0)),
-        out_shape=out_shape,
-        interpret=interpret,
-    )(slot[:, None], payload, x_binned)
+    if mode == "single":
+        out = pl.pallas_call(
+            functools.partial(_hist_kernel, n_slots=S, n_bins_pad=Bp),
+            grid=(Np // row_tile,),
+            in_specs=[
+                pl.BlockSpec((row_tile, 1), lambda i: (i, 0)),
+                pl.BlockSpec((row_tile, C), lambda i: (i, 0)),
+                pl.BlockSpec((row_tile, F), lambda i: (i, 0)),
+            ],
+            # Constant index map: the block persists across the sequential
+            # TPU grid, accumulating one row tile per step.
+            out_specs=pl.BlockSpec((F, S * C, Bp), lambda i: (0, 0, 0)),
+            out_shape=out_shape,
+            interpret=interpret,
+        )(slot[:, None], payload, x_binned)
+    else:
+        out = pl.pallas_call(
+            functools.partial(_hist_kernel_fgrid, n_slots=S, n_bins_pad=Bp),
+            # F outer, row tiles inner (TPU grids iterate the last axis
+            # fastest): each feature's out block accumulates across its
+            # full row sweep before the grid moves to the next feature.
+            grid=(F, Np // row_tile),
+            in_specs=[
+                pl.BlockSpec((row_tile, 1), lambda f, i: (i, 0)),
+                pl.BlockSpec((row_tile, C), lambda f, i: (i, 0)),
+                pl.BlockSpec((row_tile, 1), lambda f, i: (i, f)),
+            ],
+            out_specs=pl.BlockSpec((1, S * C, Bp), lambda f, i: (f, 0, 0)),
+            out_shape=out_shape,
+            interpret=interpret,
+        )(slot[:, None], payload, x_binned)
     # (F, S*C, Bp) -> (S, F, C, B)
     return out.reshape(F, S, C, Bp)[:, :, :, :n_bins].transpose(1, 0, 2, 3)
 
@@ -170,8 +256,51 @@ def pallas_available(platform: str) -> bool:
 _VMEM_BUDGET_BYTES = 10 << 20
 
 
-def fits_vmem(n_features: int, n_slots: int, n_channels: int,
-              n_bins: int) -> bool:
-    """Whether the (F, S*C, Bpad) f32 out block fits the kernel's budget."""
+def _fits_single(n_features: int, n_slots: int, n_channels: int,
+                 n_bins: int) -> bool:
+    """Whether the one-block kernel's (F, S*C, Bpad) f32 out fits budget."""
     bp = _round_up(max(n_bins, 1), 128)
     return n_features * n_slots * n_channels * bp * 4 <= _VMEM_BUDGET_BYTES
+
+
+def _fgrid_row_tile(n_slots: int, n_channels: int,
+                    n_bins: int) -> int | None:
+    """Largest row tile whose fgrid working set fits budget, or None.
+
+    Working set per grid step: the persistent (1, S*C, Bp) out block, the
+    (Rt, S*C) M1 intermediate, and the (Rt, Bp) bin one-hot, all f32.
+    """
+    bp = _round_up(max(n_bins, 1), 128)
+    out_b = n_slots * n_channels * bp * 4
+    for rt in (2048, 1024, 512, 256):
+        work = rt * (n_slots * n_channels + bp) * 4
+        if out_b + work <= _VMEM_BUDGET_BYTES:
+            return rt
+    return None
+
+
+# The dense one-hot contraction carries an S*C*B factor per row; past this
+# many S*C lanes its FLOPs catch up with the scatter wall-clock it replaces
+# (covtype estimate: S*C=448 is ~7 TFLOP/level — well ahead of the ~1s XLA
+# scatter; S*C~3600 is a wash). Pending the bench_tpu hist_tput tier sweep
+# on real hardware, cap auto-eligibility where the win is unambiguous.
+_FGRID_MAX_SLOT_CHANNELS = 1024
+
+
+def _fgrid_eligible(n_slots: int, n_channels: int, n_bins: int) -> bool:
+    return (n_slots * n_channels <= _FGRID_MAX_SLOT_CHANNELS
+            and _fgrid_row_tile(n_slots, n_channels, n_bins) is not None)
+
+
+def fits_vmem(n_features: int, n_slots: int, n_channels: int,
+              n_bins: int) -> bool:
+    """Whether SOME kernel variant is eligible at this shape.
+
+    The one-block kernel holds (F, S*C, Bpad) persistent — S <= ~8 at
+    covtype shape; the feature-gridded variant holds (1, S*C, Bpad) and
+    reaches S=64..128, which is exactly the frontier range the fused
+    crown's middle tiers occupy. histogram_small picks the variant by the
+    same predicates, so gating on this function is always safe.
+    """
+    return (_fits_single(n_features, n_slots, n_channels, n_bins)
+            or _fgrid_eligible(n_slots, n_channels, n_bins))

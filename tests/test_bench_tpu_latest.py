@@ -94,6 +94,27 @@ def test_smoke_run_never_fuses_with_full_workload(tmp_path):
     assert [m["ts"] for m in got["merged_from"]] == ["t2"]
 
 
+def test_full_only_ignores_smoke_lines_entirely(tmp_path):
+    # The watcher's done-check: a newest smoke line must neither satisfy a
+    # section nor re-key the merge away from the full workload.
+    p = _write(tmp_path, [
+        {"ts": "t1", "platform_probe": "tpu", **FULL, "rows_cap": None,
+         "north_star": {"warm_s": 20.5}},
+        {"ts": "t2", "platform_probe": "tpu", **SMOKE, "rows_cap": 100000,
+         "north_star": {"warm_s": 4.0}, "hist_tput": {"x": 1}},
+    ])
+    got = latest_line(p, full_only=True)
+    assert got["dataset"] == FULL["dataset"]
+    assert got["north_star"]["warm_s"] == 20.5
+    assert "hist_tput" not in got
+    # records predating the rows_cap field count as full-workload
+    q = _write(tmp_path, [
+        {"ts": "t1", "platform_probe": "tpu", **FULL,
+         "north_star": {"warm_s": 20.5}},
+    ])
+    assert latest_line(q, full_only=True)["north_star"]["warm_s"] == 20.5
+
+
 def test_newest_smoke_run_defines_its_own_group(tmp_path):
     # If the newest genuine line IS a smoke run, the merge is that smoke
     # run, honestly labeled — never full numbers stamped with smoke ts.

@@ -100,6 +100,87 @@ def test_exact_equality_fuzz_integer_weights(seed):
     np.testing.assert_array_equal(got, want)
 
 
+def _pallas_fgrid(xb, y, slot, w, *, c, b, s, row_tile=128):
+    payload = ph.class_payload(jnp.asarray(y), jnp.asarray(w), c)
+    return np.asarray(
+        ph.histogram_small(
+            jnp.asarray(xb), payload, jnp.asarray(slot),
+            n_slots=s, n_bins=b, n_channels=c, row_tile=row_tile,
+            interpret=True, mode="fgrid",
+        )
+    )
+
+
+@pytest.mark.parametrize("n,f,c,b,s,row_tile", CASES)
+def test_fgrid_exact_equality_vs_xla_histogram(n, f, c, b, s, row_tile):
+    """The feature-gridded layout is bit-identical to the scatter path on
+    every shape the one-block layout is tested on (forced via mode=)."""
+    xb, y, slot, w = _fuzz_case(0, n, f, c, b, s)
+    got = _pallas_fgrid(xb, y, slot, w, c=c, b=b, s=s, row_tile=row_tile)
+    want = _xla(xb, y, slot, w, c=c, b=b, s=s)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_fgrid_exact_equality_fuzz_integer_weights(seed):
+    rng = np.random.default_rng(300 + seed)
+    n = int(rng.integers(1, 800))
+    f = int(rng.integers(1, 8))
+    c = int(rng.integers(1, 9))
+    b = int(rng.integers(2, 200))
+    s = int(rng.integers(1, 17))
+    xb, y, slot, w = _fuzz_case(seed, n, f, c, b, s, weights="integer")
+    got = _pallas_fgrid(xb, y, slot, w, c=c, b=b, s=s)
+    want = _xla(xb, y, slot, w, c=c, b=b, s=s)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_auto_dispatch_routes_oversize_single_block_to_fgrid():
+    """F=24, S=128, C=7, B=128: the one-block (F, S*C, Bp) out is ~11 MB
+    (over budget) while fgrid is eligible — mode='auto' must transparently
+    produce the same exact histogram through the feature-gridded layout."""
+    f, s, c, b = 24, 128, 7, 128
+    assert not ph._fits_single(f, s, c, b)
+    assert ph._fgrid_eligible(s, c, b)
+    assert ph.fits_vmem(f, s, c, b)
+    xb, y, slot, w = _fuzz_case(7, 700, f, c, b, s, weights="integer")
+    payload = ph.class_payload(jnp.asarray(y), jnp.asarray(w), c)
+    got = np.asarray(
+        ph.histogram_small(
+            jnp.asarray(xb), payload, jnp.asarray(slot),
+            n_slots=s, n_bins=b, n_channels=c, interpret=True,
+        )
+    )
+    want = _xla(xb, y, slot, w, c=c, b=b, s=s)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_fgrid_shard_map_vma_path():
+    """fgrid inside shard_map with vma, psum'd — the fused-builder call
+    shape for the middle tiers."""
+    n, f, c, b, s = 512, 3, 4, 16, 8
+    xb, y, slot, w = _fuzz_case(11, n, f, c, b, s)
+    mesh = Mesh(np.array(jax.devices("cpu")), ("data",))
+
+    @partial(
+        jax.shard_map, mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
+        out_specs=P(), check_vma=False,
+    )
+    def sharded_hist(xb, y, slot):
+        payload = ph.class_payload(y, jnp.ones(y.shape[0], jnp.float32), c)
+        h = ph.histogram_small(
+            xb, payload, slot, n_slots=s, n_bins=b, n_channels=c,
+            row_tile=64, interpret=True, vma=("data",), mode="fgrid",
+        )
+        return jax.lax.psum(h, "data")
+
+    got = np.asarray(
+        sharded_hist(jnp.asarray(xb), jnp.asarray(y), jnp.asarray(slot))
+    )
+    want = _xla(xb, y, slot, w, c=c, b=b, s=s)
+    np.testing.assert_array_equal(got, want)
+
+
 def test_all_rows_masked_gives_zero_histogram():
     xb, y, _, w = _fuzz_case(1, 200, 3, 4, 8, 4)
     slot = np.full(200, -1, np.int32)
@@ -277,6 +358,17 @@ def test_integer_weights_gate():
 
 
 def test_fits_vmem_boundary():
-    # (F, S*C, round_up(B,128)) f32 block vs the 10 MB budget
-    assert ph.fits_vmem(54, 8, 7, 128)       # covtype-shaped: ~1.5 MB
-    assert not ph.fits_vmem(54, 512, 7, 128)  # ~99 MB
+    # one-block layout: (F, S*C, round_up(B,128)) f32 vs the 10 MB budget
+    assert ph.fits_vmem(54, 8, 7, 128)        # covtype-shaped: ~1.5 MB
+    assert ph._fits_single(54, 8, 7, 256)
+    # S=64 at covtype shape: one-block is ~25 MB (out), but the
+    # feature-gridded layout is eligible — the crown's middle tier now has
+    # an MXU path.
+    assert not ph._fits_single(54, 64, 7, 256)
+    assert ph.fits_vmem(54, 64, 7, 256)
+    # S=512 classification: S*C=3584 exceeds the dense-factor cap — the
+    # matmul FLOPs would be a wash vs the scatter, keep it ineligible.
+    assert not ph.fits_vmem(54, 512, 7, 128)
+    assert not ph.fits_vmem(54, 512, 7, 256)
+    # regression payload (C=3) is 7/3x cheaper: S=256 stays under the cap
+    assert ph.fits_vmem(54, 256, 3, 256)

@@ -16,8 +16,8 @@ the host-side phases of the measurement.
 """
 
 import argparse
-import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -45,6 +45,7 @@ BUDGET = {
     "forest": 1800,
     "refine_sweep": 1800,
     "north_star": 900,
+    "north_star_fused": 900,
     "engine_fused": 900,
 }
 
@@ -68,16 +69,18 @@ def probe_ok(timeout_s: int = 75) -> bool:
 
 
 def section_done(sec: str) -> bool:
-    """True if the merged TPU picture bench.py will embed carries it.
+    """True if the merged FULL-WORKLOAD TPU picture carries this section.
 
     Delegates to bench_tpu.latest_line so the watcher's notion of "done"
     can never drift from what the embed actually includes (same accelerator
-    filter, same workload-key grouping).
+    filter, same workload-key grouping). full_only: an operator's --rows
+    smoke line must neither satisfy a section nor re-key the merge away
+    from the full workload this watcher exists to capture.
     """
     sys.path.insert(0, REPO)
     from bench_tpu import latest_line
 
-    return sec in (latest_line(JSONL) or {})
+    return sec in (latest_line(JSONL, full_only=True) or {})
 
 
 def run_section(sec: str) -> bool:
@@ -85,17 +88,29 @@ def run_section(sec: str) -> bool:
     log(f"run {sec} (budget {budget}s)")
     open(FLAG, "w").close()
     try:
-        r = subprocess.run(
+        # Own process group: on parent timeout the section-worker GRANDCHILD
+        # must die too, or an orphan keeps holding the flaky TPU while the
+        # next section starts (device contention on exactly the tunnel this
+        # tool babysits).
+        proc = subprocess.Popen(
             [sys.executable, os.path.join(REPO, "bench_tpu.py"),
              "--sections", sec, "--timeout", str(budget),
              "--platform", "tpu"],
-            capture_output=True, text=True, timeout=budget + 300,
-            cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=REPO, start_new_session=True,
         )
-        tail = (r.stdout or "").strip().splitlines()[-3:]
-        log(f"{sec}: rc={r.returncode} | " + " / ".join(tail))
-    except subprocess.TimeoutExpired:
-        log(f"{sec}: parent timeout (budget {budget}+300s) — tunnel hung")
+        try:
+            out, _ = proc.communicate(timeout=budget + 300)
+            tail = (out or "").strip().splitlines()[-3:]
+            log(f"{sec}: rc={proc.returncode} | " + " / ".join(tail))
+        except subprocess.TimeoutExpired:
+            log(f"{sec}: parent timeout (budget {budget}+300s) — "
+                f"killing process group")
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait(timeout=30)
     finally:
         try:
             os.remove(FLAG)
@@ -109,7 +124,8 @@ def run_section(sec: str) -> bool:
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--sections",
-                   default="engine_levelwise,hist_tput,forest,refine_sweep")
+                   default="north_star_fused,hist_tput,engine_levelwise,"
+                           "forest,refine_sweep")
     p.add_argument("--deadline-s", type=int, default=6 * 3600)
     p.add_argument("--probe-every-s", type=int, default=150)
     args = p.parse_args()
