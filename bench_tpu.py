@@ -131,18 +131,36 @@ def _timed_fit(Xtr, ytr, *, backend, refine_depth, engine_env=None,
     return out, clf
 
 
-def worker_north_star(npz_path: str) -> dict:
+def _predict_tput(clf, Xte) -> float:
+    """Warm rows/s of the vectorized gather-descent predict (the
+    reference's per-row Python recursion, decision_tree.py:208-227, is the
+    parity point)."""
+    clf.predict(Xte)  # warm any lazy device program
+    t0 = time.perf_counter()
+    clf.predict(Xte)
+    return round(len(Xte) / (time.perf_counter() - t0))
+
+
+def _north_star(npz_path: str, engine_env: str | None) -> dict:
     Xtr, ytr, Xte, yte = _load(npz_path)
     platform = _device_platform()
     out, clf = _timed_fit(
-        Xtr, ytr, backend=platform, refine_depth=REFINE_DEPTH
+        Xtr, ytr, backend=platform, refine_depth=REFINE_DEPTH,
+        engine_env=engine_env,
     )
     out["platform"] = platform
+    if engine_env:
+        out["engine"] = engine_env
     out["test_acc"] = round(float((clf.predict(Xte) == yte).mean()), 4)
+    out["predict_rows_per_s"] = _predict_tput(clf, Xte)
     n_cells = Xtr.shape[0] * Xtr.shape[1]
     levels = max(out["tree_depth"], 1)
     out["throughput_cells_per_s"] = round(n_cells * levels / out["warm_s"])
     return out
+
+
+def worker_north_star(npz_path: str) -> dict:
+    return _north_star(npz_path, None)
 
 
 def worker_north_star_fused(npz_path: str) -> dict:
@@ -154,16 +172,7 @@ def worker_north_star_fused(npz_path: str) -> dict:
     section measures the remaining candidate routing: one fused program for
     the depth-7 crown, C++ exact refine for the tail.
     """
-    Xtr, ytr, Xte, yte = _load(npz_path)
-    platform = _device_platform()
-    out, clf = _timed_fit(
-        Xtr, ytr, backend=platform, refine_depth=REFINE_DEPTH,
-        engine_env="fused",
-    )
-    out["platform"] = platform
-    out["engine"] = "fused"
-    out["test_acc"] = round(float((clf.predict(Xte) == yte).mean()), 4)
-    return out
+    return _north_star(npz_path, "fused")
 
 
 def worker_engine(npz_path: str, engine: str) -> dict:

@@ -91,7 +91,12 @@ class BuildConfig:
     # histogram + gain sweep instead of the full K-slot one. Shallow levels
     # otherwise pay the K=4096-slot sweep for a handful of live nodes. The
     # smallest eligible tier also hosts the Pallas kernel (VMEM permitting).
-    frontier_tiers: tuple = (8, 64, 512)
+    # 128 serves frontiers of 65..128 nodes — a depth-7 level's worst case:
+    # the feature-gridded Pallas layout reaches S=128 for classification
+    # payloads, so a refine_depth=8 crown's last level rides the MXU
+    # instead of the 512-slot scatter. 512 stays the scatter tier that
+    # bounds the gain-sweep width below the K=4096 chunk.
+    frontier_tiers: tuple = (8, 64, 128, 512)
 
 
 # Below this many matrix cells, per-level device dispatch latency dominates

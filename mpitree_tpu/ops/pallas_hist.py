@@ -268,12 +268,16 @@ def _fgrid_row_tile(n_slots: int, n_channels: int,
     """Largest row tile whose fgrid working set fits budget, or None.
 
     Working set per grid step: the persistent (1, S*C, Bp) out block, the
-    (Rt, S*C) M1 intermediate, and the (Rt, Bp) bin one-hot, all f32.
+    M1 construction's THREE (Rt, S*C) f32 intermediates (slot mask, tiled
+    payload, product — counted materialized; Mosaic may fuse them, but
+    VMEM-allocation failures on hardware are the one error the interpret-
+    mode tests cannot catch, so the accounting stays conservative), and
+    the (Rt, Bp) bin one-hot.
     """
     bp = _round_up(max(n_bins, 1), 128)
     out_b = n_slots * n_channels * bp * 4
     for rt in (2048, 1024, 512, 256):
-        work = rt * (n_slots * n_channels + bp) * 4
+        work = rt * (3 * n_slots * n_channels + bp) * 4
         if out_b + work <= _VMEM_BUDGET_BYTES:
             return rt
     return None

@@ -110,7 +110,18 @@ def run_section(sec: str) -> bool:
                 os.killpg(proc.pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 pass
-            proc.wait(timeout=30)
+            # Drain what the child managed to print before the hang — the
+            # committed log is the evidence of WHERE sections die. A child
+            # stuck in uninterruptible device I/O can survive SIGKILL for a
+            # while; never let that crash the watcher itself.
+            try:
+                out, _ = proc.communicate(timeout=30)
+                tail = (out or "").strip().splitlines()[-3:]
+                if tail:
+                    log(f"{sec}: output before hang | " + " / ".join(tail))
+            except (subprocess.TimeoutExpired, OSError, ValueError):
+                log(f"{sec}: child unreaped after SIGKILL "
+                    f"(uninterruptible device I/O?) — moving on")
     finally:
         try:
             os.remove(FLAG)
