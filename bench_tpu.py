@@ -208,6 +208,38 @@ def worker_refine_sweep(npz_path: str) -> dict:
     return {"sweep": rows}
 
 
+def worker_device_bin(npz_path: str) -> dict:
+    """Host numpy vs on-device binning at the full workload shape.
+
+    The go/no-go for bin_for_engine's TPU default: measured on XLA-CPU the
+    device program is ~26x SLOWER than numpy (100k x 54), so it is gated
+    to real TPUs on the strength of this section's numbers.
+    """
+    import jax
+
+    from mpitree_tpu.ops.binning import bin_dataset, bin_dataset_device
+
+    Xtr, _, _, _ = _load(npz_path)
+    t0 = time.perf_counter()
+    host = bin_dataset(Xtr)
+    host_s = time.perf_counter() - t0
+    bin_dataset_device(Xtr)  # compile + transfer warm-up
+    t0 = time.perf_counter()
+    dev = bin_dataset_device(Xtr)
+    dev_s = time.perf_counter() - t0
+    same = bool(
+        np.array_equal(np.asarray(dev.x_binned), host.x_binned)
+        and np.array_equal(dev.thresholds, host.thresholds)
+    )
+    return {
+        "platform": jax.devices()[0].platform,
+        "host_s": round(host_s, 3),
+        "device_s": round(dev_s, 3),
+        "speedup_vs_host": round(host_s / dev_s, 2),
+        "identical": same,
+    }
+
+
 def worker_hist_tput(npz_path: str) -> dict:
     """K-slot and small-frontier histogram throughput at covtype shape."""
     import jax
@@ -323,6 +355,7 @@ WORKERS = {
     "engine_fused": lambda p: worker_engine(p, "fused"),
     "engine_levelwise": lambda p: worker_engine(p, "levelwise"),
     "hist_tput": worker_hist_tput,
+    "device_bin": worker_device_bin,
     "refine_sweep": worker_refine_sweep,
     "forest": worker_forest,
 }

@@ -29,7 +29,7 @@ from sklearn.utils.validation import check_is_fitted
 
 from mpitree_tpu.core.builder import BuildConfig, build_tree, prefer_host_path
 from mpitree_tpu.core.host_builder import build_tree_host
-from mpitree_tpu.ops.binning import bin_dataset
+from mpitree_tpu.ops.binning import bin_for_engine, ensure_host_binned
 from mpitree_tpu.ops.predict import (
     device_tree_arrays,
     predict_leaf_ids,
@@ -181,11 +181,14 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
         )
 
         timer = PhaseTimer(enabled=profiling_enabled())
+        host = prefer_host_path(*X.shape, self.n_devices, self.backend)
         with timer.phase("bin"):
-            binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
+            binned = bin_for_engine(
+                X, max_bins=self.max_bins, binning=self.binning,
+                device=not host, backend=self.backend,
+            )
         sw = validate_sample_weight(sample_weight, X.shape[0])
         sw = apply_class_weight(self.class_weight, y_enc, classes, sw)
-        host = prefer_host_path(*X.shape, self.n_devices, self.backend)
         rd, refine, crown_depth = resolve_refine(
             self.max_depth, self.refine_depth,
             n_rows=X.shape[0], quantized=binned.quantized,
@@ -243,9 +246,14 @@ class DecisionTreeClassifier(ClassifierMixin, BaseEstimator):
                 # Elastic recovery (utils/elastic.py): the host tier
                 # consumes the same binned matrix and produces the identical
                 # tree, so a lost accelerator costs wall-clock, not the fit.
+                # A device-binned matrix cannot be pulled back from a dead
+                # accelerator: re-bin on host (bit-identical by contract).
+                binned_h = ensure_host_binned(
+                    binned, X, max_bins=self.max_bins, binning=self.binning
+                )
                 with timer.phase("host_build"):
                     res = build_tree_host(
-                        binned, y_enc, config=cfg, n_classes=len(classes),
+                        binned_h, y_enc, config=cfg, n_classes=len(classes),
                         sample_weight=sw, return_leaf_ids=refine,
                         feature_sampler=sampler, mono_cst=mono,
                     )

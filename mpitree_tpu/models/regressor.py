@@ -22,7 +22,7 @@ from sklearn.utils.validation import check_is_fitted
 
 from mpitree_tpu.core.builder import BuildConfig, build_tree, prefer_host_path
 from mpitree_tpu.core.host_builder import build_tree_host
-from mpitree_tpu.ops.binning import bin_dataset
+from mpitree_tpu.ops.binning import bin_for_engine, ensure_host_binned
 from mpitree_tpu.ops.predict import (
     device_tree_arrays,
     predict_leaf_ids,
@@ -98,10 +98,13 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
         )
 
         timer = PhaseTimer(enabled=profiling_enabled())
-        with timer.phase("bin"):
-            binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
-        sw = validate_sample_weight(sample_weight, X.shape[0])
         host = prefer_host_path(*X.shape, self.n_devices, self.backend)
+        with timer.phase("bin"):
+            binned = bin_for_engine(
+                X, max_bins=self.max_bins, binning=self.binning,
+                device=not host, backend=self.backend,
+            )
+        sw = validate_sample_weight(sample_weight, X.shape[0])
         rd, refine, crown_depth = resolve_refine(
             self.max_depth, self.refine_depth,
             n_rows=X.shape[0], quantized=binned.quantized,
@@ -157,9 +160,14 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
             def _host():
                 # Elastic recovery (utils/elastic.py): same binned inputs,
                 # identical tree — a lost accelerator costs wall-clock only.
+                # A device-binned matrix cannot be pulled back from a dead
+                # accelerator: re-bin on host (bit-identical by contract).
+                binned_h = ensure_host_binned(
+                    binned, X, max_bins=self.max_bins, binning=self.binning
+                )
                 with timer.phase("host_build"):
                     res = build_tree_host(
-                        binned, y_c, config=cfg, sample_weight=sw,
+                        binned_h, y_c, config=cfg, sample_weight=sw,
                         refit_targets=y64, return_leaf_ids=refine,
                         feature_sampler=sampler, mono_cst=mono,
                     )

@@ -21,8 +21,15 @@ Representation (per feature ``f``):
   bounded by the parent impurity and per-feature ties break toward the
   *lowest* threshold (reference ``np.argmin`` at ``decision_tree.py:90``).
 
-Binning is host-side numpy preprocessing (one pass); the binned ``int32``
-matrix is then device_put once and stays HBM-resident for the whole build.
+Binning is host-side numpy preprocessing (one pass) for the host tier; the
+device engines can instead bin ON the accelerator (``bin_dataset_device``):
+the raw f32 matrix crosses the wire once (the same byte count as the binned
+int32 it replaces) and the sort/quantile/compare work runs where the build
+runs. Both paths produce bit-identical ``BinnedData`` — edges are *selected
+data values* (gathers of sorted columns), never arithmetic on them, so
+device parity is by construction; the engine-identity contract
+(device tree == host tree) depends on this and
+``tests/test_binning_device.py`` pins it.
 """
 
 from __future__ import annotations
@@ -85,13 +92,23 @@ def _quantile_edges(col: np.ndarray, max_bins: int) -> np.ndarray:
     return _quantile_edges_sorted(np.sort(col), max_bins)
 
 
+def _quantile_indices(n: int, max_bins: int) -> np.ndarray:
+    """Sorted-column gather indices for the quantile edges — f64 on HOST.
+
+    Parity-critical and therefore the ONE copy: both the host path and
+    ``bin_dataset_device`` gather at exactly these indices (f32 products of
+    ``(n-1)*q`` on device would round differently and break the
+    bit-identity contract between the two paths).
+    """
+    qs = np.arange(1, max_bins, dtype=np.float64) / max_bins
+    return np.floor((n - 1) * qs).astype(np.int64)
+
+
 def _quantile_edges_sorted(col_sorted: np.ndarray, max_bins: int) -> np.ndarray:
     # np.quantile(col, q, method="lower") == sorted[floor((n-1)*q)] — taking
     # the indices directly lets one sort serve both the uniqueness probe and
     # the edges (np.unique + np.quantile would each sort the column).
-    qs = np.arange(1, max_bins, dtype=np.float64) / max_bins
-    idx = np.floor((len(col_sorted) - 1) * qs).astype(np.int64)
-    return np.unique(col_sorted[idx])
+    return np.unique(col_sorted[_quantile_indices(len(col_sorted), max_bins)])
 
 
 def bin_dataset(
@@ -169,3 +186,217 @@ def bin_dataset(
         x_binned=x_binned, thresholds=thresholds, n_cand=n_cand,
         n_bins=n_bins, quantized=quantized,
     )
+
+
+# --------------------------------------------------------------------------
+# Device-side binning (the TPU path's preprocessing, HBM-resident output)
+# --------------------------------------------------------------------------
+
+def _device_bin_kernel(Xt, qidx, max_bins, force_quantile=False):
+    """(F, N) f32 -> (xbt (F, N) int32, thresholds (F, max_bins-1), n_cand).
+
+    The jnp twin of ``bin_dataset``'s "auto" mode, static-shaped for jit:
+
+    - per-feature sort; uniqueness mask; unique count
+    - exact edges (unique values minus the top one) compacted into a fixed
+      (F, max_bins-1) buffer by GATHERS: the i-th unique sits at the first
+      sorted position whose uniqueness-rank reaches i+1 (binary search over
+      the monotone rank vector — a scatter compaction here would be another
+      N*F-update scalar pass, the exact cost device binning exists to avoid)
+    - quantile edges = gathers of the sorted column at host-precomputed
+      ``qidx`` (f64 index arithmetic happens on host — f32 products of
+      ``(n-1)*q`` would round differently and break host parity), deduped
+      by the same rank-gather trick
+    - per-feature select: exact while the unique count fits ``max_bins``
+    - bin ids by candidate counting: ``xb = sum_e(thr[f, e] < x)`` —
+      identical to ``searchsorted(edges, x, side="left")`` with the +inf
+      padding inert, and a pure broadcast-compare-reduce on device (no
+      per-row scalar binary-search gathers)
+
+    Known non-contract: a column holding both -0.0 and 0.0 may yield a
+    bitwise -0.0/+0.0 threshold difference vs the host path (equal-value
+    sort order is algorithm-specific); every predicate (``x <= t``) and
+    bin id is unaffected.
+    """
+    import jax.numpy as jnp
+
+    F, N = Xt.shape
+    Q = max_bins - 1
+    import jax
+
+    srt = jnp.sort(Xt, axis=1)
+    new_val = jnp.concatenate(
+        [jnp.ones((F, 1), bool), srt[:, 1:] != srt[:, :-1]], axis=1
+    )
+    n_uniq = new_val.sum(axis=1).astype(jnp.int32)
+
+    def compact(vals, mask, keep_n):
+        """Gather the first ``Q`` mask-marked values of each ascending row.
+
+        ``rank[n] = #marked positions <= n`` is monotone, so the i-th
+        marked value sits at the first position where rank reaches i+1 —
+        one vmapped binary search instead of an N-wide scatter. Positions
+        at/after ``keep_n`` pad with +inf (inert for candidate counting).
+        """
+        M = vals.shape[1]
+        rank = jnp.cumsum(mask, axis=1, dtype=jnp.int32)
+        want = jnp.arange(1, Q + 1, dtype=jnp.int32)
+        tgt = jax.vmap(
+            lambda r: jnp.searchsorted(r, want, side="left")
+        )(rank)
+        got = jnp.take_along_axis(
+            vals, jnp.minimum(tgt, M - 1), axis=1
+        ).astype(jnp.float32)
+        pos = jnp.arange(Q, dtype=jnp.int32)[None, :]
+        return jnp.where(pos < keep_n[:, None], got, jnp.inf)
+
+    # the top unique value is never a candidate (reference
+    # decision_tree.py:73,90 semantics, see module docstring): keep n-1
+    exact_thr = compact(srt, new_val, n_uniq - 1)
+
+    qcand = jnp.take_along_axis(srt, qidx[None, :].repeat(F, 0), axis=1)
+    new_q = jnp.concatenate(
+        [jnp.ones((F, 1), bool), qcand[:, 1:] != qcand[:, :-1]], axis=1
+    )
+    n_q = new_q.sum(axis=1).astype(jnp.int32)
+    # quantile edges keep ALL deduped values (host np.unique of the
+    # gathered candidates keeps every one)
+    quant_thr = compact(qcand, new_q, n_q)
+
+    use_exact = (
+        jnp.zeros_like(n_uniq, bool) if force_quantile
+        else n_uniq <= max_bins
+    )
+    thresholds = jnp.where(use_exact[:, None], exact_thr, quant_thr)
+    n_cand = jnp.where(use_exact, n_uniq - 1, n_q)
+    xbt = (thresholds[:, :, None] < Xt[:, None, :]).sum(
+        axis=1, dtype=jnp.int32
+    )
+    return xbt, thresholds, n_cand, use_exact
+
+
+def bin_dataset_device(
+    X: np.ndarray, *, max_bins: int = 256, binning: str = "auto"
+) -> BinnedData:
+    """``bin_dataset`` computed on the default device; bit-identical output.
+
+    ``x_binned`` comes back as a DEVICE-resident (N, F) int32 array (the
+    shard step re-places it under the mesh sharding without a host round
+    trip); ``thresholds``/``n_cand`` are pulled to host (a few KB) where
+    predict/export need them. Only "auto" and "quantile" modes exist here:
+    "exact" mode's candidate count is data-dependent (unbounded), which has
+    no static shape — callers keep host binning for it. Assumes
+    estimator-validated input (finite; NaN would corrupt the sort-based
+    dedup where the host path collapses it).
+    """
+    if binning not in ("auto", "quantile"):
+        raise ValueError(
+            "bin_dataset_device supports binning='auto'|'quantile' "
+            f"(got {binning!r}); exact mode is host-only"
+        )
+    import jax
+    import jax.numpy as jnp
+
+    X = np.ascontiguousarray(X, dtype=np.float32)
+    n_samples, n_features = X.shape
+    if max_bins < 2:
+        # Degenerate: zero candidates everywhere. The device kernel's
+        # dedup seeds a first-occurrence column that would miscount a
+        # 0-wide candidate set; host handles it (and is bit-identical by
+        # definition of "no work").
+        return bin_dataset(X, max_bins=max_bins, binning=binning)
+    # Host f64 index arithmetic — the ONE shared copy (_quantile_indices).
+    qidx = jnp.asarray(
+        _quantile_indices(n_samples, max_bins).astype(np.int32)
+    )
+    kernel = jax.jit(
+        _device_bin_kernel, static_argnames=("max_bins", "force_quantile")
+    )
+    xbt, thr_d, n_cand_d, use_exact_d = kernel(
+        jnp.asarray(X.T), qidx, max_bins=max_bins,
+        force_quantile=binning == "quantile",
+    )
+    thresholds = np.asarray(thr_d)
+    n_cand = np.asarray(n_cand_d)
+    use_exact = np.asarray(use_exact_d)
+    n_bins = int(n_cand.max(initial=0)) + 1
+    quantized = bool((~use_exact).any())
+    # Trim the threshold pad to the realized bin width, like the host path.
+    thresholds = np.ascontiguousarray(thresholds[:, : max(n_bins - 1, 1)])
+    return BinnedData(
+        x_binned=xbt.T, thresholds=thresholds, n_cand=n_cand,
+        n_bins=n_bins, quantized=quantized,
+    )
+
+
+def bin_for_engine(
+    X: np.ndarray, *, max_bins: int, binning: str, device: bool,
+    backend: str | None = None,
+) -> BinnedData:
+    """Route binning to where the build will run (the one routing point).
+
+    ``device=True`` (a device engine will consume the result) bins on the
+    accelerator when that accelerator is a real TPU — measured on XLA-CPU
+    the sort/compare-reduce program is ~26x slower than the numpy path
+    (100k x 54: 25.9s vs 1.0s), so the CPU backend (tests, bench fallback)
+    keeps host binning. "exact" mode is host-only (dynamic candidate
+    count). ``MPITREE_TPU_DEVICE_BIN=1`` forces the device path on any
+    backend (the identity tests use it); ``=0`` disables it everywhere.
+    Any device FAILURE falls back to host binning — the elastic principle:
+    a flaky accelerator costs wall-clock, never the fit (bit-identical
+    outputs) — but a device HANG blocks here exactly as the subsequent
+    build would.
+    """
+    import os
+
+    flag = os.environ.get("MPITREE_TPU_DEVICE_BIN")
+    if device and binning != "exact" and flag != "0":
+        if flag == "1":
+            # Forced: raise on failure — the identity tests ride this flag,
+            # and a silent host fallback would make them compare
+            # host-vs-host and pass vacuously.
+            return bin_dataset_device(X, max_bins=max_bins, binning=binning)
+        if backend == "tpu":
+            on_tpu = True
+        elif backend in ("cpu", "host"):
+            on_tpu = False
+        else:  # backend auto: ask jax (blocks on a hung tunnel, like the build)
+            import jax
+
+            on_tpu = jax.default_backend() in ("tpu", "axon")
+        if on_tpu:
+            try:
+                return bin_dataset_device(
+                    X, max_bins=max_bins, binning=binning
+                )
+            except Exception as e:  # noqa: BLE001
+                # Same policy as device_failover (utils/elastic.py):
+                # transport failures are survivable (host output is
+                # bit-identical), everything else is a real bug the caller
+                # must see.
+                import warnings
+
+                from mpitree_tpu.utils.elastic import is_device_failure
+
+                if not is_device_failure(e):
+                    raise
+                warnings.warn(
+                    f"device binning failed ({type(e).__name__}: {e}); "
+                    f"falling back to host binning",
+                    stacklevel=2,
+                )
+    return bin_dataset(X, max_bins=max_bins, binning=binning)
+
+
+def ensure_host_binned(
+    binned: BinnedData, X: np.ndarray, *, max_bins: int, binning: str
+) -> BinnedData:
+    """Host-resident BinnedData for the elastic failover path.
+
+    A device-binned fit whose accelerator just died cannot pull
+    ``x_binned`` back; re-binning on host is safe because both paths are
+    bit-identical (tests/test_binning_device.py).
+    """
+    if isinstance(binned.x_binned, np.ndarray):
+        return binned
+    return bin_dataset(X, max_bins=max_bins, binning=binning)

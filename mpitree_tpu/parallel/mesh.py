@@ -17,6 +17,7 @@ from __future__ import annotations
 from functools import lru_cache
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -167,7 +168,11 @@ def pad_row_arrays(xb, y, w, nid, n_shards: int):
     pad = pad_rows(len(y), n_shards)
     if not pad:
         return xb, y, w, nid
-    xb = np.concatenate([xb, np.zeros((pad, xb.shape[1]), xb.dtype)])
+    # A device-binned matrix (ops/binning.bin_dataset_device) pads in place
+    # on the accelerator; np.concatenate would silently round-trip it to
+    # host through __array__.
+    xp = jnp if isinstance(xb, jax.Array) else np
+    xb = xp.concatenate([xb, xp.zeros((pad, xb.shape[1]), xb.dtype)])
     y = np.concatenate([y, np.zeros(pad, y.dtype)])
     if w.ndim == 1:
         w = np.concatenate([w, np.zeros(pad, np.float32)])
@@ -200,7 +205,10 @@ def shard_build_inputs(mesh: Mesh, binned, y, sample_weight):
     )
     fpad = (-F) % df
     if fpad:
-        xb = np.concatenate([xb, np.zeros((len(xb), fpad), np.int32)], axis=1)
+        xp = jnp if isinstance(xb, jax.Array) else np
+        xb = xp.concatenate(
+            [xb, xp.zeros((len(xb), fpad), xp.int32)], axis=1
+        )
         cand = np.concatenate(
             [cand, np.zeros((fpad, cand.shape[1]), bool)], axis=0
         )

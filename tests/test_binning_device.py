@@ -1,0 +1,125 @@
+"""Device binning is bit-identical to host binning.
+
+The engine-identity contract (device tree == host tree) rests on both
+paths consuming the same BinnedData; ``bin_dataset_device`` computes it on
+the accelerator (sort/dedup-gather/compare-reduce, no scalar scatters), so
+its thresholds, candidate counts, bin ids, n_bins and quantized flag must
+match ``bin_dataset`` exactly — on duplicates-heavy, constant, near-unique
+and overflow columns, in both "auto" and "quantile" modes.
+"""
+
+import numpy as np
+import pytest
+
+from mpitree_tpu.ops.binning import bin_dataset, bin_dataset_device
+
+
+def _assert_identical(host, dev):
+    np.testing.assert_array_equal(np.asarray(dev.x_binned), host.x_binned)
+    np.testing.assert_array_equal(dev.thresholds, host.thresholds)
+    np.testing.assert_array_equal(dev.n_cand, host.n_cand)
+    assert dev.n_bins == host.n_bins
+    assert dev.quantized == host.quantized
+    assert dev.thresholds.dtype == host.thresholds.dtype
+    assert np.asarray(dev.x_binned).dtype == host.x_binned.dtype
+
+
+def _mixed_matrix(seed, n, max_bins):
+    """Columns spanning every regime: constant, binary, duplicates-heavy
+    (fits exact), exactly-at-the-boundary, and unique-per-row (overflows
+    into quantile)."""
+    rng = np.random.default_rng(seed)
+    cols = [
+        np.full(n, 3.25, np.float32),                       # constant
+        rng.integers(0, 2, n).astype(np.float32),           # binary
+        rng.integers(0, max_bins // 2, n).astype(np.float32),
+        rng.integers(0, max_bins, n).astype(np.float32),    # boundary-ish
+        rng.normal(size=n).astype(np.float32),              # ~all unique
+        np.round(rng.normal(size=n), 1).astype(np.float32),
+    ]
+    return np.stack(cols, axis=1)
+
+
+@pytest.mark.parametrize("binning", ["auto", "quantile"])
+@pytest.mark.parametrize("seed,n,max_bins", [
+    (0, 500, 32), (1, 1000, 64), (2, 257, 8), (3, 64, 256),
+])
+def test_device_matches_host(binning, seed, n, max_bins):
+    X = _mixed_matrix(seed, n, max_bins)
+    host = bin_dataset(X, max_bins=max_bins, binning=binning)
+    dev = bin_dataset_device(X, max_bins=max_bins, binning=binning)
+    _assert_identical(host, dev)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_device_matches_host_fuzz(seed):
+    rng = np.random.default_rng(1000 + seed)
+    n = int(rng.integers(2, 600))
+    f = int(rng.integers(1, 9))
+    max_bins = int(rng.integers(2, 128))
+    # heavy duplicate mass to stress the dedup/compaction paths
+    X = np.round(
+        rng.normal(size=(n, f)) * rng.integers(1, 50), 1
+    ).astype(np.float32)
+    for binning in ("auto", "quantile"):
+        host = bin_dataset(X, max_bins=max_bins, binning=binning)
+        dev = bin_dataset_device(X, max_bins=max_bins, binning=binning)
+        _assert_identical(host, dev)
+
+
+def test_single_row_and_single_feature():
+    X = np.array([[7.0]], np.float32)
+    _assert_identical(bin_dataset(X), bin_dataset_device(X))
+
+
+def test_max_bins_one_degenerate():
+    # Q=0: zero candidates everywhere; host returns (F, 1) +inf thresholds
+    # and n_cand 0 — the device path must match exactly (it delegates).
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(50, 3)).astype(np.float32)
+    for binning in ("auto", "quantile"):
+        host = bin_dataset(X, max_bins=1, binning=binning)
+        dev = bin_dataset_device(X, max_bins=1, binning=binning)
+        _assert_identical(host, dev)
+        assert host.n_bins == 1 and host.n_cand.max(initial=0) == 0
+
+
+def test_exact_mode_is_host_only():
+    X = np.ones((4, 2), np.float32)
+    with pytest.raises(ValueError, match="exact"):
+        bin_dataset_device(X, binning="exact")
+
+
+def test_estimator_identity_device_vs_host_binning(monkeypatch):
+    """The same tree, bit for bit, whether the binned matrix was produced
+    on host or on device (the engine-identity contract's new seam)."""
+    from mpitree_tpu import DecisionTreeClassifier
+
+    rng = np.random.default_rng(0)
+    X = np.round(rng.normal(size=(400, 5)), 1).astype(np.float32)
+    y = rng.integers(0, 3, 400)
+
+    def fit():
+        return DecisionTreeClassifier(
+            max_depth=6, max_bins=16, backend="cpu"
+        ).fit(X, y)
+
+    # force=1: the cpu backend routes host by default (XLA-CPU binning is
+    # ~26x slower than numpy at scale) — the seam still has to be identical
+    monkeypatch.setenv("MPITREE_TPU_DEVICE_BIN", "1")
+    dev_tree = fit().export_text()
+    monkeypatch.setenv("MPITREE_TPU_DEVICE_BIN", "0")
+    host_tree = fit().export_text()
+    assert dev_tree == host_tree
+
+
+def test_device_array_output_feeds_builders():
+    """x_binned comes back as a jax.Array (device-resident) — the point of
+    the exercise; the shard step must not silently round-trip it to host."""
+    import jax
+
+    X = _mixed_matrix(5, 200, 16)
+    dev = bin_dataset_device(X, max_bins=16)
+    assert isinstance(dev.x_binned, jax.Array)
+    assert isinstance(dev.thresholds, np.ndarray)
+    assert isinstance(dev.n_cand, np.ndarray)

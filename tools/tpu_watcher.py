@@ -42,6 +42,7 @@ PROBE_SRC = (
 BUDGET = {
     "engine_levelwise": 1500,
     "hist_tput": 900,
+    "device_bin": 600,
     "forest": 1800,
     "refine_sweep": 1800,
     "north_star": 900,
@@ -135,8 +136,8 @@ def run_section(sec: str) -> bool:
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--sections",
-                   default="north_star_fused,hist_tput,engine_levelwise,"
-                           "forest,refine_sweep")
+                   default="device_bin,north_star_fused,hist_tput,"
+                           "engine_levelwise,forest,refine_sweep")
     p.add_argument("--deadline-s", type=int, default=6 * 3600)
     p.add_argument("--probe-every-s", type=int, default=150)
     args = p.parse_args()
