@@ -287,6 +287,30 @@ def worker_hist_tput(npz_path: str) -> dict:
         "g_updates_per_s": round(N * F / s / 1e9, 3),
         "read_gb_per_s": round(gbps, 1),
     }
+
+    # Candidate big-path variant: sort rows by node id once per level, then
+    # the SAME scatter — writes then cluster per slot region of the huge
+    # accumulator (better locality for the scatter unit), at the price of
+    # the sort + 3 gathers. (indices_are_sorted would be a lie: fine ids
+    # jumble by class/bin within a slot.) If this wins on hardware, the
+    # fused builder's deep levels get the same treatment.
+    @jax.jit
+    def big_hist_sorted(xb, y, nid):
+        order = jnp.argsort(nid)
+        return hist_ops.class_histogram(
+            xb[order], y[order], nid[order], jnp.int32(0), n_slots=K,
+            n_bins=B, n_classes=C, sample_weight=w1,
+        )
+
+    try:
+        s_sorted = timed(big_hist_sorted, xb, y, nid)
+        res["hist_K4096_sorted"] = {
+            "seconds": round(s_sorted, 5),
+            "g_updates_per_s": round(N * F / s_sorted / 1e9, 3),
+            "speedup_vs_scatter": round(s / s_sorted, 2),
+        }
+    except Exception as e:  # noqa: BLE001 — diagnostic section only
+        res["hist_K4096_sorted"] = {"error": f"{type(e).__name__}: {e}"}
     roof = next(
         (v for k, v in HBM_ROOFLINE_GBPS.items() if k in kind), None
     )

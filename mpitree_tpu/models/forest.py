@@ -182,6 +182,10 @@ class _BaseForest(BaseEstimator):
         prev_trees = self._warm_start_trees()
         sample_weight = validate_sample_weight(sample_weight, n)
         rng = np.random.default_rng(self.random_state)
+        # Host binning on purpose (vs the tree estimators' bin_for_engine):
+        # a forest bins ONCE for T tree builds, so the device-binning win is
+        # amortized away, while the host copy feeds every per-tree failover
+        # without an ensure-host seam through the tree_b replaces.
         binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
         use_host = prefer_host_path(*X.shape, self.n_devices, self.backend)
         mesh = None if use_host else mesh_lib.resolve_mesh(

@@ -299,11 +299,12 @@ def bin_dataset_device(
 
     X = np.ascontiguousarray(X, dtype=np.float32)
     n_samples, n_features = X.shape
-    if max_bins < 2:
-        # Degenerate: zero candidates everywhere. The device kernel's
-        # dedup seeds a first-occurrence column that would miscount a
-        # 0-wide candidate set; host handles it (and is bit-identical by
-        # definition of "no work").
+    if max_bins < 2 or n_samples < 1:
+        # Degenerate: zero candidates everywhere (max_bins=1), or an empty
+        # row axis whose quantile gather indices would be -1. The device
+        # kernel's dedup seeds a first-occurrence column that would
+        # miscount a 0-wide candidate set; host handles both (and is
+        # bit-identical by definition of "no work").
         return bin_dataset(X, max_bins=max_bins, binning=binning)
     # Host f64 index arithmetic — the ONE shared copy (_quantile_indices).
     qidx = jnp.asarray(
@@ -340,8 +341,10 @@ def bin_for_engine(
     the sort/compare-reduce program is ~26x slower than the numpy path
     (100k x 54: 25.9s vs 1.0s), so the CPU backend (tests, bench fallback)
     keeps host binning. "exact" mode is host-only (dynamic candidate
-    count). ``MPITREE_TPU_DEVICE_BIN=1`` forces the device path on any
-    backend (the identity tests use it); ``=0`` disables it everywhere.
+    count). ``MPITREE_TPU_DEVICE_BIN=1`` forces the device path whenever a
+    device engine will consume the result — it has no effect on host-tier
+    fits (``device=False``), which have no device build to feed; ``=0``
+    disables device binning everywhere.
     Any device FAILURE falls back to host binning — the elastic principle:
     a flaky accelerator costs wall-clock, never the fit (bit-identical
     outputs) — but a device HANG blocks here exactly as the subsequent

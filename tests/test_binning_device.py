@@ -72,6 +72,11 @@ def test_single_row_and_single_feature():
     _assert_identical(bin_dataset(X), bin_dataset_device(X))
 
 
+def test_zero_rows_degenerate():
+    X = np.empty((0, 3), np.float32)
+    _assert_identical(bin_dataset(X), bin_dataset_device(X))
+
+
 def test_max_bins_one_degenerate():
     # Q=0: zero candidates everywhere; host returns (F, 1) +inf thresholds
     # and n_cand 0 — the device path must match exactly (it delegates).
