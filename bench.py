@@ -609,7 +609,7 @@ def main():
 
                     jax.config.update("jax_platforms", "cpu")
                     platform = "cpu"
-                    is_accel = False  # downstream gates: embed tpu_last_known
+                    is_accel = False
                     detail["platform"] = "cpu (tpu fit fell back)"
                     if cpu_fallback_rows() != n_rows:
                         X, Xtr, Xte, ytr, yte = load_and_split(
@@ -675,18 +675,18 @@ def main():
             errors["forest"] = f"{type(e).__name__}: {e}"
 
         # --- last committed TPU measurement (BENCH_TPU.jsonl) ---------------
-        # When the live platform is not a TPU the round's artifact would
-        # otherwise carry no TPU number at all; embed the newest committed
-        # line captured by bench_tpu.py while the tunnel was up.
-        if not is_accel:
-            try:
-                from bench_tpu import latest_line
+        # Embed the merged committed capture unconditionally: on a CPU
+        # fallback it is the round's only TPU number; on a live accelerator
+        # it still carries sections this run does not measure (tier-swept
+        # histogram throughput, refine sweep, watcher retries).
+        try:
+            from bench_tpu import latest_line
 
-                last = latest_line()
-                if last is not None:
-                    detail["tpu_last_known"] = last
-            except Exception as e:  # noqa: BLE001
-                errors["tpu_last_known"] = f"{type(e).__name__}: {e}"
+            last = latest_line()
+            if last is not None:
+                detail["tpu_last_known"] = last
+        except Exception as e:  # noqa: BLE001
+            errors["tpu_last_known"] = f"{type(e).__name__}: {e}"
 
         # --- sklearn parity anchor ------------------------------------------
         try:

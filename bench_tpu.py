@@ -297,9 +297,12 @@ def worker_hist_tput(npz_path: str) -> dict:
     @jax.jit
     def big_hist_sorted(xb, y, nid):
         order = jnp.argsort(nid)
+        # The weight gather rides along so this stays a faithful template
+        # for the fused builder (whose sample_weight is non-uniform under
+        # bagging) and its cost is charged to the variant.
         return hist_ops.class_histogram(
             xb[order], y[order], nid[order], jnp.int32(0), n_slots=K,
-            n_bins=B, n_classes=C, sample_weight=w1,
+            n_bins=B, n_classes=C, sample_weight=w1[order],
         )
 
     try:

@@ -428,12 +428,33 @@ def build_tree(
             )
         engine = "fused"  # feature sharding exists only in the fused body
     if engine == "auto" and not debug:
-        # Measured crossover on a tunneled v5e (531k x 54 covtype-like,
-        # depth 20): levelwise 18.0s warm vs fused 23.1s — per-level compute
-        # (~0.7s) dwarfs dispatch latency at scale, while small builds are
-        # dispatch-bound and favor the single fused program.
-        N_cells = binned.x_binned.shape[0] * binned.x_binned.shape[1]
-        engine = "levelwise" if N_cells >= LEVELWISE_MIN_CELLS else "fused"
+        # Depth-capped CROWN builds (the hybrid's device half; every level's
+        # frontier fits the tier chain, 2^(d-1) <= max tier) always take the
+        # fused program: BENCH_TPU.jsonl r4 line 1 measured the levelwise
+        # crown paying ~1.8s of tunnel dispatch PER LEVEL (split phase
+        # 12.9s / 7 levels) while the fused program averaged 0.88s/level
+        # for the full depth-20 build (15.76s / 20) INCLUDING the deep
+        # scatter levels the crown never reaches.
+        N_, F_ = binned.x_binned.shape
+        C_ = n_classes if cfg.task == "classification" else 3
+        K_ = _chunk_size(N_, F_, binned.n_bins, C_, cfg)
+        tiers_t = valid_tiers(cfg.frontier_tiers, K_)
+        crown = (
+            cfg.max_depth is not None
+            and tiers_t
+            and 2 ** (int(cfg.max_depth) - 1) <= max(tiers_t)
+        )
+        if crown:
+            engine = "fused"
+        else:
+            # Full-depth crossover, measured round 2 on a tunneled v5e
+            # (531k x 54 covtype-like, depth 20): levelwise 18.0s warm vs
+            # fused 23.1s — per-level compute dwarfs dispatch at scale.
+            # That measurement predates the packed per-level transfer and
+            # the MXU middle tiers; re-derivation rides on the
+            # engine_levelwise section of BENCH_TPU.jsonl.
+            N_cells = binned.x_binned.shape[0] * binned.x_binned.shape[1]
+            engine = "levelwise" if N_cells >= LEVELWISE_MIN_CELLS else "fused"
     if engine == "fused":
         if debug:
             import warnings
