@@ -609,7 +609,6 @@ def main():
 
                     jax.config.update("jax_platforms", "cpu")
                     platform = "cpu"
-                    is_accel = False
                     detail["platform"] = "cpu (tpu fit fell back)"
                     if cpu_fallback_rows() != n_rows:
                         X, Xtr, Xte, ytr, yte = load_and_split(

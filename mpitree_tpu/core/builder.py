@@ -427,6 +427,11 @@ def build_tree(
                 stacklevel=2,
             )
         engine = "fused"  # feature sharding exists only in the fused body
+    task = cfg.task
+    N, F = binned.x_binned.shape
+    B = binned.n_bins
+    C = n_classes if task == "classification" else 3
+    K = _chunk_size(N, F, B, C, cfg)
     if engine == "auto" and not debug:
         # Depth-capped CROWN builds (the hybrid's device half; every level's
         # frontier fits the tier chain, 2^(d-1) <= max tier) always take the
@@ -435,10 +440,7 @@ def build_tree(
         # 12.9s / 7 levels) while the fused program averaged 0.88s/level
         # for the full depth-20 build (15.76s / 20) INCLUDING the deep
         # scatter levels the crown never reaches.
-        N_, F_ = binned.x_binned.shape
-        C_ = n_classes if cfg.task == "classification" else 3
-        K_ = _chunk_size(N_, F_, binned.n_bins, C_, cfg)
-        tiers_t = valid_tiers(cfg.frontier_tiers, K_)
+        tiers_t = valid_tiers(cfg.frontier_tiers, K)
         crown = (
             cfg.max_depth is not None
             and tiers_t
@@ -453,8 +455,7 @@ def build_tree(
             # That measurement predates the packed per-level transfer and
             # the MXU middle tiers; re-derivation rides on the
             # engine_levelwise section of BENCH_TPU.jsonl.
-            N_cells = binned.x_binned.shape[0] * binned.x_binned.shape[1]
-            engine = "levelwise" if N_cells >= LEVELWISE_MIN_CELLS else "fused"
+            engine = "levelwise" if N * F >= LEVELWISE_MIN_CELLS else "fused"
     if engine == "fused":
         if debug:
             import warnings
@@ -473,11 +474,6 @@ def build_tree(
             timer=timer, return_leaf_ids=return_leaf_ids,
             feature_sampler=feature_sampler, mono_cst=mono_cst,
         )
-    task = cfg.task
-    N, F = binned.x_binned.shape
-    B = binned.n_bins
-    C = n_classes if task == "classification" else 3
-
     with timer.phase("shard"):
         xb_d, y_d, w_d, nid_d, cand_mask_d = mesh_lib.shard_build_inputs(
             mesh, binned, y, sample_weight
@@ -509,7 +505,6 @@ def build_tree(
         mono_cst32 = np.ascontiguousarray(mono_cst, np.int32)
         bounds = BoundsStore()
 
-    K = _chunk_size(N, F, B, C, cfg)
     U = _table_slots(N, cfg)
     use_pallas = resolve_hist_kernel(
         cfg, mesh.devices.flat[0].platform, task,

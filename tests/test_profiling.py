@@ -55,6 +55,27 @@ def test_profile_env_sets_fit_stats(monkeypatch):
     assert clf.fit_stats_ is None
 
 
+def test_crown_builds_route_fused_even_at_scale(monkeypatch):
+    """Depth-capped crowns take the fused program regardless of N_cells
+    (BENCH_TPU.jsonl r4: per-level tunnel dispatch dominates the crown),
+    while full-depth builds above the crossover keep the levelwise loop."""
+    import mpitree_tpu.core.builder as builder_mod
+
+    X, y = _data()
+    monkeypatch.setenv("MPITREE_TPU_PROFILE", "1")
+    # Force the N_cells crossover to always prefer levelwise: the crown
+    # rule must still win for a depth-capped build.
+    monkeypatch.setattr(builder_mod, "LEVELWISE_MIN_CELLS", 0)
+    crown = DecisionTreeClassifier(
+        max_depth=6, backend="cpu", refine_depth=None
+    ).fit(X, y)
+    assert "fused_build" in crown.fit_stats_
+    deep = DecisionTreeClassifier(
+        max_depth=None, backend="cpu", refine_depth=None
+    ).fit(X, y)
+    assert "split" in deep.fit_stats_  # levelwise phases
+
+
 def test_determinism_check_passes_on_mesh():
     """The psum-fingerprint tripwire is clean on a real 8-device mesh build,
     and the debug build returns the identical tree."""
