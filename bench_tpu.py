@@ -425,6 +425,39 @@ def run_section(name: str, npz_path: str, timeout_s: int,
     )
 
 
+def read_capture_lines(path: str = OUT_PATH) -> list:
+    """Parse the jsonl tolerantly: a SIGKILL mid-append (the watcher's own
+    timeout path) can leave one truncated line, which must not discard the
+    whole file's history."""
+    records = []
+    try:
+        with open(path) as f:
+            for ln in f:
+                if not ln.strip():
+                    continue
+                try:
+                    records.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        return []
+    return records
+
+
+def is_genuine_capture(rec: dict, *, full_only: bool = False) -> bool:
+    """The ONE copy of the 'real accelerator measurement' predicate.
+
+    Shared by latest_line's merge and the watcher's done/success checks so
+    they can never drift. full_only additionally rejects --rows smoke
+    lines (records predating the rows_cap field were all full-workload).
+    """
+    return (
+        rec.get("platform_probe") in ("tpu", "axon")
+        and any(k in rec for k in WORKERS)
+        and not (full_only and rec.get("rows_cap") is not None)
+    )
+
+
 def latest_line(path: str = OUT_PATH, *, full_only: bool = False) -> dict | None:
     """Newest genuine TPU data, merged per-section — bench.py's tpu_last_known.
 
@@ -439,20 +472,9 @@ def latest_line(path: str = OUT_PATH, *, full_only: bool = False) -> dict | None
     (``platform_probe`` != tpu/axon) and lines with no successful section
     contribute nothing.
     """
-    try:
-        with open(path) as f:
-            records = [json.loads(ln) for ln in f if ln.strip()]
-    except (OSError, json.JSONDecodeError):
-        return None
     genuine = [
-        rec for rec in records
-        if rec.get("platform_probe") in ("tpu", "axon")
-        and any(k in rec for k in WORKERS)
-        # full_only (the watcher's done-check): ignore --rows smoke lines
-        # entirely, so a newest smoke capture can neither satisfy nor
-        # reset the full-workload queue. Records predating the rows_cap
-        # field were all full-workload runs.
-        and not (full_only and rec.get("rows_cap") is not None)
+        rec for rec in read_capture_lines(path)
+        if is_genuine_capture(rec, full_only=full_only)
     ]
     if not genuine:
         return None

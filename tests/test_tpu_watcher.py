@@ -1,0 +1,88 @@
+"""The tunnel watcher's queue logic: done-checks and redo accounting.
+
+The watcher (tools/tpu_watcher.py) decides which bench sections still need
+a TPU capture. Two different questions, two helpers: section_done asks
+"does the merged embed carry it" (queue init), capture_count asks "how
+many raw full-workload lines carry it" (a --redo run must append a NEW
+line — the pre-existing capture must not make a failed rerun look
+successful).
+"""
+
+import importlib.util
+import json
+from pathlib import Path
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def watcher():
+    spec = importlib.util.spec_from_file_location(
+        "tpu_watcher",
+        Path(__file__).resolve().parents[1] / "tools" / "tpu_watcher.py",
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write(tmp_path, records):
+    p = tmp_path / "BENCH_TPU.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in records))
+    return str(p)
+
+
+FULL = {"platform_probe": "tpu", "dataset": "covtype_like (531012x54)",
+        "depth": 20, "refine_depth": 7, "rows_cap": None}
+
+
+def test_section_done_and_capture_count(watcher, tmp_path):
+    p = _write(tmp_path, [
+        {"ts": "t1", **FULL, "north_star": {"warm_s": 20.5}},
+        {"ts": "t2", **FULL, "north_star": {"warm_s": 19.0}},
+    ])
+    assert watcher.section_done("north_star", p)
+    assert not watcher.section_done("hist_tput", p)
+    assert watcher.capture_count("north_star", p) == 2
+    assert watcher.capture_count("hist_tput", p) == 0
+
+
+def test_smoke_lines_count_for_neither(watcher, tmp_path):
+    smoke = dict(FULL, dataset="covtype_like (100000x54)", rows_cap=100000)
+    p = _write(tmp_path, [
+        {"ts": "t1", **smoke, "north_star": {"warm_s": 4.0}},
+    ])
+    assert not watcher.section_done("north_star", p)
+    assert watcher.capture_count("north_star", p) == 0
+
+
+def test_capture_count_sees_lines_outside_merge_group(watcher, tmp_path):
+    # A redo under changed workload defaults re-keys the merge; the raw
+    # count must still register the old-key line so `after > before`
+    # reflects exactly one new append.
+    other = dict(FULL, refine_depth=8)
+    p = _write(tmp_path, [
+        {"ts": "t1", **FULL, "north_star": {"warm_s": 20.5}},
+        {"ts": "t2", **other, "north_star": {"warm_s": 15.0}},
+    ])
+    assert watcher.capture_count("north_star", p) == 2
+    # section_done keys to the newest group (refine_depth=8)
+    assert watcher.section_done("north_star", p)
+
+
+def test_missing_file(watcher, tmp_path):
+    p = str(tmp_path / "nope.jsonl")
+    assert not watcher.section_done("north_star", p)
+    assert watcher.capture_count("north_star", p) == 0
+
+
+def test_truncated_line_does_not_discard_history(watcher, tmp_path):
+    # A SIGKILL mid-append (the watcher's own timeout path) can truncate
+    # the final line; earlier captures must still count and merge.
+    p = tmp_path / "BENCH_TPU.jsonl"
+    p.write_text(
+        json.dumps({"ts": "t1", **FULL, "north_star": {"warm_s": 20.5}})
+        + "\n" + '{"ts": "t2", "platform_probe": "tpu", "north_'
+    )
+    assert watcher.capture_count("north_star", str(p)) == 1
+    assert watcher.section_done("north_star", str(p))

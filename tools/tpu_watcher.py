@@ -72,7 +72,7 @@ def probe_ok(timeout_s: int = 75) -> bool:
         return False
 
 
-def section_done(sec: str) -> bool:
+def section_done(sec: str, path: str = JSONL) -> bool:
     """True if the merged FULL-WORKLOAD TPU picture carries this section.
 
     Delegates to bench_tpu.latest_line so the watcher's notion of "done"
@@ -83,10 +83,10 @@ def section_done(sec: str) -> bool:
     """
     from bench_tpu import latest_line
 
-    return sec in (latest_line(JSONL, full_only=True) or {})
+    return sec in (latest_line(path, full_only=True) or {})
 
 
-def capture_count(sec: str) -> int:
+def capture_count(sec: str, path: str = JSONL) -> int:
     """How many genuine full-workload lines in the FILE carry this section.
 
     Counts raw lines, NOT latest_line's merge: a --redo run must produce a
@@ -95,18 +95,14 @@ def capture_count(sec: str) -> int:
     workload group must still count as captured. A concurrent operator run
     appending the same section is indistinguishable here — acceptable for
     a babysitting tool whose worst case is one redundant re-measure.
+    The line predicate and the tolerant parse are bench_tpu's (the one
+    copy — see is_genuine_capture).
     """
-    import json
+    from bench_tpu import is_genuine_capture, read_capture_lines
 
-    try:
-        with open(JSONL) as f:
-            recs = [json.loads(ln) for ln in f if ln.strip()]
-    except (OSError, json.JSONDecodeError):
-        return 0
     return sum(
-        1 for r in recs
-        if r.get("platform_probe") in ("tpu", "axon")
-        and r.get("rows_cap") is None and sec in r
+        1 for r in read_capture_lines(path)
+        if is_genuine_capture(r, full_only=True) and sec in r
     )
 
 
