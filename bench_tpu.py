@@ -429,18 +429,19 @@ def read_capture_lines(path: str = OUT_PATH) -> list:
     """Parse the jsonl tolerantly: a SIGKILL mid-append (the watcher's own
     timeout path) can leave one truncated line, which must not discard the
     whole file's history."""
-    records = []
+    records: list = []
     try:
-        with open(path) as f:
-            for ln in f:
-                if not ln.strip():
-                    continue
-                try:
-                    records.append(json.loads(ln))
-                except json.JSONDecodeError:
-                    continue
+        f = open(path)
     except OSError:
-        return []
+        return records
+    with f:
+        for ln in f:
+            if not ln.strip():
+                continue
+            try:
+                records.append(json.loads(ln))
+            except json.JSONDecodeError:
+                continue
     return records
 
 

@@ -72,10 +72,10 @@ class BuildConfig:
     # Device build engine: "fused" = whole build in one compiled
     # lax.while_loop program (fused_builder.py — no per-level host round
     # trips); "levelwise" = host-orchestrated level loop (keeps per-phase
-    # timers and the on-device determinism check). "auto" picks levelwise
-    # for builds >= LEVELWISE_MIN_CELLS (per-level compute dwarfs dispatch
-    # there — measured) or when debug needs its instrumentation, fused
-    # otherwise. MPITREE_TPU_ENGINE overrides.
+    # timers and the on-device determinism check). "auto" picks fused
+    # (measured faster at every scale on tunneled transport — see
+    # build_tree's engine resolution) unless debug needs the levelwise
+    # instrumentation. MPITREE_TPU_ENGINE overrides.
     engine: str = "auto"
     # Histogram kernel for frontier-tier levels in BOTH device engines:
     # "auto" = the Mosaic one-hot-matmul kernel (ops/pallas_hist.py) where
@@ -103,9 +103,11 @@ class BuildConfig:
 # the arithmetic and the numpy fast path (host_builder.py) wins outright.
 HOST_PATH_MAX_CELLS = 1 << 19
 
-# Above this many cells the per-level compute dwarfs dispatch latency and
-# the host-orchestrated levelwise engine beats the fused while_loop program
-# (measured on the tunneled v5e — see build_tree's engine resolution).
+# Round-2 crossover above which levelwise was measured to beat fused
+# (18.0s vs 23.1s at covtype depth 20). No longer consulted by "auto" —
+# BENCH_TPU.jsonl r4 line 1 contradicts it on current transport (see
+# build_tree's engine resolution) — kept for the escape-hatch story and
+# re-derivation against the engine_levelwise capture.
 LEVELWISE_MIN_CELLS = 16 << 20
 
 
@@ -433,29 +435,20 @@ def build_tree(
     C = n_classes if task == "classification" else 3
     K = _chunk_size(N, F, B, C, cfg)
     if engine == "auto" and not debug:
-        # Depth-capped CROWN builds (the hybrid's device half; every level's
-        # frontier fits the tier chain, 2^(d-1) <= max tier) always take the
-        # fused program: BENCH_TPU.jsonl r4 line 1 measured the levelwise
-        # crown paying ~1.8s of tunnel dispatch PER LEVEL (split phase
-        # 12.9s / 7 levels) while the fused program averaged 0.88s/level
-        # for the full depth-20 build (15.76s / 20) INCLUDING the deep
-        # scatter levels the crown never reaches.
-        tiers_t = valid_tiers(cfg.frontier_tiers, K)
-        crown = (
-            cfg.max_depth is not None
-            and tiers_t
-            and 2 ** (int(cfg.max_depth) - 1) <= max(tiers_t)
-        )
-        if crown:
-            engine = "fused"
-        else:
-            # Full-depth crossover, measured round 2 on a tunneled v5e
-            # (531k x 54 covtype-like, depth 20): levelwise 18.0s warm vs
-            # fused 23.1s — per-level compute dwarfs dispatch at scale.
-            # That measurement predates the packed per-level transfer and
-            # the MXU middle tiers; re-derivation rides on the
-            # engine_levelwise section of BENCH_TPU.jsonl.
-            engine = "levelwise" if N * F >= LEVELWISE_MIN_CELLS else "fused"
+        # One compiled program beats per-level dispatch on the committed
+        # evidence (BENCH_TPU.jsonl r4 line 1): the fused engine built the
+        # full depth-20 covtype tree in 17.5s warm (0.88s/level including
+        # its deep scatter levels) while the levelwise crown paid ~1.84s of
+        # tunnel dispatch PER LEVEL (split phase 12.9s over 7 levels) —
+        # projecting ~38s full-depth. Round 2 had measured the opposite
+        # (levelwise 18.0s vs fused 23.1s), but that predates the packed
+        # per-level transfer and the MXU middle tiers, and the crossover is
+        # transport-latency-dependent. MPITREE_TPU_ENGINE=levelwise (or
+        # BuildConfig(engine="levelwise")) remains the escape hatch for
+        # direct-attached parts where dispatch is ~free; the
+        # engine_levelwise capture section re-derives the crossover when
+        # the tunnel allows.
+        engine = "fused"
     if engine == "fused":
         if debug:
             import warnings

@@ -55,17 +55,12 @@ def test_profile_env_sets_fit_stats(monkeypatch):
     assert clf.fit_stats_ is None
 
 
-def test_crown_builds_route_fused_even_at_scale(monkeypatch):
-    """Depth-capped crowns take the fused program regardless of N_cells
-    (BENCH_TPU.jsonl r4: per-level tunnel dispatch dominates the crown),
-    while full-depth builds above the crossover keep the levelwise loop."""
-    import mpitree_tpu.core.builder as builder_mod
-
+def test_auto_engine_routes_fused_at_every_depth(monkeypatch):
+    """Auto = the fused program at any depth cap (BENCH_TPU.jsonl r4:
+    one compiled program beat per-level dispatch at every measured scale);
+    the levelwise loop stays reachable via the env escape hatch."""
     X, y = _data()
     monkeypatch.setenv("MPITREE_TPU_PROFILE", "1")
-    # Force the N_cells crossover to always prefer levelwise: the crown
-    # rule must still win for a depth-capped build.
-    monkeypatch.setattr(builder_mod, "LEVELWISE_MIN_CELLS", 0)
     crown = DecisionTreeClassifier(
         max_depth=6, backend="cpu", refine_depth=None
     ).fit(X, y)
@@ -73,7 +68,12 @@ def test_crown_builds_route_fused_even_at_scale(monkeypatch):
     deep = DecisionTreeClassifier(
         max_depth=None, backend="cpu", refine_depth=None
     ).fit(X, y)
-    assert "split" in deep.fit_stats_  # levelwise phases
+    assert "fused_build" in deep.fit_stats_
+    monkeypatch.setenv("MPITREE_TPU_ENGINE", "levelwise")
+    lw = DecisionTreeClassifier(
+        max_depth=None, backend="cpu", refine_depth=None
+    ).fit(X, y)
+    assert "split" in lw.fit_stats_  # levelwise phases
 
 
 def test_determinism_check_passes_on_mesh():
