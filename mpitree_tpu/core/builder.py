@@ -265,6 +265,14 @@ class _TreeBuffer:
         self.n_node_samples = np.zeros(self.cap, np.int64)
         self.impurity = np.zeros(self.cap, np.float64)
 
+    # Grown regions must match __init__'s fills: nodes allocated there and
+    # left as leaves keep the pad value — threshold's leaf contract is NaN
+    # (TreeArrays docstring), and a 0 fill leaked 0.0 leaf thresholds on
+    # every tree past 256 nodes (caught by the depth-boundary identity
+    # test; the depth-5 fuzz trees never grew).
+    _GROW_FILL = {"feature": -1, "threshold": np.nan, "left": -1,
+                  "right": -1, "parent": -1}
+
     def ensure(self, n: int) -> None:
         if n <= self.cap:
             return
@@ -273,8 +281,8 @@ class _TreeBuffer:
                      "depth", "value", "count", "n_node_samples", "impurity"):
             old = getattr(self, name)
             shape = (new_cap,) + old.shape[1:]
-            fill = -1 if old.dtype == np.int32 and name != "depth" else 0
-            new = np.full(shape, fill, old.dtype) if old.ndim == 1 else np.zeros(shape, old.dtype)
+            fill = self._GROW_FILL.get(name, 0)
+            new = np.full(shape, fill, old.dtype)
             new[: self.cap] = old
             setattr(self, name, new)
         self.cap = new_cap

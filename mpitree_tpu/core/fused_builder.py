@@ -151,6 +151,27 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
     K, C = n_slots, n_classes
     M = max_nodes + n_slots
     tiers = builder_valid_tiers(tiers, K)
+    # Depth-capped builds bound every INTERIOR frontier at 2^(max_depth-1)
+    # (the terminal level runs the counts-only branch regardless): tiers
+    # that can never be the narrowest fit, and — when the widest interior
+    # frontier fits a tier — the K-slot interior sweep itself, are
+    # unreachable cond branches. Compiling them anyway costs tens of
+    # seconds through the remote-compile tunnel (the K-slot histogram +
+    # gain sweep is the largest executable in the program); crown programs
+    # (the hybrid's device half) drop them here.
+    max_interior = (
+        2 ** max(int(max_depth) - 1, 0) if max_depth >= 0 else None
+    )
+    if max_interior is not None and tiers:
+        kept, prev = [], 0
+        for t in tiers:
+            if prev < max_interior:
+                kept.append(t)
+            prev = t
+        tiers = tuple(kept)
+    interior_big_reachable = not (
+        max_interior is not None and tiers and max_interior <= max(tiers)
+    )
     hist_vma = tuple(a for a in (psum_axis, feature_axis) if a is not None)
     sampling = sample_k is not None or random_split
     if sampling and feature_axis is not None:
@@ -356,7 +377,13 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                         out = out + (jnp.zeros(K, jnp.float32),)
                     return out
 
-                pieces = lax.cond(terminal, term, interior, None)
+                if not interior_big_reachable:
+                    # Every interior frontier fits a tier branch, so the
+                    # big path only ever runs terminal counts — don't
+                    # compile the K-slot sweep at all (crown programs).
+                    pieces = term(None)
+                else:
+                    pieces = lax.cond(terminal, term, interior, None)
                 return write_bufs(bufs, pieces, chunk_lo)
 
             def big_level(bufs):
