@@ -118,6 +118,27 @@ def test_estimator_identity_device_vs_host_binning(monkeypatch):
     assert dev_tree == host_tree
 
 
+def test_device_binned_uneven_rows_pad_on_device(monkeypatch):
+    """N not divisible by the mesh width exercises pad_row_arrays' jnp
+    branch (np.concatenate would silently pull the device matrix back to
+    host); the fitted tree must equal the host-binned fit regardless."""
+    from mpitree_tpu import DecisionTreeClassifier
+
+    rng = np.random.default_rng(3)
+    X = np.round(rng.normal(size=(401, 4)), 1).astype(np.float32)
+    y = rng.integers(0, 3, 401)
+
+    def fit():
+        return DecisionTreeClassifier(
+            max_depth=5, max_bins=16, backend="cpu", n_devices=8
+        ).fit(X, y)
+
+    monkeypatch.setenv("MPITREE_TPU_DEVICE_BIN", "1")
+    dev_tree = fit().export_text()
+    monkeypatch.setenv("MPITREE_TPU_DEVICE_BIN", "0")
+    assert dev_tree == fit().export_text()
+
+
 def test_device_array_output_feeds_builders():
     """x_binned comes back as a jax.Array (device-resident) — the point of
     the exercise; the shard step must not silently round-trip it to host."""
