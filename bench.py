@@ -1,6 +1,7 @@
 """North-star benchmark: depth-20 tree build on covtype-scale data.
 
-Prints ONE JSON line to stdout:
+Prints the full JSON record, then a compact (<=1000 char) headline as the
+FINAL stdout line (the driver parses only a ~2000-char tail):
   {"metric": ..., "value": <our warm fit seconds>, "unit": "s",
    "vs_baseline": <estimated 8-rank MPI reference seconds / ours>, ...}
 
@@ -681,7 +682,10 @@ def main():
         try:
             from bench_tpu import latest_line
 
-            last = latest_line()
+            # Prefer the full-workload merge: a trailing --rows smoke line
+            # would otherwise re-key the merge and displace every
+            # full-workload section from the round artifact.
+            last = latest_line(full_only=True) or latest_line()
             if last is not None:
                 detail["tpu_last_known"] = last
         except Exception as e:  # noqa: BLE001
@@ -726,7 +730,38 @@ def main():
     finally:
         if errors:
             detail["errors"] = errors
+        # Full record first (for humans / logs), then a compact headline as
+        # the FINAL stdout line: the driver keeps only a ~2000-char tail and
+        # parses the last JSON line, so the ~4KB full record alone gets its
+        # head (value, vs_baseline) truncated away (round-4 BENCH_r04.json
+        # landed `parsed: null` exactly this way).
         print(json.dumps(result))
+        compact = {k: result.get(k) for k in
+                   ("metric", "value", "unit", "vs_baseline")}
+        cd = {}
+        for k in ("platform", "ours_test_acc", "acc_delta_vs_sklearn",
+                  "tree_depth", "tree_n_nodes", "throughput_cells_per_s",
+                  "sklearn_s", "mpi8_ideal_s", "vs_baseline_observed"):
+            if k in detail:
+                cd[k] = detail[k]
+        tpu = detail.get("tpu_last_known")
+        if isinstance(tpu, dict):
+            tcd = {k: tpu.get(k) for k in ("ts", "git", "platform_probe")
+                   if k in tpu}
+            for sec in ("north_star", "north_star_fused", "engine_fused"):
+                s = tpu.get(sec)
+                if isinstance(s, dict) and "warm_s" in s:
+                    tcd[sec + "_warm_s"] = s["warm_s"]
+            cd["tpu_last_known"] = tcd
+        if errors:
+            cd["error_keys"] = sorted(errors)
+        compact["detail"] = cd
+        line = json.dumps(compact)
+        if len(line) > 1000:  # hard contract: the driver tail must hold it
+            compact["detail"] = {k: cd[k] for k in ("platform",
+                                 "ours_test_acc") if k in cd}
+            line = json.dumps(compact)
+        print(line)
 
 
 if __name__ == "__main__":

@@ -213,6 +213,36 @@ def resolve_hist_kernel(cfg: BuildConfig, platform: str, task: str, *,
     return pallas_hist.pallas_available(platform) and exact
 
 
+def resolve_wide_hist(cfg: BuildConfig, task: str, *,
+                      integer_ok: bool, sample_weight=None) -> tuple:
+    """(use_wide, bf16_ok) for the sorted window-packed deep-level tier.
+
+    Same exactness policy as :func:`resolve_hist_kernel`: under "auto" the
+    wide matmul histogram (``ops/wide_hist.py``) replaces the scatter only
+    where it is bit-identical to it — classification with integer weights.
+    It additionally runs the matmul inputs in bfloat16 (2x MXU rate) when
+    every payload value is an integer <= 256 (exactly representable in
+    bf16's 8-bit mantissa) — unit and bootstrap weights always qualify.
+    ``MPITREE_TPU_WIDE_HIST``: "0" disables, "1" forces it for ALL
+    payloads (the same explicit identity opt-out as hist_kernel="pallas":
+    f32 accumulation whose summation order differs from the scatter's).
+    Unlike the Pallas kernel this is pure XLA, so it is not gated on a
+    TPU backend — the identity tests ride it on CPU.
+    """
+    flag = os.environ.get("MPITREE_TPU_WIDE_HIST", "auto")
+    if flag == "0":
+        return False, False
+    exact = task == "classification" and integer_ok
+    if not exact and flag != "1":
+        return False, False
+    bf16 = bool(
+        exact
+        and (sample_weight is None
+             or float(np.max(sample_weight, initial=0.0)) <= 256.0)
+    )
+    return True, bf16
+
+
 def integer_weights(sample_weight) -> bool:
     """True when raw class counts can stay integral (the reference's
     predict_proba contract) — i.e. no fractional sample weights."""
@@ -507,14 +537,17 @@ def build_tree(
         bounds = BoundsStore()
 
     U = _table_slots(N, cfg)
+    int_ok = integer_weights(sample_weight)
     use_pallas = resolve_hist_kernel(
-        cfg, mesh.devices.flat[0].platform, task,
-        integer_ok=integer_weights(sample_weight),
+        cfg, mesh.devices.flat[0].platform, task, integer_ok=int_ok,
+    )
+    use_wide, wide_bf16 = resolve_wide_hist(
+        cfg, task, integer_ok=int_ok, sample_weight=sample_weight,
     )
     # Levelwise keeps only Pallas-eligible tiers: that is where the measured
     # win lives (the MXU kernel beat the scatter 3.3x at S=8), while XLA
     # tiers saved <3% warm and cost an extra ~20-40s tunnel compile each.
-    from mpitree_tpu.ops import pallas_hist
+    from mpitree_tpu.ops import pallas_hist, wide_hist
 
     tiers = (
         tuple(
@@ -525,11 +558,16 @@ def build_tree(
     )
 
     def split_fn_for(frontier: int):
-        """Narrowest tier the frontier fits (Pallas), else the K-slot sweep."""
+        """Narrowest tier the frontier fits (Pallas), else the K-slot sweep
+        (wide-width sweeps ride the sorted window-packed matmul tier)."""
         S = next((s for s in tiers if frontier <= s), K)
         return S, collective.make_split_fn(
             mesh, n_slots=S, n_bins=B, n_classes=C, task=task,
             criterion=cfg.criterion, debug=debug, use_pallas=S in tiers,
+            use_wide=(use_wide and S not in tiers
+                      and S >= wide_hist.MIN_SLOTS
+                      and S % wide_hist.WINDOW == 0),
+            wide_bf16=wide_bf16,
             node_mask=sampling,
             random_split=sampling and feature_sampler.random_split,
             monotonic=mono,

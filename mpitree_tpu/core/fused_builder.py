@@ -42,12 +42,14 @@ from mpitree_tpu.core.builder import (
     integer_weights,
     refit_regression_values,
     resolve_hist_kernel,
+    resolve_wide_hist,
     valid_tiers as builder_valid_tiers,
 )
 from mpitree_tpu.core.tree_struct import TreeArrays
 from mpitree_tpu.ops import histogram as hist_ops
 from mpitree_tpu.ops import impurity as imp_ops
 from mpitree_tpu.ops import pallas_hist
+from mpitree_tpu.ops import wide_hist
 from mpitree_tpu.ops import sampling as sampling_ops
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.parallel.collective import node_counts_local, regression_y_range
@@ -101,6 +103,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                      task: str, criterion: str, max_nodes: int,
                      max_depth: int, min_samples_split: int,
                      tiers: tuple = (), use_pallas: bool = False,
+                     use_wide: bool = False, wide_bf16: bool = False,
                      psum_axis: str | None = DATA_AXIS,
                      feature_axis: str | None = None,
                      sample_k: int | None = None,
@@ -202,7 +205,14 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
             s for s in tiers
             if use_pallas and pallas_hist.fits_vmem(F, s, C, n_bins)
         )
-        if pallas_tiers:
+        # The sorted window-packed matmul tier (ops/wide_hist.py) serves
+        # widths the Pallas VMEM budget cannot reach: the deep-level slot
+        # widths where the XLA scatter otherwise runs on the scalar unit.
+        def wide_ok(s):
+            return (use_wide and s >= wide_hist.MIN_SLOTS
+                    and s % wide_hist.WINDOW == 0)
+
+        if pallas_tiers or any(wide_ok(s) for s in (*tiers, K)):
             payload = (  # loop-invariant
                 pallas_hist.class_payload(y, w, C)
                 if task == "classification"
@@ -279,6 +289,12 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                         xb, payload, nid - chunk_lo, n_slots=n_stat_slots,
                         n_bins=n_bins, n_channels=C, vma=hist_vma,
                     )
+                elif wide_ok(n_stat_slots):
+                    h = wide_hist.histogram_wide(
+                        xb, payload, nid - chunk_lo, n_slots=n_stat_slots,
+                        n_bins=n_bins, n_channels=C, window=wide_hist.WINDOW,
+                        bf16_ok=wide_bf16, vma=hist_vma,
+                    )
                 else:
                     h = hist_ops.class_histogram(
                         xb, y, nid, chunk_lo, n_slots=n_stat_slots,
@@ -296,6 +312,12 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                     h = pallas_hist.histogram_small(
                         xb, payload, nid - chunk_lo, n_slots=n_stat_slots,
                         n_bins=n_bins, n_channels=3, vma=hist_vma,
+                    )
+                elif wide_ok(n_stat_slots):
+                    h = wide_hist.histogram_wide(
+                        xb, payload, nid - chunk_lo, n_slots=n_stat_slots,
+                        n_bins=n_bins, n_channels=3, window=wide_hist.WINDOW,
+                        bf16_ok=False, vma=hist_vma,
                     )
                 else:
                     h = hist_ops.moment_histogram(
@@ -542,7 +564,8 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
 def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                    task: str, criterion: str, max_nodes: int, max_depth: int,
                    min_samples_split: int, tiers: tuple = (),
-                   use_pallas: bool = False, sample_k: int | None = None,
+                   use_pallas: bool = False, use_wide: bool = False,
+                   wide_bf16: bool = False, sample_k: int | None = None,
                    random_split: bool = False, monotonic: bool = False):
     """Data-parallel single-tree build: rows sharded, histograms psum'd.
 
@@ -560,7 +583,8 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         n_slots=n_slots, n_bins=n_bins, n_classes=n_classes, task=task,
         criterion=criterion, max_nodes=max_nodes, max_depth=max_depth,
         min_samples_split=min_samples_split, tiers=tiers,
-        use_pallas=use_pallas, psum_axis=DATA_AXIS,
+        use_pallas=use_pallas, use_wide=use_wide, wide_bf16=wide_bf16,
+        psum_axis=DATA_AXIS,
         feature_axis=feature_axis, sample_k=sample_k,
         random_split=random_split, monotonic=monotonic,
     )
@@ -582,6 +606,7 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                     task: str, criterion: str, max_nodes: int,
                     max_depth: int, min_samples_split: int,
                     tiers: tuple = (), use_pallas: bool = False,
+                    use_wide: bool = False, wide_bf16: bool = False,
                     data_sharded: bool = False,
                     sample_k: int | None = None,
                     random_split: bool = False,
@@ -606,7 +631,7 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         n_slots=n_slots, n_bins=n_bins, n_classes=n_classes, task=task,
         criterion=criterion, max_nodes=max_nodes, max_depth=max_depth,
         min_samples_split=min_samples_split, tiers=tiers,
-        use_pallas=use_pallas,
+        use_pallas=use_pallas, use_wide=use_wide, wide_bf16=wide_bf16,
         psum_axis=DATA_AXIS if data_sharded else None,
         sample_k=sample_k, random_split=random_split, monotonic=monotonic,
     )
@@ -693,9 +718,12 @@ def build_tree_fused(
 
     K = _chunk_size(N, F, B, C, cfg)
     M = _node_capacity(N, cfg.max_depth)
+    int_ok = integer_weights(sample_weight)
     use_pallas = resolve_hist_kernel(
-        cfg, mesh.devices.flat[0].platform, task,
-        integer_ok=integer_weights(sample_weight),
+        cfg, mesh.devices.flat[0].platform, task, integer_ok=int_ok,
+    )
+    use_wide, wide_bf16 = resolve_wide_hist(
+        cfg, task, integer_ok=int_ok, sample_weight=sample_weight,
     )
 
     fn = _make_fused_fn(
@@ -704,7 +732,8 @@ def build_tree_fused(
         max_depth=-1 if cfg.max_depth is None else int(cfg.max_depth),
         min_samples_split=int(cfg.min_samples_split),
         tiers=tuple(cfg.frontier_tiers),
-        use_pallas=use_pallas, sample_k=sample_k, random_split=random_split,
+        use_pallas=use_pallas, use_wide=use_wide, wide_bf16=wide_bf16,
+        sample_k=sample_k, random_split=random_split,
         monotonic=monotonic,
     )
 
@@ -860,6 +889,9 @@ def build_forest_fused(
     use_pallas = resolve_hist_kernel(
         cfg, mesh.devices.flat[0].platform, task, integer_ok=integer_counts
     )
+    use_wide, wide_bf16 = resolve_wide_hist(
+        cfg, task, integer_ok=integer_counts, sample_weight=weights,
+    )
 
     if task == "classification" and float(weights.sum(axis=1).max()) >= 2**24:
         import warnings
@@ -877,7 +909,7 @@ def build_forest_fused(
         max_depth=-1 if cfg.max_depth is None else int(cfg.max_depth),
         min_samples_split=int(cfg.min_samples_split),
         tiers=tuple(cfg.frontier_tiers),
-        use_pallas=use_pallas,
+        use_pallas=use_pallas, use_wide=use_wide, wide_bf16=wide_bf16,
         data_sharded=data_sharded,
         sample_k=sample_k, random_split=random_split,
         monotonic=mono_cst is not None and bool(np.any(np.asarray(mono_cst))),

@@ -285,9 +285,9 @@ def bin_dataset_device(
     trip); ``thresholds``/``n_cand`` are pulled to host (a few KB) where
     predict/export need them. Only "auto" and "quantile" modes exist here:
     "exact" mode's candidate count is data-dependent (unbounded), which has
-    no static shape — callers keep host binning for it. Assumes
-    estimator-validated input (finite; NaN would corrupt the sort-based
-    dedup where the host path collapses it).
+    no static shape — callers keep host binning for it. NaN input (which
+    would corrupt the sort-based dedup) routes to the host path, which
+    collapses NaN runs — the bit-identity contract holds either way.
     """
     if binning not in ("auto", "quantile"):
         raise ValueError(
@@ -299,6 +299,12 @@ def bin_dataset_device(
 
     X = np.ascontiguousarray(X, dtype=np.float32)
     n_samples, n_features = X.shape
+    if np.isnan(X).any():
+        # NaN != NaN breaks the device kernel's sort-based dedup; the host
+        # path collapses NaN runs, so falling back keeps the documented
+        # bit-identity contract for direct callers (estimator entrypoints
+        # already validate, but this is a public module function).
+        return bin_dataset(X, max_bins=max_bins, binning=binning)
     if max_bins < 2 or n_samples < 1:
         # Degenerate: zero candidates everywhere (max_bins=1), or an empty
         # row axis whose quantile gather indices would be -1. The device

@@ -182,10 +182,18 @@ def histogram_small(
         # steps where the working set allows. An explicit row_tile is
         # always respected (test seam: small tiles exercise the
         # cross-row-tile accumulation on small N).
-        row_tile = (
-            (_fgrid_row_tile(S, C, n_bins) or 512) if mode == "fgrid"
-            else 512
-        )
+        if mode == "fgrid":
+            row_tile = _fgrid_row_tile(S, C, n_bins)
+            if row_tile is None:
+                # A forced fgrid past the VMEM sizing would fail at
+                # hardware allocation time with a Mosaic error; fail the
+                # same way auto mode's ineligibility does instead.
+                raise ValueError(
+                    f"fgrid working set exceeds VMEM budget at S={S} "
+                    f"C={C} B={n_bins}; gate callers on fits_vmem()"
+                )
+        else:
+            row_tile = 512
     Np = _round_up(max(N, 1), row_tile)
 
     if Np != N:

@@ -1,0 +1,195 @@
+"""Sorted window-packed histogram for WIDE frontiers — the deep-level tier.
+
+The build's hot op at shallow levels is served by the Pallas MXU kernel
+(``pallas_hist.py``), but its dense one-hot contraction carries an ``S*C*B``
+FLOP factor per row, so past a few hundred frontier slots it loses to the
+XLA scatter — which a TPU executes on the *scalar* unit at ~30M updates/s
+(round-4 ``BENCH_TPU.jsonl``: ~0.9 s/level on the covtype depth-20 build's
+deep levels, single-digit percent of the HBM roofline). This module removes
+the scatter from wide levels entirely:
+
+1. **Sort** rows by frontier slot (one ``argsort`` per histogram call).
+2. **Window-pack**: group sorted rows by slot *window* (``W`` consecutive
+   slots) and pad each window's run to a multiple of the row tile, so every
+   row tile intersects exactly ONE window. Pure gather construction — the
+   packed source index per position is computed with ``searchsorted`` over
+   the (tiny) per-window offset table; no scatter anywhere.
+3. **Contract**: a ``lax.scan`` over row tiles; each tile is a dense
+   ``(W*C, Rt) @ (Rt, Fc*B)`` one-hot contraction on the MXU (features in
+   chunks of ``Fc``), accumulated into its window's block of the
+   ``(S/W, ...)`` histogram via in-place ``dynamic_update_slice``.
+
+FLOPs per row are ``W*C*B`` — independent of the frontier width ``S`` — so
+a 4096-slot deep level costs the same per row as a 32-slot one. The
+reference burns these levels in per-candidate Python rescans
+(``mpitree/tree/decision_tree.py:73-86``); the shallow-tier story is in
+``pallas_hist.py``.
+
+Exactness: counts are sums of ``onehot * payload`` products. For
+integer-valued payloads (unit/bootstrap weights — the ``integer_weights``
+fast path) every product and partial sum is exactly representable in f32
+below 2**24, so the result is bit-identical to the scatter path and
+order-independent (the determinism-across-mesh-sizes contract,
+``ops/histogram.py``). ``bf16_ok=True`` additionally runs the matmul inputs
+in bfloat16 (2x MXU throughput): exact when payload values are integers
+<= 256 (bf16 has an 8-bit mantissa) — callers gate it on that. Non-integer
+float weights follow the same contract as the Pallas kernel: f32
+accumulation whose summation order may differ from the scatter's by ulps.
+
+Works on any backend (pure XLA): CPU tests pin bit-identity against
+``ops/histogram.py``; inside ``shard_map`` each shard sorts and packs its
+local rows and the caller's psum merges shards, exactly like the scatter.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+# Routing constants shared by both engines: below MIN_SLOTS the Pallas MXU
+# tiers (or the scatter, off-TPU) win — the sort/pack overhead is fixed
+# while the matmul saving shrinks with S. WINDOW must divide the slot
+# width; 32 keeps the per-window block (W*C rows) within one MXU pass for
+# every payload width the builders use (C <= 8 after sublane padding).
+MIN_SLOTS = 256
+WINDOW = 32
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_slots", "n_bins", "n_channels", "window",
+                     "row_tile", "feature_chunk", "bf16_ok", "vma"),
+)
+def histogram_wide(
+    x_binned: jax.Array,
+    payload: jax.Array,
+    slot: jax.Array,
+    *,
+    n_slots: int,
+    n_bins: int,
+    n_channels: int,
+    window: int = WINDOW,
+    row_tile: int | None = None,
+    feature_chunk: int = 8,
+    bf16_ok: bool = False,
+    vma: tuple = (),
+) -> jax.Array:
+    """(N,F) bins + (N,C) payload + (N,) slot -> (S, F, C, B) histogram.
+
+    ``slot`` is the frontier slot per row; rows outside ``[0, n_slots)``
+    (parked in leaves, padding, other chunks) contribute nothing.
+    ``payload`` is ``class_payload``/``moment_payload`` from
+    ``pallas_hist`` — one function serves both tasks. ``vma`` names the
+    shard_map mesh axes this shard's partial histogram varies over (the
+    scan carry's zero init must carry the same varying axes as the scanned
+    row data or the carry types mismatch).
+    """
+    R, F = x_binned.shape
+    if row_tile is None:
+        # Big tiles amortize the scan/DUS overhead, but every (possibly)
+        # occupied window pads to a tile multiple — bound the tile by
+        # occupancy (R / n_win) so pad rows can't dominate live rows on
+        # small shards or sparse chunks (8-way covtype shard at K=4096:
+        # a flat 1024 tile would pack ~2 pad rows per live row).
+        row_tile = min(
+            1024, max(128, _round_up(R // max(n_slots // window, 1), 128))
+        )
+    C, S, W, Rt, Fc = n_channels, n_slots, window, row_tile, feature_chunk
+    if S % W:
+        raise ValueError(f"window {W} must divide n_slots {S}")
+    n_win = S // W
+    Bp = _round_up(max(n_bins, 1), 128)
+    Fp = _round_up(F, Fc)
+    n_fc = Fp // Fc
+    # Worst-case packed length: every live row plus up to Rt-1 pad rows per
+    # window. Static — the scan length must not depend on data.
+    n_tiles = (R + n_win * (Rt - 1) + Rt - 1) // Rt
+    Npad = n_tiles * Rt
+
+    # --- 1. sort rows by slot (dead rows to the top) ---------------------
+    live_mask = (slot >= 0) & (slot < S)
+    sl = jnp.where(live_mask, slot, S).astype(jnp.int32)
+    order = jnp.argsort(sl)
+    sl_sorted = sl[order]
+    win_sorted = sl_sorted // W  # dead rows -> n_win (== S // W)
+
+    # --- 2. window-pack via gather-only index construction ---------------
+    # bnd[k] = first sorted position of window k (bnd[n_win] = live total,
+    # everything after it is dead rows sorted to the top).
+    ks = jnp.arange(n_win + 1, dtype=jnp.int32)
+    bnd = jnp.searchsorted(win_sorted, ks, side="left").astype(jnp.int32)
+    starts = bnd[:n_win]
+    counts = bnd[1:] - starts  # (n_win,) live rows per window
+    padded = ((counts + Rt - 1) // Rt) * Rt
+    pstart = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(padded).astype(jnp.int32)]
+    )  # (n_win+1,) padded window starts; pstart[-1] = live packed total
+    pos = jnp.arange(Npad, dtype=jnp.int32)
+    k_of_p = (
+        jnp.searchsorted(pstart, pos, side="right").astype(jnp.int32) - 1
+    )
+    in_range = k_of_p < n_win
+    k_clip = jnp.minimum(k_of_p, n_win - 1)
+    local = pos - pstart[k_clip]
+    live = in_range & (local < counts[k_clip])
+    src_sorted = jnp.where(live, starts[k_clip] + local, 0)
+    src = order[src_sorted]  # (Npad,) original row index per packed pos
+
+    xb_p = jnp.where(live[:, None], jnp.take(x_binned, src, axis=0), 0)
+    pay_p = jnp.where(live[:, None], jnp.take(payload, src, axis=0), 0.0)
+    # Local slot within the window; -1 kills the one-hot row for pad rows.
+    wl_p = jnp.where(live, sl_sorted[src_sorted] - k_clip * W, -1)
+    if Fp != F:
+        xb_p = jnp.pad(xb_p, ((0, 0), (0, Fp - F)))
+
+    mm_dtype = jnp.bfloat16 if bf16_ok else jnp.float32
+
+    # --- 3. scan of MXU contractions, window blocks updated in place -----
+    def tile_body(hist, tile):
+        xb_t, pay_t, wl_t, wnd = tile  # (Rt,Fp) (Rt,C) (Rt,) ()
+        sc_iota = lax.broadcasted_iota(jnp.int32, (Rt, W * C), 1)
+        m1 = jnp.where(
+            sc_iota // C == wl_t[:, None], jnp.tile(pay_t, (1, W)), 0.0
+        ).astype(mm_dtype)  # (Rt, W*C)
+        b_iota = lax.broadcasted_iota(jnp.int32, (Rt, Fc, Bp), 2)
+
+        def fc_body(fc, hist):
+            xcols = lax.dynamic_slice(xb_t, (0, fc * Fc), (Rt, Fc))
+            onehot = (xcols[:, :, None] == b_iota).astype(mm_dtype)
+            blk = lax.dot_general(
+                m1, onehot.reshape(Rt, Fc * Bp),
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (W*C, Fc*Bp)
+            old = lax.dynamic_slice(
+                hist, (wnd, fc, 0, 0), (1, 1, W * C, Fc * Bp)
+            )
+            return lax.dynamic_update_slice(
+                hist, old + blk[None, None], (wnd, fc, 0, 0)
+            )
+
+        return lax.fori_loop(0, n_fc, fc_body, hist), None
+
+    hist0 = jnp.zeros((n_win, n_fc, W * C, Fc * Bp), jnp.float32)
+    if vma:
+        hist0 = lax.pcast(hist0, tuple(vma), to="varying")
+    xs = (
+        xb_p.reshape(n_tiles, Rt, Fp),
+        pay_p.reshape(n_tiles, Rt, C),
+        wl_p.reshape(n_tiles, Rt),
+        k_clip.reshape(n_tiles, Rt)[:, 0],
+    )
+    hist, _ = lax.scan(tile_body, hist0, xs)
+
+    # (n_win, n_fc, W*C, Fc*Bp) -> (S, F, C, n_bins)
+    out = hist.reshape(n_win, n_fc, W, C, Fc, Bp)
+    out = out.transpose(0, 2, 1, 4, 3, 5)  # (n_win, W, n_fc, Fc, C, Bp)
+    return out.reshape(S, Fp, C, Bp)[:, :F, :, :n_bins]

@@ -127,7 +127,8 @@ def unpack_decision(packed: "np.ndarray") -> dict:
 @lru_cache(maxsize=64)
 def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                   task: str, criterion: str, debug: bool = False,
-                  use_pallas: bool = False, node_mask: bool = False,
+                  use_pallas: bool = False, use_wide: bool = False,
+                  wide_bf16: bool = False, node_mask: bool = False,
                   random_split: bool = False, monotonic: bool = False):
     """Jitted (x_binned, y, node_id, weight, cand_mask, chunk_lo, mcw[, nmask])
     -> packed (n_slots, 9 + C) float32 decision buffer (see
@@ -168,6 +169,15 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                     n_slots=n_slots, n_bins=n_bins, n_channels=n_classes,
                     vma=(DATA_AXIS,),
                 )
+            elif use_wide:
+                from mpitree_tpu.ops import pallas_hist as ph
+                from mpitree_tpu.ops import wide_hist
+
+                h = wide_hist.histogram_wide(
+                    xb, ph.class_payload(y, w, n_classes), nid - chunk_lo,
+                    n_slots=n_slots, n_bins=n_bins, n_channels=n_classes,
+                    bf16_ok=wide_bf16, vma=(DATA_AXIS,),
+                )
             else:
                 h = hist_ops.class_histogram(
                     xb, y, nid, chunk_lo,
@@ -187,6 +197,15 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                     xb, ph.moment_payload(y, w), nid - chunk_lo,
                     n_slots=n_slots, n_bins=n_bins, n_channels=3,
                     vma=(DATA_AXIS,),
+                )
+            elif use_wide:
+                from mpitree_tpu.ops import pallas_hist as ph
+                from mpitree_tpu.ops import wide_hist
+
+                h = wide_hist.histogram_wide(
+                    xb, ph.moment_payload(y, w), nid - chunk_lo,
+                    n_slots=n_slots, n_bins=n_bins, n_channels=3,
+                    bf16_ok=False, vma=(DATA_AXIS,),
                 )
             else:
                 h = hist_ops.moment_histogram(

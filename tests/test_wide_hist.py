@@ -1,0 +1,153 @@
+"""Wide-frontier sorted window-packed histogram (ops/wide_hist.py).
+
+Contract under test: bit-identity with the XLA scatter histogram
+(``ops/histogram.py``) for integer-valued payloads — including bfloat16
+matmul inputs (integers <= 256 are exact in bf16) — across slot widths,
+dead-row patterns, ragged feature counts, and tile-boundary row counts.
+The deep levels of every device build ride this path (the scatter runs on
+the TPU scalar unit; the reference rescans the matrix per candidate,
+``mpitree/tree/decision_tree.py:73-86``).
+"""
+
+import numpy as np
+import pytest
+
+from mpitree_tpu.ops import histogram as hist_ops
+from mpitree_tpu.ops import pallas_hist as ph
+from mpitree_tpu.ops import wide_hist as wh
+
+
+def _class_case(rng, N, F, S, B, C, *, max_w=4, dead_frac=0.3):
+    xb = rng.integers(0, B, (N, F), dtype=np.int32)
+    y = rng.integers(0, C, N, dtype=np.int32)
+    w = rng.integers(1, max_w + 1, N).astype(np.float32)
+    nid = rng.integers(0, S, N, dtype=np.int32)
+    dead = rng.random(N) < dead_frac
+    nid = np.where(dead, rng.choice([-1, S, S + 7], N), nid).astype(np.int32)
+    return xb, y, w, nid
+
+
+@pytest.mark.parametrize("shape", [
+    # (N, F, S, B, C, window, row_tile, feature_chunk)
+    (4096, 54, 4096, 256, 7, 32, 1024, 8),
+    (2000, 54, 512, 256, 7, 32, None, 8),    # auto row tile
+    (999, 11, 256, 64, 3, 32, 256, 4),       # ragged F, odd N
+    (130, 7, 320, 32, 2, 64, 128, 7),        # window 64, F == chunk
+    (17, 3, 32, 8, 5, 8, 64, 2),             # tiny everything
+])
+@pytest.mark.parametrize("bf16", [False, True])
+def test_class_bit_identity_vs_scatter(rng, shape, bf16):
+    N, F, S, B, C, W, Rt, Fc = shape
+    xb, y, w, nid = _class_case(rng, N, F, S, B, C)
+    ref = hist_ops.class_histogram(
+        xb, y, nid, np.int32(0), n_slots=S, n_bins=B, n_classes=C,
+        sample_weight=w,
+    )
+    got = wh.histogram_wide(
+        xb, ph.class_payload(y, w, C), nid, n_slots=S, n_bins=B,
+        n_channels=C, window=W, row_tile=Rt, feature_chunk=Fc, bf16_ok=bf16,
+    )
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_moment_bit_identity_vs_scatter(rng):
+    N, F, S, B = 3000, 20, 1024, 128
+    xb = rng.integers(0, B, (N, F), dtype=np.int32)
+    y = rng.integers(-5, 11, N).astype(np.float32)  # integer-valued targets
+    w = rng.integers(1, 3, N).astype(np.float32)
+    nid = rng.integers(-1, S + 2, N, dtype=np.int32)
+    ref = hist_ops.moment_histogram(
+        xb, y, nid, np.int32(0), n_slots=S, n_bins=B, sample_weight=w,
+    )
+    got = wh.histogram_wide(
+        xb, ph.moment_payload(y, w), nid, n_slots=S, n_bins=B, n_channels=3,
+        window=32,
+    )
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_chunk_lo_offset_slots(rng):
+    """Slots are frontier-relative: the caller passes nid - chunk_lo, and
+    rows of other chunks land outside [0, S) — they must vanish."""
+    N, F, S, B, C = 1200, 9, 256, 32, 3
+    xb, y, w, nid = _class_case(rng, N, F, 3 * S, B, C, dead_frac=0.0)
+    lo = np.int32(S)  # middle chunk
+    ref = hist_ops.class_histogram(
+        xb, y, nid, lo, n_slots=S, n_bins=B, n_classes=C, sample_weight=w,
+    )
+    got = wh.histogram_wide(
+        xb, ph.class_payload(y, w, C), nid - lo, n_slots=S, n_bins=B,
+        n_channels=C, window=32,
+    )
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_all_rows_dead(rng):
+    N, F, S, B, C = 500, 6, 256, 16, 2
+    xb = rng.integers(0, B, (N, F), dtype=np.int32)
+    y = rng.integers(0, C, N, dtype=np.int32)
+    w = np.ones(N, np.float32)
+    nid = np.full(N, -1, np.int32)
+    got = wh.histogram_wide(
+        xb, ph.class_payload(y, w, C), nid, n_slots=S, n_bins=B,
+        n_channels=C, window=32,
+    )
+    assert float(np.abs(np.asarray(got)).sum()) == 0.0
+
+
+def test_skewed_occupancy_single_giant_slot(rng):
+    """One slot owning ~all rows (the deep-tree reality: a few huge nodes
+    among hundreds of tiny ones) must pack across many tiles correctly."""
+    N, F, S, B, C = 5000, 12, 512, 64, 4
+    xb = rng.integers(0, B, (N, F), dtype=np.int32)
+    y = rng.integers(0, C, N, dtype=np.int32)
+    w = rng.integers(1, 3, N).astype(np.float32)
+    nid = np.where(
+        rng.random(N) < 0.95, 37, rng.integers(0, S, N)
+    ).astype(np.int32)
+    ref = hist_ops.class_histogram(
+        xb, y, nid, np.int32(0), n_slots=S, n_bins=B, n_classes=C,
+        sample_weight=w,
+    )
+    got = wh.histogram_wide(
+        xb, ph.class_payload(y, w, C), nid, n_slots=S, n_bins=B,
+        n_channels=C, window=32, row_tile=256, bf16_ok=True,
+    )
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_window_must_divide_slots():
+    with pytest.raises(ValueError, match="must divide"):
+        wh.histogram_wide(
+            np.zeros((4, 2), np.int32), np.zeros((4, 2), np.float32),
+            np.zeros(4, np.int32), n_slots=100, n_bins=4, n_channels=2,
+            window=32,
+        )
+
+
+def test_fused_deep_build_rides_wide_tier(rng, monkeypatch):
+    """A deep fused build whose frontiers cross MIN_SLOTS must produce the
+    identical tree with the wide tier on (default) and off (scatter) —
+    the engine-level restatement of bit-identity."""
+    from mpitree_tpu import DecisionTreeClassifier
+
+    X = rng.standard_normal((3000, 8)).astype(np.float32)
+    y = rng.integers(0, 3, 3000).astype(np.int32)
+
+    def fit():
+        clf = DecisionTreeClassifier(
+            max_depth=12, max_bins=32, backend="cpu", refine_depth=None,
+        )
+        clf.fit(X, y)
+        t = clf.tree_
+        return (t.n_nodes, t.feature.copy(), t.threshold.copy(),
+                t.count.copy())
+
+    monkeypatch.setenv("MPITREE_TPU_ENGINE", "fused")
+    wide = fit()
+    monkeypatch.setenv("MPITREE_TPU_WIDE_HIST", "0")
+    scatter = fit()
+    assert wide[0] == scatter[0]
+    np.testing.assert_array_equal(wide[1], scatter[1])
+    np.testing.assert_array_equal(wide[2], scatter[2])
+    np.testing.assert_array_equal(wide[3], scatter[3])
