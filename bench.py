@@ -471,21 +471,41 @@ def load_mpi8_measured(n_full: int) -> dict | None:
     m = art.get("mpi8")
     if not m or len(m.get("grid", [])) < 2:
         return None
-    # The artifact records the row count its extrapolation refers to; the
-    # 531012 fallback covers artifacts captured before the field existed.
-    measured_n_full = art.get("n_full", 531012)
-    scale = (n_full / measured_n_full) ** m["exponent"]
+    # Cap the power law at its measured decade span (round-4 verdict #6:
+    # a 2.25-decade extrapolation of a 1-core timeshared curve is noise —
+    # the 1.888 exponent reflects 8-rank contention on one core, which
+    # cannot keep compounding on real hardware). Within the measured span
+    # the fit extrapolates as measured; the remaining decades grow
+    # LINEARLY in n — the most conservative tail that still favors the
+    # reference (real tree builds are superlinear).
+    grid_max = max(m["grid"])
+    t_last = m["times_s"][m["grid"].index(grid_max)]
+    measured_decades = m.get(
+        "measured_decades",
+        float(np.log10(grid_max / min(m["grid"]))),
+    )
+    n_cap = min(n_full, int(grid_max * 10 ** measured_decades))
+    t_cap = t_last * (n_cap / grid_max) ** m["exponent"]
+    observed_s = t_cap * max(n_full / n_cap, 1.0)
     return {
-        "mpi8_observed_s": round(m["extrapolated_full_s"] * scale, 1),
+        "mpi8_observed_s": round(observed_s, 1),
         "mpi8_observed_source": {
             "artifact": "MPI8_BASELINE.json",
             "grid": m["grid"],
             "times_s": m["times_s"],
             "exponent": m["exponent"],
             "rms_log_residual": m["rms_log_residual"],
+            "extrapolation_cap_rows": n_cap,
+            "uncapped_power_law_s": round(
+                m["extrapolated_full_s"]
+                * (n_full / art.get("n_full", 531012)) ** m["exponent"], 1,
+            ),
             "cpu_cores": art.get("cpu_cores"),
             "par_over_seq_at_shared_n": art.get("par_over_seq_at_shared_n"),
-            "note": art.get("note"),
+            "note": (
+                "power-law fit applied only over its measured decade span "
+                f"(to {n_cap} rows), linear in n beyond — "
+            ) + (art.get("note") or ""),
         },
     }
 
@@ -765,6 +785,12 @@ def main():
 
 
 if __name__ == "__main__":
+    try:  # persistent XLA executable cache (see bench_tpu.enable_compile_cache)
+        from bench_tpu import enable_compile_cache
+
+        enable_compile_cache()
+    except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
+        pass
     if len(sys.argv) >= 3 and sys.argv[1] == "--fit-worker":
         os.environ["MPITREE_TPU_PROFILE"] = "1"
         run_fit_worker(sys.argv[2])

@@ -80,6 +80,30 @@ def _load(npz_path: str):
     return data["Xtr"], data["ytr"], data["Xte"], data["yte"]
 
 
+def enable_compile_cache() -> str:
+    """Point JAX at a persistent on-disk compilation cache and return its path.
+
+    Cold bench runs previously paid 25-70 s of XLA compilation *per process*
+    through the remote-compile tunnel (round-4 BENCH_TPU.jsonl: north star
+    93.2 s cold vs 20.5 s warm) because nothing persisted executables across
+    processes. With the cache, a second cold process on the same commit
+    reuses the serialized executables and cold_s approaches warm_s. Must run
+    before the first jax operation (config is read at backend init).
+    ``MPITREE_TPU_COMPILE_CACHE`` overrides the location; gitignored.
+    """
+    import jax
+
+    path = os.environ.get(
+        "MPITREE_TPU_COMPILE_CACHE", os.path.join(_HERE, ".jax_cache")
+    )
+    jax.config.update("jax_compilation_cache_dir", path)
+    # Cache every executable (default skips small/fast ones): tunnel
+    # round trips make even sub-second compiles worth persisting.
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    return path
+
+
 def _pin_platform(platform: str) -> None:
     """Pin the JAX platform in-process before any jax op runs.
 
@@ -208,6 +232,43 @@ def worker_refine_sweep(npz_path: str) -> dict:
     return {"sweep": rows}
 
 
+def worker_predict(npz_path: str) -> dict:
+    """Inference throughput at covtype scale (verdict r4 #6).
+
+    The reference predicts with a per-row Python recursion and every rank
+    predicts the full set redundantly (``mpitree/tree/decision_tree.py:
+    208-227``); our path is the lockstep gather-descent
+    (``ops/predict.py``). Reports rows/s for ``predict_proba`` and
+    ``predict`` on the held-out set and on a ~1M-row tiling of it (the
+    covtype-scale number the artifact was missing).
+    """
+    from mpitree_tpu import DecisionTreeClassifier
+
+    Xtr, ytr, Xte, _ = _load(npz_path)
+    platform = _device_platform()
+    clf = DecisionTreeClassifier(
+        max_depth=DEPTH, max_bins=256, backend=platform,
+        refine_depth=REFINE_DEPTH,
+    )
+    clf.fit(Xtr, ytr)
+    out: dict = {"platform": platform, "tree_n_nodes": clf.tree_.n_nodes}
+
+    reps = max(1, 1_000_000 // len(Xte))
+    Xbig = np.tile(Xte, (reps, 1))
+    for name, X in (("test", Xte), ("1m", Xbig)):
+        for meth in ("predict", "predict_proba"):
+            fn = getattr(clf, meth)
+            fn(X)  # warm the compiled descent for this shape
+            t0 = time.perf_counter()
+            fn(X)
+            dt = time.perf_counter() - t0
+            out[f"{meth}_{name}_rows_per_s"] = round(len(X) / dt)
+            out[f"{meth}_{name}_s"] = round(dt, 4)
+    out["rows_test"] = len(Xte)
+    out["rows_1m"] = len(Xbig)
+    return out
+
+
 def worker_device_bin(npz_path: str) -> dict:
     """Host numpy vs on-device binning at the full workload shape.
 
@@ -314,12 +375,77 @@ def worker_hist_tput(npz_path: str) -> dict:
         }
     except Exception as e:  # noqa: BLE001 — diagnostic section only
         res["hist_K4096_sorted"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # The production deep-level path: sorted window-packed MXU contraction
+    # (ops/wide_hist.py) at the same K=4096 shape, f32 and bf16 payloads.
+    # This is the number that justifies (or retunes) wide_hist.MIN_SLOTS.
+    from mpitree_tpu.ops import wide_hist as wh
+
+    payload_k = ph.class_payload(y, w1, C)
+    for bf16 in (False, True):
+        def wide_fn(xb, payload_k, nid, bf16=bf16):
+            return wh.histogram_wide(
+                xb, payload_k, nid, n_slots=K, n_bins=B, n_channels=C,
+                bf16_ok=bf16,
+            )
+
+        try:
+            s_wide = timed(wide_fn, xb, payload_k, nid)
+            res[f"hist_K4096_wide_{'bf16' if bf16 else 'f32'}"] = {
+                "seconds": round(s_wide, 5),
+                "g_updates_per_s": round(N * F / s_wide / 1e9, 3),
+                "speedup_vs_scatter": round(s / s_wide, 2),
+            }
+        except Exception as e:  # noqa: BLE001 — diagnostic section only
+            res[f"hist_K4096_wide_{'bf16' if bf16 else 'f32'}"] = {
+                "error": f"{type(e).__name__}: {e}"
+            }
     roof = next(
         (v for k, v in HBM_ROOFLINE_GBPS.items() if k in kind), None
     )
     if roof:
         res["hist_K4096"]["hbm_roofline_gbps"] = roof
         res["hist_K4096"]["roofline_frac"] = round(gbps / roof, 3)
+
+    # Per-level non-histogram ops of the fused loop, isolated: once the
+    # wide tier removes the histogram scatter, these bound the next
+    # attack (row-reroute gathers, child-allocation scatters). Shapes
+    # mirror a covtype deep level (N rows, M~1M node capacity).
+    M = 1 << 20
+    tbl = jnp.asarray(rng.integers(-1, 54, size=M, dtype=np.int32))
+    node = jnp.asarray(rng.integers(0, M, size=N, dtype=np.int32))
+    bins_t = jnp.asarray(rng.integers(0, B, size=M, dtype=np.int32))
+
+    @jax.jit
+    def reroute(xb, tbl, bins_t, node):
+        f = tbl[node]                      # (N,) gather from M-table
+        xf = jnp.take_along_axis(
+            xb, jnp.maximum(f, 0)[:, None], axis=1
+        )[:, 0]                            # (N,) row gather
+        go_left = xf <= bins_t[node]       # second M-table gather
+        return jnp.where(go_left, node * 2, node * 2 + 1)
+
+    s_r = timed(reroute, xb, tbl, bins_t, node)
+    res["level_op_reroute"] = {
+        "seconds": round(s_r, 5),
+        "g_gathers_per_s": round(3 * N / s_r / 1e9, 3),
+    }
+
+    scat_idx = jnp.asarray(rng.integers(0, M, size=M, dtype=np.int32))
+    vals = jnp.asarray(rng.integers(0, M, size=M, dtype=np.int32))
+
+    @jax.jit
+    def child_alloc_scatter(scat_idx, vals):
+        pad = jnp.full(M + 2, -1, jnp.int32)
+        pad = pad.at[scat_idx].set(vals)
+        pad = pad.at[scat_idx + 1].set(vals)
+        return pad[:M]
+
+    s_a = timed(child_alloc_scatter, scat_idx, vals)
+    res["level_op_alloc_scatter"] = {
+        "seconds": round(s_a, 5),
+        "g_scatters_per_s": round(2 * M / s_a / 1e9, 3),
+    }
 
     # Tier sweep: XLA scatter vs the Pallas kernel (whichever layout its
     # auto-dispatch picks — one-block at S=8, feature-gridded above) at the
@@ -341,6 +467,22 @@ def worker_hist_tput(npz_path: str) -> dict:
             "seconds": round(s_xla, 5),
             "g_updates_per_s": round(N * F / s_xla / 1e9, 3),
         }
+        if S >= wh.MIN_SLOTS:
+            def wide_s_fn(xb, payload_k, nid_s, S=S):
+                return wh.histogram_wide(
+                    xb, payload_k, nid_s, n_slots=S, n_bins=B,
+                    n_channels=C, bf16_ok=True,
+                )
+
+            try:
+                s_w = timed(wide_s_fn, xb, payload_k, nid_s)
+                res[f"hist_S{S}_wide"] = {
+                    "seconds": round(s_w, 5),
+                    "g_updates_per_s": round(N * F / s_w / 1e9, 3),
+                    "speedup_vs_xla": round(s_xla / s_w, 2),
+                }
+            except Exception as e:  # noqa: BLE001
+                res[f"hist_S{S}_wide"] = {"error": f"{type(e).__name__}: {e}"}
         if ph.pallas_available(platform) and ph.fits_vmem(F, S, C, B):
             payload = ph.class_payload(y, w1, C)
 
@@ -385,6 +527,7 @@ WORKERS = {
     "device_bin": worker_device_bin,
     "refine_sweep": worker_refine_sweep,
     "forest": worker_forest,
+    "predict": worker_predict,
 }
 
 
@@ -580,6 +723,7 @@ def main() -> int:
 if __name__ == "__main__":
     if len(sys.argv) >= 4 and sys.argv[1] == "--section-worker":
         os.environ["MPITREE_TPU_PROFILE"] = "1"
+        enable_compile_cache()
         if len(sys.argv) >= 5:
             _pin_platform(sys.argv[4])
         result = WORKERS[sys.argv[2]](sys.argv[3])

@@ -213,27 +213,30 @@ def resolve_hist_kernel(cfg: BuildConfig, platform: str, task: str, *,
     return pallas_hist.pallas_available(platform) and exact
 
 
-def resolve_wide_hist(cfg: BuildConfig, task: str, *,
+def resolve_wide_hist(cfg: BuildConfig, platform: str, task: str, *,
                       integer_ok: bool, sample_weight=None) -> tuple:
     """(use_wide, bf16_ok) for the sorted window-packed deep-level tier.
 
     Same exactness policy as :func:`resolve_hist_kernel`: under "auto" the
     wide matmul histogram (``ops/wide_hist.py``) replaces the scatter only
-    where it is bit-identical to it — classification with integer weights.
-    It additionally runs the matmul inputs in bfloat16 (2x MXU rate) when
+    where it is bit-identical to it — classification with integer weights —
+    and only on a real TPU: the tier exists to dodge the TPU scalar-unit
+    scatter; on XLA-CPU the scatter is fast and the dense one-hot
+    contraction loses (measured 0.2x at the covtype chunk shape). It
+    additionally runs the matmul inputs in bfloat16 (2x MXU rate) when
     every payload value is an integer <= 256 (exactly representable in
     bf16's 8-bit mantissa) — unit and bootstrap weights always qualify.
-    ``MPITREE_TPU_WIDE_HIST``: "0" disables, "1" forces it for ALL
-    payloads (the same explicit identity opt-out as hist_kernel="pallas":
-    f32 accumulation whose summation order differs from the scatter's).
-    Unlike the Pallas kernel this is pure XLA, so it is not gated on a
-    TPU backend — the identity tests ride it on CPU.
+    ``MPITREE_TPU_WIDE_HIST``: "0" disables everywhere, "1" forces it on
+    any platform for ALL payloads (for non-integer ones that is the same
+    explicit identity opt-out as hist_kernel="pallas": f32 accumulation
+    whose summation order differs from the scatter's) — the CPU identity
+    tests and the multichip dryrun ride the force flag.
     """
     flag = os.environ.get("MPITREE_TPU_WIDE_HIST", "auto")
     if flag == "0":
         return False, False
     exact = task == "classification" and integer_ok
-    if not exact and flag != "1":
+    if flag != "1" and not (exact and platform in ("tpu", "axon")):
         return False, False
     bf16 = bool(
         exact
@@ -241,6 +244,55 @@ def resolve_wide_hist(cfg: BuildConfig, task: str, *,
              or float(np.max(sample_weight, initial=0.0)) <= 256.0)
     )
     return True, bf16
+
+
+def resolve_exact_ties(platform: str) -> bool:
+    """Whether device classification sweeps rank costs in f64 (seam closure).
+
+    The known device/host seam: split costs are mathematically tied (or
+    1e-12-close) at small deep nodes, the host's f64 resolves them one way
+    and the device's f32 noise the other (first seen at a 13-row depth-9
+    node). On CPU backends the device engines now run the cost sweep in
+    scoped-x64 f64 mirroring the host formulation (`ops/impurity.py:
+    _cost_sweep_f64`), which makes full-depth device-vs-host identity hold
+    (tests/test_engine_identity.py, depth >= 15) for every chunk width
+    within ``exact_ties_fits``'s memory bound — wider chunks keep the f32
+    sweep and ``warn_exact_ties_gap`` says so at build time. TPUs have no
+    f64 unit, so accelerator builds keep the f32 sweep — there the
+    production hybrid masks the seam (crowns stop while nodes are large;
+    the exact host tail owns deep small nodes). MPITREE_TPU_EXACT_TIES=0
+    opts out (perf escape hatch for CPU-mesh experiments).
+    """
+    if os.environ.get("MPITREE_TPU_EXACT_TIES", "auto") == "0":
+        return False
+    return platform == "cpu"
+
+
+def exact_ties_fits(n_slots: int, n_features: int,
+                    n_bins: int) -> bool:
+    """Bound the f64 sweep's working set (~8 live (K,F,B) f64 buffers —
+    the per-class accumulation keeps the C axis transient). Chunk widths
+    past the bound keep the f32 sweep; ``warn_exact_ties_gap`` makes that
+    visible at build time."""
+    return n_slots * n_features * n_bins * 64 <= (2 << 30)
+
+
+def warn_exact_ties_gap(K: int, n_features: int,
+                        n_bins: int) -> None:
+    """One visible warning when the f64 tie sweep is memory-gated off for
+    the K-slot chunks: the device/host identity contract then only covers
+    frontiers up to the widest tier that still fits — deep wide-chunk
+    ties rank in f32 (the pre-closure behavior)."""
+    import warnings
+
+    warnings.warn(
+        f"exact-ties f64 cost sweep disabled for {K}-slot frontier chunks "
+        f"(working set ~{K * n_features * n_bins * 64 >> 20} MB exceeds "
+        "the 2 GB bound); ties on frontiers wider than the largest "
+        "fitting tier rank in f32 and may resolve differently from the "
+        "host tier's f64",
+        stacklevel=3,
+    )
 
 
 def integer_weights(sample_weight) -> bool:
@@ -542,8 +594,12 @@ def build_tree(
         cfg, mesh.devices.flat[0].platform, task, integer_ok=int_ok,
     )
     use_wide, wide_bf16 = resolve_wide_hist(
-        cfg, task, integer_ok=int_ok, sample_weight=sample_weight,
+        cfg, mesh.devices.flat[0].platform, task, integer_ok=int_ok,
+        sample_weight=sample_weight,
     )
+    exact_ok = resolve_exact_ties(mesh.devices.flat[0].platform)
+    if exact_ok and not exact_ties_fits(K, F, B):
+        warn_exact_ties_gap(K, F, B)
     # Levelwise keeps only Pallas-eligible tiers: that is where the measured
     # win lives (the MXU kernel beat the scatter 3.3x at S=8), while XLA
     # tiers saved <3% warm and cost an extra ~20-40s tunnel compile each.
@@ -564,6 +620,7 @@ def build_tree(
         return S, collective.make_split_fn(
             mesh, n_slots=S, n_bins=B, n_classes=C, task=task,
             criterion=cfg.criterion, debug=debug, use_pallas=S in tiers,
+            exact_ties=exact_ok and exact_ties_fits(S, F, B),
             use_wide=(use_wide and S not in tiers
                       and S >= wide_hist.MIN_SLOTS
                       and S % wide_hist.WINDOW == 0),

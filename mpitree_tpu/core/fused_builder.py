@@ -39,8 +39,11 @@ from jax.sharding import PartitionSpec as P
 
 from mpitree_tpu.core.builder import (
     _chunk_size,
+    exact_ties_fits,
     integer_weights,
+    warn_exact_ties_gap,
     refit_regression_values,
+    resolve_exact_ties,
     resolve_hist_kernel,
     resolve_wide_hist,
     valid_tiers as builder_valid_tiers,
@@ -104,6 +107,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                      max_depth: int, min_samples_split: int,
                      tiers: tuple = (), use_pallas: bool = False,
                      use_wide: bool = False, wide_bf16: bool = False,
+                     exact_ties: bool = False,
                      psum_axis: str | None = DATA_AXIS,
                      feature_axis: str | None = None,
                      sample_k: int | None = None,
@@ -304,7 +308,11 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                 dec = select_global(imp_ops.best_split_classification(
                     h, cand_mask, criterion=criterion,
                     min_child_weight=mcw, node_mask=nmask,
-                    forced_draw=draws, **mono,
+                    forced_draw=draws,
+                    exact_ties=exact_ties and exact_ties_fits(
+                        n_stat_slots, F, n_bins
+                    ),
+                    **mono,
                 ))
                 pure = (dec.counts > 0).sum(axis=1) <= 1
             else:
@@ -565,7 +573,8 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                    task: str, criterion: str, max_nodes: int, max_depth: int,
                    min_samples_split: int, tiers: tuple = (),
                    use_pallas: bool = False, use_wide: bool = False,
-                   wide_bf16: bool = False, sample_k: int | None = None,
+                   wide_bf16: bool = False, exact_ties: bool = False,
+                   sample_k: int | None = None,
                    random_split: bool = False, monotonic: bool = False):
     """Data-parallel single-tree build: rows sharded, histograms psum'd.
 
@@ -584,6 +593,7 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         criterion=criterion, max_nodes=max_nodes, max_depth=max_depth,
         min_samples_split=min_samples_split, tiers=tiers,
         use_pallas=use_pallas, use_wide=use_wide, wide_bf16=wide_bf16,
+        exact_ties=exact_ties,
         psum_axis=DATA_AXIS,
         feature_axis=feature_axis, sample_k=sample_k,
         random_split=random_split, monotonic=monotonic,
@@ -607,6 +617,7 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                     max_depth: int, min_samples_split: int,
                     tiers: tuple = (), use_pallas: bool = False,
                     use_wide: bool = False, wide_bf16: bool = False,
+                    exact_ties: bool = False,
                     data_sharded: bool = False,
                     sample_k: int | None = None,
                     random_split: bool = False,
@@ -632,6 +643,7 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         criterion=criterion, max_nodes=max_nodes, max_depth=max_depth,
         min_samples_split=min_samples_split, tiers=tiers,
         use_pallas=use_pallas, use_wide=use_wide, wide_bf16=wide_bf16,
+        exact_ties=exact_ties,
         psum_axis=DATA_AXIS if data_sharded else None,
         sample_k=sample_k, random_split=random_split, monotonic=monotonic,
     )
@@ -723,8 +735,12 @@ def build_tree_fused(
         cfg, mesh.devices.flat[0].platform, task, integer_ok=int_ok,
     )
     use_wide, wide_bf16 = resolve_wide_hist(
-        cfg, task, integer_ok=int_ok, sample_weight=sample_weight,
+        cfg, mesh.devices.flat[0].platform, task, integer_ok=int_ok,
+        sample_weight=sample_weight,
     )
+    exact_ties = resolve_exact_ties(mesh.devices.flat[0].platform)
+    if exact_ties and not exact_ties_fits(K, F, B):
+        warn_exact_ties_gap(K, F, B)
 
     fn = _make_fused_fn(
         mesh, n_slots=K, n_bins=B, n_classes=C, task=task,
@@ -733,6 +749,7 @@ def build_tree_fused(
         min_samples_split=int(cfg.min_samples_split),
         tiers=tuple(cfg.frontier_tiers),
         use_pallas=use_pallas, use_wide=use_wide, wide_bf16=wide_bf16,
+        exact_ties=exact_ties,
         sample_k=sample_k, random_split=random_split,
         monotonic=monotonic,
     )
@@ -890,8 +907,12 @@ def build_forest_fused(
         cfg, mesh.devices.flat[0].platform, task, integer_ok=integer_counts
     )
     use_wide, wide_bf16 = resolve_wide_hist(
-        cfg, task, integer_ok=integer_counts, sample_weight=weights,
+        cfg, mesh.devices.flat[0].platform, task, integer_ok=integer_counts,
+        sample_weight=weights,
     )
+    exact_ties = resolve_exact_ties(mesh.devices.flat[0].platform)
+    if exact_ties and not exact_ties_fits(K, F, B):
+        warn_exact_ties_gap(K, F, B)
 
     if task == "classification" and float(weights.sum(axis=1).max()) >= 2**24:
         import warnings
@@ -910,6 +931,7 @@ def build_forest_fused(
         min_samples_split=int(cfg.min_samples_split),
         tiers=tuple(cfg.frontier_tiers),
         use_pallas=use_pallas, use_wide=use_wide, wide_bf16=wide_bf16,
+        exact_ties=exact_ties,
         data_sharded=data_sharded,
         sample_k=sample_k, random_split=random_split,
         monotonic=mono_cst is not None and bool(np.any(np.asarray(mono_cst))),

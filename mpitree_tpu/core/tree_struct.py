@@ -14,6 +14,7 @@ who walked ``clf.tree_`` directly (``value`` overloading per
 from __future__ import annotations
 
 import dataclasses
+import enum
 from typing import Optional
 
 import numpy as np
@@ -109,14 +110,26 @@ class TreeArrays:
         return nodes[0] if nodes else Node(value=0)
 
 
+class BranchType(enum.Enum):
+    """Rendering glyph per node (reference ``mpitree/tree/_base.py:16-19``)."""
+
+    ROOT = "┌──"
+    INTERIOR_LIKE = "├──"
+    LEAF_LIKE = "└──"
+
+
 @dataclasses.dataclass
 class Node:
     """Reference-compatible linked tree node (view over :class:`TreeArrays`).
 
-    Mirrors the attribute surface of the reference ``Node``
-    (``mpitree/tree/_base.py:50-57``): overloaded ``value``, optional
-    ``threshold``, ``depth``, class-count vector ``count``, and
-    parent/left/right links.
+    Mirrors the full attribute surface of the reference ``Node``
+    (``mpitree/tree/_base.py:50-75``): overloaded ``value``, optional
+    ``threshold``, ``depth``, class-count vector ``count``,
+    parent/left/right links, the ``_btype`` rendering state, and the
+    side-effecting ``__lt__`` the reference's renderer relies on (sorting
+    a node pair stamps each side's ``_btype`` and orders interior nodes
+    after leaves). Code written against reference nodes — including
+    ``sorted(node.children)`` idioms — behaves identically on this view.
     """
 
     value: object
@@ -126,6 +139,22 @@ class Node:
     parent: Optional["Node"] = dataclasses.field(default=None, repr=False)
     left: Optional["Node"] = dataclasses.field(default=None, repr=False)
     right: Optional["Node"] = dataclasses.field(default=None, repr=False)
+    _btype: BranchType = dataclasses.field(
+        default=BranchType.ROOT, repr=False
+    )
+
+    def __lt__(self, other: "Node") -> bool:
+        # Reference semantics verbatim (_base.py:63-75): comparing stamps
+        # both sides' branch glyphs as a side effect, and returns whether
+        # SELF is interior — so interior nodes compare less-than and sort
+        # first (the reference's quirk, kept for parity).
+        if self.is_leaf:
+            other._btype = BranchType.INTERIOR_LIKE
+            self._btype = BranchType.LEAF_LIKE
+        else:
+            self._btype = BranchType.INTERIOR_LIKE
+            other._btype = BranchType.LEAF_LIKE
+        return not self.is_leaf
 
     @property
     def is_leaf(self) -> bool:

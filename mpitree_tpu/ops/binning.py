@@ -276,7 +276,8 @@ def _device_bin_kernel(Xt, qidx, max_bins, force_quantile=False):
 
 
 def bin_dataset_device(
-    X: np.ndarray, *, max_bins: int = 256, binning: str = "auto"
+    X: np.ndarray, *, max_bins: int = 256, binning: str = "auto",
+    assume_finite: bool = False,
 ) -> BinnedData:
     """``bin_dataset`` computed on the default device; bit-identical output.
 
@@ -299,11 +300,12 @@ def bin_dataset_device(
 
     X = np.ascontiguousarray(X, dtype=np.float32)
     n_samples, n_features = X.shape
-    if np.isnan(X).any():
+    if not assume_finite and np.isnan(X).any():
         # NaN != NaN breaks the device kernel's sort-based dedup; the host
         # path collapses NaN runs, so falling back keeps the documented
-        # bit-identity contract for direct callers (estimator entrypoints
-        # already validate, but this is a public module function).
+        # bit-identity contract for direct callers. Estimator entrypoints
+        # already validate finiteness and skip this O(N*F) host scan via
+        # assume_finite=True (bin_for_engine).
         return bin_dataset(X, max_bins=max_bins, binning=binning)
     if max_bins < 2 or n_samples < 1:
         # Degenerate: zero candidates everywhere (max_bins=1), or an empty
@@ -364,7 +366,9 @@ def bin_for_engine(
             # Forced: raise on failure — the identity tests ride this flag,
             # and a silent host fallback would make them compare
             # host-vs-host and pass vacuously.
-            return bin_dataset_device(X, max_bins=max_bins, binning=binning)
+            return bin_dataset_device(
+                X, max_bins=max_bins, binning=binning, assume_finite=True
+            )
         if backend == "tpu":
             on_tpu = True
         elif backend in ("cpu", "host"):
@@ -376,7 +380,8 @@ def bin_for_engine(
         if on_tpu:
             try:
                 return bin_dataset_device(
-                    X, max_bins=max_bins, binning=binning
+                    X, max_bins=max_bins, binning=binning,
+                    assume_finite=True,
                 )
             except Exception as e:  # noqa: BLE001
                 # Same policy as device_failover (utils/elastic.py):

@@ -88,6 +88,83 @@ def class_impurity(counts: jax.Array, n: jax.Array, criterion: str) -> jax.Array
     raise ValueError(f"unknown classification criterion: {criterion!r}")
 
 
+def _cost_sweep_f64(hist, criterion: str):
+    """(K,F,C,B) histogram -> (cost_hi, cost_lo, n_l, n_r) float32.
+
+    The f64 cost leaves the scoped-x64 block as a two-float (hi, lo) pair
+    — ``hi = f32(cost64)``, ``lo = f32(cost64 - f64(hi))`` — because any
+    jnp op on an f64 array outside the scope silently canonicalizes back
+    to f32, and jnp reductions (argmin/min) on f64 operands are broken
+    even inside it (their cached inner jits build f32 init values).
+    Lexicographic (hi, lo) order equals f64 order to ~2^-48 relative,
+    so the caller ranks candidates in plain f32 ops with f64 fidelity.
+    ``n_l``/``n_r`` come back as f32 (integer counts — exact).
+
+    Mirrors ``host_builder._child_impurity_class`` op for op — division
+    (not reciprocal-multiply), ``p * log2(max(p, 1e-300))`` terms, classes
+    summed sequentially ascending (numpy's reduction order for C < 8) —
+    inside a scoped ``jax.enable_x64`` so the f32-disabled default config
+    still traces real f64 ops. Counts are integers (exact in f64), so the
+    only rounding is in the division/log/product chain: ~1e-15 relative,
+    vs ~1e-7 for the f32 sweep. This closes the depth>=10 device-vs-host
+    tie seam (VERDICT r4 #5): cost gaps the host's f64 resolves are now
+    resolved identically on-device. (XLA's f64 log2 is within ~5 ulps of
+    numpy's libm — not bitwise, but ties from symmetric count patterns
+    cancel identically on both sides, and 1e-15-coincidence gaps are
+    unobservable.) CPU backends only — TPUs have no f64 unit; the hybrid's
+    host tail owns deep small nodes there (``resolve_exact_ties``).
+    """
+    with jax.enable_x64(True):
+        C = hist.shape[2]
+
+        def l_of(c):  # per-class left cumsum, f64, transient
+            return jnp.cumsum(hist[:, :, c, :].astype(jnp.float64), axis=2)
+
+        # Pass A: side totals. The host's l.sum(axis=2) over per-class
+        # cumsums is sequential-ascending for C < 8 (numpy's pairwise
+        # blocking) — mirrored here; integer counts are exact either way.
+        n_l = l_of(0)
+        for c in range(1, C):
+            n_l = n_l + l_of(c)
+        n_tot = n_l[:, :, -1:]
+        n_r = n_tot - n_l
+
+        # Pass B: per-side impurity terms accumulated class by class in
+        # the same ascending order the host's t.sum(axis=2) uses. Only
+        # (K,F,B)-sized f64 buffers stay live (the (K,F,C,B) l/r stacks
+        # the host materializes would multiply the working set by C).
+        div_l = jnp.maximum(n_l, 1.0)
+        div_r = jnp.maximum(n_r, 1.0)
+        acc_l = acc_r = None
+        for c in range(C):
+            l_c = l_of(c)
+            r_c = l_c[:, :, -1:] - l_c
+            p_l = l_c / div_l  # division, not reciprocal-multiply (host op)
+            p_r = r_c / div_r
+            if criterion == "entropy":
+                t_l = jnp.where(
+                    l_c > 0, p_l * jnp.log2(jnp.maximum(p_l, 1e-300)), 0.0
+                )
+                t_r = jnp.where(
+                    r_c > 0, p_r * jnp.log2(jnp.maximum(p_r, 1e-300)), 0.0
+                )
+            else:
+                t_l = p_l * p_l
+                t_r = p_r * p_r
+            acc_l = t_l if acc_l is None else acc_l + t_l
+            acc_r = t_r if acc_r is None else acc_r + t_r
+        if criterion == "entropy":
+            h_l, h_r = -acc_l, -acc_r
+        else:
+            h_l = jnp.where(n_l > 0, 1.0 - acc_l, 0.0)
+            h_r = jnp.where(n_r > 0, 1.0 - acc_r, 0.0)
+
+        cost = (n_l * h_l + n_r * h_r) / jnp.maximum(n_tot, 1.0)
+        hi = cost.astype(jnp.float32)
+        lo = (cost - hi.astype(jnp.float64)).astype(jnp.float32)
+        return hi, lo, n_l.astype(jnp.float32), n_r.astype(jnp.float32)
+
+
 def best_split_classification(
     hist: jax.Array, cand_mask: jax.Array, *, criterion: str = "entropy",
     node_mask: jax.Array | None = None, min_child_weight=None,
@@ -95,6 +172,7 @@ def best_split_classification(
     mono_cst: jax.Array | None = None,
     mono_lo: jax.Array | None = None,
     mono_hi: jax.Array | None = None,
+    exact_ties: bool = False,
 ) -> SplitDecision:
     """Pick the best (feature, bin) per frontier slot from a class histogram.
 
@@ -128,31 +206,40 @@ def best_split_classification(
     if criterion not in ("entropy", "gini"):
         raise ValueError(f"unknown classification criterion: {criterion!r}")
     hist_sum = hist.sum(axis=2)  # (K, F, B)
-    n_l = jnp.cumsum(hist_sum, axis=2)
-    n_tot = n_l[:, :, -1:]  # (K, F, 1)
-    n_r = n_tot - n_l
-    inv_l = 1.0 / jnp.maximum(n_l, 1.0)
-    inv_r = 1.0 / jnp.maximum(n_r, 1.0)
+    if exact_ties:
+        cost, cost_lo, n_l, n_r = _cost_sweep_f64(hist, criterion)
+        inv_l = inv_r = None  # recomputed in f32 if the mono path needs them
+    else:
+        cost_lo = None
+        n_l = jnp.cumsum(hist_sum, axis=2)
+        n_tot = n_l[:, :, -1:]  # (K, F, 1)
+        n_r = n_tot - n_l
+        inv_l = 1.0 / jnp.maximum(n_l, 1.0)
+        inv_r = 1.0 / jnp.maximum(n_r, 1.0)
 
-    C = hist.shape[2]
-    h_l = jnp.zeros_like(n_l)  # accumulates -sum_c p log2 p  (or sum p^2)
-    h_r = jnp.zeros_like(n_l)
-    for c in range(C):
-        l_c = jnp.cumsum(hist[:, :, c, :], axis=2)
-        r_c = l_c[:, :, -1:] - l_c
-        p_l = l_c * inv_l
-        p_r = r_c * inv_r
-        if criterion == "entropy":
-            h_l -= jnp.where(l_c > 0, p_l * jnp.log2(jnp.maximum(p_l, 1e-38)), 0.0)
-            h_r -= jnp.where(r_c > 0, p_r * jnp.log2(jnp.maximum(p_r, 1e-38)), 0.0)
-        else:
-            h_l += p_l * p_l
-            h_r += p_r * p_r
+        C = hist.shape[2]
+        h_l = jnp.zeros_like(n_l)  # accumulates -sum_c p log2 p (or sum p^2)
+        h_r = jnp.zeros_like(n_l)
+        for c in range(C):
+            l_c = jnp.cumsum(hist[:, :, c, :], axis=2)
+            r_c = l_c[:, :, -1:] - l_c
+            p_l = l_c * inv_l
+            p_r = r_c * inv_r
+            if criterion == "entropy":
+                h_l -= jnp.where(
+                    l_c > 0, p_l * jnp.log2(jnp.maximum(p_l, 1e-38)), 0.0
+                )
+                h_r -= jnp.where(
+                    r_c > 0, p_r * jnp.log2(jnp.maximum(p_r, 1e-38)), 0.0
+                )
+            else:
+                h_l += p_l * p_l
+                h_r += p_r * p_r
 
-    if criterion == "gini":
-        h_l = 1.0 - h_l
-        h_r = 1.0 - h_r
-    cost = (n_l * h_l + n_r * h_r) / jnp.maximum(n_tot, 1.0)
+        if criterion == "gini":
+            h_l = 1.0 - h_l
+            h_r = 1.0 - h_r
+        cost = (n_l * h_l + n_r * h_r) / jnp.maximum(n_tot, 1.0)
 
     valid = cand_mask[None, :, :] & (n_l > 0) & (n_r > 0)
     if min_child_weight is not None:
@@ -162,6 +249,10 @@ def best_split_classification(
     if node_mask is not None:
         valid = valid & node_mask[:, :, None]
     if mono_cst is not None:
+        if inv_l is None:  # exact_ties path: f32 v-value contract regardless
+            n_l32 = jnp.cumsum(hist_sum, axis=2)
+            inv_l = 1.0 / jnp.maximum(n_l32, 1.0)
+            inv_r = 1.0 / jnp.maximum(n_l32[:, :, -1:] - n_l32, 1.0)
         l0 = jnp.cumsum(hist[:, :, 0, :], axis=2)  # class-0 left mass
         v_l_all = l0 * inv_l
         v_r_all = (l0[:, :, -1:] - l0) * inv_r
@@ -169,13 +260,24 @@ def best_split_classification(
             v_l_all, v_r_all, mono_cst, mono_lo, mono_hi
         )
     cost = jnp.where(valid, cost, jnp.inf)
+    if cost_lo is not None:
+        cost_lo = jnp.where(valid, cost_lo, 0.0)  # inf - inf would be nan
 
     if forced_draw is None:
-        best_bin_f = jnp.argmin(cost, axis=2)  # (K, F) first-min = lowest threshold
+        if cost_lo is None:
+            best_bin_f = jnp.argmin(cost, axis=2)  # first-min = lowest thr
+        else:
+            best_bin_f = _lex_argmin(cost, cost_lo, axis=2)
     else:
         best_bin_f = _drawn_bins(valid, forced_draw)
     best_cost_f = jnp.take_along_axis(cost, best_bin_f[:, :, None], axis=2)[:, :, 0]
-    best_feature = jnp.argmin(best_cost_f, axis=1)  # (K,) first-min = lowest feature
+    if cost_lo is None:
+        best_feature = jnp.argmin(best_cost_f, axis=1)  # lowest feature
+    else:
+        best_lo_f = jnp.take_along_axis(
+            cost_lo, best_bin_f[:, :, None], axis=2
+        )[:, :, 0]
+        best_feature = _lex_argmin(best_cost_f, best_lo_f, axis=1)
     best_bin = jnp.take_along_axis(best_bin_f, best_feature[:, None], axis=1)[:, 0]
     best_cost = jnp.take_along_axis(best_cost_f, best_feature[:, None], axis=1)[:, 0]
 
@@ -205,6 +307,25 @@ def best_split_classification(
         v_left=v_left,
         v_right=v_right,
     )
+
+
+def _lex_argmin(hi: jax.Array, lo: jax.Array, *, axis: int) -> jax.Array:
+    """First index of the lexicographic (hi, lo) minimum along ``axis``.
+
+    Two-float ranking: (hi, lo) pairs carry the f64 cost (see
+    ``_cost_sweep_f64``), and lexicographic comparison on them reproduces
+    the f64 order — so first-min tie-breaks (lower threshold / lower
+    feature) resolve exactly as the host's f64 argmin does, using only f32
+    ops the default config supports everywhere.
+    """
+    m_hi = jnp.min(hi, axis=axis, keepdims=True)
+    cand = hi == m_hi
+    lo_m = jnp.where(cand, lo, jnp.inf)
+    m_lo = jnp.min(lo_m, axis=axis, keepdims=True)
+    cand &= lo_m == m_lo
+    ax = axis if axis >= 0 else hi.ndim + axis
+    iota = jax.lax.broadcasted_iota(jnp.int32, hi.shape, ax)
+    return jnp.min(jnp.where(cand, iota, hi.shape[ax]), axis=axis)
 
 
 def _monotonic_ok(v_l, v_r, mono_cst, mono_lo, mono_hi) -> jax.Array:

@@ -128,7 +128,8 @@ def unpack_decision(packed: "np.ndarray") -> dict:
 def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                   task: str, criterion: str, debug: bool = False,
                   use_pallas: bool = False, use_wide: bool = False,
-                  wide_bf16: bool = False, node_mask: bool = False,
+                  wide_bf16: bool = False, exact_ties: bool = False,
+                  node_mask: bool = False,
                   random_split: bool = False, monotonic: bool = False):
     """Jitted (x_binned, y, node_id, weight, cand_mask, chunk_lo, mcw[, nmask])
     -> packed (n_slots, 9 + C) float32 decision buffer (see
@@ -187,7 +188,8 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
             h = lax.psum(h, DATA_AXIS)
             dec = imp_ops.best_split_classification(
                 h, cand_mask, criterion=criterion, node_mask=nmask,
-                min_child_weight=mcw, forced_draw=draws, **mono,
+                min_child_weight=mcw, forced_draw=draws,
+                exact_ties=exact_ties, **mono,
             )
         else:
             if use_pallas:

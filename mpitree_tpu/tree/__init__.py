@@ -7,7 +7,7 @@ reference users can switch with a one-line import change, and additionally
 exports the estimators the reference lacks (regressor, forests).
 """
 
-from mpitree_tpu.core.tree_struct import Node, TreeArrays
+from mpitree_tpu.core.tree_struct import BranchType, Node, TreeArrays
 from mpitree_tpu.models.classifier import (
     DecisionTreeClassifier,
     ParallelDecisionTreeClassifier,
@@ -21,6 +21,7 @@ __all__ = [
     "DecisionTreeRegressor",
     "RandomForestClassifier",
     "RandomForestRegressor",
+    "BranchType",
     "Node",
     "TreeArrays",
 ]

@@ -133,19 +133,20 @@ def test_identity_at_branch_trim_boundary_depths(max_depth):
     )
 
 
-def test_deep_small_node_f32_seam_is_bounded():
-    """The KNOWN host/device seam, pinned: device engines evaluate split
-    costs in f32, the host tier in f64. At small deep nodes an exact
-    mathematical cost tie (which the contract breaks toward the lower
-    threshold) can round unequal in f32, flipping the pick — first
-    observed at a 13-row depth-9 node (counts [6,3,4], thresholds 0.0 vs
-    1.0). The seam CANNOT surface in the production hybrid configuration:
-    device crowns stop at refine_depth (<= 10) where covtype-scale nodes
-    are still thousands of rows, and the exact-candidate host tail owns
-    the deep small nodes. This test documents the bound: identical trees
-    through depth 9 on this 512-row workload, same node COUNT and leaf
-    count totals (the divergence reorders structure, it does not change
-    per-node statistics correctness) deeper."""
+def test_deep_small_node_f32_seam_closed():
+    """The round-4 host/device seam, now CLOSED (VERDICT r4 #5): device
+    engines used to evaluate split costs in f32, where a mathematical
+    cost tie (contract: lower threshold wins) could round unequal and
+    flip the pick vs the host's f64 — first observed at a 13-row depth-9
+    node. CPU-backed device builds now rank costs by a scoped-x64 f64
+    sweep carried as a two-float (hi, lo) pair (ops/impurity.py:
+    _cost_sweep_f64), so full-depth device-vs-host identity holds with no
+    leaf-mass fallback. The f32 regime is pinned too: with
+    MPITREE_TPU_EXACT_TIES=0 the same workload MUST still diverge — if it
+    stops diverging, the f64 path is dead code or the workload lost its
+    tie and the test its teeth. (TPU builds keep the f32 sweep — no f64
+    unit — where the production hybrid masks the seam: crowns stop while
+    nodes are large, the exact host tail owns deep small nodes.)"""
     rng = np.random.default_rng(7)
     X = rng.integers(0, 5, size=(512, F)).astype(np.float32)
     X[:5] = np.arange(5, dtype=np.float32)[:, None]
@@ -154,31 +155,38 @@ def test_deep_small_node_f32_seam_is_bounded():
     binned = bin_dataset(X, binning="exact")
     mesh = mesh_lib.resolve_mesh(n_devices=2)
 
-    def pair(md):
+    def pair(md, eng):
         cfg = BuildConfig(
             task="classification", criterion="entropy", max_depth=md
         )
         host = build_tree_host(binned, y, config=cfg, n_classes=N_CLASSES)
         dev = build_tree(
             binned, y,
-            config=BuildConfig(**{**cfg.__dict__, "engine": "fused"}),
+            config=BuildConfig(**{**cfg.__dict__, "engine": eng}),
             mesh=mesh, n_classes=N_CLASSES,
         )
         return host, dev
 
-    host9, dev9 = pair(9)
-    assert _structure(host9) == _structure(dev9)  # crown regime: exact
-    host12, dev12 = pair(12)
-    # Deeper: structure may legitimately reorder at f32-tied nodes, but
-    # the trees must stay the same size with identical total leaf mass.
-    assert host12.n_nodes == dev12.n_nodes
-    assert host12.count[0].tolist() == dev12.count[0].tolist()
-    leaves_h = host12.feature < 0
-    leaves_d = dev12.feature < 0
-    assert leaves_h.sum() == leaves_d.sum()
-    np.testing.assert_array_equal(
-        host12.count[leaves_h].sum(axis=0), dev12.count[leaves_d].sum(axis=0)
-    )
+    for md in (12, 15, 20):
+        for eng in ("fused", "levelwise"):
+            host, dev = pair(md, eng)
+            assert _structure(host) == _structure(dev), (md, eng)
+            np.testing.assert_array_equal(host.count, dev.count)
+
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setenv("MPITREE_TPU_EXACT_TIES", "0")
+        host, dev = pair(15, "fused")
+        assert _structure(host) != _structure(dev), (
+            "f32 seam vanished: the exact-ties path is untestable"
+        )
+        # The f32 divergence stays bounded: same size, same leaf mass.
+        assert host.n_nodes == dev.n_nodes
+        leaves_h, leaves_d = host.feature < 0, dev.feature < 0
+        assert leaves_h.sum() == leaves_d.sum()
+        np.testing.assert_array_equal(
+            host.count[leaves_h].sum(axis=0),
+            dev.count[leaves_d].sum(axis=0),
+        )
 
 
 @pytest.mark.parametrize("seed", range(10))

@@ -107,3 +107,33 @@ def test_degenerate_arange_fit_renders():
     assert t.n_leaves == n  # memorized: every sample its own leaf
     text = clf.export_text()
     assert text.count("\n") + 1 == t.n_nodes
+
+
+def test_node_view_btype_and_lt_reference_semantics():
+    """The to_nodes() view carries the reference Node's full surface
+    (mpitree/tree/_base.py:57-75): `_btype` rendering state and the
+    side-effecting `__lt__` — comparing stamps both sides' glyphs and
+    returns whether SELF is interior. Code that sorted reference nodes
+    directly must behave identically on the view."""
+    import numpy as np
+
+    from mpitree_tpu import DecisionTreeClassifier
+    from mpitree_tpu.tree import BranchType
+
+    X = np.array([[0.0], [1.0], [2.0], [3.0]], np.float32)
+    y = np.array([0, 0, 1, 1], np.int32)
+    root = DecisionTreeClassifier(binning="exact").fit(X, y).tree_.to_nodes()
+    assert root._btype is BranchType.ROOT
+    assert not root.is_leaf
+    leaf, interior = root.left, root
+    # leaf < interior: stamps leaf LEAF_LIKE / other INTERIOR_LIKE, False
+    assert (leaf < interior) is False
+    assert leaf._btype is BranchType.LEAF_LIKE
+    assert interior._btype is BranchType.INTERIOR_LIKE
+    # interior < leaf: stamps self INTERIOR_LIKE / other LEAF_LIKE, True
+    assert (interior < leaf) is True
+    assert interior._btype is BranchType.INTERIOR_LIKE
+    assert leaf._btype is BranchType.LEAF_LIKE
+    # sorted() puts interior nodes first, exactly like the reference
+    both = sorted([root.left, root])
+    assert both[0] is root and both[1] is root.left

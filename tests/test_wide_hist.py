@@ -29,8 +29,11 @@ def _class_case(rng, N, F, S, B, C, *, max_w=4, dead_frac=0.3):
 
 @pytest.mark.parametrize("shape", [
     # (N, F, S, B, C, window, row_tile, feature_chunk)
-    (4096, 54, 4096, 256, 7, 32, 1024, 8),
-    (2000, 54, 512, 256, 7, 32, None, 8),    # auto row tile
+    # Covtype-chunk STRUCTURE (K=4096 slots, many windows, padding tiles)
+    # at reduced F/B — the full covtype dims cost ~90 s of CPU matmul per
+    # case and add no new code paths.
+    (3000, 12, 4096, 64, 7, 32, 512, 8),
+    (2000, 54, 512, 256, 7, 32, None, 8),    # auto row tile, covtype F/B
     (999, 11, 256, 64, 3, 32, 256, 4),       # ragged F, odd N
     (130, 7, 320, 32, 2, 64, 128, 7),        # window 64, F == chunk
     (17, 3, 32, 8, 5, 8, 64, 2),             # tiny everything
@@ -127,8 +130,11 @@ def test_window_must_divide_slots():
 
 def test_fused_deep_build_rides_wide_tier(rng, monkeypatch):
     """A deep fused build whose frontiers cross MIN_SLOTS must produce the
-    identical tree with the wide tier on (default) and off (scatter) —
-    the engine-level restatement of bit-identity."""
+    identical tree with the wide tier forced on and off (scatter) — the
+    engine-level restatement of bit-identity. (On CPU the auto routing
+    keeps the scatter — the tier targets the TPU scalar-unit dodge — so
+    the force flag is the test seam, same idea as MPITREE_TPU_DEVICE_BIN.)
+    """
     from mpitree_tpu import DecisionTreeClassifier
 
     X = rng.standard_normal((3000, 8)).astype(np.float32)
@@ -144,6 +150,7 @@ def test_fused_deep_build_rides_wide_tier(rng, monkeypatch):
                 t.count.copy())
 
     monkeypatch.setenv("MPITREE_TPU_ENGINE", "fused")
+    monkeypatch.setenv("MPITREE_TPU_WIDE_HIST", "1")
     wide = fit()
     monkeypatch.setenv("MPITREE_TPU_WIDE_HIST", "0")
     scatter = fit()

@@ -51,6 +51,7 @@ BUDGET = {
     "north_star": 900,
     "north_star_fused": 900,
     "engine_fused": 900,
+    "predict": 900,
 }
 
 
