@@ -400,6 +400,29 @@ def worker_hist_tput(npz_path: str) -> dict:
             res[f"hist_K4096_wide_{'bf16' if bf16 else 'f32'}"] = {
                 "error": f"{type(e).__name__}: {e}"
             }
+
+    # The Mosaic grouped-matmul executor of the same tier: window blocks
+    # accumulate in VMEM across their tile runs (scalar-prefetched output
+    # index) instead of a read-modify-write per tile. This number decides
+    # MPITREE_TPU_WIDE_KERNEL's default (resolve_wide_kernel).
+    if wh.wide_pallas_available(platform):
+        def wide_pl_fn(xb, payload_k, nid):
+            return wh.histogram_wide_pallas(
+                xb, payload_k, nid, n_slots=K, n_bins=B, n_channels=C,
+                bf16_ok=True,
+            )
+
+        try:
+            s_wpl = timed(wide_pl_fn, xb, payload_k, nid)
+            res["hist_K4096_wide_pallas"] = {
+                "seconds": round(s_wpl, 5),
+                "g_updates_per_s": round(N * F / s_wpl / 1e9, 3),
+                "speedup_vs_scatter": round(s / s_wpl, 2),
+            }
+        except Exception as e:  # noqa: BLE001
+            res["hist_K4096_wide_pallas"] = {
+                "error": f"{type(e).__name__}: {e}"
+            }
     roof = next(
         (v for k, v in HBM_ROOFLINE_GBPS.items() if k in kind), None
     )

@@ -246,6 +246,31 @@ def resolve_wide_hist(cfg: BuildConfig, platform: str, task: str, *,
     return True, bf16
 
 
+def resolve_wide_kernel(platform: str) -> bool:
+    """Whether the wide tier uses the Mosaic grouped-matmul executor
+    (``wide_hist.histogram_wide_pallas``) instead of the XLA scan.
+
+    Both are bit-identical (same pack, same contraction); they differ in
+    accumulation traffic — the Mosaic kernel keeps each window block in
+    VMEM across its tile run, the scan pays a read-modify-write per tile.
+    Default stays the scan until the hist_tput capture proves the kernel
+    on hardware; ``MPITREE_TPU_WIDE_KERNEL=pallas|scan`` overrides.
+    """
+    from mpitree_tpu.ops import wide_hist
+
+    flag = os.environ.get("MPITREE_TPU_WIDE_KERNEL", "scan")
+    if flag == "pallas":
+        if not wide_hist.wide_pallas_available(platform):
+            raise ValueError(
+                "MPITREE_TPU_WIDE_KERNEL=pallas needs a TPU backend "
+                f"(platform={platform!r})"
+            )
+        return True
+    if flag not in ("scan", "auto"):
+        raise ValueError(f"unknown MPITREE_TPU_WIDE_KERNEL {flag!r}")
+    return False
+
+
 def resolve_exact_ties(platform: str) -> bool:
     """Whether device classification sweeps rank costs in f64 (seam closure).
 
@@ -600,6 +625,9 @@ def build_tree(
     exact_ok = resolve_exact_ties(mesh.devices.flat[0].platform)
     if exact_ok and not exact_ties_fits(K, F, B):
         warn_exact_ties_gap(K, F, B)
+    wide_pallas = use_wide and resolve_wide_kernel(
+        mesh.devices.flat[0].platform
+    )
     # Levelwise keeps only Pallas-eligible tiers: that is where the measured
     # win lives (the MXU kernel beat the scatter 3.3x at S=8), while XLA
     # tiers saved <3% warm and cost an extra ~20-40s tunnel compile each.
@@ -621,6 +649,7 @@ def build_tree(
             mesh, n_slots=S, n_bins=B, n_classes=C, task=task,
             criterion=cfg.criterion, debug=debug, use_pallas=S in tiers,
             exact_ties=exact_ok and exact_ties_fits(S, F, B),
+            wide_pallas=wide_pallas,
             use_wide=(use_wide and S not in tiers
                       and S >= wide_hist.MIN_SLOTS
                       and S % wide_hist.WINDOW == 0),

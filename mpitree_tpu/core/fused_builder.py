@@ -46,6 +46,7 @@ from mpitree_tpu.core.builder import (
     resolve_exact_ties,
     resolve_hist_kernel,
     resolve_wide_hist,
+    resolve_wide_kernel,
     valid_tiers as builder_valid_tiers,
 )
 from mpitree_tpu.core.tree_struct import TreeArrays
@@ -107,6 +108,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                      max_depth: int, min_samples_split: int,
                      tiers: tuple = (), use_pallas: bool = False,
                      use_wide: bool = False, wide_bf16: bool = False,
+                     wide_pallas: bool = False,
                      exact_ties: bool = False,
                      psum_axis: str | None = DATA_AXIS,
                      feature_axis: str | None = None,
@@ -294,11 +296,20 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                         n_bins=n_bins, n_channels=C, vma=hist_vma,
                     )
                 elif wide_ok(n_stat_slots):
-                    h = wide_hist.histogram_wide(
-                        xb, payload, nid - chunk_lo, n_slots=n_stat_slots,
-                        n_bins=n_bins, n_channels=C, window=wide_hist.WINDOW,
-                        bf16_ok=wide_bf16, vma=hist_vma,
-                    )
+                    if wide_pallas:
+                        h = wide_hist.histogram_wide_pallas(
+                            xb, payload, nid - chunk_lo,
+                            n_slots=n_stat_slots, n_bins=n_bins,
+                            n_channels=C, window=wide_hist.WINDOW,
+                            bf16_ok=wide_bf16, vma=hist_vma,
+                        )
+                    else:
+                        h = wide_hist.histogram_wide(
+                            xb, payload, nid - chunk_lo,
+                            n_slots=n_stat_slots, n_bins=n_bins,
+                            n_channels=C, window=wide_hist.WINDOW,
+                            bf16_ok=wide_bf16, vma=hist_vma,
+                        )
                 else:
                     h = hist_ops.class_histogram(
                         xb, y, nid, chunk_lo, n_slots=n_stat_slots,
@@ -322,11 +333,20 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                         n_bins=n_bins, n_channels=3, vma=hist_vma,
                     )
                 elif wide_ok(n_stat_slots):
-                    h = wide_hist.histogram_wide(
-                        xb, payload, nid - chunk_lo, n_slots=n_stat_slots,
-                        n_bins=n_bins, n_channels=3, window=wide_hist.WINDOW,
-                        bf16_ok=False, vma=hist_vma,
-                    )
+                    if wide_pallas:
+                        h = wide_hist.histogram_wide_pallas(
+                            xb, payload, nid - chunk_lo,
+                            n_slots=n_stat_slots, n_bins=n_bins,
+                            n_channels=3, window=wide_hist.WINDOW,
+                            bf16_ok=False, vma=hist_vma,
+                        )
+                    else:
+                        h = wide_hist.histogram_wide(
+                            xb, payload, nid - chunk_lo,
+                            n_slots=n_stat_slots, n_bins=n_bins,
+                            n_channels=3, window=wide_hist.WINDOW,
+                            bf16_ok=False, vma=hist_vma,
+                        )
                 else:
                     h = hist_ops.moment_histogram(
                         xb, y, nid, chunk_lo, n_slots=n_stat_slots,
@@ -573,7 +593,8 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                    task: str, criterion: str, max_nodes: int, max_depth: int,
                    min_samples_split: int, tiers: tuple = (),
                    use_pallas: bool = False, use_wide: bool = False,
-                   wide_bf16: bool = False, exact_ties: bool = False,
+                   wide_bf16: bool = False, wide_pallas: bool = False,
+                   exact_ties: bool = False,
                    sample_k: int | None = None,
                    random_split: bool = False, monotonic: bool = False):
     """Data-parallel single-tree build: rows sharded, histograms psum'd.
@@ -593,7 +614,7 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         criterion=criterion, max_nodes=max_nodes, max_depth=max_depth,
         min_samples_split=min_samples_split, tiers=tiers,
         use_pallas=use_pallas, use_wide=use_wide, wide_bf16=wide_bf16,
-        exact_ties=exact_ties,
+        wide_pallas=wide_pallas, exact_ties=exact_ties,
         psum_axis=DATA_AXIS,
         feature_axis=feature_axis, sample_k=sample_k,
         random_split=random_split, monotonic=monotonic,
@@ -617,6 +638,7 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                     max_depth: int, min_samples_split: int,
                     tiers: tuple = (), use_pallas: bool = False,
                     use_wide: bool = False, wide_bf16: bool = False,
+                    wide_pallas: bool = False,
                     exact_ties: bool = False,
                     data_sharded: bool = False,
                     sample_k: int | None = None,
@@ -643,7 +665,7 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         criterion=criterion, max_nodes=max_nodes, max_depth=max_depth,
         min_samples_split=min_samples_split, tiers=tiers,
         use_pallas=use_pallas, use_wide=use_wide, wide_bf16=wide_bf16,
-        exact_ties=exact_ties,
+        wide_pallas=wide_pallas, exact_ties=exact_ties,
         psum_axis=DATA_AXIS if data_sharded else None,
         sample_k=sample_k, random_split=random_split, monotonic=monotonic,
     )
@@ -741,6 +763,9 @@ def build_tree_fused(
     exact_ties = resolve_exact_ties(mesh.devices.flat[0].platform)
     if exact_ties and not exact_ties_fits(K, F, B):
         warn_exact_ties_gap(K, F, B)
+    wide_pallas = use_wide and resolve_wide_kernel(
+        mesh.devices.flat[0].platform
+    )
 
     fn = _make_fused_fn(
         mesh, n_slots=K, n_bins=B, n_classes=C, task=task,
@@ -749,7 +774,7 @@ def build_tree_fused(
         min_samples_split=int(cfg.min_samples_split),
         tiers=tuple(cfg.frontier_tiers),
         use_pallas=use_pallas, use_wide=use_wide, wide_bf16=wide_bf16,
-        exact_ties=exact_ties,
+        wide_pallas=wide_pallas, exact_ties=exact_ties,
         sample_k=sample_k, random_split=random_split,
         monotonic=monotonic,
     )
@@ -913,6 +938,9 @@ def build_forest_fused(
     exact_ties = resolve_exact_ties(mesh.devices.flat[0].platform)
     if exact_ties and not exact_ties_fits(K, F, B):
         warn_exact_ties_gap(K, F, B)
+    wide_pallas = use_wide and resolve_wide_kernel(
+        mesh.devices.flat[0].platform
+    )
 
     if task == "classification" and float(weights.sum(axis=1).max()) >= 2**24:
         import warnings
@@ -931,7 +959,7 @@ def build_forest_fused(
         min_samples_split=int(cfg.min_samples_split),
         tiers=tuple(cfg.frontier_tiers),
         use_pallas=use_pallas, use_wide=use_wide, wide_bf16=wide_bf16,
-        exact_ties=exact_ties,
+        wide_pallas=wide_pallas, exact_ties=exact_ties,
         data_sharded=data_sharded,
         sample_k=sample_k, random_split=random_split,
         monotonic=mono_cst is not None and bool(np.any(np.asarray(mono_cst))),

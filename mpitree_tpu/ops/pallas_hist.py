@@ -255,8 +255,10 @@ def moment_payload(y: jax.Array, w: jax.Array) -> jax.Array:
 
 
 def pallas_available(platform: str) -> bool:
-    """True when the Mosaic TPU backend can compile this kernel."""
-    return _HAS_PLTPU and platform == "tpu"
+    """True when the Mosaic TPU backend can compile this kernel ("axon" =
+    the tunneled accelerator's backend name; its devices report "tpu" in
+    practice, but the health probe accepts both — so does this)."""
+    return _HAS_PLTPU and platform in ("tpu", "axon")
 
 
 # Conservative VMEM ceiling for the kernel's persistent out block plus its

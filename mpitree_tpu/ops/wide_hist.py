@@ -14,10 +14,19 @@ the scatter from wide levels entirely:
    row tile intersects exactly ONE window. Pure gather construction — the
    packed source index per position is computed with ``searchsorted`` over
    the (tiny) per-window offset table; no scatter anywhere.
-3. **Contract**: a ``lax.scan`` over row tiles; each tile is a dense
-   ``(W*C, Rt) @ (Rt, Fc*B)`` one-hot contraction on the MXU (features in
-   chunks of ``Fc``), accumulated into its window's block of the
-   ``(S/W, ...)`` histogram via in-place ``dynamic_update_slice``.
+3. **Contract**: each tile is a dense ``(W*C, Rt) @ (Rt, Fc*B)`` one-hot
+   contraction on the MXU, accumulated into its window's block of the
+   ``(S/W, ...)`` histogram. Two executors share steps 1-2:
+
+   - :func:`histogram_wide` — a ``lax.scan`` over tiles with in-place
+     ``dynamic_update_slice`` accumulation. Pure XLA, runs anywhere; each
+     tile pays a read-modify-write of its window block.
+   - :func:`histogram_wide_pallas` — a Mosaic kernel whose *output block
+     index* is scalar-prefetched from the per-tile window id (the
+     grouped-matmul pattern): consecutive tiles of one window accumulate
+     in VMEM and each window block is written to HBM exactly once. TPU
+     only; ``bench_tpu.py``'s hist_tput section measures both so routing
+     can follow hardware evidence.
 
 FLOPs per row are ``W*C*B`` — independent of the frontier width ``S`` — so
 a 4096-slot deep level costs the same per row as a 32-slot one. The
@@ -48,6 +57,15 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # pltpu imports fail on builds without TPU support
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except Exception:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
 
 
 def _round_up(x: int, m: int) -> int:
@@ -63,54 +81,31 @@ MIN_SLOTS = 256
 WINDOW = 32
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("n_slots", "n_bins", "n_channels", "window",
-                     "row_tile", "feature_chunk", "bf16_ok", "vma"),
-)
-def histogram_wide(
-    x_binned: jax.Array,
-    payload: jax.Array,
-    slot: jax.Array,
-    *,
-    n_slots: int,
-    n_bins: int,
-    n_channels: int,
-    window: int = WINDOW,
-    row_tile: int | None = None,
-    feature_chunk: int = 8,
-    bf16_ok: bool = False,
-    vma: tuple = (),
-) -> jax.Array:
-    """(N,F) bins + (N,C) payload + (N,) slot -> (S, F, C, B) histogram.
+def _auto_row_tile(R: int, n_win: int) -> int:
+    # Big tiles amortize per-tile overhead, but every (possibly) occupied
+    # window pads to a tile multiple — bound the tile by occupancy
+    # (R / n_win) so pad rows can't dominate live rows on small shards or
+    # sparse chunks (8-way covtype shard at K=4096: a flat 1024 tile would
+    # pack ~2 pad rows per live row).
+    return min(1024, max(128, _round_up(R // max(n_win, 1), 128)))
 
-    ``slot`` is the frontier slot per row; rows outside ``[0, n_slots)``
-    (parked in leaves, padding, other chunks) contribute nothing.
-    ``payload`` is ``class_payload``/``moment_payload`` from
-    ``pallas_hist`` — one function serves both tasks. ``vma`` names the
-    shard_map mesh axes this shard's partial histogram varies over (the
-    scan carry's zero init must carry the same varying axes as the scanned
-    row data or the carry types mismatch).
+
+def _sort_and_pack(x_binned, payload, slot, *, n_slots: int, window: int,
+                   row_tile: int, f_pad: int):
+    """Steps 1-2 shared by both executors.
+
+    Returns ``(xb_p, pay_p, wl_p, wnd_tile, n_tiles, counts)``: packed
+    inputs of ``n_tiles * row_tile`` rows where every tile's rows belong
+    to ONE slot window (``wnd_tile[i]``), pad rows carry ``wl_p = -1``
+    (their one-hot row is all zeros), ``xb_p`` is feature-padded to
+    ``f_pad``, and ``counts`` is the (n_win,) live-row count per window
+    (the Pallas executor masks never-visited blocks with it).
     """
     R, F = x_binned.shape
-    if row_tile is None:
-        # Big tiles amortize the scan/DUS overhead, but every (possibly)
-        # occupied window pads to a tile multiple — bound the tile by
-        # occupancy (R / n_win) so pad rows can't dominate live rows on
-        # small shards or sparse chunks (8-way covtype shard at K=4096:
-        # a flat 1024 tile would pack ~2 pad rows per live row).
-        row_tile = min(
-            1024, max(128, _round_up(R // max(n_slots // window, 1), 128))
-        )
-    C, S, W, Rt, Fc = n_channels, n_slots, window, row_tile, feature_chunk
-    if S % W:
-        raise ValueError(f"window {W} must divide n_slots {S}")
+    S, W, Rt = n_slots, window, row_tile
     n_win = S // W
-    Bp = _round_up(max(n_bins, 1), 128)
-    Fp = _round_up(F, Fc)
-    n_fc = Fp // Fc
-    # Worst-case packed length: every live row plus up to Rt-1 pad rows per
-    # window. Static — the scan length must not depend on data.
+    # Worst-case packed length: every live row plus up to Rt-1 pad rows
+    # per window. Static — grid/scan lengths must not depend on data.
     n_tiles = (R + n_win * (Rt - 1) + Rt - 1) // Rt
     Npad = n_tiles * Rt
 
@@ -147,9 +142,65 @@ def histogram_wide(
     pay_p = jnp.where(live[:, None], jnp.take(payload, src, axis=0), 0.0)
     # Local slot within the window; -1 kills the one-hot row for pad rows.
     wl_p = jnp.where(live, sl_sorted[src_sorted] - k_clip * W, -1)
-    if Fp != F:
-        xb_p = jnp.pad(xb_p, ((0, 0), (0, Fp - F)))
+    if f_pad != F:
+        xb_p = jnp.pad(xb_p, ((0, 0), (0, f_pad - F)))
+    wnd_tile = k_clip.reshape(n_tiles, Rt)[:, 0]
+    return xb_p, pay_p, wl_p, wnd_tile, n_tiles, counts
 
+
+def _finalize(hist, *, n_slots, n_bins, f_true, window, n_channels,
+              feature_chunk, bp):
+    """(n_win, n_fc, W*C, Fc*Bp) accumulator -> (S, F, C, B) histogram."""
+    n_win = n_slots // window
+    W, C, Fc = window, n_channels, feature_chunk
+    n_fc = hist.shape[1]
+    out = hist.reshape(n_win, n_fc, W, C, Fc, bp)
+    out = out.transpose(0, 2, 1, 4, 3, 5)  # (n_win, W, n_fc, Fc, C, Bp)
+    return out.reshape(n_slots, n_fc * Fc, C, bp)[:, :f_true, :, :n_bins]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_slots", "n_bins", "n_channels", "window",
+                     "row_tile", "feature_chunk", "bf16_ok", "vma"),
+)
+def histogram_wide(
+    x_binned: jax.Array,
+    payload: jax.Array,
+    slot: jax.Array,
+    *,
+    n_slots: int,
+    n_bins: int,
+    n_channels: int,
+    window: int = WINDOW,
+    row_tile: int | None = None,
+    feature_chunk: int = 8,
+    bf16_ok: bool = False,
+    vma: tuple = (),
+) -> jax.Array:
+    """(N,F) bins + (N,C) payload + (N,) slot -> (S, F, C, B) histogram.
+
+    ``slot`` is the frontier slot per row; rows outside ``[0, n_slots)``
+    (parked in leaves, padding, other chunks) contribute nothing.
+    ``payload`` is ``class_payload``/``moment_payload`` from
+    ``pallas_hist`` — one function serves both tasks. ``vma`` names the
+    shard_map mesh axes this shard's partial histogram varies over (the
+    scan carry's zero init must carry the same varying axes as the scanned
+    row data or the carry types mismatch).
+    """
+    R, F = x_binned.shape
+    C, S, W, Fc = n_channels, n_slots, window, feature_chunk
+    if S % W:
+        raise ValueError(f"window {W} must divide n_slots {S}")
+    n_win = S // W
+    Rt = row_tile if row_tile is not None else _auto_row_tile(R, n_win)
+    Bp = _round_up(max(n_bins, 1), 128)
+    Fp = _round_up(F, Fc)
+    n_fc = Fp // Fc
+
+    xb_p, pay_p, wl_p, wnd_tile, n_tiles, _counts = _sort_and_pack(
+        x_binned, payload, slot, n_slots=S, window=W, row_tile=Rt, f_pad=Fp,
+    )
     mm_dtype = jnp.bfloat16 if bf16_ok else jnp.float32
 
     # --- 3. scan of MXU contractions, window blocks updated in place -----
@@ -185,11 +236,135 @@ def histogram_wide(
         xb_p.reshape(n_tiles, Rt, Fp),
         pay_p.reshape(n_tiles, Rt, C),
         wl_p.reshape(n_tiles, Rt),
-        k_clip.reshape(n_tiles, Rt)[:, 0],
+        wnd_tile,
     )
     hist, _ = lax.scan(tile_body, hist0, xs)
+    return _finalize(hist, n_slots=S, n_bins=n_bins, f_true=F, window=W,
+                     n_channels=C, feature_chunk=Fc, bp=Bp)
 
-    # (n_win, n_fc, W*C, Fc*Bp) -> (S, F, C, n_bins)
-    out = hist.reshape(n_win, n_fc, W, C, Fc, Bp)
-    out = out.transpose(0, 2, 1, 4, 3, 5)  # (n_win, W, n_fc, Fc, C, Bp)
-    return out.reshape(S, Fp, C, Bp)[:, :F, :, :n_bins]
+
+def _wide_kernel(wnd_ref, wl_ref, pay_ref, xb_ref, out_ref, *, window,
+                 n_channels, n_bins_pad, fc_width, mm_dtype):
+    """Grouped-matmul grid step: one (feature chunk, row tile) pair.
+
+    Grid is ``(n_fc, n_tiles)`` — tiles innermost, so each (fc, window)
+    output block sees its tiles as one contiguous run: zero it when the
+    run starts (first tile, or the prefetched window id changed) and let
+    Mosaic's revisiting-block machinery keep it in VMEM until the id
+    changes again, writing it to HBM exactly once per run.
+    """
+    W, C, Bp, Fc = window, n_channels, n_bins_pad, fc_width
+    i = pl.program_id(1)
+    wnd_prev = wnd_ref[jnp.maximum(i - 1, 0)]
+
+    @pl.when(jnp.logical_or(i == 0, wnd_ref[i] != wnd_prev))
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    Rt = wl_ref.shape[0]
+    sc_iota = lax.broadcasted_iota(jnp.int32, (Rt, W * C), 1)
+    wl = wl_ref[:, 0]
+    m1 = jnp.where(
+        sc_iota // C == wl[:, None],
+        jnp.concatenate([pay_ref[...]] * W, axis=1),
+        0.0,
+    ).astype(mm_dtype)  # (Rt, W*C)
+    b_iota = lax.broadcasted_iota(jnp.int32, (Rt, Bp), 1)
+    for f in range(Fc):  # unrolled: Fc static, one MXU matmul each
+        onehot = (xb_ref[:, f][:, None] == b_iota).astype(mm_dtype)
+        out_ref[0, 0, :, f * Bp:(f + 1) * Bp] += lax.dot_general(
+            m1, onehot,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_slots", "n_bins", "n_channels", "window",
+                     "row_tile", "feature_chunk", "bf16_ok", "interpret",
+                     "vma"),
+)
+def histogram_wide_pallas(
+    x_binned: jax.Array,
+    payload: jax.Array,
+    slot: jax.Array,
+    *,
+    n_slots: int,
+    n_bins: int,
+    n_channels: int,
+    window: int = WINDOW,
+    row_tile: int | None = None,
+    feature_chunk: int = 8,
+    bf16_ok: bool = False,
+    interpret: bool = False,
+    vma: tuple = (),
+) -> jax.Array:
+    """Same contract as :func:`histogram_wide`, Mosaic executor.
+
+    The per-tile window id rides as a scalar-prefetch operand; the output
+    BlockSpec indexes on it, so window blocks accumulate in VMEM across
+    their contiguous tile runs (guaranteed by the packing) instead of
+    round-tripping HBM per tile. ``interpret=True`` runs the Pallas
+    interpreter — the CPU exactness seam, like ``pallas_hist``'s.
+    """
+    R, F = x_binned.shape
+    C, S, W, Fc = n_channels, n_slots, window, feature_chunk
+    if S % W:
+        raise ValueError(f"window {W} must divide n_slots {S}")
+    n_win = S // W
+    Rt = row_tile if row_tile is not None else _auto_row_tile(R, n_win)
+    Bp = _round_up(max(n_bins, 1), 128)
+    Fp = _round_up(F, Fc)
+    n_fc = Fp // Fc
+
+    xb_p, pay_p, wl_p, wnd_tile, n_tiles, counts = _sort_and_pack(
+        x_binned, payload, slot, n_slots=S, window=W, row_tile=Rt, f_pad=Fp,
+    )
+    mm_dtype = jnp.bfloat16 if bf16_ok else jnp.float32
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_fc, n_tiles),
+        in_specs=[
+            pl.BlockSpec((Rt, 1), lambda fc, i, wnd: (i, 0)),
+            pl.BlockSpec((Rt, C), lambda fc, i, wnd: (i, 0)),
+            pl.BlockSpec((Rt, Fc), lambda fc, i, wnd: (i, fc)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, W * C, Fc * Bp), lambda fc, i, wnd: (wnd[i], fc, 0, 0)
+        ),
+    )
+    out_shape = jax.ShapeDtypeStruct(
+        (n_win, n_fc, W * C, Fc * Bp), jnp.float32
+    )
+    if vma:  # inside shard_map the per-shard partial varies over the mesh
+        out_shape = jax.ShapeDtypeStruct(
+            out_shape.shape, out_shape.dtype, vma=frozenset(vma)
+        )
+    hist = pl.pallas_call(
+        functools.partial(
+            _wide_kernel, window=W, n_channels=C, n_bins_pad=Bp,
+            fc_width=Fc, mm_dtype=mm_dtype,
+        ),
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(wnd_tile, wl_p[:, None], pay_p, xb_p)
+    # Blocks of EMPTY windows are never visited by any grid step, so they
+    # come back uninitialized — mask them with the pack's window counts.
+    hist = jnp.where(
+        (counts > 0)[:, None, None, None], hist, 0.0
+    )
+    return _finalize(hist, n_slots=S, n_bins=n_bins, f_true=F, window=W,
+                     n_channels=C, feature_chunk=Fc, bp=Bp)
+
+
+def wide_pallas_available(platform: str) -> bool:
+    """True when the Mosaic grouped-matmul executor can compile.
+
+    Accepts "axon" alongside "tpu": the tunneled accelerator registers
+    under that backend name (its devices report platform "tpu" in the
+    round-4 captures, but the health probe accepts both — so does this).
+    """
+    return _HAS_PLTPU and platform in ("tpu", "axon")

@@ -128,7 +128,8 @@ def unpack_decision(packed: "np.ndarray") -> dict:
 def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                   task: str, criterion: str, debug: bool = False,
                   use_pallas: bool = False, use_wide: bool = False,
-                  wide_bf16: bool = False, exact_ties: bool = False,
+                  wide_bf16: bool = False, wide_pallas: bool = False,
+                  exact_ties: bool = False,
                   node_mask: bool = False,
                   random_split: bool = False, monotonic: bool = False):
     """Jitted (x_binned, y, node_id, weight, cand_mask, chunk_lo, mcw[, nmask])
@@ -174,7 +175,9 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                 from mpitree_tpu.ops import pallas_hist as ph
                 from mpitree_tpu.ops import wide_hist
 
-                h = wide_hist.histogram_wide(
+                wide_fn = (wide_hist.histogram_wide_pallas if wide_pallas
+                           else wide_hist.histogram_wide)
+                h = wide_fn(
                     xb, ph.class_payload(y, w, n_classes), nid - chunk_lo,
                     n_slots=n_slots, n_bins=n_bins, n_channels=n_classes,
                     bf16_ok=wide_bf16, vma=(DATA_AXIS,),
@@ -204,7 +207,9 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                 from mpitree_tpu.ops import pallas_hist as ph
                 from mpitree_tpu.ops import wide_hist
 
-                h = wide_hist.histogram_wide(
+                wide_fn = (wide_hist.histogram_wide_pallas if wide_pallas
+                           else wide_hist.histogram_wide)
+                h = wide_fn(
                     xb, ph.moment_payload(y, w), nid - chunk_lo,
                     n_slots=n_slots, n_bins=n_bins, n_channels=3,
                     bf16_ok=False, vma=(DATA_AXIS,),

@@ -158,3 +158,62 @@ def test_fused_deep_build_rides_wide_tier(rng, monkeypatch):
     np.testing.assert_array_equal(wide[1], scatter[1])
     np.testing.assert_array_equal(wide[2], scatter[2])
     np.testing.assert_array_equal(wide[3], scatter[3])
+
+
+@pytest.mark.parametrize("shape", [
+    (3000, 12, 1024, 64, 7, 32, 512, 8),
+    (500, 11, 256, 32, 3, 32, 128, 4),    # ragged F
+    (40, 3, 64, 8, 2, 8, 64, 2),          # tiny
+])
+@pytest.mark.parametrize("bf16", [False, True])
+def test_pallas_wide_interpret_bit_identity(rng, shape, bf16):
+    """The Mosaic grouped-matmul executor (scalar-prefetched window
+    blocks) must equal the scatter bit for bit — interpret mode is the
+    CPU seam, like pallas_hist's."""
+    N, F, S, B, C, W, Rt, Fc = shape
+    xb, y, w, nid = _class_case(rng, N, F, S, B, C)
+    ref = hist_ops.class_histogram(
+        xb, y, nid, np.int32(0), n_slots=S, n_bins=B, n_classes=C,
+        sample_weight=w,
+    )
+    got = wh.histogram_wide_pallas(
+        xb, ph.class_payload(y, w, C), nid, n_slots=S, n_bins=B,
+        n_channels=C, window=W, row_tile=Rt, feature_chunk=Fc,
+        bf16_ok=bf16, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_pallas_wide_giant_window_run_and_empty_windows(rng):
+    """The revisit logic's hard cases in one: a window whose run spans
+    many tiles (accumulate without re-zeroing) next to empty windows
+    (blocks that are zeroed on first visit and never touched again)."""
+    N, F, S, B, C = 4000, 6, 512, 16, 3
+    xb = rng.integers(0, B, (N, F), dtype=np.int32)
+    y = rng.integers(0, C, N, dtype=np.int32)
+    w = np.ones(N, np.float32)
+    nid = np.where(rng.random(N) < 0.97, 100, 7 * 32).astype(np.int32)
+    ref = hist_ops.class_histogram(
+        xb, y, nid, np.int32(0), n_slots=S, n_bins=B, n_classes=C,
+        sample_weight=w,
+    )
+    got = wh.histogram_wide_pallas(
+        xb, ph.class_payload(y, w, C), nid, n_slots=S, n_bins=B,
+        n_channels=C, window=32, row_tile=128, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_fused_wide_kernel_knob_validates():
+    """MPITREE_TPU_WIDE_KERNEL=pallas needs a TPU; unknown values raise."""
+    from mpitree_tpu.core.builder import resolve_wide_kernel
+
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setenv("MPITREE_TPU_WIDE_KERNEL", "pallas")
+        with pytest.raises(ValueError, match="TPU backend"):
+            resolve_wide_kernel("cpu")
+        mp.setenv("MPITREE_TPU_WIDE_KERNEL", "bogus")
+        with pytest.raises(ValueError, match="unknown"):
+            resolve_wide_kernel("cpu")
+        mp.setenv("MPITREE_TPU_WIDE_KERNEL", "scan")
+        assert resolve_wide_kernel("tpu") is False
