@@ -202,9 +202,30 @@ def worker_north_star_fused(npz_path: str) -> dict:
 def worker_engine(npz_path: str, engine: str) -> dict:
     Xtr, ytr, _, _ = _load(npz_path)
     platform = _device_platform()
-    out, _ = _timed_fit(
-        Xtr, ytr, backend=platform, refine_depth=None, engine_env=engine
+    from mpitree_tpu.core.builder import BuildConfig, resolve_wide_hist
+
+    wide_on, _ = resolve_wide_hist(
+        BuildConfig(), platform, "classification", integer_ok=True
     )
+    try:
+        out, _ = _timed_fit(
+            Xtr, ytr, backend=platform, refine_depth=None, engine_env=engine
+        )
+    except Exception as e:  # noqa: BLE001
+        # The wide tier (ops/wide_hist.py) sits in this section's critical
+        # path; until a real-hardware run exists, a full-build failure
+        # WITH the tier active burns the failure into the record and
+        # still captures the scatter-path number in the same healthy
+        # window. Failures with the tier already off are not its fault —
+        # re-raise rather than record a false verdict.
+        if not wide_on:
+            raise
+        os.environ["MPITREE_TPU_WIDE_HIST"] = "0"
+        out, _ = _timed_fit(
+            Xtr, ytr, backend=platform, refine_depth=None, engine_env=engine
+        )
+        out["wide_hist_failed"] = f"{type(e).__name__}: {e}"[:500]
+        out["wide_hist"] = "disabled-after-failure"
     out["engine"] = engine
     out["n_cells"] = int(Xtr.shape[0] * Xtr.shape[1])
     return out
@@ -394,6 +415,7 @@ def worker_hist_tput(npz_path: str) -> dict:
             res[f"hist_K4096_wide_{'bf16' if bf16 else 'f32'}"] = {
                 "seconds": round(s_wide, 5),
                 "g_updates_per_s": round(N * F / s_wide / 1e9, 3),
+                "read_gb_per_s": round(N * F * 4 / s_wide / 1e9, 1),
                 "speedup_vs_scatter": round(s / s_wide, 2),
             }
         except Exception as e:  # noqa: BLE001 — diagnostic section only
@@ -403,26 +425,29 @@ def worker_hist_tput(npz_path: str) -> dict:
 
     # The Mosaic grouped-matmul executor of the same tier: window blocks
     # accumulate in VMEM across their tile runs (scalar-prefetched output
-    # index) instead of a read-modify-write per tile. This number decides
+    # index) instead of a read-modify-write per tile. Both dtypes, so the
+    # comparison against the scan entries above is apples-to-apples (the
+    # builders' regression path runs f32); this number decides
     # MPITREE_TPU_WIDE_KERNEL's default (resolve_wide_kernel).
-    if wh.wide_pallas_available(platform):
-        def wide_pl_fn(xb, payload_k, nid):
-            return wh.histogram_wide_pallas(
-                xb, payload_k, nid, n_slots=K, n_bins=B, n_channels=C,
-                bf16_ok=True,
-            )
+    if wh.wide_pallas_available(platform) and wh.pallas_fits(C, B):
+        for bf16 in (False, True):
+            def wide_pl_fn(xb, payload_k, nid, bf16=bf16):
+                return wh.histogram_wide_pallas(
+                    xb, payload_k, nid, n_slots=K, n_bins=B, n_channels=C,
+                    bf16_ok=bf16,
+                )
 
-        try:
-            s_wpl = timed(wide_pl_fn, xb, payload_k, nid)
-            res["hist_K4096_wide_pallas"] = {
-                "seconds": round(s_wpl, 5),
-                "g_updates_per_s": round(N * F / s_wpl / 1e9, 3),
-                "speedup_vs_scatter": round(s / s_wpl, 2),
-            }
-        except Exception as e:  # noqa: BLE001
-            res["hist_K4096_wide_pallas"] = {
-                "error": f"{type(e).__name__}: {e}"
-            }
+            key = f"hist_K4096_wide_pallas_{'bf16' if bf16 else 'f32'}"
+            try:
+                s_wpl = timed(wide_pl_fn, xb, payload_k, nid)
+                res[key] = {
+                    "seconds": round(s_wpl, 5),
+                    "g_updates_per_s": round(N * F / s_wpl / 1e9, 3),
+                    "read_gb_per_s": round(N * F * 4 / s_wpl / 1e9, 1),
+                    "speedup_vs_scatter": round(s / s_wpl, 2),
+                }
+            except Exception as e:  # noqa: BLE001
+                res[key] = {"error": f"{type(e).__name__}: {e}"}
     roof = next(
         (v for k, v in HBM_ROOFLINE_GBPS.items() if k in kind), None
     )

@@ -625,13 +625,15 @@ def build_tree(
     exact_ok = resolve_exact_ties(mesh.devices.flat[0].platform)
     if exact_ok and not exact_ties_fits(K, F, B):
         warn_exact_ties_gap(K, F, B)
-    wide_pallas = use_wide and resolve_wide_kernel(
-        mesh.devices.flat[0].platform
-    )
     # Levelwise keeps only Pallas-eligible tiers: that is where the measured
     # win lives (the MXU kernel beat the scatter 3.3x at S=8), while XLA
     # tiers saved <3% warm and cost an extra ~20-40s tunnel compile each.
     from mpitree_tpu.ops import pallas_hist, wide_hist
+
+    wide_pallas = (
+        use_wide and resolve_wide_kernel(mesh.devices.flat[0].platform)
+        and wide_hist.pallas_fits(C, B)
+    )
 
     tiers = (
         tuple(

@@ -296,20 +296,14 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                         n_bins=n_bins, n_channels=C, vma=hist_vma,
                     )
                 elif wide_ok(n_stat_slots):
-                    if wide_pallas:
-                        h = wide_hist.histogram_wide_pallas(
-                            xb, payload, nid - chunk_lo,
-                            n_slots=n_stat_slots, n_bins=n_bins,
-                            n_channels=C, window=wide_hist.WINDOW,
-                            bf16_ok=wide_bf16, vma=hist_vma,
-                        )
-                    else:
-                        h = wide_hist.histogram_wide(
-                            xb, payload, nid - chunk_lo,
-                            n_slots=n_stat_slots, n_bins=n_bins,
-                            n_channels=C, window=wide_hist.WINDOW,
-                            bf16_ok=wide_bf16, vma=hist_vma,
-                        )
+                    wide_fn = (wide_hist.histogram_wide_pallas if wide_pallas
+                               else wide_hist.histogram_wide)
+                    h = wide_fn(
+                        xb, payload, nid - chunk_lo, n_slots=n_stat_slots,
+                        n_bins=n_bins, n_channels=C,
+                        window=wide_hist.WINDOW, bf16_ok=wide_bf16,
+                        vma=hist_vma,
+                    )
                 else:
                     h = hist_ops.class_histogram(
                         xb, y, nid, chunk_lo, n_slots=n_stat_slots,
@@ -333,20 +327,14 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                         n_bins=n_bins, n_channels=3, vma=hist_vma,
                     )
                 elif wide_ok(n_stat_slots):
-                    if wide_pallas:
-                        h = wide_hist.histogram_wide_pallas(
-                            xb, payload, nid - chunk_lo,
-                            n_slots=n_stat_slots, n_bins=n_bins,
-                            n_channels=3, window=wide_hist.WINDOW,
-                            bf16_ok=False, vma=hist_vma,
-                        )
-                    else:
-                        h = wide_hist.histogram_wide(
-                            xb, payload, nid - chunk_lo,
-                            n_slots=n_stat_slots, n_bins=n_bins,
-                            n_channels=3, window=wide_hist.WINDOW,
-                            bf16_ok=False, vma=hist_vma,
-                        )
+                    wide_fn = (wide_hist.histogram_wide_pallas if wide_pallas
+                               else wide_hist.histogram_wide)
+                    h = wide_fn(
+                        xb, payload, nid - chunk_lo, n_slots=n_stat_slots,
+                        n_bins=n_bins, n_channels=3,
+                        window=wide_hist.WINDOW, bf16_ok=False,
+                        vma=hist_vma,
+                    )
                 else:
                     h = hist_ops.moment_histogram(
                         xb, y, nid, chunk_lo, n_slots=n_stat_slots,
@@ -763,8 +751,9 @@ def build_tree_fused(
     exact_ties = resolve_exact_ties(mesh.devices.flat[0].platform)
     if exact_ties and not exact_ties_fits(K, F, B):
         warn_exact_ties_gap(K, F, B)
-    wide_pallas = use_wide and resolve_wide_kernel(
-        mesh.devices.flat[0].platform
+    wide_pallas = (
+        use_wide and resolve_wide_kernel(mesh.devices.flat[0].platform)
+        and wide_hist.pallas_fits(C, B)
     )
 
     fn = _make_fused_fn(
@@ -938,8 +927,9 @@ def build_forest_fused(
     exact_ties = resolve_exact_ties(mesh.devices.flat[0].platform)
     if exact_ties and not exact_ties_fits(K, F, B):
         warn_exact_ties_gap(K, F, B)
-    wide_pallas = use_wide and resolve_wide_kernel(
-        mesh.devices.flat[0].platform
+    wide_pallas = (
+        use_wide and resolve_wide_kernel(mesh.devices.flat[0].platform)
+        and wide_hist.pallas_fits(C, B)
     )
 
     if task == "classification" and float(weights.sum(axis=1).max()) >= 2**24:

@@ -314,6 +314,11 @@ def histogram_wide_pallas(
         raise ValueError(f"window {W} must divide n_slots {S}")
     n_win = S // W
     Rt = row_tile if row_tile is not None else _auto_row_tile(R, n_win)
+    if not pallas_fits(C, n_bins, window=W, feature_chunk=Fc, row_tile=Rt):
+        raise ValueError(
+            f"wide Mosaic working set exceeds VMEM at W={W} C={C} "
+            f"B={n_bins} Fc={Fc} Rt={Rt}; gate callers on pallas_fits()"
+        )
     Bp = _round_up(max(n_bins, 1), 128)
     Fp = _round_up(F, Fc)
     n_fc = Fp // Fc
@@ -358,6 +363,28 @@ def histogram_wide_pallas(
     )
     return _finalize(hist, n_slots=S, n_bins=n_bins, f_true=F, window=W,
                      n_channels=C, feature_chunk=Fc, bp=Bp)
+
+
+def pallas_fits(n_channels: int, n_bins: int, *,
+                window: int = WINDOW, feature_chunk: int = 8,
+                row_tile: int = 1024) -> bool:
+    """Whether the Mosaic executor's VMEM working set fits (~16 MB/core).
+
+    Persistent out block (double-buffered) plus the per-step row-tile
+    inputs and the (Rt, W*C) m1 intermediate. The block scales with
+    ``n_channels`` unboundedly, so callers gate on this the way
+    ``use_pallas`` gates on ``pallas_hist.fits_vmem`` — an unfittable
+    forced request should fail at routing, not deep inside Mosaic.
+    """
+    bp = _round_up(max(n_bins, 1), 128)
+    block = window * n_channels * feature_chunk * bp * 4 * 2
+    # Per-step working set: m1 (Rt, W*C) counted twice (mask intermediate),
+    # ONE per-feature (Rt, Bp) one-hot (the kernel's f-loop reuses it),
+    # payload (Rt, C) and the xb column block (Rt, Fc).
+    work = row_tile * (
+        2 * window * n_channels + bp + n_channels + feature_chunk + 8
+    ) * 4
+    return block + work <= (10 << 20)
 
 
 def wide_pallas_available(platform: str) -> bool:

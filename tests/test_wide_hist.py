@@ -217,3 +217,29 @@ def test_fused_wide_kernel_knob_validates():
             resolve_wide_kernel("cpu")
         mp.setenv("MPITREE_TPU_WIDE_KERNEL", "scan")
         assert resolve_wide_kernel("tpu") is False
+
+
+def test_wide_tier_on_feature_mesh(rng, monkeypatch):
+    """Forced wide tier on a 2-D (data, feature) mesh: each feature shard
+    packs/contracts its local columns and the winners merge — the tree
+    must equal the 1-device build (the tensor-parallel identity
+    contract)."""
+    from mpitree_tpu import DecisionTreeClassifier
+
+    X = rng.standard_normal((2000, 8)).astype(np.float32)
+    y = rng.integers(0, 3, 2000).astype(np.int32)
+    monkeypatch.setenv("MPITREE_TPU_WIDE_HIST", "1")
+
+    def fit(nd):
+        clf = DecisionTreeClassifier(
+            max_depth=11, max_bins=16, n_devices=nd, backend="cpu",
+            refine_depth=None,
+        )
+        clf.fit(X, y)
+        return clf.tree_
+
+    tp = fit((4, 2))   # 4-way data x 2-way feature shards
+    single = fit(1)
+    assert tp.n_nodes == single.n_nodes
+    np.testing.assert_array_equal(tp.feature, single.feature)
+    np.testing.assert_array_equal(tp.count, single.count)

@@ -171,8 +171,13 @@ def main() -> int:
     p.add_argument("--probe-every-s", type=int, default=150)
     args = p.parse_args()
 
+    # --sections order is the capture priority (healthy windows are short
+    # — the highest-evidence sections must run first). A section that is
+    # already captured is skipped unless it is also named in --redo, in
+    # which case it KEEPS its position; redo-only names append at the end.
+    redo = {s for s in args.redo.split(",") if s}
     todo = [s for s in args.sections.split(",")
-            if s and not section_done(s)]
+            if s and (s in redo or not section_done(s))]
     todo += [s for s in args.redo.split(",") if s and s not in todo]
     t_end = time.time() + args.deadline_s
     log(f"watcher start, todo={todo}")
