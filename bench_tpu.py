@@ -428,8 +428,15 @@ def worker_hist_tput(npz_path: str) -> dict:
     # index) instead of a read-modify-write per tile. Both dtypes, so the
     # comparison against the scan entries above is apples-to-apples (the
     # builders' regression path runs f32); this number decides
-    # MPITREE_TPU_WIDE_KERNEL's default (resolve_wide_kernel).
-    if wh.wide_pallas_available(platform) and wh.pallas_fits(C, B):
+    # MPITREE_TPU_WIDE_KERNEL's default (resolve_wide_pallas).
+    if not (wh.wide_pallas_available(platform) and wh.pallas_fits(C, B)):
+        res["hist_K4096_wide_pallas_f32"] = {
+            "skipped": (
+                f"available={wh.wide_pallas_available(platform)} "
+                f"pallas_fits={wh.pallas_fits(C, B)} at C={C} B={B}"
+            )
+        }
+    else:
         for bf16 in (False, True):
             def wide_pl_fn(xb, payload_k, nid, bf16=bf16):
                 return wh.histogram_wide_pallas(

@@ -246,15 +246,20 @@ def resolve_wide_hist(cfg: BuildConfig, platform: str, task: str, *,
     return True, bf16
 
 
-def resolve_wide_kernel(platform: str) -> bool:
+def resolve_wide_pallas(platform: str, *, use_wide: bool,
+                        n_channels: int, n_bins: int) -> bool:
     """Whether the wide tier uses the Mosaic grouped-matmul executor
-    (``wide_hist.histogram_wide_pallas``) instead of the XLA scan.
+    (``wide_hist.histogram_wide_pallas``) instead of the XLA scan — the
+    ONE routing point for both engines.
 
-    Both are bit-identical (same pack, same contraction); they differ in
-    accumulation traffic — the Mosaic kernel keeps each window block in
-    VMEM across its tile run, the scan pays a read-modify-write per tile.
-    Default stays the scan until the hist_tput capture proves the kernel
-    on hardware; ``MPITREE_TPU_WIDE_KERNEL=pallas|scan`` overrides.
+    Both executors are bit-identical (same pack, same contraction); they
+    differ in accumulation traffic — the Mosaic kernel keeps each window
+    block in VMEM across its tile run, the scan pays a read-modify-write
+    per tile. Default stays the scan until the hist_tput capture proves
+    the kernel on hardware; ``MPITREE_TPU_WIDE_KERNEL=pallas|scan``
+    overrides. A forced ``pallas`` fails LOUDLY when the backend or the
+    VMEM fit (``wide_hist.pallas_fits``) can't satisfy it — a silent
+    downgrade would attribute scan timings to the kernel.
     """
     from mpitree_tpu.ops import wide_hist
 
@@ -265,7 +270,13 @@ def resolve_wide_kernel(platform: str) -> bool:
                 "MPITREE_TPU_WIDE_KERNEL=pallas needs a TPU backend "
                 f"(platform={platform!r})"
             )
-        return True
+        if not wide_hist.pallas_fits(n_channels, n_bins):
+            raise ValueError(
+                "MPITREE_TPU_WIDE_KERNEL=pallas: working set exceeds "
+                f"VMEM at C={n_channels} B={n_bins} "
+                "(wide_hist.pallas_fits)"
+            )
+        return use_wide
     if flag not in ("scan", "auto"):
         raise ValueError(f"unknown MPITREE_TPU_WIDE_KERNEL {flag!r}")
     return False
@@ -630,9 +641,9 @@ def build_tree(
     # tiers saved <3% warm and cost an extra ~20-40s tunnel compile each.
     from mpitree_tpu.ops import pallas_hist, wide_hist
 
-    wide_pallas = (
-        use_wide and resolve_wide_kernel(mesh.devices.flat[0].platform)
-        and wide_hist.pallas_fits(C, B)
+    wide_pallas = resolve_wide_pallas(
+        mesh.devices.flat[0].platform, use_wide=use_wide,
+        n_channels=C, n_bins=B,
     )
 
     tiers = (

@@ -46,7 +46,7 @@ from mpitree_tpu.core.builder import (
     resolve_exact_ties,
     resolve_hist_kernel,
     resolve_wide_hist,
-    resolve_wide_kernel,
+    resolve_wide_pallas,
     valid_tiers as builder_valid_tiers,
 )
 from mpitree_tpu.core.tree_struct import TreeArrays
@@ -455,62 +455,86 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
             feat_a, bin_a, counts_a, n_a = bufs[:4]
             mid_a = bufs[4] if monotonic else None
 
-            # Child allocation over the frontier window (full-M vectorized;
-            # node ids inherit frontier order, so slot arithmetic keeps
-            # working next level).
-            idx = jnp.arange(M, dtype=jnp.int32)
-            in_frontier = (idx >= flo) & (idx < flo + fsz)
-            is_split = in_frontier & (feat_a >= 0)
-            rank = jnp.cumsum(is_split.astype(jnp.int32))
-            n_split = rank[-1]
-            left_ids = flo + fsz + 2 * (rank - 1)
-            left_a = jnp.where(is_split, left_ids, left_a)
-            scat = jnp.where(is_split, left_ids, M)
-            parent_pad = jnp.full(M + 2, -1, jnp.int32)
-            parent_pad = parent_pad.at[scat].set(jnp.where(is_split, idx, -1))
-            parent_pad = parent_pad.at[scat + 1].set(
-                jnp.where(is_split, idx, -1)
-            )
-            newly = parent_pad[:M] >= 0
-            parent_a = jnp.where(newly, parent_pad[:M], parent_a)
-            if sampling:
-                # Children inherit path-hashed keys through the same scatter
-                # pattern the parent links use (ops/sampling.py arithmetic).
-                lk, rk = sampling_ops.child_keys_jnp(key_a)
-                key_pad = jnp.zeros(M + 2, jnp.uint32)
-                key_pad = key_pad.at[scat].set(
-                    jnp.where(is_split, lk, jnp.uint32(0))
-                )
-                key_pad = key_pad.at[scat + 1].set(
-                    jnp.where(is_split, rk, jnp.uint32(0))
-                )
-                key_a = jnp.where(newly, key_pad[:M], key_a)
+            # Child allocation, frontier-windowed: the previous full-M
+            # formulation scattered 2*(M+2) elements per level (M is the
+            # ~2^21 node CAPACITY at covtype scale — ~84M scalar-unit
+            # scatter updates over a depth-20 build, the same cost class
+            # as the histogram scatter the wide tier removed). Walking the
+            # frontier in the existing K-chunks makes every step K-sized:
+            # updates are proportional to the LIVE frontier, and node ids
+            # still inherit frontier order (rank offsets carry across
+            # chunks), so slot arithmetic keeps working next level.
+            # parent_a / key_a / bounds are carried PADDED to (M+2,) in the
+            # while state: non-split lanes dump their scatter at index M,
+            # and padding the buffers once at state init beats re-building
+            # M+2 copies every level.
+            parent_p = parent_a
+            key_p = key_a if sampling else None
             if monotonic:
-                # sklearn bound propagation: a split on a constrained
-                # feature pins mid between the children (same scatter
-                # pattern as the parent links / sampling keys).
                 lo_a, hi_a = bounds
-                cstf = mono_cst[jnp.clip(feat_a, 0, None)]  # (M,) signs
-                llo = jnp.where(cstf == -1, mid_a, lo_a)
-                lhi = jnp.where(cstf == 1, mid_a, hi_a)
-                rlo = jnp.where(cstf == 1, mid_a, lo_a)
-                rhi = jnp.where(cstf == -1, mid_a, hi_a)
+                lo_p, hi_p = lo_a, hi_a
+            else:
+                lo_p = hi_p = None
 
-                def scatter_children(lvals, rvals, fill):
-                    pad = jnp.full(M + 2, fill, jnp.float32)
-                    pad = pad.at[scat].set(jnp.where(is_split, lvals, fill))
-                    pad = pad.at[scat + 1].set(
-                        jnp.where(is_split, rvals, fill)
+            def alloc_chunk(c, carry):
+                left_a, parent_p, key_p, lo_p, hi_p, child_base = carry
+                chunk_lo = flo + c * K
+                gidx = chunk_lo + jnp.arange(K, dtype=jnp.int32)
+                loc_feat = lax.dynamic_slice(feat_a, (chunk_lo,), (K,))
+                split = (gidx < flo + fsz) & (loc_feat >= 0)
+                rank = jnp.cumsum(split.astype(jnp.int32))
+                lids = child_base + 2 * (rank - 1)
+                old_left = lax.dynamic_slice(left_a, (chunk_lo,), (K,))
+                left_a = lax.dynamic_update_slice(
+                    left_a, jnp.where(split, lids, old_left), (chunk_lo,)
+                )
+                # Non-split lanes dump at index M (sliced off) — every
+                # real child position is written by exactly one lane.
+                scat = jnp.where(split, lids, M)
+                parent_p = parent_p.at[scat].set(
+                    jnp.where(split, gidx, -1)
+                )
+                parent_p = parent_p.at[scat + 1].set(
+                    jnp.where(split, gidx, -1)
+                )
+                if sampling:
+                    # Children inherit path-hashed keys through the same
+                    # scatter pattern (ops/sampling.py arithmetic).
+                    lk, rk = sampling_ops.child_keys_jnp(
+                        lax.dynamic_slice(key_a, (chunk_lo,), (K,))
                     )
-                    return pad[:M]
+                    key_p = key_p.at[scat].set(
+                        jnp.where(split, lk, jnp.uint32(0))
+                    )
+                    key_p = key_p.at[scat + 1].set(
+                        jnp.where(split, rk, jnp.uint32(0))
+                    )
+                if monotonic:
+                    # sklearn bound propagation: a split on a constrained
+                    # feature pins mid between the children.
+                    loc_mid = lax.dynamic_slice(mid_a, (chunk_lo,), (K,))
+                    loc_lo = lax.dynamic_slice(lo_a, (chunk_lo,), (K,))
+                    loc_hi = lax.dynamic_slice(hi_a, (chunk_lo,), (K,))
+                    cstf = mono_cst[jnp.clip(loc_feat, 0, None)]
+                    llo = jnp.where(cstf == -1, loc_mid, loc_lo)
+                    lhi = jnp.where(cstf == 1, loc_mid, loc_hi)
+                    rlo = jnp.where(cstf == 1, loc_mid, loc_lo)
+                    rhi = jnp.where(cstf == -1, loc_mid, loc_hi)
+                    lo_p = lo_p.at[scat].set(jnp.where(split, llo, 0.0))
+                    lo_p = lo_p.at[scat + 1].set(jnp.where(split, rlo, 0.0))
+                    hi_p = hi_p.at[scat].set(jnp.where(split, lhi, 0.0))
+                    hi_p = hi_p.at[scat + 1].set(jnp.where(split, rhi, 0.0))
+                child_base = child_base + 2 * rank[-1]
+                return (left_a, parent_p, key_p, lo_p, hi_p, child_base)
 
-                lo_a = jnp.where(
-                    newly, scatter_children(llo, rlo, -jnp.inf), lo_a
-                )
-                hi_a = jnp.where(
-                    newly, scatter_children(lhi, rhi, jnp.inf), hi_a
-                )
-                bounds = (lo_a, hi_a)
+            carry = (left_a, parent_p, key_p, lo_p, hi_p, flo + fsz)
+            carry = lax.fori_loop(0, n_chunks, alloc_chunk, carry)
+            left_a, parent_a, key_p, lo_p, hi_p, child_end = carry
+            n_split = (child_end - (flo + fsz)) // 2
+            if sampling:
+                key_a = key_p
+            if monotonic:
+                bounds = (lo_p, hi_p)
 
             # Reroute rows of splitting nodes (on-device mask partition —
             # the reference's recursive X[region] copies, decision_tree.py:150-164).
@@ -551,27 +575,31 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
         def level_cond(state):
             return state[8] > 0
 
+        # parent / keys / bounds carry 2 pad lanes (index M is the
+        # allocation's dump slot for non-split lanes) — see alloc_chunk.
         state0 = (
             jnp.full(M, -1, jnp.int32),            # feature
             jnp.zeros(M, jnp.int32),               # bin
             jnp.zeros((M, C if task == "classification" else 3), jnp.float32),
             jnp.zeros(M, jnp.float32),             # n per node
             jnp.full(M, -1, jnp.int32),            # left
-            jnp.full(M, -1, jnp.int32),            # parent
+            jnp.full(M + 2, -1, jnp.int32),        # parent (padded)
             nid0,
             jnp.int32(0),                          # frontier_lo
             jnp.int32(1),                          # frontier_size
             jnp.int32(0),                          # depth
-            jnp.zeros(M, jnp.uint32).at[0].set(root_key.astype(jnp.uint32)),
+            jnp.zeros(M + 2, jnp.uint32).at[0].set(
+                root_key.astype(jnp.uint32)
+            ),
         )
         if monotonic:
             state0 = state0 + (
-                jnp.full(M, -jnp.inf, jnp.float32),  # node lower bounds
-                jnp.full(M, jnp.inf, jnp.float32),   # node upper bounds
+                jnp.full(M + 2, -jnp.inf, jnp.float32),  # node lower bounds
+                jnp.full(M + 2, jnp.inf, jnp.float32),   # node upper bounds
             )
         out = lax.while_loop(level_cond, level_body, state0)
         feat_a, bin_a, counts_a, n_a, left_a, parent_a, nid, flo = out[:8]
-        return feat_a, bin_a, counts_a, n_a, left_a, parent_a, nid, flo
+        return feat_a, bin_a, counts_a, n_a, left_a, parent_a[:M], nid, flo
 
     return build
 
@@ -751,9 +779,9 @@ def build_tree_fused(
     exact_ties = resolve_exact_ties(mesh.devices.flat[0].platform)
     if exact_ties and not exact_ties_fits(K, F, B):
         warn_exact_ties_gap(K, F, B)
-    wide_pallas = (
-        use_wide and resolve_wide_kernel(mesh.devices.flat[0].platform)
-        and wide_hist.pallas_fits(C, B)
+    wide_pallas = resolve_wide_pallas(
+        mesh.devices.flat[0].platform, use_wide=use_wide,
+        n_channels=C, n_bins=B,
     )
 
     fn = _make_fused_fn(
@@ -927,9 +955,9 @@ def build_forest_fused(
     exact_ties = resolve_exact_ties(mesh.devices.flat[0].platform)
     if exact_ties and not exact_ties_fits(K, F, B):
         warn_exact_ties_gap(K, F, B)
-    wide_pallas = (
-        use_wide and resolve_wide_kernel(mesh.devices.flat[0].platform)
-        and wide_hist.pallas_fits(C, B)
+    wide_pallas = resolve_wide_pallas(
+        mesh.devices.flat[0].platform, use_wide=use_wide,
+        n_channels=C, n_bins=B,
     )
 
     if task == "classification" and float(weights.sum(axis=1).max()) >= 2**24:

@@ -100,3 +100,39 @@ def test_node_capacity():
     assert _node_capacity(100, None) == 256
     assert _node_capacity(10**6, 3) == 16
     assert _node_capacity(1, None) == 1
+
+
+def test_multi_chunk_frontier_identity():
+    """Frontiers wider than the K-slot chunk walk BOTH the stats sweep and
+    the child allocation in chunks; the allocation's rank offsets carry
+    across chunk boundaries (child ids must stay contiguous in frontier
+    order). Force n_chunks > 1 with a tiny chunk cap and pin identity
+    against the host tier."""
+    import numpy as np
+
+    from mpitree_tpu.core.builder import BuildConfig, build_tree
+    from mpitree_tpu.core.host_builder import build_tree_host
+    from mpitree_tpu.ops.binning import bin_dataset
+    from mpitree_tpu.parallel import mesh as mesh_lib
+
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((1500, 6)).astype(np.float32)
+    y = rng.integers(0, 3, 1500).astype(np.int32)
+    binned = bin_dataset(X, max_bins=16, binning="quantile")
+    mesh = mesh_lib.resolve_mesh(n_devices=2)
+    cfg = BuildConfig(
+        task="classification", criterion="entropy", max_depth=10,
+        max_frontier_chunk=32, frontier_tiers=(8,),
+    )
+    host = build_tree_host(binned, y, config=cfg, n_classes=3)
+    dev = build_tree(
+        binned, y, config=BuildConfig(**{**cfg.__dict__, "engine": "fused"}),
+        mesh=mesh, n_classes=3,
+    )
+    # Deep levels exceed 32 live nodes -> multi-chunk stats + allocation.
+    assert host.n_nodes > 64
+    assert host.n_nodes == dev.n_nodes
+    np.testing.assert_array_equal(host.feature, dev.feature)
+    np.testing.assert_array_equal(host.count, dev.count)
+    np.testing.assert_array_equal(host.left, dev.left)
+    np.testing.assert_array_equal(host.parent, dev.parent)

@@ -205,18 +205,27 @@ def test_pallas_wide_giant_window_run_and_empty_windows(rng):
 
 
 def test_fused_wide_kernel_knob_validates():
-    """MPITREE_TPU_WIDE_KERNEL=pallas needs a TPU; unknown values raise."""
-    from mpitree_tpu.core.builder import resolve_wide_kernel
+    """MPITREE_TPU_WIDE_KERNEL=pallas fails LOUDLY on a non-TPU backend
+    or an unfittable VMEM shape (a silent scan downgrade would attribute
+    scan timings to the kernel); unknown values raise."""
+    from mpitree_tpu.core.builder import resolve_wide_pallas
 
     with pytest.MonkeyPatch.context() as mp:
         mp.setenv("MPITREE_TPU_WIDE_KERNEL", "pallas")
         with pytest.raises(ValueError, match="TPU backend"):
-            resolve_wide_kernel("cpu")
+            resolve_wide_pallas("cpu", use_wide=True, n_channels=7,
+                                n_bins=256)
+        with pytest.raises(ValueError, match="VMEM"):
+            resolve_wide_pallas("tpu", use_wide=True, n_channels=100,
+                                n_bins=256)
         mp.setenv("MPITREE_TPU_WIDE_KERNEL", "bogus")
         with pytest.raises(ValueError, match="unknown"):
-            resolve_wide_kernel("cpu")
+            resolve_wide_pallas("cpu", use_wide=True, n_channels=7,
+                                n_bins=256)
         mp.setenv("MPITREE_TPU_WIDE_KERNEL", "scan")
-        assert resolve_wide_kernel("tpu") is False
+        assert resolve_wide_pallas(
+            "tpu", use_wide=True, n_channels=7, n_bins=256
+        ) is False
 
 
 def test_wide_tier_on_feature_mesh(rng, monkeypatch):
