@@ -87,10 +87,13 @@ PROBE_RETRIES = 3
 def probe_backend() -> str:
     """Decide the JAX platform without risking the parent process.
 
-    Runs ``jax.devices()`` in a subprocess (bounded by a timeout, retried:
-    the tunneled TPU backend is flaky-by-default — round 1 died here, and
-    it can also HANG rather than raise). Returns the platform of the first
-    device on success, or downgrades this process to the CPU backend.
+    Runs ``jax.devices()`` in a subprocess, bounded by a timeout. ERRORS
+    are retried (the tunneled backend is flaky-by-default — round 1 died
+    on a transient UNAVAILABLE); a HANG aborts the retries immediately
+    (an unresponsive tunnel stays down for hours — observed all of round
+    3 — and re-probing it costs ~300 s for nothing). Returns the platform
+    of the first device on success, or downgrades this process to the CPU
+    backend.
 
     The downgrade must use ``jax.config.update``: this environment's
     sitecustomize pins ``JAX_PLATFORMS`` at interpreter startup, so setting
@@ -106,7 +109,11 @@ def probe_backend() -> str:
             if out.returncode == 0 and out.stdout.strip():
                 return out.stdout.strip().splitlines()[-1]
         except subprocess.TimeoutExpired:
-            pass
+            # A HANG is a down tunnel, not a flaky init — observed to stay
+            # down for hours; burning the remaining retries costs ~300 s
+            # of every tunnel-down bench for nothing. Errors (UNAVAILABLE
+            # at round 1) do resolve on retry and keep theirs.
+            break
         time.sleep(5 * (attempt + 1))
     import jax
 

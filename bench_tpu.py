@@ -430,12 +430,14 @@ def worker_hist_tput(npz_path: str) -> dict:
     # builders' regression path runs f32); this number decides
     # MPITREE_TPU_WIDE_KERNEL's default (resolve_wide_pallas).
     if not (wh.wide_pallas_available(platform) and wh.pallas_fits(C, B)):
-        res["hist_K4096_wide_pallas_f32"] = {
+        skip = {
             "skipped": (
                 f"available={wh.wide_pallas_available(platform)} "
                 f"pallas_fits={wh.pallas_fits(C, B)} at C={C} B={B}"
             )
         }
+        res["hist_K4096_wide_pallas_f32"] = skip
+        res["hist_K4096_wide_pallas_bf16"] = skip
     else:
         for bf16 in (False, True):
             def wide_pl_fn(xb, payload_k, nid, bf16=bf16):

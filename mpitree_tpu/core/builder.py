@@ -276,7 +276,15 @@ def resolve_wide_pallas(platform: str, *, use_wide: bool,
                 f"VMEM at C={n_channels} B={n_bins} "
                 "(wide_hist.pallas_fits)"
             )
-        return use_wide
+        if not use_wide:
+            raise ValueError(
+                "MPITREE_TPU_WIDE_KERNEL=pallas: the wide tier is not "
+                "active for this build (resolve_wide_hist policy — e.g. "
+                "regression or fractional weights without "
+                "MPITREE_TPU_WIDE_HIST=1); enable the tier or drop the "
+                "kernel force"
+            )
+        return True
     if flag not in ("scan", "auto"):
         raise ValueError(f"unknown MPITREE_TPU_WIDE_KERNEL {flag!r}")
     return False
