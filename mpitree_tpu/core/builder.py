@@ -265,6 +265,14 @@ def resolve_wide_pallas(platform: str, *, use_wide: bool,
 
     flag = os.environ.get("MPITREE_TPU_WIDE_KERNEL", "scan")
     if flag == "pallas":
+        if not use_wide:
+            raise ValueError(
+                "MPITREE_TPU_WIDE_KERNEL=pallas: the wide tier is not "
+                "active for this build (resolve_wide_hist policy — e.g. "
+                "regression or fractional weights without "
+                "MPITREE_TPU_WIDE_HIST=1); enable the tier or drop the "
+                "kernel force"
+            )
         if not wide_hist.wide_pallas_available(platform):
             raise ValueError(
                 "MPITREE_TPU_WIDE_KERNEL=pallas needs a TPU backend "
@@ -275,14 +283,6 @@ def resolve_wide_pallas(platform: str, *, use_wide: bool,
                 "MPITREE_TPU_WIDE_KERNEL=pallas: working set exceeds "
                 f"VMEM at C={n_channels} B={n_bins} "
                 "(wide_hist.pallas_fits)"
-            )
-        if not use_wide:
-            raise ValueError(
-                "MPITREE_TPU_WIDE_KERNEL=pallas: the wide tier is not "
-                "active for this build (resolve_wide_hist policy — e.g. "
-                "regression or fractional weights without "
-                "MPITREE_TPU_WIDE_HIST=1); enable the tier or drop the "
-                "kernel force"
             )
         return True
     if flag not in ("scan", "auto"):

@@ -1,0 +1,97 @@
+"""The driver-parse contract of bench.py's stdout (VERDICT r4 #2).
+
+The driver records a ~2000-char tail of bench.py's stdout and parses the
+LAST line; round 4's single ~4KB record line lost its head (value,
+vs_baseline) to the truncation and the round's headline landed
+``parsed: null``. The fix is a compact FINAL line; these tests pin its
+budget and content for records of any size.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import compact_headline  # noqa: E402
+
+
+def _fat_record():
+    detail = {
+        "platform": "tpu",
+        "ours_test_acc": 0.7446,
+        "acc_delta_vs_sklearn": -0.0014,
+        "tree_depth": 20,
+        "tree_n_nodes": 28339,
+        "throughput_cells_per_s": 64889450,
+        "sklearn_s": 16.37,
+        "mpi8_ideal_s": 2398.8,
+        "vs_baseline_observed": 1357.1,
+        # The round-4 overflow source: a merged multi-section TPU embed.
+        "tpu_last_known": {
+            "ts": "2026-07-31T03:46:59Z", "git": "12c3f2c",
+            "platform_probe": "tpu",
+            "merged_from": [{"ts": f"t{i}", "sections": ["x"] * 9}
+                            for i in range(20)],
+            **{sec: {"warm_s": 17.5 + i, "cold_s": 45.1,
+                     "phases": {p: {"seconds": 1.0} for p in
+                                ("bin", "fused_build", "shard", "pad" * 30)}}
+               for i, sec in enumerate(
+                   ("north_star", "north_star_fused", "engine_fused"))},
+        },
+        "errors": {"forest": "rc=-15", "hist_tput": "rc=-15"},
+        "padding": "x" * 5000,
+    }
+    return {"metric": "covtype_like (531012x54) depth-20 tree build",
+            "value": 8.585, "unit": "s", "vs_baseline": 271.4,
+            "detail": detail}
+
+
+def test_headline_fits_budget_and_parses():
+    rec = _fat_record()
+    assert len(json.dumps(rec)) > 4000  # the regime that broke round 4
+    line = compact_headline(rec)
+    assert len(line) <= 1000
+    parsed = json.loads(line)
+    assert parsed["value"] == 8.585
+    assert parsed["vs_baseline"] == 271.4
+    assert parsed["detail"]["tpu_last_known"]["engine_fused_warm_s"] == 19.5
+    assert parsed["detail"]["error_keys"] == ["forest", "hist_tput"]
+
+
+def test_headline_survives_driver_tail_window():
+    """The driver's exact failure mode: 2000-char tail, parse last line."""
+    rec = _fat_record()
+    stdout = json.dumps(rec) + "\n" + compact_headline(rec)
+    tail = stdout[-2000:]
+    parsed = json.loads(tail.splitlines()[-1])
+    assert parsed["value"] == 8.585 and parsed["vs_baseline"] == 271.4
+
+
+def test_headline_shrinks_detail_when_over_budget():
+    rec = _fat_record()
+    # Absurd metric name forces the fallback detail shrink.
+    rec["detail"]["ours_test_acc"] = 0.7
+    line = compact_headline(rec, limit=300)
+    assert len(line) <= 300
+    parsed = json.loads(line)
+    assert parsed["value"] == 8.585
+    assert parsed["detail"] == {"platform": "tpu", "ours_test_acc": 0.7}
+
+
+def test_headline_on_minimal_error_record():
+    """A bench that died early still emits a parseable headline."""
+    line = compact_headline({"metric": "m", "value": None, "unit": "s",
+                             "vs_baseline": None, "detail": {}})
+    parsed = json.loads(line)
+    assert parsed["value"] is None and "detail" in parsed
+
+
+def test_headline_budget_enforced_for_pathological_records():
+    """The limit is enforced, not assumed, even when the fallback detail
+    would still overflow (e.g. an absurd metric string)."""
+    rec = {"metric": "m" * 5000, "value": 1.0, "unit": "s",
+           "vs_baseline": 2.0, "detail": {"platform": "cpu"}}
+    line = compact_headline(rec, limit=300)
+    assert len(line) <= 300
+    assert json.loads(line)["value"] == 1.0  # still valid JSON, never cut
