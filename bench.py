@@ -161,10 +161,12 @@ def compact_headline(result: dict, limit: int = 1000) -> str:
     if len(line) > limit:
         # Enforce, don't assume — but never at the cost of parseability
         # (a truncated JSON line is as unparseable as an overflowed one):
-        # drop detail and clip the only unbounded field. value/unit/
-        # vs_baseline are numbers/short strings, so this always fits.
+        # drop detail and clip EVERY string field; numbers are bounded.
         compact["detail"] = {}
-        compact["metric"] = str(compact.get("metric"))[:100]
+        compact = {
+            k: (v[:100] if isinstance(v, str) else v)
+            for k, v in compact.items()
+        }
         line = json.dumps(compact)
     return line
 

@@ -44,7 +44,23 @@ def main():
     p.add_argument("--bins", type=int, default=256)
     p.add_argument("--slots", type=int, default=4096)
     p.add_argument("--classes", type=int, default=8)
+    p.add_argument("--platform", default="auto",
+                   help="jax platform ('auto' probes the accelerator in a "
+                        "bounded subprocess and falls back to cpu — a dead "
+                        "tunnel HANGS backend init rather than raising)")
     args = p.parse_args()
+
+    if args.platform == "auto":
+        from bench import probe_backend
+
+        platform = probe_backend()  # downgrades this process on failure
+    else:
+        platform = args.platform
+        if platform not in ("tpu", "axon"):
+            import jax as _jax
+
+            _jax.config.update("jax_platforms", platform)
+    print(json.dumps({"platform": platform}))
 
     import jax
     import jax.numpy as jnp

@@ -95,3 +95,13 @@ def test_headline_budget_enforced_for_pathological_records():
     line = compact_headline(rec, limit=300)
     assert len(line) <= 300
     assert json.loads(line)["value"] == 1.0  # still valid JSON, never cut
+
+
+def test_headline_budget_enforced_for_long_unit_strings():
+    """Every string field clips in the final clamp, not just metric."""
+    line = compact_headline(
+        {"metric": "m", "value": 1.0, "unit": "u" * 2000,
+         "vs_baseline": 2.0, "detail": {}}, limit=300,
+    )
+    assert len(line) <= 300
+    assert json.loads(line)["value"] == 1.0
