@@ -161,10 +161,12 @@ def compact_headline(result: dict, limit: int = 1000) -> str:
     if len(line) > limit:
         # Enforce, don't assume — but never at the cost of parseability
         # (a truncated JSON line is as unparseable as an overflowed one):
-        # drop detail and clip EVERY string field; numbers are bounded.
+        # drop detail and bound EVERY field. Non-scalar or oversize values
+        # coerce through str() so no type can smuggle unbounded content.
         compact["detail"] = {}
         compact = {
-            k: (v[:100] if isinstance(v, str) else v)
+            k: (v if isinstance(v, (int, float, type(None)))
+                and len(repr(v)) <= 100 else str(v)[:100])
             for k, v in compact.items()
         }
         line = json.dumps(compact)

@@ -55,11 +55,10 @@ def main():
 
         platform = probe_backend()  # downgrades this process on failure
     else:
-        platform = args.platform
-        if platform not in ("tpu", "axon"):
-            import jax as _jax
+        from bench_tpu import _pin_platform
 
-            _jax.config.update("jax_platforms", platform)
+        platform = args.platform
+        _pin_platform(platform)  # the ONE copy of the tpu/axon-skip rule
     print(json.dumps({"platform": platform}))
 
     import jax

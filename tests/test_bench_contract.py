@@ -105,3 +105,14 @@ def test_headline_budget_enforced_for_long_unit_strings():
     )
     assert len(line) <= 300
     assert json.loads(line)["value"] == 1.0
+
+
+def test_headline_budget_enforced_for_nonstring_fields():
+    """A non-string unbounded field (e.g. a list metric) cannot smuggle
+    content past the final clamp — it coerces through str() and clips."""
+    line = compact_headline(
+        {"metric": ["x" * 200] * 20, "value": 1.0,
+         "unit": "s", "vs_baseline": 2.0, "detail": {}}, limit=300,
+    )
+    assert len(line) <= 300
+    assert json.loads(line)["value"] == 1.0

@@ -86,3 +86,27 @@ def test_truncated_line_does_not_discard_history(watcher, tmp_path):
     )
     assert watcher.capture_count("north_star", str(p)) == 1
     assert watcher.section_done("north_star", str(p))
+
+
+def test_build_todo_priority_order_with_redo(watcher, tmp_path):
+    """--sections order is the capture priority: captured sections drop
+    unless named in --redo (keeping their position); redo-only names
+    append at the end (round-5 fix — redos used to always go last,
+    pushing the highest-evidence re-measure behind never-captured
+    low-value sections)."""
+    p = _write(tmp_path, [
+        {"ts": "t1", **FULL,
+         "north_star": {"warm_s": 20.5}, "engine_fused": {"warm_s": 17.5}},
+    ])
+    todo = watcher.build_todo(
+        "hist_tput,engine_fused,forest,north_star",
+        "engine_fused,device_bin", p,
+    )
+    # engine_fused: captured but redone -> keeps position 2;
+    # north_star: captured, not redone -> dropped;
+    # device_bin: redo-only -> appended.
+    assert todo == ["hist_tput", "engine_fused", "forest", "device_bin"]
+    # no redo: captured sections simply drop
+    assert watcher.build_todo(
+        "hist_tput,engine_fused,forest", "", p,
+    ) == ["hist_tput", "forest"]

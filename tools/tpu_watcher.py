@@ -44,7 +44,10 @@ PROBE_SRC = (
 # 4 configs x (cold + warm) fits.
 BUDGET = {
     "engine_levelwise": 1500,
-    "hist_tput": 900,
+    # ~18 separately-compiled entries since round 5 (wide executors ×2
+    # dtypes, level-op microbenches); the persistent compile cache makes
+    # retries resume, but give the first attempt room to land whole.
+    "hist_tput": 1200,
     "device_bin": 600,
     "forest": 1800,
     "refine_sweep": 1800,
@@ -105,6 +108,18 @@ def capture_count(sec: str, path: str = JSONL) -> int:
         1 for r in read_capture_lines(path)
         if is_genuine_capture(r, full_only=True) and sec in r
     )
+
+
+def build_todo(sections: str, redo: str, path: str = JSONL) -> list:
+    """Capture queue: --sections order IS the priority (healthy windows
+    are short — highest-evidence first). A section that is already
+    captured is skipped unless also named in --redo, in which case it
+    KEEPS its position; redo-only names append at the end."""
+    redo_set = {s for s in redo.split(",") if s}
+    todo = [s for s in sections.split(",")
+            if s and (s in redo_set or not section_done(s, path))]
+    todo += [s for s in redo.split(",") if s and s not in todo]
+    return todo
 
 
 def run_section(sec: str) -> bool:
@@ -171,14 +186,7 @@ def main() -> int:
     p.add_argument("--probe-every-s", type=int, default=150)
     args = p.parse_args()
 
-    # --sections order is the capture priority (healthy windows are short
-    # — the highest-evidence sections must run first). A section that is
-    # already captured is skipped unless it is also named in --redo, in
-    # which case it KEEPS its position; redo-only names append at the end.
-    redo = {s for s in args.redo.split(",") if s}
-    todo = [s for s in args.sections.split(",")
-            if s and (s in redo or not section_done(s))]
-    todo += [s for s in args.redo.split(",") if s and s not in todo]
+    todo = build_todo(args.sections, args.redo)
     t_end = time.time() + args.deadline_s
     log(f"watcher start, todo={todo}")
     while todo and time.time() < t_end:
