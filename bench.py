@@ -163,12 +163,12 @@ def compact_headline(result: dict, limit: int = 1000) -> str:
         # (a truncated JSON line is as unparseable as an overflowed one):
         # drop detail and bound EVERY field. Non-scalar or oversize values
         # coerce through str() so no type can smuggle unbounded content.
-        compact["detail"] = {}
         compact = {
             k: (v if isinstance(v, (int, float, type(None)))
                 and len(repr(v)) <= 100 else str(v)[:100])
             for k, v in compact.items()
         }
+        compact["detail"] = {}  # after the coercion: stays a JSON object
         line = json.dumps(compact)
     return line
 

@@ -136,3 +136,42 @@ def test_multi_chunk_frontier_identity():
     np.testing.assert_array_equal(host.count, dev.count)
     np.testing.assert_array_equal(host.left, dev.left)
     np.testing.assert_array_equal(host.parent, dev.parent)
+
+
+def test_multi_chunk_frontier_with_sampling():
+    """Per-node feature-sampling keys propagate to children through the
+    chunked allocation (round 5): keys ride the same K-sized scatters as
+    the parent links, with rank offsets carried across chunks. Force
+    n_chunks > 1 (tiny chunk cap) and pin identity against the host
+    tier, which computes the same path-hashed keys in numpy. (The wide
+    histogram tier needs >= wide_hist.MIN_SLOTS slots, so a 64-slot
+    chunk rides the scatter — its own multi-chunk coverage is
+    test_multi_chunk_frontier_identity at the default chunk widths plus
+    tests/test_wide_hist.py.)"""
+    import dataclasses
+
+    from mpitree_tpu.core.host_builder import build_tree_host
+    from mpitree_tpu.ops.sampling import NodeFeatureSampler
+
+    rng = np.random.default_rng(11)
+    X = rng.standard_normal((2000, 6)).astype(np.float32)
+    y = rng.integers(0, 3, 2000).astype(np.int32)
+    binned = bin_dataset(X, max_bins=16, binning="quantile")
+    sampler = NodeFeatureSampler(k=3, n_features=6, seed=5)
+    mesh = mesh_lib.resolve_mesh(n_devices=2)
+    cfg = BuildConfig(
+        task="classification", criterion="entropy", max_depth=11,
+        max_frontier_chunk=64, frontier_tiers=(8,),
+    )
+    host = build_tree_host(
+        binned, y, config=cfg, n_classes=3, feature_sampler=sampler
+    )
+    dev = build_tree(
+        binned, y, config=dataclasses.replace(cfg, engine="fused"),
+        mesh=mesh, n_classes=3, feature_sampler=sampler,
+    )
+    assert host.n_nodes > 128  # frontiers crossed the 64-slot chunk
+    assert dev.n_nodes == host.n_nodes
+    np.testing.assert_array_equal(dev.feature, host.feature)
+    np.testing.assert_array_equal(dev.count, host.count)
+    np.testing.assert_array_equal(dev.parent, host.parent)
