@@ -298,10 +298,15 @@ def resolve_exact_ties(platform: str) -> bool:
     and the device's f32 noise the other (first seen at a 13-row depth-9
     node). On CPU backends the device engines now run the cost sweep in
     scoped-x64 f64 mirroring the host formulation (`ops/impurity.py:
-    _cost_sweep_f64`), which makes full-depth device-vs-host identity hold
-    (tests/test_engine_identity.py, depth >= 15) for every chunk width
-    within ``exact_ties_fits``'s memory bound — wider chunks keep the f32
-    sweep and ``warn_exact_ties_gap`` says so at build time. TPUs have no
+    _cost_sweep_f64`): cost gaps the host's f64 resolves now resolve
+    identically on-device — full-depth identity holds on the r4 seam
+    workload to depth 20 — for every chunk width within
+    ``exact_ties_fits``'s memory bound (wider chunks keep the f32 sweep
+    and ``warn_exact_ties_gap`` says so at build time). NOT closed: exact
+    rational-coincidence ties, where XLA CPU's fused codegen (excess
+    precision / reassociation, see _cost_sweep_f64) computes ulps apart
+    what numpy computes equal — those picks can still flip, bounded by
+    test_exact_tie_residual_is_bounded. TPUs have no
     f64 unit, so accelerator builds keep the f32 sweep — there the
     production hybrid masks the seam (crowns stop while nodes are large;
     the exact host tail owns deep small nodes). MPITREE_TPU_EXACT_TIES=0

@@ -106,13 +106,22 @@ def _cost_sweep_f64(hist, criterion: str):
     inside a scoped ``jax.enable_x64`` so the f32-disabled default config
     still traces real f64 ops. Counts are integers (exact in f64), so the
     only rounding is in the division/log/product chain: ~1e-15 relative,
-    vs ~1e-7 for the f32 sweep. This closes the depth>=10 device-vs-host
-    tie seam (VERDICT r4 #5): cost gaps the host's f64 resolves are now
-    resolved identically on-device. (XLA's f64 log2 is within ~5 ulps of
-    numpy's libm — not bitwise, but ties from symmetric count patterns
-    cancel identically on both sides, and 1e-15-coincidence gaps are
-    unobservable.) CPU backends only — TPUs have no f64 unit; the hybrid's
-    host tail owns deep small nodes there (``resolve_exact_ties``).
+    vs ~1e-7 for the f32 sweep. Cost gaps the host's f64 resolves are now
+    resolved identically on-device (the r4 seam workload holds identity
+    to depth 20, tests/test_engine_identity.py).
+
+    The residual, stated plainly: XLA CPU's fused codegen is NOT bitwise
+    numpy — it keeps excess precision / reassociates inside fusions
+    (measured: ``(l/d)*(l/d)`` summed = exactly 17/25 where numpy's
+    twice-rounded ops give 1 ulp more; optimization_barrier and bitcast
+    round-trips do not stop it). So an EXACT rational tie between two
+    different count configurations (e.g. two gini costs both equal to
+    13/35 — common at small integer-featured nodes) can compute equal on
+    the host but ulps apart here, flipping the first-min pick; sub-ulp
+    gaps likewise. Bounded by
+    ``tests/test_engine_identity.py::test_exact_tie_residual_is_bounded``.
+    CPU backends only — TPUs have no f64 unit; the hybrid's host tail
+    owns deep small nodes there (``resolve_exact_ties``).
     """
     with jax.enable_x64(True):
         C = hist.shape[2]
