@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-cpu lint bench bench-tpu clean
+.PHONY: test test-cpu lint lint-graft bench bench-tpu clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -16,6 +16,12 @@ test-cpu: test
 
 lint:
 	ruff check mpitree_tpu tests bench.py
+
+# JAX-aware invariants ruff cannot see: host-sync (GL01), recompile (GL02),
+# collective-axis (GL03) and dtype/tiling (GL04) rules — tools/graftlint.
+# Pure-AST: runs on any CPU box, no accelerator (or even jax) needed.
+lint-graft:
+	$(PY) -m tools.graftlint mpitree_tpu
 
 bench:
 	$(PY) bench.py

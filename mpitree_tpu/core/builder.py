@@ -28,7 +28,6 @@ from __future__ import annotations
 import dataclasses
 import math
 import os
-from typing import Optional
 
 import jax
 import numpy as np
@@ -44,7 +43,7 @@ from mpitree_tpu.utils.profiling import PhaseTimer, debug_checks_enabled
 class BuildConfig:
     task: str = "classification"  # "classification" | "regression"
     criterion: str = "entropy"  # entropy | gini (classification), mse (regression)
-    max_depth: Optional[int] = None
+    max_depth: int | None = None
     min_samples_split: int = 2
     # Absolute weight floor for each side of a split (the estimator computes
     # it as min_weight_fraction_leaf * total fit weight, sklearn semantics);
@@ -462,6 +461,8 @@ def fetch_row_nodes(nid_d, N: int) -> np.ndarray:
     return np.asarray(nid_d)[:N]
 
 
+# graftlint: host-fn — the levelwise host driver: device_get of packed
+# decisions and per-level Python orchestration are its deliberate job
 def build_tree(
     binned: BinnedData,
     y: np.ndarray,

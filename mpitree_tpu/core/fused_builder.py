@@ -196,6 +196,8 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
     def psum(x):
         return lax.psum(x, psum_axis) if psum_axis is not None else x
 
+    # graftlint: device-fn (jit-wrapped indirectly: this factory's return
+    # value reaches jax.shard_map in _make_fused_fn / _make_forest_fn)
     def build(xb, y, nid0, w, cand_mask, mcw, mid, root_key, mono_cst):
         # mid: sklearn's min_impurity_decrease pre-scaled by the total fit
         # weight (BuildConfig.min_decrease_scaled), a runtime operand so
@@ -729,6 +731,8 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     return jax.jit(sharded)
 
 
+# graftlint: host-fn — host shell around the fused device program:
+# materializes the finished tree arrays after ONE device_get
 def build_tree_fused(
     binned,
     y: np.ndarray,
@@ -835,6 +839,7 @@ def build_tree_fused(
     return tree
 
 
+# graftlint: host-fn — post-device_get numpy finalization
 def _finalize_tree(binned, task, criterion, n_nodes, feat, bins, counts,
                    nvec, left, parent, *, integer_counts: bool) -> TreeArrays:
     """Device build buffers (full capacity) -> host TreeArrays (trimmed)."""
@@ -886,6 +891,8 @@ def _finalize_tree(binned, task, criterion, n_nodes, feat, bins, counts,
     )
 
 
+# graftlint: host-fn — host shell; per-tree np.asarray pulls happen
+# after the single forest-program device_get (deliberate boundary)
 def build_forest_fused(
     binned,
     y: np.ndarray,

@@ -384,6 +384,8 @@ def apply_refine(
         )
 
 
+# graftlint: host-fn — hybrid orchestration: crown/frontier handoff is
+# an intentional host boundary (np.asarray of fetched row assignments)
 def refine_deep_subtrees(
     tree: TreeArrays,
     X: np.ndarray,

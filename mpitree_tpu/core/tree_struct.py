@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Optional
 
 import numpy as np
 
@@ -86,25 +85,37 @@ class TreeArrays:
         np.savez(path, **dataclasses.asdict(self))
 
     @classmethod
-    def load(cls, path) -> "TreeArrays":
+    def load(cls, path) -> TreeArrays:
         with np.load(path) as z:
             return cls(**{k: z[k] for k in z.files})
 
-    def to_nodes(self) -> "Node":
+    def to_nodes(self) -> Node:
         """Materialize the reference-style linked-node view (root returned)."""
+        # One host materialization up front: the arrays may be
+        # device-resident after a fused build, and per-node ``.item()``
+        # indexing costs one D2H round trip per node (graftlint GL01).
+        # ``.tolist()`` unwraps every leaf payload to Python scalars in one
+        # transfer, preserving the old per-element ``.item()`` semantics.
+        feature = np.asarray(self.feature)
+        threshold = np.asarray(self.threshold)
+        depth = np.asarray(self.depth)
+        count = np.asarray(self.count)
+        left = np.asarray(self.left)
+        right = np.asarray(self.right)
+        value = np.asarray(self.value).tolist()
         nodes = [
             Node(
-                value=(int(self.feature[i]) if self.feature[i] >= 0 else self.value[i].item()),
-                threshold=(float(self.threshold[i]) if self.feature[i] >= 0 else None),
-                depth=int(self.depth[i]),
-                count=self.count[i],
+                value=(int(feature[i]) if feature[i] >= 0 else value[i]),
+                threshold=(float(threshold[i]) if feature[i] >= 0 else None),
+                depth=int(depth[i]),
+                count=count[i],
             )
             for i in range(self.n_nodes)
         ]
         for i, node in enumerate(nodes):
-            if self.feature[i] >= 0:
-                node.left = nodes[self.left[i]]
-                node.right = nodes[self.right[i]]
+            if feature[i] >= 0:
+                node.left = nodes[left[i]]
+                node.right = nodes[right[i]]
                 node.left.parent = node
                 node.right.parent = node
         return nodes[0] if nodes else Node(value=0)
@@ -133,17 +144,17 @@ class Node:
     """
 
     value: object
-    threshold: Optional[float] = None
+    threshold: float | None = None
     depth: int = 0
     count: object = None
-    parent: Optional["Node"] = dataclasses.field(default=None, repr=False)
-    left: Optional["Node"] = dataclasses.field(default=None, repr=False)
-    right: Optional["Node"] = dataclasses.field(default=None, repr=False)
+    parent: Node | None = dataclasses.field(default=None, repr=False)
+    left: Node | None = dataclasses.field(default=None, repr=False)
+    right: Node | None = dataclasses.field(default=None, repr=False)
     _btype: BranchType = dataclasses.field(
         default=BranchType.ROOT, repr=False
     )
 
-    def __lt__(self, other: "Node") -> bool:
+    def __lt__(self, other: Node) -> bool:
         # Reference semantics verbatim (_base.py:63-75): comparing stamps
         # both sides' branch glyphs as a side effect, and returns whether
         # SELF is interior — so interior nodes compare less-than and sort

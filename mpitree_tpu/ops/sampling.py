@@ -261,7 +261,7 @@ class NodeFeatureSampler:
                 ^ ((f[None, :] + np.uint32(1)) * _DRAW_SALT).astype(np.uint32)
             )
 
-    def key_store(self, root_keys=None) -> "KeyStore":
+    def key_store(self, root_keys=None) -> KeyStore:
         return KeyStore(self, root_keys)
 
     def keys_for_tree(self, tree) -> np.ndarray:

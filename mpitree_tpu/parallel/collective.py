@@ -25,6 +25,7 @@ from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -80,7 +81,7 @@ def regression_y_range(y, nid, w, chunk_lo, *, n_slots, axis=DATA_AXIS):
     return lax.pmin(ymin, axis), lax.pmax(ymax, axis)
 
 
-def _pack_decision(dec) -> "jax.Array":
+def _pack_decision(dec) -> jax.Array:
     """SplitDecision -> one (K, 9 + C) float32 buffer.
 
     The levelwise builder fetches the decision every level; a namedtuple
@@ -106,10 +107,8 @@ def _pack_decision(dec) -> "jax.Array":
     return jnp.concatenate([head, dec.counts.astype(jnp.float32)], axis=1)
 
 
-def unpack_decision(packed: "np.ndarray") -> dict:
+def unpack_decision(packed: np.ndarray) -> dict:
     """Host-side inverse of :func:`_pack_decision` (numpy dict)."""
-    import numpy as np
-
     return {
         "feature": packed[:, 0].astype(np.int32),
         "bin": packed[:, 1].astype(np.int32),

@@ -149,7 +149,7 @@ class ForestCheckpoint:
         self.trees: list = []
 
     @classmethod
-    def open(cls, path, params: dict, X, y, sample_weight) -> "ForestCheckpoint":
+    def open(cls, path, params: dict, X, y, sample_weight) -> ForestCheckpoint:
         """Load a resumable checkpoint, or a fresh one on any mismatch."""
         fp = _fingerprint(params, X, y, sample_weight)
         ck = cls(path, fp)

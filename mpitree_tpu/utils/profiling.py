@@ -69,7 +69,8 @@ class PhaseTimer:
             f"  {name:<12} {self.seconds[name]:8.3f}s  x{self.calls[name]}"
             for name in sorted(self.seconds, key=self.seconds.get, reverse=True)
         ]
-        return "PhaseTimer(total={:.3f}s\n{}\n)".format(total, "\n".join(rows))
+        body = "\n".join(rows)
+        return f"PhaseTimer(total={total:.3f}s\n{body}\n)"
 
 
 @contextlib.contextmanager

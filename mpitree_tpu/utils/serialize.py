@@ -51,7 +51,9 @@ def _json_params(params: dict) -> dict:
     out = {}
     for k, v in params.items():
         if isinstance(v, np.generic):
-            v = v.item()
+            # genuine host boundary: np.generic params (never device
+            # arrays) unwrap to JSON scalars one at a time
+            v = v.item()  # graftlint: disable=GL01
         try:
             json.dumps(v)
         except TypeError:

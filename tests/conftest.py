@@ -6,10 +6,22 @@ runs here across 8 fake CPU devices. The environment pins JAX_PLATFORMS=axon
 via sitecustomize, so the platform must be overridden in-process.
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older JAX (< 0.4.34) spells the 8-device override as an XLA flag;
+    # backends initialize lazily, so setting it here still precedes first
+    # device use. Without this fallback the whole suite dies at collection
+    # on hosts that carry the older wheel.
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

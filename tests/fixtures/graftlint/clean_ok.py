@@ -1,0 +1,65 @@
+"""Negative cases: correct idioms that must produce NO findings."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.sharding import PartitionSpec as P
+
+from mesh_decl import DATA_AXIS
+
+
+@partial(jax.jit, static_argnames=("n_bins", "mode"))
+def good_jit(x, *, n_bins: int, mode: str = "auto"):
+    # statics branch fine; shape-derived values launder tracedness
+    N, F = x.shape
+    if mode == "auto":
+        n_bins = min(n_bins, 128)
+    if N != F:
+        x = x[:, :N]
+    acc = jnp.zeros((N, n_bins), jnp.float32)
+    return acc + x[:, :1]
+
+
+@jax.jit
+def good_contract(a, b):
+    return lax.dot_general(
+        a, b,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def make_good_collective(mesh):
+    def local_step(x, y):
+        h = jnp.zeros(x.shape, jnp.float32) + x * y
+        return lax.psum(h, DATA_AXIS)
+
+    return jax.jit(jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(),
+    ))
+
+
+def good_blockspec(row_tile):
+    # literal dims on tile boundaries; 1 allowed for degenerate dims
+    return pl.BlockSpec((row_tile, 1), lambda i: (i, 0)), pl.BlockSpec(
+        (8, 256), lambda i: (i, 0)
+    )
+
+
+def host_side_materialization(tree):
+    # host code: one bulk pull then Python scalars — the GL01-clean shape
+    feature = np.asarray(tree.feature)
+    values = np.asarray(tree.value).tolist()
+    return [int(feature[i]) + values[i] for i in range(len(values))]
+
+
+def host_loop_with_coercions(rows):
+    # int()/float() in host loops are fine; only .item() per element syncs
+    return [float(r) for r in rows]

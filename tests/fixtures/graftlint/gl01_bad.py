@@ -1,0 +1,56 @@
+"""Seeded GL01 violations: host-device syncs inside device code."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("scale",))
+def item_in_jit(x, *, scale: int):
+    total = x.sum()
+    return total.item() * scale  # expect: GL01
+
+
+@jax.jit
+def coerce_in_jit(x):
+    s = float(x.sum())  # expect: GL01
+    return jnp.float32(s)
+
+
+@jax.jit
+def asarray_in_jit(x):
+    h = np.asarray(x)  # expect: GL01
+    return h + 1
+
+
+@jax.jit
+def device_get_in_jit(x):
+    return jax.device_get(x)  # expect: GL01
+
+
+@jax.jit
+def block_in_jit(x):
+    return (x * 2).block_until_ready()  # expect: GL01
+
+
+def helper_called_from_jit(h):
+    # reached transitively from routed_entry: still device code
+    return int(h)  # expect: GL01
+
+
+@jax.jit
+def routed_entry(x):
+    return helper_called_from_jit(x.sum())
+
+
+def item_per_element(values):
+    out = []
+    for v in values:
+        out.append(v.item())  # expect: GL01
+    return out
+
+
+def item_in_comprehension(arr):
+    return [arr[i].item() for i in range(3)]  # expect: GL01

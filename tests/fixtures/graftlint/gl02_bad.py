@@ -1,0 +1,37 @@
+"""Seeded GL02 violations: recompile hazards at jit boundaries."""
+
+from functools import partial
+
+import jax
+
+
+@jax.jit
+def missing_static(x, n_bins: int):  # expect: GL02
+    return x * n_bins
+
+
+@partial(jax.jit, static_argnames=("n_binz",))
+def typo_static(x):  # expect: GL02
+    return x + 1
+
+
+@partial(jax.jit, static_argnames=("flag",))
+def branch_on_traced(x, *, flag: bool):
+    y = x * 2
+    if y.sum() > 0:  # expect: GL02
+        return x
+    return -x
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def while_on_traced(x, *, depth: int):
+    while x.sum() < depth:  # expect: GL02
+        x = x * 2
+    return x
+
+
+def wrapped_later(x, max_depth):  # expect: GL02
+    return x[:max_depth]
+
+
+wrapped = jax.jit(wrapped_later, static_argnames=())

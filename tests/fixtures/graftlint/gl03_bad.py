@@ -1,0 +1,38 @@
+"""Seeded GL03 violations: undeclared collective axes, short shard_map specs."""
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from mesh_decl import DATA_AXIS  # noqa: F401 (lint input only)
+
+
+def make_bad_axis(mesh):
+    def local_step(x, y):
+        h = x + y
+        return lax.psum(h, "rows")  # expect: GL03
+
+    return jax.jit(jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(),
+    ))
+
+
+def make_short_specs(mesh):
+    def local_update(a, b, c):
+        return a + b + c
+
+    in_specs = (P(DATA_AXIS), P(DATA_AXIS))  # expect: GL03
+    return jax.shard_map(
+        local_update, mesh=mesh, in_specs=in_specs, out_specs=P(DATA_AXIS)
+    )
+
+
+def bad_axis_index():
+    return lax.axis_index("chips")  # expect: GL03
+
+
+def bad_all_gather(x):
+    return lax.all_gather(x, axis_name="replica")  # expect: GL03

@@ -1,0 +1,33 @@
+"""Seeded GL04 violations: dtype and TPU-tiling contract breaks."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+
+@jax.jit
+def alloc_no_dtype(x):
+    acc = jnp.zeros((8, 128))  # expect: GL04
+    return acc + x
+
+
+@jax.jit
+def full_weak_fill(x):
+    base = jnp.full((8, 128), 0)  # expect: GL04
+    return base + x
+
+
+@jax.jit
+def dot_no_pet(a, b):
+    return lax.dot_general(  # expect: GL04
+        a, b, dimension_numbers=(((0,), (0,)), ((), ())),
+    )
+
+
+def off_lane_blockspec(row_tile):
+    return pl.BlockSpec((row_tile, 100), lambda i: (i, 0))  # expect: GL04
+
+
+def off_sublane_blockspec():
+    return pl.BlockSpec((12, 128), lambda i: (i, 0))  # expect: GL04

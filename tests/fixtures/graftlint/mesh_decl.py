@@ -1,0 +1,10 @@
+"""Axis declarations the GL03 fixtures resolve against (lint input only)."""
+
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+
+
+def make_mesh(devs):
+    return Mesh(np.array(devs), (DATA_AXIS, "model"))

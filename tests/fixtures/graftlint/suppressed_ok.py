@@ -1,0 +1,22 @@
+"""Suppression fixtures: seeded violations silenced by directives."""
+
+import jax
+
+# graftlint: disable-file=GL04
+import jax.numpy as jnp
+
+
+@jax.jit
+def suppressed_same_line(x):
+    return x.sum().item()  # graftlint: disable=GL01
+
+
+@jax.jit
+def suppressed_line_above(x):
+    # graftlint: disable=GL01
+    return float(x.sum())
+
+
+@jax.jit
+def suppressed_by_file_directive(x):
+    return jnp.zeros((8, 128)) + x  # GL04, silenced file-wide above

@@ -1,0 +1,1 @@
+"""Dev tooling (graftlint, TPU watcher, MPI-baseline measurement)."""

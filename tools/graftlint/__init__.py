@@ -1,0 +1,16 @@
+"""graftlint — JAX-aware static analysis for the mpitree_tpu framework.
+
+Enforces the device-boundary, recompile, collective and dtype invariants
+the TPU engines depend on (see each ``rules/glXX_*`` module), on every
+CPU-only CI run. Public API: :func:`run_lint`, :class:`Finding`.
+"""
+
+from tools.graftlint.engine import (
+    Finding,
+    GraftlintError,
+    Project,
+    run_lint,
+)
+
+__all__ = ["Finding", "GraftlintError", "Project", "run_lint"]
+__version__ = "0.1.0"

@@ -1,0 +1,245 @@
+"""Shared AST helpers: dotted-name resolution and traced-value tracking.
+
+Everything here is pure ``ast`` — graftlint never imports the code it lints
+(the package targets a newer JAX than some lint hosts carry), so every fact
+is derived from source text. Resolution is deliberately conservative: a name
+that cannot be resolved is *skipped*, never guessed, because a lint that
+cries wolf on the builders' factory closures would be suppressed into
+uselessness within a week.
+"""
+
+from __future__ import annotations
+
+import ast
+
+# Attribute/call forms whose result is trace-time static even when computed
+# from a traced array: shapes, ranks and dtypes are Python values under jit.
+STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size"})
+STATIC_CALLS = frozenset({"len", "range", "isinstance", "type"})
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def canonical(node: ast.AST, aliases: dict) -> str | None:
+    """Dotted name with its first segment rewritten through import aliases.
+
+    ``pl.BlockSpec`` with ``from jax.experimental import pallas as pl``
+    becomes ``jax.experimental.pallas.BlockSpec``; an unaliased head is
+    returned as spelled (builtins, locals).
+    """
+    name = dotted_name(node)
+    if name is None:
+        return None
+    head, _, rest = name.partition(".")
+    target = aliases.get(head)
+    if target is None:
+        return name
+    return f"{target}.{rest}" if rest else target
+
+
+def str_const(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def str_tuple(node: ast.AST) -> tuple | None:
+    """Tuple/list-of-string-constants literal, a single string, or None."""
+    s = str_const(node)
+    if s is not None:
+        return (s,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            s = str_const(el)
+            if s is None:
+                return None
+            out.append(s)
+        return tuple(out)
+    return None
+
+
+def keyword_arg(call: ast.Call, name: str) -> ast.AST | None:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def param_names(args: ast.arguments) -> list:
+    return [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+
+
+def param_defaults(args: ast.arguments) -> dict:
+    """name -> default expr, for every parameter that has one.
+
+    ``args.defaults`` aligns with the TAIL of posonly+args combined (a
+    posonly parameter can carry a default too); kw_defaults align 1:1 with
+    kwonlyargs, None meaning required.
+    """
+    out: dict = {}
+    positional = args.posonlyargs + args.args
+    for p, d in zip(positional[len(positional) - len(args.defaults):],
+                    args.defaults):
+        out[p.arg] = d
+    for p, d in zip(args.kwonlyargs, args.kw_defaults):
+        if d is not None:
+            out[p.arg] = d
+    return out
+
+
+def positional_arity(args: ast.arguments) -> int | None:
+    """Count of positionally-fillable params; None when *args/**kw make the
+    arity open (e.g. ``local_step(..., *nm)`` in parallel/collective.py)."""
+    if args.vararg is not None or args.kwarg is not None:
+        return None
+    return len(args.posonlyargs) + len(args.args)
+
+
+def _ann_static(ann: ast.AST | None) -> bool:
+    """Whether an annotation names a trace-time-static Python type.
+
+    Matches ``int``, ``bool``, ``str``, ``tuple``/``tuple[...]`` and their
+    ``X | None`` unions — the types jit cannot trace and must either hash as
+    static or recompile on. Array annotations (``jax.Array``) return False.
+    """
+    if ann is None:
+        return False
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        return _ann_static(ann.left) or _ann_static(ann.right)
+    if isinstance(ann, ast.Subscript):
+        return _ann_static(ann.value)
+    if isinstance(ann, ast.Constant):
+        if not isinstance(ann.value, str):  # e.g. the None in `int | None`
+            return False
+        try:  # quoted annotation
+            return _ann_static(ast.parse(ann.value, mode="eval").body)
+        except SyntaxError:
+            return False
+    return isinstance(ann, ast.Name) and ann.id in (
+        "int", "bool", "str", "tuple"
+    )
+
+
+# Parameter-name shapes that in this codebase always determine array shapes
+# or compiled control flow (the GL02 heuristic's second leg alongside type
+# annotations). Deliberately NOT matched: runtime scalars the builders trace
+# on purpose — chunk_lo, mcw, mid, root_key.
+_STATIC_NAME_SUFFIXES = (
+    "_bins", "_slots", "_size", "_tile", "_chunk", "_depth", "_width",
+    "_channels", "_steps", "_classes", "_features", "_samples",
+)
+_STATIC_NAME_EXACT = frozenset({"window", "mode", "interpret", "task",
+                                "criterion", "axis_name"})
+
+
+def looks_shape_static(name: str, ann: ast.AST | None,
+                       default: ast.AST | None) -> bool:
+    """GL02's "should this jitted parameter be static?" heuristic."""
+    if _ann_static(ann):
+        return True
+    if isinstance(default, ast.Constant) and isinstance(
+        default.value, (bool, int, str)
+    ) and not isinstance(default.value, float):
+        return True
+    if name.startswith(("n_", "num_", "max_", "min_")):
+        return True
+    return name.endswith(_STATIC_NAME_SUFFIXES) or name in _STATIC_NAME_EXACT
+
+
+def strip_static_contexts(expr: ast.AST) -> list:
+    """Nodes of ``expr`` excluding subtrees that are static under tracing.
+
+    ``x.shape``, ``len(x)``, ``x.ndim`` never carry tracedness out — a name
+    referenced only inside such a subtree is not a traced use (the pervasive
+    ``N, F = xb.shape`` idiom in ops/).
+    """
+    out: list = []
+
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS:
+            return
+        if isinstance(n, ast.Call):
+            fn = dotted_name(n.func)
+            if fn in STATIC_CALLS:
+                return
+        out.append(n)
+        for child in ast.iter_child_nodes(n):
+            visit(child)
+
+    visit(expr)
+    return out
+
+
+def refs_traced(expr: ast.AST, traced: frozenset) -> bool:
+    """Whether ``expr`` uses a traced name outside static contexts."""
+    return any(
+        isinstance(n, ast.Name) and n.id in traced
+        for n in strip_static_contexts(expr)
+    )
+
+
+def propagate_traced(func: ast.FunctionDef, seed: frozenset) -> frozenset:
+    """Forward-propagate tracedness through straight-line assignments.
+
+    One pass in statement order over the function's own body (nested defs
+    excluded — they are separate analysis units): a target assigned from an
+    expression that uses a traced name becomes traced; shape/len contexts
+    launder it back to static. Loops/branches are not iterated to fixpoint —
+    sound enough for the flat jit wrappers this repo writes, and the miss
+    direction is a skipped check, not a false finding.
+    """
+    traced = set(seed)
+    for stmt in own_statements(func):
+        targets: list = []
+        value = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AugAssign):
+            targets, value = [stmt.target], stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        if value is None or not refs_traced(value, frozenset(traced)):
+            continue
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name):
+                    traced.add(n.id)
+    return frozenset(traced)
+
+
+def own_statements(func: ast.AST):
+    """Every statement in ``func`` excluding nested function bodies."""
+    stack = list(getattr(func, "body", []))
+    while stack:
+        stmt = stack.pop(0)
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for _field, val in ast.iter_fields(stmt):
+            if isinstance(val, list):
+                stack.extend(v for v in val if isinstance(v, ast.stmt))
+
+
+def own_nodes(func: ast.AST):
+    """Every AST node lexically in ``func``, excluding nested ``def`` bodies
+    (separate functions) but INCLUDING lambdas (traced in-place)."""
+    def visit(n: ast.AST):
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield from visit(child)
+
+    for stmt in getattr(func, "body", []):
+        yield from visit(stmt)

@@ -1,0 +1,90 @@
+"""graftlint command line: ``python -m tools.graftlint [paths...]``.
+
+Exit status: 0 clean, 1 findings, 2 usage error — the same contract as
+ruff's, so CI treats both lint steps identically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.graftlint.engine import GraftlintError, run_lint
+
+
+def main(argv: list | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description=(
+            "JAX-aware static analysis for mpitree_tpu: host-sync (GL01), "
+            "recompile (GL02), collective (GL03) and dtype/tiling (GL04) "
+            "invariants."
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["mpitree_tpu"],
+        help="files or package directories to lint (default: mpitree_tpu)",
+    )
+    parser.add_argument(
+        "--format", choices=("human", "json"), default="human",
+    )
+    parser.add_argument(
+        "--select", metavar="RULES",
+        help="comma-separated rule ids to run (e.g. GL01,GL03)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print rule ids and one-line docs, then exit",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        from tools.graftlint.rules import RULE_DOCS
+
+        for rid, doc in sorted(RULE_DOCS.items()):
+            print(f"{rid}  {doc}")
+        return 0
+
+    rules = None
+    if args.select:
+        from tools.graftlint.rules import RULE_DOCS
+
+        rules = [r.strip().upper() for r in args.select.split(",")]
+        unknown = [r for r in rules if r not in RULE_DOCS]
+        if unknown:
+            print(
+                f"graftlint: unknown rule id(s): {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(RULE_DOCS))})",
+                file=sys.stderr,
+            )
+            return 2
+
+    try:
+        findings, suppressed = run_lint(args.paths, rules)
+    except GraftlintError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "findings": [f.as_dict() for f in findings],
+                "suppressed": suppressed,
+            },
+            indent=2,
+        ))
+    else:
+        for f in findings:
+            print(f.format_human())
+        tail = f" ({suppressed} suppressed)" if suppressed else ""
+        print(
+            f"graftlint: {len(findings)} finding"
+            f"{'' if len(findings) == 1 else 's'}{tail}",
+            file=sys.stderr,
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

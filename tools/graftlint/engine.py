@@ -1,0 +1,543 @@
+"""graftlint engine: module model, jit-reachability, suppressions, runner.
+
+The analyzer answers one question the rule modules all depend on: *which
+functions execute under a JAX trace?* Roots are found four ways —
+
+- ``@jax.jit`` / ``@partial(jax.jit, static_argnames=...)`` decorators,
+- wrapper calls whose first argument resolves to a known function:
+  ``jax.jit(f, ...)``, ``jax.shard_map(f, ...)``, ``jax.vmap(f)``,
+  ``pl.pallas_call(kernel_or_partial(kernel), ...)``,
+- the annotation convention: a ``# graftlint: device-fn`` comment on (or
+  directly above) a ``def`` marks functions whose jit wrapping is indirect
+  (e.g. ``fused_builder._make_build_body``'s inner ``build``, which reaches
+  ``jax.shard_map`` only as a factory return value),
+- and transitively: any project function referenced (called OR passed as a
+  function value, covering ``lax.scan``/``fori_loop`` bodies) from a
+  device function is itself device code.
+
+``# graftlint: host-fn`` marks a deliberate host boundary: the function is
+never treated as device code and reachability does not descend into it.
+
+Suppressions: ``# graftlint: disable=GL01[,GL03]`` on the finding's line or
+the line directly above; ``# graftlint: disable-file=GL01`` anywhere
+disables a rule for the whole file.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+
+from tools.graftlint import astutil
+
+
+class GraftlintError(Exception):
+    """Usage/input error (bad path, unparseable file) — CLI exit code 2."""
+
+JIT_WRAPPERS = frozenset({"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"})
+SHARD_MAP = frozenset({"jax.shard_map", "jax.experimental.shard_map.shard_map"})
+MAP_WRAPPERS = frozenset({"jax.vmap", "jax.pmap"})
+PALLAS_CALL = frozenset({"jax.experimental.pallas.pallas_call"})
+PARTIAL = frozenset({"functools.partial", "partial"})
+
+_DIRECTIVE = re.compile(r"#\s*graftlint:\s*([\w-]+)\s*(?:=\s*([\w,\s]+))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def format_human(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One ``def`` (possibly nested), addressed by (module, qualname)."""
+
+    module: "ModuleInfo"
+    qualname: str
+    node: ast.FunctionDef
+    parent: "FuncInfo | None"
+    # filled by Project:
+    is_device: bool = False
+    is_host: bool = False
+    statics: frozenset | None = None  # known static_argnames, else None
+    statics_known: bool = False
+
+    @property
+    def params(self) -> list:
+        return astutil.param_names(self.node.args)
+
+    def traced_params(self) -> frozenset:
+        """Parameter names treated as traced values inside this function.
+
+        With known ``static_argnames`` everything else is traced. Without
+        (shard_map roots, device-fn annotations, transitively reached
+        helpers), keyword-only and static-annotated/static-defaulted
+        parameters are assumed static — the convention every factory in
+        ops/ and core/ follows — and the rest traced.
+        """
+        a = self.node.args
+        if self.statics_known:
+            return frozenset(p for p in self.params
+                             if p not in (self.statics or frozenset()))
+        traced = set()
+        defaults = astutil.param_defaults(a)
+        for p in a.posonlyargs + a.args:
+            if not astutil.looks_shape_static(
+                p.arg, p.annotation, defaults.get(p.arg)
+            ):
+                traced.add(p.arg)
+        return frozenset(traced)
+
+
+class ModuleInfo:
+    def __init__(self, path: str, name: str, source: str):
+        self.path = path
+        self.name = name
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.aliases: dict = {}
+        self.functions: dict = {}  # qualname -> FuncInfo
+        self.constants: dict = {}  # module-level NAME -> str constant
+        self.file_disabled: set = set()
+        self.line_disabled: dict = {}  # line -> set of rules
+        self.directive_lines: dict = {}  # line -> (directive, values)
+        self._collect_directives()
+        self._collect_imports()
+        self._collect_functions()
+        self._collect_constants()
+
+    # -- source directives -------------------------------------------------
+    def _comment_tokens(self):
+        """(line, text) per COMMENT token — raw-line regexes would honor
+        directive text quoted inside docstrings (e.g. documentation OF the
+        suppression syntax), silently disabling rules."""
+        try:
+            for tok in tokenize.generate_tokens(
+                io.StringIO(self.source).readline
+            ):
+                if tok.type == tokenize.COMMENT:
+                    yield tok.start[0], tok.string
+        except tokenize.TokenizeError:  # pragma: no cover — ast parsed it
+            return
+
+    def _collect_directives(self) -> None:
+        for i, text in self._comment_tokens():
+            m = _DIRECTIVE.search(text)
+            if not m:
+                continue
+            kind, val = m.group(1), m.group(2)
+            rules = {
+                r.strip().upper() for r in (val or "").split(",") if r.strip()
+            }
+            if kind == "disable":
+                self.line_disabled.setdefault(i, set()).update(rules)
+            elif kind == "disable-file":
+                self.file_disabled.update(rules)
+            else:
+                self.directive_lines[i] = (kind, rules)
+
+    def _directive_at_def(self, node: ast.FunctionDef, kind: str) -> bool:
+        """Directive on the def line, or anywhere in the contiguous comment
+        block directly above it (or above its first decorator)."""
+        starts = [node.lineno]
+        starts.extend(dec.lineno for dec in node.decorator_list)
+        for start in starts:
+            d = self.directive_lines.get(start)
+            if d and d[0] == kind:
+                return True
+            line = start - 1
+            while line >= 1 and self.lines[line - 1].lstrip().startswith("#"):
+                d = self.directive_lines.get(line)
+                if d and d[0] == kind:
+                    return True
+                line -= 1
+        return False
+
+    def suppressed(self, f: Finding) -> bool:
+        if f.rule in self.file_disabled:
+            return True
+        for line in (f.line, f.line - 1):
+            rules = self.line_disabled.get(line)
+            if rules and (f.rule in rules or "ALL" in rules):
+                # a directive on the line above only applies if that line is
+                # a standalone comment (not trailing on unrelated code)
+                if line == f.line - 1 and not self.lines[
+                    line - 1
+                ].lstrip().startswith("#"):
+                    continue
+                return True
+        return False
+
+    # -- imports / functions / constants -----------------------------------
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.level:
+                    continue  # relative imports: out of scope
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = (
+                        f"{node.module}.{a.name}"
+                    )
+
+    def _collect_functions(self) -> None:
+        mod = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.stack: list = []
+
+            def visit_FunctionDef(self, node):
+                parent = self.stack[-1] if self.stack else None
+                qual = (
+                    f"{parent.qualname}.{node.name}" if parent else node.name
+                )
+                info = FuncInfo(mod, qual, node, parent)
+                mod.functions[qual] = info
+                self.stack.append(info)
+                self.generic_visit(node)
+                self.stack.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_ClassDef(self, node):
+                # methods index under the class name; scope chain unaffected
+                parent = self.stack[-1] if self.stack else None
+                fake = FuncInfo(
+                    mod,
+                    f"{parent.qualname}.{node.name}" if parent else node.name,
+                    ast.FunctionDef(
+                        name=node.name,
+                        args=ast.arguments(
+                            posonlyargs=[], args=[], kwonlyargs=[],
+                            kw_defaults=[], defaults=[],
+                        ),
+                        body=[], decorator_list=[],
+                    ),
+                    parent,
+                )
+                self.stack.append(fake)
+                self.generic_visit(node)
+                self.stack.pop()
+
+        V().visit(self.tree)
+
+    def _collect_constants(self) -> None:
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                s = astutil.str_const(node.value)
+                if isinstance(t, ast.Name) and s is not None:
+                    self.constants[t.id] = s
+
+    def canonical(self, node: ast.AST) -> str | None:
+        return astutil.canonical(node, self.aliases)
+
+
+class Project:
+    """All modules under the lint paths plus the derived device-code facts."""
+
+    def __init__(self, paths: list):
+        self.modules: list = []
+        self.by_name: dict = {}
+        for path in _discover(paths):
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    src = fh.read()
+                mod = ModuleInfo(path, _module_name(path), src)
+            except OSError as e:
+                raise GraftlintError(f"cannot read {path}: {e}") from e
+            except SyntaxError as e:
+                raise GraftlintError(f"cannot parse {path}: {e}") from e
+            self.modules.append(mod)
+            self.by_name[mod.name] = mod
+        self.jit_sites: list = []  # (FuncInfo, wrapper_kind)
+        self._mark_annotations()
+        self._find_jit_roots()
+        self._propagate_reachability()
+        self.mesh_axes = self._collect_mesh_axes()
+
+    # -- resolution --------------------------------------------------------
+    def resolve_function(self, mod: ModuleInfo, scope: FuncInfo | None,
+                         node: ast.AST) -> FuncInfo | None:
+        """Function a Name/Attribute refers to at a call/reference site."""
+        if isinstance(node, ast.Name):
+            # lexical scope chain: nested defs of each enclosing function
+            cur = scope
+            while cur is not None:
+                hit = mod.functions.get(f"{cur.qualname}.{node.id}")
+                if hit is not None:
+                    return hit
+                cur = cur.parent
+            hit = mod.functions.get(node.id)
+            if hit is not None:
+                return hit
+        dotted = mod.canonical(node)
+        if dotted is None:
+            return None
+        # longest known-module prefix + top-level function name
+        parts = dotted.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            m = self.by_name.get(".".join(parts[:cut]))
+            if m is not None:
+                return m.functions.get(".".join(parts[cut:]))
+        return None
+
+    def resolve_str(self, mod: ModuleInfo, node: ast.AST) -> str | None:
+        """String value of a literal or a resolvable module-level constant."""
+        s = astutil.str_const(node)
+        if s is not None:
+            return s
+        dotted = mod.canonical(node)
+        if dotted is None:
+            return None
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            return mod.constants.get(parts[0])
+        owner = self.by_name.get(".".join(parts[:-1]))
+        return owner.constants.get(parts[-1]) if owner else None
+
+    # -- jit-root discovery ------------------------------------------------
+    def _mark_annotations(self) -> None:
+        for mod in self.modules:
+            for fn in mod.functions.values():
+                if mod._directive_at_def(fn.node, "device-fn"):
+                    fn.is_device = True
+                if mod._directive_at_def(fn.node, "host-fn"):
+                    fn.is_host = True
+
+    def _jit_target(self, mod: ModuleInfo, scope: FuncInfo | None,
+                    call: ast.Call):
+        """(FuncInfo, statics, kind) for a wrapper call, or None."""
+        fn = mod.canonical(call.func)
+        if fn is None or not call.args:
+            return None
+        if fn in JIT_WRAPPERS or fn in SHARD_MAP or fn in MAP_WRAPPERS:
+            target = self.resolve_function(mod, scope, call.args[0])
+            if target is None:
+                return None
+            statics = astutil.str_tuple(
+                astutil.keyword_arg(call, "static_argnames") or ast.Tuple(
+                    elts=[], ctx=ast.Load()
+                )
+            )
+            known = fn in JIT_WRAPPERS
+            return target, (frozenset(statics or ()) if known else None), fn
+        if fn in PALLAS_CALL:
+            kernel = call.args[0]
+            if isinstance(kernel, ast.Call) and (
+                mod.canonical(kernel.func) in PARTIAL
+            ) and kernel.args:
+                kernel = kernel.args[0]
+            target = self.resolve_function(mod, scope, kernel)
+            if target is None:
+                return None
+            return target, None, "pallas_call"
+        return None
+
+    def _decorator_jit(self, mod: ModuleInfo, fn: FuncInfo):
+        for dec in fn.node.decorator_list:
+            name = mod.canonical(dec if not isinstance(dec, ast.Call)
+                                 else dec.func)
+            if name in JIT_WRAPPERS:
+                statics: frozenset = frozenset()
+                if isinstance(dec, ast.Call):
+                    statics = frozenset(astutil.str_tuple(
+                        astutil.keyword_arg(dec, "static_argnames")
+                        or ast.Tuple(elts=[], ctx=ast.Load())
+                    ) or ())
+                return statics
+            if (isinstance(dec, ast.Call) and name in PARTIAL and dec.args
+                    and mod.canonical(dec.args[0]) in JIT_WRAPPERS):
+                statics = frozenset(astutil.str_tuple(
+                    astutil.keyword_arg(dec, "static_argnames")
+                    or ast.Tuple(elts=[], ctx=ast.Load())
+                ) or ())
+                return statics
+        return None
+
+    def _find_jit_roots(self) -> None:
+        for mod in self.modules:
+            for fn in mod.functions.values():
+                statics = self._decorator_jit(mod, fn)
+                if statics is not None and not fn.is_host:
+                    fn.is_device = True
+                    fn.statics = statics
+                    fn.statics_known = True
+                    self.jit_sites.append((fn, "decorator"))
+            for scope, call in self._walk_calls(mod):
+                hit = self._jit_target(mod, scope, call)
+                if hit is None:
+                    continue
+                target, statics, kind = hit
+                if target.is_host:
+                    continue
+                target.is_device = True
+                if statics is not None and not target.statics_known:
+                    target.statics = statics
+                    target.statics_known = True
+                    self.jit_sites.append((target, kind))
+
+    def _walk_calls(self, mod: ModuleInfo):
+        """(enclosing FuncInfo | None, Call) pairs across the module."""
+        def visit(node, scope):
+            for child in ast.iter_child_nodes(node):
+                child_scope = scope
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = (
+                        f"{scope.qualname}.{child.name}" if scope
+                        else child.name
+                    )
+                    child_scope = mod.functions.get(qual, scope)
+                if isinstance(child, ast.Call):
+                    yield scope, child
+                yield from visit(child, child_scope)
+
+        yield from visit(mod.tree, None)
+
+    def _propagate_reachability(self) -> None:
+        queue = [
+            fn for mod in self.modules for fn in mod.functions.values()
+            if fn.is_device
+        ]
+        seen = set(id(f) for f in queue)
+        while queue:
+            fn = queue.pop()
+            for node in astutil.own_nodes(fn.node):
+                # any resolvable function reference counts — called, passed
+                # to lax.scan/cond/fori_loop, or returned (tier factories)
+                if not isinstance(node, (ast.Name, ast.Attribute)):
+                    continue
+                target = self.resolve_function(fn.module, fn, node)
+                if target is None or target.is_host or id(target) in seen:
+                    continue
+                target.is_device = True
+                seen.add(id(target))
+                queue.append(target)
+
+    def device_functions(self):
+        for mod in self.modules:
+            for fn in mod.functions.values():
+                if fn.is_device:
+                    yield fn
+
+    # -- mesh axes ---------------------------------------------------------
+    def _collect_mesh_axes(self) -> frozenset:
+        """Axis names declared anywhere in the lint set.
+
+        Sources: module-level ``*_AXIS = "name"`` constants, and literal
+        axis tuples handed to ``Mesh(...)`` constructors (names resolve
+        through module constants). GL03 checks collective axis names against
+        this set; when the set is empty the check is skipped (linting a
+        single file without its mesh module must not cry wolf).
+        """
+        axes: set = set()
+        for mod in self.modules:
+            for name, val in mod.constants.items():
+                if "AXIS" in name.upper():
+                    axes.add(val)
+            for _scope, call in self._walk_calls(mod):
+                fn = mod.canonical(call.func)
+                if fn is None or fn.rsplit(".", 1)[-1] != "Mesh":
+                    continue
+                if len(call.args) < 2:
+                    axis_arg = astutil.keyword_arg(call, "axis_names")
+                else:
+                    axis_arg = call.args[1]
+                if not isinstance(axis_arg, (ast.Tuple, ast.List)):
+                    continue
+                for el in axis_arg.elts:
+                    s = self.resolve_str(mod, el)
+                    if s is not None:
+                        axes.add(s)
+        return frozenset(axes)
+
+
+def _discover(paths: list) -> list:
+    """Python files under ``paths``; bad inputs are hard errors.
+
+    A typo'd path must NOT exit 0-clean — a green CI run that linted
+    nothing is the worst failure mode a lint gate can have.
+    """
+    files: list = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = []
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", ".ruff_cache")
+                )
+                found.extend(
+                    os.path.join(root, n) for n in sorted(names)
+                    if n.endswith(".py")
+                )
+            if not found:
+                raise GraftlintError(f"no Python files under {p!r}")
+            files.extend(found)
+        elif os.path.isfile(p) and p.endswith(".py"):
+            files.append(p)
+        else:
+            raise GraftlintError(
+                f"path {p!r} is not a directory or existing .py file"
+            )
+    return files
+
+
+def _module_name(path: str) -> str:
+    """Dotted module name by walking up through ``__init__.py`` packages."""
+    path = os.path.abspath(path)
+    parts = [os.path.splitext(os.path.basename(path))[0]]
+    d = os.path.dirname(path)
+    while os.path.exists(os.path.join(d, "__init__.py")):
+        parts.append(os.path.basename(d))
+        d = os.path.dirname(d)
+    if parts[0] == "__init__":
+        parts = parts[1:]
+    return ".".join(reversed(parts))
+
+
+def run_lint(paths: list, rules: list | None = None) -> tuple:
+    """Lint ``paths``; returns (findings, suppressed_count).
+
+    ``rules``: optional rule-id filter (e.g. ["GL01"]). Findings are sorted
+    by (path, line, col, rule) and deduplicated.
+    """
+    from tools.graftlint.rules import ALL_RULES
+
+    project = Project(paths)
+    selected = [
+        r for r in ALL_RULES if rules is None or r.rule_id in rules
+    ]
+    raw: set = set()
+    for rule in selected:
+        for f in rule.check(project):
+            raw.add(f)
+    findings, suppressed = [], 0
+    mods = {m.path: m for m in project.modules}
+    for f in sorted(raw, key=lambda f: (f.path, f.line, f.col, f.rule)):
+        mod = mods.get(f.path)
+        if mod is not None and mod.suppressed(f):
+            suppressed += 1
+        else:
+            findings.append(f)
+    return findings, suppressed

@@ -1,0 +1,14 @@
+"""graftlint rule registry — one module per rule family."""
+
+from tools.graftlint.rules import (
+    gl01_host_sync,
+    gl02_recompile,
+    gl03_collectives,
+    gl04_dtype,
+)
+
+ALL_RULES = (gl01_host_sync, gl02_recompile, gl03_collectives, gl04_dtype)
+
+RULE_DOCS = {
+    r.rule_id: (r.__doc__ or "").strip().splitlines()[0] for r in ALL_RULES
+}

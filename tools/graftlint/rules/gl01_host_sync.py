@@ -1,0 +1,111 @@
+"""GL01 — host-device synchronization inside device code paths.
+
+Two checks:
+
+1. Inside jit-reachable functions: ``.item()``, ``.block_until_ready()``,
+   ``jax.device_get``, ``np.asarray``/``np.array`` on traced values, and
+   ``float()``/``int()``/``bool()`` coercion of traced values. Under a
+   trace these either raise ``ConcretizationTypeError`` at runtime or —
+   worse, when the value happens to be concrete — silently insert a
+   blocking transfer into what profiles as a device-only hot path
+   (VERDICT.md round 5's regression class).
+
+2. Anywhere: ``.item()`` / ``.block_until_ready()`` inside a loop or
+   comprehension body. A per-element sync turns one device fetch into N
+   round trips — the exact shape of the ``tree_struct.to_nodes`` hotspot
+   this rule was seeded from. Genuine per-scalar host boundaries (numpy
+   generics, post-``device_get`` code) carry a suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint import astutil
+from tools.graftlint.engine import Finding
+
+rule_id = "GL01"
+
+_COERCIONS = frozenset({"float", "int", "bool", "complex"})
+_NP_PULLS = frozenset({"numpy.asarray", "numpy.array"})
+_SYNC_ATTRS = frozenset({"item", "block_until_ready"})
+
+
+def _device_findings(project):
+    for fn in project.device_functions():
+        mod = fn.module
+        traced = astutil.propagate_traced(fn.node, fn.traced_params())
+        for node in astutil.own_nodes(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SYNC_ATTRS and not node.args):
+                yield Finding(
+                    rule_id, mod.path, node.lineno, node.col_offset,
+                    f".{node.func.attr}() inside device function "
+                    f"'{fn.qualname}' forces a host sync under jit",
+                )
+                continue
+            name = mod.canonical(node.func)
+            if name == "jax.device_get":
+                yield Finding(
+                    rule_id, mod.path, node.lineno, node.col_offset,
+                    f"jax.device_get inside device function '{fn.qualname}' "
+                    "blocks the trace on a device fetch",
+                )
+            elif name in _NP_PULLS and node.args and astutil.refs_traced(
+                node.args[0], traced
+            ):
+                yield Finding(
+                    rule_id, mod.path, node.lineno, node.col_offset,
+                    f"{name.replace('numpy', 'np')} on traced value inside "
+                    f"device function '{fn.qualname}' round-trips to host "
+                    "(use jnp, or suppress if this is a real host boundary)",
+                )
+            elif (name in _COERCIONS and len(node.args) == 1
+                  and astutil.refs_traced(node.args[0], traced)):
+                yield Finding(
+                    rule_id, mod.path, node.lineno, node.col_offset,
+                    f"{name}() coerces a traced value to a Python scalar in "
+                    f"device function '{fn.qualname}' (host sync / "
+                    "ConcretizationTypeError under jit)",
+                )
+
+
+_LOOPS = (ast.For, ast.While, ast.AsyncFor,
+          ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+def _loop_findings(project):
+    for mod in project.modules:
+        stack: list = []
+
+        def visit(node):
+            in_loop = bool(stack)
+            if (in_loop and isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SYNC_ATTRS and not node.args):
+                yield Finding(
+                    rule_id, mod.path, node.lineno, node.col_offset,
+                    f".{node.func.attr}() inside a loop: a per-element host "
+                    "sync — materialize the array once (np.asarray / "
+                    ".tolist()) before iterating",
+                )
+            if isinstance(node, _LOOPS):
+                stack.append(node)
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child)
+            if isinstance(node, _LOOPS):
+                stack.pop()
+
+        yield from visit(mod.tree)
+
+
+def check(project):
+    seen: set = set()
+    for f in _device_findings(project):
+        seen.add((f.path, f.line, f.col))
+        yield f
+    for f in _loop_findings(project):
+        if (f.path, f.line, f.col) not in seen:
+            yield f

@@ -1,0 +1,75 @@
+"""GL02 — recompile hazards at jit boundaries.
+
+Checks, for functions wrapped by ``jax.jit`` with a statically-known
+``static_argnames`` (decorator form or ``jax.jit(f, ...)`` call form):
+
+1. Every parameter that *looks* shape-determining (a static type
+   annotation — ``int``/``bool``/``str``/``tuple`` —, a Python-scalar
+   default, or one of the codebase's shape-parameter name patterns:
+   ``n_*``/``max_*``/``*_bins``/``*_tile``/...) must appear in
+   ``static_argnames``. A traced Python scalar does not crash — it
+   recompiles the program on every distinct value, which on a tunneled TPU
+   is tens of seconds per miss.
+2. Every name listed in ``static_argnames`` must actually be a parameter
+   (typo guard — a stale name silently makes the REAL parameter traced).
+3. Python ``if``/``while`` on a traced parameter (or a value derived from
+   one outside shape/len contexts) inside the jitted body: data-dependent
+   Python control flow either fails to trace or bakes one branch in
+   per-compile. Deliberately-traced runtime scalars (``chunk_lo``, ``mcw``)
+   carry none of the static name/annotation markers, so they do not fire
+   check 1; shard_map-wrapped bodies (whose operands are all traced by
+   design) are out of scope entirely.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint import astutil
+from tools.graftlint.engine import Finding
+
+rule_id = "GL02"
+
+
+def check(project):
+    for fn, _kind in project.jit_sites:
+        if not fn.statics_known:
+            continue
+        mod, node = fn.module, fn.node
+        statics = fn.statics or frozenset()
+        params = fn.params
+        a = node.args
+        defaults = astutil.param_defaults(a)
+        anns = {
+            p.arg: p.annotation
+            for p in a.posonlyargs + a.args + a.kwonlyargs
+        }
+        for p in params:
+            if p in statics:
+                continue
+            if astutil.looks_shape_static(p, anns.get(p), defaults.get(p)):
+                yield Finding(
+                    rule_id, mod.path, node.lineno, node.col_offset,
+                    f"jitted '{fn.qualname}': parameter '{p}' looks "
+                    "shape-determining but is not in static_argnames — "
+                    "every distinct value recompiles",
+                )
+        for s in statics:
+            if s not in params:
+                yield Finding(
+                    rule_id, mod.path, node.lineno, node.col_offset,
+                    f"jitted '{fn.qualname}': static_argnames entry '{s}' "
+                    "is not a parameter (typo leaves the real one traced)",
+                )
+        traced = astutil.propagate_traced(node, fn.traced_params())
+        for stmt in astutil.own_statements(node):
+            if not isinstance(stmt, (ast.If, ast.While)):
+                continue
+            if astutil.refs_traced(stmt.test, traced):
+                kw = "while" if isinstance(stmt, ast.While) else "if"
+                yield Finding(
+                    rule_id, mod.path, stmt.lineno, stmt.col_offset,
+                    f"Python `{kw}` on a traced value in jitted "
+                    f"'{fn.qualname}' — use lax.cond/jnp.where, or mark "
+                    "the driving parameter static",
+                )

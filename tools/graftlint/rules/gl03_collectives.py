@@ -1,0 +1,107 @@
+"""GL03 — collective/mesh coherence.
+
+1. Axis names handed to ``lax.psum``/``pmean``/``pmin``/``pmax``/
+   ``all_gather``/``axis_index``/``pcast``/... must be declared by a mesh in
+   the lint set (``parallel/mesh.py``'s ``*_AXIS`` constants or literal
+   ``Mesh(..., (names,))`` tuples). A typo'd axis name traces fine and
+   fails only at run time on multi-device hardware — exactly the error
+   class CPU-only CI cannot catch dynamically. Dynamic axis arguments
+   (parameters like ``node_counts_local``'s ``axis=``) are skipped.
+2. ``shard_map`` in_specs must cover the wrapped function's positional
+   arity — a short tuple raises at trace time on hardware, a long one
+   silently drops a spec. Specs passed as a local variable resolve through
+   its literal-tuple assignments in the enclosing function; functions with
+   ``*args`` (e.g. ``collective.make_split_fn``'s ``local_step``) are
+   skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint import astutil
+from tools.graftlint.engine import SHARD_MAP, Finding
+
+rule_id = "GL03"
+
+# canonical name -> index of the axis-name argument
+_COLLECTIVES = {
+    "jax.lax.psum": 1, "jax.lax.pmean": 1, "jax.lax.pmin": 1,
+    "jax.lax.pmax": 1, "jax.lax.all_gather": 1, "jax.lax.psum_scatter": 1,
+    "jax.lax.ppermute": 1, "jax.lax.pshuffle": 1, "jax.lax.pcast": 1,
+    "jax.lax.axis_index": 0, "jax.lax.axis_size": 0,
+}
+
+
+def _axis_arg(call: ast.Call, idx: int) -> ast.AST | None:
+    if len(call.args) > idx:
+        return call.args[idx]
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "axis", "axes"):
+            return kw.value
+    return None
+
+
+def _axis_names(project, mod, node):
+    """Resolvable axis-name strings in an axis argument (non-strings skip)."""
+    elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else [node]
+    for el in elts:
+        s = project.resolve_str(mod, el)
+        if s is not None:
+            yield s, el
+
+
+def check(project):
+    declared = project.mesh_axes
+    for mod in project.modules:
+        for scope, call in project._walk_calls(mod):
+            name = mod.canonical(call.func)
+            if name in _COLLECTIVES and declared:
+                axis_arg = _axis_arg(call, _COLLECTIVES[name])
+                if axis_arg is None:
+                    continue
+                for axis, el in _axis_names(project, mod, axis_arg):
+                    if axis not in declared:
+                        yield Finding(
+                            rule_id, mod.path, el.lineno, el.col_offset,
+                            f"{name.rsplit('.', 1)[-1]} over axis "
+                            f"'{axis}' which no declared mesh provides "
+                            f"(declared: {', '.join(sorted(declared))})",
+                        )
+            elif name in SHARD_MAP and call.args:
+                yield from _check_shard_map(project, mod, scope, call)
+
+
+def _check_shard_map(project, mod, scope, call):
+    target = project.resolve_function(mod, scope, call.args[0])
+    if target is None:
+        return
+    arity = astutil.positional_arity(target.node.args)
+    if arity is None:
+        return
+    specs = astutil.keyword_arg(call, "in_specs")
+    if specs is None and len(call.args) > 2:
+        specs = call.args[2]
+    for tup in _spec_tuples(scope, specs):
+        n = len(tup.elts)
+        if n != arity:
+            yield Finding(
+                rule_id, mod.path, tup.lineno, tup.col_offset,
+                f"shard_map in_specs has {n} entries but "
+                f"'{target.qualname}' takes {arity} positional args — "
+                "every array operand needs a PartitionSpec",
+            )
+
+
+def _spec_tuples(scope, specs):
+    """Literal tuples an in_specs argument denotes (direct or via a local)."""
+    if isinstance(specs, (ast.Tuple, ast.List)):
+        yield specs
+    elif isinstance(specs, ast.Name) and scope is not None:
+        for stmt in astutil.own_statements(scope.node):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            for t in stmt.targets:
+                if (isinstance(t, ast.Name) and t.id == specs.id
+                        and isinstance(stmt.value, (ast.Tuple, ast.List))):
+                    yield stmt.value

@@ -1,0 +1,97 @@
+"""GL04 — dtype and tiling contracts in device code.
+
+1. ``jnp.zeros``/``ones``/``full``/``empty`` inside device functions must
+   pass an explicit dtype. JAX's weak-type promotion makes an undtyped
+   accumulator inherit whatever the first addend carries — a histogram
+   seeded ``jnp.zeros(shape)`` silently accumulates in f64-weak on CPU
+   tests and f32 on TPU, breaking the bit-identity contracts
+   ``ops/histogram.py`` documents.
+2. ``lax.dot_general`` (the MXU contraction both histogram kernels are
+   built on) must pin ``preferred_element_type`` — without it a bf16
+   operand pair accumulates in bf16 and the integer-exactness argument
+   (exact counts below 2**24) is void.
+3. ``pl.BlockSpec`` block shapes: literal trailing dims must respect TPU
+   tiling — last dim a multiple of 128 (lanes), second-to-last a multiple
+   of 8 (sublanes); 1 is allowed for degenerate dims (the ``(Rt, 1)`` slot
+   column idiom). Name-valued dims are checked at their call sites by the
+   kernels' own ``_round_up`` guards, not here.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint import astutil
+from tools.graftlint.engine import Finding
+
+rule_id = "GL04"
+
+_ALLOCS = {
+    "jax.numpy.zeros": 1, "jax.numpy.ones": 1, "jax.numpy.empty": 1,
+    "jax.numpy.full": 2,
+}
+_CONTRACTIONS = frozenset({"jax.lax.dot_general"})
+
+
+def _literal_int(node: ast.AST) -> int | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def check(project):
+    for fn in project.device_functions():
+        mod = fn.module
+        for node in astutil.own_nodes(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = mod.canonical(node.func)
+            if name in _ALLOCS:
+                dtype_pos = _ALLOCS[name]
+                if (len(node.args) <= dtype_pos
+                        and astutil.keyword_arg(node, "dtype") is None):
+                    yield Finding(
+                        rule_id, mod.path, node.lineno, node.col_offset,
+                        f"{name.replace('jax.numpy', 'jnp')} without an "
+                        f"explicit dtype in device function '{fn.qualname}' "
+                        "— weak-type promotion makes the accumulator dtype "
+                        "platform-dependent",
+                    )
+            elif name in _CONTRACTIONS:
+                if astutil.keyword_arg(
+                    node, "preferred_element_type"
+                ) is None:
+                    yield Finding(
+                        rule_id, mod.path, node.lineno, node.col_offset,
+                        f"dot_general in '{fn.qualname}' without "
+                        "preferred_element_type — MXU accumulation dtype "
+                        "follows the (possibly bf16) operands",
+                    )
+    # BlockSpec tiling is checked module-wide: kernels build specs in host
+    # factory code (grid_spec construction) as often as in device fns.
+    for mod in project.modules:
+        for _scope, call in project._walk_calls(mod):
+            name = mod.canonical(call.func)
+            if name is None or name.rsplit(".", 1)[-1] != "BlockSpec":
+                continue
+            shape = call.args[0] if call.args else astutil.keyword_arg(
+                call, "block_shape"
+            )
+            if not isinstance(shape, (ast.Tuple, ast.List)):
+                continue
+            dims = shape.elts
+            checks = []
+            if dims:
+                checks.append((dims[-1], 128, "last (lane)"))
+            if len(dims) >= 2:
+                checks.append((dims[-2], 8, "second-to-last (sublane)"))
+            for dim, mult, which in checks:
+                v = _literal_int(dim)
+                if v is not None and v != 1 and v % mult:
+                    yield Finding(
+                        rule_id, mod.path, dim.lineno, dim.col_offset,
+                        f"BlockSpec {which} block dim {v} is not a "
+                        f"multiple of {mult} — Mosaic pads or rejects "
+                        "off-tile blocks on TPU",
+                    )
