@@ -19,8 +19,12 @@ cannot fire; a section timing out costs that section, not the line):
                      cold + warm, per-phase breakdown, held-out accuracy.
 - ``engine_fused`` / ``engine_levelwise`` — the same workload forced
                      through each device engine with no refine tail: the
-                     measured input for the LEVELWISE_MIN_CELLS crossover
-                     (core/builder.py) on the live transport.
+                     measured input for re-deriving the fused-vs-levelwise
+                     engine crossover (core/builder.py's engine
+                     resolution) on the live transport.
+- ``boosting``     — histogram gradient-boosted trees (mpitree_tpu.
+                     boosting) at covtype scale: the sequential Newton
+                     outer loop over the same engine.
 - ``hist_tput``    — the K-slot histogram op at covtype shape: achieved
                      G updates/s and HBM GB/s vs the chip roofline, so
                      bandwidth efficiency is judgeable from the artifact.
@@ -559,6 +563,41 @@ def worker_hist_tput(npz_path: str) -> dict:
     return res
 
 
+def worker_boosting(npz_path: str) -> dict:
+    """The boosting workload section (mpitree_tpu.boosting) at covtype scale.
+
+    20 Newton rounds of one-tree-per-class softmax GBDT at depth 6 through
+    the levelwise gbdt engine — the sequential residual-fitting outer loop
+    no single-tree section represents. Reports total and per-round fit
+    wall, held-out accuracy, and warm predict throughput.
+    """
+    from mpitree_tpu import GradientBoostingClassifier
+
+    Xtr, ytr, Xte, yte = _load(npz_path)
+    platform = _device_platform()
+    t0 = time.perf_counter()
+    clf = GradientBoostingClassifier(
+        max_iter=20, max_depth=6, max_bins=256, backend=platform,
+        random_state=0,
+    ).fit(Xtr, ytr)
+    fit_s = time.perf_counter() - t0
+    out = {
+        "platform": platform,
+        "max_iter": 20,
+        "max_depth": 6,
+        "n_trees": len(clf.trees_),
+        "fit_s": round(fit_s, 3),
+        "round_s": round(fit_s / max(clf.n_iter_, 1), 3),
+        "test_acc": round(float((clf.predict(Xte) == yte).mean()), 4),
+    }
+    # The test_acc predict above already compiled/warmed the stacked
+    # descent for this shape — time the next call directly.
+    t0 = time.perf_counter()
+    clf.predict(Xte)
+    out["predict_rows_per_s"] = round(len(Xte) / (time.perf_counter() - t0))
+    return out
+
+
 def worker_forest(npz_path: str) -> dict:
     """BASELINE configs[4] on the live platform (core shared with bench.py:
     one-program tree-sharded forest vs T sequential fused builds)."""
@@ -585,6 +624,7 @@ WORKERS = {
     "refine_sweep": worker_refine_sweep,
     "forest": worker_forest,
     "predict": worker_predict,
+    "boosting": worker_boosting,
 }
 
 
@@ -707,8 +747,11 @@ def main() -> int:
                    help="cap training rows (default: full dataset)")
     p.add_argument("--out", default=OUT_PATH)
     p.add_argument("--sweep-refine", action="store_true")
-    p.add_argument("--sections", default="north_star,engine_fused,"
-                   "engine_levelwise,hist_tput,forest")
+    # Value-ranked: healthy tunnel windows are short, so the sections with
+    # the most evidence per second come first (hist_tput -> north_star ->
+    # engine_fused -> boosting -> the rest).
+    p.add_argument("--sections", default="hist_tput,north_star,"
+                   "engine_fused,boosting,engine_levelwise,forest")
     p.add_argument("--timeout", type=int, default=SECTION_TIMEOUT_S)
     p.add_argument("--platform", default="auto",
                    help="jax platform for every section (auto = probe, "
@@ -758,6 +801,16 @@ def main() -> int:
             took = round(time.perf_counter() - t0, 1)
             if res is not None:
                 record[sec] = res
+                # Checkpoint the section to the jsonl AS IT COMPLETES: a
+                # killed window (watcher timeout, tunnel death, operator
+                # ctrl-C) still yields committed evidence for everything
+                # that finished. latest_line merges these per-section
+                # partial lines with the final summary record; the
+                # "partial" marker just keeps the file honest to read.
+                with open(args.out, "a") as f:
+                    f.write(json.dumps(
+                        {**record, "partial": True, "ok": False}
+                    ) + "\n")
                 print(f"[bench-tpu] {sec}: ok in {took}s", file=sys.stderr)
             else:
                 errors[sec] = err

@@ -27,6 +27,11 @@ backed, no ``mpirun``), plus ``DecisionTreeRegressor`` and bagged random
 forests.
 """
 
+from mpitree_tpu import _compat  # noqa: F401  (JAX API shims, side effect)
+from mpitree_tpu.boosting import (
+    GradientBoostingClassifier,
+    GradientBoostingRegressor,
+)
 from mpitree_tpu.models.classifier import (
     DecisionTreeClassifier,
     ParallelDecisionTreeClassifier,
@@ -50,6 +55,8 @@ __all__ = [
     "RandomForestRegressor",
     "ExtraTreesClassifier",
     "ExtraTreesRegressor",
+    "GradientBoostingClassifier",
+    "GradientBoostingRegressor",
     "save_model",
     "load_model",
 ]

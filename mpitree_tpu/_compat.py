@@ -1,0 +1,46 @@
+"""JAX API compatibility shims — imported for side effect by the package root.
+
+This codebase targets the modern top-level spellings ``jax.shard_map`` and
+``jax.enable_x64``; older jax wheels (e.g. the 0.4.x line some containers
+bake) still carry both only under ``jax.experimental`` — with
+``shard_map``'s replication-check kwarg spelled ``check_rep`` instead of
+``check_vma``. Aliasing them here (a no-op on newer jax) lets one source
+tree run on both, instead of every device-engine entry point dying with
+``AttributeError`` on the older wheel.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# True on wheels predating the top-level aliases. Beyond steering the shims
+# below, this gates the scoped-f64 exact-ties cost sweep off
+# (core/builder.resolve_exact_ties): those wheels canonicalize inlined f64
+# scalar constants back to f32 at lowering, so the sweep's weak-constant
+# arithmetic cannot lower — the device/host tie seam stays open there,
+# exactly the pre-closure behavior. (The gbdt f64 histogram closure is
+# unaffected: it uses only converted operands and lifted array constants.)
+LEGACY_JAX = not hasattr(jax, "shard_map")
+
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def _shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma=True,
+                          **kwargs):
+        # check_rep stays False regardless of check_vma: the old
+        # replication checker has no rule for lax.while_loop (it raises
+        # NotImplementedError on the fused builders), while the modern
+        # vma checker — the validation this codebase actually targets —
+        # runs natively wherever the new API exists and this shim doesn't.
+        del check_vma
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False, **kwargs,
+        )
+
+    jax.shard_map = _shard_map_compat
+
+if not hasattr(jax, "enable_x64"):
+    from jax.experimental import enable_x64 as _enable_x64
+
+    jax.enable_x64 = _enable_x64

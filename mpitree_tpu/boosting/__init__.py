@@ -1,0 +1,17 @@
+"""Histogram gradient-boosted trees on the level-synchronous engine.
+
+The sequential, gradient-driven outer loop (XGBoost / LightGBM lineage)
+layered on the proven per-tree machinery: one binned matrix for the whole
+ensemble (``ops/binning.py``), per-node (count, g, h) histograms through
+the same psum'd scatter path every tree build uses
+(``ops/histogram.grad_hess_histogram`` + ``parallel/collective.py``), and
+Newton-gain split selection (``ops/impurity.best_split_newton``) driven by
+the levelwise builder (``core/builder.build_tree`` with ``task="gbdt"``).
+"""
+
+from mpitree_tpu.boosting.gradient_boosting import (
+    GradientBoostingClassifier,
+    GradientBoostingRegressor,
+)
+
+__all__ = ["GradientBoostingClassifier", "GradientBoostingRegressor"]

@@ -1,0 +1,413 @@
+"""Histogram gradient-boosted trees (sklearn ``HistGradientBoosting*`` API).
+
+Each round fits one tree (per class, for multiclass softmax) to the
+current Newton residuals:
+
+1. gradients/hessians come from ``losses.py`` (host f64, O(N) per round);
+2. the tree grows through the SAME level-synchronous device engine every
+   estimator uses — ``core/builder.build_tree(task="gbdt")`` drives the
+   psum'd (count, g, h) histograms (``ops/histogram.grad_hess_histogram``)
+   and the Newton-gain sweep (``ops/impurity.best_split_newton``), so data
+   sharding, frontier chunking, and the f32/f64 accumulation policy are
+   inherited, not duplicated;
+3. leaf values are refit on host in exact f64 from the final row
+   assignments (the same stance as the regressor's ``refit_regression_
+   values``) — mesh-invariant, no cancellation noise — and shrunk by
+   ``learning_rate`` at prediction time.
+
+Rows never re-bin: ``X`` is binned once for the whole ensemble. Stochastic
+rounds (``subsample < 1``) draw keyed Bernoulli row masks
+(``ops/sampling.row_subsample_mask``) — a pure function of
+(seed, round, row), so resumed fits and every mesh size agree. Excluded
+rows carry ``h == 0`` and fall out of every histogram channel, but their
+``node_id`` still advances, which is what makes the training-set margin
+update free (no re-descent).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from sklearn.base import BaseEstimator, ClassifierMixin, RegressorMixin
+from sklearn.utils.validation import check_is_fitted
+
+from mpitree_tpu.boosting.losses import loss_for
+from mpitree_tpu.core.builder import BuildConfig, build_tree
+from mpitree_tpu.models.forest import _TreeList
+from mpitree_tpu.ops.binning import bin_dataset
+from mpitree_tpu.ops.predict import predict_mesh, stacked_leaf_ids
+from mpitree_tpu.ops.sampling import row_subsample_mask, seed_from
+from mpitree_tpu.parallel import mesh as mesh_lib
+from mpitree_tpu.utils.validation import (
+    feature_names_of,
+    resolve_min_samples_leaf,
+    validate_fit_data,
+    validate_predict_data,
+    validate_sample_weight,
+)
+
+
+def _newton_refit(tree, leaf_ids: np.ndarray, g64: np.ndarray,
+                  h64: np.ndarray, reg_lambda: float) -> np.ndarray:
+    """Exact f64 Newton refit from final row assignments (in place).
+
+    One descending rollup (children always have larger ids than their
+    parent — the level-synchronous allocation order) turns per-leaf (G, H)
+    sums into per-node sums; every node then gets its Newton value
+    ``-G/(H + lambda)`` (returned, and stored f64 in ``count[:, 0]`` — the
+    predict surface) and its structure score ``1/2 G^2/(H + lambda)`` as
+    ``impurity``. The same stance as ``refit_regression_values``: the
+    build's device f32 statistics drive split *selection* only; every
+    persisted per-node number comes from this host pass, so the whole
+    serialized tree — impurity at depth-capped leaves included — is
+    mesh-invariant.
+    """
+    G = np.bincount(leaf_ids, weights=g64, minlength=tree.n_nodes)
+    H = np.bincount(leaf_ids, weights=h64, minlength=tree.n_nodes)
+    for i in range(tree.n_nodes - 1, 0, -1):
+        p = tree.parent[i]
+        if p < 0:
+            continue
+        G[p] += G[i]
+        H[p] += H[i]
+    denom = np.maximum(H + reg_lambda, 1e-12)
+    vals = -G / denom
+    tree.value = vals.astype(np.float32)
+    tree.count[:, 0] = vals
+    tree.impurity = 0.5 * G * G / denom
+    return vals
+
+
+def _host_leaf_ids(tree, X: np.ndarray) -> np.ndarray:
+    """Vectorized numpy descent (validation rows during fit).
+
+    Early stopping scores a small held-out slice once per round; each
+    round's tree has a different node count, so the jitted device descent
+    would recompile every round. The numpy gather loop is O(n_val * depth)
+    and compiles nothing.
+    """
+    node = np.zeros(X.shape[0], np.int32)
+    for _ in range(max(tree.max_depth, 1)):
+        f = tree.feature[node]
+        leaf = f < 0
+        xf = X[np.arange(X.shape[0]), np.maximum(f, 0)]
+        nxt = np.where(
+            xf <= tree.threshold[node], tree.left[node], tree.right[node]
+        )
+        node = np.where(leaf, node, nxt).astype(np.int32)
+    return node
+
+
+class _BaseGradientBoosting(BaseEstimator):
+    """Shared fit/predict machinery; subclasses bind the task and loss."""
+
+    def __init__(self, *, loss, learning_rate=0.1, max_iter=100, max_depth=6,
+                 max_bins=256, binning="auto", subsample=1.0,
+                 min_samples_split=2, min_samples_leaf=20,
+                 min_child_weight=1e-3, reg_lambda=0.0, min_split_gain=0.0,
+                 early_stopping=False, validation_fraction=0.1,
+                 n_iter_no_change=10, tol=1e-7, random_state=None,
+                 n_devices=None, backend=None, verbose=0):
+        self.loss = loss
+        self.learning_rate = learning_rate
+        self.max_iter = max_iter
+        self.max_depth = max_depth
+        self.max_bins = max_bins
+        self.binning = binning
+        self.subsample = subsample
+        self.min_samples_split = min_samples_split
+        self.min_samples_leaf = min_samples_leaf
+        self.min_child_weight = min_child_weight
+        self.reg_lambda = reg_lambda
+        self.min_split_gain = min_split_gain
+        self.early_stopping = early_stopping
+        self.validation_fraction = validation_fraction
+        self.n_iter_no_change = n_iter_no_change
+        self.tol = tol
+        self.random_state = random_state
+        self.n_devices = n_devices
+        self.backend = backend
+        self.verbose = verbose
+
+    # -- fit ---------------------------------------------------------------
+    def _validate_params_(self):
+        if not self.learning_rate > 0:
+            raise ValueError(
+                f"learning_rate must be > 0, got {self.learning_rate!r}"
+            )
+        if int(self.max_iter) < 1:
+            raise ValueError(f"max_iter must be >= 1, got {self.max_iter!r}")
+        for name in ("reg_lambda", "min_split_gain", "min_child_weight"):
+            if float(getattr(self, name)) < 0:
+                raise ValueError(
+                    f"{name} must be >= 0, got {getattr(self, name)!r}"
+                )
+        if not 0.0 < float(self.subsample) <= 1.0:
+            raise ValueError(
+                f"subsample must be in (0, 1], got {self.subsample!r}"
+            )
+
+    def _fit(self, X, y, sample_weight, *, task):
+        self._validate_params_()
+        names = feature_names_of(X)
+        X, y_t, classes = validate_fit_data(X, y, task=task)
+        sw = validate_sample_weight(sample_weight, X.shape[0])
+        if names is not None:
+            self.feature_names_in_ = names
+        elif hasattr(self, "feature_names_in_"):
+            del self.feature_names_in_
+        self.n_features_ = X.shape[1]
+        self.n_features_in_ = X.shape[1]
+        self.n_outputs_ = 1
+        if task == "classification":
+            if len(classes) < 2:
+                raise ValueError(
+                    "gradient boosting needs at least 2 classes; got "
+                    f"{len(classes)}"
+                )
+            self.classes_ = classes
+            self.n_classes_ = len(classes)
+        loss = loss_for(self.loss, task, len(classes) if classes is not None
+                        else None)
+        K = loss.K
+        self.n_trees_per_iteration_ = K
+        seed = seed_from(self.random_state)
+
+        # Held-out rows for early stopping come off the top of a keyed
+        # permutation BEFORE binning: the validation slice must not leak
+        # into the bin edges any more than into the trees.
+        if self.early_stopping:
+            if not 0.0 < float(self.validation_fraction) < 1.0:
+                raise ValueError(
+                    "validation_fraction must be in (0, 1), got "
+                    f"{self.validation_fraction!r}"
+                )
+            perm = np.random.default_rng(seed).permutation(X.shape[0])
+            n_val = max(1, int(round(self.validation_fraction * X.shape[0])))
+            if n_val >= X.shape[0]:
+                raise ValueError("validation_fraction leaves no training rows")
+            val_idx, tr_idx = perm[:n_val], perm[n_val:]
+            X_tr, X_val = X[tr_idx], X[val_idx]
+            y_tr, y_val = y_t[tr_idx], y_t[val_idx]
+            sw_tr = sw[tr_idx] if sw is not None else None
+            sw_val = sw[val_idx] if sw is not None else None
+        else:
+            X_tr, y_tr, sw_tr = X, y_t, sw
+            X_val = y_val = sw_val = None
+
+        n_tr = X_tr.shape[0]
+        binned = bin_dataset(
+            X_tr, max_bins=self.max_bins, binning=self.binning
+        )
+        mesh = mesh_lib.resolve_mesh(
+            backend=self.backend, n_devices=self.n_devices
+        )
+        cfg = BuildConfig(
+            task="gbdt",
+            max_depth=self.max_depth,
+            min_samples_split=int(self.min_samples_split),
+            min_child_weight=float(self.min_child_weight),
+            reg_lambda=float(self.reg_lambda),
+            min_split_gain=float(self.min_split_gain),
+            min_leaf_rows=float(
+                resolve_min_samples_leaf(self.min_samples_leaf, n_tr)
+            ),
+        )
+
+        baseline = loss.init_raw(y_tr, sw_tr)  # (K,) f64
+        self._baseline_raw = np.asarray(baseline, np.float64)
+        raw_tr = np.tile(baseline, (n_tr, 1))
+        raw_val = (
+            np.tile(baseline, (len(X_val), 1)) if X_val is not None else None
+        )
+        lr = float(self.learning_rate)
+        trees: list = []
+        train_scores = [-loss.loss(raw_tr, y_tr, sw_tr)]
+        val_scores = (
+            [-loss.loss(raw_val, y_val, sw_val)] if X_val is not None else None
+        )
+        best_val = -np.inf if val_scores is None else val_scores[0]
+        stale = 0
+        n_iter = 0
+        for r in range(int(self.max_iter)):
+            mask = row_subsample_mask(seed, r, n_tr, float(self.subsample))
+            g, h = loss.grad_hess(raw_tr, y_tr)  # (N, K) f64 each
+            if sw_tr is not None:
+                g = g * sw_tr[:, None]
+                h = h * sw_tr[:, None]
+            if float(self.subsample) < 1.0:
+                g = g * mask[:, None]
+                h = h * mask[:, None]
+            for k in range(K):
+                g32 = np.ascontiguousarray(g[:, k], np.float32)
+                h32 = np.ascontiguousarray(h[:, k], np.float32)
+                tree, leaf_ids = build_tree(
+                    binned, g32, config=cfg, mesh=mesh, sample_weight=h32,
+                    return_leaf_ids=True,
+                )
+                vals = _newton_refit(
+                    tree, leaf_ids, g[:, k], h[:, k], float(self.reg_lambda)
+                )
+                raw_tr[:, k] += lr * vals[leaf_ids]
+                if X_val is not None:
+                    raw_val[:, k] += lr * vals[_host_leaf_ids(tree, X_val)]
+                trees.append(tree)
+            n_iter = r + 1
+            train_scores.append(-loss.loss(raw_tr, y_tr, sw_tr))
+            if self.verbose and (r % 10 == 0 or r + 1 == int(self.max_iter)):
+                print(
+                    f"[gbdt] round {r + 1}/{self.max_iter} "
+                    f"train_loss={-train_scores[-1]:.6f}"
+                )
+            if val_scores is not None:
+                val_scores.append(-loss.loss(raw_val, y_val, sw_val))
+                if val_scores[-1] > best_val + float(self.tol):
+                    best_val = val_scores[-1]
+                    stale = 0
+                else:
+                    stale += 1
+                    if stale >= int(self.n_iter_no_change):
+                        break
+        self.trees_ = _TreeList(trees)
+        self.n_iter_ = n_iter
+        self.train_score_ = np.asarray(train_scores)
+        self.validation_score_ = (
+            np.asarray(val_scores) if val_scores is not None else None
+        )
+        self._loss_obj = loss
+        return self
+
+    # -- predict -----------------------------------------------------------
+    def _loss(self):
+        loss = getattr(self, "_loss_obj", None)
+        if loss is None:  # loaded models skip fit; rebuild from params.
+            # NOT cached on self: predict paths must leave the estimator's
+            # __dict__ untouched (the sklearn conformance contract the
+            # WeakIdCache docstring records), and construction is trivial.
+            task = (
+                "classification" if hasattr(self, "classes_") else "regression"
+            )
+            loss = loss_for(
+                self.loss, task, getattr(self, "n_classes_", None)
+            )
+        return loss
+
+    def _staged_raw(self, X):
+        """Yield the (N, K) raw margin matrix after each boosting round.
+
+        One stacked descent computes every tree's leaf ids up front (the
+        shared ensemble-inference path); staging is then pure numpy
+        accumulation.
+        """
+        check_is_fitted(self)
+        X = validate_predict_data(X, self)
+        K = self.n_trees_per_iteration_
+        ids = stacked_leaf_ids(self.trees_, X, mesh=predict_mesh(self))
+        raw = np.tile(self._baseline_raw, (X.shape[0], 1))
+        lr = float(self.learning_rate)
+        for r in range(len(self.trees_) // K):
+            for k in range(K):
+                t = self.trees_[r * K + k]
+                raw[:, k] += lr * t.count[ids[r * K + k], 0]
+            yield raw
+
+    def _raw_predict(self, X):
+        raw = None
+        for raw in self._staged_raw(X):
+            pass
+        return raw
+
+    def __sklearn_is_fitted__(self):
+        return hasattr(self, "trees_")
+
+
+class GradientBoostingRegressor(RegressorMixin, _BaseGradientBoosting):
+    """Histogram gradient-boosted regression trees (squared error).
+
+    sklearn ``HistGradientBoostingRegressor``-style API on the TPU-native
+    level-synchronous engine; growth is depth-wise (``max_depth``, default
+    6) rather than sklearn's leaf-wise ``max_leaf_nodes`` — the frontier
+    IS the batch dimension here.
+    """
+
+    def __init__(self, *, loss="squared_error", learning_rate=0.1,
+                 max_iter=100, max_depth=6, max_bins=256, binning="auto",
+                 subsample=1.0, min_samples_split=2, min_samples_leaf=20,
+                 min_child_weight=1e-3, reg_lambda=0.0, min_split_gain=0.0,
+                 early_stopping=False, validation_fraction=0.1,
+                 n_iter_no_change=10, tol=1e-7, random_state=None,
+                 n_devices=None, backend=None, verbose=0):
+        super().__init__(
+            loss=loss, learning_rate=learning_rate, max_iter=max_iter,
+            max_depth=max_depth, max_bins=max_bins, binning=binning,
+            subsample=subsample, min_samples_split=min_samples_split,
+            min_samples_leaf=min_samples_leaf,
+            min_child_weight=min_child_weight, reg_lambda=reg_lambda,
+            min_split_gain=min_split_gain, early_stopping=early_stopping,
+            validation_fraction=validation_fraction,
+            n_iter_no_change=n_iter_no_change, tol=tol,
+            random_state=random_state, n_devices=n_devices, backend=backend,
+            verbose=verbose,
+        )
+
+    def fit(self, X, y, sample_weight=None):
+        return self._fit(X, y, sample_weight, task="regression")
+
+    def predict(self, X):
+        return self._raw_predict(X)[:, 0]
+
+    def staged_predict(self, X):
+        """Prediction after each boosting round (sklearn's staged API)."""
+        for raw in self._staged_raw(X):
+            yield raw[:, 0].copy()
+
+
+class GradientBoostingClassifier(ClassifierMixin, _BaseGradientBoosting):
+    """Histogram gradient-boosted classification trees (log loss).
+
+    Binary targets train one tree per round on the logistic gradient;
+    ``C > 2`` classes train one tree per class per round on the softmax
+    diagonal Newton residuals. See :class:`GradientBoostingRegressor` for
+    the engine notes.
+    """
+
+    def __init__(self, *, loss="log_loss", learning_rate=0.1, max_iter=100,
+                 max_depth=6, max_bins=256, binning="auto", subsample=1.0,
+                 min_samples_split=2, min_samples_leaf=20,
+                 min_child_weight=1e-3, reg_lambda=0.0, min_split_gain=0.0,
+                 early_stopping=False, validation_fraction=0.1,
+                 n_iter_no_change=10, tol=1e-7, random_state=None,
+                 n_devices=None, backend=None, verbose=0):
+        super().__init__(
+            loss=loss, learning_rate=learning_rate, max_iter=max_iter,
+            max_depth=max_depth, max_bins=max_bins, binning=binning,
+            subsample=subsample, min_samples_split=min_samples_split,
+            min_samples_leaf=min_samples_leaf,
+            min_child_weight=min_child_weight, reg_lambda=reg_lambda,
+            min_split_gain=min_split_gain, early_stopping=early_stopping,
+            validation_fraction=validation_fraction,
+            n_iter_no_change=n_iter_no_change, tol=tol,
+            random_state=random_state, n_devices=n_devices, backend=backend,
+            verbose=verbose,
+        )
+
+    def fit(self, X, y, sample_weight=None):
+        return self._fit(X, y, sample_weight, task="classification")
+
+    def decision_function(self, X):
+        raw = self._raw_predict(X)
+        return raw[:, 0] if raw.shape[1] == 1 else raw
+
+    def predict_proba(self, X):
+        return self._loss().proba(self._raw_predict(X))
+
+    def predict(self, X):
+        return self.classes_[self.predict_proba(X).argmax(axis=1)]
+
+    def staged_predict_proba(self, X):
+        loss = self._loss()
+        for raw in self._staged_raw(X):
+            yield loss.proba(raw)
+
+    def staged_predict(self, X):
+        for proba in self.staged_predict_proba(X):
+            yield self.classes_[proba.argmax(axis=1)]

@@ -41,10 +41,19 @@ from mpitree_tpu.utils.profiling import PhaseTimer, debug_checks_enabled
 
 @dataclasses.dataclass(frozen=True)
 class BuildConfig:
-    task: str = "classification"  # "classification" | "regression"
+    # "classification" | "regression" | "gbdt" (one Newton boosting round:
+    # y carries per-row gradients, sample_weight per-row hessians).
+    task: str = "classification"
     criterion: str = "entropy"  # entropy | gini (classification), mse (regression)
     max_depth: int | None = None
     min_samples_split: int = 2
+    # gbdt only: L2 leaf regularization (XGBoost's lambda), the minimum
+    # Newton gain a split must clear, and the minimum subsampled row count
+    # per child (min_child_weight below is the per-child HESSIAN floor for
+    # gbdt — the hessian is the weight of the second-order fit).
+    reg_lambda: float = 0.0
+    min_split_gain: float = 0.0
+    min_leaf_rows: float = 0.0
     # Absolute weight floor for each side of a split (the estimator computes
     # it as min_weight_fraction_leaf * total fit weight, sklearn semantics);
     # 0.0 = unconstrained.
@@ -101,13 +110,6 @@ class BuildConfig:
 # Below this many matrix cells, per-level device dispatch latency dominates
 # the arithmetic and the numpy fast path (host_builder.py) wins outright.
 HOST_PATH_MAX_CELLS = 1 << 19
-
-# Round-2 crossover above which levelwise was measured to beat fused
-# (18.0s vs 23.1s at covtype depth 20). No longer consulted by "auto" —
-# BENCH_TPU.jsonl r4 line 1 contradicts it on current transport (see
-# build_tree's engine resolution) — kept for the escape-hatch story and
-# re-derivation against the engine_levelwise capture.
-LEVELWISE_MIN_CELLS = 16 << 20
 
 
 def prefer_host_path(n_samples: int, n_features: int, n_devices, backend) -> bool:
@@ -313,6 +315,12 @@ def resolve_exact_ties(platform: str) -> bool:
     """
     if os.environ.get("MPITREE_TPU_EXACT_TIES", "auto") == "0":
         return False
+    from mpitree_tpu import _compat
+
+    if _compat.LEGACY_JAX:
+        # Pre-shard_map wheels mislower the sweep's scoped-f64 weak
+        # constants (see _compat.LEGACY_JAX); ties rank in f32 there.
+        return False
     return platform == "cpu"
 
 
@@ -341,6 +349,27 @@ def warn_exact_ties_gap(K: int, n_features: int,
         "host tier's f64",
         stacklevel=3,
     )
+
+
+def resolve_gbdt_x64(platform: str) -> bool:
+    """Whether gbdt (g, h) histograms accumulate in f64 (mesh invariance).
+
+    Gradients and hessians are non-integer f32, so their scatter sums are
+    reduction-order-dependent — a row shard split across D devices psums D
+    partials that differ in last-ulp from the single-device sum, and an
+    ulp-level cost difference can flip a first-min split pick. On CPU
+    meshes the histogram accumulates in a scoped-x64 f64 and rounds the
+    psum'd result to f32: f64 carries 29 extra mantissa bits over the f32
+    inputs, so every partition order rounds to the same f32 histogram and
+    boosted ensembles are bit-identical across mesh sizes (the same closure
+    story as ``resolve_exact_ties``). TPUs have no f64 unit and keep the
+    f32 scatter — there the build_tree ceiling guard below is the warning
+    surface. ``MPITREE_TPU_GBDT_X64=0`` opts out (perf escape hatch; the
+    ceiling-guard tests also ride it to exercise the f32 path on CPU).
+    """
+    if os.environ.get("MPITREE_TPU_GBDT_X64", "auto") == "0":
+        return False
+    return platform == "cpu"
 
 
 def integer_weights(sample_weight) -> bool:
@@ -510,6 +539,7 @@ def build_tree(
     timer = timer if timer is not None else PhaseTimer(enabled=False)
     debug = cfg.debug or debug_checks_enabled()
 
+    platform = mesh.devices.flat[0].platform
     if cfg.task == "classification":
         total_w = (
             float(binned.x_binned.shape[0]) if sample_weight is None
@@ -525,6 +555,7 @@ def build_tree(
                 "node sizes where it matters)",
                 stacklevel=2,
             )
+    gbdt64 = cfg.task == "gbdt" and resolve_gbdt_x64(platform)
 
     # The env var only steers the default ("auto"); an explicit
     # BuildConfig(engine=...) choice always wins.
@@ -533,6 +564,22 @@ def build_tree(
         engine = os.environ.get("MPITREE_TPU_ENGINE", "auto")
     if engine not in ("auto", "fused", "levelwise"):
         raise ValueError(f"unknown build engine {engine!r}")
+    if cfg.task == "gbdt":
+        # Newton rounds run the levelwise engine only: the boosting outer
+        # loop is host-sequential anyway (each round's gradients depend on
+        # the previous round's tree), so a fused whole-build program would
+        # buy nothing per tree while duplicating the Newton sweep in the
+        # while_loop body.
+        if cfg.engine == "fused":
+            raise ValueError(
+                "the fused engine does not implement task='gbdt'; use "
+                "engine='auto' or 'levelwise'"
+            )
+        if mesh_lib.feature_shards(mesh) > 1:
+            raise ValueError(
+                "task='gbdt' supports 1-D data meshes only"
+            )
+        engine = "levelwise"
     mono = mono_cst is not None and bool(np.any(np.asarray(mono_cst) != 0))
     if not mono:
         mono_cst = None
@@ -641,13 +688,40 @@ def build_tree(
     U = _table_slots(N, cfg)
     int_ok = integer_weights(sample_weight)
     use_pallas = resolve_hist_kernel(
-        cfg, mesh.devices.flat[0].platform, task, integer_ok=int_ok,
+        cfg, platform, task, integer_ok=int_ok,
     )
     use_wide, wide_bf16 = resolve_wide_hist(
-        cfg, mesh.devices.flat[0].platform, task, integer_ok=int_ok,
+        cfg, platform, task, integer_ok=int_ok,
         sample_weight=sample_weight,
     )
-    exact_ok = resolve_exact_ties(mesh.devices.flat[0].platform)
+    # Forced Pallas/wide kernels are the documented exactness opt-out
+    # (resolve_hist_kernel): they accumulate in f32, so the f64 gbdt
+    # closure stands down rather than silently fighting them.
+    gbdt64 = gbdt64 and not (use_pallas or use_wide)
+    if cfg.task == "gbdt" and not gbdt64:
+        # Same f32 ceiling as class counts, restated for the (g, h)
+        # channels: once the total hessian weight approaches 2**24 the f32
+        # histogram sums lose ulps to accumulation order, so split picks
+        # (and the min_child_weight gate) can drift run-to-run. Decided
+        # HERE, after the forced-kernel downgrade above, so a CPU mesh
+        # running the f32 wide/Pallas path still warns; only the live f64
+        # accumulation path (resolve_gbdt_x64, scatter kernel) is exempt.
+        total_h = (
+            float(N) if sample_weight is None
+            else float(np.sum(sample_weight))
+        )
+        if total_h >= 2**24:
+            import warnings
+
+            warnings.warn(
+                "gradient/hessian histograms accumulate in float32 on this "
+                "backend: beyond 2**24 total hessian weight the (g, h) "
+                "sums lose precision to accumulation order, and Newton "
+                "split selection can drift; shard rows wider or rescale "
+                "sample_weight",
+                stacklevel=2,
+            )
+    exact_ok = resolve_exact_ties(platform)
     if exact_ok and not exact_ties_fits(K, F, B):
         warn_exact_ties_gap(K, F, B)
     # Levelwise keeps only Pallas-eligible tiers: that is where the measured
@@ -656,7 +730,7 @@ def build_tree(
     from mpitree_tpu.ops import pallas_hist, wide_hist
 
     wide_pallas = resolve_wide_pallas(
-        mesh.devices.flat[0].platform, use_wide=use_wide,
+        platform, use_wide=use_wide,
         n_channels=C, n_bins=B,
     )
 
@@ -684,6 +758,7 @@ def build_tree(
             node_mask=sampling,
             random_split=sampling and feature_sampler.random_split,
             monotonic=mono,
+            gbdt_x64=gbdt64,
         )
 
     mcw32 = np.float32(cfg.min_child_weight)
@@ -691,6 +766,10 @@ def build_tree(
     def split_args(lo, take, S_lvl):
         """Positional tail of a split_fn call for the chunk at ``lo``."""
         args = (np.int32(lo), mcw32)
+        if task == "gbdt":
+            args = args + (
+                np.float32(cfg.reg_lambda), np.float32(cfg.min_leaf_rows),
+            )
         if sampling:
             nmask = np.ones((S_lvl, F), bool)
             nmask[:take] = keys.masks(lo, lo + take)
@@ -767,6 +846,14 @@ def build_tree(
             n = counts.sum(axis=1)
             pure = (counts > 0).sum(axis=1) <= 1
             value = counts.argmax(axis=1).astype(np.int32)
+        elif task == "gbdt":
+            m = dec["counts"]  # (S, 3) = (count, G, H)
+            n = m[:, 0]
+            # Raw Newton leaf value; the boosting loop overwrites it with
+            # the exact f64 host refit and applies shrinkage itself.
+            value = (
+                -m[:, 1] / np.maximum(m[:, 2] + cfg.reg_lambda, 1e-12)
+            ).astype(np.float32)
         else:
             m = dec["counts"]  # (S, 3) moments
             n = m[:, 0]
@@ -775,7 +862,13 @@ def build_tree(
         if terminal:
             stop = np.ones(frontier_size, bool)
         else:
-            pure = pure if task == "classification" else dec["y_range"] <= 0.0
+            if task == "gbdt":
+                # No purity concept for gradients: a node with zero best
+                # gain stops through the min_split_gain gate below (or the
+                # constant/inf-cost rules).
+                pure = np.zeros(frontier_size, bool)
+            elif task != "classification":
+                pure = dec["y_range"] <= 0.0
             stop = (
                 pure | dec["constant"] | (n < cfg.min_samples_split)
                 | np.isinf(dec["cost"])
@@ -786,6 +879,14 @@ def build_tree(
                     stop |= (
                         n * (dec["impurity"] - dec["cost"])
                         < cfg.min_decrease_scaled
+                    )
+            if task == "gbdt" and cfg.min_split_gain > 0.0:
+                # impurity - cost IS the Newton gain (best_split_newton's
+                # sign convention); unlike min_decrease_scaled it is a raw
+                # per-split threshold, not weight-scaled.
+                with np.errstate(invalid="ignore"):
+                    stop |= (
+                        dec["impurity"] - dec["cost"] < cfg.min_split_gain
                     )
 
         tree.feature[ids] = (
@@ -798,6 +899,17 @@ def build_tree(
             tree.count[ids] = counts.astype(tree.count.dtype)
             tree.impurity[ids] = imp_utils.class_node_impurity(
                 counts, cfg.criterion
+            )
+        elif task == "gbdt":
+            tree.count[ids, 0] = value
+            # f32-accuracy Newton structure score 1/2 G^2/(H+lambda);
+            # value, count AND impurity are all overwritten exactly by the
+            # boosting loop's f64 host refit (_newton_refit) — same
+            # contract as the regression refit pass.
+            m = dec["counts"]
+            tree.impurity[ids] = (
+                0.5 * m[:, 1] * m[:, 1]
+                / np.maximum(m[:, 2] + cfg.reg_lambda, 1e-12)
             )
         else:
             tree.count[ids, 0] = value

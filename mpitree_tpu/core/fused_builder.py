@@ -647,7 +647,12 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         out_specs=out_specs,
         check_vma=FA is None,  # replicated/varying mixes in the 2-D cond
     )
-    return jax.jit(sharded)
+    # Donate the row-assignment input (arg 2, nid0): it is freshly sharded
+    # per build (shard_build_inputs) and the program returns nid with the
+    # identical shape/sharding, so XLA reuses the buffer instead of
+    # double-buffering an N-row vector across the fused while_loop (GL05).
+    # xb/y/w are NOT donatable: the forest path reuses them across groups.
+    return jax.jit(sharded, donate_argnums=(2,))
 
 
 @lru_cache(maxsize=32)
@@ -728,7 +733,12 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         # single-tree fused fn on a feature mesh).
         check_vma=False,
     )
-    return jax.jit(sharded)
+    # No usable donation here: every output is tree-stacked (T, ...) while
+    # the inputs are per-row/per-tree shapes XLA cannot alias onto them,
+    # and xb/y/nid0 replicate across the whole lax.map tree batch — an
+    # unusable donation would only emit compile-time warnings (the ceiling
+    # tests run warnings-as-errors).
+    return jax.jit(sharded)  # graftlint: disable=GL05
 
 
 # graftlint: host-fn — host shell around the fused device program:

@@ -28,7 +28,6 @@ from __future__ import annotations
 import dataclasses
 import warnings
 
-import jax
 import numpy as np
 from sklearn.base import BaseEstimator, ClassifierMixin, RegressorMixin
 from sklearn.utils.validation import check_is_fitted
@@ -43,7 +42,6 @@ from mpitree_tpu.core.fused_builder import build_forest_fused
 from mpitree_tpu.core.host_builder import build_tree_host
 from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.sampling import NodeFeatureSampler, n_subspace_features
-from mpitree_tpu.ops.predict import WeakIdCache, predict_leaf_ids
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.utils.elastic import ForestCheckpoint, device_failover
 from mpitree_tpu.utils.validation import (
@@ -57,9 +55,6 @@ from mpitree_tpu.utils.validation import (
     validate_predict_data,
     validate_sample_weight,
 )
-
-
-_stacked_cache = WeakIdCache()
 
 
 class _TreeList(list):
@@ -502,55 +497,16 @@ class _BaseForest(BaseEstimator):
             or debug_checks_enabled()
         )
 
-    # Device-memory ceiling for one stacked predict group (4 arrays x int32).
-    _PREDICT_GROUP_BYTES = 256 << 20
-
     def _leaf_ids(self, X: np.ndarray):
         """Yield (tree, leaf_ids) — trees descend in vmapped device programs
-        over a stacked (tree, node) axis instead of a per-tree Python loop.
-        The stacked arrays are cached host-side and shipped in groups capped
-        at ``_PREDICT_GROUP_BYTES``, so forests of deep trees cannot pin
-        gigabytes of accelerator memory."""
-        def build_stacked():
-            T = len(self.trees_)
-            M = max(t.n_nodes for t in self.trees_)
-            feat = np.full((T, M), -1, np.int32)
-            thr = np.full((T, M), np.nan, np.float32)
-            left = np.full((T, M), -1, np.int32)
-            right = np.full((T, M), -1, np.int32)
-            for i, t in enumerate(self.trees_):
-                feat[i, : t.n_nodes] = t.feature
-                thr[i, : t.n_nodes] = t.threshold
-                left[i, : t.n_nodes] = t.left
-                right[i, : t.n_nodes] = t.right
-            depth = max(max(t.max_depth for t in self.trees_), 1)
-            return (feat, thr, left, right), depth
+        over a stacked (tree, node) axis instead of a per-tree Python loop
+        (``ops/predict.stacked_leaf_ids``, the ensemble-inference path
+        boosting shares). On a multi-device fit the query rows shard over
+        the mesh's data axis — the reference's ranks each predicted the
+        full set redundantly."""
+        from mpitree_tpu.ops.predict import predict_mesh, stacked_leaf_ids
 
-        (feat, thr, left, right), depth = _stacked_cache.get_or_build(
-            self.trees_, build_stacked
-        )
-        T, M = feat.shape
-        group = max(1, min(T, self._PREDICT_GROUP_BYTES // max(16 * M, 1)))
-        n = X.shape[0]
-        from mpitree_tpu.ops.predict import predict_mesh, shard_rows
-
-        mesh = predict_mesh(self)
-        if mesh is not None:
-            # Distributed inference: query rows shard over the mesh's data
-            # axis, the stacked tree arrays replicate, and the vmapped
-            # descent partitions across chips (GSPMD propagates the input
-            # sharding) — single-tree estimators do the same, the
-            # reference's ranks each predicted the full set redundantly.
-            X_d, n = shard_rows(X, mesh)
-        else:
-            X_d = jax.device_put(X)
-        ids = np.empty((T, n), np.int32)
-        for g0 in range(0, T, group):
-            sl = slice(g0, min(g0 + group, T))
-            parts = tuple(jax.device_put(a[sl]) for a in (feat, thr, left, right))
-            ids[sl] = np.asarray(jax.vmap(
-                lambda f, th, l, r: predict_leaf_ids(X_d, (f, th, l, r), depth)
-            )(*parts))[:, :n]
+        ids = stacked_leaf_ids(self.trees_, X, mesh=predict_mesh(self))
         for i, t in enumerate(self.trees_):
             yield t, ids[i]
 

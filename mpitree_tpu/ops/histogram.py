@@ -70,6 +70,64 @@ def class_histogram(
     return hist.reshape(n_slots, F, n_classes, n_bins)
 
 
+def _flat_ids(x_binned: jax.Array, valid: jax.Array, slot: jax.Array,
+              n_bins: int) -> jax.Array:
+    """Flattened (N*F,) (slot, feature, bin) segment ids, masked to 0."""
+    F = x_binned.shape[1]
+    feat = jnp.arange(F, dtype=jnp.int32)[None, :]
+    ids = (slot[:, None] * F + feat) * n_bins + x_binned
+    return jnp.where(valid[:, None], ids, 0).reshape(-1)
+
+
+def _channel_histogram(
+    x_binned: jax.Array,
+    payloads: tuple,
+    ids: jax.Array,
+    *,
+    n_slots: int,
+    n_bins: int,
+    acc_dtype=jnp.float32,
+) -> jax.Array:
+    """Shared weighted-accumulation path: one scalar scatter per channel.
+
+    ``payloads`` is a tuple of (N,) per-row channel values (already masked
+    to zero on invalid rows); ``ids`` the :func:`_flat_ids` segment ids;
+    the result is (n_slots, F, len(payloads), n_bins). One scalar scatter
+    per channel on purpose: a vector-payload scatter of shape (N*F, C)
+    would pad its trailing dim to 128 lanes (42x the bandwidth at C=3).
+    ``acc_dtype`` is the accumulation dtype — float64 (under a scoped
+    ``jax.enable_x64``; all inputs prepared OUTSIDE the scope, see
+    grad_hess_histogram) makes non-integer payload sums
+    row-partition-invariant to f32 resolution, the mesh-size identity
+    story the GBDT path relies on (CPU only; TPUs have no f64 unit).
+    """
+    N, F = x_binned.shape
+    f64 = acc_dtype == jnp.float64
+    chans = []
+    for payload in payloads:
+        data = jnp.broadcast_to(payload[:, None], (N, F)).astype(acc_dtype)
+        if f64:
+            # f64 constants canonicalize to f32 at lowering time even when
+            # the trace ran inside a scoped enable_x64 (the same breakage
+            # ops/impurity.py::_cost_sweep_f64 documents for f64 inits) —
+            # so neither segment_sum's cached init nor a direct f64 zeros
+            # lowers; an f32 zeros CONVERTED to f64 does, and scatter-add
+            # into it is the identical sum.
+            acc = jnp.zeros(
+                n_slots * F * n_bins, dtype=jnp.float32
+            ).astype(acc_dtype)
+            chans.append(
+                acc.at[ids].add(data.reshape(-1)).reshape(n_slots, F, n_bins)
+            )
+        else:
+            chans.append(
+                jax.ops.segment_sum(
+                    data.reshape(-1), ids, num_segments=n_slots * F * n_bins
+                ).reshape(n_slots, F, n_bins)
+            )
+    return jnp.stack(chans, axis=2)  # (n_slots, F, C, n_bins)
+
+
 def moment_histogram(
     x_binned: jax.Array,
     y: jax.Array,
@@ -82,26 +140,62 @@ def moment_histogram(
 ) -> jax.Array:
     """Scatter-add (w, w*y, w*y^2) into a (n_slots, F, 3, n_bins) histogram.
 
-    Used for MSE split evaluation in :class:`DecisionTreeRegressor`. One
-    scalar scatter per moment channel: a vector-payload scatter of shape
-    (N*F, 3) would pad its trailing dim to 128 lanes (42x the bandwidth).
+    Used for MSE split evaluation in :class:`DecisionTreeRegressor`.
     """
-    N, F = x_binned.shape
     slot = node_id - chunk_lo
     valid = (slot >= 0) & (slot < n_slots)
     w = jnp.where(valid, 1.0, 0.0) if sample_weight is None else jnp.where(
         valid, sample_weight, 0.0
     )
-    feat = jnp.arange(F, dtype=jnp.int32)[None, :]
-    ids = (slot[:, None] * F + feat) * n_bins + x_binned
-    ids = jnp.where(valid[:, None], ids, 0).reshape(-1)
     y32 = y.astype(jnp.float32)
-    chans = []
-    for payload in (w, w * y32, w * y32 * y32):
-        data = jnp.broadcast_to(payload[:, None], (N, F)).astype(jnp.float32)
-        chans.append(
-            jax.ops.segment_sum(
-                data.reshape(-1), ids, num_segments=n_slots * F * n_bins
-            ).reshape(n_slots, F, n_bins)
-        )
-    return jnp.stack(chans, axis=2)  # (n_slots, F, 3, n_bins)
+    return _channel_histogram(
+        x_binned, (w, w * y32, w * y32 * y32),
+        _flat_ids(x_binned, valid, slot, n_bins),
+        n_slots=n_slots, n_bins=n_bins,
+    )
+
+
+def grad_hess_histogram(
+    x_binned: jax.Array,
+    g: jax.Array,
+    h: jax.Array,
+    node_id: jax.Array,
+    chunk_lo: jax.Array,
+    *,
+    n_slots: int,
+    n_bins: int,
+    acc_dtype=jnp.float32,
+) -> jax.Array:
+    """Scatter-add (count, g, h) into a (n_slots, F, 3, n_bins) histogram.
+
+    The Newton (GBDT) counterpart of :func:`moment_histogram`, riding the
+    same weighted-accumulation path: per candidate bin the split sweep
+    needs the left/right gradient total G, hessian total H (XGBoost-style
+    Newton gain), and a row count for ``min_samples_leaf``. Rows outside
+    the round's subsample carry ``h == 0`` and contribute to no channel —
+    including the count. Gradients and hessians are non-integer f32, so
+    unlike class counts their sums are NOT order-independent; on CPU the
+    caller accumulates in f64 (``acc_dtype``) inside a scoped
+    ``jax.enable_x64`` and rounds the psum'd result to f32, which restores
+    mesh-size invariance (see ``_channel_histogram``).
+    """
+    slot = node_id - chunk_lo
+    valid = (slot >= 0) & (slot < n_slots) & (h > 0)
+    # Masking stays OUTSIDE any enable_x64 scope: a weak python constant
+    # inside the scope promotes the f32 operands to f64 at trace time but
+    # lowers as f32 — the mixed-dtype lowering failure _cost_sweep_f64's
+    # docstring warns about. Only the convert/scatter run scoped.
+    cnt = jnp.where(valid, 1.0, 0.0).astype(jnp.float32)
+    gm = jnp.where(valid, g, 0.0).astype(jnp.float32)
+    hm = jnp.where(valid, h, 0.0).astype(jnp.float32)
+    ids = _flat_ids(x_binned, valid, slot, n_bins)
+    if acc_dtype == jnp.float64:
+        with jax.enable_x64(True):
+            return _channel_histogram(
+                x_binned, (cnt, gm, hm), ids,
+                n_slots=n_slots, n_bins=n_bins, acc_dtype=acc_dtype,
+            )
+    return _channel_histogram(
+        x_binned, (cnt, gm, hm), ids,
+        n_slots=n_slots, n_bins=n_bins, acc_dtype=acc_dtype,
+    )

@@ -379,6 +379,87 @@ def _drawn_bins(valid: jax.Array, draw: jax.Array) -> jax.Array:
     return jnp.argmax(csum > j[:, :, None], axis=2)
 
 
+def best_split_newton(
+    hist: jax.Array, cand_mask: jax.Array, *,
+    reg_lambda,
+    min_child_weight=None,
+    min_samples_leaf=None,
+) -> SplitDecision:
+    """Pick the best Newton-gain split per frontier slot (GBDT rounds).
+
+    Parameters
+    ----------
+    hist : (K, F, 3, B) float32 — from :func:`histogram.grad_hess_histogram`;
+        channels are (count, gradient, hessian), bins last for TPU lane
+        alignment.
+    reg_lambda : traced scalar — L2 leaf regularization (XGBoost's lambda).
+    min_child_weight : traced scalar, optional — minimum hessian weight per
+        child (XGBoost semantics: the hessian IS the effective sample
+        weight of the second-order fit).
+    min_samples_leaf : traced scalar, optional — minimum subsampled row
+        count per child.
+
+    Candidate score is the XGBoost structure score
+    ``G^2 / (H + lambda)`` per side; to slot into the builder's
+    first-min cost ranking (lower threshold / lower feature tie-breaks)
+    the decision carries ``cost = -1/2 (score_l + score_r)`` and
+    ``impurity = -1/2 score_parent``, so ``impurity - cost`` is exactly
+    the Newton gain ``1/2 (score_l + score_r - score_parent)`` the
+    builder's min-gain gate reads. Leaf values (``-G / (H + lambda)``)
+    are NOT computed here — the boosting loop refits them on host in f64
+    from the final row assignments, which keeps them mesh-invariant.
+    """
+    c_l = jnp.cumsum(hist[:, :, 0, :], axis=2)  # (K, F, B)
+    g_l = jnp.cumsum(hist[:, :, 1, :], axis=2)
+    h_l = jnp.cumsum(hist[:, :, 2, :], axis=2)
+    c_t, g_t, h_t = c_l[:, :, -1:], g_l[:, :, -1:], h_l[:, :, -1:]
+    c_r, g_r, h_r = c_t - c_l, g_t - g_l, h_t - h_l
+
+    def score(g, h):
+        # Occupied sides have h > 0; the epsilon only guards the
+        # empty/invalid candidates that the mask below discards anyway.
+        return g * g / jnp.maximum(h + reg_lambda, 1e-12)
+
+    cost = -0.5 * (score(g_l, h_l) + score(g_r, h_r))
+
+    valid = cand_mask[None, :, :] & (c_l > 0) & (c_r > 0)
+    if min_child_weight is not None:
+        valid = valid & (h_l >= min_child_weight) & (h_r >= min_child_weight)
+    if min_samples_leaf is not None:
+        valid = valid & (c_l >= min_samples_leaf) & (c_r >= min_samples_leaf)
+    cost = jnp.where(valid, cost, jnp.inf)
+
+    best_bin_f = jnp.argmin(cost, axis=2)  # first-min = lowest threshold
+    best_cost_f = jnp.take_along_axis(cost, best_bin_f[:, :, None], axis=2)[:, :, 0]
+    best_feature = jnp.argmin(best_cost_f, axis=1)  # lowest feature
+    best_bin = jnp.take_along_axis(best_bin_f, best_feature[:, None], axis=1)[:, 0]
+    best_cost = jnp.take_along_axis(best_cost_f, best_feature[:, None], axis=1)[:, 0]
+
+    parent = hist[:, 0, :, :].sum(axis=-1)  # (K, 3) — bins summed out
+    parent_n = parent[..., 0]
+    parent_impurity = -0.5 * (
+        parent[..., 1] * parent[..., 1]
+        / jnp.maximum(parent[..., 2] + reg_lambda, 1e-12)
+    )
+
+    occupied = (hist[:, :, 0, :] > 0).sum(axis=2)
+    constant = (occupied <= 1).all(axis=1)
+
+    zeros = jnp.zeros_like(parent_n)
+    return SplitDecision(
+        feature=best_feature.astype(jnp.int32),
+        bin=best_bin.astype(jnp.int32),
+        cost=best_cost,
+        impurity=parent_impurity,
+        n=parent_n,
+        counts=parent,
+        constant=constant,
+        y_range=zeros,
+        v_left=zeros,
+        v_right=zeros,
+    )
+
+
 def best_split_regression(
     hist: jax.Array, cand_mask: jax.Array,
     node_mask: jax.Array | None = None, min_child_weight=None,

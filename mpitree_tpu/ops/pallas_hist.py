@@ -254,6 +254,18 @@ def moment_payload(y: jax.Array, w: jax.Array) -> jax.Array:
     return jnp.stack([w, w * y32, w * y32 * y32], axis=1)
 
 
+def gbdt_payload(g: jax.Array, h: jax.Array) -> jax.Array:
+    """(N,) gradients + hessians -> (N, 3) ``(count, g, h)`` payload.
+
+    ``h == 0`` marks rows outside the boosting round's subsample — they
+    contribute to no channel, the count included (the kernels mask
+    out-of-chunk rows by slot equality, so only the subsample mask needs
+    to ride the payload)."""
+    cnt = jnp.where(h > 0, 1.0, 0.0).astype(jnp.float32)
+    return jnp.stack([cnt, g.astype(jnp.float32), h.astype(jnp.float32)],
+                     axis=1)
+
+
 def pallas_available(platform: str) -> bool:
     """True when the Mosaic TPU backend can compile this kernel ("axon" =
     the tunneled accelerator's backend name; its devices report "tpu" in

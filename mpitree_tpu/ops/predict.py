@@ -15,6 +15,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -56,7 +57,10 @@ def device_tree_arrays(tree):
     )
 
 
-@partial(jax.jit, static_argnames=("n_steps",))
+# No donation on purpose: X and the tree arrays are cached device buffers
+# reused across predict calls (device_tree_arrays / stacked groups), and the
+# fori_loop carry is one fresh (N,) id vector no input could alias anyway.
+@partial(jax.jit, static_argnames=("n_steps",))  # graftlint: disable=GL05
 def descend(
     X: jax.Array,
     feature: jax.Array,
@@ -153,3 +157,58 @@ def shard_rows(X, mesh):
             [Xh, np.broadcast_to(Xh[-1:], (pad,) + Xh.shape[1:])]
         )
     return jax.device_put(Xh, NamedSharding(mesh, P(DATA_AXIS))), n
+
+
+_stacked_trees_cache = WeakIdCache()
+
+# Device-memory ceiling for one stacked descent group (4 arrays x int32).
+STACKED_GROUP_BYTES = 256 << 20
+
+
+def stacked_leaf_ids(trees, X, *, mesh=None,
+                     group_bytes: int = STACKED_GROUP_BYTES) -> np.ndarray:
+    """(T, N) leaf ids for an ensemble: vmapped descent over a stacked
+    (tree, node) axis instead of a per-tree Python loop (whose per-tree
+    array shapes would also force one compile per tree).
+
+    The ONE ensemble-inference path — bagged forests and boosting both ride
+    it. Stacked arrays are cached host-side per ensemble object (weak-ref
+    anchored, so loaded and freshly fitted ensembles behave alike) and
+    shipped in groups capped at ``group_bytes``, so ensembles of deep trees
+    cannot pin gigabytes of accelerator memory. ``mesh``: optional
+    multi-device mesh — query rows shard over its data axis with the
+    stacked tree arrays replicated (GSPMD partitions the vmapped descent).
+    """
+    def build_stacked():
+        T = len(trees)
+        M = max(t.n_nodes for t in trees)
+        feat = np.full((T, M), -1, np.int32)
+        thr = np.full((T, M), np.nan, np.float32)
+        left = np.full((T, M), -1, np.int32)
+        right = np.full((T, M), -1, np.int32)
+        for i, t in enumerate(trees):
+            feat[i, : t.n_nodes] = t.feature
+            thr[i, : t.n_nodes] = t.threshold
+            left[i, : t.n_nodes] = t.left
+            right[i, : t.n_nodes] = t.right
+        depth = max(max(t.max_depth for t in trees), 1)
+        return (feat, thr, left, right), depth
+
+    (feat, thr, left, right), depth = _stacked_trees_cache.get_or_build(
+        trees, build_stacked
+    )
+    T, M = feat.shape
+    group = max(1, min(T, group_bytes // max(16 * M, 1)))
+    if mesh is not None:
+        X_d, n = shard_rows(X, mesh)
+    else:
+        X_d = X if isinstance(X, jax.Array) else jax.device_put(X)
+        n = X.shape[0]
+    ids = np.empty((T, n), np.int32)
+    for g0 in range(0, T, group):
+        sl = slice(g0, min(g0 + group, T))
+        parts = tuple(jax.device_put(a[sl]) for a in (feat, thr, left, right))
+        ids[sl] = np.asarray(jax.vmap(
+            lambda f, th, l, r: predict_leaf_ids(X_d, (f, th, l, r), depth)
+        )(*parts))[:, :n]
+    return ids

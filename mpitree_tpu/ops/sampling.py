@@ -121,6 +121,7 @@ _LEFT_SALT = np.uint32(0x9E3779B9)
 _RIGHT_SALT = np.uint32(0xC2B2AE35)
 _FEAT_SALT = np.uint32(0x85EBCA6B)
 _DRAW_SALT = np.uint32(0x27D4EB2F)  # random-split bin draws (ExtraTrees)
+_ROW_SALT = np.uint32(0x51ED270B)  # per-round row subsampling (boosting)
 
 
 def pcg_hash(x: np.ndarray) -> np.ndarray:
@@ -130,6 +131,31 @@ def pcg_hash(x: np.ndarray) -> np.ndarray:
         shift = ((x >> np.uint32(28)) + np.uint32(4)).astype(np.uint32)
         word = (((x >> shift) ^ x) * _FIN).astype(np.uint32)
         return ((word >> np.uint32(22)) ^ word).astype(np.uint32)
+
+
+def row_subsample_mask(seed: int, round_idx: int, n_rows: int,
+                       fraction: float) -> np.ndarray:
+    """(n_rows,) bool mask of rows sampled into one boosting round.
+
+    Stochastic gradient boosting's per-round row subsample, keyed like
+    everything else in this module: each row's inclusion is
+    ``pcg_hash(mix(seed, round) + row) < fraction * 2^32`` — a pure
+    function of (seed, round, row), so refits, resumed fits, and every
+    mesh size draw the identical subsample without materializing index
+    permutations. Expected draw is Bernoulli(fraction) per row (LightGBM's
+    ``bagging_fraction`` semantics, without replacement).
+    """
+    if not 0.0 < fraction <= 1.0:
+        raise ValueError(f"subsample fraction must be in (0, 1], got {fraction!r}")
+    if fraction >= 1.0:
+        return np.ones(n_rows, bool)
+    with np.errstate(over="ignore"):
+        base = np.uint32(
+            pcg_hash(np.uint32(seed))
+            ^ pcg_hash((np.uint32(round_idx) + _ROW_SALT).astype(np.uint32))
+        )
+        keys = pcg_hash(base + np.arange(n_rows, dtype=np.uint32))
+    return keys < np.uint32(int(fraction * 4294967296.0))
 
 
 def pcg_hash_jnp(x):

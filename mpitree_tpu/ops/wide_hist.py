@@ -159,6 +159,10 @@ def _finalize(hist, *, n_slots, n_bins, f_true, window, n_channels,
     return out.reshape(n_slots, n_fc * Fc, C, bp)[:, :f_true, :, :n_bins]
 
 
+# No donation on purpose: xb/payload/slot are level-loop invariants the
+# builders reuse across every level and chunk of a build, and the scan
+# carry (the packed histogram) has no input-aliasable shape.
+# graftlint: disable=GL05
 @functools.partial(
     jax.jit,
     static_argnames=("n_slots", "n_bins", "n_channels", "window",

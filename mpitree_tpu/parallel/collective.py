@@ -50,6 +50,17 @@ def node_counts_local(y, nid, w, chunk_lo, *, n_slots, n_classes, task,
         ids = jnp.where(valid, slot * n_classes + y, 0)
         h = jax.ops.segment_sum(wv, ids, num_segments=n_slots * n_classes)
         h = h.reshape(n_slots, n_classes)
+    elif task == "gbdt":
+        # (count, G, H) per slot: y carries per-row gradients, w hessians
+        # (h == 0 marks rows outside the round's subsample — no channel,
+        # count included, sees them; see histogram.grad_hess_histogram).
+        cnt = jnp.where(valid & (w > 0), 1.0, 0.0)
+        data = jnp.stack(
+            [cnt, jnp.where(valid, y.astype(jnp.float32), 0.0), wv], axis=-1
+        )
+        h = jax.ops.segment_sum(
+            data, jnp.where(valid, slot, 0), num_segments=n_slots
+        )
     else:
         y32 = y.astype(jnp.float32)
         data = jnp.stack([wv, wv * y32, wv * y32 * y32], axis=-1)
@@ -130,7 +141,8 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                   wide_bf16: bool = False, wide_pallas: bool = False,
                   exact_ties: bool = False,
                   node_mask: bool = False,
-                  random_split: bool = False, monotonic: bool = False):
+                  random_split: bool = False, monotonic: bool = False,
+                  gbdt_x64: bool = False):
     """Jitted (x_binned, y, node_id, weight, cand_mask, chunk_lo, mcw[, nmask])
     -> packed (n_slots, 9 + C) float32 decision buffer (see
     :func:`_pack_decision`, :func:`unpack_decision`). ``mcw`` is the
@@ -150,7 +162,22 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     per-(node, feature) candidate draws (ExtraTrees; the drawn bin replaces
     the per-feature argmin). ``monotonic=True`` adds three trailing inputs
     — (F,) int32 internal constraint signs and (n_slots,) f32 lower/upper
-    node bounds (sklearn ``monotonic_cst``; ops/impurity.py)."""
+    node bounds (sklearn ``monotonic_cst``; ops/impurity.py).
+
+    ``task="gbdt"`` (boosting rounds): ``y`` carries per-row gradients and
+    ``w`` per-row hessians; the trailing operands are two runtime scalars
+    ``(reg_lambda, min_samples_leaf)`` and ``mcw`` is the minimum hessian
+    weight per child. ``gbdt_x64=True`` (CPU meshes) accumulates the
+    non-integer (g, h) histogram in f64 inside a scoped ``enable_x64`` and
+    rounds the psum'd result to f32 — what makes boosted trees identical
+    across mesh sizes (histogram.grad_hess_histogram). Per-node feature
+    masks / random splits / monotonic constraints are not supported for
+    gbdt."""
+    if task == "gbdt" and (node_mask or random_split or monotonic):
+        raise ValueError(
+            "task='gbdt' does not support per-node feature masks, random "
+            "splits, or monotonic constraints"
+        )
 
     def local_step(xb, y, nid, w, cand_mask, chunk_lo, mcw, *nm):
         nm = list(nm)
@@ -192,6 +219,49 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                 h, cand_mask, criterion=criterion, node_mask=nmask,
                 min_child_weight=mcw, forced_draw=draws,
                 exact_ties=exact_ties, **mono,
+            )
+        elif task == "gbdt":
+            lam, msl = nm[0], nm[1]
+            if gbdt_x64:
+                h = hist_ops.grad_hess_histogram(
+                    xb, y, w, nid, chunk_lo,
+                    n_slots=n_slots, n_bins=n_bins,
+                    acc_dtype=jnp.float64,
+                )
+                with jax.enable_x64(True):
+                    h = lax.psum(h, DATA_AXIS).astype(jnp.float32)
+            else:
+                if use_pallas or use_wide:
+                    from mpitree_tpu.ops import pallas_hist as ph
+
+                    payload = ph.gbdt_payload(y, w)
+                    if use_pallas:
+                        h = ph.histogram_small(
+                            xb, payload, nid - chunk_lo,
+                            n_slots=n_slots, n_bins=n_bins, n_channels=3,
+                            vma=(DATA_AXIS,),
+                        )
+                    else:
+                        from mpitree_tpu.ops import wide_hist
+
+                        wide_fn = (
+                            wide_hist.histogram_wide_pallas if wide_pallas
+                            else wide_hist.histogram_wide
+                        )
+                        h = wide_fn(
+                            xb, payload, nid - chunk_lo,
+                            n_slots=n_slots, n_bins=n_bins, n_channels=3,
+                            bf16_ok=False, vma=(DATA_AXIS,),
+                        )
+                else:
+                    h = hist_ops.grad_hess_histogram(
+                        xb, y, w, nid, chunk_lo,
+                        n_slots=n_slots, n_bins=n_bins,
+                    )
+                h = lax.psum(h, DATA_AXIS)
+            dec = imp_ops.best_split_newton(
+                h, cand_mask, reg_lambda=lam,
+                min_child_weight=mcw, min_samples_leaf=msl,
             )
         else:
             if use_pallas:
@@ -235,6 +305,8 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
 
     in_specs = (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                 P(), P(), P())
+    if task == "gbdt":
+        in_specs = in_specs + (P(), P())  # reg_lambda, min_samples_leaf
     if node_mask:
         in_specs = in_specs + (P(),)
     if random_split:

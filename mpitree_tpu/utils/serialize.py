@@ -34,6 +34,19 @@ _ESTIMATOR_CLASSES = (
     "RandomForestRegressor",
     "ExtraTreesClassifier",
     "ExtraTreesRegressor",
+    "GradientBoostingClassifier",
+    "GradientBoostingRegressor",
+)
+
+# Classes whose fitted trees live in ``trees_`` (ensembles) vs ``tree_``.
+_ENSEMBLE_PREFIXES = ("RandomForest", "ExtraTrees", "GradientBoosting")
+
+# Scalar fitted attributes carried through the JSON header (both directions
+# iterate this one tuple). n_iter_ / n_trees_per_iteration_ / _y_mean are
+# harmlessly absent on estimators that don't define them.
+_SCALAR_ATTRS = (
+    "n_features_", "n_features_in_", "_y_mean", "n_classes_",
+    "n_outputs_", "max_features_", "n_iter_", "n_trees_per_iteration_",
 )
 
 
@@ -95,8 +108,7 @@ def save_model(estimator, path) -> None:
     }
     arrays: dict = {}
 
-    for attr in ("n_features_", "n_features_in_", "_y_mean", "n_classes_",
-                 "n_outputs_", "max_features_"):
+    for attr in _SCALAR_ATTRS:
         if hasattr(estimator, attr):
             header["attrs"][attr] = getattr(estimator, attr)
     if hasattr(estimator, "feature_names_in_"):
@@ -106,6 +118,8 @@ def save_model(estimator, path) -> None:
 
     if hasattr(estimator, "classes_"):
         arrays["classes_"] = np.asarray(estimator.classes_)
+    if hasattr(estimator, "_baseline_raw"):  # boosting: (K,) f64 raw offsets
+        arrays["_baseline_raw"] = np.asarray(estimator._baseline_raw)
 
     if hasattr(estimator, "trees_"):  # forest
         header["n_trees"] = len(estimator.trees_)
@@ -137,8 +151,7 @@ def load_model(path):
             raise ValueError(f"unknown estimator class {header['class']!r}")
         cls = getattr(mpitree_tpu, header["class"])
         est = cls(**header["params"])
-        for attr in ("n_features_", "n_features_in_", "_y_mean", "n_classes_",
-                     "n_outputs_", "max_features_"):
+        for attr in _SCALAR_ATTRS:
             if attr in header["attrs"]:
                 setattr(est, attr, header["attrs"][attr])
         if "feature_names_in_" in header["attrs"]:
@@ -147,10 +160,12 @@ def load_model(path):
             )
         if "classes_" in z.files:
             est.classes_ = z["classes_"]
+        if "_baseline_raw" in z.files:
+            est._baseline_raw = z["_baseline_raw"]
         trees = [_read_tree(z, f"tree{i}/") for i in range(header["n_trees"])]
-    if header["class"].startswith(("RandomForest", "ExtraTrees")):
+    if header["class"].startswith(_ENSEMBLE_PREFIXES):
         # _TreeList (not a plain list) so the weak-ref stacked-predict cache
-        # works on loaded forests exactly as on freshly fitted ones.
+        # works on loaded ensembles exactly as on freshly fitted ones.
         from mpitree_tpu.models.forest import _TreeList
 
         est.trees_ = _TreeList(trees)

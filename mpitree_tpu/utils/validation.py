@@ -91,6 +91,35 @@ def validate_sample_weight(sample_weight, n_samples: int):
     return w
 
 
+def resolve_min_samples_leaf(min_samples_leaf, n_samples: int) -> int:
+    """sklearn's ``min_samples_leaf`` grammar -> a row count (int >= 1).
+
+    Fractional values in (0, 1) mean ``ceil(fraction * n_samples)`` rows;
+    integers pass through; anything else raises. The ONE copy of the
+    grammar — the weight-floor composition (:func:`min_child_weight`) and
+    the boosting estimators' row-count gate both resolve through it.
+    """
+    import numbers
+
+    if isinstance(min_samples_leaf, numbers.Real) and not isinstance(
+        min_samples_leaf, numbers.Integral
+    ):
+        # sklearn's fractional form: ceil(fraction * n_samples) rows
+        if not 0.0 < min_samples_leaf < 1.0:
+            raise ValueError(
+                f"float min_samples_leaf must be in (0, 1), "
+                f"got {min_samples_leaf!r}"
+            )
+        return int(np.ceil(min_samples_leaf * n_samples))
+    msl = int(min_samples_leaf)
+    if msl != min_samples_leaf or msl < 1:
+        raise ValueError(
+            f"int min_samples_leaf must be a positive integer, "
+            f"got {min_samples_leaf!r}"
+        )
+    return msl
+
+
 def min_child_weight(min_weight_fraction_leaf, sample_weight, n_samples,
                      min_samples_leaf=1):
     """sklearn's leaf floors -> one absolute per-child weight floor.
@@ -108,25 +137,7 @@ def min_child_weight(min_weight_fraction_leaf, sample_weight, n_samples,
         raise ValueError(
             f"min_weight_fraction_leaf must be in [0, 0.5], got {frac!r}"
         )
-    import numbers
-
-    if isinstance(min_samples_leaf, numbers.Real) and not isinstance(
-        min_samples_leaf, numbers.Integral
-    ):
-        # sklearn's fractional form: ceil(fraction * n_samples) rows
-        if not 0.0 < min_samples_leaf < 1.0:
-            raise ValueError(
-                f"float min_samples_leaf must be in (0, 1), "
-                f"got {min_samples_leaf!r}"
-            )
-        msl = int(np.ceil(min_samples_leaf * n_samples))
-    else:
-        msl = int(min_samples_leaf)
-        if msl != min_samples_leaf or msl < 1:
-            raise ValueError(
-                f"int min_samples_leaf must be a positive integer, "
-                f"got {min_samples_leaf!r}"
-            )
+    msl = resolve_min_samples_leaf(min_samples_leaf, n_samples)
     floor = 0.0 if msl == 1 else float(msl)
     if frac > 0.0:
         total = float(n_samples) if sample_weight is None else float(

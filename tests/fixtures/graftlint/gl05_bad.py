@@ -1,0 +1,51 @@
+"""Seeded GL05 violations: fused-state jits that donate nothing."""
+
+import jax
+import jax.numpy as jnp
+from functools import partial
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from mesh_decl import DATA_AXIS
+
+
+def level_body(state):
+    nid, depth = state
+    return nid * 2 + 1, depth + 1
+
+
+def level_cond(state):
+    return state[1] < 8
+
+
+def fused_build(nid0):
+    return lax.while_loop(level_cond, level_body, (nid0, 0))
+
+
+def make_fused(mesh):
+    sharded = jax.shard_map(
+        fused_build, mesh=mesh, in_specs=(P(DATA_AXIS),),
+        out_specs=(P(DATA_AXIS), P()),
+    )
+    return jax.jit(sharded)  # expect: GL05
+
+
+def make_fused_direct():
+    return jax.jit(fused_build)  # expect: GL05
+
+
+@jax.jit  # expect: GL05
+def scanned_update(nid, steps):
+    def body(carry, s):
+        return carry + s, ()
+
+    out, _ = lax.scan(body, nid, steps)
+    return out
+
+
+@partial(jax.jit, static_argnames=("depth",))  # expect: GL05
+def fori_descend(x, nodes, *, depth: int):
+    def body(_, node):
+        return node * 2
+
+    return lax.fori_loop(0, depth, body, jnp.zeros_like(nodes))

@@ -1,0 +1,54 @@
+"""GL05 negative cases: donated, suppressed, and loop-free jits."""
+
+import jax
+import jax.numpy as jnp
+from functools import partial
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from mesh_decl import DATA_AXIS
+
+
+def level_body(state):
+    nid, depth = state
+    return nid * 2 + 1, depth + 1
+
+
+def level_cond(state):
+    return state[1] < 8
+
+
+def fused_build(nid0):
+    return lax.while_loop(level_cond, level_body, (nid0, 0))
+
+
+def make_fused_donating(mesh):
+    sharded = jax.shard_map(
+        fused_build, mesh=mesh, in_specs=(P(DATA_AXIS),),
+        out_specs=(P(DATA_AXIS), P()),
+    )
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+@partial(jax.jit, donate_argnames=("nid",))
+def scanned_update_donating(nid, steps):
+    def body(carry, s):
+        return carry + s, ()
+
+    out, _ = lax.scan(body, nid, steps)
+    return out
+
+
+def make_fused_opted_out(mesh):
+    sharded = jax.shard_map(
+        fused_build, mesh=mesh, in_specs=(P(DATA_AXIS),),
+        out_specs=(P(DATA_AXIS), P()),
+    )
+    # inputs reused across calls: donation would invalidate them
+    return jax.jit(sharded)  # graftlint: disable=GL05
+
+
+@jax.jit
+def loop_free(x, y):
+    # no lax loop: plain fused arithmetic needs no donation story
+    return jnp.where(x > 0, x, y).sum()

@@ -1,0 +1,426 @@
+"""Boosting subsystem: conformance, parity, and mesh-identity contracts.
+
+The acceptance surface of the `mpitree_tpu.boosting` subsystem:
+
+- sklearn estimator mechanics (clone / get_params / set_params round-trip,
+  NotFittedError before fit);
+- ``staged_predict`` whose training loss is monotone non-increasing on a
+  toy set (squared error + shrinkage can only descend);
+- logistic-loss parity with ``sklearn.ensemble.HistGradientBoosting
+  Classifier`` on breast-cancer at matched depth/learning-rate;
+- serialize round-trip through ``save_model``/``load_model``;
+- the mesh-identity contract: a CPU 8-device data-sharded fit is
+  bit-identical to the single-device fit (the f64 (g, h) accumulation
+  closure, ``core/builder.resolve_gbdt_x64``);
+- the Newton sweep against a brute-force numpy oracle.
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+from sklearn.base import clone
+from sklearn.datasets import load_breast_cancer, load_iris
+from sklearn.exceptions import NotFittedError
+from sklearn.model_selection import train_test_split
+
+from mpitree_tpu import (
+    GradientBoostingClassifier,
+    GradientBoostingRegressor,
+    load_model,
+    save_model,
+)
+
+
+@pytest.fixture(scope="module")
+def cancer_split():
+    X, y = load_breast_cancer(return_X_y=True)
+    return train_test_split(X, y, test_size=0.25, random_state=0)
+
+
+@pytest.fixture(scope="module")
+def toy_regression():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(400, 5)).astype(np.float32)
+    y = X[:, 0] * 2.0 + np.sin(3.0 * X[:, 1]) + 0.1 * rng.normal(size=400)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# sklearn estimator mechanics
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "est",
+    [
+        GradientBoostingClassifier(max_iter=7, max_depth=3, reg_lambda=0.5,
+                                   subsample=0.9, random_state=3),
+        GradientBoostingRegressor(max_iter=7, max_depth=3,
+                                  min_child_weight=0.1, random_state=3),
+    ],
+    ids=lambda e: type(e).__name__,
+)
+def test_clone_and_params_round_trip(est):
+    c = clone(est)
+    assert c.get_params() == est.get_params()
+    fresh = type(est)()
+    fresh.set_params(**est.get_params())
+    assert fresh.get_params() == est.get_params()
+
+
+def test_min_samples_leaf_shared_grammar(toy_regression):
+    """Boosting resolves min_samples_leaf through the same validated
+    grammar as every other estimator: fractional = ceil(frac * n) rows,
+    invalid values raise (never silently truncate to 0)."""
+    X, y = toy_regression
+    with pytest.raises(ValueError, match="min_samples_leaf"):
+        GradientBoostingRegressor(min_samples_leaf=0).fit(X, y)
+    with pytest.raises(ValueError, match="min_samples_leaf"):
+        GradientBoostingRegressor(min_samples_leaf=1.5).fit(X, y)
+    # a large fractional floor really constrains growth
+    loose = GradientBoostingRegressor(
+        max_iter=2, max_depth=5, min_samples_leaf=1
+    ).fit(X, y)
+    tight = GradientBoostingRegressor(
+        max_iter=2, max_depth=5, min_samples_leaf=0.25
+    ).fit(X, y)
+    assert sum(t.n_nodes for t in tight.trees_) < sum(
+        t.n_nodes for t in loose.trees_
+    )
+
+
+def test_not_fitted_raises():
+    with pytest.raises(NotFittedError):
+        GradientBoostingRegressor().predict(np.zeros((3, 2)))
+
+
+def test_param_validation_errors():
+    X = np.zeros((10, 2))
+    y = np.arange(10) % 2
+    with pytest.raises(ValueError, match="learning_rate"):
+        GradientBoostingClassifier(learning_rate=0.0).fit(X, y)
+    with pytest.raises(ValueError, match="subsample"):
+        GradientBoostingClassifier(subsample=1.5).fit(X, y)
+    with pytest.raises(ValueError, match="reg_lambda"):
+        GradientBoostingClassifier(reg_lambda=-1.0).fit(X, y)
+    with pytest.raises(ValueError, match="loss"):
+        GradientBoostingRegressor(loss="absolute_error").fit(X, y.astype(float))
+    with pytest.raises(ValueError, match="classes"):
+        GradientBoostingClassifier(max_iter=2).fit(X, np.zeros(10))
+
+
+# ---------------------------------------------------------------------------
+# staged predictions
+# ---------------------------------------------------------------------------
+
+def test_staged_predict_monotone_train_loss(toy_regression):
+    X, y = toy_regression
+    reg = GradientBoostingRegressor(max_iter=25, max_depth=3).fit(X, y)
+    losses = [
+        float(np.mean((y - p) ** 2)) for p in reg.staged_predict(X)
+    ]
+    assert len(losses) == reg.n_iter_
+    assert all(b <= a + 1e-12 for a, b in zip(losses, losses[1:])), losses
+    # the recorded train curve agrees: scores are negative losses
+    assert len(reg.train_score_) == reg.n_iter_ + 1  # + baseline entry
+    assert reg.train_score_[-1] > reg.train_score_[0]
+
+
+def test_staged_predict_proba_final_stage_matches(cancer_split):
+    Xtr, Xte, ytr, _ = cancer_split
+    clf = GradientBoostingClassifier(max_iter=8, max_depth=3).fit(Xtr, ytr)
+    stages = list(clf.staged_predict_proba(Xte))
+    assert len(stages) == clf.n_iter_
+    np.testing.assert_allclose(stages[-1], clf.predict_proba(Xte))
+    preds = list(clf.staged_predict(Xte))
+    assert np.array_equal(preds[-1], clf.predict(Xte))
+
+
+# ---------------------------------------------------------------------------
+# accuracy parity with sklearn
+# ---------------------------------------------------------------------------
+
+def test_logistic_parity_with_sklearn_hist_gbdt(cancer_split):
+    """Acceptance: max_iter=100 on breast-cancer within 0.01 accuracy of
+    sklearn's HistGradientBoostingClassifier at matched depth/lr."""
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    Xtr, Xte, ytr, yte = cancer_split
+    sk = HistGradientBoostingClassifier(
+        max_iter=100, max_depth=4, learning_rate=0.1, early_stopping=False,
+        min_samples_leaf=20,
+    ).fit(Xtr, ytr)
+    ours = GradientBoostingClassifier(
+        max_iter=100, max_depth=4, learning_rate=0.1, min_samples_leaf=20,
+    ).fit(Xtr, ytr)
+    acc_sk = float((sk.predict(Xte) == yte).mean())
+    acc_us = float((ours.predict(Xte) == yte).mean())
+    assert acc_us >= acc_sk - 0.01, (acc_us, acc_sk)
+
+
+def test_multiclass_softmax_one_tree_per_class():
+    X, y = load_iris(return_X_y=True)
+    clf = GradientBoostingClassifier(
+        max_iter=12, max_depth=3, random_state=0
+    ).fit(X, y)
+    assert clf.n_trees_per_iteration_ == 3
+    assert len(clf.trees_) == 3 * clf.n_iter_
+    assert (clf.predict(X) == y).mean() > 0.93
+    proba = clf.predict_proba(X)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-12)
+
+
+def test_regression_quality(toy_regression):
+    X, y = toy_regression
+    reg = GradientBoostingRegressor(max_iter=60, max_depth=4).fit(X, y)
+    assert reg.score(X, y) > 0.9
+
+
+# ---------------------------------------------------------------------------
+# serialize round-trip
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["clf", "reg"])
+def test_serialize_round_trip(tmp_path, cancer_split, toy_regression, kind):
+    Xtr, Xte, ytr, _ = cancer_split
+    if kind == "clf":
+        est = GradientBoostingClassifier(
+            max_iter=6, max_depth=3, random_state=1
+        ).fit(Xtr, ytr)
+    else:
+        Xtr, _ = toy_regression[0], None
+        ytr = toy_regression[1]
+        Xte = Xtr
+        est = GradientBoostingRegressor(
+            max_iter=6, max_depth=3, random_state=1
+        ).fit(Xtr, ytr)
+    path = tmp_path / f"gb_{kind}.npz"
+    save_model(est, path)
+    loaded = load_model(path)
+    assert loaded.n_iter_ == est.n_iter_
+    assert loaded.n_trees_per_iteration_ == est.n_trees_per_iteration_
+    np.testing.assert_array_equal(loaded._baseline_raw, est._baseline_raw)
+    if kind == "clf":
+        np.testing.assert_allclose(
+            loaded.predict_proba(Xte), est.predict_proba(Xte)
+        )
+    np.testing.assert_array_equal(loaded.predict(Xte), est.predict(Xte))
+
+
+# ---------------------------------------------------------------------------
+# mesh identity: sharded fit == single-device fit, bit for bit
+# ---------------------------------------------------------------------------
+
+def _trees_identical(a, b):
+    for ta, tb in zip(a, b):
+        for f in ("feature", "left", "right", "n_node_samples"):
+            if not np.array_equal(getattr(ta, f), getattr(tb, f)):
+                return False
+        if not np.array_equal(ta.threshold, tb.threshold, equal_nan=True):
+            return False
+        # count AND impurity: every serialized per-node number must be
+        # mesh-invariant (the f64 host refit owns them all).
+        if not np.array_equal(ta.count, tb.count):
+            return False
+        if not np.array_equal(ta.impurity, tb.impurity):
+            return False
+    return True
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_sharded_fit_bit_identical(cancer_split, n_devices):
+    Xtr, _, ytr, _ = cancer_split
+    kw = dict(max_iter=10, max_depth=4, subsample=0.8, random_state=0)
+    one = GradientBoostingClassifier(n_devices=1, **kw).fit(Xtr, ytr)
+    many = GradientBoostingClassifier(n_devices=n_devices, **kw).fit(Xtr, ytr)
+    assert len(one.trees_) == len(many.trees_)
+    assert _trees_identical(one.trees_, many.trees_)
+
+
+def test_sharded_regressor_bit_identical(toy_regression):
+    X, y = toy_regression
+    kw = dict(max_iter=8, max_depth=3, random_state=0)
+    one = GradientBoostingRegressor(n_devices=1, **kw).fit(X, y)
+    many = GradientBoostingRegressor(n_devices=8, **kw).fit(X, y)
+    assert _trees_identical(one.trees_, many.trees_)
+    np.testing.assert_array_equal(one.predict(X), many.predict(X))
+
+
+def test_same_seed_same_ensemble(toy_regression):
+    X, y = toy_regression
+    kw = dict(max_iter=5, max_depth=3, subsample=0.6, random_state=7)
+    a = GradientBoostingRegressor(**kw).fit(X, y)
+    b = GradientBoostingRegressor(**kw).fit(X, y)
+    assert _trees_identical(a.trees_, b.trees_)
+
+
+# ---------------------------------------------------------------------------
+# early stopping / subsampling / regularization behavior
+# ---------------------------------------------------------------------------
+
+def test_early_stopping_stops_and_records():
+    X, y = load_iris(return_X_y=True)
+    clf = GradientBoostingClassifier(
+        max_iter=200, max_depth=3, early_stopping=True, n_iter_no_change=5,
+        random_state=0,
+    ).fit(X, y)
+    assert clf.n_iter_ < 200
+    assert clf.validation_score_ is not None
+    assert len(clf.validation_score_) == clf.n_iter_ + 1
+    assert len(clf.trees_) == clf.n_iter_ * clf.n_trees_per_iteration_
+
+
+def test_row_subsample_mask_properties():
+    from mpitree_tpu.ops.sampling import row_subsample_mask
+
+    m1 = row_subsample_mask(3, 0, 100_000, 0.7)
+    m2 = row_subsample_mask(3, 0, 100_000, 0.7)
+    m3 = row_subsample_mask(3, 1, 100_000, 0.7)
+    assert np.array_equal(m1, m2)  # pure function of (seed, round, row)
+    assert not np.array_equal(m1, m3)  # rounds draw differently
+    assert abs(m1.mean() - 0.7) < 0.01  # Bernoulli(fraction)
+    assert row_subsample_mask(0, 0, 10, 1.0).all()
+    with pytest.raises(ValueError):
+        row_subsample_mask(0, 0, 10, 0.0)
+
+
+def test_reg_lambda_shrinks_leaf_values(toy_regression):
+    X, y = toy_regression
+    kw = dict(max_iter=3, max_depth=3, random_state=0)
+    small = GradientBoostingRegressor(reg_lambda=0.0, **kw).fit(X, y)
+    big = GradientBoostingRegressor(reg_lambda=100.0, **kw).fit(X, y)
+    mag = lambda m: float(np.mean([np.abs(t.count[:, 0]).max()  # noqa: E731
+                                   for t in m.trees_]))
+    assert mag(big) < mag(small)
+
+
+def test_min_split_gain_prunes_growth(toy_regression):
+    X, y = toy_regression
+    kw = dict(max_iter=3, max_depth=5, random_state=0)
+    free = GradientBoostingRegressor(min_split_gain=0.0, **kw).fit(X, y)
+    gated = GradientBoostingRegressor(min_split_gain=1e9, **kw).fit(X, y)
+    assert sum(t.n_nodes for t in gated.trees_) < sum(
+        t.n_nodes for t in free.trees_
+    )
+    # an impossible gain threshold leaves every tree a stump
+    assert all(t.n_nodes == 1 for t in gated.trees_)
+
+
+def test_gbdt_rejects_fused_engine_and_feature_mesh(toy_regression):
+    from mpitree_tpu.core.builder import BuildConfig, build_tree
+    from mpitree_tpu.ops.binning import bin_dataset
+    from mpitree_tpu.parallel import mesh as mesh_lib
+
+    X, y = toy_regression
+    binned = bin_dataset(X[:64], max_bins=16)
+    g = np.ascontiguousarray(y[:64], np.float32)
+    h = np.ones(64, np.float32)
+    with pytest.raises(ValueError, match="fused"):
+        build_tree(
+            binned, g, config=BuildConfig(task="gbdt", engine="fused",
+                                          max_depth=2),
+            mesh=mesh_lib.resolve_mesh(n_devices=1), sample_weight=h,
+        )
+    with pytest.raises(ValueError, match="1-D data meshes"):
+        build_tree(
+            binned, g, config=BuildConfig(task="gbdt", max_depth=2),
+            mesh=mesh_lib.resolve_mesh(n_devices=(4, 2)), sample_weight=h,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Newton sweep vs a brute-force numpy oracle
+# ---------------------------------------------------------------------------
+
+def test_best_split_newton_matches_bruteforce():
+    import jax.numpy as jnp
+
+    from mpitree_tpu.ops.impurity import best_split_newton
+
+    rng = np.random.default_rng(1)
+    K, F, B = 3, 4, 8
+    cnt = rng.integers(0, 5, size=(K, F, B)).astype(np.float32)
+    g = rng.normal(size=(K, F, B)).astype(np.float32) * (cnt > 0)
+    h = (rng.uniform(0.1, 1.0, size=(K, F, B)).astype(np.float32)) * (cnt > 0)
+    hist = np.stack([cnt, g, h], axis=2)  # (K, F, 3, B)
+    cand = np.ones((F, B), bool)
+    cand[:, -1] = False
+    lam = 0.3
+    dec = best_split_newton(
+        jnp.asarray(hist), jnp.asarray(cand),
+        reg_lambda=jnp.float32(lam), min_child_weight=jnp.float32(0.0),
+        min_samples_leaf=jnp.float32(0.0),
+    )
+
+    def score(gs, hs):
+        return gs * gs / (hs + lam)
+
+    for k in range(K):
+        best = (np.inf, -1, -1)
+        for f in range(F):
+            cl = np.cumsum(cnt[k, f])
+            gl = np.cumsum(g[k, f])
+            hl = np.cumsum(h[k, f])
+            for b in range(B):
+                if not cand[f, b]:
+                    continue
+                cr = cl[-1] - cl[b]
+                if cl[b] <= 0 or cr <= 0:
+                    continue
+                cost = -0.5 * (
+                    score(gl[b], hl[b])
+                    + score(gl[-1] - gl[b], hl[-1] - hl[b])
+                )
+                if cost < best[0]:  # strict < = first-min, like the sweep
+                    best = (cost, f, b)
+        if best[1] >= 0:
+            assert int(dec.feature[k]) == best[1], k
+            assert int(dec.bin[k]) == best[2], k
+        else:
+            assert np.isinf(float(dec.cost[k]))
+
+
+def test_grad_hess_histogram_totals():
+    import jax.numpy as jnp
+
+    from mpitree_tpu.ops.histogram import grad_hess_histogram
+
+    rng = np.random.default_rng(2)
+    N, F, B, S = 200, 3, 6, 4
+    xb = rng.integers(0, B, size=(N, F)).astype(np.int32)
+    g = rng.normal(size=N).astype(np.float32)
+    h = rng.uniform(0.1, 1.0, size=N).astype(np.float32)
+    h[::5] = 0.0  # subsample-excluded rows
+    nid = rng.integers(-1, S, size=N).astype(np.int32)
+    hist = np.asarray(grad_hess_histogram(
+        jnp.asarray(xb), jnp.asarray(g), jnp.asarray(h), jnp.asarray(nid),
+        jnp.int32(0), n_slots=S, n_bins=B,
+    ))
+    assert hist.shape == (S, F, 3, B)
+    live = (nid >= 0) & (h > 0)
+    for s in range(S):
+        rows = live & (nid == s)
+        np.testing.assert_allclose(
+            hist[s, 0, 0].sum(), rows.sum(), rtol=1e-6
+        )
+        np.testing.assert_allclose(
+            hist[s, 0, 1].sum(), g[rows].sum(), rtol=1e-4, atol=1e-4
+        )
+        np.testing.assert_allclose(
+            hist[s, 0, 2].sum(), h[rows].sum(), rtol=1e-4, atol=1e-4
+        )
+
+
+def test_f32_fallback_env_still_fits(cancer_split, monkeypatch):
+    """MPITREE_TPU_GBDT_X64=0 (the f32 escape hatch) stays functional —
+    the accuracy story cannot silently depend on the f64 closure."""
+    monkeypatch.setenv("MPITREE_TPU_GBDT_X64", "0")
+    Xtr, Xte, ytr, yte = cancer_split
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        clf = GradientBoostingClassifier(
+            max_iter=10, max_depth=3, random_state=0
+        ).fit(Xtr, ytr)
+    assert float((clf.predict(Xte) == yte).mean()) > 0.9
+    assert os.environ["MPITREE_TPU_GBDT_X64"] == "0"

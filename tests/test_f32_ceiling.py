@@ -99,3 +99,55 @@ def test_forest_silent_below_ceiling():
             binned, y, config=cfg, mesh=mesh, weights=weights,
             cand_masks=masks, n_classes=2, integer_counts=True,
         )
+
+
+# ---------------------------------------------------------------------------
+# gradient/hessian accumulation (gbdt rounds) — the same 2**24 seam
+# ---------------------------------------------------------------------------
+
+def _tiny_gbdt(n=64, seed=4):
+    rng = np.random.default_rng(seed)
+    X = rng.integers(0, 4, size=(n, 3)).astype(np.float32)
+    g = rng.normal(size=n).astype(np.float32)
+    return X, g
+
+
+def test_gbdt_warns_above_hessian_ceiling(monkeypatch):
+    """With the f64 accumulation closure off (the TPU regime, forced here
+    via the escape hatch), total hessian weight past 2**24 must warn —
+    f32 (g, h) sums lose ulps to accumulation order there."""
+    monkeypatch.setenv("MPITREE_TPU_GBDT_X64", "0")
+    X, g = _tiny_gbdt()
+    binned = bin_dataset(X, binning="exact")
+    cfg = BuildConfig(task="gbdt", max_depth=3)
+    mesh = mesh_lib.resolve_mesh(n_devices=1)
+    # 64 rows x 2**19 hessian each = 2**25 total: over the ceiling
+    h = np.full(len(X), float(2**19), np.float32)
+    with pytest.warns(UserWarning, match="hessian"):
+        build_tree(binned, g, config=cfg, mesh=mesh, sample_weight=h)
+
+
+def test_gbdt_silent_below_hessian_ceiling(monkeypatch):
+    monkeypatch.setenv("MPITREE_TPU_GBDT_X64", "0")
+    X, g = _tiny_gbdt(seed=5)
+    binned = bin_dataset(X, binning="exact")
+    cfg = BuildConfig(task="gbdt", max_depth=3)
+    mesh = mesh_lib.resolve_mesh(n_devices=1)
+    h = np.full(len(X), 8.0, np.float32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        build_tree(binned, g, config=cfg, mesh=mesh, sample_weight=h)
+
+
+def test_gbdt_f64_closure_exempt_from_warning():
+    """On a CPU mesh the f64 accumulation closure is active by default
+    (resolve_gbdt_x64), so the same over-ceiling hessian total must NOT
+    warn — the sums are exact to f32 resolution regardless of order."""
+    X, g = _tiny_gbdt(seed=6)
+    binned = bin_dataset(X, binning="exact")
+    cfg = BuildConfig(task="gbdt", max_depth=3)
+    mesh = mesh_lib.resolve_mesh(n_devices=1)
+    h = np.full(len(X), float(2**19), np.float32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", UserWarning)
+        build_tree(binned, g, config=cfg, mesh=mesh, sample_weight=h)

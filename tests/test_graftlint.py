@@ -63,7 +63,7 @@ def test_fixture_findings_match_markers_exactly():
 def test_each_rule_family_has_fixture_coverage():
     findings, _ = _lint_fixtures()
     fired = {f.rule for f in findings}
-    assert {"GL01", "GL02", "GL03", "GL04"} <= fired
+    assert {"GL01", "GL02", "GL03", "GL04", "GL05"} <= fired
 
 
 def test_clean_fixture_is_silent():

@@ -5,9 +5,11 @@ from tools.graftlint.rules import (
     gl02_recompile,
     gl03_collectives,
     gl04_dtype,
+    gl05_donation,
 )
 
-ALL_RULES = (gl01_host_sync, gl02_recompile, gl03_collectives, gl04_dtype)
+ALL_RULES = (gl01_host_sync, gl02_recompile, gl03_collectives, gl04_dtype,
+             gl05_donation)
 
 RULE_DOCS = {
     r.rule_id: (r.__doc__ or "").strip().splitlines()[0] for r in ALL_RULES

@@ -1,0 +1,151 @@
+"""GL05 — donation hygiene: fused-state jits must donate (or opt out).
+
+A jitted program whose body drives a ``lax`` control-flow loop
+(``while_loop`` / ``scan`` / ``fori_loop`` / ``map``) is a *fused-state*
+program: the whole multi-step computation compiles into one executable, so
+XLA holds every un-donated input buffer alive for the program's full
+duration while also allocating the loop state — state-sized arrays
+double-buffer in HBM exactly where the working set is largest (the fused
+tree builder's row vectors at covtype scale). Such a jit must either pass
+``donate_argnums``/``donate_argnames`` for the inputs it consumes, or
+carry an explicit ``# graftlint: disable=GL05`` with a rationale where
+donation is genuinely wrong (inputs reused across calls, e.g. a binned
+matrix shared by every tree of a forest).
+
+Covered jit spellings:
+
+- ``jax.jit(f, ...)`` with a resolvable function first argument,
+- ``jax.jit(sharded, ...)`` where ``sharded = jax.shard_map(f, ...)`` was
+  bound earlier in the same (or an enclosing) function — the factory
+  idiom every ``parallel/collective.py`` kernel uses,
+- ``@jax.jit`` / ``@partial(jax.jit, ...)`` decorators.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint import astutil
+from tools.graftlint.engine import (
+    JIT_WRAPPERS,
+    PARTIAL,
+    SHARD_MAP,
+    Finding,
+)
+
+rule_id = "GL05"
+
+_LOOPS = frozenset({
+    "jax.lax.while_loop",
+    "jax.lax.scan",
+    "jax.lax.fori_loop",
+    "jax.lax.map",
+})
+_DONATE = ("donate_argnums", "donate_argnames")
+
+
+def _has_fused_loop(mod, fn) -> bool:
+    for node in astutil.own_nodes(fn.node):
+        if isinstance(node, ast.Call) and mod.canonical(node.func) in _LOOPS:
+            return True
+    return False
+
+
+def _donates(call: ast.Call) -> bool:
+    return any(
+        astutil.keyword_arg(call, k) is not None for k in _DONATE
+    )
+
+
+def _shard_map_bindings(project, mod) -> dict:
+    """(scope-qualname, varname) -> FuncInfo for ``v = jax.shard_map(f, ..)``.
+
+    ``jax.jit(sharded)`` hides its real target behind a local variable;
+    one assignment-tracking pass recovers it (single-assignment factory
+    code — the only form the package uses).
+    """
+    out: dict = {}
+
+    def visit(node, scope):
+        for child in ast.iter_child_nodes(node):
+            child_scope = scope
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = (
+                    f"{scope.qualname}.{child.name}" if scope else child.name
+                )
+                child_scope = mod.functions.get(qual, scope)
+            if (
+                isinstance(child, ast.Assign)
+                and len(child.targets) == 1
+                and isinstance(child.targets[0], ast.Name)
+                and isinstance(child.value, ast.Call)
+                and mod.canonical(child.value.func) in SHARD_MAP
+                and child.value.args
+            ):
+                target = project.resolve_function(
+                    mod, scope, child.value.args[0]
+                )
+                if target is not None:
+                    key = (scope.qualname if scope else None,
+                           child.targets[0].id)
+                    out[key] = target
+            visit(child, child_scope)
+
+    visit(mod.tree, None)
+    return out
+
+
+def _finding(mod, line, col, target, spelled) -> Finding:
+    return Finding(
+        rule_id, mod.path, line, col,
+        f"{spelled} of fused-state program '{target.qualname}' (drives a "
+        "lax loop) without donate_argnums/donate_argnames — un-donated "
+        "inputs double-buffer in HBM for the whole fused program; donate "
+        "consumed inputs or suppress with a rationale",
+    )
+
+
+def check(project):
+    for mod in project.modules:
+        bindings = _shard_map_bindings(project, mod)
+        for scope, call in project._walk_calls(mod):
+            if mod.canonical(call.func) not in JIT_WRAPPERS:
+                continue
+            if not call.args or _donates(call):
+                continue
+            target = project.resolve_function(mod, scope, call.args[0])
+            if target is None and isinstance(call.args[0], ast.Name):
+                # jit(sharded): look the variable up through the scope chain
+                cur = scope
+                while target is None:
+                    key = (cur.qualname if cur else None, call.args[0].id)
+                    target = bindings.get(key)
+                    if cur is None:
+                        break
+                    cur = cur.parent
+            if target is None or target.is_host:
+                continue
+            if _has_fused_loop(target.module, target):
+                yield _finding(
+                    mod, call.lineno, call.col_offset, target, "jax.jit",
+                )
+        # decorator spellings: @jax.jit / @partial(jax.jit, ...)
+        for fn in mod.functions.values():
+            if fn.is_host or not _has_fused_loop(mod, fn):
+                continue
+            for dec in fn.node.decorator_list:
+                name = mod.canonical(
+                    dec.func if isinstance(dec, ast.Call) else dec
+                )
+                is_partial_jit = (
+                    isinstance(dec, ast.Call) and name in PARTIAL
+                    and dec.args
+                    and mod.canonical(dec.args[0]) in JIT_WRAPPERS
+                )
+                if name not in JIT_WRAPPERS and not is_partial_jit:
+                    continue
+                if isinstance(dec, ast.Call) and _donates(dec):
+                    continue
+                yield _finding(
+                    mod, dec.lineno, dec.col_offset, fn, "@jit decorator",
+                )

@@ -44,6 +44,8 @@ PROBE_SRC = (
 # 4 configs x (cold + warm) fits.
 BUDGET = {
     "engine_levelwise": 1500,
+    # 20 rounds x 7 softmax trees of levelwise gbdt dispatch on the tunnel.
+    "boosting": 1500,
     # ~18 separately-compiled entries since round 5 (wide executors ×2
     # dtypes, level-op microbenches); the persistent compile cache makes
     # retries resume, but give the first attempt room to land whole.
@@ -174,9 +176,14 @@ def run_section(sec: str) -> bool:
 
 def main() -> int:
     p = argparse.ArgumentParser()
+    # Value-ranked queue (the --sections order IS the priority): the
+    # highest-evidence sections first — hist_tput (kernel go/no-go
+    # numbers), north_star (the headline), engine_fused (crossover),
+    # boosting (the new workload) — then the rest.
     p.add_argument("--sections",
-                   default="device_bin,north_star_fused,hist_tput,"
-                           "engine_levelwise,forest,refine_sweep")
+                   default="hist_tput,north_star,engine_fused,boosting,"
+                           "device_bin,north_star_fused,engine_levelwise,"
+                           "forest,refine_sweep")
     p.add_argument("--redo", default="",
                    help="comma-separated sections to re-measure even if "
                         "already captured (appended after the missing "
