@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-cpu lint lint-graft bench bench-tpu clean
+.PHONY: test test-cpu lint lint-graft bench bench-tpu report clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -32,6 +32,12 @@ bench:
 # tpu_last_known when its own live probe fails.
 bench-tpu:
 	$(PY) bench_tpu.py
+
+# Pretty-print the newest BENCH_TPU.jsonl line with each section's embedded
+# run-record digest (engine decision + reason, recompiles, psum bytes) —
+# the artifact-side view of every estimator's fit_report_.
+report:
+	$(PY) bench_tpu.py --report
 
 clean:
 	find . -type d \( -name "__pycache__" -o -name ".pytest_cache" \

@@ -131,6 +131,52 @@ def _device_platform() -> str:
     return jax.devices()[0].platform
 
 
+# The digest fields every fit-bearing section line must embed (the
+# artifact contract tests/test_bench_contract.py enforces). Values come
+# from mpitree_tpu.obs.digest(fit_report_) — ~10 scalars, so a line
+# carrying one per section stays inside the driver's tail window.
+RECORD_DIGEST_KEYS = (
+    "engine", "reason", "n_nodes", "depth", "levels", "compile_new",
+    "psum_bytes", "events", "wall_s",
+)
+
+
+def record_digest(report) -> dict | None:
+    """Compact attribution summary of a ``fit_report_`` (or None)."""
+    if not report:
+        return None
+    from mpitree_tpu.obs import digest
+
+    return digest(report)
+
+
+def format_record_digest(d: dict) -> str:
+    """One-line rendering of a stored digest dict — pure string work, no
+    mpitree import, so the watcher can log it even on a jax-less host."""
+    mb = (d.get("psum_bytes") or 0) / 1e6
+    line = (
+        f"engine={d.get('engine')} nodes={d.get('n_nodes')} "
+        f"depth={d.get('depth')} levels={d.get('levels')} "
+        f"compile_new={d.get('compile_new')} psum={mb:.1f}MB "
+        f"events={d.get('events')} wall={d.get('wall_s')}s"
+    )
+    if d.get("reason"):
+        line += f" reason={d['reason']!r}"
+    return line
+
+
+def section_record_digest(sec: str, path: str = OUT_PATH) -> str | None:
+    """Newest stored record digest for ``sec``, formatted for one log line
+    (the watcher's per-section attribution — TPU_WATCHER.log)."""
+    for rec in reversed(read_capture_lines(path)):
+        payload = rec.get(sec)
+        if isinstance(payload, dict) and isinstance(
+            payload.get("record"), dict
+        ):
+            return format_record_digest(payload["record"])
+    return None
+
+
 def _timed_fit(Xtr, ytr, *, backend, refine_depth, engine_env=None,
                warm=True):
     """One (optionally cold+warm) timed fit through the device path."""
@@ -156,6 +202,10 @@ def _timed_fit(Xtr, ytr, *, backend, refine_depth, engine_env=None,
     out["tree_depth"] = clf.tree_.max_depth
     out["tree_n_nodes"] = clf.tree_.n_nodes
     out["phases"] = clf.fit_stats_
+    # Embedded run-record digest: the section line carries its own
+    # attribution (engine decision + reason, recompiles, psum bytes), so
+    # the next slow-section mystery is explained by the artifact itself.
+    out["record"] = record_digest(clf.fit_report_)
     return out, clf
 
 
@@ -253,6 +303,7 @@ def worker_refine_sweep(npz_path: str) -> dict:
         rows.append({
             "refine_depth": rd, "warm_s": round(warm_s, 3),
             "test_acc": round(float((clf.predict(Xte) == yte).mean()), 4),
+            "record": record_digest(clf.fit_report_),
         })
     return {"sweep": rows}
 
@@ -589,6 +640,7 @@ def worker_boosting(npz_path: str) -> dict:
         "fit_s": round(fit_s, 3),
         "round_s": round(fit_s / max(clf.n_iter_, 1), 3),
         "test_acc": round(float((clf.predict(Xte) == yte).mean()), 4),
+        "record": record_digest(clf.fit_report_),
     }
     # The test_acc predict above already compiled/warmed the stacked
     # descent for this shape — time the next call directly.
@@ -741,11 +793,40 @@ def latest_line(path: str = OUT_PATH, *, full_only: bool = False) -> dict | None
     return merged
 
 
+def print_report(path: str = OUT_PATH) -> int:
+    """`make report`: pretty-print the newest capture line with its
+    embedded record digests — the artifact-side view of fit_report_."""
+    lines = read_capture_lines(path)
+    if not lines:
+        print(f"no capture lines in {path}")
+        return 1
+    rec = lines[-1]
+    head = {k: rec.get(k) for k in
+            ("ts", "git", "platform_probe", "dataset", "rows_cap", "depth",
+             "refine_depth", "ok") if k in rec}
+    print(json.dumps(head, indent=2))
+    for sec in WORKERS:
+        payload = rec.get(sec)
+        if not isinstance(payload, dict):
+            continue
+        keys = {k: v for k, v in payload.items()
+                if isinstance(v, (int, float, str)) and k != "record"}
+        print(f"\n[{sec}] " + json.dumps(keys))
+        if isinstance(payload.get("record"), dict):
+            print("  record | " + format_record_digest(payload["record"]))
+    if rec.get("errors"):
+        print("\nerrors: " + json.dumps(rec["errors"]))
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--rows", type=int, default=None,
                    help="cap training rows (default: full dataset)")
     p.add_argument("--out", default=OUT_PATH)
+    p.add_argument("--report", action="store_true",
+                   help="pretty-print the newest capture line (with its "
+                        "embedded record digests) and exit")
     p.add_argument("--sweep-refine", action="store_true")
     # Value-ranked: healthy tunnel windows are short, so the sections with
     # the most evidence per second come first (hist_tput -> north_star ->
@@ -757,6 +838,9 @@ def main() -> int:
                    help="jax platform for every section (auto = probe, "
                         "falling back to cpu when the accelerator hangs)")
     args = p.parse_args()
+
+    if args.report:
+        return print_report(args.out)
 
     sections = [s for s in args.sections.split(",") if s]
     if args.sweep_refine:

@@ -26,6 +26,8 @@ update free (no re-descent).
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 from sklearn.base import BaseEstimator, ClassifierMixin, RegressorMixin
 from sklearn.utils.validation import check_is_fitted
@@ -33,6 +35,7 @@ from sklearn.utils.validation import check_is_fitted
 from mpitree_tpu.boosting.losses import loss_for
 from mpitree_tpu.core.builder import BuildConfig, build_tree
 from mpitree_tpu.models.forest import _TreeList
+from mpitree_tpu.obs import BuildObserver, ReportMixin
 from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.predict import predict_mesh, stacked_leaf_ids
 from mpitree_tpu.ops.sampling import row_subsample_mask, seed_from
@@ -97,7 +100,7 @@ def _host_leaf_ids(tree, X: np.ndarray) -> np.ndarray:
     return node
 
 
-class _BaseGradientBoosting(BaseEstimator):
+class _BaseGradientBoosting(ReportMixin, BaseEstimator):
     """Shared fit/predict machinery; subclasses bind the task and loss."""
 
     def __init__(self, *, loss, learning_rate=0.1, max_iter=100, max_depth=6,
@@ -195,12 +198,17 @@ class _BaseGradientBoosting(BaseEstimator):
             X_val = y_val = sw_val = None
 
         n_tr = X_tr.shape[0]
-        binned = bin_dataset(
-            X_tr, max_bins=self.max_bins, binning=self.binning
-        )
+        # Structured run record (mpitree_tpu.obs): per-round rows always
+        # on (losses are already computed); phases/levels profile-gated.
+        obs = BuildObserver()
+        with obs.span("bin"):
+            binned = bin_dataset(
+                X_tr, max_bins=self.max_bins, binning=self.binning
+            )
         mesh = mesh_lib.resolve_mesh(
             backend=self.backend, n_devices=self.n_devices
         )
+        obs.set_mesh(mesh)
         cfg = BuildConfig(
             task="gbdt",
             max_depth=self.max_depth,
@@ -228,7 +236,9 @@ class _BaseGradientBoosting(BaseEstimator):
         best_val = -np.inf if val_scores is None else val_scores[0]
         stale = 0
         n_iter = 0
+        stopped_early = False
         for r in range(int(self.max_iter)):
+            t_round = time.perf_counter() if obs.enabled else 0.0
             mask = row_subsample_mask(seed, r, n_tr, float(self.subsample))
             g, h = loss.grad_hess(raw_tr, y_tr)  # (N, K) f64 each
             if sw_tr is not None:
@@ -242,7 +252,7 @@ class _BaseGradientBoosting(BaseEstimator):
                 h32 = np.ascontiguousarray(h[:, k], np.float32)
                 tree, leaf_ids = build_tree(
                     binned, g32, config=cfg, mesh=mesh, sample_weight=h32,
-                    return_leaf_ids=True,
+                    return_leaf_ids=True, timer=obs,
                 )
                 vals = _newton_refit(
                     tree, leaf_ids, g[:, k], h[:, k], float(self.reg_lambda)
@@ -265,8 +275,35 @@ class _BaseGradientBoosting(BaseEstimator):
                     stale = 0
                 else:
                     stale += 1
-                    if stale >= int(self.n_iter_no_change):
-                        break
+                    stopped_early = stale >= int(self.n_iter_no_change)
+            obs.round(
+                round=r,
+                trees=K,
+                subsample=float(self.subsample),
+                train_loss=float(-train_scores[-1]),
+                val_loss=(
+                    float(-val_scores[-1]) if val_scores is not None else None
+                ),
+                stale=(int(stale) if val_scores is not None else None),
+                early_stop=stopped_early,
+                seconds=(
+                    round(time.perf_counter() - t_round, 6)
+                    if obs.enabled else None
+                ),
+            )
+            if stopped_early:
+                break
+        obs.decision(
+            "early_stop", stopped_early,
+            reason=(
+                f"held-out loss stale for {stale} rounds "
+                f"(n_iter_no_change={self.n_iter_no_change})"
+                if stopped_early else
+                "ran the full max_iter budget" if val_scores is not None
+                else "early_stopping disabled"
+            ),
+            n_iter=int(n_iter),
+        )
         self.trees_ = _TreeList(trees)
         self.n_iter_ = n_iter
         self.train_score_ = np.asarray(train_scores)
@@ -274,6 +311,10 @@ class _BaseGradientBoosting(BaseEstimator):
             np.asarray(val_scores) if val_scores is not None else None
         )
         self._loss_obj = loss
+        self.fit_stats_ = obs.summary() if obs.enabled else None
+        # Always-on structured run record (mpitree_tpu.obs): per-round
+        # rows, engine decision, compile/collective accounting.
+        self.fit_report_ = obs.report(trees=self.trees_)
         return self
 
     # -- predict -----------------------------------------------------------

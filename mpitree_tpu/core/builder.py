@@ -28,11 +28,13 @@ from __future__ import annotations
 import dataclasses
 import math
 import os
+import time
 
 import jax
 import numpy as np
 
 from mpitree_tpu.core.tree_struct import TreeArrays
+from mpitree_tpu.obs import warn_event
 from mpitree_tpu.ops.binning import BinnedData
 from mpitree_tpu.parallel import collective, mesh as mesh_lib
 from mpitree_tpu.utils import importances as imp_utils
@@ -334,14 +336,14 @@ def exact_ties_fits(n_slots: int, n_features: int,
 
 
 def warn_exact_ties_gap(K: int, n_features: int,
-                        n_bins: int) -> None:
+                        n_bins: int, obs=None) -> None:
     """One visible warning when the f64 tie sweep is memory-gated off for
     the K-slot chunks: the device/host identity contract then only covers
     frontiers up to the widest tier that still fits — deep wide-chunk
-    ties rank in f32 (the pre-closure behavior)."""
-    import warnings
-
-    warnings.warn(
+    ties rank in f32 (the pre-closure behavior). ``obs``: an optional
+    PhaseTimer/BuildObserver that also receives the typed event."""
+    warn_event(
+        obs, "exact_ties_gap",
         f"exact-ties f64 cost sweep disabled for {K}-slot frontier chunks "
         f"(working set ~{K * n_features * n_bins * 64 >> 20} MB exceeds "
         "the 2 GB bound); ties on frontiers wider than the largest "
@@ -538,6 +540,7 @@ def build_tree(
     cfg = config
     timer = timer if timer is not None else PhaseTimer(enabled=False)
     debug = cfg.debug or debug_checks_enabled()
+    timer.set_mesh(mesh)
 
     platform = mesh.devices.flat[0].platform
     if cfg.task == "classification":
@@ -546,9 +549,8 @@ def build_tree(
             else float(np.sum(sample_weight))
         )
         if total_w >= 2**24:
-            import warnings
-
-            warnings.warn(
+            warn_event(
+                timer, "f32_ceiling",
                 "device class counts accumulate in float32: beyond 2**24 "
                 "total weight the raw-count predict_proba contract can lose "
                 "integer exactness (split selection is unaffected at the "
@@ -558,10 +560,17 @@ def build_tree(
     gbdt64 = cfg.task == "gbdt" and resolve_gbdt_x64(platform)
 
     # The env var only steers the default ("auto"); an explicit
-    # BuildConfig(engine=...) choice always wins.
+    # BuildConfig(engine=...) choice always wins. ``engine_reason`` is the
+    # attribution fit_report_ carries — every resolution branch states why.
     engine = cfg.engine
-    if engine == "auto":
-        engine = os.environ.get("MPITREE_TPU_ENGINE", "auto")
+    engine_reason = None
+    if engine != "auto":
+        engine_reason = f"explicit BuildConfig(engine={engine!r})"
+    else:
+        env_engine = os.environ.get("MPITREE_TPU_ENGINE", "auto")
+        if env_engine != "auto":
+            engine = env_engine
+            engine_reason = f"MPITREE_TPU_ENGINE={env_engine}"
     if engine not in ("auto", "fused", "levelwise"):
         raise ValueError(f"unknown build engine {engine!r}")
     if cfg.task == "gbdt":
@@ -580,6 +589,10 @@ def build_tree(
                 "task='gbdt' supports 1-D data meshes only"
             )
         engine = "levelwise"
+        engine_reason = (
+            "task='gbdt': Newton rounds run the levelwise engine only "
+            "(the boosting outer loop is host-sequential per round)"
+        )
     mono = mono_cst is not None and bool(np.any(np.asarray(mono_cst) != 0))
     if not mono:
         mono_cst = None
@@ -608,14 +621,17 @@ def build_tree(
                 "the fused engine (default) for a (data, feature) mesh"
             )
         if engine == "levelwise":
-            import warnings
-
-            warnings.warn(
+            warn_event(
+                timer, "engine_override_ignored",
                 "MPITREE_TPU_ENGINE=levelwise ignored on a (data, feature) "
                 "mesh; using the fused engine",
                 stacklevel=2,
             )
         engine = "fused"  # feature sharding exists only in the fused body
+        engine_reason = (
+            "(data, feature) mesh: only the fused engine shards the "
+            "histogram's feature dimension"
+        )
     task = cfg.task
     N, F = binned.x_binned.shape
     B = binned.n_bins
@@ -636,11 +652,26 @@ def build_tree(
         # engine_levelwise capture section re-derives the crossover when
         # the tunnel allows.
         engine = "fused"
+        engine_reason = (
+            "auto: one compiled program beats per-level dispatch on "
+            "tunneled transport (BENCH_TPU.jsonl r4: fused 17.5s warm vs "
+            "~38s projected levelwise at covtype depth 20)"
+        )
+    elif engine == "auto":
+        engine_reason = (
+            "auto + debug: the on-device determinism check runs only in "
+            "the levelwise engine"
+        )
+    timer.decision(
+        "engine", "fused" if engine == "fused" else "levelwise",
+        reason=engine_reason,
+        rows=int(N), features=int(F), bins=int(B), chunk_slots=int(K),
+        max_depth=cfg.max_depth, task=task, debug=bool(debug),
+    )
     if engine == "fused":
         if debug:
-            import warnings
-
-            warnings.warn(
+            warn_event(
+                timer, "fused_no_determinism_check",
                 "the fused engine does not run the on-device determinism "
                 "check; use engine='levelwise' (or engine='auto') with "
                 "debug mode",
@@ -711,9 +742,8 @@ def build_tree(
             else float(np.sum(sample_weight))
         )
         if total_h >= 2**24:
-            import warnings
-
-            warnings.warn(
+            warn_event(
+                timer, "f32_ceiling",
                 "gradient/hessian histograms accumulate in float32 on this "
                 "backend: beyond 2**24 total hessian weight the (g, h) "
                 "sums lose precision to accumulation order, and Newton "
@@ -723,7 +753,7 @@ def build_tree(
             )
     exact_ok = resolve_exact_ties(platform)
     if exact_ok and not exact_ties_fits(K, F, B):
-        warn_exact_ties_gap(K, F, B)
+        warn_exact_ties_gap(K, F, B, obs=timer)
     # Levelwise keeps only Pallas-eligible tiers: that is where the measured
     # win lives (the MXU kernel beat the scatter 3.3x at S=8), while XLA
     # tiers saved <3% warm and cost an extra ~20-40s tunnel compile each.
@@ -744,10 +774,13 @@ def build_tree(
 
     def split_fn_for(frontier: int):
         """Narrowest tier the frontier fits (Pallas), else the K-slot sweep
-        (wide-width sweeps ride the sorted window-packed matmul tier)."""
+        (wide-width sweeps ride the sorted window-packed matmul tier).
+        Returns ``(S, fn, new_lowering)`` — the compile-accounting flag is
+        True when this static configuration had not been traced before
+        (the cache-key registry, ``obs.CompileRegistry``)."""
         S = next((s for s in tiers if frontier <= s), K)
-        return S, collective.make_split_fn(
-            mesh, n_slots=S, n_bins=B, n_classes=C, task=task,
+        kw = dict(
+            n_slots=S, n_bins=B, n_classes=C, task=task,
             criterion=cfg.criterion, debug=debug, use_pallas=S in tiers,
             exact_ties=exact_ok and exact_ties_fits(S, F, B),
             wide_pallas=wide_pallas,
@@ -760,6 +793,11 @@ def build_tree(
             monotonic=mono,
             gbdt_x64=gbdt64,
         )
+        fn = collective.make_split_fn(mesh, **kw)
+        new = timer.compile_note(
+            "split_fn", (mesh,) + tuple(sorted(kw.items()))
+        )
+        return S, fn, new
 
     mcw32 = np.float32(cfg.min_child_weight)
 
@@ -783,13 +821,19 @@ def build_tree(
         return args
 
     update_fn = collective.make_update_fn(mesh, n_slots=U)
+    timer.compile_note("update_fn", (mesh, U))
     counts_fn = collective.make_counts_fn(
         mesh, n_slots=U, n_classes=C, task=task
     )
+    timer.compile_note("counts_fn", (mesh, U, C, task))
 
     frontier_lo, frontier_size, depth = 0, 1, 0
     while frontier_size > 0:
         terminal = cfg.max_depth is not None and depth == cfg.max_depth
+        t_level = time.perf_counter() if timer.enabled else 0.0
+        lvl_new = 0
+        lvl_hist_b = 0
+        lvl_psum_b = 0
 
         # Phase A: per-node statistics. Terminal levels (every node becomes a
         # leaf) need only counts — an O(N) scatter over wide U-slot tables —
@@ -807,10 +851,17 @@ def build_tree(
                 counts_all = np.concatenate(
                     [jax.device_get(h)[:take] for take, h in futures]
                 )
+            lvl_psum_b = len(futures) * collective.counts_psum_bytes(
+                n_slots=U, n_channels=C
+            )
+            timer.collective(
+                "counts_psum", calls=len(futures), nbytes=lvl_psum_b
+            )
             dec = {"counts": counts_all}
         else:
             with timer.phase("split"):
-                S_lvl, split_fn = split_fn_for(frontier_size)
+                S_lvl, split_fn, new_fn = split_fn_for(frontier_size)
+                lvl_new = int(new_fn)
                 hi = frontier_lo + frontier_size
                 chunks = [
                     (lo, min(S_lvl, hi - lo))
@@ -825,11 +876,16 @@ def build_tree(
                 if debug:
                     errs = [float(jax.device_get(e)) for _, (_, e) in futures]
                     if any(e != 0.0 for e in errs):
+                        timer.event(
+                            "determinism_check_failed",
+                            f"split decisions diverged at depth={depth}",
+                        )
                         raise RuntimeError(
                             "determinism check failed: split decisions diverged "
                             f"across mesh devices (level depth={depth}, "
                             f"errs={errs})"
                         )
+                    timer.counter("determinism_checks_passed", len(errs))
                     futures = [(take, d) for take, (d, _) in futures]
                 # One packed buffer per chunk = one host transfer, not one
                 # per decision field (8x fewer round trips on the tunnel).
@@ -838,6 +894,21 @@ def build_tree(
                     for take, d in futures
                 ]
             dec = {k: np.concatenate([c[k] for c in decs]) for k in decs[0]}
+            per_chunk = collective.split_psum_bytes(
+                n_slots=S_lvl, n_features=F, n_bins=B, n_channels=C,
+                itemsize=8 if gbdt64 else 4,
+            )
+            lvl_hist_b = len(chunks) * per_chunk
+            lvl_psum_b = lvl_hist_b
+            timer.collective(
+                "split_hist_psum", calls=len(chunks), nbytes=lvl_hist_b
+            )
+            if task == "regression":
+                yb = len(chunks) * 2 * S_lvl * 4
+                lvl_psum_b += yb
+                timer.collective(
+                    "y_range_pminmax", calls=len(chunks), nbytes=yb
+                )
 
         # Phase B: stopping rules + node records (host, vectorized).
         ids = frontier_lo + np.arange(frontier_size)
@@ -962,6 +1033,15 @@ def build_tree(
                         is_split, feat_t, bin_t, left_t, right_t,
                     )
 
+        timer.level(
+            level=depth, frontier=frontier_size, splits=len(split_ids),
+            hist_bytes=lvl_hist_b, psum_bytes=lvl_psum_b,
+            seconds=(
+                round(time.perf_counter() - t_level, 6)
+                if timer.enabled else None
+            ),
+            new_lowerings=lvl_new,
+        )
         frontier_lo = frontier_lo + frontier_size
         frontier_size = 2 * len(split_ids)
         depth += 1

@@ -50,6 +50,8 @@ from mpitree_tpu.core.builder import (
     valid_tiers as builder_valid_tiers,
 )
 from mpitree_tpu.core.tree_struct import TreeArrays
+from mpitree_tpu.obs import accounting as obs_acct
+from mpitree_tpu.obs import warn_event
 from mpitree_tpu.ops import histogram as hist_ops
 from mpitree_tpu.ops import impurity as imp_ops
 from mpitree_tpu.ops import pallas_hist
@@ -167,19 +169,12 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
     # unreachable cond branches. Compiling them anyway costs tens of
     # seconds through the remote-compile tunnel (the K-slot histogram +
     # gain sweep is the largest executable in the program); crown programs
-    # (the hybrid's device half) drop them here.
-    max_interior = (
-        2 ** max(int(max_depth) - 1, 0) if max_depth >= 0 else None
-    )
-    if max_interior is not None and tiers:
-        kept, prev = [], 0
-        for t in tiers:
-            if prev < max_interior:
-                kept.append(t)
-            prev = t
-        tiers = tuple(kept)
-    interior_big_reachable = not (
-        max_interior is not None and tiers and max_interior <= max(tiers)
+    # (the hybrid's device half) drop them here. The trim lives in
+    # obs/accounting.py — the post-hoc collective accounting must replay
+    # the identical tier routing, so there is exactly one copy.
+    tiers = obs_acct.effective_tiers(tiers, max_depth)
+    interior_big_reachable = obs_acct.interior_big_reachable(
+        tiers, max_depth
     )
     hist_vma = tuple(a for a in (psum_axis, feature_axis) if a is not None)
     sampling = sample_k is not None or random_split
@@ -792,22 +787,28 @@ def build_tree_fused(
     )
     exact_ties = resolve_exact_ties(mesh.devices.flat[0].platform)
     if exact_ties and not exact_ties_fits(K, F, B):
-        warn_exact_ties_gap(K, F, B)
+        warn_exact_ties_gap(K, F, B, obs=timer)
     wide_pallas = resolve_wide_pallas(
         mesh.devices.flat[0].platform, use_wide=use_wide,
         n_channels=C, n_bins=B,
     )
 
-    fn = _make_fused_fn(
-        mesh, n_slots=K, n_bins=B, n_classes=C, task=task,
+    timer.set_mesh(mesh)
+    md = -1 if cfg.max_depth is None else int(cfg.max_depth)
+    fn_kw = dict(
+        n_slots=K, n_bins=B, n_classes=C, task=task,
         criterion=cfg.criterion, max_nodes=M,
-        max_depth=-1 if cfg.max_depth is None else int(cfg.max_depth),
+        max_depth=md,
         min_samples_split=int(cfg.min_samples_split),
         tiers=tuple(cfg.frontier_tiers),
         use_pallas=use_pallas, use_wide=use_wide, wide_bf16=wide_bf16,
         wide_pallas=wide_pallas, exact_ties=exact_ties,
         sample_k=sample_k, random_split=random_split,
         monotonic=monotonic,
+    )
+    fn = _make_fused_fn(mesh, **fn_kw)
+    timer.compile_note(
+        "fused_fn", (mesh,) + tuple(sorted(fn_kw.items())), cache_size=32
     )
 
     with timer.phase("shard"):
@@ -832,6 +833,24 @@ def build_tree_fused(
             binned, task, cfg.criterion, int(n_nodes), feat, bins, counts,
             nvec, left, parent, integer_counts=integer_weights(sample_weight),
         )
+
+    # Post-hoc per-level rows + collective accounting: replayed from the
+    # finished tree's depth histogram on host (static shapes — zero device
+    # cost; see obs/accounting.py). Level rows are profile-gated inside
+    # timer.level; collective byte totals are always-on.
+    timer.counter("fused_builds")
+    eff_tiers = obs_acct.effective_tiers(
+        builder_valid_tiers(tuple(cfg.frontier_tiers), K), md
+    )
+    rows, coll = obs_acct.fused_level_rows(
+        tree.depth, n_slots=K, tiers=eff_tiers, n_features=F, n_bins=B,
+        n_channels=C, counts_channels=C, max_depth=md, task=task,
+        feature_shards=mesh_lib.feature_shards(mesh), n_rows=N,
+    )
+    for site, v in coll.items():
+        timer.collective(site, calls=v["calls"], nbytes=v["bytes"])
+    for r in rows:
+        timer.level(**r)
 
     from mpitree_tpu.core.builder import fetch_row_nodes
 
@@ -971,26 +990,27 @@ def build_forest_fused(
     )
     exact_ties = resolve_exact_ties(mesh.devices.flat[0].platform)
     if exact_ties and not exact_ties_fits(K, F, B):
-        warn_exact_ties_gap(K, F, B)
+        warn_exact_ties_gap(K, F, B, obs=timer)
     wide_pallas = resolve_wide_pallas(
         mesh.devices.flat[0].platform, use_wide=use_wide,
         n_channels=C, n_bins=B,
     )
 
     if task == "classification" and float(weights.sum(axis=1).max()) >= 2**24:
-        import warnings
-
-        warnings.warn(
+        warn_event(
+            timer, "f32_ceiling",
             "device class counts accumulate in float32: beyond 2**24 "
             "per-tree total weight the raw-count contract can lose integer "
             "exactness",
             stacklevel=2,
         )
 
-    fn = _make_forest_fn(
-        tmesh, n_slots=K, n_bins=B, n_classes=C, task=task,
+    timer.set_mesh(tmesh)
+    md = -1 if cfg.max_depth is None else int(cfg.max_depth)
+    fn_kw = dict(
+        n_slots=K, n_bins=B, n_classes=C, task=task,
         criterion=cfg.criterion, max_nodes=M,
-        max_depth=-1 if cfg.max_depth is None else int(cfg.max_depth),
+        max_depth=md,
         min_samples_split=int(cfg.min_samples_split),
         tiers=tuple(cfg.frontier_tiers),
         use_pallas=use_pallas, use_wide=use_wide, wide_bf16=wide_bf16,
@@ -998,6 +1018,10 @@ def build_forest_fused(
         data_sharded=data_sharded,
         sample_k=sample_k, random_split=random_split,
         monotonic=mono_cst is not None and bool(np.any(np.asarray(mono_cst))),
+    )
+    fn = _make_forest_fn(tmesh, **fn_kw)
+    timer.compile_note(
+        "forest_fn", (tmesh,) + tuple(sorted(fn_kw.items())), cache_size=32
     )
 
     ws = weights.astype(np.float32)
@@ -1076,6 +1100,24 @@ def build_forest_fused(
                     weights[t].astype(np.float64), refit_targets,
                 )
             trees.append(tree)
+    timer.counter("forest_fused_builds")
+    timer.counter("trees_built", T)
+    if data_sharded:
+        # Row shards psum per tree group exactly as the single-tree build
+        # does — replay each tree's routing from its depth histogram
+        # (obs/accounting.py). Non-data-sharded forests run with
+        # psum_axis=None (data replicated per device): no collectives.
+        eff_tiers = obs_acct.effective_tiers(
+            builder_valid_tiers(tuple(cfg.frontier_tiers), K), md
+        )
+        for tree in trees:
+            _, coll = obs_acct.fused_level_rows(
+                tree.depth, n_slots=K, tiers=eff_tiers, n_features=F,
+                n_bins=B, n_channels=C, counts_channels=C, max_depth=md,
+                task=task,
+            )
+            for site, v in coll.items():
+                timer.collective(site, calls=v["calls"], nbytes=v["bytes"])
     if return_leaf_ids:
         return trees, np.asarray(nid_out)[:T, :N]
     return trees

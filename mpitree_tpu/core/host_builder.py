@@ -26,6 +26,8 @@ tests (tests/test_engine_identity.py) pin this seam across many seeds.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from mpitree_tpu.core.tree_struct import TreeArrays
@@ -33,6 +35,7 @@ from mpitree_tpu.utils.importances import (
     class_node_impurity,
     moment_node_impurity,
 )
+from mpitree_tpu.utils.profiling import PhaseTimer
 
 
 def _child_impurity_class(hist, criterion: str):
@@ -247,8 +250,14 @@ def build_tree_host(
     return_leaf_ids: bool = False,
     feature_sampler=None,
     mono_cst: np.ndarray | None = None,
+    timer: PhaseTimer | None = None,
 ) -> TreeArrays:
     """Grow one tree on the host; same contract as ``builder.build_tree``.
+
+    ``timer``: optional PhaseTimer/BuildObserver — per-level record rows
+    (level, frontier, splits, histogram bytes, wall seconds) under
+    ``MPITREE_TPU_PROFILE=1``, always-on counters otherwise
+    (``mpitree_tpu.obs``). No collectives: this tier is single-host numpy.
 
     ``feature_sampler``: per-node random feature subsets (ops/sampling.py) —
     identical node keys and masks to the device levelwise build.
@@ -263,6 +272,8 @@ def build_tree_host(
 
     cfg = config
     task = cfg.task
+    timer = timer if timer is not None else PhaseTimer(enabled=False)
+    timer.counter("host_builds")
     xb = binned.x_binned
     N, F = xb.shape
     B = binned.n_bins
@@ -312,8 +323,20 @@ def build_tree_host(
             split_ids, tree.left[split_ids], tree.right[split_ids], tree.n
         )
 
+    def note_level(d, S, splits, hist_nbytes, t0):
+        timer.level(
+            level=d, frontier=int(S), splits=int(splits),
+            hist_bytes=int(hist_nbytes), psum_bytes=0,
+            seconds=(
+                round(time.perf_counter() - t0, 6)
+                if timer.enabled else None
+            ),
+            new_lowerings=0,
+        )
+
     while frontier_size > 0:
         S = frontier_size
+        t_level = time.perf_counter() if timer.enabled else 0.0
         terminal = cfg.max_depth is not None and depth == cfg.max_depth
         slot = nid - frontier_lo  # all rows are in the frontier or parked (<0)
         live = slot >= 0
@@ -366,6 +389,7 @@ def build_tree_host(
                     nat["v_left"][sel], nat["v_right"][sel],
                     cst32[feat_best[sel]], tree.n,
                 )
+            note_level(depth - 1, S, (~stop).sum(), 0, t_level)
             continue
 
         # Per-node statistics (and, unless terminal, full split histograms).
@@ -394,6 +418,7 @@ def build_tree_host(
             pure = ~(ymax > ymin)
 
         ids = frontier_lo + np.arange(S)
+        lvl_hist = 0
         if terminal:
             stop = np.ones(S, bool)
             feat_best = bin_best = None
@@ -422,6 +447,7 @@ def build_tree_host(
                         np.broadcast_to(payload[:, None], xbl.shape).ravel(),
                     )
                 cost, n_l, n_r = _child_cost_mse(hist)
+            lvl_hist = hist.nbytes
 
             valid = cand[None, :, :] & (n_l > 0) & (n_r > 0)
             if cfg.min_child_weight > 0.0:
@@ -502,6 +528,7 @@ def build_tree_host(
             slot, live, S, frontier_lo, depth,
         )
         thread_keys(ids, stop)
+        note_level(depth - 1, S, (~stop).sum(), lvl_hist, t_level)
         if mono and not terminal and (~stop).any():
             # Children of a constrained split are pinned by the winning
             # candidate's mid value (utils/monotonic.py BoundsStore).

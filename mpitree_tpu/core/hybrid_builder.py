@@ -375,13 +375,16 @@ def apply_refine(
     import dataclasses
 
     with timer.phase("refine"):
-        return refine_deep_subtrees(
+        out = refine_deep_subtrees(
             tree, X, y_build, leaf_ids,
             config=dataclasses.replace(cfg, max_depth=max_depth),
             refine_depth=rd, n_classes=n_classes,
             sample_weight=sample_weight, refit_targets=refit_targets,
             feature_mask=feature_mask, feature_sampler=feature_sampler,
+            obs=timer,
         )
+    timer.counter("refine_nodes_added", int(out.n_nodes - tree.n_nodes))
+    return out
 
 
 # graftlint: host-fn — hybrid orchestration: crown/frontier handoff is
@@ -399,8 +402,12 @@ def refine_deep_subtrees(
     refit_targets: np.ndarray | None = None,
     feature_mask: np.ndarray | None = None,
     feature_sampler=None,
+    obs=None,
 ) -> TreeArrays:
     """Host-finish every still-splittable leaf of the crown.
+
+    ``obs``: optional PhaseTimer/BuildObserver (``mpitree_tpu.obs``) —
+    receives the tail-engine decision and candidate counters.
 
     ``tree`` is the device-built crown (grown with
     ``max_depth=refine_depth``); ``leaf_ids`` the training rows' leaf
@@ -443,15 +450,30 @@ def refine_deep_subtrees(
     if not keep.any():
         return tree
     candidates, starts, ends = candidates[keep], starts[keep], ends[keep]
+    if obs is not None:
+        obs.counter("refine_candidates", len(candidates))
 
     sampling = feature_sampler is not None and feature_sampler.active
+    batched = native.lib() is not None and not (
+        feature_sampler is not None and feature_sampler.random_split
+    )
+    if obs is not None:
+        obs.decision(
+            "refine_tail",
+            "batched-native" if batched else "per-subtree",
+            reason=(
+                "C++ kernel available: all subtrees grow in one multi-root "
+                "frontier" if batched else
+                "no native kernel (or splitter='random'): per-subtree "
+                "host builds"
+            ),
+            refine_depth=int(refine_depth),
+        )
     root_keys = (
         feature_sampler.keys_for_tree(tree)[candidates] if sampling else None
     )
 
-    if native.lib() is not None and not (
-        feature_sampler is not None and feature_sampler.random_split
-    ):
+    if batched:
         rows_per = [order[s:e] for s, e in zip(starts, ends)]
         return _refine_batched(
             tree, X, y_enc, candidates, rows_per,

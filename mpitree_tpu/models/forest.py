@@ -40,6 +40,13 @@ from mpitree_tpu.core.builder import (
 )
 from mpitree_tpu.core.fused_builder import build_forest_fused
 from mpitree_tpu.core.host_builder import build_tree_host
+from mpitree_tpu.obs import (
+    BuildObserver,
+    ReportMixin,
+    note_build_path,
+    note_refine,
+    warn_event,
+)
 from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.sampling import NodeFeatureSampler, n_subspace_features
 from mpitree_tpu.parallel import mesh as mesh_lib
@@ -64,7 +71,7 @@ class _TreeList(list):
     __slots__ = ("__weakref__",)
 
 
-class _BaseForest(BaseEstimator):
+class _BaseForest(ReportMixin, BaseEstimator):
     def __init__(self, *, n_estimators=10, max_depth=None, min_samples_split=2,
                  max_bins=256, binning="auto", bootstrap=True,
                  max_features=None, max_features_mode="node",
@@ -107,17 +114,19 @@ class _BaseForest(BaseEstimator):
         return masks
 
     @staticmethod
-    def _warn_partial_oob(seen) -> None:
+    def _warn_partial_oob(seen, obs=None) -> None:
         if not seen.all():
-            warnings.warn(
+            warn_event(
+                obs, "oob_partial",
                 "Some inputs do not have OOB scores (too few trees); their "
                 "OOB estimates are NaN",
                 stacklevel=3,
             )
 
     @staticmethod
-    def _warn_no_oob() -> float:
-        warnings.warn(
+    def _warn_no_oob(obs=None) -> float:
+        warn_event(
+            obs, "oob_empty",
             "no out-of-bag rows (too few trees); oob_score_ is nan",
             stacklevel=3,
         )
@@ -174,6 +183,10 @@ class _BaseForest(BaseEstimator):
         n = X.shape[0]
         if self.oob_score and not self.bootstrap:
             raise ValueError("oob_score=True requires bootstrap=True")
+        # The ensemble's structured run record (mpitree_tpu.obs): one
+        # observer accumulates phases/counters/collectives across every
+        # member build; fit() finalizes it into fit_report_ (post-OOB).
+        obs = self._fit_obs = BuildObserver()
         prev_trees = self._warm_start_trees()
         sample_weight = validate_sample_weight(sample_weight, n)
         rng = np.random.default_rng(self.random_state)
@@ -181,11 +194,20 @@ class _BaseForest(BaseEstimator):
         # a forest bins ONCE for T tree builds, so the device-binning win is
         # amortized away, while the host copy feeds every per-tree failover
         # without an ensure-host seam through the tree_b replaces.
-        binned = bin_dataset(X, max_bins=self.max_bins, binning=self.binning)
+        with obs.span("bin"):
+            binned = bin_dataset(
+                X, max_bins=self.max_bins, binning=self.binning
+            )
         use_host = prefer_host_path(*X.shape, self.n_devices, self.backend)
+        note_build_path(
+            obs, host=use_host, backend=self.backend,
+            n_rows=n, n_features=X.shape[1],
+        )
         mesh = None if use_host else mesh_lib.resolve_mesh(
             backend=self.backend, n_devices=self.n_devices
         )
+        if mesh is not None:
+            obs.set_mesh(mesh)
         rd, refine, crown_depth = resolve_refine(
             self.max_depth, self.refine_depth,
             n_rows=n, quantized=binned.quantized,
@@ -199,6 +221,11 @@ class _BaseForest(BaseEstimator):
             # Single-engine full-depth builds under constraints (same
             # stance as the tree estimators: no hybrid tail).
             rd, refine, crown_depth = None, False, self.max_depth
+        note_refine(
+            obs, refine=refine, rd=rd, crown_depth=crown_depth,
+            refine_depth_param=self.refine_depth,
+            constrained=mono is not None,
+        )
         cfg = BuildConfig(
             task=task, criterion=criterion, max_depth=crown_depth,
             min_samples_split=self.min_samples_split,
@@ -299,12 +326,11 @@ class _BaseForest(BaseEstimator):
             checkpoint-safe)."""
             if refine:
                 from mpitree_tpu.core.hybrid_builder import apply_refine
-                from mpitree_tpu.utils.profiling import PhaseTimer
 
                 tree = apply_refine(
                     tree, ids, X, y_enc, cfg=tree_cfg(tree_w[i]),
                     max_depth=self.max_depth, rd=rd,
-                    timer=PhaseTimer(enabled=False), n_classes=n_classes,
+                    timer=obs, n_classes=n_classes,
                     sample_weight=tree_w[i], refit_targets=refit_targets,
                     feature_mask=tree_mask[i],
                     feature_sampler=tree_sampler[i],
@@ -326,7 +352,7 @@ class _BaseForest(BaseEstimator):
                 tree_b[i], y_enc, config=tree_cfg(tree_w[i]),
                 n_classes=n_classes, sample_weight=tree_w[i],
                 refit_targets=refit_targets, return_leaf_ids=refine,
-                feature_sampler=tree_sampler[i], mono_cst=mono,
+                feature_sampler=tree_sampler[i], mono_cst=mono, timer=obs,
             )
             return res if refine else (res, None)
 
@@ -344,11 +370,19 @@ class _BaseForest(BaseEstimator):
                     n_classes=n_classes, sample_weight=tree_w[i],
                     refit_targets=refit_targets, return_leaf_ids=refine,
                     feature_sampler=tree_sampler[i], mono_cst=mono,
+                    timer=obs,
                 )
                 return res if refine else (res, None)
 
+            def host():
+                obs.event(
+                    "device_failover",
+                    f"forest tree {i} device build failed; host tier",
+                )
+                return host_raw(i)
+
             t, ids = device_failover(
-                dev, lambda: host_raw(i),
+                dev, host,
                 what=f"forest tree {i} device build",
             )
             return finish(i, t, ids)
@@ -384,9 +418,14 @@ class _BaseForest(BaseEstimator):
                     sample_k=k if node_sampling else None,
                     random_split=rand_split,
                     mono_cst=mono,
+                    timer=obs,
                 )
 
             def host():
+                obs.event(
+                    "device_failover",
+                    "forest group device build failed; host tier",
+                )
                 out = [host_raw(i) for i in idxs]
                 if refine:
                     return [o[0] for o in out], [o[1] for o in out]
@@ -415,7 +454,8 @@ class _BaseForest(BaseEstimator):
                 # (fresh entropy) or a stateful Generator the re-run's
                 # draws differ, and resuming would silently mix two
                 # forests (and mispair OOB masks with trees).
-                warnings.warn(
+                warn_event(
+                    obs, "checkpoint_disabled",
                     "forest checkpointing requires a fixed integer "
                     "random_state so a resumed fit replays the same "
                     "bootstrap/feature draws; checkpoint disabled",
@@ -434,6 +474,19 @@ class _BaseForest(BaseEstimator):
                 trees = list(ck.trees[:start])
 
         batched = not (use_host or self._per_tree_device_builds())
+        obs.decision(
+            "ensemble_path",
+            ("host" if use_host
+             else "batched-fused" if batched else "per-tree-device"),
+            reason=(
+                obs.record.decisions["build_path"]["reason"] if use_host
+                else "trees batch into one tree-sharded fused program per "
+                     "group" if batched
+                else "MPITREE_TPU_ENGINE=levelwise or debug mode: per-tree "
+                     "builds keep the levelwise instrumentation"
+            ),
+            n_estimators=int(self.n_estimators),
+        )
         remaining = list(range(start, self.n_estimators))
         if batched:
             if ck is not None and remaining:
@@ -598,12 +651,12 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
                 )
                 seen |= oob
             if not seen.any():
-                self.oob_score_ = self._warn_no_oob()
+                self.oob_score_ = self._warn_no_oob(self._fit_obs)
                 self.oob_decision_function_ = np.full(
                     (len(X), len(classes)), np.nan
                 )
             else:
-                self._warn_partial_oob(seen)
+                self._warn_partial_oob(seen, self._fit_obs)
                 df = votes / np.maximum(
                     votes.sum(axis=1, keepdims=True), 1e-300
                 )
@@ -612,6 +665,12 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
                 self.oob_score_ = float(
                     (votes[seen].argmax(axis=1) == y_enc[seen]).mean()
                 )
+        obs = self._fit_obs
+        del self._fit_obs
+        self.fit_stats_ = obs.summary() if obs.enabled else None
+        # Ensemble run record: aggregates per-tree child summaries plus the
+        # shared phases/counters/collectives (mpitree_tpu.obs).
+        self.fit_report_ = obs.report(trees=self.trees_)
         return self
 
     def predict_proba(self, X):
@@ -704,16 +763,20 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
                 cnt[oob] += 1
             seen = cnt > 0
             if not seen.any():
-                self.oob_score_ = self._warn_no_oob()
+                self.oob_score_ = self._warn_no_oob(self._fit_obs)
                 self.oob_prediction_ = np.full(len(X), np.nan)
             else:
-                self._warn_partial_oob(seen)
+                self._warn_partial_oob(seen, self._fit_obs)
                 self.oob_prediction_ = np.where(seen, pred / np.maximum(cnt, 1), np.nan)
                 resid = y64[seen] - self.oob_prediction_[seen]
                 tot = y64[seen] - y64[seen].mean()
                 self.oob_score_ = float(
                     1.0 - (resid @ resid) / max(tot @ tot, 1e-300)
                 )
+        obs = self._fit_obs
+        del self._fit_obs
+        self.fit_stats_ = obs.summary() if obs.enabled else None
+        self.fit_report_ = obs.report(trees=self.trees_)
         return self
 
     def predict(self, X):

@@ -22,6 +22,12 @@ from sklearn.utils.validation import check_is_fitted
 
 from mpitree_tpu.core.builder import BuildConfig, build_tree, prefer_host_path
 from mpitree_tpu.core.host_builder import build_tree_host
+from mpitree_tpu.obs import (
+    BuildObserver,
+    ReportMixin,
+    note_build_path,
+    note_refine,
+)
 from mpitree_tpu.ops.binning import bin_for_engine, ensure_host_binned
 from mpitree_tpu.ops.predict import (
     device_tree_arrays,
@@ -32,7 +38,6 @@ from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.utils.elastic import device_failover
 from mpitree_tpu.utils.export import export_tree_text
 from mpitree_tpu.utils.importances import feature_importances
-from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
 from mpitree_tpu.utils.validation import (
     feature_names_of,
     min_child_weight,
@@ -45,7 +50,7 @@ from mpitree_tpu.utils.validation import (
 )
 
 
-class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
+class DecisionTreeRegressor(RegressorMixin, ReportMixin, BaseEstimator):
     """TPU-native regression tree (squared-error criterion).
 
     Parameters mirror :class:`DecisionTreeClassifier`; ``criterion`` accepts
@@ -97,8 +102,12 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
             self.monotonic_cst, X.shape[1], task="regression"
         )
 
-        timer = PhaseTimer(enabled=profiling_enabled())
+        timer = obs = BuildObserver()
         host = prefer_host_path(*X.shape, self.n_devices, self.backend)
+        note_build_path(
+            obs, host=host, backend=self.backend,
+            n_rows=X.shape[0], n_features=X.shape[1],
+        )
         with timer.phase("bin"):
             binned = bin_for_engine(
                 X, max_bins=self.max_bins, binning=self.binning,
@@ -114,6 +123,11 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
             # tail would need crown bounds threaded across the graft seam;
             # constraint semantics take precedence over tail perf here.
             rd, refine, crown_depth = None, False, self.max_depth
+        note_refine(
+            obs, refine=refine, rd=rd, crown_depth=crown_depth,
+            refine_depth_param=self.refine_depth,
+            constrained=mono is not None,
+        )
         cfg = BuildConfig(
             task="regression",
             criterion="mse",
@@ -139,9 +153,13 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
                 res = build_tree_host(
                     binned, y_c, config=cfg, sample_weight=sw,
                     refit_targets=y64, return_leaf_ids=refine,
-                    feature_sampler=sampler, mono_cst=mono,
+                    feature_sampler=sampler, mono_cst=mono, timer=timer,
                 )
                 self.tree_, leaf_ids = res if refine else (res, None)
+            obs.decision(
+                "engine", "host",
+                reason=obs.record.decisions["build_path"]["reason"],
+            )
         else:
             mesh = mesh_lib.resolve_mesh(
                 backend=self.backend, n_devices=self.n_devices
@@ -162,6 +180,10 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
                 # identical tree — a lost accelerator costs wall-clock only.
                 # A device-binned matrix cannot be pulled back from a dead
                 # accelerator: re-bin on host (bit-identical by contract).
+                obs.event(
+                    "device_failover",
+                    "device build failed; rebuilding on the host tier",
+                )
                 binned_h = ensure_host_binned(
                     binned, X, max_bins=self.max_bins, binning=self.binning
                 )
@@ -169,7 +191,7 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
                     res = build_tree_host(
                         binned_h, y_c, config=cfg, sample_weight=sw,
                         refit_targets=y64, return_leaf_ids=refine,
-                        feature_sampler=sampler, mono_cst=mono,
+                        feature_sampler=sampler, mono_cst=mono, timer=timer,
                     )
                     return res if refine else (res, None)
 
@@ -197,6 +219,8 @@ class DecisionTreeRegressor(RegressorMixin, BaseEstimator):
 
             clip_tree_values(self.tree_, mono, "regression")
         self.fit_stats_ = timer.summary() if timer.enabled else None
+        # Always-on structured run record (mpitree_tpu.obs).
+        self.fit_report_ = obs.report(tree=self.tree_)
         return self
 
     def cost_complexity_pruning_path(self, X, y, sample_weight=None):

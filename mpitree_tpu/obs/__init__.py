@@ -1,0 +1,47 @@
+"""mpitree_tpu.obs — structured build records for every estimator.
+
+The cross-cutting observability layer (ISSUE 3): every engine writes into
+a :class:`BuildObserver` (a superset of ``utils/profiling.PhaseTimer``),
+every estimator exposes the finalized :class:`BuildRecord` as an
+always-on ``fit_report_`` dict plus a ``dump_report(path)`` helper, and
+the bench harness embeds the :func:`digest` in each ``BENCH_TPU.jsonl``
+section line so on-hardware perf evidence carries its own attribution
+(engine decision + reason, per-level rows, compile and collective
+accounting, typed events).
+
+Gating: counters, decisions, events, and compile/collective accounting
+are always on (O(1) host work from static shapes); wall-clock spans and
+per-level rows require ``MPITREE_TPU_PROFILE=1``.
+"""
+
+from mpitree_tpu.obs.observer import (
+    REGISTRY,
+    BuildObserver,
+    CompileRegistry,
+    mesh_info,
+    note_build_path,
+    note_refine,
+    warn_event,
+)
+from mpitree_tpu.obs.record import (
+    SCHEMA_VERSION,
+    TOP_LEVEL_FIELDS,
+    BuildRecord,
+    ReportMixin,
+    digest,
+)
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "TOP_LEVEL_FIELDS",
+    "BuildRecord",
+    "BuildObserver",
+    "CompileRegistry",
+    "REGISTRY",
+    "ReportMixin",
+    "digest",
+    "mesh_info",
+    "note_build_path",
+    "note_refine",
+    "warn_event",
+]

@@ -1,0 +1,139 @@
+"""Static-shape accounting: collective payloads and fused per-level rows.
+
+Everything here is host arithmetic on STATIC shapes and the finished
+tree's host arrays — it costs nothing on device, which is what lets
+collective accounting stay always-on (ISSUE 3 tentpole piece 4). The
+levelwise engine accounts live (it owns a host loop anyway); the fused
+engine's whole build runs inside one ``lax.while_loop``, so its per-level
+rows and psum totals are *reconstructed* after the fact from the depth
+histogram of the finished tree — every allocated node was exactly once a
+frontier member at its depth, so ``bincount(tree.depth)`` IS the frontier
+trajectory, and the tier-routing replay below mirrors
+``fused_builder._make_build_body``'s dispatch chain.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from mpitree_tpu.parallel.collective import (
+    counts_psum_bytes,
+    split_psum_bytes,
+)
+
+
+def effective_tiers(tiers: tuple, max_depth: int) -> tuple:
+    """Tiers reachable under a depth cap (``max_depth < 0`` = unbounded).
+
+    The ONE copy of the trim ``fused_builder._make_build_body`` applies:
+    depth-capped builds bound every interior frontier at
+    ``2^(max_depth-1)``, so tiers that can never be the narrowest fit are
+    dropped. ``tiers`` must already be normalized (sorted ascending,
+    bounded by the chunk width — ``builder.valid_tiers``).
+    """
+    max_interior = (
+        2 ** max(int(max_depth) - 1, 0) if max_depth >= 0 else None
+    )
+    if max_interior is None or not tiers:
+        return tuple(tiers)
+    kept, prev = [], 0
+    for t in tiers:
+        if prev < max_interior:
+            kept.append(t)
+        prev = t
+    return tuple(kept)
+
+
+def interior_big_reachable(tiers: tuple, max_depth: int) -> bool:
+    """Whether the K-slot interior sweep can ever run (fused cond chain)."""
+    max_interior = (
+        2 ** max(int(max_depth) - 1, 0) if max_depth >= 0 else None
+    )
+    return not (
+        max_interior is not None and tiers and max_interior <= max(tiers)
+    )
+
+
+def fused_level_rows(
+    node_depths: np.ndarray,
+    *,
+    n_slots: int,
+    tiers: tuple,
+    n_features: int,
+    n_bins: int,
+    n_channels: int,
+    counts_channels: int,
+    max_depth: int,
+    task: str,
+    feature_shards: int = 1,
+    n_rows: int | None = None,
+) -> tuple:
+    """(level_rows, collectives) replayed from a fused build's finished tree.
+
+    ``node_depths``: the host ``tree.depth`` array. ``tiers`` must be the
+    EFFECTIVE tier tuple the compiled program used
+    (:func:`effective_tiers` of the valid tiers). ``n_channels`` is the
+    histogram payload width (C for classification, 3 moment channels
+    otherwise); ``counts_channels`` the terminal counts width.
+    ``max_depth < 0`` = unbounded. Returns per-level row dicts (seconds
+    ``None`` — one compiled program has no per-level host clock) and a
+    ``{site: {"calls", "bytes"}}`` dict of logical psum/gather payloads.
+    """
+    frontiers = np.bincount(np.asarray(node_depths, np.int64))
+    rows: list = []
+    coll: dict = {}
+
+    def add(site, calls, nbytes):
+        entry = coll.setdefault(site, {"calls": 0, "bytes": 0})
+        entry["calls"] += calls
+        entry["bytes"] += nbytes
+
+    K = n_slots
+    for d, f in enumerate(frontiers.tolist()):
+        if f == 0:
+            continue
+        splits = (
+            int(frontiers[d + 1]) // 2 if d + 1 < len(frontiers) else 0
+        )
+        terminal = max_depth >= 0 and d == max_depth
+        if terminal:
+            chunks = math.ceil(f / K)
+            nbytes = chunks * counts_psum_bytes(
+                n_slots=K, n_channels=counts_channels
+            )
+            add("counts_psum", chunks, nbytes)
+            hist_bytes = 0
+            psum_bytes = nbytes
+        else:
+            S = next((s for s in tiers if f <= s), K)
+            chunks = 1 if S < K else math.ceil(f / K)
+            per_chunk = split_psum_bytes(
+                n_slots=S, n_features=n_features, n_bins=n_bins,
+                n_channels=n_channels,
+            )
+            hist_bytes = chunks * per_chunk
+            psum_bytes = chunks * per_chunk
+            add("split_hist_psum", chunks, chunks * per_chunk)
+            if task == "regression":
+                yb = chunks * 2 * S * 4  # pmin/pmax of per-slot f32 y range
+                add("y_range_pminmax", chunks, yb)
+                psum_bytes += yb
+            if feature_shards > 1:
+                # select_global's stacked (3, S) f32 all_gather per chunk,
+                # plus the per-level row-routing psum of child ids.
+                gb = chunks * 3 * S * 4
+                add("feature_merge_all_gather", chunks, gb)
+                if n_rows is not None:
+                    add("route_psum", 1, n_rows * 4)
+        rows.append({
+            "level": d,
+            "frontier": int(f),
+            "splits": splits,
+            "hist_bytes": int(hist_bytes),
+            "psum_bytes": int(psum_bytes),
+            "seconds": None,
+            "new_lowerings": 0,
+        })
+    return rows, coll

@@ -1,0 +1,272 @@
+"""BuildObserver: the span()/counter() API every engine writes into.
+
+A superset of ``utils/profiling.PhaseTimer`` (which it absorbs by
+subclassing): the timer's phase spans keep working unchanged — every
+``timer.phase(...)``/``timer.span(...)`` site in the engines is also an
+observer site — and the observer adds the always-on cheap channels
+(counters, decisions, typed events, compile and collective accounting)
+plus the profile-gated per-level rows.
+
+Cost model, enforced by ``tests/test_obs.py``'s disabled-path test:
+
+- observability OFF (no ``MPITREE_TPU_PROFILE``): spans are the existing
+  no-op ``yield``; level rows are never allocated; counters/events/
+  decisions are O(1) dict updates on numbers computed from static shapes
+  — within the <5% wall bound on the 2k-row smoke workload;
+- observability ON: spans accumulate wall-clock and per-level rows are
+  appended (capped — see ``MAX_LEVEL_ROWS`` — with an honest
+  ``levels_dropped`` counter instead of a silent truncation).
+
+Compile accounting is a process-wide cache-key registry — the runtime
+twin of graftlint GL02: every jit entry point (``split_fn``,
+``counts_fn``, ``update_fn``, ``fused_fn``, ``forest_fn``) notes its
+static-configuration key; a key first seen means a fresh lowering (cold
+seconds land in whatever span is open), a repeat means the lru-cached
+executable. Crossing ``RECOMPILE_WARN_AFTER`` distinct keys for one
+entry point warns once — the signature of recompile churn (shape keys
+leaking runtime values).
+"""
+
+from __future__ import annotations
+
+import warnings
+from collections import OrderedDict
+
+from mpitree_tpu.obs.record import BuildRecord
+from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
+
+# Lowering events per entry point beyond which we warn: the collective
+# factories' lru_caches hold 64 entries and the fused builder's 32 — past
+# half the cache a workload is compiling more variants than it can keep,
+# and every further miss is a silent 20-70s tunnel recompile.
+RECOMPILE_WARN_AFTER = 32
+
+
+class CompileRegistry:
+    """Process-wide lowering-event counts per jit entry point.
+
+    Each entry point's key set is an LRU mirroring that factory's
+    ``lru_cache`` size: a key seen before but since EVICTED re-traces and
+    re-compiles on the device, and the registry reports it as new again —
+    without the mirror, cache-cycling workloads would pay full tunnel
+    recompiles while ``fit_report_['compile']`` claimed everything warm.
+    ``count`` therefore totals lowering *events* (>= distinct keys).
+    """
+
+    def __init__(self):
+        self._lru: dict = {}  # entry -> OrderedDict of live cache keys
+        self._lowerings: dict = {}  # entry -> lowering events
+        self._warned: set = set()
+
+    def note(self, entry: str, key, cache_size: int = 64) -> bool:
+        """Record one factory resolution; True when ``key`` lowers fresh
+        (first sight OR evicted from the mirrored lru), False when the
+        cached executable serves it. ``cache_size`` must match the
+        factory's ``lru_cache(maxsize=...)``."""
+        lru = self._lru.setdefault(entry, OrderedDict())
+        if key in lru:
+            lru.move_to_end(key)
+            return False
+        lru[key] = True
+        while len(lru) > cache_size:
+            lru.popitem(last=False)
+        n = self._lowerings.get(entry, 0) + 1
+        self._lowerings[entry] = n
+        if n == RECOMPILE_WARN_AFTER and entry not in self._warned:
+            self._warned.add(entry)
+            warnings.warn(
+                f"jit entry point {entry!r} has compiled "
+                f"{RECOMPILE_WARN_AFTER} lowerings this process — "
+                "recompile churn (a static config key is probably carrying "
+                "a runtime-varying value); see fit_report_['compile']",
+                stacklevel=4,
+            )
+        return True
+
+    def count(self, entry: str) -> int:
+        return self._lowerings.get(entry, 0)
+
+
+REGISTRY = CompileRegistry()
+
+
+def mesh_info(mesh) -> dict:
+    """JSON-able mesh description for the record."""
+    return {
+        "platform": mesh.devices.flat[0].platform,
+        "n_devices": int(mesh.size),
+        "axes": {str(name): int(mesh.shape[name]) for name in mesh.axis_names},
+    }
+
+
+def warn_event(obs, kind: str, message: str, *, stacklevel: int = 2) -> None:
+    """``warnings.warn`` + typed record event — one call per site.
+
+    Every structured-event site in the engines routes through here so the
+    stderr warning and the ``fit_report_`` event can never say different
+    things. ``stacklevel`` counts from the CALLER (this frame is added).
+    ``obs`` may be any PhaseTimer (the base class's ``event`` is a no-op).
+    """
+    warnings.warn(message, stacklevel=stacklevel + 1)
+    if obs is not None:
+        obs.event(kind, message)
+
+
+def note_build_path(obs, *, host: bool, backend, n_rows: int,
+                    n_features: int) -> None:
+    """Record the host-vs-device routing decision (one copy for every
+    estimator — ``core/builder.prefer_host_path``'s inputs and verdict)."""
+    if backend == "host":
+        reason = "backend='host' forces the numpy tier"
+    elif host:
+        reason = (
+            f"auto: {n_rows}x{n_features} = {n_rows * n_features} cells "
+            "<= host-path threshold on a single device (per-level device "
+            "dispatch would dominate)"
+        )
+    elif backend is not None:
+        reason = f"explicit backend={backend!r} forces the device path"
+    else:
+        reason = "multi-device mesh or workload above the host-path threshold"
+    obs.decision(
+        "build_path", "host" if host else "device", reason=reason,
+        rows=int(n_rows), features=int(n_features),
+    )
+
+
+def note_refine(obs, *, refine: bool, rd, crown_depth,
+                refine_depth_param, constrained: bool = False) -> None:
+    """Record the hybrid-refine decision (estimator-level routing)."""
+    if constrained:
+        reason = (
+            "monotonic_cst: hybrid tail skipped — constraint bounds do not "
+            "thread across the graft seam (single-engine full depth)"
+        )
+    elif not refine:
+        reason = (
+            "no hybrid tail (refine_depth=None, exact candidates, or "
+            "max_depth within the crown)"
+        )
+    elif refine_depth_param == "auto":
+        reason = (
+            "auto: quantile binning capped some feature's candidate set — "
+            "exact-local-candidate host tail recovers deep-node accuracy"
+        )
+    else:
+        reason = f"explicit refine_depth={refine_depth_param!r}"
+    obs.decision(
+        "refine", int(rd) if refine and rd is not None else None,
+        reason=reason,
+        crown_depth=(None if crown_depth is None else int(crown_depth)),
+    )
+
+
+class BuildObserver(PhaseTimer):
+    """Structured run-record collector; see module docstring.
+
+    ``timing=None`` reads ``MPITREE_TPU_PROFILE`` (the PhaseTimer gate);
+    pass an explicit bool to override. The record is always created —
+    counters/decisions/events/accounting are the always-on cheap channel;
+    spans and level rows are timing-gated.
+    """
+
+    MAX_LEVEL_ROWS = 512
+    MAX_EVENTS = 128
+    MAX_ROUNDS = 1024
+
+    def __init__(self, timing: bool | None = None):
+        super().__init__(
+            enabled=profiling_enabled() if timing is None else timing
+        )
+        self.record = BuildRecord()
+
+    # ``span`` is the obs-native name; ``phase`` stays for PhaseTimer
+    # compatibility (both are the same context manager).
+    span = PhaseTimer.phase
+
+    # -- always-on channels ------------------------------------------------
+    def counter(self, name: str, inc=1) -> None:
+        c = self.record.counters
+        c[name] = c.get(name, 0) + inc
+
+    def event(self, kind: str, message: str, **data) -> None:
+        ev = self.record.events
+        if len(ev) >= self.MAX_EVENTS:
+            self.counter("events_dropped")
+            return
+        row = {"kind": kind, "message": message}
+        if data:
+            row.update(data)
+        ev.append(row)
+
+    def decision(self, key: str, value, reason: str | None = None,
+                 **inputs) -> None:
+        entry = {"value": value, "reason": reason}
+        if inputs:
+            entry["inputs"] = inputs
+        self.record.decisions[key] = entry
+        if key == "engine":
+            self.record.engine = entry
+
+    def set_mesh(self, mesh) -> None:
+        self.record.mesh = mesh_info(mesh)
+
+    def collective(self, site: str, *, calls: int = 1, nbytes: int = 0) -> None:
+        entry = self.record.collectives.setdefault(
+            site, {"calls": 0, "bytes": 0}
+        )
+        entry["calls"] += int(calls)
+        entry["bytes"] += int(nbytes)
+
+    def compile_note(self, entry: str, key, cache_size: int = 64) -> bool:
+        new = REGISTRY.note(entry, key, cache_size=cache_size)
+        rec = self.record.compile.setdefault(entry, {"lowerings": 0, "new": 0})
+        rec["lowerings"] = REGISTRY.count(entry)
+        if new:
+            rec["new"] += 1
+        return new
+
+    def round(self, **row) -> None:
+        r = self.record.rounds
+        if len(r) >= self.MAX_ROUNDS:
+            self.counter("rounds_dropped")
+            return
+        r.append(row)
+
+    # -- profile-gated channels --------------------------------------------
+    def level(self, **row) -> None:
+        if not self.enabled:
+            return
+        rows = self.record.levels
+        if len(rows) >= self.MAX_LEVEL_ROWS:
+            self.counter("levels_dropped")
+            return
+        rows.append(row)
+
+    # -- finalization ------------------------------------------------------
+    def report(self, *, tree=None, trees=None) -> dict:
+        """Finalize into a plain JSON-able dict (the ``fit_report_`` value).
+
+        ``tree``: a fitted TreeArrays — fills ``result``. ``trees``: an
+        ensemble's member list — fills per-member summaries and aggregate
+        ``result``. Callable repeatedly (e.g. after post-fit OOB events).
+        """
+        rec = self.record
+        rec.phases = self.summary() if self.enabled else {}
+        if tree is not None:
+            rec.result = {
+                "n_nodes": int(tree.n_nodes),
+                "depth": int(tree.max_depth),
+            }
+        if trees is not None:
+            rec.trees = [
+                {"n_nodes": int(t.n_nodes), "depth": int(t.max_depth)}
+                for t in trees
+            ]
+            if rec.trees:
+                rec.result = {
+                    "n_trees": len(rec.trees),
+                    "n_nodes": sum(t["n_nodes"] for t in rec.trees),
+                    "depth": max(t["depth"] for t in rec.trees),
+                }
+        return rec.to_dict()

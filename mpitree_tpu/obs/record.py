@@ -1,0 +1,179 @@
+"""BuildRecord: the schema-versioned structured run record every fit emits.
+
+The reference's only observability was a hand-run ``time.time()`` sweep in a
+notebook (SURVEY.md §5); our first replacement was a single env-gated
+``PhaseTimer`` that recorded *how long* a build took but never *why* it
+behaved the way it did. A ``BuildRecord`` is the why: the engine decision
+and its reason, the mesh, per-level (or per-phase) rows, compile and
+collective accounting, and every structured event (f32-ceiling trips,
+fallbacks, determinism-check results) that previously only reached stderr.
+
+Contract:
+
+- **JSON-serializable and schema-versioned.** ``to_dict()`` returns plain
+  Python containers (numpy scalars coerced); ``SCHEMA_VERSION`` bumps on
+  any field rename/removal so ``BENCH_TPU.jsonl`` consumers can gate.
+  The top-level field set is pinned by a golden test
+  (``tests/test_obs.py``) — renaming a field is an intentional,
+  version-bumped act, never a refactor accident.
+- **Cheap when observability is off.** Counters, decisions, events, and
+  collective/compile accounting are always on (they are O(1) host dict
+  updates computed from static shapes); wall-clock spans and per-level
+  rows only exist under ``MPITREE_TPU_PROFILE=1``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+SCHEMA_VERSION = 1
+
+# The golden field set: tests/test_obs.py pins this against to_dict() so a
+# rename cannot slip past bench/watcher consumers silently.
+TOP_LEVEL_FIELDS = (
+    "schema",
+    "engine",
+    "mesh",
+    "decisions",
+    "phases",
+    "levels",
+    "counters",
+    "compile",
+    "collectives",
+    "events",
+    "rounds",
+    "trees",
+    "result",
+)
+
+
+def _jsonable(obj):
+    """Coerce numpy scalars/containers to plain JSON-serializable Python."""
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (str, bool)) or obj is None:
+        return obj
+    if isinstance(obj, int):
+        return obj
+    if isinstance(obj, float):
+        return obj
+    # numpy scalars (and anything else numeric-ish) land here
+    if hasattr(obj, "item"):
+        return _jsonable(obj.item())
+    return str(obj)
+
+
+@dataclasses.dataclass
+class BuildRecord:
+    """One fit's structured run record (see module docstring).
+
+    Field semantics:
+
+    - ``engine``: ``{"value", "reason", "inputs"}`` — the resolved build
+      engine AND why (``core/builder.py``'s "auto" resolution inputs).
+    - ``mesh``: ``{"platform", "n_devices", "axes"}``.
+    - ``decisions``: every other recorded routing decision
+      (``build_path``, ``refine``, ``early_stop``, ...), same shape as
+      ``engine``.
+    - ``phases``: PhaseTimer summary (``{name: {seconds, calls}}``) —
+      populated only under ``MPITREE_TPU_PROFILE=1``.
+    - ``levels``: per-level rows ``{level, frontier, splits, hist_bytes,
+      psum_bytes, seconds, new_lowerings}`` (levelwise/host: live;
+      fused: reconstructed post-hoc from the finished tree's depth
+      histogram). Profile-gated; capped (see BuildObserver).
+    - ``counters``: always-on integer counters.
+    - ``compile``: per jit entry point ``{"lowerings": lowering events
+      seen process-wide (distinct keys, plus re-lowerings of keys the
+      factory lru evicted), "new": lowerings triggered during this
+      fit}`` — the runtime twin of graftlint GL02.
+    - ``collectives``: per psum/gather site ``{"calls", "bytes"}`` — the
+      LOGICAL payload computed from static shapes (zero device cost;
+      multiply by (shards-1)/shards for wire traffic on an N-wide axis).
+    - ``events``: typed events ``{"kind", "message"}`` — the structured
+      form of what previously only went to stderr via ``warnings.warn``.
+    - ``rounds``: boosting per-round records (train/val loss, subsample
+      fraction, early-stop state).
+    - ``trees``: ensemble per-member summaries ``{"n_nodes", "depth"}``.
+    - ``result``: ``{"n_nodes", "depth"}`` of the fitted tree (aggregates
+      for ensembles).
+    """
+
+    schema: int = SCHEMA_VERSION
+    engine: dict = dataclasses.field(default_factory=dict)
+    mesh: dict = dataclasses.field(default_factory=dict)
+    decisions: dict = dataclasses.field(default_factory=dict)
+    phases: dict = dataclasses.field(default_factory=dict)
+    levels: list = dataclasses.field(default_factory=list)
+    counters: dict = dataclasses.field(default_factory=dict)
+    compile: dict = dataclasses.field(default_factory=dict)
+    collectives: dict = dataclasses.field(default_factory=dict)
+    events: list = dataclasses.field(default_factory=list)
+    rounds: list = dataclasses.field(default_factory=list)
+    trees: list = dataclasses.field(default_factory=list)
+    result: dict = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return _jsonable(dataclasses.asdict(self))
+
+    def to_json(self, **kwargs) -> str:
+        kwargs.setdefault("sort_keys", True)
+        return json.dumps(self.to_dict(), **kwargs)
+
+    @classmethod
+    def from_json(cls, text: str) -> "BuildRecord":
+        data = json.loads(text)
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in data.items() if k in known})
+
+
+def digest(report: dict) -> dict:
+    """Compact summary of a report dict — what bench section lines embed.
+
+    Small by construction (~10 scalar fields) so a ``BENCH_TPU.jsonl``
+    line carrying one per section stays within the driver's tail window
+    (the round-4 truncation lesson, ``tests/test_bench_contract.py``).
+    The one-line string rendering lives in
+    ``bench_tpu.format_record_digest`` — deliberately NOT here, so the
+    watcher can format stored digests without importing jax.
+    """
+    total_psum = sum(
+        int(v.get("bytes", 0)) for v in report.get("collectives", {}).values()
+    )
+    wall = sum(
+        float(v.get("seconds", 0.0)) for v in report.get("phases", {}).values()
+    )
+    return {
+        "engine": report.get("engine", {}).get("value"),
+        "reason": (report.get("engine", {}).get("reason") or "")[:120],
+        "n_nodes": report.get("result", {}).get("n_nodes"),
+        "depth": report.get("result", {}).get("depth"),
+        "levels": len(report.get("levels", [])),
+        "compile_new": sum(
+            int(v.get("new", 0)) for v in report.get("compile", {}).values()
+        ),
+        "psum_bytes": total_psum,
+        "events": len(report.get("events", [])),
+        "wall_s": round(wall, 3),
+    }
+
+
+class ReportMixin:
+    """Adds ``dump_report(path)`` to estimators carrying ``fit_report_``."""
+
+    def dump_report(self, path) -> str:
+        """Write the fitted ``fit_report_`` as JSON to ``path``.
+
+        Round-trip contract: ``json.load(open(path)) == self.fit_report_``
+        (pinned in ``tests/test_profiling.py``). Returns ``path``.
+        """
+        report = getattr(self, "fit_report_", None)
+        if report is None:
+            raise ValueError(
+                "no fit_report_ on this estimator — call fit() first"
+            )
+        with open(path, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+        return str(path)

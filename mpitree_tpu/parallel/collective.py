@@ -35,6 +35,27 @@ from mpitree_tpu.parallel.mesh import DATA_AXIS
 from mpitree_tpu.utils import profiling
 
 
+def split_psum_bytes(*, n_slots: int, n_features: int, n_bins: int,
+                     n_channels: int, itemsize: int = 4) -> int:
+    """Logical payload of one split-step histogram ``psum`` (bytes).
+
+    The psum'd array IS the (n_slots, n_features, n_channels, n_bins)
+    histogram chunk; computed from static shapes so the observability
+    layer (``mpitree_tpu.obs``) can account collective traffic with zero
+    device cost. ``itemsize=8`` for the gbdt scoped-f64 accumulation
+    path (``resolve_gbdt_x64``). Wire traffic on a D-wide axis is
+    ``(D-1)/D`` of this per all-reduce direction; the record keeps the
+    logical payload (mesh width rides alongside in ``record.mesh``).
+    """
+    return n_slots * n_features * n_channels * n_bins * itemsize
+
+
+def counts_psum_bytes(*, n_slots: int, n_channels: int,
+                      itemsize: int = 4) -> int:
+    """Logical payload of one terminal counts-step ``psum`` (bytes)."""
+    return n_slots * n_channels * itemsize
+
+
 def node_counts_local(y, nid, w, chunk_lo, *, n_slots, n_classes, task,
                       axis=DATA_AXIS):
     """Per-slot class counts (or regression moments), psum'd over ``axis``.

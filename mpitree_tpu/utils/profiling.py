@@ -38,7 +38,15 @@ def debug_checks_enabled() -> bool:
 
 
 class PhaseTimer:
-    """Accumulates wall-clock seconds and call counts per named phase."""
+    """Accumulates wall-clock seconds and call counts per named phase.
+
+    Also the base of the observability API: the no-op hooks below are the
+    structured-record channels ``mpitree_tpu.obs.BuildObserver`` overrides
+    (counters, decisions, typed events, per-level rows, collective and
+    compile accounting). The engines call them unconditionally, so a
+    library caller passing a plain ``PhaseTimer`` to ``build_tree(...,
+    timer=...)`` keeps working and pays nothing for the record.
+    """
 
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
@@ -57,6 +65,36 @@ class PhaseTimer:
             self.seconds[name] += time.perf_counter() - t0
             self.calls[name] += 1
 
+    # obs-native alias: ``with timer.span("bin"):`` == ``timer.phase``.
+    span = phase
+
+    # -- observability hooks (no-ops; see mpitree_tpu.obs.BuildObserver) ---
+    def counter(self, name: str, inc=1) -> None:
+        pass
+
+    def event(self, kind: str, message: str, **data) -> None:
+        pass
+
+    def decision(self, key: str, value, reason: str | None = None,
+                 **inputs) -> None:
+        pass
+
+    def set_mesh(self, mesh) -> None:
+        pass
+
+    def level(self, **row) -> None:
+        pass
+
+    def collective(self, site: str, *, calls: int = 1,
+                   nbytes: int = 0) -> None:
+        pass
+
+    def compile_note(self, entry: str, key, cache_size: int = 64) -> bool:
+        return False
+
+    def round(self, **row) -> None:
+        pass
+
     def summary(self) -> dict:
         return {
             name: {"seconds": round(self.seconds[name], 4), "calls": self.calls[name]}
@@ -74,16 +112,31 @@ class PhaseTimer:
 
 
 @contextlib.contextmanager
-def trace(log_dir: str):
+def trace(log_dir: str, on_event=None):
     """Device-level profiler trace (TensorBoard/Perfetto), or no-op if the
     profiler is unavailable on the current platform. Exceptions raised by the
-    traced block propagate unchanged."""
+    traced block propagate unchanged.
+
+    ``jax.profiler.trace.__enter__`` can raise AFTER partially starting the
+    backend profiler (e.g. the log-dir write fails once the collector is
+    live); a swallowed error would then leave the profiler running and every
+    later ``trace`` failing with "profiler already active". On entry failure
+    we stop any half-started trace and report a structured
+    ``trace_unavailable`` event through ``on_event(kind, message)`` (e.g.
+    ``BuildObserver.event``) instead of silence.
+    """
     ctx = jax.profiler.trace(log_dir)
+    entered = False
     try:
         ctx.__enter__()
         entered = True
-    except Exception:
-        entered = False
+    except Exception as e:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass  # nothing was started — the usual unavailable-platform case
+        if on_event is not None:
+            on_event("trace_unavailable", f"{type(e).__name__}: {e}")
     try:
         yield
     finally:

@@ -63,3 +63,27 @@ def host_side_materialization(tree):
 def host_loop_with_coercions(rows):
     # int()/float() in host loops are fine; only .item() per element syncs
     return [float(r) for r in rows]
+
+
+def host_only_accumulator(ids, w):
+    # undtyped np.zeros consumed only by host numpy: the f64 default is
+    # deliberate (exact bincount accumulation) and never crosses to device
+    votes = np.zeros((len(ids), 4))
+    np.add.at(votes, ids, w)
+    return votes.sum()
+
+
+def dtyped_alloc_feeds_device(x):
+    # explicit dtype: the transfer width is pinned — no finding
+    acc = np.zeros((8, 128), np.float32)
+    return jnp.asarray(acc) + x
+
+
+def rebound_name_is_host_only(x):
+    # 'buf' feeds jax ABOVE the np.zeros rebind — the allocation below is
+    # a different (host-only) binding and must not fire
+    buf = jnp.asarray(x)
+    total = jnp.sum(buf)
+    buf = np.zeros((4, 4))
+    buf[0, 0] = float(total)
+    return buf

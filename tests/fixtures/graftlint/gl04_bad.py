@@ -2,6 +2,7 @@
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 
@@ -31,3 +32,18 @@ def off_lane_blockspec(row_tile):
 
 def off_sublane_blockspec():
     return pl.BlockSpec((12, 128), lambda i: (i, 0))  # expect: GL04
+
+
+@jax.jit
+def device_sum(acc):
+    return acc.sum()
+
+
+def host_acc_feeds_device_fn():
+    acc = np.zeros((8, 128))  # expect: GL04
+    return device_sum(acc)
+
+
+def host_empty_feeds_jax_call():
+    buf = np.empty((4, 4))  # expect: GL04
+    return jnp.asarray(buf).sum()

@@ -1,0 +1,268 @@
+"""mpitree_tpu.obs — schema, gating, accounting, and registry contracts.
+
+The two satellite guarantees ISSUE 3 pins here:
+
+- **golden schema**: ``BuildRecord.to_dict()``'s top-level field names are
+  frozen — bench/watcher consumers parse them out of committed
+  ``BENCH_TPU.jsonl`` lines, so a rename must bump ``SCHEMA_VERSION``
+  and fail THIS test first, never break consumers silently;
+- **disabled path**: with observability off a fit allocates no per-level
+  record rows and stays within 5% wall time of a stripped timer on the
+  2k-row smoke workload.
+"""
+
+import dataclasses
+import json
+import time
+
+import numpy as np
+import pytest
+
+from mpitree_tpu.core.builder import BuildConfig, build_tree
+from mpitree_tpu.obs import (
+    SCHEMA_VERSION,
+    TOP_LEVEL_FIELDS,
+    BuildObserver,
+    BuildRecord,
+    CompileRegistry,
+    digest,
+)
+from mpitree_tpu.obs import accounting
+from mpitree_tpu.ops.binning import bin_dataset
+from mpitree_tpu.parallel import mesh as mesh_lib
+from mpitree_tpu.parallel.collective import (
+    counts_psum_bytes,
+    split_psum_bytes,
+)
+from mpitree_tpu.utils.profiling import PhaseTimer, trace
+
+
+def _data(n=2000, f=8, seed=5):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64) + (X[:, 1] > 0.5)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# golden schema
+# ---------------------------------------------------------------------------
+
+def test_build_record_schema_golden():
+    """Field names are pinned: renaming/removing one is a versioned act."""
+    rep = BuildObserver(timing=False).report()
+    assert tuple(sorted(rep)) == tuple(sorted(TOP_LEVEL_FIELDS))
+    assert rep["schema"] == SCHEMA_VERSION == 1
+    # dataclass fields and the pinned tuple must agree too
+    assert tuple(
+        f.name for f in dataclasses.fields(BuildRecord)
+    ) == TOP_LEVEL_FIELDS
+
+
+def test_record_json_round_trip():
+    obs = BuildObserver(timing=False)
+    obs.counter("x", 3)
+    obs.decision("engine", "fused", reason="r", rows=np.int64(10))
+    obs.event("f32_ceiling", "msg")
+    obs.collective("split_hist_psum", calls=2, nbytes=np.int64(1024))
+    rep = obs.report()
+    text = json.dumps(rep)  # numpy scalars must already be coerced
+    assert json.loads(text) == rep
+    rec = BuildRecord.from_json(text)
+    assert rec.counters == {"x": 3}
+    assert rec.engine["value"] == "fused"
+
+
+def test_digest_shape():
+    obs = BuildObserver(timing=False)
+    obs.decision("engine", "levelwise", reason="because")
+    obs.collective("split_hist_psum", calls=4, nbytes=2_000_000)
+    obs.compile_note("split_fn_digest_test", ("k",))
+    rep = obs.report()
+    d = digest(rep)
+    assert d["engine"] == "levelwise"
+    assert d["psum_bytes"] == 2_000_000
+    assert d["compile_new"] == 1
+    # the one-line string rendering is bench_tpu.format_record_digest —
+    # deliberately jax-free, covered by tests/test_bench_contract.py
+
+
+# ---------------------------------------------------------------------------
+# gating: always-on vs profile-gated channels
+# ---------------------------------------------------------------------------
+
+def test_level_rows_gated_and_capped():
+    off = BuildObserver(timing=False)
+    off.level(level=0, frontier=1)
+    assert off.record.levels == []  # disabled: never allocated
+
+    on = BuildObserver(timing=True)
+    for i in range(on.MAX_LEVEL_ROWS + 5):
+        on.level(level=i, frontier=1)
+    assert len(on.record.levels) == on.MAX_LEVEL_ROWS
+    assert on.record.counters["levels_dropped"] == 5  # honest cap
+
+
+def test_events_capped_honestly():
+    obs = BuildObserver(timing=False)
+    for i in range(obs.MAX_EVENTS + 3):
+        obs.event("k", f"m{i}")
+    assert len(obs.record.events) == obs.MAX_EVENTS
+    assert obs.record.counters["events_dropped"] == 3
+
+
+def test_compile_registry_counts_and_churn_warning():
+    reg = CompileRegistry()
+    assert reg.note("entry", ("a",)) is True
+    assert reg.note("entry", ("a",)) is False  # cached executable
+    assert reg.count("entry") == 1
+    with pytest.warns(UserWarning, match="recompile churn"):
+        for i in range(1, 64):
+            reg.note("entry", ("key", i))
+    # warns once, not on every further key
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        reg.note("entry", ("key", 999))
+
+
+def test_compile_registry_mirrors_lru_eviction():
+    """A key evicted from the factory's lru_cache re-compiles on device —
+    the registry must report it as new again, not claim it warm."""
+    reg = CompileRegistry()
+    assert reg.note("e", "k0", cache_size=2) is True
+    assert reg.note("e", "k1", cache_size=2) is True
+    assert reg.note("e", "k0", cache_size=2) is False  # still cached
+    assert reg.note("e", "k2", cache_size=2) is True   # evicts k1 (LRU)
+    assert reg.note("e", "k1", cache_size=2) is True   # evicted: re-lowers
+    assert reg.note("e", "k0", cache_size=2) is True   # k0 evicted by k1
+    assert reg.count("e") == 5  # lowering EVENTS, not distinct keys
+
+
+# ---------------------------------------------------------------------------
+# static accounting
+# ---------------------------------------------------------------------------
+
+def test_collective_byte_helpers():
+    assert split_psum_bytes(
+        n_slots=8, n_features=4, n_bins=16, n_channels=3
+    ) == 8 * 4 * 16 * 3 * 4
+    assert split_psum_bytes(
+        n_slots=8, n_features=4, n_bins=16, n_channels=3, itemsize=8
+    ) == 8 * 4 * 16 * 3 * 8
+    assert counts_psum_bytes(n_slots=64, n_channels=7) == 64 * 7 * 4
+
+
+def test_fused_level_rows_replay_matches_depth_histogram():
+    # A 3-level tree: 1 root, 2, then 4 nodes at the terminal depth cap.
+    depths = np.array([0, 1, 1, 2, 2, 2, 2], np.int32)
+    rows, coll = accounting.fused_level_rows(
+        depths, n_slots=64, tiers=(8,), n_features=5, n_bins=16,
+        n_channels=3, counts_channels=3, max_depth=2, task="classification",
+    )
+    assert [r["frontier"] for r in rows] == [1, 2, 4]
+    assert [r["splits"] for r in rows] == [1, 2, 0]
+    # interior levels ride the 8-slot tier; the depth-2 level is terminal
+    per_chunk = split_psum_bytes(
+        n_slots=8, n_features=5, n_bins=16, n_channels=3
+    )
+    assert coll["split_hist_psum"] == {"calls": 2, "bytes": 2 * per_chunk}
+    assert coll["counts_psum"]["calls"] == 1
+    assert rows[2]["hist_bytes"] == 0  # terminal: counts-only scatter
+
+
+def test_effective_tiers_trim_matches_depth_cap():
+    # depth cap 3 bounds interior frontiers at 4: the 64 tier is dead
+    assert accounting.effective_tiers((8, 64), 3) == (8,)
+    assert accounting.effective_tiers((8, 64), -1) == (8, 64)
+    assert accounting.interior_big_reachable((8,), 3) is False
+    assert accounting.interior_big_reachable((8, 64), -1) is True
+
+
+# ---------------------------------------------------------------------------
+# disabled path: no rows, <5% wall overhead on the 2k-row smoke workload
+# ---------------------------------------------------------------------------
+
+def test_disabled_observability_no_rows_and_cheap():
+    X, y = _data(2000)
+    binned = bin_dataset(X, max_bins=64, binning="quantile")
+    mesh = mesh_lib.resolve_mesh(n_devices=None)
+    cfg = BuildConfig(max_depth=8, engine="levelwise")
+    n_classes = int(y.max()) + 1
+
+    def run(timer):
+        t0 = time.perf_counter()
+        build_tree(
+            binned, y, config=cfg, mesh=mesh, n_classes=n_classes,
+            timer=timer,
+        )
+        return time.perf_counter() - t0
+
+    run(PhaseTimer(enabled=False))  # compile warm-up, both paths share it
+    t_plain, t_obs = [], []
+    obs_timers = []
+    for _ in range(7):  # interleaved best-of to shrug off CPU noise
+        t_plain.append(run(PhaseTimer(enabled=False)))
+        obs = BuildObserver(timing=False)
+        t_obs.append(run(obs))
+        obs_timers.append(obs)
+    for obs in obs_timers:
+        assert obs.record.levels == []  # no per-level rows allocated
+        assert obs.record.phases == {}
+    # <5% wall vs the stripped timer (plus 2ms absolute for clock grain)
+    assert min(t_obs) <= min(t_plain) * 1.05 + 0.002, (
+        f"disabled-observability overhead: {min(t_obs):.4f}s vs "
+        f"{min(t_plain):.4f}s stripped"
+    )
+    # ...while the always-on channels still populated for free
+    rep = obs_timers[-1].report()
+    assert rep["engine"]["value"] == "levelwise"
+    assert rep["collectives"]["split_hist_psum"]["bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# trace() half-entered hazard (satellite 2)
+# ---------------------------------------------------------------------------
+
+def test_trace_entry_failure_stops_profiler_and_reports(monkeypatch):
+    import jax
+
+    stopped = []
+
+    class _Boom:
+        def __enter__(self):
+            raise RuntimeError("log dir unwritable")
+
+        def __exit__(self, *a):  # pragma: no cover — must not be reached
+            raise AssertionError("half-entered ctx must not __exit__")
+
+    monkeypatch.setattr(jax.profiler, "trace", lambda log_dir: _Boom())
+    monkeypatch.setattr(
+        jax.profiler, "stop_trace", lambda: stopped.append(True)
+    )
+    obs = BuildObserver(timing=False)
+    with trace("/nonexistent/dir", on_event=obs.event):
+        ran = True
+    assert ran
+    assert stopped == [True]  # any half-started profiler was stopped
+    assert obs.record.events == [{
+        "kind": "trace_unavailable",
+        "message": "RuntimeError: log dir unwritable",
+    }]
+
+
+def test_trace_still_noop_without_callback(monkeypatch):
+    import jax
+
+    class _Boom:
+        def __enter__(self):
+            raise RuntimeError("nope")
+
+        def __exit__(self, *a):
+            raise AssertionError
+
+    monkeypatch.setattr(jax.profiler, "trace", lambda log_dir: _Boom())
+    monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+    with trace("/nonexistent/dir"):
+        pass  # old callers: silent no-op, but profiler is stopped

@@ -76,6 +76,99 @@ def test_auto_engine_routes_fused_at_every_depth(monkeypatch):
     assert "split" in lw.fit_stats_  # levelwise phases
 
 
+def test_fit_report_populated_for_all_four_engines(monkeypatch, tmp_path):
+    """ISSUE 3 acceptance: a depth-8 covtype-subset fit through each engine
+    (fused, levelwise, hybrid, host) on the CPU mesh yields a fit_report_
+    whose engine-decision reason, per-level (or per-phase) rows, recompile
+    count, and collective byte totals are populated and round-trip through
+    dump_report/JSON."""
+    import json
+
+    from mpitree_tpu.utils.datasets import covtype_like
+
+    monkeypatch.setenv("MPITREE_TPU_PROFILE", "1")
+    monkeypatch.delenv("MPITREE_TPU_ENGINE", raising=False)
+    X, y = covtype_like(3000, seed=1)
+    cases = {
+        "fused": dict(backend="cpu", refine_depth=None),
+        "levelwise": dict(backend="cpu", refine_depth=None),
+        "hybrid": dict(backend="cpu", refine_depth=4),
+        "host": dict(backend="host", refine_depth=None),
+    }
+    for name, kw in cases.items():
+        if name == "levelwise":
+            monkeypatch.setenv("MPITREE_TPU_ENGINE", "levelwise")
+        else:
+            monkeypatch.delenv("MPITREE_TPU_ENGINE", raising=False)
+        clf = DecisionTreeClassifier(max_depth=8, **kw).fit(X, y)
+        rep = clf.fit_report_
+
+        # engine decision AND its reason
+        want_engine = {"fused": "fused", "levelwise": "levelwise",
+                       "hybrid": "fused", "host": "host"}[name]
+        assert rep["engine"]["value"] == want_engine, name
+        assert rep["engine"]["reason"], name
+
+        # per-level rows (all four engines emit them under PROFILE=1),
+        # and per-phase totals alongside
+        assert rep["levels"], name
+        assert rep["levels"][0]["frontier"] == 1, name
+        assert rep["phases"], name
+        if name in ("levelwise", "host"):
+            # live rows carry wall seconds; fused rows are post-hoc
+            assert rep["levels"][0]["seconds"] is not None, name
+
+        # recompile count via the cache-key registry
+        if name != "host":
+            assert any(
+                v["lowerings"] >= 1 for v in rep["compile"].values()
+            ), name
+            # collective byte totals from static shapes
+            total = sum(v["bytes"] for v in rep["collectives"].values())
+            assert total > 0, name
+        else:
+            assert rep["collectives"] == {}, name  # single-host numpy
+
+        if name == "hybrid":
+            assert rep["decisions"]["refine"]["value"] == 4
+            assert rep["decisions"]["refine_tail"]["value"] in (
+                "batched-native", "per-subtree",
+            )
+
+        # round-trips through dump_report / JSON
+        path = tmp_path / f"{name}.json"
+        clf.dump_report(path)
+        assert json.loads(path.read_text()) == rep, name
+
+
+def test_ensemble_fit_reports(monkeypatch):
+    """Forests and boosting expose the record the single trees always had
+    (ISSUE 3 satellite: fit_stats_ -> fit_report_ on ensembles)."""
+    from mpitree_tpu import GradientBoostingClassifier, RandomForestClassifier
+
+    monkeypatch.delenv("MPITREE_TPU_PROFILE", raising=False)
+    X, y = _data()
+    rf = RandomForestClassifier(
+        n_estimators=3, max_depth=4, backend="cpu", random_state=0
+    ).fit(X, y)
+    rep = rf.fit_report_
+    assert rep["result"]["n_trees"] == 3
+    assert len(rep["trees"]) == 3
+    assert rep["decisions"]["ensemble_path"]["value"] == "batched-fused"
+    assert rf.fit_stats_ is None  # profile off: legacy surface unchanged
+
+    gb = GradientBoostingClassifier(
+        max_iter=3, max_depth=3, backend="cpu", random_state=0
+    ).fit(X, y)
+    rep = gb.fit_report_
+    assert len(rep["rounds"]) == 3
+    r0 = rep["rounds"][0]
+    assert {"round", "trees", "subsample", "train_loss", "val_loss",
+            "early_stop"} <= set(r0)
+    assert rep["engine"]["value"] == "levelwise"  # gbdt rides levelwise
+    assert rep["decisions"]["early_stop"]["value"] is False
+
+
 def test_determinism_check_passes_on_mesh():
     """The psum-fingerprint tripwire is clean on a real 8-device mesh build,
     and the debug build returns the identical tree."""

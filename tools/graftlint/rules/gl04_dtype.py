@@ -15,6 +15,16 @@
    of 8 (sublanes); 1 is allowed for degenerate dims (the ``(Rt, 1)`` slot
    column idiom). Name-valued dims are checked at their call sites by the
    kernels' own ``_round_up`` guards, not here.
+4. Host-``numpy`` accumulator allocations feeding device code:
+   ``np.zeros``/``np.empty`` without an explicit dtype default to float64,
+   and a variable so allocated that is later handed to a ``jax.*`` call
+   (or a project device function) either silently doubles the transfer
+   and accumulates a dtype the device path never tested, or — with x64
+   disabled — truncates back to f32 after the host math already rounded
+   differently. Scoped to allocations whose VARIABLE later appears as an
+   argument of a jax/device call in the same function, so plain host
+   accumulators (predict vote buffers, the host builder's own f64
+   histograms) stay silent.
 """
 
 from __future__ import annotations
@@ -30,6 +40,10 @@ _ALLOCS = {
     "jax.numpy.zeros": 1, "jax.numpy.ones": 1, "jax.numpy.empty": 1,
     "jax.numpy.full": 2,
 }
+# Host-numpy accumulators (ROADMAP deferred GL04 family): zeros/empty are
+# the accumulator idioms; ones/full are almost always explicit-valued
+# fills whose dtype the fill literal documents.
+_NP_ALLOCS = {"numpy.zeros": 1, "numpy.empty": 1}
 _CONTRACTIONS = frozenset({"jax.lax.dot_general"})
 
 
@@ -68,6 +82,54 @@ def check(project):
                         "preferred_element_type — MXU accumulation dtype "
                         "follows the (possibly bf16) operands",
                     )
+    # Host-numpy accumulators feeding device code, per function: collect
+    # undtyped np.zeros/np.empty assignments, then flag any whose variable
+    # later rides into a jax.* call or a resolvable project device
+    # function. Conservative on purpose: an alloc consumed only by host
+    # numpy (bincounts, vote buffers) never fires.
+    for mod in project.modules:
+        for fn in mod.functions.values():
+            allocs: dict = {}
+            fed: dict = {}  # name -> latest device-feed lineno
+            for node in astutil.own_nodes(fn.node):
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and isinstance(node.value, ast.Call)):
+                    call = node.value
+                    name = mod.canonical(call.func)
+                    dtype_pos = _NP_ALLOCS.get(name)
+                    if (dtype_pos is not None
+                            and len(call.args) <= dtype_pos
+                            and astutil.keyword_arg(call, "dtype") is None):
+                        allocs.setdefault(node.targets[0].id, (name, call))
+                if not isinstance(node, ast.Call):
+                    continue
+                cname = mod.canonical(node.func)
+                target = project.resolve_function(mod, fn, node.func)
+                if not ((cname or "").startswith("jax.")
+                        or (target is not None and target.is_device)):
+                    continue
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name):
+                            fed[sub.id] = max(
+                                fed.get(sub.id, 0), node.lineno
+                            )
+            for var, (name, call) in allocs.items():
+                # Statement order matters: a device use of the same NAME
+                # that precedes the allocation is a different binding
+                # (e.g. `a = jnp.sum(x); ...; a = np.zeros(n)` host
+                # buffer) — only a feed BELOW the alloc line fires.
+                if fed.get(var, 0) <= call.lineno:
+                    continue
+                yield Finding(
+                    rule_id, mod.path, call.lineno, call.col_offset,
+                    f"{name.replace('numpy', 'np')} without an explicit "
+                    f"dtype allocates float64 on host, and '{var}' feeds a "
+                    f"device call in '{fn.qualname}' — pin the dtype the "
+                    "device path actually accumulates",
+                )
     # BlockSpec tiling is checked module-wide: kernels build specs in host
     # factory code (grid_spec construction) as often as in device fns.
     for mod in project.modules:

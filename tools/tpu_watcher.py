@@ -171,6 +171,16 @@ def run_section(sec: str) -> bool:
             pass
     done = capture_count(sec) > before
     log(f"{sec}: {'captured' if done else 'NOT captured'}")
+    if done:
+        # One-line run-record digest next to the capture verdict: the next
+        # slow-section mystery (rounds 3-4 cost whole windows to exactly
+        # this) arrives with its engine decision, recompile count, and
+        # psum payload already attributed in the committed log.
+        from bench_tpu import section_record_digest
+
+        digest = section_record_digest(sec)
+        if digest:
+            log(f"{sec}: record | {digest}")
     return done
 
 
