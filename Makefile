@@ -5,7 +5,7 @@
 
 PY ?= python
 
-.PHONY: test test-cpu lint lint-graft bench bench-tpu report clean
+.PHONY: test test-cpu lint lint-graft lint-baseline bench bench-tpu report clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -18,10 +18,22 @@ lint:
 	ruff check mpitree_tpu tests bench.py
 
 # JAX-aware invariants ruff cannot see: host-sync (GL01), recompile (GL02),
-# collective-axis (GL03) and dtype/tiling (GL04) rules — tools/graftlint.
-# Pure-AST: runs on any CPU box, no accelerator (or even jax) needed.
+# collective-axis (GL03), dtype/tiling (GL04), donation (GL05/GL08),
+# host-callback (GL06), Pallas hygiene (GL07) and the GL00 unused-
+# suppression audit — tools/graftlint, dataflow-backed (interprocedural
+# traced-value propagation). Pure-AST: runs on any CPU box, no accelerator
+# (or even jax) needed. Human format here; CI runs --format github against
+# the checked-in baseline so only NEW findings fail a build.
 lint-graft:
-	$(PY) -m tools.graftlint mpitree_tpu
+	$(PY) -m tools.graftlint mpitree_tpu --format human \
+	  --baseline tools/graftlint/baseline.json
+
+# Regenerate the baseline snapshot after deliberately accepting findings
+# (each entry should be a tracked burn-down item, not a dumping ground —
+# the live package currently baselines NOTHING and should stay that way).
+lint-baseline:
+	$(PY) -m tools.graftlint mpitree_tpu \
+	  --write-baseline tools/graftlint/baseline.json
 
 bench:
 	$(PY) bench.py

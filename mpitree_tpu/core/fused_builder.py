@@ -211,9 +211,12 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
         # The sorted window-packed matmul tier (ops/wide_hist.py) serves
         # widths the Pallas VMEM budget cannot reach: the deep-level slot
         # widths where the XLA scatter otherwise runs on the scalar unit.
-        def wide_ok(s):
-            return (use_wide and s >= wide_hist.MIN_SLOTS
-                    and s % wide_hist.WINDOW == 0)
+        # slot_width: the candidate tier width under test, NOT the build's
+        # n_slots (the _width suffix also tells graftlint's dataflow this
+        # predicate is static — see astutil.looks_shape_static)
+        def wide_ok(slot_width):
+            return (use_wide and slot_width >= wide_hist.MIN_SLOTS
+                    and slot_width % wide_hist.WINDOW == 0)
 
         if pallas_tiers or any(wide_ok(s) for s in (*tiers, K)):
             payload = (  # loop-invariant
@@ -647,6 +650,9 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     # identical shape/sharding, so XLA reuses the buffer instead of
     # double-buffering an N-row vector across the fused while_loop (GL05).
     # xb/y/w are NOT donatable: the forest path reuses them across groups.
+    # GL08 (donation-after-use) audits the caller: build_tree_fused never
+    # touches nid_d after the call — everything downstream reads the
+    # returned nid_out.
     return jax.jit(sharded, donate_argnums=(2,))
 
 
@@ -732,7 +738,9 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     # the inputs are per-row/per-tree shapes XLA cannot alias onto them,
     # and xb/y/nid0 replicate across the whole lax.map tree batch — an
     # unusable donation would only emit compile-time warnings (the ceiling
-    # tests run warnings-as-errors).
+    # tests run warnings-as-errors). Re-audited under GL08: build_forest_
+    # fused also re-reads none of the inputs post-call, so donation is
+    # neither usable nor (if it were) unsafe — the opt-out stands.
     return jax.jit(sharded)  # graftlint: disable=GL05
 
 

@@ -60,6 +60,9 @@ def device_tree_arrays(tree):
 # No donation on purpose: X and the tree arrays are cached device buffers
 # reused across predict calls (device_tree_arrays / stacked groups), and the
 # fori_loop carry is one fresh (N,) id vector no input could alias anyway.
+# Re-audited under GL08: every caller (predict_leaf_ids, the stacked vmap
+# groups) re-reads X and the tree arrays after the call — donation would
+# turn those reads into the garbage-read bug GL08 exists to catch.
 @partial(jax.jit, static_argnames=("n_steps",))  # graftlint: disable=GL05
 def descend(
     X: jax.Array,
@@ -208,7 +211,11 @@ def stacked_leaf_ids(trees, X, *, mesh=None,
     for g0 in range(0, T, group):
         sl = slice(g0, min(g0 + group, T))
         parts = tuple(jax.device_put(a[sl]) for a in (feat, thr, left, right))
+        # descend directly: predict_leaf_ids' mesh/device_put routing is
+        # host logic that must not run under the vmap trace
         ids[sl] = np.asarray(jax.vmap(
-            lambda f, th, l, r: predict_leaf_ids(X_d, (f, th, l, r), depth)
+            lambda f, th, l, r: descend(
+                X_d, f, th, l, r, n_steps=max(depth, 1)
+            )
         )(*parts))[:, :n]
     return ids

@@ -161,7 +161,9 @@ def _finalize(hist, *, n_slots, n_bins, f_true, window, n_channels,
 
 # No donation on purpose: xb/payload/slot are level-loop invariants the
 # builders reuse across every level and chunk of a build, and the scan
-# carry (the packed histogram) has no input-aliasable shape.
+# carry (the packed histogram) has no input-aliasable shape. Re-audited
+# under GL08 (donation-after-use): donating here would be the GL08 bug —
+# every level's next histogram call re-reads all three inputs.
 # graftlint: disable=GL05
 @functools.partial(
     jax.jit,

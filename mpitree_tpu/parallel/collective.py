@@ -396,4 +396,7 @@ def make_update_fn(mesh, *, n_slots: int):
         in_specs=(P(DATA_AXIS), P(DATA_AXIS, None), P(), P(), P(), P(), P(), P()),
         out_specs=P(DATA_AXIS),
     )
+    # nid donated: the level loop's canonical `nid_d = update_fn(nid_d, ..)`
+    # rebind consumes the old buffer each call — GL08 (donation-after-use)
+    # holds every caller to that shape.
     return jax.jit(sharded, donate_argnums=(0,))

@@ -1,0 +1,68 @@
+"""Dataflow-engine fixture: propagation cases asserting exact traced sets.
+
+No ``# expect:`` markers on purpose — every function here is CLEAN under
+all rules. ``tests/test_graftlint_dataflow.py`` builds a Project over this
+file and asserts the exact per-function traced-name sets, so a rule
+regression is attributable to propagation vs. matching.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def tuple_unpack(x, y):
+    a, b = x * 2, 3        # element-wise: a traced, b static
+    n, f = x.shape         # laundered: neither traced
+    c = a + n
+    return b + c
+
+
+@jax.jit
+def cond_closure(x, flag01):
+    total = x.sum()
+
+    def on_true(op):
+        return op + total  # total rides in through the closure
+
+    def on_false(op):
+        return op
+
+    return lax.cond(flag01 == 1, on_true, on_false, x)
+
+
+@partial(jax.jit, donate_argnames=("xs",))
+def scan_carry(xs):
+    def body(carry, row):
+        nxt = carry + row.sum()
+        return nxt, nxt * 0
+
+    out, hist = lax.scan(body, jnp.float32(0.0), xs)
+    return out + hist.sum()
+
+
+@jax.jit
+def lambda_capture(x):
+    shift = x.mean()
+    f = lambda v: v - shift  # noqa: E731 — the capture under test
+    return f(x)
+
+
+def helper(z):
+    return jnp.exp(z)
+
+
+@jax.jit
+def through_call(x):
+    e = helper(x)
+    s = e.shape[0]         # laundered back to static
+    return e * s
+
+
+@jax.jit
+def comp_case(xs):
+    parts = [p * 2 for p in (xs, xs)]
+    return parts[0]

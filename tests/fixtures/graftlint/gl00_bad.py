@@ -1,0 +1,23 @@
+"""Seeded GL00 violations: suppressions that no longer suppress anything.
+
+The RUF100-style audit: a dead directive reads as load-bearing forever —
+after a refactor fixes the underlying finding, the stale comment is the
+finding.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def fixed_long_ago(x):
+    total = jnp.sum(x, dtype=jnp.float32)
+    return total * 2  # graftlint: disable=GL01  # expect: GL00
+
+
+@jax.jit
+def wrong_rule_listed(x):
+    # the GL04 half is live, the GL03 half never fires here
+    # graftlint: disable=GL03  # expect: GL00
+    # graftlint: disable=GL04
+    return jnp.zeros((8, 128)) + x

@@ -1,0 +1,49 @@
+"""Seeded GL06 violations: undisciplined host callbacks in device code."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
+
+
+def log_host(x):
+    # host-side sink — reachability must NOT treat this as device code
+    print(np.asarray(x).sum())
+
+
+def stats_host():
+    return np.float32(0.0)
+
+
+@jax.jit
+def undirected_callback(x):
+    jax.debug.callback(log_host, x)  # expect: GL06
+    return x * 2
+
+
+@jax.jit
+def no_result_shapes(x):
+    # graftlint: host-callback — deliberate host fetch
+    y = jax.pure_callback(stats_host)  # expect: GL06
+    return x + y
+
+
+@jax.jit
+def traced_result_shapes(x):
+    total = x.sum()
+    # graftlint: host-callback — deliberate host fetch
+    return x + jax.pure_callback(
+        stats_host,
+        jnp.zeros_like(total),  # expect: GL06
+    )
+
+
+@jax.jit
+def closure_over_traced(x):
+    scale = x * 2
+
+    def fetch():
+        return np.asarray(scale).sum()
+
+    # graftlint: host-callback — deliberate host fetch
+    return x + io_callback(fetch, jax.ShapeDtypeStruct((), np.float32))  # expect: GL06

@@ -1,0 +1,62 @@
+"""GL06 negative cases: disciplined host callbacks produce no findings."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import io_callback
+
+
+def log_host(x):
+    print(np.asarray(x).sum())
+
+
+def fetch_host(x):
+    return np.asarray(x, np.float32).sum()
+
+
+@jax.jit
+def directed_callback(x):
+    # graftlint: host-callback — training-loop progress sink
+    jax.debug.callback(log_host, x)
+    return x * 2
+
+
+@jax.jit
+def static_result_shapes(x):
+    # shapes derived through .shape/.dtype laundering are static
+    out_spec = jax.ShapeDtypeStruct((), x.dtype)
+    # graftlint: host-callback — deliberate host reduction
+    y = jax.pure_callback(fetch_host, out_spec, x)
+    return x + y
+
+
+@jax.jit
+def operands_not_closures(x):
+    scale = x * 2
+    # graftlint: host-callback — scale rides as an explicit operand
+    return x + io_callback(
+        fetch_host, jax.ShapeDtypeStruct((), np.float32), scale
+    )
+
+
+def host_side_callback_free(x):
+    # callbacks in plain host code are not policed
+    return jnp.asarray(fetch_host(x))
+
+
+SCALE = 2.0
+
+
+def global_reader(v):
+    # free name `scale`... no: `SCALE` resolves to the module global —
+    # it must NOT collide with a caller local of the same spelling
+    return np.float32(SCALE) * np.asarray(v).sum()
+
+
+@jax.jit
+def name_collision_is_not_a_leak(x):
+    SCALE = x * 3  # noqa: F841 — the collision under test
+    # graftlint: host-callback — deliberate host reduction
+    return x + jax.pure_callback(
+        global_reader, jax.ShapeDtypeStruct((), np.float32), x
+    )

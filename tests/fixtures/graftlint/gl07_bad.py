@@ -1,0 +1,77 @@
+"""Seeded GL07 violations: Pallas kernel hygiene breaks."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def doubler(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def bf16_sublane_too_short():
+    # (8, 128) satisfies the f32 floor (GL04-silent) but bf16 tiles 16-row
+    # sublanes: the out block must be a multiple of (16, 128)
+    return pl.pallas_call(
+        doubler,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),  # expect: GL07
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.bfloat16),
+    )
+
+
+def grid_undercovers_rows():
+    # 2 grid steps x 8-row blocks cover 16 of 32 output rows
+    return pl.pallas_call(
+        doubler,
+        grid=(2,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),  # expect: GL07
+        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+    )
+
+
+def vmem_blowout():
+    # 8 MiB in-block + 8 MiB out-block (double-buffered -> 16 MiB) blow
+    # the ~10 MiB per-step budget: Mosaic fails allocation on hardware
+    return pl.pallas_call(  # expect: GL07
+        doubler,
+        grid=(2,),
+        in_specs=[pl.BlockSpec((4096, 512), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((4096, 512), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((8192, 512), jnp.float32),
+    )
+
+
+def const_offset_leaves_prefix_uncovered():
+    # a constant index map writes exactly ONE block; at offset 1 the
+    # first 8 rows are never visited
+    return pl.pallas_call(
+        doubler,
+        grid=(1,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (1, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (1, 0)),  # expect: GL07
+        out_shape=jax.ShapeDtypeStruct((16, 128), jnp.float32),
+    )
+
+
+def kernel_partial(scale, x_ref, o_ref):
+    o_ref[...] = x_ref[...] * scale
+
+
+def grid_spec_binding_resolves():
+    # grid/in_specs/out_specs riding a PrefetchScalarGridSpec-style local
+    # binding still resolve (the ops/wide_hist.py idiom)
+    grid_spec = pl.GridSpec(
+        grid=(2,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),  # expect: GL07
+    )
+    return pl.pallas_call(
+        functools.partial(kernel_partial, 2.0),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((64, 128), jnp.float32),
+    )

@@ -1,0 +1,42 @@
+"""Seeded GL08 violations: donated buffers read after the call."""
+
+import jax
+from functools import partial
+
+
+def advance(nid, xb):
+    return nid + xb.sum(axis=1).astype(nid.dtype)
+
+
+def read_after_donation(xb, nid0):
+    step = jax.jit(advance, donate_argnums=(0,))
+    out = step(nid0, xb)
+    return out + nid0.sum()  # expect: GL08
+
+
+def loop_without_rebind(xb, nid0):
+    step = jax.jit(advance, donate_argnums=(0,))
+    out = None
+    for _ in range(4):
+        out = step(nid0, xb)  # expect: GL08
+    return out
+
+
+def make_step():
+    return jax.jit(advance, donate_argnums=(0,))
+
+
+def factory_caller(xb, nid0):
+    step = make_step()
+    acc = step(nid0, xb)
+    return acc * nid0  # expect: GL08
+
+
+@partial(jax.jit, donate_argnames=("state",))
+def consume(state, x):
+    return state + x
+
+
+def decorated_caller(state, x):
+    y = consume(state, x)
+    return y + state.mean()  # expect: GL08

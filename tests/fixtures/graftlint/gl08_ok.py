@@ -1,0 +1,54 @@
+"""GL08 negative cases: donation used the way the contract intends."""
+
+import jax
+from functools import partial
+
+
+def advance(nid, xb):
+    return nid + xb.sum(axis=1).astype(nid.dtype)
+
+
+def rebind_level_loop(xb, nid0):
+    # the canonical fused-builder shape: each call consumes the previous
+    # buffer and rebinds the name to the fresh output
+    step = jax.jit(advance, donate_argnums=(0,))
+    for _ in range(4):
+        nid0 = step(nid0, xb)
+    return nid0
+
+
+def last_use_at_call(xb, nid0):
+    step = jax.jit(advance, donate_argnums=(0,))
+    return step(nid0, xb)
+
+
+def fresh_expression_donated(xb, nid0):
+    step = jax.jit(advance, donate_argnums=(0,))
+    out = step(nid0 * 2, xb)
+    return out + nid0.sum()  # nid0 itself was never donated
+
+
+def restore_before_read(xb, nid0):
+    step = jax.jit(advance, donate_argnums=(0,))
+    out = step(nid0, xb)
+    nid0 = jax.device_put(out)
+    return out + nid0.sum()  # reads the fresh binding, not the donated one
+
+
+@partial(jax.jit, donate_argnames=("state",))
+def consume(state, x):
+    return state + x
+
+
+def read_other_args_freely(state, x):
+    y = consume(state, x)
+    return y + x.sum()  # x is not donated; reading it stays legal
+
+
+def metadata_survives_donation(xb, nid0):
+    # .shape/.ndim/len() read the retained aval, never the released
+    # buffer — legal after donation
+    step = jax.jit(advance, donate_argnums=(0,))
+    out = step(nid0, xb)
+    assert out.shape == nid0.shape and len(nid0) == nid0.shape[0]
+    return out

@@ -1,0 +1,16 @@
+"""Seeded violations inside wrapper-rooted lambdas (synthetic FuncInfos).
+
+The PR-1 engine could not root ``jax.vmap(lambda ...)`` (ROADMAP: "lambdas
+aren't FuncInfos") — a sync in a vmapped lambda body escaped every rule.
+"""
+
+import jax
+
+per_row_sync = jax.vmap(lambda row: row.sum().item())  # expect: GL01
+
+jitted_coercion = jax.jit(lambda x: float(x.mean()))  # expect: GL01
+
+
+def factory(xs):
+    # rooted through a call argument inside a host function too
+    return jax.vmap(lambda r: r.max().item())(xs)  # expect: GL01

@@ -1,0 +1,18 @@
+"""Lambda negative cases: rooted lambdas with clean bodies, inert lambdas."""
+
+import jax
+import jax.numpy as jnp
+
+scale_rows = jax.vmap(lambda row: row / jnp.maximum(row.sum(), 1.0))
+
+shift = jax.jit(lambda x, lo: x - lo)
+
+
+def host_lambdas(pairs):
+    # lambdas in plain host code stay host: sort keys may coerce freely
+    return sorted(pairs, key=lambda p: float(p[1]))
+
+
+def index_maps(row_tile):
+    # BlockSpec-style index lambdas are device by containment but inert
+    return (lambda i: (i, 0))(row_tile)

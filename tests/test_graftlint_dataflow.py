@@ -1,0 +1,94 @@
+"""Unit tests for the traced-value dataflow engine itself.
+
+Rule tests assert findings; these assert the *propagation substrate* — the
+exact traced-name set per function over ``dataflow_cases.py`` — so a rule
+regression is attributable: wrong set here means propagation broke, right
+set with a wrong finding means matching broke.
+
+Pure AST — no JAX import, runs on any lint host.
+"""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURE = REPO / "tests" / "fixtures" / "graftlint" / "dataflow_cases.py"
+sys.path.insert(0, str(REPO))
+
+from tools.graftlint.engine import Project  # noqa: E402
+
+
+def _project():
+    return Project([str(FIXTURE)])
+
+
+def _fn(project, qualname):
+    return project.modules[0].functions[qualname]
+
+
+def _lambda_child(fn):
+    [lam] = fn.lambda_children
+    return lam
+
+
+def test_tuple_unpack_is_elementwise_and_shape_launders():
+    p = _project()
+    fn = _fn(p, "tuple_unpack")
+    # a traced through the tuple element, c through a; b is the static
+    # element, n/f laundered by .shape
+    assert p.dataflow.traced(fn) == {"x", "y", "a", "c"}
+    assert p.dataflow.returns_traced(fn)
+
+
+def test_cond_branch_closure_captures_tracedness():
+    p = _project()
+    on_true = _fn(p, "cond_closure.on_true")
+    on_false = _fn(p, "cond_closure.on_false")
+    # branch params are traced by the control-flow seeding; `total` enters
+    # on_true through the closure edge and must NOT leak into on_false
+    assert p.dataflow.traced(on_true) == {"op", "total"}
+    assert p.dataflow.traced(on_false) == {"op"}
+    assert on_true.is_device and on_false.is_device
+
+
+def test_scan_body_carry_and_locals():
+    p = _project()
+    body = _fn(p, "scan_carry.body")
+    assert p.dataflow.traced(body) == {"carry", "row", "nxt"}
+    # the scan RESULT taints the caller's unpacked targets
+    outer = _fn(p, "scan_carry")
+    assert {"out", "hist"} <= p.dataflow.traced(outer)
+
+
+def test_lambda_is_a_funcinfo_with_closure_capture():
+    p = _project()
+    outer = _fn(p, "lambda_capture")
+    lam = _lambda_child(outer)
+    assert lam.is_lambda and lam.is_device
+    assert p.dataflow.traced(lam) == {"v", "shift"}
+    # the lambda EXPRESSION itself must not taint the name `f`
+    assert "f" not in p.dataflow.traced(outer)
+
+
+def test_interprocedural_return_taints_call_targets():
+    p = _project()
+    helper = _fn(p, "helper")
+    assert helper.is_device  # reached from a jit root
+    assert p.dataflow.returns_traced(helper)
+    outer = _fn(p, "through_call")
+    traced = p.dataflow.traced(outer)
+    assert "e" in traced       # tainted by helper's traced return
+    assert "s" not in traced   # .shape launders
+
+
+def test_comprehension_variable_traced_from_iterable():
+    p = _project()
+    fn = _fn(p, "comp_case")
+    assert {"p", "parts"} <= p.dataflow.traced(fn)
+
+
+def test_fixture_is_finding_free():
+    from tools.graftlint.engine import run_lint
+
+    findings, _ = run_lint([str(FIXTURE)])
+    assert findings == [], [f.format_human() for f in findings]

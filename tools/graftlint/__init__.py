@@ -1,16 +1,24 @@
 """graftlint — JAX-aware static analysis for the mpitree_tpu framework.
 
-Enforces the device-boundary, recompile, collective and dtype invariants
-the TPU engines depend on (see each ``rules/glXX_*`` module), on every
-CPU-only CI run. Public API: :func:`run_lint`, :class:`Finding`.
+Enforces the device-boundary, recompile, collective, dtype, donation,
+host-callback and Pallas invariants the TPU engines depend on (see each
+``rules/glXX_*`` module), on every CPU-only CI run, over an
+interprocedural traced-value dataflow (``dataflow.py``). Public API:
+:func:`run_lint`, :class:`Finding`, plus the baseline helpers the CLI's
+``--baseline`` CI gate is built on.
 """
 
 from tools.graftlint.engine import (
     Finding,
     GraftlintError,
     Project,
+    apply_baseline,
+    load_baseline,
     run_lint,
 )
 
-__all__ = ["Finding", "GraftlintError", "Project", "run_lint"]
-__version__ = "0.1.0"
+__all__ = [
+    "Finding", "GraftlintError", "Project", "apply_baseline",
+    "load_baseline", "run_lint",
+]
+__version__ = "0.2.0"

@@ -53,6 +53,32 @@ def str_const(node: ast.AST) -> str | None:
     return None
 
 
+def int_tuple(node: ast.AST) -> tuple | None:
+    """Tuple/list-of-int-constants literal, a single int, or None.
+
+    The ``donate_argnums=(0, 2)`` / ``grid=(4,)`` literal shapes GL07/GL08
+    resolve; bools are not ints here (``True`` is not an argument index).
+    """
+    def one(n: ast.AST) -> int | None:
+        if isinstance(n, ast.Constant) and isinstance(n.value, int) \
+                and not isinstance(n.value, bool):
+            return n.value
+        return None
+
+    v = one(node)
+    if v is not None:
+        return (v,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            v = one(el)
+            if v is None:
+                return None
+            out.append(v)
+        return tuple(out)
+    return None
+
+
 def str_tuple(node: ast.AST) -> tuple | None:
     """Tuple/list-of-string-constants literal, a single string, or None."""
     s = str_const(node)
@@ -162,12 +188,23 @@ def strip_static_contexts(expr: ast.AST) -> list:
 
     ``x.shape``, ``len(x)``, ``x.ndim`` never carry tracedness out — a name
     referenced only inside such a subtree is not a traced use (the pervasive
-    ``N, F = xb.shape`` idiom in ops/).
+    ``N, F = xb.shape`` idiom in ops/). Lambda subtrees are excluded too: a
+    lambda *expression* is a function value, never a traced array — its body
+    is analyzed as a synthetic FuncInfo, not in place.
     """
     out: list = []
 
     def visit(n: ast.AST) -> None:
         if isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS:
+            return
+        if isinstance(n, ast.Lambda):
+            return
+        if isinstance(n, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in n.ops
+        ):
+            # identity tests never read a value: `x is None` on a traced
+            # array is a concrete Python bool (the pervasive optional-
+            # operand idiom in ops/impurity.py), not a concretization
             return
         if isinstance(n, ast.Call):
             fn = dotted_name(n.func)
@@ -181,41 +218,62 @@ def strip_static_contexts(expr: ast.AST) -> list:
     return out
 
 
-def refs_traced(expr: ast.AST, traced: frozenset) -> bool:
-    """Whether ``expr`` uses a traced name outside static contexts."""
-    return any(
-        isinstance(n, ast.Name) and n.id in traced
-        for n in strip_static_contexts(expr)
-    )
+def target_names(target: ast.AST):
+    """Name ids assigned by a (possibly tuple/starred) assignment target."""
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name):
+            yield n.id
 
 
-def propagate_traced(func: ast.FunctionDef, seed: frozenset) -> frozenset:
-    """Forward-propagate tracedness through straight-line assignments.
-
-    One pass in statement order over the function's own body (nested defs
-    excluded — they are separate analysis units): a target assigned from an
-    expression that uses a traced name becomes traced; shape/len contexts
-    launder it back to static. Loops/branches are not iterated to fixpoint —
-    sound enough for the flat jit wrappers this repo writes, and the miss
-    direction is a skipped check, not a false finding.
+def bound_names(func: ast.AST) -> frozenset:
+    """Names the function binds locally: params, assignment/loop/with
+    targets, walrus targets, comprehension variables, nested def names,
+    and imports. Everything referenced but not bound is a *free* name —
+    the closure-capture edge the dataflow engine propagates through.
     """
-    traced = set(seed)
+    out: set = set()
+    a = getattr(func, "args", None)
+    if a is not None:
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            out.add(p.arg)
+        if a.vararg is not None:
+            out.add(a.vararg.arg)
+        if a.kwarg is not None:
+            out.add(a.kwarg.arg)
     for stmt in own_statements(func):
-        targets: list = []
-        value = None
-        if isinstance(stmt, ast.Assign):
-            targets, value = stmt.targets, stmt.value
-        elif isinstance(stmt, ast.AugAssign):
-            targets, value = [stmt.target], stmt.value
-        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
-            targets, value = [stmt.target], stmt.value
-        if value is None or not refs_traced(value, frozenset(traced)):
-            continue
-        for t in targets:
-            for n in ast.walk(t):
-                if isinstance(n, ast.Name):
-                    traced.add(n.id)
-    return frozenset(traced)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            out.add(stmt.name)
+        elif isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                out.update(target_names(t))
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            out.update(target_names(stmt.target))
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            out.update(target_names(stmt.target))
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    out.update(target_names(item.optional_vars))
+        elif isinstance(stmt, (ast.Import, ast.ImportFrom)):
+            for alias in stmt.names:
+                out.add((alias.asname or alias.name).split(".")[0])
+    for n in own_nodes(func):
+        if isinstance(n, ast.NamedExpr):
+            out.update(target_names(n.target))
+        elif isinstance(n, ast.comprehension):
+            out.update(target_names(n.target))
+    return frozenset(out)
+
+
+def free_names(func: ast.AST) -> frozenset:
+    """Load-context names referenced in ``func`` but bound elsewhere."""
+    bound = bound_names(func)
+    return frozenset(
+        n.id for n in own_nodes(func)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+        and n.id not in bound
+    )
 
 
 def own_statements(func: ast.AST):
@@ -232,13 +290,19 @@ def own_statements(func: ast.AST):
 
 
 def own_nodes(func: ast.AST):
-    """Every AST node lexically in ``func``, excluding nested ``def`` bodies
-    (separate functions) but INCLUDING lambdas (traced in-place)."""
+    """Every AST node lexically in ``func``, excluding nested ``def`` AND
+    ``lambda`` bodies — both are separate analysis units (lambdas are
+    rooted as synthetic FuncInfos by the engine). The lambda node itself
+    is still yielded (it is an expression in this scope)."""
     def visit(n: ast.AST):
         yield n
+        # any FunctionDef reaching here is a NESTED def (the root's body
+        # statements are dispatched below, never the root itself) — its
+        # body belongs to its own FuncInfo, stop descending
+        if isinstance(n, (ast.Lambda, ast.FunctionDef,
+                          ast.AsyncFunctionDef)):
+            return
         for child in ast.iter_child_nodes(n):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
             yield from visit(child)
 
     for stmt in getattr(func, "body", []):

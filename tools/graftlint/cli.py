@@ -2,6 +2,21 @@
 
 Exit status: 0 clean, 1 findings, 2 usage error — the same contract as
 ruff's, so CI treats both lint steps identically.
+
+Output formats:
+
+- ``human`` (default): one ``path:line:col: RULE message`` line per
+  finding, summary on stderr — the ``make lint-graft`` view.
+- ``json``: a version-pinned object (``tests/test_graftlint.py`` holds the
+  golden schema) for tooling.
+- ``github``: GitHub Actions annotation lines (``::error file=...``) so CI
+  findings land inline on the PR diff.
+
+Baseline workflow: ``--baseline [FILE]`` diffs findings against a
+checked-in snapshot (default ``tools/graftlint/baseline.json``) and fails
+only on NEW findings — a strict rule family can land while pre-existing
+annotated sites are burned down. ``--write-baseline [FILE]`` regenerates
+the snapshot from the current findings (``make lint-baseline``).
 """
 
 from __future__ import annotations
@@ -10,7 +25,37 @@ import argparse
 import json
 import sys
 
-from tools.graftlint.engine import GraftlintError, run_lint
+from tools.graftlint.engine import (
+    GraftlintError,
+    apply_baseline,
+    load_baseline,
+    run_lint,
+)
+
+DEFAULT_BASELINE = "tools/graftlint/baseline.json"
+JSON_SCHEMA_VERSION = 1
+
+
+def _print_json(findings, suppressed, known_count):
+    print(json.dumps(
+        {
+            "version": JSON_SCHEMA_VERSION,
+            "findings": [f.as_dict() for f in findings],
+            "suppressed": suppressed,
+            "baselined": known_count,
+        },
+        indent=2,
+    ))
+
+
+def _print_github(findings):
+    for f in findings:
+        # the message is a single line by construction; commas/colons are
+        # legal in the free-text part of an annotation
+        print(
+            f"::error file={f.path},line={f.line},col={f.col},"
+            f"title=graftlint {f.rule}::{f.message}"
+        )
 
 
 def main(argv: list | None = None) -> int:
@@ -18,8 +63,9 @@ def main(argv: list | None = None) -> int:
         prog="graftlint",
         description=(
             "JAX-aware static analysis for mpitree_tpu: host-sync (GL01), "
-            "recompile (GL02), collective (GL03) and dtype/tiling (GL04) "
-            "invariants."
+            "recompile (GL02), collective (GL03), dtype/tiling (GL04), "
+            "donation (GL05/GL08), host-callback (GL06) and Pallas (GL07) "
+            "invariants, plus the GL00 unused-suppression audit."
         ),
     )
     parser.add_argument(
@@ -27,11 +73,23 @@ def main(argv: list | None = None) -> int:
         help="files or package directories to lint (default: mpitree_tpu)",
     )
     parser.add_argument(
-        "--format", choices=("human", "json"), default="human",
+        "--format", choices=("human", "json", "github"), default="human",
     )
     parser.add_argument(
         "--select", metavar="RULES",
         help="comma-separated rule ids to run (e.g. GL01,GL03)",
+    )
+    parser.add_argument(
+        "--baseline", nargs="?", const=DEFAULT_BASELINE, metavar="FILE",
+        help=(
+            "diff findings against a baseline snapshot and fail only on "
+            f"new ones (default file: {DEFAULT_BASELINE})"
+        ),
+    )
+    parser.add_argument(
+        "--write-baseline", nargs="?", const=DEFAULT_BASELINE,
+        metavar="FILE",
+        help="write the current findings as the new baseline, then exit 0",
     )
     parser.add_argument(
         "--list-rules", action="store_true",
@@ -59,27 +117,69 @@ def main(argv: list | None = None) -> int:
                 file=sys.stderr,
             )
             return 2
+        if rules == ["GL00"]:
+            # GL00 audits the suppressions of rules that RAN — alone it
+            # could only report a guaranteed-empty (misleadingly green)
+            # result
+            print(
+                "graftlint: --select GL00 needs the rules whose "
+                "suppressions it audits — add them (e.g. GL00,GL01) or "
+                "drop --select",
+                file=sys.stderr,
+            )
+            return 2
 
     try:
         findings, suppressed = run_lint(args.paths, rules)
+
+        if args.write_baseline:
+            payload = {
+                "version": JSON_SCHEMA_VERSION,
+                "findings": [f.as_dict() for f in findings],
+            }
+            with open(args.write_baseline, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh, indent=2)
+                fh.write("\n")
+            print(
+                f"graftlint: baseline {args.write_baseline} written "
+                f"({len(findings)} finding"
+                f"{'' if len(findings) == 1 else 's'})",
+                file=sys.stderr,
+            )
+            return 0
+
+        known_count = 0
+        if args.baseline:
+            findings, known = apply_baseline(
+                findings, load_baseline(args.baseline)
+            )
+            known_count = len(known)
     except GraftlintError as e:
         print(f"graftlint: {e}", file=sys.stderr)
         return 2
 
     if args.format == "json":
-        print(json.dumps(
-            {
-                "findings": [f.as_dict() for f in findings],
-                "suppressed": suppressed,
-            },
-            indent=2,
-        ))
+        _print_json(findings, suppressed, known_count)
+    elif args.format == "github":
+        _print_github(findings)
+        print(
+            f"graftlint: {len(findings)} new finding"
+            f"{'' if len(findings) == 1 else 's'}"
+            f" ({known_count} baselined, {suppressed} suppressed)",
+            file=sys.stderr,
+        )
     else:
         for f in findings:
             print(f.format_human())
-        tail = f" ({suppressed} suppressed)" if suppressed else ""
+        parts = []
+        if args.baseline:
+            parts.append(f"{known_count} baselined")
+        if suppressed:
+            parts.append(f"{suppressed} suppressed")
+        tail = f" ({', '.join(parts)})" if parts else ""
         print(
-            f"graftlint: {len(findings)} finding"
+            f"graftlint: {len(findings)}"
+            f"{' new' if args.baseline else ''} finding"
             f"{'' if len(findings) == 1 else 's'}{tail}",
             file=sys.stderr,
         )

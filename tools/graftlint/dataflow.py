@@ -1,0 +1,269 @@
+"""Interprocedural traced-value dataflow over the Project call graph.
+
+The PR-1 rules tracked tracedness with a single straight-line pass per
+function (``astutil.propagate_traced``), which meant a traced value escaped
+the moment it crossed a function boundary: into a ``lax.cond`` branch
+closure, out of a helper's ``return``, or into a lambda body. This engine
+replaces that pass with a flow-insensitive fixpoint over every function in
+the lint set:
+
+Seeds
+    - device-function parameters (``FuncInfo.traced_params``: everything
+      not statically-known or heuristically static),
+    - results of ``jnp.*`` / ``lax.*`` / ``jax.random.*`` calls — any
+      value a JAX primitive produces is an array under trace,
+    - parameters of functions passed to ``lax.cond`` / ``while_loop`` /
+      ``scan`` / ``fori_loop`` / ``switch`` / ``map`` (branch operands and
+      loop carries are traced by construction, whatever their names
+      suggest).
+
+Propagation
+    - assignments (including tuple-unpacking, element-wise when both sides
+      are literal tuples, ``AugAssign``, walrus),
+    - ``for`` targets of a traced iterable and comprehension variables,
+    - ``return``: a function whose return expression is traced marks
+      ``returns_traced``; call sites then taint their targets —
+      the interprocedural edge,
+    - closures: a free name in a nested def or lambda resolves through the
+      lexical parent chain; if the binding scope holds it traced, the
+      inner function does too — the ``lax.cond`` branch-closure edge.
+
+Laundering: ``x.shape`` / ``len(x)`` / ``x.ndim`` subtrees never carry
+tracedness out (``astutil.strip_static_contexts``), so the pervasive
+``N, F = xb.shape`` idiom stays static.
+
+Flow-insensitive on purpose: statement order and branch structure are
+ignored, so a name is traced if ANY binding in the function taints it.
+That over-approximates per-path truth in the one direction rules can
+tolerate — a spurious traced mark surfaces as a finding a human reviews,
+never as a silently skipped check. The known miss: tracedness entering a
+function through *call arguments* of non-device helpers is not modeled
+(device helpers already seed all non-static params).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint import astutil
+
+# Canonical-name prefixes whose call results are traced arrays. jax.jit /
+# jax.vmap / shard_map results are CALLABLES, not arrays — none of these
+# prefixes cover the wrapper namespaces.
+_TRACED_PREFIXES = (
+    "jax.numpy.",
+    "jax.lax.",
+    "jax.random.",
+    "jax.nn.",
+    "jax.scipy.",
+    "jax.ops.",
+)
+# Exceptions inside those namespaces whose results are Python values.
+_UNTRACED_CALLS = frozenset({
+    "jax.numpy.shape", "jax.numpy.ndim", "jax.numpy.size",
+    "jax.numpy.result_type", "jax.numpy.dtype", "jax.numpy.iinfo",
+    "jax.numpy.finfo",
+})
+# Control-flow combinators: every function-valued argument's parameters are
+# traced (operands, carries, loop indices), regardless of name heuristics.
+_CONTROL_FLOW = frozenset({
+    "jax.lax.cond", "jax.lax.switch", "jax.lax.while_loop",
+    "jax.lax.scan", "jax.lax.fori_loop", "jax.lax.map",
+    "jax.lax.associative_scan",
+})
+
+_MAX_PASSES = 50  # >> any real closure-nesting depth; fixpoint guard
+
+
+class Dataflow:
+    """Per-function traced-name sets, shared by every rule family.
+
+    ``traced(fn)`` is the set of local names holding (possibly) traced
+    values inside ``fn``; ``returns_traced(fn)`` whether a call of ``fn``
+    produces one. Sets exist for host functions too (a host-held jnp
+    result is a device array a closure can smuggle into device code) —
+    rules decide which functions' sets they consult.
+    """
+
+    def __init__(self, project):
+        self.project = project
+        self._sets: dict = {}      # id(FuncInfo) -> set[str]
+        self._returns: dict = {}   # id(FuncInfo) -> bool
+        self._bound: dict = {}     # id(FuncInfo) -> frozenset[str]
+        self._free: dict = {}      # id(FuncInfo) -> frozenset[str]
+        self._fns: list = []
+        self._facts: dict = {}     # id(expr) -> (names, prefix?, targets)
+        self._work: dict = {}      # id(FuncInfo) -> precomputed body facts
+        for mod in project.modules:
+            for fn in mod.functions.values():
+                self._fns.append(fn)
+                self._sets[id(fn)] = (
+                    set(fn.traced_params()) if fn.is_device else set()
+                )
+                self._returns[id(fn)] = False
+                self._bound[id(fn)] = astutil.bound_names(fn.node)
+                self._free[id(fn)] = astutil.free_names(fn.node)
+        for fn in self._fns:
+            self._work[id(fn)] = self._body_facts(fn)
+        self._seed_control_flow_params()
+        self._run()
+
+    # -- public view -------------------------------------------------------
+    def traced(self, fn) -> frozenset:
+        return frozenset(self._sets.get(id(fn), ()))
+
+    def returns_traced(self, fn) -> bool:
+        return self._returns.get(id(fn), False)
+
+    def free(self, fn) -> frozenset:
+        """Free (closure-captured) names of ``fn`` — GL06's leak check."""
+        return self._free.get(id(fn), frozenset())
+
+    def captured_traced(self, fn) -> frozenset:
+        """Free names of ``fn`` that are traced in their binding scope."""
+        out = set()
+        for name in self._free.get(id(fn), ()):
+            anc = fn.parent
+            while anc is not None:
+                if name in self._bound.get(id(anc), ()):
+                    if name in self._sets.get(id(anc), ()):
+                        out.add(name)
+                    break
+                anc = anc.parent
+        return frozenset(out)
+
+    # -- tracedness of one expression --------------------------------------
+    def expr_traced(self, mod, scope, expr: ast.AST, traced) -> bool:
+        """Whether ``expr`` carries a traced value, given the scope's set.
+
+        A Name in ``traced`` outside shape/len laundering, or a call whose
+        result is traced (jnp/lax primitive, or a project function with
+        ``returns_traced``). Facts per expression are extracted once and
+        cached — the fixpoint re-queries the same expressions every pass.
+        """
+        names, has_prefix, targets = self._expr_facts(mod, scope, expr)
+        if has_prefix or names & traced:
+            return True
+        return any(self._returns[id(t)] for t in targets)
+
+    def _expr_facts(self, mod, scope, expr: ast.AST):
+        facts = self._facts.get(id(expr))
+        if facts is not None:
+            return facts
+        names: set = set()
+        has_prefix = False
+        targets: list = []
+        for n in astutil.strip_static_contexts(expr):
+            if isinstance(n, ast.Name):
+                names.add(n.id)
+            elif isinstance(n, ast.Call):
+                cname = mod.canonical(n.func)
+                if (cname is not None and cname not in _UNTRACED_CALLS
+                        and any(cname.startswith(p)
+                                for p in _TRACED_PREFIXES)):
+                    has_prefix = True
+                t = self.project.resolve_function(mod, scope, n.func)
+                if t is not None:
+                    targets.append(t)
+        facts = (frozenset(names), has_prefix, tuple(targets))
+        self._facts[id(expr)] = facts
+        return facts
+
+    # -- fixpoint ----------------------------------------------------------
+    def _seed_control_flow_params(self) -> None:
+        for mod in self.project.modules:
+            for scope, call in self.project._walk_calls(mod):
+                if mod.canonical(call.func) not in _CONTROL_FLOW:
+                    continue
+                for arg in call.args:
+                    target = self.project.resolve_function(mod, scope, arg)
+                    if target is not None:
+                        self._sets[id(target)].update(target.params)
+
+    def _run(self) -> None:
+        for _ in range(_MAX_PASSES):
+            changed = False
+            for fn in self._fns:
+                if self._pass_one(fn):
+                    changed = True
+            if not changed:
+                return
+
+    def _body_facts(self, fn) -> list:
+        """One-time statement scan -> (kind, target-names, expr) work items
+        the fixpoint replays each pass without re-walking the AST.
+        """
+        items: list = []
+        for stmt in astutil.own_statements(fn.node):
+            targets: list = []
+            value = None
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AugAssign):
+                targets, value = [stmt.target], stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                items.append((
+                    frozenset(astutil.target_names(stmt.target)), stmt.iter
+                ))
+                continue
+            elif isinstance(stmt, ast.Return) and stmt.value is not None:
+                items.append((None, stmt.value))  # None = returns marker
+                continue
+            else:
+                continue
+            if value is None:
+                continue
+            # element-wise when both sides are same-length literal tuples:
+            # `a, b = x * 2, 3` taints a but not b
+            if (len(targets) == 1
+                    and isinstance(targets[0], (ast.Tuple, ast.List))
+                    and isinstance(value, (ast.Tuple, ast.List))
+                    and len(targets[0].elts) == len(value.elts)):
+                for t, v in zip(targets[0].elts, value.elts):
+                    items.append((
+                        frozenset(astutil.target_names(t)), v
+                    ))
+                continue
+            names = frozenset(
+                n for t in targets for n in astutil.target_names(t)
+            )
+            items.append((names, value))
+        # walrus and comprehension variables (expression-level bindings)
+        for n in astutil.own_nodes(fn.node):
+            if isinstance(n, ast.NamedExpr):
+                items.append((
+                    frozenset(astutil.target_names(n.target)), n.value
+                ))
+            elif isinstance(n, ast.comprehension):
+                items.append((
+                    frozenset(astutil.target_names(n.target)), n.iter
+                ))
+        return items
+
+    def _pass_one(self, fn) -> bool:
+        mod = fn.module
+        traced = self._sets[id(fn)]
+        before = len(traced)
+        returns_before = self._returns[id(fn)]
+
+        # closure capture from the lexical parent chain
+        traced.update(self.captured_traced(fn))
+
+        for targets, value in self._work[id(fn)]:
+            if targets is None:  # a Return expression
+                if not self._returns[id(fn)] and self.expr_traced(
+                    mod, fn, value, traced
+                ):
+                    self._returns[id(fn)] = True
+            elif not targets <= traced and self.expr_traced(
+                mod, fn, value, traced
+            ):
+                traced.update(targets)
+
+        # lambda bodies are separate units, but a lambda's Return-wrapped
+        # body contributes to THIS function's returns only via calls, which
+        # resolve_function already handles.
+        return (len(traced) != before
+                or self._returns[id(fn)] != returns_before)

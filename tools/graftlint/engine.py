@@ -1,26 +1,37 @@
 """graftlint engine: module model, jit-reachability, suppressions, runner.
 
 The analyzer answers one question the rule modules all depend on: *which
-functions execute under a JAX trace?* Roots are found four ways —
+functions execute under a JAX trace?* Roots are found five ways —
 
 - ``@jax.jit`` / ``@partial(jax.jit, static_argnames=...)`` decorators,
-- wrapper calls whose first argument resolves to a known function:
-  ``jax.jit(f, ...)``, ``jax.shard_map(f, ...)``, ``jax.vmap(f)``,
-  ``pl.pallas_call(kernel_or_partial(kernel), ...)``,
+- wrapper calls whose first argument resolves to a known function OR a
+  lambda: ``jax.jit(f, ...)``, ``jax.shard_map(f, ...)``, ``jax.vmap(f)``,
+  ``jax.vmap(lambda ...)``, ``pl.pallas_call(kernel_or_partial(kernel))``
+  — every lambda is rooted as a synthetic FuncInfo (body wrapped in a
+  Return), addressed by node identity,
 - the annotation convention: a ``# graftlint: device-fn`` comment on (or
   directly above) a ``def`` marks functions whose jit wrapping is indirect
   (e.g. ``fused_builder._make_build_body``'s inner ``build``, which reaches
   ``jax.shard_map`` only as a factory return value),
-- and transitively: any project function referenced (called OR passed as a
+- transitively: any project function referenced (called OR passed as a
   function value, covering ``lax.scan``/``fori_loop`` bodies) from a
-  device function is itself device code.
+  device function is itself device code,
+- and by containment: a lambda lexically inside a device function
+  (BlockSpec index maps, inline thunks) evaluates under the same trace.
 
 ``# graftlint: host-fn`` marks a deliberate host boundary: the function is
 never treated as device code and reachability does not descend into it.
+Functions handed to ``io_callback``/``pure_callback``/``debug.callback``
+are host implicitly (they run in Python — GL06 polices the call sites).
+
+On top of reachability the Project builds a :class:`~tools.graftlint.
+dataflow.Dataflow` — interprocedural traced-value sets every value-
+sensitive rule (GL01/GL02/GL06) shares.
 
 Suppressions: ``# graftlint: disable=GL01[,GL03]`` on the finding's line or
 the line directly above; ``# graftlint: disable-file=GL01`` anywhere
-disables a rule for the whole file.
+disables a rule for the whole file. Every suppression must earn its keep:
+one that matches no finding is itself flagged (GL00).
 """
 
 from __future__ import annotations
@@ -43,6 +54,13 @@ SHARD_MAP = frozenset({"jax.shard_map", "jax.experimental.shard_map.shard_map"})
 MAP_WRAPPERS = frozenset({"jax.vmap", "jax.pmap"})
 PALLAS_CALL = frozenset({"jax.experimental.pallas.pallas_call"})
 PARTIAL = frozenset({"functools.partial", "partial"})
+# Host-callback entry points: the function handed to these runs on HOST —
+# reachability must not descend into it (GL01 inside a callback body would
+# cry wolf), and GL06 polices the call sites instead.
+CALLBACKS = frozenset({
+    "jax.experimental.io_callback", "jax.experimental.pure_callback",
+    "jax.pure_callback", "jax.debug.callback",
+})
 
 _DIRECTIVE = re.compile(r"#\s*graftlint:\s*([\w-]+)\s*(?:=\s*([\w,\s]+))?")
 
@@ -64,17 +82,22 @@ class Finding:
 
 @dataclasses.dataclass
 class FuncInfo:
-    """One ``def`` (possibly nested), addressed by (module, qualname)."""
+    """One ``def`` or ``lambda`` (possibly nested), addressed by
+    (module, qualname). Lambdas carry a synthetic FunctionDef node whose
+    body is their expression wrapped in a Return, so every body-walking
+    helper treats both forms identically."""
 
     module: "ModuleInfo"
     qualname: str
     node: ast.FunctionDef
     parent: "FuncInfo | None"
+    is_lambda: bool = False
     # filled by Project:
     is_device: bool = False
     is_host: bool = False
     statics: frozenset | None = None  # known static_argnames, else None
     statics_known: bool = False
+    lambda_children: list = dataclasses.field(default_factory=list)
 
     @property
     def params(self) -> list:
@@ -112,10 +135,12 @@ class ModuleInfo:
         self.tree = ast.parse(source, filename=path)
         self.aliases: dict = {}
         self.functions: dict = {}  # qualname -> FuncInfo
+        self.lambda_infos: dict = {}  # id(ast.Lambda) -> FuncInfo
         self.constants: dict = {}  # module-level NAME -> str constant
-        self.file_disabled: set = set()
+        self.file_disabled: dict = {}  # rule -> directive line
         self.line_disabled: dict = {}  # line -> set of rules
         self.directive_lines: dict = {}  # line -> (directive, values)
+        self.suppression_hits: set = set()  # (line|'file', rule) that fired
         self._collect_directives()
         self._collect_imports()
         self._collect_functions()
@@ -147,7 +172,8 @@ class ModuleInfo:
             if kind == "disable":
                 self.line_disabled.setdefault(i, set()).update(rules)
             elif kind == "disable-file":
-                self.file_disabled.update(rules)
+                for r in rules:
+                    self.file_disabled.setdefault(r, i)
             else:
                 self.directive_lines[i] = (kind, rules)
 
@@ -169,7 +195,11 @@ class ModuleInfo:
         return False
 
     def suppressed(self, f: Finding) -> bool:
+        """Whether a suppression covers ``f`` — and which one: every match
+        is recorded in ``suppression_hits`` so the GL00 audit can flag the
+        directives that suppressed nothing."""
         if f.rule in self.file_disabled:
+            self.suppression_hits.add(("file", f.rule))
             return True
         for line in (f.line, f.line - 1):
             rules = self.line_disabled.get(line)
@@ -180,7 +210,25 @@ class ModuleInfo:
                     line - 1
                 ].lstrip().startswith("#"):
                     continue
+                self.suppression_hits.add(
+                    (line, f.rule if f.rule in rules else "ALL")
+                )
                 return True
+        return False
+
+    def directive_at(self, lineno: int, kind: str) -> bool:
+        """Directive of ``kind`` on ``lineno`` or in the contiguous
+        standalone-comment block directly above it (the GL06
+        ``host-callback`` convention, mirroring ``_directive_at_def``)."""
+        d = self.directive_lines.get(lineno)
+        if d and d[0] == kind:
+            return True
+        line = lineno - 1
+        while line >= 1 and self.lines[line - 1].lstrip().startswith("#"):
+            d = self.directive_lines.get(line)
+            if d and d[0] == kind:
+                return True
+            line -= 1
         return False
 
     # -- imports / functions / constants -----------------------------------
@@ -218,6 +266,30 @@ class ModuleInfo:
                 self.stack.pop()
 
             visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Lambda(self, node):
+                # Root every lambda as a synthetic FuncInfo: body wrapped
+                # in a Return so all body-walking helpers apply unchanged.
+                # Closes the ROADMAP "jax.vmap(lambda ...) isn't rooted"
+                # gap — resolve_function finds these by node identity.
+                parent = self.stack[-1] if self.stack else None
+                tag = f"<lambda:{node.lineno}:{node.col_offset}>"
+                qual = f"{parent.qualname}.{tag}" if parent else tag
+                ret = ast.Return(value=node.body)
+                ast.copy_location(ret, node.body)
+                fd = ast.FunctionDef(
+                    name="<lambda>", args=node.args, body=[ret],
+                    decorator_list=[],
+                )
+                ast.copy_location(fd, node)
+                info = FuncInfo(mod, qual, fd, parent, is_lambda=True)
+                mod.functions[qual] = info
+                mod.lambda_infos[id(node)] = info
+                if parent is not None:
+                    parent.lambda_children.append(info)
+                self.stack.append(info)
+                self.generic_visit(node)
+                self.stack.pop()
 
             def visit_ClassDef(self, node):
                 # methods index under the class name; scope chain unaffected
@@ -272,14 +344,20 @@ class Project:
             self.by_name[mod.name] = mod
         self.jit_sites: list = []  # (FuncInfo, wrapper_kind)
         self._mark_annotations()
+        self._mark_callback_targets()
         self._find_jit_roots()
         self._propagate_reachability()
         self.mesh_axes = self._collect_mesh_axes()
+        from tools.graftlint.dataflow import Dataflow
+
+        self.dataflow = Dataflow(self)
 
     # -- resolution --------------------------------------------------------
     def resolve_function(self, mod: ModuleInfo, scope: FuncInfo | None,
                          node: ast.AST) -> FuncInfo | None:
-        """Function a Name/Attribute refers to at a call/reference site."""
+        """Function a Name/Attribute/Lambda refers to at a call site."""
+        if isinstance(node, ast.Lambda):
+            return mod.lambda_infos.get(id(node))
         if isinstance(node, ast.Name):
             # lexical scope chain: nested defs of each enclosing function
             cur = scope
@@ -320,10 +398,25 @@ class Project:
     def _mark_annotations(self) -> None:
         for mod in self.modules:
             for fn in mod.functions.values():
+                if fn.is_lambda:
+                    continue  # synthetic defs carry no real comment lines
                 if mod._directive_at_def(fn.node, "device-fn"):
                     fn.is_device = True
                 if mod._directive_at_def(fn.node, "host-fn"):
                     fn.is_host = True
+
+    def _mark_callback_targets(self) -> None:
+        """Functions handed to io_callback/pure_callback/debug.callback run
+        on HOST — mark them host so reachability never descends into them
+        (their np.asarray/.item() bodies are the point, not a finding).
+        GL06 polices the call sites instead."""
+        for mod in self.modules:
+            for scope, call in self._walk_calls(mod):
+                if mod.canonical(call.func) not in CALLBACKS or not call.args:
+                    continue
+                target = self.resolve_function(mod, scope, call.args[0])
+                if target is not None:
+                    target.is_host = True
 
     def _jit_target(self, mod: ModuleInfo, scope: FuncInfo | None,
                     call: ast.Call):
@@ -398,7 +491,15 @@ class Project:
                     self.jit_sites.append((target, kind))
 
     def _walk_calls(self, mod: ModuleInfo):
-        """(enclosing FuncInfo | None, Call) pairs across the module."""
+        """(enclosing FuncInfo | None, Call) pairs across the module.
+
+        Materialized once per ModuleInfo: root discovery, mesh-axis
+        collection, dataflow seeding and four rule families all replay it.
+        """
+        cached = getattr(mod, "_call_sites", None)
+        if cached is not None:
+            return cached
+
         def visit(node, scope):
             for child in ast.iter_child_nodes(node):
                 child_scope = scope
@@ -408,11 +509,14 @@ class Project:
                         else child.name
                     )
                     child_scope = mod.functions.get(qual, scope)
+                elif isinstance(child, ast.Lambda):
+                    child_scope = mod.lambda_infos.get(id(child), scope)
                 if isinstance(child, ast.Call):
                     yield scope, child
                 yield from visit(child, child_scope)
 
-        yield from visit(mod.tree, None)
+        mod._call_sites = list(visit(mod.tree, None))
+        return mod._call_sites
 
     def _propagate_reachability(self) -> None:
         queue = [
@@ -420,19 +524,29 @@ class Project:
             if fn.is_device
         ]
         seen = set(id(f) for f in queue)
+
+        def enqueue(target):
+            if target.is_host or id(target) in seen:
+                return
+            target.is_device = True
+            seen.add(id(target))
+            queue.append(target)
+
         while queue:
             fn = queue.pop()
+            # a lambda lexically inside a device function evaluates under
+            # the same trace (BlockSpec index maps, sort keys, inline
+            # branch thunks) — device by containment
+            for lam in fn.lambda_children:
+                enqueue(lam)
             for node in astutil.own_nodes(fn.node):
                 # any resolvable function reference counts — called, passed
                 # to lax.scan/cond/fori_loop, or returned (tier factories)
                 if not isinstance(node, (ast.Name, ast.Attribute)):
                     continue
                 target = self.resolve_function(fn.module, fn, node)
-                if target is None or target.is_host or id(target) in seen:
-                    continue
-                target.is_device = True
-                seen.add(id(target))
-                queue.append(target)
+                if target is not None:
+                    enqueue(target)
 
     def device_functions(self):
         for mod in self.modules:
@@ -516,11 +630,47 @@ def _module_name(path: str) -> str:
     return ".".join(reversed(parts))
 
 
+def _unused_suppressions(project, selected_ids, rules_filter):
+    """GL00 — the RUF100 audit: a suppression that suppressed nothing is
+    itself a finding (dead directives read as load-bearing and rot).
+
+    A directive for rule R is only auditable when R actually ran this
+    invocation (R in the selected set, or no ``--select`` filter at all —
+    in which case a directive naming an unknown rule id is dead by
+    definition and flagged too). ``ALL`` suppressions are never audited.
+    GL00 findings are not themselves suppressible: the fix is deleting a
+    comment, never adding one.
+    """
+    for mod in project.modules:
+        entries = [
+            (line, r, (line, r))
+            for line, rs in mod.line_disabled.items() for r in rs
+        ] + [
+            (line, r, ("file", r))
+            for r, line in mod.file_disabled.items()
+        ]
+        for line, r, key in sorted(entries, key=lambda e: (e[0], e[1])):
+            if r == "ALL":
+                continue
+            if rules_filter is not None and r not in selected_ids:
+                continue  # rule didn't run — can't judge its suppressions
+            if key in mod.suppression_hits:
+                continue
+            scope = "file-wide " if key[0] == "file" else ""
+            yield Finding(
+                "GL00", mod.path, line, 0,
+                f"unused {scope}suppression: no {r} finding is silenced "
+                "by this directive — delete it",
+            )
+
+
 def run_lint(paths: list, rules: list | None = None) -> tuple:
     """Lint ``paths``; returns (findings, suppressed_count).
 
     ``rules``: optional rule-id filter (e.g. ["GL01"]). Findings are sorted
-    by (path, line, col, rule) and deduplicated.
+    by (path, line, col, rule) and deduplicated. The GL00 unused-suppression
+    audit runs after suppression resolution (it needs the hit accounting)
+    unless filtered out.
     """
     from tools.graftlint.rules import ALL_RULES
 
@@ -540,4 +690,54 @@ def run_lint(paths: list, rules: list | None = None) -> tuple:
             suppressed += 1
         else:
             findings.append(f)
+    if rules is None or "GL00" in rules:
+        selected_ids = {r.rule_id for r in selected}
+        findings.extend(_unused_suppressions(project, selected_ids, rules))
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings, suppressed
+
+
+def load_baseline(path: str) -> list:
+    """Parse a baseline file into a list of (rule, path, message) keys.
+
+    Line/col are deliberately NOT part of the key — unrelated edits shift
+    them, and a baseline that churns on every diff is a baseline nobody
+    regenerates honestly.
+    """
+    import json
+
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except OSError as e:
+        raise GraftlintError(f"cannot read baseline {path}: {e}") from e
+    except ValueError as e:
+        raise GraftlintError(f"cannot parse baseline {path}: {e}") from e
+    if not isinstance(data, dict) or "findings" not in data:
+        raise GraftlintError(
+            f"baseline {path}: expected an object with a 'findings' list"
+        )
+    return [
+        (f["rule"], f["path"].replace(os.sep, "/"), f["message"])
+        for f in data["findings"]
+    ]
+
+
+def apply_baseline(findings: list, baseline: list) -> tuple:
+    """Split ``findings`` into (new, known) against baseline keys.
+
+    Multiset matching: two identical findings in one file consume two
+    baseline entries — a third is new.
+    """
+    budget: dict = {}
+    for key in baseline:
+        budget[key] = budget.get(key, 0) + 1
+    new, known = [], []
+    for f in findings:
+        key = (f.rule, f.path.replace(os.sep, "/"), f.message)
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            known.append(f)
+        else:
+            new.append(f)
+    return new, known

@@ -1,4 +1,10 @@
-"""graftlint rule registry — one module per rule family."""
+"""graftlint rule registry — one module per rule family.
+
+GL00 (unused-suppression audit) lives in the engine itself: it needs the
+suppression-hit accounting that only exists after finding/suppression
+resolution, so it cannot be a ``check(project)`` rule. It is registered in
+``RULE_DOCS`` so ``--select``/``--list-rules`` treat it uniformly.
+"""
 
 from tools.graftlint.rules import (
     gl01_host_sync,
@@ -6,11 +12,17 @@ from tools.graftlint.rules import (
     gl03_collectives,
     gl04_dtype,
     gl05_donation,
+    gl06_callbacks,
+    gl07_pallas,
+    gl08_donation_use,
 )
 
 ALL_RULES = (gl01_host_sync, gl02_recompile, gl03_collectives, gl04_dtype,
-             gl05_donation)
+             gl05_donation, gl06_callbacks, gl07_pallas, gl08_donation_use)
 
 RULE_DOCS = {
     r.rule_id: (r.__doc__ or "").strip().splitlines()[0] for r in ALL_RULES
 }
+RULE_DOCS["GL00"] = (
+    "GL00 — unused suppression: a disable directive that silences nothing."
+)

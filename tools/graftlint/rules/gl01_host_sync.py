@@ -8,7 +8,10 @@ Two checks:
    trace these either raise ``ConcretizationTypeError`` at runtime or —
    worse, when the value happens to be concrete — silently insert a
    blocking transfer into what profiles as a device-only hot path
-   (VERDICT.md round 5's regression class).
+   (VERDICT.md round 5's regression class). Tracedness comes from the
+   interprocedural dataflow engine (``tools/graftlint/dataflow.py``), so
+   a value smuggled into a ``lax.cond`` branch closure, returned from a
+   helper, or captured by a vmapped lambda no longer escapes.
 
 2. Anywhere: ``.item()`` / ``.block_until_ready()`` inside a loop or
    comprehension body. A per-element sync turns one device fetch into N
@@ -34,7 +37,7 @@ _SYNC_ATTRS = frozenset({"item", "block_until_ready"})
 def _device_findings(project):
     for fn in project.device_functions():
         mod = fn.module
-        traced = astutil.propagate_traced(fn.node, fn.traced_params())
+        traced = project.dataflow.traced(fn)
         for node in astutil.own_nodes(fn.node):
             if not isinstance(node, ast.Call):
                 continue
@@ -53,8 +56,8 @@ def _device_findings(project):
                     f"jax.device_get inside device function '{fn.qualname}' "
                     "blocks the trace on a device fetch",
                 )
-            elif name in _NP_PULLS and node.args and astutil.refs_traced(
-                node.args[0], traced
+            elif name in _NP_PULLS and node.args and project.dataflow.expr_traced(
+                mod, fn, node.args[0], traced
             ):
                 yield Finding(
                     rule_id, mod.path, node.lineno, node.col_offset,
@@ -63,7 +66,9 @@ def _device_findings(project):
                     "(use jnp, or suppress if this is a real host boundary)",
                 )
             elif (name in _COERCIONS and len(node.args) == 1
-                  and astutil.refs_traced(node.args[0], traced)):
+                  and project.dataflow.expr_traced(
+                      mod, fn, node.args[0], traced
+                  )):
                 yield Finding(
                     rule_id, mod.path, node.lineno, node.col_offset,
                     f"{name}() coerces a traced value to a Python scalar in "

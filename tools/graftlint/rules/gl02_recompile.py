@@ -12,13 +12,15 @@ Checks, for functions wrapped by ``jax.jit`` with a statically-known
    is tens of seconds per miss.
 2. Every name listed in ``static_argnames`` must actually be a parameter
    (typo guard — a stale name silently makes the REAL parameter traced).
-3. Python ``if``/``while`` on a traced parameter (or a value derived from
-   one outside shape/len contexts) inside the jitted body: data-dependent
-   Python control flow either fails to trace or bakes one branch in
-   per-compile. Deliberately-traced runtime scalars (``chunk_lo``, ``mcw``)
+3. Python ``if``/``while`` on a traced value inside ANY device function —
+   not just direct jit roots. Tracedness comes from the interprocedural
+   dataflow engine, so data-dependent Python control flow inside a
+   ``lax.cond`` branch closure, a helper reached from a jit root, or a
+   rooted lambda no longer escapes. Data-dependent Python branches either
+   fail to trace (``ConcretizationTypeError``) or bake one branch in per
+   compile. Deliberately-traced runtime scalars (``chunk_lo``, ``mcw``)
    carry none of the static name/annotation markers, so they do not fire
-   check 1; shard_map-wrapped bodies (whose operands are all traced by
-   design) are out of scope entirely.
+   check 1; branching on them in Python still (correctly) fires check 3.
 """
 
 from __future__ import annotations
@@ -61,15 +63,21 @@ def check(project):
                     f"jitted '{fn.qualname}': static_argnames entry '{s}' "
                     "is not a parameter (typo leaves the real one traced)",
                 )
-        traced = astutil.propagate_traced(node, fn.traced_params())
-        for stmt in astutil.own_statements(node):
+    # check 3 covers every device function (dataflow-backed): nested branch
+    # closures and transitively-reached helpers included
+    for fn in project.device_functions():
+        mod = fn.module
+        traced = project.dataflow.traced(fn)
+        if not traced:
+            continue
+        for stmt in astutil.own_statements(fn.node):
             if not isinstance(stmt, (ast.If, ast.While)):
                 continue
-            if astutil.refs_traced(stmt.test, traced):
+            if project.dataflow.expr_traced(mod, fn, stmt.test, traced):
                 kw = "while" if isinstance(stmt, ast.While) else "if"
                 yield Finding(
                     rule_id, mod.path, stmt.lineno, stmt.col_offset,
-                    f"Python `{kw}` on a traced value in jitted "
+                    f"Python `{kw}` on a traced value in device function "
                     f"'{fn.qualname}' — use lax.cond/jnp.where, or mark "
                     "the driving parameter static",
                 )

@@ -1,0 +1,96 @@
+"""GL06 — host-callback policing inside device code.
+
+``io_callback`` / ``pure_callback`` / ``jax.debug.callback`` punch a hole
+through the device program back to Python. The engine already treats the
+callback *target* as host code (reachability never descends into it — its
+``np.asarray`` body is the point); this rule polices the hole itself, for
+every callback call reachable from a jit root:
+
+1. The call must carry an explicit ``# graftlint: host-callback`` directive
+   (same line or the standalone comment block above): a host round trip in
+   a device program is always a deliberate design decision and must read
+   as one — an undirected callback is indistinguishable from a leftover
+   debug hook.
+2. ``result_shape_dtypes`` must be present (io/pure_callback; debug.callback
+   returns nothing) and static: an expression that reads a traced value
+   (outside shape/len laundering) would concretize at trace time — the
+   result contract has to be computable before the program runs.
+3. The callback function must not close over traced values it doesn't
+   declare: a traced free variable in the callback body is dead at call
+   time on TPU (callbacks receive their operands as explicit arguments;
+   closures capture tracers, which hold garbage by the time the host runs).
+   Pass the value as an operand instead.
+"""
+
+from __future__ import annotations
+
+from tools.graftlint import astutil
+from tools.graftlint.engine import CALLBACKS, Finding
+
+rule_id = "GL06"
+
+# callbacks whose second positional argument is result_shape_dtypes
+_HAS_RESULT_SHAPES = frozenset({
+    "jax.experimental.io_callback", "jax.experimental.pure_callback",
+    "jax.pure_callback",
+})
+
+
+def _result_shapes_arg(call):
+    kw = astutil.keyword_arg(call, "result_shape_dtypes")
+    if kw is not None:
+        return kw
+    if len(call.args) >= 2:
+        return call.args[1]
+    return None
+
+
+def check(project):
+    for mod in project.modules:
+        for fn, call in project._walk_calls(mod):
+            if fn is None or not fn.is_device:
+                continue
+            name = mod.canonical(call.func)
+            if name not in CALLBACKS or not call.args:
+                continue
+            traced = project.dataflow.traced(fn)
+            short = name.rsplit(".", 1)[-1]
+            if not mod.directive_at(call.lineno, "host-callback"):
+                yield Finding(
+                    rule_id, mod.path, call.lineno, call.col_offset,
+                    f"{short} in device function '{fn.qualname}' without a "
+                    "'# graftlint: host-callback' directive — host round "
+                    "trips in device programs must be declared deliberate",
+                )
+            if name in _HAS_RESULT_SHAPES:
+                shapes = _result_shapes_arg(call)
+                if shapes is None:
+                    yield Finding(
+                        rule_id, mod.path, call.lineno, call.col_offset,
+                        f"{short} in '{fn.qualname}' without "
+                        "result_shape_dtypes — the result contract must be "
+                        "static before the program runs",
+                    )
+                elif project.dataflow.expr_traced(mod, fn, shapes, traced):
+                    yield Finding(
+                        rule_id, mod.path, shapes.lineno, shapes.col_offset,
+                        f"{short} result_shape_dtypes in '{fn.qualname}' "
+                        "reads a traced value — shapes/dtypes must be "
+                        "trace-time static (derive them from .shape/.dtype)",
+                    )
+            target = project.resolve_function(mod, fn, call.args[0])
+            if target is None:
+                continue
+            # free names resolve through the CALLBACK's own lexical chain
+            # (captured_traced), not the caller's namespace — a module-
+            # level callback whose free `x` is a global must not collide
+            # with a caller parameter that happens to share the name
+            leaked = sorted(project.dataflow.captured_traced(target))
+            if leaked:
+                yield Finding(
+                    rule_id, mod.path, call.lineno, call.col_offset,
+                    f"{short} callback '{target.qualname}' closes over "
+                    f"traced value(s) {', '.join(leaked)} — a captured "
+                    "tracer is garbage when the host runs; pass them as "
+                    "explicit operands",
+                )

@@ -1,0 +1,257 @@
+"""GL07 — Pallas kernel hygiene at ``pallas_call`` sites.
+
+The Mosaic failure modes this rule front-runs all share one property: they
+surface only at *hardware compile time* (or worse, as silent padding), so
+CPU CI never sees them. ``ops/pallas_hist.py`` and ``ops/wide_hist.py`` are
+the live targets; their dims are mostly symbolic (row_tile, S*C), which
+this rule skips — every check below fires only on facts it can prove from
+literals, the same conservative stance as the rest of graftlint.
+
+1. **Dtype-aware sublane tiling.** GL04 checks the dtype-agnostic f32
+   floor — last dim % 128, second-to-last % 8. But packed dtypes tile
+   taller: bf16 needs sublane multiples of 16, int8/fp8 of 32. When the
+   ``out_shape``'s ``ShapeDtypeStruct`` names a literal dtype, out-spec
+   block dims are held to the real multiple. Only values that PASS the
+   GL04 floor are flagged here (no double findings).
+2. **Grid×block bounds coverage.** For literal grids, literal block dims,
+   literal array dims and ``lambda i, ...: (...)`` index maps made of grid
+   names and constants: every array dim must be covered — a grid axis
+   mapping a block dim must satisfy ``grid[j] * block[d] >= dim``; an
+   unmapped (constant-indexed) dim needs ``block[d] >= dim``. An
+   under-covered output comes back partially uninitialized.
+3. **Static VMEM budget.** When every block dim of every spec is literal,
+   the per-grid-step working set (sum of block sizes × dtype width, out
+   counted double for Mosaic's double buffering) is estimated against a
+   conservative budget; exceeding it is the one error interpret-mode
+   tests cannot catch.
+
+``grid_spec=pltpu.PrefetchScalarGridSpec(...)`` resolves through a local
+single-assignment binding (the ``wide_hist`` idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+import math
+
+from tools.graftlint import astutil
+from tools.graftlint.engine import PALLAS_CALL, Finding
+
+rule_id = "GL07"
+
+# conservative per-core VMEM budget for one grid step's working set —
+# mirrors ops/pallas_hist._VMEM_BUDGET_BYTES (~16 MB physical, headroom
+# for Mosaic's own spills)
+VMEM_BUDGET_BYTES = 10 << 20
+
+# dtype suffix -> (itemsize bytes, required sublane multiple)
+_DTYPES = {
+    "float64": (8, 8), "int64": (8, 8),
+    "float32": (4, 8), "int32": (4, 8), "uint32": (4, 8),
+    "bfloat16": (2, 16), "float16": (2, 16), "int16": (2, 16),
+    "uint16": (2, 16),
+    "int8": (1, 32), "uint8": (1, 32), "float8_e4m3fn": (1, 32),
+    "float8_e5m2": (1, 32),
+    "bool_": (1, 32), "bool": (1, 32),
+}
+
+
+def _dtype_info(mod, node):
+    """(itemsize, sublane_multiple) for a dtype expression, or None."""
+    name = mod.canonical(node)
+    if name is None:
+        s = astutil.str_const(node)
+        name = s if s is not None else None
+    if name is None:
+        return None
+    return _DTYPES.get(name.rsplit(".", 1)[-1])
+
+
+def _local_call_binding(scope, name_node):
+    """The single ``v = SomeCall(...)`` assignment binding a Name, if any."""
+    if not isinstance(name_node, ast.Name) or scope is None:
+        return None
+    hit = None
+    for stmt in astutil.own_statements(scope.node):
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and stmt.targets[0].id == name_node.id
+                and isinstance(stmt.value, ast.Call)):
+            if hit is not None:
+                return None  # multiple assignments: don't guess
+            hit = stmt.value
+    return hit
+
+
+def _spec_list(node):
+    """BlockSpec call nodes inside an in_specs/out_specs expression."""
+    if node is None:
+        return []
+    if isinstance(node, (ast.Tuple, ast.List)):
+        items = node.elts
+    else:
+        items = [node]
+    out = []
+    for item in items:
+        if isinstance(item, ast.Call):
+            out.append(item)
+    return out
+
+
+def _block_dims(spec_call):
+    """(shape_node, literal dims list-with-Nones) of a BlockSpec call."""
+    shape = spec_call.args[0] if spec_call.args else astutil.keyword_arg(
+        spec_call, "block_shape"
+    )
+    if not isinstance(shape, (ast.Tuple, ast.List)):
+        return None, None
+    dims = []
+    for el in shape.elts:
+        v = astutil.int_tuple(el)
+        dims.append(v[0] if v is not None and len(v) == 1 else None)
+    return shape, dims
+
+
+def _index_map(spec_call):
+    """Per-dim mapping of a literal ``lambda g0, g1, ...: (...)`` index map:
+    each entry is ('grid', axis) | ('const', value) | None (unresolvable).
+    """
+    lam = None
+    if len(spec_call.args) >= 2 and isinstance(spec_call.args[1], ast.Lambda):
+        lam = spec_call.args[1]
+    else:
+        kw = astutil.keyword_arg(spec_call, "index_map")
+        if isinstance(kw, ast.Lambda):
+            lam = kw
+    if lam is None:
+        return None
+    params = [a.arg for a in lam.args.args]
+    body = lam.body
+    elts = body.elts if isinstance(body, (ast.Tuple, ast.List)) else [body]
+    out = []
+    for el in elts:
+        if isinstance(el, ast.Name) and el.id in params:
+            out.append(("grid", params.index(el.id)))
+        elif (v := astutil.int_tuple(el)) is not None and len(v) == 1:
+            out.append(("const", v[0]))
+        else:
+            out.append(None)
+    return out
+
+
+def _shape_dtype(mod, scope, node):
+    """(literal dims list, dtype info) from jax.ShapeDtypeStruct(...)."""
+    if not isinstance(node, ast.Call):
+        return None, None
+    name = mod.canonical(node.func)
+    if name is None or name.rsplit(".", 1)[-1] != "ShapeDtypeStruct":
+        return None, None
+    shape = node.args[0] if node.args else astutil.keyword_arg(node, "shape")
+    dtype = (node.args[1] if len(node.args) > 1
+             else astutil.keyword_arg(node, "dtype"))
+    dims = None
+    if isinstance(shape, (ast.Tuple, ast.List)):
+        dims = []
+        for el in shape.elts:
+            v = astutil.int_tuple(el)
+            dims.append(v[0] if v is not None and len(v) == 1 else None)
+    return dims, (_dtype_info(mod, dtype) if dtype is not None else None)
+
+
+def check(project):
+    for mod in project.modules:
+        for scope, call in project._walk_calls(mod):
+            if mod.canonical(call.func) not in PALLAS_CALL:
+                continue
+            yield from _check_site(project, mod, scope, call)
+
+
+def _gather(mod, scope, call):
+    """(grid dims, in_spec calls, out_spec calls, out dims, out dtype)."""
+    grid_node = astutil.keyword_arg(call, "grid")
+    in_specs = astutil.keyword_arg(call, "in_specs")
+    out_specs = astutil.keyword_arg(call, "out_specs")
+    gs = astutil.keyword_arg(call, "grid_spec")
+    if gs is not None:
+        if isinstance(gs, ast.Name):
+            gs = _local_call_binding(scope, gs)
+        if isinstance(gs, ast.Call):
+            grid_node = grid_node or astutil.keyword_arg(gs, "grid")
+            in_specs = in_specs or astutil.keyword_arg(gs, "in_specs")
+            out_specs = out_specs or astutil.keyword_arg(gs, "out_specs")
+    grid = astutil.int_tuple(grid_node) if grid_node is not None else None
+    out_shape = astutil.keyword_arg(call, "out_shape")
+    out_dims, out_dt = _shape_dtype(mod, scope, out_shape)
+    return grid, _spec_list(in_specs), _spec_list(out_specs), out_dims, out_dt
+
+
+def _check_site(project, mod, scope, call):
+    grid, in_specs, out_specs, out_dims, out_dt = _gather(mod, scope, call)
+
+    # 1. dtype-aware sublane tiling on out specs (dtype provable there)
+    if out_dt is not None:
+        _itemsize, sublane = out_dt
+        for spec in out_specs:
+            _shape, dims = _block_dims(spec)
+            if not dims or len(dims) < 2:
+                continue
+            v = dims[-2]
+            if (v is not None and v != 1 and v % 8 == 0 and v % sublane):
+                yield Finding(
+                    rule_id, mod.path, spec.lineno, spec.col_offset,
+                    f"BlockSpec sublane block dim {v} breaks the "
+                    f"{sublane}-row tiling this out dtype needs "
+                    "(packed dtypes tile taller than f32's 8)",
+                )
+
+    # 2. grid x block coverage of the out array
+    if grid is not None and out_dims is not None:
+        for spec in out_specs:
+            _shape, dims = _block_dims(spec)
+            imap = _index_map(spec)
+            if not dims or imap is None or len(dims) != len(imap):
+                continue
+            if len(dims) != len(out_dims):
+                continue
+            for d, (bdim, entry, adim) in enumerate(
+                zip(dims, imap, out_dims)
+            ):
+                if bdim is None or adim is None or entry is None:
+                    continue
+                if entry[0] == "grid":
+                    j = entry[1]
+                    if j >= len(grid):
+                        continue
+                    covered = grid[j] * bdim
+                else:
+                    # a constant index writes exactly ONE block; anything
+                    # at a nonzero offset leaves the prefix uncovered
+                    covered = bdim if entry[1] == 0 else 0
+                if covered < adim:
+                    yield Finding(
+                        rule_id, mod.path, spec.lineno, spec.col_offset,
+                        f"grid x block covers only {covered} of {adim} "
+                        f"along out dim {d} — the uncovered tail comes "
+                        "back uninitialized",
+                    )
+
+    # 3. static VMEM estimate when every block dim is literal
+    specs = [(s, False) for s in in_specs] + [(s, True) for s in out_specs]
+    if not specs:
+        return
+    total = 0
+    for spec, is_out in specs:
+        _shape, dims = _block_dims(spec)
+        if not dims or any(d is None for d in dims):
+            return  # a symbolic dim: no honest estimate possible
+        itemsize = (out_dt[0] if is_out and out_dt is not None else 4)
+        nbytes = math.prod(dims) * itemsize
+        total += nbytes * (2 if is_out else 1)  # out double-buffers
+    if total > VMEM_BUDGET_BYTES:
+        yield Finding(
+            rule_id, mod.path, call.lineno, call.col_offset,
+            f"static VMEM estimate {total >> 20} MiB exceeds the "
+            f"{VMEM_BUDGET_BYTES >> 20} MiB per-step budget — Mosaic "
+            "will fail allocation on hardware (shrink blocks or grid "
+            "the dominant axis)",
+        )

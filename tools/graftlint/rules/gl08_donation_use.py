@@ -1,0 +1,315 @@
+"""GL08 — donation-after-use: a donated buffer is dead after the call.
+
+``donate_argnums``/``donate_argnames`` hands the input buffer to XLA for
+reuse; on TPU the caller's array object now aliases memory the program is
+free to overwrite. Reading it afterwards is not an error Python can see —
+it is a silent garbage read (CPU/interpret runs usually still pass, which
+is exactly why a static rule exists). GL05 asks fused-state jits to donate;
+this rule polices the other side of that contract at every call site of a
+donating callable.
+
+Donating callables are recognized three ways:
+
+- local/module bindings: ``step = jax.jit(f, donate_argnums=(0,))``
+  (including ``jax.jit(sharded)`` where ``sharded`` wraps via shard_map —
+  donation indices are positional, so no unwrapping is needed),
+- factory returns: a function whose ``return`` is such a ``jax.jit`` call
+  marks every ``fn = factory(...)`` binding in callers — the
+  ``_make_fused_fn`` / ``make_update_fn`` idiom,
+- decorator form: ``@jax.jit(donate_argnames=...)`` /
+  ``@partial(jax.jit, donate_argnums=...)`` on a def, checked at direct
+  call sites (argnames map through the def's positional parameters).
+
+A call site is clean when the donated argument is a fresh expression, is
+rebound by the call's own assignment (``nid = step(nid, ...)`` — the level
+loop's canonical shape), or is re-Stored before any later Load. Analysis
+is per-caller and line-ordered (flow-insensitive, like the dataflow core):
+a Load after the call in ANY syntactic path fires. Calls inside a loop
+additionally require the donated name to be Stored somewhere in that loop
+body — otherwise iteration 2 re-donates a buffer iteration 1 already
+consumed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint import astutil
+from tools.graftlint.engine import JIT_WRAPPERS, PARTIAL, Finding
+
+rule_id = "GL08"
+
+_DONATE_KW = ("donate_argnums", "donate_argnames")
+
+
+def _donated_positions(project, mod, scope, call):
+    """Donated positional indices of a ``jax.jit(...)`` call, or None.
+
+    ``donate_argnames`` resolves through the wrapped function's positional
+    parameter list when the target is resolvable; an unresolvable names
+    form is skipped (never guessed).
+    """
+    nums = astutil.keyword_arg(call, "donate_argnums")
+    if nums is not None:
+        t = astutil.int_tuple(nums)
+        return frozenset(t) if t else None
+    names = astutil.keyword_arg(call, "donate_argnames")
+    if names is None:
+        return None
+    strs = astutil.str_tuple(names)
+    if not strs or not call.args:
+        return None
+    target = project.resolve_function(mod, scope, call.args[0])
+    if target is None:
+        return None
+    a = target.node.args
+    positional = [p.arg for p in a.posonlyargs + a.args]
+    hits = frozenset(
+        positional.index(s) for s in strs if s in positional
+    )
+    return hits or None
+
+
+def _decorator_donations(project, mod, fn):
+    """Donated positions declared by a @jit decorator on ``fn``."""
+    for dec in fn.node.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        name = mod.canonical(dec.func)
+        is_partial_jit = (
+            name in PARTIAL and dec.args
+            and mod.canonical(dec.args[0]) in JIT_WRAPPERS
+        )
+        if name not in JIT_WRAPPERS and not is_partial_jit:
+            continue
+        nums = astutil.keyword_arg(dec, "donate_argnums")
+        if nums is not None:
+            t = astutil.int_tuple(nums)
+            if t:
+                return frozenset(t)
+        names = astutil.keyword_arg(dec, "donate_argnames")
+        if names is not None:
+            strs = astutil.str_tuple(names)
+            if strs:
+                a = fn.node.args
+                positional = [p.arg for p in a.posonlyargs + a.args]
+                hits = frozenset(
+                    positional.index(s) for s in strs if s in positional
+                )
+                if hits:
+                    return hits
+    return None
+
+
+def _collect_donors(project):
+    """Maps the three donating-callable spellings across the project.
+
+    Returns (bindings, factories, decorated):
+      bindings:  (module path, scope qualname|None, varname) -> positions
+      factories: id(FuncInfo) -> positions (functions returning a donating
+                 jit)
+      decorated: id(FuncInfo) -> positions
+    """
+    bindings: dict = {}
+    factories: dict = {}
+    decorated: dict = {}
+    for mod in project.modules:
+        for fn in mod.functions.values():
+            pos = _decorator_donations(project, mod, fn)
+            if pos:
+                decorated[id(fn)] = (fn, pos)
+            for stmt in astutil.own_statements(fn.node):
+                if (isinstance(stmt, ast.Return)
+                        and isinstance(stmt.value, ast.Call)
+                        and mod.canonical(stmt.value.func) in JIT_WRAPPERS):
+                    pos = _donated_positions(project, mod, fn, stmt.value)
+                    if pos:
+                        factories[id(fn)] = pos
+        for scope, call in project._walk_calls(mod):
+            if mod.canonical(call.func) not in JIT_WRAPPERS:
+                continue
+            pos = _donated_positions(project, mod, scope, call)
+            if pos is None:
+                continue
+            parent = _assign_target(scope, call)
+            if parent is not None:
+                key = (mod.path, scope.qualname if scope else None, parent)
+                bindings[key] = pos
+    return bindings, factories, decorated
+
+
+def _assign_target(scope, call):
+    """Varname when ``call`` is the whole RHS of a single-Name assignment
+    in ``scope`` (module level included via scope None callers)."""
+    if scope is None:
+        return None
+    for stmt in astutil.own_statements(scope.node):
+        if (isinstance(stmt, ast.Assign) and stmt.value is call
+                and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            return stmt.targets[0].id
+    return None
+
+
+class _Caller:
+    """Per-caller AST facts: statement parents, loops, name occurrences."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.parent: dict = {}
+        for node in ast.walk(fn.node):
+            for child in ast.iter_child_nodes(node):
+                self.parent[id(child)] = node
+
+    def enclosing_loops(self, node):
+        out = []
+        cur = self.parent.get(id(node))
+        while cur is not None and cur is not self.fn.node:
+            if isinstance(cur, (ast.For, ast.While, ast.AsyncFor)):
+                out.append(cur)
+            cur = self.parent.get(id(cur))
+        return out
+
+    def assign_of(self, call):
+        cur = self.parent.get(id(call))
+        if isinstance(cur, ast.Assign) and cur.value is call:
+            return cur
+        return None
+
+    def is_metadata_read(self, name_node):
+        """Whether a Load only touches aval metadata, which survives
+        donation: ``donated.shape`` / ``donated.ndim`` / ``len(donated)``
+        read the retained abstract value, never the released buffer."""
+        cur = self.parent.get(id(name_node))
+        if isinstance(cur, ast.Attribute) and (
+            cur.attr in astutil.STATIC_ATTRS
+        ):
+            return True
+        if isinstance(cur, ast.Call) and name_node in cur.args:
+            return astutil.dotted_name(cur.func) in astutil.STATIC_CALLS
+        return False
+
+
+def _name_uses(root, var, skip_subtree):
+    """(pos, node, is_store) for ``var`` Names outside ``skip_subtree``."""
+    skip_ids = {id(n) for n in ast.walk(skip_subtree)}
+    for n in ast.walk(root):
+        if id(n) in skip_ids or not isinstance(n, ast.Name) or n.id != var:
+            continue
+        yield (n.lineno, n.col_offset), n, isinstance(n.ctx, ast.Store)
+
+
+def check(project):
+    bindings, factories, decorated = _collect_donors(project)
+    for mod in project.modules:
+        for fn in mod.functions.values():
+            if fn.is_lambda:
+                continue
+            caller = None
+            for node in astutil.own_nodes(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                pos = _site_positions(
+                    project, mod, fn, node, bindings, factories, decorated
+                )
+                if not pos:
+                    continue
+                if caller is None:
+                    caller = _Caller(fn)
+                yield from _check_call(mod, fn, caller, node, pos)
+
+
+def _site_positions(project, mod, fn, call, bindings, factories, decorated):
+    """Donated positions if ``call`` invokes a donating callable."""
+    # direct call of a decorated donating def
+    target = project.resolve_function(mod, fn, call.func)
+    if target is not None and id(target) in decorated:
+        return decorated[id(target)][1]
+    # call through a local binding of jax.jit(...) or a donating factory
+    if isinstance(call.func, ast.Name):
+        cur = fn
+        while True:
+            key = (mod.path, cur.qualname if cur else None, call.func.id)
+            if key in bindings:
+                return bindings[key]
+            if cur is None:
+                break
+            cur = cur.parent
+        # `v = factory(...)` in this scope?
+        src = _local_factory(project, mod, fn, call.func.id)
+        if src is not None and id(src) in factories:
+            return factories[id(src)]
+    return None
+
+
+def _local_factory(project, mod, scope, varname):
+    """FuncInfo of F when ``varname = F(...)`` binds in ``scope`` (single
+    assignment — the lru_cache factory idiom every builder uses)."""
+    hit = None
+    cur = scope
+    while cur is not None and hit is None:
+        for stmt in astutil.own_statements(cur.node):
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == varname
+                    and isinstance(stmt.value, ast.Call)):
+                hit = project.resolve_function(mod, cur, stmt.value.func)
+        cur = cur.parent
+    return hit
+
+
+def _check_call(mod, fn, caller, call, positions):
+    assign = caller.assign_of(call)
+    rebound = set()
+    if assign is not None:
+        for t in assign.targets:
+            rebound.update(astutil.target_names(t))
+    for p in sorted(positions):
+        if p >= len(call.args):
+            continue
+        arg = call.args[p]
+        if not isinstance(arg, ast.Name):
+            continue  # fresh expressions donate safely
+        var = arg.id
+        if var in rebound:
+            continue  # nid = step(nid, ...): the canonical loop shape
+        call_pos = (call.lineno, call.col_offset)
+        uses = sorted(
+            (u for u in _name_uses(fn.node, var, call)
+             if u[0] > call_pos),
+            key=lambda u: u[0],
+        )
+        for pos_, node_, is_store in uses:
+            if is_store:
+                break  # re-Stored before any read: later Loads see the
+                # fresh binding (flow-insensitive approximation)
+            if caller.is_metadata_read(node_):
+                continue  # .shape/.ndim/len() read the aval, not the buffer
+            yield Finding(
+                rule_id, mod.path, pos_[0], pos_[1],
+                f"'{var}' is read after being donated to "
+                f"'{_callee_label(call)}' at line {call.lineno} — a "
+                "donated buffer aliases memory XLA reuses; on TPU this "
+                "is a silent garbage read",
+            )
+            break
+        loops = caller.enclosing_loops(call)
+        if loops and not _stored_in(loops[0], var):
+            yield Finding(
+                rule_id, mod.path, call.lineno, call.col_offset,
+                f"'{var}' is donated inside a loop but never rebound in "
+                "the loop body — iteration 2 re-donates the buffer "
+                "iteration 1 already consumed",
+            )
+
+
+def _stored_in(loop, var):
+    return any(
+        isinstance(n, ast.Name) and n.id == var
+        and isinstance(n.ctx, ast.Store)
+        for n in ast.walk(loop)
+    )
+
+
+def _callee_label(call):
+    return astutil.dotted_name(call.func) or "<callable>"
