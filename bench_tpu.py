@@ -137,7 +137,7 @@ def _device_platform() -> str:
 # carrying one per section stays inside the driver's tail window.
 RECORD_DIGEST_KEYS = (
     "engine", "reason", "n_nodes", "depth", "levels", "compile_new",
-    "psum_bytes", "events", "wall_s",
+    "psum_bytes", "sub_frac", "events", "wall_s",
 )
 
 
@@ -160,6 +160,8 @@ def format_record_digest(d: dict) -> str:
         f"compile_new={d.get('compile_new')} psum={mb:.1f}MB "
         f"events={d.get('events')} wall={d.get('wall_s')}s"
     )
+    if d.get("sub_frac") is not None:
+        line += f" sub_frac={d['sub_frac']}"
     if d.get("reason"):
         line += f" reason={d['reason']!r}"
     return line
@@ -234,6 +236,45 @@ def _north_star(npz_path: str, engine_env: str | None) -> dict:
     n_cells = Xtr.shape[0] * Xtr.shape[1]
     levels = max(out["tree_depth"], 1)
     out["throughput_cells_per_s"] = round(n_cells * levels / out["warm_s"])
+    # Sibling-subtraction A/B on the same platform in the same run
+    # (ISSUE 5): the main fit above ran the default ("auto" — ON for this
+    # integer-weight classification workload on a TPU; auto resolves OFF
+    # on CPU dryruns), so one env-toggled OFF fit closes the comparison.
+    # Rides the same bounded-section protocol: the extra cold compile is
+    # a different executable set, charged to this section. Each side
+    # carries its RESOLVED hist_subtraction decision, and the speedup is
+    # labeled honestly when the main fit resolved off (off-vs-off would
+    # otherwise read as "the trick gained nothing").
+    main_resolved = (
+        clf.fit_report_.get("decisions", {})
+        .get("hist_subtraction", {}).get("value")
+    )
+    os.environ["MPITREE_TPU_HIST_SUBTRACTION"] = "off"
+    try:
+        off_out, off_clf = _timed_fit(
+            Xtr, ytr, backend=platform, refine_depth=REFINE_DEPTH,
+            engine_env=engine_env,
+        )
+    finally:
+        os.environ.pop("MPITREE_TPU_HIST_SUBTRACTION", None)
+    out["subtraction_ab"] = {
+        "main": {
+            "resolved": main_resolved,
+            "warm_s": out["warm_s"], "record": out["record"],
+        },
+        "off": {
+            "resolved": (
+                off_clf.fit_report_.get("decisions", {})
+                .get("hist_subtraction", {}).get("value")
+            ),
+            "cold_s": off_out["cold_s"], "warm_s": off_out["warm_s"],
+            "phases": off_out["phases"], "record": off_out["record"],
+        },
+        (
+            "warm_speedup_on_vs_off" if main_resolved == "on"
+            else "warm_speedup_off_vs_off"  # auto resolved off: no A in A/B
+        ): round(off_out["warm_s"] / out["warm_s"], 3),
+    }
     return out
 
 
@@ -424,6 +465,45 @@ def worker_hist_tput(npz_path: str) -> dict:
         "g_updates_per_s": round(N * F / s / 1e9, 3),
         "read_gb_per_s": round(gbps, 1),
     }
+
+    # Sibling-subtraction accumulate at the same K shape: only the smaller
+    # sibling of each pair scatters, into the compact K/2-slot buffer
+    # (ops/histogram.sibling_accumulate_slots) — the shape both engines
+    # run on every single-chunk interior level when hist_subtraction is
+    # on. sub_frac is the realized scan fraction (~0.5 on this uniform
+    # nid draw; real trees do better — small children average well under
+    # half their parent's rows).
+    cnt_slots = np.bincount(np.asarray(nid), minlength=K).astype(np.int64)
+    pair_cnt = cnt_slots.reshape(K // 2, 2)
+    left_small = pair_cnt[:, 0] <= pair_cnt[:, 1]
+    is_small_h = np.zeros(K, bool)
+    is_small_h[0::2] = left_small
+    is_small_h[1::2] = ~left_small
+    sub_frac = float(cnt_slots[is_small_h].sum()) / max(
+        float(cnt_slots.sum()), 1.0
+    )
+    is_small_d = jnp.asarray(is_small_h)
+
+    @jax.jit
+    def big_hist_sub(xb, y, nid, is_small_d):
+        acc = hist_ops.sibling_accumulate_slots(
+            nid, jnp.int32(0), is_small_d, n_slots=K
+        )
+        return hist_ops.class_histogram(
+            xb, y, acc, jnp.int32(0), n_slots=K // 2, n_bins=B,
+            n_classes=C, sample_weight=w1,
+        )
+
+    try:
+        s_sub = timed(big_hist_sub, xb, y, nid, is_small_d)
+        res["hist_K4096_sub"] = {
+            "seconds": round(s_sub, 5),
+            "sub_frac": round(sub_frac, 4),
+            "psum_slots": K // 2,
+            "speedup_vs_full_scatter": round(s / s_sub, 2),
+        }
+    except Exception as e:  # noqa: BLE001 — diagnostic section only
+        res["hist_K4096_sub"] = {"error": f"{type(e).__name__}: {e}"}
 
     # Candidate big-path variant: sort rows by node id once per level, then
     # the SAME scatter — writes then cluster per slot region of the huge

@@ -36,9 +36,13 @@ from mpitree_tpu.boosting.losses import loss_for
 from mpitree_tpu.core.builder import BuildConfig, build_tree
 from mpitree_tpu.models.forest import _TreeList
 from mpitree_tpu.obs import BuildObserver, ReportMixin
-from mpitree_tpu.ops.binning import bin_dataset
+from mpitree_tpu.ops.binning import BinnedData, bin_dataset
 from mpitree_tpu.ops.predict import predict_mesh, stacked_leaf_ids
-from mpitree_tpu.ops.sampling import row_subsample_mask, seed_from
+from mpitree_tpu.ops.sampling import (
+    feature_subsample_mask,
+    row_subsample_mask,
+    seed_from,
+)
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.utils.validation import (
     feature_names_of,
@@ -100,11 +104,32 @@ def _host_leaf_ids(tree, X: np.ndarray) -> np.ndarray:
     return node
 
 
+def _column_slice(binned, kept):
+    """Per-round feature-subset BinnedData (``colsample_bytree``).
+
+    Slicing the binned matrix — rather than only masking candidates in
+    the gain sweep — shrinks the O(N*F) histogram hot path itself: every
+    engine sees a k-feature problem, the same hot path the
+    sibling-subtraction frontier halves row-wise. Tree feature ids are
+    remapped back through ``kept`` after each build; k is constant across
+    rounds (``feature_subsample_mask`` draws exactly k), so all rounds
+    share one compiled executable set.
+    """
+    return BinnedData(
+        x_binned=np.ascontiguousarray(binned.x_binned[:, kept]),
+        thresholds=binned.thresholds[kept],
+        n_cand=binned.n_cand[kept],
+        n_bins=binned.n_bins,
+        quantized=binned.quantized,
+    )
+
+
 class _BaseGradientBoosting(ReportMixin, BaseEstimator):
     """Shared fit/predict machinery; subclasses bind the task and loss."""
 
     def __init__(self, *, loss, learning_rate=0.1, max_iter=100, max_depth=6,
                  max_bins=256, binning="auto", subsample=1.0,
+                 colsample_bytree=1.0,
                  min_samples_split=2, min_samples_leaf=20,
                  min_child_weight=1e-3, reg_lambda=0.0, min_split_gain=0.0,
                  early_stopping=False, validation_fraction=0.1,
@@ -117,6 +142,7 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
         self.max_bins = max_bins
         self.binning = binning
         self.subsample = subsample
+        self.colsample_bytree = colsample_bytree
         self.min_samples_split = min_samples_split
         self.min_samples_leaf = min_samples_leaf
         self.min_child_weight = min_child_weight
@@ -147,6 +173,11 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
         if not 0.0 < float(self.subsample) <= 1.0:
             raise ValueError(
                 f"subsample must be in (0, 1], got {self.subsample!r}"
+            )
+        if not 0.0 < float(self.colsample_bytree) <= 1.0:
+            raise ValueError(
+                "colsample_bytree must be in (0, 1], got "
+                f"{self.colsample_bytree!r}"
             )
 
     def _fit(self, X, y, sample_weight, *, task):
@@ -240,6 +271,15 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
         for r in range(int(self.max_iter)):
             t_round = time.perf_counter() if obs.enabled else 0.0
             mask = row_subsample_mask(seed, r, n_tr, float(self.subsample))
+            colsample = float(self.colsample_bytree)
+            if colsample < 1.0:
+                kept = np.flatnonzero(feature_subsample_mask(
+                    seed, r, binned.n_features, colsample
+                )).astype(np.int32)
+                binned_r = _column_slice(binned, kept)
+            else:
+                kept = None
+                binned_r = binned
             g, h = loss.grad_hess(raw_tr, y_tr)  # (N, K) f64 each
             if sw_tr is not None:
                 g = g * sw_tr[:, None]
@@ -251,9 +291,14 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
                 g32 = np.ascontiguousarray(g[:, k], np.float32)
                 h32 = np.ascontiguousarray(h[:, k], np.float32)
                 tree, leaf_ids = build_tree(
-                    binned, g32, config=cfg, mesh=mesh, sample_weight=h32,
+                    binned_r, g32, config=cfg, mesh=mesh, sample_weight=h32,
                     return_leaf_ids=True, timer=obs,
                 )
+                if kept is not None:
+                    # Back to full-matrix feature ids (the predict surface
+                    # and importances read the original columns).
+                    interior = tree.feature >= 0
+                    tree.feature[interior] = kept[tree.feature[interior]]
                 vals = _newton_refit(
                     tree, leaf_ids, g[:, k], h[:, k], float(self.reg_lambda)
                 )
@@ -280,6 +325,7 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
                 round=r,
                 trees=K,
                 subsample=float(self.subsample),
+                colsample=colsample,
                 train_loss=float(-train_scores[-1]),
                 val_loss=(
                     float(-val_scores[-1]) if val_scores is not None else None
@@ -372,7 +418,8 @@ class GradientBoostingRegressor(RegressorMixin, _BaseGradientBoosting):
 
     def __init__(self, *, loss="squared_error", learning_rate=0.1,
                  max_iter=100, max_depth=6, max_bins=256, binning="auto",
-                 subsample=1.0, min_samples_split=2, min_samples_leaf=20,
+                 subsample=1.0, colsample_bytree=1.0,
+                 min_samples_split=2, min_samples_leaf=20,
                  min_child_weight=1e-3, reg_lambda=0.0, min_split_gain=0.0,
                  early_stopping=False, validation_fraction=0.1,
                  n_iter_no_change=10, tol=1e-7, random_state=None,
@@ -380,7 +427,8 @@ class GradientBoostingRegressor(RegressorMixin, _BaseGradientBoosting):
         super().__init__(
             loss=loss, learning_rate=learning_rate, max_iter=max_iter,
             max_depth=max_depth, max_bins=max_bins, binning=binning,
-            subsample=subsample, min_samples_split=min_samples_split,
+            subsample=subsample, colsample_bytree=colsample_bytree,
+            min_samples_split=min_samples_split,
             min_samples_leaf=min_samples_leaf,
             min_child_weight=min_child_weight, reg_lambda=reg_lambda,
             min_split_gain=min_split_gain, early_stopping=early_stopping,
@@ -413,6 +461,7 @@ class GradientBoostingClassifier(ClassifierMixin, _BaseGradientBoosting):
 
     def __init__(self, *, loss="log_loss", learning_rate=0.1, max_iter=100,
                  max_depth=6, max_bins=256, binning="auto", subsample=1.0,
+                 colsample_bytree=1.0,
                  min_samples_split=2, min_samples_leaf=20,
                  min_child_weight=1e-3, reg_lambda=0.0, min_split_gain=0.0,
                  early_stopping=False, validation_fraction=0.1,
@@ -421,7 +470,8 @@ class GradientBoostingClassifier(ClassifierMixin, _BaseGradientBoosting):
         super().__init__(
             loss=loss, learning_rate=learning_rate, max_iter=max_iter,
             max_depth=max_depth, max_bins=max_bins, binning=binning,
-            subsample=subsample, min_samples_split=min_samples_split,
+            subsample=subsample, colsample_bytree=colsample_bytree,
+            min_samples_split=min_samples_split,
             min_samples_leaf=min_samples_leaf,
             min_child_weight=min_child_weight, reg_lambda=reg_lambda,
             min_split_gain=min_split_gain, early_stopping=early_stopping,

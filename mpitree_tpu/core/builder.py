@@ -96,6 +96,30 @@ class BuildConfig:
     # and fractional weights (see resolve_hist_kernel).
     # MPITREE_TPU_HIST_KERNEL overrides "auto".
     hist_kernel: str = "auto"
+    # Sibling-subtraction histogram frontier (LightGBM's halved-histogram
+    # trick) in BOTH device engines: at each level the globally-reduced
+    # parent histograms stay resident on device (<= one extra chunk-sized
+    # buffer), only the SMALLER child of each sibling pair accumulates
+    # rows — into a compact half-width buffer, so the per-level histogram
+    # psum payload also halves — and the larger child is reconstructed as
+    # ``parent - small_sibling`` after the reduction (exact under the
+    # linearity of the allreduce; ops/histogram.sibling_reconstruct).
+    # "auto" enables it only where the subtraction is exact (the tree
+    # stays toggle-invariant: classification with integer weights —
+    # integer f32 counts < 2**24 subtract exactly) AND the platform wins
+    # from it (TPU: masked accumulation cannot skip rows under static
+    # shapes, so the payoff is the halved psum payload + halved MXU-tier
+    # FLOPs; on XLA-CPU the scatter dominates and the remap/reconstruct
+    # overhead nets ~0.92x — same policy shape as resolve_wide_hist).
+    # "on" forces it anywhere: exact for integer-weight classification
+    # and the scoped-f64 gbdt path (resolve_gbdt_x64, CPU meshes); for
+    # non-integer f32 channels it is the same explicit identity opt-out
+    # as hist_kernel="pallas" (reconstruction differs from direct
+    # accumulation by ulps). The 2**24 f32-ceiling guard overrides even
+    # "on": cancellation must never silently corrupt a large-child
+    # histogram. MPITREE_TPU_HIST_SUBTRACTION overrides "auto" (see
+    # resolve_hist_subtraction).
+    hist_subtraction: str = "auto"
     # Frontier-width tiers served by dedicated branches (lax.cond chain in
     # the fused loop): a level whose frontier fits tier S computes an S-slot
     # histogram + gain sweep instead of the full K-slot one. Shallow levels
@@ -351,6 +375,79 @@ def warn_exact_ties_gap(K: int, n_features: int,
         "host tier's f64",
         stacklevel=3,
     )
+
+
+def resolve_hist_subtraction(cfg: BuildConfig, platform: str, task: str, *,
+                             integer_ok: bool, gbdt_x64: bool = False,
+                             total_weight: float | None = None,
+                             obs=None) -> bool:
+    """Shared sibling-subtraction resolution for both device engines.
+
+    Follows the engine-resolution idiom: the env var
+    ``MPITREE_TPU_HIST_SUBTRACTION`` steers the default ("auto") only; an
+    explicit ``BuildConfig(hist_subtraction=...)`` choice wins.
+
+    Where the win lives: masked accumulation cannot skip rows under XLA's
+    static shapes, so the scatter tier does N*F updates regardless — the
+    subtraction's gains are the HALVED per-level histogram ``psum``
+    payload over ICI and the halved MXU-tier FLOPs (``pallas_hist``'s
+    one-hot contraction scales with the slot count). On XLA-CPU meshes
+    psum is shared-memory and the scatter dominates, so the remap +
+    reconstruct overhead nets a measured ~0.92x — the same evidence shape
+    that gates the wide tier (:func:`resolve_wide_hist`) — hence "auto"
+    engages on accelerator platforms only; "on" forces any platform (the
+    CPU engine-identity tests ride it).
+
+    Exactness policy mirrors :func:`resolve_hist_kernel`: the subtraction
+    runs under "auto" only where ``parent - small`` is bit-identical to
+    direct accumulation of the large child — classification with
+    integer-valued weights (integer f32 sums below 2**24 are exact in any
+    order, so the difference is too). The gbdt scoped-f64 path
+    (``resolve_gbdt_x64``; f64 carries 29 extra mantissa bits over the
+    f32 (g, h) inputs, so the reconstruction rounds to the same f32
+    histogram direct accumulation does) is exact too but CPU-only, so it
+    runs subtraction on explicit "on". Regression moments and fractional
+    weights are non-exact everywhere: "on" for them is the documented
+    one-tree identity opt-out.
+
+    The f32-ceiling guard overrides even "on": when a parent channel
+    total can reach 2**24 in f32, the sums themselves lose integer
+    exactness and subtraction could silently cancel into a corrupt
+    large-child histogram — warn (typed ``f32_ceiling`` event) and fall
+    back to direct accumulation. The guard is moot on the f64 gbdt path
+    (53-bit mantissa). ``total_weight``: the max per-channel total the
+    caller can bound (total fit weight / hessian total); ``None`` skips
+    the guard (caller guarantees f64).
+    """
+    flag = cfg.hist_subtraction
+    if flag == "auto":
+        flag = os.environ.get("MPITREE_TPU_HIST_SUBTRACTION", "auto")
+    if flag not in ("auto", "on", "off"):
+        raise ValueError(f"unknown hist_subtraction {flag!r}")
+    if flag == "off":
+        return False
+    exact = (
+        (task == "classification" and integer_ok)
+        or (task == "gbdt" and gbdt_x64)
+    )
+    if flag == "auto" and not (
+        exact and platform in ("tpu", "axon")
+    ):
+        return False
+    f64_path = task == "gbdt" and gbdt_x64
+    if (not f64_path and total_weight is not None
+            and total_weight >= 2**24):
+        warn_event(
+            obs, "f32_ceiling",
+            "sibling-subtraction histograms disabled: a parent channel "
+            "total can exceed 2**24 in float32, where sums lose integer "
+            "exactness and parent-minus-sibling cancellation could "
+            "silently corrupt a large-child histogram; accumulating "
+            "every child directly instead",
+            stacklevel=3,
+        )
+        return False
+    return True
 
 
 def resolve_gbdt_x64(platform: str) -> bool:
@@ -764,6 +861,25 @@ def build_tree(
         n_channels=C, n_bins=B,
     )
 
+    total_w_all = (
+        float(N) if sample_weight is None else float(np.sum(sample_weight))
+    )
+    use_sub = resolve_hist_subtraction(
+        cfg, platform, task, integer_ok=int_ok, gbdt_x64=gbdt64,
+        total_weight=total_w_all, obs=timer,
+    )
+    timer.decision(
+        "hist_subtraction", "on" if use_sub else "off",
+        reason=(
+            "sibling-subtraction frontier: accumulate the smaller child, "
+            "derive the larger as parent - small after the psum"
+            if use_sub else
+            "direct accumulation (resolve_hist_subtraction: config/env "
+            "off, non-exact channels or non-accelerator platform under "
+            "'auto', or the 2**24 f32 ceiling)"
+        ),
+    )
+
     tiers = (
         tuple(
             s for s in valid_tiers(cfg.frontier_tiers, K)
@@ -772,26 +888,33 @@ def build_tree(
         if use_pallas else ()
     )
 
-    def split_fn_for(frontier: int):
+    def split_fn_for(frontier: int, *, sub: bool = False,
+                     keep: bool = False):
         """Narrowest tier the frontier fits (Pallas), else the K-slot sweep
         (wide-width sweeps ride the sorted window-packed matmul tier).
         Returns ``(S, fn, new_lowering)`` — the compile-accounting flag is
         True when this static configuration had not been traced before
-        (the cache-key registry, ``obs.CompileRegistry``)."""
+        (the cache-key registry, ``obs.CompileRegistry``). ``sub``/``keep``
+        route the sibling-subtraction variant; kernel eligibility is
+        evaluated at the ACCUMULATE width (S // 2 under subtraction — only
+        the compact small-child buffer is scattered/matmul'd)."""
         S = next((s for s in tiers if frontier <= s), K)
+        acc = S // 2 if sub else S
         kw = dict(
             n_slots=S, n_bins=B, n_classes=C, task=task,
-            criterion=cfg.criterion, debug=debug, use_pallas=S in tiers,
+            criterion=cfg.criterion, debug=debug,
+            use_pallas=S in tiers and pallas_hist.fits_vmem(F, acc, C, B),
             exact_ties=exact_ok and exact_ties_fits(S, F, B),
             wide_pallas=wide_pallas,
             use_wide=(use_wide and S not in tiers
-                      and S >= wide_hist.MIN_SLOTS
-                      and S % wide_hist.WINDOW == 0),
+                      and acc >= wide_hist.MIN_SLOTS
+                      and acc % wide_hist.WINDOW == 0),
             wide_bf16=wide_bf16,
             node_mask=sampling,
             random_split=sampling and feature_sampler.random_split,
             monotonic=mono,
             gbdt_x64=gbdt64,
+            subtraction=sub, keep_hist=keep,
         )
         fn = collective.make_split_fn(mesh, **kw)
         new = timer.compile_note(
@@ -828,12 +951,21 @@ def build_tree(
     timer.compile_note("counts_fn", (mesh, U, C, task))
 
     frontier_lo, frontier_size, depth = 0, 1, 0
+    # Sibling-subtraction carry: the previous level's globally-reduced
+    # histogram (device-resident, <= one chunk) plus the host-side
+    # child -> (parent slot, smaller sibling) maps derived from its
+    # decisions. None whenever the previous level cannot serve as a
+    # subtraction parent (multi-chunk, terminal, or subtraction off).
+    sub_parent = None
     while frontier_size > 0:
         terminal = cfg.max_depth is not None and depth == cfg.max_depth
         t_level = time.perf_counter() if timer.enabled else 0.0
         lvl_new = 0
         lvl_hist_b = 0
         lvl_psum_b = 0
+        sub_now = keep_now = False
+        ismall_lvl = None
+        kept_hist = None
 
         # Phase A: per-node statistics. Terminal levels (every node becomes a
         # leaf) need only counts — an O(N) scatter over wide U-slot tables —
@@ -859,22 +991,41 @@ def build_tree(
             )
             dec = {"counts": counts_all}
         else:
+            # Subtraction runs on single-chunk levels only (the parent
+            # histogram must be one resident buffer); multi-chunk levels
+            # fall back to direct accumulation and break the carry.
+            single = frontier_size <= K
+            sub_now = use_sub and single and sub_parent is not None
+            keep_now = use_sub and single
             with timer.phase("split"):
-                S_lvl, split_fn, new_fn = split_fn_for(frontier_size)
+                S_lvl, split_fn, new_fn = split_fn_for(
+                    frontier_size, sub=sub_now, keep=keep_now
+                )
                 lvl_new = int(new_fn)
                 hi = frontier_lo + frontier_size
                 chunks = [
                     (lo, min(S_lvl, hi - lo))
                     for lo in range(frontier_lo, hi, S_lvl)
                 ]
+                sub_ops = ()
+                if sub_now:
+                    pslot = np.zeros(S_lvl, np.int32)
+                    ismall = np.ones(S_lvl, bool)  # pads read the zero pair
+                    pslot[:frontier_size] = sub_parent["parent_slot"]
+                    ismall[:frontier_size] = sub_parent["is_small"]
+                    ismall_lvl = ismall
+                    sub_ops = (sub_parent["hist"], pslot, ismall)
+                n_extra = int(keep_now) + int(debug)
                 futures = [
                     (take,
                      split_fn(xb_d, y_d, nid_d, w_d, cand_mask_d,
-                              *split_args(lo, take, S_lvl)))
+                              *split_args(lo, take, S_lvl), *sub_ops))
                     for lo, take in chunks
                 ]
-                if debug:
-                    errs = [float(jax.device_get(e)) for _, (_, e) in futures]
+                if keep_now:  # outputs: (packed[, hist][, repl_err])
+                    kept_hist = futures[0][1][1]
+                if debug:  # repl_err is always the last output
+                    errs = [float(jax.device_get(r[-1])) for _, r in futures]
                     if any(e != 0.0 for e in errs):
                         timer.event(
                             "determinism_check_failed",
@@ -886,16 +1037,20 @@ def build_tree(
                             f"errs={errs})"
                         )
                     timer.counter("determinism_checks_passed", len(errs))
-                    futures = [(take, d) for take, (d, _) in futures]
                 # One packed buffer per chunk = one host transfer, not one
                 # per decision field (8x fewer round trips on the tunnel).
                 decs = [
-                    collective.unpack_decision(jax.device_get(d)[:take])
-                    for take, d in futures
+                    collective.unpack_decision(
+                        jax.device_get(r[0] if n_extra else r)[:take]
+                    )
+                    for take, r in futures
                 ]
             dec = {k: np.concatenate([c[k] for c in decs]) for k in decs[0]}
             per_chunk = collective.split_psum_bytes(
-                n_slots=S_lvl, n_features=F, n_bins=B, n_channels=C,
+                # Subtraction psums only the compact small-child buffer —
+                # half the slots, half the ICI payload per level.
+                n_slots=S_lvl // 2 if sub_now else S_lvl,
+                n_features=F, n_bins=B, n_channels=C,
                 itemsize=8 if gbdt64 else 4,
             )
             lvl_hist_b = len(chunks) * per_chunk
@@ -1033,9 +1188,48 @@ def build_tree(
                         is_split, feat_t, bin_t, left_t, right_t,
                     )
 
+        # Realized-savings accounting (always-on counters + level-row
+        # fields): rows_scanned is the weight actually accumulated into
+        # split histograms this level — under subtraction only the smaller
+        # siblings; rows_frontier what direct accumulation would scan.
+        rows_scanned = rows_frontier = small_frac = None
+        if not terminal:
+            rows_frontier = float(np.sum(n))
+            rows_scanned = (
+                float(np.sum(n[ismall_lvl[:frontier_size]]))
+                if sub_now else rows_frontier
+            )
+            small_frac = (
+                round(rows_scanned / rows_frontier, 6)
+                if rows_frontier else None
+            )
+            timer.counter("rows_scanned", int(round(rows_scanned)))
+            timer.counter("rows_frontier", int(round(rows_frontier)))
+
+        # Carry this level's reduced histogram + child maps so the next
+        # level can accumulate small siblings only. Children are allocated
+        # left/right interleaved starting at the next frontier_lo, so
+        # child 2r/2r+1 pair exactly (ops/histogram slot pairing).
+        if keep_now and not terminal and len(split_ids):
+            nl = dec["n_left"][~stop]
+            left_small = nl * 2.0 <= n[~stop]  # ties go left
+            ism = np.empty(2 * len(split_ids), bool)
+            ism[0::2] = left_small
+            ism[1::2] = ~left_small
+            sub_parent = {
+                "hist": kept_hist,
+                "is_small": ism,
+                "parent_slot": np.repeat(
+                    split_ids.astype(np.int32) - frontier_lo, 2
+                ),
+            }
+        else:
+            sub_parent = None
+
         timer.level(
             level=depth, frontier=frontier_size, splits=len(split_ids),
             hist_bytes=lvl_hist_b, psum_bytes=lvl_psum_b,
+            rows_scanned=rows_scanned, small_child_fraction=small_frac,
             seconds=(
                 round(time.perf_counter() - t_level, 6)
                 if timer.enabled else None

@@ -45,6 +45,7 @@ from mpitree_tpu.core.builder import (
     refit_regression_values,
     resolve_exact_ties,
     resolve_hist_kernel,
+    resolve_hist_subtraction,
     resolve_wide_hist,
     resolve_wide_pallas,
     valid_tiers as builder_valid_tiers,
@@ -116,7 +117,8 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                      feature_axis: str | None = None,
                      sample_k: int | None = None,
                      random_split: bool = False,
-                     monotonic: bool = False):
+                     monotonic: bool = False,
+                     subtraction: bool = False):
     """Pure per-device build fn (xb, y, nid0, w, cand_mask) -> tree arrays.
 
     ``max_depth < 0`` means unbounded. ``psum_axis`` names the mesh axis that
@@ -154,6 +156,21 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
     build fn takes a further trailing ``mono_cst`` (F,) int32 operand of
     INTERNAL signs; children of a constrained split receive mid-value
     bounds through the same allocation scatter as the parent links.
+
+    ``subtraction`` compiles the sibling-subtraction frontier
+    (``ops/histogram.sibling_accumulate_slots`` / ``sibling_reconstruct``)
+    into the loop: the previous level's globally-reduced histogram stays
+    resident in a (K, F, C, B) while-state buffer alongside a per-node
+    smaller-sibling mask and the slot -> parent-slot map (``parent_a``
+    minus the carried previous frontier_lo), and every interior level
+    whose frontier (and parent frontier) fit one chunk accumulates only
+    the smaller children — into a compact half-width buffer, halving both
+    the scatter work and the histogram psum payload — then reconstructs
+    the larger siblings as ``parent - small`` after the reduction. Levels
+    that overflow one chunk (or follow one that did) fall back to direct
+    accumulation via a ``lax.cond`` on the carried ``sub_ok`` flag.
+    Callers gate this on the exactness policy
+    (``builder.resolve_hist_subtraction``).
     """
     # K slots of slack past the true capacity: the last chunk's
     # dynamic_update_slice window [chunk_lo, chunk_lo+K) may extend past the
@@ -218,7 +235,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
             return (use_wide and slot_width >= wide_hist.MIN_SLOTS
                     and slot_width % wide_hist.WINDOW == 0)
 
-        if pallas_tiers or any(wide_ok(s) for s in (*tiers, K)):
+        if use_pallas or use_wide:  # unused widths are DCE'd
             payload = (  # loop-invariant
                 pallas_hist.class_payload(y, w, C)
                 if task == "classification"
@@ -231,13 +248,17 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                 return dec
             j = lax.axis_index(feature_axis)
             f_global = (dec.feature + j * F).astype(jnp.int32)
-            # One stacked gather instead of three: the loop body is
-            # latency-bound on tiny (df, K) payloads.
+            # One stacked gather instead of four: the loop body is
+            # latency-bound on tiny (df, K) payloads. n_left rides along so
+            # the sibling-subtraction smaller-child pick sees the GLOBAL
+            # winner's left weight, not the local shard's.
             packed = jnp.stack(
                 [dec.cost, f_global.astype(jnp.float32),
-                 dec.bin.astype(jnp.float32)]
-            )  # (3, K)
-            gathered = lax.all_gather(packed, feature_axis)  # (df, 3, K)
+                 dec.bin.astype(jnp.float32),
+                 dec.n_left if dec.n_left is not None
+                 else jnp.zeros_like(dec.cost)]
+            )  # (4, K)
+            gathered = lax.all_gather(packed, feature_axis)  # (df, 4, K)
             costs = gathered[:, 0, :]
             # First-min over shards = lowest shard on cost ties = lowest
             # global feature (feature blocks are contiguous per shard) —
@@ -257,6 +278,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                 bin=take(2).astype(jnp.int32),
                 cost=take(0),
                 constant=nonconst == 0,
+                n_left=take(3),
             )
 
         def node_subsets(chunk_lo, n_stat_slots, key_a):
@@ -273,9 +295,51 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
             )
             return nmask, draws
 
+        def raw_hist(slot_rel, n_acc_slots, pallas_ok=False):
+            """One frontier histogram accumulation at ``n_acc_slots`` slots.
+
+            ``slot_rel`` is the per-row slot (or the sibling-subtraction
+            remap, already compacted and masked to -1); kernel routing is
+            width-generic so the subtraction path reuses every tier at its
+            halved accumulate width. ``n_acc_slots``/``pallas_ok`` are
+            STATIC (python ints/bools at trace time — the n_/default
+            conventions graftlint's dataflow reads)."""
+            n_chan = C if task == "classification" else 3
+            if pallas_ok:
+                return pallas_hist.histogram_small(
+                    xb, payload, slot_rel, n_slots=n_acc_slots,
+                    n_bins=n_bins, n_channels=n_chan, vma=hist_vma,
+                )
+            if wide_ok(n_acc_slots):
+                wide_fn = (wide_hist.histogram_wide_pallas if wide_pallas
+                           else wide_hist.histogram_wide)
+                return wide_fn(
+                    xb, payload, slot_rel, n_slots=n_acc_slots,
+                    n_bins=n_bins, n_channels=n_chan,
+                    window=wide_hist.WINDOW,
+                    bf16_ok=wide_bf16 if task == "classification" else False,
+                    vma=hist_vma,
+                )
+            if task == "classification":
+                return hist_ops.class_histogram(
+                    xb, y, slot_rel, jnp.int32(0), n_slots=n_acc_slots,
+                    n_bins=n_bins, n_classes=C, sample_weight=w,
+                )
+            return hist_ops.moment_histogram(
+                xb, y, slot_rel, jnp.int32(0), n_slots=n_acc_slots,
+                n_bins=n_bins, sample_weight=w,
+            )
+
         def chunk_stats(chunk_lo, nid, n_stat_slots, pallas_ok=False,
-                        key_a=None, bounds=None):
-            """Histogram + split search for nodes [chunk_lo, chunk_lo+S_or_K)."""
+                        key_a=None, bounds=None, sub=None):
+            """Histogram + split search for nodes [chunk_lo, chunk_lo+S_or_K).
+
+            ``sub`` (subtraction builds only): ``(sub_now, phist, small_a,
+            parent_a, pflo)`` — the traced use-subtraction flag for this
+            level plus the carried parent histogram and per-node
+            smaller-sibling/parent bookkeeping. Returns ``(dec, pure, h)``
+            with ``h`` the globally-reduced frontier histogram (what the
+            next level subtracts against)."""
             nmask, draws = node_subsets(chunk_lo, n_stat_slots, key_a)
             mono = {}
             if monotonic:
@@ -289,27 +353,38 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                         hi_a, (chunk_lo,), (n_stat_slots,)
                     ),
                 }
+            slot = nid - chunk_lo
+            if sub is not None:
+                sub_now, phist, small_a, parent_p, pflo = sub
+                sm = lax.dynamic_slice(small_a, (chunk_lo,), (n_stat_slots,))
+                half = max(n_stat_slots // 2, 1)
+                pallas_half = (
+                    pallas_ok
+                    and pallas_hist.fits_vmem(
+                        F, half, C if task == "classification" else 3, n_bins
+                    )
+                )
+
+                def sub_branch(_):
+                    acc = hist_ops.sibling_accumulate_slots(
+                        nid, chunk_lo, sm, n_slots=n_stat_slots
+                    )
+                    hs = psum(raw_hist(acc, half, pallas_half))
+                    ps = (
+                        lax.dynamic_slice(
+                            parent_p, (chunk_lo,), (n_stat_slots,)
+                        )
+                        - pflo
+                    )
+                    return hist_ops.sibling_reconstruct(hs, phist, ps, sm)
+
+                def direct_branch(_):
+                    return psum(raw_hist(slot, n_stat_slots, pallas_ok))
+
+                h = lax.cond(sub_now, sub_branch, direct_branch, None)
+            else:
+                h = psum(raw_hist(slot, n_stat_slots, pallas_ok))
             if task == "classification":
-                if pallas_ok:
-                    h = pallas_hist.histogram_small(
-                        xb, payload, nid - chunk_lo, n_slots=n_stat_slots,
-                        n_bins=n_bins, n_channels=C, vma=hist_vma,
-                    )
-                elif wide_ok(n_stat_slots):
-                    wide_fn = (wide_hist.histogram_wide_pallas if wide_pallas
-                               else wide_hist.histogram_wide)
-                    h = wide_fn(
-                        xb, payload, nid - chunk_lo, n_slots=n_stat_slots,
-                        n_bins=n_bins, n_channels=C,
-                        window=wide_hist.WINDOW, bf16_ok=wide_bf16,
-                        vma=hist_vma,
-                    )
-                else:
-                    h = hist_ops.class_histogram(
-                        xb, y, nid, chunk_lo, n_slots=n_stat_slots,
-                        n_bins=n_bins, n_classes=C, sample_weight=w,
-                    )
-                h = psum(h)
                 dec = select_global(imp_ops.best_split_classification(
                     h, cand_mask, criterion=criterion,
                     min_child_weight=mcw, node_mask=nmask,
@@ -321,26 +396,6 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                 ))
                 pure = (dec.counts > 0).sum(axis=1) <= 1
             else:
-                if pallas_ok:
-                    h = pallas_hist.histogram_small(
-                        xb, payload, nid - chunk_lo, n_slots=n_stat_slots,
-                        n_bins=n_bins, n_channels=3, vma=hist_vma,
-                    )
-                elif wide_ok(n_stat_slots):
-                    wide_fn = (wide_hist.histogram_wide_pallas if wide_pallas
-                               else wide_hist.histogram_wide)
-                    h = wide_fn(
-                        xb, payload, nid - chunk_lo, n_slots=n_stat_slots,
-                        n_bins=n_bins, n_channels=3,
-                        window=wide_hist.WINDOW, bf16_ok=False,
-                        vma=hist_vma,
-                    )
-                else:
-                    h = hist_ops.moment_histogram(
-                        xb, y, nid, chunk_lo, n_slots=n_stat_slots,
-                        n_bins=n_bins, sample_weight=w,
-                    )
-                h = psum(h)
                 dec = select_global(imp_ops.best_split_regression(
                     h, cand_mask, min_child_weight=mcw, node_mask=nmask,
                     forced_draw=draws, **mono,
@@ -349,7 +404,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                     y, nid, w, chunk_lo, n_slots=n_stat_slots, axis=psum_axis
                 )
                 pure = ~(ymax > ymin)
-            return dec, pure
+            return dec, pure, h
 
         def chunk_counts(chunk_lo, nid):
             """Terminal level: per-node counts only (O(R) instead of O(R*F))."""
@@ -361,7 +416,13 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
         def level_body(state):
             (feat_a, bin_a, counts_a, n_a, left_a, parent_a, nid, flo, fsz,
              depth, key_a) = state[:11]
-            bounds = (state[11], state[12]) if monotonic else None
+            idx = 11
+            bounds = None
+            if monotonic:
+                bounds = (state[idx], state[idx + 1])
+                idx += 2
+            if subtraction:
+                small_a, phist0, pflo, sub_ok = state[idx:idx + 4]
             terminal = jnp.logical_and(max_depth >= 0, depth == max_depth)
             n_chunks = (fsz + K - 1) // K
 
@@ -382,29 +443,42 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                     # sklearn's middle_value of the winning candidate —
                     # the child-bound pin below.
                     out = out + ((dec.v_left + dec.v_right) * 0.5,)
+                if subtraction:
+                    # Winner's left weight — the smaller-child pick during
+                    # child allocation below.
+                    out = out + (dec.n_left,)
                 return out
 
+            # bufs layout: (feat, bin, counts, n)[, mid][, nl][, phist] —
+            # pieces cover everything but phist, which branches update in
+            # place (it is level-global, not per-chunk-slot data).
             def write_bufs(bufs, pieces, at):
-                feat_a, bin_a, counts_a, n_a = bufs[:4]
-                feat_a = lax.dynamic_update_slice(feat_a, pieces[0], (at,))
-                bin_a = lax.dynamic_update_slice(bin_a, pieces[1], (at,))
-                counts_a = lax.dynamic_update_slice(
-                    counts_a, pieces[2], (at, 0)
-                )
-                n_a = lax.dynamic_update_slice(n_a, pieces[3], (at,))
-                out = (feat_a, bin_a, counts_a, n_a)
-                if monotonic:
-                    out = out + (
-                        lax.dynamic_update_slice(bufs[4], pieces[4], (at,)),
-                    )
-                return out
+                out = []
+                for buf, piece in zip(bufs, pieces):
+                    ix = (at, 0) if buf.ndim == 2 else (at,)
+                    out.append(lax.dynamic_update_slice(buf, piece, ix))
+                return tuple(out) + tuple(bufs[len(pieces):])
+
+            n_pieces = 4 + int(monotonic) + int(subtraction)
+            sub_args_big = (
+                (jnp.logical_and(sub_ok, n_chunks == 1), phist0, small_a,
+                 parent_a, pflo)
+                if subtraction else None
+            )
 
             def chunk_body(c, bufs):
                 chunk_lo = flo + c * K
 
                 def interior(_):
-                    return decide(*chunk_stats(chunk_lo, nid, K, key_a=key_a,
-                                               bounds=bounds))
+                    dec, pure, h = chunk_stats(
+                        chunk_lo, nid, K, key_a=key_a, bounds=bounds,
+                        sub=sub_args_big,
+                    )
+                    # Offset 0, not chunk_lo - flo: multi-chunk levels
+                    # cannot serve as subtraction parents (sub_ok drops
+                    # below), so later chunks overwriting slot 0 is dead
+                    # data, while the single-chunk case lands exactly.
+                    return decide(dec, pure), (h if subtraction else None)
 
                 def term(_):
                     cc = chunk_counts(chunk_lo, nid)
@@ -413,27 +487,44 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                            jnp.zeros(K, jnp.int32), cc, n)
                     if monotonic:
                         out = out + (jnp.zeros(K, jnp.float32),)
-                    return out
+                    if subtraction:
+                        out = out + (jnp.zeros(K, jnp.float32),)
+                    return out, (bufs[n_pieces] if subtraction else None)
 
                 if not interior_big_reachable:
                     # Every interior frontier fits a tier branch, so the
                     # big path only ever runs terminal counts — don't
                     # compile the K-slot sweep at all (crown programs).
-                    pieces = term(None)
+                    pieces, h = term(None)
                 else:
-                    pieces = lax.cond(terminal, term, interior, None)
-                return write_bufs(bufs, pieces, chunk_lo)
+                    pieces, h = lax.cond(terminal, term, interior, None)
+                bufs = write_bufs(bufs, pieces, chunk_lo)
+                if subtraction:
+                    bufs = bufs[:n_pieces] + (h,)
+                return bufs
 
             def big_level(bufs):
                 return lax.fori_loop(0, n_chunks, chunk_body, bufs)
 
             def tier_level(s):
                 def branch(bufs):
-                    pieces = decide(
-                        *chunk_stats(flo, nid, s, pallas_ok=s in pallas_tiers,
-                                     key_a=key_a, bounds=bounds)
+                    dec, pure, h = chunk_stats(
+                        flo, nid, s, pallas_ok=s in pallas_tiers,
+                        key_a=key_a, bounds=bounds,
+                        sub=(
+                            (sub_ok, phist0, small_a, parent_a, pflo)
+                            if subtraction else None
+                        ),
                     )
-                    return write_bufs(bufs, pieces, flo)
+                    pieces = decide(dec, pure)
+                    bufs = write_bufs(bufs, pieces, flo)
+                    if subtraction:
+                        bufs = bufs[:n_pieces] + (
+                            lax.dynamic_update_slice(
+                                bufs[n_pieces], h, (0, 0, 0, 0)
+                            ),
+                        )
+                    return bufs
 
                 return branch
 
@@ -451,9 +542,14 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
             bufs = (feat_a, bin_a, counts_a, n_a)
             if monotonic:
                 bufs = bufs + (jnp.zeros(M, jnp.float32),)  # winner mids
+            if subtraction:
+                bufs = bufs + (jnp.zeros(M, jnp.float32),)  # winner n_left
+                bufs = bufs + (phist0,)
             bufs = dispatch(bufs)
             feat_a, bin_a, counts_a, n_a = bufs[:4]
             mid_a = bufs[4] if monotonic else None
+            nl_a = bufs[4 + int(monotonic)] if subtraction else None
+            phist_new = bufs[n_pieces] if subtraction else None
 
             # Child allocation, frontier-windowed: the previous full-M
             # formulation scattered 2*(M+2) elements per level (M is the
@@ -470,6 +566,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
             # M+2 copies every level.
             parent_p = parent_a
             key_p = key_a if sampling else None
+            small_p = small_a if subtraction else None
             if monotonic:
                 lo_a, hi_a = bounds
                 lo_p, hi_p = lo_a, hi_a
@@ -477,7 +574,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                 lo_p = hi_p = None
 
             def alloc_chunk(c, carry):
-                left_a, parent_p, key_p, lo_p, hi_p, child_base = carry
+                left_a, parent_p, key_p, lo_p, hi_p, small_p, child_base = carry
                 chunk_lo = flo + c * K
                 gidx = chunk_lo + jnp.arange(K, dtype=jnp.int32)
                 loc_feat = lax.dynamic_slice(feat_a, (chunk_lo,), (K,))
@@ -509,6 +606,19 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                     key_p = key_p.at[scat + 1].set(
                         jnp.where(split, rk, jnp.uint32(0))
                     )
+                if subtraction:
+                    # Smaller-sibling pick from the winner's left weight
+                    # (ties go left — same rule as the levelwise host
+                    # tier, so both engines accumulate the same children).
+                    loc_nl = lax.dynamic_slice(nl_a, (chunk_lo,), (K,))
+                    loc_n = lax.dynamic_slice(n_a, (chunk_lo,), (K,))
+                    left_small = loc_nl * 2.0 <= loc_n
+                    small_p = small_p.at[scat].set(
+                        jnp.where(split, left_small, True)
+                    )
+                    small_p = small_p.at[scat + 1].set(
+                        jnp.where(split, ~left_small, True)
+                    )
                 if monotonic:
                     # sklearn bound propagation: a split on a constrained
                     # feature pins mid between the children.
@@ -525,11 +635,12 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                     hi_p = hi_p.at[scat].set(jnp.where(split, lhi, 0.0))
                     hi_p = hi_p.at[scat + 1].set(jnp.where(split, rhi, 0.0))
                 child_base = child_base + 2 * rank[-1]
-                return (left_a, parent_p, key_p, lo_p, hi_p, child_base)
+                return (left_a, parent_p, key_p, lo_p, hi_p, small_p,
+                        child_base)
 
-            carry = (left_a, parent_p, key_p, lo_p, hi_p, flo + fsz)
+            carry = (left_a, parent_p, key_p, lo_p, hi_p, small_p, flo + fsz)
             carry = lax.fori_loop(0, n_chunks, alloc_chunk, carry)
-            left_a, parent_a, key_p, lo_p, hi_p, child_end = carry
+            left_a, parent_a, key_p, lo_p, hi_p, small_p, child_end = carry
             n_split = (child_end - (flo + fsz)) // 2
             if sampling:
                 key_a = key_p
@@ -570,6 +681,13 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                    flo + fsz, 2 * n_split, depth + 1, key_a)
             if monotonic:
                 out = out + bounds
+            if subtraction:
+                # Next level may subtract iff this level's reduced
+                # histogram is whole in the carry: one interior chunk.
+                out = out + (
+                    small_p, phist_new, flo,
+                    jnp.logical_and(n_chunks == 1, ~terminal),
+                )
             return out
 
         def level_cond(state):
@@ -597,6 +715,18 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                 jnp.full(M + 2, -jnp.inf, jnp.float32),  # node lower bounds
                 jnp.full(M + 2, jnp.inf, jnp.float32),   # node upper bounds
             )
+        if subtraction:
+            n_chan = C if task == "classification" else 3
+            state0 = state0 + (
+                # smaller-sibling per node (padded; True = pads read the
+                # zero pair in sibling_reconstruct)
+                jnp.ones(M + 2, bool),
+                # resident parent histogram, slot-indexed from the parent
+                # level's frontier_lo — one chunk's worth
+                jnp.zeros((K, F, n_chan, n_bins), jnp.float32),
+                jnp.int32(0),         # parent level's frontier_lo
+                jnp.array(False),     # sub_ok: no parent above the root
+            )
         out = lax.while_loop(level_cond, level_body, state0)
         feat_a, bin_a, counts_a, n_a, left_a, parent_a, nid, flo = out[:8]
         return feat_a, bin_a, counts_a, n_a, left_a, parent_a[:M], nid, flo
@@ -612,7 +742,8 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                    wide_bf16: bool = False, wide_pallas: bool = False,
                    exact_ties: bool = False,
                    sample_k: int | None = None,
-                   random_split: bool = False, monotonic: bool = False):
+                   random_split: bool = False, monotonic: bool = False,
+                   subtraction: bool = False):
     """Data-parallel single-tree build: rows sharded, histograms psum'd.
 
     Jitted (xb, y, nid0, w, cand_mask, mcw, mid, root_key, mono_cst) ->
@@ -634,6 +765,7 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         psum_axis=DATA_AXIS,
         feature_axis=feature_axis, sample_k=sample_k,
         random_split=random_split, monotonic=monotonic,
+        subtraction=subtraction,
     )
     FA = feature_axis  # None on a 1-D mesh -> replicated feature dim
     out_specs = (P(), P(), P(), P(), P(), P(), P(DATA_AXIS), P())
@@ -683,6 +815,12 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     collective path as the single-tree build), so forests scale past
     one device's HBM per tree and surplus devices stop idling when
     ``n_trees < n_devices``.
+
+    Sibling subtraction stays OFF here for now: the resident parent
+    histogram would ride the per-tree ``lax.map`` carry (one extra
+    chunk-sized buffer per in-flight tree) and the forest program's
+    compile cost already dominates small fits — ROADMAP lists the
+    follow-up.
     """
     build = _make_build_body(
         n_slots=n_slots, n_bins=n_bins, n_classes=n_classes, task=task,
@@ -800,8 +938,27 @@ def build_tree_fused(
         mesh.devices.flat[0].platform, use_wide=use_wide,
         n_channels=C, n_bins=B,
     )
+    total_w_all = (
+        float(N) if sample_weight is None else float(np.sum(sample_weight))
+    )
+    use_sub = resolve_hist_subtraction(
+        cfg, mesh.devices.flat[0].platform, task, integer_ok=int_ok,
+        total_weight=total_w_all, obs=timer,
+    )
 
     timer.set_mesh(mesh)
+    timer.decision(
+        "hist_subtraction", "on" if use_sub else "off",
+        reason=(
+            "sibling-subtraction frontier compiled into the fused loop: "
+            "single-chunk interior levels accumulate the smaller child "
+            "only and derive the larger from the resident parent histogram"
+            if use_sub else
+            "direct accumulation (resolve_hist_subtraction: config/env "
+            "off, non-exact channels or non-accelerator platform under "
+            "'auto', or the 2**24 f32 ceiling)"
+        ),
+    )
     md = -1 if cfg.max_depth is None else int(cfg.max_depth)
     fn_kw = dict(
         n_slots=K, n_bins=B, n_classes=C, task=task,
@@ -813,6 +970,7 @@ def build_tree_fused(
         wide_pallas=wide_pallas, exact_ties=exact_ties,
         sample_k=sample_k, random_split=random_split,
         monotonic=monotonic,
+        subtraction=use_sub,
     )
     fn = _make_fused_fn(mesh, **fn_kw)
     timer.compile_note(
@@ -854,6 +1012,7 @@ def build_tree_fused(
         tree.depth, n_slots=K, tiers=eff_tiers, n_features=F, n_bins=B,
         n_channels=C, counts_channels=C, max_depth=md, task=task,
         feature_shards=mesh_lib.feature_shards(mesh), n_rows=N,
+        subtraction=use_sub,
     )
     for site, v in coll.items():
         timer.collective(site, calls=v["calls"], nbytes=v["bytes"])

@@ -69,6 +69,7 @@ def fused_level_rows(
     task: str,
     feature_shards: int = 1,
     n_rows: int | None = None,
+    subtraction: bool = False,
 ) -> tuple:
     """(level_rows, collectives) replayed from a fused build's finished tree.
 
@@ -77,8 +78,13 @@ def fused_level_rows(
     (:func:`effective_tiers` of the valid tiers). ``n_channels`` is the
     histogram payload width (C for classification, 3 moment channels
     otherwise); ``counts_channels`` the terminal counts width.
-    ``max_depth < 0`` = unbounded. Returns per-level row dicts (seconds
-    ``None`` — one compiled program has no per-level host clock) and a
+    ``max_depth < 0`` = unbounded. ``subtraction`` replays the
+    sibling-subtraction routing (``fused_builder``'s ``sub_ok`` carry): an
+    interior level below the root whose frontier AND parent frontier each
+    fit one chunk psums only the compact half-width small-child buffer.
+    Returns per-level row dicts (seconds ``None`` — one compiled program
+    has no per-level host clock; ``rows_scanned``/``small_child_fraction``
+    ``None`` — the depth histogram carries no per-node row counts) and a
     ``{site: {"calls", "bytes"}}`` dict of logical psum/gather payloads.
     """
     frontiers = np.bincount(np.asarray(node_depths, np.int64))
@@ -91,6 +97,7 @@ def fused_level_rows(
         entry["bytes"] += nbytes
 
     K = n_slots
+    prev_one_chunk = False  # the root has no parent histogram above it
     for d, f in enumerate(frontiers.tolist()):
         if f == 0:
             continue
@@ -106,11 +113,14 @@ def fused_level_rows(
             add("counts_psum", chunks, nbytes)
             hist_bytes = 0
             psum_bytes = nbytes
+            prev_one_chunk = False
         else:
             S = next((s for s in tiers if f <= s), K)
             chunks = 1 if S < K else math.ceil(f / K)
+            sub_here = subtraction and chunks == 1 and prev_one_chunk
             per_chunk = split_psum_bytes(
-                n_slots=S, n_features=n_features, n_bins=n_bins,
+                n_slots=S // 2 if sub_here else S,
+                n_features=n_features, n_bins=n_bins,
                 n_channels=n_channels,
             )
             hist_bytes = chunks * per_chunk
@@ -121,18 +131,21 @@ def fused_level_rows(
                 add("y_range_pminmax", chunks, yb)
                 psum_bytes += yb
             if feature_shards > 1:
-                # select_global's stacked (3, S) f32 all_gather per chunk,
+                # select_global's stacked (4, S) f32 all_gather per chunk,
                 # plus the per-level row-routing psum of child ids.
-                gb = chunks * 3 * S * 4
+                gb = chunks * 4 * S * 4
                 add("feature_merge_all_gather", chunks, gb)
                 if n_rows is not None:
                     add("route_psum", 1, n_rows * 4)
+            prev_one_chunk = chunks == 1
         rows.append({
             "level": d,
             "frontier": int(f),
             "splits": splits,
             "hist_bytes": int(hist_bytes),
             "psum_bytes": int(psum_bytes),
+            "rows_scanned": None,
+            "small_child_fraction": None,
             "seconds": None,
             "new_lowerings": 0,
         })

@@ -27,7 +27,9 @@ from __future__ import annotations
 import dataclasses
 import json
 
-SCHEMA_VERSION = 1
+# v2: level rows gained ``rows_scanned``/``small_child_fraction`` and the
+# digest gained ``sub_frac`` (sibling-subtraction realized savings).
+SCHEMA_VERSION = 2
 
 # The golden field set: tests/test_obs.py pins this against to_dict() so a
 # rename cannot slip past bench/watcher consumers silently.
@@ -81,9 +83,14 @@ class BuildRecord:
     - ``phases``: PhaseTimer summary (``{name: {seconds, calls}}``) —
       populated only under ``MPITREE_TPU_PROFILE=1``.
     - ``levels``: per-level rows ``{level, frontier, splits, hist_bytes,
-      psum_bytes, seconds, new_lowerings}`` (levelwise/host: live;
-      fused: reconstructed post-hoc from the finished tree's depth
-      histogram). Profile-gated; capped (see BuildObserver).
+      psum_bytes, rows_scanned, small_child_fraction, seconds,
+      new_lowerings}`` (levelwise/host: live; fused: reconstructed
+      post-hoc from the finished tree's depth histogram, where the two
+      row-scan fields are ``None`` — depth counts carry no per-node row
+      totals). ``rows_scanned`` is the weight actually accumulated into
+      split histograms (under sibling subtraction: the smaller siblings
+      only); ``small_child_fraction = rows_scanned / frontier rows``.
+      Profile-gated; capped (see BuildObserver).
     - ``counters``: always-on integer counters.
     - ``compile``: per jit entry point ``{"lowerings": lowering events
       seen process-wide (distinct keys, plus re-lowerings of keys the
@@ -145,6 +152,14 @@ def digest(report: dict) -> dict:
     wall = sum(
         float(v.get("seconds", 0.0)) for v in report.get("phases", {}).values()
     )
+    # Realized sibling-subtraction savings: the fraction of interior
+    # frontier weight that was actually accumulated into histograms
+    # (1.0 = direct accumulation everywhere; ~0.5 + 1/levels is the
+    # steady-state floor — the root always scans fully). None when the
+    # engine recorded no row counters (fused replay, host tiers).
+    counters = report.get("counters", {})
+    scanned = counters.get("rows_scanned")
+    frontier = counters.get("rows_frontier")
     return {
         "engine": report.get("engine", {}).get("value"),
         "reason": (report.get("engine", {}).get("reason") or "")[:120],
@@ -155,6 +170,10 @@ def digest(report: dict) -> dict:
             int(v.get("new", 0)) for v in report.get("compile", {}).values()
         ),
         "psum_bytes": total_psum,
+        "sub_frac": (
+            round(scanned / frontier, 4) if scanned is not None and frontier
+            else None
+        ),
         "events": len(report.get("events", [])),
         "wall_s": round(wall, 3),
     }

@@ -19,6 +19,20 @@ assigned level by level in creation order, so a level's frontier is a
 contiguous id range and slot arithmetic replaces any remap table. Rows parked
 in finished leaves (or padding rows with ``node_id == -1``) fall outside
 ``[0, n_slots)`` and are masked to weight zero.
+
+Sibling subtraction (LightGBM's halved-histogram trick, Ke et al. 2017):
+the two children of a split partition their parent exactly, and every
+channel here is a sum, so ``hist(large) = hist(parent) - hist(small)``.
+:func:`sibling_accumulate_slots` remaps rows so only SMALL children
+accumulate — into a *compacted* ``n_slots // 2`` buffer addressed by pair
+index ``slot >> 1`` (children are allocated left/right interleaved, so
+siblings share a pair) — which also halves the cross-device ``psum``
+payload; :func:`sibling_reconstruct` rebuilds the full frontier histogram
+after the reduction from the resident parent histogram. Subtraction is
+EXACT whenever the channel sums are: integer-valued f32 counts below
+2**24, and the scoped-f64 (g, h) accumulation path. The remap composes
+with every kernel tier (scatter, ``pallas_hist``, ``wide_hist``) because
+they all address rows purely by slot.
 """
 
 from __future__ import annotations
@@ -68,6 +82,69 @@ def class_histogram(
         data.reshape(-1), ids.reshape(-1), num_segments=n_slots * F * n_classes * n_bins
     )
     return hist.reshape(n_slots, F, n_classes, n_bins)
+
+
+def sibling_accumulate_slots(
+    node_id: jax.Array,
+    chunk_lo: jax.Array,
+    is_small: jax.Array,
+    *,
+    n_slots: int,
+) -> jax.Array:
+    """Per-row pseudo node ids for small-child-only accumulation.
+
+    ``is_small`` is (n_slots,) bool — True where the frontier slot holds
+    the smaller sibling of its pair (exactly one True per live pair; pad
+    slots are True so they read the zero-accumulated compact buffer in
+    :func:`sibling_reconstruct`). Rows in small children map to their pair
+    index ``slot >> 1`` (valid in a compact ``n_slots // 2``-slot
+    histogram with ``chunk_lo == 0``); rows in large children — and rows
+    outside the chunk — map to ``-1``, which every histogram kernel
+    already masks to weight zero.
+    """
+    slot = node_id - chunk_lo
+    in_chunk = (slot >= 0) & (slot < n_slots)
+    small = in_chunk & is_small[jnp.clip(slot, 0, n_slots - 1)]
+    return jnp.where(small, slot >> 1, -1)
+
+
+def sibling_reconstruct(
+    small_hist: jax.Array,
+    parent_hist: jax.Array,
+    parent_slot: jax.Array,
+    is_small: jax.Array,
+) -> jax.Array:
+    """Full frontier histogram from the compact small-child histogram.
+
+    ``small_hist`` is the globally-reduced (n_slots // 2, ...) compact
+    buffer from :func:`sibling_accumulate_slots` rows; ``parent_hist`` the
+    RESIDENT globally-reduced histogram of the previous level (any slot
+    width >= the parent frontier); ``parent_slot`` (n_slots,) int32 maps
+    each frontier slot to its parent's slot in ``parent_hist`` (pad slots
+    may carry any value — they read their zero pair through the
+    ``is_small`` mask). Runs AFTER the psum, so the subtraction is exact
+    under the linearity of the allreduce: ``psum(parent) - psum(small) ==
+    psum(parent - small)``. dtype follows the inputs (f32, or f64 on the
+    scoped-x64 gbdt path).
+    """
+    S = is_small.shape[0]
+    # This runs inside the gbdt path's scoped ``enable_x64``, where
+    # (a) fill-mode gathers cannot lower for f64 operands (the fill
+    # constant canonicalizes to f32) and (b) ``jnp.clip``'s cached inner
+    # jit traces against the wrong scalar width on pre-shard_map wheels —
+    # so indices are bounded with plain min/max ufuncs and both gathers
+    # run clip-mode (lax clamps in HLO — no python-side jnp.clip, no fill
+    # select; the indices are already in bounds: pair < S // 2 and
+    # parent_slot is clamped).
+    pair = jnp.right_shift(jnp.arange(S, dtype=jnp.int32), jnp.int32(1))
+    ps = jnp.minimum(
+        jnp.maximum(parent_slot, jnp.int32(0)),
+        jnp.int32(parent_hist.shape[0] - 1),
+    )
+    small = jnp.take(small_hist, pair, axis=0, mode="clip")
+    parent = jnp.take(parent_hist, ps, axis=0, mode="clip")
+    mask = is_small.reshape((S,) + (1,) * (small.ndim - 1))
+    return jnp.where(mask, small, parent - small)
 
 
 def _flat_ids(x_binned: jax.Array, valid: jax.Array, slot: jax.Array,

@@ -64,6 +64,12 @@ class SplitDecision(NamedTuple):
     # (sklearn's middle_value, sklearn/tree/_tree.pyx bound propagation).
     v_left: jax.Array = None
     v_right: jax.Array = None
+    # Winning candidate's left-side total (weight for classification/
+    # regression, subsampled row count for gbdt) — what the sibling-
+    # subtraction frontier uses to pick the smaller child per pair
+    # (``n_left * 2 <= n`` => left is small; ties go left). Exact integers
+    # in f32 wherever the histogram channels are.
+    n_left: jax.Array = None
 
 
 def _entropy(counts: jax.Array, n: jax.Array) -> jax.Array:
@@ -315,6 +321,16 @@ def best_split_classification(
         y_range=jnp.zeros_like(parent_n),
         v_left=v_left,
         v_right=v_right,
+        # Winner's left weight from a plain f32 cumsum — exact for the
+        # integer counts the subtraction frontier runs on, and crucially
+        # NOT a read of the exact-ties f64 sweep's n_l: a new consumer
+        # there changes XLA's fusion clustering, and the sweep's
+        # excess-precision behavior (the _cost_sweep_f64 residual) is
+        # fusion-sensitive — gathering from it flipped documented
+        # host==device tie pins.
+        n_left=_winner_gather(
+            jnp.cumsum(hist_sum, axis=2), best_feature, best_bin
+        ),
     )
 
 
@@ -358,12 +374,16 @@ def _monotonic_ok(v_l, v_r, mono_cst, mono_lo, mono_hi) -> jax.Array:
 
 def _winner_values(v_l, v_r, best_feature, best_bin):
     """Gather the winning candidate's (v_left, v_right) per slot."""
-    vl_f = jnp.take_along_axis(v_l, best_bin[:, None, None], axis=2)[:, :, 0]
-    vr_f = jnp.take_along_axis(v_r, best_bin[:, None, None], axis=2)[:, :, 0]
     return (
-        jnp.take_along_axis(vl_f, best_feature[:, None], axis=1)[:, 0],
-        jnp.take_along_axis(vr_f, best_feature[:, None], axis=1)[:, 0],
+        _winner_gather(v_l, best_feature, best_bin),
+        _winner_gather(v_r, best_feature, best_bin),
     )
+
+
+def _winner_gather(a, best_feature, best_bin):
+    """Winning candidate's entry of a (K, F, B) per-candidate array."""
+    a_f = jnp.take_along_axis(a, best_bin[:, None, None], axis=2)[:, :, 0]
+    return jnp.take_along_axis(a_f, best_feature[:, None], axis=1)[:, 0]
 
 
 def _drawn_bins(valid: jax.Array, draw: jax.Array) -> jax.Array:
@@ -457,6 +477,9 @@ def best_split_newton(
         y_range=zeros,
         v_left=zeros,
         v_right=zeros,
+        # Row count, not hessian: the subtraction frontier picks the child
+        # with fewer rows to ACCUMULATE — the scatter cost is per row.
+        n_left=_winner_gather(c_l, best_feature, best_bin),
     )
 
 
@@ -545,4 +568,5 @@ def best_split_regression(
         y_range=jnp.zeros_like(parent_n),
         v_left=v_left,
         v_right=v_right,
+        n_left=_winner_gather(w_l, best_feature, best_bin),
     )

@@ -122,6 +122,7 @@ _RIGHT_SALT = np.uint32(0xC2B2AE35)
 _FEAT_SALT = np.uint32(0x85EBCA6B)
 _DRAW_SALT = np.uint32(0x27D4EB2F)  # random-split bin draws (ExtraTrees)
 _ROW_SALT = np.uint32(0x51ED270B)  # per-round row subsampling (boosting)
+_COL_SALT = np.uint32(0x6C62272E)  # per-round feature subsampling (boosting)
 
 
 def pcg_hash(x: np.ndarray) -> np.ndarray:
@@ -156,6 +157,41 @@ def row_subsample_mask(seed: int, round_idx: int, n_rows: int,
         )
         keys = pcg_hash(base + np.arange(n_rows, dtype=np.uint32))
     return keys < np.uint32(int(fraction * 4294967296.0))
+
+
+def feature_subsample_mask(seed: int, round_idx: int, n_features: int,
+                           fraction: float) -> np.ndarray:
+    """(n_features,) bool mask of features sampled into one boosting round.
+
+    XGBoost's ``colsample_bytree``, keyed like :func:`row_subsample_mask`:
+    a pure function of (seed, round, feature), so refits, resumed fits,
+    and every mesh size draw the identical subset. Unlike the Bernoulli
+    row draw this selects EXACTLY ``k = max(1, floor(fraction * F))``
+    features — a round with zero features cannot fit a tree, and a fixed
+    k keeps the sliced binned matrix one compiled executable across
+    rounds. Selection is the first k of a stable ascending argsort of
+    per-(round, feature) PCG scores — hash-collision ties resolve to the
+    lowest feature index, the same stability contract as
+    :meth:`NodeFeatureSampler.node_masks`.
+    """
+    if not 0.0 < fraction <= 1.0:
+        raise ValueError(
+            f"colsample fraction must be in (0, 1], got {fraction!r}"
+        )
+    if fraction >= 1.0:
+        return np.ones(n_features, bool)
+    k = max(1, int(fraction * n_features))
+    with np.errstate(over="ignore"):
+        base = np.uint32(
+            pcg_hash(np.uint32(seed))
+            ^ pcg_hash((np.uint32(round_idx) + _COL_SALT).astype(np.uint32))
+        )
+        f = np.arange(n_features, dtype=np.uint32)
+        scores = pcg_hash(base + (f + np.uint32(1)) * _COL_SALT)
+    order = np.argsort(scores, kind="stable")
+    mask = np.zeros(n_features, bool)
+    mask[order[:k]] = True
+    return mask
 
 
 def pcg_hash_jnp(x):

@@ -114,18 +114,20 @@ def regression_y_range(y, nid, w, chunk_lo, *, n_slots, axis=DATA_AXIS):
 
 
 def _pack_decision(dec) -> jax.Array:
-    """SplitDecision -> one (K, 9 + C) float32 buffer.
+    """SplitDecision -> one (K, 10 + C) float32 buffer.
 
     The levelwise builder fetches the decision every level; a namedtuple
-    fetch is one host transfer per field (10 round trips on a tunneled
+    fetch is one host transfer per field (11 round trips on a tunneled
     transport), a packed buffer is one. feature/bin/constant ride as f32 —
-    exact below 2^24, far above any bin or feature count. ``n`` and the
-    class ``counts`` share that 2^24 integer-exactness ceiling: today they
-    arrive as f32 device histograms anyway, so packing loses nothing, but a
-    future f64-histogram path must widen this buffer or it would silently
-    truncate node totals past 16.7M weighted rows (tree.count contract,
-    min_samples_split tests). ``v_left``/``v_right`` (monotonic
-    constraints; zeros otherwise) feed the host's child-bound propagation.
+    exact below 2^24, far above any bin or feature count. ``n``,
+    ``n_left`` and the class ``counts`` share that 2^24 integer-exactness
+    ceiling: today they arrive as f32 device histograms anyway, so packing
+    loses nothing, but a future f64-histogram path must widen this buffer
+    or it would silently truncate node totals past 16.7M weighted rows
+    (tree.count contract, min_samples_split tests). ``v_left``/``v_right``
+    (monotonic constraints; zeros otherwise) feed the host's child-bound
+    propagation; ``n_left`` feeds the sibling-subtraction frontier's
+    smaller-child pick.
     """
     zeros = jnp.zeros_like(dec.n)
     head = jnp.stack(
@@ -133,7 +135,8 @@ def _pack_decision(dec) -> jax.Array:
          dec.cost, dec.impurity, dec.n,
          dec.constant.astype(jnp.float32), dec.y_range,
          dec.v_left if dec.v_left is not None else zeros,
-         dec.v_right if dec.v_right is not None else zeros],
+         dec.v_right if dec.v_right is not None else zeros,
+         dec.n_left if dec.n_left is not None else zeros],
         axis=1,
     )
     return jnp.concatenate([head, dec.counts.astype(jnp.float32)], axis=1)
@@ -151,7 +154,8 @@ def unpack_decision(packed: np.ndarray) -> dict:
         "y_range": packed[:, 6],
         "v_left": packed[:, 7],
         "v_right": packed[:, 8],
-        "counts": packed[:, 9:],
+        "n_left": packed[:, 9],
+        "counts": packed[:, 10:],
     }
 
 
@@ -163,9 +167,10 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                   exact_ties: bool = False,
                   node_mask: bool = False,
                   random_split: bool = False, monotonic: bool = False,
-                  gbdt_x64: bool = False):
+                  gbdt_x64: bool = False,
+                  subtraction: bool = False, keep_hist: bool = False):
     """Jitted (x_binned, y, node_id, weight, cand_mask, chunk_lo, mcw[, nmask])
-    -> packed (n_slots, 9 + C) float32 decision buffer (see
+    -> packed (n_slots, 10 + C) float32 decision buffer (see
     :func:`_pack_decision`, :func:`unpack_decision`). ``mcw`` is the
     min-child-weight floor as a RUNTIME scalar (a traced constant would
     recompile per distinct total fit weight).
@@ -193,15 +198,40 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     rounds the psum'd result to f32 — what makes boosted trees identical
     across mesh sizes (histogram.grad_hess_histogram). Per-node feature
     masks / random splits / monotonic constraints are not supported for
-    gbdt."""
+    gbdt.
+
+    ``subtraction=True`` (sibling-subtraction frontier,
+    ``ops/histogram.sibling_accumulate_slots``): three trailing operands —
+    the RESIDENT globally-reduced parent histogram of the previous level
+    ((S_parent, F, C, B); f64 on the gbdt scoped-x64 path), a (n_slots,)
+    int32 slot -> parent-slot map, and a (n_slots,) bool smaller-sibling
+    mask. Only rows of small children accumulate, into a COMPACT
+    ``n_slots // 2`` buffer, so the histogram psum payload halves; the
+    large siblings are reconstructed from the parent after the reduction.
+    Callers gate ``use_pallas``/``use_wide`` at the halved accumulate
+    width. ``keep_hist=True`` additionally returns the full
+    globally-reduced frontier histogram (after the reconstruction, before
+    any f32 rounding on the gbdt path) so the next level can subtract
+    against it — outputs become ``(packed, hist[, repl_err])``."""
     if task == "gbdt" and (node_mask or random_split or monotonic):
         raise ValueError(
             "task='gbdt' does not support per-node feature masks, random "
             "splits, or monotonic constraints"
         )
+    n_acc = n_slots // 2 if subtraction else n_slots
 
     def local_step(xb, y, nid, w, cand_mask, chunk_lo, mcw, *nm):
         nm = list(nm)
+        if subtraction:  # last three operands, popped in reverse
+            is_small = nm.pop()
+            parent_slot = nm.pop()
+            parent_hist = nm.pop()
+            acc_nid = hist_ops.sibling_accumulate_slots(
+                nid, chunk_lo, is_small, n_slots=n_slots
+            )
+            acc_lo = jnp.int32(0)
+        else:
+            acc_nid, acc_lo = nid, chunk_lo
         mono = {}
         if monotonic:  # trailing operands: ..., cst, lo, hi
             hi = nm.pop()
@@ -209,13 +239,21 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
             mono = {"mono_cst": nm.pop(), "mono_lo": lo, "mono_hi": hi}
         nmask = nm[0] if nm else None
         draws = nm[1] if random_split else None
+
+        def reconstruct(hs):
+            if not subtraction:
+                return hs
+            return hist_ops.sibling_reconstruct(
+                hs, parent_hist, parent_slot, is_small
+            )
+
         if task == "classification":
             if use_pallas:
                 from mpitree_tpu.ops import pallas_hist as ph
 
                 h = ph.histogram_small(
-                    xb, ph.class_payload(y, w, n_classes), nid - chunk_lo,
-                    n_slots=n_slots, n_bins=n_bins, n_channels=n_classes,
+                    xb, ph.class_payload(y, w, n_classes), acc_nid - acc_lo,
+                    n_slots=n_acc, n_bins=n_bins, n_channels=n_classes,
                     vma=(DATA_AXIS,),
                 )
             elif use_wide:
@@ -225,17 +263,18 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                 wide_fn = (wide_hist.histogram_wide_pallas if wide_pallas
                            else wide_hist.histogram_wide)
                 h = wide_fn(
-                    xb, ph.class_payload(y, w, n_classes), nid - chunk_lo,
-                    n_slots=n_slots, n_bins=n_bins, n_channels=n_classes,
+                    xb, ph.class_payload(y, w, n_classes), acc_nid - acc_lo,
+                    n_slots=n_acc, n_bins=n_bins, n_channels=n_classes,
                     bf16_ok=wide_bf16, vma=(DATA_AXIS,),
                 )
             else:
                 h = hist_ops.class_histogram(
-                    xb, y, nid, chunk_lo,
-                    n_slots=n_slots, n_bins=n_bins, n_classes=n_classes,
+                    xb, y, acc_nid, acc_lo,
+                    n_slots=n_acc, n_bins=n_bins, n_classes=n_classes,
                     sample_weight=w,
                 )
-            h = lax.psum(h, DATA_AXIS)
+            h = reconstruct(lax.psum(h, DATA_AXIS))
+            hist_keep = h
             dec = imp_ops.best_split_classification(
                 h, cand_mask, criterion=criterion, node_mask=nmask,
                 min_child_weight=mcw, forced_draw=draws,
@@ -245,12 +284,14 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
             lam, msl = nm[0], nm[1]
             if gbdt_x64:
                 h = hist_ops.grad_hess_histogram(
-                    xb, y, w, nid, chunk_lo,
-                    n_slots=n_slots, n_bins=n_bins,
+                    xb, y, w, acc_nid, acc_lo,
+                    n_slots=n_acc, n_bins=n_bins,
                     acc_dtype=jnp.float64,
                 )
                 with jax.enable_x64(True):
-                    h = lax.psum(h, DATA_AXIS).astype(jnp.float32)
+                    h = reconstruct(lax.psum(h, DATA_AXIS))
+                    hist_keep = h  # f64: the next level subtracts pre-round
+                    h = h.astype(jnp.float32)
             else:
                 if use_pallas or use_wide:
                     from mpitree_tpu.ops import pallas_hist as ph
@@ -258,8 +299,8 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                     payload = ph.gbdt_payload(y, w)
                     if use_pallas:
                         h = ph.histogram_small(
-                            xb, payload, nid - chunk_lo,
-                            n_slots=n_slots, n_bins=n_bins, n_channels=3,
+                            xb, payload, acc_nid - acc_lo,
+                            n_slots=n_acc, n_bins=n_bins, n_channels=3,
                             vma=(DATA_AXIS,),
                         )
                     else:
@@ -270,16 +311,17 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                             else wide_hist.histogram_wide
                         )
                         h = wide_fn(
-                            xb, payload, nid - chunk_lo,
-                            n_slots=n_slots, n_bins=n_bins, n_channels=3,
+                            xb, payload, acc_nid - acc_lo,
+                            n_slots=n_acc, n_bins=n_bins, n_channels=3,
                             bf16_ok=False, vma=(DATA_AXIS,),
                         )
                 else:
                     h = hist_ops.grad_hess_histogram(
-                        xb, y, w, nid, chunk_lo,
-                        n_slots=n_slots, n_bins=n_bins,
+                        xb, y, w, acc_nid, acc_lo,
+                        n_slots=n_acc, n_bins=n_bins,
                     )
-                h = lax.psum(h, DATA_AXIS)
+                h = reconstruct(lax.psum(h, DATA_AXIS))
+                hist_keep = h
             dec = imp_ops.best_split_newton(
                 h, cand_mask, reg_lambda=lam,
                 min_child_weight=mcw, min_samples_leaf=msl,
@@ -289,8 +331,8 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                 from mpitree_tpu.ops import pallas_hist as ph
 
                 h = ph.histogram_small(
-                    xb, ph.moment_payload(y, w), nid - chunk_lo,
-                    n_slots=n_slots, n_bins=n_bins, n_channels=3,
+                    xb, ph.moment_payload(y, w), acc_nid - acc_lo,
+                    n_slots=n_acc, n_bins=n_bins, n_channels=3,
                     vma=(DATA_AXIS,),
                 )
             elif use_wide:
@@ -300,29 +342,35 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                 wide_fn = (wide_hist.histogram_wide_pallas if wide_pallas
                            else wide_hist.histogram_wide)
                 h = wide_fn(
-                    xb, ph.moment_payload(y, w), nid - chunk_lo,
-                    n_slots=n_slots, n_bins=n_bins, n_channels=3,
+                    xb, ph.moment_payload(y, w), acc_nid - acc_lo,
+                    n_slots=n_acc, n_bins=n_bins, n_channels=3,
                     bf16_ok=False, vma=(DATA_AXIS,),
                 )
             else:
                 h = hist_ops.moment_histogram(
-                    xb, y, nid, chunk_lo, n_slots=n_slots, n_bins=n_bins,
+                    xb, y, acc_nid, acc_lo, n_slots=n_acc, n_bins=n_bins,
                     sample_weight=w,
                 )
-            h = lax.psum(h, DATA_AXIS)
+            h = reconstruct(lax.psum(h, DATA_AXIS))
+            hist_keep = h
             dec = imp_ops.best_split_regression(
                 h, cand_mask, node_mask=nmask, min_child_weight=mcw,
                 forced_draw=draws, **mono,
             )
+            # min/max are not linear — the y-range purity signal always
+            # scans directly (an O(N) scatter, not the O(N*F) hot path).
             ymin, ymax = regression_y_range(
                 y, nid, w, chunk_lo, n_slots=n_slots
             )
             y_range = jnp.where(ymax >= ymin, ymax - ymin, 0.0)
             dec = dec._replace(y_range=y_range)
+        out = (_pack_decision(dec),)
+        if keep_hist:
+            out = out + (hist_keep,)
         if debug:
             fp = profiling.replication_fingerprint(dec.feature, dec.bin, dec.n)
-            return _pack_decision(dec), profiling.assert_replicated(fp, DATA_AXIS)
-        return _pack_decision(dec)
+            out = out + (profiling.assert_replicated(fp, DATA_AXIS),)
+        return out if len(out) > 1 else out[0]
 
     in_specs = (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
                 P(), P(), P())
@@ -334,11 +382,14 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         in_specs = in_specs + (P(),)
     if monotonic:
         in_specs = in_specs + (P(), P(), P())
+    if subtraction:
+        in_specs = in_specs + (P(), P(), P())  # parent hist/slot map/small
+    n_out = 1 + int(keep_hist) + int(debug)
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,
         in_specs=in_specs,
-        out_specs=(P(), P()) if debug else P(),
+        out_specs=tuple(P() for _ in range(n_out)) if n_out > 1 else P(),
     )
     return jax.jit(sharded)
 

@@ -1,0 +1,539 @@
+"""Sibling-subtraction histogram frontier (ISSUE 5).
+
+Three layers of teeth:
+
+1. numpy oracles for the reconstruction arithmetic itself
+   (``ops/histogram.sibling_accumulate_slots`` / ``sibling_reconstruct``)
+   on every channel family — counts, weighted counts, regression moments,
+   and the gbdt (count, g, h) channels on the scoped-f64 path;
+2. engine-identity pins: ``hist_subtraction`` on/off and
+   levelwise/fused produce bit-identical trees on CPU meshes (mirroring
+   the boosting determinism pins), and the boosting estimators stay
+   bit-identical across the toggle AND mesh sizes;
+3. the 2**24 f32-ceiling guard actually fires (warn + fall back to
+   direct accumulation) — cancellation must never silently corrupt a
+   large-child histogram.
+
+Plus the ride-along satellites: per-round ``colsample_bytree`` feature
+subsampling and the obs accounting (rows_scanned / small_child_fraction /
+halved psum bytes / digest sub_frac).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpitree_tpu.core.builder import (
+    BuildConfig,
+    build_tree,
+    resolve_hist_subtraction,
+)
+from mpitree_tpu.core.host_builder import build_tree_host
+from mpitree_tpu.obs import BuildObserver
+from mpitree_tpu.ops import histogram as hist_ops
+from mpitree_tpu.ops.binning import bin_dataset
+from mpitree_tpu.parallel import mesh as mesh_lib
+
+N, F, C = 128, 4, 3
+
+
+@pytest.fixture(scope="module")
+def cancer_split():
+    from sklearn.datasets import load_breast_cancer
+    from sklearn.model_selection import train_test_split
+
+    X, y = load_breast_cancer(return_X_y=True)
+    return train_test_split(X, y, test_size=0.25, random_state=0)
+
+
+# ---------------------------------------------------------------------------
+# 1. numpy oracles for the reconstruction arithmetic
+# ---------------------------------------------------------------------------
+
+def _parent_child_setup(seed, n_parents=4, n_bins=6):
+    """Rows assigned to parents, then partitioned into sibling pairs."""
+    rng = np.random.default_rng(seed)
+    n = 200
+    xb = rng.integers(0, n_bins, size=(n, F)).astype(np.int32)
+    pnid = rng.integers(100, 100 + n_parents, size=n).astype(np.int32)
+    go_left = xb[:, 0] <= (n_bins // 2)
+    cnid = np.where(
+        go_left, 200 + 2 * (pnid - 100), 200 + 2 * (pnid - 100) + 1
+    ).astype(np.int32)
+    S = 2 * n_parents
+    cnt = np.bincount(cnid - 200, minlength=S)
+    is_small = np.zeros(S, bool)
+    for r in range(n_parents):
+        if cnt[2 * r] <= cnt[2 * r + 1]:
+            is_small[2 * r] = True
+        else:
+            is_small[2 * r + 1] = True
+    pslot = np.repeat(np.arange(n_parents, dtype=np.int32), 2)
+    return rng, xb, pnid, cnid, S, is_small, pslot
+
+
+def _reconstruct_class(xb, y, pnid, cnid, S, is_small, pslot, w=None):
+    n_parents = S // 2
+    parent = hist_ops.class_histogram(
+        jnp.asarray(xb), jnp.asarray(y), jnp.asarray(pnid), jnp.int32(100),
+        n_slots=n_parents, n_bins=int(xb.max()) + 1, n_classes=C,
+        sample_weight=None if w is None else jnp.asarray(w),
+    )
+    acc = hist_ops.sibling_accumulate_slots(
+        jnp.asarray(cnid), jnp.int32(200), jnp.asarray(is_small), n_slots=S
+    )
+    small = hist_ops.class_histogram(
+        jnp.asarray(xb), jnp.asarray(y), acc, jnp.int32(0),
+        n_slots=S // 2, n_bins=int(xb.max()) + 1, n_classes=C,
+        sample_weight=None if w is None else jnp.asarray(w),
+    )
+    return np.asarray(hist_ops.sibling_reconstruct(
+        small, parent, jnp.asarray(pslot), jnp.asarray(is_small)
+    ))
+
+
+@pytest.mark.parametrize("weighted", [False, True], ids=["unit", "weighted"])
+@pytest.mark.parametrize("seed", range(3))
+def test_counts_reconstruction_exact(seed, weighted):
+    """Integer count channels: parent - small is BIT-identical to direct
+    accumulation of every child (integer f32 sums < 2**24 are exact)."""
+    rng, xb, pnid, cnid, S, is_small, pslot = _parent_child_setup(seed)
+    y = rng.integers(0, C, size=len(xb)).astype(np.int32)
+    w = (
+        rng.integers(0, 5, size=len(xb)).astype(np.float32)
+        if weighted else None
+    )
+    rec = _reconstruct_class(xb, y, pnid, cnid, S, is_small, pslot, w=w)
+    direct = np.asarray(hist_ops.class_histogram(
+        jnp.asarray(xb), jnp.asarray(y), jnp.asarray(cnid), jnp.int32(200),
+        n_slots=S, n_bins=int(xb.max()) + 1, n_classes=C,
+        sample_weight=None if w is None else jnp.asarray(w),
+    ))
+    np.testing.assert_array_equal(rec, direct)
+    # and against a pure-numpy oracle
+    wv = np.ones(len(xb)) if w is None else w
+    oracle = np.zeros_like(direct)
+    for i in range(len(xb)):
+        for f in range(F):
+            oracle[cnid[i] - 200, f, y[i], xb[i, f]] += wv[i]
+    np.testing.assert_array_equal(direct, oracle)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_moment_reconstruction_close(seed):
+    """Non-integer f32 moment channels reconstruct to f32-roundoff of the
+    f64 oracle (the documented forced-"on" identity caveat: ulps, not
+    corruption)."""
+    rng, xb, pnid, cnid, S, is_small, pslot = _parent_child_setup(seed + 10)
+    y = rng.normal(size=len(xb)).astype(np.float32)
+    B = int(xb.max()) + 1
+    parent = hist_ops.moment_histogram(
+        jnp.asarray(xb), jnp.asarray(y), jnp.asarray(pnid), jnp.int32(100),
+        n_slots=S // 2, n_bins=B,
+    )
+    acc = hist_ops.sibling_accumulate_slots(
+        jnp.asarray(cnid), jnp.int32(200), jnp.asarray(is_small), n_slots=S
+    )
+    small = hist_ops.moment_histogram(
+        jnp.asarray(xb), jnp.asarray(y), acc, jnp.int32(0),
+        n_slots=S // 2, n_bins=B,
+    )
+    rec = np.asarray(hist_ops.sibling_reconstruct(
+        small, parent, jnp.asarray(pslot), jnp.asarray(is_small)
+    ))
+    oracle = np.zeros((S, F, 3, B))
+    y64 = y.astype(np.float64)
+    for i in range(len(xb)):
+        for f in range(F):
+            s = cnid[i] - 200
+            oracle[s, f, 0, xb[i, f]] += 1.0
+            oracle[s, f, 1, xb[i, f]] += y64[i]
+            oracle[s, f, 2, xb[i, f]] += y64[i] * y64[i]
+    np.testing.assert_allclose(rec, oracle, rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_grad_hess_reconstruction_f64_path(seed):
+    """(count, g, h) channels on the scoped-f64 accumulation path: the
+    reconstruction agrees with the f64 oracle to f64 roundoff — which is
+    why rounding to f32 after the psum is toggle-invariant."""
+    rng, xb, pnid, cnid, S, is_small, pslot = _parent_child_setup(seed + 20)
+    g = rng.normal(size=len(xb)).astype(np.float32)
+    h = np.abs(rng.normal(size=len(xb))).astype(np.float32) + 0.1
+    B = int(xb.max()) + 1
+    parent = hist_ops.grad_hess_histogram(
+        jnp.asarray(xb), jnp.asarray(g), jnp.asarray(h),
+        jnp.asarray(pnid), jnp.int32(100),
+        n_slots=S // 2, n_bins=B, acc_dtype=jnp.float64,
+    )
+    acc = hist_ops.sibling_accumulate_slots(
+        jnp.asarray(cnid), jnp.int32(200), jnp.asarray(is_small), n_slots=S
+    )
+    small = hist_ops.grad_hess_histogram(
+        jnp.asarray(xb), jnp.asarray(g), jnp.asarray(h), acc, jnp.int32(0),
+        n_slots=S // 2, n_bins=B, acc_dtype=jnp.float64,
+    )
+    # the engine reconstructs INSIDE the scoped enable_x64 (outside it,
+    # jnp ops silently canonicalize f64 back to f32)
+    import jax
+
+    with jax.enable_x64(True):
+        rec = np.asarray(hist_ops.sibling_reconstruct(
+            small, parent, jnp.asarray(pslot), jnp.asarray(is_small)
+        ))
+    assert rec.dtype == np.float64
+    oracle = np.zeros((S, F, 3, B))
+    for i in range(len(xb)):
+        for f in range(F):
+            s = cnid[i] - 200
+            oracle[s, f, 0, xb[i, f]] += 1.0
+            oracle[s, f, 1, xb[i, f]] += np.float64(g[i])
+            oracle[s, f, 2, xb[i, f]] += np.float64(h[i])
+    np.testing.assert_allclose(rec, oracle, rtol=1e-12, atol=1e-12)
+    np.testing.assert_array_equal(rec[:, :, 0, :], oracle[:, :, 0, :])
+
+
+def test_pad_slots_read_zero():
+    """Pad slots (is_small=True, arbitrary parent_slot) must reconstruct
+    to zero rows, never to a live pair's data."""
+    small = jnp.asarray(np.arange(8, dtype=np.float32).reshape(4, 2))
+    small = small.at[1:].set(0.0)  # only pair 0 live
+    parent = jnp.asarray(np.full((4, 2), 100.0, np.float32))
+    is_small = jnp.asarray(np.array([True, False] + [True] * 6))
+    pslot = jnp.asarray(np.zeros(8, np.int32))
+    rec = np.asarray(hist_ops.sibling_reconstruct(
+        small, parent, pslot, is_small
+    ))
+    np.testing.assert_array_equal(rec[2:], 0.0)  # pads: zero pairs
+    np.testing.assert_array_equal(rec[0], np.asarray(small)[0])
+    np.testing.assert_array_equal(rec[1], 100.0 - np.asarray(small)[0])
+
+
+# ---------------------------------------------------------------------------
+# 2. engine identity across the toggle
+# ---------------------------------------------------------------------------
+
+def _integer_grid(seed):
+    rng = np.random.default_rng(seed)
+    X = rng.integers(0, 5, size=(N, F)).astype(np.float32)
+    X[:5] = np.arange(5, dtype=np.float32)[:, None]
+    return rng, X
+
+
+def _structure(tree):
+    return (
+        tree.feature.tolist(),
+        tree.left.tolist(),
+        tree.right.tolist(),
+        np.nan_to_num(np.round(tree.threshold, 6), nan=-999.0).tolist(),
+        tree.n_node_samples.tolist(),
+    )
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_toggle_and_engine_identity_classification(seed, monkeypatch):
+    """hist_subtraction on/off x levelwise/fused x mesh sizes: one tree,
+    bit-identical counts — the integer-count subtraction is exact, so the
+    toggle can never change a pick."""
+    rng, X = _integer_grid(seed)
+    y = rng.integers(0, C, size=N).astype(np.int32)
+    y[:C] = np.arange(C)
+    binned = bin_dataset(X, binning="exact")
+    cfg = BuildConfig(task="classification", criterion="entropy", max_depth=9)
+    host = build_tree_host(binned, y, config=cfg, n_classes=C)
+
+    for sub in ("on", "off"):
+        monkeypatch.setenv("MPITREE_TPU_HIST_SUBTRACTION", sub)
+        for engine in ("levelwise", "fused"):
+            for nd in (1, 2):
+                mesh = mesh_lib.resolve_mesh(n_devices=nd)
+                t = build_tree(
+                    binned, y,
+                    config=BuildConfig(
+                        **{**cfg.__dict__, "engine": engine}
+                    ),
+                    mesh=mesh, n_classes=C,
+                )
+                tag = f"{engine}@{nd} sub={sub} (seed={seed})"
+                assert _structure(t) == _structure(host), tag
+                np.testing.assert_array_equal(
+                    t.count, host.count, err_msg=tag
+                )
+
+
+def test_subtraction_actually_engages():
+    """Anti-vacuity: the on-toggle must really route the subtraction path
+    — realized rows_scanned strictly below the frontier total, psum bytes
+    strictly below the off-toggle's, and the digest's sub_frac < 1."""
+    from mpitree_tpu.obs import digest
+
+    rng, X = _integer_grid(99)
+    y = rng.integers(0, C, size=N).astype(np.int32)
+    y[:C] = np.arange(C)
+    binned = bin_dataset(X, binning="exact")
+    cfg = BuildConfig(
+        task="classification", criterion="entropy", max_depth=7,
+        engine="levelwise",
+    )
+    mesh = mesh_lib.resolve_mesh(n_devices=2)
+
+    def run(sub):
+        os.environ["MPITREE_TPU_HIST_SUBTRACTION"] = sub
+        try:
+            obs = BuildObserver(timing=True)
+            build_tree(binned, y, config=cfg, mesh=mesh, n_classes=C,
+                       timer=obs)
+            return obs.report()
+        finally:
+            del os.environ["MPITREE_TPU_HIST_SUBTRACTION"]
+
+    rep_on, rep_off = run("on"), run("off")
+    assert rep_on["decisions"]["hist_subtraction"]["value"] == "on"
+    assert rep_off["decisions"]["hist_subtraction"]["value"] == "off"
+
+    c_on, c_off = rep_on["counters"], rep_off["counters"]
+    assert c_on["rows_frontier"] == c_off["rows_frontier"]
+    assert c_off["rows_scanned"] == c_off["rows_frontier"]
+    assert c_on["rows_scanned"] < c_on["rows_frontier"]
+
+    b_on = rep_on["collectives"]["split_hist_psum"]["bytes"]
+    b_off = rep_off["collectives"]["split_hist_psum"]["bytes"]
+    assert b_on < b_off
+
+    # per level: the root scans fully, every other interior level psums
+    # exactly the compact half-width buffer and scans at most half its
+    # frontier weight
+    lvl_off = {r["level"]: r for r in rep_off["levels"]}
+    for row in rep_on["levels"]:
+        lvl = row["level"]
+        if row["rows_scanned"] is None:  # terminal counts level
+            assert row["psum_bytes"] == lvl_off[lvl]["psum_bytes"]
+            continue
+        if lvl == 0:
+            assert row["psum_bytes"] == lvl_off[lvl]["psum_bytes"]
+            assert row["small_child_fraction"] == 1.0
+            continue
+        assert row["psum_bytes"] * 2 == lvl_off[lvl]["psum_bytes"], row
+        assert row["small_child_fraction"] <= 0.5 + 1e-9, row
+
+    d = digest(rep_on)
+    assert d["sub_frac"] is not None and d["sub_frac"] < 1.0
+    assert digest(rep_off)["sub_frac"] == 1.0
+
+
+def test_fused_replay_halves_psum_accounting():
+    """The fused engine's post-hoc accounting replays the sub_ok routing:
+    on-toggle psum bytes land strictly below off."""
+    rng, X = _integer_grid(7)
+    y = rng.integers(0, C, size=N).astype(np.int32)
+    y[:C] = np.arange(C)
+    binned = bin_dataset(X, binning="exact")
+    cfg = BuildConfig(
+        task="classification", criterion="entropy", max_depth=7,
+        engine="fused",
+    )
+    mesh = mesh_lib.resolve_mesh(n_devices=2)
+
+    def run(sub):
+        os.environ["MPITREE_TPU_HIST_SUBTRACTION"] = sub
+        try:
+            obs = BuildObserver(timing=False)
+            build_tree(binned, y, config=cfg, mesh=mesh, n_classes=C,
+                       timer=obs)
+            return obs.report()
+        finally:
+            del os.environ["MPITREE_TPU_HIST_SUBTRACTION"]
+
+    b_on = run("on")["collectives"]["split_hist_psum"]["bytes"]
+    b_off = run("off")["collectives"]["split_hist_psum"]["bytes"]
+    assert b_on < b_off
+
+
+def test_gbdt_toggle_and_mesh_invariance(cancer_split):
+    """Boosting rides the levelwise engine's subtraction on the scoped-f64
+    (g, h) path: ensembles are bit-identical across the toggle and mesh
+    sizes (mirrors tests/test_boosting.py's determinism pins)."""
+    from mpitree_tpu.boosting import GradientBoostingClassifier
+
+    Xtr, _, ytr, _ = cancer_split
+
+    def fit(sub, nd):
+        os.environ["MPITREE_TPU_HIST_SUBTRACTION"] = sub
+        try:
+            clf = GradientBoostingClassifier(
+                max_iter=6, max_depth=4, subsample=0.8, random_state=0,
+                n_devices=nd,
+            )
+            return clf.fit(Xtr[:250], ytr[:250])
+        finally:
+            del os.environ["MPITREE_TPU_HIST_SUBTRACTION"]
+
+    ref = fit("off", 1)
+    for sub, nd in (("on", 1), ("on", 2), ("on", 8), ("auto", 2)):
+        c = fit(sub, nd)
+        for a, b in zip(c.trees_, ref.trees_):
+            np.testing.assert_array_equal(a.feature, b.feature)
+            np.testing.assert_allclose(a.count, b.count, rtol=0, atol=0)
+    # auto stays off on CPU meshes (accelerator-only policy — the scatter
+    # cannot skip masked rows, so there is nothing to win here)
+    assert (
+        fit("auto", 1).fit_report_["decisions"]["hist_subtraction"]["value"]
+        == "off"
+    )
+
+
+# ---------------------------------------------------------------------------
+# 3. resolution policy + the 2**24 ceiling guard
+# ---------------------------------------------------------------------------
+
+def test_resolution_policy(monkeypatch):
+    cfg_auto = BuildConfig()
+    # auto = exact channels AND an accelerator platform (the scatter
+    # cannot skip masked rows under static shapes — on XLA-CPU the
+    # remap/reconstruct overhead nets a measured ~0.92x, the same
+    # evidence shape that gates the wide tier)
+    assert resolve_hist_subtraction(
+        cfg_auto, "tpu", "classification", integer_ok=True
+    )
+    assert not resolve_hist_subtraction(
+        cfg_auto, "cpu", "classification", integer_ok=True
+    )
+    assert not resolve_hist_subtraction(
+        cfg_auto, "tpu", "classification", integer_ok=False
+    )
+    assert not resolve_hist_subtraction(
+        cfg_auto, "tpu", "regression", integer_ok=True
+    )
+    # the exact gbdt f64 path is CPU-only, so it never auto-engages —
+    # explicit "on" is its lever (and stays exact there)
+    assert not resolve_hist_subtraction(
+        cfg_auto, "cpu", "gbdt", integer_ok=False, gbdt_x64=True
+    )
+    cfg_on = BuildConfig(hist_subtraction="on")
+    assert resolve_hist_subtraction(
+        cfg_on, "cpu", "gbdt", integer_ok=False, gbdt_x64=True
+    )
+    # forced on = the documented identity opt-out for non-exact payloads
+    assert resolve_hist_subtraction(
+        cfg_on, "cpu", "regression", integer_ok=False
+    )
+    # env steers "auto" only; explicit config wins
+    monkeypatch.setenv("MPITREE_TPU_HIST_SUBTRACTION", "off")
+    assert not resolve_hist_subtraction(
+        cfg_auto, "tpu", "classification", integer_ok=True
+    )
+    assert resolve_hist_subtraction(
+        cfg_on, "cpu", "classification", integer_ok=True
+    )
+    monkeypatch.delenv("MPITREE_TPU_HIST_SUBTRACTION")
+    monkeypatch.setenv("MPITREE_TPU_HIST_SUBTRACTION", "on")
+    assert resolve_hist_subtraction(
+        cfg_auto, "cpu", "classification", integer_ok=True
+    )
+    monkeypatch.delenv("MPITREE_TPU_HIST_SUBTRACTION")
+    with pytest.raises(ValueError, match="hist_subtraction"):
+        resolve_hist_subtraction(
+            BuildConfig(hist_subtraction="bogus"), "cpu", "classification",
+            integer_ok=True,
+        )
+
+
+def test_f32_ceiling_guard_fires(monkeypatch):
+    """Past 2**24 total f32 weight the guard must warn and fall back to
+    direct accumulation — even under a forced "on"."""
+    cfg_on = BuildConfig(hist_subtraction="on")
+    with pytest.warns(UserWarning, match="sibling-subtraction"):
+        assert not resolve_hist_subtraction(
+            cfg_on, "tpu", "classification", integer_ok=True,
+            total_weight=float(2**24),
+        )
+    # the f64 gbdt path is exempt (53-bit mantissa)
+    assert resolve_hist_subtraction(
+        cfg_on, "cpu", "gbdt", integer_ok=False, gbdt_x64=True,
+        total_weight=float(2**24),
+    )
+
+    # end to end: a fit whose integer weights total past the ceiling
+    # builds with subtraction off and records why
+    rng, X = _integer_grid(3)
+    y = rng.integers(0, C, size=N).astype(np.int32)
+    y[:C] = np.arange(C)
+    binned = bin_dataset(X, binning="exact")
+    w = np.full(N, float(1 << 18), np.float32)  # 128 * 2**18 = 2**25
+    mesh = mesh_lib.resolve_mesh(n_devices=1)
+    obs = BuildObserver(timing=False)
+    with pytest.warns(UserWarning):
+        build_tree(
+            binned, y,
+            config=BuildConfig(
+                task="classification", max_depth=3, engine="levelwise",
+                hist_subtraction="on",
+            ),
+            mesh=mesh, n_classes=C, sample_weight=w, timer=obs,
+        )
+    rep = obs.report()
+    assert rep["decisions"]["hist_subtraction"]["value"] == "off"
+    assert any(e["kind"] == "f32_ceiling" for e in rep["events"])
+
+
+# ---------------------------------------------------------------------------
+# satellites: colsample_bytree + keyed feature masks
+# ---------------------------------------------------------------------------
+
+def test_feature_subsample_mask_properties():
+    from mpitree_tpu.ops.sampling import feature_subsample_mask
+
+    m = feature_subsample_mask(7, 2, 30, 0.5)
+    assert m.shape == (30,) and m.dtype == bool
+    assert m.sum() == 15  # exact k, not Bernoulli
+    np.testing.assert_array_equal(
+        m, feature_subsample_mask(7, 2, 30, 0.5)
+    )  # pure function
+    assert not np.array_equal(m, feature_subsample_mask(7, 3, 30, 0.5))
+    assert feature_subsample_mask(7, 0, 30, 1.0).all()
+    assert feature_subsample_mask(7, 0, 30, 0.01).sum() == 1  # never empty
+    with pytest.raises(ValueError, match="colsample"):
+        feature_subsample_mask(7, 0, 30, 0.0)
+
+
+def test_colsample_bytree_subsets_and_determinism(cancer_split):
+    from mpitree_tpu.boosting import GradientBoostingClassifier
+    from mpitree_tpu.ops.sampling import feature_subsample_mask, seed_from
+
+    Xtr, _, ytr, _ = cancer_split
+    Xtr, ytr = Xtr[:250], ytr[:250]
+    clf = GradientBoostingClassifier(
+        max_iter=5, max_depth=3, colsample_bytree=0.5, random_state=3,
+        n_devices=1,
+    )
+    clf.fit(Xtr, ytr)
+    assert (clf.predict(Xtr) == ytr).mean() > 0.9
+    seed = seed_from(3)
+    for r, t in enumerate(clf.trees_):
+        kept = np.flatnonzero(
+            feature_subsample_mask(seed, r, Xtr.shape[1], 0.5)
+        )
+        feats = np.unique(t.feature[t.feature >= 0])
+        assert np.all(np.isin(feats, kept)), (r, feats, kept)
+    assert clf.fit_report_["rounds"][0]["colsample"] == 0.5
+
+    clf2 = GradientBoostingClassifier(
+        max_iter=5, max_depth=3, colsample_bytree=0.5, random_state=3,
+        n_devices=2,
+    )
+    clf2.fit(Xtr, ytr)
+    for a, b in zip(clf.trees_, clf2.trees_):
+        np.testing.assert_array_equal(a.feature, b.feature)
+        np.testing.assert_allclose(a.count, b.count, rtol=0, atol=0)
+
+
+def test_colsample_validation():
+    from mpitree_tpu.boosting import GradientBoostingRegressor
+
+    est = GradientBoostingRegressor(colsample_bytree=1.5, max_iter=1)
+    with pytest.raises(ValueError, match="colsample_bytree"):
+        est.fit(np.zeros((20, 3)), np.zeros(20))

@@ -52,11 +52,19 @@ def test_build_record_schema_golden():
     """Field names are pinned: renaming/removing one is a versioned act."""
     rep = BuildObserver(timing=False).report()
     assert tuple(sorted(rep)) == tuple(sorted(TOP_LEVEL_FIELDS))
-    assert rep["schema"] == SCHEMA_VERSION == 1
+    # v2: level rows gained rows_scanned/small_child_fraction and the
+    # digest gained sub_frac (ISSUE 5 sibling subtraction)
+    assert rep["schema"] == SCHEMA_VERSION == 2
     # dataclass fields and the pinned tuple must agree too
     assert tuple(
         f.name for f in dataclasses.fields(BuildRecord)
     ) == TOP_LEVEL_FIELDS
+    # digest field names are part of the same contract (bench section
+    # lines and the watcher format stored digests)
+    assert tuple(sorted(digest(rep))) == tuple(sorted((
+        "engine", "reason", "n_nodes", "depth", "levels", "compile_new",
+        "psum_bytes", "sub_frac", "events", "wall_s",
+    )))
 
 
 def test_record_json_round_trip():
@@ -83,6 +91,7 @@ def test_digest_shape():
     assert d["engine"] == "levelwise"
     assert d["psum_bytes"] == 2_000_000
     assert d["compile_new"] == 1
+    assert d["sub_frac"] is None  # no row counters recorded
     # the one-line string rendering is bench_tpu.format_record_digest —
     # deliberately jax-free, covered by tests/test_bench_contract.py
 
