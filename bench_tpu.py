@@ -831,6 +831,57 @@ def is_genuine_capture(rec: dict, *, full_only: bool = False) -> bool:
     )
 
 
+def observed_section_seconds(sec: str, path: str = OUT_PATH) -> float | None:
+    """Max observed in-section wall (s) for ``sec`` across genuine
+    full-workload capture lines — the evidence base for the watcher's
+    per-section budgets (tools/tpu_watcher.derive_budget).
+
+    Sums every ``*_s`` DURATION scalar in the section payload,
+    RECURSIVELY — sections nest real wall-clock (north_star's
+    ``subtraction_ab`` off-fit is ~half the section's wall;
+    refine_sweep's timings live entirely under ``sweep: [...]``), and a
+    top-level-only sum would derive budgets from a fraction of the true
+    duration. ``phases`` subtrees are skipped (their seconds are a
+    breakdown of cold_s/warm_s, not additional wall), and rate keys
+    (``*_per_s``: throughput_cells_per_s, ...) also end in ``_s`` but
+    would inflate a budget by seven orders of magnitude, so both are
+    excluded explicitly. Takes the max across lines so a budget derived
+    under a fast tunnel still covers the slow days. None when the
+    section has never been captured (the watcher then falls back to its
+    static table). The A/B mirror of the main fit's warm_s under
+    ``subtraction_ab.main`` double-counts one warm fit — a deliberate
+    safe-high bias for a timeout budget, bounded by the clamp.
+    """
+
+    def walk(node) -> float:
+        # "phases" (span breakdown of cold_s/warm_s) and "record" (obs
+        # digest, carries wall_s) restate durations already counted.
+        if isinstance(node, dict):
+            return sum(
+                float(v) if (
+                    k.endswith("_s") and not k.endswith("per_s")
+                    and isinstance(v, (int, float))
+                    and not isinstance(v, bool)
+                ) else walk(v)
+                for k, v in node.items() if k not in ("phases", "record")
+            )
+        if isinstance(node, list):
+            return sum(walk(v) for v in node)
+        return 0.0
+
+    best = None
+    for rec in read_capture_lines(path):
+        if not is_genuine_capture(rec, full_only=True) or sec not in rec:
+            continue
+        payload = rec.get(sec)
+        if not isinstance(payload, dict):
+            continue
+        t = walk(payload)
+        if t > 0:
+            best = t if best is None else max(best, t)
+    return best
+
+
 def latest_line(path: str = OUT_PATH, *, full_only: bool = False) -> dict | None:
     """Newest genuine TPU data, merged per-section — bench.py's tpu_last_known.
 

@@ -22,11 +22,23 @@ rounds (``subsample < 1``) draw keyed Bernoulli row masks
 rows carry ``h == 0`` and fall out of every histogram channel, but their
 ``node_id`` still advances, which is what makes the training-set margin
 update free (no re-descent).
+
+Resilience (``mpitree_tpu.resilience``): each round's device build runs
+through the retry rung (transient transport blips re-dispatch on the
+accelerator, ``retry_device``); with ``checkpoint=path`` completed rounds
+persist at ``checkpoint_every`` granularity (trees plus the f64 raw-margin
+matrix and early-stopping state, sharded atomic-rename ``.npz`` — see
+``resilience.checkpoint``), and a killed fit re-run with the same params
+and data resumes to a **bit-identical** ensemble — the keyed masks above
+are exactly what makes that true. Per-round (g, h) totals are guarded for
+NaN/Inf (typed ``nonfinite_grad`` event + fail-fast) so a poisoned loss
+channel can never silently fit garbage rounds.
 """
 
 from __future__ import annotations
 
 import time
+from functools import partial
 
 import numpy as np
 from sklearn.base import BaseEstimator, ClassifierMixin, RegressorMixin
@@ -35,7 +47,7 @@ from sklearn.utils.validation import check_is_fitted
 from mpitree_tpu.boosting.losses import loss_for
 from mpitree_tpu.core.builder import BuildConfig, build_tree
 from mpitree_tpu.models.forest import _TreeList
-from mpitree_tpu.obs import BuildObserver, ReportMixin
+from mpitree_tpu.obs import BuildObserver, ReportMixin, warn_event
 from mpitree_tpu.ops.binning import BinnedData, bin_dataset
 from mpitree_tpu.ops.predict import predict_mesh, stacked_leaf_ids
 from mpitree_tpu.ops.sampling import (
@@ -44,6 +56,7 @@ from mpitree_tpu.ops.sampling import (
     seed_from,
 )
 from mpitree_tpu.parallel import mesh as mesh_lib
+from mpitree_tpu.resilience import BoostCheckpoint, chaos, retry_device
 from mpitree_tpu.utils.validation import (
     feature_names_of,
     resolve_min_samples_leaf,
@@ -134,7 +147,8 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
                  min_child_weight=1e-3, reg_lambda=0.0, min_split_gain=0.0,
                  early_stopping=False, validation_fraction=0.1,
                  n_iter_no_change=10, tol=1e-7, random_state=None,
-                 n_devices=None, backend=None, verbose=0):
+                 n_devices=None, backend=None, verbose=0,
+                 checkpoint=None, checkpoint_every=10):
         self.loss = loss
         self.learning_rate = learning_rate
         self.max_iter = max_iter
@@ -156,6 +170,13 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
         self.n_devices = n_devices
         self.backend = backend
         self.verbose = verbose
+        # Optional path for round-granular checkpoint/resume of the
+        # boosting build (resilience.checkpoint.BoostCheckpoint): every
+        # `checkpoint_every` completed rounds persist trees + resume state;
+        # a killed fit re-run with the same params/data resumes
+        # bit-identically.
+        self.checkpoint = checkpoint
+        self.checkpoint_every = checkpoint_every
 
     # -- fit ---------------------------------------------------------------
     def _validate_params_(self):
@@ -178,6 +199,10 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
             raise ValueError(
                 "colsample_bytree must be in (0, 1], got "
                 f"{self.colsample_bytree!r}"
+            )
+        if int(self.checkpoint_every) < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {self.checkpoint_every!r}"
             )
 
     def _fit(self, X, y, sample_weight, *, task):
@@ -252,6 +277,35 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
             ),
         )
 
+        # Round-granular checkpoint (resilience.checkpoint): fingerprinted
+        # over the FULL validated inputs (pre val-split — both runs split
+        # identically from the seed) and every non-checkpoint param.
+        # A stateful Generator/RandomState random_state draws fresh
+        # entropy per fit, so the resumed run's keyed masks would differ
+        # and resume would silently mix two ensembles — refuse and warn
+        # (None and int are both reproducible: seed_from(None) == 0).
+        ck = None
+        if getattr(self, "checkpoint", None):
+            if isinstance(self.random_state,
+                          (np.random.Generator, np.random.RandomState)):
+                warn_event(
+                    obs, "checkpoint_disabled",
+                    "boosting checkpointing requires a reproducible "
+                    "random_state (None or a fixed integer) so a resumed "
+                    "fit replays the same subsample/validation draws; "
+                    "checkpoint disabled",
+                    stacklevel=3,
+                )
+            else:
+                ck_params = {
+                    k_: v for k_, v in self.get_params().items()
+                    if k_ not in ("checkpoint", "checkpoint_every")
+                }
+                ck_params["task"] = task
+                ck = BoostCheckpoint.open(
+                    self.checkpoint, ck_params, X, y_t, sw
+                )
+
         baseline = loss.init_raw(y_tr, sw_tr)  # (K,) f64
         self._baseline_raw = np.asarray(baseline, np.float64)
         raw_tr = np.tile(baseline, (n_tr, 1))
@@ -268,7 +322,65 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
         stale = 0
         n_iter = 0
         stopped_early = False
-        for r in range(int(self.max_iter)):
+        start_round = 0
+        if ck is not None and ck.trees:
+            # Resume: restore the completed rounds' trees plus the exact
+            # f64 raw margins and score/early-stopping state they left
+            # behind. Everything after start_round re-derives from the
+            # keyed (seed, round, row) masks, so the resumed ensemble is
+            # bit-identical to an uninterrupted fit (pinned in
+            # tests/test_resilience.py).
+            st = ck.state or {}
+            n_rounds, rem = divmod(len(ck.trees), K)
+            rt = st.get("raw_tr")
+            ts = st.get("train_scores")
+            resumable = (
+                rem == 0
+                and rt is not None and rt.shape == raw_tr.shape
+                and ts is not None and len(ts) == n_rounds + 1
+                and (X_val is None) == ("raw_val" not in st)
+                and (X_val is None or (
+                    st["raw_val"].shape == raw_val.shape
+                    and all(k in st for k in
+                            ("val_scores", "best_val", "stale"))
+                ))
+            )
+            if not resumable:
+                warn_event(
+                    obs, "checkpoint_disabled",
+                    f"boosting checkpoint at {self.checkpoint} carries "
+                    "inconsistent round state (crash inside a flush "
+                    "window, or tampering); starting fresh",
+                    stacklevel=3,
+                )
+                ck = BoostCheckpoint(self.checkpoint, ck.fingerprint)
+            else:
+                trees = list(ck.trees)
+                raw_tr[:] = rt
+                train_scores = [float(v) for v in ts]
+                if X_val is not None:
+                    raw_val[:] = st["raw_val"]
+                    val_scores = [float(v) for v in st["val_scores"]]
+                    best_val = float(st["best_val"])
+                    stale = int(st["stale"])
+                    # A preemption can land between the flush at the
+                    # early-stop round and the checkpoint removal; the
+                    # restored staleness must re-derive the verdict or a
+                    # resumed fit would train past the stop.
+                    stopped_early = stale >= int(self.n_iter_no_change)
+                start_round = n_iter = n_rounds
+                obs.event(
+                    "checkpoint_resume",
+                    f"resumed {n_rounds} completed boosting rounds "
+                    f"({len(trees)} trees) from {self.checkpoint}",
+                    rounds=n_rounds,
+                )
+        for r in range(start_round, int(self.max_iter)):
+            if stopped_early:
+                break  # resumed at (or past) the early-stop round
+            # Chaos seam: deterministic kill/blip/hang at an exact round
+            # (resilience.chaos) — how the resume-equivalence tests die.
+            chaos.step("round")
             t_round = time.perf_counter() if obs.enabled else 0.0
             mask = row_subsample_mask(seed, r, n_tr, float(self.subsample))
             colsample = float(self.colsample_bytree)
@@ -287,12 +399,43 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
             if float(self.subsample) < 1.0:
                 g = g * mask[:, None]
                 h = h * mask[:, None]
+            # Non-finite guard on the loss channel: one poisoned row (an
+            # overflowed sigmoid/softmax margin, a NaN target that slipped
+            # validation, a chaos injection) poisons the psum'd histogram
+            # totals and every split after it. Checking the per-round
+            # TOTALS is O(N) host work the loss already paid; fail fast
+            # with a typed event instead of silently fitting garbage
+            # rounds. chaos.corrupt is the injection seam the tier-1
+            # chaos test drives.
+            g, h = chaos.corrupt("grad_hess", g, h)
+            g_total, h_total = float(np.sum(g)), float(np.sum(h))
+            if not (np.isfinite(g_total) and np.isfinite(h_total)):
+                msg = (
+                    f"non-finite gradient/hessian totals at boosting round "
+                    f"{r} (G_total={g_total}, H_total={h_total}): the raw "
+                    "predictions have overflowed or the inputs carry "
+                    "non-finite values; lower learning_rate, rescale "
+                    "targets/sample_weight, or enable early_stopping — "
+                    "refusing to fit garbage rounds"
+                )
+                obs.event("nonfinite_grad", msg)
+                # The raise aborts _fit before the normal report
+                # assignment; attach the record now so the typed event
+                # survives for postmortem (dump_report, log scrapers).
+                self.fit_report_ = obs.report(trees=trees)
+                raise FloatingPointError(msg)
             for k in range(K):
                 g32 = np.ascontiguousarray(g[:, k], np.float32)
                 h32 = np.ascontiguousarray(h[:, k], np.float32)
-                tree, leaf_ids = build_tree(
-                    binned_r, g32, config=cfg, mesh=mesh, sample_weight=h32,
-                    return_leaf_ids=True, timer=obs,
+                # Retry rung only (resilience.retry): boosting has no host
+                # twin of the round build — below retries, the recovery
+                # rung is the round checkpoint.
+                tree, leaf_ids = retry_device(
+                    partial(
+                        build_tree, binned_r, g32, config=cfg, mesh=mesh,
+                        sample_weight=h32, return_leaf_ids=True, timer=obs,
+                    ),
+                    what=f"gbdt round {r} tree build", obs=obs,
                 )
                 if kept is not None:
                     # Back to full-matrix feature ids (the predict surface
@@ -337,8 +480,24 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
                     if obs.enabled else None
                 ),
             )
+            if ck is not None and (r + 1) % int(self.checkpoint_every) == 0:
+                # Round-group flush: this group's K*checkpoint_every trees
+                # as one O(group) shard, plus the full resume state (exact
+                # f64 margins + score history + early-stopping counters).
+                state = {
+                    "raw_tr": raw_tr,
+                    "train_scores": np.asarray(train_scores, np.float64),
+                }
+                if val_scores is not None:
+                    state["raw_val"] = raw_val
+                    state["val_scores"] = np.asarray(val_scores, np.float64)
+                    state["best_val"] = np.float64(best_val)
+                    state["stale"] = np.int64(stale)
+                ck.append(trees[len(ck.trees):], state)
             if stopped_early:
                 break
+        if ck is not None:
+            ck.done()
         obs.decision(
             "early_stop", stopped_early,
             reason=(
@@ -423,7 +582,8 @@ class GradientBoostingRegressor(RegressorMixin, _BaseGradientBoosting):
                  min_child_weight=1e-3, reg_lambda=0.0, min_split_gain=0.0,
                  early_stopping=False, validation_fraction=0.1,
                  n_iter_no_change=10, tol=1e-7, random_state=None,
-                 n_devices=None, backend=None, verbose=0):
+                 n_devices=None, backend=None, verbose=0,
+                 checkpoint=None, checkpoint_every=10):
         super().__init__(
             loss=loss, learning_rate=learning_rate, max_iter=max_iter,
             max_depth=max_depth, max_bins=max_bins, binning=binning,
@@ -435,7 +595,8 @@ class GradientBoostingRegressor(RegressorMixin, _BaseGradientBoosting):
             validation_fraction=validation_fraction,
             n_iter_no_change=n_iter_no_change, tol=tol,
             random_state=random_state, n_devices=n_devices, backend=backend,
-            verbose=verbose,
+            verbose=verbose, checkpoint=checkpoint,
+            checkpoint_every=checkpoint_every,
         )
 
     def fit(self, X, y, sample_weight=None):
@@ -466,7 +627,8 @@ class GradientBoostingClassifier(ClassifierMixin, _BaseGradientBoosting):
                  min_child_weight=1e-3, reg_lambda=0.0, min_split_gain=0.0,
                  early_stopping=False, validation_fraction=0.1,
                  n_iter_no_change=10, tol=1e-7, random_state=None,
-                 n_devices=None, backend=None, verbose=0):
+                 n_devices=None, backend=None, verbose=0,
+                 checkpoint=None, checkpoint_every=10):
         super().__init__(
             loss=loss, learning_rate=learning_rate, max_iter=max_iter,
             max_depth=max_depth, max_bins=max_bins, binning=binning,
@@ -478,7 +640,8 @@ class GradientBoostingClassifier(ClassifierMixin, _BaseGradientBoosting):
             validation_fraction=validation_fraction,
             n_iter_no_change=n_iter_no_change, tol=tol,
             random_state=random_state, n_devices=n_devices, backend=backend,
-            verbose=verbose,
+            verbose=verbose, checkpoint=checkpoint,
+            checkpoint_every=checkpoint_every,
         )
 
     def fit(self, X, y, sample_weight=None):

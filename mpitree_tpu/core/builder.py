@@ -37,6 +37,7 @@ from mpitree_tpu.core.tree_struct import TreeArrays
 from mpitree_tpu.obs import warn_event
 from mpitree_tpu.ops.binning import BinnedData
 from mpitree_tpu.parallel import collective, mesh as mesh_lib
+from mpitree_tpu.resilience import chaos
 from mpitree_tpu.utils import importances as imp_utils
 from mpitree_tpu.utils.profiling import PhaseTimer, debug_checks_enabled
 
@@ -958,6 +959,9 @@ def build_tree(
     # subtraction parent (multi-chunk, terminal, or subtraction off).
     sub_parent = None
     while frontier_size > 0:
+        # Chaos seam (resilience.chaos): lets tests kill/blip the build at
+        # an exact level; free (one global read) with no plan installed.
+        chaos.step("level")
         terminal = cfg.max_depth is not None and depth == cfg.max_depth
         t_level = time.perf_counter() if timer.enabled else 0.0
         lvl_new = 0

@@ -42,7 +42,7 @@ from mpitree_tpu.ops.predict import (
     predict_mesh,
 )
 from mpitree_tpu.parallel import mesh as mesh_lib
-from mpitree_tpu.utils.elastic import device_failover
+from mpitree_tpu.resilience import device_failover
 from mpitree_tpu.utils.export import export_tree_text
 from mpitree_tpu.utils.importances import feature_importances
 from mpitree_tpu.utils.validation import (
@@ -282,7 +282,8 @@ class DecisionTreeClassifier(ClassifierMixin, ReportMixin, BaseEstimator):
                     return res if refine else (res, None)
 
             self.tree_, leaf_ids = device_failover(
-                _dev, _host, what=f"{type(self).__name__}.fit device build"
+                _dev, _host, what=f"{type(self).__name__}.fit device build",
+                obs=obs,
             )
         if refine:
             from mpitree_tpu.core.hybrid_builder import apply_refine

@@ -50,7 +50,7 @@ from mpitree_tpu.obs import (
 from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.sampling import NodeFeatureSampler, n_subspace_features
 from mpitree_tpu.parallel import mesh as mesh_lib
-from mpitree_tpu.utils.elastic import ForestCheckpoint, device_failover
+from mpitree_tpu.resilience import ForestCheckpoint, device_failover
 from mpitree_tpu.utils.validation import (
     apply_class_weight,
     feature_names_of,
@@ -96,9 +96,10 @@ class _BaseForest(ReportMixin, BaseEstimator):
         self.n_devices = n_devices
         self.backend = backend
         self.refine_depth = refine_depth
-        # Optional .npz path for incremental checkpoint/resume of the
-        # forest build (utils/elastic.py) — the recovery story SURVEY §5
-        # lists as absent from the reference.
+        # Optional path for incremental checkpoint/resume of the forest
+        # build (resilience.checkpoint: sharded group files + atomic
+        # manifest) — the recovery story SURVEY §5 lists as absent from
+        # the reference.
         self.checkpoint = checkpoint
         self.ccp_alpha = ccp_alpha
         self.min_impurity_decrease = min_impurity_decrease
@@ -383,7 +384,7 @@ class _BaseForest(ReportMixin, BaseEstimator):
 
             t, ids = device_failover(
                 dev, host,
-                what=f"forest tree {i} device build",
+                what=f"forest tree {i} device build", obs=obs,
             )
             return finish(i, t, ids)
 
@@ -431,7 +432,9 @@ class _BaseForest(ReportMixin, BaseEstimator):
                     return [o[0] for o in out], [o[1] for o in out]
                 return [o[0] for o in out]
 
-            res = device_failover(dev, host, what="forest group device build")
+            res = device_failover(
+                dev, host, what="forest group device build", obs=obs,
+            )
             if refine:
                 gtrees, nid_all = res
                 return [
@@ -503,8 +506,8 @@ class _BaseForest(ReportMixin, BaseEstimator):
                 )
                 # Floor the group width: on a narrow tree axis (e.g. one
                 # device, where the fused builder lax.maps the whole batch
-                # in one program anyway) per-tree groups would mean O(T^2)
-                # checkpoint rewrites and one program launch per tree.
+                # in one program anyway) per-tree groups would mean one
+                # program launch and one checkpoint flush per tree.
                 g = max(g, 8)
                 groups = [
                     remaining[j:j + g] for j in range(0, len(remaining), g)
@@ -517,9 +520,10 @@ class _BaseForest(ReportMixin, BaseEstimator):
                 if ck is not None:
                     ck.append(new)
         else:
-            # Flush the checkpoint per batch of trees, not per tree: each
-            # append rewrites the whole file, so per-tree flushes would
-            # cost O(T^2) write traffic (ForestCheckpoint.append).
+            # Flush the checkpoint per batch of trees, not per tree:
+            # appends are O(group) shard writes (resilience.checkpoint),
+            # but per-tree flushes would still mean one manifest rewrite
+            # and one fsync-sized file per tree for no recovery benefit.
             g = 8
             chunks = (
                 [remaining] if ck is None

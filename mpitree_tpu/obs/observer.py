@@ -106,6 +106,10 @@ def warn_event(obs, kind: str, message: str, *, stacklevel: int = 2) -> None:
     stderr warning and the ``fit_report_`` event can never say different
     things. ``stacklevel`` counts from the CALLER (this frame is added).
     ``obs`` may be any PhaseTimer (the base class's ``event`` is a no-op).
+    The resilience ladder emits its rung events (``device_retry``,
+    ``device_failover``) directly via ``obs.event`` + its own warning —
+    the retry loop needs per-attempt data fields; see
+    ``resilience/retry.py``.
     """
     warnings.warn(message, stacklevel=stacklevel + 1)
     if obs is not None:

@@ -101,6 +101,13 @@ class BuildRecord:
       multiply by (shards-1)/shards for wire traffic on an N-wide axis).
     - ``events``: typed events ``{"kind", "message"}`` — the structured
       form of what previously only went to stderr via ``warnings.warn``.
+      The resilience ladder (``mpitree_tpu.resilience``) reports through
+      here: ``device_retry`` (transient loss re-dispatched on the
+      accelerator; paired counter ``device_retries``),
+      ``device_failover`` (final rung, host rebuild; counter
+      ``device_failovers``), ``checkpoint_resume`` (rounds/groups
+      restored), ``nonfinite_grad`` (poisoned gbdt loss channel,
+      fail-fast), ``checkpoint_disabled``.
     - ``rounds``: boosting per-round records (train/val loss, subsample
       fraction, early-stop state).
     - ``trees``: ensemble per-member summaries ``{"n_nodes", "depth"}``.

@@ -384,13 +384,13 @@ def bin_for_engine(
                     assume_finite=True,
                 )
             except Exception as e:  # noqa: BLE001
-                # Same policy as device_failover (utils/elastic.py):
+                # Same policy as device_failover (resilience.retry):
                 # transport failures are survivable (host output is
                 # bit-identical), everything else is a real bug the caller
                 # must see.
                 import warnings
 
-                from mpitree_tpu.utils.elastic import is_device_failure
+                from mpitree_tpu.resilience import is_device_failure
 
                 if not is_device_failure(e):
                     raise

@@ -32,7 +32,23 @@ from jax.sharding import PartitionSpec as P
 from mpitree_tpu.ops import histogram as hist_ops
 from mpitree_tpu.ops import impurity as imp_ops
 from mpitree_tpu.parallel.mesh import DATA_AXIS
+from mpitree_tpu.resilience import chaos
 from mpitree_tpu.utils import profiling
+
+
+def _chaos_dispatch(site: str, fn):
+    """Fault-injection seam on the host-side dispatch boundary of a jitted
+    collective program (``resilience.chaos``). Always wrapped — the
+    factories are lru-cached, so a conditional wrap would freeze whatever
+    plan existed at first compile — but an empty plan costs one global
+    read per *dispatch* (per chunk, not per row): nothing against a
+    device launch."""
+
+    def dispatch(*args):
+        chaos.step(site)
+        return fn(*args)
+
+    return dispatch
 
 
 def split_psum_bytes(*, n_slots: int, n_features: int, n_bins: int,
@@ -391,7 +407,7 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         in_specs=in_specs,
         out_specs=tuple(P() for _ in range(n_out)) if n_out > 1 else P(),
     )
-    return jax.jit(sharded)
+    return _chaos_dispatch("split_dispatch", jax.jit(sharded))
 
 
 @lru_cache(maxsize=64)
@@ -416,7 +432,7 @@ def make_counts_fn(mesh, *, n_slots: int, n_classes: int, task: str):
         in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
         out_specs=P(),
     )
-    return jax.jit(sharded)
+    return _chaos_dispatch("counts_dispatch", jax.jit(sharded))
 
 
 @lru_cache(maxsize=64)
@@ -449,5 +465,6 @@ def make_update_fn(mesh, *, n_slots: int):
     )
     # nid donated: the level loop's canonical `nid_d = update_fn(nid_d, ..)`
     # rebind consumes the old buffer each call — GL08 (donation-after-use)
-    # holds every caller to that shape.
-    return jax.jit(sharded, donate_argnums=(0,))
+    # holds every caller to that shape. The chaos wrapper raises (if at
+    # all) BEFORE the jitted call, so a planned fault never half-donates.
+    return _chaos_dispatch("update_dispatch", jax.jit(sharded, donate_argnums=(0,)))

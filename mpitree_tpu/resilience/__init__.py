@@ -1,0 +1,54 @@
+"""mpitree_tpu.resilience — the failure-handling subsystem.
+
+The reference's failure story is "a rank dying inside ``comm.allgather``
+aborts the job" (SURVEY §5). This package is the TPU-native answer, a
+standard training-stack resilience ladder:
+
+1. **retry in place** — transient transport blips re-dispatch on the
+   accelerator with bounded exponential backoff (``retry``);
+2. **checkpoint at natural barriers** — forest tree groups and boosting
+   round groups persist as they complete and resume bit-identically
+   (``checkpoint``);
+3. **degrade last** — only terminal device failures (or an exhausted
+   retry budget) rebuild on the host tier, which produces the identical
+   tree (``retry.device_failover``'s final rung);
+
+plus the deterministic fault-injection layer (``chaos``) that proves
+every rung in CI without hardware. ``mpitree_tpu.utils.elastic`` (the
+pre-PR-6 home) re-exports this API for backward compatibility.
+
+Env surface: ``MPITREE_TPU_RETRIES``, ``MPITREE_TPU_BACKOFF_S``,
+``MPITREE_TPU_ELASTIC``, ``MPITREE_TPU_CHAOS`` — see ``config`` and
+``chaos``.
+"""
+
+from mpitree_tpu.resilience import chaos
+from mpitree_tpu.resilience.checkpoint import (
+    BoostCheckpoint,
+    BuildCheckpoint,
+    ForestCheckpoint,
+)
+from mpitree_tpu.resilience.config import (
+    ResilienceConfig,
+    backoff_delay,
+    elastic_enabled,
+)
+from mpitree_tpu.resilience.failure import (
+    is_device_failure,
+    is_transient_failure,
+)
+from mpitree_tpu.resilience.retry import device_failover, retry_device
+
+__all__ = [
+    "BoostCheckpoint",
+    "BuildCheckpoint",
+    "ForestCheckpoint",
+    "ResilienceConfig",
+    "backoff_delay",
+    "chaos",
+    "device_failover",
+    "elastic_enabled",
+    "is_device_failure",
+    "is_transient_failure",
+    "retry_device",
+]

@@ -1,0 +1,238 @@
+"""Deterministic fault injection — the chaos layer that proves the ladder.
+
+None of the recovery paths (retry rung, host failover, checkpoint resume)
+should only ever run for real when a tunnel actually dies at 3am. This
+module lets tests — and the tier-1 chaos job — inject the exact failure
+shapes PJRT produces, at exact points, on CPU, deterministically:
+
+- ``Fault("dispatch", at=3, kind="unavailable")`` — the 3rd device
+  dispatch raises ``UNAVAILABLE`` (a chaos-built exception whose type
+  *name* is ``XlaRuntimeError``, so the failure classifier treats it
+  exactly like jaxlib's).
+- ``Fault("grad_hess", at=2, kind="nan")`` — poison the round-2 (g, h)
+  payload with NaN (exercises the non-finite guard).
+- ``Fault("round", at=5, kind="kill")`` — simulate a preemption at
+  boosting round 5 (``ChaosKilled`` derives from ``BaseException`` so no
+  recovery layer can swallow it — like a real SIGKILL).
+- ``Fault("level", at=4, kind="hang", arg=0.05)`` — stall a level
+  dispatch (watchdog/timeout paths).
+
+Sites are host-side seams, zero-cost when no plan is installed (one
+module-global ``is None`` check): ``dispatch`` (the retry ladder, one
+step per device attempt), ``split_dispatch``/``counts_dispatch``/
+``update_dispatch`` (the levelwise collective programs,
+``parallel/collective.py``), ``level`` (each level of the levelwise
+loop), ``round`` (each boosting round), ``grad_hess`` (the per-round
+gradient payload, via :func:`corrupt`).
+
+Install programmatically (:func:`install` / :func:`active`) or via
+``MPITREE_TPU_CHAOS="site:at:kind[:arg];..."`` (e.g.
+``dispatch:1:unavailable;round:3:hang:0.5``) — the env form is how the
+CI chaos job and the bench harness inject without touching code. All
+counting is per-plan and 1-based; a plan is exhausted, never random.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import time
+
+
+class ChaosXlaError(Exception):
+    """Chaos-injected accelerator failure.
+
+    The type NAME is rebound to ``XlaRuntimeError`` below so
+    ``resilience.failure``'s name-based classification (which cannot
+    import jaxlib's private exception type) treats injected faults
+    exactly like real ones. Tests that need to catch it still have the
+    ``chaos.ChaosXlaError`` module attribute.
+    """
+
+
+ChaosXlaError.__name__ = "XlaRuntimeError"
+
+
+class ChaosKilled(BaseException):
+    """Simulated preemption/SIGKILL. Derives from BaseException on
+    purpose: no recovery rung may swallow it — the process is 'dead', and
+    only the on-disk checkpoint survives."""
+
+
+_STATUS = {
+    "unavailable": "UNAVAILABLE",
+    "deadline": "DEADLINE_EXCEEDED",
+    "aborted": "ABORTED",
+    "cancelled": "CANCELLED",
+    "internal": "INTERNAL",
+    "data_loss": "DATA_LOSS",
+}
+
+_KINDS = tuple(_STATUS) + ("nan", "hang", "kill")
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One planned fault: fire at the ``at``-th (1-based) step of ``site``.
+
+    ``arg``: seconds for ``kind='hang'``; ignored otherwise.
+    """
+
+    site: str
+    at: int
+    kind: str
+    arg: float | None = None
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(
+                f"unknown chaos fault kind {self.kind!r}; one of {_KINDS}"
+            )
+        if self.at < 1:
+            raise ValueError(f"fault 'at' is 1-based, got {self.at}")
+
+
+class ChaosPlan:
+    """A set of faults plus the per-site step counters that sequence them.
+
+    Counters live on the plan (not the module) so installing a fresh plan
+    restarts the clock — what makes kill-at-round-k tests deterministic.
+    ``fired`` records ``(site, step, kind)`` for every fault that actually
+    triggered, so a test can assert the injection happened.
+    """
+
+    def __init__(self, faults):
+        self.faults = [
+            f if isinstance(f, Fault) else Fault(*f) for f in faults
+        ]
+        self.counts: dict[str, int] = {}
+        self.fired: list[tuple[str, int, str]] = []
+
+    def step(self, site: str) -> Fault | None:
+        n = self.counts.get(site, 0) + 1
+        self.counts[site] = n
+        for f in self.faults:
+            if f.site == site and f.at == n:
+                self.fired.append((site, n, f.kind))
+                return f
+        return None
+
+
+_PLAN: ChaosPlan | None = None
+# Env plans are parsed once per distinct spec string and keep their step
+# counters for the life of the process (matching "the 3rd dispatch" of a
+# whole run, which is what a CI chaos job injects against).
+_ENV_SPEC: str | None = None
+_ENV_PLAN: ChaosPlan | None = None
+
+
+def parse_plan(spec: str) -> ChaosPlan:
+    """Parse ``"site:at:kind[:arg];..."`` into a :class:`ChaosPlan`."""
+    faults = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        if len(bits) not in (3, 4):
+            raise ValueError(
+                f"malformed chaos fault {part!r}; expected site:at:kind[:arg]"
+            )
+        site, at, kind = bits[0], int(bits[1]), bits[2]
+        arg = float(bits[3]) if len(bits) == 4 else None
+        faults.append(Fault(site, at, kind, arg))
+    return ChaosPlan(faults)
+
+
+def install(plan) -> ChaosPlan:
+    """Install a plan (a ChaosPlan, an iterable of Faults, or a spec
+    string); returns the live plan object (for ``.fired`` assertions)."""
+    global _PLAN
+    if isinstance(plan, str):
+        plan = parse_plan(plan)
+    elif not isinstance(plan, ChaosPlan):
+        plan = ChaosPlan(plan)
+    _PLAN = plan
+    return plan
+
+
+def clear() -> None:
+    """Remove any programmatic plan and forget the cached env plan."""
+    global _PLAN, _ENV_SPEC, _ENV_PLAN
+    _PLAN = None
+    _ENV_SPEC = None
+    _ENV_PLAN = None
+
+
+@contextlib.contextmanager
+def active(*faults):
+    """``with chaos.active(Fault(...), ...):`` — install for a block."""
+    plan = install(faults)
+    try:
+        yield plan
+    finally:
+        clear()
+
+
+def _current() -> ChaosPlan | None:
+    if _PLAN is not None:
+        return _PLAN
+    spec = os.environ.get("MPITREE_TPU_CHAOS")
+    if not spec:
+        return None
+    global _ENV_SPEC, _ENV_PLAN
+    if spec != _ENV_SPEC:
+        _ENV_SPEC = spec
+        _ENV_PLAN = parse_plan(spec)
+    return _ENV_PLAN
+
+
+def _fire(f: Fault, site: str, n: int) -> None:
+    if f.kind in _STATUS:
+        raise ChaosXlaError(
+            f"{_STATUS[f.kind]}: chaos-injected fault at {site}#{n}"
+        )
+    if f.kind == "kill":
+        raise ChaosKilled(f"chaos-injected preemption at {site}#{n}")
+    if f.kind == "hang":
+        time.sleep(float(f.arg or 0.0))
+    # kind == "nan" is corrupt()-only: a raise site stepping past one is
+    # a plan mistake, not a crash — ignore it here.
+
+
+def step(site: str) -> None:
+    """Advance ``site``'s step counter; fire a matching fault if planned.
+
+    The hook every raise/hang seam calls. No plan installed: one global
+    read, zero allocation — always-on seams cost nothing in production.
+    """
+    plan = _current()
+    if plan is None:
+        return
+    f = plan.step(site)
+    if f is not None:
+        _fire(f, site, plan.counts[site])
+
+
+def corrupt(site: str, *arrays):
+    """Advance ``site``; on a planned ``nan`` fault, return copies of
+    ``arrays`` with NaN poisoned into the first element of each — the
+    payload-corruption seam (raise/hang kinds also honor their semantics
+    here, so one site can plan either shape).
+    """
+    plan = _current()
+    if plan is None:
+        return arrays if len(arrays) != 1 else arrays[0]
+    f = plan.step(site)
+    if f is not None:
+        if f.kind == "nan":
+            poisoned = []
+            for a in arrays:
+                a = a.copy()
+                a.reshape(-1)[0] = float("nan")
+                poisoned.append(a)
+            arrays = tuple(poisoned)
+        else:
+            _fire(f, site, plan.counts[site])
+    return arrays if len(arrays) != 1 else arrays[0]

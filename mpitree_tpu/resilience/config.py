@@ -1,0 +1,81 @@
+"""ResilienceConfig: the knobs of the retry/backoff/failover ladder.
+
+Precedence: an explicit ``ResilienceConfig`` passed by a caller wins;
+otherwise :meth:`ResilienceConfig.from_env` reads the env once per
+failover site:
+
+- ``MPITREE_TPU_RETRIES`` — max in-place device retries for *transient*
+  failures before the next rung (default 2; 0 disables the retry rung).
+- ``MPITREE_TPU_BACKOFF_S`` — base backoff in seconds (default 0.5;
+  attempt ``a`` sleeps ``base * 2**a`` plus deterministic jitter, capped).
+- ``MPITREE_TPU_ELASTIC`` — ``0`` switches the whole ladder off: device
+  failures raise immediately (the CI stance — a device regression must
+  never silently pass on the host tier).
+
+Malformed env values warn and fall back to the default rather than
+failing a fit over a typo.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import warnings
+
+
+def elastic_enabled() -> bool:
+    return os.environ.get("MPITREE_TPU_ELASTIC", "1") != "0"
+
+
+def _env_number(name: str, cast, default):
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        v = cast(raw)
+        if v < 0:
+            raise ValueError(v)
+        return v
+    except (TypeError, ValueError):
+        warnings.warn(
+            f"ignoring malformed {name}={raw!r} (expected a non-negative "
+            f"{cast.__name__}); using the default {default!r}",
+            stacklevel=3,
+        )
+        return default
+
+
+@dataclasses.dataclass(frozen=True)
+class ResilienceConfig:
+    """Bounded retry-with-exponential-backoff parameters.
+
+    ``jitter_key`` seeds the *deterministic* jitter (a hash, never
+    ``random``): two ranks retrying the same blip spread out, yet a rerun
+    of the same config reproduces the same schedule — the same stance as
+    the keyed subsample masks.
+    """
+
+    max_retries: int = 2
+    backoff_base_s: float = 0.5
+    backoff_cap_s: float = 8.0
+    jitter_key: int = 0
+
+    @classmethod
+    def from_env(cls) -> "ResilienceConfig":
+        return cls(
+            max_retries=_env_number("MPITREE_TPU_RETRIES", int, 2),
+            backoff_base_s=_env_number("MPITREE_TPU_BACKOFF_S", float, 0.5),
+        )
+
+
+def backoff_delay(cfg: ResilienceConfig, attempt: int, salt: str = "") -> float:
+    """Seconds to sleep before retry ``attempt`` (0-based): exponential
+    base with up to +25% deterministic jitter from (jitter_key, salt,
+    attempt)."""
+    base = min(cfg.backoff_base_s * (2.0 ** attempt), cfg.backoff_cap_s)
+    h = hashlib.sha256(
+        f"{cfg.jitter_key}:{salt}:{attempt}".encode()
+    ).digest()
+    frac = int.from_bytes(h[:4], "big") / 2.0**32
+    return base * (1.0 + 0.25 * frac)

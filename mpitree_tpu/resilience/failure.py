@@ -1,0 +1,177 @@
+"""Failure classification: which exceptions mean the accelerator is gone.
+
+The reference has no failure taxonomy at all — a rank dying inside
+``comm.allgather`` aborts the job (``mpitree/tree/decision_tree.py:456``).
+Our TPU-native analogue of a lost rank is a lost/hung accelerator client:
+``XlaRuntimeError`` (UNAVAILABLE / DEADLINE_EXCEEDED / INTERNAL) or a PJRT
+wire error surfacing as ``RuntimeError``. Two orthogonal questions, two
+predicates:
+
+- :func:`is_device_failure` — is this an accelerator/runtime loss at all
+  (vs a program bug or user error, which must re-raise untouched)?
+- :func:`is_transient_failure` — is it the kind of loss a bounded retry
+  can heal (a tunnel blip), vs a terminal one (compiler crash, data loss)
+  where re-running the same program on the same runtime buys nothing?
+
+Both walk the exception chain (``__cause__``/``__context__``, bounded
+depth): library layers routinely wrap transport errors as
+``raise RuntimeError(...) from XlaRuntimeError(UNAVAILABLE)``, and
+matching only the outermost link used to re-raise exactly the failures
+this subsystem exists to recover. The walk refuses to look past an
+unambiguous user-error link (``ValueError`` & friends): a bug raised
+*while handling* a device failure is still a bug the caller must see.
+"""
+
+from __future__ import annotations
+
+# Status markers that identify an accelerator/transport loss inside an
+# exception message. Deliberately conservative: program bugs
+# (INVALID_ARGUMENT shape errors, ENOSPC, arbitrary RuntimeErrors) must
+# re-raise, or a device-engine regression would silently pass CI on the
+# 10-100x slower host tier.
+# Matching is CASE-SENSITIVE on purpose: the uppercase entries are gRPC
+# status codes exactly as PJRT prints them — lowercasing would make
+# ordinary prose ("Resource temporarily unavailable", "launch aborted")
+# classify as transport loss.
+_TRANSPORT_MARKERS = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "DATA_LOSS",
+    "ABORTED",
+    "CANCELLED",
+    "Connection",
+    "connection",
+    "socket",
+    "PJRT",
+    "pjrt",
+)
+
+# Terminal statuses: still device failures (the host tier rescues the
+# fit) but re-dispatching the same program at the same runtime state
+# would fail the same way, so the retry rung skips straight past them.
+# Checked with PRIORITY over the transient markers — a real
+# "INTERNAL: PJRT_LoadedExecutable_Execute failed" carries both kinds of
+# token, and burning the retry budget on it would just delay the rescue.
+_TERMINAL_MARKERS = ("INTERNAL", "DATA_LOSS")
+
+# The retryable subset: statuses a healthy-again transport serves on the
+# next attempt.
+_TRANSIENT_MARKERS = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "CANCELLED",
+    "Connection",
+    "connection",
+    "socket",
+    "PJRT",
+    "pjrt",
+)
+
+# Definite user-error/program-bug types: never classified, and the chain
+# walk stops rather than looking past them (see module docstring).
+_USER_ERROR_TYPES = (
+    ValueError,
+    TypeError,
+    KeyError,
+    IndexError,
+    AttributeError,
+    AssertionError,
+    NotImplementedError,
+)
+
+# Chained-exception walk bound: real wrap chains are 2-3 deep; anything
+# deeper is pathological and O(1) inspection matters on the hot except
+# path.
+_MAX_CHAIN_DEPTH = 8
+
+
+def _chain(exc: BaseException):
+    """Yield ``exc`` then its causes/contexts, bounded and cycle-safe.
+
+    ``__cause__`` (explicit ``raise ... from e``) wins over ``__context__``
+    (implicit during-handling chaining) at each link, mirroring how
+    tracebacks render the chain.
+    """
+    seen: set[int] = set()
+    node: BaseException | None = exc
+    for _ in range(_MAX_CHAIN_DEPTH):
+        if node is None or id(node) in seen:
+            return
+        seen.add(id(node))
+        yield node
+        if node is not exc and isinstance(node, _USER_ERROR_TYPES):
+            # A user error anywhere down the chain: whatever sits below it
+            # was already being handled when the bug fired — stop here.
+            return
+        if node.__cause__ is not None:
+            node = node.__cause__
+        elif node.__suppress_context__:
+            # `raise ... from None`: the raiser explicitly severed the
+            # chain — honoring it is what keeps a deliberate new error
+            # from inheriting a handled device failure's classification.
+            return
+        else:
+            node = node.__context__
+
+
+def _one_is_device_failure(exc: BaseException) -> bool:
+    """The single-link test (PR-1..5 semantics, unchanged)."""
+    name = type(exc).__name__
+    msg = str(exc)
+    if name in ("XlaRuntimeError", "JaxRuntimeError"):
+        return any(m in msg for m in _TRANSPORT_MARKERS + ("INTERNAL",))
+    if isinstance(exc, ConnectionError):
+        return True  # ConnectionReset/Refused/Aborted ARE transport losses
+    if isinstance(exc, (RuntimeError, OSError)):
+        return any(m in msg for m in _TRANSPORT_MARKERS)
+    return False
+
+
+def _one_is_transient(exc: BaseException) -> bool:
+    name = type(exc).__name__
+    msg = str(exc)
+    if name in ("XlaRuntimeError", "JaxRuntimeError"):
+        return (
+            not any(m in msg for m in _TERMINAL_MARKERS)
+            and any(m in msg for m in _TRANSIENT_MARKERS)
+        )
+    if isinstance(exc, ConnectionError):
+        return True
+    if isinstance(exc, (RuntimeError, OSError)):
+        return (
+            not any(m in msg for m in _TERMINAL_MARKERS)
+            and any(m in msg for m in _TRANSIENT_MARKERS)
+        )
+    return False
+
+
+def is_device_failure(exc: BaseException) -> bool:
+    """True when ``exc`` (or a chained cause/context) is an accelerator loss.
+
+    ``XlaRuntimeError`` (jaxlib) / jax's ``JaxRuntimeError`` qualify only
+    when they carry a transport status (UNAVAILABLE, DEADLINE_EXCEEDED,
+    ...; INTERNAL also qualifies there — runtime/compiler crashes surface
+    so) — an INVALID_ARGUMENT program bug re-raises. A plain
+    ``RuntimeError``/``OSError`` qualifies only on an explicit transport
+    marker (ENOSPC's "No space left on device" does not). ValueError &
+    friends — user errors — never do, and the chain walk will not look
+    past one (a bug raised while handling a device failure is still a
+    bug).
+    """
+    if isinstance(exc, _USER_ERROR_TYPES):
+        return False
+    return any(_one_is_device_failure(e) for e in _chain(exc))
+
+
+def is_transient_failure(exc: BaseException) -> bool:
+    """True when ``exc`` is a device failure a bounded retry can heal.
+
+    The retry rung of the resilience ladder keys off this: UNAVAILABLE /
+    DEADLINE_EXCEEDED / ABORTED / CANCELLED and connection-shaped errors
+    re-dispatch on the accelerator; INTERNAL and DATA_LOSS (still device
+    failures) skip straight to the host-failover rung.
+    """
+    if isinstance(exc, _USER_ERROR_TYPES):
+        return False
+    return any(_one_is_transient(e) for e in _chain(exc))

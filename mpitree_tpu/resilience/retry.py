@@ -1,0 +1,141 @@
+"""The retry/backoff/failover ladder around device-engine dispatches.
+
+The ladder, rung by rung (each rung emits a typed obs event, so
+``fit_report_`` carries the whole recovery story):
+
+1. **Retry in place** (:func:`retry_device`, folded into
+   :func:`device_failover`): a *transient* loss (UNAVAILABLE /
+   DEADLINE_EXCEEDED / connection blip — ``failure.is_transient_failure``)
+   re-dispatches on the accelerator after exponential backoff with
+   deterministic jitter, up to ``ResilienceConfig.max_retries`` times.
+   This is the everyday case on tunneled transports, and before this rung
+   existed every blip cliff-dropped the whole fit to the 10-100x slower
+   host tier. Event: ``device_retry``; counter: ``device_retries``.
+2. **Host failover** (the final rung of :func:`device_failover`): retry
+   budget exhausted, or a non-transient device failure (INTERNAL compiler
+   crash, DATA_LOSS). The host tier consumes the same binned inputs and
+   produces the identical tree (the engine-identity contract), so losing
+   the accelerator costs wall-clock, not the job. Event:
+   ``device_failover``; counter: ``device_failovers``.
+
+User errors re-raise untouched from every rung, and
+``MPITREE_TPU_ELASTIC=0`` turns the whole ladder off (device failures
+raise — the CI stance). Checkpointing (``resilience.checkpoint``) is the
+rung *below* this module: when the process itself dies, the on-disk
+group/round state is what resumes.
+"""
+
+from __future__ import annotations
+
+import time
+import warnings
+
+from mpitree_tpu.resilience import chaos
+from mpitree_tpu.resilience.config import (
+    ResilienceConfig,
+    backoff_delay,
+    elastic_enabled,
+)
+from mpitree_tpu.resilience.failure import (
+    is_device_failure,
+    is_transient_failure,
+)
+
+
+def _transient_retry(e: BaseException, attempt: int, cfg: ResilienceConfig,
+                     what: str, obs) -> bool:
+    """One retry-rung step: classify, account, warn, back off.
+
+    True means "re-dispatch on the device tier" (the sleep already
+    happened); False means the rung does not apply — not transient, the
+    ladder is disabled, or the budget is spent — and the caller moves to
+    its next rung. The ONE copy of the rung both ladder entry points
+    share, so the event fields and warning text can never drift between
+    them. ``is_transient_failure`` implies ``is_device_failure`` (its
+    markers are the retryable subset), so callers need no second check
+    before this rung.
+    """
+    if not (elastic_enabled() and is_transient_failure(e)
+            and attempt < cfg.max_retries):
+        return False
+    delay = backoff_delay(cfg, attempt, salt=what)
+    n = attempt + 1
+    if obs is not None:
+        obs.counter("device_retries")
+        obs.event(
+            "device_retry",
+            f"transient device failure during {what} "
+            f"({type(e).__name__}: {str(e)[:160]}); retry "
+            f"{n}/{cfg.max_retries} on the device tier",
+            attempt=n, delay_s=round(delay, 3),
+        )
+    warnings.warn(
+        f"transient device failure during {what} "
+        f"({type(e).__name__}: {str(e)[:160]}); retrying on the device "
+        f"tier in {delay:.2f}s ({n}/{cfg.max_retries})",
+        stacklevel=3,
+    )
+    time.sleep(delay)
+    return True
+
+
+def retry_device(device_fn, *, what: str, obs=None,
+                 config: ResilienceConfig | None = None):
+    """Run ``device_fn`` with the retry rung only; re-raise when exhausted.
+
+    For callers with no host twin of the work (the boosting round loop —
+    its recovery rung below retries is the round checkpoint, not a host
+    rebuild). Transient failures re-dispatch with backoff; everything
+    else (including non-transient device failures) raises to the caller.
+    """
+    cfg = config if config is not None else ResilienceConfig.from_env()
+    attempt = 0
+    while True:
+        try:
+            chaos.step("dispatch")
+            return device_fn()
+        except Exception as e:  # noqa: BLE001 — classified, not swallowed
+            if not _transient_retry(e, attempt, cfg, what, obs):
+                raise
+            attempt += 1
+
+
+def device_failover(device_fn, host_fn, *, what: str, obs=None,
+                    config: ResilienceConfig | None = None):
+    """Run ``device_fn`` through the full ladder; ``host_fn`` is the last
+    rung.
+
+    The TPU-native answer to the reference's abort-the-job failure mode:
+    transient losses retry on the accelerator (see module docstring);
+    only an exhausted retry budget or a terminal device failure rebuilds
+    on the host tier, which consumes the same binned inputs and produces
+    the identical tree — so losing the accelerator mid-fit costs
+    wall-clock, not the job. User errors re-raise untouched; with
+    elasticity disabled (``MPITREE_TPU_ELASTIC=0``) device failures
+    re-raise too.
+
+    ``obs``: any PhaseTimer/BuildObserver — retry counts and rung events
+    land in ``fit_report_`` through it. Callers' ``host_fn`` closures
+    emit their own ``device_failover`` event with site context.
+    """
+    cfg = config if config is not None else ResilienceConfig.from_env()
+    attempt = 0
+    while True:
+        try:
+            chaos.step("dispatch")
+            return device_fn()
+        except Exception as e:  # noqa: BLE001 — classified, not swallowed
+            if not (elastic_enabled() and is_device_failure(e)):
+                raise
+            if _transient_retry(e, attempt, cfg, what, obs):
+                attempt += 1
+                continue
+            if obs is not None:
+                obs.counter("device_failovers")
+            warnings.warn(
+                f"device failure during {what} ({type(e).__name__}: "
+                f"{str(e)[:200]}); rebuilding on the host tier"
+                + (f" after {attempt} device retries" if attempt else ""),
+                stacklevel=2,
+            )
+            return host_fn()

@@ -1,210 +1,42 @@
-"""Failure detection and elastic recovery — SURVEY.md §5's missing subsystem.
+"""Back-compat shim — this subsystem is now ``mpitree_tpu.resilience``.
 
-The reference has no failure story at all: a rank dying inside
-``comm.allgather`` deadlocks or aborts the whole job (reference:
-``mpitree/tree/decision_tree.py:456``; SURVEY §5 "Failure detection").
-The TPU-native analogue of a lost rank is a lost/hung accelerator client —
-on this project's tunneled transport an everyday event, observed as
-``XlaRuntimeError`` (UNAVAILABLE / DEADLINE_EXCEEDED / INTERNAL) or a
-PJRT wire error surfacing as ``RuntimeError``.
-
-Two mechanisms, both estimator-integrated:
-
-- **Device failover** (:func:`device_failover`): every estimator wraps its
-  device-engine build; a *device* failure (never a user error — those
-  re-raise untouched) logs a warning and rebuilds on the host tier, which
-  consumes the same binned matrix and produces the identical tree (the
-  engine-identity contract, ``tests/test_engine_identity.py``). The job
-  completes where the reference's would abort. Opt out with
-  ``MPITREE_TPU_ELASTIC=0`` (then device failures raise).
-
-- **Forest checkpointing** (:class:`ForestCheckpoint`): with
-  ``RandomForestClassifier(checkpoint=path)`` the build runs in tree-axis
-  sized groups, each group persisted (pickle-free ``.npz``) as it
-  completes. A crashed or preempted fit re-run with the same params and
-  data resumes after the last finished group — a fingerprint of params,
-  data, and RNG state guards against silently resuming onto different
-  inputs. Per-tree RNG draws happen up front either way, so a resumed
-  forest is bit-identical to an uninterrupted one (pinned in
-  ``tests/test_elastic.py``).
+PR 6 promoted the single-module failure story here (device-failure
+classification, host failover, forest checkpointing) into a full
+subsystem with a retry/backoff ladder, sharded checkpoints that also
+cover boosting rounds, and a deterministic chaos layer. Import from
+``mpitree_tpu.resilience`` going forward; this module re-exports the
+historical names so existing callers and serialized references keep
+working.
 """
 
-from __future__ import annotations
-
-import hashlib
-import json
-import os
-import warnings
-
-import numpy as np
-
-# Status markers that identify an accelerator/transport loss inside an
-# exception message. Deliberately conservative: program bugs
-# (INVALID_ARGUMENT shape errors, ENOSPC, arbitrary RuntimeErrors) must
-# re-raise, or a device-engine regression would silently pass CI on the
-# 10-100x slower host tier.
-# Matching is CASE-SENSITIVE on purpose: the uppercase entries are gRPC
-# status codes exactly as PJRT prints them — lowercasing would make
-# ordinary prose ("Resource temporarily unavailable", "launch aborted")
-# classify as transport loss.
-_TRANSPORT_MARKERS = (
-    "UNAVAILABLE",
-    "DEADLINE_EXCEEDED",
-    "DATA_LOSS",
-    "ABORTED",
-    "CANCELLED",
-    "Connection",
-    "connection",
-    "socket",
-    "PJRT",
-    "pjrt",
+from mpitree_tpu.resilience.checkpoint import (  # noqa: F401
+    BoostCheckpoint,
+    BuildCheckpoint,
+    ForestCheckpoint,
+    _fingerprint,
+)
+from mpitree_tpu.resilience.config import (  # noqa: F401
+    ResilienceConfig,
+    elastic_enabled,
+)
+from mpitree_tpu.resilience.failure import (  # noqa: F401
+    _TRANSPORT_MARKERS,
+    is_device_failure,
+    is_transient_failure,
+)
+from mpitree_tpu.resilience.retry import (  # noqa: F401
+    device_failover,
+    retry_device,
 )
 
-
-def elastic_enabled() -> bool:
-    return os.environ.get("MPITREE_TPU_ELASTIC", "1") != "0"
-
-
-def is_device_failure(exc: BaseException) -> bool:
-    """True when ``exc`` looks like an accelerator/runtime loss.
-
-    ``XlaRuntimeError`` (jaxlib) / jax's ``JaxRuntimeError`` qualify only
-    when they carry a transport status (UNAVAILABLE, DEADLINE_EXCEEDED,
-    ...; INTERNAL also qualifies there — runtime/compiler crashes surface
-    so) — an INVALID_ARGUMENT program bug re-raises. A plain
-    ``RuntimeError``/``OSError`` qualifies only on an explicit transport
-    marker (ENOSPC's "No space left on device" does not). ValueError &
-    friends — user errors — never do.
-    """
-    name = type(exc).__name__
-    msg = str(exc)
-    if name in ("XlaRuntimeError", "JaxRuntimeError"):
-        return any(m in msg for m in _TRANSPORT_MARKERS + ("INTERNAL",))
-    if isinstance(exc, ConnectionError):
-        return True  # ConnectionReset/Refused/Aborted ARE transport losses
-    if isinstance(exc, (RuntimeError, OSError)):
-        return any(m in msg for m in _TRANSPORT_MARKERS)
-    return False
-
-
-def device_failover(device_fn, host_fn, *, what: str):
-    """Run ``device_fn``; on a *device* failure fall back to ``host_fn``.
-
-    The TPU-native answer to the reference's abort-the-job failure mode:
-    the host tier consumes the same binned inputs and produces the
-    identical tree, so losing the accelerator mid-fit costs wall-clock,
-    not the job. User errors re-raise untouched; with elasticity disabled
-    (``MPITREE_TPU_ELASTIC=0``) device failures re-raise too.
-    """
-    try:
-        return device_fn()
-    except Exception as e:  # noqa: BLE001 — classified, not swallowed
-        if not (elastic_enabled() and is_device_failure(e)):
-            raise
-        warnings.warn(
-            f"device failure during {what} ({type(e).__name__}: "
-            f"{str(e)[:200]}); rebuilding on the host tier",
-            stacklevel=2,
-        )
-        return host_fn()
-
-
-# --------------------------------------------------------------------------
-# Forest checkpoint/resume
-# --------------------------------------------------------------------------
-
-_CKPT_VERSION = 1
-
-
-def _fingerprint(params: dict, X: np.ndarray, y: np.ndarray,
-                 sample_weight) -> str:
-    """Stable digest of everything that determines the fitted forest.
-
-    Hashes the constructor params (JSON), the data's shape/dtype and
-    content, targets, and weights — resuming onto different inputs would
-    silently mix two forests, so a mismatch restarts from scratch instead.
-    """
-    h = hashlib.sha256()
-    h.update(json.dumps(params, sort_keys=True, default=str).encode())
-    for a in (X, y):
-        a = np.ascontiguousarray(a)
-        h.update(str((a.shape, str(a.dtype))).encode())
-        h.update(a.tobytes())
-    if sample_weight is not None:
-        h.update(np.ascontiguousarray(sample_weight).tobytes())
-    return h.hexdigest()
-
-
-class ForestCheckpoint:
-    """Pickle-free incremental persistence for a forest build.
-
-    One ``.npz`` file holding the fingerprint, the completed-tree count,
-    and each finished tree's arrays (post-refine, i.e. final). Append is
-    atomic-by-rename so a crash mid-write leaves the previous state.
-    """
-
-    def __init__(self, path: str, fingerprint: str):
-        self.path = os.fspath(path)
-        self.fingerprint = fingerprint
-        self.trees: list = []
-
-    @classmethod
-    def open(cls, path, params: dict, X, y, sample_weight) -> ForestCheckpoint:
-        """Load a resumable checkpoint, or a fresh one on any mismatch."""
-        fp = _fingerprint(params, X, y, sample_weight)
-        ck = cls(path, fp)
-        if not os.path.exists(ck.path):
-            return ck
-        try:
-            from mpitree_tpu.utils.serialize import _read_tree
-
-            with np.load(ck.path, allow_pickle=False) as z:
-                head = json.loads(str(z["header"]))
-                if (head.get("version") != _CKPT_VERSION
-                        or head.get("fingerprint") != fp):
-                    raise ValueError("fingerprint mismatch")
-                ck.trees = [
-                    _read_tree(z, f"tree{i}_")
-                    for i in range(int(head["n_trees"]))
-                ]
-        except Exception as e:  # noqa: BLE001 — a bad checkpoint restarts
-            warnings.warn(
-                f"forest checkpoint at {ck.path} not resumable "
-                f"({type(e).__name__}: {e}); starting fresh",
-                stacklevel=3,
-            )
-            ck.trees = []
-        return ck
-
-    def append(self, new_trees: list) -> None:
-        """Persist ``new_trees`` as completed (write-temp + rename).
-
-        Each append rewrites the whole file (the price of one atomic
-        ``.npz``), so callers append at GROUP granularity — the forest
-        flushes per device-program batch, never per tree — keeping total
-        write cost O(groups x forest size), and recovery granularity = one
-        group.
-        """
-        from mpitree_tpu.utils.serialize import _tree_arrays
-
-        self.trees.extend(new_trees)
-        payload: dict = {
-            "header": json.dumps({
-                "version": _CKPT_VERSION,
-                "fingerprint": self.fingerprint,
-                "n_trees": len(self.trees),
-            })
-        }
-        for i, t in enumerate(self.trees):
-            payload.update(_tree_arrays(f"tree{i}_", t))
-        tmp = self.path + ".tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, **payload)
-        os.replace(tmp, self.path)
-
-    def done(self) -> None:
-        """Remove the file once the full fit has succeeded."""
-        try:
-            os.unlink(self.path)
-        except OSError:
-            pass
+__all__ = [
+    "BoostCheckpoint",
+    "BuildCheckpoint",
+    "ForestCheckpoint",
+    "ResilienceConfig",
+    "device_failover",
+    "elastic_enabled",
+    "is_device_failure",
+    "is_transient_failure",
+    "retry_device",
+]

@@ -26,6 +26,14 @@ class FakeXlaRuntimeError(Exception):
 FakeXlaRuntimeError.__name__ = "XlaRuntimeError"
 
 
+@pytest.fixture(autouse=True)
+def _zero_backoff(monkeypatch):
+    """These tests inject transient faults that now pass through the
+    retry rung (PR 6) before the failover they pin; zero the backoff so
+    tier-1 never sleeps on purpose."""
+    monkeypatch.setenv("MPITREE_TPU_BACKOFF_S", "0")
+
+
 def _data(n=400, seed=0):
     rng = np.random.default_rng(seed)
     X = rng.normal(size=(n, 5)).astype(np.float32)

@@ -1,0 +1,570 @@
+"""mpitree_tpu.resilience: the ladder, the chaos harness, the checkpoints.
+
+The tier-1 chaos job (CPU-only, fast): every recovery rung is driven by
+deterministic fault injection (``resilience.chaos``) rather than by
+monkeypatched build functions, so the seams tested here are the seams a
+real tunnel failure hits — the dispatch boundary of ``device_failover``,
+the collective dispatch wrappers, and the boosting round loop.
+
+Acceptance pins (ISSUE 6):
+
+- a chaos-injected transient UNAVAILABLE on dispatch N recovers ON THE
+  DEVICE TIER within the retry budget (no host fallback), retry count
+  visible in ``fit_report_``;
+- a checkpointed GradientBoosting fit killed at an arbitrary round
+  resumes to a bit-identical ensemble (predict/staged_predict), early
+  stopping included.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from mpitree_tpu import (
+    DecisionTreeClassifier,
+    GradientBoostingClassifier,
+    GradientBoostingRegressor,
+)
+from mpitree_tpu.resilience import (
+    BuildCheckpoint,
+    ResilienceConfig,
+    backoff_delay,
+    chaos,
+    device_failover,
+    is_device_failure,
+    is_transient_failure,
+)
+from mpitree_tpu.resilience.chaos import ChaosKilled, ChaosXlaError, Fault
+
+
+class FakeXlaRuntimeError(Exception):
+    """Stands in for jaxlib's XlaRuntimeError (same type-name matching)."""
+
+
+FakeXlaRuntimeError.__name__ = "XlaRuntimeError"
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos(monkeypatch):
+    """Every test starts with no plan and zero backoff (deterministic,
+    fast); MPITREE_TPU_CHAOS from the outer env must not leak in."""
+    chaos.clear()
+    monkeypatch.delenv("MPITREE_TPU_CHAOS", raising=False)
+    monkeypatch.setenv("MPITREE_TPU_BACKOFF_S", "0")
+    yield
+    chaos.clear()
+
+
+def _data(n=400, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = ((X[:, 0] > 0) + 2 * (X[:, 1] > 0.3)).astype(np.int64)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# failure classification (satellite: chained exceptions)
+# ---------------------------------------------------------------------------
+
+def test_chained_device_failure_recovers_cause():
+    """raise RuntimeError(...) from XlaRuntimeError(UNAVAILABLE) — the
+    wrap chain library layers produce — must classify as a (transient)
+    device failure; before the chain walk it re-raised."""
+    wrapped = RuntimeError("dispatch failed")
+    wrapped.__cause__ = FakeXlaRuntimeError("UNAVAILABLE: tunnel lost")
+    assert is_device_failure(wrapped)
+    assert is_transient_failure(wrapped)
+
+    # implicit chaining (__context__) counts too
+    ctx = RuntimeError("while handling")
+    ctx.__context__ = FakeXlaRuntimeError("DEADLINE_EXCEEDED")
+    assert is_device_failure(ctx)
+
+    # a 3-deep chain still resolves
+    deep = RuntimeError("outer")
+    mid = RuntimeError("mid")
+    mid.__cause__ = OSError("PJRT transport reset")
+    deep.__cause__ = mid
+    assert is_device_failure(deep)
+
+
+def test_chained_walk_never_swallows_user_errors():
+    """A bug raised WHILE HANDLING a device failure is still a bug: the
+    walk refuses to look past a user-error link, and a user-error
+    outermost never classifies."""
+    bug = ValueError("bad reshape in recovery path")
+    bug.__context__ = FakeXlaRuntimeError("UNAVAILABLE: tunnel lost")
+    assert not is_device_failure(bug)
+    assert not is_transient_failure(bug)
+
+    # user error buried mid-chain blocks the walk below it
+    outer = RuntimeError("wrapper")
+    mid = KeyError("missing")
+    mid.__context__ = FakeXlaRuntimeError("UNAVAILABLE")
+    outer.__cause__ = mid
+    assert not is_device_failure(outer)
+
+
+def test_chained_walk_honors_suppressed_context():
+    """`raise ... from None` severs the chain on purpose: the deliberate
+    new error must not inherit the handled device failure's
+    classification (or a device-engine bug would silently pass CI on the
+    host tier)."""
+    try:
+        try:
+            raise FakeXlaRuntimeError("UNAVAILABLE: tunnel lost")
+        except FakeXlaRuntimeError:
+            raise RuntimeError("invalid tree state") from None
+    except RuntimeError as e:
+        severed = e
+    assert severed.__context__ is not None  # python still records it...
+    assert not is_device_failure(severed)  # ...but the walk honors None
+    assert not is_transient_failure(severed)
+
+
+def test_chained_walk_is_cycle_safe_and_bounded():
+    e = RuntimeError("self-referential")
+    e.__cause__ = e
+    assert not is_device_failure(e)  # and terminates
+
+    # a chain deeper than the bound with the marker at the bottom: the
+    # bounded walk gives up (conservative re-raise, never a hang)
+    head = RuntimeError("link 0")
+    node = head
+    for i in range(1, 12):
+        nxt = RuntimeError(f"link {i}")
+        node.__cause__ = nxt
+        node = nxt
+    node.__cause__ = FakeXlaRuntimeError("UNAVAILABLE")
+    assert not is_device_failure(head)
+
+
+def test_transient_vs_terminal_device_failures():
+    # transient: retryable statuses and connection-shaped errors
+    for msg in ("UNAVAILABLE: x", "DEADLINE_EXCEEDED", "ABORTED: reset",
+                "CANCELLED"):
+        assert is_transient_failure(FakeXlaRuntimeError(msg)), msg
+    assert is_transient_failure(ConnectionResetError("peer"))
+    # terminal device failures: still device failures, never retried —
+    # even when the message ALSO carries a transport-shaped token (real
+    # PJRT INTERNAL errors name the PJRT entry point that failed)
+    for msg in ("INTERNAL: compiler crash", "DATA_LOSS: corrupt",
+                "INTERNAL: PJRT_LoadedExecutable_Execute failed",
+                "DATA_LOSS: corrupted buffer on socket transfer"):
+        e = FakeXlaRuntimeError(msg)
+        assert is_device_failure(e) and not is_transient_failure(e), msg
+    # non-failures are neither
+    assert not is_transient_failure(ValueError("x"))
+    assert not is_transient_failure(RuntimeError("logic bug"))
+
+
+def test_backoff_is_exponential_capped_and_deterministic():
+    cfg = ResilienceConfig(backoff_base_s=0.5, backoff_cap_s=2.0)
+    d0, d1, d2, d3 = (backoff_delay(cfg, a, salt="s") for a in range(4))
+    assert 0.5 <= d0 <= 0.625 and 1.0 <= d1 <= 1.25  # base*2^a (+<=25%)
+    assert d2 <= 2.5 and d3 <= 2.5  # cap
+    assert d0 == backoff_delay(cfg, 0, salt="s")  # deterministic jitter
+    assert d0 != backoff_delay(cfg, 0, salt="other")  # ...but spread
+
+
+# ---------------------------------------------------------------------------
+# chaos harness
+# ---------------------------------------------------------------------------
+
+def test_chaos_plan_fires_at_exact_step():
+    plan = chaos.install([Fault("dispatch", 2, "unavailable")])
+    chaos.step("dispatch")  # step 1: no fault
+    with pytest.raises(ChaosXlaError, match="UNAVAILABLE"):
+        chaos.step("dispatch")  # step 2: fires
+    chaos.step("dispatch")  # step 3: exhausted
+    assert plan.fired == [("dispatch", 2, "unavailable")]
+    assert plan.counts["dispatch"] == 3
+
+
+def test_chaos_env_plan_parsing(monkeypatch):
+    plan = chaos.parse_plan("dispatch:3:unavailable;grad_hess:1:nan;"
+                            "round:2:hang:0.01")
+    kinds = [(f.site, f.at, f.kind, f.arg) for f in plan.faults]
+    assert kinds == [("dispatch", 3, "unavailable", None),
+                     ("grad_hess", 1, "nan", None),
+                     ("round", 2, "hang", 0.01)]
+    with pytest.raises(ValueError, match="malformed"):
+        chaos.parse_plan("dispatch:unavailable")
+    with pytest.raises(ValueError, match="unknown chaos fault kind"):
+        chaos.parse_plan("dispatch:1:explode")
+    # env installation reaches the step sites
+    monkeypatch.setenv("MPITREE_TPU_CHAOS", "level:1:deadline")
+    with pytest.raises(ChaosXlaError, match="DEADLINE_EXCEEDED"):
+        chaos.step("level")
+
+
+def test_chaos_corrupt_injects_nan():
+    chaos.install([Fault("grad_hess", 2, "nan")])
+    g = np.ones(4)
+    h = np.ones(4)
+    g1, h1 = chaos.corrupt("grad_hess", g, h)  # step 1: untouched
+    assert np.isfinite(g1).all() and np.isfinite(h1).all()
+    g2, h2 = chaos.corrupt("grad_hess", g, h)  # step 2: poisoned copies
+    assert np.isnan(g2[0]) and np.isnan(h2[0])
+    assert np.isfinite(g).all(), "originals must never be mutated"
+
+
+# ---------------------------------------------------------------------------
+# the retry ladder (tentpole acceptance)
+# ---------------------------------------------------------------------------
+
+def test_transient_blip_recovers_on_device_tier():
+    """ACCEPTANCE: chaos-injected UNAVAILABLE on the first dispatch
+    recovers on the device tier within the retry budget — no host
+    fallback — and the retry count lands in fit_report_."""
+    X, y = _data()
+    healthy = DecisionTreeClassifier(max_depth=5, backend="cpu").fit(X, y)
+
+    chaos.install([Fault("dispatch", 1, "unavailable")])
+    with pytest.warns(UserWarning, match="retrying on the device tier"):
+        clf = DecisionTreeClassifier(max_depth=5, backend="cpu").fit(X, y)
+    chaos.clear()
+
+    assert clf.export_text() == healthy.export_text()
+    rep = clf.fit_report_
+    assert rep["counters"]["device_retries"] == 1
+    kinds = [ev["kind"] for ev in rep["events"]]
+    assert "device_retry" in kinds
+    assert "device_failover" not in kinds, "must NOT have fallen to host"
+    assert "device_failovers" not in rep["counters"]
+    # the winning build ran on the device engine, not the host tier
+    assert rep["engine"]["value"] in ("fused", "levelwise")
+
+
+def test_retry_budget_exhaustion_falls_to_host():
+    """More blips than budget: the final rung (host failover) still saves
+    the fit, and the report carries both rung counters."""
+    X, y = _data()
+    healthy = DecisionTreeClassifier(max_depth=5, backend="cpu").fit(X, y)
+    chaos.install([Fault("dispatch", i, "unavailable") for i in (1, 2, 3)])
+    with pytest.warns(UserWarning, match="host tier"):
+        clf = DecisionTreeClassifier(max_depth=5, backend="cpu").fit(X, y)
+    chaos.clear()
+    assert clf.export_text() == healthy.export_text()
+    rep = clf.fit_report_
+    assert rep["counters"]["device_retries"] == 2  # default budget
+    assert rep["counters"]["device_failovers"] == 1
+    assert "device_failover" in [ev["kind"] for ev in rep["events"]]
+
+
+def test_terminal_failure_skips_retry_rung():
+    """INTERNAL (compiler crash) is a device failure but not transient:
+    straight to the host rung, zero retries burned."""
+    X, y = _data()
+    chaos.install([Fault("dispatch", 1, "internal")])
+    with pytest.warns(UserWarning, match="host tier"):
+        clf = DecisionTreeClassifier(max_depth=5, backend="cpu").fit(X, y)
+    chaos.clear()
+    rep = clf.fit_report_
+    assert "device_retries" not in rep["counters"]
+    assert rep["counters"]["device_failovers"] == 1
+
+
+def test_retries_env_override(monkeypatch):
+    """MPITREE_TPU_RETRIES=0 disables the retry rung (old single-shot
+    failover behavior); a transient blip goes straight to host."""
+    X, y = _data()
+    monkeypatch.setenv("MPITREE_TPU_RETRIES", "0")
+    chaos.install([Fault("dispatch", 1, "unavailable")])
+    with pytest.warns(UserWarning, match="host tier"):
+        clf = DecisionTreeClassifier(max_depth=5, backend="cpu").fit(X, y)
+    chaos.clear()
+    assert "device_retries" not in clf.fit_report_["counters"]
+    assert clf.fit_report_["counters"]["device_failovers"] == 1
+
+
+def test_elastic_off_disables_whole_ladder(monkeypatch):
+    X, y = _data()
+    monkeypatch.setenv("MPITREE_TPU_ELASTIC", "0")
+    chaos.install([Fault("dispatch", 1, "unavailable")])
+    with pytest.raises(ChaosXlaError):
+        DecisionTreeClassifier(max_depth=4, backend="cpu").fit(X, y)
+    chaos.clear()
+
+
+def test_user_error_reraises_through_ladder():
+    def dev():
+        raise ValueError("user bug")
+
+    with pytest.raises(ValueError, match="user bug"):
+        device_failover(dev, lambda: None, what="test")
+
+
+def test_collective_seam_blip_recovers(monkeypatch):
+    """A fault at the levelwise collective dispatch (mid-build, not at
+    the first dispatch) propagates up and the whole build retries on the
+    device tier — second attempt passes because the chaos step counter
+    advanced past the planned occurrence."""
+    X, y = _data(600, seed=1)
+    monkeypatch.setenv("MPITREE_TPU_ENGINE", "levelwise")
+    # refine_depth=None: the full depth runs on the device engine, so the
+    # build crosses the split_dispatch seam once per interior level.
+    kw = dict(max_depth=4, refine_depth=None, backend="cpu")
+    healthy = DecisionTreeClassifier(**kw).fit(X, y)
+    chaos.install([Fault("split_dispatch", 2, "unavailable")])
+    with pytest.warns(UserWarning, match="retrying on the device tier"):
+        clf = DecisionTreeClassifier(**kw).fit(X, y)
+    chaos.clear()
+    assert clf.export_text() == healthy.export_text()
+    assert clf.fit_report_["counters"]["device_retries"] == 1
+
+
+# ---------------------------------------------------------------------------
+# sharded BuildCheckpoint (satellite: O(group) appends)
+# ---------------------------------------------------------------------------
+
+def _fitted_trees(n):
+    X, y = _data(300, seed=5)
+    from mpitree_tpu import RandomForestClassifier
+
+    rf = RandomForestClassifier(
+        n_estimators=n, max_depth=3, random_state=0, backend="cpu"
+    ).fit(X, y)
+    return list(rf.trees_)
+
+
+def test_checkpoint_appends_are_per_group_shards(tmp_path):
+    """Each append writes ONE new shard; earlier shard files are never
+    rewritten (the O(groups x forest) rewrite this PR retires)."""
+    trees = _fitted_trees(6)
+    path = str(tmp_path / "ck.npz")
+    ck = BuildCheckpoint(path, "fp")
+    ck.append(trees[:2])
+    shard0 = tmp_path / "ck.npz.shard-0000.npz"
+    first_bytes = shard0.read_bytes()
+    ck.append(trees[2:4])
+    ck.append(trees[4:6])
+    assert (tmp_path / "ck.npz.shard-0001.npz").exists()
+    assert (tmp_path / "ck.npz.shard-0002.npz").exists()
+    assert shard0.read_bytes() == first_bytes, "shard 0 was rewritten"
+
+    ck3 = BuildCheckpoint(path, "fp")
+    ck3._load()
+    assert len(ck3.trees) == 6
+    np.testing.assert_array_equal(ck3.trees[5].feature, trees[5].feature)
+    # a mismatched fingerprint opens fresh (with the warning)
+    with pytest.warns(UserWarning, match="not resumable"):
+        ck2 = BuildCheckpoint.open(path, {"p": 1}, *_data(10), None)
+    assert ck2.trees == []
+
+    ck.done()
+    assert not any(tmp_path.iterdir()), "done() sweeps manifest + shards"
+
+
+def test_checkpoint_crash_between_shard_and_manifest(tmp_path):
+    """A crash after the shard write but before the manifest rename must
+    recover to the previous consistent state (the manifest is the commit
+    point)."""
+    trees = _fitted_trees(4)
+    path = str(tmp_path / "ck.npz")
+    ck = BuildCheckpoint(path, "fp")
+    ck.append(trees[:2])
+    good_manifest = (tmp_path / "ck.npz").read_bytes()
+    ck.append(trees[2:])
+    # simulate the crash window: roll the manifest back one append; the
+    # newer shard-0001 file is now an unreferenced orphan
+    (tmp_path / "ck.npz").write_bytes(good_manifest)
+    ck2 = BuildCheckpoint(path, "fp")
+    ck2._load()
+    assert len(ck2.trees) == 2
+    # resuming writer overwrites the orphan shard slot cleanly
+    ck2.append(trees[2:])
+    ck3 = BuildCheckpoint(path, "fp")
+    ck3._load()
+    assert len(ck3.trees) == 4
+
+
+def test_checkpoint_corrupt_shard_restarts_fresh(tmp_path):
+    trees = _fitted_trees(2)
+    path = str(tmp_path / "ck.npz")
+    X, y = _data(50, seed=6)
+    ck = BuildCheckpoint.open(path, {"a": 1}, X, y, None)
+    ck.append(trees)
+    (tmp_path / "ck.npz.shard-0000.npz").write_bytes(b"garbage")
+    with pytest.warns(UserWarning, match="not resumable"):
+        fresh = BuildCheckpoint.open(path, {"a": 1}, X, y, None)
+    assert fresh.trees == []
+
+
+# ---------------------------------------------------------------------------
+# boosting checkpoint-resume (tentpole acceptance + satellite tests)
+# ---------------------------------------------------------------------------
+
+GB_KW = dict(max_iter=6, max_depth=3, random_state=3, backend="cpu",
+             subsample=0.8, colsample_bytree=0.8, checkpoint_every=2)
+
+
+@pytest.mark.parametrize("kill_round", [1, 3, 5])
+def test_gbdt_resume_bit_identical(tmp_path, kill_round):
+    """ACCEPTANCE: kill a checkpointed boosting fit at round k (chaos
+    preemption), resume, and the final ensemble is bit-identical to an
+    uninterrupted fit — predict_proba AND every staged prediction."""
+    X, y = _data(500, seed=2)
+    path = str(tmp_path / "gb.ckpt")
+    ref = GradientBoostingClassifier(**GB_KW).fit(X, y)
+
+    chaos.install([Fault("round", kill_round + 1, "kill")])
+    with pytest.raises(ChaosKilled):
+        GradientBoostingClassifier(checkpoint=path, **GB_KW).fit(X, y)
+    chaos.clear()
+    if kill_round >= 2:
+        assert os.path.exists(path), "flushed rounds must survive the kill"
+
+    resumed = GradientBoostingClassifier(checkpoint=path, **GB_KW).fit(X, y)
+    assert not os.path.exists(path), "finished fit removes its checkpoint"
+    assert resumed.n_iter_ == ref.n_iter_
+    np.testing.assert_array_equal(
+        resumed.predict_proba(X), ref.predict_proba(X)
+    )
+    for a, b in zip(resumed.staged_predict_proba(X),
+                    ref.staged_predict_proba(X)):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(
+        resumed.train_score_, ref.train_score_
+    )
+    if kill_round >= 2:
+        kinds = [ev["kind"] for ev in resumed.fit_report_["events"]]
+        assert "checkpoint_resume" in kinds
+
+
+def test_gbdt_resume_early_stopping_state(tmp_path):
+    """Early stopping resumes mid-patience: held-out margins, best score,
+    and the staleness counter all restore, so the resumed fit stops at
+    the same round with the same validation curve."""
+    X, y = _data(500, seed=7)
+    kw = dict(max_iter=25, max_depth=2, random_state=5, backend="cpu",
+              early_stopping=True, validation_fraction=0.25,
+              n_iter_no_change=3, checkpoint_every=2)
+    ref = GradientBoostingClassifier(**kw).fit(X, y)
+    path = str(tmp_path / "gb-es.ckpt")
+    chaos.install([Fault("round", 5, "kill")])
+    with pytest.raises(ChaosKilled):
+        GradientBoostingClassifier(checkpoint=path, **kw).fit(X, y)
+    chaos.clear()
+    resumed = GradientBoostingClassifier(checkpoint=path, **kw).fit(X, y)
+    assert resumed.n_iter_ == ref.n_iter_
+    np.testing.assert_array_equal(
+        resumed.validation_score_, ref.validation_score_
+    )
+    np.testing.assert_array_equal(
+        resumed.predict_proba(X), ref.predict_proba(X)
+    )
+
+
+def test_gbdt_resume_at_early_stop_round_does_not_overtrain(tmp_path,
+                                                            monkeypatch):
+    """A preemption in the window between the final flush and checkpoint
+    removal leaves a checkpoint whose staleness already crossed the
+    early-stop threshold; the resumed fit must re-derive the verdict and
+    train ZERO extra rounds."""
+    from mpitree_tpu.resilience import BoostCheckpoint
+
+    X, y = _data(500, seed=12)
+    kw = dict(max_iter=25, max_depth=2, random_state=5, backend="cpu",
+              early_stopping=True, validation_fraction=0.25,
+              n_iter_no_change=3, checkpoint_every=1)
+    ref = GradientBoostingClassifier(**kw).fit(X, y)
+    assert ref.n_iter_ < 25, "workload must actually stop early"
+
+    path = str(tmp_path / "gb-window.ckpt")
+    monkeypatch.setattr(BoostCheckpoint, "done", lambda self: None)
+    GradientBoostingClassifier(checkpoint=path, **kw).fit(X, y)
+    assert os.path.exists(path), "simulated crash-before-cleanup"
+    monkeypatch.undo()
+
+    resumed = GradientBoostingClassifier(checkpoint=path, **kw).fit(X, y)
+    assert resumed.n_iter_ == ref.n_iter_, "resume must not overtrain"
+    np.testing.assert_array_equal(
+        resumed.predict_proba(X), ref.predict_proba(X)
+    )
+    np.testing.assert_array_equal(
+        resumed.validation_score_, ref.validation_score_
+    )
+
+
+def test_gbdt_checkpoint_fingerprint_guards_inputs(tmp_path):
+    """Resuming onto different data restarts instead of mixing models."""
+    X, y = _data(300, seed=8)
+    path = str(tmp_path / "gb-fp.ckpt")
+    kw = dict(max_iter=4, max_depth=2, random_state=1, backend="cpu",
+              checkpoint_every=1)
+    chaos.install([Fault("round", 3, "kill")])
+    with pytest.raises(ChaosKilled):
+        GradientBoostingClassifier(checkpoint=path, **kw).fit(X, y)
+    chaos.clear()
+    y2 = (y + 1) % 3
+    with pytest.warns(UserWarning, match="not resumable"):
+        fresh = GradientBoostingClassifier(checkpoint=path, **kw).fit(X, y2)
+    ref = GradientBoostingClassifier(**kw).fit(X, y2)
+    np.testing.assert_array_equal(
+        fresh.predict_proba(X), ref.predict_proba(X)
+    )
+
+
+def test_gbdt_checkpoint_requires_reproducible_seed(tmp_path):
+    X, y = _data(200, seed=9)
+    path = str(tmp_path / "gb-rng.ckpt")
+    with pytest.warns(UserWarning, match="reproducible"):
+        GradientBoostingClassifier(
+            max_iter=2, max_depth=2, backend="cpu", checkpoint=path,
+            random_state=np.random.default_rng(0),
+        ).fit(X, y)
+    assert not os.path.exists(path)
+
+
+def test_checkpoint_creates_parent_directory(tmp_path):
+    """An unwritable checkpoint path must fail at open() (before any
+    training work), not at the first flush after completed rounds — so
+    open() creates missing parent directories up front."""
+    X, y = _data(100, seed=13)
+    path = str(tmp_path / "not" / "yet" / "there" / "gb.ckpt")
+    est = GradientBoostingClassifier(
+        max_iter=2, max_depth=2, random_state=0, backend="cpu",
+        checkpoint=path, checkpoint_every=1,
+    ).fit(X, y)
+    assert est.n_iter_ == 2  # fit completed; dirs were created, swept
+
+
+def test_gbdt_checkpoint_every_validated():
+    X, y = _data(50)
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        GradientBoostingClassifier(checkpoint_every=0).fit(X, y)
+
+
+# ---------------------------------------------------------------------------
+# non-finite loss-channel guard (satellite)
+# ---------------------------------------------------------------------------
+
+def test_nonfinite_grad_fails_fast():
+    """Chaos-poisoned (g, h) at round 1: typed fail-fast instead of
+    silently fitting garbage rounds."""
+    X, y = _data(300, seed=10)
+    yr = (X[:, 0] * 2 + np.sin(X[:, 1])).astype(np.float64)
+    est = GradientBoostingRegressor(max_iter=4, max_depth=2, backend="cpu")
+    chaos.install([Fault("grad_hess", 2, "nan")])
+    with pytest.raises(FloatingPointError, match="round 1") as ei:
+        est.fit(X, yr)
+    chaos.clear()
+    assert "learning_rate" in str(ei.value)  # actionable, not just fatal
+    # the typed event survives the abort for postmortem
+    assert "nonfinite_grad" in [
+        ev["kind"] for ev in est.fit_report_["events"]
+    ]
+
+
+def test_nonfinite_grad_multiclass_round_zero():
+    """Same guard on the softmax channel, firing on the very first round
+    (a poisoned input would die before any garbage tree is fitted)."""
+    X, y = _data(300, seed=11)
+    chaos.install([Fault("grad_hess", 1, "nan")])
+    with pytest.raises(FloatingPointError, match="round 0"):
+        GradientBoostingClassifier(
+            max_iter=3, max_depth=2, backend="cpu"
+        ).fit(X, y)
+    chaos.clear()

@@ -88,6 +88,93 @@ def test_truncated_line_does_not_discard_history(watcher, tmp_path):
     assert watcher.section_done("north_star", str(p))
 
 
+def test_derived_budget_from_observed_durations(watcher, tmp_path):
+    """rc=-15 triage: budgets come from observed capture durations (max
+    across lines, headroom + slack, clamped), not one flat timeout."""
+    p = _write(tmp_path, [
+        {"ts": "t1", **FULL,
+         "north_star": {"cold_s": 93.2, "warm_s": 20.5, "test_acc": 0.74,
+                        # rate keys also end in _s and must NOT count as
+                        # durations (they would clamp every budget to max)
+                        "throughput_cells_per_s": 7.2e7,
+                        "predict_rows_per_s": 1.1e6}},
+        {"ts": "t2", **FULL, "north_star": {"cold_s": 60.0, "warm_s": 19.0}},
+    ])
+    budget, why = watcher.derive_budget("north_star", p)
+    observed = 93.2 + 20.5  # max across lines, all *_s fields summed
+    expected = int(watcher.BUDGET_HEADROOM * observed + watcher.BUDGET_SLACK_S)
+    assert budget == max(expected, watcher.BUDGET_MIN_S)
+    assert "derived from observed" in why
+
+
+def test_derived_budget_sums_nested_durations(watcher, tmp_path):
+    """Sections nest real wall (refine_sweep entirely under sweep[],
+    north_star's A/B off-fit under subtraction_ab); breakdown subtrees
+    (phases, record digests) must not double-count."""
+    p = _write(tmp_path, [
+        {"ts": "t1", **FULL,
+         "refine_sweep": {"sweep": [
+             {"refine_depth": 7, "warm_s": 30.0,
+              "record": {"wall_s": 29.0}},
+             {"refine_depth": 8, "warm_s": 50.0,
+              "record": {"wall_s": 49.0}},
+         ]},
+         "north_star": {
+             "cold_s": 80.0, "warm_s": 20.0,
+             "phases": {"split": {"seconds": 12.9}},
+             "subtraction_ab": {
+                 "off": {"cold_s": 40.0, "warm_s": 20.0,
+                         "phases": {}, "record": {"wall_s": 19.0}},
+             },
+         }},
+    ])
+    b_sweep, why = watcher.derive_budget("refine_sweep", p)
+    assert "derived from observed 80s" in why  # 30 + 50, records excluded
+    expected = int(watcher.BUDGET_HEADROOM * 80.0 + watcher.BUDGET_SLACK_S)
+    assert b_sweep == max(expected, watcher.BUDGET_MIN_S)
+    _, why_ns = watcher.derive_budget("north_star", p)
+    assert "derived from observed 160s" in why_ns  # 80+20 + off 40+20
+
+
+def test_derived_budget_fallback_and_clamps(watcher, tmp_path):
+    # never captured -> static table entry (or the 1200s default)
+    p = _write(tmp_path, [{"ts": "t1", **FULL,
+                           "north_star": {"warm_s": 20.5}}])
+    budget, why = watcher.derive_budget("hist_tput", p)
+    assert budget == watcher.BUDGET["hist_tput"]
+    assert "static table" in why
+    assert watcher.derive_budget("nonexistent_section", p)[0] == 1200
+    # a missing file falls back too (never crashes the watcher loop)
+    missing = str(tmp_path / "nope.jsonl")
+    assert watcher.derive_budget("north_star", missing)[0] == \
+        watcher.BUDGET["north_star"]
+    # tiny observed durations clamp to the floor; huge ones to the cap
+    p2 = _write(tmp_path, [
+        {"ts": "t1", **FULL, "north_star": {"warm_s": 2.0},
+         "forest": {"cold_s": 9000.0}},
+    ])
+    assert watcher.derive_budget("north_star", p2)[0] == watcher.BUDGET_MIN_S
+    assert watcher.derive_budget("forest", p2)[0] == watcher.BUDGET_MAX_S
+
+
+def test_derived_budget_ignores_smoke_lines(watcher, tmp_path):
+    """--rows smoke captures are fast by construction; deriving a budget
+    from one would starve the full-workload run."""
+    smoke = dict(FULL, dataset="covtype_like (100000x54)", rows_cap=100000)
+    p = _write(tmp_path, [{"ts": "t1", **smoke,
+                           "north_star": {"cold_s": 4.0, "warm_s": 1.0}}])
+    budget, why = watcher.derive_budget("north_star", p)
+    assert budget == watcher.BUDGET["north_star"]
+    assert "static table" in why
+
+
+def test_tail_lines_reads_partial_output(watcher, tmp_path):
+    out = tmp_path / "sec.out"
+    out.write_text("line1\n\nline2\nline3\n")
+    assert watcher.tail_lines(str(out), 2) == ["line2", "line3"]
+    assert watcher.tail_lines(str(tmp_path / "missing.out"), 3) == []
+
+
 def test_build_todo_priority_order_with_redo(watcher, tmp_path):
     """--sections order is the capture priority: captured sections drop
     unless named in --redo (keeping their position); redo-only names

@@ -39,9 +39,15 @@ PROBE_SRC = (
     "print('PROBE_OK', d[0].device_kind)"
 )
 
-# Per-section wall budgets (s). engine_levelwise is dispatch-bound on the
-# tunnel (2-4 round trips x 20 levels + per-tier compiles); refine_sweep is
-# 4 configs x (cold + warm) fits.
+# STATIC per-section wall budgets (s) — the fallback for sections that
+# have never landed a capture. Once BENCH_TPU.jsonl carries a genuine
+# line for a section, derive_budget() supersedes this table with a budget
+# computed from the observed duration (the rc=-15 triage: one flat
+# SECTION_TIMEOUT_S both starved the compile-heavy sections and wasted
+# whole healthy windows waiting on hung cheap ones).
+# engine_levelwise is dispatch-bound on the tunnel (2-4 round trips x 20
+# levels + per-tier compiles); refine_sweep is 4 configs x (cold + warm)
+# fits.
 BUDGET = {
     "engine_levelwise": 1500,
     # 20 rounds x 7 softmax trees of levelwise gbdt dispatch on the tunnel.
@@ -58,6 +64,52 @@ BUDGET = {
     "engine_fused": 900,
     "predict": 900,
 }
+
+
+# Derived-budget envelope: observed in-section seconds miss subprocess
+# overhead (interpreter + data load + recompiles after code changes), so
+# scale generously and add slack; clamp so a one-off outlier capture can
+# neither starve a section nor let one hang eat a whole healthy window.
+BUDGET_HEADROOM = 2.5
+BUDGET_SLACK_S = 180
+BUDGET_MIN_S = 420
+BUDGET_MAX_S = 3600
+
+
+def derive_budget(sec: str, path: str = JSONL) -> tuple[int, str]:
+    """(budget_s, why): evidence-derived per-section budget.
+
+    Uses the max observed in-section wall from genuine BENCH_TPU.jsonl
+    captures (bench_tpu.observed_section_seconds — the one copy of the
+    line predicate) scaled by HEADROOM + SLACK; falls back to the static
+    BUDGET table for never-captured sections. The ``why`` string lands in
+    the committed log so every timeout verdict carries its budget's
+    provenance.
+    """
+    static = BUDGET.get(sec, 1200)
+    try:
+        from bench_tpu import observed_section_seconds
+
+        observed = observed_section_seconds(sec, path)
+    except Exception as e:  # noqa: BLE001 — a broken jsonl must not stop
+        return static, f"static table ({type(e).__name__} reading captures)"
+    if not observed:
+        return static, "static table (no capture yet)"
+    derived = int(
+        min(max(BUDGET_HEADROOM * observed + BUDGET_SLACK_S, BUDGET_MIN_S),
+            BUDGET_MAX_S)
+    )
+    return derived, f"derived from observed {observed:.0f}s"
+
+
+def tail_lines(path: str, n: int) -> list:
+    """Last n non-empty lines of a (possibly still-growing) text file."""
+    try:
+        with open(path, errors="replace") as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+        return lines[-n:]
+    except OSError:
+        return []
 
 
 def log(msg: str) -> None:
@@ -125,45 +177,53 @@ def build_todo(sections: str, redo: str, path: str = JSONL) -> list:
 
 
 def run_section(sec: str) -> bool:
-    budget = BUDGET.get(sec, 1200)
+    budget, why = derive_budget(sec)
     before = capture_count(sec)
-    log(f"run {sec} (budget {budget}s)")
+    log(f"run {sec} (budget {budget}s, {why})")
     open(FLAG, "w").close()
+    outpath = f"/tmp/tpu_watcher_{sec}.out"
     try:
-        # Own process group: on parent timeout the section-worker GRANDCHILD
-        # must die too, or an orphan keeps holding the flaky TPU while the
-        # next section starts (device contention on exactly the tunnel this
-        # tool babysits).
-        proc = subprocess.Popen(
-            [sys.executable, os.path.join(REPO, "bench_tpu.py"),
-             "--sections", sec, "--timeout", str(budget),
-             "--platform", "tpu"],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            cwd=REPO, start_new_session=True,
-        )
-        try:
-            out, _ = proc.communicate(timeout=budget + 300)
-            tail = (out or "").strip().splitlines()[-3:]
-            log(f"{sec}: rc={proc.returncode} | " + " / ".join(tail))
-        except subprocess.TimeoutExpired:
-            log(f"{sec}: parent timeout (budget {budget}+300s) — "
-                f"killing process group")
+        # Child stdout goes to a FILE, not a pipe: a hung child cannot
+        # deadlock on a full pipe buffer, and — the rc=-15 diagnosability
+        # fix — the parent can read everything the section printed BEFORE
+        # deciding to kill it, so a timeout verdict in the committed log
+        # always says where the section died.
+        # Own process group: on parent timeout the section-worker
+        # GRANDCHILD must die too, or an orphan keeps holding the flaky
+        # TPU while the next section starts (device contention on exactly
+        # the tunnel this tool babysits).
+        with open(outpath, "w") as outf:
+            proc = subprocess.Popen(
+                [sys.executable, os.path.join(REPO, "bench_tpu.py"),
+                 "--sections", sec, "--timeout", str(budget),
+                 "--platform", "tpu"],
+                stdout=outf, stderr=subprocess.STDOUT, text=True,
+                cwd=REPO, start_new_session=True,
+            )
+            t0 = time.time()
             try:
-                os.killpg(proc.pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                pass
-            # Drain what the child managed to print before the hang — the
-            # committed log is the evidence of WHERE sections die. A child
-            # stuck in uninterruptible device I/O can survive SIGKILL for a
-            # while; never let that crash the watcher itself.
-            try:
-                out, _ = proc.communicate(timeout=30)
-                tail = (out or "").strip().splitlines()[-3:]
-                if tail:
-                    log(f"{sec}: output before hang | " + " / ".join(tail))
-            except (subprocess.TimeoutExpired, OSError, ValueError):
-                log(f"{sec}: child unreaped after SIGKILL "
-                    f"(uninterruptible device I/O?) — moving on")
+                proc.wait(timeout=budget + 300)
+                tail = tail_lines(outpath, 3)
+                log(f"{sec}: rc={proc.returncode} | " + " / ".join(tail))
+            except subprocess.TimeoutExpired:
+                # Partial-section progress BEFORE the kill — the evidence
+                # of WHERE the section died and how far it got.
+                partial = tail_lines(outpath, 6)
+                log(f"{sec}: parent timeout after {time.time() - t0:.0f}s "
+                    f"(budget {budget}+300s); progress before kill | "
+                    + (" / ".join(partial) if partial else "<no output>"))
+                log(f"{sec}: killing process group")
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                # A child stuck in uninterruptible device I/O can survive
+                # SIGKILL for a while; never let that crash the watcher.
+                try:
+                    proc.wait(timeout=30)
+                except (subprocess.TimeoutExpired, OSError, ValueError):
+                    log(f"{sec}: child unreaped after SIGKILL "
+                        f"(uninterruptible device I/O?) — moving on")
     finally:
         try:
             os.remove(FLAG)
