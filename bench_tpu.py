@@ -84,7 +84,7 @@ def _load(npz_path: str):
     return data["Xtr"], data["ytr"], data["Xte"], data["yte"]
 
 
-def enable_compile_cache() -> str:
+def enable_compile_cache(platform: str | None = None) -> str | None:
     """Point JAX at a persistent on-disk compilation cache and return its path.
 
     Cold bench runs previously paid 25-70 s of XLA compilation *per process*
@@ -94,8 +94,29 @@ def enable_compile_cache() -> str:
     reuses the serialized executables and cold_s approaches warm_s. Must run
     before the first jax operation (config is read at backend init).
     ``MPITREE_TPU_COMPILE_CACHE`` overrides the location; gitignored.
+
+    NOT enabled for CPU workers on legacy (pre-shard_map) wheels: there a
+    cache-DESERIALIZED executable mishandles input-output aliasing — any
+    donating program (the level loop's ``update_fn(nid, ...)``, serving's
+    accumulator traversal) returns garbage through the donated buffer.
+    Measured on this container's 0.4.37 wheel: a cold-cache gbdt fit is
+    correct, the identical warm-cache rerun yields out-of-range leaf ids
+    (PR-7 triage; accelerator workers keep the cache — every prior TPU
+    capture's accuracy checks pass warm).
     """
     import jax
+
+    from mpitree_tpu import _compat
+
+    if platform is None:
+        # Callers that don't know their platform (bench.py's workers):
+        # read the sitecustomize pin without initializing a backend —
+        # tunnel containers pin "axon" (cache stays, it's the whole
+        # point); an unset pin on a legacy wheel means the worker will
+        # land on CPU, where the cache is poison.
+        platform = jax.config.jax_platforms or None
+    if _compat.LEGACY_JAX and platform not in ("tpu", "axon"):
+        return None
 
     path = os.environ.get(
         "MPITREE_TPU_COMPILE_CACHE", os.path.join(_HERE, ".jax_cache")
@@ -730,6 +751,97 @@ def worker_boosting(npz_path: str) -> dict:
     return out
 
 
+def worker_serving(npz_path: str) -> dict:
+    """The compiled-serving section (mpitree_tpu.serving, ISSUE 7).
+
+    Publishes a ~500-tree GBDT (72 softmax rounds x 7 covtype classes at
+    a bounded fit-row cap — the section measures PREDICT; the fit is
+    setup) into a bucket-warmed registry, then reports the request-path
+    numbers ROADMAP item 1 asked for: p50/p99 latency at batch sizes
+    1/64/4096, sustained rows/s on a large tiled batch, and the speedup
+    over the estimator predict path (stacked descent + host-side value
+    application) on the same model.
+    """
+    from mpitree_tpu import GradientBoostingClassifier
+    from mpitree_tpu.obs import REGISTRY
+    from mpitree_tpu.serving import ModelRegistry
+
+    Xtr, ytr, Xte, yte = _load(npz_path)
+    platform = _device_platform()
+    fit_rows = min(len(Xtr), 40_000)
+    # x n_classes trees — ~500 for covtype's 7 classes on the full
+    # workload; --rows smoke captures (the CPU evidence runs) fit a
+    # >=100-tree ensemble instead so the section bounds on laptop CPUs.
+    rounds = 72 if len(Xtr) > 100_000 else 18
+    t0 = time.perf_counter()
+    clf = GradientBoostingClassifier(
+        max_iter=rounds, max_depth=4, max_bins=256, backend=platform,
+        random_state=0,
+    ).fit(Xtr[:fit_rows], ytr[:fit_rows])
+    fit_s = time.perf_counter() - t0
+    out: dict = {
+        "platform": platform,
+        "n_trees": len(clf.trees_),
+        "fit_rows": fit_rows,
+        "fit_s": round(fit_s, 3),
+        "record": record_digest(clf.fit_report_),
+    }
+
+    # Estimator path first (it would warm the serving leaf-id table
+    # anyway): stacked descent + host value application.
+    reps = max(1, 500_000 // len(Xte))
+    Xbig = np.tile(Xte, (reps, 1))
+    clf.predict(Xbig)
+    t0 = time.perf_counter()
+    clf.predict(Xbig)
+    est_rows = len(Xbig) / (time.perf_counter() - t0)
+    out["estimator_rows_per_s"] = round(est_rows)
+
+    reg = ModelRegistry()
+    t0 = time.perf_counter()
+    model = reg.publish("bench", clf)
+    out["publish_warm_s"] = round(time.perf_counter() - t0, 3)
+    out["serving_exact"] = bool(model.exact)
+    out["kernel"] = "pallas" if model._use_kernel else "xla"
+
+    lowerings0 = REGISTRY.count("serving_traverse")
+    rng = np.random.default_rng(0)
+    for bucket, req in ((1, 300), (64, 150), (4096, 30)):
+        lat = []
+        served = 0
+        for _ in range(req):
+            lo = int(rng.integers(0, max(len(Xte) - bucket, 1)))
+            batch = Xte[lo:lo + bucket]
+            t0 = time.perf_counter()
+            reg.predict("bench", batch)
+            lat.append(time.perf_counter() - t0)
+            # Rows ACTUALLY served: on --rows smoke captures the test
+            # split can be smaller than the 4096 bucket — crediting the
+            # bucket width would inflate the throughput number.
+            served += len(batch)
+        lat.sort()
+        out[f"b{bucket}_p50_ms"] = round(lat[len(lat) // 2] * 1e3, 3)
+        out[f"b{bucket}_p99_ms"] = round(
+            lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3, 3
+        )
+        out[f"b{bucket}_rows_per_s"] = round(served / sum(lat))
+    t0 = time.perf_counter()
+    reg.predict("bench", Xbig)
+    sus = len(Xbig) / (time.perf_counter() - t0)
+    out["sustained_rows_per_s"] = round(sus)
+    out["rows_sustained"] = len(Xbig)
+    out["speedup_vs_estimator"] = round(sus / max(est_rows, 1e-9), 2)
+    # The registry swap contract: zero new traversal lowerings on the
+    # request path after the bucket warmup.
+    out["request_path_lowerings"] = (
+        REGISTRY.count("serving_traverse") - lowerings0
+    )
+    out["test_acc"] = round(
+        float((reg.predict("bench", Xte) == yte).mean()), 4
+    )
+    return out
+
+
 def worker_forest(npz_path: str) -> dict:
     """BASELINE configs[4] on the live platform (core shared with bench.py:
     one-program tree-sharded forest vs T sequential fused builds)."""
@@ -757,6 +869,7 @@ WORKERS = {
     "forest": worker_forest,
     "predict": worker_predict,
     "boosting": worker_boosting,
+    "serving": worker_serving,
 }
 
 
@@ -924,6 +1037,31 @@ def latest_line(path: str = OUT_PATH, *, full_only: bool = False) -> dict | None
     return merged
 
 
+def serving_headline(path: str = OUT_PATH) -> str | None:
+    """One-line serving summary from the newest captured serving section —
+    the bench headline `record` consumer (ROADMAP carried follow-up):
+    p50/p99 per bucket, sustained rows/s, speedup over the estimator
+    path, kernel tier, and the request-path compile count. Pure string
+    work over stored payloads (no jax import) so the watcher can log it."""
+    for rec in reversed(read_capture_lines(path)):
+        s = rec.get("serving")
+        if not isinstance(s, dict):
+            continue
+        buckets = " ".join(
+            f"b{b}: p50={s.get(f'b{b}_p50_ms')}ms "
+            f"p99={s.get(f'b{b}_p99_ms')}ms"
+            for b in (1, 64, 4096) if f"b{b}_p50_ms" in s
+        )
+        return (
+            f"serving[{s.get('platform')}] {s.get('n_trees')} trees "
+            f"{buckets} sustained={s.get('sustained_rows_per_s')} rows/s "
+            f"({s.get('speedup_vs_estimator')}x vs estimator) "
+            f"kernel={s.get('kernel')} "
+            f"request_compiles={s.get('request_path_lowerings')}"
+        )
+    return None
+
+
 def print_report(path: str = OUT_PATH) -> int:
     """`make report`: pretty-print the newest capture line with its
     embedded record digests — the artifact-side view of fit_report_."""
@@ -945,6 +1083,9 @@ def print_report(path: str = OUT_PATH) -> int:
         print(f"\n[{sec}] " + json.dumps(keys))
         if isinstance(payload.get("record"), dict):
             print("  record | " + format_record_digest(payload["record"]))
+    head_line = serving_headline(path)
+    if head_line:
+        print("\n" + head_line)
     if rec.get("errors"):
         print("\nerrors: " + json.dumps(rec["errors"]))
     return 0
@@ -963,7 +1104,7 @@ def main() -> int:
     # the most evidence per second come first (hist_tput -> north_star ->
     # engine_fused -> boosting -> the rest).
     p.add_argument("--sections", default="hist_tput,north_star,"
-                   "engine_fused,boosting,engine_levelwise,forest")
+                   "engine_fused,boosting,serving,engine_levelwise,forest")
     p.add_argument("--timeout", type=int, default=SECTION_TIMEOUT_S)
     p.add_argument("--platform", default="auto",
                    help="jax platform for every section (auto = probe, "
@@ -1048,7 +1189,7 @@ def main() -> int:
 if __name__ == "__main__":
     if len(sys.argv) >= 4 and sys.argv[1] == "--section-worker":
         os.environ["MPITREE_TPU_PROFILE"] = "1"
-        enable_compile_cache()
+        enable_compile_cache(sys.argv[4] if len(sys.argv) >= 5 else None)
         if len(sys.argv) >= 5:
             _pin_platform(sys.argv[4])
         result = WORKERS[sys.argv[2]](sys.argv[3])

@@ -57,6 +57,7 @@ from mpitree_tpu.ops.sampling import (
 )
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.resilience import BoostCheckpoint, chaos, retry_device
+from mpitree_tpu.serving.tables import note_serving
 from mpitree_tpu.utils.validation import (
     feature_names_of,
     resolve_min_samples_leaf,
@@ -517,6 +518,9 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
         )
         self._loss_obj = loss
         self.fit_stats_ = obs.summary() if obs.enabled else None
+        # Serving-table notes (mpitree_tpu.serving): the flat-table plan
+        # the compiled inference path will serve this ensemble from.
+        note_serving(obs, self.trees_)
         # Always-on structured run record (mpitree_tpu.obs): per-round
         # rows, engine decision, compile/collective accounting.
         self.fit_report_ = obs.report(trees=self.trees_)

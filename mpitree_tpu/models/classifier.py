@@ -43,6 +43,7 @@ from mpitree_tpu.ops.predict import (
 )
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.resilience import device_failover
+from mpitree_tpu.serving.tables import note_serving
 from mpitree_tpu.utils.export import export_tree_text
 from mpitree_tpu.utils.importances import feature_importances
 from mpitree_tpu.utils.validation import (
@@ -306,6 +307,10 @@ class DecisionTreeClassifier(ClassifierMixin, ReportMixin, BaseEstimator):
 
             clip_tree_values(self.tree_, mono, "classification")
         self.fit_stats_ = timer.summary() if timer.enabled else None
+        # Serving-table notes (mpitree_tpu.serving): what the compiled
+        # inference path will flatten this tree into — true descent depth,
+        # node count — so the fit record carries the predict-side plan.
+        note_serving(obs, [self.tree_])
         # Always-on structured run record (mpitree_tpu.obs): engine
         # decision + reason, counters, compile/collective accounting,
         # typed events; spans/per-level rows under MPITREE_TPU_PROFILE=1.

@@ -51,6 +51,7 @@ from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.sampling import NodeFeatureSampler, n_subspace_features
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.resilience import ForestCheckpoint, device_failover
+from mpitree_tpu.serving.tables import note_serving
 from mpitree_tpu.utils.validation import (
     apply_class_weight,
     feature_names_of,
@@ -672,6 +673,9 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
         obs = self._fit_obs
         del self._fit_obs
         self.fit_stats_ = obs.summary() if obs.enabled else None
+        # Serving-table notes (mpitree_tpu.serving): the flat-table plan
+        # the compiled inference path will serve this forest from.
+        note_serving(obs, self.trees_)
         # Ensemble run record: aggregates per-tree child summaries plus the
         # shared phases/counters/collectives (mpitree_tpu.obs).
         self.fit_report_ = obs.report(trees=self.trees_)
@@ -780,6 +784,7 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
         obs = self._fit_obs
         del self._fit_obs
         self.fit_stats_ = obs.summary() if obs.enabled else None
+        note_serving(obs, self.trees_)
         self.fit_report_ = obs.report(trees=self.trees_)
         return self
 

@@ -36,6 +36,7 @@ from mpitree_tpu.ops.predict import (
 )
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.resilience import device_failover
+from mpitree_tpu.serving.tables import note_serving
 from mpitree_tpu.utils.export import export_tree_text
 from mpitree_tpu.utils.importances import feature_importances
 from mpitree_tpu.utils.validation import (
@@ -220,7 +221,9 @@ class DecisionTreeRegressor(RegressorMixin, ReportMixin, BaseEstimator):
 
             clip_tree_values(self.tree_, mono, "regression")
         self.fit_stats_ = timer.summary() if timer.enabled else None
-        # Always-on structured run record (mpitree_tpu.obs).
+        # Serving-table notes (mpitree_tpu.serving) + the always-on
+        # structured run record (mpitree_tpu.obs).
+        note_serving(obs, [self.tree_])
         self.fit_report_ = obs.report(tree=self.tree_)
         return self
 

@@ -17,6 +17,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from mpitree_tpu.parallel import mesh as mesh_lib
+from mpitree_tpu.parallel.mesh import DATA_AXIS
 
 
 class WeakIdCache:
@@ -106,8 +111,6 @@ def predict_mesh(estimator):
     if nd in (None, 1):
         return None
     try:
-        from mpitree_tpu.parallel import mesh as mesh_lib
-
         mesh = mesh_lib.resolve_mesh(
             backend=getattr(estimator, "backend", None), n_devices=nd
         )
@@ -145,12 +148,6 @@ def shard_rows(X, mesh):
     results back to ``n``). The one copy of the pad-and-place recipe —
     single-tree inference and the forests' stacked descent both use it.
     """
-    import numpy as np
-    from jax.sharding import NamedSharding
-    from jax.sharding import PartitionSpec as P
-
-    from mpitree_tpu.parallel.mesh import DATA_AXIS
-
     Xh = np.asarray(X)
     n = Xh.shape[0]
     shards = int(dict(mesh.shape).get(DATA_AXIS, 1))
@@ -162,60 +159,52 @@ def shard_rows(X, mesh):
     return jax.device_put(Xh, NamedSharding(mesh, P(DATA_AXIS))), n
 
 
-_stacked_trees_cache = WeakIdCache()
-
-# Device-memory ceiling for one stacked descent group (4 arrays x int32).
+# Device-memory ceiling for one ensemble descent group — kept as the
+# public knob name; the flat serving tables (mpitree_tpu.serving.tables)
+# now enforce it on a padding-free layout.
 STACKED_GROUP_BYTES = 256 << 20
 
 
 def stacked_leaf_ids(trees, X, *, mesh=None,
                      group_bytes: int = STACKED_GROUP_BYTES) -> np.ndarray:
-    """(T, N) leaf ids for an ensemble: vmapped descent over a stacked
-    (tree, node) axis instead of a per-tree Python loop (whose per-tree
-    array shapes would also force one compile per tree).
+    """(T, N) per-tree leaf ids for an ensemble — ONE traversal dispatch
+    over the cached depth-packed serving table.
 
-    The ONE ensemble-inference path — bagged forests and boosting both ride
-    it. Stacked arrays are cached host-side per ensemble object (weak-ref
-    anchored, so loaded and freshly fitted ensembles behave alike) and
-    shipped in groups capped at ``group_bytes``, so ensembles of deep trees
-    cannot pin gigabytes of accelerator memory. ``mesh``: optional
-    multi-device mesh — query rows shard over its data axis with the
-    stacked tree arrays replicated (GSPMD partitions the vmapped descent).
+    The ONE ensemble-inference path — bagged forests and boosting both
+    ride it. Since ISSUE 7 it descends the flat serving node table
+    (``serving.tables``): no per-tree vmap axis, no ``(T, max_nodes)``
+    padding, descent steps bound by the ensemble's TRUE depth, and —
+    unlike the old per-call ``jax.device_put(a[sl])`` group uploads — the
+    device-resident arrays are cached in the same weak-ref entry as the
+    host table, so a warm predict transfers only the query batch.
+    Ensembles whose tables exceed ``group_bytes`` split into multiple
+    tables (one dispatch each), so deep forests cannot pin gigabytes of
+    accelerator memory. ``mesh``: optional multi-device mesh — query rows
+    shard over its data axis with the table replicated (GSPMD partitions
+    the gather descent).
     """
-    def build_stacked():
-        T = len(trees)
-        M = max(t.n_nodes for t in trees)
-        feat = np.full((T, M), -1, np.int32)
-        thr = np.full((T, M), np.nan, np.float32)
-        left = np.full((T, M), -1, np.int32)
-        right = np.full((T, M), -1, np.int32)
-        for i, t in enumerate(trees):
-            feat[i, : t.n_nodes] = t.feature
-            thr[i, : t.n_nodes] = t.threshold
-            left[i, : t.n_nodes] = t.left
-            right[i, : t.n_nodes] = t.right
-        depth = max(max(t.max_depth for t in trees), 1)
-        return (feat, thr, left, right), depth
+    # Lazy import: serving.tables imports this module's WeakIdCache.
+    from mpitree_tpu.serving.tables import tables_for
+    from mpitree_tpu.serving.traversal import flat_leaf_ids
 
-    (feat, thr, left, right), depth = _stacked_trees_cache.get_or_build(
-        trees, build_stacked
-    )
-    T, M = feat.shape
-    group = max(1, min(T, group_bytes // max(16 * M, 1)))
+    tables = tables_for(trees, group_bytes=group_bytes)
     if mesh is not None:
         X_d, n = shard_rows(X, mesh)
     else:
         X_d = X if isinstance(X, jax.Array) else jax.device_put(X)
         n = X.shape[0]
-    ids = np.empty((T, n), np.int32)
-    for g0 in range(0, T, group):
-        sl = slice(g0, min(g0 + group, T))
-        parts = tuple(jax.device_put(a[sl]) for a in (feat, thr, left, right))
-        # descend directly: predict_leaf_ids' mesh/device_put routing is
-        # host logic that must not run under the vmap trace
-        ids[sl] = np.asarray(jax.vmap(
-            lambda f, th, l, r: descend(
-                X_d, f, th, l, r, n_steps=max(depth, 1)
-            )
-        )(*parts))[:, :n]
+    ids = np.empty((len(trees), n), np.int32)
+    t0 = 0
+    for tb in tables:
+        # Single-table ensembles cache their device copy (warm predicts
+        # transfer only X); a multi-table split uploads transiently so
+        # peak device residency stays bounded by ONE group.
+        feat, thr, left, right, root, orig = tb.dev_arrays(
+            cache=len(tables) == 1
+        )
+        rel = flat_leaf_ids(
+            X_d, feat, thr, left, right, root, orig, n_steps=tb.n_steps
+        )
+        ids[t0:t0 + tb.n_trees] = np.asarray(rel).T[:, :n]
+        t0 += tb.n_trees
     return ids

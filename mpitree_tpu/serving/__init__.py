@@ -1,0 +1,47 @@
+"""mpitree_tpu.serving — compiled batched inference (ISSUE 7, ROADMAP 1).
+
+Everything before this subsystem optimized ``fit``; a system serving
+millions of users lives or dies on ``predict``. The serving stack:
+
+- **tables** — fitted trees/ensembles flatten into depth-packed
+  structure-of-arrays node tables (one flat id space, level slabs,
+  true-depth step counts) with cached device residency;
+- **traversal** — ONE jitted gather program per (model, batch-bucket):
+  descent unrolled to the table's true depth, leaf-value application
+  fused in, ensemble aggregation bit-identical to the estimators' host
+  float64 semantics on CPU backends;
+- **pallas_serve** — optional Mosaic tier keeping small/medium tables
+  VMEM-resident (``MPITREE_TPU_SERVING_KERNEL``, graceful typed-event
+  fallback);
+- **model** — :func:`compile_model` / :class:`CompiledModel`: the
+  estimator-equivalent predict surface plus ``serve_report_``;
+- **registry** — named slots with bucket-warmed publish, so swapping a
+  freshly trained model never compiles on the request path;
+- **staging** — donated double-buffered input staging for streaming.
+
+The estimators' own ensemble predicts ride the same tables:
+``ops/predict.stacked_leaf_ids`` descends the cached flat table in one
+dispatch and leaves the exact host-side value application untouched.
+"""
+
+from mpitree_tpu.serving.model import (
+    DEFAULT_BUCKETS,
+    CompiledModel,
+    compile_model,
+)
+from mpitree_tpu.serving.pallas_serve import resolve_serving_kernel
+from mpitree_tpu.serving.registry import ModelRegistry
+from mpitree_tpu.serving.staging import StreamStage
+from mpitree_tpu.serving.tables import NodeTable, note_serving, tables_for
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "CompiledModel",
+    "ModelRegistry",
+    "NodeTable",
+    "StreamStage",
+    "compile_model",
+    "note_serving",
+    "resolve_serving_kernel",
+    "tables_for",
+]

@@ -1,0 +1,424 @@
+"""CompiledModel — a fitted estimator flattened for the request path.
+
+``compile_model(estimator)`` turns any fitted mpitree_tpu estimator
+(single trees, forests/ExtraTrees, GradientBoosting*) into a serving
+handle whose predict surface is ONE jitted traversal dispatch per
+(model, batch-bucket):
+
+- the depth-packed node table and every leaf-value channel are device-
+  resident from compile time (``serving.tables``) — the request path
+  transfers nothing but the query batch;
+- leaf-value application is fused into the traversal
+  (``serving.traversal``): margins, probabilities, and values come back
+  as one (N, K) device result, with the estimators' host-side float64
+  sequential aggregation reproduced bit-for-bit on CPU backends (the
+  parity contract ``tests/test_serving.py`` pins);
+- batches ride shape BUCKETS (default 1/64/4096): a request pads to the
+  smallest covering bucket, oversize batches chunk at the largest — so a
+  warmed model never compiles on the request path, whatever sizes
+  arrive;
+- dispatches run through the resilience retry rung
+  (``resilience.retry_device``) with a dedicated ``serving_dispatch``
+  chaos seam, and every compile note / fallback / retry lands in the
+  model's own ``serve_report_`` (the ``fit_report_`` analogue for the
+  serving side).
+
+The optional Mosaic tier (``serving.pallas_serve``) engages by the
+``resolve_serving_kernel`` policy — VMEM-resident tables on real TPUs,
+graceful XLA fallback (typed event) everywhere else.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+import jax
+
+from mpitree_tpu.obs import BuildObserver
+from mpitree_tpu.resilience import chaos, retry_device
+from mpitree_tpu.serving import pallas_serve, traversal
+from mpitree_tpu.serving.tables import table_notes, tables_for
+
+DEFAULT_BUCKETS = (1, 64, 4096)
+
+
+def _pad_rows(X: np.ndarray, b: int) -> np.ndarray:
+    """Zero-pad ``X`` up to ``b`` rows (identity at the exact bucket)."""
+    k = X.shape[0]
+    if k == b:
+        return X
+    return np.concatenate([X, np.zeros((b - k, X.shape[1]), np.float32)])
+
+
+def _channel(trees, per_tree, table, dtype) -> np.ndarray:
+    """Concatenate a per-tree leaf channel and depth-pack it."""
+    flat = np.concatenate(
+        [np.asarray(per_tree(t)).reshape(t.n_nodes, -1) for t in trees],
+        axis=0,
+    )
+    return np.ascontiguousarray(flat[table.scatter_order()], dtype=dtype)
+
+
+class CompiledModel:
+    """One published model: flat table + fused traversal + buckets."""
+
+    def __init__(self, trees, *, kind, n_features, n_out, values_fn,
+                 classes=None, loss=None, scale=1.0, baseline=None,
+                 buckets=DEFAULT_BUCKETS, value_dtype=None,
+                 channel_salt=""):
+        self._state_lock = threading.Lock()
+        self._obs = BuildObserver()
+        self.trees = list(trees)
+        self.kind = kind
+        self.n_features = int(n_features)
+        self.n_out = int(n_out)
+        self.classes = classes
+        self._loss = loss
+        self._values_fn = values_fn
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        platform = jax.devices()[0].platform
+        # CPU backends aggregate in f64 under a scoped enable_x64 — the
+        # bit-identical twin of the estimators' host accumulation.
+        # Accelerators have no f64 unit: channels ride f32 there (the
+        # documented serving divergence; ids and argmaxes still agree).
+        # Integer channels (single-tree label/count gathers) involve no
+        # float aggregation at all — bit-exact on every platform.
+        self._int_channel = (
+            value_dtype is not None and np.dtype(value_dtype).kind in "iu"
+        )
+        self.exact = self._int_channel or (
+            platform == "cpu" and value_dtype is None
+        )
+        dtype = (value_dtype if value_dtype is not None
+                 else (np.float64 if platform == "cpu" else np.float32))
+        self._x64 = np.dtype(dtype) == np.float64
+
+        # Key the table cache on the CALLER's container (the estimator's
+        # ``trees_`` anchor), so the fused path and the estimators'
+        # leaf-id path share one weak-ref cache entry.
+        [self.table] = tables_for(trees, group_bytes=None)
+        # The salt carries any estimator hyperparameter BAKED INTO the
+        # channel contents (the gbdt learning rate): the table cache
+        # outlives this CompiledModel via the trees_ anchor, so without
+        # it a recompile after a hyperparameter edit would silently
+        # reuse the stale channel.
+        self._values = self.table.dev_values(
+            f"serve:{kind}{channel_salt}", lambda tb: _channel(
+                self.trees, values_fn, tb, dtype
+            ), dtype=dtype,
+        )
+        kv = int(self._values.shape[1])
+        if self._x64:
+            with jax.enable_x64(True):
+                self._scale = jax.device_put(np.float64(scale))
+        else:
+            self._scale = jax.device_put(np.asarray(scale, np.float32))
+        # The staged accumulator template (traverse_accumulate donates the
+        # per-request copy): the boosting baseline row, or zeros.
+        self._acc_row = (
+            np.zeros(max(self.n_out, 1), dtype)
+            if baseline is None
+            else np.asarray(baseline, dtype).reshape(-1)
+        )
+        self._scale_host = float(scale)
+        self._baseline_host = (
+            np.zeros(max(self.n_out, 1), np.float32) if baseline is None
+            else np.asarray(baseline, np.float32).reshape(-1)
+        )
+        precision = ("int-exact gather" if self._int_channel
+                     else "f64-exact" if self.exact else "f32")
+        self._obs.decision(
+            "serving_compile", kind,
+            reason=f"{precision} fused traversal, buckets {self.buckets}",
+            exact=bool(self.exact), n_out=self.n_out,
+            **table_notes(self.trees),
+        )
+        self._use_kernel = kind in (
+            "forest_proba", "forest_mean", "margin"
+        ) and pallas_serve.resolve_serving_kernel(
+            platform,
+            n_nodes_max=max(t.n_nodes for t in self.trees),
+            n_features=self.n_features, kv=kv, n_out=self.n_out,
+            obs=self._obs,
+        )
+        self._kernel_state = None
+        self._obs.decision(
+            "serving_kernel", "pallas" if self._use_kernel else "xla",
+            reason=(
+                "VMEM-resident Mosaic traversal (table fits the budget)"
+                if self._use_kernel else
+                "XLA gather traversal (policy: resolve_serving_kernel)"
+            ),
+        )
+
+    # -- dispatch ----------------------------------------------------------
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _dispatch(self, Xp: np.ndarray):
+        """One bucket-shaped traversal dispatch through the retry rung."""
+
+        def dev():
+            # Chaos seam: a serving dispatch blip (tunnel flap, device
+            # loss) rides the same transient-retry ladder as fit.
+            chaos.step("serving_dispatch")
+            if self._use_kernel:
+                return self._dispatch_kernel(Xp)
+            acc0 = None
+            if self.kind in traversal.ACC_KINDS:
+                # Freshly staged per ATTEMPT (the traversal donates it);
+                # for margins this is exactly the estimators' host-side
+                # baseline tile.
+                acc0 = np.broadcast_to(
+                    self._acc_row[None, :],
+                    (Xp.shape[0], self._acc_row.shape[0]),
+                ).copy()
+            return traversal.dispatch(
+                Xp, self.table.dev_arrays()[:5], self._values,
+                kind=self.kind, n_steps=self.table.n_steps,
+                acc0=acc0, scale=self._scale, x64=self._x64,
+                obs=self._obs,
+            )
+
+        with self._state_lock:
+            self._obs.counter("serving_dispatches")
+        # Retry-rung obs writes (device_retry events/counters) stay
+        # unlocked: they are failure-path-only and best-effort under
+        # concurrency; the load-bearing audits (compile registry, request
+        # counters) are all locked.
+        return retry_device(
+            dev, what="serving traversal dispatch", obs=self._obs
+        )
+
+    def _dispatch_kernel(self, Xp: np.ndarray):
+        """The Mosaic tier: VMEM-resident stacked tables, f32 aggregate,
+        per-kind post-scale as two eager element-wise ops over device-
+        cached constants — nothing but the query batch transfers."""
+        with self._state_lock:
+            # Locked lazy init: the registry's contract is concurrent
+            # dispatch, and a racing double-build would transiently pin
+            # two device copies of the kernel tables.
+            if self._kernel_state is None:
+                tbl, _ = pallas_serve.build_kernel_tables(self.trees)
+                agg = {"forest_proba": "norm", "forest_mean": "sum",
+                       "margin": "percls"}[self.kind]
+                kv = self.n_out if self.kind == "forest_proba" else 1
+                vals = pallas_serve.build_kernel_values(
+                    self.trees, self._values_fn, kv
+                )
+                rt = pallas_serve.kernel_row_tile(
+                    max(t.n_nodes for t in self.trees), self.n_features,
+                    kv, self.n_out,
+                )
+                self._kernel_state = (
+                    jax.device_put(tbl), jax.device_put(vals), agg, kv, rt,
+                    jax.device_put(np.float32(self._scale_host)),
+                    jax.device_put(self._baseline_host),
+                )
+        tbl, vals, agg, kv, rt, dscale, dbase = self._kernel_state
+        out = pallas_serve.traverse_batch_pallas(
+            Xp, tbl, vals, n_steps=self.table.n_steps, agg=agg,
+            n_out=self.n_out, kv=kv, row_tile=rt,
+        )
+        if agg == "percls":
+            return out * dscale + dbase[None, :]
+        return out / dscale
+
+    def raw_async(self, X) -> tuple:
+        """Dispatch without blocking: (device result, true row count).
+
+        The streaming stage rides this — JAX's async dispatch overlaps
+        this batch's H2D + compute with the caller staging the next one.
+        """
+        X = np.ascontiguousarray(np.asarray(X, np.float32))
+        if X.ndim != 2 or X.shape[1] != self.n_features:
+            raise ValueError(
+                f"expected (n, {self.n_features}) query batch, got "
+                f"{X.shape}"
+            )
+        n = X.shape[0]
+        with self._state_lock:
+            # The observer's dict counters are read-modify-write; the
+            # registry serves concurrent requests, and dropped increments
+            # would silently under-report serve_report_ traffic.
+            self._obs.counter("serving_requests")
+            self._obs.counter("serving_rows", n)
+        b = self._bucket(n)
+        if n <= b:
+            return self._dispatch(_pad_rows(X, b)), n
+        # Oversize batch: chunk at the largest bucket (every chunk is a
+        # warm shape; the tail pads). Device-side chunks concatenate on
+        # host at materialization.
+        outs = []
+        for lo in range(0, n, b):
+            outs.append(
+                (self._dispatch(_pad_rows(X[lo:lo + b], b)), min(b, n - lo))
+            )
+        return outs, n
+
+    def finalize(self, out, n: int) -> np.ndarray:
+        """Materialize a ``raw_async`` result into the estimator-shaped
+        host array (blocks; trims bucket padding; forest means travel on
+        device as an (N, 1) accumulator column). The ONE copy of the
+        chunk-concat + shape logic — ``raw`` and the streaming stage both
+        ride it."""
+        if isinstance(out, list):
+            host = np.concatenate(
+                [np.asarray(o)[:k] for o, k in out], axis=0
+            )
+        else:
+            host = np.asarray(out)[:n]
+        return host[:, 0] if self.kind == "forest_mean" else host
+
+    def raw(self, X) -> np.ndarray:
+        """The fused traversal result as a host array (margins for
+        boosting, probabilities for classification forests, values for
+        regressors, raw counts for single classification trees)."""
+        return self.finalize(*self.raw_async(X))
+
+    def warmup(self, buckets=None) -> None:
+        """Pre-compile every bucket shape OFF the request path (what the
+        registry runs before a slot swap, so swapping a freshly trained
+        model never compiles under traffic)."""
+        for b in buckets or self.buckets:
+            self.raw(np.zeros((int(b), self.n_features), np.float32))
+
+    # -- estimator-equivalent surface -------------------------------------
+    def predict(self, X):
+        out = self.raw(X)
+        if self.kind == "gather_counts":
+            return self.classes[out.argmax(axis=1)]
+        if self.kind == "gather_value":
+            if self.classes is not None:  # monotonic classifier labels
+                return self.classes[out.astype(np.int64)]
+            return out
+        if self.kind == "forest_proba":
+            return self.classes[out.argmax(axis=1)]
+        if self.kind == "forest_mean":
+            return out
+        # margin
+        if self.classes is None:
+            return out[:, 0]
+        return self.classes[
+            self._loss.proba(out.astype(np.float64)).argmax(axis=1)
+        ]
+
+    def predict_proba(self, X):
+        out = self.raw(X)
+        if self.kind == "gather_counts":
+            # The reference quirk, preserved: RAW leaf counts.
+            return out.astype(np.int64)
+        if self.kind == "forest_proba":
+            return out
+        if self.kind == "margin" and self.classes is not None:
+            return self._loss.proba(out.astype(np.float64))
+        raise AttributeError(
+            f"predict_proba undefined for serving kind {self.kind!r}"
+        )
+
+    def decision_function(self, X):
+        if self.kind != "margin" or self.classes is None:
+            raise AttributeError(
+                "decision_function is a boosting-classifier surface"
+            )
+        raw = self.raw(X)
+        return raw[:, 0] if raw.shape[1] == 1 else raw
+
+    @property
+    def serve_report_(self) -> dict:
+        """Structured serving record (the ``fit_report_`` analogue):
+        compile notes per bucket, kernel policy decision, retry/fallback
+        events, request/row counters."""
+        return self._obs.report()
+
+
+def compile_model(estimator, *, buckets=DEFAULT_BUCKETS) -> CompiledModel:
+    """Flatten a FITTED estimator into a :class:`CompiledModel`."""
+    from mpitree_tpu.boosting.gradient_boosting import (
+        _BaseGradientBoosting,
+    )
+    from mpitree_tpu.models.classifier import DecisionTreeClassifier
+    from mpitree_tpu.models.forest import _BaseForest
+    from mpitree_tpu.models.regressor import DecisionTreeRegressor
+
+    if isinstance(estimator, _BaseGradientBoosting):
+        classes = getattr(estimator, "classes_", None)
+        K = int(estimator.n_trees_per_iteration_)
+        lr = float(estimator.learning_rate)
+        return CompiledModel(
+            estimator.trees_, kind="margin",
+            n_features=estimator.n_features_in_, n_out=K,
+            # Leaf values pre-scaled by the learning rate in host f64 —
+            # see traversal._margin's FMA note.
+            values_fn=lambda t, lr=lr: lr * np.asarray(
+                t.count[:, 0], np.float64
+            ),
+            channel_salt=f":lr={lr!r}",
+            classes=classes,
+            loss=estimator._loss() if classes is not None else None,
+            baseline=np.asarray(estimator._baseline_raw, np.float64),
+            buckets=buckets,
+        )
+    if isinstance(estimator, _BaseForest):
+        if getattr(estimator, "monotonic_cst", None) is not None:
+            raise NotImplementedError(
+                "serving tables for monotonic-constrained forests are a "
+                "ROADMAP follow-up (clipped per-tree probabilities need "
+                "their own value channel); serve the estimator directly"
+            )
+        T = len(estimator.trees_)
+        if hasattr(estimator, "classes_"):
+            C = len(estimator.classes_)
+            return CompiledModel(
+                estimator.trees_, kind="forest_proba",
+                n_features=estimator.n_features_, n_out=C,
+                values_fn=lambda t: np.asarray(t.count, np.float64),
+                classes=estimator.classes_, scale=float(T), buckets=buckets,
+            )
+        return CompiledModel(
+            estimator.trees_, kind="forest_mean",
+            n_features=estimator.n_features_, n_out=1,
+            values_fn=lambda t: np.asarray(t.count[:, 0], np.float64),
+            scale=float(T), buckets=buckets,
+        )
+    if isinstance(estimator, DecisionTreeClassifier):
+        tree = estimator.tree_
+        if getattr(estimator, "monotonic_cst", None) is not None:
+            # Constrained classifiers predict from the bound-clipped leaf
+            # LABELS (classifier.predict's documented divergence) — an
+            # int32 label channel, plain gather, no f64 needed.
+            return CompiledModel(
+                [tree], kind="gather_value",
+                n_features=estimator.n_features_, n_out=1,
+                values_fn=lambda t: np.asarray(t.value, np.int32),
+                classes=estimator.classes_, buckets=buckets,
+                value_dtype=np.int32,
+            )
+        counts = np.asarray(tree.count)
+        if counts.max(initial=0) >= 2**31:
+            raise OverflowError(
+                "leaf counts exceed int32 on the serving table"
+            )
+        return CompiledModel(
+            [tree], kind="gather_counts",
+            n_features=estimator.n_features_,
+            n_out=len(estimator.classes_),
+            values_fn=lambda t: np.asarray(t.count, np.int32),
+            classes=estimator.classes_, buckets=buckets,
+            value_dtype=np.int32,
+        )
+    if isinstance(estimator, DecisionTreeRegressor):
+        return CompiledModel(
+            [estimator.tree_], kind="gather_value",
+            n_features=estimator.n_features_, n_out=1,
+            values_fn=lambda t: np.asarray(t.count[:, 0], np.float64),
+            buckets=buckets,
+        )
+    raise TypeError(
+        f"compile_model: unsupported estimator {type(estimator).__name__}"
+    )

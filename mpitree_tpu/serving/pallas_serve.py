@@ -1,0 +1,251 @@
+"""Pallas (Mosaic) serving kernel — VMEM-resident ensemble traversal.
+
+The XLA traversal (``serving.traversal``) re-reads the node table from HBM
+at every descent step of every batch; for the small/medium tables that
+production serving actually pins (a few thousand nodes), the whole table
+fits in VMEM. This kernel keeps one tree's table block resident across a
+batch tile's full descent and accumulates the ensemble reduction into a
+persistent output block — the table crosses HBM→VMEM once per (tile,
+tree), not once per step.
+
+TPU Mosaic has no vectorized dynamic gather, so — like the histogram
+kernel (``ops/pallas_hist.py``) — the per-step node lookup is reformulated
+as a dense one-hot contraction on the MXU::
+
+    props[r, c] = sum_m  onehot(node[r]) [r, m] * table[m, c]
+
+with the per-row feature-value pick ``x[r, feature[r]]`` as a one-hot
+row-reduction on the VPU. The kernel uses the per-tree STACKED layout
+(``(T, Mp)`` blocks, roots at 0) rather than the flat table: each grid
+step owns one tree's block, whose ids are tree-relative — exactly the
+shape Mosaic's block slicing wants.
+
+Grid: ``(row_tiles, T)`` — trees innermost, so the (Rt, K) output block
+persists in VMEM while the ensemble accumulates (the same
+constant-index-map idiom as ``pallas_hist``). Aggregation is f32 (the
+accelerator serving dtype); the CPU f64 exactness contract stays with the
+XLA tier. Selection lives in :func:`resolve_serving_kernel` — same policy
+shape as ``resolve_wide_hist``/``resolve_hist_subtraction``: the env var
+steers "auto", a forced ``pallas`` falls back GRACEFULLY (typed
+``serving_pallas_fallback`` obs event) when the backend or the VMEM fit
+can't satisfy it — serving must degrade, never die, on a policy mismatch.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from mpitree_tpu.ops.pallas_hist import _round_up, pallas_available
+
+
+def _traverse_kernel(x_ref, tbl_ref, val_ref, out_ref, *, n_steps,
+                     agg, n_out, kv):
+    """One grid step: descend one row tile through one tree, accumulate.
+
+    x_ref   : (Rt, Fp) f32 — query rows (features padded to Fp).
+    tbl_ref : (1, 8, Mp) f32 — this tree's (feature, threshold, left,
+              right, pad...) rows, node axis on lanes; pad nodes carry
+              feature = -1 (leaves).
+    val_ref : (1, Kvp, Mp) f32 — this tree's leaf-value channels.
+    out_ref : (Rt, Kop) f32 — ensemble accumulation (persists over T).
+    """
+    Rt, Fp = x_ref.shape
+    Mp = tbl_ref.shape[2]
+    t = pl.program_id(1)
+
+    @pl.when(t == 0)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    tbl = tbl_ref[0]  # (8, Mp)
+    x = x_ref[...]
+    m_iota = jax.lax.broadcasted_iota(jnp.int32, (Rt, Mp), 1)
+    f_iota = jax.lax.broadcasted_iota(jnp.int32, (Rt, Fp), 1)
+    node = jnp.zeros((Rt,), jnp.int32)  # stacked layout: every root is 0
+    for _ in range(n_steps):
+        onehot = (node[:, None] == m_iota).astype(jnp.float32)
+        # HIGHEST precision on both contractions: the MXU's default
+        # truncates the f32 table operand to bf16, which corrupts child
+        # ids above 256 and rounds thresholds — silent misrouting on
+        # exactly the real-TPU tier this kernel exists for. Cheap: the
+        # one-hot operand is exact 0/1 either way.
+        props = jax.lax.dot_general(
+            onehot, tbl,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )  # (Rt, 8): feature, threshold, left, right, pad
+        f = props[:, 0].astype(jnp.int32)
+        xf = jnp.sum(
+            jnp.where(f[:, None] == f_iota, x, 0.0), axis=1
+        )
+        nxt = jnp.where(xf <= props[:, 1], props[:, 2], props[:, 3])
+        node = jnp.where(f < 0, node, nxt.astype(jnp.int32))
+    onehot = (node[:, None] == m_iota).astype(jnp.float32)
+    vals = jax.lax.dot_general(
+        onehot, val_ref[0],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )  # (Rt, Kvp)
+    if agg == "norm":
+        # Per-tree normalized count rows (forest predict_proba): the pad
+        # channels are zero, so the kv-wide row sum is the true one.
+        rowsum = jnp.sum(vals[:, :kv], axis=1, keepdims=True)
+        out_ref[...] += vals / jnp.maximum(rowsum, 1.0)
+    elif agg == "percls":
+        # Boosting: tree t contributes its single value channel to margin
+        # column t mod K (trees are laid out round-major, class-minor).
+        col = jax.lax.rem(t, n_out)
+        k_iota = jax.lax.broadcasted_iota(jnp.int32, (Rt, out_ref.shape[1]), 1)
+        out_ref[...] += vals[:, 0][:, None] * (k_iota == col).astype(
+            jnp.float32
+        )
+    else:  # "sum"
+        out_ref[...] += vals
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_steps", "agg", "n_out", "kv", "row_tile",
+                     "interpret"),
+)
+def traverse_batch_pallas(X, tables, values, *, n_steps: int, agg: str,
+                          n_out: int, kv: int, row_tile: int = 256,
+                          interpret: bool = False):
+    """(N, F) rows + stacked per-tree tables -> (N, n_out) f32 aggregate.
+
+    ``tables``: (T, 8, Mp) f32 (property axis sublane-padded, nodes on
+    lanes); ``values``: (T, Kvp, Mp) f32 — both built by
+    :func:`build_kernel_tables`. ``interpret=True`` runs the Pallas
+    interpreter (the CPU parity tests); on hardware the caller gates on
+    :func:`fits_vmem`.
+    """
+    N, F = X.shape
+    T, _, Mp = tables.shape
+    Kop = values.shape[1] if agg != "percls" else n_out
+    Np = _round_up(max(N, 1), row_tile)
+    Fp = _round_up(max(F, 1), 8)
+    Xp = jnp.pad(X.astype(jnp.float32), ((0, Np - N), (0, Fp - F)))
+    out = pl.pallas_call(
+        functools.partial(
+            _traverse_kernel, n_steps=n_steps, agg=agg, n_out=n_out, kv=kv,
+        ),
+        # Trees innermost (TPU grids iterate the last axis fastest): each
+        # row tile's out block accumulates across the full ensemble before
+        # the grid advances to the next tile.
+        grid=(Np // row_tile, T),
+        in_specs=[
+            pl.BlockSpec((row_tile, Fp), lambda i, t: (i, 0)),
+            pl.BlockSpec((1, 8, Mp), lambda i, t: (t, 0, 0)),
+            pl.BlockSpec((1, values.shape[1], Mp), lambda i, t: (t, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((row_tile, Kop), lambda i, t: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((Np, Kop), jnp.float32),
+        interpret=interpret,
+    )(Xp, tables, values)
+    return out[:N, :n_out]
+
+
+def build_kernel_tables(trees) -> tuple:
+    """Stacked per-tree kernel layout: ((T, 8, Mp) f32, Mp).
+
+    Node ids are tree-relative (roots at 0) and live on the LANE axis
+    (``Mp`` rounds to the 128-lane boundary the one-hot contraction
+    wants); the property axis pads to the 8-sublane tile. Pad nodes carry
+    feature = -1 so descent holds on them like any leaf.
+    """
+    T = len(trees)
+    Mp = _round_up(max(t.n_nodes for t in trees), 128)
+    tbl = np.zeros((T, 8, Mp), np.float32)
+    tbl[:, 0, :] = -1.0
+    for i, t in enumerate(trees):
+        m = t.n_nodes
+        tbl[i, 0, :m] = np.asarray(t.feature, np.float32)
+        # Leaf thresholds are NaN in TreeArrays; the one-hot CONTRACTION
+        # would propagate them (0 * nan = nan) into every row's props, so
+        # leaves store a neutral 0.0 — they never route anyway.
+        tbl[i, 1, :m] = np.nan_to_num(
+            np.asarray(t.threshold, np.float32), nan=0.0
+        )
+        tbl[i, 2, :m] = np.maximum(np.asarray(t.left, np.float32), 0.0)
+        tbl[i, 3, :m] = np.maximum(np.asarray(t.right, np.float32), 0.0)
+    return tbl, Mp
+
+
+def build_kernel_values(trees, channel_fn, kv: int) -> np.ndarray:
+    """(T, Kvp, Mp) f32 leaf-value channels (channels padded to the
+    8-sublane tile, node axis on lanes)."""
+    T = len(trees)
+    Mp = _round_up(max(t.n_nodes for t in trees), 128)
+    kvp = _round_up(max(kv, 1), 8)
+    vals = np.zeros((T, kvp, Mp), np.float32)
+    for i, t in enumerate(trees):
+        ch = np.asarray(channel_fn(t), np.float32).reshape(t.n_nodes, -1)
+        vals[i, : ch.shape[1], : t.n_nodes] = ch.T
+    return vals
+
+
+# Conservative VMEM ceiling (same stance as pallas_hist): the persistent
+# out block + one tree's table/value blocks + the one-hot working set.
+_VMEM_BUDGET_BYTES = 10 << 20
+
+
+def kernel_row_tile(n_nodes_max: int, n_features: int, kv: int,
+                    n_out: int) -> int | None:
+    """Largest row tile whose working set fits the VMEM budget, or None."""
+    mp = _round_up(max(n_nodes_max, 1), 128)
+    fp = _round_up(max(n_features, 1), 8)
+    # table (8, Mp) + value (Kvp, Mp) blocks, both sublane-padded
+    blocks = mp * (8 + _round_up(max(kv, 1), 8)) * 4
+    for rt in (1024, 512, 256, 128, 64, 8):
+        work = rt * (mp + 2 * fp + 4 + max(n_out, 1)) * 4
+        if blocks + work <= _VMEM_BUDGET_BYTES:
+            return rt
+    return None
+
+
+def fits_vmem(n_nodes_max: int, n_features: int, kv: int,
+              n_out: int) -> bool:
+    return kernel_row_tile(n_nodes_max, n_features, kv, n_out) is not None
+
+
+def resolve_serving_kernel(platform: str, *, n_nodes_max: int,
+                           n_features: int, kv: int, n_out: int,
+                           obs=None) -> bool:
+    """Whether the fused serving path runs the Mosaic kernel.
+
+    Policy shape mirrors ``resolve_wide_hist``: ``MPITREE_TPU_SERVING_
+    KERNEL`` is "auto" (kernel on real TPUs whose table fits VMEM — there
+    the XLA tier is f32 too, so the tiers differ only in where the table
+    lives), "xla" (off everywhere), or "pallas" (forced). Unlike the wide
+    kernel's loud force-failure, an unsatisfiable force here degrades
+    GRACEFULLY to the XLA tier with a typed ``serving_pallas_fallback``
+    event: a serving stack must answer the request, not die, when a model
+    outgrows VMEM or fails over to a f64-capable host.
+    """
+    flag = os.environ.get("MPITREE_TPU_SERVING_KERNEL", "auto")
+    if flag == "xla":
+        return False
+    if flag not in ("auto", "pallas"):
+        raise ValueError(f"unknown MPITREE_TPU_SERVING_KERNEL {flag!r}")
+    ok = pallas_available(platform)
+    fits = fits_vmem(n_nodes_max, n_features, kv, n_out)
+    if flag == "pallas" and not (ok and fits):
+        why = ("needs a TPU backend" if not ok
+               else "table working set exceeds the VMEM budget")
+        if obs is not None:
+            obs.event(
+                "serving_pallas_fallback",
+                f"MPITREE_TPU_SERVING_KERNEL=pallas: {why} "
+                f"(platform={platform!r}, nodes={n_nodes_max}); serving "
+                "the XLA traversal tier instead",
+            )
+        return False
+    return ok and fits

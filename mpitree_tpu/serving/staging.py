@@ -1,0 +1,65 @@
+"""Donated double-buffered input staging for streaming inference.
+
+The streaming request loop's overlap story: while batch *k* computes on
+device, batch *k+1*'s host→device transfer should already be in flight.
+JAX's async dispatch gives the overlap for free ONCE two batches are in
+flight simultaneously — what this stage adds is the bounded pipeline that
+keeps exactly ``depth`` results outstanding (backpressure blocks on the
+oldest, so an unbounded burst cannot queue device work without limit) and
+the donation discipline around it.
+
+Donation contract (the GL05/GL08 caller side, annotated here because the
+traversal's ``donate_argnums`` makes every staged buffer single-use):
+each submitted batch is staged as a FRESH host array handed to exactly
+one ``raw_async`` dispatch, which donates the transferred device buffer
+into the traversal's loop state. The stage never re-reads a submitted
+buffer — results come back as the traversal's OUTPUT arrays — and callers
+get their numpy results copied out at drain time, so no donated storage
+ever escapes.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+
+class StreamStage:
+    """Bounded async pipeline over a :class:`~.model.CompiledModel`.
+
+    >>> stage = StreamStage(model, depth=2)
+    >>> for batch in batches:
+    ...     for ticket, out in stage.submit(batch):
+    ...         handle(ticket, out)
+    >>> for ticket, out in stage.drain():
+    ...     handle(ticket, out)
+    """
+
+    def __init__(self, model, *, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self.model = model
+        self.depth = int(depth)
+        self._inflight: deque = deque()
+        self._next_ticket = 0
+
+    def _materialize(self, entry) -> tuple:
+        ticket, out, n = entry
+        return ticket, self.model.finalize(out, n)
+
+    def submit(self, X) -> list:
+        """Stage + dispatch one batch; returns any results whose slots
+        this submission displaced (ready-or-forced, oldest first)."""
+        done = []
+        while len(self._inflight) >= self.depth:
+            done.append(self._materialize(self._inflight.popleft()))
+        out, n = self.model.raw_async(X)
+        self._inflight.append((self._next_ticket, out, n))
+        self._next_ticket += 1
+        return done
+
+    def drain(self) -> list:
+        """Block on everything still in flight (oldest first)."""
+        done = []
+        while self._inflight:
+            done.append(self._materialize(self._inflight.popleft()))
+        return done

@@ -1,0 +1,273 @@
+"""Depth-packed structure-of-arrays node tables — the serving-side tree form.
+
+Training produces per-tree :class:`~mpitree_tpu.core.tree_struct.TreeArrays`
+keyed by within-tree node ids; the old ensemble descent stacked them into a
+padded ``(T, M)`` grid (``M`` = the LARGEST member's node count) and vmapped
+a per-tree gather loop over it, re-uploading every tree slice on every
+predict call. A :class:`NodeTable` is the serving-native flattening:
+
+- **one flat id space** — every node of every tree in the group lives at an
+  absolute index into five parallel arrays (feature, threshold, left, right,
+  orig), children addressed absolutely, so the whole ensemble traverses as
+  ONE gather program with no tree axis in the table (mixed-size ensembles
+  carry zero padding);
+- **packed contiguously per depth level** — nodes are ordered by
+  ``(depth, tree, node)`` with ``level_off`` recording the slab bounds, so
+  the ids live at traversal step ``d`` all fall in one dense slab instead of
+  scattering across a sparse ``(T, M)`` grid;
+- **true-depth steps** — ``n_steps`` is the deepest MEMBER's depth (the
+  number of level slabs minus one), not the estimator's ``max_depth``
+  budget: a ``max_depth=20`` ensemble whose trees all stopped at depth 6
+  descends 6 steps, not 20;
+- **cached device residency** — host arrays build once per ensemble object
+  (weak-ref anchored, like every predict cache) and ``dev_arrays()`` /
+  ``dev_values()`` pin the device copies in the same cache entry, so the
+  request path transfers nothing but the query batch.
+
+Leaf-value channels (``values``) attach lazily — only the fused serving
+path (``serving.model``) needs them; the estimators' leaf-id path descends
+on the five structural arrays alone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from mpitree_tpu.ops.predict import WeakIdCache
+
+# Device-memory ceiling for one table's five structural int32/f32 arrays
+# plus headroom for lazily-attached value channels — the same role as the
+# old stacked path's STACKED_GROUP_BYTES, now counted on the flat (padding
+# free) layout, so a given budget admits strictly more trees.
+TABLE_GROUP_BYTES = 256 << 20
+_BYTES_PER_NODE = 24  # 5 x int32/f32 structural columns + value headroom
+
+
+@dataclasses.dataclass
+class NodeTable:
+    """One depth-packed flat node table (a whole ensemble, or one group).
+
+    Attributes
+    ----------
+    feature : (M,) int32 — split feature per node, ``-1`` marks leaves.
+    threshold : (M,) float32 — split value; ``nan`` on leaves.
+    left, right : (M,) int32 — ABSOLUTE child ids into this table
+        (``-1`` on leaves; never followed — the traversal holds leaves).
+    orig : (M,) int32 — the node's id within its source tree (what maps
+        absolute traversal results back to per-tree leaf ids).
+    root : (T,) int32 — absolute root id per member tree.
+    level_off : (D+2,) int64 — slab offsets: level ``d`` occupies
+        ``[level_off[d], level_off[d+1])``.
+    n_steps : int — true ensemble depth (deepest member; >= 1).
+    """
+
+    feature: np.ndarray
+    threshold: np.ndarray
+    left: np.ndarray
+    right: np.ndarray
+    orig: np.ndarray
+    root: np.ndarray
+    level_off: np.ndarray
+    n_steps: int
+
+    def __post_init__(self):
+        self._dev = None
+        self._values: dict = {}
+        self._dev_values: dict = {}
+
+    @property
+    def n_nodes(self) -> int:
+        return int(self.feature.shape[0])
+
+    @property
+    def n_trees(self) -> int:
+        return int(self.root.shape[0])
+
+    def dev_arrays(self, *, cache: bool = True) -> tuple:
+        """The five traversal arrays + root + orig on device.
+
+        ``cache=True`` pins the copies on the table (uploading becomes a
+        first-touch cost, never a request-path one) — right for tables
+        within the ``group_bytes`` budget and for published serving
+        models, whose whole point is persistent residency.
+        ``cache=False`` uploads transiently (the buffers free when the
+        caller drops them) — how the estimator predict path keeps a
+        multi-table ensemble's PEAK device residency bounded by one
+        group instead of the whole forest."""
+        if self._dev is not None:
+            return self._dev
+        dev = tuple(
+            jax.device_put(a)
+            for a in (self.feature, self.threshold, self.left,
+                      self.right, self.root, self.orig)
+        )
+        if cache:
+            self._dev = dev
+        return dev
+
+    def values(self, channel: str, build) -> np.ndarray:
+        """Host value channel ``channel``, built once via ``build(self)``."""
+        v = self._values.get(channel)
+        if v is None:
+            v = self._values[channel] = build(self)
+        return v
+
+    def dev_values(self, channel: str, build, *, dtype) -> jax.Array:
+        """Device copy of a value channel at ``dtype``, cached.
+
+        f64 channels transfer inside a scoped ``enable_x64`` — outside it
+        this wheel canonicalizes the upload to f32 (the gbdt-path lesson,
+        ``ops/histogram.py``).
+        """
+        key = (channel, np.dtype(dtype).str)
+        d = self._dev_values.get(key)
+        if d is None:
+            host = np.asarray(self.values(channel, build), dtype=dtype)
+            if host.dtype == np.float64:
+                with jax.enable_x64(True):
+                    d = jax.device_put(host)
+            else:
+                d = jax.device_put(host)
+            self._dev_values[key] = d
+        return d
+
+    def scatter_order(self) -> np.ndarray:
+        """(M,) permutation mapping absolute table position -> index into
+        the per-tree concatenation (``concat(arrays)[scatter_order()]``
+        depth-packs a per-node channel)."""
+        return self._order
+
+
+def _flatten(trees, lo: int, hi: int) -> NodeTable:
+    """Depth-pack ``trees[lo:hi]`` into one :class:`NodeTable`."""
+    group = trees[lo:hi]
+    sizes = np.array([t.n_nodes for t in group], np.int64)
+    offs = np.concatenate([[0], np.cumsum(sizes)])
+    total = int(offs[-1])
+    all_depth = np.concatenate(
+        [np.asarray(t.depth, np.int64) for t in group]
+    )
+    all_tree = np.repeat(np.arange(len(group), dtype=np.int64), sizes)
+    all_node = np.concatenate([np.arange(s, dtype=np.int64) for s in sizes])
+    # (depth, tree, node) ascending: each depth level is one contiguous
+    # slab, trees in member order inside it.
+    order = np.lexsort((all_node, all_tree, all_depth))
+    pos = np.empty(total, np.int64)
+    pos[order] = np.arange(total)
+
+    feat = np.concatenate([np.asarray(t.feature, np.int32) for t in group])
+    thr = np.concatenate([np.asarray(t.threshold, np.float32) for t in group])
+    left = np.concatenate([np.asarray(t.left, np.int64) for t in group])
+    right = np.concatenate([np.asarray(t.right, np.int64) for t in group])
+    # Child ids are within-tree; lift to flat-concat ids, then through the
+    # depth-pack permutation to absolute table ids. Leaves stay -1 (their
+    # ``pos[-1]`` lookup is a valid-but-masked numpy wraparound read).
+    tree_off = offs[all_tree]
+    left_abs = np.where(left >= 0, pos[left + tree_off], -1)
+    right_abs = np.where(right >= 0, pos[right + tree_off], -1)
+
+    depth_sorted = all_depth[order]
+    n_levels = int(depth_sorted[-1]) + 1 if total else 1
+    level_off = np.searchsorted(
+        depth_sorted, np.arange(n_levels + 1), side="left"
+    )
+    table = NodeTable(
+        feature=feat[order],
+        threshold=thr[order],
+        left=left_abs[order].astype(np.int32),
+        right=right_abs[order].astype(np.int32),
+        orig=all_node[order].astype(np.int32),
+        root=pos[offs[:-1]].astype(np.int32),
+        level_off=level_off.astype(np.int64),
+        n_steps=max(n_levels - 1, 1),
+    )
+    table._order = order
+    return table
+
+
+_tables_cache = WeakIdCache()
+
+
+def tables_for(trees, *, group_bytes: int | None = TABLE_GROUP_BYTES) -> list:
+    """Depth-packed tables for ``trees``, cached on the ensemble object.
+
+    ``group_bytes`` caps one table's structural footprint; ``None`` means
+    one table regardless of size (the fused serving path, whose ensemble
+    accumulation is a single program over one table). The cache entry is
+    keyed by the trees CONTAINER (the estimators' ``_TreeList``/``tree_``
+    anchor) and holds host arrays — plus, for within-budget single-table
+    ensembles, their cached device copies — so repeat predict calls
+    upload nothing (the PR-6-era per-call ``jax.device_put(a[sl])``
+    re-upload is gone). Oversize ensembles split into multiple tables
+    whose device copies stay TRANSIENT on the estimator path (peak
+    residency = one group, the old bound; see ``dev_arrays``).
+    """
+
+    n = len(trees)
+    if group_bytes is None:
+        bounds = [0, n]
+    else:
+        per_group = []
+        cur = 0
+        budget = max(int(group_bytes), 1)
+        acc = 0
+        for i, t in enumerate(trees):
+            b = t.n_nodes * _BYTES_PER_NODE
+            if i > cur and acc + b > budget:
+                per_group.append(i)
+                cur = i
+                acc = 0
+            acc += b
+        bounds = [0, *per_group, n]
+
+    by_bytes = _tables_cache.get_or_build(trees, dict)
+    # A byte budget the whole ensemble fits inside yields the same single
+    # table as group_bytes=None — normalize the key so the estimator
+    # predict path and a published CompiledModel share ONE table (and one
+    # device copy) instead of flattening twice.
+    key = "one" if len(bounds) == 2 else int(group_bytes)
+    tables = by_bytes.get(key)
+    if tables is None:
+        tables = by_bytes[key] = [
+            _flatten(trees, bounds[i], bounds[i + 1])
+            for i in range(len(bounds) - 1)
+        ]
+    return tables
+
+
+def table_notes(trees) -> dict:
+    """Cheap (host-only) serving notes for a fitted ensemble — what
+    ``fit_report_`` records without building device tables: total nodes,
+    true descent depth vs the padded stacked grid, and the flat table's
+    size advantage over the old ``(T, max_nodes)`` layout."""
+    sizes = [int(t.n_nodes) for t in trees]
+    n_steps = max(max((int(t.max_depth) for t in trees), default=0), 1)
+    total = sum(sizes)
+    stacked_cells = len(sizes) * max(sizes, default=0)
+    return {
+        "n_trees": len(sizes),
+        "n_nodes": total,
+        "n_steps": n_steps,
+        "flat_fill": round(total / stacked_cells, 4) if stacked_cells else 1.0,
+    }
+
+
+def note_serving(obs, trees) -> None:
+    """Record the serving-table plan on a fit's ``BuildObserver`` — the
+    ``fit_report_`` side of the serving story (the compile-side notes land
+    in the process compile registry under ``serving_traverse`` when the
+    model is actually published; ``serving.model.CompiledModel`` carries
+    those in its own ``serve_report_``)."""
+    notes = table_notes(trees)
+    obs.decision(
+        "serving", "flat-table",
+        reason=(
+            f"depth-packed node table: {notes['n_nodes']} nodes, "
+            f"{notes['n_steps']} descent steps (true ensemble depth), "
+            f"{notes['flat_fill']:.0%} of the padded stacked grid"
+        ),
+        **notes,
+    )

@@ -1,0 +1,249 @@
+"""Single-dispatch compiled traversal over depth-packed node tables.
+
+The request-path contract (ISSUE 7 / ROADMAP item 1): ONE jitted call per
+(model, batch-bucket) — no per-tree Python loop, no per-call device upload
+of tree slices, leaf-value application fused into the same program. Three
+entry points:
+
+- :func:`flat_leaf_ids` — descent only, returning per-tree RELATIVE leaf
+  ids. The estimators' ensemble predict path
+  (``ops/predict.stacked_leaf_ids``) rides this so every existing
+  host-side value application stays bit-identical while the descent
+  becomes a single gather program over the cached flat table.
+- :func:`traverse_gather` — descent + a fused leaf-value gather (single
+  trees: raw counts, regression means, monotonic labels).
+- :func:`traverse_accumulate` — descent + the fused ensemble reduction
+  (forest probabilities/means, boosting margins), sequentially
+  accumulated into a DONATED carry: the caller stages the (N, K)
+  accumulator init host-side (zeros, or the tiled boosting baseline —
+  literally what the estimators build host-side) and hands it over;
+  the ``lax.fori_loop`` carry aliases that buffer in place, which is
+  exactly the donation GL05 asks fused-state programs for. Caller
+  contract (GL08): the staged init is single-use — every dispatch
+  stages a fresh one (``CompiledModel._dispatch`` and the retry rung
+  both rebuild it per attempt). The table/value arrays are deliberately
+  NOT donated: they are the cached device-resident model state reused
+  by every request — donating them would be the garbage-read bug GL08
+  exists to catch.
+
+Descent is an UNROLLED gather sequence: ``n_steps`` is the table's true
+ensemble depth (static, small), so the loop is Python-level — each step
+is four clip-mode gathers plus a compare, and the step count is the
+table's, not the estimator's ``max_depth`` budget.
+
+Exactness: the estimators aggregate leaf values HOST-SIDE in float64 with
+a strict sequential per-tree order (``forest.predict_proba``'s ``acc +=``
+loop, boosting's ``raw[:, k] += lr * vals``). The fused path reproduces
+that bit-for-bit on CPU backends: value channels ride in f64 under a
+scoped ``jax.enable_x64`` and the ensemble reduction runs in member
+order — same IEEE ops, same order. The legacy-wheel scoped-x64 hazards
+are all routed around the way the gbdt engine does (``ops/histogram.py``):
+f64 constants enter as f32 exactly converted (:func:`_fconst`), gathers
+run clip-mode, and f64 operands are device-put inside the scope.
+Accelerator backends have no f64 unit; there the same programs run with
+f32 channels (``exact=False`` in the model's ``serve_report_`` — the
+documented serving-tier divergence).
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpitree_tpu.obs import REGISTRY
+
+# Guards the compile-registry bookkeeping below: the process-wide
+# REGISTRY's LRU mirror and the per-model obs compile records are plain
+# dict read-modify-writes, and the registry's contract is concurrent
+# dispatch (possibly across models). The jit CALL itself stays outside
+# any lock — executables are thread-safe and must serve concurrently.
+_NOTE_LOCK = threading.Lock()
+
+
+def _fconst(v: float, dtype) -> jax.Array:
+    """A scalar constant that lowers under scoped x64 on legacy wheels.
+
+    f64 literals canonicalize to f32 at lowering time there (the
+    ``_channel_histogram`` lesson), so constants enter as exact-in-f32
+    values converted on device. Callers only pass such values (0, 1,
+    small integers)."""
+    return jnp.float32(v).astype(dtype)
+
+
+def _descend(X, feature, threshold, left, right, root, n_steps: int):
+    """(N, T) absolute leaf ids — the unrolled lockstep gather descent.
+
+    Rows parked on a leaf (``feature < 0``) keep their node id, so
+    ``n_steps`` iterations (the table's true depth) land every row on its
+    leaf. All gathers are clip-mode: leaf children are ``-1`` and never
+    followed, and clip is the gather mode that lowers everywhere this
+    wheel runs (fill-mode gathers mislower under scoped x64).
+    """
+    node = jnp.broadcast_to(
+        root[None, :], (X.shape[0], root.shape[0])
+    ).astype(jnp.int32)
+    for _ in range(n_steps):
+        f = jnp.take(feature, node, mode="clip")
+        thr = jnp.take(threshold, node, mode="clip")
+        xf = jnp.take_along_axis(X, jnp.maximum(f, 0), axis=1)
+        nxt = jnp.where(
+            xf <= thr,
+            jnp.take(left, node, mode="clip"),
+            jnp.take(right, node, mode="clip"),
+        )
+        node = jnp.where(f < 0, node, nxt)
+    return node
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def flat_leaf_ids(X, feature, threshold, left, right, root, orig, *,
+                  n_steps: int):
+    """(N, T) per-tree RELATIVE leaf ids for a query batch.
+
+    One dispatch for the whole table: the absolute descent result maps
+    back through ``orig`` so callers (the estimators' host-side value
+    application) see exactly the ids the old stacked path produced.
+    """
+    node = _descend(X, feature, threshold, left, right, root, n_steps)
+    return jnp.take(orig, node, mode="clip")
+
+
+# Aggregation kinds (static trace branch, one lowering per kind):
+#   gather_counts — single classification tree: (N, C) raw leaf counts
+#                   (the reference's predict_proba quirk), int32 gather.
+#   gather_value  — single tree, one value channel: (N,) gather
+#                   (f64 regressor means; monotonic classifier labels
+#                   ride the same shape with an int32 channel).
+#   forest_proba  — per-tree normalized count rows, sequentially
+#                   accumulated then divided by T (RandomForestClassifier
+#                   .predict_proba's loop, verbatim in f64).
+#   forest_mean   — per-tree value column, sequentially accumulated then
+#                   divided by T (RandomForestRegressor.predict).
+#   margin        — boosting: staged baseline tile + lr * per-round
+#                   (N, K) value blocks, in round order (``_staged_raw``'s
+#                   accumulation, verbatim in f64).
+GATHER_KINDS = ("gather_counts", "gather_value")
+ACC_KINDS = ("forest_proba", "forest_mean", "margin")
+
+
+@partial(jax.jit, static_argnames=("kind", "n_steps"))
+def traverse_gather(X, feature, threshold, left, right, root, values, *,
+                    kind: str, n_steps: int):
+    """Descent + single-tree leaf-value gather; see module docstring."""
+    node = _descend(X, feature, threshold, left, right, root, n_steps)
+    if kind == "gather_counts":
+        return jnp.take(values, node[:, 0], axis=0, mode="clip")
+    if kind == "gather_value":
+        return jnp.take(values[:, 0], node[:, 0], mode="clip")
+    raise ValueError(f"unknown serving gather kind {kind!r}")
+
+
+def _forest_proba(node, values, acc0, scale):
+    one = _fconst(1.0, values.dtype)
+
+    def body(t, acc):
+        ids = jnp.take(node, t, axis=1, mode="clip")
+        cnt = jnp.take(values, ids, axis=0, mode="clip")
+        return acc + cnt / jnp.maximum(
+            jnp.sum(cnt, axis=1, keepdims=True), one
+        )
+
+    return lax.fori_loop(0, node.shape[1], body, acc0) / scale
+
+
+def _forest_mean(node, values, acc0, scale):
+    def body(t, acc):
+        ids = jnp.take(node, t, axis=1, mode="clip")
+        return acc + jnp.take(values[:, 0], ids, mode="clip")[:, None]
+
+    return lax.fori_loop(0, node.shape[1], body, acc0) / scale
+
+
+def _margin(node, values, acc0, scale):
+    # ``values`` arrives PRE-SCALED by the learning rate (a host f64
+    # multiply at compile time — the same numpy op the estimator applies
+    # per gather), so each round is a pure add: a device ``raw + lr *
+    # vals`` would contract to an FMA and drift one ulp off the host's
+    # separate mul-then-add. ``scale`` is unused here by design.
+    del scale
+    N, K = acc0.shape
+    rounds = node.shape[1] // K
+
+    def body(r, raw):
+        ids = lax.dynamic_slice(node, (0, r * K), (N, K))
+        return raw + jnp.take(values[:, 0], ids, mode="clip")
+
+    return lax.fori_loop(0, rounds, body, acc0)
+
+
+_ACC_FNS = {
+    "forest_proba": _forest_proba,
+    "forest_mean": _forest_mean,
+    "margin": _margin,
+}
+
+
+# acc0 is donated: the fori carry aliases the staged accumulator buffer
+# in place (see module docstring for the caller contract — acc0 is a
+# fresh host-staged array per dispatch, dead to the caller afterwards).
+@partial(
+    jax.jit,
+    static_argnames=("kind", "n_steps"),
+    donate_argnums=(6,),
+)
+def traverse_accumulate(X, feature, threshold, left, right, root, acc0,
+                        values, scale, *, kind: str, n_steps: int):
+    """Descent + fused sequential ensemble reduction into ``acc0``."""
+    node = _descend(X, feature, threshold, left, right, root, n_steps)
+    try:
+        fn = _ACC_FNS[kind]
+    except KeyError:
+        raise ValueError(
+            f"unknown serving accumulate kind {kind!r}"
+        ) from None
+    return fn(node, values, acc0, scale)
+
+
+def dispatch(X, table_args, values, *, kind: str, n_steps: int,
+             acc0=None, scale=None, x64: bool, obs=None):
+    """One request-path dispatch: compile-note the cache key, then run.
+
+    ``x64=True`` (CPU exactness mode) enters the scoped ``enable_x64``
+    for the call — the same trace context the program compiled under, so
+    the cached executable serves it (a context mismatch would silently
+    retrace). The key mirrors everything static about the lowering; the
+    process-wide compile registry (obs.REGISTRY — the GL02 runtime twin)
+    is what the swap-under-load test pins at zero new entries on the
+    request path.
+    """
+    key = (
+        kind, n_steps, x64, X.shape,
+        values.shape, str(values.dtype),
+        # root's (T,) aval: two tables can share total node count M but
+        # differ in tree count — jit would retrace while an M-only key
+        # claimed a cache hit, silently defeating the zero-compile audit.
+        table_args[4].shape,
+        None if acc0 is None else acc0.shape,
+    )
+    with _NOTE_LOCK:
+        REGISTRY.note("serving_traverse", key, cache_size=64)
+        if obs is not None:
+            obs.compile_note("serving_traverse", key, cache_size=64)
+
+    def run():
+        if kind in GATHER_KINDS:
+            return traverse_gather(
+                X, *table_args, values, kind=kind, n_steps=n_steps
+            )
+        return traverse_accumulate(
+            X, *table_args, acc0, values, scale, kind=kind, n_steps=n_steps
+        )
+
+    if x64:
+        with jax.enable_x64(True):
+            return run()
+    return run()

@@ -1,0 +1,543 @@
+"""mpitree_tpu.serving — the compiled-inference parity and contract suite.
+
+The load-bearing pins (ISSUE 7 acceptance):
+
+- **Bit-identical parity**: ``CompiledModel.predict`` / ``predict_proba``
+  / ``decision_function`` equal the estimator outputs EXACTLY (not
+  allclose) for single trees, forests, ExtraTrees, and GBDT — including
+  multi-device fits — because the fused traversal reproduces the
+  estimators' host f64 sequential aggregation op for op.
+- **True-depth descent**: a depth-capped ensemble whose members stopped
+  early descends its TRUE depth, not the ``max_depth`` budget.
+- **Warm request path**: after a registry publish, a request storm (and a
+  model swap) adds ZERO compile cache-key entries and ZERO explicit
+  device_put transfers on the request path.
+- **Resilience**: a chaos-injected serving dispatch blip rides the retry
+  ladder and still answers.
+- **Kernel tier**: the Pallas traversal (interpret mode on this CPU mesh)
+  agrees with the XLA tier; the forced-kernel policy falls back
+  gracefully with a typed event off-TPU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+
+from mpitree_tpu import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    ExtraTreesClassifier,
+    GradientBoostingClassifier,
+    GradientBoostingRegressor,
+    RandomForestClassifier,
+    RandomForestRegressor,
+)
+from mpitree_tpu.obs import REGISTRY
+from mpitree_tpu.resilience import chaos
+from mpitree_tpu.resilience.chaos import Fault
+from mpitree_tpu.serving import (
+    ModelRegistry,
+    StreamStage,
+    compile_model,
+    tables_for,
+)
+from mpitree_tpu.serving import pallas_serve
+from mpitree_tpu.serving.tables import table_notes
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos(monkeypatch):
+    chaos.clear()
+    monkeypatch.delenv("MPITREE_TPU_CHAOS", raising=False)
+    monkeypatch.setenv("MPITREE_TPU_BACKOFF_S", "0")
+    yield
+    chaos.clear()
+
+
+def _cls_data(n=300, f=8, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] ** 2 + rng.normal(scale=0.3, size=n) > 0.4
+         ).astype(int)
+    if c > 2:
+        y = y + (X[:, 2] > 0.8).astype(int)
+    return X, y
+
+
+def _reg_data(n=300, f=6, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = X[:, 0] * 2 + np.sin(X[:, 1]) + rng.normal(scale=0.1, size=n)
+    return X, y
+
+
+def _oracle_leaf(tree, X):
+    out = np.zeros(len(X), np.int32)
+    for i, row in enumerate(X):
+        n = 0
+        while tree.feature[n] >= 0:
+            n = (tree.left[n] if row[tree.feature[n]] <= tree.threshold[n]
+                 else tree.right[n])
+        out[i] = n
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Tables: depth packing, true depth, cached device residency
+# ---------------------------------------------------------------------------
+
+def test_table_depth_packing_and_oracle_descent():
+    X, y = _cls_data()
+    f = RandomForestClassifier(
+        n_estimators=5, max_depth=6, random_state=0
+    ).fit(X, y)
+    [tb] = tables_for(f.trees_, group_bytes=None)
+    # Level slabs: offsets monotone, cover all nodes, and every node's
+    # depth matches its slab.
+    assert tb.level_off[0] == 0 and tb.level_off[-1] == tb.n_nodes
+    depths = np.concatenate([
+        np.full(int(tb.level_off[d + 1] - tb.level_off[d]), d)
+        for d in range(len(tb.level_off) - 1)
+    ])
+    all_depth = np.concatenate(
+        [np.asarray(t.depth) for t in f.trees_]
+    )[tb.scatter_order()]
+    assert np.array_equal(depths, all_depth)
+    # Children stay consistent through the permutation.
+    inner = tb.feature >= 0
+    assert (tb.left[inner] >= 0).all() and (tb.right[inner] >= 0).all()
+    # The flat descent agrees with a per-row host recursion.
+    from mpitree_tpu.ops.predict import stacked_leaf_ids
+
+    ids = stacked_leaf_ids(f.trees_, X)
+    for i, t in enumerate(f.trees_):
+        assert np.array_equal(ids[i], _oracle_leaf(t, X))
+
+
+def test_true_depth_n_steps_not_estimator_budget():
+    # Tiny 1-feature data: trees cannot use their max_depth=9 budget.
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 1)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(int)
+    g = GradientBoostingClassifier(
+        max_iter=4, max_depth=9, min_samples_leaf=30, random_state=0
+    ).fit(X, y)
+    true_depth = max(t.max_depth for t in g.trees_)
+    assert true_depth < 9  # the premise: members stopped early
+    [tb] = tables_for(g.trees_, group_bytes=None)
+    assert tb.n_steps == max(true_depth, 1)
+    assert table_notes(g.trees_)["n_steps"] == tb.n_steps
+    # And the short descent still lands every row on its leaf.
+    cm = compile_model(g)
+    assert np.array_equal(cm.predict(X), g.predict(X))
+
+
+def test_fit_report_carries_serving_notes():
+    X, y = _cls_data()
+    clf = DecisionTreeClassifier(max_depth=5).fit(X, y)
+    notes = clf.fit_report_["decisions"]["serving"]
+    assert notes["value"] == "flat-table"
+    assert notes["inputs"]["n_steps"] == max(clf.tree_.max_depth, 1)
+    f = RandomForestRegressor(n_estimators=3, max_depth=4).fit(
+        *_reg_data()
+    )
+    assert f.fit_report_["decisions"]["serving"]["inputs"]["n_trees"] == 3
+
+
+def test_stacked_leaf_ids_no_reupload_after_warm(monkeypatch):
+    X, y = _cls_data()
+    f = RandomForestClassifier(
+        n_estimators=4, max_depth=5, random_state=0
+    ).fit(X, y)
+    from mpitree_tpu.ops import predict as predict_mod
+
+    predict_mod.stacked_leaf_ids(f.trees_, X)  # build + upload tables
+    calls = []
+    real = jax.device_put
+    monkeypatch.setattr(
+        jax, "device_put", lambda *a, **k: calls.append(a) or real(*a, **k)
+    )
+    predict_mod.stacked_leaf_ids(f.trees_, X)
+    # Only the query batch transfers — the PR-6-era per-call re-upload of
+    # every tree slice is gone.
+    assert len(calls) == 1
+
+
+def test_stacked_leaf_ids_grouping_matches_single_table():
+    X, y = _cls_data()
+    f = RandomForestClassifier(
+        n_estimators=6, max_depth=5, random_state=0
+    ).fit(X, y)
+    from mpitree_tpu.ops.predict import stacked_leaf_ids
+
+    one = stacked_leaf_ids(f.trees_, X)
+    # A tiny byte budget forces multiple tables; ids must not change.
+    few = stacked_leaf_ids(f.trees_, X, group_bytes=1)
+    assert len(tables_for(f.trees_, group_bytes=1)) > 1
+    assert np.array_equal(one, few)
+
+
+# ---------------------------------------------------------------------------
+# Parity: serving outputs bit-identical to the estimator surface
+# ---------------------------------------------------------------------------
+
+def test_parity_classifier_tree():
+    X, y = _cls_data()
+    clf = DecisionTreeClassifier(max_depth=7).fit(X, y)
+    cm = compile_model(clf)
+    sp, ep = cm.predict_proba(X), clf.predict_proba(X)
+    assert sp.dtype == ep.dtype and np.array_equal(sp, ep)
+    assert np.array_equal(cm.predict(X), clf.predict(X))
+
+
+def test_parity_classifier_tree_monotonic():
+    X, y = _cls_data(c=2)
+    cst = np.zeros(X.shape[1], int)
+    cst[0] = 1
+    clf = DecisionTreeClassifier(max_depth=5, monotonic_cst=cst).fit(X, y)
+    cm = compile_model(clf)
+    assert np.array_equal(cm.predict(X), clf.predict(X))
+
+
+def test_parity_regressor_tree():
+    X, y = _reg_data()
+    r = DecisionTreeRegressor(max_depth=7).fit(X, y)
+    cm = compile_model(r)
+    assert np.array_equal(cm.predict(X), r.predict(X))
+
+
+def test_parity_forest_classifier():
+    X, y = _cls_data()
+    f = RandomForestClassifier(
+        n_estimators=9, max_depth=6, random_state=0
+    ).fit(X, y)
+    cm = compile_model(f)
+    assert np.array_equal(cm.predict_proba(X), f.predict_proba(X))
+    assert np.array_equal(cm.predict(X), f.predict(X))
+
+
+def test_parity_extratrees():
+    X, y = _cls_data()
+    f = ExtraTreesClassifier(
+        n_estimators=6, max_depth=6, random_state=0
+    ).fit(X, y)
+    cm = compile_model(f)
+    assert np.array_equal(cm.predict_proba(X), f.predict_proba(X))
+
+
+def test_parity_forest_regressor():
+    X, y = _reg_data()
+    f = RandomForestRegressor(
+        n_estimators=7, max_depth=6, random_state=0
+    ).fit(X, y)
+    cm = compile_model(f)
+    assert np.array_equal(cm.predict(X), f.predict(X))
+
+
+def test_parity_gbdt_classifier_multiclass():
+    X, y = _cls_data(c=3)
+    g = GradientBoostingClassifier(
+        max_iter=10, max_depth=3, random_state=0
+    ).fit(X, y)
+    cm = compile_model(g)
+    assert np.array_equal(cm.decision_function(X), g.decision_function(X))
+    assert np.array_equal(cm.predict_proba(X), g.predict_proba(X))
+    assert np.array_equal(cm.predict(X), g.predict(X))
+
+
+def test_recompile_after_lr_edit_rebuilds_margin_channel():
+    # The node table (and its value channels) cache on the trees_ anchor
+    # and OUTLIVE a CompiledModel; the margin channel bakes the learning
+    # rate in. Editing lr and recompiling must serve the NEW scaling, not
+    # the cached channel built under the old one.
+    X, y = _cls_data(c=3)
+    g = GradientBoostingClassifier(
+        max_iter=6, max_depth=3, random_state=0
+    ).fit(X, y)
+    compile_model(g)  # populates the lr=0.1 channel on the shared table
+    g.learning_rate = 0.05
+    cm2 = compile_model(g)
+    assert np.array_equal(cm2.decision_function(X), g.decision_function(X))
+
+
+def test_parity_gbdt_binary_and_regressor():
+    X, y = _cls_data(c=2)
+    g = GradientBoostingClassifier(
+        max_iter=8, max_depth=3, random_state=0
+    ).fit(X, y)
+    cm = compile_model(g)
+    assert np.array_equal(cm.decision_function(X), g.decision_function(X))
+    Xr, yr = _reg_data()
+    gr = GradientBoostingRegressor(
+        max_iter=8, max_depth=3, random_state=0
+    ).fit(Xr, yr)
+    assert np.array_equal(compile_model(gr).predict(Xr), gr.predict(Xr))
+
+
+def test_parity_multidevice_fit():
+    # A mesh-built forest serves from the same tables; serving stays
+    # bit-identical to the (mesh-sharded) estimator predict.
+    X, y = _cls_data(n=512)
+    f = RandomForestClassifier(
+        n_estimators=4, max_depth=5, random_state=0, n_devices=8,
+        backend="cpu",
+    ).fit(X, y)
+    cm = compile_model(f)
+    assert np.array_equal(cm.predict_proba(X), f.predict_proba(X))
+
+
+def test_bucketing_pads_and_chunks():
+    X, y = _cls_data()
+    f = RandomForestClassifier(
+        n_estimators=5, max_depth=5, random_state=0
+    ).fit(X, y)
+    cm = compile_model(f, buckets=(1, 16, 64))
+    for n in (1, 2, 16, 17, 63, 64):  # pad-to-bucket shapes
+        idx = np.arange(n) % len(X)
+        assert np.array_equal(
+            cm.predict_proba(X[idx]), f.predict_proba(X[idx])
+        ), n
+    big = np.tile(X, (2, 1))[:300]  # > max bucket: chunked dispatches
+    assert np.array_equal(cm.predict_proba(big), f.predict_proba(big))
+
+
+# ---------------------------------------------------------------------------
+# Registry: warm pool, swap-under-load, zero-transfer request path
+# ---------------------------------------------------------------------------
+
+def test_registry_swap_zero_new_lowerings_on_request_path(monkeypatch):
+    X, y = _cls_data()
+    f1 = RandomForestClassifier(
+        n_estimators=5, max_depth=5, random_state=0
+    ).fit(X, y)
+    f2 = RandomForestClassifier(
+        n_estimators=5, max_depth=5, random_state=1
+    ).fit(X, y)
+    reg = ModelRegistry(buckets=(1, 16, 64))
+    reg.publish("m", f1)
+    reg.predict("m", X[:3])  # request warm-pool sanity
+    reg.publish("m", f2)     # swap: compiles happen HERE (warmup)...
+    n0 = REGISTRY.count("serving_traverse")
+    calls = []
+    real = jax.device_put
+    monkeypatch.setattr(
+        jax, "device_put", lambda *a, **k: calls.append(a) or real(*a, **k)
+    )
+    for n in (1, 2, 16, 16, 64, 40, 130):  # ...and NONE here.
+        idx = np.arange(n) % len(X)
+        out = reg.predict("m", X[idx])
+        assert out.shape == (n,)
+    assert REGISTRY.count("serving_traverse") == n0
+    # Zero explicit transfers on the warmed request path: the table and
+    # value channels are cached device-resident; only the batch (and its
+    # donated accumulator) ride each jit call implicitly.
+    assert calls == []
+    assert reg.models()["m"]["generation"] == 2
+
+
+def test_registry_unknown_name():
+    reg = ModelRegistry()
+    with pytest.raises(KeyError, match="no model published"):
+        reg.get("ghost")
+
+
+def test_serving_dispatch_blip_rides_retry_ladder():
+    X, y = _cls_data()
+    g = GradientBoostingClassifier(
+        max_iter=4, max_depth=3, random_state=0
+    ).fit(X, y)
+    reg = ModelRegistry(buckets=(64,))
+    with pytest.warns(UserWarning, match="transient device failure"):
+        with chaos.active(Fault("serving_dispatch", 1, "unavailable")) as plan:
+            reg.publish("g", g, warm=False)
+            out = reg.predict("g", X[:10])
+    assert plan.fired == [("serving_dispatch", 1, "unavailable")]
+    assert np.array_equal(out, g.predict(X[:10]))
+    rep = reg.get("g").serve_report_
+    assert rep["counters"]["device_retries"] == 1
+    assert any(e["kind"] == "device_retry" for e in rep["events"])
+
+
+def test_serve_report_counters_and_decisions():
+    X, y = _cls_data()
+    f = RandomForestClassifier(
+        n_estimators=3, max_depth=4, random_state=0
+    ).fit(X, y)
+    cm = compile_model(f, buckets=(32,))
+    cm.predict(X[:10])
+    rep = cm.serve_report_
+    assert rep["decisions"]["serving_compile"]["value"] == "forest_proba"
+    assert rep["decisions"]["serving_kernel"]["value"] == "xla"
+    assert rep["counters"]["serving_requests"] >= 1
+    assert rep["counters"]["serving_rows"] >= 10
+    assert "serving_traverse" in rep["compile"]
+
+
+def test_monotonic_forest_compile_refused():
+    X, y = _cls_data(c=2)
+    cst = np.zeros(X.shape[1], int)
+    cst[0] = 1
+    f = RandomForestClassifier(
+        n_estimators=3, max_depth=4, random_state=0, monotonic_cst=cst
+    ).fit(X, y)
+    with pytest.raises(NotImplementedError, match="monotonic"):
+        compile_model(f)
+
+
+# ---------------------------------------------------------------------------
+# Streaming stage
+# ---------------------------------------------------------------------------
+
+def test_stream_stage_parity_and_backpressure():
+    X, y = _cls_data()
+    g = GradientBoostingClassifier(
+        max_iter=6, max_depth=3, random_state=0
+    ).fit(X, y)
+    cm = compile_model(g, buckets=(64,))
+    stage = StreamStage(cm, depth=2)
+    results = []
+    for lo in range(0, 300, 30):
+        results += stage.submit(X[lo:lo + 30])
+        assert len(stage._inflight) <= 2  # backpressure bound
+    results += stage.drain()
+    assert [t for t, _ in results] == list(range(10))  # order preserved
+    got = np.concatenate([r for _, r in results], axis=0)
+    assert np.array_equal(got, cm.raw(X))
+
+
+def test_stream_stage_forest_mean_shape():
+    # forest means travel on device as an (N, 1) accumulator column; the
+    # stage must hand back the estimator-shaped (N,) result like raw().
+    X, y = _reg_data()
+    f = RandomForestRegressor(
+        n_estimators=4, max_depth=4, random_state=0
+    ).fit(X, y)
+    cm = compile_model(f, buckets=(64,))
+    stage = StreamStage(cm, depth=2)
+    results = stage.submit(X[:50]) + stage.drain()
+    [(_, out)] = results
+    assert out.shape == (50,)
+    assert np.array_equal(out, f.predict(X[:50]))
+
+
+def test_stream_stage_rejects_bad_depth():
+    X, y = _cls_data()
+    g = DecisionTreeClassifier(max_depth=3).fit(X, y)
+    with pytest.raises(ValueError, match="depth"):
+        StreamStage(compile_model(g), depth=0)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel tier (interpret mode on this CPU mesh) + policy
+# ---------------------------------------------------------------------------
+
+def _kernel_reference(trees, X, agg, n_out, values_fn):
+    """Float32 reference for the kernel semantics (numpy)."""
+    out = np.zeros((len(X), n_out), np.float32)
+    for t_i, t in enumerate(trees):
+        ids = _oracle_leaf(t, X)
+        vals = np.asarray(values_fn(t), np.float32).reshape(t.n_nodes, -1)
+        leaf = vals[ids]
+        if agg == "norm":
+            leaf = leaf / np.maximum(
+                leaf.sum(axis=1, keepdims=True), 1.0
+            )
+            out += leaf
+        elif agg == "percls":
+            out[:, t_i % n_out] += leaf[:, 0]
+        else:
+            out += leaf
+    return out
+
+
+@pytest.mark.parametrize("agg", ["norm", "sum", "percls"])
+def test_pallas_kernel_matches_reference(agg):
+    X, y = _cls_data(n=80, f=5)
+    f = RandomForestClassifier(
+        n_estimators=4, max_depth=4, random_state=0
+    ).fit(X, y)
+    trees = list(f.trees_)
+    C = len(f.classes_)
+    if agg == "norm":
+        n_out, kv = C, C
+        values_fn = lambda t: np.asarray(t.count, np.float32)  # noqa: E731
+    elif agg == "percls":
+        n_out, kv = 2, 1
+        values_fn = lambda t: np.asarray(  # noqa: E731
+            t.count[:, 0], np.float32
+        )
+    else:
+        n_out, kv = 1, 1
+        values_fn = lambda t: np.asarray(  # noqa: E731
+            t.n_node_samples, np.float32
+        )
+    tbl, _ = pallas_serve.build_kernel_tables(trees)
+    vals = pallas_serve.build_kernel_values(trees, values_fn, kv)
+    n_steps = max(t.max_depth for t in trees)
+    got = np.asarray(pallas_serve.traverse_batch_pallas(
+        X, tbl, vals, n_steps=max(n_steps, 1), agg=agg, n_out=n_out,
+        kv=kv, row_tile=32, interpret=True,
+    ))
+    want = _kernel_reference(trees, X, agg, n_out, values_fn)
+    # Integer-valued f32 payloads: the one-hot contraction is exact.
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_resolve_serving_kernel_policy(monkeypatch):
+    from mpitree_tpu.obs import BuildObserver
+
+    monkeypatch.delenv("MPITREE_TPU_SERVING_KERNEL", raising=False)
+    # auto: off this CPU mesh (no Mosaic backend).
+    assert not pallas_serve.resolve_serving_kernel(
+        "cpu", n_nodes_max=100, n_features=8, kv=3, n_out=3
+    )
+    # forced pallas off-TPU: GRACEFUL fallback + typed event.
+    obs = BuildObserver()
+    monkeypatch.setenv("MPITREE_TPU_SERVING_KERNEL", "pallas")
+    assert not pallas_serve.resolve_serving_kernel(
+        "cpu", n_nodes_max=100, n_features=8, kv=3, n_out=3, obs=obs
+    )
+    assert any(
+        e["kind"] == "serving_pallas_fallback"
+        for e in obs.record.events
+    )
+    monkeypatch.setenv("MPITREE_TPU_SERVING_KERNEL", "xla")
+    assert not pallas_serve.resolve_serving_kernel(
+        "tpu", n_nodes_max=100, n_features=8, kv=3, n_out=3
+    )
+    monkeypatch.setenv("MPITREE_TPU_SERVING_KERNEL", "bogus")
+    with pytest.raises(ValueError, match="MPITREE_TPU_SERVING_KERNEL"):
+        pallas_serve.resolve_serving_kernel(
+            "tpu", n_nodes_max=100, n_features=8, kv=3, n_out=3
+        )
+    # VMEM sizing: a table too large for the budget is rejected.
+    assert not pallas_serve.fits_vmem(3_000_000, 54, 7, 7)
+    assert pallas_serve.fits_vmem(2048, 54, 7, 7)
+
+
+def test_serving_bench_headline_consumer(tmp_path):
+    import json
+
+    import bench_tpu
+
+    path = tmp_path / "cap.jsonl"
+    rec = {
+        "platform_probe": "cpu",
+        "serving": {
+            "platform": "cpu", "n_trees": 504,
+            "b1_p50_ms": 1.2, "b1_p99_ms": 3.0,
+            "b64_p50_ms": 1.5, "b64_p99_ms": 3.1,
+            "b4096_p50_ms": 20.0, "b4096_p99_ms": 25.0,
+            "sustained_rows_per_s": 1_000_000,
+            "speedup_vs_estimator": 3.4, "kernel": "xla",
+            "request_path_lowerings": 0,
+        },
+    }
+    path.write_text(json.dumps(rec) + "\n")
+    line = bench_tpu.serving_headline(str(path))
+    assert "504 trees" in line and "p99=3.0ms" in line
+    assert "3.4x vs estimator" in line and "request_compiles=0" in line
+    assert bench_tpu.serving_headline(str(tmp_path / "none.jsonl")) is None

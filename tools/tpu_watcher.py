@@ -63,6 +63,9 @@ BUDGET = {
     "north_star_fused": 900,
     "engine_fused": 900,
     "predict": 900,
+    # The ~500-tree GBDT fit (72 levelwise softmax rounds at a 40k-row
+    # cap) dominates; the serving latency sweep itself is seconds.
+    "serving": 1800,
 }
 
 
@@ -252,8 +255,8 @@ def main() -> int:
     # boosting (the new workload) — then the rest.
     p.add_argument("--sections",
                    default="hist_tput,north_star,engine_fused,boosting,"
-                           "device_bin,north_star_fused,engine_levelwise,"
-                           "forest,refine_sweep")
+                           "serving,device_bin,north_star_fused,"
+                           "engine_levelwise,forest,refine_sweep")
     p.add_argument("--redo", default="",
                    help="comma-separated sections to re-measure even if "
                         "already captured (appended after the missing "
