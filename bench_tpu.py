@@ -158,7 +158,8 @@ def _device_platform() -> str:
 # carrying one per section stays inside the driver's tail window.
 RECORD_DIGEST_KEYS = (
     "engine", "reason", "n_nodes", "depth", "levels", "compile_new",
-    "psum_bytes", "sub_frac", "events", "wall_s",
+    "psum_bytes", "sub_frac", "expansions", "rounds_per_dispatch",
+    "events", "wall_s",
 )
 
 
@@ -183,6 +184,10 @@ def format_record_digest(d: dict) -> str:
     )
     if d.get("sub_frac") is not None:
         line += f" sub_frac={d['sub_frac']}"
+    if d.get("expansions") is not None:
+        line += f" expansions={d['expansions']}"
+    if d.get("rounds_per_dispatch") is not None:
+        line += f" rpd={d['rounds_per_dispatch']}"
     if d.get("reason"):
         line += f" reason={d['reason']!r}"
     return line
@@ -201,7 +206,7 @@ def section_record_digest(sec: str, path: str = OUT_PATH) -> str | None:
 
 
 def _timed_fit(Xtr, ytr, *, backend, refine_depth, engine_env=None,
-               warm=True):
+               warm=True, max_leaf_nodes=None):
     """One (optionally cold+warm) timed fit through the device path."""
     from mpitree_tpu import DecisionTreeClassifier
 
@@ -211,7 +216,7 @@ def _timed_fit(Xtr, ytr, *, backend, refine_depth, engine_env=None,
     def once():
         clf = DecisionTreeClassifier(
             max_depth=DEPTH, max_bins=256, backend=backend,
-            refine_depth=refine_depth,
+            refine_depth=refine_depth, max_leaf_nodes=max_leaf_nodes,
         )
         t0 = time.perf_counter()
         clf.fit(Xtr, ytr)
@@ -751,6 +756,142 @@ def worker_boosting(npz_path: str) -> dict:
     return out
 
 
+def worker_leafwise_ab(npz_path: str) -> dict:
+    """Leaf-wise vs level-wise A/B at the north-star depth (ISSUE 8).
+
+    Two full-depth single-engine device fits of the same covtype
+    workload — the level-synchronous frontier at ``max_depth=20`` vs the
+    best-first frontier at ``max_leaf_nodes=255`` — with the always-on
+    ``rows_scanned`` accounting deciding the headline: histogram cells
+    actually scanned per finished tree (``rows_scanned * n_features``;
+    the psum payload ratio rides the embedded record digests). The
+    acceptance bar is >=2x fewer cells at equal accuracy (+-0.002
+    against the sklearn best-first reference at the same leaf budget),
+    measured from the records rather than asserted.
+    """
+    Xtr, ytr, Xte, yte = _load(npz_path)
+    platform = _device_platform()
+    F = Xtr.shape[1]
+    out: dict = {
+        "platform": platform, "max_depth": DEPTH, "max_leaf_nodes": 255,
+    }
+
+    def side(mln):
+        # refine_depth=None: the host refine tail would hide the device
+        # frontier's scan counters — both sides build full-depth on the
+        # device engines (the leaf-wise path is single-engine anyway).
+        sec, clf = _timed_fit(
+            Xtr, ytr, backend=platform, refine_depth=None,
+            max_leaf_nodes=mln,
+        )
+        counters = clf.fit_report_.get("counters", {})
+        scanned = counters.get("rows_scanned")
+        sec["test_acc"] = round(float((clf.predict(Xte) == yte).mean()), 4)
+        sec["rows_scanned"] = None if scanned is None else int(scanned)
+        sec["cells_scanned"] = (
+            None if scanned is None else int(scanned * F)
+        )
+        return sec
+
+    out["levelwise"] = side(None)
+    out["leafwise"] = side(255)
+    lvl_cells = out["levelwise"]["cells_scanned"]
+    lw_cells = out["leafwise"]["cells_scanned"]
+    if lvl_cells and lw_cells:
+        out["scan_reduction_x"] = round(lvl_cells / lw_cells, 2)
+    lvl_psum = (out["levelwise"].get("record") or {}).get("psum_bytes")
+    lw_psum = (out["leafwise"].get("record") or {}).get("psum_bytes")
+    if lvl_psum and lw_psum:
+        out["psum_reduction_x"] = round(lvl_psum / lw_psum, 2)
+    out["warm_speedup_x"] = round(
+        out["levelwise"]["warm_s"] / out["leafwise"]["warm_s"], 3
+    )
+    # The "equal accuracy" reference: sklearn's own best-first grower at
+    # the identical leaf budget (it switches to a priority frontier
+    # whenever max_leaf_nodes is set), exact splits on the raw floats.
+    from sklearn.tree import DecisionTreeClassifier as SkTree
+
+    t0 = time.perf_counter()
+    sk = SkTree(
+        max_leaf_nodes=255, max_depth=DEPTH, random_state=0
+    ).fit(Xtr, ytr)
+    sk_acc = round(float((sk.predict(Xte) == yte).mean()), 4)
+    out["sklearn"] = {
+        "fit_s": round(time.perf_counter() - t0, 3), "test_acc": sk_acc,
+    }
+    out["acc_delta_vs_sklearn"] = round(
+        out["leafwise"]["test_acc"] - sk_acc, 4
+    )
+    return out
+
+
+def worker_gbdt_fusedK(npz_path: str) -> dict:
+    """Fused multi-round GBDT dispatch A/B (ISSUE 8).
+
+    Binary covtype (class 2 vs rest, ~49/51) because the fused program
+    requires one tree per round; 16 logistic rounds at depth 4 through
+    the host per-round loop (``rounds_per_dispatch=1``) vs the K=8 fused
+    ``lax.scan`` program — the evidence ROADMAP item 2 asked for:
+    per-round dispatch count cut to 1/K (the ``fused_round_dispatches``
+    counter) with <=1 new compile cache-key per (K, shape) bucket (the
+    ``fused_rounds_fn`` registry entry), plus the documented f32-margin
+    divergence measured as a max-abs-proba delta.
+    """
+    from mpitree_tpu import GradientBoostingClassifier
+    from mpitree_tpu.obs import REGISTRY
+
+    Xtr, ytr, Xte, yte = _load(npz_path)
+    platform = _device_platform()
+    ytr2 = (ytr == 2).astype(np.int64)
+    yte2 = (yte == 2).astype(np.int64)
+    iters, K = 16, 8
+    out: dict = {
+        "platform": platform, "max_iter": iters, "max_depth": 4, "K": K,
+    }
+
+    def side(rpd):
+        keys0 = REGISTRY.count("fused_rounds_fn")
+        t0 = time.perf_counter()
+        clf = GradientBoostingClassifier(
+            max_iter=iters, max_depth=4, max_bins=256, backend=platform,
+            random_state=0, rounds_per_dispatch=rpd,
+        ).fit(Xtr, ytr2)
+        fit_s = time.perf_counter() - t0
+        counters = clf.fit_report_.get("counters", {})
+        sec = {
+            "fit_s": round(fit_s, 3),
+            "round_s": round(fit_s / max(clf.n_iter_, 1), 3),
+            # Host loop: one build dispatch per round; fused: the counted
+            # K-round dispatches.
+            "dispatches": int(
+                counters.get("fused_round_dispatches") or iters
+            ),
+            "new_compile_keys": REGISTRY.count("fused_rounds_fn") - keys0,
+            "test_acc": round(
+                float((clf.predict(Xte) == yte2).mean()), 4
+            ),
+            "record": record_digest(clf.fit_report_),
+        }
+        return sec, clf
+
+    out["host_loop"], host_clf = side(1)
+    out["fused"], fused_clf = side(K)
+    out["dispatch_reduction_x"] = round(
+        out["host_loop"]["dispatches"] / out["fused"]["dispatches"], 2
+    )
+    out["fit_speedup_x"] = round(
+        out["host_loop"]["fit_s"] / out["fused"]["fit_s"], 3
+    )
+    # Documented divergence (f64 host margins vs the fused f32 carry):
+    # quantify it so "bit-identical across mesh sizes, NOT across
+    # rounds_per_dispatch" stays an honest, measured statement.
+    sample = Xte[:10_000]
+    out["max_abs_proba_delta"] = round(float(np.max(np.abs(
+        host_clf.predict_proba(sample) - fused_clf.predict_proba(sample)
+    ))), 6)
+    return out
+
+
 def worker_serving(npz_path: str) -> dict:
     """The compiled-serving section (mpitree_tpu.serving, ISSUE 7).
 
@@ -869,6 +1010,8 @@ WORKERS = {
     "forest": worker_forest,
     "predict": worker_predict,
     "boosting": worker_boosting,
+    "leafwise_ab": worker_leafwise_ab,
+    "gbdt_fusedK": worker_gbdt_fusedK,
     "serving": worker_serving,
 }
 
@@ -1104,7 +1247,8 @@ def main() -> int:
     # the most evidence per second come first (hist_tput -> north_star ->
     # engine_fused -> boosting -> the rest).
     p.add_argument("--sections", default="hist_tput,north_star,"
-                   "engine_fused,boosting,serving,engine_levelwise,forest")
+                   "engine_fused,boosting,leafwise_ab,gbdt_fusedK,"
+                   "serving,engine_levelwise,forest")
     p.add_argument("--timeout", type=int, default=SECTION_TIMEOUT_S)
     p.add_argument("--platform", default="auto",
                    help="jax platform for every section (auto = probe, "

@@ -1,0 +1,537 @@
+"""Fused multi-round GBDT device program (``rounds_per_dispatch=K``).
+
+The host boosting loop pays one full dispatch round trip — and, cold,
+one compile-cache probe — PER ROUND: gradients out, tree build dispatch,
+decisions back, margins updated, repeat. For shallow-tree GBDT that
+per-round traffic dominates the arithmetic the same way the PR-7 serving
+capture showed request-path compiles dominating inference. This module
+runs **K full boosting rounds inside one compiled dispatch**: a
+``lax.scan`` whose body recomputes (g, h) from the carried f32 margins,
+grows one leaf-wise tree (``core/leafwise_builder._make_leafwise_body``
+— the best-first pool rides entirely in-program), refits leaf values
+from f64-scoped (G, H) sums rounded to f32, and applies the
+learning-rate-shrunk update to the donated margin carry. Per-ensemble
+dispatch count drops to ``ceil(max_iter / K)`` and the compile-cache
+sees ONE key per (K, shape) bucket.
+
+Determinism contract (CPU meshes): the (g, h) recompute is elementwise
+per row (mesh-layout-free); histograms accumulate scoped-f64 and round
+to f32 after the psum (``resolve_gbdt_x64``, the PR-2 closure); leaf
+(G, H) sums accumulate scoped-f64 and ROUND TO f32 before the division,
+so every mesh size computes identical leaf values — fused-round
+ensembles are bit-identical across mesh sizes. They are NOT bit-identical
+to ``rounds_per_dispatch=1`` fits: the host loop carries f64 margins and
+f64 leaf refits, the fused program carries f32 margins (documented
+divergence, the price of the in-program carry). Keyed row subsampling
+(``ops/sampling.row_subsample_mask_jnp``) is a pure function of
+(seed, round, global row), so checkpoint-resumed fused fits replay the
+identical draws — resume stays bit-identical.
+
+Eligibility (``resolve_rounds_per_dispatch``): one tree per round
+(binary logistic / squared error), no early stopping (held-out scoring
+is per-round host work), no ``colsample_bytree`` (per-round column
+slices change the compiled shape), and a static leaf budget
+(``max_depth`` and/or ``max_leaf_nodes``). ``"auto"`` engages K=8 on
+accelerator platforms only — on XLA-CPU dispatch is cheap and the
+per-expansion leaf-wise scan costs more than it saves;
+``MPITREE_TPU_ROUNDS_PER_DISPATCH`` steers the default, an explicit
+``rounds_per_dispatch=K`` forces any platform (the CPU determinism tests
+ride it) and raises on ineligible configurations rather than silently
+degrading.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from mpitree_tpu.core import leafwise_builder as leafwise
+from mpitree_tpu.obs import accounting as obs_acct
+from mpitree_tpu.core.builder import (
+    fetch_row_nodes,
+    resolve_gbdt_x64,
+    resolve_hist_subtraction,
+)
+from mpitree_tpu.ops import sampling as sampling_ops
+from mpitree_tpu.parallel import mesh as mesh_lib
+from mpitree_tpu.parallel.mesh import DATA_AXIS
+from mpitree_tpu.resilience import chaos, retry_device
+
+DEFAULT_ROUNDS_PER_DISPATCH = 8
+
+# Leaf-pool ceiling for the fused program: each open leaf is one
+# SEQUENTIAL expansion step inside the scanned round body, so a pool
+# this wide already runs thousands of per-expansion psums per round —
+# past it the level-wise host loop's chunked dispatches win regardless
+# of round-trip savings (and under subtraction the pool-resident
+# histograms scale with the pool too).
+FUSED_POOL_CEILING = 4096
+
+
+def resolve_rounds_per_dispatch(param, *, platform: str, loss_kind,
+                                loss_K: int, early_stopping: bool,
+                                colsample: float, max_depth,
+                                max_leaf_nodes, n_samples=None,
+                                n_features=None, n_bins=None,
+                                hist_budget_bytes=None) -> tuple:
+    """Resolve the estimator's ``rounds_per_dispatch`` into (K, reason).
+
+    Follows the engine-resolution idiom: the env var steers the "auto"
+    default only; an explicit integer wins — and raises when the
+    configuration cannot honor it (silent degradation would attribute
+    host-loop timings to the fused program).
+
+    ``n_samples``/``n_features``/``n_bins``/``hist_budget_bytes`` (all
+    optional) size the in-program leaf pool: a ``max_depth``-only config
+    implies a ``2^max_depth`` pool, and past :data:`FUSED_POOL_CEILING`
+    open leaves — or a pool-resident histogram estimate over the
+    histogram HBM budget — the fused program would be pathologically
+    large, so the guard blocks it like any other ineligibility.
+    """
+    blockers = []
+    if n_samples is not None:
+        pn = leafwise._pool_capacity(
+            max_leaf_nodes if max_leaf_nodes is not None else 1 << 30,
+            max_depth, int(n_samples),
+        )
+        # (count, g, h) f32 pool histograms under subtraction — the
+        # widest buffer the scanned build carries.
+        pool_bytes = (
+            pn * max(int(n_features or 1), 1)
+            * 3 * max(int(n_bins or 256), 1) * 4
+        )
+        budget = (
+            int(hist_budget_bytes) if hist_budget_bytes else 4 << 30
+        )
+        if pn > FUSED_POOL_CEILING or pool_bytes > budget:
+            blockers.append(
+                f"leaf pool of {pn} open leaves exceeds the fused-program "
+                f"budget (> {FUSED_POOL_CEILING} sequential expansions "
+                f"per round, or ~{pool_bytes >> 20} MiB pool histograms "
+                "vs hist_budget_bytes) — set max_leaf_nodes to bound it"
+            )
+    if loss_K > 1 or loss_kind is None:
+        blockers.append(
+            "the loss has no in-device twin (multiclass softmax fits one "
+            "tree per class per round)"
+        )
+    if early_stopping:
+        blockers.append(
+            "early_stopping scores the held-out slice per round on host"
+        )
+    if float(colsample) < 1.0:
+        blockers.append(
+            "colsample_bytree < 1 re-slices the binned matrix per round "
+            "(one compiled shape per round set)"
+        )
+    if max_depth is None and max_leaf_nodes is None:
+        blockers.append(
+            "unbounded trees: the in-program leaf pool needs a static "
+            "budget (set max_depth or max_leaf_nodes)"
+        )
+    flag = "auto" if param in (None, "auto") else param
+    from_env = False
+    env_note = ""
+    if flag == "auto":
+        env = os.environ.get("MPITREE_TPU_ROUNDS_PER_DISPATCH", "auto")
+        if env != "auto":
+            try:
+                ek = int(env)
+            except ValueError:
+                ek = -1
+            if ek >= 1:
+                flag, from_env = ek, True
+            else:
+                # An ambient env setting must never crash fits — an
+                # invalid value falls back to auto, with the reason
+                # string carrying the evidence for triage.
+                env_note = (
+                    f"MPITREE_TPU_ROUNDS_PER_DISPATCH={env!r} invalid "
+                    "(ignored; use an integer >= 1 or 'auto'); "
+                )
+    if flag == "auto":
+        if blockers:
+            return 1, env_note + "auto: " + "; ".join(blockers)
+        if platform not in ("tpu", "axon"):
+            return 1, env_note + (
+                "auto: host-per-round on XLA-CPU — dispatch is cheap "
+                "there and the leaf-wise in-program build scans more "
+                "(accelerators amortize K rounds per dispatch instead)"
+            )
+        return DEFAULT_ROUNDS_PER_DISPATCH, env_note + (
+            f"auto: accelerator platform — {DEFAULT_ROUNDS_PER_DISPATCH} "
+            "rounds per dispatch amortize round-trip and compile-cache "
+            "traffic"
+        )
+    k = int(flag)
+    if k < 1:
+        raise ValueError(
+            f"rounds_per_dispatch must be >= 1 or 'auto', got {param!r}"
+        )
+    if k > 1 and blockers:
+        if from_env:
+            # The env var steers the DEFAULT only — an ambient setting
+            # must not crash fits it cannot apply to (the estimator
+            # param is the consent surface for that).
+            return 1, (
+                f"MPITREE_TPU_ROUNDS_PER_DISPATCH={k} overridden "
+                "(env steers the auto default only): " + "; ".join(blockers)
+            )
+        raise ValueError(
+            f"rounds_per_dispatch={k} cannot apply: " + "; ".join(blockers)
+        )
+    if from_env:
+        return k, f"explicit MPITREE_TPU_ROUNDS_PER_DISPATCH={k}"
+    return k, f"explicit rounds_per_dispatch={k}"
+
+
+def _grad_hess_jnp(loss_kind: str, raw, y):
+    """In-scan (g, h) twins of ``boosting/losses.py`` (f32 elementwise)."""
+    if loss_kind == "squared_error":
+        g = raw - y
+        return g, jnp.ones_like(g)
+    # logistic — the host's tanh form, stable at both tails
+    p = 0.5 * (1.0 + jnp.tanh(0.5 * raw))
+    return p - y, p * (1.0 - p)
+
+
+def _loss_rows_jnp(loss_kind: str, raw, y):
+    """Per-row loss twins (the in-dispatch train-score channel)."""
+    if loss_kind == "squared_error":
+        return 0.5 * (raw - y) ** 2
+    return jnp.logaddexp(0.0, raw) - y * raw
+
+
+@lru_cache(maxsize=16)
+def _make_rounds_fn(mesh, *, loss_kind: str, n_rounds: int, n_bins: int,
+                    max_leaves: int, max_depth: int, min_samples_split: int,
+                    gbdt_x64: bool, subtraction: bool, subsample_on: bool):
+    """One jitted program running ``n_rounds`` boosting rounds.
+
+    (xb, y, raw0, sw, cand_mask, mcw, mid, lam, msl, msg, lr, r0, seed,
+    sub_thresh) -> (raw_out, feat, bin, counts, n, left, parent, n_nodes,
+    G, H, loss_sum, loss_weight) with every tree output stacked
+    (n_rounds, ...). ``r0`` is a RUNTIME round offset so every dispatch
+    of the same width — including checkpoint-resumed ones — shares one
+    executable.
+    """
+    M = 2 * max_leaves - 1
+    build = leafwise._make_leafwise_body(
+        n_bins=n_bins, n_classes=3, task="gbdt", criterion="mse",
+        max_leaves=max_leaves, max_depth=max_depth,
+        min_samples_split=min_samples_split, psum_axis=DATA_AXIS,
+        exact_ties=False, gbdt_x64=gbdt_x64, subtraction=subtraction,
+    )
+
+    # graftlint: device-fn (jit-wrapped through jax.shard_map below)
+    def program(xb, y, raw0, sw, cand_mask, mcw, mid, lam, msl, msg, lr,
+                r0, seed, sub_thresh):
+        R = y.shape[0]
+        j = lax.axis_index(DATA_AXIS).astype(jnp.uint32)
+        gidx = j * jnp.uint32(R) + jnp.arange(R, dtype=jnp.uint32)
+
+        def round_step(raw, r):
+            g, h = _grad_hess_jnp(loss_kind, raw, y)
+            g = g * sw
+            h = h * sw
+            if subsample_on:
+                m = sampling_ops.row_subsample_mask_jnp(
+                    seed, r, gidx, sub_thresh
+                ).astype(jnp.float32)
+                g = g * m
+                h = h * m
+            nid0 = jnp.zeros(R, jnp.int32)
+            out = build(xb, g, nid0, h, cand_mask, mcw, mid, lam, msl, msg)
+            feat_a, bin_a, counts_a, n_a, left_a, parent_a = out[:6]
+            nid_f, n_nodes = out[7], out[8]
+            # Leaf (G, H): scoped-f64 accumulation ROUNDED to f32 before
+            # the division — any row partition rounds to the same f32
+            # sums (29 spare mantissa bits over the f32 terms), so leaf
+            # values — and therefore margins, and therefore every later
+            # round — are identical at every mesh size.
+            if gbdt_x64:
+                with jax.enable_x64(True):
+                    zero = jnp.zeros(M, jnp.float32).astype(jnp.float64)
+                    G = lax.psum(
+                        zero.at[nid_f].add(g.astype(jnp.float64)),
+                        DATA_AXIS,
+                    ).astype(jnp.float32)
+                    H = lax.psum(
+                        zero.at[nid_f].add(h.astype(jnp.float64)),
+                        DATA_AXIS,
+                    ).astype(jnp.float32)
+            else:
+                G = lax.psum(
+                    jax.ops.segment_sum(g, nid_f, num_segments=M), DATA_AXIS
+                )
+                H = lax.psum(
+                    jax.ops.segment_sum(h, nid_f, num_segments=M), DATA_AXIS
+                )
+            # The host refit mirror (run_fused_rounds) reproduces this
+            # f32 arithmetic bit for bit into tree.count[:, 0].
+            vals = -G / jnp.maximum(H + lam, 1e-12)
+            raw_new = raw + lr * jnp.take(vals, nid_f, mode="clip")
+            ls = lax.psum(
+                jnp.sum(sw * _loss_rows_jnp(loss_kind, raw_new, y)),
+                DATA_AXIS,
+            )
+            lw = lax.psum(jnp.sum(sw), DATA_AXIS)
+            return raw_new, (feat_a, bin_a, counts_a, n_a, left_a,
+                             parent_a, n_nodes, G, H, ls, lw)
+
+        raw_out, stacks = lax.scan(
+            round_step, raw0, r0 + jnp.arange(n_rounds, dtype=jnp.int32)
+        )
+        return (raw_out,) + stacks
+
+    sharded = jax.shard_map(
+        program,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS), P(), P(), P(), P(), P(), P(), P(), P(),
+                  P(), P()),
+        out_specs=(P(DATA_AXIS),) + tuple(P() for _ in range(11)),
+    )
+    # The margin carry is donated (GL05: jit-of-lax-scan): each dispatch
+    # device_puts a FRESH raw shard from the host mirror (GL08-safe — a
+    # retried dispatch can never re-read a consumed buffer).
+    return jax.jit(sharded, donate_argnums=(2,))
+
+
+def _finalize_round_tree(binned, feat, bins, counts, nvec, left, parent,
+                         n_nodes, G32, H32, reg_lambda: float):
+    """One scanned round's buffers -> a host TreeArrays with f64 refit.
+
+    The Newton rollup mirrors ``gradient_boosting._newton_refit`` but
+    starts from the DEVICE's psum'd-and-rounded per-leaf (G, H) — leaf
+    values reproduce the in-program f32 division bit for bit, so the
+    predict surface replays the training-time margins exactly (in f64
+    accumulation; interior values/impurities come from the f64 rollup).
+    """
+    tree, perm = leafwise._finalize_leafwise(
+        binned, "gbdt", "mse", n_nodes, feat, bins, counts, nvec, left,
+        parent, integer_counts=False,
+    )
+    G = np.zeros(tree.n_nodes)
+    H = np.zeros(tree.n_nodes)
+    G[perm] = np.asarray(G32[:n_nodes], np.float64)
+    H[perm] = np.asarray(H32[:n_nodes], np.float64)
+    for i in range(tree.n_nodes - 1, 0, -1):
+        p = tree.parent[i]
+        if p < 0:
+            continue
+        G[p] += G[i]
+        H[p] += H[i]
+    denom = np.maximum(H + reg_lambda, 1e-12)
+    vals = -G / denom
+    leaves = tree.left < 0
+    # Leaf arithmetic replayed in f32 — the device computed
+    # -G32 / max(H32 + lam, 1e-12) in f32 and updated margins with it.
+    lam32 = np.float32(reg_lambda)
+    vals32 = -G[leaves].astype(np.float32) / np.maximum(
+        H[leaves].astype(np.float32) + lam32, np.float32(1e-12)
+    )
+    vals[leaves] = vals32.astype(np.float64)
+    tree.value = vals.astype(np.float32)
+    tree.count[:, 0] = vals
+    tree.impurity = 0.5 * G * G / denom
+    return tree
+
+
+# graftlint: host-fn — the dispatch-granular boosting driver: host
+# mirrors of margins/scores and per-dispatch device_get are its job
+def run_fused_rounds(*, binned, y_tr, sw_tr, raw_tr, trees, train_scores,
+                     start_round: int, max_iter: int, cfg, mesh, obs,
+                     seed: int, ck, lr: float, loss_kind: str,
+                     rounds_per_dispatch: int, subsample: float,
+                     checkpoint_every: int, verbose: bool = False) -> int:
+    """Drive the boosting fit in K-round fused dispatches.
+
+    Mutates ``trees``/``train_scores``/``raw_tr`` in place (the same
+    state the host loop owns) and returns the completed round count.
+    Checkpoints flush at DISPATCH boundaries: whenever a dispatch crosses
+    a ``checkpoint_every`` multiple, the completed rounds' trees plus the
+    exact margin mirror persist — a killed fit re-run with the same
+    params resumes bit-identically (the keyed subsample masks and the
+    runtime ``r0`` operand make resumed dispatches replay exactly).
+    """
+    N = binned.x_binned.shape[0]
+    B = binned.n_bins
+    platform = mesh.devices.flat[0].platform
+    gbdt_x64 = resolve_gbdt_x64(platform)
+    # Ceiling guard bound: per-round f32 hessian totals never exceed
+    # sum(sw) (squared error h == sw, logistic h <= sw/4), so the
+    # weight total is a static upper bound for EVERY scanned round —
+    # past 2**24 the parent-minus-small reconstruction could cancel
+    # into a corrupt large-child histogram, and the guard falls back
+    # to direct accumulation exactly like the level-wise twin (the
+    # scoped-f64 CPU path is exempt inside resolve_hist_subtraction).
+    total_w = float(np.sum(sw_tr)) if sw_tr is not None else float(N)
+    use_sub = resolve_hist_subtraction(
+        cfg, platform, "gbdt", integer_ok=False, gbdt_x64=gbdt_x64,
+        total_weight=total_w, obs=obs,
+    )
+    Pn = leafwise._pool_capacity(
+        cfg.max_leaf_nodes if cfg.max_leaf_nodes is not None else 1 << 30,
+        cfg.max_depth, N,
+    )
+    md = -1 if cfg.max_depth is None else int(cfg.max_depth)
+    subsample_on = float(subsample) < 1.0
+
+    with obs.span("shard"):
+        yf = np.ascontiguousarray(y_tr, np.float32)
+        xb_d, y_d, w_d, _nid_d, cand_d = mesh_lib.shard_build_inputs(
+            mesh, binned, yf, sw_tr
+        )
+    pad = mesh_lib.pad_rows(N, mesh_lib.data_shards(mesh))
+
+    mcw = np.float32(cfg.min_child_weight)
+    mid = np.float32(cfg.min_decrease_scaled)
+    lam = np.float32(cfg.reg_lambda)
+    msl = np.float32(cfg.min_leaf_rows)
+    msg = np.float32(cfg.min_split_gain)
+    lr32 = np.float32(lr)
+    sub_thresh = (
+        sampling_ops.subsample_threshold_u32(float(subsample))
+        if subsample_on else np.uint32(0)
+    )
+
+    # The fused path never routes through build_tree, so the record's
+    # engine attribution (what the digest leads with) is claimed here.
+    obs.decision(
+        "engine", "fused_rounds",
+        reason=(
+            f"rounds_per_dispatch={rounds_per_dispatch}: K full boosting "
+            "rounds (grad/hess, leaf-wise build, leaf refit, margin "
+            "update) per compiled lax.scan dispatch"
+        ),
+        rounds_per_dispatch=int(rounds_per_dispatch), pool=int(Pn),
+    )
+
+    raw32 = np.ascontiguousarray(raw_tr[:, 0], np.float32)
+    r = start_round
+    while r < max_iter:
+        k = min(int(rounds_per_dispatch), max_iter - r)
+        fn_kw = dict(
+            loss_kind=loss_kind, n_rounds=k, n_bins=B, max_leaves=Pn,
+            max_depth=md, min_samples_split=int(cfg.min_samples_split),
+            gbdt_x64=gbdt_x64, subtraction=use_sub,
+            subsample_on=subsample_on,
+        )
+        fn = _make_rounds_fn(mesh, **fn_kw)
+        obs.compile_note(
+            "fused_rounds_fn", (mesh,) + tuple(sorted(fn_kw.items())),
+            cache_size=16,
+        )
+
+        def dispatch():
+            # Chaos seam INSIDE the retried closure: a planned blip here
+            # exercises the retry rung exactly like a transport loss at
+            # the dispatch boundary (resilience.chaos).
+            chaos.step("fused_rounds")
+            # grad_hess corrupt seam, fused twin: (g, h) are recomputed
+            # in-program from the margins, so poisoning the margin
+            # mirror is how a corrupt loss channel enters here — the
+            # NaN rides into every psum'd total and the post-dispatch
+            # guard below fails fast exactly like the host loop's.
+            raw_c = chaos.corrupt("grad_hess", raw32)
+            raw_p = (
+                np.concatenate([raw_c, np.zeros(pad, np.float32)])
+                if pad else raw_c
+            )
+            raw_d = mesh_lib.shard_rows(mesh, raw_p)
+            return fn(xb_d, y_d, raw_d, w_d, cand_d, mcw, mid, lam, msl,
+                      msg, lr32, np.int32(r), np.uint32(seed), sub_thresh)
+
+        with obs.span("fused_rounds"):
+            out = retry_device(
+                dispatch, what=f"gbdt fused rounds {r}..{r + k - 1}",
+                obs=obs,
+            )
+            raw32 = np.ascontiguousarray(fetch_row_nodes(out[0], N))
+            (feat_s, bin_s, counts_s, n_s, left_s, parent_s, nn_s, G_s,
+             H_s, ls_s, lw_s) = jax.device_get(out[1:])
+        for i in range(k):
+            # Non-finite guard, fused twin of the host loop's: a poisoned
+            # loss channel (overflowed f32 margin carry, NaN targets, a
+            # chaos injection) poisons the psum'd (G, H)/loss totals and
+            # every scanned round after it. The totals are already on
+            # host — checking them is O(pool) — so fail fast with the
+            # same typed event instead of silently appending garbage
+            # trees; rounds before the poisoned one stay finalized.
+            gt, ht = float(np.sum(G_s[i])), float(np.sum(H_s[i]))
+            if not (np.isfinite(gt) and np.isfinite(ht)
+                    and np.isfinite(float(ls_s[i]))):
+                err = (
+                    f"non-finite gradient/hessian totals at boosting "
+                    f"round {r + i} (G_total={gt}, H_total={ht}, in a "
+                    f"fused rounds_per_dispatch={rounds_per_dispatch} "
+                    "dispatch): the f32 margin carry has overflowed or "
+                    "the inputs carry non-finite values; lower "
+                    "learning_rate, rescale targets/sample_weight, or "
+                    "set rounds_per_dispatch=1 for the f64-margin host "
+                    "loop — refusing to fit garbage rounds"
+                )
+                obs.event("nonfinite_grad", err)
+                raise FloatingPointError(err)
+            tree = _finalize_round_tree(
+                binned, feat_s[i], bin_s[i], counts_s[i], n_s[i],
+                left_s[i], parent_s[i], int(nn_s[i]), G_s[i], H_s[i],
+                float(cfg.reg_lambda),
+            )
+            trees.append(tree)
+            # Realized-work replay per finished round tree — the
+            # in-program build emits no live counters, but the structure
+            # replays its expansion work exactly (same accounting as the
+            # single-tree fused leaf-wise engine), so the record's
+            # rows_scanned / psum payload / expansions stay comparable
+            # with the host per-round loop's live numbers.
+            rows_i, coll_i, counters_i = obs_acct.leafwise_scan_rows(
+                tree, n_features=binned.x_binned.shape[1], n_bins=B,
+                n_channels=3, task="gbdt", subtraction=use_sub,
+                gbdt_x64=gbdt_x64,
+            )
+            for name, v in counters_i.items():
+                obs.counter(name, v)
+            for site, v in coll_i.items():
+                obs.collective(site, calls=v["calls"], nbytes=v["bytes"])
+            for row in rows_i:
+                obs.level(**row)
+            mean_loss = float(ls_s[i]) / max(float(lw_s[i]), 1e-300)
+            train_scores.append(-mean_loss)
+            obs.round(
+                round=r + i, trees=1, subsample=float(subsample),
+                colsample=1.0, train_loss=mean_loss, val_loss=None,
+                stale=None, early_stop=False, seconds=None,
+                rounds_per_dispatch=int(rounds_per_dispatch),
+            )
+        obs.counter("fused_round_dispatches")
+        obs.counter("rounds_fused", k)
+        new_r = r + k
+        if verbose:
+            # The host loop prints every 10th round; one dispatch IS the
+            # progress granularity here (per-round losses landed above),
+            # so print per dispatch — a hung dispatch stays tellable
+            # from normal progress.
+            print(
+                f"[gbdt] rounds {r + 1}..{new_r}/{max_iter} (fused "
+                f"dispatch) train_loss={-train_scores[-1]:.6f}"
+            )
+        if ck is not None and (
+            new_r // int(checkpoint_every) > r // int(checkpoint_every)
+        ):
+            raw_tr[:, 0] = raw32
+            state = {
+                "raw_tr": raw_tr,
+                "train_scores": np.asarray(train_scores, np.float64),
+            }
+            ck.append(trees[len(ck.trees):], state)
+        r = new_r
+    raw_tr[:, 0] = raw32
+    return r

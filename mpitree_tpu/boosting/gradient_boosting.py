@@ -37,6 +37,7 @@ channel can never silently fit garbage rounds.
 
 from __future__ import annotations
 
+import numbers
 import time
 from functools import partial
 
@@ -62,6 +63,7 @@ from mpitree_tpu.utils.validation import (
     feature_names_of,
     resolve_min_samples_leaf,
     validate_fit_data,
+    validate_max_leaf_nodes,
     validate_predict_data,
     validate_sample_weight,
 )
@@ -142,6 +144,7 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
     """Shared fit/predict machinery; subclasses bind the task and loss."""
 
     def __init__(self, *, loss, learning_rate=0.1, max_iter=100, max_depth=6,
+                 max_leaf_nodes=None, rounds_per_dispatch="auto",
                  max_bins=256, binning="auto", subsample=1.0,
                  colsample_bytree=1.0,
                  min_samples_split=2, min_samples_leaf=20,
@@ -154,6 +157,15 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
         self.learning_rate = learning_rate
         self.max_iter = max_iter
         self.max_depth = max_depth
+        # Leaf-wise growth budget (LightGBM's num_leaves): rounds grow
+        # best-first through core/leafwise_builder when set; None keeps
+        # the depth-wise level-synchronous engine.
+        self.max_leaf_nodes = max_leaf_nodes
+        # K boosting rounds per compiled device dispatch (boosting/
+        # fused_rounds.py): "auto" = 8 on accelerators when eligible,
+        # host-per-round otherwise; an explicit K forces (and raises on
+        # ineligible configs).
+        self.rounds_per_dispatch = rounds_per_dispatch
         self.max_bins = max_bins
         self.binning = binning
         self.subsample = subsample
@@ -205,6 +217,19 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
             raise ValueError(
                 f"checkpoint_every must be >= 1, got {self.checkpoint_every!r}"
             )
+        # Shared grammar + the backend="host" refusal (boosting rounds
+        # run the device engines only, same as the tree estimators).
+        validate_max_leaf_nodes(self)
+        rpd = self.rounds_per_dispatch
+        if rpd not in (None, "auto"):
+            # Strict grammar like every other param here: integral values
+            # only (a float would silently truncate through int()).
+            if (not isinstance(rpd, numbers.Integral)
+                    or isinstance(rpd, bool) or int(rpd) < 1):
+                raise ValueError(
+                    "rounds_per_dispatch must be an integer >= 1 or "
+                    f"'auto', got {rpd!r}"
+                )
 
     def _fit(self, X, y, sample_weight, *, task):
         self._validate_params_()
@@ -269,6 +294,10 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
         cfg = BuildConfig(
             task="gbdt",
             max_depth=self.max_depth,
+            max_leaf_nodes=(
+                None if self.max_leaf_nodes is None
+                else int(self.max_leaf_nodes)
+            ),
             min_samples_split=int(self.min_samples_split),
             min_child_weight=float(self.min_child_weight),
             reg_lambda=float(self.reg_lambda),
@@ -376,7 +405,53 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
                     f"({len(trees)} trees) from {self.checkpoint}",
                     rounds=n_rounds,
                 )
-        for r in range(start_round, int(self.max_iter)):
+        # Fused multi-round path (boosting/fused_rounds.py): K rounds per
+        # compiled dispatch. Resolution follows the engine idiom — "auto"
+        # engages on accelerators for eligible configs, an explicit K
+        # forces (or raises); K == 1 keeps the host-per-round loop below.
+        from mpitree_tpu.boosting import fused_rounds as fused_rounds_mod
+
+        k_dispatch, rpd_reason = fused_rounds_mod.resolve_rounds_per_dispatch(
+            self.rounds_per_dispatch,
+            platform=mesh.devices.flat[0].platform,
+            loss_kind=getattr(loss, "kind", None), loss_K=K,
+            early_stopping=bool(self.early_stopping),
+            colsample=float(self.colsample_bytree),
+            max_depth=self.max_depth, max_leaf_nodes=self.max_leaf_nodes,
+            n_samples=binned.x_binned.shape[0],
+            n_features=binned.x_binned.shape[1], n_bins=binned.n_bins,
+            hist_budget_bytes=cfg.hist_budget_bytes,
+        )
+        obs.decision(
+            "rounds_per_dispatch", int(k_dispatch), reason=rpd_reason
+        )
+        if k_dispatch > 1:
+            if not stopped_early and start_round < int(self.max_iter):
+                try:
+                    n_iter = fused_rounds_mod.run_fused_rounds(
+                        binned=binned, y_tr=y_tr, sw_tr=sw_tr,
+                        raw_tr=raw_tr,
+                        trees=trees, train_scores=train_scores,
+                        start_round=start_round,
+                        max_iter=int(self.max_iter),
+                        cfg=cfg, mesh=mesh, obs=obs, seed=seed, ck=ck,
+                        lr=lr, loss_kind=loss.kind,
+                        rounds_per_dispatch=int(k_dispatch),
+                        subsample=float(self.subsample),
+                        checkpoint_every=int(self.checkpoint_every),
+                        verbose=bool(self.verbose),
+                    )
+                except FloatingPointError:
+                    # The raise aborts _fit before the normal report
+                    # assignment; attach the record now so the typed
+                    # nonfinite_grad event survives for postmortem
+                    # (the host loop's guard does the same).
+                    self.fit_report_ = obs.report(trees=trees)
+                    raise
+            host_rounds = ()
+        else:
+            host_rounds = range(start_round, int(self.max_iter))
+        for r in host_rounds:
             if stopped_early:
                 break  # resumed at (or past) the early-stop round
             # Chaos seam: deterministic kill/blip/hang at an exact round
@@ -580,7 +655,8 @@ class GradientBoostingRegressor(RegressorMixin, _BaseGradientBoosting):
     """
 
     def __init__(self, *, loss="squared_error", learning_rate=0.1,
-                 max_iter=100, max_depth=6, max_bins=256, binning="auto",
+                 max_iter=100, max_depth=6, max_leaf_nodes=None,
+                 rounds_per_dispatch="auto", max_bins=256, binning="auto",
                  subsample=1.0, colsample_bytree=1.0,
                  min_samples_split=2, min_samples_leaf=20,
                  min_child_weight=1e-3, reg_lambda=0.0, min_split_gain=0.0,
@@ -590,7 +666,9 @@ class GradientBoostingRegressor(RegressorMixin, _BaseGradientBoosting):
                  checkpoint=None, checkpoint_every=10):
         super().__init__(
             loss=loss, learning_rate=learning_rate, max_iter=max_iter,
-            max_depth=max_depth, max_bins=max_bins, binning=binning,
+            max_depth=max_depth, max_leaf_nodes=max_leaf_nodes,
+            rounds_per_dispatch=rounds_per_dispatch,
+            max_bins=max_bins, binning=binning,
             subsample=subsample, colsample_bytree=colsample_bytree,
             min_samples_split=min_samples_split,
             min_samples_leaf=min_samples_leaf,
@@ -625,7 +703,9 @@ class GradientBoostingClassifier(ClassifierMixin, _BaseGradientBoosting):
     """
 
     def __init__(self, *, loss="log_loss", learning_rate=0.1, max_iter=100,
-                 max_depth=6, max_bins=256, binning="auto", subsample=1.0,
+                 max_depth=6, max_leaf_nodes=None,
+                 rounds_per_dispatch="auto",
+                 max_bins=256, binning="auto", subsample=1.0,
                  colsample_bytree=1.0,
                  min_samples_split=2, min_samples_leaf=20,
                  min_child_weight=1e-3, reg_lambda=0.0, min_split_gain=0.0,
@@ -635,7 +715,9 @@ class GradientBoostingClassifier(ClassifierMixin, _BaseGradientBoosting):
                  checkpoint=None, checkpoint_every=10):
         super().__init__(
             loss=loss, learning_rate=learning_rate, max_iter=max_iter,
-            max_depth=max_depth, max_bins=max_bins, binning=binning,
+            max_depth=max_depth, max_leaf_nodes=max_leaf_nodes,
+            rounds_per_dispatch=rounds_per_dispatch,
+            max_bins=max_bins, binning=binning,
             subsample=subsample, colsample_bytree=colsample_bytree,
             min_samples_split=min_samples_split,
             min_samples_leaf=min_samples_leaf,

@@ -34,6 +34,11 @@ class SquaredError:
     """1/2 (y - raw)^2 — h == 1, so Newton boosting == gradient boosting."""
 
     K = 1
+    # In-device twin id: the fused multi-round program (boosting/
+    # fused_rounds.py) recomputes (g, h) from f32 margins inside its
+    # lax.scan body, keyed by this kind string — a loss without one can
+    # only run the host-per-round path (rounds_per_dispatch=1).
+    kind = "squared_error"
 
     def init_raw(self, y: np.ndarray, w: np.ndarray | None) -> np.ndarray:
         return np.array([_weighted_mean(y, w)])
@@ -51,6 +56,7 @@ class BinaryLogistic:
     """Binomial deviance on {0, 1} labels; one tree per round."""
 
     K = 1
+    kind = "logistic"  # fused-round twin id (see SquaredError.kind)
 
     def init_raw(self, y: np.ndarray, w: np.ndarray | None) -> np.ndarray:
         p = np.clip(_weighted_mean(y.astype(np.float64), w), 1e-12, 1 - 1e-12)
@@ -72,6 +78,8 @@ class BinaryLogistic:
 
 class MultinomialLogistic:
     """Softmax cross-entropy; one tree per class per round."""
+
+    kind = None  # no fused-round twin: one tree per CLASS per round
 
     def __init__(self, n_classes: int):
         self.K = n_classes

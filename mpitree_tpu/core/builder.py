@@ -31,6 +31,7 @@ import os
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from mpitree_tpu.core.tree_struct import TreeArrays
@@ -49,6 +50,15 @@ class BuildConfig:
     task: str = "classification"
     criterion: str = "entropy"  # entropy | gini (classification), mse (regression)
     max_depth: int | None = None
+    # Leaf-wise (best-first) growth budget: when set, the tree grows by
+    # repeatedly expanding the highest-gain open leaf (LightGBM's
+    # ``num_leaves`` / sklearn's best-first ``max_leaf_nodes``) instead of
+    # level-synchronously, stopping at this many leaves —
+    # ``core/leafwise_builder.py``; ``None`` = level-wise growth. With the
+    # budget at the level-wise node bound (``2^max_depth``) the finished
+    # tree is bit-identical to the level-wise engines (stopping rules are
+    # node-local and order-independent; node ids are BFS-renumbered).
+    max_leaf_nodes: int | None = None
     min_samples_split: int = 2
     # gbdt only: L2 leaf regularization (XGBoost's lambda), the minimum
     # Newton gain a split must clear, and the minimum subsampled row count
@@ -99,8 +109,12 @@ class BuildConfig:
     hist_kernel: str = "auto"
     # Sibling-subtraction histogram frontier (LightGBM's halved-histogram
     # trick) in BOTH device engines: at each level the globally-reduced
-    # parent histograms stay resident on device (<= one extra chunk-sized
-    # buffer), only the SMALLER child of each sibling pair accumulates
+    # parent histograms stay resident on device (one buffer per frontier
+    # chunk, kept while the total fits ``hist_budget_bytes`` — so the
+    # carry at most doubles peak histogram HBM; over budget the next
+    # level falls back to direct accumulation with a typed
+    # ``sub_carry_over_budget`` event), only the SMALLER child of each
+    # sibling pair accumulates
     # rows — into a compact half-width buffer, so the per-level histogram
     # psum payload also halves — and the larger child is reconstructed as
     # ``parent - small_sibling`` after the reduction (exact under the
@@ -637,6 +651,20 @@ def build_tree(
     """
     cfg = config
     timer = timer if timer is not None else PhaseTimer(enabled=False)
+    if cfg.max_leaf_nodes is not None:
+        if int(cfg.max_leaf_nodes) < 2:
+            raise ValueError(
+                f"max_leaf_nodes must be >= 2 or None, got "
+                f"{cfg.max_leaf_nodes!r}"
+            )
+        from mpitree_tpu.core.leafwise_builder import build_tree_leafwise
+
+        return build_tree_leafwise(
+            binned, y, config=cfg, mesh=mesh, n_classes=n_classes,
+            sample_weight=sample_weight, refit_targets=refit_targets,
+            timer=timer, return_leaf_ids=return_leaf_ids,
+            feature_sampler=feature_sampler, mono_cst=mono_cst,
+        )
     debug = cfg.debug or debug_checks_enabled()
     timer.set_mesh(mesh)
 
@@ -953,11 +981,76 @@ def build_tree(
 
     frontier_lo, frontier_size, depth = 0, 1, 0
     # Sibling-subtraction carry: the previous level's globally-reduced
-    # histogram (device-resident, <= one chunk) plus the host-side
-    # child -> (parent slot, smaller sibling) maps derived from its
-    # decisions. None whenever the previous level cannot serve as a
-    # subtraction parent (multi-chunk, terminal, or subtraction off).
+    # chunk histograms (device-resident) plus the host-side child ->
+    # (parent slot, smaller sibling) maps derived from its decisions.
+    # Multi-chunk levels keep ONE buffer PER CHUNK (the ISSUE-8
+    # follow-up; previously multi-chunk levels broke the carry) as long
+    # as the total kept bytes fit ``cfg.hist_budget_bytes`` — the same
+    # budget that sized the live chunk, so the carry at most doubles
+    # peak histogram HBM. None whenever the previous level cannot serve
+    # as a subtraction parent (over budget, terminal, or subtraction
+    # off).
     sub_parent = None
+    carry_budget_warned = False
+    hist_itemsize = 8 if gbdt64 else 4
+
+    def _sub_ops_for_chunk(sp, base, take, S_lvl):
+        """Subtraction operands for the child chunk at frontier offset
+        ``base``: ``(parent_hist, slot_map, is_small)``.
+
+        Single-chunk parents pass their resident buffer straight through
+        (zero-copy, the PR-5 shape). Multi-chunk parents gather this
+        chunk's pair parents into one COMPACT buffer — row ``p`` serves
+        child slots ``2p``/``2p + 1``, so the slot map becomes the
+        static ``j // 2`` ramp — with one device ``take`` per touched
+        parent chunk (grouped, then un-permuted; ``mode="clip"`` because
+        fill-mode gathers mislower inside scoped x64 on legacy wheels).
+        Pads map to parent row 0 as small siblings: they accumulate
+        nothing and nothing reads them back.
+        """
+        pslot = np.zeros(S_lvl, np.int32)
+        ismall = np.ones(S_lvl, bool)
+        ismall[:take] = sp["is_small"][base:base + take]
+        hists = sp["hists"]
+        if len(hists) == 1:
+            pslot[:take] = sp["parent_slot"][base:base + take]
+            return hists[0], pslot, ismall
+        S_par = sp["S_par"]
+        pair = np.zeros(max(S_lvl // 2, 1), np.int64)
+        pair[:take // 2] = sp["parent_slot"][base:base + take:2]
+        cid = pair // S_par
+        order = np.argsort(cid, kind="stable")
+        inv = np.empty_like(order)
+        inv[order] = np.arange(len(order))
+
+        def gather():
+            parts = [
+                jnp.take(
+                    hists[int(c)],
+                    jnp.asarray(
+                        (pair[order][cid[order] == c] % S_par).astype(
+                            np.int32
+                        )
+                    ),
+                    axis=0, mode="clip",
+                )
+                for c in np.unique(cid)
+            ]
+            buf = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+            return jnp.take(
+                buf, jnp.asarray(inv.astype(np.int32)), axis=0, mode="clip"
+            )
+
+        if gbdt64:
+            with jax.enable_x64(True):
+                buf = gather()
+        else:
+            buf = gather()
+        pslot[:take] = np.repeat(
+            np.arange(take // 2, dtype=np.int32), 2
+        )
+        return buf, pslot, ismall
+
     while frontier_size > 0:
         # Chaos seam (resilience.chaos): lets tests kill/blip the build at
         # an exact level; free (one global read) with no plan installed.
@@ -995,12 +1088,29 @@ def build_tree(
             )
             dec = {"counts": counts_all}
         else:
-            # Subtraction runs on single-chunk levels only (the parent
-            # histogram must be one resident buffer); multi-chunk levels
-            # fall back to direct accumulation and break the carry.
-            single = frontier_size <= K
-            sub_now = use_sub and single and sub_parent is not None
-            keep_now = use_sub and single
+            # Subtraction runs whenever the previous level's reduced
+            # chunk histograms stayed resident; keeping THIS level's is
+            # budget-gated (multi-chunk levels keep one buffer per chunk
+            # — see the carry comment above the loop). Width-1 chunks
+            # (a floor hist_budget_bytes / max_frontier_chunk=1 drives
+            # _chunk_size to K=1) cannot hold a sibling PAIR, so both
+            # legs fall back to direct accumulation there.
+            S_pred = next((s for s in tiers if frontier_size <= s), K)
+            sub_now = use_sub and sub_parent is not None and S_pred >= 2
+            n_chunks_pred = -(-frontier_size // S_pred)
+            keep_bytes = (
+                n_chunks_pred * S_pred * F * C * B * hist_itemsize
+            )
+            over_budget = keep_bytes > cfg.hist_budget_bytes
+            keep_now = use_sub and S_pred >= 2 and not over_budget
+            if use_sub and over_budget and not carry_budget_warned:
+                carry_budget_warned = True
+                timer.event(
+                    "sub_carry_over_budget",
+                    f"depth={depth}: keeping {n_chunks_pred} chunk "
+                    f"histograms ({keep_bytes >> 20} MiB) exceeds "
+                    "hist_budget_bytes; next level accumulates directly",
+                )
             with timer.phase("split"):
                 S_lvl, split_fn, new_fn = split_fn_for(
                     frontier_size, sub=sub_now, keep=keep_now
@@ -1011,23 +1121,20 @@ def build_tree(
                     (lo, min(S_lvl, hi - lo))
                     for lo in range(frontier_lo, hi, S_lvl)
                 ]
-                sub_ops = ()
                 if sub_now:
-                    pslot = np.zeros(S_lvl, np.int32)
-                    ismall = np.ones(S_lvl, bool)  # pads read the zero pair
-                    pslot[:frontier_size] = sub_parent["parent_slot"]
-                    ismall[:frontier_size] = sub_parent["is_small"]
-                    ismall_lvl = ismall
-                    sub_ops = (sub_parent["hist"], pslot, ismall)
+                    ismall_lvl = sub_parent["is_small"]
                 n_extra = int(keep_now) + int(debug)
                 futures = [
                     (take,
                      split_fn(xb_d, y_d, nid_d, w_d, cand_mask_d,
-                              *split_args(lo, take, S_lvl), *sub_ops))
+                              *split_args(lo, take, S_lvl),
+                              *(_sub_ops_for_chunk(
+                                  sub_parent, lo - frontier_lo, take, S_lvl,
+                              ) if sub_now else ())))
                     for lo, take in chunks
                 ]
                 if keep_now:  # outputs: (packed[, hist][, repl_err])
-                    kept_hist = futures[0][1][1]
+                    kept_hist = [r[1] for _take, r in futures]
                 if debug:  # repl_err is always the last output
                     errs = [float(jax.device_get(r[-1])) for _, r in futures]
                     if any(e != 0.0 for e in errs):
@@ -1221,7 +1328,8 @@ def build_tree(
             ism[0::2] = left_small
             ism[1::2] = ~left_small
             sub_parent = {
-                "hist": kept_hist,
+                "hists": kept_hist,
+                "S_par": S_lvl,
                 "is_small": ism,
                 "parent_slot": np.repeat(
                     split_ids.astype(np.int32) - frontier_lo, 2

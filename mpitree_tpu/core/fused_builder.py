@@ -799,7 +799,8 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                     data_sharded: bool = False,
                     sample_k: int | None = None,
                     random_split: bool = False,
-                    monotonic: bool = False):
+                    monotonic: bool = False,
+                    subtraction: bool = False):
     """Tree-parallel forest build: trees sharded over the mesh (ensemble
     parallelism — BASELINE configs[4], "N trees sharded across TPU chips").
 
@@ -816,11 +817,13 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     one device's HBM per tree and surplus devices stop idling when
     ``n_trees < n_devices``.
 
-    Sibling subtraction stays OFF here for now: the resident parent
-    histogram would ride the per-tree ``lax.map`` carry (one extra
-    chunk-sized buffer per in-flight tree) and the forest program's
-    compile cost already dominates small fits — ROADMAP lists the
-    follow-up.
+    ``subtraction`` compiles the sibling-subtraction frontier into the
+    per-tree body: the build body allocates its resident parent histogram
+    inside ``build``, so under ``lax.map`` each in-flight tree carries
+    its own copy on the loop state for free — one extra chunk-sized
+    buffer per tree in flight, exactly the ROADMAP follow-up's cost
+    estimate. Callers gate on ``builder.resolve_hist_subtraction`` (the
+    forest's per-tree bootstrap totals drive the f32-ceiling guard).
     """
     build = _make_build_body(
         n_slots=n_slots, n_bins=n_bins, n_classes=n_classes, task=task,
@@ -830,6 +833,7 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         wide_pallas=wide_pallas, exact_ties=exact_ties,
         psum_axis=DATA_AXIS if data_sharded else None,
         sample_k=sample_k, random_split=random_split, monotonic=monotonic,
+        subtraction=subtraction,
     )
 
     def per_device(xb, y, nid0, ws, cand_masks, mcw, mid, root_keys,
@@ -1008,12 +1012,14 @@ def build_tree_fused(
     eff_tiers = obs_acct.effective_tiers(
         builder_valid_tiers(tuple(cfg.frontier_tiers), K), md
     )
-    rows, coll = obs_acct.fused_level_rows(
-        tree.depth, n_slots=K, tiers=eff_tiers, n_features=F, n_bins=B,
+    rows, coll, counters = obs_acct.fused_scan_rows(
+        tree, n_slots=K, tiers=eff_tiers, n_features=F, n_bins=B,
         n_channels=C, counts_channels=C, max_depth=md, task=task,
         feature_shards=mesh_lib.feature_shards(mesh), n_rows=N,
         subtraction=use_sub,
     )
+    for name, v in counters.items():
+        timer.counter(name, v)
     for site, v in coll.items():
         timer.collective(site, calls=v["calls"], nbytes=v["bytes"])
     for r in rows:
@@ -1162,8 +1168,29 @@ def build_forest_fused(
         mesh.devices.flat[0].platform, use_wide=use_wide,
         n_channels=C, n_bins=B,
     )
+    # Sibling subtraction in the forest program (ROADMAP carried
+    # follow-up): the per-tree build body owns its resident parent
+    # histogram, so it rides the lax.map carry with no extra plumbing;
+    # the f32-ceiling guard bounds on the largest per-tree bootstrap
+    # total (the per-channel maximum any tree's parent can reach).
+    tree_totals_max = float(weights.sum(axis=1).max(initial=0.0))
+    use_sub = resolve_hist_subtraction(
+        cfg, mesh.devices.flat[0].platform, task, integer_ok=integer_counts,
+        total_weight=tree_totals_max, obs=timer,
+    )
+    timer.decision(
+        "hist_subtraction", "on" if use_sub else "off",
+        reason=(
+            "sibling-subtraction frontier compiled into the per-tree "
+            "lax.map body (parent histogram rides each tree's loop carry)"
+            if use_sub else
+            "direct accumulation (resolve_hist_subtraction: config/env "
+            "off, non-exact channels or non-accelerator platform under "
+            "'auto', or the 2**24 f32 ceiling)"
+        ),
+    )
 
-    if task == "classification" and float(weights.sum(axis=1).max()) >= 2**24:
+    if task == "classification" and tree_totals_max >= 2**24:
         warn_event(
             timer, "f32_ceiling",
             "device class counts accumulate in float32: beyond 2**24 "
@@ -1185,6 +1212,7 @@ def build_forest_fused(
         data_sharded=data_sharded,
         sample_k=sample_k, random_split=random_split,
         monotonic=mono_cst is not None and bool(np.any(np.asarray(mono_cst))),
+        subtraction=use_sub,
     )
     fn = _make_forest_fn(tmesh, **fn_kw)
     timer.compile_note(
@@ -1269,20 +1297,22 @@ def build_forest_fused(
             trees.append(tree)
     timer.counter("forest_fused_builds")
     timer.counter("trees_built", T)
-    if data_sharded:
-        # Row shards psum per tree group exactly as the single-tree build
-        # does — replay each tree's routing from its depth histogram
-        # (obs/accounting.py). Non-data-sharded forests run with
-        # psum_axis=None (data replicated per device): no collectives.
-        eff_tiers = obs_acct.effective_tiers(
-            builder_valid_tiers(tuple(cfg.frontier_tiers), K), md
+    # Realized-work counters replay per tree (always-on; the subtraction
+    # carry on the per-tree lax.map loop shows up as scanned < frontier).
+    # Collective rows only when row shards actually psum: non-data-sharded
+    # forests run with psum_axis=None (data replicated per device).
+    eff_tiers = obs_acct.effective_tiers(
+        builder_valid_tiers(tuple(cfg.frontier_tiers), K), md
+    )
+    for tree in trees:
+        _, coll, counters = obs_acct.fused_scan_rows(
+            tree, n_slots=K, tiers=eff_tiers, n_features=F,
+            n_bins=B, n_channels=C, counts_channels=C, max_depth=md,
+            task=task, subtraction=use_sub,
         )
-        for tree in trees:
-            _, coll = obs_acct.fused_level_rows(
-                tree.depth, n_slots=K, tiers=eff_tiers, n_features=F,
-                n_bins=B, n_channels=C, counts_channels=C, max_depth=md,
-                task=task,
-            )
+        for name, v in counters.items():
+            timer.counter(name, v)
+        if data_sharded:
             for site, v in coll.items():
                 timer.collective(site, calls=v["calls"], nbytes=v["bytes"])
     if return_leaf_ids:

@@ -1,0 +1,781 @@
+"""Leaf-wise (best-first) tree growth — the ``max_leaf_nodes`` frontier.
+
+The level-synchronous engines spend one full O(N*F) histogram pass per
+LEVEL: every frontier slot gets a histogram whether its best split is
+worth anything or not, and on covtype-like data most depth-20 slots carry
+near-zero gain. This module grows the tree in the LightGBM order instead
+(Ke et al. 2017, "best-first"/"lossguide"): a fixed-capacity,
+statically-shaped priority pool holds every open leaf with its best
+candidate split and gain; each step expands ONLY the highest-gain leaf,
+paying one sibling-pair histogram — under the PR-5 subtraction carry the
+accumulated side is just the SMALLER child (the larger is
+``parent - small`` against the leaf's pool-resident histogram), so each
+split costs one half-pair histogram + psum. Growth stops at
+``max_leaf_nodes`` leaves or when no open leaf clears the gain gates.
+
+Two engines, one arithmetic (``parallel/collective.pair_split_stats`` is
+the shared pair kernel, ``ops/impurity.leaf_gain``/``best_leaf_slot``
+the shared priority):
+
+- **fused** (default): the whole best-first loop is ONE compiled
+  ``lax.while_loop`` program — pool gains, node arrays, and (under
+  subtraction) the per-leaf resident histograms all ride the loop carry;
+  best-leaf selection is a ``lax.top_k`` over the padded pool with a
+  lowest-node-id tie-break — no host sync anywhere in the loop
+  (GL01-clean). This body is also what the fused multi-round GBDT
+  program (``boosting/fused_rounds``) scans over.
+- **levelwise** (the host-stepped counterpart): one
+  ``collective.make_expand_fn`` dispatch per expansion with the pool
+  bookkeeping on host — per-expansion obs rows, chaos seams, and the
+  engine-identity cross-check against the fused program.
+
+Node ids are assigned in EXPANSION order on device, then renumbered to
+the canonical breadth-first order every level-synchronous engine uses
+(:func:`bfs_new_ids`) — so with ``max_leaf_nodes`` at the level-wise
+node budget (``2^max_depth``) the finished tree is bit-identical to the
+level-wise engines wherever the stopping rules are (they are node-local
+and order-independent), which is what the equivalence pins hold.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from mpitree_tpu.core.builder import (
+    integer_weights,
+    resolve_exact_ties,
+    resolve_gbdt_x64,
+    resolve_hist_subtraction,
+)
+from mpitree_tpu.core.fused_builder import _finalize_tree
+from mpitree_tpu.obs import accounting as obs_acct
+from mpitree_tpu.ops import impurity as imp_ops
+from mpitree_tpu.parallel import collective, mesh as mesh_lib
+from mpitree_tpu.parallel.mesh import DATA_AXIS
+from mpitree_tpu.resilience import chaos
+from mpitree_tpu.utils.profiling import PhaseTimer
+
+
+def _pool_capacity(max_leaf_nodes: int, max_depth, n_samples: int) -> int:
+    """Open-leaf pool width: the static shape every buffer sizes from.
+
+    A depth-``d`` tree can hold at most ``2^d`` leaves and ``N`` rows at
+    most ``N`` non-empty ones, so the pool (and the ``2P - 1`` node
+    capacity) shrinks to whatever is actually reachable — the compiled
+    program's buffers are proportional to the LEAF budget, not the node
+    capacity of a depth-bounded level-wise build.
+    """
+    p = int(max_leaf_nodes)
+    if max_depth is not None and max_depth < 31:
+        p = min(p, 2 ** max(int(max_depth), 0))
+    return max(min(p, max(n_samples, 1)), 1)
+
+
+def _stop_and_gain_jnp(dec, pure, child_depth, *, task, max_depth,
+                       min_samples_split, mid, msg):
+    """Stopping rules + expansion priority for a decision pair (device).
+
+    The identical rule set the level-synchronous engines apply (purity /
+    constancy / ``min_samples_split`` / no-valid-candidate /
+    ``min_impurity_decrease`` / gbdt ``min_split_gain`` / depth cap),
+    evaluated in the same f32 arithmetic; a stopped child enters the pool
+    with ``-inf`` gain and can never be expanded.
+    """
+    n = (dec.counts.sum(axis=1) if task == "classification"
+         else dec.counts[:, 0])
+    stop = (
+        pure | dec.constant | (n < min_samples_split)
+        | jnp.isinf(dec.cost)
+        | ((mid > 0) & (n * (dec.impurity - dec.cost) < mid))
+    )
+    if task == "gbdt":
+        stop = stop | ((msg > 0) & (dec.impurity - dec.cost < msg))
+    if max_depth >= 0:
+        stop = stop | (child_depth == max_depth)
+    gain = imp_ops.leaf_gain(n, dec.impurity, dec.cost, task=task)
+    gain = jnp.where(stop | jnp.isnan(gain), -jnp.inf, gain)
+    return n, stop, gain
+
+
+def _stop_and_gain_np(dec, child_depth, *, task, cfg):
+    """Host twin of :func:`_stop_and_gain_jnp` for the stepped engine.
+
+    Operates on an :func:`collective.unpack_decision` dict (all-f32
+    fields) with the same one-multiply-one-subtract f32 arithmetic, so
+    both engines rank every pair identically.
+    """
+    counts = dec["counts"]
+    if task == "classification":
+        n = counts.sum(axis=1, dtype=np.float32)
+        pure = (counts > 0).sum(axis=1) <= 1
+    elif task == "gbdt":
+        n = counts[:, 0]
+        pure = np.zeros(2, bool)
+    else:
+        n = counts[:, 0]
+        pure = dec["y_range"] <= 0.0
+    imp, cost = dec["impurity"], dec["cost"]
+    with np.errstate(invalid="ignore"):
+        stop = (
+            pure | dec["constant"] | (n < cfg.min_samples_split)
+            | np.isinf(cost)
+        )
+        if cfg.min_decrease_scaled > 0.0:
+            stop |= (
+                n * (imp - cost) < np.float32(cfg.min_decrease_scaled)
+            )
+        if task == "gbdt" and cfg.min_split_gain > 0.0:
+            stop |= (imp - cost) < np.float32(cfg.min_split_gain)
+        if cfg.max_depth is not None and child_depth == cfg.max_depth:
+            stop = np.ones(2, bool)
+        gain = imp_ops.leaf_gain(n, imp, cost, task=task)
+        gain = np.where(stop | np.isnan(gain), -np.inf, gain)
+    return n, stop, gain.astype(np.float32)
+
+
+def _make_leafwise_body(*, n_bins: int, n_classes: int, task: str,
+                        criterion: str, max_leaves: int, max_depth: int,
+                        min_samples_split: int,
+                        psum_axis: str | None = DATA_AXIS,
+                        exact_ties: bool = False, gbdt_x64: bool = False,
+                        subtraction: bool = False):
+    """Pure per-device best-first build: (xb, y, nid0, w, cand_mask,
+    mcw, mid, lam, msl, msg) -> (feat, bin, counts, n, left, parent,
+    depth, nid, n_nodes).
+
+    ``max_depth < 0`` = unbounded. Node capacity is exactly
+    ``2 * max_leaves - 1`` (every expansion adds two nodes and one leaf).
+    The per-expansion histograms are two-slot scatters (one compact slot
+    under ``subtraction``), so no Pallas/wide kernel tiers apply — the
+    scalar-unit scatter is already minimal at pair width. ``lam``/
+    ``msl``/``msg`` are the gbdt Newton scalars (reg_lambda,
+    min_samples_leaf, min_split_gain; dead operands otherwise).
+    """
+    Pn = int(max_leaves)
+    M = 2 * Pn - 1
+    C = n_classes if task == "classification" else 3
+    f64_pool = subtraction and task == "gbdt" and gbdt_x64
+
+    # graftlint: device-fn (jit-wrapped indirectly: this factory's return
+    # value reaches jax.shard_map in _make_leafwise_fn and the fused
+    # multi-round GBDT program)
+    def build(xb, y, nid0, w, cand_mask, mcw, mid, lam, msl, msg):
+        R, F = xb.shape
+
+        def pair(nid, base_id, is_small, phist_row):
+            return collective.pair_split_stats(
+                xb, y, nid, w, cand_mask, base_id, is_small, phist_row,
+                mcw, lam, msl, task=task, criterion=criterion,
+                n_bins=n_bins, n_classes=C, exact_ties=exact_ties,
+                gbdt_x64=gbdt_x64, subtraction=subtraction,
+                psum_axis=psum_axis,
+            )
+
+        # Pool + tree buffers. The f64 pool histogram (gbdt scoped-x64
+        # path) is created as f32 zeros CONVERTED inside the scope — a
+        # direct f64 zeros canonicalizes to f32 at lowering time on
+        # pre-shard_map wheels (the ops/histogram._channel_histogram
+        # lesson); every later read/write of it is scoped the same way.
+        if subtraction:
+            if f64_pool:
+                with jax.enable_x64(True):
+                    # Slice INSIDE the scope too: an outside-scope op on
+                    # an f64 array canonicalizes its aval to f32 while the
+                    # runtime value stays f64 — a lowering-time verifier
+                    # mismatch on legacy wheels.
+                    pool_hist = jnp.zeros(
+                        (Pn, F, C, n_bins), jnp.float32
+                    ).astype(jnp.float64)
+                    root_phist = pool_hist[:1]
+            else:
+                pool_hist = jnp.zeros((Pn, F, C, n_bins), jnp.float32)
+                root_phist = pool_hist[:1]
+        else:
+            pool_hist = root_phist = None
+
+        # Root bootstrap rides the pair kernel: every row still carries
+        # node 0, so slot 0 IS the root (slot 1 empty under direct
+        # accumulation; garbage-but-unread against the zero parent under
+        # subtraction, where "small" slot 0 accumulates everything).
+        root_small = jnp.array([True, False])
+        dec0, pure0, keep0 = pair(nid0, jnp.int32(0), root_small,
+                                  root_phist)
+        n0, _, gain0 = _stop_and_gain_jnp(
+            dec0, pure0, jnp.int32(0), task=task, max_depth=max_depth,
+            min_samples_split=min_samples_split, mid=mid, msg=msg,
+        )
+
+        feat_a = jnp.full(M, -1, jnp.int32)
+        bin_a = jnp.zeros(M, jnp.int32)
+        counts_a = jnp.zeros((M, C), jnp.float32).at[0].set(
+            dec0.counts[0].astype(jnp.float32)
+        )
+        n_a = jnp.zeros(M, jnp.float32).at[0].set(n0[0])
+        left_a = jnp.full(M, -1, jnp.int32)
+        parent_a = jnp.full(M, -1, jnp.int32)
+        depth_a = jnp.zeros(M, jnp.int32)
+
+        pool_gain = jnp.full(Pn, -jnp.inf, jnp.float32).at[0].set(gain0[0])
+        pool_node = jnp.zeros(Pn, jnp.int32)
+        pool_feat = jnp.zeros(Pn, jnp.int32).at[0].set(dec0.feature[0])
+        pool_bin = jnp.zeros(Pn, jnp.int32).at[0].set(dec0.bin[0])
+        pool_nl = jnp.zeros(Pn, jnp.float32).at[0].set(dec0.n_left[0])
+        if subtraction:
+            if f64_pool:
+                with jax.enable_x64(True):
+                    pool_hist = pool_hist.at[0].set(keep0[0])
+            else:
+                pool_hist = pool_hist.at[0].set(keep0[0])
+
+        def cond(state):
+            pool_gain, n_leaves = state[8], state[14]
+            return jnp.logical_and(
+                n_leaves < Pn, jnp.max(pool_gain) > -jnp.inf
+            )
+
+        def body(state):
+            (feat_a, bin_a, counts_a, n_a, left_a, parent_a, depth_a, nid,
+             pool_gain, pool_node, pool_feat, pool_bin, pool_nl,
+             n_nodes, n_leaves) = state[:15]
+            pool_hist = state[15] if subtraction else None
+
+            # Best open leaf: lax.top_k over the padded pool, gain ties
+            # broken toward the lowest node id (ops/impurity).
+            p = imp_ops.best_leaf_slot(pool_gain, pool_node)
+            enode = pool_node[p]
+            f = pool_feat[p]
+            b = pool_bin[p]
+            l_id = n_nodes
+
+            feat_a = feat_a.at[enode].set(f)
+            bin_a = bin_a.at[enode].set(b)
+            left_a = left_a.at[enode].set(l_id)
+            parent_a = parent_a.at[l_id].set(enode)
+            parent_a = parent_a.at[l_id + 1].set(enode)
+            child_depth = depth_a[enode] + 1
+            depth_a = depth_a.at[l_id].set(child_depth)
+            depth_a = depth_a.at[l_id + 1].set(child_depth)
+
+            # Reroute the expanded leaf's rows (everyone else is parked).
+            xf = jnp.take_along_axis(
+                xb, jnp.broadcast_to(jnp.maximum(f, 0), (R,))[:, None],
+                axis=1,
+            )[:, 0]
+            child = jnp.where(xf <= b, l_id, l_id + 1)
+            nid = jnp.where(nid == enode, child, nid)
+
+            # Smaller-sibling pick from the recorded winner's left weight
+            # (ties go left — the same rule as the level-wise carry).
+            small_left = pool_nl[p] * 2.0 <= n_a[enode]
+            is_small = jnp.stack([small_left, ~small_left])
+            if subtraction:
+                # All-i32 start indices: inside the scoped-x64 branch the
+                # literal zeros would otherwise promote to i64 and clash
+                # with the i32 pool slot.
+                z = jnp.int32(0)
+                if f64_pool:
+                    with jax.enable_x64(True):
+                        phist_row = lax.dynamic_slice(
+                            pool_hist, (p, z, z, z), (1, F, C, n_bins)
+                        )
+                else:
+                    phist_row = lax.dynamic_slice(
+                        pool_hist, (p, z, z, z), (1, F, C, n_bins)
+                    )
+            else:
+                phist_row = None
+            dec, pure, keep = pair(nid, l_id, is_small, phist_row)
+            n2, _, gain2 = _stop_and_gain_jnp(
+                dec, pure, child_depth, task=task, max_depth=max_depth,
+                min_samples_split=min_samples_split, mid=mid, msg=msg,
+            )
+
+            counts_a = lax.dynamic_update_slice(
+                counts_a, dec.counts.astype(jnp.float32), (l_id, 0)
+            )
+            n_a = lax.dynamic_update_slice(
+                n_a, n2.astype(jnp.float32), (l_id,)
+            )
+
+            # Left child reuses the parent's pool slot, right child takes
+            # the next fresh one — slot count == n_leaves by induction.
+            q = n_leaves
+            pool_gain = pool_gain.at[p].set(gain2[0]).at[q].set(gain2[1])
+            pool_node = pool_node.at[p].set(l_id).at[q].set(l_id + 1)
+            pool_feat = (
+                pool_feat.at[p].set(dec.feature[0]).at[q].set(dec.feature[1])
+            )
+            pool_bin = pool_bin.at[p].set(dec.bin[0]).at[q].set(dec.bin[1])
+            pool_nl = pool_nl.at[p].set(dec.n_left[0]).at[q].set(
+                dec.n_left[1]
+            )
+            out = (feat_a, bin_a, counts_a, n_a, left_a, parent_a, depth_a,
+                   nid, pool_gain, pool_node, pool_feat, pool_bin, pool_nl,
+                   n_nodes + 2, n_leaves + 1)
+            if subtraction:
+                if f64_pool:
+                    with jax.enable_x64(True):
+                        pool_hist = pool_hist.at[p].set(keep[0])
+                        pool_hist = pool_hist.at[q].set(keep[1])
+                else:
+                    pool_hist = pool_hist.at[p].set(keep[0])
+                    pool_hist = pool_hist.at[q].set(keep[1])
+                out = out + (pool_hist,)
+            return out
+
+        state0 = (feat_a, bin_a, counts_a, n_a, left_a, parent_a, depth_a,
+                  nid0, pool_gain, pool_node, pool_feat, pool_bin, pool_nl,
+                  jnp.int32(1), jnp.int32(1))
+        if subtraction:
+            state0 = state0 + (pool_hist,)
+        out = lax.while_loop(cond, body, state0)
+        (feat_a, bin_a, counts_a, n_a, left_a, parent_a, depth_a,
+         nid) = out[:8]
+        return (feat_a, bin_a, counts_a, n_a, left_a, parent_a, depth_a,
+                nid, out[13])
+
+    return build
+
+
+@lru_cache(maxsize=32)
+def _make_leafwise_fn(mesh, *, n_bins: int, n_classes: int, task: str,
+                      criterion: str, max_leaves: int, max_depth: int,
+                      min_samples_split: int, exact_ties: bool = False,
+                      gbdt_x64: bool = False, subtraction: bool = False):
+    """Data-parallel fused leaf-wise build: rows sharded, pair histograms
+    psum'd, the whole best-first loop one compiled program."""
+    build = _make_leafwise_body(
+        n_bins=n_bins, n_classes=n_classes, task=task, criterion=criterion,
+        max_leaves=max_leaves, max_depth=max_depth,
+        min_samples_split=min_samples_split, psum_axis=DATA_AXIS,
+        exact_ties=exact_ties, gbdt_x64=gbdt_x64, subtraction=subtraction,
+    )
+    sharded = jax.shard_map(
+        build,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                  P(DATA_AXIS), P(), P(), P(), P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), P(), P(), P(), P(DATA_AXIS), P()),
+    )
+    # nid0 donated (GL05): freshly sharded per build, and the program
+    # returns the advanced assignment with identical shape/sharding —
+    # callers (GL08) never touch nid_d after the call.
+    return jax.jit(sharded, donate_argnums=(2,))
+
+
+def bfs_new_ids(left: np.ndarray) -> np.ndarray:
+    """Expansion-ordered node ids -> canonical breadth-first ids.
+
+    The level-synchronous engines allocate children level by level in
+    parent-id order (left before right); replaying that walk over the
+    finished structure gives each node the id a level-wise build would
+    have assigned — the identity-pin permutation. ``left`` must hold
+    expansion-order ids (children allocated pairwise, right = left + 1);
+    returns ``new_id[old_id]``.
+    """
+    n = len(left)
+    perm = np.zeros(n, np.int64)
+    frontier = np.array([0], np.int64)
+    k = 1
+    while len(frontier):
+        parents = frontier[left[frontier] >= 0]
+        if not len(parents):
+            break
+        kids = np.empty(2 * len(parents), np.int64)
+        kids[0::2] = left[parents]
+        kids[1::2] = left[parents] + 1
+        perm[kids] = k + np.arange(len(kids))
+        k += len(kids)
+        frontier = kids
+    return perm
+
+
+def _finalize_leafwise(binned, task, criterion, n_nodes, feat, bins, counts,
+                       nvec, left, parent, *, integer_counts: bool):
+    """Trim, BFS-renumber, and finalize device buffers into a TreeArrays.
+
+    Returns ``(tree, perm)`` with ``perm`` the old->new id map (callers
+    remap row->leaf assignments through it).
+    """
+    feat = np.asarray(feat[:n_nodes])
+    bins = np.asarray(bins[:n_nodes])
+    counts = np.asarray(counts[:n_nodes])
+    nvec = np.asarray(nvec[:n_nodes])
+    left = np.asarray(left[:n_nodes])
+    parent = np.asarray(parent[:n_nodes])
+    perm = bfs_new_ids(left)
+
+    def scatter(a):
+        out = np.empty_like(a)
+        out[perm] = a
+        return out
+
+    left_v = np.where(left >= 0, perm[np.maximum(left, 0)], -1)
+    parent_v = np.where(parent >= 0, perm[np.maximum(parent, 0)], -1)
+    tree = _finalize_tree(
+        binned, task, criterion, int(n_nodes), scatter(feat), scatter(bins),
+        scatter(counts), scatter(nvec), scatter(left_v).astype(np.int32),
+        scatter(parent_v).astype(np.int32), integer_counts=integer_counts,
+    )
+    return tree, perm
+
+
+# graftlint: host-fn — the leaf-wise router/finalizer: engine resolution,
+# device_get of finished buffers, and numpy renumbering are its job
+def build_tree_leafwise(
+    binned,
+    y: np.ndarray,
+    *,
+    config,
+    mesh,
+    n_classes: int | None = None,
+    sample_weight: np.ndarray | None = None,
+    refit_targets: np.ndarray | None = None,
+    timer: PhaseTimer | None = None,
+    return_leaf_ids: bool = False,
+    feature_sampler=None,
+    mono_cst: np.ndarray | None = None,
+):
+    """Grow one tree best-first; same contract as ``builder.build_tree``.
+
+    Routed by ``build_tree`` whenever ``BuildConfig.max_leaf_nodes`` is
+    set. Engine resolution mirrors the level-wise one: "fused" (default —
+    the whole loop is one program) or "levelwise" (the host-stepped
+    expansion loop with per-expansion obs rows and chaos seams);
+    ``MPITREE_TPU_ENGINE`` steers the default. Per-node feature sampling,
+    monotonic constraints, and (data, feature) meshes are not supported
+    with a leaf-wise frontier yet (ROADMAP carries the follow-ups).
+    """
+    cfg = config
+    task = cfg.task
+    timer = timer if timer is not None else PhaseTimer(enabled=False)
+    timer.set_mesh(mesh)
+    if feature_sampler is not None and feature_sampler.active:
+        raise ValueError(
+            "max_leaf_nodes does not support per-node feature sampling "
+            "(max_features / splitter='random') yet"
+        )
+    if mono_cst is not None and bool(np.any(np.asarray(mono_cst) != 0)):
+        raise ValueError(
+            "max_leaf_nodes does not support monotonic_cst yet"
+        )
+    if mesh_lib.feature_shards(mesh) > 1:
+        raise ValueError(
+            "max_leaf_nodes supports 1-D data meshes only"
+        )
+    if cfg.hist_kernel == "pallas":
+        raise ValueError(
+            "hist_kernel='pallas' cannot apply to a leaf-wise frontier: "
+            "per-expansion histograms are two-slot scatters with no "
+            "Mosaic tier"
+        )
+    if (cfg.hist_kernel == "auto"
+            and os.environ.get("MPITREE_TPU_HIST_KERNEL") == "pallas"):
+        # The env var is an ambient preference for level-wise fits and
+        # must not crash a fit it cannot apply to (only the explicit
+        # BuildConfig raises) — same graceful identity opt-out as the
+        # serving tier's forced-but-unsatisfiable kernel.
+        timer.event(
+            "leafwise_pallas_fallback",
+            "MPITREE_TPU_HIST_KERNEL=pallas ignored for the leaf-wise "
+            "frontier: per-expansion histograms are two-slot scatters "
+            "with no Mosaic tier (scatter path used)",
+        )
+
+    engine = cfg.engine
+    engine_reason = None
+    if engine != "auto":
+        engine_reason = f"explicit BuildConfig(engine={engine!r})"
+    else:
+        env_engine = os.environ.get("MPITREE_TPU_ENGINE", "auto")
+        if env_engine != "auto":
+            engine = env_engine
+            engine_reason = f"MPITREE_TPU_ENGINE={env_engine}"
+    if engine not in ("auto", "fused", "levelwise"):
+        raise ValueError(f"unknown build engine {engine!r}")
+    if engine == "auto":
+        engine = "fused"
+        engine_reason = (
+            "auto: the best-first loop runs one expansion per step — "
+            "per-expansion host dispatch would put O(max_leaf_nodes) "
+            "round trips on the critical path, so the fused single-"
+            "program loop is the default"
+        )
+
+    platform = mesh.devices.flat[0].platform
+    N, F = binned.x_binned.shape
+    B = binned.n_bins
+    C = n_classes if task == "classification" else 3
+    int_ok = integer_weights(sample_weight)
+    exact_ties = resolve_exact_ties(platform)
+    gbdt_x64 = task == "gbdt" and resolve_gbdt_x64(platform)
+    total_w = (
+        float(N) if sample_weight is None else float(np.sum(sample_weight))
+    )
+    use_sub = resolve_hist_subtraction(
+        cfg, platform, task, integer_ok=int_ok, gbdt_x64=gbdt_x64,
+        total_weight=total_w, obs=timer,
+    )
+    Pn = _pool_capacity(cfg.max_leaf_nodes, cfg.max_depth, N)
+    M = 2 * Pn - 1
+    md = -1 if cfg.max_depth is None else int(cfg.max_depth)
+
+    timer.decision(
+        "engine", engine, reason=engine_reason,
+        rows=int(N), features=int(F), bins=int(B), task=task,
+    )
+    timer.decision(
+        "frontier", "leafwise",
+        reason=(
+            f"max_leaf_nodes={cfg.max_leaf_nodes}: best-first priority "
+            f"pool of {Pn} open leaves; each expansion pays one "
+            "sibling-pair histogram"
+            + (" (smaller child only, larger = parent - small)"
+               if use_sub else "")
+        ),
+        max_leaf_nodes=int(cfg.max_leaf_nodes), pool=int(Pn),
+    )
+    timer.decision(
+        "hist_subtraction", "on" if use_sub else "off",
+        reason=(
+            "per-expansion sibling subtraction against the pool-resident "
+            "parent histogram" if use_sub else
+            "direct pair accumulation (resolve_hist_subtraction: "
+            "config/env off, non-exact channels or non-accelerator "
+            "platform under 'auto', or the 2**24 f32 ceiling)"
+        ),
+    )
+
+    mcw = np.float32(cfg.min_child_weight)
+    mid = np.float32(cfg.min_decrease_scaled)
+    lam = np.float32(cfg.reg_lambda)
+    msl = np.float32(cfg.min_leaf_rows)
+    msg = np.float32(cfg.min_split_gain)
+
+    if engine == "fused":
+        fn_kw = dict(
+            n_bins=B, n_classes=C, task=task, criterion=cfg.criterion,
+            max_leaves=Pn, max_depth=md,
+            min_samples_split=int(cfg.min_samples_split),
+            exact_ties=exact_ties, gbdt_x64=gbdt_x64, subtraction=use_sub,
+        )
+        fn = _make_leafwise_fn(mesh, **fn_kw)
+        timer.compile_note(
+            "leafwise_fn", (mesh,) + tuple(sorted(fn_kw.items())),
+            cache_size=32,
+        )
+        with timer.phase("shard"):
+            xb_d, y_d, w_d, nid_d, cand_d = mesh_lib.shard_build_inputs(
+                mesh, binned, y, sample_weight
+            )
+        with timer.phase("leafwise_build"):
+            chaos.step("leafwise_build")
+            out = fn(xb_d, y_d, nid_d, w_d, cand_d, mcw, mid, lam, msl, msg)
+            feat, bins, counts, nvec, left, parent, _depth, nid_out, nn = out
+            feat, bins, counts, nvec, left, parent, nn = jax.device_get(
+                (feat, bins, counts, nvec, left, parent, nn)
+            )
+        n_nodes = int(nn)
+        timer.counter("leafwise_fused_builds")
+    else:
+        feat, bins, counts, nvec, left, parent, n_nodes, nid_out = (
+            _build_leafwise_stepped(
+                binned, y, cfg=cfg, mesh=mesh, n_classes=C, task=task,
+                pool=Pn, max_nodes=M, sample_weight=sample_weight,
+                exact_ties=exact_ties, gbdt_x64=gbdt_x64, use_sub=use_sub,
+                mcw=mcw, mid=mid, lam=lam, msl=msl, msg=msg, timer=timer,
+            )
+        )
+        timer.counter("leafwise_stepped_builds")
+
+    with timer.phase("host_finalize"):
+        tree, perm = _finalize_leafwise(
+            binned, task, cfg.criterion, n_nodes, feat, bins, counts, nvec,
+            left, parent, integer_counts=int_ok,
+        )
+
+    # Realized-work accounting (always-on counters; per-depth rows for the
+    # fused engine, whose expansion order the finished tree cannot replay
+    # — the stepped loop already emitted live per-expansion rows).
+    rows, coll, counters = obs_acct.leafwise_scan_rows(
+        tree, n_features=F, n_bins=B, n_channels=C, task=task,
+        subtraction=use_sub, gbdt_x64=gbdt_x64,
+    )
+    for name, v in counters.items():
+        timer.counter(name, v)
+    for site, v in coll.items():
+        timer.collective(site, calls=v["calls"], nbytes=v["bytes"])
+    if engine == "fused":
+        for r in rows:
+            timer.level(**r)
+
+    from mpitree_tpu.core.builder import fetch_row_nodes
+
+    nid_host = None
+    if task == "regression" and refit_targets is not None:
+        from mpitree_tpu.core.builder import refit_regression_values
+
+        nid_host = perm[fetch_row_nodes(nid_out, N)]
+        w64 = (np.ones(N) if sample_weight is None
+               else sample_weight).astype(np.float64)
+        refit_regression_values(tree, nid_host, w64, refit_targets)
+
+    if return_leaf_ids:
+        if nid_host is None:
+            nid_host = perm[fetch_row_nodes(nid_out, N)]
+        return tree, nid_host
+    return tree
+
+
+# graftlint: host-fn — the stepped engine's host loop: per-expansion
+# device_get of packed pair decisions is its deliberate job
+def _build_leafwise_stepped(binned, y, *, cfg, mesh, n_classes, task, pool,
+                            max_nodes, sample_weight, exact_ties, gbdt_x64,
+                            use_sub, mcw, mid, lam, msl, msg, timer):
+    """Host-orchestrated best-first loop: one expand dispatch per step.
+
+    Returns raw expansion-ordered buffers (the shared finalizer
+    renumbers). Pool bookkeeping lives on host; under subtraction each
+    open leaf's reduced pair histogram stays DEVICE-resident (a slice of
+    the expansion output that created it) and is fed back as the parent
+    operand when the leaf is expanded.
+    """
+    B = binned.n_bins
+    F = binned.x_binned.shape[1]
+    expand_kw = dict(
+        n_bins=B, n_classes=n_classes, task=task, criterion=cfg.criterion,
+        exact_ties=exact_ties, gbdt_x64=gbdt_x64, subtraction=use_sub,
+    )
+    expand = collective.make_expand_fn(mesh, **expand_kw)
+    timer.compile_note(
+        "expand_fn", (mesh,) + tuple(sorted(expand_kw.items()))
+    )
+    with timer.phase("shard"):
+        xb_d, y_d, w_d, nid_d, cand_d = mesh_lib.shard_build_inputs(
+            mesh, binned, y, sample_weight
+        )
+
+    M = max_nodes
+    feat = np.full(M, -1, np.int32)
+    bins = np.zeros(M, np.int32)
+    counts = np.zeros((M, n_classes), np.float32)
+    nvec = np.zeros(M, np.float32)
+    left = np.full(M, -1, np.int32)
+    parent = np.full(M, -1, np.int32)
+    depth = np.zeros(M, np.int32)
+
+    pool_gain = np.full(pool, -np.inf, np.float32)
+    pool_node = np.zeros(pool, np.int32)
+    pool_feat = np.zeros(pool, np.int32)
+    pool_bin = np.zeros(pool, np.int32)
+    pool_nl = np.zeros(pool, np.float32)
+    # Per-slot (pair_hist device array, 0|1) refs — subtraction only.
+    pool_hist: list = [None] * pool
+
+    if use_sub and gbdt_x64:
+        # f32 zeros converted INSIDE the scope — a direct f64 zeros
+        # canonicalizes to f32 on legacy wheels (_channel_histogram).
+        with jax.enable_x64(True):
+            zeros_ph = jnp.zeros(
+                (1, F, n_classes, B), jnp.float32
+            ).astype(jnp.float64)
+    elif use_sub:
+        zeros_ph = jnp.zeros((1, F, n_classes, B), jnp.float32)
+
+    def dispatch(e_node, f, b, l_id, small_left, phist):
+        sub_ops = (phist,) if use_sub else ()
+        return expand(
+            xb_d, y_d, nid_d, w_d, cand_d, np.int32(e_node), np.int32(f),
+            np.int32(b), np.int32(l_id), bool(small_left), mcw, lam, msl,
+            *sub_ops,
+        )
+
+    # Root bootstrap: sentinel -2 reroutes nothing (live rows are >= 0,
+    # padding is -1), left_id 0 puts the whole dataset in pair slot 0.
+    res = dispatch(-2, 0, 0, 0, True, zeros_ph if use_sub else None)
+    nid_d = res[0]
+    dec = collective.unpack_decision(np.asarray(jax.device_get(res[1])))
+    n0, _, gain0 = _stop_and_gain_np(dec, 0, task=task, cfg=cfg)
+    counts[0] = dec["counts"][0]
+    nvec[0] = n0[0]
+    pool_gain[0] = gain0[0]
+    pool_feat[0] = dec["feature"][0]
+    pool_bin[0] = dec["bin"][0]
+    pool_nl[0] = dec["n_left"][0]
+    if use_sub:
+        pool_hist[0] = (res[2], 0)
+
+    n_nodes, n_leaves = 1, 1
+    while n_leaves < pool and pool_gain.max() > -np.inf:
+        # Chaos seam (resilience.chaos): deterministic kill/blip at an
+        # exact expansion; free (one global read) with no plan installed.
+        chaos.step("expansion")
+        t_exp = time.perf_counter() if timer.enabled else 0.0
+        p = imp_ops.best_leaf_slot_np(pool_gain, pool_node)
+        enode = int(pool_node[p])
+        f, b = int(pool_feat[p]), int(pool_bin[p])
+        l_id = n_nodes
+        feat[enode] = f
+        bins[enode] = b
+        left[enode] = l_id
+        parent[l_id] = parent[l_id + 1] = enode
+        d_child = int(depth[enode]) + 1
+        depth[l_id] = depth[l_id + 1] = d_child
+        small_left = bool(pool_nl[p] * np.float32(2.0) <= nvec[enode])
+        phist = None
+        if use_sub:
+            keep, idx = pool_hist[p]
+            if gbdt_x64:
+                # Scoped slice: an outside-scope op on the f64 pair
+                # histogram would round the operand aval to f32.
+                with jax.enable_x64(True):
+                    phist = keep[idx:idx + 1]
+            else:
+                phist = keep[idx:idx + 1]
+        res = dispatch(enode, f, b, l_id, small_left, phist)
+        nid_d = res[0]
+        dec = collective.unpack_decision(
+            np.asarray(jax.device_get(res[1]))
+        )
+        n2, stop2, gain2 = _stop_and_gain_np(
+            dec, d_child, task=task, cfg=cfg
+        )
+        counts[l_id:l_id + 2] = dec["counts"]
+        nvec[l_id:l_id + 2] = n2
+        q = n_leaves
+        pool_gain[p], pool_gain[q] = gain2[0], gain2[1]
+        pool_node[p], pool_node[q] = l_id, l_id + 1
+        pool_feat[p], pool_feat[q] = dec["feature"][0], dec["feature"][1]
+        pool_bin[p], pool_bin[q] = dec["bin"][0], dec["bin"][1]
+        pool_nl[p], pool_nl[q] = dec["n_left"][0], dec["n_left"][1]
+        if use_sub:
+            pool_hist[p] = (res[2], 0)
+            pool_hist[q] = (res[2], 1)
+        small_n = float(n2[0] if small_left else n2[1])
+        timer.level(
+            level=d_child, frontier=2, splits=int((~stop2).sum()),
+            hist_bytes=collective.split_psum_bytes(
+                n_slots=1 if use_sub else 2, n_features=F, n_bins=B,
+                n_channels=n_classes, itemsize=8 if gbdt_x64 else 4,
+            ),
+            psum_bytes=None,
+            rows_scanned=small_n if use_sub else float(n2.sum()),
+            small_child_fraction=None,
+            seconds=(
+                round(time.perf_counter() - t_exp, 6)
+                if timer.enabled else None
+            ),
+            new_lowerings=0,
+        )
+        n_nodes += 2
+        n_leaves += 1
+
+    return feat, bins, counts, nvec, left, parent, n_nodes, nid_d

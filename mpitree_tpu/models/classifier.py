@@ -42,7 +42,7 @@ from mpitree_tpu.ops.predict import (
     predict_mesh,
 )
 from mpitree_tpu.parallel import mesh as mesh_lib
-from mpitree_tpu.resilience import device_failover
+from mpitree_tpu.resilience import device_failover, retry_device
 from mpitree_tpu.serving.tables import note_serving
 from mpitree_tpu.utils.export import export_tree_text
 from mpitree_tpu.utils.importances import feature_importances
@@ -53,6 +53,7 @@ from mpitree_tpu.utils.validation import (
     min_decrease_scaled,
     record_sklearn_attributes,
     validate_fit_data,
+    validate_max_leaf_nodes,
     validate_predict_data,
     resolve_refine,
     validate_sample_weight,
@@ -75,6 +76,17 @@ class DecisionTreeClassifier(ClassifierMixin, ReportMixin, BaseEstimator):
     max_depth : int, optional
         Exact-equality depth cutoff, as in the reference
         (``decision_tree.py:121``); ``None`` = unbounded.
+    max_leaf_nodes : int, optional
+        Grow the tree leaf-wise (best-first) with at most this many
+        leaves: each step expands the open leaf with the largest weighted
+        impurity decrease (sklearn's best-first semantics, LightGBM's
+        ``num_leaves`` playbook), paying one sibling-pair histogram per
+        expansion instead of a full-frontier pass per level
+        (``core/leafwise_builder.py``). ``None`` (default) grows
+        level-wise. Composes with ``max_depth``; requires a device engine
+        (no ``backend="host"``) and currently excludes ``max_features``,
+        ``splitter="random"``, ``monotonic_cst``, and the hybrid refine
+        tail.
     min_samples_split : int, default=2
         Nodes with fewer samples become leaves (``decision_tree.py:122``).
     criterion : {"entropy", "gini"}, default="entropy"
@@ -141,7 +153,8 @@ class DecisionTreeClassifier(ClassifierMixin, ReportMixin, BaseEstimator):
 
     _task = "classification"
 
-    def __init__(self, *, max_depth=None, min_samples_split=2,
+    def __init__(self, *, max_depth=None, max_leaf_nodes=None,
+                 min_samples_split=2,
                  criterion="entropy", splitter="best", max_bins=256,
                  binning="auto",
                  max_features=None, class_weight=None,
@@ -151,6 +164,7 @@ class DecisionTreeClassifier(ClassifierMixin, ReportMixin, BaseEstimator):
                  ccp_alpha=0.0, min_impurity_decrease=0.0,
                  monotonic_cst=None):
         self.max_depth = max_depth
+        self.max_leaf_nodes = max_leaf_nodes
         self.min_samples_split = min_samples_split
         self.criterion = criterion
         self.splitter = splitter
@@ -186,8 +200,13 @@ class DecisionTreeClassifier(ClassifierMixin, ReportMixin, BaseEstimator):
             n_classes=len(classes),
         )
 
+        mln = validate_max_leaf_nodes(self)
+
         timer = obs = BuildObserver()
-        host = prefer_host_path(*X.shape, self.n_devices, self.backend)
+        host = (
+            prefer_host_path(*X.shape, self.n_devices, self.backend)
+            and mln is None  # best-first growth lives in the device engines
+        )
         note_build_path(
             obs, host=host, backend=self.backend,
             n_rows=X.shape[0], n_features=X.shape[1],
@@ -208,15 +227,20 @@ class DecisionTreeClassifier(ClassifierMixin, ReportMixin, BaseEstimator):
             # tail would need crown bounds threaded across the graft seam;
             # constraint semantics take precedence over tail perf here.
             rd, refine, crown_depth = None, False, self.max_depth
+        if mln is not None:
+            # The leaf budget is global: a host tail re-growing crown
+            # leaves would blow past it, so best-first fits single-engine.
+            rd, refine, crown_depth = None, False, self.max_depth
         note_refine(
             obs, refine=refine, rd=rd, crown_depth=crown_depth,
             refine_depth_param=self.refine_depth,
-            constrained=mono is not None,
+            constrained=mono is not None, leafwise=mln is not None,
         )
         cfg = BuildConfig(
             task="classification",
             criterion=self.criterion,
             max_depth=crown_depth,
+            max_leaf_nodes=mln,
             min_samples_split=self.min_samples_split,
             min_child_weight=min_child_weight(
                 self.min_weight_fraction_leaf, sw, X.shape[0],
@@ -282,10 +306,21 @@ class DecisionTreeClassifier(ClassifierMixin, ReportMixin, BaseEstimator):
                     )
                     return res if refine else (res, None)
 
-            self.tree_, leaf_ids = device_failover(
-                _dev, _host, what=f"{type(self).__name__}.fit device build",
-                obs=obs,
-            )
+            if mln is not None:
+                # No host twin for the best-first frontier (the numpy
+                # tier grows level-wise only): the ladder keeps its retry
+                # rung and stops there — the boosting-round stance.
+                self.tree_, leaf_ids = retry_device(
+                    _dev,
+                    what=f"{type(self).__name__}.fit leaf-wise build",
+                    obs=obs,
+                )
+            else:
+                self.tree_, leaf_ids = device_failover(
+                    _dev, _host,
+                    what=f"{type(self).__name__}.fit device build",
+                    obs=obs,
+                )
         if refine:
             from mpitree_tpu.core.hybrid_builder import apply_refine
 
@@ -436,7 +471,8 @@ class ParallelDecisionTreeClassifier(DecisionTreeClassifier):
     single-process runs see rank 0 — same as the reference's notebook usage.
     """
 
-    def __init__(self, *, max_depth=None, min_samples_split=2,
+    def __init__(self, *, max_depth=None, max_leaf_nodes=None,
+                 min_samples_split=2,
                  criterion="entropy", splitter="best", max_bins=256,
                  binning="auto",
                  max_features=None, class_weight=None,
@@ -446,7 +482,8 @@ class ParallelDecisionTreeClassifier(DecisionTreeClassifier):
                  ccp_alpha=0.0, min_impurity_decrease=0.0,
                  monotonic_cst=None):
         super().__init__(
-            max_depth=max_depth, min_samples_split=min_samples_split,
+            max_depth=max_depth, max_leaf_nodes=max_leaf_nodes,
+            min_samples_split=min_samples_split,
             criterion=criterion, splitter=splitter, max_bins=max_bins,
             binning=binning,
             max_features=max_features, class_weight=class_weight,

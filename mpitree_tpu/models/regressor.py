@@ -35,7 +35,7 @@ from mpitree_tpu.ops.predict import (
     predict_mesh,
 )
 from mpitree_tpu.parallel import mesh as mesh_lib
-from mpitree_tpu.resilience import device_failover
+from mpitree_tpu.resilience import device_failover, retry_device
 from mpitree_tpu.serving.tables import note_serving
 from mpitree_tpu.utils.export import export_tree_text
 from mpitree_tpu.utils.importances import feature_importances
@@ -47,6 +47,7 @@ from mpitree_tpu.utils.validation import (
     validate_fit_data,
     validate_predict_data,
     resolve_refine,
+    validate_max_leaf_nodes,
     validate_sample_weight,
 )
 
@@ -60,7 +61,8 @@ class DecisionTreeRegressor(RegressorMixin, ReportMixin, BaseEstimator):
 
     _task = "regression"
 
-    def __init__(self, *, max_depth=None, min_samples_split=2,
+    def __init__(self, *, max_depth=None, max_leaf_nodes=None,
+                 min_samples_split=2,
                  criterion="squared_error", splitter="best", max_bins=256,
                  binning="auto",
                  max_features=None, min_weight_fraction_leaf=0.0,
@@ -69,6 +71,7 @@ class DecisionTreeRegressor(RegressorMixin, ReportMixin, BaseEstimator):
                  ccp_alpha=0.0, min_impurity_decrease=0.0,
                  monotonic_cst=None):
         self.max_depth = max_depth
+        self.max_leaf_nodes = max_leaf_nodes
         self.min_samples_split = min_samples_split
         self.criterion = criterion
         self.splitter = splitter
@@ -103,8 +106,13 @@ class DecisionTreeRegressor(RegressorMixin, ReportMixin, BaseEstimator):
             self.monotonic_cst, X.shape[1], task="regression"
         )
 
+        mln = validate_max_leaf_nodes(self)
+
         timer = obs = BuildObserver()
-        host = prefer_host_path(*X.shape, self.n_devices, self.backend)
+        host = (
+            prefer_host_path(*X.shape, self.n_devices, self.backend)
+            and mln is None  # best-first growth lives in the device engines
+        )
         note_build_path(
             obs, host=host, backend=self.backend,
             n_rows=X.shape[0], n_features=X.shape[1],
@@ -124,15 +132,20 @@ class DecisionTreeRegressor(RegressorMixin, ReportMixin, BaseEstimator):
             # tail would need crown bounds threaded across the graft seam;
             # constraint semantics take precedence over tail perf here.
             rd, refine, crown_depth = None, False, self.max_depth
+        if mln is not None:
+            # The leaf budget is global: a host tail re-growing crown
+            # leaves would blow past it, so best-first fits single-engine.
+            rd, refine, crown_depth = None, False, self.max_depth
         note_refine(
             obs, refine=refine, rd=rd, crown_depth=crown_depth,
             refine_depth_param=self.refine_depth,
-            constrained=mono is not None,
+            constrained=mono is not None, leafwise=mln is not None,
         )
         cfg = BuildConfig(
             task="regression",
             criterion="mse",
             max_depth=crown_depth,
+            max_leaf_nodes=mln,
             min_samples_split=self.min_samples_split,
             min_child_weight=min_child_weight(
                 self.min_weight_fraction_leaf, sw, X.shape[0],
@@ -196,10 +209,21 @@ class DecisionTreeRegressor(RegressorMixin, ReportMixin, BaseEstimator):
                     )
                     return res if refine else (res, None)
 
-            self.tree_, leaf_ids = device_failover(
-                _dev, _host, what=f"{type(self).__name__}.fit device build",
-                obs=obs,
-            )
+            if mln is not None:
+                # No host twin for the best-first frontier (the numpy
+                # tier grows level-wise only): the ladder keeps its retry
+                # rung and stops there — the boosting-round stance.
+                self.tree_, leaf_ids = retry_device(
+                    _dev,
+                    what=f"{type(self).__name__}.fit leaf-wise build",
+                    obs=obs,
+                )
+            else:
+                self.tree_, leaf_ids = device_failover(
+                    _dev, _host,
+                    what=f"{type(self).__name__}.fit device build",
+                    obs=obs,
+                )
         if refine:
             from mpitree_tpu.core.hybrid_builder import apply_refine
 

@@ -70,6 +70,9 @@ def fused_level_rows(
     feature_shards: int = 1,
     n_rows: int | None = None,
     subtraction: bool = False,
+    node_samples: np.ndarray | None = None,
+    node_left: np.ndarray | None = None,
+    node_right: np.ndarray | None = None,
 ) -> tuple:
     """(level_rows, collectives) replayed from a fused build's finished tree.
 
@@ -83,11 +86,40 @@ def fused_level_rows(
     interior level below the root whose frontier AND parent frontier each
     fit one chunk psums only the compact half-width small-child buffer.
     Returns per-level row dicts (seconds ``None`` — one compiled program
-    has no per-level host clock; ``rows_scanned``/``small_child_fraction``
-    ``None`` — the depth histogram carries no per-node row counts) and a
-    ``{site: {"calls", "bytes"}}`` dict of logical psum/gather payloads.
+    has no per-level host clock) and a ``{site: {"calls", "bytes"}}``
+    dict of logical psum/gather payloads.
+
+    ``node_samples``/``node_left``/``node_right`` (the finished tree's
+    per-node weights and child links) make the replay EXACT for realized
+    work: every allocated node was once a frontier member at its depth,
+    so the per-level frontier weight is ``bincount(depth, weights=n)``,
+    and a subtraction level accumulates only each pair's smaller sibling
+    — ``min(n[left], n[right])`` binned by child depth. Without them the
+    per-row ``rows_scanned``/``small_child_fraction`` stay ``None``
+    (depth histogram alone carries no row counts — the pre-ISSUE-8
+    contract, still pinned by the golden replay test).
     """
-    frontiers = np.bincount(np.asarray(node_depths, np.int64))
+    depths_a = np.asarray(node_depths, np.int64)
+    frontiers = np.bincount(depths_a)
+    wlev = minlev = None
+    # All-or-nothing: without the child links a subtraction level cannot
+    # price its smaller siblings, and a zeros placeholder would claim
+    # ZERO realized work — keep the documented None contract instead.
+    if (node_samples is not None and node_left is not None
+            and node_right is not None):
+        n = np.asarray(node_samples, np.float64)
+        wlev = np.bincount(depths_a, weights=n, minlength=len(frontiers))
+        minlev = np.zeros(len(frontiers) + 1)
+        li = np.asarray(node_left)
+        ids = np.flatnonzero(li >= 0)
+        if len(ids):
+            mw = np.minimum(
+                n[li[ids]], n[np.asarray(node_right)[ids]]
+            )
+            minlev = np.bincount(
+                depths_a[ids] + 1, weights=mw,
+                minlength=len(frontiers) + 1,
+            )
     rows: list = []
     coll: dict = {}
 
@@ -105,6 +137,7 @@ def fused_level_rows(
             int(frontiers[d + 1]) // 2 if d + 1 < len(frontiers) else 0
         )
         terminal = max_depth >= 0 and d == max_depth
+        scanned = small_frac = None
         if terminal:
             chunks = math.ceil(f / K)
             nbytes = chunks * counts_psum_bytes(
@@ -137,6 +170,10 @@ def fused_level_rows(
                 add("feature_merge_all_gather", chunks, gb)
                 if n_rows is not None:
                     add("route_psum", 1, n_rows * 4)
+            if wlev is not None:
+                fw = float(wlev[d])
+                scanned = float(minlev[d]) if sub_here and d > 0 else fw
+                small_frac = round(scanned / fw, 6) if fw else None
             prev_one_chunk = chunks == 1
         rows.append({
             "level": d,
@@ -144,9 +181,106 @@ def fused_level_rows(
             "splits": splits,
             "hist_bytes": int(hist_bytes),
             "psum_bytes": int(psum_bytes),
-            "rows_scanned": None,
-            "small_child_fraction": None,
+            "rows_scanned": scanned,
+            "small_child_fraction": small_frac,
             "seconds": None,
             "new_lowerings": 0,
         })
     return rows, coll
+
+
+def fused_scan_rows(tree, **kwargs) -> tuple:
+    """(rows, coll, counters): :func:`fused_level_rows` with the exact
+    realized-work replay wired up from the finished ``TreeArrays``.
+
+    The always-on ``rows_scanned``/``rows_frontier`` counters mirror the
+    host-stepped levelwise loop's live accounting (``builder.build_tree``)
+    so the ``leafwise_ab`` bench A/B reads the same counter names off
+    every engine: scanned = weight actually accumulated into split
+    histograms (small siblings only at subtraction levels), frontier =
+    what direct accumulation would have scanned. Terminal counts-only
+    levels pay no split histogram and count toward neither.
+    """
+    rows, coll = fused_level_rows(
+        tree.depth, node_samples=tree.n_node_samples,
+        node_left=tree.left, node_right=tree.right, **kwargs,
+    )
+    wlev = np.bincount(
+        np.asarray(tree.depth, np.int64),
+        weights=np.asarray(tree.n_node_samples, np.float64),
+    )
+    live = [r for r in rows if r["rows_scanned"] is not None]
+    counters = {}
+    if live:
+        counters = {
+            "rows_scanned": int(round(sum(
+                r["rows_scanned"] for r in live
+            ))),
+            "rows_frontier": int(round(sum(
+                float(wlev[r["level"]]) for r in live
+            ))),
+        }
+    return rows, coll, counters
+
+
+def leafwise_scan_rows(tree, *, n_features: int, n_bins: int,
+                       n_channels: int, task: str, subtraction: bool,
+                       gbdt_x64: bool = False) -> tuple:
+    """(rows, collectives, counters) replayed from a leaf-wise build.
+
+    Unlike the level-wise replay, the finished tree carries EXACT
+    per-expansion work: each interior node was expanded exactly once,
+    paying one sibling-pair histogram whose accumulated weight is both
+    children (direct) or the smaller child (``subtraction``) — plus the
+    root bootstrap, which always scans everything. ``rows_scanned`` /
+    ``rows_frontier`` therefore come out exact (the realized-savings
+    counters the ``leafwise_ab`` bench A/B compares against the
+    level-wise engines' live counters); per-depth aggregate rows stand in
+    for the expansion order, which the finished structure cannot replay
+    (the host-stepped engine emits true per-expansion rows live instead).
+    """
+    n = np.asarray(tree.n_node_samples, np.float64)
+    interior = tree.left >= 0
+    exp_ids = np.flatnonzero(interior)
+    nl = n[tree.left[exp_ids]] if len(exp_ids) else np.zeros(0)
+    nr = n[tree.right[exp_ids]] if len(exp_ids) else np.zeros(0)
+    acc = np.minimum(nl, nr) if subtraction else nl + nr
+    rows_scanned = float(n[0]) + float(acc.sum())
+    rows_frontier = float(n[0]) + float((nl + nr).sum())
+    counters = {
+        "rows_scanned": int(round(rows_scanned)),
+        "rows_frontier": int(round(rows_frontier)),
+        "expansions": int(len(exp_ids)),
+    }
+
+    per_pair = split_psum_bytes(
+        n_slots=1 if subtraction else 2, n_features=n_features,
+        n_bins=n_bins, n_channels=n_channels,
+        itemsize=8 if gbdt_x64 else 4,
+    )
+    calls = len(exp_ids) + 1  # + the root bootstrap pair
+    coll = {"split_hist_psum": {"calls": calls, "bytes": calls * per_pair}}
+    if task == "regression":
+        coll["y_range_pminmax"] = {"calls": calls, "bytes": calls * 2 * 2 * 4}
+
+    rows = []
+    depths = tree.depth[tree.left[exp_ids]] if len(exp_ids) else np.zeros(0)
+    for d in sorted(set(np.asarray(depths, np.int64).tolist())):
+        sel = depths == d
+        scanned = float(acc[sel].sum())
+        frontier = float((nl + nr)[sel].sum())
+        rows.append({
+            "level": int(d),
+            "frontier": int(2 * sel.sum()),
+            "splits": int(interior[tree.left[exp_ids[sel]]].sum()
+                          + interior[tree.right[exp_ids[sel]]].sum()),
+            "hist_bytes": int(sel.sum()) * per_pair,
+            "psum_bytes": int(sel.sum()) * per_pair,
+            "rows_scanned": scanned,
+            "small_child_fraction": (
+                round(scanned / frontier, 6) if frontier else None
+            ),
+            "seconds": None,
+            "new_lowerings": 0,
+        })
+    return rows, coll, counters

@@ -14,8 +14,12 @@ Cost model, enforced by ``tests/test_obs.py``'s disabled-path test:
   decisions are O(1) dict updates on numbers computed from static shapes
   — within the <5% wall bound on the 2k-row smoke workload;
 - observability ON: spans accumulate wall-clock and per-level rows are
-  appended (capped — see ``MAX_LEVEL_ROWS`` — with an honest
-  ``levels_dropped`` counter instead of a silent truncation).
+  appended (capped — see ``MAX_LEVEL_ROWS``). Rows past the cap stream
+  to a JSONL spill file when a sink is configured
+  (:meth:`BuildObserver.stream_levels_to` or
+  ``MPITREE_TPU_OBS_STREAM_DIR`` — leaf-wise builds emit one row per
+  EXPANSION, so a 255-leaf GBDT blows the cap inside two rounds); with
+  no sink the honest ``levels_dropped`` counter records the truncation.
 
 Compile accounting is a process-wide cache-key registry — the runtime
 twin of graftlint GL02: every jit entry point (``split_fn``,
@@ -29,11 +33,18 @@ leaking runtime values).
 
 from __future__ import annotations
 
+import itertools
+import json
+import os
 import warnings
 from collections import OrderedDict
 
-from mpitree_tpu.obs.record import BuildRecord
+from mpitree_tpu.obs.record import BuildRecord, _jsonable
 from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
+
+# Per-process spill-file sequence: distinguishes observers sharing a PID
+# without relying on id(self) (heap addresses recycle).
+_STREAM_SEQ = itertools.count()
 
 # Lowering events per entry point beyond which we warn: the collective
 # factories' lru_caches hold 64 entries and the fused builder's 32 — past
@@ -139,9 +150,16 @@ def note_build_path(obs, *, host: bool, backend, n_rows: int,
 
 
 def note_refine(obs, *, refine: bool, rd, crown_depth,
-                refine_depth_param, constrained: bool = False) -> None:
+                refine_depth_param, constrained: bool = False,
+                leafwise: bool = False) -> None:
     """Record the hybrid-refine decision (estimator-level routing)."""
-    if constrained:
+    if leafwise:
+        reason = (
+            "max_leaf_nodes: hybrid tail skipped — the best-first frontier "
+            "owns the leaf budget end to end (a host tail would re-grow "
+            "past it)"
+        )
+    elif constrained:
         reason = (
             "monotonic_cst: hybrid tail skipped — constraint bounds do not "
             "thread across the graft seam (single-engine full depth)"
@@ -183,6 +201,62 @@ class BuildObserver(PhaseTimer):
             enabled=profiling_enabled() if timing is None else timing
         )
         self.record = BuildRecord()
+        self._level_stream_path: str | None = None
+        self._level_stream_file = None
+        self._level_stream_failed = False
+
+    def stream_levels_to(self, path) -> None:
+        """Spill per-level/per-expansion rows past ``MAX_LEVEL_ROWS`` to
+        ``path`` (JSONL, append) instead of dropping them.
+
+        The in-record list keeps the first ``MAX_LEVEL_ROWS`` rows (the
+        record stays bench-line sized); everything past the cap lands in
+        the spill file and ``record.level_stream`` carries
+        ``{"path", "rows"}`` so consumers know where the tail lives.
+        ``MPITREE_TPU_OBS_STREAM_DIR=<dir>`` configures the same sink
+        ambiently (one uniquely named file per observer, created on first
+        spill) for estimators that build their observer internally.
+        """
+        self._level_stream_path = str(path)
+
+    def _level_sink(self):
+        """The open spill file, or None when no sink is configured.
+
+        An unwritable sink (read-only dir, full disk) must never abort a
+        fit — the observability channel degrades to ``levels_dropped``
+        with a typed event carrying the evidence, same contract as every
+        other ambient env knob.
+        """
+        if self._level_stream_file is not None:
+            return self._level_stream_file
+        if self._level_stream_failed:
+            return None
+        path = self._level_stream_path
+        try:
+            if path is None:
+                stream_dir = os.environ.get("MPITREE_TPU_OBS_STREAM_DIR")
+                if not stream_dir:
+                    return None
+                os.makedirs(stream_dir, exist_ok=True)
+                # Monotonic per-process counter, NOT id(self): a recycled
+                # heap address would append a new fit's rows to a dead
+                # observer's spill file.
+                path = os.path.join(
+                    stream_dir,
+                    f"levels_{os.getpid()}_{next(_STREAM_SEQ)}.jsonl",
+                )
+            self._level_stream_file = open(path, "a")
+        except OSError as e:
+            self._level_stream_failed = True
+            self.event(
+                "level_stream_failed",
+                f"level-row spill sink unwritable ({e}); rows past the "
+                "cap are dropped instead",
+                path=path,
+            )
+            return None
+        self._level_stream_path = path
+        return self._level_stream_file
 
     # ``span`` is the obs-native name; ``phase`` stays for PhaseTimer
     # compatibility (both are the same context manager).
@@ -243,7 +317,14 @@ class BuildObserver(PhaseTimer):
             return
         rows = self.record.levels
         if len(rows) >= self.MAX_LEVEL_ROWS:
-            self.counter("levels_dropped")
+            sink = self._level_sink()
+            if sink is None:
+                self.counter("levels_dropped")
+                return
+            sink.write(json.dumps(_jsonable(row), sort_keys=True) + "\n")
+            ls = self.record.level_stream
+            ls["path"] = self._level_stream_path
+            ls["rows"] = ls.get("rows", 0) + 1
             return
         rows.append(row)
 
@@ -256,6 +337,12 @@ class BuildObserver(PhaseTimer):
         ``result``. Callable repeatedly (e.g. after post-fit OOB events).
         """
         rec = self.record
+        if self._level_stream_file is not None:
+            # Close (not just flush) so long-lived processes don't leak
+            # one fd per spilling fit; the resolved path stays, so a
+            # post-report spill simply reopens in append mode.
+            self._level_stream_file.close()
+            self._level_stream_file = None
         rec.phases = self.summary() if self.enabled else {}
         if tree is not None:
             rec.result = {

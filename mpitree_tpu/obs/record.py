@@ -29,7 +29,13 @@ import json
 
 # v2: level rows gained ``rows_scanned``/``small_child_fraction`` and the
 # digest gained ``sub_frac`` (sibling-subtraction realized savings).
-SCHEMA_VERSION = 2
+# v3 (ISSUE 8, leaf-wise growth): top-level ``level_stream`` (per-level/
+# per-expansion rows past the in-record cap spill to a JSONL file instead
+# of dropping — leaf-wise builds emit one row per EXPANSION and blow the
+# 512-row cap at max_leaf_nodes=255 within two boosting rounds); digest
+# gained ``expansions`` (leaf-wise expansion count) and
+# ``rounds_per_dispatch`` (fused multi-round GBDT dispatch width).
+SCHEMA_VERSION = 3
 
 # The golden field set: tests/test_obs.py pins this against to_dict() so a
 # rename cannot slip past bench/watcher consumers silently.
@@ -47,6 +53,7 @@ TOP_LEVEL_FIELDS = (
     "rounds",
     "trees",
     "result",
+    "level_stream",
 )
 
 
@@ -113,6 +120,10 @@ class BuildRecord:
     - ``trees``: ensemble per-member summaries ``{"n_nodes", "depth"}``.
     - ``result``: ``{"n_nodes", "depth"}`` of the fitted tree (aggregates
       for ensembles).
+    - ``level_stream``: ``{"path", "rows"}`` when per-level/per-expansion
+      rows past the in-record cap were streamed to a JSONL spill file
+      (``BuildObserver.stream_levels_to`` / ``MPITREE_TPU_OBS_STREAM_DIR``)
+      instead of dropped; ``{}`` otherwise.
     """
 
     schema: int = SCHEMA_VERSION
@@ -128,6 +139,7 @@ class BuildRecord:
     rounds: list = dataclasses.field(default_factory=list)
     trees: list = dataclasses.field(default_factory=list)
     result: dict = dataclasses.field(default_factory=dict)
+    level_stream: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return _jsonable(dataclasses.asdict(self))
@@ -181,6 +193,14 @@ def digest(report: dict) -> dict:
             round(scanned / frontier, 4) if scanned is not None and frontier
             else None
         ),
+        # Leaf-wise growth (ISSUE 8): interior expansions the best-first
+        # frontier actually paid for (None for level-wise builds), and
+        # the fused multi-round GBDT dispatch width (None for
+        # host-per-round loops and non-boosting fits).
+        "expansions": counters.get("expansions"),
+        "rounds_per_dispatch": (
+            report.get("decisions", {}).get("rounds_per_dispatch") or {}
+        ).get("value"),
         "events": len(report.get("events", [])),
         "wall_s": round(wall, 3),
     }

@@ -147,6 +147,32 @@ def sibling_reconstruct(
     return jnp.where(mask, small, parent - small)
 
 
+def sibling_reconstruct_pair(
+    small_hist: jax.Array,
+    parent_hist: jax.Array,
+    is_small: jax.Array,
+) -> jax.Array:
+    """:func:`sibling_reconstruct` specialized to ONE sibling pair.
+
+    The leaf-wise frontier expands a single leaf per step, so its
+    reconstruction reads exactly one compact slot against exactly one
+    parent row — static slicing + broadcast, no gather at all. That is
+    not just cheaper: ``jnp.take``'s cached inner jit mislowers for f64
+    operands inside a ``lax.while_loop`` body on pre-shard_map wheels
+    (the scoped-x64 gbdt pool), and a gather-free formulation sidesteps
+    the whole class. ``small_hist`` is (1, ...) (the compact pair
+    buffer), ``parent_hist`` (1, ...) (the expanded leaf's resident
+    histogram), ``is_small`` (2,) bool; returns the (2, ...) pair
+    histogram. Exactness contract identical to
+    :func:`sibling_reconstruct`.
+    """
+    shape = (2,) + small_hist.shape[1:]
+    small = jnp.broadcast_to(small_hist, shape)
+    parent = jnp.broadcast_to(parent_hist, shape)
+    mask = is_small.reshape((2,) + (1,) * (small_hist.ndim - 1))
+    return jnp.where(mask, small, parent - small)
+
+
 def _flat_ids(x_binned: jax.Array, valid: jax.Array, slot: jax.Array,
               n_bins: int) -> jax.Array:
     """Flattened (N*F,) (slot, feature, bin) segment ids, masked to 0."""

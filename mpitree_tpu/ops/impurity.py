@@ -334,6 +334,53 @@ def best_split_classification(
     )
 
 
+def leaf_gain(n, impurity, cost, *, task: str):
+    """Best-first expansion priority of an open leaf (numpy/jnp polymorphic).
+
+    The ONE copy of the priority formula every leaf-wise engine ranks by,
+    so the device-fused pool and the host-stepped pool can never drift:
+    classification/regression use the weighted impurity decrease
+    ``n * (impurity - cost)`` (sklearn's best-first ``max_leaf_nodes``
+    criterion — the same quantity ``min_impurity_decrease`` gates on);
+    gbdt uses the raw Newton gain ``impurity - cost`` (the
+    LightGBM/XGBoost ``lossguide`` convention — ``best_split_newton``'s
+    sign convention makes ``impurity - cost`` exactly the gain). All
+    inputs are the f32 decision fields, and the arithmetic is one
+    IEEE subtract (+ one multiply), so numpy and XLA rank identically.
+    """
+    gain = impurity - cost
+    if task != "gbdt":
+        gain = n * gain
+    return gain
+
+
+def best_leaf_slot(gain: jax.Array, node_id: jax.Array) -> jax.Array:
+    """Pool slot of the best open leaf (leaf-wise frontier selection).
+
+    ``gain`` is the (P,) padded pool priority (``-inf`` marks closed/empty
+    slots); ``node_id`` the (P,) node id each slot holds. The winner is
+    the max-gain slot, with ties broken toward the LOWEST node id —
+    node ids are unique and creation-ordered, so the tie-break is
+    engine- and slot-layout-independent (pool slots are reused by left
+    children, so "first slot" would not be canonical). ``lax.top_k``
+    extracts the max without any host sync (GL01-clean inside the fused
+    while_loop); the masked argmin then resolves the tie canonically.
+    """
+    top, _ = jax.lax.top_k(gain, 1)
+    eligible = gain == top[0]
+    return jnp.argmin(
+        jnp.where(eligible, node_id, jnp.int32(2**31 - 1))
+    ).astype(jnp.int32)
+
+
+def best_leaf_slot_np(gain, node_id) -> int:
+    """numpy twin of :func:`best_leaf_slot` (host-stepped leaf-wise loop)."""
+    import numpy as np
+
+    top = np.max(gain)
+    return int(np.argmin(np.where(gain == top, node_id, np.int32(2**31 - 1))))
+
+
 def _lex_argmin(hi: jax.Array, lo: jax.Array, *, axis: int) -> jax.Array:
     """First index of the lexicographic (hi, lo) minimum along ``axis``.
 

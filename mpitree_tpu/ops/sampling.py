@@ -156,7 +156,7 @@ def row_subsample_mask(seed: int, round_idx: int, n_rows: int,
             ^ pcg_hash((np.uint32(round_idx) + _ROW_SALT).astype(np.uint32))
         )
         keys = pcg_hash(base + np.arange(n_rows, dtype=np.uint32))
-    return keys < np.uint32(int(fraction * 4294967296.0))
+    return keys < subsample_threshold_u32(fraction)
 
 
 def feature_subsample_mask(seed: int, round_idx: int, n_features: int,
@@ -192,6 +192,33 @@ def feature_subsample_mask(seed: int, round_idx: int, n_features: int,
     mask = np.zeros(n_features, bool)
     mask[order[:k]] = True
     return mask
+
+
+def subsample_threshold_u32(fraction: float) -> np.uint32:
+    """The u32 acceptance threshold :func:`row_subsample_mask` compares
+    against — shared with the jnp twin so the fused multi-round program
+    and the host loop draw identical subsamples. Callers gate
+    ``fraction < 1`` themselves (1.0 would wrap)."""
+    return np.uint32(int(fraction * 4294967296.0))
+
+
+def row_subsample_mask_jnp(seed, round_idx, row_ids, threshold):
+    """jnp twin of :func:`row_subsample_mask` for in-dispatch rounds.
+
+    ``round_idx`` may be TRACED (the fused multi-round GBDT program scans
+    it); ``row_ids`` are GLOBAL row indices (shard offset + local iota —
+    row shards are contiguous blocks, so global index == host row index);
+    ``threshold`` from :func:`subsample_threshold_u32`. Bit-identical to
+    the host mask for rows < N; padding rows (global id >= N) draw
+    arbitrary bits but carry zero weight everywhere.
+    """
+    import jax.numpy as jnp
+
+    base = pcg_hash_jnp(jnp.asarray(seed).astype(jnp.uint32)) ^ pcg_hash_jnp(
+        jnp.asarray(round_idx).astype(jnp.uint32) + jnp.uint32(_ROW_SALT)
+    )
+    keys = pcg_hash_jnp(base + row_ids.astype(jnp.uint32))
+    return keys < threshold
 
 
 def pcg_hash_jnp(x):

@@ -410,6 +410,169 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     return _chaos_dispatch("split_dispatch", jax.jit(sharded))
 
 
+def pair_split_stats(xb, y, nid, w, cand_mask, base_id, is_small, phist,
+                     mcw, lam, msl, *, task: str, criterion: str,
+                     n_bins: int, n_classes: int, exact_ties: bool,
+                     gbdt_x64: bool, subtraction: bool,
+                     psum_axis=DATA_AXIS):
+    """Histogram + split sweep for ONE sibling pair — the leaf-wise hot op.
+
+    The best-first frontier expands one leaf at a time, so its unit of
+    histogram work is the two-slot pair ``(base_id, base_id + 1)`` (the
+    ROOT bootstrap rides the same code: ``base_id == 0`` with every row
+    still assigned to node 0 puts the whole dataset in slot 0 and leaves
+    slot 1 empty). Shared verbatim by the fused leaf-wise while_loop body
+    (``core/leafwise_builder``) and the host-stepped expansion program
+    (:func:`make_expand_fn`) so the two engines cannot drift.
+
+    ``subtraction``: accumulate only the smaller sibling into a COMPACT
+    one-slot buffer (``histogram.sibling_accumulate_slots`` at pair
+    granularity — the per-expansion psum payload halves) and reconstruct
+    the larger as ``parent - small`` from ``phist`` ((1, F, C, B), the
+    expanded leaf's RESIDENT reduced histogram; f64 on the gbdt
+    scoped-x64 path). Callers gate on the exactness policy
+    (``builder.resolve_hist_subtraction``). Returns ``(dec, pure, keep)``
+    where ``keep`` is the reduced pair histogram the children enter the
+    pool with (pre-f32-rounding on the gbdt f64 path; ``None`` when
+    subtraction is off — nothing needs to stay resident).
+    """
+    n_acc = 1 if subtraction else 2
+    if subtraction:
+        acc_nid = hist_ops.sibling_accumulate_slots(
+            nid, base_id, is_small, n_slots=2
+        )
+        acc_lo = jnp.int32(0)
+    else:
+        acc_nid, acc_lo = nid, base_id
+
+    def reconstruct(hs):
+        if not subtraction:
+            return hs
+        # Pair-specialized (gather-free) reconstruction — see
+        # histogram.sibling_reconstruct_pair for why not the general op.
+        return hist_ops.sibling_reconstruct_pair(hs, phist, is_small)
+
+    keep = None
+    if task == "classification":
+        h = hist_ops.class_histogram(
+            xb, y, acc_nid, acc_lo, n_slots=n_acc, n_bins=n_bins,
+            n_classes=n_classes, sample_weight=w,
+        )
+        h = reconstruct(lax.psum(h, psum_axis) if psum_axis is not None else h)
+        keep = h
+        dec = imp_ops.best_split_classification(
+            h, cand_mask, criterion=criterion, min_child_weight=mcw,
+            exact_ties=exact_ties,
+        )
+        pure = (dec.counts > 0).sum(axis=1) <= 1
+    elif task == "gbdt":
+        if gbdt_x64:
+            h = hist_ops.grad_hess_histogram(
+                xb, y, w, acc_nid, acc_lo, n_slots=n_acc, n_bins=n_bins,
+                acc_dtype=jnp.float64,
+            )
+            with jax.enable_x64(True):
+                h = lax.psum(h, psum_axis) if psum_axis is not None else h
+                h = reconstruct(h)
+                keep = h  # f64: children subtract pre-rounding
+                h = h.astype(jnp.float32)
+        else:
+            h = hist_ops.grad_hess_histogram(
+                xb, y, w, acc_nid, acc_lo, n_slots=n_acc, n_bins=n_bins,
+            )
+            h = reconstruct(lax.psum(h, psum_axis) if psum_axis is not None else h)
+            keep = h
+        dec = imp_ops.best_split_newton(
+            h, cand_mask, reg_lambda=lam, min_child_weight=mcw,
+            min_samples_leaf=msl,
+        )
+        pure = jnp.zeros(2, bool)
+    else:
+        h = hist_ops.moment_histogram(
+            xb, y, acc_nid, acc_lo, n_slots=n_acc, n_bins=n_bins,
+            sample_weight=w,
+        )
+        h = reconstruct(lax.psum(h, psum_axis) if psum_axis is not None else h)
+        keep = h
+        dec = imp_ops.best_split_regression(
+            h, cand_mask, min_child_weight=mcw,
+        )
+        ymin, ymax = regression_y_range(
+            y, nid, w, base_id, n_slots=2, axis=psum_axis
+        )
+        pure = ~(ymax > ymin)
+        dec = dec._replace(
+            y_range=jnp.where(ymax >= ymin, ymax - ymin, 0.0)
+        )
+    return dec, pure, (keep if subtraction else None)
+
+
+@lru_cache(maxsize=64)
+def make_expand_fn(mesh, *, n_bins: int, n_classes: int, task: str,
+                   criterion: str, exact_ties: bool = False,
+                   gbdt_x64: bool = False, subtraction: bool = False):
+    """Jitted one-expansion step for the host-stepped leaf-wise frontier.
+
+    ``(x_binned, y, node_id, weight, cand_mask, e_node, feat, bin,
+    left_id, small_left, mcw, lam, msl[, parent_hist])`` ->
+    ``(node_id', packed (2, 10 + C) decisions[, pair_hist])``: reroute
+    the rows of node ``e_node`` through its recorded split
+    ``(feat, bin)`` into children ``(left_id, left_id + 1)``, then run
+    :func:`pair_split_stats` on the new pair — one dispatch per
+    best-first expansion, the levelwise-engine counterpart of the fused
+    leaf-wise program. The ROOT bootstrap passes ``e_node == -2`` (a
+    sentinel no live or padding row carries, so the reroute is a no-op)
+    with ``left_id == 0``: slot 0 of the pair then IS the root.
+    ``small_left`` picks which child accumulates under subtraction;
+    ``parent_hist`` is the expanded leaf's resident (1, F, C, B) reduced
+    histogram (f64 on the gbdt scoped-x64 path). ``lam``/``msl`` are the
+    gbdt Newton scalars (dead operands otherwise — uniform signature
+    keeps one executable shape per task).
+    """
+
+    def local_expand(xb, y, nid, w, cand_mask, e_node, feat, bin_, left_id,
+                     small_left, mcw, lam, msl, *sub_ops):
+        R = nid.shape[0]
+        xf = jnp.take_along_axis(
+            xb, jnp.broadcast_to(jnp.maximum(feat, 0), (R,))[:, None],
+            axis=1,
+        )[:, 0]
+        child = jnp.where(xf <= bin_, left_id, left_id + 1)
+        nid = jnp.where(nid == e_node, child, nid)
+        is_small = jnp.stack([small_left, ~small_left])
+        dec, pure, keep = pair_split_stats(
+            xb, y, nid, w, cand_mask, left_id, is_small,
+            sub_ops[0] if subtraction else None, mcw, lam, msl,
+            task=task, criterion=criterion, n_bins=n_bins,
+            n_classes=n_classes, exact_ties=exact_ties, gbdt_x64=gbdt_x64,
+            subtraction=subtraction,
+        )
+        out = (nid, _pack_decision(dec))
+        if subtraction:
+            out = out + (keep,)
+        return out
+
+    in_specs = (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
+                P(DATA_AXIS), P(), P(), P(), P(), P(), P(), P(), P(), P())
+    if subtraction:
+        in_specs = in_specs + (P(),)
+    out_specs = (P(DATA_AXIS), P()) + ((P(),) if subtraction else ())
+    sharded = jax.shard_map(
+        local_expand,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+    )
+    # node_id donated: the expansion loop's canonical
+    # `nid_d = expand_fn(nid_d, ...)[0]` rebind consumes the old buffer
+    # each call (GL08 holds callers to that shape); the chaos wrapper
+    # raises BEFORE the jitted call, so a planned fault never
+    # half-donates.
+    return _chaos_dispatch(
+        "expand_dispatch", jax.jit(sharded, donate_argnums=(2,))
+    )
+
+
 @lru_cache(maxsize=64)
 def make_counts_fn(mesh, *, n_slots: int, n_classes: int, task: str):
     """Jitted (y, node_id, weight, chunk_lo) -> per-slot statistics only.

@@ -23,7 +23,16 @@ step per device attempt), ``split_dispatch``/``counts_dispatch``/
 ``update_dispatch`` (the levelwise collective programs,
 ``parallel/collective.py``), ``level`` (each level of the levelwise
 loop), ``round`` (each boosting round), ``grad_hess`` (the per-round
-gradient payload, via :func:`corrupt`).
+gradient payload, via :func:`corrupt`), ``serving_dispatch`` (the
+compiled-inference request path, ``serving/traversal.py``). The fused
+single-program engines (ISSUE 8) add: ``leafwise_build`` (immediately
+before the one-dispatch best-first build,
+``core/leafwise_builder.py``), ``expansion`` (each step of the
+host-stepped best-first loop), ``expand_dispatch`` (its per-expansion
+collective program), and ``fused_rounds`` (inside the retried closure
+of each K-round fused GBDT dispatch, ``boosting/fused_rounds.py`` —
+a blip here exercises the retry rung exactly like a transport loss at
+the dispatch boundary).
 
 Install programmatically (:func:`install` / :func:`active`) or via
 ``MPITREE_TPU_CHAOS="site:at:kind[:arg];..."`` (e.g.

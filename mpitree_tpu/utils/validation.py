@@ -307,3 +307,27 @@ def resolve_refine(max_depth, refine_depth, *, n_rows=None, quantized=True):
                 )
     refine = rd is not None and (max_depth is None or max_depth > rd)
     return rd, refine, (rd if refine else max_depth)
+
+
+def validate_max_leaf_nodes(est):
+    """Resolve an estimator's ``max_leaf_nodes`` into an int budget or None.
+
+    sklearn's grammar (None or an int > 1), plus this framework's routing
+    constraint: the best-first frontier lives in the device engines only
+    (``core/leafwise_builder.py``), so ``backend="host"`` cannot honor it
+    — refusing loudly beats silently growing a level-wise tree.
+    """
+    mln = getattr(est, "max_leaf_nodes", None)
+    if mln is None:
+        return None
+    mln = int(mln)
+    if mln < 2:
+        raise ValueError(
+            f"max_leaf_nodes {mln} must be either None or larger than 1"
+        )
+    if getattr(est, "backend", None) == "host":
+        raise ValueError(
+            "max_leaf_nodes requires a device engine (the numpy host tier "
+            "grows level-wise only); drop backend='host'"
+        )
+    return mln

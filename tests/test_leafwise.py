@@ -1,0 +1,665 @@
+"""Leaf-wise (best-first) growth + fused multi-round GBDT (ISSUE 8).
+
+Acceptance pins:
+
+- **Equivalence**: with ``max_leaf_nodes`` at the level-wise node budget
+  (``2^max_depth``) the best-first tree is bit-identical to the existing
+  device engines on CPU meshes — toggle (subtraction on/off) × engine
+  (fused one-program loop / host-stepped expansion loop) × mesh size,
+  the PR-5 pin style — and a numpy oracle checks the best-leaf
+  SELECTION ORDER (greedy highest-gain prefix of the full tree).
+- **Work reduction measured**: the always-on ``rows_scanned`` counter of
+  a leaf-budgeted build is strictly below the level-wise engine's on a
+  deep unbalanced workload (the ``leafwise_ab`` bench section captures
+  the ≥2x covtype-scale figure).
+- **Fused rounds**: ``rounds_per_dispatch=K`` ensembles are
+  bit-identical across mesh sizes (scoped-f64 (g, h) inside the scanned
+  loop), run ``ceil(max_iter/K)`` dispatches, keep ``staged_predict``
+  working, replay keyed subsampling deterministically, and compose with
+  ``checkpoint_every`` (kill-at-dispatch resume stays bit-identical).
+- **Chaos seams** (the fused engines' single-program builds):
+  ``leafwise_build`` / ``expand_dispatch`` blips recover on the retry
+  rung; a ``fused_rounds`` kill + checkpoint resumes bit-identically.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpitree_tpu import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    GradientBoostingClassifier,
+    GradientBoostingRegressor,
+    RandomForestClassifier,
+)
+from mpitree_tpu.boosting import fused_rounds
+from mpitree_tpu.core.builder import BuildConfig, build_tree
+from mpitree_tpu.core.leafwise_builder import bfs_new_ids
+from mpitree_tpu.obs import BuildObserver
+from mpitree_tpu.ops import impurity as imp_ops
+from mpitree_tpu.ops.binning import bin_dataset
+from mpitree_tpu.parallel import mesh as mesh_lib
+from mpitree_tpu.resilience import chaos
+from mpitree_tpu.resilience.chaos import ChaosKilled, Fault
+
+TREE_FIELDS = ("feature", "threshold", "left", "right", "value",
+               "n_node_samples")
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos(monkeypatch):
+    chaos.clear()
+    monkeypatch.delenv("MPITREE_TPU_CHAOS", raising=False)
+    monkeypatch.setenv("MPITREE_TPU_BACKOFF_S", "0")
+    yield
+    chaos.clear()
+
+
+def _cls_data(n=500, f=8, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = ((X[:, 0] + 0.5 * X[:, 1] > 0) ^ (X[:, 2] > 0.7)).astype(np.int64)
+    return X, y
+
+
+def _reg_data(n=500, f=8, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] * 2 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=n)).astype(
+        np.float64
+    )
+    return X, y
+
+
+def assert_trees_identical(t0, t1, what=""):
+    for fld in TREE_FIELDS:
+        a, b = np.asarray(getattr(t0, fld)), np.asarray(getattr(t1, fld))
+        np.testing.assert_array_equal(a, b, err_msg=f"{what}: {fld}")
+
+
+# ---------------------------------------------------------------------------
+# numpy oracles: selection order + BFS renumbering
+# ---------------------------------------------------------------------------
+
+def test_best_leaf_slot_matches_numpy_oracle():
+    """Device and host selection agree bit-for-bit, incl. the
+    lowest-node-id tie-break over equal gains and -inf closed slots."""
+    rng = np.random.default_rng(3)
+    for _ in range(50):
+        P = 16
+        gain = rng.choice(
+            [1.0, 2.0, 2.0, 5.5, -np.inf], size=P
+        ).astype(np.float32)
+        gain[rng.integers(0, P)] = 5.5  # guarantee a live max
+        node = rng.permutation(P).astype(np.int32)
+        dev = int(imp_ops.best_leaf_slot(jnp.asarray(gain),
+                                         jnp.asarray(node)))
+        host = imp_ops.best_leaf_slot_np(gain, node)
+        assert dev == host
+        # the winner is a max-gain slot with the smallest node id
+        top = gain.max()
+        assert gain[dev] == top
+        assert node[dev] == node[gain == top].min()
+
+
+def test_leaf_gain_formula_by_task():
+    n = np.float32(10.0)
+    imp, cost = np.float32(0.5), np.float32(0.2)
+    assert imp_ops.leaf_gain(n, imp, cost, task="classification") == (
+        pytest.approx(10 * 0.3, rel=1e-6)
+    )
+    assert imp_ops.leaf_gain(n, imp, cost, task="gbdt") == (
+        pytest.approx(0.3, rel=1e-6)
+    )
+
+
+def test_bfs_renumbering_roundtrip():
+    # expansion-ordered tree: root 0 -> (1, 2); expand 2 -> (3, 4);
+    # then 1 -> (5, 6). BFS order: 0, 1, 2, 5, 6, 3, 4.
+    left = np.array([1, 5, 3, -1, -1, -1, -1])
+    perm = bfs_new_ids(left)
+    np.testing.assert_array_equal(perm, [0, 1, 2, 5, 6, 3, 4])
+
+
+def test_expansion_order_is_greedy_gain_prefix():
+    """ORACLE: the budgeted tree's interior set equals the greedy
+    highest-gain prefix replayed over the FULL tree with numpy.
+
+    The full best-first tree (budget = node bound) realizes every
+    expansion the greedy loop could make; replaying the priority rule —
+    weighted impurity decrease, lowest-node-id tie-break — over its
+    structure predicts exactly which nodes a smaller budget keeps.
+    """
+    X, y = _cls_data(600, seed=9)
+    budget = 9
+    full = DecisionTreeClassifier(
+        max_depth=6, max_leaf_nodes=64, backend="cpu", n_devices=8
+    ).fit(X, y).tree_
+    small = DecisionTreeClassifier(
+        max_depth=6, max_leaf_nodes=budget, backend="cpu", n_devices=8
+    ).fit(X, y).tree_
+
+    left = np.asarray(full.left)
+    right = np.asarray(full.right)
+    nns = np.asarray(full.n_node_samples).astype(np.float64)
+    imp = np.asarray(full.impurity).astype(np.float64)
+    # realized weighted impurity decrease of expanding node i
+    gain = {
+        i: nns[i] * imp[i] - nns[left[i]] * imp[left[i]]
+        - nns[right[i]] * imp[right[i]]
+        for i in range(full.n_nodes) if left[i] >= 0
+    }
+    open_set, expanded, leaves = {0}, [], 1
+    while leaves < budget:
+        cand = [i for i in open_set if i in gain]
+        if not cand:
+            break
+        best = max(cand, key=lambda i: (gain[i], -i))
+        open_set.remove(best)
+        open_set.update((left[best], right[best]))
+        expanded.append(best)
+        leaves += 1
+    # the budgeted tree realizes exactly these expansions
+    sl = np.asarray(small.left)
+    assert int((sl >= 0).sum()) == len(expanded)
+    # compare by (feature, n_node_samples) signature of expanded nodes
+    sig = sorted(
+        (int(np.asarray(full.feature)[i]), int(nns[i])) for i in expanded
+    )
+    small_sig = sorted(
+        (int(f), int(n)) for f, n in zip(
+            np.asarray(small.feature)[sl >= 0],
+            np.asarray(small.n_node_samples)[sl >= 0],
+        )
+    )
+    assert sig == small_sig
+
+
+# ---------------------------------------------------------------------------
+# equivalence pins: budget at the node bound == level-wise engines
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("engine", ["fused", "levelwise"])
+@pytest.mark.parametrize("sub", ["on", "off"])
+def test_classifier_identity_toggle_engine(engine, sub, monkeypatch):
+    X, y = _cls_data()
+    base = DecisionTreeClassifier(
+        max_depth=4, refine_depth=None, backend="cpu", n_devices=8
+    ).fit(X, y)
+    monkeypatch.setenv("MPITREE_TPU_ENGINE",
+                       "levelwise" if engine == "levelwise" else "auto")
+    monkeypatch.setenv("MPITREE_TPU_HIST_SUBTRACTION", sub)
+    lw = DecisionTreeClassifier(
+        max_depth=4, max_leaf_nodes=16, backend="cpu", n_devices=8
+    ).fit(X, y)
+    assert_trees_identical(base.tree_, lw.tree_, f"{engine}/{sub}")
+
+
+@pytest.mark.parametrize("n_devices", [1, 8])
+def test_regressor_identity_mesh(n_devices):
+    Xr, yr = _reg_data()
+    base = DecisionTreeRegressor(
+        max_depth=4, refine_depth=None, backend="cpu", n_devices=8
+    ).fit(Xr, yr)
+    lw = DecisionTreeRegressor(
+        max_depth=4, max_leaf_nodes=16, backend="cpu", n_devices=n_devices
+    ).fit(Xr, yr)
+    assert_trees_identical(base.tree_, lw.tree_, f"mesh={n_devices}")
+
+
+def test_gbdt_tree_identity_at_node_budget():
+    X, y = _cls_data()
+    base = GradientBoostingClassifier(
+        max_iter=4, max_depth=3, n_devices=8, rounds_per_dispatch=1
+    ).fit(X, y)
+    lw = GradientBoostingClassifier(
+        max_iter=4, max_depth=3, max_leaf_nodes=8, n_devices=8,
+        rounds_per_dispatch=1,
+    ).fit(X, y)
+    np.testing.assert_array_equal(
+        base.predict_proba(X), lw.predict_proba(X)
+    )
+
+
+def test_stepped_engine_emits_expansion_rows(monkeypatch):
+    """The host-stepped engine records one obs row PER EXPANSION."""
+    monkeypatch.setenv("MPITREE_TPU_ENGINE", "levelwise")
+    monkeypatch.setenv("MPITREE_TPU_PROFILE", "1")
+    X, y = _cls_data()
+    m = DecisionTreeClassifier(
+        max_leaf_nodes=6, max_depth=8, backend="cpu", n_devices=8
+    ).fit(X, y)
+    rep = m.fit_report_
+    n_interior = int((np.asarray(m.tree_.left) >= 0).sum())
+    assert len(rep["levels"]) == n_interior
+    assert rep["counters"]["expansions"] == n_interior
+    assert rep["counters"]["leafwise_stepped_builds"] == 1
+
+
+# ---------------------------------------------------------------------------
+# budget semantics + validation
+# ---------------------------------------------------------------------------
+
+def test_budget_restricts_leaves_and_keeps_accuracy():
+    X, y = _cls_data(800)
+    m = DecisionTreeClassifier(
+        max_leaf_nodes=7, max_depth=10, backend="cpu", n_devices=8
+    ).fit(X, y)
+    leaves = int((np.asarray(m.tree_.left) < 0).sum())
+    assert 2 <= leaves <= 7
+    assert m.score(X, y) > 0.8
+    assert m.fit_report_["decisions"]["frontier"]["value"] == "leafwise"
+
+
+def test_gain_gates_stop_before_budget():
+    # pure-ish data: growth must stop when no leaf clears the gates,
+    # not burn the whole budget
+    X, y = _cls_data(200)
+    m = DecisionTreeClassifier(
+        max_leaf_nodes=200, min_impurity_decrease=0.2,
+        backend="cpu", n_devices=8,
+    ).fit(X, y)
+    leaves = int((np.asarray(m.tree_.left) < 0).sum())
+    assert leaves < 16
+
+
+def test_validation_errors():
+    X, y = _cls_data(100)
+    with pytest.raises(ValueError, match="larger than 1"):
+        DecisionTreeClassifier(max_leaf_nodes=1).fit(X, y)
+    with pytest.raises(ValueError, match="device engine"):
+        DecisionTreeClassifier(max_leaf_nodes=4, backend="host").fit(X, y)
+    with pytest.raises(ValueError, match="feature sampling"):
+        DecisionTreeClassifier(
+            max_leaf_nodes=4, max_features=2, backend="cpu"
+        ).fit(X, y)
+    with pytest.raises(ValueError, match="monotonic"):
+        DecisionTreeClassifier(
+            max_leaf_nodes=4, monotonic_cst=[1, 0, 0, 0, 0, 0, 0, 0],
+            backend="cpu",
+        ).fit(X, y)
+    # strict rounds_per_dispatch grammar: non-integers must not truncate
+    # (or stringify) through int()
+    for bad in ("fast", 2.7, True):
+        with pytest.raises(ValueError, match="rounds_per_dispatch"):
+            GradientBoostingClassifier(rounds_per_dispatch=bad).fit(X, y)
+
+
+def test_parallel_classifier_exposes_max_leaf_nodes():
+    """The mesh-parallel alias re-declares __init__ — the leaf budget
+    must ride through it like every other estimator param."""
+    from mpitree_tpu.tree import ParallelDecisionTreeClassifier
+
+    X, y = _cls_data(200)
+    m = ParallelDecisionTreeClassifier(
+        max_depth=8, max_leaf_nodes=7, backend="cpu"
+    ).fit(X, y)
+    assert int((np.asarray(m.tree_.left) < 0).sum()) <= 7
+
+
+def test_work_reduction_counters():
+    """Realized work: a leaf-budgeted build scans strictly fewer rows
+    into histograms than the level-wise engine at the same depth."""
+    X, y = _cls_data(2000, seed=4)
+    lvl = DecisionTreeClassifier(
+        max_depth=8, refine_depth=None, backend="cpu", n_devices=8
+    ).fit(X, y)
+    lw = DecisionTreeClassifier(
+        max_depth=8, max_leaf_nodes=15, backend="cpu", n_devices=8
+    ).fit(X, y)
+    scanned_lvl = lvl.fit_report_["counters"]["rows_scanned"]
+    scanned_lw = lw.fit_report_["counters"]["rows_scanned"]
+    assert scanned_lw < scanned_lvl
+    assert lw.fit_report_["counters"]["expansions"] == 14
+    # accuracy holds at a fraction of the scanned rows
+    assert lw.score(X, y) >= lvl.score(X, y) - 0.05
+
+
+# ---------------------------------------------------------------------------
+# levelwise multi-chunk subtraction carry (satellite)
+# ---------------------------------------------------------------------------
+
+def _chunked_build(sub, chunk, budget=4 << 30):
+    X, y = _cls_data(1500, seed=6)
+    binned = bin_dataset(np.ascontiguousarray(X, np.float32), max_bins=64)
+    obs = BuildObserver(timing=False)
+    cfg = BuildConfig(
+        task="classification", criterion="entropy", max_depth=7,
+        hist_subtraction=sub, max_frontier_chunk=chunk,
+        hist_budget_bytes=budget, frontier_tiers=(), engine="levelwise",
+    )
+    mesh = mesh_lib.resolve_mesh(n_devices=8)
+    return build_tree(
+        binned, y, config=cfg, mesh=mesh, n_classes=2, timer=obs
+    ), obs
+
+
+def test_multichunk_subtraction_carry_identity():
+    """Multi-chunk levels now ride the carry (one kept buffer per chunk)
+    and stay bit-identical to direct accumulation."""
+    t_off, _ = _chunked_build("off", 4096)
+    t_multi, _ = _chunked_build("on", 4)
+    assert_trees_identical(t_off, t_multi, "multi-chunk carry")
+
+
+def test_width1_chunks_fall_back_to_direct():
+    """A 1-slot chunk cannot hold a sibling PAIR: subtraction under
+    ``max_frontier_chunk=1`` degrades to direct accumulation (identical
+    tree) instead of crashing the carry's pair remap."""
+    t_off, _ = _chunked_build("off", 4096)
+    t_w1, _ = _chunked_build("on", 1)
+    assert_trees_identical(t_off, t_w1, "width-1 fallback")
+
+
+def test_multichunk_carry_budget_fallback():
+    """Over ``hist_budget_bytes`` the carry falls back to direct
+    accumulation with a typed event — and stays identical."""
+    t_off, _ = _chunked_build("off", 4096)
+    t_ob, obs = _chunked_build("on", 4, budget=1)
+    assert_trees_identical(t_off, t_ob, "over-budget fallback")
+    assert "sub_carry_over_budget" in [
+        e["kind"] for e in obs.record.events
+    ]
+
+
+def test_forest_subtraction_identity(monkeypatch):
+    """Satellite: the tree-parallel forest program now compiles the
+    subtraction frontier into the per-tree lax.map body."""
+    X, y = _cls_data(600, seed=8)
+    kw = dict(n_estimators=4, max_depth=4, random_state=0,
+              refine_depth=None, n_devices=8, backend="cpu")
+    monkeypatch.setenv("MPITREE_TPU_HIST_SUBTRACTION", "off")
+    f_off = RandomForestClassifier(**kw).fit(X, y)
+    monkeypatch.setenv("MPITREE_TPU_HIST_SUBTRACTION", "on")
+    f_on = RandomForestClassifier(**kw).fit(X, y)
+    np.testing.assert_array_equal(
+        f_off.predict_proba(X), f_on.predict_proba(X)
+    )
+    assert f_on.fit_report_["decisions"]["hist_subtraction"]["value"] == "on"
+
+
+# ---------------------------------------------------------------------------
+# fused multi-round GBDT
+# ---------------------------------------------------------------------------
+
+def test_resolve_rounds_per_dispatch_policy():
+    base = dict(loss_kind="logistic", loss_K=1, early_stopping=False,
+                colsample=1.0, max_depth=3, max_leaf_nodes=None)
+    k, reason = fused_rounds.resolve_rounds_per_dispatch(
+        "auto", platform="cpu", **base
+    )
+    assert k == 1 and "host-per-round" in reason
+    k, _ = fused_rounds.resolve_rounds_per_dispatch(
+        "auto", platform="tpu", **base
+    )
+    assert k == fused_rounds.DEFAULT_ROUNDS_PER_DISPATCH
+    k, _ = fused_rounds.resolve_rounds_per_dispatch(
+        4, platform="cpu", **base
+    )
+    assert k == 4  # explicit K forces any platform
+    # blockers: auto degrades with a reason, explicit K raises
+    for blocked in (
+        dict(base, loss_kind=None, loss_K=3),
+        dict(base, early_stopping=True),
+        dict(base, colsample=0.5),
+        dict(base, max_depth=None),
+    ):
+        k, reason = fused_rounds.resolve_rounds_per_dispatch(
+            "auto", platform="tpu", **blocked
+        )
+        assert k == 1
+        with pytest.raises(ValueError, match="cannot apply"):
+            fused_rounds.resolve_rounds_per_dispatch(
+                4, platform="tpu", **blocked
+            )
+    with pytest.raises(ValueError, match=">= 1"):
+        fused_rounds.resolve_rounds_per_dispatch(
+            0, platform="cpu", **base
+        )
+
+
+def test_resolve_rounds_per_dispatch_pool_budget_guard():
+    """A max_depth-only config implies a 2^max_depth leaf pool: past the
+    expansion ceiling (or the histogram HBM budget) auto must NOT engage
+    the fused program, and an explicit K raises with the evidence."""
+    deep = dict(loss_kind="logistic", loss_K=1, early_stopping=False,
+                colsample=1.0, max_depth=16, max_leaf_nodes=None,
+                n_samples=1_000_000, n_features=54, n_bins=256)
+    k, reason = fused_rounds.resolve_rounds_per_dispatch(
+        "auto", platform="tpu", **deep
+    )
+    assert k == 1 and "leaf pool" in reason
+    with pytest.raises(ValueError, match="leaf pool"):
+        fused_rounds.resolve_rounds_per_dispatch(4, platform="tpu", **deep)
+    # a bounded max_leaf_nodes keeps the same depth eligible
+    k, _ = fused_rounds.resolve_rounds_per_dispatch(
+        "auto", platform="tpu", **dict(deep, max_leaf_nodes=255)
+    )
+    assert k == fused_rounds.DEFAULT_ROUNDS_PER_DISPATCH
+    # a tight histogram budget blocks even a modest pool
+    k, reason = fused_rounds.resolve_rounds_per_dispatch(
+        "auto", platform="tpu",
+        **dict(deep, max_leaf_nodes=255, hist_budget_bytes=1 << 20)
+    )
+    assert k == 1 and "leaf pool" in reason
+
+
+def test_rounds_per_dispatch_env_steers_auto(monkeypatch):
+    monkeypatch.setenv("MPITREE_TPU_ROUNDS_PER_DISPATCH", "3")
+    base = dict(loss_kind="squared_error", loss_K=1, early_stopping=False,
+                colsample=1.0, max_depth=3, max_leaf_nodes=None)
+    k, reason = fused_rounds.resolve_rounds_per_dispatch(
+        "auto", platform="cpu", **base
+    )
+    assert k == 3 and "explicit" in reason
+    # the env var steers the DEFAULT only: on an ineligible fit it
+    # degrades to the host loop with a reason instead of raising (only
+    # the estimator param is allowed to crash a fit)
+    k, reason = fused_rounds.resolve_rounds_per_dispatch(
+        "auto", platform="cpu", **dict(base, early_stopping=True)
+    )
+    assert k == 1 and "overridden" in reason and "early_stopping" in reason
+    # an invalid env value falls back to auto with the evidence in the
+    # reason — an ambient setting must never crash (or silently force) a fit
+    for bad in ("fast", "0"):
+        monkeypatch.setenv("MPITREE_TPU_ROUNDS_PER_DISPATCH", bad)
+        k, reason = fused_rounds.resolve_rounds_per_dispatch(
+            "auto", platform="cpu", **base
+        )
+        assert k == 1 and "invalid" in reason and bad in reason
+
+
+GBF_KW = dict(max_iter=9, max_depth=3, learning_rate=0.3, random_state=0,
+              n_devices=8)
+
+
+@pytest.mark.parametrize("n_devices", [1, 2, 8])
+def test_fused_rounds_mesh_invariant(n_devices):
+    """ACCEPTANCE: fused-round ensembles are bit-identical across mesh
+    sizes on CPU (scoped-f64 (g, h) preserved inside the scanned loop)."""
+    Xr, yr = _reg_data()
+    kw = dict(GBF_KW, n_devices=n_devices, rounds_per_dispatch=4)
+    ref = GradientBoostingRegressor(
+        **dict(GBF_KW, rounds_per_dispatch=4)
+    ).fit(Xr, yr)
+    other = GradientBoostingRegressor(**kw).fit(Xr, yr)
+    np.testing.assert_array_equal(ref.predict(Xr), other.predict(Xr))
+
+
+def test_fused_rounds_dispatch_count_and_staged_predict():
+    Xr, yr = _reg_data()
+    m = GradientBoostingRegressor(
+        **dict(GBF_KW, rounds_per_dispatch=4)
+    ).fit(Xr, yr)
+    counters = m.fit_report_["counters"]
+    assert counters["fused_round_dispatches"] == 3  # ceil(9 / 4)
+    assert counters["rounds_fused"] == 9
+    assert m.fit_report_["decisions"]["rounds_per_dispatch"]["value"] == 4
+    stages = list(m.staged_predict(Xr))
+    assert len(stages) == 9
+    np.testing.assert_allclose(stages[-1], m.predict(Xr), rtol=1e-6)
+    # staged losses improve overall (margins reconstructed per stage)
+    mse = [float(np.mean((s - yr) ** 2)) for s in stages]
+    assert mse[-1] < mse[0]
+    # digest surfaces the dispatch width (SCHEMA v3)
+    from mpitree_tpu.obs import digest
+
+    assert digest(m.fit_report_)["rounds_per_dispatch"] == 4
+
+
+def test_fused_rounds_close_to_host_loop():
+    """K>1 carries f32 margins in-program (documented divergence from the
+    host loop's f64): predictions agree to f32 resolution, not bitwise."""
+    Xr, yr = _reg_data()
+    fused = GradientBoostingRegressor(
+        **dict(GBF_KW, rounds_per_dispatch=4)
+    ).fit(Xr, yr)
+    host = GradientBoostingRegressor(
+        **dict(GBF_KW, rounds_per_dispatch=1)
+    ).fit(Xr, yr)
+    np.testing.assert_allclose(
+        fused.predict(Xr), host.predict(Xr), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_fused_rounds_classifier_subsample_deterministic():
+    X, y = _cls_data()
+    kw = dict(max_iter=6, max_depth=3, subsample=0.75, random_state=7,
+              rounds_per_dispatch=3)
+    a = GradientBoostingClassifier(**kw, n_devices=8).fit(X, y)
+    b = GradientBoostingClassifier(**kw, n_devices=8).fit(X, y)
+    c = GradientBoostingClassifier(**kw, n_devices=2).fit(X, y)
+    np.testing.assert_array_equal(a.predict_proba(X), b.predict_proba(X))
+    np.testing.assert_array_equal(a.predict_proba(X), c.predict_proba(X))
+    assert a.score(X, y) > 0.85
+
+
+def test_fused_rounds_with_leafwise_budget():
+    X, y = _cls_data()
+    m = GradientBoostingClassifier(
+        max_iter=6, max_depth=None, max_leaf_nodes=8, random_state=0,
+        rounds_per_dispatch=3, n_devices=8,
+    ).fit(X, y)
+    assert m.score(X, y) > 0.85
+    assert m.fit_report_["counters"]["fused_round_dispatches"] == 2
+    for t in m.trees_:
+        assert int((np.asarray(t.left) < 0).sum()) <= 8
+
+
+def test_fused_rounds_explicit_k_rejects_blockers():
+    X, y = _cls_data(200)
+    with pytest.raises(ValueError, match="cannot apply"):
+        GradientBoostingClassifier(
+            max_iter=4, max_depth=3, rounds_per_dispatch=4,
+            early_stopping=True,
+        ).fit(X, y)
+
+
+def test_fused_rounds_one_cache_key_per_k_bucket():
+    """≤1 new compile cache-key per (K, shape) bucket: a second identical
+    fit lowers nothing new."""
+    Xr, yr = _reg_data()
+    kw = dict(GBF_KW, rounds_per_dispatch=4)
+    GradientBoostingRegressor(**kw).fit(Xr, yr)
+    m2 = GradientBoostingRegressor(**kw).fit(Xr, yr)
+    comp = m2.fit_report_["compile"]["fused_rounds_fn"]
+    assert comp["new"] == 0
+
+
+# ---------------------------------------------------------------------------
+# chaos seams + checkpoint-resume (satellites)
+# ---------------------------------------------------------------------------
+
+def test_leafwise_build_blip_recovers_on_retry_rung():
+    X, y = _cls_data()
+    healthy = DecisionTreeClassifier(
+        max_leaf_nodes=8, max_depth=6, backend="cpu", n_devices=8
+    ).fit(X, y)
+    chaos.install([Fault("leafwise_build", 1, "unavailable")])
+    with pytest.warns(UserWarning, match="retrying on the device tier"):
+        m = DecisionTreeClassifier(
+            max_leaf_nodes=8, max_depth=6, backend="cpu", n_devices=8
+        ).fit(X, y)
+    chaos.clear()
+    assert_trees_identical(healthy.tree_, m.tree_, "leafwise blip")
+    assert m.fit_report_["counters"]["device_retries"] == 1
+
+
+def test_expand_dispatch_blip_recovers(monkeypatch):
+    monkeypatch.setenv("MPITREE_TPU_ENGINE", "levelwise")
+    X, y = _cls_data()
+    healthy = DecisionTreeClassifier(
+        max_leaf_nodes=6, max_depth=6, backend="cpu", n_devices=8
+    ).fit(X, y)
+    chaos.install([Fault("expand_dispatch", 3, "unavailable")])
+    with pytest.warns(UserWarning, match="retrying on the device tier"):
+        m = DecisionTreeClassifier(
+            max_leaf_nodes=6, max_depth=6, backend="cpu", n_devices=8
+        ).fit(X, y)
+    chaos.clear()
+    assert_trees_identical(healthy.tree_, m.tree_, "expand blip")
+    assert m.fit_report_["counters"]["device_retries"] == 1
+
+
+def test_fused_rounds_blip_recovers():
+    Xr, yr = _reg_data()
+    kw = dict(GBF_KW, rounds_per_dispatch=4)
+    healthy = GradientBoostingRegressor(**kw).fit(Xr, yr)
+    chaos.install([Fault("fused_rounds", 2, "unavailable")])
+    with pytest.warns(UserWarning, match="retrying"):
+        m = GradientBoostingRegressor(**kw).fit(Xr, yr)
+    chaos.clear()
+    np.testing.assert_array_equal(healthy.predict(Xr), m.predict(Xr))
+    assert m.fit_report_["counters"]["device_retries"] == 1
+
+
+def test_fused_rounds_nonfinite_grad_fails_fast():
+    """Chaos-poisoned margin mirror at dispatch 2: the fused twin of the
+    host loop's non-finite guard fails fast with the same typed event
+    instead of silently scanning garbage rounds."""
+    Xr, yr = _reg_data()
+    est = GradientBoostingRegressor(**dict(GBF_KW, rounds_per_dispatch=4))
+    chaos.install([Fault("grad_hess", 2, "nan")])
+    # dispatch 2 covers rounds 4..7; the poison lands in its first round
+    with pytest.raises(FloatingPointError, match="round 4") as ei:
+        est.fit(Xr, yr)
+    chaos.clear()
+    assert "learning_rate" in str(ei.value)  # actionable, not just fatal
+    # the typed event survives the abort for postmortem
+    assert "nonfinite_grad" in [
+        ev["kind"] for ev in est.fit_report_["events"]
+    ]
+
+
+@pytest.mark.parametrize("kill_dispatch", [2, 3])
+def test_fused_rounds_kill_resume_bit_identical(tmp_path, kill_dispatch):
+    """ACCEPTANCE: rounds_per_dispatch=K composes with checkpoint_every=N
+    — kill at dispatch k, resume, bit-identical ensemble (the keyed
+    subsample masks + runtime round offset replay exactly)."""
+    X, y = _cls_data()
+    kw = dict(max_iter=12, max_depth=3, subsample=0.8, random_state=3,
+              rounds_per_dispatch=3, checkpoint_every=3, n_devices=8)
+    path = str(tmp_path / "fused.ckpt")
+    ref = GradientBoostingClassifier(**kw).fit(X, y)
+
+    chaos.install([Fault("fused_rounds", kill_dispatch, "kill")])
+    with pytest.raises(ChaosKilled):
+        GradientBoostingClassifier(checkpoint=path, **kw).fit(X, y)
+    chaos.clear()
+    assert os.path.exists(path), "flushed dispatches must survive"
+
+    resumed = GradientBoostingClassifier(checkpoint=path, **kw).fit(X, y)
+    assert not os.path.exists(path)
+    np.testing.assert_array_equal(
+        resumed.predict_proba(X), ref.predict_proba(X)
+    )
+    for a, b in zip(resumed.staged_predict_proba(X),
+                    ref.staged_predict_proba(X)):
+        np.testing.assert_array_equal(a, b)
+    kinds = [e["kind"] for e in resumed.fit_report_["events"]]
+    assert "checkpoint_resume" in kinds

@@ -52,9 +52,10 @@ def test_build_record_schema_golden():
     """Field names are pinned: renaming/removing one is a versioned act."""
     rep = BuildObserver(timing=False).report()
     assert tuple(sorted(rep)) == tuple(sorted(TOP_LEVEL_FIELDS))
-    # v2: level rows gained rows_scanned/small_child_fraction and the
-    # digest gained sub_frac (ISSUE 5 sibling subtraction)
-    assert rep["schema"] == SCHEMA_VERSION == 2
+    # v3 (ISSUE 8): top-level level_stream (rows past the cap spill to
+    # JSONL) and digest expansions/rounds_per_dispatch (leaf-wise growth
+    # + fused multi-round GBDT accounting)
+    assert rep["schema"] == SCHEMA_VERSION == 3
     # dataclass fields and the pinned tuple must agree too
     assert tuple(
         f.name for f in dataclasses.fields(BuildRecord)
@@ -63,7 +64,8 @@ def test_build_record_schema_golden():
     # lines and the watcher format stored digests)
     assert tuple(sorted(digest(rep))) == tuple(sorted((
         "engine", "reason", "n_nodes", "depth", "levels", "compile_new",
-        "psum_bytes", "sub_frac", "events", "wall_s",
+        "psum_bytes", "sub_frac", "expansions", "rounds_per_dispatch",
+        "events", "wall_s",
     )))
 
 
@@ -110,6 +112,72 @@ def test_level_rows_gated_and_capped():
         on.level(level=i, frontier=1)
     assert len(on.record.levels) == on.MAX_LEVEL_ROWS
     assert on.record.counters["levels_dropped"] == 5  # honest cap
+
+
+def test_level_rows_stream_past_cap(tmp_path):
+    """ISSUE 8: with a sink, rows past the cap stream instead of drop —
+    leaf-wise builds emit one row per expansion and need the tail."""
+    obs = BuildObserver(timing=True)
+    spill = tmp_path / "levels.jsonl"
+    obs.stream_levels_to(spill)
+    total = obs.MAX_LEVEL_ROWS + 7
+    for i in range(total):
+        obs.level(level=i, frontier=1, rows_scanned=np.int64(i))
+    rep = obs.report()
+    assert len(rep["levels"]) == obs.MAX_LEVEL_ROWS
+    assert "levels_dropped" not in rep["counters"]
+    assert rep["level_stream"] == {"path": str(spill), "rows": 7}
+    rows = [json.loads(line) for line in spill.read_text().splitlines()]
+    assert [r["level"] for r in rows] == list(range(obs.MAX_LEVEL_ROWS, total))
+    assert all(isinstance(r["rows_scanned"], int) for r in rows)  # jsonable
+
+
+def test_level_rows_stream_env_dir(tmp_path, monkeypatch):
+    """MPITREE_TPU_OBS_STREAM_DIR configures the sink ambiently (estimators
+    build their observer internally)."""
+    monkeypatch.setenv("MPITREE_TPU_OBS_STREAM_DIR", str(tmp_path))
+    obs = BuildObserver(timing=True)
+    for i in range(obs.MAX_LEVEL_ROWS + 2):
+        obs.level(level=i)
+    rep = obs.report()
+    assert rep["level_stream"]["rows"] == 2
+    assert rep["level_stream"]["path"].startswith(str(tmp_path))
+
+
+def test_level_rows_stream_unwritable_dir_degrades(tmp_path, monkeypatch):
+    """An unwritable ambient sink must never abort a fit: rows past the
+    cap drop with a typed event instead of raising out of the build."""
+    # a FILE as the dir's parent raises even for root (chmod-based
+    # read-only dirs don't)
+    blocker = tmp_path / "blocker"
+    blocker.write_text("")
+    monkeypatch.setenv("MPITREE_TPU_OBS_STREAM_DIR", str(blocker / "sub"))
+    obs = BuildObserver(timing=True)
+    for i in range(obs.MAX_LEVEL_ROWS + 3):
+        obs.level(level=i)  # must not raise
+    rep = obs.report()
+    assert rep["counters"]["levels_dropped"] == 3
+    assert rep["level_stream"] == {}
+    assert any(
+        e["kind"] == "level_stream_failed" for e in rep["events"]
+    )
+
+
+def test_level_stream_fd_closed_on_report(tmp_path):
+    """report() closes the spill fd (no leak per fit in long-lived
+    processes); a post-report spill reopens the same file in append."""
+    obs = BuildObserver(timing=True)
+    spill = tmp_path / "levels.jsonl"
+    obs.stream_levels_to(spill)
+    for i in range(obs.MAX_LEVEL_ROWS + 2):
+        obs.level(level=i)
+    obs.report()
+    assert obs._level_stream_file is None
+    obs.level(level=99999)  # reopens in append mode
+    rep = obs.report()
+    assert rep["level_stream"]["rows"] == 3
+    rows = [json.loads(line) for line in spill.read_text().splitlines()]
+    assert rows[-1]["level"] == 99999
 
 
 def test_events_capped_honestly():

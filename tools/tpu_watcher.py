@@ -66,6 +66,12 @@ BUDGET = {
     # The ~500-tree GBDT fit (72 levelwise softmax rounds at a 40k-row
     # cap) dominates; the serving latency sweep itself is seconds.
     "serving": 1800,
+    # Two full-depth device fits (cold+warm each) + a sklearn exact-split
+    # reference fit on the full training split.
+    "leafwise_ab": 1800,
+    # 2x16 shallow binary-logistic rounds; the host-loop side pays 16
+    # levelwise dispatch rounds on the tunnel.
+    "gbdt_fusedK": 1200,
 }
 
 
@@ -255,8 +261,9 @@ def main() -> int:
     # boosting (the new workload) — then the rest.
     p.add_argument("--sections",
                    default="hist_tput,north_star,engine_fused,boosting,"
-                           "serving,device_bin,north_star_fused,"
-                           "engine_levelwise,forest,refine_sweep")
+                           "leafwise_ab,gbdt_fusedK,serving,device_bin,"
+                           "north_star_fused,engine_levelwise,forest,"
+                           "refine_sweep")
     p.add_argument("--redo", default="",
                    help="comma-separated sections to re-measure even if "
                         "already captured (appended after the missing "
