@@ -5,7 +5,8 @@
 
 PY ?= python
 
-.PHONY: test test-cpu lint lint-graft lint-baseline bench bench-tpu report clean
+.PHONY: test test-cpu lint lint-graft lint-baseline bench bench-tpu report \
+  trace-smoke clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -50,6 +51,13 @@ bench-tpu:
 # the artifact-side view of every estimator's fit_report_.
 report:
 	$(PY) bench_tpu.py --report
+
+# Observability v2 gate (ISSUE 9): tiny fit+serve -> one Chrome-trace
+# JSON -> golden trace-event schema validation (exit non-zero on a
+# schema break or a missing span family). CPU-safe, seconds.
+trace-smoke:
+	$(PY) examples/obs_trace_run.py --smoke \
+	  --out /tmp/mpitree_trace_smoke.json
 
 clean:
 	find . -type d \( -name "__pycache__" -o -name ".pytest_cache" \

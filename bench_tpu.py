@@ -159,7 +159,7 @@ def _device_platform() -> str:
 RECORD_DIGEST_KEYS = (
     "engine", "reason", "n_nodes", "depth", "levels", "compile_new",
     "psum_bytes", "sub_frac", "expansions", "rounds_per_dispatch",
-    "events", "wall_s",
+    "events", "wire_bytes", "wire_shard_bytes", "wall_s",
 )
 
 
@@ -182,6 +182,10 @@ def format_record_digest(d: dict) -> str:
         f"compile_new={d.get('compile_new')} psum={mb:.1f}MB "
         f"events={d.get('events')} wall={d.get('wall_s')}s"
     )
+    if d.get("wire_bytes"):
+        # Nonzero only on a real multi-shard axis: actual ICI fabric
+        # traffic (ring-allreduce estimate), vs psum's logical payload.
+        line += f" wire={(d['wire_bytes'] or 0) / 1e6:.1f}MB"
     if d.get("sub_frac") is not None:
         line += f" sub_frac={d['sub_frac']}"
     if d.get("expansions") is not None:

@@ -1,0 +1,135 @@
+"""One Perfetto-loadable timeline covering fit AND serve (ISSUE 9).
+
+Runs the telemetry layer end to end into a single Chrome-trace JSON:
+
+1. a **level-wise** device build (live per-level split/counts/update
+   spans + the synthesized per-level replay track),
+2. a **fused-engine** build (one ``lax.while_loop`` dispatch — its
+   per-level spans are synthesized post-hoc from ``obs/accounting``'s
+   exact realized-work rows, laid inside the live ``fused_build`` span),
+3. a **gradient-boosting** fit (per-round replay spans + compile
+   attribution for every entry point that lowered),
+4. a **serving dispatch** through a :class:`CompiledModel` with one
+   chaos-injected transient blip, so the **resilience retry rung** lands
+   as a ``device_retry`` instant on the serving events track,
+
+then validates the file against the golden trace-event schema
+(``mpitree_tpu.obs.trace.validate_trace``) and prints the serving
+latency quantiles from the log-bucketed metrics histograms.
+
+Run:   python examples/obs_trace_run.py [--out PATH] [--smoke]
+Load:  https://ui.perfetto.dev  (or chrome://tracing) -> open the JSON.
+
+``--smoke`` shrinks the workload to seconds — ``make trace-smoke`` runs
+exactly that as the CI-side tiny-fit -> trace -> schema-validation gate.
+Exit status is non-zero if validation fails or any required span family
+is missing, so the Makefile target IS the acceptance check.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="/tmp/mpitree_fit_serve.trace.json")
+    p.add_argument("--smoke", action="store_true",
+                   help="tiny workload (the make trace-smoke gate)")
+    args = p.parse_args()
+
+    # Keep the injected-blip retry fast; never disable the ladder itself.
+    os.environ.setdefault("MPITREE_TPU_BACKOFF_S", "0.01")
+
+    import numpy as np
+
+    from mpitree_tpu import (
+        DecisionTreeClassifier,
+        GradientBoostingClassifier,
+    )
+    from mpitree_tpu.obs.trace import TraceSink, validate_trace
+    from mpitree_tpu.resilience import chaos
+    from mpitree_tpu.serving.model import compile_model
+
+    n = 600 if args.smoke else 4000
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(n, 8)).astype(np.float32)
+    y = ((X[:, 0] + 0.5 * X[:, 1] > 0) + (X[:, 2] > 0.8)).astype(np.int64)
+
+    sink = TraceSink(args.out)
+
+    # 1) level-wise build: live per-level split/counts/update spans.
+    os.environ["MPITREE_TPU_ENGINE"] = "levelwise"
+    DecisionTreeClassifier(max_depth=4, backend="cpu").fit(
+        X, y, trace_to=sink
+    )
+
+    # 2) fused engine: ONE compiled dispatch; its level spans are
+    # synthesized post-hoc from the realized-work replay rows.
+    os.environ["MPITREE_TPU_ENGINE"] = "fused"
+    DecisionTreeClassifier(max_depth=4, backend="cpu").fit(
+        X, y, trace_to=sink
+    )
+    del os.environ["MPITREE_TPU_ENGINE"]
+
+    # 3) boosting rounds (per-round replay spans + compile attribution).
+    gb = GradientBoostingClassifier(
+        max_iter=2 if args.smoke else 5, max_depth=3, random_state=0
+    ).fit(X, y, trace_to=sink)
+
+    # 4) serving: warm dispatch, then one with an injected transient blip
+    # — the retry rung recovers and its device_retry instant hits the
+    # timeline with a real timestamp.
+    model = compile_model(gb)
+    model.trace_to(sink)
+    model.predict(X[:64])
+    with chaos.active(
+        chaos.Fault("serving_dispatch", at=1, kind="unavailable")
+    ):
+        model.predict(X[:64])
+    report = model.serve_report_
+
+    path = sink.write()
+    with open(path) as f:
+        trace = json.load(f)
+    problems = validate_trace(trace)
+    names = {e["name"] for e in trace["traceEvents"]}
+    required = {
+        "level-wise build span": "split" in names,
+        "fused-engine replay span": any(
+            n_.startswith("level ") for n_ in names
+        ) and "fused_build" in names,
+        "boosting round span": any(n_.startswith("round ") for n_ in names),
+        "resilience retry rung": "device_retry" in names,
+        "serving dispatch span": "serving_dispatch" in names,
+        "compile attribution span": any(
+            n_.startswith("compile:") for n_ in names
+        ),
+    }
+
+    print(f"trace: {path} ({len(trace['traceEvents'])} events)")
+    for what, ok in required.items():
+        print(f"  [{'ok' if ok else 'MISSING'}] {what}")
+    if problems:
+        print(f"  schema problems: {problems[:5]}")
+    lat = report["latency"]
+    for bucket, row in lat["buckets"].items():
+        print(
+            f"serving bucket {bucket}: p50 {row['p50_ms']}ms "
+            f"p99 {row['p99_ms']}ms over {row['count']} requests"
+        )
+    print(
+        "retries recovered on the device tier:",
+        report["counters"].get("device_retries", 0),
+    )
+    print("load it in https://ui.perfetto.dev")
+    return 0 if not problems and all(required.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -98,6 +98,47 @@ class MicroBatcher:
         return await fut
 
 
+async def start_metrics_exporter(registry, host="127.0.0.1", port=0):
+    """Minimal asyncio Prometheus scrape endpoint (ISSUE 9 metrics half).
+
+    Serves ``ModelRegistry.metrics_text()`` — per-model request counters
+    and log-bucketed latency histograms with ``model=<slot>`` labels — as
+    a plain-text HTTP response on every connection. Zero dependencies;
+    ``port=0`` picks a free port (returned via ``server.sockets``). A
+    production front-end would point its Prometheus scrape job here.
+    """
+
+    async def handle(reader, writer):
+        try:
+            # Drain the request head through the blank line: closing a
+            # socket with unread received bytes can RST and discard the
+            # queued response before the scraper reads it.
+            while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+                pass
+            body = registry.metrics_text().encode()
+            writer.write(
+                b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: text/plain; version=0.0.4\r\n"
+                b"Content-Length: " + str(len(body)).encode() + b"\r\n"
+                b"Connection: close\r\n\r\n" + body
+            )
+            await writer.drain()
+        finally:
+            writer.close()
+
+    return await asyncio.start_server(handle, host, port)
+
+
+async def scrape_once(host: str, port: int) -> str:
+    """One GET against the exporter (the demo's self-scrape)."""
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    return raw.decode().split("\r\n\r\n", 1)[1]
+
+
 async def main():
     from mpitree_tpu.obs import REGISTRY
     from mpitree_tpu.serving import ModelRegistry
@@ -105,9 +146,12 @@ async def main():
     X, gen1, gen2 = fit_models()
     registry = ModelRegistry(buckets=(1, MAX_BATCH, 4096))
     print("publishing generation 1 (compiles + bucket warmup)...")
-    registry.publish("clicks", gen1)
+    model1 = registry.publish("clicks", gen1)
     batcher = MicroBatcher(registry, "clicks")
     server = asyncio.ensure_future(batcher.serve_forever())
+    exporter = await start_metrics_exporter(registry)
+    ex_port = exporter.sockets[0].getsockname()[1]
+    print(f"metrics exporter on 127.0.0.1:{ex_port}/metrics")
 
     latencies: list[float] = []
 
@@ -151,6 +195,29 @@ async def main():
         f"(max {max(batcher.batch_sizes)})"
     )
     print("registry:", registry.models())
+
+    # Scrape the exporter once: the Prometheus view of the same traffic —
+    # request counters plus per-bucket log-histogram latency series.
+    text = await scrape_once("127.0.0.1", ex_port)
+    served = [
+        ln for ln in text.splitlines()
+        if ln.startswith(("mpitree_serving_requests_total",
+                          "mpitree_serving_request_seconds_count",
+                          "mpitree_registry_publish_total"))
+    ]
+    print("scraped metrics:")
+    for ln in served:
+        print("  " + ln)
+    # Per-generation latency quantiles (log-bucketed histograms; warmup
+    # compiles are excluded by design): gen1 carried the pre-swap bulk.
+    for gen, m in (("gen1", model1), ("gen2", registry.get("clicks"))):
+        for bucket, row in m.latency_summary()["buckets"].items():
+            print(
+                f"{gen} bucket {bucket}: p50 {row['p50_ms']}ms "
+                f"p99 {row['p99_ms']}ms ({row['count']} requests)"
+            )
+    exporter.close()
+    await exporter.wait_closed()
 
 
 if __name__ == "__main__":

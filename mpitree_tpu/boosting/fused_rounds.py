@@ -425,7 +425,7 @@ def run_fused_rounds(*, binned, y_tr, sw_tr, raw_tr, trees, train_scores,
             subsample_on=subsample_on,
         )
         fn = _make_rounds_fn(mesh, **fn_kw)
-        obs.compile_note(
+        rounds_fresh = obs.compile_note(
             "fused_rounds_fn", (mesh,) + tuple(sorted(fn_kw.items())),
             cache_size=16,
         )
@@ -450,10 +450,11 @@ def run_fused_rounds(*, binned, y_tr, sw_tr, raw_tr, trees, train_scores,
                       msg, lr32, np.int32(r), np.uint32(seed), sub_thresh)
 
         with obs.span("fused_rounds"):
-            out = retry_device(
-                dispatch, what=f"gbdt fused rounds {r}..{r + k - 1}",
-                obs=obs,
-            )
+            with obs.compile_attribution("fused_rounds_fn", rounds_fresh):
+                out = retry_device(
+                    dispatch, what=f"gbdt fused rounds {r}..{r + k - 1}",
+                    obs=obs,
+                )
             raw32 = np.ascontiguousarray(fetch_row_nodes(out[0], N))
             (feat_s, bin_s, counts_s, n_s, left_s, parent_s, nn_s, G_s,
              H_s, ls_s, lw_s) = jax.device_get(out[1:])
@@ -531,7 +532,8 @@ def run_fused_rounds(*, binned, y_tr, sw_tr, raw_tr, trees, train_scores,
                 "raw_tr": raw_tr,
                 "train_scores": np.asarray(train_scores, np.float64),
             }
-            ck.append(trees[len(ck.trees):], state)
+            with obs.span("checkpoint_flush"):
+                ck.append(trees[len(ck.trees):], state)
         r = new_r
     raw_tr[:, 0] = raw32
     return r

@@ -231,7 +231,7 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
                     f"'auto', got {rpd!r}"
                 )
 
-    def _fit(self, X, y, sample_weight, *, task):
+    def _fit(self, X, y, sample_weight, *, task, trace_to=None):
         self._validate_params_()
         names = feature_names_of(X)
         X, y_t, classes = validate_fit_data(X, y, task=task)
@@ -283,6 +283,10 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
         # Structured run record (mpitree_tpu.obs): per-round rows always
         # on (losses are already computed); phases/levels profile-gated.
         obs = BuildObserver()
+        if trace_to is not None:
+            # Chrome-trace timeline (obs/trace.py): a path, or a shared
+            # TraceSink when one file should cover several fits + serving.
+            obs.trace_to(trace_to)
         with obs.span("bin"):
             binned = bin_dataset(
                 X_tr, max_bins=self.max_bins, binning=self.binning
@@ -569,7 +573,8 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
                     state["val_scores"] = np.asarray(val_scores, np.float64)
                     state["best_val"] = np.float64(best_val)
                     state["stale"] = np.int64(stale)
-                ck.append(trees[len(ck.trees):], state)
+                with obs.span("checkpoint_flush"):
+                    ck.append(trees[len(ck.trees):], state)
             if stopped_early:
                 break
         if ck is not None:
@@ -681,8 +686,10 @@ class GradientBoostingRegressor(RegressorMixin, _BaseGradientBoosting):
             checkpoint_every=checkpoint_every,
         )
 
-    def fit(self, X, y, sample_weight=None):
-        return self._fit(X, y, sample_weight, task="regression")
+    def fit(self, X, y, sample_weight=None, *, trace_to=None):
+        return self._fit(
+            X, y, sample_weight, task="regression", trace_to=trace_to
+        )
 
     def predict(self, X):
         return self._raw_predict(X)[:, 0]
@@ -730,8 +737,10 @@ class GradientBoostingClassifier(ClassifierMixin, _BaseGradientBoosting):
             checkpoint_every=checkpoint_every,
         )
 
-    def fit(self, X, y, sample_weight=None):
-        return self._fit(X, y, sample_weight, task="classification")
+    def fit(self, X, y, sample_weight=None, *, trace_to=None):
+        return self._fit(
+            X, y, sample_weight, task="classification", trace_to=trace_to
+        )
 
     def decision_function(self, X):
         raw = self._raw_predict(X)

@@ -973,11 +973,11 @@ def build_tree(
         return args
 
     update_fn = collective.make_update_fn(mesh, n_slots=U)
-    timer.compile_note("update_fn", (mesh, U))
+    update_fresh = timer.compile_note("update_fn", (mesh, U))
     counts_fn = collective.make_counts_fn(
         mesh, n_slots=U, n_classes=C, task=task
     )
-    timer.compile_note("counts_fn", (mesh, U, C, task))
+    counts_fresh = timer.compile_note("counts_fn", (mesh, U, C, task))
 
     frontier_lo, frontier_size, depth = 0, 1, 0
     # Sibling-subtraction carry: the previous level's globally-reduced
@@ -1072,11 +1072,15 @@ def build_tree(
         # device transports.
         if terminal:
             with timer.phase("counts"):
-                futures = [
-                    (min(U, frontier_lo + frontier_size - lo),
-                     counts_fn(y_d, nid_d, w_d, np.int32(lo)))
-                    for lo in range(frontier_lo, frontier_lo + frontier_size, U)
-                ]
+                with timer.compile_attribution("counts_fn", counts_fresh):
+                    futures = [
+                        (min(U, frontier_lo + frontier_size - lo),
+                         counts_fn(y_d, nid_d, w_d, np.int32(lo)))
+                        for lo in range(
+                            frontier_lo, frontier_lo + frontier_size, U
+                        )
+                    ]
+                counts_fresh = False
                 counts_all = np.concatenate(
                     [jax.device_get(h)[:take] for take, h in futures]
                 )
@@ -1124,15 +1128,17 @@ def build_tree(
                 if sub_now:
                     ismall_lvl = sub_parent["is_small"]
                 n_extra = int(keep_now) + int(debug)
-                futures = [
-                    (take,
-                     split_fn(xb_d, y_d, nid_d, w_d, cand_mask_d,
-                              *split_args(lo, take, S_lvl),
-                              *(_sub_ops_for_chunk(
-                                  sub_parent, lo - frontier_lo, take, S_lvl,
-                              ) if sub_now else ())))
-                    for lo, take in chunks
-                ]
+                with timer.compile_attribution("split_fn", bool(new_fn)):
+                    futures = [
+                        (take,
+                         split_fn(xb_d, y_d, nid_d, w_d, cand_mask_d,
+                                  *split_args(lo, take, S_lvl),
+                                  *(_sub_ops_for_chunk(
+                                      sub_parent, lo - frontier_lo, take,
+                                      S_lvl,
+                                  ) if sub_now else ())))
+                        for lo, take in chunks
+                    ]
                 if keep_now:  # outputs: (packed[, hist][, repl_err])
                     kept_hist = [r[1] for _take, r in futures]
                 if debug:  # repl_err is always the last output
@@ -1294,10 +1300,12 @@ def build_tree(
                     bin_t[:take] = np.where(is_split_full[sl], dec["bin"][sl], 0)
                     left_t[:take] = lr[sl]
                     right_t[:take] = rr[sl]
-                    nid_d = update_fn(
-                        nid_d, xb_d, np.int32(lo),
-                        is_split, feat_t, bin_t, left_t, right_t,
-                    )
+                    with timer.compile_attribution("update_fn", update_fresh):
+                        nid_d = update_fn(
+                            nid_d, xb_d, np.int32(lo),
+                            is_split, feat_t, bin_t, left_t, right_t,
+                        )
+                    update_fresh = False
 
         # Realized-savings accounting (always-on counters + level-row
         # fields): rows_scanned is the weight actually accumulated into

@@ -977,7 +977,7 @@ def build_tree_fused(
         subtraction=use_sub,
     )
     fn = _make_fused_fn(mesh, **fn_kw)
-    timer.compile_note(
+    fused_fresh = timer.compile_note(
         "fused_fn", (mesh,) + tuple(sorted(fn_kw.items())), cache_size=32
     )
 
@@ -986,10 +986,11 @@ def build_tree_fused(
             mesh, binned, y, sample_weight
         )
     with timer.phase("fused_build"):
-        out = fn(xb_d, y_d, nid_d, w_d, cand_d,
-                 np.float32(cfg.min_child_weight),
-                 np.float32(cfg.min_decrease_scaled),
-                 root_key, cst_op)
+        with timer.compile_attribution("fused_fn", fused_fresh):
+            out = fn(xb_d, y_d, nid_d, w_d, cand_d,
+                     np.float32(cfg.min_child_weight),
+                     np.float32(cfg.min_decrease_scaled),
+                     root_key, cst_op)
         feat, bins, counts, nvec, left, parent, nid_out, n_nodes = out
         # Tree outputs are replicated (addressable from any process); the
         # row-sharded nid_out is only fetched when the refit needs it —
@@ -1215,7 +1216,7 @@ def build_forest_fused(
         subtraction=use_sub,
     )
     fn = _make_forest_fn(tmesh, **fn_kw)
-    timer.compile_note(
+    forest_fresh = timer.compile_note(
         "forest_fn", (tmesh,) + tuple(sorted(fn_kw.items())), cache_size=32
     )
 
@@ -1275,10 +1276,10 @@ def build_forest_fused(
         cst_d = jax.device_put(cst_op, NamedSharding(tmesh, P()))
 
     with timer.phase("forest_build"):
+        with timer.compile_attribution("forest_fn", forest_fresh):
+            out = fn(xb_d, y_d, nid_d, ws_d, cm_d, mcw_d, mid_d, rk_d, cst_d)
         feat, bins, counts, nvec, left, parent, nid_out, n_nodes = (
-            jax.device_get(
-                fn(xb_d, y_d, nid_d, ws_d, cm_d, mcw_d, mid_d, rk_d, cst_d)
-            )
+            jax.device_get(out)
         )
 
     trees = []

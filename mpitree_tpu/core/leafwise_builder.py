@@ -568,7 +568,7 @@ def build_tree_leafwise(
             exact_ties=exact_ties, gbdt_x64=gbdt_x64, subtraction=use_sub,
         )
         fn = _make_leafwise_fn(mesh, **fn_kw)
-        timer.compile_note(
+        lw_fresh = timer.compile_note(
             "leafwise_fn", (mesh,) + tuple(sorted(fn_kw.items())),
             cache_size=32,
         )
@@ -578,7 +578,10 @@ def build_tree_leafwise(
             )
         with timer.phase("leafwise_build"):
             chaos.step("leafwise_build")
-            out = fn(xb_d, y_d, nid_d, w_d, cand_d, mcw, mid, lam, msl, msg)
+            with timer.compile_attribution("leafwise_fn", lw_fresh):
+                out = fn(
+                    xb_d, y_d, nid_d, w_d, cand_d, mcw, mid, lam, msl, msg
+                )
             feat, bins, counts, nvec, left, parent, _depth, nid_out, nn = out
             feat, bins, counts, nvec, left, parent, nn = jax.device_get(
                 (feat, bins, counts, nvec, left, parent, nn)
@@ -655,7 +658,7 @@ def _build_leafwise_stepped(binned, y, *, cfg, mesh, n_classes, task, pool,
         exact_ties=exact_ties, gbdt_x64=gbdt_x64, subtraction=use_sub,
     )
     expand = collective.make_expand_fn(mesh, **expand_kw)
-    timer.compile_note(
+    expand_fresh = timer.compile_note(
         "expand_fn", (mesh,) + tuple(sorted(expand_kw.items()))
     )
     with timer.phase("shard"):
@@ -700,7 +703,8 @@ def _build_leafwise_stepped(binned, y, *, cfg, mesh, n_classes, task, pool,
 
     # Root bootstrap: sentinel -2 reroutes nothing (live rows are >= 0,
     # padding is -1), left_id 0 puts the whole dataset in pair slot 0.
-    res = dispatch(-2, 0, 0, 0, True, zeros_ph if use_sub else None)
+    with timer.compile_attribution("expand_fn", expand_fresh):
+        res = dispatch(-2, 0, 0, 0, True, zeros_ph if use_sub else None)
     nid_d = res[0]
     dec = collective.unpack_decision(np.asarray(jax.device_get(res[1])))
     n0, _, gain0 = _stop_and_gain_np(dec, 0, task=task, cfg=cfg)

@@ -183,7 +183,7 @@ class DecisionTreeClassifier(ClassifierMixin, ReportMixin, BaseEstimator):
         self.monotonic_cst = monotonic_cst
 
     # -- fitting -----------------------------------------------------------
-    def fit(self, X, y, sample_weight=None):
+    def fit(self, X, y, sample_weight=None, *, trace_to=None):
         names = feature_names_of(X)
         X, y_enc, classes = validate_fit_data(X, y, task="classification")
         self.n_features_ = X.shape[1]
@@ -203,6 +203,10 @@ class DecisionTreeClassifier(ClassifierMixin, ReportMixin, BaseEstimator):
         mln = validate_max_leaf_nodes(self)
 
         timer = obs = BuildObserver()
+        if trace_to is not None:
+            # Chrome-trace timeline (obs/trace.py): a path, or a shared
+            # TraceSink covering several fits + serving in one file.
+            obs.trace_to(trace_to)
         host = (
             prefer_host_path(*X.shape, self.n_devices, self.backend)
             and mln is None  # best-first growth lives in the device engines

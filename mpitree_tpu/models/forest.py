@@ -181,7 +181,7 @@ class _BaseForest(ReportMixin, BaseEstimator):
         return prev
 
     def _fit_forest(self, X, y_enc, *, task, criterion, n_classes=None,
-                    refit_targets=None, sample_weight=None):
+                    refit_targets=None, sample_weight=None, trace_to=None):
         n = X.shape[0]
         if self.oob_score and not self.bootstrap:
             raise ValueError("oob_score=True requires bootstrap=True")
@@ -189,6 +189,10 @@ class _BaseForest(ReportMixin, BaseEstimator):
         # observer accumulates phases/counters/collectives across every
         # member build; fit() finalizes it into fit_report_ (post-OOB).
         obs = self._fit_obs = BuildObserver()
+        if trace_to is not None:
+            # Chrome-trace timeline (obs/trace.py): a path, or a shared
+            # TraceSink covering several fits + serving in one file.
+            obs.trace_to(trace_to)
         prev_trees = self._warm_start_trees()
         sample_weight = validate_sample_weight(sample_weight, n)
         rng = np.random.default_rng(self.random_state)
@@ -626,7 +630,7 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
         self.criterion = criterion
         self.class_weight = class_weight
 
-    def fit(self, X, y, sample_weight=None):
+    def fit(self, X, y, sample_weight=None, *, trace_to=None):
         names = feature_names_of(X)
         X, y_enc, classes = validate_fit_data(X, y, task="classification")
         self.n_features_ = X.shape[1]
@@ -642,6 +646,7 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
         self.trees_ = _TreeList(self._fit_forest(
             X, y_enc, task="classification", criterion=self.criterion,
             n_classes=len(classes), sample_weight=sample_weight,
+            trace_to=trace_to,
         ))
         self._mono_p0 = None  # predict_proba's clipped-probability cache
         if self.oob_score:
@@ -752,7 +757,7 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
             warm_start=warm_start,
         )
 
-    def fit(self, X, y, sample_weight=None):
+    def fit(self, X, y, sample_weight=None, *, trace_to=None):
         names = feature_names_of(X)
         X, y64, _ = validate_fit_data(X, y, task="regression")
         self.n_features_ = X.shape[1]
@@ -762,6 +767,7 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
         self.trees_ = _TreeList(self._fit_forest(
             X, (y64 - self._y_mean).astype(np.float32), task="regression",
             criterion="mse", refit_targets=y64, sample_weight=sample_weight,
+            trace_to=trace_to,
         ))
         if self.oob_score:
             pred = np.zeros(len(X))

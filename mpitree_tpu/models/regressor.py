@@ -88,7 +88,7 @@ class DecisionTreeRegressor(RegressorMixin, ReportMixin, BaseEstimator):
         self.min_impurity_decrease = min_impurity_decrease
         self.monotonic_cst = monotonic_cst
 
-    def fit(self, X, y, sample_weight=None):
+    def fit(self, X, y, sample_weight=None, *, trace_to=None):
         if self.criterion not in ("squared_error", "mse"):
             raise ValueError(f"unknown regression criterion: {self.criterion!r}")
         names = feature_names_of(X)
@@ -109,6 +109,10 @@ class DecisionTreeRegressor(RegressorMixin, ReportMixin, BaseEstimator):
         mln = validate_max_leaf_nodes(self)
 
         timer = obs = BuildObserver()
+        if trace_to is not None:
+            # Chrome-trace timeline (obs/trace.py): a path, or a shared
+            # TraceSink covering several fits + serving in one file.
+            obs.trace_to(trace_to)
         host = (
             prefer_host_path(*X.shape, self.n_devices, self.backend)
             and mln is None  # best-first growth lives in the device engines

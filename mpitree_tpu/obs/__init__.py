@@ -11,7 +11,13 @@ accounting, typed events).
 
 Gating: counters, decisions, events, and compile/collective accounting
 are always on (O(1) host work from static shapes); wall-clock spans and
-per-level rows require ``MPITREE_TPU_PROFILE=1``.
+per-level rows require ``MPITREE_TPU_PROFILE=1``. Observability v2
+(ISSUE 9) layers on top: ``obs.trace`` renders spans/events/replay rows
+as Perfetto-loadable Chrome-trace timelines (``fit(trace_to=...)`` /
+``MPITREE_TPU_TRACE_DIR``), ``obs.metrics`` carries the serving
+latency/throughput registry (log-bucketed histograms, Prometheus text
+exposition), fresh compile cache-keys attribute cold-dispatch wall per
+entry point, and ``record.wire`` is the ICI wire-traffic ledger.
 """
 
 from mpitree_tpu.obs.observer import (
@@ -23,25 +29,40 @@ from mpitree_tpu.obs.observer import (
     note_refine,
     warn_event,
 )
+from mpitree_tpu.obs.metrics import MetricsRegistry, metrics_text
 from mpitree_tpu.obs.record import (
     SCHEMA_VERSION,
     TOP_LEVEL_FIELDS,
     BuildRecord,
     ReportMixin,
     digest,
+    wire_estimate,
+)
+from mpitree_tpu.obs.trace import (
+    TRACE_DIR_ENV,
+    TraceSink,
+    merge_trace_files,
+    validate_trace,
 )
 
 __all__ = [
     "SCHEMA_VERSION",
     "TOP_LEVEL_FIELDS",
+    "TRACE_DIR_ENV",
     "BuildRecord",
     "BuildObserver",
     "CompileRegistry",
+    "MetricsRegistry",
     "REGISTRY",
     "ReportMixin",
+    "TraceSink",
     "digest",
+    "merge_trace_files",
     "mesh_info",
+    "metrics_text",
     "note_build_path",
     "note_refine",
+    "validate_trace",
     "warn_event",
+    "wire_estimate",
 ]

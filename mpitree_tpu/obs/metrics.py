@@ -1,0 +1,331 @@
+"""Lock-safe serving metrics: counters, gauges, log-bucketed histograms.
+
+The request-path telemetry registry (ISSUE 9 tentpole, metrics half):
+``mpitree_tpu.serving`` threads one :class:`MetricsRegistry` per
+:class:`~mpitree_tpu.serving.model.CompiledModel` — request/row counters,
+per-bucket latency histograms, stream-stage queue depth — and
+``ModelRegistry.metrics_text()`` aggregates every published slot into one
+Prometheus text exposition for a scrape endpoint (the asyncio exporter in
+``examples/serving_run.py``).
+
+Design constraints, in order:
+
+- **No sample storage.** A serving process observes millions of
+  latencies; :class:`Histogram` keeps O(log range) integer bucket counts
+  (geometric buckets, ratio ``2**0.25`` ≈ 1.19), so p50/p95/p99 come out
+  with bounded ~9% relative error (geometric-midpoint estimate; the
+  oracle test pins it against ``numpy.percentile``) at constant memory.
+- **Lock-safe under the registry's concurrent-dispatch contract.** One
+  registry lock covers metric creation AND every update — serving
+  dispatches run from many threads (``ModelRegistry`` publishes into a
+  live asyncio/executor mix) and a dropped increment would silently
+  under-report traffic. Updates are O(1) dict ops; the lock is
+  uncontended microseconds against millisecond dispatches.
+- **Prometheus text exposition** (:func:`MetricsRegistry.metrics_text`):
+  counters render as ``name{labels} value``, histograms as cumulative
+  ``name_bucket{le="..."}`` series plus ``_sum``/``_count`` — scrapeable
+  by anything that speaks the exposition format, with zero dependencies.
+
+Stdlib-only on purpose (no jax, no numpy): metrics observation sits ON
+the request path, and the zero-new-compile-keys / zero-device_put pins
+in ``tests/test_obs_trace.py`` hold precisely because nothing here can
+touch the device.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+# Geometric bucket ratio: 2**(1/4) per bucket = 4 buckets per octave.
+# Quantile estimates use the geometric midpoint of the winning bucket, so
+# the worst-case relative error is sqrt(ratio) - 1 ≈ 9% — tight enough to
+# tell a 1 ms p99 from a 10 ms one, at ~150 buckets across ns..hours.
+_BUCKET_RATIO = 2.0 ** 0.25
+_LOG_RATIO = math.log(_BUCKET_RATIO)
+
+
+class Counter:
+    """Monotonic counter. ``inc`` only; see ``set_total`` for mirrors."""
+
+    def __init__(self, lock):
+        self._lock = lock
+        self._value = 0.0
+
+    def inc(self, v=1) -> None:
+        if v < 0:
+            raise ValueError(f"counters only go up; got inc({v!r})")
+        with self._lock:
+            self._value += v
+
+    def set_total(self, v) -> None:
+        """Sync from an upstream monotonic source (the obs record's
+        retry/fallback counters, owned by the resilience ladder) — takes
+        the max so the mirror can never run a counter backwards."""
+        with self._lock:
+            self._value = max(self._value, float(v))
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Point-in-time value (queue depth, inflight batches)."""
+
+    def __init__(self, lock):
+        self._lock = lock
+        self._value = 0.0
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, v=1) -> None:
+        with self._lock:
+            self._value += v
+
+    def dec(self, v=1) -> None:
+        with self._lock:
+            self._value -= v
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Log-bucketed distribution: quantiles without sample storage.
+
+    Bucket ``i`` covers ``(ratio**(i-1), ratio**i]``; non-positive
+    observations land in a dedicated zero bucket (quantile 0.0). The
+    estimator returns the geometric midpoint of the bucket the target
+    rank falls in, clamped to the observed [min, max] — so tiny
+    populations degrade gracefully to exact extremes.
+    """
+
+    def __init__(self, lock):
+        self._lock = lock
+        self._buckets: dict = {}  # index -> count; None key = zero bucket
+        self.count = 0
+        self.sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def observe(self, v) -> None:
+        v = float(v)
+        idx = None if v <= 0.0 else math.ceil(
+            math.log(v) / _LOG_RATIO - 1e-9
+        )
+        with self._lock:
+            self._buckets[idx] = self._buckets.get(idx, 0) + 1
+            self.count += 1
+            self.sum += v
+            self._min = min(self._min, v)
+            self._max = max(self._max, v)
+
+    def quantile(self, q: float) -> float | None:
+        """Estimated q-quantile (q in [0, 1]); None with no observations."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q!r}")
+        with self._lock:
+            if self.count == 0:
+                return None
+            if q == 0.0:
+                return self._min
+            if q == 1.0:
+                return self._max
+            target = q * self.count
+            cum = 0.0
+            # None (zero bucket) sorts first: it holds the smallest values
+            for idx in sorted(
+                self._buckets, key=lambda i: -math.inf if i is None else i
+            ):
+                cum += self._buckets[idx]
+                if cum >= target:
+                    if idx is None:
+                        return max(0.0, self._min)
+                    mid = _BUCKET_RATIO ** (idx - 0.5)
+                    return min(max(mid, self._min), self._max)
+            return self._max
+
+    def snapshot(self) -> dict:
+        """(upper_bound -> cumulative count) plus sum/count, for text
+        exposition and ``serve_report_``."""
+        with self._lock:
+            cum = 0
+            bounds = {}
+            for idx in sorted(
+                self._buckets, key=lambda i: -math.inf if i is None else i
+            ):
+                cum += self._buckets[idx]
+                bound = 0.0 if idx is None else _BUCKET_RATIO ** idx
+                bounds[bound] = cum
+            return {"buckets": bounds, "count": self.count, "sum": self.sum}
+
+
+def _esc(v) -> str:
+    """Prometheus label-value escaping: backslash, quote, newline —
+    slot names are caller-controlled, and one raw ``\"`` would make the
+    whole scrape endpoint unparseable."""
+    return (
+        str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+    )
+
+
+def _label_str(labels: dict, extra=None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    body = ",".join(
+        f'{k}="{_esc(v)}"' for k, v in sorted(merged.items())
+    )
+    return "{" + body + "}"
+
+
+class MetricsRegistry:
+    """Named metric families with label sets; one lock for everything."""
+
+    _TYPES = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        # name -> (cls, {label_tuple: metric})
+        self._families: dict = {}
+
+    def _get(self, cls, name: str, labels: dict):
+        key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = self._families[name] = (cls, {})
+            if fam[0] is not cls:
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{self._TYPES[fam[0]]}, not {self._TYPES[cls]}"
+                )
+            metric = fam[1].get(key)
+            if metric is None:
+                metric = fam[1][key] = cls(self._lock)
+            return metric
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        return self._get(Histogram, name, labels)
+
+    def render_families(self, extra_labels: dict | None = None) -> dict:
+        """{family name: (prometheus type, [sample lines])}, sorted by
+        name. The composable half of the exposition: merging several
+        registries into ONE scrape (``ModelRegistry.metrics_text``) must
+        group samples under a single ``# TYPE`` line per family — the
+        Prometheus text parser rejects duplicate TYPE lines, so naive
+        per-registry concatenation would fail the whole scrape."""
+        with self._lock:
+            families = {
+                name: (cls, dict(children))
+                for name, (cls, children) in self._families.items()
+            }
+        out: dict = {}
+        for name in sorted(families):
+            cls, children = families[name]
+            lines: list = []
+            for key in sorted(children):
+                metric = children[key]
+                labels = dict(key)
+                if cls is Histogram:
+                    snap = metric.snapshot()
+                    c = 0
+                    for bound, c in snap["buckets"].items():
+                        le = _label_str(
+                            labels, {**(extra_labels or {}),
+                                     "le": f"{bound:.9g}"}
+                        )
+                        lines.append(f"{name}_bucket{le} {c}")
+                    inf = _label_str(
+                        labels, {**(extra_labels or {}), "le": "+Inf"}
+                    )
+                    lines.append(f"{name}_bucket{inf} {snap['count']}")
+                    ls = _label_str(labels, extra_labels)
+                    lines.append(f"{name}_sum{ls} {snap['sum']:.9g}")
+                    lines.append(f"{name}_count{ls} {snap['count']}")
+                else:
+                    ls = _label_str(labels, extra_labels)
+                    v = metric.value
+                    val = f"{int(v)}" if float(v).is_integer() else f"{v:.9g}"
+                    lines.append(f"{name}{ls} {val}")
+            out[name] = (self._TYPES[cls], lines)
+        return out
+
+    def metrics_text(self, extra_labels: dict | None = None) -> str:
+        """Prometheus text exposition of every family.
+
+        ``extra_labels`` merge into each sample's label set — how
+        ``ModelRegistry.metrics_text`` stamps per-slot ``model=...``
+        labels onto each published model's private registry.
+        """
+        return render_text([self.render_families(extra_labels)])
+
+    def snapshot(self) -> dict:
+        """Plain-dict view:
+        {name: {label_str: value-or-histogram-snapshot}}."""
+        with self._lock:
+            families = {
+                name: (cls, dict(children))
+                for name, (cls, children) in self._families.items()
+            }
+        out: dict = {}
+        for name, (cls, children) in families.items():
+            fam: dict = {}
+            for key, metric in children.items():
+                label = _label_str(dict(key)) or ""
+                fam[label] = (
+                    metric.snapshot() if cls is Histogram else metric.value
+                )
+            out[name] = fam
+        return out
+
+
+def render_text(family_maps: list) -> str:
+    """Merge ``render_families`` maps into one exposition: one ``# TYPE``
+    line per family name, all contributors' samples grouped under it.
+    Conflicting types for the same name raise — two registries must not
+    silently publish a counter and a gauge under one family."""
+    merged: dict = {}
+    for fams in family_maps:
+        for name, (tname, lines) in fams.items():
+            prev = merged.get(name)
+            if prev is None:
+                merged[name] = (tname, list(lines))
+            else:
+                if prev[0] != tname:
+                    raise TypeError(
+                        f"metric {name!r} exposed as both {prev[0]} "
+                        f"and {tname} across merged registries"
+                    )
+                prev[1].extend(lines)
+    out: list = []
+    for name in sorted(merged):
+        tname, lines = merged[name]
+        out.append(f"# TYPE {name} {tname}")
+        out.extend(lines)
+    return "\n".join(out) + ("\n" if out else "")
+
+
+# The process-default registry (module-level convenience for exporters
+# that want one scrape surface); serving models keep their own private
+# registries so per-model latency never mixes across slots.
+DEFAULT = MetricsRegistry()
+
+
+def metrics_text() -> str:
+    """Text exposition of the process-default registry."""
+    return DEFAULT.metrics_text()

@@ -33,18 +33,27 @@ leaking runtime values).
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import json
 import os
+import threading
+import time
 import warnings
 from collections import OrderedDict
 
-from mpitree_tpu.obs.record import BuildRecord, _jsonable
+from mpitree_tpu.obs import trace as trace_mod
+from mpitree_tpu.obs.record import BuildRecord, _jsonable, wire_estimate
 from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
 
 # Per-process spill-file sequence: distinguishes observers sharing a PID
 # without relying on id(self) (heap addresses recycle).
 _STREAM_SEQ = itertools.count()
+
+# Same idea for trace files and synthesized-track ownership keys: a
+# recycled heap address must never let a new fit's replay spans replace a
+# live observer's in a shared sink.
+_TRACE_SEQ = itertools.count()
 
 # Lowering events per entry point beyond which we warn: the collective
 # factories' lru_caches hold 64 entries and the fused builder's 32 — past
@@ -67,6 +76,12 @@ class CompileRegistry:
     def __init__(self):
         self._lru: dict = {}  # entry -> OrderedDict of live cache keys
         self._lowerings: dict = {}  # entry -> lowering events
+        self._seconds: dict = {}  # entry -> attributed cold-dispatch wall
+        # attribute() is called from concurrently-publishing serving
+        # threads (the registry's concurrent-dispatch contract, same
+        # reason traversal serializes note() under its _NOTE_LOCK); an
+        # unlocked read-modify-write would drop addends.
+        self._seconds_lock = threading.Lock()
         self._warned: set = set()
 
     def note(self, entry: str, key, cache_size: int = 64) -> bool:
@@ -96,6 +111,22 @@ class CompileRegistry:
 
     def count(self, entry: str) -> int:
         return self._lowerings.get(entry, 0)
+
+    def attribute(self, entry: str, seconds: float) -> None:
+        """Attribute cold-dispatch wall-clock to ``entry`` (the ROADMAP
+        per-entry-point cold-compile follow-up): the wall of the FIRST
+        dispatch after a fresh cache-key registration, which is compile
+        plus one execution — an honest upper bound on the tunnel-compile
+        cost this entry point charged the process."""
+        with self._seconds_lock:
+            self._seconds[entry] = (
+                self._seconds.get(entry, 0.0) + float(seconds)
+            )
+
+    def seconds(self, entry: str) -> float:
+        """Total cold-dispatch wall attributed to ``entry`` process-wide."""
+        with self._seconds_lock:
+            return self._seconds.get(entry, 0.0)
 
 
 REGISTRY = CompileRegistry()
@@ -204,6 +235,55 @@ class BuildObserver(PhaseTimer):
         self._level_stream_path: str | None = None
         self._level_stream_file = None
         self._level_stream_failed = False
+        # Trace channel (obs/trace.py): spans/events/collectives feed a
+        # Chrome-trace sink when one is configured; one `is None` check
+        # otherwise (inside the disabled-path <5% budget).
+        self._trace: trace_mod.TraceSink | None = None
+        self._trace_owned = False
+        self._trace_failed = False
+        self._trace_seq = next(_TRACE_SEQ)
+        self._trace_track = f"fit{self._trace_seq}"
+        self._trace_window: list | None = None
+        self._trace_windows: dict = {}  # phase name -> [t0, t1]
+        tdir = os.environ.get(trace_mod.TRACE_DIR_ENV)
+        if tdir:
+            self.trace_to(os.path.join(
+                tdir, f"trace_{os.getpid()}_{self._trace_seq}.json"
+            ))
+
+    def trace_to(self, sink, *, track: str | None = None) -> None:
+        """Emit this observer's timeline into ``sink`` (a path, or a
+        :class:`~mpitree_tpu.obs.trace.TraceSink` shared across fits —
+        what ``fit(trace_to=...)`` plumbs here).
+
+        Tracing implies timing: spans need wall-clock, so ``enabled``
+        flips on regardless of ``MPITREE_TPU_PROFILE``. A path sink is
+        makedirs'd and probed UP FRONT; an unwritable one degrades to a
+        typed ``trace_failed`` event with tracing off (the checkpoint/
+        level-stream sink contract — telemetry never aborts a fit).
+        """
+        if track is not None:
+            self._trace_track = str(track)
+        if isinstance(sink, trace_mod.TraceSink):
+            self._trace, self._trace_owned = sink, False
+        else:
+            path = str(sink)
+            try:
+                parent = os.path.dirname(os.path.abspath(path))
+                os.makedirs(parent, exist_ok=True)
+                with open(path, "a"):
+                    pass
+            except OSError as e:
+                self._trace_failed = True
+                self.event(
+                    "trace_failed",
+                    f"trace sink unwritable ({e}); tracing disabled for "
+                    "this fit",
+                    path=path,
+                )
+                return
+            self._trace, self._trace_owned = trace_mod.TraceSink(path), True
+        self.enabled = True
 
     def stream_levels_to(self, path) -> None:
         """Spill per-level/per-expansion rows past ``MAX_LEVEL_ROWS`` to
@@ -259,8 +339,66 @@ class BuildObserver(PhaseTimer):
         return self._level_stream_file
 
     # ``span`` is the obs-native name; ``phase`` stays for PhaseTimer
-    # compatibility (both are the same context manager).
-    span = PhaseTimer.phase
+    # compatibility (both are the same context manager). Overrides the
+    # base timer to ALSO emit a Chrome-trace complete event per span
+    # instance when a sink is configured — the timer aggregates seconds
+    # per phase NAME, the trace keeps every instance on the timeline.
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        tr = self._trace
+        if not self.enabled and tr is None:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            if self.enabled:
+                self.seconds[name] += dt
+                self.calls[name] += 1
+            if tr is not None:
+                tr.complete(self._trace_track, name, t0, dt)
+                w = self._trace_window
+                if w is None:
+                    self._trace_window = [t0, t0 + dt]
+                else:
+                    w[0] = min(w[0], t0)
+                    w[1] = max(w[1], t0 + dt)
+                pw = self._trace_windows.get(name)
+                if pw is None:
+                    self._trace_windows[name] = [t0, t0 + dt]
+                else:
+                    pw[0] = min(pw[0], t0)
+                    pw[1] = max(pw[1], t0 + dt)
+
+    span = phase
+
+    @contextlib.contextmanager
+    def compile_attribution(self, entry: str, fresh: bool = True):
+        """Time the dispatch following a FRESH ``compile_note`` and
+        attribute its wall to ``entry`` — in the process registry
+        (``REGISTRY.seconds``), in ``fit_report_['compile'][entry]
+        ['seconds']``, and as a ``compile:{entry}`` trace span. A warm
+        key (``fresh=False``) passes through untouched: only cold
+        lowerings carry compile cost."""
+        if not fresh:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            REGISTRY.attribute(entry, dt)
+            rec = self.record.compile.setdefault(
+                entry, {"lowerings": 0, "new": 0}
+            )
+            rec["seconds"] = round(rec.get("seconds", 0.0) + dt, 6)
+            if self._trace is not None:
+                self._trace.complete(
+                    "compile", f"compile:{entry}", t0, dt, cat="compile"
+                )
 
     # -- always-on channels ------------------------------------------------
     def counter(self, name: str, inc=1) -> None:
@@ -268,6 +406,14 @@ class BuildObserver(PhaseTimer):
         c[name] = c.get(name, 0) + inc
 
     def event(self, kind: str, message: str, **data) -> None:
+        if self._trace is not None:
+            # Typed events are the resilience ladder's rung reports
+            # (device_retry/device_failover), checkpoint notes, fallback
+            # decisions — instants on the timeline, real timestamps.
+            self._trace.instant(
+                f"{self._trace_track}:events", kind, cat="event",
+                args={"message": message, **data},
+            )
         ev = self.record.events
         if len(ev) >= self.MAX_EVENTS:
             self.counter("events_dropped")
@@ -295,6 +441,15 @@ class BuildObserver(PhaseTimer):
         )
         entry["calls"] += int(calls)
         entry["bytes"] += int(nbytes)
+        if self._trace is not None:
+            # Live ICI counter track: cumulative logical payload per site
+            # at the moment the engine accounted it (the levelwise loops
+            # account live; the fused engines' post-hoc totals land via
+            # the synthesized replay counters instead).
+            self._trace.counter(
+                "ici", f"ici:{site}", time.perf_counter(),
+                {"bytes": entry["bytes"]},
+            )
 
     def compile_note(self, entry: str, key, cache_size: int = 64) -> bool:
         new = REGISTRY.note(entry, key, cache_size=cache_size)
@@ -360,4 +515,40 @@ class BuildObserver(PhaseTimer):
                     "n_nodes": sum(t["n_nodes"] for t in rec.trees),
                     "depth": max(t["depth"] for t in rec.trees),
                 }
-        return rec.to_dict()
+        # The collective ledger (v4): wire-traffic estimates derived from
+        # the logical payloads and the mesh width — free host arithmetic.
+        rec.wire = wire_estimate(
+            rec.collectives, rec.mesh.get("n_devices")
+        )
+        out = rec.to_dict()
+        if self._trace is not None:
+            # Post-hoc replay: level/round rows (the fused engines' exact
+            # realized-work accounting) become spans inside the live
+            # ENGINE-span window (split/fused_build/...; the bin/shard
+            # preamble did no level work); repeated report() calls
+            # replace, never duplicate (owner-keyed).
+            build = [
+                w for n, w in self._trace_windows.items()
+                if n in trace_mod.BUILD_PHASES
+            ]
+            window = (
+                [min(w[0] for w in build), max(w[1] for w in build)]
+                if build else self._trace_window
+            )
+            trace_mod.synthesize_record_tracks(
+                self._trace, f"obs{self._trace_seq}", self._trace_track,
+                out, window=window,
+            )
+            if self._trace_owned and not self._trace_failed:
+                try:
+                    self._trace.write()
+                except OSError as e:
+                    self._trace_failed = True
+                    self.event(
+                        "trace_failed",
+                        f"trace sink unwritable at report ({e}); trace "
+                        "kept in memory only",
+                        path=self._trace.path,
+                    )
+                    out = rec.to_dict()  # carry the event out
+        return out

@@ -31,12 +31,14 @@ graceful XLA fallback (typed event) everywhere else.
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 
 import jax
 
 from mpitree_tpu.obs import BuildObserver
+from mpitree_tpu.obs.metrics import MetricsRegistry
 from mpitree_tpu.resilience import chaos, retry_device
 from mpitree_tpu.serving import pallas_serve, traversal
 from mpitree_tpu.serving.tables import table_notes, tables_for
@@ -70,6 +72,12 @@ class CompiledModel:
                  channel_salt=""):
         self._state_lock = threading.Lock()
         self._obs = BuildObserver()
+        # Request-path telemetry (obs/metrics.py): per-bucket latency
+        # histograms + request/row counters, private per model so slot
+        # swaps never mix distributions. Pure host dict work — the
+        # zero-compile/zero-transfer request-path pins hold with metrics
+        # on (tests/test_obs_trace.py).
+        self.metrics = MetricsRegistry()
         self.trees = list(trees)
         self.kind = kind
         self.n_features = int(n_features)
@@ -78,6 +86,28 @@ class CompiledModel:
         self._loss = loss
         self._values_fn = values_fn
         self.buckets = tuple(sorted(int(b) for b in buckets))
+        self._lat = {
+            b: self.metrics.histogram(
+                "mpitree_serving_request_seconds", bucket=str(b)
+            )
+            for b in self.buckets
+        }
+        # Oversize batches chunk at the largest bucket: their end-to-end
+        # wall is a chunk-LOOP total, which must not masquerade as
+        # single-dispatch latency in the largest bucket's p99.
+        self._lat_over = self.metrics.histogram(
+            "mpitree_serving_request_seconds", bucket="oversize"
+        )
+        self._m_requests = self.metrics.counter(
+            "mpitree_serving_requests_total"
+        )
+        self._m_rows = self.metrics.counter("mpitree_serving_rows_total")
+        # Rows that actually went through raw()'s latency clock — the
+        # honest numerator for sustained rows/s (warmup and streaming
+        # raw_async rows are counted in serving_rows but never timed).
+        self._m_lat_rows = self.metrics.counter(
+            "mpitree_serving_latency_rows_total"
+        )
         platform = jax.devices()[0].platform
         # CPU backends aggregate in f64 under a scoped enable_x64 — the
         # bit-identical twin of the estimators' host accumulation.
@@ -191,9 +221,10 @@ class CompiledModel:
         # unlocked: they are failure-path-only and best-effort under
         # concurrency; the load-bearing audits (compile registry, request
         # counters) are all locked.
-        return retry_device(
-            dev, what="serving traversal dispatch", obs=self._obs
-        )
+        with self._obs.span("serving_dispatch"):
+            return retry_device(
+                dev, what="serving traversal dispatch", obs=self._obs
+            )
 
     def _dispatch_kernel(self, Xp: np.ndarray):
         """The Mosaic tier: VMEM-resident stacked tables, f32 aggregate,
@@ -248,6 +279,8 @@ class CompiledModel:
             # would silently under-report serve_report_ traffic.
             self._obs.counter("serving_requests")
             self._obs.counter("serving_rows", n)
+        self._m_requests.inc()
+        self._m_rows.inc(n)
         b = self._bucket(n)
         if n <= b:
             return self._dispatch(_pad_rows(X, b)), n
@@ -278,15 +311,33 @@ class CompiledModel:
     def raw(self, X) -> np.ndarray:
         """The fused traversal result as a host array (margins for
         boosting, probabilities for classification forests, values for
-        regressors, raw counts for single classification trees)."""
-        return self.finalize(*self.raw_async(X))
+        regressors, raw counts for single classification trees).
+
+        Blocking end-to-end request latency lands in the per-bucket
+        metrics histograms here (pad + dispatch + materialize — what a
+        caller actually waits). Streaming callers ride ``raw_async``
+        without a per-request clock; the stage's queue-depth gauge is
+        their telemetry."""
+        t0 = time.perf_counter()
+        out, n = self.raw_async(X)
+        host = self.finalize(out, n)
+        dt = time.perf_counter() - t0
+        b = self._bucket(n)
+        (self._lat[b] if n <= b else self._lat_over).observe(dt)
+        self._m_lat_rows.inc(n)
+        return host
 
     def warmup(self, buckets=None) -> None:
         """Pre-compile every bucket shape OFF the request path (what the
         registry runs before a slot swap, so swapping a freshly trained
-        model never compiles under traffic)."""
+        model never compiles under traffic). Deliberately skips ``raw``'s
+        latency clock: a warmup dispatch is one cold XLA compile, and
+        folding 100-1000x-of-steady-state walls into the histograms
+        would poison every early p99 the scrape side reports."""
         for b in buckets or self.buckets:
-            self.raw(np.zeros((int(b), self.n_features), np.float32))
+            self.finalize(*self.raw_async(
+                np.zeros((int(b), self.n_features), np.float32)
+            ))
 
     # -- estimator-equivalent surface -------------------------------------
     def predict(self, X):
@@ -329,12 +380,90 @@ class CompiledModel:
         raw = self.raw(X)
         return raw[:, 0] if raw.shape[1] == 1 else raw
 
+    def trace_to(self, sink, *, track: str = "serving") -> None:
+        """Route this model's dispatch spans/events into a Chrome-trace
+        sink (a path or a :class:`~mpitree_tpu.obs.trace.TraceSink`
+        shared with training fits — one fit+serve timeline)."""
+        self._obs.trace_to(sink, track=track)
+
+    def _sync_metrics(self) -> None:
+        """Mirror the obs record's failure-path counters into the metrics
+        registry (the retry rung writes through the observer; Prometheus
+        scrapes should see the same numbers). set_total is max-based, so
+        the mirror can never run a counter backwards."""
+        with self._state_lock:
+            c = dict(self._obs.record.counters)
+            fallbacks = sum(
+                1 for e in self._obs.record.events
+                if e.get("kind") == "serving_pallas_fallback"
+            )
+        self.metrics.counter("mpitree_serving_retries_total").set_total(
+            c.get("device_retries", 0)
+        )
+        self.metrics.counter("mpitree_serving_fallbacks_total").set_total(
+            fallbacks
+        )
+
+    def latency_summary(self) -> dict:
+        """Per-bucket p50/p95/p99 (log-bucketed histogram estimates) plus
+        the sustained throughput over observed request wall.
+
+        Buckets are the padded dispatch shapes; ``oversize`` collects
+        chunk-looped requests past the largest bucket (their wall is a
+        loop total, not a single-dispatch latency). ``rows`` counts ALL
+        rows the model served (incl. warmup/streaming); the sustained
+        rate divides only the latency-clocked rows by the clocked wall —
+        mixing in untimed rows would inflate it by orders of magnitude
+        on any freshly warmed model."""
+        out: dict = {"buckets": {}}
+        total_s, total_n = 0.0, 0
+        hists = [(str(b), self._lat[b]) for b in self.buckets]
+        hists.append(("oversize", self._lat_over))
+        for label, h in hists:
+            if h.count == 0:
+                continue
+            out["buckets"][label] = {
+                "count": h.count,
+                "p50_ms": round(h.quantile(0.5) * 1e3, 4),
+                "p95_ms": round(h.quantile(0.95) * 1e3, 4),
+                "p99_ms": round(h.quantile(0.99) * 1e3, 4),
+                "mean_ms": round(h.sum / h.count * 1e3, 4),
+            }
+            total_s += h.sum
+            total_n += h.count
+        with self._state_lock:
+            rows = int(self._obs.record.counters.get("serving_rows", 0))
+        clocked = int(self._m_lat_rows.value)
+        out["requests"] = total_n
+        out["rows"] = rows
+        out["rows_latency_clocked"] = clocked
+        out["rows_per_s_sustained"] = (
+            round(clocked / total_s, 1) if total_s > 0 else None
+        )
+        return out
+
+    def metrics_text(self, extra_labels: dict | None = None) -> str:
+        """Prometheus text exposition of this model's registry."""
+        self._sync_metrics()
+        return self.metrics.metrics_text(extra_labels)
+
+    def metrics_families(self, extra_labels: dict | None = None) -> dict:
+        """Synced ``render_families`` map — what ``ModelRegistry``
+        merges into its single-TYPE-line-per-family exposition."""
+        self._sync_metrics()
+        return self.metrics.render_families(extra_labels)
+
     @property
     def serve_report_(self) -> dict:
         """Structured serving record (the ``fit_report_`` analogue):
-        compile notes per bucket, kernel policy decision, retry/fallback
-        events, request/row counters."""
-        return self._obs.report()
+        compile notes per bucket (with cold-dispatch ``seconds``
+        attribution), kernel policy decision, retry/fallback events,
+        request/row counters, and the per-bucket ``latency`` quantile
+        block from the log-bucketed histograms."""
+        self._sync_metrics()
+        rep = self._obs.report()
+        rep["latency"] = self.latency_summary()
+        return rep
 
 
 def compile_model(estimator, *, buckets=DEFAULT_BUCKETS) -> CompiledModel:

@@ -19,6 +19,7 @@ from __future__ import annotations
 import threading
 import time
 
+from mpitree_tpu.obs.metrics import MetricsRegistry
 from mpitree_tpu.serving.model import DEFAULT_BUCKETS, CompiledModel
 
 
@@ -30,6 +31,10 @@ class ModelRegistry:
         self._slots: dict[str, CompiledModel] = {}
         self._meta: dict[str, dict] = {}
         self._lock = threading.Lock()
+        # Registry-level metrics (obs/metrics.py): publish counts + warm
+        # seconds; metrics_text() merges every slot model's private
+        # registry under a model=<name> label for one scrape surface.
+        self.metrics = MetricsRegistry()
 
     def publish(self, name: str, model, *, warm: bool = True) -> CompiledModel:
         """Compile (if needed) + warm ``model``, then swap it live.
@@ -47,6 +52,12 @@ class ModelRegistry:
         if warm:
             model.warmup()
         warm_s = time.perf_counter() - t0
+        self.metrics.counter(
+            "mpitree_registry_publish_total", model=name
+        ).inc()
+        self.metrics.histogram(
+            "mpitree_registry_warm_seconds", model=name
+        ).observe(warm_s)
         with self._lock:
             generation = self._meta.get(name, {}).get("generation", 0) + 1
             self._slots[name] = model
@@ -82,6 +93,23 @@ class ModelRegistry:
         """Snapshot of slot metadata (generation, warm time, buckets)."""
         with self._lock:
             return {k: dict(v) for k, v in self._meta.items()}
+
+    def metrics_text(self) -> str:
+        """One Prometheus exposition for the whole registry: its own
+        publish/warm metrics plus every published model's request-path
+        registry stamped with a ``model=<slot>`` label (the scrape
+        surface ``examples/serving_run.py``'s asyncio exporter serves).
+        Families merge under ONE ``# TYPE`` line per name — the
+        Prometheus parser rejects duplicates, so two published slots
+        must share each family header (``obs.metrics.render_text``)."""
+        from mpitree_tpu.obs.metrics import render_text
+
+        with self._lock:
+            slots = dict(self._slots)
+        maps = [self.metrics.render_families()]
+        for name in sorted(slots):
+            maps.append(slots[name].metrics_families({"model": name}))
+        return render_text(maps)
 
     # Request-path conveniences — one slot read, then the model's own
     # bucketed single-dispatch path.

@@ -41,6 +41,13 @@ class StreamStage:
         self.depth = int(depth)
         self._inflight: deque = deque()
         self._next_ticket = 0
+        # Queue-depth telemetry (obs/metrics.py): streaming callers skip
+        # the blocking per-request latency clock, so the pipeline's
+        # outstanding-batch gauge is their scrape-side signal.
+        self._m_depth = model.metrics.gauge("mpitree_serving_inflight")
+        self._m_staged = model.metrics.counter(
+            "mpitree_serving_staged_batches_total"
+        )
 
     def _materialize(self, entry) -> tuple:
         ticket, out, n = entry
@@ -55,6 +62,8 @@ class StreamStage:
         out, n = self.model.raw_async(X)
         self._inflight.append((self._next_ticket, out, n))
         self._next_ticket += 1
+        self._m_staged.inc()
+        self._m_depth.set(len(self._inflight))
         return done
 
     def drain(self) -> list:
@@ -62,4 +71,5 @@ class StreamStage:
         done = []
         while self._inflight:
             done.append(self._materialize(self._inflight.popleft()))
+        self._m_depth.set(0)
         return done

@@ -47,6 +47,7 @@ documented serving-tier divergence).
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from functools import partial
 
@@ -230,9 +231,14 @@ def dispatch(X, table_args, values, *, kind: str, n_steps: int,
         None if acc0 is None else acc0.shape,
     )
     with _NOTE_LOCK:
-        REGISTRY.note("serving_traverse", key, cache_size=64)
+        # ONE registry note per dispatch: obs.compile_note already feeds
+        # the process REGISTRY, so calling both would mark the key warm
+        # before the record could count it new (and double-count the
+        # lowering event).
         if obs is not None:
-            obs.compile_note("serving_traverse", key, cache_size=64)
+            fresh = obs.compile_note("serving_traverse", key, cache_size=64)
+        else:
+            fresh = REGISTRY.note("serving_traverse", key, cache_size=64)
 
     def run():
         if kind in GATHER_KINDS:
@@ -243,7 +249,16 @@ def dispatch(X, table_args, values, *, kind: str, n_steps: int,
             X, *table_args, acc0, values, scale, kind=kind, n_steps=n_steps
         )
 
-    if x64:
-        with jax.enable_x64(True):
-            return run()
-    return run()
+    # Cold-compile attribution (ISSUE 9): a fresh cache key's dispatch
+    # wall lands on the 'serving_traverse' entry point — in practice the
+    # registry warms every bucket OFF the request path, so request-time
+    # attribution staying zero IS the swap-under-load story.
+    attr = (
+        obs.compile_attribution("serving_traverse", fresh)
+        if obs is not None else contextlib.nullcontext()
+    )
+    with attr:
+        if x64:
+            with jax.enable_x64(True):
+                return run()
+        return run()

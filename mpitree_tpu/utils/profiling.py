@@ -92,6 +92,12 @@ class PhaseTimer:
     def compile_note(self, entry: str, key, cache_size: int = 64) -> bool:
         return False
 
+    @contextlib.contextmanager
+    def compile_attribution(self, entry: str, fresh: bool = True):
+        """No-op twin of BuildObserver.compile_attribution (cold-dispatch
+        wall attribution per jit entry point); plain timers pay nothing."""
+        yield
+
     def round(self, **row) -> None:
         pass
 

@@ -52,10 +52,11 @@ def test_build_record_schema_golden():
     """Field names are pinned: renaming/removing one is a versioned act."""
     rep = BuildObserver(timing=False).report()
     assert tuple(sorted(rep)) == tuple(sorted(TOP_LEVEL_FIELDS))
-    # v3 (ISSUE 8): top-level level_stream (rows past the cap spill to
-    # JSONL) and digest expansions/rounds_per_dispatch (leaf-wise growth
-    # + fused multi-round GBDT accounting)
-    assert rep["schema"] == SCHEMA_VERSION == 3
+    # v4 (ISSUE 9): top-level wire (the collective ledger's per-site/
+    # per-fit/per-shard wire-traffic estimates) and digest
+    # wire_bytes/wire_shard_bytes; compile entries may carry 'seconds'
+    # (cold-dispatch attribution per jit entry point)
+    assert rep["schema"] == SCHEMA_VERSION == 4
     # dataclass fields and the pinned tuple must agree too
     assert tuple(
         f.name for f in dataclasses.fields(BuildRecord)
@@ -65,7 +66,7 @@ def test_build_record_schema_golden():
     assert tuple(sorted(digest(rep))) == tuple(sorted((
         "engine", "reason", "n_nodes", "depth", "levels", "compile_new",
         "psum_bytes", "sub_frac", "expansions", "rounds_per_dispatch",
-        "events", "wall_s",
+        "events", "wire_bytes", "wire_shard_bytes", "wall_s",
     )))
 
 
@@ -262,6 +263,14 @@ def test_effective_tiers_trim_matches_depth_cap():
 # ---------------------------------------------------------------------------
 
 def test_disabled_observability_no_rows_and_cheap():
+    """Medians over interleaved repeats (ISSUE 9 satellite): the old
+    one-shot/best-of ratio flaked under concurrent background load —
+    one descheduled run on either side flipped the verdict. Interleaving
+    exposes both timers to the same load profile and the median shrugs
+    off asymmetric outliers that min() happened to absorb only when the
+    spike hit the lucky side."""
+    import statistics
+
     X, y = _data(2000)
     binned = bin_dataset(X, max_bins=64, binning="quantile")
     mesh = mesh_lib.resolve_mesh(n_devices=None)
@@ -279,7 +288,7 @@ def test_disabled_observability_no_rows_and_cheap():
     run(PhaseTimer(enabled=False))  # compile warm-up, both paths share it
     t_plain, t_obs = [], []
     obs_timers = []
-    for _ in range(7):  # interleaved best-of to shrug off CPU noise
+    for _ in range(9):  # interleaved so load spikes hit both sides alike
         t_plain.append(run(PhaseTimer(enabled=False)))
         obs = BuildObserver(timing=False)
         t_obs.append(run(obs))
@@ -287,10 +296,12 @@ def test_disabled_observability_no_rows_and_cheap():
     for obs in obs_timers:
         assert obs.record.levels == []  # no per-level rows allocated
         assert obs.record.phases == {}
-    # <5% wall vs the stripped timer (plus 2ms absolute for clock grain)
-    assert min(t_obs) <= min(t_plain) * 1.05 + 0.002, (
-        f"disabled-observability overhead: {min(t_obs):.4f}s vs "
-        f"{min(t_plain):.4f}s stripped"
+    med_plain = statistics.median(t_plain)
+    med_obs = statistics.median(t_obs)
+    # <5% wall vs the stripped timer (plus 5ms absolute for clock grain)
+    assert med_obs <= med_plain * 1.05 + 0.005, (
+        f"disabled-observability overhead: median {med_obs:.4f}s vs "
+        f"{med_plain:.4f}s stripped ({sorted(t_obs)} vs {sorted(t_plain)})"
     )
     # ...while the always-on channels still populated for free
     rep = obs_timers[-1].report()

@@ -1,0 +1,442 @@
+"""ISSUE 9 — obs.trace + obs.metrics: timelines, quantiles, attribution.
+
+The four contracts this file pins:
+
+- **golden trace schema**: a real fit's ``trace_to`` output is a valid
+  Chrome-trace-event JSON (required per-event fields, whitelisted
+  phases, non-negative microsecond timestamps monotonic per (pid, tid)
+  track, ``thread_name`` metadata for every used track) — the
+  Perfetto-loadability gate ``make trace-smoke`` runs in CI;
+- **quantile oracle**: the log-bucketed histogram's p50/p95/p99 track
+  ``numpy.percentile`` within the geometric-bucket error bound;
+- **request-path pins with metrics on**: latency observation + counters
+  add ZERO new compile cache-keys and ZERO explicit device_put calls to
+  the warmed serving path;
+- **attribution + ledger**: fresh cache-key registrations carry
+  cold-dispatch wall per entry point, and the record's ``wire`` block /
+  digest carry the per-fit and per-shard ICI wire estimates.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from mpitree_tpu.models.classifier import DecisionTreeClassifier
+from mpitree_tpu.obs import (
+    REGISTRY,
+    BuildObserver,
+    digest,
+    wire_estimate,
+)
+from mpitree_tpu.obs import metrics as metrics_mod
+from mpitree_tpu.obs import trace as trace_mod
+
+
+def _cls_data(n=400, f=6, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = ((X[:, 0] > 0) + (X[:, 1] > 0.6)).astype(np.int64)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# golden Chrome trace schema
+# ---------------------------------------------------------------------------
+
+def _fit_trace(tmp_path, engine, monkeypatch, name):
+    # backend="cpu" forces the device path (auto would route this smoke
+    # workload to the host tier, which has no engine spans to trace)
+    monkeypatch.setenv("MPITREE_TPU_ENGINE", engine)
+    path = tmp_path / f"{name}.trace.json"
+    clf = DecisionTreeClassifier(
+        max_depth=3, max_bins=16, backend="cpu"
+    ).fit(*_cls_data(), trace_to=path)
+    with open(path) as f:
+        return clf, json.load(f)
+
+
+def test_trace_schema_golden_levelwise(tmp_path, monkeypatch):
+    """The pinned trace-event contract: valid fields, monotonic ts per
+    track, pid/tid -> thread_name mapping — on a live level-wise fit."""
+    clf, tr = _fit_trace(tmp_path, "levelwise", monkeypatch, "lw")
+    assert trace_mod.validate_trace(tr) == []
+    evs = tr["traceEvents"]
+    assert all(e["ph"] in ("X", "i", "C", "M") for e in evs)
+    for e in evs:
+        assert {"ph", "pid", "tid", "name"} <= set(e)
+        if e["ph"] != "M":
+            assert e["ts"] >= 0
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+    # every used track is named, and ts is monotonic per track
+    named = {(e["pid"], e["tid"]) for e in evs
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    last = {}
+    for e in evs:
+        if e["ph"] == "M":
+            continue
+        key = (e["pid"], e["tid"])
+        assert key in named
+        assert e["ts"] >= last.get(key, 0.0)
+        last[key] = e["ts"]
+    names = {e["name"] for e in evs}
+    # live engine spans + synthesized per-level replay + ICI counters
+    assert "split" in names and "update" in names
+    assert any(n.startswith("level ") for n in names)
+    assert any(e["ph"] == "C" for e in evs)
+
+
+def test_trace_fused_replay_spans_inside_build_window(tmp_path, monkeypatch):
+    """The fused engine has no per-level host clock: its level spans are
+    synthesized from the realized-work replay rows and must land inside
+    the live fused_build span's window."""
+    _clf, tr = _fit_trace(tmp_path, "fused", monkeypatch, "fz")
+    assert trace_mod.validate_trace(tr) == []
+    evs = tr["traceEvents"]
+    build = [e for e in evs if e["name"] == "fused_build"]
+    assert len(build) == 1
+    lo, hi = build[0]["ts"], build[0]["ts"] + build[0]["dur"]
+    replay = [e for e in evs if e.get("cat") == "replay"
+              and e["name"].startswith("level ")]
+    assert replay  # at least the root level
+    for e in replay:
+        assert lo - 1 <= e["ts"] and e["ts"] + e["dur"] <= hi + 1
+    # replay rows carry the accounting fields as args
+    assert all("frontier" in e["args"] for e in replay)
+
+
+def test_trace_shared_sink_no_duplication_on_rereport(tmp_path):
+    """Repeated report() re-synthesizes (owner-keyed) instead of
+    duplicating replay spans — forests call report() again after OOB."""
+    sink = trace_mod.TraceSink(str(tmp_path / "s.json"))
+    obs = BuildObserver(timing=False)
+    obs.trace_to(sink)
+    with obs.span("split"):
+        pass
+    obs.level(level=0, frontier=1, psum_bytes=10, seconds=0.001)
+    obs.level(level=1, frontier=2, psum_bytes=20, seconds=None)
+    obs.round(round=0, trees=1)
+    n1 = len(sink.events())
+    obs.report()
+    n2 = len(sink.events())
+    assert n2 > n1  # synthesis added replay spans
+    obs.report()
+    assert len(sink.events()) == n2  # replaced, not duplicated
+    path = sink.write()
+    assert trace_mod.validate_trace(json.load(open(path))) == []
+
+
+def test_trace_env_dir_ambient(tmp_path, monkeypatch):
+    """MPITREE_TPU_TRACE_DIR traces estimator-internal observers with no
+    API change (the bench/watcher capture hook)."""
+    monkeypatch.setenv(trace_mod.TRACE_DIR_ENV, str(tmp_path))
+    DecisionTreeClassifier(max_depth=3, max_bins=16).fit(*_cls_data())
+    files = list(tmp_path.glob("trace_*.json"))
+    assert files
+    assert trace_mod.validate_trace(json.load(open(files[0]))) == []
+
+
+def test_trace_unwritable_sink_degrades(tmp_path):
+    """An unwritable trace path must never abort a fit: typed
+    trace_failed event, fit completes (the checkpoint-sink contract)."""
+    blocker = tmp_path / "blocker"
+    blocker.write_text("")
+    clf = DecisionTreeClassifier(max_depth=3, max_bins=16).fit(
+        *_cls_data(), trace_to=blocker / "sub" / "t.json"
+    )
+    assert hasattr(clf, "tree_")
+    assert any(
+        e["kind"] == "trace_failed" for e in clf.fit_report_["events"]
+    )
+
+
+def test_merge_trace_files(tmp_path):
+    import time
+
+    s1 = trace_mod.TraceSink(str(tmp_path / "a.json"))
+    s1.complete("t", "x", time.perf_counter(), 0.001)
+    s1.write()
+    s2 = trace_mod.TraceSink(str(tmp_path / "b.json"))
+    s2.instant("t", "y")
+    s2.write()
+    (tmp_path / "broken.json").write_text("{nope")
+    out = trace_mod.merge_trace_files(
+        [str(tmp_path / p) for p in ("a.json", "b.json", "broken.json")],
+        str(tmp_path / "merged.json"),
+    )
+    merged = json.load(open(out))
+    assert trace_mod.validate_trace(merged) == []
+    # each source got its own pid
+    pids = {e["pid"] for e in merged["traceEvents"]}
+    assert pids == {1, 2}
+
+
+def test_dump_report_makedirs_and_degrades(tmp_path):
+    clf = DecisionTreeClassifier(max_depth=2, max_bins=16).fit(*_cls_data())
+    # parent dirs created up front
+    dest = tmp_path / "deep" / "nested" / "report.json"
+    assert clf.dump_report(dest) == str(dest)
+    assert json.load(open(dest)) == clf.fit_report_
+    # unwritable: degrade with a typed event, not an OSError
+    blocker = tmp_path / "blocker"
+    blocker.write_text("")
+    with pytest.warns(UserWarning, match="dump_report sink unwritable"):
+        out = clf.dump_report(blocker / "sub" / "r.json")
+    assert out is None
+    assert any(
+        e["kind"] == "trace_failed" for e in clf.fit_report_["events"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# metrics: histogram quantile oracle + exposition
+# ---------------------------------------------------------------------------
+
+def test_histogram_quantile_oracle_vs_numpy():
+    """Log-bucketed quantiles track numpy.percentile within the bucket
+    bound (~9% geometric-midpoint error; 12% asserted for slack) on a
+    latency-shaped lognormal population."""
+    rng = np.random.default_rng(0)
+    xs = np.exp(rng.normal(-6.0, 1.3, 20000))
+    reg = metrics_mod.MetricsRegistry()
+    h = reg.histogram("lat")
+    for x in xs:
+        h.observe(float(x))
+    for q in (0.5, 0.9, 0.95, 0.99):
+        est = h.quantile(q)
+        ref = float(np.percentile(xs, q * 100))
+        assert abs(est - ref) / ref < 0.12, (q, est, ref)
+    # extremes clamp to observed min/max
+    assert h.quantile(0.0) == pytest.approx(float(xs.min()))
+    assert h.quantile(1.0) == pytest.approx(float(xs.max()))
+
+
+def test_histogram_small_population_and_zero_bucket():
+    reg = metrics_mod.MetricsRegistry()
+    h = reg.histogram("h")
+    assert h.quantile(0.5) is None
+    h.observe(0.0)
+    h.observe(5.0)
+    assert h.quantile(0.0) == 0.0
+    assert h.quantile(1.0) == 5.0
+
+
+def test_metrics_text_exposition_format():
+    reg = metrics_mod.MetricsRegistry()
+    reg.counter("req_total", kind="a").inc(3)
+    reg.gauge("depth").set(2)
+    h = reg.histogram("lat_seconds", bucket="64")
+    for v in (0.001, 0.002, 0.004, 0.2):
+        h.observe(v)
+    text = reg.metrics_text(extra_labels={"model": "m"})
+    lines = text.splitlines()
+    assert '# TYPE req_total counter' in lines
+    assert 'req_total{kind="a",model="m"} 3' in lines
+    assert 'depth{model="m"} 2' in lines
+    # histogram: cumulative buckets ending at +Inf, plus _sum/_count
+    bkt = [ln for ln in lines if ln.startswith("lat_seconds_bucket")]
+    assert bkt[-1].startswith('lat_seconds_bucket{bucket="64",le="+Inf"')
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in bkt]
+    assert counts == sorted(counts) and counts[-1] == 4
+    assert 'lat_seconds_count{bucket="64",model="m"} 4' in lines
+    # type conflicts are refused, not silently merged
+    with pytest.raises(TypeError, match="already registered"):
+        reg.gauge("req_total")
+
+
+def test_counter_monotonic_and_mirror():
+    reg = metrics_mod.MetricsRegistry()
+    c = reg.counter("c_total")
+    c.inc(2)
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    c.set_total(1)  # mirror can never run a counter backwards
+    assert c.value == 2
+    c.set_total(7)
+    assert c.value == 7
+
+
+# ---------------------------------------------------------------------------
+# serving: latency block + request-path pins with metrics on
+# ---------------------------------------------------------------------------
+
+def test_serving_latency_quantiles_and_zero_compile_with_metrics(
+    monkeypatch,
+):
+    """serve_report_ exposes per-bucket p50/p95/p99 from the log-bucketed
+    histograms, and the metrics-on request path still pins ZERO new
+    compile cache-keys and ZERO explicit device_put transfers."""
+    from mpitree_tpu.boosting.gradient_boosting import (
+        GradientBoostingClassifier,
+    )
+    from mpitree_tpu.serving.model import compile_model
+
+    X, y = _cls_data(300)
+    gb = GradientBoostingClassifier(
+        max_iter=3, max_depth=3, random_state=0
+    ).fit(X, y)
+    model = compile_model(gb, buckets=(1, 16, 64))
+    model.warmup()
+    n0 = REGISTRY.count("serving_traverse")
+    calls = []
+    real = jax.device_put
+    monkeypatch.setattr(
+        jax, "device_put", lambda *a, **k: calls.append(a) or real(*a, **k)
+    )
+    for n in (1, 3, 16, 40, 64, 100):
+        model.predict(X[:n] if n <= len(X) else X)
+    assert REGISTRY.count("serving_traverse") == n0
+    assert calls == []  # metrics observation is pure host work
+    rep = model.serve_report_
+    lat = rep["latency"]
+    assert lat["requests"] >= 6
+    for row in lat["buckets"].values():
+        assert row["count"] > 0
+        assert 0 < row["p50_ms"] <= row["p95_ms"] <= row["p99_ms"]
+    assert lat["rows_per_s_sustained"] > 0
+    # warmup stays OFF the latency clock (cold compiles would poison p99)
+    assert sum(r["count"] for r in lat["buckets"].values()) == 6
+    # the 100-row request chunk-loops past the largest bucket: its loop
+    # total lands in 'oversize', not the 64 bucket's p99
+    assert lat["buckets"]["oversize"]["count"] == 1
+    # sustained rows/s divides CLOCKED rows only — warmup's 81 padded
+    # rows are served but never timed
+    assert lat["rows_latency_clocked"] == 1 + 3 + 16 + 40 + 64 + 100
+    assert lat["rows"] > lat["rows_latency_clocked"]  # warmup counted
+    text = model.metrics_text()
+    assert "mpitree_serving_requests_total" in text
+    assert "mpitree_serving_request_seconds_bucket" in text
+
+
+def test_stream_stage_queue_depth_gauge():
+    from mpitree_tpu.models.forest import RandomForestRegressor
+    from mpitree_tpu.serving.model import compile_model
+    from mpitree_tpu.serving.staging import StreamStage
+
+    X, y = _cls_data(200)
+    fr = RandomForestRegressor(
+        n_estimators=3, max_depth=3, random_state=0
+    ).fit(X, y.astype(np.float64))
+    model = compile_model(fr, buckets=(1, 64))
+    stage = StreamStage(model, depth=2)
+    stage.submit(X[:8])
+    stage.submit(X[8:16])
+    assert model.metrics.gauge("mpitree_serving_inflight").value == 2
+    stage.drain()
+    assert model.metrics.gauge("mpitree_serving_inflight").value == 0
+    assert (
+        model.metrics.counter(
+            "mpitree_serving_staged_batches_total"
+        ).value == 2
+    )
+
+
+def test_registry_metrics_text_aggregates_slots():
+    """Two published slots merge into ONE exposition with a single
+    # TYPE line per family — the Prometheus parser rejects duplicates,
+    so naive per-slot concatenation would fail the whole scrape."""
+    from mpitree_tpu.models.forest import RandomForestClassifier
+    from mpitree_tpu.serving.registry import ModelRegistry
+
+    X, y = _cls_data(200)
+    f1 = RandomForestClassifier(
+        n_estimators=3, max_depth=3, random_state=0
+    ).fit(X, y)
+    f2 = RandomForestClassifier(
+        n_estimators=3, max_depth=3, random_state=1
+    ).fit(X, y)
+    reg = ModelRegistry(buckets=(1, 16))
+    reg.publish("slot_a", f1)
+    reg.publish("slot_b", f2)
+    reg.predict("slot_a", X[:4])
+    text = reg.metrics_text()
+    assert 'mpitree_registry_publish_total{model="slot_a"} 1' in text
+    assert 'model="slot_a"' in text and 'model="slot_b"' in text
+    assert "mpitree_serving_requests_total" in text
+    type_lines = [
+        ln for ln in text.splitlines() if ln.startswith("# TYPE ")
+    ]
+    assert len(type_lines) == len(set(type_lines))
+    # samples group under their one TYPE header: every non-comment line
+    # between a header and the next belongs to that family
+    fam = None
+    for ln in text.splitlines():
+        if ln.startswith("# TYPE "):
+            fam = ln.split()[2]
+        else:
+            assert fam is not None and ln.startswith(fam)
+
+
+# ---------------------------------------------------------------------------
+# cold-compile attribution + the collective wire ledger
+# ---------------------------------------------------------------------------
+
+def test_compile_attribution_records_seconds():
+    obs = BuildObserver(timing=False)
+    entry = f"attr_test_{os.getpid()}_{id(obs)}"
+    fresh = obs.compile_note(entry, ("k",))
+    assert fresh
+    before = REGISTRY.seconds(entry)
+    with obs.compile_attribution(entry, fresh):
+        pass
+    assert REGISTRY.seconds(entry) >= before
+    assert "seconds" in obs.record.compile[entry]
+    # warm keys attribute nothing
+    warm = obs.compile_note(entry, ("k",))
+    assert not warm
+    s0 = obs.record.compile[entry]["seconds"]
+    with obs.compile_attribution(entry, warm):
+        pass
+    assert obs.record.compile[entry]["seconds"] == s0
+
+
+def test_fit_report_carries_compile_seconds(monkeypatch):
+    """A fit whose entry points lower fresh attributes cold-dispatch wall
+    in fit_report_['compile'][entry]['seconds'] (ROADMAP follow-up 1)."""
+    monkeypatch.setenv("MPITREE_TPU_ENGINE", "levelwise")
+    # a never-seen max_bins forces fresh split/counts/update keys even
+    # when earlier tests warmed the common configurations
+    clf = DecisionTreeClassifier(
+        max_depth=3, max_bins=23, backend="cpu"
+    ).fit(*_cls_data())
+    comp = clf.fit_report_["compile"]
+    fresh_entries = [k for k, v in comp.items() if v.get("new")]
+    assert fresh_entries
+    assert any(v.get("seconds", 0) > 0 for v in comp.values())
+
+
+def test_wire_estimate_math_and_digest_keys():
+    coll = {"split_hist_psum": {"calls": 4, "bytes": 1000},
+            "counts_psum": {"calls": 1, "bytes": 24}}
+    w = wire_estimate(coll, 8)
+    assert w["bytes"] == 1024
+    assert w["wire_bytes"] == 1024 * 7
+    assert w["wire_bytes_per_shard"] == 1024 * 7 // 8
+    assert w["sites"]["split_hist_psum"]["wire_bytes"] == 7000
+    # one device: no ICI hop, honestly zero
+    w1 = wire_estimate(coll, 1)
+    assert w1["wire_bytes"] == 0 and w1["wire_bytes_per_shard"] == 0
+    # report + digest carry the ledger
+    obs = BuildObserver(timing=False)
+    obs.record.mesh = {"platform": "cpu", "n_devices": 8, "axes": {}}
+    obs.collective("split_hist_psum", calls=2, nbytes=512)
+    rep = obs.report()
+    assert rep["wire"]["wire_bytes"] == 512 * 7
+    d = digest(rep)
+    assert d["wire_bytes"] == 512 * 7
+    assert d["wire_shard_bytes"] == 512 * 7 // 8
+
+
+def test_fit_report_wire_block_present():
+    clf = DecisionTreeClassifier(
+        max_depth=3, max_bins=16, backend="cpu"
+    ).fit(*_cls_data())
+    wire = clf.fit_report_["wire"]
+    assert wire["n_shards"] == clf.fit_report_["mesh"]["n_devices"]
+    assert set(wire["sites"]) == set(clf.fit_report_["collectives"])
+    if wire["n_shards"] > 1:
+        assert wire["wire_bytes"] > 0

@@ -16,7 +16,10 @@ the host-side phases of the measurement.
 """
 
 import argparse
+import glob
+import importlib.util
 import os
+import shutil
 import signal
 import subprocess
 import sys
@@ -26,6 +29,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "TPU_WATCHER.log")
 JSONL = os.path.join(REPO, "BENCH_TPU.jsonl")
 FLAG = "/tmp/tpu_bench_running"
+TRACE_DIR = os.path.join(REPO, "traces")
 
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
@@ -111,6 +115,41 @@ def derive_budget(sec: str, path: str = JSONL) -> tuple[int, str]:
     return derived, f"derived from observed {observed:.0f}s"
 
 
+def _trace_module():
+    """obs/trace.py loaded BY FILE PATH — stdlib-only by contract, so the
+    merge works without importing the mpitree_tpu package (and its jax
+    dependency) on the babysitting host."""
+    spec = importlib.util.spec_from_file_location(
+        "_watcher_obs_trace",
+        os.path.join(REPO, "mpitree_tpu", "obs", "trace.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def merge_section_trace(sec: str) -> str | None:
+    """Merge the section's per-fit trace files (written by the child via
+    MPITREE_TPU_TRACE_DIR) into ONE Perfetto-loadable file next to
+    BENCH_TPU.jsonl — the rc=-15 diagnosability satellite: whatever a
+    killed section managed to trace survives the kill, and the committed
+    log points at it. Returns the merged path, or None when the section
+    wrote no trace (never raises — a broken trace must not stop the
+    capture loop)."""
+    try:
+        files = glob.glob(
+            os.path.join(TRACE_DIR, sec, "trace_*.json")
+        )
+        if not files:
+            return None
+        return _trace_module().merge_trace_files(
+            files, os.path.join(REPO, f"TRACE_{sec}.trace.json")
+        )
+    except Exception as e:  # noqa: BLE001 — telemetry, not the capture
+        log(f"{sec}: trace merge failed ({type(e).__name__}: {e})")
+        return None
+
+
 def tail_lines(path: str, n: int) -> list:
     """Last n non-empty lines of a (possibly still-growing) text file."""
     try:
@@ -188,9 +227,19 @@ def build_todo(sections: str, redo: str, path: str = JSONL) -> list:
 def run_section(sec: str) -> bool:
     budget, why = derive_budget(sec)
     before = capture_count(sec)
-    log(f"run {sec} (budget {budget}s, {why})")
+    # Per-section span timeline (ISSUE 9): the child's fits auto-trace
+    # into traces/<sec>/ via MPITREE_TPU_TRACE_DIR; merged next to
+    # BENCH_TPU.jsonl afterwards — so the next rc=-15 verdict shows WHERE
+    # inside the section time went, not just that it died.
+    sec_trace_dir = os.path.join(TRACE_DIR, sec)
+    # Fresh per run: a --redo or retry-after-NOT-captured must not merge
+    # a previous round's trace files into this run's timeline (and a
+    # recycled pid could even silently overwrite one).
+    shutil.rmtree(sec_trace_dir, ignore_errors=True)
+    log(f"run {sec} (budget {budget}s, {why}; trace -> {sec_trace_dir})")
     open(FLAG, "w").close()
     outpath = f"/tmp/tpu_watcher_{sec}.out"
+    child_env = {**os.environ, "MPITREE_TPU_TRACE_DIR": sec_trace_dir}
     try:
         # Child stdout goes to a FILE, not a pipe: a hung child cannot
         # deadlock on a full pipe buffer, and — the rc=-15 diagnosability
@@ -207,7 +256,7 @@ def run_section(sec: str) -> bool:
                  "--sections", sec, "--timeout", str(budget),
                  "--platform", "tpu"],
                 stdout=outf, stderr=subprocess.STDOUT, text=True,
-                cwd=REPO, start_new_session=True,
+                cwd=REPO, start_new_session=True, env=child_env,
             )
             t0 = time.time()
             try:
@@ -216,10 +265,15 @@ def run_section(sec: str) -> bool:
                 log(f"{sec}: rc={proc.returncode} | " + " / ".join(tail))
             except subprocess.TimeoutExpired:
                 # Partial-section progress BEFORE the kill — the evidence
-                # of WHERE the section died and how far it got.
+                # of WHERE the section died and how far it got, with the
+                # budget's provenance and the trace file carrying the
+                # intra-section timeline of everything that completed.
                 partial = tail_lines(outpath, 6)
+                merged = merge_section_trace(sec)
                 log(f"{sec}: parent timeout after {time.time() - t0:.0f}s "
-                    f"(budget {budget}+300s); progress before kill | "
+                    f"(budget {budget}+300s, {why}); trace "
+                    f"{merged or f'<none in {sec_trace_dir}>'}; "
+                    "progress before kill | "
                     + (" / ".join(partial) if partial else "<no output>"))
                 log(f"{sec}: killing process group")
                 try:
@@ -241,6 +295,9 @@ def run_section(sec: str) -> bool:
     done = capture_count(sec) > before
     log(f"{sec}: {'captured' if done else 'NOT captured'}")
     if done:
+        merged = merge_section_trace(sec)
+        if merged:
+            log(f"{sec}: trace | {merged}")
         # One-line run-record digest next to the capture verdict: the next
         # slow-section mystery (rounds 3-4 cost whole windows to exactly
         # this) arrives with its engine decision, recompile count, and
