@@ -159,7 +159,8 @@ def _device_platform() -> str:
 RECORD_DIGEST_KEYS = (
     "engine", "reason", "n_nodes", "depth", "levels", "compile_new",
     "psum_bytes", "sub_frac", "expansions", "rounds_per_dispatch",
-    "events", "wire_bytes", "wire_shard_bytes", "wall_s",
+    "events", "wire_bytes", "wire_shard_bytes", "feature_shards",
+    "wall_s",
 )
 
 
@@ -192,6 +193,9 @@ def format_record_digest(d: dict) -> str:
         line += f" expansions={d['expansions']}"
     if d.get("rounds_per_dispatch") is not None:
         line += f" rpd={d['rounds_per_dispatch']}"
+    if (d.get("feature_shards") or 1) > 1:
+        # 2-D (data, feature) mesh: psum_bytes above is per feature slab
+        line += f" fshards={d['feature_shards']}"
     if d.get("reason"):
         line += f" reason={d['reason']!r}"
     return line
@@ -987,6 +991,86 @@ def worker_serving(npz_path: str) -> dict:
     return out
 
 
+def worker_mesh2d_ab(npz_path: str) -> dict:
+    """1-D vs 2-D (data, feature) mesh A/B (ISSUE 10).
+
+    Same bounded-section protocol as ``subtraction_ab``: two cold+warm
+    timed full-depth device fits of the same workload — an ``(n, 1)``
+    data mesh vs an ``(n/2, 2)`` rows-x-features mesh — comparing wall
+    clock and the wire ledger's recorded payloads. The headline is the
+    ``split_hist_psum`` logical-payload ratio (the feature-sharded slab
+    should be ~1/2 the 1-D payload, independent of wall clock) plus the
+    per-axis wire breakdown; structural identity (node/depth/accuracy
+    equality — the mesh-invariance pin on the real workload) rides along.
+    CPU workers force a virtual 8-device mesh; a single-device worker
+    skips honestly.
+    """
+    import jax
+
+    # Must precede first device use; harmless after (the config update
+    # refuses once the backend is up — fall back to whatever exists).
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except Exception:  # noqa: BLE001 — older wheels / initialized backend
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        )
+    from mpitree_tpu import DecisionTreeClassifier
+
+    Xtr, ytr, Xte, yte = _load(npz_path)
+    platform = _device_platform()
+    D = len(jax.devices())
+    if D < 2:
+        return {"skipped": f"needs >= 2 devices, have {D}",
+                "platform": platform}
+    D = D if D % 2 == 0 else D - 1
+    out: dict = {"platform": platform, "n_devices": D, "depth": DEPTH}
+    for name, shape in (("mesh_1d", (D, 1)), ("mesh_2d", (D // 2, 2))):
+        def once():
+            clf = DecisionTreeClassifier(
+                max_depth=DEPTH, max_bins=256, backend=platform,
+                n_devices=shape, refine_depth=None,
+            )
+            t0 = time.perf_counter()
+            clf.fit(Xtr, ytr)
+            return time.perf_counter() - t0, clf
+
+        cold_s, clf = once()
+        warm_s, clf = once()
+        rep = clf.fit_report_
+        out[name] = {
+            "shape": list(shape),
+            "cold_s": round(cold_s, 3), "warm_s": round(warm_s, 3),
+            "test_acc": round(float((clf.predict(Xte) == yte).mean()), 4),
+            "tree_n_nodes": clf.tree_.n_nodes,
+            "tree_depth": clf.tree_.max_depth,
+            "split_psum_bytes": int(
+                rep["collectives"].get("split_hist_psum", {})
+                .get("bytes", 0)
+            ),
+            "wire": {
+                k: rep.get("wire", {}).get(k)
+                for k in ("axes", "wire_bytes", "data_bytes",
+                          "feature_bytes")
+            },
+            "record": record_digest(rep),
+        }
+    p1 = out["mesh_1d"]["split_psum_bytes"]
+    p2 = out["mesh_2d"]["split_psum_bytes"]
+    if p1 and p2:
+        out["split_psum_reduction_x"] = round(p1 / p2, 3)
+    out["warm_speedup_2d_vs_1d"] = round(
+        out["mesh_1d"]["warm_s"] / out["mesh_2d"]["warm_s"], 3
+    )
+    out["same_structure"] = bool(
+        out["mesh_1d"]["tree_n_nodes"] == out["mesh_2d"]["tree_n_nodes"]
+        and out["mesh_1d"]["tree_depth"] == out["mesh_2d"]["tree_depth"]
+        and out["mesh_1d"]["test_acc"] == out["mesh_2d"]["test_acc"]
+    )
+    return out
+
+
 def worker_forest(npz_path: str) -> dict:
     """BASELINE configs[4] on the live platform (core shared with bench.py:
     one-program tree-sharded forest vs T sequential fused builds)."""
@@ -1016,6 +1100,7 @@ WORKERS = {
     "boosting": worker_boosting,
     "leafwise_ab": worker_leafwise_ab,
     "gbdt_fusedK": worker_gbdt_fusedK,
+    "mesh2d_ab": worker_mesh2d_ab,
     "serving": worker_serving,
 }
 
@@ -1252,7 +1337,7 @@ def main() -> int:
     # engine_fused -> boosting -> the rest).
     p.add_argument("--sections", default="hist_tput,north_star,"
                    "engine_fused,boosting,leafwise_ab,gbdt_fusedK,"
-                   "serving,engine_levelwise,forest")
+                   "mesh2d_ab,serving,engine_levelwise,forest")
     p.add_argument("--timeout", type=int, default=SECTION_TIMEOUT_S)
     p.add_argument("--platform", default="auto",
                    help="jax platform for every section (auto = probe, "

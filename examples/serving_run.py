@@ -14,6 +14,8 @@ Run:  python examples/serving_run.py  (CPU-safe, ~seconds)
 from __future__ import annotations
 
 import asyncio
+import heapq
+import itertools
 import os
 import sys
 import time
@@ -22,8 +24,10 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-MAX_BATCH = 64       # the middle serving bucket
-MAX_WAIT_MS = 2.0    # micro-batch coalescing window
+MAX_BATCH = 64             # the middle serving bucket
+MAX_WAIT_MS = 2.0          # micro-batch coalescing window
+DEFAULT_DEADLINE_MS = 50.0  # per-request latency budget (batching fairness)
+DISPATCH_MARGIN_MS = 5.0   # window slack reserved for the dispatch itself
 N_CLIENTS = 8
 REQUESTS_PER_CLIENT = 40
 
@@ -49,31 +53,58 @@ def fit_models():
 
 
 class MicroBatcher:
-    """Coalesce concurrent requests into bucket-sized registry dispatches."""
+    """Coalesce concurrent requests into bucket-sized registry dispatches.
 
-    def __init__(self, registry, name: str):
+    Batching fairness (ROADMAP item 1 follow-up): the original FIFO
+    coalescer let a large burst occupy every consecutive dispatch, so a
+    single-row request arriving just behind it waited ``burst/MAX_BATCH``
+    full dispatches — starved of its latency budget by other tenants'
+    traffic. Every request now carries a DEADLINE and the batcher serves
+    strictly in earliest-deadline order (a heap, not a FIFO): a
+    tight-deadline request jumps a loose burst's backlog and rides the
+    very next dispatch. The coalescing window also closes early when the
+    head request's deadline (minus a dispatch margin) would otherwise be
+    blown, and ``deadline_misses`` counts requests whose reply landed
+    past their budget — the SLO signal a front-end would alert on.
+    """
+
+    def __init__(self, registry, name: str, *, max_batch: int = MAX_BATCH,
+                 max_wait_ms: float = MAX_WAIT_MS):
         self.registry = registry
         self.name = name
-        self.queue: asyncio.Queue = asyncio.Queue()
+        self.max_batch = max_batch
+        self.max_wait_ms = max_wait_ms
+        self._heap: list = []  # (deadline, seq, row, future)
+        self._seq = itertools.count()
+        self._arrived = asyncio.Event()
         self.batch_sizes: list[int] = []
+        self.deadline_misses = 0
 
     async def serve_forever(self):
         while True:
-            rows, futures = [await self.queue.get()], None
-            deadline = time.perf_counter() + MAX_WAIT_MS / 1e3
-            while len(rows) < MAX_BATCH:
-                timeout = deadline - time.perf_counter()
+            while not self._heap:
+                self._arrived.clear()
+                await self._arrived.wait()
+            # Coalesce up to max_batch, but never hold the HEAD (earliest
+            # deadline) past its budget minus the dispatch margin.
+            window_end = min(
+                time.perf_counter() + self.max_wait_ms / 1e3,
+                self._heap[0][0] - DISPATCH_MARGIN_MS / 1e3,
+            )
+            while len(self._heap) < self.max_batch:
+                timeout = window_end - time.perf_counter()
                 if timeout <= 0:
                     break
+                self._arrived.clear()
                 try:
-                    rows.append(
-                        await asyncio.wait_for(self.queue.get(), timeout)
-                    )
+                    await asyncio.wait_for(self._arrived.wait(), timeout)
                 except asyncio.TimeoutError:
                     break
-            batch = np.stack([r for r, _ in rows])
-            futures = [f for _, f in rows]
-            self.batch_sizes.append(len(rows))
+            take = min(self.max_batch, len(self._heap))
+            items = [heapq.heappop(self._heap) for _ in range(take)]
+            batch = np.stack([row for _, _, row, _ in items])
+            futures = [f for _, _, _, f in items]
+            self.batch_sizes.append(take)
             # One bucket-shaped dispatch for the coalesced batch; the
             # executor keeps the event loop responsive while it runs.
             # A dispatch failure must land on the waiting futures — an
@@ -88,13 +119,25 @@ class MicroBatcher:
                     if not fut.done():
                         fut.set_exception(exc)
                 continue
-            for fut, p in zip(futures, preds):
+            done_t = time.perf_counter()
+            for (deadline, _, _, fut), p in zip(items, preds):
+                if done_t > deadline:
+                    self.deadline_misses += 1
                 if not fut.done():  # a client may have been cancelled
                     fut.set_result(p)
 
-    async def request(self, row) -> object:
+    async def request(self, row, *,
+                      deadline_ms: float = DEFAULT_DEADLINE_MS) -> object:
+        """Submit one row; served within ``deadline_ms`` when capacity
+        allows (earliest-deadline-first — a tighter budget means earlier
+        service relative to looser concurrent traffic)."""
         fut = asyncio.get_running_loop().create_future()
-        await self.queue.put((row, fut))
+        heapq.heappush(
+            self._heap,
+            (time.perf_counter() + deadline_ms / 1e3, next(self._seq),
+             row, fut),
+        )
+        self._arrived.set()
         return await fut
 
 
@@ -192,7 +235,9 @@ async def main():
         f"({n / wall:.0f} req/s) | "
         f"p50 {lat_ms[n // 2]:.2f}ms  p99 {lat_ms[int(n * 0.99)]:.2f}ms | "
         f"mean batch {np.mean(batcher.batch_sizes):.1f} rows "
-        f"(max {max(batcher.batch_sizes)})"
+        f"(max {max(batcher.batch_sizes)}) | "
+        f"{batcher.deadline_misses} past the {DEFAULT_DEADLINE_MS:.0f}ms "
+        "budget"
     )
     print("registry:", registry.models())
 

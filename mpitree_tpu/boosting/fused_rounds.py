@@ -79,7 +79,8 @@ def resolve_rounds_per_dispatch(param, *, platform: str, loss_kind,
                                 colsample: float, max_depth,
                                 max_leaf_nodes, n_samples=None,
                                 n_features=None, n_bins=None,
-                                hist_budget_bytes=None) -> tuple:
+                                hist_budget_bytes=None,
+                                feature_shards: int = 1) -> tuple:
     """Resolve the estimator's ``rounds_per_dispatch`` into (K, reason).
 
     Follows the engine-resolution idiom: the env var steers the "auto"
@@ -134,6 +135,17 @@ def resolve_rounds_per_dispatch(param, *, platform: str, loss_kind,
         blockers.append(
             "unbounded trees: the in-program leaf pool needs a static "
             "budget (set max_depth or max_leaf_nodes)"
+        )
+    if int(feature_shards) > 1:
+        # The in-program leaf-wise build sweeps feature-complete pair
+        # histograms — no select_global twin in the expansion loop, so a
+        # (data, feature) mesh would silently reshard the slabs back to
+        # feature-complete and waste the feature axis (same refusal as
+        # max_leaf_nodes, resolved here instead of mis-attributed).
+        blockers.append(
+            "(data, feature) mesh: the fused-rounds leaf pool has no "
+            "feature-axis winner merge (mesh2d_unsupported) — use a 1-D "
+            "data mesh or rounds_per_dispatch=1"
         )
     flag = "auto" if param in (None, "auto") else param
     from_env = False

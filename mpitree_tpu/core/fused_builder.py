@@ -59,7 +59,12 @@ from mpitree_tpu.ops import pallas_hist
 from mpitree_tpu.ops import wide_hist
 from mpitree_tpu.ops import sampling as sampling_ops
 from mpitree_tpu.parallel import mesh as mesh_lib
-from mpitree_tpu.parallel.collective import node_counts_local, regression_y_range
+from mpitree_tpu.parallel import partition
+from mpitree_tpu.parallel.collective import (
+    node_counts_local,
+    regression_y_range,
+    select_global,
+)
 from mpitree_tpu.parallel.mesh import DATA_AXIS, TREE_AXIS
 from mpitree_tpu.utils import importances as imp_utils
 from mpitree_tpu.utils.profiling import PhaseTimer
@@ -242,45 +247,6 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                 else pallas_hist.moment_payload(y, w)
             )
 
-        def select_global(dec):
-            """Merge per-feature-shard winners into the global decision."""
-            if feature_axis is None:
-                return dec
-            j = lax.axis_index(feature_axis)
-            f_global = (dec.feature + j * F).astype(jnp.int32)
-            # One stacked gather instead of four: the loop body is
-            # latency-bound on tiny (df, K) payloads. n_left rides along so
-            # the sibling-subtraction smaller-child pick sees the GLOBAL
-            # winner's left weight, not the local shard's.
-            packed = jnp.stack(
-                [dec.cost, f_global.astype(jnp.float32),
-                 dec.bin.astype(jnp.float32),
-                 dec.n_left if dec.n_left is not None
-                 else jnp.zeros_like(dec.cost)]
-            )  # (4, K)
-            gathered = lax.all_gather(packed, feature_axis)  # (df, 4, K)
-            costs = gathered[:, 0, :]
-            # First-min over shards = lowest shard on cost ties = lowest
-            # global feature (feature blocks are contiguous per shard) —
-            # the reference's np.argmax tie-break (decision_tree.py:140).
-            best = jnp.argmin(costs, axis=0)
-
-            def take(c):
-                return jnp.take_along_axis(
-                    gathered[:, c, :], best[None, :], axis=0
-                )[0]
-
-            nonconst = lax.psum(
-                1.0 - dec.constant.astype(jnp.float32), feature_axis
-            )
-            return dec._replace(
-                feature=take(1).astype(jnp.int32),
-                bin=take(2).astype(jnp.int32),
-                cost=take(0),
-                constant=nonconst == 0,
-                n_left=take(3),
-            )
-
         def node_subsets(chunk_lo, n_stat_slots, key_a):
             """Per-node feature masks + candidate draws for a frontier window."""
             if not sampling:
@@ -393,13 +359,13 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
                         n_stat_slots, F, n_bins
                     ),
                     **mono,
-                ))
+                ), feature_axis, F)
                 pure = (dec.counts > 0).sum(axis=1) <= 1
             else:
                 dec = select_global(imp_ops.best_split_regression(
                     h, cand_mask, min_child_weight=mcw, node_mask=nmask,
                     forced_draw=draws, **mono,
-                ))
+                ), feature_axis, F)
                 ymin, ymax = regression_y_range(
                     y, nid, w, chunk_lo, n_slots=n_stat_slots, axis=psum_axis
                 )
@@ -652,28 +618,20 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
             node = jnp.clip(nid, 0, M - 1)
             f = feat_a[node]
             active = (nid >= flo) & (nid < flo + fsz) & (f >= 0)
+            # Only the feature shard owning each node's split feature can
+            # read that column; it computes the child id and a psum over
+            # the feature axis delivers it to every shard (each active
+            # row has exactly one owner, others contribute zero) —
+            # hist_ops.slab_local_features, the shared slab plumbing.
+            local, owner = hist_ops.slab_local_features(f, feature_axis, F)
+            xf = jnp.take_along_axis(xb, local[:, None], axis=1)[:, 0]
+            go_left = xf <= bin_a[node]
+            child = jnp.where(go_left, left_a[node], left_a[node] + 1)
             if feature_axis is None:
-                xf = jnp.take_along_axis(
-                    xb, jnp.maximum(f, 0)[:, None], axis=1
-                )[:, 0]
-                go_left = xf <= bin_a[node]
-                child = jnp.where(go_left, left_a[node], left_a[node] + 1)
                 nid = jnp.where(active, child, nid)
             else:
-                # Only the feature shard owning each node's split feature can
-                # read that column; it computes the child id and a psum over
-                # the feature axis delivers it to every shard (each active
-                # row has exactly one owner, others contribute zero).
-                j = lax.axis_index(feature_axis)
-                local = f - j * F
-                owner = active & (local >= 0) & (local < F)
-                xf = jnp.take_along_axis(
-                    xb, jnp.clip(local, 0, F - 1)[:, None], axis=1
-                )[:, 0]
-                go_left = xf <= bin_a[node]
-                child = jnp.where(go_left, left_a[node], left_a[node] + 1)
                 child_all = lax.psum(
-                    jnp.where(owner, child, 0), feature_axis
+                    jnp.where(active & owner, child, 0), feature_axis
                 )
                 nid = jnp.where(active, child_all, nid)
 
@@ -767,15 +725,19 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         random_split=random_split, monotonic=monotonic,
         subtraction=subtraction,
     )
-    FA = feature_axis  # None on a 1-D mesh -> replicated feature dim
     out_specs = (P(), P(), P(), P(), P(), P(), P(DATA_AXIS), P())
     sharded = jax.shard_map(
         build,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, FA), P(DATA_AXIS), P(DATA_AXIS),
-                  P(DATA_AXIS), P(FA, None), P(), P(), P(), P()),
+        # Operand specs from the ONE partition-rule table
+        # (parallel/partition.py) — trimmed to 1-D meshes automatically.
+        in_specs=partition.in_specs_for(
+            mesh, ("x_binned", "y", "node_id", "weight", "cand_mask",
+                   ("mcw", 0), ("mid", 0), ("root_key", 0),
+                   "mono_cst"),
+        ),
         out_specs=out_specs,
-        check_vma=FA is None,  # replicated/varying mixes in the 2-D cond
+        check_vma=feature_axis is None,  # replicated/varying mixes in the 2-D cond
     )
     # Donate the row-assignment input (arg 2, nid0): it is freshly sharded
     # per build (shard_build_inputs) and the program returns nid with the
@@ -925,7 +887,12 @@ def build_tree_fused(
         else np.zeros(F, np.int32)
     )
 
-    K = _chunk_size(N, F, B, C, cfg)
+    # Chunk width binds per DEVICE: on a (data, feature) mesh each shard
+    # holds only its padded feature slab, so a budget-bound chunk can be
+    # df times wider than the feature-complete formula allows (the same
+    # slab sizing as the levelwise engine).
+    df = mesh_lib.feature_shards(mesh)
+    K = _chunk_size(N, (F + ((-F) % df)) // df, B, C, cfg)
     M = _node_capacity(N, cfg.max_depth)
     int_ok = integer_weights(sample_weight)
     use_pallas = resolve_hist_kernel(
@@ -1016,7 +983,8 @@ def build_tree_fused(
     rows, coll, counters = obs_acct.fused_scan_rows(
         tree, n_slots=K, tiers=eff_tiers, n_features=F, n_bins=B,
         n_channels=C, counts_channels=C, max_depth=md, task=task,
-        feature_shards=mesh_lib.feature_shards(mesh), n_rows=N,
+        feature_shards=mesh_lib.feature_shards(mesh),
+        data_shards=mesh_lib.data_shards(mesh), n_rows=N,
         subtraction=use_sub,
     )
     for name, v in counters.items():

@@ -468,8 +468,30 @@ def build_tree_leafwise(
             "max_leaf_nodes does not support monotonic_cst yet"
         )
     if mesh_lib.feature_shards(mesh) > 1:
+        # The best-first frontier has no feature-axis winner merge yet:
+        # its pair kernel sweeps feature-complete histograms, so running
+        # it on a (data, feature) mesh would silently evaluate only one
+        # shard's slab — refuse LOUDLY, with the typed event + recorded
+        # decision so fit_report_ postmortems see why (the expansion-step
+        # select_global twin is the ROADMAP follow-up).
+        timer.decision(
+            "leafwise_mesh", "refused",
+            reason=(
+                "(data, feature) mesh: the leaf-wise pair kernel has no "
+                "feature-axis select_global twin yet — use a 1-D data "
+                "mesh or drop max_leaf_nodes"
+            ),
+            feature_shards=int(mesh_lib.feature_shards(mesh)),
+        )
+        timer.event(
+            "mesh2d_unsupported",
+            "max_leaf_nodes supports 1-D data meshes only (no feature-"
+            "axis winner merge in the expansion loop)",
+        )
         raise ValueError(
-            "max_leaf_nodes supports 1-D data meshes only"
+            "max_leaf_nodes supports 1-D data meshes only "
+            "(mesh2d_unsupported: the best-first frontier has no "
+            "feature-axis select_global twin)"
         )
     if cfg.hist_kernel == "pallas":
         raise ValueError(

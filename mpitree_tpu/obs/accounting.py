@@ -20,6 +20,7 @@ import numpy as np
 
 from mpitree_tpu.parallel.collective import (
     counts_psum_bytes,
+    select_global_bytes,
     split_psum_bytes,
 )
 
@@ -68,6 +69,7 @@ def fused_level_rows(
     max_depth: int,
     task: str,
     feature_shards: int = 1,
+    data_shards: int = 1,
     n_rows: int | None = None,
     subtraction: bool = False,
     node_samples: np.ndarray | None = None,
@@ -99,6 +101,13 @@ def fused_level_rows(
     (depth histogram alone carries no row counts — the pre-ISSUE-8
     contract, still pinned by the golden replay test).
     """
+    # On a 2-D (data, feature) mesh the psum'd histogram is each shard's
+    # PADDED feature slab — the logical payload divides by the feature-
+    # axis width, which is the whole point of the sharding (per-level ICI
+    # payload independent of F). Mirrors the levelwise engine's live
+    # accounting (builder.build_tree's f_shard).
+    fs = max(int(feature_shards), 1)
+    f_slab = (n_features + ((-n_features) % fs)) // fs
     depths_a = np.asarray(node_depths, np.int64)
     frontiers = np.bincount(depths_a)
     wlev = minlev = None
@@ -153,7 +162,7 @@ def fused_level_rows(
             sub_here = subtraction and chunks == 1 and prev_one_chunk
             per_chunk = split_psum_bytes(
                 n_slots=S // 2 if sub_here else S,
-                n_features=n_features, n_bins=n_bins,
+                n_features=f_slab, n_bins=n_bins,
                 n_channels=n_channels,
             )
             hist_bytes = chunks * per_chunk
@@ -164,12 +173,16 @@ def fused_level_rows(
                 add("y_range_pminmax", chunks, yb)
                 psum_bytes += yb
             if feature_shards > 1:
-                # select_global's stacked (4, S) f32 all_gather per chunk,
-                # plus the per-level row-routing psum of child ids.
-                gb = chunks * 4 * S * 4
+                # select_global's stacked winner gather per chunk, plus
+                # the per-level row-routing psum of child ids — per-RING
+                # payloads (each feature ring reduces one data-shard's
+                # local row block; wire_estimate scales by the concurrent
+                # group count), matching the levelwise live accounting.
+                gb = chunks * select_global_bytes(n_slots=S)
                 add("feature_merge_all_gather", chunks, gb)
                 if n_rows is not None:
-                    add("route_psum", 1, n_rows * 4)
+                    add("route_psum", 1,
+                        -(-n_rows // max(int(data_shards), 1)) * 4)
             if wlev is not None:
                 fw = float(wlev[d])
                 scanned = float(minlev[d]) if sub_here and d > 0 else fw

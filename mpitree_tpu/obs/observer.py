@@ -515,10 +515,14 @@ class BuildObserver(PhaseTimer):
                     "n_nodes": sum(t["n_nodes"] for t in rec.trees),
                     "depth": max(t["depth"] for t in rec.trees),
                 }
-        # The collective ledger (v4): wire-traffic estimates derived from
-        # the logical payloads and the mesh width — free host arithmetic.
+        # The collective ledger (v4/v5): wire-traffic estimates derived
+        # from the logical payloads and the PER-AXIS mesh widths — free
+        # host arithmetic. Axis widths attribute each site's ring to the
+        # axis it actually crosses (data psums vs the feature-axis winner
+        # merge); records without axes fall back to the flat device count.
         rec.wire = wire_estimate(
-            rec.collectives, rec.mesh.get("n_devices")
+            rec.collectives,
+            rec.mesh.get("axes") or rec.mesh.get("n_devices"),
         )
         out = rec.to_dict()
         if self._trace is not None:

@@ -41,7 +41,23 @@ import json
 # digest gained ``wire_bytes``/``wire_shard_bytes``; ``compile`` entries
 # gained ``seconds`` (cold-dispatch wall attributed per entry point —
 # ROADMAP obs follow-up 1).
-SCHEMA_VERSION = 4
+# v5 (ISSUE 10, 2-D (data, feature) meshes): ``wire`` attributes traffic
+# per MESH AXIS — each site entry carries its ``axis`` and widths come
+# from ``record.mesh['axes']`` instead of the flat device count (a psum
+# over a 4-wide data axis on a (4, 2) mesh rings over 4 shards, not 8);
+# top-level ``wire`` gained ``axes``/``data_bytes``/``feature_bytes``
+# and the digest a ``feature_shards`` field.
+SCHEMA_VERSION = 5
+
+# Which mesh axis each collective site reduces/gathers over — the wire
+# ledger's per-axis attribution. Every histogram/counts/y-range reduction
+# rides the data axis; the split-winner merge (collective.select_global)
+# and the update step's owner-broadcast of child ids are the only
+# feature-axis collectives. Unknown sites default to "data".
+COLLECTIVE_AXES = {
+    "feature_merge_all_gather": "feature",
+    "route_psum": "feature",
+}
 
 # The golden field set: tests/test_obs.py pins this against to_dict() so a
 # rename cannot slip past bench/watcher consumers silently.
@@ -133,10 +149,14 @@ class BuildRecord:
       instead of dropped; ``{}`` otherwise.
     - ``wire``: the collective ledger (:func:`wire_estimate`) — per-site
       and total wire-traffic estimates derived from the LOGICAL psum
-      payloads above and the mesh width: a ring all-reduce of B logical
-      bytes over n shards moves ``B*(n-1)/n`` per shard, ``B*(n-1)``
-      across the fabric. Zero on a single device (no ICI hop exists).
-      Populated by ``BuildObserver.report()``.
+      payloads above and the PER-AXIS mesh widths: a ring all-reduce of
+      B logical bytes over an n-shard axis moves ``B*(n-1)/n`` per
+      shard, ``B*(n-1)`` per concurrent ring across the fabric. Each
+      site entry carries the ``axis`` it crosses
+      (:data:`COLLECTIVE_AXES`) and the top level breaks fabric bytes
+      down as ``data_bytes``/``feature_bytes`` (v5). Zero on a single
+      device (no ICI hop exists). Populated by
+      ``BuildObserver.report()``.
     """
 
     schema: int = SCHEMA_VERSION
@@ -169,7 +189,7 @@ class BuildRecord:
         return cls(**{k: v for k, v in data.items() if k in known})
 
 
-def wire_estimate(collectives: dict, n_devices) -> dict:
+def wire_estimate(collectives: dict, axes) -> dict:
     """The collective ledger: wire-traffic estimates per psum/gather site.
 
     ``collectives`` holds LOGICAL payloads (static-shape bytes per call
@@ -177,24 +197,57 @@ def wire_estimate(collectives: dict, n_devices) -> dict:
     moves ``B*(n-1)/n`` per shard and ``B*(n-1)`` across the fabric —
     the per-shard/per-fit ICI wire estimates the ROADMAP obs follow-up
     asked for. One device means no ICI hop: everything is zero, honestly.
+
+    ``axes``: the mesh's axis widths (``record.mesh['axes']``, e.g.
+    ``{"data": 4, "feature": 2}``) — each site's ring width is the width
+    of ITS axis (:data:`COLLECTIVE_AXES`), not the flat device count: a
+    data-axis psum on a (4, 2) mesh runs df=2 independent 4-shard rings,
+    and the recorded logical payload is already per feature group. A
+    plain int (legacy callers) means a 1-D data axis of that width. An
+    axis the mesh does not carry has width 1 — zero wire. The per-axis
+    breakdown (``data_bytes``/``feature_bytes``) sums fabric wire bytes
+    by the axis they cross.
     """
-    n = int(n_devices or 1)
+    if not isinstance(axes, dict):
+        axes = {"data": int(axes or 1)}
+    axes = {str(k): int(v) for k, v in axes.items()}
+    n = 1
+    for v in axes.values():
+        n *= max(v, 1)
     sites = {}
     total_logical = 0
+    total_wire = 0
+    total_shard = 0
+    per_axis = {"data": 0, "feature": 0}
     for site, v in sorted(collectives.items()):
         b = int(v.get("bytes", 0))
+        axis = COLLECTIVE_AXES.get(site, "data")
+        w = max(int(axes.get(axis, 1)), 1)
+        # The fabric total counts every concurrent ring: a data-axis
+        # reduction on a (dr, df) mesh runs df independent dr-shard rings
+        # (one per feature group), each moving the recorded per-group
+        # payload; each SHARD still sits in exactly one ring.
+        groups = max(n // w, 1)
+        wire = b * (w - 1) * groups
         total_logical += b
+        total_wire += wire
+        total_shard += b * (w - 1) // w
+        per_axis[axis] = per_axis.get(axis, 0) + wire
         sites[site] = {
             "bytes": b,
-            "wire_bytes": b * (n - 1),
-            "wire_bytes_per_shard": b * (n - 1) // n,
+            "axis": axis,
+            "wire_bytes": wire,
+            "wire_bytes_per_shard": b * (w - 1) // w,
         }
     return {
         "n_shards": n,
+        "axes": axes,
         "sites": sites,
         "bytes": total_logical,
-        "wire_bytes": total_logical * (n - 1),
-        "wire_bytes_per_shard": total_logical * (n - 1) // n,
+        "wire_bytes": total_wire,
+        "wire_bytes_per_shard": total_shard,
+        "data_bytes": per_axis["data"],
+        "feature_bytes": per_axis["feature"],
     }
 
 
@@ -252,6 +305,12 @@ def digest(report: dict) -> dict:
         "wire_shard_bytes": report.get("wire", {}).get(
             "wire_bytes_per_shard"
         ),
+        # Feature-axis width of the build mesh (v5): 1 on every 1-D data
+        # mesh — a >1 value says histograms were feature-sharded and
+        # psum_bytes is per-slab, not per-F.
+        "feature_shards": (
+            report.get("mesh", {}).get("axes", {}) or {}
+        ).get("feature", 1),
         "wall_s": round(wall, 3),
     }
 

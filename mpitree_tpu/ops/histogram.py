@@ -33,12 +33,24 @@ EXACT whenever the channel sums are: integer-valued f32 counts below
 2**24, and the scoped-f64 (g, h) accumulation path. The remap composes
 with every kernel tier (scatter, ``pallas_hist``, ``wide_hist``) because
 they all address rows purely by slot.
+
+On a 2-D ``(data, feature)`` mesh every kernel here operates on a
+feature SLAB: ``x_binned`` arrives as the shard's ``(N_local, F/df)``
+column block, so the accumulated histogram is the matching
+``(n_slots, F/df, C, B)`` slab and the cross-device ``psum`` payload is
+independent of the global feature count. Slot addressing, masking, and
+sibling subtraction are all feature-elementwise, so the slab needs no
+special casing — the only slab-aware operations are global-feature
+re-basing (``parallel.collective.select_global`` merges per-slab
+winners) and :func:`slab_local_features`, which routes a GLOBAL winning
+feature id back to the one shard owning its column.
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 def class_histogram(
@@ -171,6 +183,33 @@ def sibling_reconstruct_pair(
     parent = jnp.broadcast_to(parent_hist, shape)
     mask = is_small.reshape((2,) + (1,) * (small_hist.ndim - 1))
     return jnp.where(mask, small, parent - small)
+
+
+def slab_local_features(
+    feature_global: jax.Array,
+    feature_axis: str | None,
+    n_local: int,
+):
+    """Route global feature ids onto a feature-sharded slab.
+
+    ``feature_global`` holds GLOBAL winning feature ids (what
+    ``select_global`` returns); on a feature mesh each shard owns the
+    contiguous column block ``[j * n_local, (j + 1) * n_local)``.
+    Returns ``(local, owner)``: the clamped slab-local column to gather
+    (safe to read even off-owner — the ``owner`` mask gates the result)
+    and the per-element ownership mask. The canonical consumer pattern
+    is gather-then-``psum(where(owner, v, 0), feature_axis)`` — the
+    owner-broadcast both engines' row reroute uses. On a 1-D mesh
+    (``feature_axis=None``) features are device-complete: ``local`` is
+    the id itself (clamped non-negative — leaf sentinels stay readable)
+    and ``owner`` is ``None`` (everyone owns everything).
+    """
+    if feature_axis is None:
+        return jnp.maximum(feature_global, 0), None
+    j = lax.axis_index(feature_axis)
+    local = feature_global - j * n_local
+    owner = (local >= 0) & (local < n_local)
+    return jnp.minimum(jnp.maximum(local, 0), n_local - 1), owner
 
 
 def _flat_ids(x_binned: jax.Array, valid: jax.Array, slot: jax.Array,

@@ -31,7 +31,8 @@ from jax.sharding import PartitionSpec as P
 
 from mpitree_tpu.ops import histogram as hist_ops
 from mpitree_tpu.ops import impurity as imp_ops
-from mpitree_tpu.parallel.mesh import DATA_AXIS
+from mpitree_tpu.parallel import partition
+from mpitree_tpu.parallel.mesh import DATA_AXIS, FEATURE_AXIS, feature_shards
 from mpitree_tpu.resilience import chaos
 from mpitree_tpu.utils import profiling
 
@@ -129,6 +130,69 @@ def regression_y_range(y, nid, w, chunk_lo, *, n_slots, axis=DATA_AXIS):
     return lax.pmin(ymin, axis), lax.pmax(ymax, axis)
 
 
+def select_global(dec, feature_axis, f_local: int):
+    """Merge per-feature-shard split winners into the global decision.
+
+    THE one cross-(feature)-axis hop per level, shared verbatim by the
+    fused while_loop body (``core/fused_builder``) and the levelwise
+    ``make_split_fn`` program so the two engines cannot drift: each shard
+    sweeps only its own (K, F/df, C, B) histogram slab, and this merges
+    the per-shard winners with a tiny stacked all_gather + first-min.
+    ``feature_axis=None`` (1-D mesh) is the identity. ``f_local`` is the
+    per-shard feature-block width — contiguous blocks, so local winner
+    ``f`` on shard ``j`` is global feature ``f + j * f_local``.
+
+    Node-level statistics (``counts``/``n``/``impurity``/``y_range``)
+    stay local: every row contributes to every feature column, so each
+    shard's slab already carries the full node totals — only the
+    candidate-dependent fields cross the axis.
+    """
+    if feature_axis is None:
+        return dec
+    j = lax.axis_index(feature_axis)
+    f_global = (dec.feature + j * f_local).astype(jnp.int32)
+    # One stacked gather instead of four: the level step is latency-bound
+    # on tiny (df, K) payloads. n_left rides along so the
+    # sibling-subtraction smaller-child pick sees the GLOBAL winner's
+    # left weight, not the local shard's.
+    packed = jnp.stack(
+        [dec.cost, f_global.astype(jnp.float32),
+         dec.bin.astype(jnp.float32),
+         dec.n_left if dec.n_left is not None
+         else jnp.zeros_like(dec.cost)]
+    )  # (4, K)
+    gathered = lax.all_gather(packed, feature_axis)  # (df, 4, K)
+    costs = gathered[:, 0, :]
+    # First-min over shards = lowest shard on cost ties = lowest global
+    # feature (feature blocks are contiguous per shard) — the reference's
+    # np.argmax tie-break (decision_tree.py:140).
+    best = jnp.argmin(costs, axis=0)
+
+    def take(c):
+        return jnp.take_along_axis(
+            gathered[:, c, :], best[None, :], axis=0
+        )[0]
+
+    nonconst = lax.psum(
+        1.0 - dec.constant.astype(jnp.float32), feature_axis
+    )
+    return dec._replace(
+        feature=take(1).astype(jnp.int32),
+        bin=take(2).astype(jnp.int32),
+        cost=take(0),
+        constant=nonconst == 0,
+        n_left=take(3),
+    )
+
+
+def select_global_bytes(*, n_slots: int) -> int:
+    """Logical payload of one :func:`select_global` stacked all_gather
+    (bytes): the (4, K) f32 winner pack each feature shard contributes.
+    Static shapes, same accounting contract as :func:`split_psum_bytes`.
+    """
+    return 4 * n_slots * 4
+
+
 def _pack_decision(dec) -> jax.Array:
     """SplitDecision -> one (K, 10 + C) float32 buffer.
 
@@ -216,12 +280,24 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     masks / random splits / monotonic constraints are not supported for
     gbdt.
 
+    On a 2-D ``(data, feature)`` mesh (ISSUE 10) the program
+    feature-shards itself from the partition-rule table: each shard
+    accumulates and psums only its ``(n_slots, F/df, C, B)`` slab over
+    the data axis — per-level ICI payload independent of F — then the
+    per-shard winners merge through :func:`select_global`, the one
+    cross-axis hop (node-level stats are already complete per slab).
+    Works for every task including the gbdt scoped-f64 path; per-node
+    masks / random splits / monotonic constraints refuse (their host
+    tables are feature-indexed and would straddle shards).
+
     ``subtraction=True`` (sibling-subtraction frontier,
     ``ops/histogram.sibling_accumulate_slots``): three trailing operands —
     the RESIDENT globally-reduced parent histogram of the previous level
-    ((S_parent, F, C, B); f64 on the gbdt scoped-x64 path), a (n_slots,)
-    int32 slot -> parent-slot map, and a (n_slots,) bool smaller-sibling
-    mask. Only rows of small children accumulate, into a COMPACT
+    ((S_parent, F, C, B); f64 on the gbdt scoped-x64 path — on a feature
+    mesh it stays a per-shard slab end to end: kept sharded in the
+    output, fed back sharded, reconstructed feature-elementwise), a
+    (n_slots,) int32 slot -> parent-slot map, and a (n_slots,) bool
+    smaller-sibling mask. Only rows of small children accumulate, into a COMPACT
     ``n_slots // 2`` buffer, so the histogram psum payload halves; the
     large siblings are reconstructed from the parent after the reduction.
     Callers gate ``use_pallas``/``use_wide`` at the halved accumulate
@@ -234,6 +310,22 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
             "task='gbdt' does not support per-node feature masks, random "
             "splits, or monotonic constraints"
         )
+    # 2-D (data, feature) mesh: each shard accumulates and psums only its
+    # own feature slab; the winner merge (select_global) is the one
+    # cross-axis hop. Per-node masks/draws and monotonic bounds are
+    # feature-indexed host tables that would straddle shards — the
+    # builder refuses those configs on a feature mesh before reaching
+    # here (builder.build_tree).
+    feature_axis = FEATURE_AXIS if feature_shards(mesh) > 1 else None
+    if feature_axis is not None and (node_mask or random_split or monotonic):
+        raise ValueError(
+            "per-node feature masks / random splits / monotonic "
+            "constraints are not supported on a (data, feature) mesh"
+        )
+    hist_vma = (DATA_AXIS,) + (
+        (FEATURE_AXIS,) if feature_axis is not None else ()
+    )
+    repl_axes = hist_vma
     n_acc = n_slots // 2 if subtraction else n_slots
 
     def local_step(xb, y, nid, w, cand_mask, chunk_lo, mcw, *nm):
@@ -270,7 +362,7 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                 h = ph.histogram_small(
                     xb, ph.class_payload(y, w, n_classes), acc_nid - acc_lo,
                     n_slots=n_acc, n_bins=n_bins, n_channels=n_classes,
-                    vma=(DATA_AXIS,),
+                    vma=hist_vma,
                 )
             elif use_wide:
                 from mpitree_tpu.ops import pallas_hist as ph
@@ -281,7 +373,7 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                 h = wide_fn(
                     xb, ph.class_payload(y, w, n_classes), acc_nid - acc_lo,
                     n_slots=n_acc, n_bins=n_bins, n_channels=n_classes,
-                    bf16_ok=wide_bf16, vma=(DATA_AXIS,),
+                    bf16_ok=wide_bf16, vma=hist_vma,
                 )
             else:
                 h = hist_ops.class_histogram(
@@ -291,11 +383,11 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                 )
             h = reconstruct(lax.psum(h, DATA_AXIS))
             hist_keep = h
-            dec = imp_ops.best_split_classification(
+            dec = select_global(imp_ops.best_split_classification(
                 h, cand_mask, criterion=criterion, node_mask=nmask,
                 min_child_weight=mcw, forced_draw=draws,
                 exact_ties=exact_ties, **mono,
-            )
+            ), feature_axis, xb.shape[1])
         elif task == "gbdt":
             lam, msl = nm[0], nm[1]
             if gbdt_x64:
@@ -317,7 +409,7 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                         h = ph.histogram_small(
                             xb, payload, acc_nid - acc_lo,
                             n_slots=n_acc, n_bins=n_bins, n_channels=3,
-                            vma=(DATA_AXIS,),
+                            vma=hist_vma,
                         )
                     else:
                         from mpitree_tpu.ops import wide_hist
@@ -329,7 +421,7 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                         h = wide_fn(
                             xb, payload, acc_nid - acc_lo,
                             n_slots=n_acc, n_bins=n_bins, n_channels=3,
-                            bf16_ok=False, vma=(DATA_AXIS,),
+                            bf16_ok=False, vma=hist_vma,
                         )
                 else:
                     h = hist_ops.grad_hess_histogram(
@@ -338,10 +430,10 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                     )
                 h = reconstruct(lax.psum(h, DATA_AXIS))
                 hist_keep = h
-            dec = imp_ops.best_split_newton(
+            dec = select_global(imp_ops.best_split_newton(
                 h, cand_mask, reg_lambda=lam,
                 min_child_weight=mcw, min_samples_leaf=msl,
-            )
+            ), feature_axis, xb.shape[1])
         else:
             if use_pallas:
                 from mpitree_tpu.ops import pallas_hist as ph
@@ -349,7 +441,7 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                 h = ph.histogram_small(
                     xb, ph.moment_payload(y, w), acc_nid - acc_lo,
                     n_slots=n_acc, n_bins=n_bins, n_channels=3,
-                    vma=(DATA_AXIS,),
+                    vma=hist_vma,
                 )
             elif use_wide:
                 from mpitree_tpu.ops import pallas_hist as ph
@@ -360,7 +452,7 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                 h = wide_fn(
                     xb, ph.moment_payload(y, w), acc_nid - acc_lo,
                     n_slots=n_acc, n_bins=n_bins, n_channels=3,
-                    bf16_ok=False, vma=(DATA_AXIS,),
+                    bf16_ok=False, vma=hist_vma,
                 )
             else:
                 h = hist_ops.moment_histogram(
@@ -369,10 +461,10 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
                 )
             h = reconstruct(lax.psum(h, DATA_AXIS))
             hist_keep = h
-            dec = imp_ops.best_split_regression(
+            dec = select_global(imp_ops.best_split_regression(
                 h, cand_mask, node_mask=nmask, min_child_weight=mcw,
                 forced_draw=draws, **mono,
-            )
+            ), feature_axis, xb.shape[1])
             # min/max are not linear — the y-range purity signal always
             # scans directly (an O(N) scatter, not the O(N*F) hot path).
             ymin, ymax = regression_y_range(
@@ -385,27 +477,41 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
             out = out + (hist_keep,)
         if debug:
             fp = profiling.replication_fingerprint(dec.feature, dec.bin, dec.n)
-            out = out + (profiling.assert_replicated(fp, DATA_AXIS),)
+            out = out + (profiling.assert_replicated(fp, repl_axes),)
         return out if len(out) > 1 else out[0]
 
-    in_specs = (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
-                P(), P(), P())
+    # Operand specs come from the ONE partition-rule table
+    # (parallel/partition.py): named rules for the sharded operands, the
+    # replicated catch-all for host tables and runtime scalars. On a 1-D
+    # mesh the feature-axis entries trim to None — same tuple as before.
+    names = ["x_binned", "y", "node_id", "weight", "cand_mask",
+             ("chunk_lo", 0), ("mcw", 0)]
     if task == "gbdt":
-        in_specs = in_specs + (P(), P())  # reg_lambda, min_samples_leaf
+        names += [("reg_lambda", 0), ("min_samples_leaf", 0)]
     if node_mask:
-        in_specs = in_specs + (P(),)
+        names += ["node_mask"]
     if random_split:
-        in_specs = in_specs + (P(),)
+        names += ["draws"]
     if monotonic:
-        in_specs = in_specs + (P(), P(), P())
+        names += ["mono_cst", "mono_lo", "mono_hi"]
     if subtraction:
-        in_specs = in_specs + (P(), P(), P())  # parent hist/slot map/small
-    n_out = 1 + int(keep_hist) + int(debug)
+        names += ["parent_hist", "parent_slot", "is_small"]
+    in_specs = partition.in_specs_for(mesh, names)
+    # The kept frontier histogram stays feature-sharded on device: each
+    # shard's slab is all the next level's reconstruction reads, so the
+    # carry never materializes feature-complete.
+    hist_spec = partition.spec_for("hist_keep", mesh)
+    out_specs = (P(),) + ((hist_spec,) if keep_hist else ()) \
+        + ((P(),) if debug else ())
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,
         in_specs=in_specs,
-        out_specs=tuple(P() for _ in range(n_out)) if n_out > 1 else P(),
+        out_specs=out_specs if len(out_specs) > 1 else out_specs[0],
+        # vma tracking flags replicated-vs-varying mixes after the
+        # feature-axis gather that are semantically fine (same stance as
+        # the fused engine on a 2-D mesh).
+        check_vma=feature_axis is None,
     )
     return _chaos_dispatch("split_dispatch", jax.jit(sharded))
 
@@ -552,10 +658,12 @@ def make_expand_fn(mesh, *, n_bins: int, n_classes: int, task: str,
             out = out + (keep,)
         return out
 
-    in_specs = (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
-                P(DATA_AXIS), P(), P(), P(), P(), P(), P(), P(), P(), P())
+    names = ["x_binned", "y", "node_id", "weight", "cand_mask",
+             ("e_node", 0), ("feat", 0), ("bin", 0), ("left_id", 0),
+             ("small_left", 0), ("mcw", 0), ("lam", 0), ("msl", 0)]
     if subtraction:
-        in_specs = in_specs + (P(),)
+        names += ["parent_hist"]
+    in_specs = partition.in_specs_for(mesh, names)
     out_specs = (P(DATA_AXIS), P()) + ((P(),) if subtraction else ())
     sharded = jax.shard_map(
         local_expand,
@@ -592,7 +700,9 @@ def make_counts_fn(mesh, *, n_slots: int, n_classes: int, task: str):
     sharded = jax.shard_map(
         local_counts,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P()),
+        in_specs=partition.in_specs_for(
+            mesh, ("y", "node_id", "weight", ("chunk_lo", 0))
+        ),
         out_specs=P(),
     )
     return _chaos_dispatch("counts_dispatch", jax.jit(sharded))
@@ -607,7 +717,14 @@ def make_update_fn(mesh, *, n_slots: int):
     rows in splitting nodes route by ``x_binned[:, feat] <= bin`` — the
     on-device replacement for the reference's partition copies
     (``decision_tree.py:150-164``).
+
+    On a 2-D ``(data, feature)`` mesh only the shard owning a node's
+    split feature can read that column: it computes the child id and one
+    ``psum`` over the feature axis delivers it to every shard (each
+    active row has exactly one owner, others contribute zero) — the same
+    owner-broadcast the fused engine's reroute uses.
     """
+    feature_axis = FEATURE_AXIS if feature_shards(mesh) > 1 else None
 
     def local_update(nid, xb, chunk_lo, is_split, feat, bin_, left_id, right_id):
         slot = nid - chunk_lo
@@ -615,16 +732,28 @@ def make_update_fn(mesh, *, n_slots: int):
         s = jnp.clip(slot, 0, n_slots - 1)
         active = in_chunk & is_split[s]
         f = feat[s]
-        xf = jnp.take_along_axis(xb, f[:, None], axis=1)[:, 0]
+        local, owner = hist_ops.slab_local_features(
+            f, feature_axis, xb.shape[1]
+        )
+        xf = jnp.take_along_axis(xb, local[:, None], axis=1)[:, 0]
         go_left = xf <= bin_[s]
         nxt = jnp.where(go_left, left_id[s], right_id[s])
-        return jnp.where(active, nxt, nid)
+        if feature_axis is None:
+            return jnp.where(active, nxt, nid)
+        child_all = lax.psum(
+            jnp.where(active & owner, nxt, 0), feature_axis
+        )
+        return jnp.where(active, child_all, nid)
 
     sharded = jax.shard_map(
         local_update,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS, None), P(), P(), P(), P(), P(), P()),
+        in_specs=partition.in_specs_for(
+            mesh, ("node_id", "x_binned", ("chunk_lo", 0), "is_split",
+                   "feat", "bin", "left_id", "right_id")
+        ),
         out_specs=P(DATA_AXIS),
+        check_vma=feature_axis is None,
     )
     # nid donated: the level loop's canonical `nid_d = update_fn(nid_d, ..)`
     # rebind consumes the old buffer each call — GL08 (donation-after-use)

@@ -125,6 +125,63 @@ def resolve_mesh(*, backend: str | None = None, n_devices=None) -> Mesh:
     return _cached_mesh(tuple(range(n)), backend)
 
 
+def data_feature_shape(n_devices: int, n_features: int, *,
+                       hist_bytes: int = 0,
+                       hist_budget: int | None = None) -> tuple:
+    """(data_shards, feature_shards) for the 2-D single-tree build mesh.
+
+    The mirror of :func:`tree_data_shape`'s policy, restated for the
+    ``(data, feature)`` mesh: give the DATA axis the widest divisor of
+    ``n_devices`` (histogram psums ride it, and row sharding is what the
+    level loop scales by), then the histogram-budget guard — while one
+    shard's per-chunk histogram slab (``hist_bytes / feature_shards``)
+    would exceed ``hist_budget`` — trades data-axis width for feature
+    shards, i.e. picks the widest feature divisor needed for the
+    per-shard slab to fit (capped at ``n_features``: a shard with zero
+    real columns does no work). When even the widest usable feature
+    divisor cannot fit the budget, it is used anyway — the guard
+    degrades, it never refuses.
+
+    ``hist_bytes``: the feature-complete per-device histogram cost the
+    caller sizes chunks from (``K * F * C * B * itemsize``, see
+    ``core/builder._chunk_size``); ``hist_budget`` the same
+    ``BuildConfig.hist_budget_bytes`` knob that sizes the live chunk.
+    """
+    d = max(int(n_devices), 1)
+    divisors = [k for k in range(1, d + 1) if d % k == 0]
+    usable = [k for k in divisors if k <= max(int(n_features), 1)]
+    f = 1
+    if hist_budget:
+        while f < max(usable) and hist_bytes > hist_budget * f:
+            f = min(k for k in usable if k > f)
+    return d // f, f
+
+
+def resolve_mesh_2d(*, n_features: int, hist_bytes: int = 0,
+                    hist_budget: int | None = None,
+                    backend: str | None = None, n_devices=None) -> Mesh:
+    """2-D ``(data, feature)`` mesh factory with the shape policy applied.
+
+    ``n_devices`` follows :func:`resolve_mesh`'s grammar for a TOTAL
+    device count (None/int/"all"); the split between the two axes comes
+    from :func:`data_feature_shape`. An explicit ``(dr, df)`` tuple
+    bypasses the policy (same as :func:`resolve_mesh`).
+    """
+    if isinstance(n_devices, tuple):
+        return resolve_mesh(backend=backend, n_devices=n_devices)
+    devs = available_devices(backend)
+    if n_devices in (None, 1):
+        n = 1
+    elif n_devices in ("all", -1):
+        n = len(devs)
+    else:
+        n = int(n_devices)
+    shape = data_feature_shape(
+        n, n_features, hist_bytes=hist_bytes, hist_budget=hist_budget
+    )
+    return resolve_mesh(backend=backend, n_devices=shape)
+
+
 def feature_shards(mesh: Mesh) -> int:
     """Width of the mesh's feature axis (1 on a 1-D data mesh)."""
     return (
@@ -194,6 +251,12 @@ def shard_build_inputs(mesh: Mesh, binned, y, sample_weight):
     shard their feature dimension (padding features have zero candidates —
     inert). Returns the four sharded arrays plus the candidate mask.
     """
+    # Placement rides the partition-rule table (parallel/partition.py):
+    # every named array gets its spec from the one declarative map both
+    # engines also derive their shard_map in_specs from. Lazy import —
+    # partition reads this module's axis constants at load.
+    from mpitree_tpu.parallel import partition
+
     N, F = binned.x_binned.shape
     dr = data_shards(mesh)
     df = feature_shards(mesh)
@@ -212,15 +275,9 @@ def shard_build_inputs(mesh: Mesh, binned, y, sample_weight):
         cand = np.concatenate(
             [cand, np.zeros((fpad, cand.shape[1]), bool)], axis=0
         )
-    y_d, w_d, nid_d = shard_rows(mesh, yy, w, nid)
-    if df == 1:
-        xb_d = shard_rows(mesh, xb)
-        cand_d = replicate(mesh, cand)
-    else:
-        xb_d = jax.device_put(
-            xb, NamedSharding(mesh, P(DATA_AXIS, FEATURE_AXIS))
-        )
-        cand_d = jax.device_put(
-            cand, NamedSharding(mesh, P(FEATURE_AXIS, None))
-        )
-    return xb_d, y_d, w_d, nid_d, cand_d
+    state = partition.shard_build_state(mesh, {
+        "x_binned": xb, "y": yy, "weight": w, "node_id": nid,
+        "cand_mask": cand,
+    })
+    return (state["x_binned"], state["y"], state["weight"],
+            state["node_id"], state["cand_mask"])

@@ -1,0 +1,145 @@
+"""Partition-rule table: ONE declarative map from build-state array names
+to ``PartitionSpec``s over the 2-D ``(data, feature)`` mesh.
+
+Before this module every call site hand-wrote its ``shard_map`` in_specs
+and ``device_put`` shardings, so adding the feature axis meant auditing a
+dozen spec tuples for drift. The idiom here is the regex→spec table from
+large-model training codebases (SNIPPETS.md [2] ``match_partition_rules``,
+[3] ``shard_params``/``get_sharding_tree``): every array that crosses the
+host/device boundary during a build has a NAME, the table maps names to
+specs, and both device engines derive their ``shard_map`` in_specs and
+initial placements from the one table — a new array gets a rule, not a
+per-call-site audit.
+
+Axis semantics (``parallel/mesh.py``):
+
+- ``data`` shards rows: per-row state (``y``, ``weight``, ``node_id``)
+  and the row axis of the binned matrix. Histogram reductions ``psum``
+  over it.
+- ``feature`` shards the histogram's feature dimension (tensor
+  parallelism): the column axis of the binned matrix, the candidate
+  mask's leading axis, and the F axis of every resident histogram slab
+  (the sibling-subtraction carry keeps PER-SHARD slabs — the parent
+  histogram never materializes feature-complete anywhere). The one
+  cross-axis hop per level is the split-winner merge
+  (``collective.select_global``).
+
+On a mesh that lacks an axis (a 1-D data mesh, the single-device mesh)
+the spec entries naming it are trimmed to ``None`` — one table serves
+every mesh shape.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpitree_tpu.parallel.mesh import DATA_AXIS, FEATURE_AXIS
+
+# name-pattern -> PartitionSpec over the (data, feature) mesh. First match
+# wins; the terminal catch-all replicates, because everything else that
+# crosses the boundary is a host-built table, a packed decision buffer, or
+# a runtime scalar — all of which every device must see whole. Scalars
+# (0-d operands like chunk offsets and leaf floors) are forced to P()
+# before the table is consulted, the SNIPPETS [2] rule.
+PARTITION_RULES: tuple = (
+    # The binned matrix: rows x features, both axes sharded.
+    (r"^x_binned$", P(DATA_AXIS, FEATURE_AXIS)),
+    # Per-row state: targets/gradients, weights/hessians, node routing.
+    (r"^(y|weight|sample_weight|node_id|nid\w*)$", P(DATA_AXIS)),
+    # (F, B) candidate mask: feature-major, bins replicated.
+    (r"^cand_masks?$", P(FEATURE_AXIS, None)),
+    # Resident (S, F, C, B) histogram slabs (the sibling-subtraction
+    # carry): slots replicated, features sharded — each shard subtracts
+    # against its own slab, so the carry's HBM cost also divides by the
+    # feature-axis width.
+    (r"^(parent_hist|hist_keep|pair_hist)$", P(None, FEATURE_AXIS, None, None)),
+    # Per-node tables the host builds for the split/update/counts steps:
+    # frontier maps, smaller-sibling masks, split routing, monotonic
+    # bounds, per-node feature masks/draws. Replicated — they are O(K)
+    # and every shard's decision logic reads all of them.
+    (r"^(parent_slot|is_small|is_split|feat|bin|left_id|right_id)$", P()),
+    (r"^(node_mask|draws|mono_(cst|lo|hi))$", P()),
+    # Decision buffers and everything else (runtime scalars ride the
+    # scalar guard before this table is consulted).
+    (r".*", P()),
+)
+
+
+def match_partition_rules(name: str, *, rules=PARTITION_RULES,
+                          ndim: int | None = None) -> P:
+    """Spec for ``name`` from the rule table (SNIPPETS [2] shape).
+
+    ``ndim=0`` (scalars) short-circuits to ``P()`` — don't partition
+    scalar values. A spec longer than ``ndim`` raises: that is a table
+    bug, not a caller problem.
+    """
+    if ndim == 0:
+        return P()
+    for pattern, spec in rules:
+        if re.search(pattern, name) is not None:
+            if ndim is not None and len(spec) > ndim:
+                raise ValueError(
+                    f"partition rule {pattern!r} yields rank-{len(spec)} "
+                    f"spec {spec} for rank-{ndim} array {name!r}"
+                )
+            return spec
+    raise ValueError(f"partition rule not found for array: {name!r}")
+
+
+def trim_spec(spec: P, mesh) -> P:
+    """Drop axis names the mesh does not carry (1-D meshes, host mesh).
+
+    ``P('data', 'feature')`` on a 1-D data mesh becomes ``P('data', None)``
+    — same placement semantics, valid on the narrower mesh — so the one
+    table drives every mesh shape.
+    """
+    names = set(mesh.axis_names)
+    return P(*[a if a in names else None for a in spec])
+
+
+def spec_for(name: str, mesh=None, *, ndim: int | None = None) -> P:
+    """Table spec for ``name``, trimmed to ``mesh``'s axes when given."""
+    spec = match_partition_rules(name, ndim=ndim)
+    return spec if mesh is None else trim_spec(spec, mesh)
+
+
+def in_specs_for(mesh, names) -> tuple:
+    """``shard_map`` in_specs for a named operand list — the one place
+    both engines' spec tuples come from. Names must match the wrapped
+    function's positional order; scalars may pass ``ndim`` via a
+    ``(name, ndim)`` pair (plain names consult the table directly)."""
+    specs = []
+    for n in names:
+        if isinstance(n, tuple):
+            n, nd = n
+            specs.append(spec_for(n, mesh, ndim=nd))
+        else:
+            specs.append(spec_for(n, mesh))
+    return tuple(specs)
+
+
+def sharding_tree(mesh, state: dict) -> dict:
+    """``{name: NamedSharding}`` for a named build-state tree (SNIPPETS
+    [3] ``get_sharding_tree`` shape). Scalars map to replicated."""
+    return {
+        name: NamedSharding(
+            mesh, spec_for(name, mesh, ndim=int(np.ndim(value)))
+        )
+        for name, value in state.items()
+    }
+
+
+def shard_build_state(mesh, state: dict) -> dict:
+    """device_put every named array per the rule table (SNIPPETS [3]
+    ``shard_params`` shape) — the one-time placement both build engines
+    ride (``mesh.shard_build_inputs``). Values must already be padded to
+    the mesh's axis widths (``mesh.pad_row_arrays`` / feature padding)."""
+    tree = sharding_tree(mesh, state)
+    return {
+        name: jax.device_put(value, tree[name])
+        for name, value in state.items()
+    }

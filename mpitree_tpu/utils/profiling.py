@@ -163,8 +163,10 @@ def replication_fingerprint(*arrays) -> jax.Array:
     return (acc % jnp.uint32(1 << 16)).astype(jnp.float32)
 
 
-def assert_replicated(fingerprint: jax.Array, axis: str) -> jax.Array:
+def assert_replicated(fingerprint: jax.Array, axis) -> jax.Array:
     """Inside shard_map: returns |psum(fp) - n*fp|, which must be 0 when the
-    value is truly replicated. The caller checks the hostside result."""
+    value is truly replicated. The caller checks the hostside result.
+    ``axis`` may be one mesh axis name or a tuple of them (the 2-D
+    (data, feature) mesh checks replication across both)."""
     n = lax.psum(jnp.float32(1), axis)
     return jnp.abs(lax.psum(fingerprint, axis) - n * fingerprint)

@@ -330,4 +330,16 @@ def validate_max_leaf_nodes(est):
             "max_leaf_nodes requires a device engine (the numpy host tier "
             "grows level-wise only); drop backend='host'"
         )
+    nd = getattr(est, "n_devices", None)
+    if isinstance(nd, (tuple, list)) and len(nd) == 2 and int(nd[1]) > 1:
+        # Mirror of the engine-level refusal (leafwise_builder's typed
+        # mesh2d_unsupported event): fail at param validation, before any
+        # sharding work, when the mesh request itself names feature
+        # shards the best-first frontier cannot honor.
+        raise ValueError(
+            "max_leaf_nodes supports 1-D data meshes only "
+            f"(mesh2d_unsupported: n_devices={tuple(nd)!r} requests "
+            f"{int(nd[1])} feature shards, and the best-first frontier "
+            "has no feature-axis select_global twin)"
+        )
     return mln

@@ -1,0 +1,36 @@
+"""Seeded GL03 body-axis violations: collectives inside a shard_map body
+over an axis the enclosing call's PartitionSpecs do not bind (the 2-D
+(data, feature) mesh lesson — "model" IS declared by mesh_decl's Mesh
+literal, so only the spec-binding rule fires, not the declared-axis one).
+"""
+
+import jax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from mesh_decl import DATA_AXIS  # noqa: F401 (lint input only)
+
+
+def make_unbound_body_psum(mesh):
+    def local_step(x, y):
+        h = lax.psum(x * y, DATA_AXIS)  # bound by the in_specs — fine
+        return lax.psum(h, "model")  # expect: GL03
+
+    return jax.jit(jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(),
+    ))
+
+
+def make_unbound_nested_gather(mesh):
+    def body(x):
+        def merge(v):
+            return lax.all_gather(v, "model")  # expect: GL03
+
+        return merge(x)
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(P(DATA_AXIS),), out_specs=P(DATA_AXIS)
+    )

@@ -146,6 +146,31 @@ def test_timed_fit_section_embeds_record_digest(monkeypatch):
     assert len(json.dumps(rec)) < 600
 
 
+def test_mesh2d_ab_section_runs_on_cpu(tmp_path, monkeypatch):
+    """ISSUE 10: the mesh2d_ab section's CPU smoke path — the worker must
+    run end to end on the 8-device virtual mesh, record the feature-
+    sharded payload reduction, and keep the two trees structurally
+    identical (the mesh-invariance pin on the bench protocol itself)."""
+    import numpy as np
+
+    import bench_tpu
+
+    monkeypatch.setenv("MPITREE_TPU_PROFILE", "1")
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 10)).astype(np.float32)
+    y = ((X[:, 0] > 0) + (X[:, 3] > 0.4)).astype(np.int64)
+    npz = tmp_path / "ab.npz"
+    np.savez(npz, Xtr=X[:400], ytr=y[:400], Xte=X[400:], yte=y[400:])
+    out = bench_tpu.worker_mesh2d_ab(str(npz))
+    assert "skipped" not in out, out
+    assert out["mesh_2d"]["wire"]["axes"] == {"data": 4, "feature": 2}
+    # the headline: per-fit histogram-psum payload halves on the 2-D mesh
+    assert out["split_psum_reduction_x"] == 2.0
+    assert out["same_structure"] is True
+    assert out["mesh_2d"]["record"]["feature_shards"] == 2
+    assert out["mesh_1d"]["record"]["feature_shards"] == 1
+
+
 def test_record_digest_helpers_are_pure():
     """The watcher formats stored digests on jax-less hosts: the format
     path must not import mpitree, and None-reports must stay None."""

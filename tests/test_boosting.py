@@ -307,7 +307,11 @@ def test_min_split_gain_prunes_growth(toy_regression):
     assert all(t.n_nodes == 1 for t in gated.trees_)
 
 
-def test_gbdt_rejects_fused_engine_and_feature_mesh(toy_regression):
+def test_gbdt_rejects_fused_engine_but_builds_on_feature_mesh(toy_regression):
+    """The fused-engine refusal stands; the old feature-mesh refusal is
+    GONE (ISSUE 10): a Newton round on a (data, feature) mesh now sweeps
+    per-shard (g, h) slabs and merges winners through select_global,
+    bit-identical to the 1-D mesh build."""
     from mpitree_tpu.core.builder import BuildConfig, build_tree
     from mpitree_tpu.ops.binning import bin_dataset
     from mpitree_tpu.parallel import mesh as mesh_lib
@@ -322,11 +326,17 @@ def test_gbdt_rejects_fused_engine_and_feature_mesh(toy_regression):
                                           max_depth=2),
             mesh=mesh_lib.resolve_mesh(n_devices=1), sample_weight=h,
         )
-    with pytest.raises(ValueError, match="1-D data meshes"):
-        build_tree(
-            binned, g, config=BuildConfig(task="gbdt", max_depth=2),
-            mesh=mesh_lib.resolve_mesh(n_devices=(4, 2)), sample_weight=h,
-        )
+    cfg = BuildConfig(task="gbdt", max_depth=2)
+    ref = build_tree(
+        binned, g, config=cfg,
+        mesh=mesh_lib.resolve_mesh(n_devices=8), sample_weight=h,
+    )
+    two_d = build_tree(
+        binned, g, config=cfg,
+        mesh=mesh_lib.resolve_mesh(n_devices=(4, 2)), sample_weight=h,
+    )
+    np.testing.assert_array_equal(ref.feature, two_d.feature)
+    np.testing.assert_array_equal(ref.threshold, two_d.threshold)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,98 @@
+"""parallel/distributed.initialize() — idempotency/no-op unit coverage.
+
+ISSUE 10 satellite: the multi-host bootstrap previously had zero direct
+tests (its siblings, ``test_distributed_failures``/``_twoprocess``, cover
+runtime failure semantics and need working process spawning). These pin
+the SINGLE-host contracts: no-op without a coordinator, idempotent once
+joined, graceful degrade when the runtime refuses, and the rank/size
+view's field set — all monkeypatched, no real coordination service.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import pytest
+
+from mpitree_tpu.parallel import distributed
+
+
+@pytest.fixture(autouse=True)
+def _reset_state(monkeypatch):
+    """Each test sees a fresh module flag and a coordinator-free env."""
+    monkeypatch.setattr(distributed, "_initialized", False)
+    monkeypatch.delenv("COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+
+
+def test_initialize_is_a_noop_without_coordinator(monkeypatch):
+    calls = []
+    monkeypatch.setattr(
+        distributed.jax.distributed, "initialize",
+        lambda **kw: calls.append(kw),
+    )
+    assert distributed.initialize() is None
+    assert calls == []  # single host, nothing to join
+    assert distributed._initialized is False
+
+
+def test_initialize_joins_once_and_is_idempotent(monkeypatch):
+    calls = []
+
+    def fake_init(**kw):
+        calls.append(kw)
+
+    monkeypatch.setattr(
+        distributed.jax.distributed, "initialize", fake_init
+    )
+    distributed.initialize(
+        coordinator_address="localhost:1234", num_processes=2, process_id=0,
+        initialization_timeout=3,
+    )
+    assert distributed._initialized is True
+    assert len(calls) == 1
+    assert calls[0]["coordinator_address"] == "localhost:1234"
+    assert calls[0]["initialization_timeout"] == 3  # knob passthrough
+    # the second call must not re-join (the runtime raises on re-init)
+    distributed.initialize(
+        coordinator_address="localhost:9999", num_processes=2, process_id=0,
+    )
+    assert len(calls) == 1
+
+
+def test_env_coordinator_triggers_join(monkeypatch):
+    calls = []
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "host:8476")
+    monkeypatch.setattr(
+        distributed.jax.distributed, "initialize",
+        lambda **kw: calls.append(kw),
+    )
+    distributed.initialize()  # env-driven discovery path
+    assert len(calls) == 1
+    assert distributed._initialized is True
+
+
+def test_runtime_refusal_degrades_to_warning(monkeypatch):
+    def refuse(**kw):
+        raise RuntimeError("backend already initialized")
+
+    monkeypatch.setattr(distributed.jax.distributed, "initialize", refuse)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        distributed.initialize(
+            coordinator_address="localhost:1234", num_processes=2,
+            process_id=0,
+        )
+    assert any(
+        "distributed.initialize skipped" in str(w.message) for w in caught
+    )
+    assert distributed._initialized is False  # a later call may retry
+
+
+def test_process_info_field_set():
+    info = distributed.process_info()
+    assert set(info) == {
+        "process_index", "process_count", "local_devices", "global_devices",
+    }
+    assert info["process_count"] >= 1
+    assert info["global_devices"] >= info["local_devices"] >= 1

@@ -56,7 +56,10 @@ def test_build_record_schema_golden():
     # per-fit/per-shard wire-traffic estimates) and digest
     # wire_bytes/wire_shard_bytes; compile entries may carry 'seconds'
     # (cold-dispatch attribution per jit entry point)
-    assert rep["schema"] == SCHEMA_VERSION == 4
+    # v5 (ISSUE 10): wire attributes per MESH AXIS (site entries carry
+    # 'axis', top level gains axes/data_bytes/feature_bytes) and the
+    # digest gains feature_shards
+    assert rep["schema"] == SCHEMA_VERSION == 5
     # dataclass fields and the pinned tuple must agree too
     assert tuple(
         f.name for f in dataclasses.fields(BuildRecord)
@@ -66,7 +69,8 @@ def test_build_record_schema_golden():
     assert tuple(sorted(digest(rep))) == tuple(sorted((
         "engine", "reason", "n_nodes", "depth", "levels", "compile_new",
         "psum_bytes", "sub_frac", "expansions", "rounds_per_dispatch",
-        "events", "wire_bytes", "wire_shard_bytes", "wall_s",
+        "events", "wire_bytes", "wire_shard_bytes", "feature_shards",
+        "wall_s",
     )))
 
 

@@ -1,0 +1,164 @@
+"""Partition-rule table + 2-D mesh shape policy (ISSUE 10 tentpole).
+
+The table (``parallel/partition.py``) is the ONE declarative map from
+build-state array names to PartitionSpecs over the ``(data, feature)``
+mesh; both device engines derive their shard_map in_specs and initial
+placements from it. These tests pin the rules, the 1-D trim, the
+shard/sharding-tree helpers (SNIPPETS [2]/[3] idiom), and the
+``data_feature_shape`` policy mirroring ``tree_data_shape``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from mpitree_tpu.parallel import mesh as mesh_lib
+from mpitree_tpu.parallel import partition
+from mpitree_tpu.parallel.mesh import DATA_AXIS, FEATURE_AXIS
+
+
+def test_rule_table_covers_build_state_names():
+    expect = {
+        "x_binned": P(DATA_AXIS, FEATURE_AXIS),
+        "y": P(DATA_AXIS),
+        "weight": P(DATA_AXIS),
+        "sample_weight": P(DATA_AXIS),
+        "node_id": P(DATA_AXIS),
+        "nid0": P(DATA_AXIS),
+        "cand_mask": P(FEATURE_AXIS, None),
+        "cand_masks": P(FEATURE_AXIS, None),
+        "parent_hist": P(None, FEATURE_AXIS, None, None),
+        "hist_keep": P(None, FEATURE_AXIS, None, None),
+        # host-built per-node tables and config vectors replicate
+        "is_small": P(),
+        "parent_slot": P(),
+        "node_mask": P(),
+        "draws": P(),
+        "mono_cst": P(),
+        "mono_lo": P(),
+        "mono_hi": P(),
+        "is_split": P(),
+        "feat": P(),
+        "bin": P(),
+        "left_id": P(),
+        "right_id": P(),
+    }
+    for name, spec in expect.items():
+        assert partition.match_partition_rules(name) == spec, name
+
+
+def test_scalars_never_partition():
+    # the SNIPPETS [2] rule: 0-d values get P() regardless of their name
+    assert partition.match_partition_rules("x_binned", ndim=0) == P()
+    assert partition.match_partition_rules("chunk_lo", ndim=0) == P()
+
+
+def test_rank_mismatch_is_a_table_bug():
+    with pytest.raises(ValueError, match="rank"):
+        partition.match_partition_rules("x_binned", ndim=1)
+
+
+def test_trim_to_1d_mesh_drops_feature_axis():
+    mesh1d = mesh_lib.resolve_mesh(n_devices=4)
+    assert partition.spec_for("x_binned", mesh1d) == P(DATA_AXIS, None)
+    assert partition.spec_for("cand_mask", mesh1d) == P(None, None)
+    assert partition.spec_for("parent_hist", mesh1d) == P(
+        None, None, None, None
+    )
+    mesh2d = mesh_lib.resolve_mesh(n_devices=(2, 2))
+    assert partition.spec_for("x_binned", mesh2d) == P(
+        DATA_AXIS, FEATURE_AXIS
+    )
+
+
+def test_in_specs_for_orders_and_scalars():
+    mesh2d = mesh_lib.resolve_mesh(n_devices=(2, 2))
+    specs = partition.in_specs_for(
+        mesh2d, ("x_binned", "y", ("chunk_lo", 0), "cand_mask")
+    )
+    assert specs == (
+        P(DATA_AXIS, FEATURE_AXIS), P(DATA_AXIS), P(), P(FEATURE_AXIS, None)
+    )
+
+
+def test_shard_build_state_places_per_table():
+    mesh = mesh_lib.resolve_mesh(n_devices=(4, 2))
+    state = {
+        "x_binned": np.zeros((16, 6), np.int32),
+        "y": np.zeros(16, np.int32),
+        "weight": np.ones(16, np.float32),
+        "node_id": np.zeros(16, np.int32),
+        "cand_mask": np.ones((6, 4), bool),
+        "mcw": np.float32(0.0),  # scalar -> replicated
+    }
+    tree = partition.sharding_tree(mesh, state)
+    assert tree["x_binned"].spec == P(DATA_AXIS, FEATURE_AXIS)
+    assert tree["cand_mask"].spec == P(FEATURE_AXIS, None)
+    assert tree["mcw"].spec == P()
+    placed = partition.shard_build_state(mesh, state)
+    for name, v in placed.items():
+        assert v.sharding.spec == tree[name].spec, name
+    # per-shard slab shapes: rows /4, features /2
+    shard_shapes = {
+        s.data.shape for s in placed["x_binned"].addressable_shards
+    }
+    assert shard_shapes == {(4, 3)}
+
+
+def test_unknown_name_without_catchall_raises():
+    with pytest.raises(ValueError, match="not found"):
+        partition.match_partition_rules(
+            "mystery", rules=partition.PARTITION_RULES[:-1]
+        )
+
+
+# ---------------------------------------------------------------------------
+# mesh shape policy: data axis stays widest; the feature axis engages
+# only when one shard's histogram slab exceeds the budget — the mirror of
+# tree_data_shape's HBM guard.
+# ---------------------------------------------------------------------------
+
+def test_data_feature_shape_defaults_to_all_data():
+    assert mesh_lib.data_feature_shape(8, 54) == (8, 1)
+    assert mesh_lib.data_feature_shape(8, 54, hist_bytes=1 << 20) == (8, 1)
+
+
+def test_data_feature_shape_widens_features_under_budget_pressure():
+    # slab must fit 1 MiB: 4 MiB full histogram -> 4 feature shards
+    assert mesh_lib.data_feature_shape(
+        8, 54, hist_bytes=4 << 20, hist_budget=1 << 20
+    ) == (2, 4)
+    # 2 MiB -> 2 shards suffice (widest data axis that fits)
+    assert mesh_lib.data_feature_shape(
+        8, 54, hist_bytes=2 << 20, hist_budget=1 << 20
+    ) == (4, 2)
+
+
+def test_data_feature_shape_caps_at_feature_count_and_degrades():
+    # only 3 features: divisor 4 of 8 is unusable, widest usable is 2 —
+    # used even though the slab still exceeds the budget (degrade, never
+    # refuse)
+    assert mesh_lib.data_feature_shape(
+        8, 3, hist_bytes=64 << 20, hist_budget=1 << 20
+    ) == (4, 2)
+    assert mesh_lib.data_feature_shape(1, 54, hist_budget=1) == (1, 1)
+
+
+def test_resolve_mesh_2d_applies_policy():
+    m = mesh_lib.resolve_mesh_2d(
+        n_features=54, hist_bytes=4 << 20, hist_budget=1 << 20,
+        n_devices=8,
+    )
+    assert dict(zip(m.axis_names, m.devices.shape)) == {
+        DATA_AXIS: 2, FEATURE_AXIS: 4
+    }
+    # an explicit tuple bypasses the policy
+    m2 = mesh_lib.resolve_mesh_2d(n_features=54, n_devices=(4, 2))
+    assert dict(zip(m2.axis_names, m2.devices.shape)) == {
+        DATA_AXIS: 4, FEATURE_AXIS: 2
+    }
+    # df == 1 resolves to the plain 1-D data mesh
+    m3 = mesh_lib.resolve_mesh_2d(n_features=54, n_devices=8)
+    assert m3.axis_names == (DATA_AXIS,)

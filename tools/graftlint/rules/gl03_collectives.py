@@ -13,6 +13,14 @@
    its literal-tuple assignments in the enclosing function; functions with
    ``*args`` (e.g. ``collective.make_split_fn``'s ``local_step``) are
    skipped.
+3. Collectives INSIDE a ``shard_map`` body must name an axis the
+   enclosing call's PartitionSpecs bind (the 2-D ``(data, feature)`` mesh
+   lesson): a ``psum`` over ``"feature"`` inside a body mapped on a 1-D
+   data mesh traces fine on CPU and mis-reduces (or dies) only on
+   multi-device hardware. Checked only when the wrapped function and both
+   spec tuples resolve to literals (``P(...)`` calls over string
+   constants); dynamic axis arguments and closure-parameterized bodies
+   (``psum_axis=...``) are skipped, same stance as rule 1.
 """
 
 from __future__ import annotations
@@ -76,21 +84,112 @@ def _check_shard_map(project, mod, scope, call):
     target = project.resolve_function(mod, scope, call.args[0])
     if target is None:
         return
-    arity = astutil.positional_arity(target.node.args)
-    if arity is None:
-        return
     specs = astutil.keyword_arg(call, "in_specs")
     if specs is None and len(call.args) > 2:
         specs = call.args[2]
-    for tup in _spec_tuples(scope, specs):
-        n = len(tup.elts)
-        if n != arity:
-            yield Finding(
-                rule_id, mod.path, tup.lineno, tup.col_offset,
-                f"shard_map in_specs has {n} entries but "
-                f"'{target.qualname}' takes {arity} positional args — "
-                "every array operand needs a PartitionSpec",
-            )
+    arity = astutil.positional_arity(target.node.args)
+    if arity is not None:
+        for tup in _spec_tuples(scope, specs):
+            n = len(tup.elts)
+            if n != arity:
+                yield Finding(
+                    rule_id, mod.path, tup.lineno, tup.col_offset,
+                    f"shard_map in_specs has {n} entries but "
+                    f"'{target.qualname}' takes {arity} positional args — "
+                    "every array operand needs a PartitionSpec",
+                )
+    out_specs = astutil.keyword_arg(call, "out_specs")
+    if out_specs is None and len(call.args) > 3:
+        out_specs = call.args[3]
+    bound: set = set()
+    for group in (specs, out_specs):
+        axes = _bound_axes(project, mod, scope, group)
+        if axes is None:
+            return  # dynamic spec construction — body check unavailable
+        bound |= axes
+    if not bound:
+        # fully replicated specs bind no axis; a collective inside such a
+        # body is unusual but not provably wrong — skip, same stance as
+        # dynamic axis arguments.
+        return
+    yield from _check_body_axes(project, mod, target, bound)
+
+
+def _bound_axes(project, mod, scope, specs):
+    """Axis names a specs argument binds, or None when not fully literal.
+
+    Accepts a literal tuple/list of ``P(...)`` calls, a single ``P(...)``
+    (out_specs of one output), or a local Name resolving to a literal
+    tuple (the ``_spec_tuples`` contract — augmented ``specs + (P(),)``
+    rebinds make the tuple partial, so those sites resolve to None via
+    the element walk below when they carry non-spec elements).
+    """
+    if specs is None:
+        return None
+    if isinstance(specs, ast.Name):
+        tups = list(_spec_tuples(scope, specs))
+        if len(tups) != 1:
+            return None
+        specs = tups[0]
+    elts = (
+        specs.elts if isinstance(specs, (ast.Tuple, ast.List)) else [specs]
+    )
+    axes: set = set()
+    for el in elts:
+        got = _p_axes(project, mod, el)
+        if got is None:
+            return None
+        axes |= got
+    return axes
+
+
+def _p_axes(project, mod, el):
+    """Axis names in one ``PartitionSpec(...)`` literal (None = not one)."""
+    if not isinstance(el, ast.Call):
+        return None
+    name = mod.canonical(el.func)
+    if name is None or name.rsplit(".", 1)[-1] != "PartitionSpec":
+        return None
+    axes: set = set()
+    stack = list(el.args)
+    while stack:
+        a = stack.pop()
+        if isinstance(a, ast.Constant) and a.value is None:
+            continue
+        if isinstance(a, (ast.Tuple, ast.List)):
+            stack.extend(a.elts)
+            continue
+        s = project.resolve_str(mod, a)
+        if s is None:
+            return None
+        axes.add(s)
+    return axes
+
+
+def _check_body_axes(project, mod, target, bound):
+    """Collectives lexically inside the wrapped body (nested defs and
+    closures included — they run in the same shard_map program) must name
+    a spec-bound axis. Dynamic axis arguments skip, as everywhere."""
+    for node in ast.walk(target.node):
+        if not isinstance(node, ast.Call):
+            continue
+        name = mod.canonical(node.func)
+        if name not in _COLLECTIVES:
+            continue
+        axis_arg = _axis_arg(node, _COLLECTIVES[name])
+        if axis_arg is None:
+            continue
+        for axis, el in _axis_names(project, mod, axis_arg):
+            if axis not in bound:
+                yield Finding(
+                    rule_id, mod.path, el.lineno, el.col_offset,
+                    f"{name.rsplit('.', 1)[-1]} over axis '{axis}' inside "
+                    f"'{target.qualname}', but the enclosing shard_map's "
+                    "specs bind only "
+                    f"{{{', '.join(sorted(bound))}}} — a collective over "
+                    "an unbound axis traces on CPU and mis-reduces only "
+                    "on multi-device hardware",
+                )
 
 
 def _spec_tuples(scope, specs):

@@ -318,9 +318,9 @@ def main() -> int:
     # boosting (the new workload) — then the rest.
     p.add_argument("--sections",
                    default="hist_tput,north_star,engine_fused,boosting,"
-                           "leafwise_ab,gbdt_fusedK,serving,device_bin,"
-                           "north_star_fused,engine_levelwise,forest,"
-                           "refine_sweep")
+                           "leafwise_ab,gbdt_fusedK,mesh2d_ab,serving,"
+                           "device_bin,north_star_fused,engine_levelwise,"
+                           "forest,refine_sweep")
     p.add_argument("--redo", default="",
                    help="comma-separated sections to re-measure even if "
                         "already captured (appended after the missing "
