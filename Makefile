@@ -59,6 +59,12 @@ trace-smoke:
 	$(PY) examples/obs_trace_run.py --smoke \
 	  --out /tmp/mpitree_trace_smoke.json
 
+# Observability v3 gate (ISSUE 12): plan -> fit -> ledger present, live
+# watermarks bracketed, planner refusal fires on an absurd budget before
+# any dispatch. CPU-safe, seconds.
+mem-smoke:
+	JAX_PLATFORMS=cpu $(PY) examples/obs_memory_run.py
+
 clean:
 	find . -type d \( -name "__pycache__" -o -name ".pytest_cache" \
 	  -o -name ".ruff_cache" \) -exec rm -rf {} +

@@ -160,6 +160,7 @@ RECORD_DIGEST_KEYS = (
     "engine", "reason", "n_nodes", "depth", "levels", "compile_new",
     "psum_bytes", "sub_frac", "expansions", "rounds_per_dispatch",
     "events", "wire_bytes", "wire_shard_bytes", "feature_shards",
+    "hbm_peak_bytes", "host_peak_bytes",
     "wall_s",
 )
 
@@ -196,6 +197,10 @@ def format_record_digest(d: dict) -> str:
     if (d.get("feature_shards") or 1) > 1:
         # 2-D (data, feature) mesh: psum_bytes above is per feature slab
         line += f" fshards={d['feature_shards']}"
+    if d.get("hbm_peak_bytes"):
+        # The obs.memory ledger's predicted per-device peak (v6) — the
+        # number the watcher sanity-checks captured sections against.
+        line += f" hbm_peak={(d['hbm_peak_bytes'] or 0) / 1e6:.1f}MB"
     if d.get("reason"):
         line += f" reason={d['reason']!r}"
     return line

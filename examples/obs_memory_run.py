@@ -1,0 +1,136 @@
+"""obs.memory smoke: plan -> fit -> ledger + live watermarks -> refusal.
+
+The CI gate for the memory-observability contract (ISSUE 12, wired as
+``make mem-smoke``), mirroring ``obs_trace_run``'s role for the timeline
+schema. Four checks, each exiting nonzero on failure:
+
+1. **preflight planning** — ``plan_fit`` on the covtype-like bench shape
+   prices a per-device peak and names its binding array, with nothing
+   but shapes (no device touched);
+2. **the ledger rides the fit** — a real (CPU) fit's ``fit_report_``
+   carries ``record.memory`` with per-phase watermarks and the same
+   schema ``tests/test_obs_memory.py`` pins;
+3. **live watermarks** — with ``MPITREE_TPU_MEM_SAMPLE=1`` the observer
+   samples span-boundary memory and the ledger-vs-live delta stays
+   inside the documented bracket (estimate >= live resident within
+   25%, and under ``DRIFT_TOL`` x on the memory_stats source);
+4. **planner refusal** — an absurd budget (``MPITREE_TPU_HBM_BYTES``)
+   refuses BEFORE any device dispatch with a typed ``oom_predicted``
+   event naming the binding array.
+
+Run:  python examples/obs_memory_run.py  (CPU-safe, ~seconds)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["MPITREE_TPU_MEM_SAMPLE"] = "1"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+FAILURES: list[str] = []
+
+
+def check(ok: bool, what: str) -> None:
+    tag = "ok" if ok else "FAIL"
+    print(f"[{tag}] {what}")
+    if not ok:
+        FAILURES.append(what)
+
+
+def main() -> int:
+    from mpitree_tpu import DecisionTreeClassifier
+    from mpitree_tpu.obs import memory
+
+    # -- 1. preflight planning on the bench headline shape ---------------
+    plan = memory.plan_fit(
+        rows=531_000, features=54, classes=7, bins=256, max_depth=20,
+        mesh_axes={"data": 8},
+    )
+    binding = plan.binding_array()
+    print(
+        f"covtype-like plan: peak {plan.hbm_peak_bytes >> 20} MiB/device "
+        f"in phase {plan.peak_phase!r}, binding array {binding['name']!r} "
+        f"({binding['bytes_per_device'] >> 20} MiB); host "
+        f"{plan.host_peak_bytes >> 20} MiB"
+    )
+    check(plan.hbm_peak_bytes > 0, "plan_fit predicts a positive peak")
+    check(
+        binding["name"] == "split_hist_chunk",
+        "the depth-20 peak is the split chunk working set",
+    )
+
+    # -- 2 + 3. a real fit carries the ledger and live watermarks --------
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(20_000, 12)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64) + (X[:, 1] > 0.5)
+    # refine_depth=None: one engine end to end, so the recorded plan
+    # covers every allocation the live sampler sees (the hybrid tail
+    # would re-plan for its crown only).
+    clf = DecisionTreeClassifier(
+        max_depth=6, backend="cpu", max_bins=64, refine_depth=None
+    )
+    clf.fit(X, y)
+    mem = clf.fit_report_.get("memory") or {}
+    check(bool(mem.get("arrays")), "fit_report_ carries the memory ledger")
+    check(
+        mem.get("hbm_peak_bytes", 0) > 0 and mem.get("phases"),
+        "ledger has per-phase watermarks and a peak",
+    )
+    live = mem.get("live") or {}
+    check(
+        live.get("samples", 0) > 0 and live.get("source") != "none",
+        "live watermark sampling ran at span boundaries",
+    )
+    est = mem.get("hbm_peak_bytes", 0)
+    delta = live.get("hbm_peak_delta_bytes", 0)
+    print(
+        f"fit ledger: est {est} B vs live delta {delta} B "
+        f"(source {live.get('source')}, {live.get('samples')} samples; "
+        f"host peak {live.get('host_peak_bytes', 0) >> 20} MiB)"
+    )
+    # The documented bracket (see README): the analytical peak must not
+    # UNDERestimate live resident bytes by more than 25% — transients the
+    # sampler cannot see make overestimates expected and benign.
+    check(delta > 0, "live sampling observed this fit's allocations")
+    check(est >= delta * 0.8, "ledger does not underestimate live resident")
+    drift_events = [
+        e for e in clf.fit_report_.get("events", [])
+        if e.get("kind") == "mem_estimate_drift"
+    ]
+    check(not drift_events, "no drift event on the healthy CPU fit")
+
+    # -- 4. planner refusal fires before dispatch ------------------------
+    os.environ[memory.HBM_BUDGET_ENV] = str(1 << 16)  # 64 KiB: absurd
+    try:
+        try:
+            DecisionTreeClassifier(
+                max_depth=6, backend="cpu", max_bins=64,
+                refine_depth=None,
+            ).fit(X, y)
+        except memory.MemoryPlanError as e:
+            print(f"refusal: {e}")
+            check(
+                bool(e.binding_array),
+                f"oom_predicted names the binding array "
+                f"({e.binding_array!r})",
+            )
+        else:
+            check(False, "absurd budget must raise MemoryPlanError")
+    finally:
+        del os.environ[memory.HBM_BUDGET_ENV]
+
+    if FAILURES:
+        print(f"\n{len(FAILURES)} memory-smoke failures")
+        return 1
+    print("\nmemory smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -120,11 +120,23 @@ class MicroBatcher:
                         fut.set_exception(exc)
                 continue
             done_t = time.perf_counter()
+            misses = 0
             for (deadline, _, _, fut), p in zip(items, preds):
                 if done_t > deadline:
-                    self.deadline_misses += 1
+                    misses += 1
                 if not fut.done():  # a client may have been cancelled
                     fut.set_result(p)
+            if misses:
+                self.deadline_misses += misses
+                # Promote the SLO signal into obs.metrics (ISSUE 12
+                # satellite / carried ROADMAP obs follow-up): the model's
+                # private registry exposes it under the model label via
+                # registry.metrics_text(), next to the latency histograms
+                # a front-end alerts on.
+                try:
+                    self.registry.get(self.name).note_deadline_miss(misses)
+                except KeyError:
+                    pass  # slot dropped mid-flight; the local count stands
 
     async def request(self, row, *,
                       deadline_ms: float = DEFAULT_DEADLINE_MS) -> object:
@@ -248,6 +260,7 @@ async def main():
         ln for ln in text.splitlines()
         if ln.startswith(("mpitree_serving_requests_total",
                           "mpitree_serving_request_seconds_count",
+                          "mpitree_serving_deadline_misses_total",
                           "mpitree_registry_publish_total"))
     ]
     print("scraped metrics:")

@@ -53,6 +53,7 @@ from jax.sharding import PartitionSpec as P
 
 from mpitree_tpu.core import leafwise_builder as leafwise
 from mpitree_tpu.obs import accounting as obs_acct
+from mpitree_tpu.obs import memory as obs_memory
 from mpitree_tpu.core.builder import (
     fetch_row_nodes,
     resolve_gbdt_x64,
@@ -102,10 +103,10 @@ def resolve_rounds_per_dispatch(param, *, platform: str, loss_kind,
             max_depth, int(n_samples),
         )
         # (count, g, h) f32 pool histograms under subtraction — the
-        # widest buffer the scanned build carries.
-        pool_bytes = (
-            pn * max(int(n_features or 1), 1)
-            * 3 * max(int(n_bins or 256), 1) * 4
+        # widest buffer the scanned build carries (formula: obs.memory,
+        # the one pricing source the capacity planner also reads).
+        pool_bytes = obs_memory.pool_hist_bytes(
+            pn, int(n_features or 1), int(n_bins or 256)
         )
         budget = (
             int(hist_budget_bytes) if hist_budget_bytes else 4 << 30
@@ -395,6 +396,23 @@ def run_fused_rounds(*, binned, y_tr, sw_tr, raw_tr, trees, train_scores,
     )
     md = -1 if cfg.max_depth is None else int(cfg.max_depth)
     subsample_on = float(subsample) < 1.0
+
+    # Memory ledger + OOM preflight (obs.memory, ISSUE 12): the fused
+    # multi-round program never routes through build_tree, so it records
+    # its own analytical plan — pool histograms, the donated margin
+    # carry, the (g, h) recompute — BEFORE the first device placement.
+    plan = obs_acct.build_memory_plan(
+        mesh=mesh, rows=int(N), features=int(binned.x_binned.shape[1]),
+        classes=2, bins=int(B), task="gbdt", max_depth=cfg.max_depth,
+        max_leaf_nodes=int(Pn), gbdt_x64=gbdt_x64, subtraction=use_sub,
+        hist_budget_bytes=cfg.hist_budget_bytes,
+        max_frontier_chunk=cfg.max_frontier_chunk,
+        max_table_slots=cfg.max_table_slots,
+        rounds_per_dispatch=int(rounds_per_dispatch),
+        engine="fused_rounds",
+    )
+    obs.memory_plan(plan.to_dict())
+    obs_memory.preflight(plan, obs=obs, what="fused-rounds dispatch")
 
     with obs.span("shard"):
         yf = np.ascontiguousarray(y_tr, np.float32)

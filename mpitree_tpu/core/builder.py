@@ -29,13 +29,15 @@ import dataclasses
 import math
 import os
 import time
+import warnings
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from mpitree_tpu.core.tree_struct import TreeArrays
-from mpitree_tpu.obs import warn_event
+from mpitree_tpu.obs import accounting as obs_acct, warn_event
+from mpitree_tpu.obs import memory as memory_lib
 from mpitree_tpu.ops.binning import BinnedData
 from mpitree_tpu.parallel import collective, mesh as mesh_lib
 from mpitree_tpu.resilience import chaos
@@ -181,9 +183,10 @@ def _chunk_size(n_samples: int, n_feat: int, n_bins: int, n_chan: int,
     """
     # Live peak per slot: the (K,F,C,B) histogram (C padded to 8 sublanes by
     # TPU tiling) plus ~8 (K,F,B) f32 accumulators (impurity.py's memory-lean
-    # gain formulation keeps per-class cumsums transient).
-    c_padded = ((n_chan + 7) // 8) * 8
-    per_node = n_feat * n_bins * (c_padded * 4 + 8 * 4)
+    # gain formulation keeps per-class cumsums transient). The formula
+    # lives in obs.memory (ISSUE 12: ONE pricing source — the capacity
+    # planner and this chunk sizing can never disagree).
+    per_node = memory_lib.chunk_bytes_per_slot(n_feat, n_bins, n_chan)
     cap = max(1, cfg.hist_budget_bytes // max(per_node, 1))
     cap = min(cap, cfg.max_frontier_chunk)
     widest = _widest_frontier(n_samples, cfg)
@@ -486,6 +489,52 @@ def resolve_gbdt_x64(platform: str) -> bool:
     return platform == "cpu"
 
 
+def ledger_and_preflight(*, binned, mesh, cfg: BuildConfig, task: str,
+                         n_classes, sample_weight, platform: str,
+                         gbdt_x64: bool, timer, engine: str,
+                         chunk_slots: int | None = None,
+                         rounds_per_dispatch: int = 1,
+                         n_out: int = 1) -> dict:
+    """Record the analytical memory ledger and refuse a config whose
+    predicted per-device peak exceeds the HBM budget — BEFORE the first
+    device dispatch (ISSUE 12).
+
+    The subtraction resolve here is the QUIET twin of the engines' own
+    later resolution (same pure function, warnings suppressed) — it only
+    prices the carry; the engine's resolution still owns the recorded
+    decision and any f32-ceiling event. Returns the plan dict (also
+    recorded through ``timer.memory_plan``). Raises
+    :class:`~mpitree_tpu.obs.memory.MemoryPlanError` on a predicted OOM
+    (typed ``oom_predicted`` event attached first).
+    """
+    N, F = binned.x_binned.shape
+    total_w = (
+        float(N) if sample_weight is None else float(np.sum(sample_weight))
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        sub = resolve_hist_subtraction(
+            cfg, platform, task,
+            integer_ok=integer_weights(sample_weight),
+            gbdt_x64=gbdt_x64, total_weight=total_w, obs=None,
+        )
+    plan = obs_acct.build_memory_plan(
+        mesh=mesh, rows=int(N), features=int(F),
+        classes=int(n_classes or 2), bins=int(binned.n_bins), task=task,
+        max_depth=cfg.max_depth, max_leaf_nodes=cfg.max_leaf_nodes,
+        gbdt_x64=gbdt_x64, subtraction=sub, chunk_slots=chunk_slots,
+        hist_budget_bytes=cfg.hist_budget_bytes,
+        max_frontier_chunk=cfg.max_frontier_chunk,
+        max_table_slots=cfg.max_table_slots,
+        rounds_per_dispatch=rounds_per_dispatch, n_out=n_out,
+        engine=engine,
+    )
+    d = plan.to_dict()
+    timer.memory_plan(d)
+    memory_lib.preflight(plan, obs=timer, what=f"{engine} build")
+    return d
+
+
 def integer_weights(sample_weight) -> bool:
     """True when raw class counts can stay integral (the reference's
     predict_proba contract) — i.e. no fractional sample weights."""
@@ -659,6 +708,16 @@ def build_tree(
             )
         from mpitree_tpu.core.leafwise_builder import build_tree_leafwise
 
+        ledger_and_preflight(
+            binned=binned, mesh=mesh, cfg=cfg, task=cfg.task,
+            n_classes=n_classes, sample_weight=sample_weight,
+            platform=mesh.devices.flat[0].platform,
+            gbdt_x64=(
+                cfg.task == "gbdt"
+                and resolve_gbdt_x64(mesh.devices.flat[0].platform)
+            ),
+            timer=timer, engine="leafwise",
+        )
         return build_tree_leafwise(
             binned, y, config=cfg, mesh=mesh, n_classes=n_classes,
             sample_weight=sample_weight, refit_targets=refit_targets,
@@ -780,6 +839,16 @@ def build_tree(
         reason=engine_reason,
         rows=int(N), features=int(F), bins=int(B), chunk_slots=int(K),
         max_depth=cfg.max_depth, task=task, debug=bool(debug),
+    )
+    # Memory ledger + OOM preflight (ISSUE 12): recorded for BOTH device
+    # engines before their first dispatch — the fused engine gets its
+    # per-phase watermarks replayed analytically (obs/accounting), the
+    # levelwise engine prices the identical statics.
+    ledger_and_preflight(
+        binned=binned, mesh=mesh, cfg=cfg, task=task,
+        n_classes=n_classes, sample_weight=sample_weight,
+        platform=platform, gbdt_x64=gbdt64, timer=timer,
+        engine=engine, chunk_slots=K,
     )
     if engine == "fused":
         if debug:
@@ -1089,10 +1158,11 @@ def build_tree(
             S_pred = next((s for s in tiers if frontier_size <= s), K)
             sub_now = use_sub and sub_parent is not None and S_pred >= 2
             n_chunks_pred = -(-frontier_size // S_pred)
-            keep_bytes = (
-                # per-device resident cost: the kept buffers stay
-                # feature-sharded slabs on a 2-D mesh
-                n_chunks_pred * S_pred * f_shard * C * B * hist_itemsize
+            # Per-device resident cost: the kept buffers stay feature-
+            # sharded slabs on a 2-D mesh (slab formula: obs.memory, the
+            # one pricing source).
+            keep_bytes = n_chunks_pred * memory_lib.slab_bytes(
+                S_pred, f_shard, C, B, itemsize=hist_itemsize
             )
             over_budget = keep_bytes > cfg.hist_budget_bytes
             keep_now = use_sub and S_pred >= 2 and not over_budget

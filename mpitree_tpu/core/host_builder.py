@@ -278,6 +278,20 @@ def build_tree_host(
     N, F = xb.shape
     B = binned.n_bins
     C = n_classes if task == "classification" else 3
+    # Memory ledger (obs.memory, ISSUE 12): the host tier carries no
+    # device arrays — its record prices the HOST side (raw + binned
+    # matrix + row state), which is what out-of-core chunk sizing
+    # (ROADMAP item 1) budgets against.
+    from mpitree_tpu.obs import accounting as obs_acct
+
+    timer.memory_plan(obs_acct.build_memory_plan(
+        mesh_axes=1, rows=int(N), features=int(F),
+        classes=int(n_classes or 2), bins=int(B), task=task,
+        max_depth=cfg.max_depth, max_leaf_nodes=cfg.max_leaf_nodes,
+        hist_budget_bytes=cfg.hist_budget_bytes,
+        max_frontier_chunk=cfg.max_frontier_chunk,
+        max_table_slots=cfg.max_table_slots, engine="host",
+    ))
     cand = binned.candidate_mask()  # (F, B)
     w = np.ones(N) if sample_weight is None else sample_weight.astype(np.float64)
     if task == "regression":

@@ -18,6 +18,12 @@ as Perfetto-loadable Chrome-trace timelines (``fit(trace_to=...)`` /
 latency/throughput registry (log-bucketed histograms, Prometheus text
 exposition), fresh compile cache-keys attribute cold-dispatch wall per
 entry point, and ``record.wire`` is the ICI wire-traffic ledger.
+Observability v3 (ISSUE 12): ``obs.memory`` is the wire ledger's memory
+twin — ``record.memory`` carries an analytical per-array device/host
+ledger priced from the partition-rule table, ``plan_fit``/``plan_serve``
+expose it as a preflight capacity planner (typed ``oom_predicted``
+refusal before dispatch), and ``MPITREE_TPU_MEM_SAMPLE=1`` samples live
+HBM/host watermarks at span boundaries.
 """
 
 from mpitree_tpu.obs.observer import (
@@ -28,6 +34,13 @@ from mpitree_tpu.obs.observer import (
     note_build_path,
     note_refine,
     warn_event,
+)
+from mpitree_tpu.obs.memory import (
+    MemoryPlan,
+    MemoryPlanError,
+    MemWatch,
+    plan_fit,
+    plan_serve,
 )
 from mpitree_tpu.obs.metrics import MetricsRegistry, metrics_text
 from mpitree_tpu.obs.record import (
@@ -52,6 +65,9 @@ __all__ = [
     "BuildRecord",
     "BuildObserver",
     "CompileRegistry",
+    "MemWatch",
+    "MemoryPlan",
+    "MemoryPlanError",
     "MetricsRegistry",
     "REGISTRY",
     "ReportMixin",
@@ -62,6 +78,8 @@ __all__ = [
     "metrics_text",
     "note_build_path",
     "note_refine",
+    "plan_fit",
+    "plan_serve",
     "validate_trace",
     "warn_event",
     "wire_estimate",

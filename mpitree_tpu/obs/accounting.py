@@ -18,11 +18,32 @@ import math
 
 import numpy as np
 
+from mpitree_tpu.obs import memory as memory_mod
 from mpitree_tpu.parallel.collective import (
     counts_psum_bytes,
     select_global_bytes,
     split_psum_bytes,
 )
+
+
+def build_memory_plan(*, mesh=None, mesh_axes=None,
+                      **statics) -> memory_mod.MemoryPlan:
+    """Assemble the analytical memory ledger for one build — the memory
+    twin of :func:`fused_level_rows` (ISSUE 12): the fused engines run
+    one compiled program with no per-phase host visibility, so their
+    per-phase HBM watermarks are *replayed* analytically from the same
+    statics the live level-wise loop prices — one assembly point, so the
+    engines cannot drift in what they ledger.
+
+    ``mesh``: a jax Mesh (axis widths are read off it); ``mesh_axes``
+    the already-normalized alternative. Everything else forwards to
+    :func:`mpitree_tpu.obs.memory.plan_fit`.
+    """
+    if mesh is not None and mesh_axes is None:
+        mesh_axes = {
+            str(n): int(mesh.shape[n]) for n in mesh.axis_names
+        }
+    return memory_mod.plan_fit(mesh_axes=mesh_axes, **statics)
 
 
 def effective_tiers(tiers: tuple, max_depth: int) -> tuple:

@@ -1,0 +1,818 @@
+"""obs.memory — the device/host memory ledger and preflight capacity planner.
+
+The memory twin of the PR-9 ICI *wire* ledger (ISSUE 12 tentpole): every
+build-state array the engines materialize has a NAME in
+``parallel/partition.py``'s rule table, its global shape is a pure
+function of the workload statics (rows, features, classes, bins,
+depth/leaves, dtype policy, mesh axes), and its per-device cost follows
+from the spec the table assigns it — so peak HBM is *computable before
+dispatch*, exactly the way the wire ledger computes ICI bytes from the
+logical psum payloads. Three layers ride the one pricing source:
+
+- **the analytical ledger** (:func:`plan_fit` / :func:`plan_serve`):
+  per-array per-device byte rows with per-phase watermarks, recorded
+  under ``record.memory`` (schema v6) by every engine;
+- **live watermark sampling** (:class:`MemWatch`): span-boundary samples
+  of ``device.memory_stats()`` (where the backend provides it — TPU),
+  with a live-``jax.Array`` shard-byte fallback for CPU backends, plus
+  host RSS; the observer renders them as Perfetto ``mem`` counter tracks
+  and logs ledger-vs-live deltas past a threshold as a typed
+  ``mem_estimate_drift`` event;
+- **the preflight planner** (:func:`preflight` /
+  :meth:`MemoryPlan.check`): a config whose predicted peak exceeds the
+  per-device budget (``MPITREE_TPU_HBM_BYTES``, or the backend's
+  reported ``bytes_limit``) refuses BEFORE any device dispatch with a
+  typed ``oom_predicted`` event naming the binding array and the
+  smallest workable data-axis widening.
+
+The pricing helpers below are THE one copy of every slab/pool/table
+formula: ``core/builder._chunk_size`` (chunk sizing), the
+sibling-subtraction carry budget gate, ``mesh.data_feature_shape`` /
+``mesh.tree_data_shape`` (mesh shape policy), ``fused_rounds``'s leaf
+pool guard, and the serving Pallas tier's ``fits_vmem`` all consume them
+— pinned equal to their pre-refactor decisions by
+``tests/test_obs_memory.py``.
+
+Import cost: stdlib-only at module level (``math``/``os``/``dataclasses``);
+jax and the partition-rule table load lazily, only when a plan is priced
+or live memory sampled — so ``parallel/mesh`` can consume the pricing
+helpers without an import cycle and the disabled observability path pays
+nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+
+# record.memory carries its own sub-schema version (the top-level record
+# version is obs.record.SCHEMA_VERSION): bump on any ledger field rename.
+MEMORY_SCHEMA = 1
+
+# Env knobs (documented in README "Observability v3 — memory"):
+HBM_BUDGET_ENV = "MPITREE_TPU_HBM_BYTES"       # per-device preflight budget
+MEM_SAMPLE_ENV = "MPITREE_TPU_MEM_SAMPLE"      # "1" = span-boundary sampling
+DRIFT_TOL_ENV = "MPITREE_TPU_MEM_DRIFT_TOL"    # drift-event threshold (x)
+
+# Ledger-vs-live default drift threshold: the analytical peak prices
+# TRANSIENT working sets (the split chunk histogram) that live sampling
+# at span boundaries cannot see, so the estimate legitimately sits above
+# the sampled resident bytes; a drift event fires only when they diverge
+# by more than this FACTOR either way (underestimates are always worth an
+# event — see _drift below).
+DRIFT_TOL_DEFAULT = 8.0
+
+# The serving Pallas tier's VMEM ceiling (moved here from
+# serving/pallas_serve so both the kernel gate and the capacity planner
+# read ONE number; pallas_serve re-exports it).
+SERVE_VMEM_BUDGET_BYTES = 10 << 20
+
+# Phase names the fit ledger prices. "resident" arrays live for the whole
+# build; the others are per-phase working sets layered on top of it —
+# matching the observer's span names, so the levelwise engine's live
+# spans and the fused engines' single-program builds share one watermark
+# vocabulary (the fused twin of the wire ledger's replay).
+RESIDENT = "resident"
+FIT_PHASES = ("shard", "split", "update", "leafwise", "fused_rounds")
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-int(x) // int(m)) * int(m)
+
+
+def c_padded(n_channels: int) -> int:
+    """Histogram channel axis padded to the 8-sublane TPU tile."""
+    return _round_up(max(int(n_channels), 1), 8)
+
+
+# ---------------------------------------------------------------------------
+# pricing formulas — THE one copy each consumer reads
+# ---------------------------------------------------------------------------
+
+def chunk_bytes_per_slot(n_features: int, n_bins: int, n_channels: int,
+                         *, itemsize: int = 4) -> int:
+    """Live split-phase working set per frontier slot.
+
+    The (K, F, C, B) histogram (C padded to 8 sublanes by TPU tiling)
+    plus ~8 (K, F, B) accumulators from the memory-lean gain sweep —
+    ``core/builder._chunk_size`` sizes the frontier chunk from exactly
+    this number (``itemsize=4``: the chunk-sizing contract predates the
+    f64 gbdt path and must not drift with it; the LEDGER prices the f64
+    histogram via its real itemsize separately).
+    """
+    return int(n_features) * int(n_bins) * (
+        c_padded(n_channels) * int(itemsize) + 8 * 4
+    )
+
+
+def slab_bytes(n_slots: int, n_features: int, n_channels: int,
+               n_bins: int, *, itemsize: int = 4) -> int:
+    """One resident (S, F, C, B) histogram slab — the sibling-subtraction
+    carry's per-chunk buffer and the ``data_feature_shape`` policy's
+    per-shard cost unit."""
+    return (int(n_slots) * int(n_features) * int(n_channels)
+            * int(n_bins) * int(itemsize))
+
+
+def pool_hist_bytes(pool_slots: int, n_features: int, n_bins: int) -> int:
+    """The fused-rounds leaf pool's (P, F, 3, B) f32 (count, g, h)
+    histograms under subtraction — ``resolve_rounds_per_dispatch``'s
+    budget guard reads this."""
+    return int(pool_slots) * max(int(n_features), 1) * 3 * max(
+        int(n_bins), 1
+    ) * 4
+
+
+def table_bytes(n_slots: int, n_channels: int) -> int:
+    """The per-level update/counts tables: one U-wide bool routing mask,
+    four U-wide int32 id/bin columns, and the (U, C) f32 counts slab."""
+    u = int(n_slots)
+    return u * (1 + 4 * 4) + u * max(int(n_channels), 1) * 4
+
+
+def node_table_bytes(n_nodes: int, value_channels: int,
+                     *, value_itemsize: int = 4) -> int:
+    """A serving flat node table: five parallel property columns
+    (feature/left/right int32, threshold f32, depth int32) plus the
+    (M, Kv) leaf-value channel."""
+    m = int(n_nodes)
+    return m * 5 * 4 + m * max(int(value_channels), 1) * int(value_itemsize)
+
+
+def pool_capacity(max_leaf_nodes: int, max_depth, n_samples: int) -> int:
+    """Open-leaf pool width for best-first growth — the arithmetic twin
+    of ``core/leafwise_builder._pool_capacity`` (kept here jax-free so
+    the planner can price leaf pools without importing the engine; the
+    identity is pinned by ``tests/test_obs_memory.py``)."""
+    p = int(max_leaf_nodes)
+    if max_depth is not None and int(max_depth) < 31:
+        p = min(p, 2 ** max(int(max_depth), 0))
+    return max(min(p, max(int(n_samples), 1)), 1)
+
+
+def feature_shards_for_budget(hist_bytes: int, hist_budget,
+                              usable: list) -> int:
+    """The 2-D mesh policy's feature-shard engagement threshold: the
+    narrowest usable feature divisor whose per-shard slab
+    (``hist_bytes / f``) fits ``hist_budget`` — degrading to the widest
+    divisor when none fits (never refuse). Extracted verbatim from
+    ``mesh.data_feature_shape`` so the shape policy and the capacity
+    planner can never disagree about when feature sharding engages."""
+    f = 1
+    if hist_budget:
+        while f < max(usable) and int(hist_bytes) > int(hist_budget) * f:
+            f = min(k for k in usable if k > f)
+    return f
+
+
+def tree_shards_for_budget(tree_shards: int, dataset_bytes: int,
+                           hbm_budget, divisors: list,
+                           n_devices: int) -> int:
+    """The forest mesh policy's HBM guard: trade tree-axis width for row
+    sharding while the replicated binned matrix would exceed the
+    per-device budget (extracted verbatim from
+    ``mesh.tree_data_shape``)."""
+    t = int(tree_shards)
+    if hbm_budget:
+        while t > 1 and int(dataset_bytes) > int(hbm_budget) * (
+            int(n_devices) // t
+        ):
+            t = max(k for k in divisors if k < t)
+    return t
+
+
+def serve_kernel_row_tile(n_nodes_max: int, n_features: int, kv: int,
+                          n_out: int,
+                          budget: int = SERVE_VMEM_BUDGET_BYTES) -> int | None:
+    """Largest serving-kernel row tile whose VMEM working set fits
+    ``budget`` (the persistent out block + one tree's table/value blocks
+    + the one-hot working set), or None — the ONE copy of the arithmetic
+    ``serving.pallas_serve.kernel_row_tile``/``fits_vmem`` gate on."""
+    mp = _round_up(max(n_nodes_max, 1), 128)
+    fp = _round_up(max(n_features, 1), 8)
+    blocks = mp * (8 + _round_up(max(kv, 1), 8)) * 4
+    for rt in (1024, 512, 256, 128, 64, 8):
+        work = rt * (mp + 2 * fp + 4 + max(n_out, 1)) * 4
+        if blocks + work <= budget:
+            return rt
+    return None
+
+
+def serve_fits_vmem(n_nodes_max: int, n_features: int, kv: int,
+                    n_out: int) -> bool:
+    return serve_kernel_row_tile(n_nodes_max, n_features, kv, n_out) is not None
+
+
+# ---------------------------------------------------------------------------
+# the plan
+# ---------------------------------------------------------------------------
+
+class MemoryPlanError(ValueError):
+    """Preflight refusal: the predicted per-device peak exceeds the HBM
+    budget. Carries the binding array and the planner's suggestion so the
+    caller (and the typed ``oom_predicted`` event) can say exactly what
+    to change."""
+
+    def __init__(self, message: str, *, binding_array: str,
+                 suggestion: str):
+        super().__init__(message)
+        self.binding_array = binding_array
+        self.suggestion = suggestion
+
+
+def _axis_widths(mesh_axes) -> dict:
+    """Normalize a mesh description into ``{"data": dr, "feature": df}``.
+
+    Accepts an axes dict (``record.mesh['axes']`` shape), a plain int
+    (1-D data mesh), a ``(dr, df)`` tuple, or None (single device).
+    """
+    if mesh_axes is None:
+        return {"data": 1, "feature": 1}
+    if isinstance(mesh_axes, dict):
+        return {
+            "data": max(int(mesh_axes.get("data", 1)), 1),
+            "feature": max(int(mesh_axes.get("feature", 1)), 1),
+        }
+    if isinstance(mesh_axes, (tuple, list)):
+        dr = int(mesh_axes[0]) if len(mesh_axes) > 0 else 1
+        df = int(mesh_axes[1]) if len(mesh_axes) > 1 else 1
+        return {"data": max(dr, 1), "feature": max(df, 1)}
+    return {"data": max(int(mesh_axes), 1), "feature": 1}
+
+
+def _spec_axes(name: str, ndim: int) -> tuple:
+    """Per-dimension axis names for ``name`` from the partition-rule
+    table (lazy import: ``parallel.partition`` pulls jax). Unknown names
+    and import failures fall back to replicated — the ledger must price
+    in any environment."""
+    try:
+        from mpitree_tpu.parallel import partition
+
+        spec = partition.match_partition_rules(name, ndim=ndim)
+    except Exception:
+        return (None,) * ndim
+    axes = tuple(spec) + (None,) * (ndim - len(tuple(spec)))
+    return axes[:ndim]
+
+
+def _per_device_bytes(name: str, shape: tuple, itemsize: int,
+                      axes: dict) -> int:
+    """Bytes per device for a named global array: each dimension the
+    rule table shards divides (padded) by its axis width."""
+    total = int(itemsize)
+    for dim, axis in zip(shape, _spec_axes(name, len(shape))):
+        w = axes.get(axis, 1) if axis is not None else 1
+        total *= -(-int(dim) // max(int(w), 1))
+    return total
+
+
+@dataclasses.dataclass
+class MemoryPlan:
+    """The priced ledger: per-array rows, per-phase watermarks, peaks.
+
+    ``arrays``: ``{name, shape, itemsize, phase, bytes_per_device}``
+    rows (phase ``"resident"`` = alive for the whole build).
+    ``phases``: per-phase per-device watermark = resident + that phase's
+    working set. ``hbm_peak_bytes`` = max watermark; ``peak_phase`` its
+    phase; ``host_peak_bytes`` the host-RAM side (raw + binned matrix +
+    per-row state — the out-of-core chunk-sizing input, ROADMAP item 1).
+    """
+
+    kind: str
+    mesh_axes: dict
+    arrays: list
+    phases: dict
+    hbm_peak_bytes: int
+    peak_phase: str
+    host_peak_bytes: int
+    inputs: dict
+
+    def to_dict(self) -> dict:
+        return {
+            "schema": MEMORY_SCHEMA,
+            "kind": self.kind,
+            "mesh_axes": dict(self.mesh_axes),
+            "arrays": [dict(a) for a in self.arrays],
+            "phases": dict(self.phases),
+            "hbm_peak_bytes": int(self.hbm_peak_bytes),
+            "peak_phase": self.peak_phase,
+            "host_peak_bytes": int(self.host_peak_bytes),
+            "inputs": dict(self.inputs),
+        }
+
+    def top(self, k: int = 5) -> list:
+        """The k largest per-device arrays — what the OOM postmortem and
+        the ``oom_predicted`` refusal name."""
+        return sorted(
+            self.arrays, key=lambda a: -a["bytes_per_device"]
+        )[:k]
+
+    def binding_array(self) -> dict | None:
+        """The largest array alive in the peak phase (the one a smaller
+        config must shrink first)."""
+        live = [
+            a for a in self.arrays
+            if a["phase"] in (RESIDENT, self.peak_phase)
+        ] or self.arrays
+        return max(live, key=lambda a: a["bytes_per_device"], default=None)
+
+    def suggestion(self, budget: int) -> str:
+        """Smallest workable change: the data-axis widening that brings
+        the peak under ``budget`` (row-sharded arrays scale down with
+        it), else a chunk/budget knob hint — what the refusal message
+        carries."""
+        dr = self.mesh_axes.get("data", 1)
+        scalable = sum(
+            a["bytes_per_device"] for a in self.arrays
+            if "data" in _spec_axes(a["name"], len(a["shape"]))
+            and a["phase"] in (RESIDENT, self.peak_phase)
+        )
+        fixed = max(self.hbm_peak_bytes - scalable, 0)
+        for widen in (2, 4, 8, 16, 32, 64, 128):
+            if fixed + scalable / widen <= budget:
+                return (
+                    f"widen the data axis to {dr * widen} shards "
+                    f"(predicted peak ~{int(fixed + scalable / widen) >> 20}"
+                    " MiB/device)"
+                )
+        return (
+            "no data-axis widening (up to 128x) fits; shrink the workload "
+            "or lower hist_budget_bytes/max_frontier_chunk so smaller "
+            "chunks bound the histogram working set"
+        )
+
+    def check(self, budget=None, *, obs=None, what: str = "fit") -> None:
+        """Preflight: raise :class:`MemoryPlanError` (after recording a
+        typed ``oom_predicted`` event on ``obs``) when the predicted
+        per-device peak exceeds ``budget`` (None = no known budget, no
+        check — the degrade-never-guess stance on backends that report
+        nothing)."""
+        if not budget or self.hbm_peak_bytes <= int(budget):
+            return
+        binding = self.binding_array() or {"name": "?", "bytes_per_device": 0}
+        suggestion = self.suggestion(int(budget))
+        msg = (
+            f"predicted per-device peak {self.hbm_peak_bytes >> 20} MiB "
+            f"exceeds the {int(budget) >> 20} MiB HBM budget for this "
+            f"{what} (peak phase {self.peak_phase!r}; binding array "
+            f"{binding['name']!r} at "
+            f"{binding['bytes_per_device'] >> 20} MiB/device); "
+            f"{suggestion}. Refusing before dispatch — override with a "
+            f"larger {HBM_BUDGET_ENV} if the budget is wrong."
+        )
+        if obs is not None:
+            obs.event(
+                "oom_predicted", msg,
+                binding_array=binding["name"],
+                binding_bytes=int(binding["bytes_per_device"]),
+                hbm_peak_bytes=int(self.hbm_peak_bytes),
+                budget_bytes=int(budget),
+                top=[
+                    {"name": a["name"], "bytes": int(a["bytes_per_device"])}
+                    for a in self.top(5)
+                ],
+            )
+        raise MemoryPlanError(
+            msg, binding_array=binding["name"], suggestion=suggestion,
+        )
+
+
+def _widest_frontier(rows: int, max_depth) -> int:
+    w = int(rows)
+    if max_depth is not None and int(max_depth) < 31:
+        w = min(w, 2 ** int(max_depth))
+    return max(w, 1)
+
+
+def default_chunk_slots(rows: int, f_shard: int, bins: int, channels: int,
+                        *, hist_budget_bytes: int = 4 << 30,
+                        max_frontier_chunk: int = 4096,
+                        max_depth=None) -> int:
+    """Mirror of ``core/builder._chunk_size`` on the shared pricing
+    formula (identity pinned) — lets :func:`plan_fit` price a build
+    before the builder has resolved its own chunk width."""
+    per_node = chunk_bytes_per_slot(f_shard, bins, channels)
+    cap = max(1, int(hist_budget_bytes) // max(per_node, 1))
+    cap = min(cap, int(max_frontier_chunk))
+    widest = _widest_frontier(rows, max_depth)
+    want = 1 << max(0, math.ceil(math.log2(max(widest, 1))))
+    return min(want, 1 << int(math.log2(cap)))
+
+
+def plan_fit(*, rows: int, features: int, classes: int = 2,
+             bins: int = 256, task: str = "classification",
+             max_depth=None, max_leaf_nodes=None, mesh_axes=None,
+             gbdt_x64: bool = False, subtraction: bool = False,
+             chunk_slots: int | None = None,
+             table_slots: int | None = None,
+             hist_budget_bytes: int = 4 << 30,
+             max_frontier_chunk: int = 4096,
+             max_table_slots: int = 1 << 17,
+             rounds_per_dispatch: int = 1,
+             n_out: int = 1,
+             engine: str | None = None) -> MemoryPlan:
+    """Price one fit's build-state arrays into a :class:`MemoryPlan`.
+
+    Every argument is a workload STATIC (nothing here touches a device):
+    the same inputs ``core/builder.build_tree`` resolves before its first
+    dispatch, which is what makes this a *preflight* — callable from a
+    notebook with nothing but the intended shapes. ``mesh_axes`` follows
+    :func:`_axis_widths`'s grammar; ``engine`` is recorded verbatim for
+    attribution.
+    """
+    axes = _axis_widths(mesh_axes)
+    dr, df = axes["data"], axes["feature"]
+    C = int(classes) if task == "classification" else 3
+    rows = int(rows)
+    features = int(features)
+    bins = int(bins)
+    rows_pad = _round_up(rows, dr)
+    feat_pad = _round_up(features, df)
+    f_shard = feat_pad // df
+    hist_itemsize = 8 if gbdt_x64 else 4
+    K = (int(chunk_slots) if chunk_slots else default_chunk_slots(
+        rows, f_shard, bins, C, hist_budget_bytes=hist_budget_bytes,
+        max_frontier_chunk=max_frontier_chunk, max_depth=max_depth,
+    ))
+    widest = _widest_frontier(rows, max_depth)
+    U = (int(table_slots) if table_slots else
+         1 << max(0, math.ceil(math.log2(min(widest, int(max_table_slots))))))
+
+    arrays: list = []
+
+    def add(name, shape, itemsize, phase, *, bytes_per_device=None):
+        b = (_per_device_bytes(name, shape, itemsize, axes)
+             if bytes_per_device is None else int(bytes_per_device))
+        arrays.append({
+            "name": name, "shape": [int(s) for s in shape],
+            "itemsize": int(itemsize), "phase": phase,
+            "bytes_per_device": int(b),
+        })
+
+    # Resident build state (alive from shard to finalize) — named per the
+    # partition table, so per-device division follows the same rules the
+    # engines' shard_map in_specs do.
+    add("x_binned", (rows_pad, feat_pad), 4, RESIDENT)
+    add("y", (rows_pad,), 4, RESIDENT)
+    add("weight", (rows_pad,), 4, RESIDENT)
+    add("node_id", (rows_pad,), 4, RESIDENT)
+    add("cand_mask", (feat_pad, bins), 1, RESIDENT)
+
+    fused_gbdt = task == "gbdt" and int(rounds_per_dispatch) > 1
+    if max_leaf_nodes is not None:
+        # Best-first growth: the statically-shaped open-leaf pool replaces
+        # the level-wise chunk sweep — pool scalars, the 2P-1 node
+        # arrays, and (under subtraction) the pool-resident histograms.
+        # Inside a fused multi-round GBDT program the pool lives in the
+        # SAME compiled dispatch as the margin carry, so its arrays join
+        # the fused_rounds phase — pricing them as separate watermarks
+        # would let a near-budget config pass preflight and OOM live.
+        ph = "fused_rounds" if fused_gbdt else "leafwise"
+        Pn = pool_capacity(max_leaf_nodes, max_depth, rows)
+        add("pool_scalars", (Pn, 6), 4, ph, bytes_per_device=Pn * 24)
+        add("pool_nodes", (2 * Pn - 1, 10 + C), 4, ph,
+            bytes_per_device=(2 * Pn - 1) * (10 + C) * 4)
+        if subtraction:
+            add("pool_hist", (Pn, f_shard, C, bins), hist_itemsize,
+                ph,
+                bytes_per_device=slab_bytes(
+                    Pn, f_shard, C, bins, itemsize=hist_itemsize))
+        # One sibling-pair histogram per expansion (the compact
+        # small-child buffer under subtraction).
+        pair = 1 if subtraction else 2
+        add("pair_hist", (pair, f_shard, C, bins), hist_itemsize,
+            ph,
+            bytes_per_device=slab_bytes(
+                pair, f_shard, C, bins, itemsize=hist_itemsize))
+    else:
+        # Level-synchronous engines: the K-slot split working set plus
+        # (under subtraction) the kept-parent carry, budget-gated at the
+        # same hist_budget_bytes that sized the live chunk.
+        add("split_hist_chunk", (K, f_shard, C, bins), hist_itemsize,
+            "split",
+            bytes_per_device=K * chunk_bytes_per_slot(
+                f_shard, bins, C, itemsize=hist_itemsize))
+        if subtraction:
+            n_chunks = -(-widest // K)
+            carry = min(
+                int(hist_budget_bytes),
+                n_chunks * slab_bytes(
+                    K, f_shard, C, bins, itemsize=hist_itemsize),
+            )
+            add("parent_hist", (n_chunks, K, f_shard, C, bins),
+                hist_itemsize, "split", bytes_per_device=carry)
+        add("update_tables", (U,), 4, "update",
+            bytes_per_device=table_bytes(U, C))
+
+    if fused_gbdt:
+        # Fused multi-round GBDT: the donated f32 margin carry rides the
+        # whole dispatch (in + out generation live across the scan
+        # boundary), plus the in-program (g, h) recompute. Both are
+        # row-sharded like every per-row array — explicit bytes because
+        # neither name appears in the partition table (the program
+        # derives their placement from the carry's in_specs).
+        add("margin_carry", (rows_pad, max(int(n_out), 1)), 4,
+            "fused_rounds",
+            bytes_per_device=2 * (-(-rows_pad // dr))
+            * max(int(n_out), 1) * 4)
+        add("grad_hess", (rows_pad, 2), 4, "fused_rounds",
+            bytes_per_device=(-(-rows_pad // dr)) * 2 * 4)
+
+    resident = sum(
+        a["bytes_per_device"] for a in arrays if a["phase"] == RESIDENT
+    )
+    phases = {RESIDENT: resident}
+    for ph in FIT_PHASES:
+        extra = sum(
+            a["bytes_per_device"] for a in arrays if a["phase"] == ph
+        )
+        if extra:
+            phases[ph] = resident + extra
+    peak_phase = max(phases, key=lambda p: phases[p])
+    host_peak = (
+        rows * features * 4      # the raw f32 matrix
+        + rows * features * 4    # the binned int32 copy
+        + rows * 16              # y/weight/node_id/leaf_ids host state
+    )
+    return MemoryPlan(
+        kind="fit",
+        mesh_axes=axes,
+        arrays=arrays,
+        phases=phases,
+        hbm_peak_bytes=int(phases[peak_phase]),
+        peak_phase=peak_phase,
+        host_peak_bytes=int(host_peak),
+        inputs={
+            "rows": rows, "features": features, "classes": int(classes),
+            "bins": bins, "task": task,
+            "max_depth": None if max_depth is None else int(max_depth),
+            "max_leaf_nodes": (
+                None if max_leaf_nodes is None else int(max_leaf_nodes)
+            ),
+            "chunk_slots": int(K), "table_slots": int(U),
+            "gbdt_x64": bool(gbdt_x64), "subtraction": bool(subtraction),
+            "rounds_per_dispatch": int(rounds_per_dispatch),
+            "engine": engine,
+        },
+    )
+
+
+def plan_serve(*, n_trees: int, n_nodes_total: int, n_nodes_max: int,
+               n_features: int, value_channels: int, n_out: int,
+               buckets=(1, 64, 4096), x64: bool = False,
+               kernel: bool = False) -> MemoryPlan:
+    """Price a serving model's device residency (the ``plan_fit`` twin
+    for the request path): the flat node table + leaf-value channels
+    (resident from publish), the largest bucket's query/accumulator
+    working set, the optional VMEM-tier stacked tables, and the Pallas
+    VMEM verdict itself (:func:`serve_kernel_row_tile`)."""
+    val_item = 8 if x64 else 4
+    bmax = max(int(b) for b in buckets) if buckets else 1
+    kv = max(int(value_channels), 1)
+    arrays = [
+        {
+            "name": "node_table", "shape": [int(n_nodes_total), 5],
+            "itemsize": 4, "phase": RESIDENT,
+            "bytes_per_device": int(n_nodes_total) * 5 * 4,
+        },
+        {
+            "name": "leaf_values", "shape": [int(n_nodes_total), kv],
+            "itemsize": val_item, "phase": RESIDENT,
+            "bytes_per_device": int(n_nodes_total) * kv * val_item,
+        },
+        {
+            "name": "query_batch", "shape": [bmax, int(n_features)],
+            "itemsize": 4, "phase": "dispatch",
+            "bytes_per_device": bmax * int(n_features) * 4,
+        },
+        {
+            "name": "accumulator", "shape": [bmax, max(int(n_out), 1)],
+            "itemsize": val_item, "phase": "dispatch",
+            "bytes_per_device": bmax * max(int(n_out), 1) * val_item,
+        },
+    ]
+    rt = serve_kernel_row_tile(n_nodes_max, n_features, kv, n_out)
+    if kernel:
+        mp = _round_up(max(int(n_nodes_max), 1), 128)
+        kvp = _round_up(kv, 8)
+        arrays.append({
+            "name": "kernel_tables",
+            "shape": [int(n_trees), 8 + kvp, mp], "itemsize": 4,
+            "phase": RESIDENT,
+            "bytes_per_device": int(n_trees) * (8 + kvp) * mp * 4,
+        })
+    resident = sum(
+        a["bytes_per_device"] for a in arrays if a["phase"] == RESIDENT
+    )
+    dispatch = resident + sum(
+        a["bytes_per_device"] for a in arrays if a["phase"] == "dispatch"
+    )
+    phases = {RESIDENT: resident, "dispatch": dispatch}
+    return MemoryPlan(
+        kind="serve",
+        mesh_axes={"data": 1, "feature": 1},
+        arrays=arrays,
+        phases=phases,
+        hbm_peak_bytes=int(dispatch),
+        peak_phase="dispatch",
+        host_peak_bytes=int(n_nodes_total) * (5 * 4 + kv * val_item),
+        inputs={
+            "n_trees": int(n_trees),
+            "n_nodes_total": int(n_nodes_total),
+            "n_nodes_max": int(n_nodes_max),
+            "n_features": int(n_features),
+            "value_channels": kv, "n_out": int(n_out),
+            "buckets": [int(b) for b in buckets],
+            "x64": bool(x64), "kernel": bool(kernel),
+            "vmem_row_tile": rt,
+            "vmem_fits": rt is not None,
+            "vmem_budget_bytes": SERVE_VMEM_BUDGET_BYTES,
+        },
+    )
+
+
+# ---------------------------------------------------------------------------
+# budgets + preflight
+# ---------------------------------------------------------------------------
+
+def device_hbm_budget(device=None) -> int | None:
+    """The per-device HBM budget the preflight checks against:
+    ``MPITREE_TPU_HBM_BYTES`` wins (the operator knows best); else the
+    backend's reported ``bytes_limit`` (TPU runtimes provide it; CPU
+    backends report nothing → None → no refusal — the planner never
+    guesses a budget)."""
+    env = os.environ.get(HBM_BUDGET_ENV)
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            return None
+    try:
+        import jax
+
+        dev = device if device is not None else jax.local_devices()[0]
+        stats = dev.memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return int(stats["bytes_limit"])
+    except Exception:
+        return None
+    return None
+
+
+def preflight(plan: MemoryPlan, *, obs=None, what: str = "fit",
+              device=None) -> None:
+    """Refuse an impossible config BEFORE dispatch (the planner's public
+    gate): no-op when no budget is known."""
+    plan.check(device_hbm_budget(device), obs=obs, what=what)
+
+
+# ---------------------------------------------------------------------------
+# live watermark sampling
+# ---------------------------------------------------------------------------
+
+def host_rss_bytes() -> int | None:
+    """Host resident-set size, or None where unreadable."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+
+        kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return int(kb) * 1024
+    except Exception:
+        return None
+
+
+def live_hbm_bytes(device=None) -> tuple:
+    """(bytes, source) for one device's live allocation.
+
+    Prefers the backend's ``memory_stats()['bytes_in_use']`` (TPU);
+    CPU backends fall back to summing the shard bytes of every live
+    ``jax.Array`` addressable on that device (``"live_arrays"`` — sees
+    resident arrays only, not XLA scratch; the drift tolerance accounts
+    for it). (0, "none") when nothing is measurable."""
+    try:
+        import jax
+
+        dev = device if device is not None else jax.local_devices()[0]
+    except Exception:
+        return 0, "none"
+    try:
+        stats = dev.memory_stats()
+        if stats and stats.get("bytes_in_use") is not None:
+            return int(stats["bytes_in_use"]), "memory_stats"
+    except Exception:
+        pass
+    total = 0
+    try:
+        import gc
+
+        import jax
+
+        # The fallback counts python-held arrays: collect first, or
+        # cycle-retained garbage from earlier levels/fits (dead carry
+        # buffers waiting on the gc) inflates "live" several-fold.
+        # Opt-in sampling at span boundaries only, so the collect's
+        # milliseconds never touch a production path.
+        gc.collect()
+        for a in jax.live_arrays():
+            try:
+                for shard in a.addressable_shards:
+                    if shard.device == dev:
+                        total += int(shard.data.nbytes)
+            except Exception:
+                continue
+    except Exception:
+        return 0, "none"
+    return total, "live_arrays"
+
+
+class MemWatch:
+    """Span-boundary live-memory watermark tracker.
+
+    One instance per observer (``BuildObserver.watch_memory`` /
+    ``MPITREE_TPU_MEM_SAMPLE=1``): the observer calls :meth:`sample` at
+    every span close — never inside a device program — and the summary
+    lands in ``record.memory['live']``. The baseline is the first
+    sample, so ``hbm_peak_delta_bytes`` is what THIS fit added on top of
+    whatever the process already held."""
+
+    def __init__(self, device=None):
+        self.device = device
+        self.source = "none"
+        self.samples = 0
+        self.hbm_baseline: int | None = None
+        self.hbm_peak = 0
+        self.host_peak = 0
+        # The most recent raw readings — what the Perfetto counter track
+        # plots (the peaks above are a cummax and would render a flat
+        # high line that can never show memory being released).
+        self.hbm_last = 0
+        self.host_last = 0
+
+    def sample(self) -> None:
+        hbm, source = live_hbm_bytes(self.device)
+        if source != "none":
+            self.source = source
+            self.hbm_last = hbm
+            if self.hbm_baseline is None:
+                self.hbm_baseline = hbm
+            self.hbm_peak = max(self.hbm_peak, hbm)
+        rss = host_rss_bytes()
+        if rss:
+            self.host_last = rss
+            self.host_peak = max(self.host_peak, rss)
+        self.samples += 1
+
+    def summary(self) -> dict:
+        base = self.hbm_baseline or 0
+        return {
+            "source": self.source,
+            "samples": int(self.samples),
+            "hbm_baseline_bytes": int(base),
+            "hbm_peak_bytes": int(self.hbm_peak),
+            "hbm_peak_delta_bytes": int(max(self.hbm_peak - base, 0)),
+            "host_peak_bytes": int(self.host_peak),
+        }
+
+
+def drift_tolerance() -> float:
+    try:
+        return float(os.environ.get(DRIFT_TOL_ENV, DRIFT_TOL_DEFAULT))
+    except ValueError:
+        return DRIFT_TOL_DEFAULT
+
+
+def drift_check(estimate: int | None, live_delta: int | None,
+                source: str = "memory_stats") -> dict | None:
+    """Ledger-vs-live verdict; a dict of event fields when the delta
+    crosses the threshold, else None.
+
+    An UNDERESTIMATE — live measurably above the analytical peak, >25%
+    — reports on every source (the ledger's one unforgivable failure
+    mode: a preflight that said "fits" while the device filled up). An
+    OVERESTIMATE reports only past the tolerance factor AND only on the
+    ``memory_stats`` source: the ``live_arrays`` fallback sees resident
+    python-held arrays, not XLA scratch, so the analytical peak (which
+    prices the transient chunk working set) legitimately sits well above
+    it."""
+    if not estimate or live_delta is None or live_delta <= 0:
+        return None
+    tol = drift_tolerance()
+    ratio = estimate / live_delta
+    over = source == "memory_stats" and ratio > tol
+    under = ratio < 0.8
+    if not (over or under):
+        return None
+    return {
+        "estimate_bytes": int(estimate),
+        "live_delta_bytes": int(live_delta),
+        "ratio": round(ratio, 3),
+        "tolerance": tol,
+        "source": source,
+        "direction": "underestimate" if under else "overestimate",
+    }

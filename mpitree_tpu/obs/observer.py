@@ -42,6 +42,7 @@ import time
 import warnings
 from collections import OrderedDict
 
+from mpitree_tpu.obs import memory as memory_mod
 from mpitree_tpu.obs import trace as trace_mod
 from mpitree_tpu.obs.record import BuildRecord, _jsonable, wire_estimate
 from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
@@ -250,6 +251,34 @@ class BuildObserver(PhaseTimer):
             self.trace_to(os.path.join(
                 tdir, f"trace_{os.getpid()}_{self._trace_seq}.json"
             ))
+        # Live memory watermarks (obs/memory.py, ISSUE 12): sampled at
+        # span boundaries only, and only when a watch is installed —
+        # the disabled path pays one `is None` check per span (inside
+        # the pinned <5% budget).
+        self._memwatch: memory_mod.MemWatch | None = None
+        if os.environ.get(memory_mod.MEM_SAMPLE_ENV) == "1":
+            self.watch_memory()
+
+    def watch_memory(self, watch=None) -> None:
+        """Enable span-boundary live-memory sampling (the ambient form is
+        ``MPITREE_TPU_MEM_SAMPLE=1``). Implies timing — watermark samples
+        without spans would never fire."""
+        self._memwatch = (
+            watch if watch is not None else memory_mod.MemWatch()
+        )
+        self._memwatch.sample()  # baseline: what the process already held
+        self.enabled = True
+
+    def memory_plan(self, plan) -> None:
+        """Record the analytical memory ledger (a
+        :class:`~mpitree_tpu.obs.memory.MemoryPlan` or its dict) under
+        ``record.memory`` — the always-on channel every engine writes
+        once per fit, before its first dispatch."""
+        d = plan if isinstance(plan, dict) else plan.to_dict()
+        live = self.record.memory.get("live")
+        self.record.memory = dict(d)
+        if live is not None:
+            self.record.memory["live"] = live
 
     def trace_to(self, sink, *, track: str | None = None) -> None:
         """Emit this observer's timeline into ``sink`` (a path, or a
@@ -346,7 +375,8 @@ class BuildObserver(PhaseTimer):
     @contextlib.contextmanager
     def phase(self, name: str):
         tr = self._trace
-        if not self.enabled and tr is None:
+        mw = self._memwatch
+        if not self.enabled and tr is None and mw is None:
             yield
             return
         t0 = time.perf_counter()
@@ -357,6 +387,19 @@ class BuildObserver(PhaseTimer):
             if self.enabled:
                 self.seconds[name] += dt
                 self.calls[name] += 1
+            if mw is not None:
+                # Span-boundary watermark sample (never inside a device
+                # program); rendered as a Perfetto counter track next to
+                # the PR-9 ICI tracks when a trace sink is live.
+                mw.sample()
+                if tr is not None:
+                    # Current readings, not the cummax peaks — the track
+                    # must show memory being RELEASED (a dropped carry
+                    # buffer correlating with a span edge).
+                    tr.counter(
+                        "mem", "mem_hbm_bytes", time.perf_counter(),
+                        {"hbm": mw.hbm_last, "host": mw.host_last},
+                    )
             if tr is not None:
                 tr.complete(self._trace_track, name, t0, dt)
                 w = self._trace_window
@@ -524,6 +567,42 @@ class BuildObserver(PhaseTimer):
             rec.collectives,
             rec.mesh.get("axes") or rec.mesh.get("n_devices"),
         )
+        if self._memwatch is not None:
+            # Final watermark sample + the ledger-vs-live verdict: a
+            # delta past the threshold becomes a typed event so drifting
+            # pricing formulas surface in fit_report_, not just dashboards.
+            self._memwatch.sample()
+            live = self._memwatch.summary()
+            rec.memory["live"] = live
+            # Drift checking is calibrated for SINGLE-build fits: a
+            # multi-round boosting loop records one per-round plan while
+            # the live watermark spans every round's state (old rounds'
+            # buffers linger until the allocator reuses them), so the
+            # comparison would fire spurious underestimates on healthy
+            # fits. Fused multi-round dispatches are one program under
+            # one plan and keep the check. (Whole-fit plan aggregation
+            # for host-loop ensembles: ROADMAP obs.memory follow-up.)
+            multi_build = bool(rec.rounds) and (
+                rec.memory.get("inputs", {}).get("engine")
+                != "fused_rounds"
+            )
+            drift = None if multi_build else memory_mod.drift_check(
+                rec.memory.get("hbm_peak_bytes"),
+                live.get("hbm_peak_delta_bytes"),
+                live.get("source", "none"),
+            )
+            if drift is not None and not any(
+                e.get("kind") == "mem_estimate_drift" for e in rec.events
+            ):
+                self.event(
+                    "mem_estimate_drift",
+                    "analytical memory ledger and live watermark diverge: "
+                    f"estimate {drift['estimate_bytes']} B vs live delta "
+                    f"{drift['live_delta_bytes']} B "
+                    f"({drift['direction']}, ratio {drift['ratio']}; "
+                    f"tolerance {drift['tolerance']}x)",
+                    **drift,
+                )
         out = rec.to_dict()
         if self._trace is not None:
             # Post-hoc replay: level/round rows (the fused engines' exact

@@ -47,7 +47,13 @@ import json
 # over a 4-wide data axis on a (4, 2) mesh rings over 4 shards, not 8);
 # top-level ``wire`` gained ``axes``/``data_bytes``/``feature_bytes``
 # and the digest a ``feature_shards`` field.
-SCHEMA_VERSION = 5
+# v6 (ISSUE 12, obs.memory): top-level ``memory`` — the device/host
+# memory ledger (``obs/memory.py``): analytical per-array per-device
+# byte rows with per-phase watermarks priced from the partition-rule
+# table, plus the ``live`` span-boundary watermark samples when
+# ``MPITREE_TPU_MEM_SAMPLE=1``; digest gained
+# ``hbm_peak_bytes``/``host_peak_bytes``.
+SCHEMA_VERSION = 6
 
 # Which mesh axis each collective site reduces/gathers over — the wire
 # ledger's per-axis attribution. Every histogram/counts/y-range reduction
@@ -77,6 +83,7 @@ TOP_LEVEL_FIELDS = (
     "result",
     "level_stream",
     "wire",
+    "memory",
 )
 
 
@@ -157,6 +164,12 @@ class BuildRecord:
       down as ``data_bytes``/``feature_bytes`` (v5). Zero on a single
       device (no ICI hop exists). Populated by
       ``BuildObserver.report()``.
+    - ``memory`` (v6): the device/host memory ledger
+      (``obs.memory.MemoryPlan.to_dict()``) — per-array per-device byte
+      rows with per-phase watermarks, ``hbm_peak_bytes``/
+      ``host_peak_bytes``, the pricing inputs, and (with sampling on) a
+      ``live`` section of span-boundary watermarks; ``{}`` when the
+      engine recorded no plan.
     """
 
     schema: int = SCHEMA_VERSION
@@ -174,6 +187,7 @@ class BuildRecord:
     result: dict = dataclasses.field(default_factory=dict)
     level_stream: dict = dataclasses.field(default_factory=dict)
     wire: dict = dataclasses.field(default_factory=dict)
+    memory: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return _jsonable(dataclasses.asdict(self))
@@ -311,6 +325,15 @@ def digest(report: dict) -> dict:
         "feature_shards": (
             report.get("mesh", {}).get("axes", {}) or {}
         ).get("feature", 1),
+        # The memory ledger's predicted per-device peak HBM and host RAM
+        # (v6): None when the engine recorded no plan (plain-PhaseTimer
+        # callers, pre-v6 records).
+        "hbm_peak_bytes": (report.get("memory") or {}).get(
+            "hbm_peak_bytes"
+        ),
+        "host_peak_bytes": (report.get("memory") or {}).get(
+            "host_peak_bytes"
+        ),
         "wall_s": round(wall, 3),
     }
 

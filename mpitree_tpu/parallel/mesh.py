@@ -61,12 +61,17 @@ def tree_data_shape(n_devices: int, n_trees: int, *, dataset_bytes: int = 0,
     sharding. With ``tree_shards < n_trees`` each device builds its tree
     batch sequentially (``lax.map``), exactly as before.
     """
+    from mpitree_tpu.obs import memory as memory_lib
+
     d = max(int(n_devices), 1)
     divisors = [k for k in range(1, d + 1) if d % k == 0]
     t = max(k for k in divisors if k <= max(int(n_trees), 1))
-    if hbm_budget:
-        while t > 1 and dataset_bytes > hbm_budget * (d // t):
-            t = max(k for k in divisors if k < t)
+    # The HBM guard's arithmetic lives in obs.memory (ISSUE 12: the
+    # capacity planner and the shape policy read ONE pricing source;
+    # pinned equal to the pre-refactor inline loop).
+    t = memory_lib.tree_shards_for_budget(
+        t, dataset_bytes, hbm_budget, divisors, d
+    )
     return t, d // t
 
 
@@ -147,28 +152,44 @@ def data_feature_shape(n_devices: int, n_features: int, *,
     ``core/builder._chunk_size``); ``hist_budget`` the same
     ``BuildConfig.hist_budget_bytes`` knob that sizes the live chunk.
     """
+    from mpitree_tpu.obs import memory as memory_lib
+
     d = max(int(n_devices), 1)
     divisors = [k for k in range(1, d + 1) if d % k == 0]
     usable = [k for k in divisors if k <= max(int(n_features), 1)]
-    f = 1
-    if hist_budget:
-        while f < max(usable) and hist_bytes > hist_budget * f:
-            f = min(k for k in usable if k > f)
+    # Feature-shard engagement threshold: obs.memory owns the arithmetic
+    # (the ONE pricing source — pinned equal to the pre-refactor inline
+    # loop on the existing test grid).
+    f = memory_lib.feature_shards_for_budget(hist_bytes, hist_budget, usable)
     return d // f, f
 
 
 def resolve_mesh_2d(*, n_features: int, hist_bytes: int = 0,
                     hist_budget: int | None = None,
-                    backend: str | None = None, n_devices=None) -> Mesh:
+                    backend: str | None = None, n_devices=None,
+                    chunk_slots: int | None = None,
+                    n_classes: int | None = None,
+                    n_bins: int | None = None) -> Mesh:
     """2-D ``(data, feature)`` mesh factory with the shape policy applied.
 
     ``n_devices`` follows :func:`resolve_mesh`'s grammar for a TOTAL
     device count (None/int/"all"); the split between the two axes comes
     from :func:`data_feature_shape`. An explicit ``(dr, df)`` tuple
     bypasses the policy (same as :func:`resolve_mesh`).
+
+    ``chunk_slots``/``n_classes``/``n_bins`` (optional): price
+    ``hist_bytes`` from the workload shape via the obs.memory slab
+    formula instead of passing pre-computed bytes — the planner-driven
+    form (``hist_bytes`` wins when both are given).
     """
     if isinstance(n_devices, tuple):
         return resolve_mesh(backend=backend, n_devices=n_devices)
+    if not hist_bytes and chunk_slots and n_bins:
+        from mpitree_tpu.obs import memory as memory_lib
+
+        hist_bytes = memory_lib.slab_bytes(
+            chunk_slots, n_features, n_classes or 2, n_bins
+        )
     devs = available_devices(backend)
     if n_devices in (None, 1):
         n = 1

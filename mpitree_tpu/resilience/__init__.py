@@ -35,6 +35,7 @@ from mpitree_tpu.resilience.config import (
 )
 from mpitree_tpu.resilience.failure import (
     is_device_failure,
+    is_oom_failure,
     is_transient_failure,
 )
 from mpitree_tpu.resilience.retry import device_failover, retry_device
@@ -49,6 +50,7 @@ __all__ = [
     "device_failover",
     "elastic_enabled",
     "is_device_failure",
+    "is_oom_failure",
     "is_transient_failure",
     "retry_device",
 ]

@@ -76,6 +76,10 @@ _STATUS = {
     "cancelled": "CANCELLED",
     "internal": "INTERNAL",
     "data_loss": "DATA_LOSS",
+    # ISSUE 12: allocator exhaustion, terminal by classification — the
+    # seam the OOM-postmortem and straight-to-host-rung tests inject
+    # (message mirrors a real PJRT allocator failure).
+    "oom": "RESOURCE_EXHAUSTED",
 }
 
 _KINDS = tuple(_STATUS) + ("nan", "hang", "kill")
@@ -198,6 +202,11 @@ def _current() -> ChaosPlan | None:
 
 
 def _fire(f: Fault, site: str, n: int) -> None:
+    if f.kind == "oom":
+        raise ChaosXlaError(
+            f"RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+            f"chaos-injected fault at {site}#{n}"
+        )
     if f.kind in _STATUS:
         raise ChaosXlaError(
             f"{_STATUS[f.kind]}: chaos-injected fault at {site}#{n}"

@@ -39,6 +39,7 @@ _TRANSPORT_MARKERS = (
     "DATA_LOSS",
     "ABORTED",
     "CANCELLED",
+    "RESOURCE_EXHAUSTED",
     "Connection",
     "connection",
     "socket",
@@ -52,7 +53,15 @@ _TRANSPORT_MARKERS = (
 # Checked with PRIORITY over the transient markers — a real
 # "INTERNAL: PJRT_LoadedExecutable_Execute failed" carries both kinds of
 # token, and burning the retry budget on it would just delay the rescue.
-_TERMINAL_MARKERS = ("INTERNAL", "DATA_LOSS")
+# RESOURCE_EXHAUSTED (ISSUE 12): an OOM is deterministic for a given
+# program + live state — re-dispatching the identical program burns the
+# whole retry/backoff ladder to fail identically, so it goes straight to
+# the host rung (with the memory ledger's postmortem, resilience/retry).
+_TERMINAL_MARKERS = ("INTERNAL", "DATA_LOSS", "RESOURCE_EXHAUSTED")
+
+# OOM-shaped markers (the postmortem trigger): the gRPC status plus the
+# prose PJRT puts in allocator failures.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
 
 # The retryable subset: statuses a healthy-again transport serves on the
 # next attempt.
@@ -162,6 +171,20 @@ def is_device_failure(exc: BaseException) -> bool:
     if isinstance(exc, _USER_ERROR_TYPES):
         return False
     return any(_one_is_device_failure(e) for e in _chain(exc))
+
+
+def is_oom_failure(exc: BaseException) -> bool:
+    """True when the failure is allocator exhaustion (RESOURCE_EXHAUSTED
+    / "Out of memory") anywhere down the chain — terminal by
+    classification (see ``_TERMINAL_MARKERS``), and the trigger for the
+    retry ladder's memory-ledger postmortem."""
+    if isinstance(exc, _USER_ERROR_TYPES):
+        return False
+    return any(
+        any(m in str(e) for m in _OOM_MARKERS)
+        and _one_is_device_failure(e)
+        for e in _chain(exc)
+    )
 
 
 def is_transient_failure(exc: BaseException) -> bool:

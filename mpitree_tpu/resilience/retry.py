@@ -38,8 +38,47 @@ from mpitree_tpu.resilience.config import (
 )
 from mpitree_tpu.resilience.failure import (
     is_device_failure,
+    is_oom_failure,
     is_transient_failure,
 )
+
+
+def _oom_postmortem(e: BaseException, what: str, obs) -> None:
+    """Attach the memory ledger's top arrays to the record when a
+    dispatch died of RESOURCE_EXHAUSTED (ISSUE 12).
+
+    OOM is classified terminal (``failure._TERMINAL_MARKERS``), so the
+    retry rung never burns its budget on it — this postmortem is what
+    the fit_report_ carries instead: the analytical ledger's largest
+    per-device arrays, i.e. what to shrink. One event per record
+    (re-raises down the ladder must not duplicate it)."""
+    if obs is None or not is_oom_failure(e):
+        return
+    rec = getattr(obs, "record", None)
+    if rec is None or any(
+        ev.get("kind") == "oom_postmortem" for ev in rec.events
+    ):
+        return
+    mem = rec.memory or {}
+    top = sorted(
+        mem.get("arrays", []),
+        key=lambda a: -int(a.get("bytes_per_device", 0)),
+    )[:5]
+    obs.counter("device_ooms")
+    obs.event(
+        "oom_postmortem",
+        f"device OOM during {what} ({type(e).__name__}: "
+        f"{str(e)[:160]}); terminal — not retried. The memory ledger's "
+        "largest per-device arrays are attached (top); shrink the "
+        "binding one or widen the data axis.",
+        hbm_peak_bytes=mem.get("hbm_peak_bytes"),
+        peak_phase=mem.get("peak_phase"),
+        top=[
+            {"name": a.get("name"),
+             "bytes": int(a.get("bytes_per_device", 0))}
+            for a in top
+        ],
+    )
 
 
 def _transient_retry(e: BaseException, attempt: int, cfg: ResilienceConfig,
@@ -96,6 +135,7 @@ def retry_device(device_fn, *, what: str, obs=None,
             return device_fn()
         except Exception as e:  # noqa: BLE001 — classified, not swallowed
             if not _transient_retry(e, attempt, cfg, what, obs):
+                _oom_postmortem(e, what, obs)
                 raise
             attempt += 1
 
@@ -126,10 +166,12 @@ def device_failover(device_fn, host_fn, *, what: str, obs=None,
             return device_fn()
         except Exception as e:  # noqa: BLE001 — classified, not swallowed
             if not (elastic_enabled() and is_device_failure(e)):
+                _oom_postmortem(e, what, obs)
                 raise
             if _transient_retry(e, attempt, cfg, what, obs):
                 attempt += 1
                 continue
+            _oom_postmortem(e, what, obs)
             if obs is not None:
                 obs.counter("device_failovers")
             warnings.warn(

@@ -38,6 +38,7 @@ import numpy as np
 import jax
 
 from mpitree_tpu.obs import BuildObserver
+from mpitree_tpu.obs import memory as memory_lib
 from mpitree_tpu.obs.metrics import MetricsRegistry
 from mpitree_tpu.resilience import chaos, retry_device
 from mpitree_tpu.serving import pallas_serve, traversal
@@ -182,6 +183,30 @@ class CompiledModel:
                 "XLA gather traversal (policy: resolve_serving_kernel)"
             ),
         )
+        # Serving memory ledger (obs.memory, ISSUE 12): the published
+        # model's device residency — flat table + value channels + the
+        # largest bucket's working set (+ the stacked VMEM-tier tables
+        # when the kernel engaged) — recorded so serve_report_ carries
+        # capacity the same way fit_report_ does.
+        self._obs.memory_plan(memory_lib.plan_serve(
+            n_trees=len(self.trees),
+            n_nodes_total=sum(t.n_nodes for t in self.trees),
+            n_nodes_max=max(t.n_nodes for t in self.trees),
+            n_features=self.n_features, value_channels=kv,
+            n_out=self.n_out, buckets=self.buckets, x64=self._x64,
+            kernel=self._use_kernel,
+        ))
+        # Per-request deadline tracking (carried ROADMAP obs follow-up):
+        # schedulers report misses here so metrics_text() exposes them
+        # under the model label next to the latency histograms.
+        self._m_deadline = self.metrics.counter(
+            "mpitree_serving_deadline_misses_total"
+        )
+
+    def note_deadline_miss(self, n: int = 1) -> None:
+        """Count requests answered past their deadline (the EDF
+        micro-batcher's SLO signal — ``examples/serving_run.py``)."""
+        self._m_deadline.inc(n)
 
     # -- dispatch ----------------------------------------------------------
     def _bucket(self, n: int) -> int:

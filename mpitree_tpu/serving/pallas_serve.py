@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from mpitree_tpu.obs import memory as memory_lib
 from mpitree_tpu.ops.pallas_hist import _round_up, pallas_available
 
 
@@ -194,21 +195,19 @@ def build_kernel_values(trees, channel_fn, kv: int) -> np.ndarray:
 
 # Conservative VMEM ceiling (same stance as pallas_hist): the persistent
 # out block + one tree's table/value blocks + the one-hot working set.
-_VMEM_BUDGET_BYTES = 10 << 20
+# The arithmetic lives in obs.memory (ISSUE 12: the serving capacity
+# planner and this kernel gate read ONE pricing source — pinned equal to
+# the pre-refactor loop); this module keeps thin delegates so kernel
+# callers and the policy below stay import-stable.
+_VMEM_BUDGET_BYTES = memory_lib.SERVE_VMEM_BUDGET_BYTES
 
 
 def kernel_row_tile(n_nodes_max: int, n_features: int, kv: int,
                     n_out: int) -> int | None:
     """Largest row tile whose working set fits the VMEM budget, or None."""
-    mp = _round_up(max(n_nodes_max, 1), 128)
-    fp = _round_up(max(n_features, 1), 8)
-    # table (8, Mp) + value (Kvp, Mp) blocks, both sublane-padded
-    blocks = mp * (8 + _round_up(max(kv, 1), 8)) * 4
-    for rt in (1024, 512, 256, 128, 64, 8):
-        work = rt * (mp + 2 * fp + 4 + max(n_out, 1)) * 4
-        if blocks + work <= _VMEM_BUDGET_BYTES:
-            return rt
-    return None
+    return memory_lib.serve_kernel_row_tile(
+        n_nodes_max, n_features, kv, n_out, budget=_VMEM_BUDGET_BYTES
+    )
 
 
 def fits_vmem(n_nodes_max: int, n_features: int, kv: int,

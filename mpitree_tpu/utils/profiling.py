@@ -92,6 +92,10 @@ class PhaseTimer:
     def compile_note(self, entry: str, key, cache_size: int = 64) -> bool:
         return False
 
+    def memory_plan(self, plan) -> None:
+        """No-op twin of BuildObserver.memory_plan (the obs.memory
+        device/host ledger); plain timers pay nothing."""
+
     @contextlib.contextmanager
     def compile_attribution(self, entry: str, fresh: bool = True):
         """No-op twin of BuildObserver.compile_attribution (cold-dispatch

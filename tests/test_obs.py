@@ -59,7 +59,9 @@ def test_build_record_schema_golden():
     # v5 (ISSUE 10): wire attributes per MESH AXIS (site entries carry
     # 'axis', top level gains axes/data_bytes/feature_bytes) and the
     # digest gains feature_shards
-    assert rep["schema"] == SCHEMA_VERSION == 5
+    # v6 (ISSUE 12): top-level memory (the obs.memory device/host
+    # ledger) and digest hbm_peak_bytes/host_peak_bytes
+    assert rep["schema"] == SCHEMA_VERSION == 6
     # dataclass fields and the pinned tuple must agree too
     assert tuple(
         f.name for f in dataclasses.fields(BuildRecord)
@@ -70,6 +72,7 @@ def test_build_record_schema_golden():
         "engine", "reason", "n_nodes", "depth", "levels", "compile_new",
         "psum_bytes", "sub_frac", "expansions", "rounds_per_dispatch",
         "events", "wire_bytes", "wire_shard_bytes", "feature_shards",
+        "hbm_peak_bytes", "host_peak_bytes",
         "wall_s",
     )))
 

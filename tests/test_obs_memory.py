@@ -1,0 +1,571 @@
+"""obs.memory — ledger schema, pricing oracles, parity, and preflight.
+
+The ISSUE 12 contracts:
+
+- **golden ``record.memory`` schema** — field names pinned like the
+  top-level record's;
+- **numpy oracles** for the slab/pool/table pricing formulas, and
+  **one-pricing-source pins**: ``mesh.data_feature_shape`` /
+  ``tree_data_shape``, ``core/builder._chunk_size`` and the serving
+  VMEM gate must compute exactly what their pre-refactor inline
+  formulas did;
+- **ledger-vs-live parity** on CPU: over a (shape x mesh x engine x
+  subtraction) grid the analytical per-device estimate brackets the
+  measured live allocation within a documented tolerance;
+- **preflight refusal**: an absurd budget raises
+  :class:`MemoryPlanError` BEFORE any device dispatch, with a typed
+  ``oom_predicted`` event naming the binding array;
+- **OOM resilience**: RESOURCE_EXHAUSTED is terminal-not-transient,
+  the chaos ``oom`` kind injects it, and the ladder attaches the
+  ledger's top arrays as an ``oom_postmortem`` instead of retrying.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from mpitree_tpu.core import builder as builder_mod
+from mpitree_tpu.core.builder import BuildConfig, build_tree
+from mpitree_tpu.core.leafwise_builder import _pool_capacity
+from mpitree_tpu.obs import BuildObserver, digest
+from mpitree_tpu.obs import memory
+from mpitree_tpu.obs.memory import MemoryPlanError
+from mpitree_tpu.ops.binning import bin_dataset
+from mpitree_tpu.parallel import mesh as mesh_lib
+from mpitree_tpu.resilience import chaos
+from mpitree_tpu.resilience.failure import (
+    is_device_failure,
+    is_oom_failure,
+    is_transient_failure,
+)
+from mpitree_tpu.resilience.retry import device_failover, retry_device
+
+# Ledger-vs-live bracket (DOCUMENTED tolerance, also in README):
+# live resident (what span-boundary sampling of python-held jax.Arrays
+# can see) must not exceed the analytical peak by more than 25%
+# (est >= 0.8 * live), and the analytical peak — which prices TRANSIENT
+# working sets the sampler cannot observe (the K-slot chunk histogram,
+# gain-sweep accumulators) — must stay within 64x of live resident.
+PARITY_LO = 0.8
+PARITY_HI = 64.0
+
+
+def _data(n=6000, f=10, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int64) + (X[:, 1] > 0.5)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# golden record.memory schema
+# ---------------------------------------------------------------------------
+
+def test_memory_plan_schema_golden():
+    plan = memory.plan_fit(
+        rows=1000, features=8, classes=3, bins=64, max_depth=5,
+        mesh_axes={"data": 4, "feature": 2},
+    )
+    d = plan.to_dict()
+    assert tuple(sorted(d)) == tuple(sorted((
+        "schema", "kind", "mesh_axes", "arrays", "phases",
+        "hbm_peak_bytes", "peak_phase", "host_peak_bytes", "inputs",
+    )))
+    assert d["schema"] == memory.MEMORY_SCHEMA == 1
+    for a in d["arrays"]:
+        assert tuple(sorted(a)) == tuple(sorted((
+            "name", "shape", "itemsize", "phase", "bytes_per_device",
+        )))
+    # JSON-able by construction (the record embeds it verbatim)
+    import json
+
+    assert json.loads(json.dumps(d)) == d
+
+
+def test_digest_carries_memory_peaks():
+    obs = BuildObserver(timing=False)
+    obs.memory_plan(memory.plan_fit(rows=100, features=4, bins=16))
+    d = digest(obs.report())
+    assert d["hbm_peak_bytes"] > 0
+    assert d["host_peak_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# pricing oracles + one-pricing-source pins
+# ---------------------------------------------------------------------------
+
+def test_formula_oracles():
+    # chunk working set per slot: F*B*(C_pad8*item + 8 accumulators f32)
+    assert memory.chunk_bytes_per_slot(12, 64, 3) == 12 * 64 * (8 * 4 + 32)
+    assert memory.chunk_bytes_per_slot(5, 32, 9, itemsize=8) == (
+        5 * 32 * (16 * 8 + 32)
+    )
+    # resident slab: S*F*C*B*item
+    assert memory.slab_bytes(8, 54, 7, 256) == 8 * 54 * 7 * 256 * 4
+    assert memory.slab_bytes(2, 3, 3, 8, itemsize=8) == 2 * 3 * 3 * 8 * 8
+    # leaf pool (count, g, h) f32
+    assert memory.pool_hist_bytes(255, 54, 256) == 255 * 54 * 3 * 256 * 4
+    # update/counts tables: U*(bool + 4 int32) + U*C*f32
+    assert memory.table_bytes(512, 7) == 512 * 17 + 512 * 7 * 4
+    # serving flat table: 5 property columns + (M, Kv) values
+    assert memory.node_table_bytes(1000, 3) == 1000 * 20 + 1000 * 3 * 4
+
+
+def test_pool_capacity_matches_leafwise_engine():
+    for mln, md, n in ((255, None, 10**6), (255, 6, 10**6),
+                       (4096, 20, 100), (2, 1, 50)):
+        assert memory.pool_capacity(mln, md, n) == _pool_capacity(mln, md, n)
+
+
+def test_chunk_size_pinned_to_pre_refactor_formula():
+    """builder._chunk_size must compute exactly what its inline formula
+    did before the pricing moved to obs.memory."""
+    for n, f, b, c, budget, cap in (
+        (531_000, 54, 256, 7, 4 << 30, 4096),
+        (48_000, 54, 256, 7, 1 << 28, 4096),
+        (2_000, 8, 64, 3, 4 << 30, 4096),
+        (100, 4, 16, 2, 1 << 20, 64),
+    ):
+        cfg = BuildConfig(hist_budget_bytes=budget, max_frontier_chunk=cap,
+                          max_depth=20)
+        c_pad = ((c + 7) // 8) * 8
+        per_node = f * b * (c_pad * 4 + 8 * 4)
+        old_cap = min(max(1, budget // max(per_node, 1)), cap)
+        widest = min(n, 2 ** 20)
+        want = 1 << max(0, math.ceil(math.log2(max(widest, 1))))
+        expect = min(want, 1 << int(math.log2(old_cap)))
+        assert builder_mod._chunk_size(n, f, b, c, cfg) == expect
+
+
+def test_data_feature_shape_pinned_to_pre_refactor_policy():
+    """The feature-shard engagement threshold must route through
+    obs.memory WITHOUT behavior drift (acceptance pin): grid equality
+    against the pre-PR inline loop."""
+
+    def oracle(d, n_features, hist_bytes, hist_budget):
+        divisors = [k for k in range(1, d + 1) if d % k == 0]
+        usable = [k for k in divisors if k <= max(int(n_features), 1)]
+        f = 1
+        if hist_budget:
+            while f < max(usable) and hist_bytes > hist_budget * f:
+                f = min(k for k in usable if k > f)
+        return d // f, f
+
+    grid = [
+        (8, 54, 0, None), (8, 54, 1 << 20, None),
+        (8, 54, 4 << 20, 1 << 20), (8, 54, 2 << 20, 1 << 20),
+        (8, 3, 64 << 20, 1 << 20), (1, 54, 0, 1),
+        (4, 2, 10 << 20, 1 << 20), (16, 54, 32 << 20, 1 << 20),
+    ]
+    for d, nf, hb, budget in grid:
+        assert mesh_lib.data_feature_shape(
+            d, nf, hist_bytes=hb, hist_budget=budget
+        ) == oracle(d, nf, hb, budget)
+
+
+def test_tree_data_shape_pinned_to_pre_refactor_policy():
+    def oracle(d, n_trees, dataset_bytes, hbm_budget):
+        divisors = [k for k in range(1, d + 1) if d % k == 0]
+        t = max(k for k in divisors if k <= max(int(n_trees), 1))
+        if hbm_budget:
+            while t > 1 and dataset_bytes > hbm_budget * (d // t):
+                t = max(k for k in divisors if k < t)
+        return t, d // t
+
+    grid = [
+        (8, 8, 0, None), (8, 2, 0, None), (8, 8, 100, 30),
+        (8, 8, 10**9, 1), (8, 5, 10**6, 10**5), (1, 4, 0, None),
+    ]
+    for d, nt, db, budget in grid:
+        assert mesh_lib.tree_data_shape(
+            d, nt, dataset_bytes=db, hbm_budget=budget
+        ) == oracle(d, nt, db, budget)
+
+
+def test_serve_vmem_gate_pinned_to_pre_refactor_formula():
+    """serving fits_vmem now reads obs.memory — pinned equal to the
+    pre-PR loop (acceptance pin)."""
+    from mpitree_tpu.serving import pallas_serve
+
+    def oracle(n_nodes_max, n_features, kv, n_out):
+        def up(x, m):
+            return -(-x // m) * m
+
+        mp = up(max(n_nodes_max, 1), 128)
+        fp = up(max(n_features, 1), 8)
+        blocks = mp * (8 + up(max(kv, 1), 8)) * 4
+        for rt in (1024, 512, 256, 128, 64, 8):
+            work = rt * (mp + 2 * fp + 4 + max(n_out, 1)) * 4
+            if blocks + work <= 10 << 20:
+                return rt
+        return None
+
+    grid = [
+        (100, 10, 1, 1), (5000, 54, 7, 7), (50_000, 54, 7, 7),
+        (200_000, 54, 1, 1), (1_000_000, 54, 1, 1), (127, 8, 3, 3),
+    ]
+    for args in grid:
+        assert pallas_serve.kernel_row_tile(*args) == oracle(*args)
+        assert pallas_serve.fits_vmem(*args) == (oracle(*args) is not None)
+
+
+# ---------------------------------------------------------------------------
+# per-device division follows the partition rules
+# ---------------------------------------------------------------------------
+
+def test_plan_divides_per_partition_rules():
+    one = memory.plan_fit(rows=8000, features=16, classes=3, bins=64,
+                          max_depth=6, mesh_axes=1)
+    two = memory.plan_fit(rows=8000, features=16, classes=3, bins=64,
+                          max_depth=6, mesh_axes={"data": 4, "feature": 2})
+
+    def arr(plan, name):
+        return next(a for a in plan.arrays if a["name"] == name)
+
+    # x_binned shards both axes: 8x fewer bytes per device on (4, 2)
+    assert arr(one, "x_binned")["bytes_per_device"] == 8000 * 16 * 4
+    assert arr(two, "x_binned")["bytes_per_device"] == 8000 * 16 * 4 // 8
+    # per-row state shards the data axis only
+    assert arr(two, "y")["bytes_per_device"] == 8000 * 4 // 4
+    # the candidate mask shards its feature axis
+    assert arr(one, "cand_mask")["bytes_per_device"] == 16 * 64
+    assert arr(two, "cand_mask")["bytes_per_device"] == 16 * 64 // 2
+    # watermarks: phases include resident, peak is their max
+    assert one.phases["resident"] == sum(
+        a["bytes_per_device"] for a in one.arrays
+        if a["phase"] == "resident"
+    )
+    assert one.hbm_peak_bytes == max(one.phases.values())
+
+
+def test_plan_prices_leaf_pool_and_fused_rounds():
+    lw = memory.plan_fit(rows=50_000, features=20, classes=2, bins=128,
+                         max_leaf_nodes=255, subtraction=True)
+    names = {a["name"] for a in lw.arrays}
+    assert {"pool_hist", "pair_hist", "pool_nodes"} <= names
+    assert lw.inputs["max_leaf_nodes"] == 255
+
+    fr = memory.plan_fit(rows=50_000, features=20, bins=128, task="gbdt",
+                         max_leaf_nodes=31, rounds_per_dispatch=8)
+    names = {a["name"] for a in fr.arrays}
+    assert "margin_carry" in names and "grad_hess" in names
+    assert fr.phases["fused_rounds"] > fr.phases["resident"]
+
+
+def test_fused_gbdt_pool_and_margins_share_one_watermark():
+    """Inside a fused multi-round program the leaf pool and the margin
+    carry are live SIMULTANEOUSLY — the plan must price them in one
+    phase, or a near-budget config passes preflight and OOMs live."""
+    fr = memory.plan_fit(
+        rows=100_000, features=54, bins=256, task="gbdt",
+        max_leaf_nodes=255, rounds_per_dispatch=8, subtraction=True,
+        mesh_axes={"data": 8},
+    )
+    assert "leafwise" not in fr.phases  # folded into fused_rounds
+    expect = sum(
+        a["bytes_per_device"] for a in fr.arrays
+        if a["phase"] in ("resident", "fused_rounds")
+    )
+    assert fr.phases["fused_rounds"] == expect == fr.hbm_peak_bytes
+    # row-sharded carry arrays divide by the data axis (grad_hess has no
+    # partition-table rule — explicit bytes, not the replicated default)
+    gh = next(a for a in fr.arrays if a["name"] == "grad_hess")
+    assert gh["bytes_per_device"] == (100_000 // 8) * 2 * 4
+    mc = next(a for a in fr.arrays if a["name"] == "margin_carry")
+    assert mc["bytes_per_device"] == 2 * (100_000 // 8) * 4
+
+
+def test_no_drift_event_on_multi_round_host_loop_fit():
+    """The host boosting loop records one per-round plan while live
+    sampling spans every round — drift checking must stand down there
+    (it would fire spurious 'underestimate' events on healthy fits)."""
+    from mpitree_tpu import GradientBoostingClassifier
+
+    import os
+
+    X, y = _data(4000, 8)
+    gb = GradientBoostingClassifier(
+        max_iter=3, max_depth=3, random_state=0
+    )
+    # ambient sampling via the env knob, like a production run
+    os.environ[memory.MEM_SAMPLE_ENV] = "1"
+    try:
+        gb.fit(X, y)
+    finally:
+        del os.environ[memory.MEM_SAMPLE_ENV]
+    assert gb.fit_report_["rounds"]  # really a multi-round fit
+    assert not any(
+        e["kind"] == "mem_estimate_drift"
+        for e in gb.fit_report_["events"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# preflight refusal
+# ---------------------------------------------------------------------------
+
+def test_plan_check_names_binding_array():
+    plan = memory.plan_fit(rows=100_000, features=54, classes=7, bins=256,
+                           max_depth=20)
+    obs = BuildObserver(timing=False)
+    with pytest.raises(MemoryPlanError) as ei:
+        plan.check(1 << 20, obs=obs, what="test")
+    assert ei.value.binding_array == "split_hist_chunk"
+    ev = [e for e in obs.record.events if e["kind"] == "oom_predicted"]
+    assert len(ev) == 1
+    assert ev[0]["binding_array"] == "split_hist_chunk"
+    assert ev[0]["top"][0]["bytes"] >= ev[0]["top"][-1]["bytes"]
+    # a budget that fits (or none) passes silently
+    plan.check(plan.hbm_peak_bytes + 1)
+    plan.check(None)
+
+
+def test_build_tree_refuses_before_dispatch(monkeypatch):
+    X, y = _data(4000, 8)
+    binned = bin_dataset(X, max_bins=32)
+    mesh = mesh_lib.resolve_mesh(backend="cpu", n_devices=8)
+    monkeypatch.setenv(memory.HBM_BUDGET_ENV, str(1 << 12))
+    obs = BuildObserver(timing=False)
+    with pytest.raises(MemoryPlanError):
+        build_tree(binned, y, config=BuildConfig(max_depth=5), mesh=mesh,
+                   n_classes=3, timer=obs)
+    assert any(
+        e["kind"] == "oom_predicted" for e in obs.record.events
+    )
+    # refused BEFORE dispatch: no collective ever ran, no phase recorded
+    assert obs.record.collectives == {}
+    # the suggestion names a workable change
+    assert obs.record.memory.get("hbm_peak_bytes", 0) > (1 << 12)
+
+
+def test_hbm_budget_env_wins(monkeypatch):
+    monkeypatch.setenv(memory.HBM_BUDGET_ENV, "12345")
+    assert memory.device_hbm_budget() == 12345
+    monkeypatch.setenv(memory.HBM_BUDGET_ENV, "garbage")
+    assert memory.device_hbm_budget() is None
+
+
+# ---------------------------------------------------------------------------
+# ledger-vs-live parity (CPU)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n,f,engine,sub,n_dev", [
+    (6000, 10, "fused", "off", 8),
+    (6000, 10, "levelwise", "off", 8),
+    (6000, 10, "fused", "on", 8),
+    (12000, 6, "levelwise", "on", None),
+])
+def test_ledger_brackets_live_allocation(n, f, engine, sub, n_dev):
+    """For each (shape x mesh x engine x subtraction) config the
+    analytical per-device estimate brackets the measured live
+    allocation within the documented [PARITY_LO, PARITY_HI] factor."""
+    X, y = _data(n, f)
+    binned = bin_dataset(X, max_bins=32)
+    mesh = mesh_lib.resolve_mesh(backend="cpu", n_devices=n_dev)
+    obs = BuildObserver(timing=True)
+    obs.watch_memory()
+    tree = build_tree(
+        binned, y,
+        config=BuildConfig(max_depth=6, engine=engine,
+                           hist_subtraction=sub),
+        mesh=mesh, n_classes=3, timer=obs,
+    )
+    rep = obs.report(tree=tree)
+    mem = rep["memory"]
+    live = mem["live"]
+    est = mem["hbm_peak_bytes"]
+    delta = live["hbm_peak_delta_bytes"]
+    assert live["samples"] >= 2 and live["source"] != "none"
+    assert delta > 0, "live sampling saw no allocation"
+    assert est >= delta * PARITY_LO, (
+        f"ledger underestimates live: est {est} vs live {delta}"
+    )
+    assert est <= delta * PARITY_HI, (
+        f"ledger wildly overestimates live: est {est} vs live {delta}"
+    )
+    assert live["host_peak_bytes"] > 0
+
+
+def test_estimator_fit_report_carries_memory():
+    X, y = _data(3000, 8)
+    from mpitree_tpu import DecisionTreeClassifier
+
+    clf = DecisionTreeClassifier(max_depth=5, backend="cpu").fit(X, y)
+    mem = clf.fit_report_["memory"]
+    assert mem["kind"] == "fit" and mem["hbm_peak_bytes"] > 0
+    d = digest(clf.fit_report_)
+    assert d["hbm_peak_bytes"] == mem["hbm_peak_bytes"]
+    assert d["host_peak_bytes"] == mem["host_peak_bytes"]
+
+
+def test_host_engine_records_memory_plan():
+    X, y = _data(500, 4)
+    from mpitree_tpu import DecisionTreeClassifier
+
+    clf = DecisionTreeClassifier(max_depth=3, backend="host").fit(X, y)
+    mem = clf.fit_report_["memory"]
+    assert mem["inputs"]["engine"] == "host"
+    assert mem["host_peak_bytes"] > 0
+
+
+def test_drift_check_semantics():
+    # within tolerance: silent
+    assert memory.drift_check(100, 90, "memory_stats") is None
+    # underestimate fires on every source
+    d = memory.drift_check(100, 200, "live_arrays")
+    assert d is not None and d["direction"] == "underestimate"
+    # overestimate fires only on the authoritative source
+    big = int(100 * (memory.drift_tolerance() + 1))
+    assert memory.drift_check(big, 100, "live_arrays") is None
+    d = memory.drift_check(big, 100, "memory_stats")
+    assert d is not None and d["direction"] == "overestimate"
+    # nothing measurable: silent
+    assert memory.drift_check(None, 100) is None
+    assert memory.drift_check(100, 0) is None
+
+
+# ---------------------------------------------------------------------------
+# serving: plan_serve + deadline metric satellite
+# ---------------------------------------------------------------------------
+
+def test_serve_report_carries_memory_and_deadline_counter():
+    X, y = _data(2000, 6)
+    from mpitree_tpu import DecisionTreeClassifier
+    from mpitree_tpu.serving import ModelRegistry, compile_model
+
+    clf = DecisionTreeClassifier(max_depth=4, backend="cpu").fit(X, y)
+    model = compile_model(clf)
+    rep = model.serve_report_
+    mem = rep["memory"]
+    assert mem["kind"] == "serve"
+    assert {"node_table", "leaf_values", "query_batch"} <= {
+        a["name"] for a in mem["arrays"]
+    }
+    assert "vmem_fits" in mem["inputs"]
+
+    # the deadline-miss SLO counter (carried ROADMAP obs follow-up):
+    # schedulers report through the model, the registry exposes it under
+    # the model label
+    model.note_deadline_miss(3)
+    text = model.metrics_text()
+    assert "mpitree_serving_deadline_misses_total 3" in text
+    reg = ModelRegistry()
+    reg.publish("m", model, warm=False)
+    merged = reg.metrics_text()
+    assert (
+        'mpitree_serving_deadline_misses_total{model="m"} 3' in merged
+    )
+
+
+def test_plan_serve_prices_kernel_tier():
+    base = memory.plan_serve(
+        n_trees=10, n_nodes_total=5000, n_nodes_max=600, n_features=20,
+        value_channels=3, n_out=3,
+    )
+    kern = memory.plan_serve(
+        n_trees=10, n_nodes_total=5000, n_nodes_max=600, n_features=20,
+        value_channels=3, n_out=3, kernel=True,
+    )
+    assert kern.hbm_peak_bytes > base.hbm_peak_bytes
+    assert base.inputs["vmem_fits"] is True
+    huge = memory.plan_serve(
+        n_trees=2, n_nodes_total=2_000_000, n_nodes_max=1_000_000,
+        n_features=54, value_channels=1, n_out=1,
+    )
+    assert huge.inputs["vmem_fits"] is False
+
+
+# ---------------------------------------------------------------------------
+# resilience: OOM is terminal; the ladder attaches the postmortem
+# ---------------------------------------------------------------------------
+
+def _oom_exc():
+    try:
+        chaos._fire(chaos.Fault("x", 1, "oom"), "x", 1)
+    except Exception as e:  # noqa: BLE001
+        return e
+    raise AssertionError("oom fault did not raise")
+
+
+def test_oom_is_terminal_not_transient():
+    e = _oom_exc()
+    assert is_device_failure(e)
+    assert is_oom_failure(e)
+    assert not is_transient_failure(e)
+    # wrapped one level down the chain, same verdicts
+    try:
+        raise RuntimeError("dispatch failed") from e
+    except RuntimeError as outer:
+        assert is_device_failure(outer)
+        assert is_oom_failure(outer)
+        assert not is_transient_failure(outer)
+
+
+def test_retry_device_does_not_burn_budget_on_oom(monkeypatch):
+    monkeypatch.setenv("MPITREE_TPU_RETRIES", "5")
+    monkeypatch.setenv("MPITREE_TPU_BACKOFF_S", "0")
+    obs = BuildObserver(timing=False)
+    obs.memory_plan(memory.plan_fit(rows=1000, features=8, bins=32))
+    calls = []
+
+    def dev():
+        calls.append(1)
+        raise _oom_exc()
+
+    with pytest.raises(Exception, match="RESOURCE_EXHAUSTED"):
+        retry_device(dev, what="oom test", obs=obs)
+    # terminal: ONE attempt, zero retry events, postmortem attached
+    assert len(calls) == 1
+    assert not any(
+        e["kind"] == "device_retry" for e in obs.record.events
+    )
+    pm = [e for e in obs.record.events if e["kind"] == "oom_postmortem"]
+    assert len(pm) == 1
+    assert pm[0]["top"][0]["name"]
+    assert obs.record.counters.get("device_ooms") == 1
+
+
+def test_failover_goes_straight_to_host_on_oom(monkeypatch):
+    monkeypatch.setenv("MPITREE_TPU_RETRIES", "5")
+    monkeypatch.setenv("MPITREE_TPU_BACKOFF_S", "0")
+    obs = BuildObserver(timing=False)
+    obs.memory_plan(memory.plan_fit(rows=1000, features=8, bins=32))
+    attempts = []
+
+    def dev():
+        attempts.append(1)
+        raise _oom_exc()
+
+    with pytest.warns(UserWarning, match="host tier"):
+        out = device_failover(
+            dev, lambda: "host", what="oom test", obs=obs
+        )
+    assert out == "host"
+    assert len(attempts) == 1  # no retry ladder burn
+    assert any(
+        e["kind"] == "oom_postmortem" for e in obs.record.events
+    )
+    assert obs.record.counters.get("device_failovers") == 1
+
+
+def test_chaos_oom_seam_in_tier1_fit(monkeypatch):
+    """The chaos Fault(kind='oom') seam end to end: a device OOM at the
+    first dispatch rescues on the host tier WITHOUT burning retries, and
+    the fit_report_ carries the postmortem."""
+    X, y = _data(3000, 8)
+    monkeypatch.setenv("MPITREE_TPU_BACKOFF_S", "0")
+    from mpitree_tpu import DecisionTreeClassifier
+
+    with chaos.active(chaos.Fault("dispatch", 1, "oom")) as plan:
+        with pytest.warns(UserWarning, match="host tier"):
+            clf = DecisionTreeClassifier(
+                max_depth=4, backend="cpu"
+            ).fit(X, y)
+    assert plan.fired == [("dispatch", 1, "oom")]
+    events = [e["kind"] for e in clf.fit_report_["events"]]
+    assert "oom_postmortem" in events
+    assert "device_retry" not in events
+    assert clf.fit_report_["counters"].get("device_failovers") == 1
+    # the rescue produced a working tree
+    assert clf.predict(X[:10]).shape == (10,)

@@ -300,8 +300,11 @@ def run_section(sec: str) -> bool:
             log(f"{sec}: trace | {merged}")
         # One-line run-record digest next to the capture verdict: the next
         # slow-section mystery (rounds 3-4 cost whole windows to exactly
-        # this) arrives with its engine decision, recompile count, and
-        # psum payload already attributed in the committed log.
+        # this) arrives with its engine decision, recompile count, psum
+        # payload, and (v6) the obs.memory ledger's predicted per-device
+        # peak HBM (hbm_peak=...) already attributed in the committed log
+        # — an on-hardware RESOURCE_EXHAUSTED kill reads its suspect
+        # straight off this line.
         from bench_tpu import section_record_digest
 
         digest = section_record_digest(sec)
