@@ -6,7 +6,7 @@
 PY ?= python
 
 .PHONY: test test-cpu lint lint-graft lint-baseline bench bench-tpu report \
-  trace-smoke clean
+  trace-smoke mem-smoke flight-smoke bench-diff clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -64,6 +64,20 @@ trace-smoke:
 # any dispatch. CPU-safe, seconds.
 mem-smoke:
 	JAX_PLATFORMS=cpu $(PY) examples/obs_memory_run.py
+
+# Observability v4 gate (ISSUE 13): two fits -> flight store -> clean
+# twin diffs green -> injected perf regression and a chaos-skewed build
+# both refuse (the divergence localized to its level+channel). CPU-safe,
+# seconds.
+flight-smoke:
+	JAX_PLATFORMS=cpu $(PY) examples/obs_flight_run.py
+
+# Regression gate over the committed CPU baselines (tools/benchdiff over
+# BENCH_r*.json): newest round vs the previous parseable one, noise
+# thresholds seeded from the stored trajectory. Stdlib-only (no jax) —
+# CI runs it with --format github so regressions annotate the PR.
+bench-diff:
+	$(PY) -m tools.benchdiff --bench $(sort $(wildcard BENCH_r*.json))
 
 clean:
 	find . -type d \( -name "__pycache__" -o -name ".pytest_cache" \

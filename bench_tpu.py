@@ -75,6 +75,74 @@ def _git_head() -> str:
         return "unknown"
 
 
+def _obs_module(name: str):
+    """An obs module (flight/diff) loaded BY FILE PATH — stdlib-only by
+    contract, so the PARENT orchestrator (which deliberately never
+    imports jax; sections run in pinned subprocesses) can diff and store
+    runs (the obs/trace.py precedent the watcher set). Cached in
+    sys.modules: per-call re-exec would re-probe git for every append
+    (flight's sha cache lives on the module) and crash dataclass field
+    resolution for modules that define one."""
+    import importlib.util
+
+    modname = f"_bench_obs_{name}"
+    if modname in sys.modules:
+        return sys.modules[modname]
+    spec = importlib.util.spec_from_file_location(
+        modname,
+        os.path.join(_HERE, "mpitree_tpu", "obs", f"{name}.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[modname] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def section_history(sec: str, lines: list) -> list:
+    """The section's stored payload trajectory (oldest→newest) from
+    already-parsed capture lines — the --baseline diff's evidence base."""
+    return [
+        rec[sec] for rec in lines
+        if isinstance(rec, dict) and isinstance(rec.get(sec), dict)
+    ]
+
+
+def baseline_verdict(sec: str, payload: dict, prior_lines: list):
+    """(diff, one-line summary) of this capture vs the newest stored
+    capture of the same section — the ``--baseline`` regression
+    sentinel (obs.diff: noise thresholds seeded from the section's own
+    stored dispersion). (None, reason) with no stored baseline."""
+    history = section_history(sec, prior_lines)
+    if not history:
+        return None, "no stored baseline for this section yet"
+    diff_mod = _obs_module("diff")
+    d = diff_mod.diff_payloads(history[-1], payload, history=history)
+    return d, diff_mod.summary_line(d, label=sec)
+
+
+def flight_append_section(sec: str, payload: dict, platform: str) -> None:
+    """Append one captured section to the flight store when
+    ``MPITREE_TPU_RUN_DIR`` is set (kind="bench" envelopes; the fit
+    records inside the section workers append their own kind="fit"
+    lines). Best-effort — the capture must never die on telemetry."""
+    try:
+        flight = _obs_module("flight")
+        if not flight.enabled():
+            return
+        diff_mod = _obs_module("diff")
+        flight.FlightStore().append(
+            kind="bench", section=sec,
+            metrics=diff_mod.scalar_metrics(payload),
+            digest=(payload.get("record") or {}),
+            config={"section": sec, "depth": DEPTH,
+                    "refine_depth": REFINE_DEPTH},
+            platform=platform, git=_git_head(),
+        )
+    except Exception as e:  # noqa: BLE001 — telemetry, not the capture
+        print(f"[bench-tpu] {sec}: flight append failed "
+              f"({type(e).__name__}: {e})", file=sys.stderr)
+
+
 # --------------------------------------------------------------------------
 # Section workers (run in subprocesses; each prints one tagged JSON line)
 # --------------------------------------------------------------------------
@@ -160,7 +228,7 @@ RECORD_DIGEST_KEYS = (
     "engine", "reason", "n_nodes", "depth", "levels", "compile_new",
     "psum_bytes", "sub_frac", "expansions", "rounds_per_dispatch",
     "events", "wire_bytes", "wire_shard_bytes", "feature_shards",
-    "hbm_peak_bytes", "host_peak_bytes",
+    "hbm_peak_bytes", "host_peak_bytes", "fingerprint",
     "wall_s",
 )
 
@@ -201,6 +269,10 @@ def format_record_digest(d: dict) -> str:
         # The obs.memory ledger's predicted per-device peak (v6) — the
         # number the watcher sanity-checks captured sections against.
         line += f" hbm_peak={(d['hbm_peak_bytes'] or 0) / 1e6:.1f}MB"
+    if d.get("fingerprint"):
+        # The whole-fit build-state fingerprint (v7): two lineage lines
+        # with different fp= built DIFFERENT trees — obs.diff bisects.
+        line += f" fp={d['fingerprint']}"
     if d.get("reason"):
         line += f" reason={d['reason']!r}"
     return line
@@ -1347,6 +1419,11 @@ def main() -> int:
     p.add_argument("--platform", default="auto",
                    help="jax platform for every section (auto = probe, "
                         "falling back to cpu when the accelerator hangs)")
+    p.add_argument("--baseline", action="store_true",
+                   help="diff each captured section against its newest "
+                        "stored capture (obs.diff; noise thresholds from "
+                        "the section's stored dispersion) and self-report "
+                        "regressions per section")
     args = p.parse_args()
 
     if args.report:
@@ -1385,6 +1462,11 @@ def main() -> int:
     }
     errors: dict = {}
 
+    # Parsed BEFORE this run appends anything: the --baseline diff must
+    # compare against prior captures, not this run's own partial lines.
+    prior_lines = read_capture_lines(args.out) if args.baseline else []
+    baseline_report: dict = {}
+
     with tempfile.NamedTemporaryFile(suffix=".npz", delete=False) as f:
         npz_path = f.name
     try:
@@ -1394,6 +1476,16 @@ def main() -> int:
             res, err = run_section(sec, npz_path, args.timeout, platform)
             took = round(time.perf_counter() - t0, 1)
             if res is not None:
+                if args.baseline:
+                    d, line = baseline_verdict(sec, res, prior_lines)
+                    print(f"[bench-tpu] {line}", file=sys.stderr)
+                    if d is not None:
+                        baseline_report[sec] = {
+                            "verdict": d["verdict"],
+                            "regressions": d["regressions"],
+                            "changed": d["changed"],
+                        }
+                flight_append_section(sec, res, platform)
                 record[sec] = res
                 # Checkpoint the section to the jsonl AS IT COMPLETES: a
                 # killed window (watcher timeout, tunnel death, operator
@@ -1417,6 +1509,10 @@ def main() -> int:
 
     if errors:
         record["errors"] = errors
+    if baseline_report:
+        # Per-section regression verdicts ride the committed line, so
+        # the capture artifact itself says whether the round regressed.
+        record["baseline"] = baseline_report
     record["ok"] = not errors
     with open(args.out, "a") as f:
         f.write(json.dumps(record) + "\n")

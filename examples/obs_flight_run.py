@@ -1,0 +1,149 @@
+"""obs.flight smoke: two fits -> store -> diff -> clean verdict ->
+injected-regression refusal.
+
+The CI gate for the flight-recorder contract (ISSUE 13, wired as
+``make flight-smoke``), mirroring ``obs_memory_run``'s role for the
+memory schema. Checks, each exiting nonzero on failure:
+
+1. **ambient store** — with ``MPITREE_TPU_RUN_DIR`` set, two identical
+   fits append two ``kind="fit"`` envelopes stamped with platform /
+   mesh axes / config digest, and both land in ONE lineage;
+2. **clean twin diffs green** — ``obs.diff`` on the two envelopes:
+   identical configs on identical data carry IDENTICAL whole-fit
+   fingerprints (the bit-identity pin, now observable) and no
+   regression verdicts;
+3. **injected perf regression refuses** — a doctored candidate whose
+   wall is 3x the lineage baseline yields ``verdict="regression"`` and
+   a nonzero sentinel exit code;
+4. **injected divergence localizes** — a fit whose gradient payload is
+   finitely skewed (the ``grad_hess`` chaos seam, kind="skew") builds a
+   DIFFERENT tree: the diff says ``diverged`` and the fingerprint
+   bisect names the first divergent (tree, level, channel).
+
+Run:  python examples/obs_flight_run.py  (CPU-safe, ~seconds)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+FAILURES: list[str] = []
+
+
+def check(ok: bool, what: str) -> None:
+    tag = "ok" if ok else "FAIL"
+    print(f"[{tag}] {what}")
+    if not ok:
+        FAILURES.append(what)
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory() as run_dir:
+        os.environ["MPITREE_TPU_RUN_DIR"] = run_dir
+        try:
+            return run_checks(run_dir)
+        finally:
+            del os.environ["MPITREE_TPU_RUN_DIR"]
+
+
+def run_checks(run_dir: str) -> int:
+    from mpitree_tpu import GradientBoostingClassifier
+    from mpitree_tpu.obs import diff as obs_diff
+    from mpitree_tpu.obs import flight
+    from mpitree_tpu.resilience import chaos
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(3000, 8)).astype(np.float32)
+    y = ((X[:, 0] + 0.5 * X[:, 1] > 0)).astype(np.int32)
+
+    def fit():
+        return GradientBoostingClassifier(
+            max_iter=3, max_depth=3, max_bins=32, backend="cpu",
+        ).fit(X, y)
+
+    # -- 1. ambient store: two identical fits, one lineage ---------------
+    fit()
+    fit()
+    store = flight.FlightStore(run_dir)
+    fits = store.entries(kind="fit")
+    check(len(fits) == 2, f"two fit envelopes stored ({len(fits)})")
+    a, b = fits[0], fits[1]
+    check(
+        a["config_digest"] == b["config_digest"]
+        and a["platform"] == b["platform"],
+        "identical configs share one lineage "
+        f"(config_digest {b['config_digest']})",
+    )
+    check(
+        store.baseline_for(b) is not None,
+        "the second run resolves the first as its lineage baseline",
+    )
+
+    # -- 2. clean twin diffs green ---------------------------------------
+    d = obs_diff.diff_envelopes(a, b, history=[a])
+    print(obs_diff.format_diff(d))
+    check(
+        (d["fingerprint"]["match"] is True),
+        "identical fits carry identical whole-fit fingerprints",
+    )
+    check(
+        d["verdict"] in ("ok", "improved"),
+        f"clean twin verdict is green ({d['verdict']})",
+    )
+    check(obs_diff.exit_code(d) == 0, "clean sentinel exit code is 0")
+
+    # -- 3. injected perf regression refuses -----------------------------
+    import copy
+
+    slow = copy.deepcopy(b)
+    slow["digest"]["wall_s"] = round(
+        (b["digest"].get("wall_s") or 0.1) * 3.0 + 1.0, 3
+    )
+    d_slow = obs_diff.diff_envelopes(a, slow, history=[a, b])
+    check(
+        d_slow["verdict"] == "regression"
+        and "wall_s" in d_slow["regressions"],
+        f"3x wall injects a named regression ({d_slow['regressions']})",
+    )
+    check(obs_diff.exit_code(d_slow) == 1, "regression exit code is 1")
+    print("regression: " + obs_diff.summary_line(d_slow, label="slow-twin"))
+
+    # -- 4. injected divergence localizes --------------------------------
+    with chaos.active(chaos.Fault("grad_hess", 2, "skew", 4.0)):
+        fit()
+    fits = store.entries(kind="fit")
+    check(len(fits) == 3, "the corrupted twin stored a third envelope")
+    corrupt = fits[-1]
+    d_div = obs_diff.diff_envelopes(b, corrupt, history=[a, b])
+    dv = d_div["fingerprint"]["divergence"]
+    check(d_div["verdict"] == "diverged", "corrupted twin diverges")
+    check(
+        dv is not None and dv.get("tree") is not None
+        and dv.get("channel") in ("hist", "winner", "alloc"),
+        f"bisect names the first divergent point ({dv})",
+    )
+    if dv:
+        print(
+            f"divergence localized: round {dv['tree']}, level "
+            f"{dv['level']}, channel {dv['channel']} (all: "
+            f"{dv.get('channels')})"
+        )
+    check(obs_diff.exit_code(d_div) == 1, "divergence exit code is 1")
+
+    if FAILURES:
+        print(f"\n{len(FAILURES)} flight-smoke failures")
+        return 1
+    print("\nflight smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

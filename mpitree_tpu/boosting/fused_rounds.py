@@ -534,6 +534,12 @@ def run_fused_rounds(*, binned, y_tr, sw_tr, raw_tr, trees, train_scores,
                 obs.collective(site, calls=v["calls"], nbytes=v["bytes"])
             for row in rows_i:
                 obs.level(**row)
+            if obs.wants_fingerprints:
+                # Per-ROUND fingerprint rows replayed from the finished
+                # round tree (ISSUE 13) — commit order matches the host
+                # loop's per-round build_tree commits, so obs.diff's
+                # bisect names the same round index on either engine.
+                obs.fingerprint_tree(obs_acct.replay_fingerprints(tree))
             mean_loss = float(ls_s[i]) / max(float(lw_s[i]), 1e-300)
             train_scores.append(-mean_loss)
             obs.round(

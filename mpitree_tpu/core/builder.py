@@ -37,6 +37,7 @@ import numpy as np
 
 from mpitree_tpu.core.tree_struct import TreeArrays
 from mpitree_tpu.obs import accounting as obs_acct, warn_event
+from mpitree_tpu.obs import fingerprint as fingerprint_lib
 from mpitree_tpu.obs import memory as memory_lib
 from mpitree_tpu.ops.binning import BinnedData
 from mpitree_tpu.parallel import collective, mesh as mesh_lib
@@ -1036,6 +1037,12 @@ def build_tree(
     counts_fresh = timer.compile_note("counts_fn", (mesh, U, C, task))
 
     frontier_lo, frontier_size, depth = 0, 1, 0
+    # Per-level build-state fingerprints (obs/fingerprint.py, ISSUE 13):
+    # hashed LIVE at this loop's existing host boundary — the level's
+    # decisions and child allocations are already host-resident — and
+    # committed as one tree at the end. Zero device collectives; the
+    # fused engines replay identical rows from the finished tree.
+    fp_rows: list = [] if timer.wants_fingerprints else None
     # Sibling-subtraction carry: the previous level's globally-reduced
     # chunk histograms (device-resident) plus the host-side child ->
     # (parent slot, smaller sibling) maps derived from its decisions.
@@ -1441,11 +1448,21 @@ def build_tree(
             ),
             new_lowerings=lvl_new,
         )
+        if fp_rows is not None:
+            # The level's nodes are fully decided here (stats, winners,
+            # child ids) — hash the same tree-buffer slices the replay
+            # path re-slices from the finished tree.
+            fp_rows.append(fingerprint_lib.level_fingerprint(
+                depth, tree.n_node_samples[ids], tree.feature[ids],
+                tree.threshold[ids], tree.left[ids], tree.right[ids],
+            ))
         frontier_lo = frontier_lo + frontier_size
         frontier_size = 2 * len(split_ids)
         depth += 1
 
     out = tree.finalize()
+    if fp_rows is not None:
+        timer.fingerprint_tree(fp_rows)
 
     nid_host = None
     if task == "regression" and refit_targets is not None:

@@ -52,6 +52,7 @@ from mpitree_tpu.core.builder import (
 )
 from mpitree_tpu.core.tree_struct import TreeArrays
 from mpitree_tpu.obs import accounting as obs_acct
+from mpitree_tpu.obs import memory as memory_lib
 from mpitree_tpu.obs import warn_event
 from mpitree_tpu.ops import histogram as hist_ops
 from mpitree_tpu.ops import impurity as imp_ops
@@ -993,6 +994,11 @@ def build_tree_fused(
         timer.collective(site, calls=v["calls"], nbytes=v["bytes"])
     for r in rows:
         timer.level(**r)
+    if timer.wants_fingerprints:
+        # Build-state fingerprints (ISSUE 13): the one-program build has
+        # no per-level host boundary, so the rows are replayed from the
+        # finished tree — pinned equal to the level-wise loop's live rows.
+        timer.fingerprint_tree(obs_acct.replay_fingerprints(tree))
 
     from mpitree_tpu.core.builder import fetch_row_nodes
 
@@ -1169,6 +1175,19 @@ def build_forest_fused(
         )
 
     timer.set_mesh(tmesh)
+    # Memory ledger + OOM preflight (ISSUE 13 satellite, the PR-12 gap):
+    # the forest program records a plan like every other engine, priced
+    # per the partition table's tree-axis rules, and refuses a predicted
+    # over-budget build BEFORE the one big dispatch.
+    fplan = memory_lib.plan_forest(
+        n_trees=T, rows=int(N), features=int(F),
+        classes=int(n_classes or 2), bins=int(B), task=task,
+        max_depth=cfg.max_depth, tree_shards=Dt, data_shards=Dd,
+        subtraction=use_sub, chunk_slots=K, node_capacity=M,
+        hist_budget_bytes=cfg.hist_budget_bytes,
+    )
+    timer.memory_plan(fplan.to_dict())
+    memory_lib.preflight(fplan, obs=timer, what="forest build")
     md = -1 if cfg.max_depth is None else int(cfg.max_depth)
     fn_kw = dict(
         n_slots=K, n_bins=B, n_classes=C, task=task,
@@ -1284,6 +1303,10 @@ def build_forest_fused(
         if data_sharded:
             for site, v in coll.items():
                 timer.collective(site, calls=v["calls"], nbytes=v["bytes"])
+        if timer.wants_fingerprints:
+            # One fingerprint row list per ensemble member, in member
+            # order — the forest twin of the boosting per-round commits.
+            timer.fingerprint_tree(obs_acct.replay_fingerprints(tree))
     if return_leaf_ids:
         return trees, np.asarray(nid_out)[:T, :N]
     return trees

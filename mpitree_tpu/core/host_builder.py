@@ -556,6 +556,13 @@ def build_tree_host(
             )
 
     out = tree.finalize()
+    if timer.wants_fingerprints:
+        # Build-state fingerprints (ISSUE 13): the whole build is host
+        # work, so the finished buffer IS the host boundary — one shared
+        # replay hashes the same per-level bytes the device level-wise
+        # loop hashes live (engine identity makes them equal wherever the
+        # trees are).
+        timer.fingerprint_tree(obs_acct.replay_fingerprints(out))
 
     if task == "regression" and refit_targets is not None:
         from mpitree_tpu.core.builder import refit_regression_values

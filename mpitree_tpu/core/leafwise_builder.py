@@ -641,6 +641,12 @@ def build_tree_leafwise(
     if engine == "fused":
         for r in rows:
             timer.level(**r)
+    if timer.wants_fingerprints:
+        # Build-state fingerprints (ISSUE 13), replayed from the
+        # BFS-renumbered tree — at the level-wise node budget these rows
+        # are bit-identical to the level-wise engines' (the pin, now
+        # observable).
+        timer.fingerprint_tree(obs_acct.replay_fingerprints(tree))
 
     from mpitree_tpu.core.builder import fetch_row_nodes
 

@@ -24,8 +24,27 @@ ledger priced from the partition-rule table, ``plan_fit``/``plan_serve``
 expose it as a preflight capacity planner (typed ``oom_predicted``
 refusal before dispatch), and ``MPITREE_TPU_MEM_SAMPLE=1`` samples live
 HBM/host watermarks at span boundaries.
+Observability v4 (ISSUE 13): ``obs.fingerprint`` stamps every fit with
+cheap u64 per-level build-state fingerprints (hist/winner/alloc channels
+— the bit-identity pins, now observable), ``obs.flight`` appends every
+finalized record to a persistent run store under ``MPITREE_TPU_RUN_DIR``
+(git/platform/mesh/config lineage keys, query API), and ``obs.diff``
+compares two runs with noise-aware verdicts seeded from run-history
+dispersion, bisecting fingerprint divergences to the first divergent
+(tree, level, channel).
 """
 
+from mpitree_tpu.obs.diff import (
+    diff_envelopes,
+    diff_payloads,
+    localize_divergence,
+)
+from mpitree_tpu.obs.fingerprint import (
+    FINGERPRINT_VERSION,
+    ensemble_fingerprint,
+    tree_fingerprints,
+)
+from mpitree_tpu.obs.flight import RUN_DIR_ENV, FlightStore
 from mpitree_tpu.obs.observer import (
     REGISTRY,
     BuildObserver,
@@ -59,10 +78,13 @@ from mpitree_tpu.obs.trace import (
 )
 
 __all__ = [
+    "FINGERPRINT_VERSION",
+    "RUN_DIR_ENV",
     "SCHEMA_VERSION",
     "TOP_LEVEL_FIELDS",
     "TRACE_DIR_ENV",
     "BuildRecord",
+    "FlightStore",
     "BuildObserver",
     "CompileRegistry",
     "MemWatch",
@@ -72,7 +94,11 @@ __all__ = [
     "REGISTRY",
     "ReportMixin",
     "TraceSink",
+    "diff_envelopes",
+    "diff_payloads",
     "digest",
+    "ensemble_fingerprint",
+    "localize_divergence",
     "merge_trace_files",
     "mesh_info",
     "metrics_text",
@@ -80,6 +106,7 @@ __all__ = [
     "note_refine",
     "plan_fit",
     "plan_serve",
+    "tree_fingerprints",
     "validate_trace",
     "warn_event",
     "wire_estimate",

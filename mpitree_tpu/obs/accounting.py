@@ -18,12 +18,23 @@ import math
 
 import numpy as np
 
+from mpitree_tpu.obs import fingerprint as fingerprint_mod
 from mpitree_tpu.obs import memory as memory_mod
 from mpitree_tpu.parallel.collective import (
     counts_psum_bytes,
     select_global_bytes,
     split_psum_bytes,
 )
+
+
+def replay_fingerprints(tree) -> list:
+    """Per-level build-state fingerprint rows synthesized from a finished
+    tree (ISSUE 13) — the fused engines' twin of the level-wise loop's
+    live per-level hashing, the same live/replay split as
+    :func:`fused_level_rows` vs the live wire accounting. Both paths hash
+    the same bytes from the same host arrays, so live and replayed rows
+    are pinned equal (``tests/test_obs_flight.py``)."""
+    return fingerprint_mod.tree_fingerprints(tree)
 
 
 def build_memory_plan(*, mesh=None, mesh_axes=None,

@@ -1,0 +1,144 @@
+"""obs.fingerprint — cheap u64 per-level build-state fingerprints.
+
+The divergence-localization layer under ``obs.diff`` (ISSUE 13): the
+repo's core correctness invariant is bit-identity — the same workload
+must build the same tree across (8,)/(4,2)/(2,4) meshes, fused/levelwise
+engines, and subtraction on/off — but until now that invariant lived
+only inside individual tests, and when two runs disagreed nothing could
+say *where*. A fingerprint row is three u64 hashes per tree level, one
+per state **channel**, ordered by data flow:
+
+- ``hist`` — the reduced-histogram checksum: each level node's total
+  accumulated weight (``n_node_samples``), i.e. the 0th moment of the
+  globally psum'd histogram. The first channel a corrupted payload or a
+  routing bug moves.
+- ``winner`` — the packed winning splits: per-node ``(feature,
+  threshold)`` (leaves contribute ``(-1, NaN)``). Diverges when the
+  gain sweep picks differently off identical histograms (tie seams,
+  kernel-exactness opt-outs).
+- ``alloc`` — the child-id allocation: per-node ``(left, right)``.
+  Diverges when identical winners allocate differently (frontier
+  bookkeeping bugs).
+
+Two runs that diverge are bisected by ``obs.diff.localize_divergence``
+to the first divergent (tree/round, level) and the first channel in the
+order above — "round 3, level 2, hist" instead of "the digests differ".
+
+Cost contract (the acceptance pin): fingerprints are **host-side
+arithmetic over arrays the engines already hold** — zero device
+collectives, zero transfers. The level-wise/host engines hash each
+level's slice of the host tree buffer at their existing host boundary
+(the per-level decision fetch); the fused single-program engines
+(fused/leaf-wise/forest/fused-rounds), which have no per-level host
+boundary, get the identical rows *replayed* from the finished tree
+(:func:`tree_fingerprints`) — the same live/replay split as the wire
+ledger (``obs/accounting``). Live and replayed rows hash the same bytes
+from the same arrays, pinned equal in ``tests/test_obs_flight.py``.
+
+Hashing is BLAKE2b (stdlib, C speed) truncated to 64 bits, rendered as
+16 hex chars — compact enough for every level of a depth-20 build to
+ride a ``fit_report_``, stable across platforms and processes (no
+PYTHONHASHSEED dependence). Only refit-stable fields are hashed:
+``value``/``count``/``impurity`` are overwritten post-build by the f64
+refit passes (regression/gbdt), so including them would make live and
+replayed fingerprints disagree on healthy fits.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+# Bump on any change to which bytes a channel hashes — stored
+# fingerprints are only comparable within one version.
+FINGERPRINT_VERSION = 1
+
+# Data-flow order: histogram stats feed the winner sweep, winners feed
+# child allocation — the bisect reports the FIRST divergent channel in
+# this order, which names the most upstream divergent state.
+CHANNELS = ("hist", "winner", "alloc")
+
+
+def _h64(*chunks: bytes) -> str:
+    """64-bit BLAKE2b over the concatenated chunks, as 16 hex chars."""
+    h = hashlib.blake2b(digest_size=8)
+    for c in chunks:
+        h.update(c)
+    return h.hexdigest()
+
+
+def _canon(a, dtype) -> bytes:
+    """Canonical little-endian bytes regardless of the input's dtype."""
+    return np.ascontiguousarray(np.asarray(a), dtype=dtype).tobytes()
+
+
+def level_fingerprint(level: int, n_samples, feature, threshold,
+                      left, right) -> dict:
+    """One fingerprint row from a level's node slices (id order).
+
+    The arrays are the level's slices of the host tree buffer — what the
+    level-wise loop already has at its host boundary, and exactly what
+    :func:`tree_fingerprints` re-slices from a finished tree, so the two
+    paths can never hash different bytes.
+    """
+    return {
+        "level": int(level),
+        "nodes": int(len(np.asarray(feature))),
+        "hist": _h64(_canon(n_samples, "<i8")),
+        "winner": _h64(_canon(feature, "<i4"), _canon(threshold, "<f4")),
+        "alloc": _h64(_canon(left, "<i4"), _canon(right, "<i4")),
+    }
+
+
+def tree_fingerprints(tree) -> list:
+    """Per-level fingerprint rows replayed from a finished tree.
+
+    ``tree`` is any struct-of-arrays carrying ``depth`` /
+    ``n_node_samples`` / ``feature`` / ``threshold`` / ``left`` /
+    ``right`` (a ``TreeArrays``). Nodes group by depth in id order —
+    the engines allocate level nodes contiguously (level-wise) or
+    BFS-renumber (leaf-wise/fused), so id order within a depth is the
+    same canonical order the live path hashes.
+    """
+    depth = np.asarray(tree.depth, np.int64)
+    ns = np.asarray(tree.n_node_samples)
+    feat = np.asarray(tree.feature)
+    thr = np.asarray(tree.threshold)
+    left = np.asarray(tree.left)
+    right = np.asarray(tree.right)
+    rows = []
+    for d in range(int(depth.max(initial=0)) + 1):
+        ids = np.flatnonzero(depth == d)
+        if not len(ids):
+            continue
+        rows.append(level_fingerprint(
+            d, ns[ids], feat[ids], thr[ids], left[ids], right[ids]
+        ))
+    return rows
+
+
+def fold(rows: list, into=None):
+    """Fold fingerprint rows into a running whole-fit BLAKE2b state.
+
+    ``into``: an existing hash object (or None to start one). The
+    observer folds every committed tree's rows through here and renders
+    the final state as the record's whole-fit ``fingerprint`` — one u64
+    that changes iff any level of any tree changed.
+    """
+    h = into if into is not None else hashlib.blake2b(digest_size=8)
+    for r in rows:
+        h.update(
+            f"{r['level']}:{r['hist']}:{r['winner']}:{r['alloc']};"
+            .encode()
+        )
+    return h
+
+
+def ensemble_fingerprint(trees) -> str:
+    """Whole-model u64 over every member's per-level rows — the serving
+    side's "am I serving the same model?" stamp (``serve_report_``)."""
+    h = None
+    for t in trees:
+        h = fold(tree_fingerprints(t), h)
+    return (h or fold([])).hexdigest()

@@ -558,6 +558,149 @@ def plan_fit(*, rows: int, features: int, classes: int = 2,
     )
 
 
+def plan_forest(*, n_trees: int, rows: int, features: int,
+                classes: int = 2, bins: int = 256,
+                task: str = "classification", max_depth=None,
+                tree_shards: int = 1, data_shards: int = 1,
+                subtraction: bool = False,
+                chunk_slots: int | None = None,
+                node_capacity: int | None = None,
+                hist_budget_bytes: int = 4 << 30,
+                max_frontier_chunk: int = 4096) -> MemoryPlan:
+    """Price a tree-sharded forest build (``build_forest_fused``) — the
+    PR-12 gap: single-tree, leaf-wise, gbdt and serving all recorded a
+    plan, the forest engines did not (ISSUE 13 satellite).
+
+    Per-device division follows ``parallel/partition.py``'s tree-axis
+    rules: per-tree operand stacks (``tree_weights`` / ``tree_cand_masks``
+    / ``tree_nodes``) shard their leading axis over the ``tree`` axis,
+    per-row state and the binned matrix shard over ``data`` (replicated
+    when the forest mesh carries no data axis — exactly the engine's
+    ``data_sharded`` placement switch). Each device's ``lax.map`` builds
+    its tree group SEQUENTIALLY, so the split working set is one tree's —
+    not the group's — chunk histogram.
+    """
+    Dt = max(int(tree_shards), 1)
+    Dd = max(int(data_shards), 1)
+    axes = {"tree": Dt, "data": Dd}
+    C = int(classes) if task == "classification" else 3
+    rows_pad = _round_up(int(rows), Dd)
+    T_pad = _round_up(int(n_trees), Dt)
+    K = (int(chunk_slots) if chunk_slots else default_chunk_slots(
+        rows, int(features), int(bins), C,
+        hist_budget_bytes=hist_budget_bytes,
+        max_frontier_chunk=max_frontier_chunk, max_depth=max_depth,
+    ))
+    M = (int(node_capacity) if node_capacity else min(
+        (2 ** (int(max_depth) + 1) - 1) if max_depth is not None
+        and int(max_depth) < 31 else 2 * int(rows) - 1,
+        2 * int(rows) - 1,
+    ))
+
+    arrays: list = []
+
+    def add(name, shape, itemsize, phase, *, bytes_per_device=None):
+        b = (_per_device_bytes(name, shape, itemsize, axes)
+             if bytes_per_device is None else int(bytes_per_device))
+        arrays.append({
+            "name": name, "shape": [int(s) for s in shape],
+            "itemsize": int(itemsize), "phase": phase,
+            "bytes_per_device": int(b),
+        })
+
+    add("x_binned", (rows_pad, int(features)), 4, RESIDENT)
+    add("y", (rows_pad,), 4, RESIDENT)
+    add("node_id", (rows_pad,), 4, RESIDENT)
+    add("tree_weights", (T_pad, rows_pad), 4, RESIDENT)
+    add("tree_cand_masks", (T_pad, int(features), int(bins)), 1, RESIDENT)
+    # Device-resident node buffers: feature/bin/left/parent int32 columns,
+    # the (C or 3)-wide counts slab, and n/value — ~10 + C words per node.
+    add("tree_nodes", (T_pad, M, 10 + C), 4, RESIDENT)
+    # One tree's split working set at a time (sequential lax.map body).
+    add("split_hist_chunk", (K, int(features), C, int(bins)), 4, "split",
+        bytes_per_device=K * chunk_bytes_per_slot(
+            int(features), int(bins), C))
+    if subtraction:
+        widest = _widest_frontier(int(rows), max_depth)
+        n_chunks = -(-widest // K)
+        add("parent_hist", (n_chunks, K, int(features), C, int(bins)), 4,
+            "split",
+            bytes_per_device=min(
+                int(hist_budget_bytes),
+                n_chunks * slab_bytes(K, int(features), C, int(bins)),
+            ))
+
+    resident = sum(
+        a["bytes_per_device"] for a in arrays if a["phase"] == RESIDENT
+    )
+    phases = {RESIDENT: resident}
+    split_extra = sum(
+        a["bytes_per_device"] for a in arrays if a["phase"] == "split"
+    )
+    if split_extra:
+        phases["split"] = resident + split_extra
+    peak_phase = max(phases, key=lambda p: phases[p])
+    host_peak = (
+        int(rows) * int(features) * 8      # raw + binned matrix
+        + int(n_trees) * int(rows) * 4     # per-tree bootstrap weights
+        + int(rows) * 16                   # row state
+    )
+    return MemoryPlan(
+        kind="forest",
+        mesh_axes=axes,
+        arrays=arrays,
+        phases=phases,
+        hbm_peak_bytes=int(phases[peak_phase]),
+        peak_phase=peak_phase,
+        host_peak_bytes=int(host_peak),
+        inputs={
+            "n_trees": int(n_trees), "rows": int(rows),
+            "features": int(features), "classes": int(classes),
+            "bins": int(bins), "task": task,
+            "max_depth": None if max_depth is None else int(max_depth),
+            "tree_shards": Dt, "data_shards": Dd,
+            "chunk_slots": int(K), "node_capacity": int(M),
+            "subtraction": bool(subtraction),
+            "engine": "forest_fused",
+        },
+    )
+
+
+def aggregate_plans(plans: list) -> dict:
+    """Whole-fit aggregation of a multi-plan fit (ISSUE 13 satellite —
+    the PR-12 follow-up): the host boosting loop records one plan per
+    round, so drift checking had nothing fit-shaped to compare the
+    whole-fit live watermark against and stood down.
+
+    The aggregate's per-phase watermark is the max across rounds; the
+    fit-level peak adds ONE extra resident generation on top — the host
+    loop places round ``r+1``'s shards before round ``r``'s buffers are
+    collected, so at the placement boundary two generations of resident
+    build state briefly coexist (more than two would mean a leak, which
+    is exactly what the re-armed underestimate check now catches).
+    """
+    plans = [p if isinstance(p, dict) else p.to_dict() for p in plans]
+    peaks = [int(p.get("hbm_peak_bytes") or 0) for p in plans]
+    binding = plans[peaks.index(max(peaks))]
+    resident = int((binding.get("phases") or {}).get(RESIDENT, 0))
+    phases: dict = {}
+    for p in plans:
+        for ph, v in (p.get("phases") or {}).items():
+            phases[ph] = max(int(phases.get(ph, 0)), int(v))
+    return {
+        "schema": MEMORY_SCHEMA,
+        "kind": "fit_aggregate",
+        "rounds": len(plans),
+        "phases": phases,
+        "hbm_peak_bytes": max(peaks) + resident,
+        "peak_phase": binding.get("peak_phase"),
+        "host_peak_bytes": max(
+            int(p.get("host_peak_bytes") or 0) for p in plans
+        ),
+        "inputs": dict(binding.get("inputs") or {}),
+    }
+
+
 def plan_serve(*, n_trees: int, n_nodes_total: int, n_nodes_max: int,
                n_features: int, value_channels: int, n_out: int,
                buckets=(1, 64, 4096), x64: bool = False,

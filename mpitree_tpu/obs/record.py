@@ -53,7 +53,16 @@ import json
 # table, plus the ``live`` span-boundary watermark samples when
 # ``MPITREE_TPU_MEM_SAMPLE=1``; digest gained
 # ``hbm_peak_bytes``/``host_peak_bytes``.
-SCHEMA_VERSION = 6
+# v7 (ISSUE 13, obs.flight): top-level ``fingerprints`` — cheap u64
+# per-level/per-round build-state fingerprints (``obs/fingerprint.py``:
+# hist/winner/alloc channels per tree level, live at the level-wise
+# host boundaries, replayed from finished trees for the fused
+# engines); digest gained the whole-fit ``fingerprint``, the one u64
+# ``obs.diff`` bisects from when two runs' digests disagree. Host-loop
+# multi-round fits may carry ``memory['aggregate']`` (the whole-fit
+# MemoryPlan aggregation that re-arms drift checking, a PR-12
+# follow-up).
+SCHEMA_VERSION = 7
 
 # Which mesh axis each collective site reduces/gathers over — the wire
 # ledger's per-axis attribution. Every histogram/counts/y-range reduction
@@ -84,6 +93,7 @@ TOP_LEVEL_FIELDS = (
     "level_stream",
     "wire",
     "memory",
+    "fingerprints",
 )
 
 
@@ -169,7 +179,15 @@ class BuildRecord:
       rows with per-phase watermarks, ``hbm_peak_bytes``/
       ``host_peak_bytes``, the pricing inputs, and (with sampling on) a
       ``live`` section of span-boundary watermarks; ``{}`` when the
-      engine recorded no plan.
+      engine recorded no plan. Host-loop multi-round fits add
+      ``aggregate`` (v7): the whole-fit plan aggregation drift checking
+      compares against.
+    - ``fingerprints`` (v7): ``{"version", "trees": [[{level, nodes,
+      hist, winner, alloc}, ...], ...], "fit"}`` — per-level u64 state
+      fingerprints per built tree/round (``obs/fingerprint.py``) plus
+      the whole-fit fold; ``{}`` when no engine committed any (plain
+      PhaseTimer callers). ``obs.diff.localize_divergence`` bisects two
+      records' trees to the first divergent (tree, level, channel).
     """
 
     schema: int = SCHEMA_VERSION
@@ -188,6 +206,7 @@ class BuildRecord:
     level_stream: dict = dataclasses.field(default_factory=dict)
     wire: dict = dataclasses.field(default_factory=dict)
     memory: dict = dataclasses.field(default_factory=dict)
+    fingerprints: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return _jsonable(dataclasses.asdict(self))
@@ -334,6 +353,12 @@ def digest(report: dict) -> dict:
         "host_peak_bytes": (report.get("memory") or {}).get(
             "host_peak_bytes"
         ),
+        # The whole-fit build-state fingerprint (v7): one u64 over every
+        # level of every tree (obs/fingerprint.py). Two lineage entries
+        # whose fingerprints differ built DIFFERENT trees — obs.diff then
+        # bisects the per-level rows to the first divergent
+        # (tree, level, channel). None when no engine committed rows.
+        "fingerprint": (report.get("fingerprints") or {}).get("fit"),
         "wall_s": round(wall, 3),
     }
 

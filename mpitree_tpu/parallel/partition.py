@@ -37,7 +37,7 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from mpitree_tpu.parallel.mesh import DATA_AXIS, FEATURE_AXIS
+from mpitree_tpu.parallel.mesh import DATA_AXIS, FEATURE_AXIS, TREE_AXIS
 
 # name-pattern -> PartitionSpec over the (data, feature) mesh. First match
 # wins; the terminal catch-all replicates, because everything else that
@@ -57,6 +57,15 @@ PARTITION_RULES: tuple = (
     # against its own slab, so the carry's HBM cost also divides by the
     # feature-axis width.
     (r"^(parent_hist|hist_keep|pair_hist)$", P(None, FEATURE_AXIS, None, None)),
+    # Forest ensemble state on the (tree, data) mesh (ISSUE 13
+    # satellite): per-tree operand stacks shard their leading axis over
+    # the tree axis — bootstrap weight rows additionally data-shard with
+    # the rows they weight; candidate masks / node buffers / per-tree
+    # scalars replicate within a tree group. The forest memory plan
+    # (``obs.memory.plan_forest``) prices per-device bytes from exactly
+    # these rules.
+    (r"^tree_weights$", P(TREE_AXIS, DATA_AXIS)),
+    (r"^tree_\w+$", P(TREE_AXIS)),
     # Per-node tables the host builds for the split/update/counts steps:
     # frontier maps, smaller-sibling masks, split routing, monotonic
     # bounds, per-node feature masks/draws. Replicated — they are O(K)

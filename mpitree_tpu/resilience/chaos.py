@@ -82,7 +82,7 @@ _STATUS = {
     "oom": "RESOURCE_EXHAUSTED",
 }
 
-_KINDS = tuple(_STATUS) + ("nan", "hang", "kill")
+_KINDS = tuple(_STATUS) + ("nan", "hang", "kill", "skew")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -251,6 +251,21 @@ def corrupt(site: str, *arrays):
                 a.reshape(-1)[0] = float("nan")
                 poisoned.append(a)
             arrays = tuple(poisoned)
+        elif f.kind == "skew":
+            # Finite corruption (ISSUE 13): scale the first half of each
+            # payload by ``arg`` (default 2.0). Unlike ``nan`` — which the
+            # non-finite guards fail-fast on — a skewed payload builds a
+            # VALID but DIFFERENT tree, which is exactly what the
+            # fingerprint-divergence sentinel must localize to its first
+            # divergent level and channel (obs.diff).
+            factor = float(f.arg if f.arg is not None else 2.0)
+            skewed = []
+            for a in arrays:
+                a = a.copy()
+                flat = a.reshape(-1)
+                flat[: max(len(flat) // 2, 1)] *= factor
+                skewed.append(a)
+            arrays = tuple(skewed)
         else:
             _fire(f, site, plan.counts[site])
     return arrays if len(arrays) != 1 else arrays[0]

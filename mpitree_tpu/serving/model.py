@@ -38,6 +38,7 @@ import numpy as np
 import jax
 
 from mpitree_tpu.obs import BuildObserver
+from mpitree_tpu.obs import fingerprint as fingerprint_lib
 from mpitree_tpu.obs import memory as memory_lib
 from mpitree_tpu.obs.metrics import MetricsRegistry
 from mpitree_tpu.resilience import chaos, retry_device
@@ -202,6 +203,20 @@ class CompiledModel:
         self._m_deadline = self.metrics.counter(
             "mpitree_serving_deadline_misses_total"
         )
+        # Model build-state fingerprint (ISSUE 13): the whole-ensemble
+        # u64 over every member's per-level rows — serve_report_'s "am I
+        # serving the same model the baseline served?" stamp. A serving
+        # lineage whose latency moved AND whose fingerprint moved is a
+        # model change, not a serving regression; obs.diff reads it from
+        # the digest like the fit side's.
+        self._obs.record.fingerprints = {
+            "version": fingerprint_lib.FINGERPRINT_VERSION,
+            "trees": [],
+            "fit": fingerprint_lib.ensemble_fingerprint(self.trees),
+        }
+        # Flight-store envelopes from this observer are serve records,
+        # not fits (obs/flight lineage keys separate the two).
+        self._obs.flight_kind = "serve"
 
     def note_deadline_miss(self, n: int = 1) -> None:
         """Count requests answered past their deadline (the EDF

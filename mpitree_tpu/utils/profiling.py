@@ -96,6 +96,14 @@ class PhaseTimer:
         """No-op twin of BuildObserver.memory_plan (the obs.memory
         device/host ledger); plain timers pay nothing."""
 
+    # Engines compute per-level state fingerprints (obs/fingerprint.py)
+    # only when the timer wants them; a plain PhaseTimer doesn't, so
+    # library callers pay neither the hashing nor the row storage.
+    wants_fingerprints = False
+
+    def fingerprint_tree(self, rows) -> None:
+        """No-op twin of BuildObserver.fingerprint_tree."""
+
     @contextlib.contextmanager
     def compile_attribution(self, entry: str, fresh: bool = True):
         """No-op twin of BuildObserver.compile_attribution (cold-dispatch

@@ -61,7 +61,10 @@ def test_build_record_schema_golden():
     # digest gains feature_shards
     # v6 (ISSUE 12): top-level memory (the obs.memory device/host
     # ledger) and digest hbm_peak_bytes/host_peak_bytes
-    assert rep["schema"] == SCHEMA_VERSION == 6
+    # v7 (ISSUE 13): top-level fingerprints (per-level u64 build-state
+    # fingerprints, obs/fingerprint.py) and the digest's whole-fit
+    # fingerprint
+    assert rep["schema"] == SCHEMA_VERSION == 7
     # dataclass fields and the pinned tuple must agree too
     assert tuple(
         f.name for f in dataclasses.fields(BuildRecord)
@@ -72,7 +75,7 @@ def test_build_record_schema_golden():
         "engine", "reason", "n_nodes", "depth", "levels", "compile_new",
         "psum_bytes", "sub_frac", "expansions", "rounds_per_dispatch",
         "events", "wire_bytes", "wire_shard_bytes", "feature_shards",
-        "hbm_peak_bytes", "host_peak_bytes",
+        "hbm_peak_bytes", "host_peak_bytes", "fingerprint",
         "wall_s",
     )))
 

@@ -1,0 +1,516 @@
+"""obs.flight / obs.diff / obs.fingerprint — run registry, record diffing
+with divergence localization, and the noise-aware regression sentinel
+(ISSUE 13).
+
+The load-bearing pins:
+
+- **golden schemas**: fingerprint row fields, flight envelope fields, and
+  the diff dict's field set are frozen (consumers: benchdiff, the
+  watcher's verdict lines, committed flight stores);
+- **live == replay**: the level-wise loop's live per-level fingerprints
+  equal the replay from the finished tree — the same contract as the
+  wire ledger's live/replay split;
+- **the bit-identity pins, now observable**: fingerprints invariant
+  across (8,)/(4,2)/(2,4) meshes x {fused, levelwise} engines x the
+  host tier;
+- **zero device collectives**: fingerprinting changes no collective
+  accounting (host-side hashing only);
+- **the sentinel, end to end**: a slowed twin yields a regression
+  verdict naming the metric; a chaos-skewed twin diverges and bisects
+  to its exact round + level + channel; the clean twin diffs green;
+  injected perf/wire/accuracy regressions each exit benchdiff nonzero.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+
+import numpy as np
+import pytest
+
+from mpitree_tpu.obs import diff as obs_diff
+from mpitree_tpu.obs import fingerprint as obs_fp
+from mpitree_tpu.obs import flight as obs_flight
+from mpitree_tpu.obs import BuildObserver, digest
+from mpitree_tpu.resilience import chaos
+
+
+@pytest.fixture()
+def small_cls():
+    rng = np.random.default_rng(7)
+    X = rng.standard_normal((1200, 6)).astype(np.float32)
+    y = rng.integers(0, 3, 1200).astype(np.int32)
+    return X, y
+
+
+def _tree_clf(X, y, *, engine=None, n_devices=8, **kw):
+    from mpitree_tpu import DecisionTreeClassifier
+
+    if engine:
+        os.environ["MPITREE_TPU_ENGINE"] = engine
+    try:
+        return DecisionTreeClassifier(
+            max_depth=5, max_bins=16, backend="cpu", refine_depth=None,
+            n_devices=n_devices, **kw,
+        ).fit(X, y)
+    finally:
+        os.environ.pop("MPITREE_TPU_ENGINE", None)
+
+
+# ---------------------------------------------------------------------------
+# golden schemas
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_row_schema_golden(small_cls):
+    """Row field names and the record's fingerprints block are pinned."""
+    X, y = small_cls
+    clf = _tree_clf(X, y, engine="levelwise")
+    fp = clf.fit_report_["fingerprints"]
+    assert tuple(sorted(fp)) == ("fit", "trees", "version")
+    assert fp["version"] == obs_fp.FINGERPRINT_VERSION == 1
+    assert len(fp["fit"]) == 16  # u64 as 16 hex chars
+    row = fp["trees"][0][0]
+    assert tuple(sorted(row)) == (
+        "alloc", "hist", "level", "nodes", "winner",
+    )
+    assert obs_fp.CHANNELS == ("hist", "winner", "alloc")
+    # the digest carries the whole-fit fold
+    assert digest(clf.fit_report_)["fingerprint"] == fp["fit"]
+    # rows are JSON-clean (they ride fit_report_ and the flight store)
+    json.dumps(fp)
+
+
+def test_flight_envelope_schema_golden(tmp_path, small_cls):
+    X, y = small_cls
+    os.environ[obs_flight.RUN_DIR_ENV] = str(tmp_path)
+    try:
+        _tree_clf(X, y)
+    finally:
+        del os.environ[obs_flight.RUN_DIR_ENV]
+    store = obs_flight.FlightStore(str(tmp_path))
+    [env] = store.entries(kind="fit")
+    assert tuple(sorted(env)) == tuple(sorted((
+        "schema", "ts", "iso", "kind", "section", "git", "platform",
+        "mesh_axes", "config_digest", "digest", "metrics", "record",
+    )))
+    assert env["schema"] == obs_flight.FLIGHT_SCHEMA == 1
+    assert env["platform"] == "cpu"
+    assert env["record"]["schema"] == 7
+    assert env["digest"]["fingerprint"]
+
+
+def test_diff_dict_schema_golden():
+    d = obs_diff.diff_envelopes(
+        {"digest": {"wall_s": 1.0}}, {"digest": {"wall_s": 1.1}}
+    )
+    assert tuple(sorted(d)) == tuple(sorted((
+        "schema", "verdict", "metrics", "regressions", "changed",
+        "improvements", "fingerprint", "n_history",
+    )))
+    [row] = d["metrics"]
+    assert tuple(sorted(row)) == tuple(sorted((
+        "metric", "base", "cand", "delta", "ratio", "kind",
+        "threshold", "verdict",
+    )))
+
+
+# ---------------------------------------------------------------------------
+# live == replay, and the bit-identity pins made observable
+# ---------------------------------------------------------------------------
+
+def test_levelwise_live_rows_equal_replay(small_cls):
+    """The live per-level hashing at the host boundary and the finished-
+    tree replay hash the same bytes (the wire-ledger live/replay pin)."""
+    X, y = small_cls
+    clf = _tree_clf(X, y, engine="levelwise")
+    live = clf.fit_report_["fingerprints"]["trees"][0]
+    replay = obs_fp.tree_fingerprints(clf.tree_)
+    assert live == replay
+
+
+def test_fingerprints_invariant_across_meshes_and_engines(small_cls):
+    """(8,)/(4,2)/(2,4) x {fused, levelwise} x host tier: one build-state
+    fingerprint — the repo's bit-identity invariant, now observable."""
+    X, y = small_cls
+    fps = {}
+    for engine in ("fused", "levelwise"):
+        for nd in (8, 4, (4, 2), (2, 4)):
+            if engine == "fused" and isinstance(nd, tuple):
+                continue  # feature meshes ride levelwise programs
+            clf = _tree_clf(X, y, engine=engine, n_devices=nd)
+            fps[(engine, nd)] = clf.fit_report_["fingerprints"]
+    from mpitree_tpu import DecisionTreeClassifier
+
+    host = DecisionTreeClassifier(
+        max_depth=5, max_bins=16, backend="host", refine_depth=None,
+    ).fit(X, y)
+    fps[("host", 1)] = host.fit_report_["fingerprints"]
+    fits = {v["fit"] for v in fps.values()}
+    trees = [v["trees"] for v in fps.values()]
+    assert len(fits) == 1, f"fingerprints split: { {k: v['fit'] for k, v in fps.items()} }"
+    assert all(t == trees[0] for t in trees)
+
+
+def test_leafwise_fingerprints_match_levelwise_at_node_budget(small_cls):
+    """max_leaf_nodes at the level-wise node bound: identical trees,
+    identical fingerprints (the ISSUE-8 pin through the new channel)."""
+    X, y = small_cls
+    base = _tree_clf(X, y, engine="levelwise")
+    budget = int(np.sum(base.tree_.feature < 0))  # leaf count
+    lw = _tree_clf(X, y, max_leaf_nodes=budget)
+    assert (
+        lw.fit_report_["fingerprints"]["fit"]
+        == base.fit_report_["fingerprints"]["fit"]
+    )
+
+
+def test_fingerprints_add_zero_device_collectives(small_cls):
+    """Host-side hashing only: with fingerprinting disabled (a timer that
+    doesn't want rows) the collective ledger is byte-identical."""
+    from mpitree_tpu.core.builder import BuildConfig, build_tree
+    from mpitree_tpu.ops.binning import bin_dataset
+    from mpitree_tpu.parallel import mesh as mesh_lib
+
+    X, y = small_cls
+    binned = bin_dataset(X, max_bins=16, binning="quantile")
+    mesh = mesh_lib.resolve_mesh(n_devices=2)
+    cfg = BuildConfig(max_depth=4, engine="levelwise")
+
+    def run(want_fp: bool):
+        obs = BuildObserver(timing=False)
+        if not want_fp:
+            obs.wants_fingerprints = False
+        build_tree(binned, y, config=cfg, mesh=mesh, n_classes=3,
+                   timer=obs)
+        return obs.report()
+
+    with_fp = run(True)
+    without = run(False)
+    assert with_fp["collectives"] == without["collectives"]
+    assert with_fp["fingerprints"].get("trees")
+    assert without["fingerprints"] == {}
+
+
+# ---------------------------------------------------------------------------
+# flight store
+# ---------------------------------------------------------------------------
+
+def test_flight_store_lineage_and_baseline(tmp_path, small_cls):
+    X, y = small_cls
+    os.environ[obs_flight.RUN_DIR_ENV] = str(tmp_path)
+    try:
+        _tree_clf(X, y)
+        _tree_clf(X, y)
+        # a different config = a different lineage
+        from mpitree_tpu import DecisionTreeClassifier
+
+        DecisionTreeClassifier(
+            max_depth=3, max_bins=16, backend="cpu", refine_depth=None,
+            n_devices=8,
+        ).fit(X, y)
+    finally:
+        del os.environ[obs_flight.RUN_DIR_ENV]
+    store = obs_flight.FlightStore(str(tmp_path))
+    fits = store.entries(kind="fit")
+    assert len(fits) == 3
+    a, b, c = fits
+    assert a["config_digest"] == b["config_digest"]
+    assert c["config_digest"] != b["config_digest"]
+    assert store.lineage(b) == [a, b]
+    assert store.baseline_for(b) == a
+    assert store.baseline_for(a) is None
+    assert store.baseline_for(c) is None
+    assert store.latest(kind="fit") == c
+
+
+def test_flight_store_append_once_per_fit(tmp_path, small_cls):
+    """Repeated report() calls (post-fit events) must not duplicate."""
+    X, y = small_cls
+    os.environ[obs_flight.RUN_DIR_ENV] = str(tmp_path)
+    try:
+        clf = _tree_clf(X, y)
+        # a dump_report-style re-report
+        clf.dump_report(str(tmp_path / "rep.json"))
+    finally:
+        del os.environ[obs_flight.RUN_DIR_ENV]
+    assert len(obs_flight.FlightStore(str(tmp_path)).entries()) == 1
+
+
+def test_flight_store_torn_line_and_unwritable_degrade(tmp_path):
+    store = obs_flight.FlightStore(str(tmp_path))
+    store.append(kind="bench", section="s", metrics={"warm_s": 1.0})
+    with open(store.path, "a") as f:
+        f.write('{"torn": ')  # SIGKILL mid-append
+    store.append(kind="bench", section="s", metrics={"warm_s": 2.0})
+    rows = store.entries(section="s")
+    assert [r["metrics"]["warm_s"] for r in rows] == [1.0, 2.0]
+    # unwritable root: warn + None, never raise (telemetry contract)
+    blocked = obs_flight.FlightStore(str(tmp_path / "f"))
+    (tmp_path / "f").write_text("a file where the dir should be")
+    with pytest.warns(UserWarning, match="flight store unwritable"):
+        assert blocked.append(kind="fit", record={}) is None
+
+
+def test_serve_records_carry_model_fingerprint(small_cls):
+    from mpitree_tpu.serving import compile_model
+
+    X, y = small_cls
+    clf = _tree_clf(X, y)
+    m1 = compile_model(clf, buckets=(64,))
+    m2 = compile_model(clf, buckets=(64,))
+    f1 = m1.serve_report_["fingerprints"]["fit"]
+    assert f1 and f1 == m2.serve_report_["fingerprints"]["fit"]
+    # ...and it is the ensemble fold of the served trees
+    assert f1 == obs_fp.ensemble_fingerprint([clf.tree_])
+
+
+# ---------------------------------------------------------------------------
+# the sentinel, end to end
+# ---------------------------------------------------------------------------
+
+def _gbdt(X, y):
+    from mpitree_tpu import GradientBoostingClassifier
+
+    return GradientBoostingClassifier(
+        max_iter=3, max_depth=3, max_bins=32, backend="cpu",
+    ).fit(X, y)
+
+
+def test_sentinel_end_to_end_clean_slow_and_corrupt(tmp_path):
+    """The acceptance proof: clean twin green; slowed twin = a regression
+    verdict naming the metric; chaos-corrupted twin = diverged, bisected
+    to its exact round + level + channel."""
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((2500, 8)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.int32)
+    os.environ[obs_flight.RUN_DIR_ENV] = str(tmp_path)
+    try:
+        _gbdt(X, y)
+        _gbdt(X, y)
+        # round 2 (0-based round index 1) gets a finite skewed gradient
+        # payload: a valid but DIFFERENT tree — the nan kind would
+        # fail-fast in the non-finite guard instead of diverging.
+        with chaos.active(chaos.Fault("grad_hess", 2, "skew", 4.0)):
+            _gbdt(X, y)
+    finally:
+        del os.environ[obs_flight.RUN_DIR_ENV]
+    store = obs_flight.FlightStore(str(tmp_path))
+    a, b, corrupt = store.entries(kind="fit")
+    assert a["config_digest"] == corrupt["config_digest"]  # one lineage
+
+    # clean twin diffs green
+    d_clean = obs_diff.diff_envelopes(a, b, history=[a])
+    assert d_clean["verdict"] in ("ok", "improved")
+    assert d_clean["fingerprint"]["match"] is True
+    assert obs_diff.exit_code(d_clean) == 0
+
+    # slowed twin: regression verdict NAMES the metric
+    slow = copy.deepcopy(b)
+    slow["digest"]["wall_s"] = (b["digest"].get("wall_s") or 0.2) * 3 + 1
+    d_slow = obs_diff.diff_envelopes(a, slow, history=[a, b])
+    assert d_slow["verdict"] == "regression"
+    assert "wall_s" in d_slow["regressions"]
+    assert obs_diff.exit_code(d_slow) == 1
+    assert "wall_s" in obs_diff.summary_line(d_slow)
+
+    # corrupted twin: diverged, localized to the poisoned round and a
+    # real channel (the skew flips winners at the first level it binds)
+    d_div = obs_diff.diff_envelopes(b, corrupt, history=[a, b])
+    assert d_div["verdict"] == "diverged"
+    dv = d_div["fingerprint"]["divergence"]
+    assert dv is not None
+    assert dv["tree"] == 1  # the round the fault fired on (0-based)
+    assert dv["level"] is not None
+    assert dv["channel"] in ("hist", "winner", "alloc")
+    assert obs_diff.exit_code(d_div) == 1
+
+
+def test_localize_divergence_orders_channels_upstream_first():
+    row = {"level": 0, "nodes": 1, "hist": "a", "winner": "b", "alloc": "c"}
+    other = dict(row, hist="X", winner="Y")
+    fa = {"trees": [[row], [row]]}
+    fb = {"trees": [[row], [other]]}
+    dv = obs_diff.localize_divergence(fa, fb)
+    assert dv == {
+        "tree": 1, "level": 0, "channel": "hist",
+        "channels": ["hist", "winner"],
+    }
+    assert obs_diff.localize_divergence(fa, fa) is None
+    # tree-count mismatch localizes to the first missing tree
+    dv2 = obs_diff.localize_divergence({"trees": [[row]]}, fa)
+    assert dv2["tree"] == 1 and "tree counts differ" in dv2["note"]
+
+
+def test_chaos_skew_is_finite_and_deterministic():
+    g = np.ones((8, 1))
+    with chaos.active(chaos.Fault("grad_hess", 1, "skew", 3.0)):
+        out = chaos.corrupt("grad_hess", g)
+    assert np.isfinite(out).all()
+    assert out[:4, 0].tolist() == [3.0] * 4
+    assert out[4:, 0].tolist() == [1.0] * 4
+    assert g[0, 0] == 1.0  # the input is copied, never mutated
+
+
+# ---------------------------------------------------------------------------
+# benchdiff CLI: injected perf / wire / accuracy regressions each gate
+# ---------------------------------------------------------------------------
+
+def _write_jsonl(path, payloads, section="secX"):
+    with open(path, "w") as f:
+        for p in payloads:
+            f.write(json.dumps({section: p, "platform_probe": "tpu"}) + "\n")
+
+
+def _bench_payload(**over):
+    base = {
+        "warm_s": 10.0, "test_acc": 0.75,
+        "record": {
+            "engine": "fused", "n_nodes": 100, "wall_s": 10.0,
+            "psum_bytes": 1000, "wire_bytes": 5000,
+            "fingerprint": "aa" * 8,
+        },
+    }
+    rec_over = over.pop("record", {})
+    base.update(over)
+    base["record"] = {**base["record"], **rec_over}
+    return base
+
+
+@pytest.mark.parametrize("doctor, metric", [
+    ({"warm_s": 30.0, "record": {"wall_s": 30.0}}, "warm_s"),
+    ({"record": {"wire_bytes": 9000}}, "wire_bytes"),
+    ({"test_acc": 0.60}, "test_acc"),
+])
+def test_benchdiff_exits_nonzero_on_injected_regression(
+    tmp_path, capsys, doctor, metric,
+):
+    from tools import benchdiff
+
+    path = str(tmp_path / "bench.jsonl")
+    _write_jsonl(path, [_bench_payload(), _bench_payload(**doctor)])
+    rc = benchdiff.main(["--jsonl", path, "--section", "secX"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "regression" in out and metric in out
+
+
+def test_benchdiff_clean_and_bench_artifact_modes(tmp_path, capsys):
+    from tools import benchdiff
+
+    path = str(tmp_path / "bench.jsonl")
+    _write_jsonl(path, [_bench_payload(), _bench_payload(warm_s=10.4)])
+    assert benchdiff.main(["--jsonl", path, "--section", "secX"]) == 0
+
+    # --bench mode over BENCH_rNN-style driver artifacts; parsed=null
+    # rounds are skipped, newest parseable pair compares
+    rounds = [
+        {"parsed": None},
+        {"parsed": {"value": 10.0, "detail": {"ours_test_acc": 0.74}}},
+        {"parsed": {"value": 9.0, "detail": {"ours_test_acc": 0.74}}},
+    ]
+    paths = []
+    for i, doc in enumerate(rounds):
+        p = str(tmp_path / f"BENCH_r0{i + 1}.json")
+        with open(p, "w") as f:
+            json.dump(doc, f)
+        paths.append(p)
+    assert benchdiff.main(["--bench", *paths]) == 0
+    # an injected wall regression in the newest round gates
+    with open(paths[-1], "w") as f:
+        json.dump({"parsed": {"value": 30.0,
+                              "detail": {"ours_test_acc": 0.74}}}, f)
+    assert benchdiff.main(["--bench", *paths, "--format", "github"]) == 1
+    assert "::error" in capsys.readouterr().out
+
+
+def test_benchdiff_report_mode_bisects_fingerprints(tmp_path, small_cls):
+    """Two dump_report files whose trees differ: diverged + localized."""
+    from tools import benchdiff
+
+    X, y = small_cls
+    a = _tree_clf(X, y, engine="levelwise")
+    b = _tree_clf(X, y.copy() * 0 + (y % 2), engine="levelwise")
+    pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    a.dump_report(pa)
+    b.dump_report(pb)
+    assert benchdiff.main([pa, pa]) == 0
+    assert benchdiff.main([pa, pb]) == 1
+
+
+# ---------------------------------------------------------------------------
+# satellites: forest memory plan + whole-fit aggregate re-arming drift
+# ---------------------------------------------------------------------------
+
+def test_forest_records_memory_plan_and_preflight_refuses(small_cls):
+    from mpitree_tpu.models.forest import RandomForestClassifier
+    from mpitree_tpu.obs import memory
+
+    X, y = small_cls
+    rf = RandomForestClassifier(
+        n_estimators=4, max_depth=4, backend="cpu", n_devices=8,
+    ).fit(X, y)
+    mem = rf.fit_report_["memory"]
+    assert mem["kind"] == "forest"
+    assert mem["mesh_axes"]["tree"] >= 1
+    assert mem["inputs"]["engine"] == "forest_fused"
+    names = {a["name"] for a in mem["arrays"]}
+    assert {"tree_weights", "tree_nodes", "x_binned"} <= names
+    # tree-axis division follows the partition rules: the per-tree weight
+    # stack divides by BOTH axes
+    tw = next(a for a in mem["arrays"] if a["name"] == "tree_weights")
+    Dt, Dd = mem["mesh_axes"]["tree"], mem["mesh_axes"]["data"]
+    T_pad, rows_pad = tw["shape"]
+    assert tw["bytes_per_device"] == (
+        -(-T_pad // Dt) * -(-rows_pad // Dd) * 4
+    )
+    # ...and the preflight refuses an absurd budget BEFORE dispatch
+    os.environ[memory.HBM_BUDGET_ENV] = str(1 << 12)
+    try:
+        with pytest.raises(memory.MemoryPlanError):
+            RandomForestClassifier(
+                n_estimators=4, max_depth=4, backend="cpu", n_devices=8,
+            ).fit(X, y)
+    finally:
+        del os.environ[memory.HBM_BUDGET_ENV]
+
+
+def test_gbdt_host_loop_records_whole_fit_aggregate(small_cls):
+    from mpitree_tpu.obs import memory
+
+    X, y = small_cls
+    os.environ[memory.MEM_SAMPLE_ENV] = "1"
+    try:
+        gb = _gbdt(X, (y % 2).astype(np.int32))
+    finally:
+        del os.environ[memory.MEM_SAMPLE_ENV]
+    rep = gb.fit_report_
+    agg = rep["memory"]["aggregate"]
+    assert agg["kind"] == "fit_aggregate"
+    assert agg["rounds"] == 3  # one plan per round build
+    # the aggregate covers >= the per-round peak (max + one resident gen)
+    assert agg["hbm_peak_bytes"] >= rep["memory"]["hbm_peak_bytes"]
+    # drift checking is RE-ARMED (no stand-down) and stays silent on the
+    # healthy fit
+    assert not any(
+        e["kind"] == "mem_estimate_drift" for e in rep["events"]
+    )
+
+
+def test_aggregate_plans_math():
+    from mpitree_tpu.obs import memory
+
+    p1 = {"hbm_peak_bytes": 100, "host_peak_bytes": 7,
+          "phases": {"resident": 40, "split": 100}, "peak_phase": "split",
+          "inputs": {"engine": "levelwise"}}
+    p2 = {"hbm_peak_bytes": 130, "host_peak_bytes": 9,
+          "phases": {"resident": 60, "split": 130}, "peak_phase": "split",
+          "inputs": {"engine": "levelwise"}}
+    agg = memory.aggregate_plans([p1, p2])
+    assert agg["rounds"] == 2
+    assert agg["phases"] == {"resident": 60, "split": 130}
+    # max per-round peak + the binding plan's resident generation
+    assert agg["hbm_peak_bytes"] == 130 + 60
+    assert agg["host_peak_bytes"] == 9
+    assert agg["kind"] == "fit_aggregate"

@@ -1,0 +1,247 @@
+"""benchdiff — the regression gate over stored run/bench artifacts.
+
+Thin CLI over ``mpitree_tpu/obs/diff.py`` + ``obs/flight.py`` (both
+loaded BY FILE PATH — stdlib-only by contract, so this runs on any CPU
+box with no jax install: the graftlint/tpu_watcher precedent). Three
+comparison sources, one verdict grammar:
+
+- ``--bench A.json B.json ...`` — committed ``BENCH_rNN.json`` driver
+  artifacts (CPU baselines): the NEWEST file is the candidate, the
+  previous parseable one the baseline, everything earlier the history
+  that seeds noise thresholds. ``make bench-diff`` / CI gate.
+- ``--jsonl BENCH_TPU.jsonl --section north_star`` — the newest stored
+  section payload vs the previous capture of the same section.
+- ``--store <run_dir> [--kind fit] [--section S]`` — the newest flight
+  envelope vs its lineage baseline (``obs.flight.FlightStore``).
+- two positional paths — ``dump_report(path)`` JSON files (full
+  BuildRecords): digest metrics compare AND fingerprint divergence
+  bisects to the first divergent (tree, level, channel).
+
+Exit code: 0 for ok/changed/improved, 1 for regression/diverged (the
+gate), 2 for usage/IO problems. ``--format github`` emits workflow
+annotations (the graftlint idiom).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name: str):
+    """Load an obs module by file path (no package import, no jax).
+
+    Registered in ``sys.modules`` BEFORE exec: record.py defines a
+    dataclass, and dataclass field resolution looks the defining module
+    up by name — an unregistered module crashes it."""
+    modname = f"_benchdiff_{name}"
+    if modname in sys.modules:
+        return sys.modules[modname]
+    spec = importlib.util.spec_from_file_location(
+        modname,
+        os.path.join(REPO, "mpitree_tpu", "obs", f"{name}.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[modname] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# The curated BENCH_rNN comparison set: our build's wall/accuracy/
+# throughput and the headline speedup. Reference-side walls (sklearn_s,
+# mpi8_*) are environment measurements, not ours — gating on them would
+# fail CI on a slow runner with zero code change.
+BENCH_METRICS = (
+    "value", "vs_baseline", "ours_test_acc", "acc_delta_vs_sklearn",
+    "throughput_cells_per_s", "tree_n_nodes", "tree_depth",
+)
+
+
+def bench_metrics(path: str) -> dict | None:
+    """{metric: value} from one BENCH_rNN.json driver artifact, or None
+    when its ``parsed`` payload is missing (a failed round — skipped,
+    the tolerant-history contract)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    parsed = doc.get("parsed") if isinstance(doc, dict) else None
+    if not isinstance(parsed, dict):
+        return None
+    flat = dict(parsed)
+    detail = parsed.get("detail")
+    if isinstance(detail, dict):
+        for k, v in detail.items():
+            flat.setdefault(k, v)
+    return {
+        k: flat[k] for k in BENCH_METRICS
+        if isinstance(flat.get(k), (int, float))
+        and not isinstance(flat.get(k), bool)
+    }
+
+
+def _env(metrics: dict | None = None, digest: dict | None = None,
+         record: dict | None = None) -> dict:
+    return {"metrics": metrics or {}, "digest": digest or {},
+            "record": record}
+
+
+def diff_bench(paths: list, diff_mod) -> tuple:
+    """(diff, label) over BENCH_rNN artifacts, newest = candidate."""
+    rows = [(p, bench_metrics(p)) for p in paths]
+    usable = [(p, m) for p, m in rows if m]
+    if len(usable) < 2:
+        return None, (
+            f"need >= 2 parseable BENCH artifacts, got {len(usable)} of "
+            f"{len(paths)} (rounds with parsed=null are skipped)"
+        )
+    (bp, bm), (cp, cm) = usable[-2], usable[-1]
+    history = [_env(metrics=m) for _p, m in usable[:-1]]
+    d = diff_mod.diff_envelopes(
+        _env(metrics=bm), _env(metrics=cm), history=history
+    )
+    return d, f"{os.path.basename(bp)} -> {os.path.basename(cp)}"
+
+
+def diff_jsonl(path: str, section: str, diff_mod) -> tuple:
+    """Newest vs previous stored payload of one BENCH_TPU.jsonl section."""
+    payloads = []
+    try:
+        with open(path) as f:
+            for ln in f:
+                if not ln.strip():
+                    continue
+                try:
+                    rec = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                p = rec.get(section) if isinstance(rec, dict) else None
+                if isinstance(p, dict):
+                    payloads.append(p)
+    except OSError as e:
+        return None, f"cannot read {path}: {e}"
+    if len(payloads) < 2:
+        return None, (
+            f"section {section!r} has {len(payloads)} stored payload(s) "
+            "in the jsonl; need >= 2 to diff"
+        )
+    d = diff_mod.diff_payloads(
+        payloads[-2], payloads[-1], history=payloads[:-1]
+    )
+    return d, f"{section} (jsonl history n={len(payloads)})"
+
+
+def diff_store(root: str, diff_mod, flight_mod, *, kind=None,
+               section=None, platform=None) -> tuple:
+    """Newest flight envelope vs its lineage baseline.
+
+    One store read: entries() parses the whole JSONL (envelopes can
+    embed full BuildRecords), so latest/baseline/history derive from a
+    single pass instead of three."""
+    store = flight_mod.FlightStore(root)
+    rows = store.entries(kind=kind, section=section, platform=platform)
+    if not rows:
+        return None, f"no entries in {store.path} match the filters"
+    cand = rows[-1]
+    lineage_key = tuple(cand.get(k) for k in flight_mod.LINEAGE_KEYS)
+    history = [
+        e for e in rows[:-1]
+        if tuple(e.get(k) for k in flight_mod.LINEAGE_KEYS) == lineage_key
+    ]
+    if not history:
+        return None, (
+            "newest entry has no lineage baseline yet (first run of this "
+            f"config on {cand.get('platform')}) — nothing to diff"
+        )
+    d = diff_mod.diff_envelopes(history[-1], cand, history=history)
+    label = (
+        f"{cand.get('kind')}:{cand.get('section') or cand.get('config_digest')}"
+        f" @ {cand.get('platform')}"
+    )
+    return d, label
+
+
+def diff_reports(base_path: str, cand_path: str, diff_mod) -> tuple:
+    """Two dump_report(path) JSON files — full BuildRecord diff."""
+    try:
+        with open(base_path) as f:
+            base = json.load(f)
+        with open(cand_path) as f:
+            cand = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return None, f"cannot read reports: {e}"
+    # The record digest needs the obs digest function — record.py is
+    # stdlib-only too, so it loads the same way.
+    record_mod = _load("record")
+    d = diff_mod.diff_envelopes(
+        _env(digest=record_mod.digest(base), record=base),
+        _env(digest=record_mod.digest(cand), record=cand),
+    )
+    return d, (
+        f"{os.path.basename(base_path)} -> {os.path.basename(cand_path)}"
+    )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="benchdiff", description=__doc__.splitlines()[0]
+    )
+    p.add_argument("reports", nargs="*",
+                   help="two dump_report JSON files (base, candidate)")
+    p.add_argument("--bench", nargs="+", metavar="BENCH_rNN.json",
+                   help="committed driver artifacts, oldest first; "
+                        "newest = candidate, earlier = history")
+    p.add_argument("--jsonl", help="BENCH_TPU.jsonl to read --section from")
+    p.add_argument("--section", help="section name (with --jsonl/--store)")
+    p.add_argument("--store", metavar="RUN_DIR",
+                   help="flight run dir (obs.flight store)")
+    p.add_argument("--kind", default=None,
+                   help="flight envelope kind filter (fit/serve/bench)")
+    p.add_argument("--platform", default=None)
+    p.add_argument("--format", choices=("human", "github"),
+                   default="human")
+    p.add_argument("--json", action="store_true",
+                   help="print the full diff dict as JSON")
+    args = p.parse_args(argv)
+
+    diff_mod = _load("diff")
+    if args.bench:
+        d, label = diff_bench(args.bench, diff_mod)
+    elif args.jsonl:
+        if not args.section:
+            print("benchdiff: --jsonl needs --section", file=sys.stderr)
+            return 2
+        d, label = diff_jsonl(args.jsonl, args.section, diff_mod)
+    elif args.store:
+        d, label = diff_store(
+            args.store, diff_mod, _load("flight"), kind=args.kind,
+            section=args.section, platform=args.platform,
+        )
+    elif len(args.reports) == 2:
+        d, label = diff_reports(args.reports[0], args.reports[1], diff_mod)
+    else:
+        p.print_usage(sys.stderr)
+        print(
+            "benchdiff: pass two report files, --bench, --jsonl, or "
+            "--store", file=sys.stderr,
+        )
+        return 2
+
+    if d is None:
+        print(f"benchdiff: {label}", file=sys.stderr)
+        return 2
+    print(f"benchdiff {label}")
+    print(diff_mod.format_diff(d, args.format))
+    if args.json:
+        print(json.dumps(d, indent=2, sort_keys=True))
+    return diff_mod.exit_code(d)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

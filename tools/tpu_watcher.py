@@ -17,7 +17,6 @@ the host-side phases of the measurement.
 
 import argparse
 import glob
-import importlib.util
 import os
 import shutil
 import signal
@@ -115,17 +114,19 @@ def derive_budget(sec: str, path: str = JSONL) -> tuple[int, str]:
     return derived, f"derived from observed {observed:.0f}s"
 
 
+def _obs_module(name: str):
+    """An obs module (trace/flight/diff) loaded BY FILE PATH — all three
+    are stdlib-only by contract, so the watcher works without importing
+    the mpitree_tpu package (and its jax dependency) on the babysitting
+    host. One shared sys.modules-cached loader (bench_tpu's) — the
+    watcher already imports bench_tpu helpers."""
+    from bench_tpu import _obs_module as load
+
+    return load(name)
+
+
 def _trace_module():
-    """obs/trace.py loaded BY FILE PATH — stdlib-only by contract, so the
-    merge works without importing the mpitree_tpu package (and its jax
-    dependency) on the babysitting host."""
-    spec = importlib.util.spec_from_file_location(
-        "_watcher_obs_trace",
-        os.path.join(REPO, "mpitree_tpu", "obs", "trace.py"),
-    )
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+    return _obs_module("trace")
 
 
 def merge_section_trace(sec: str) -> str | None:
@@ -239,7 +240,19 @@ def run_section(sec: str) -> bool:
     log(f"run {sec} (budget {budget}s, {why}; trace -> {sec_trace_dir})")
     open(FLAG, "w").close()
     outpath = f"/tmp/tpu_watcher_{sec}.out"
-    child_env = {**os.environ, "MPITREE_TPU_TRACE_DIR": sec_trace_dir}
+    # The flight store (ISSUE 13): the CHILD appends the section envelope
+    # (bench_tpu.flight_append_section — it knows the resolved platform
+    # and the workload config; the watcher appending too would split the
+    # lineage across two config digests). The watcher only injects the
+    # store location and logs the verdict afterwards.
+    child_env = {
+        **os.environ,
+        "MPITREE_TPU_TRACE_DIR": sec_trace_dir,
+        "MPITREE_TPU_RUN_DIR": (
+            os.environ.get("MPITREE_TPU_RUN_DIR")
+            or os.path.join(REPO, "runs")
+        ),
+    }
     try:
         # Child stdout goes to a FILE, not a pipe: a hung child cannot
         # deadlock on a full pipe buffer, and — the rc=-15 diagnosability
@@ -310,7 +323,38 @@ def run_section(sec: str) -> bool:
         digest = section_record_digest(sec)
         if digest:
             log(f"{sec}: record | {digest}")
+        flight_section(sec)
     return done
+
+
+def flight_section(sec: str) -> None:
+    """Log the just-captured section's regression verdict vs its stored
+    history (ISSUE 13): the next hardware round produces its own
+    trajectory analysis in the committed log instead of a bare JSONL
+    line. The flight-store APPEND itself happened in the bench_tpu child
+    (run_section injects ``MPITREE_TPU_RUN_DIR``); appending here too
+    would store every capture twice under two lineages. Best-effort —
+    telemetry never stops the capture loop."""
+    try:
+        from bench_tpu import read_capture_lines
+
+        payloads = [
+            rec[sec] for rec in read_capture_lines(JSONL)
+            if isinstance(rec.get(sec), dict)
+        ]
+        if not payloads:
+            return
+        diffm = _obs_module("diff")
+        if len(payloads) >= 2:
+            d = diffm.diff_payloads(
+                payloads[-2], payloads[-1], history=payloads[:-1]
+            )
+            log(f"{sec}: verdict | " + diffm.summary_line(d, label=sec))
+        else:
+            log(f"{sec}: verdict | first capture of this section — "
+                "stored as the baseline")
+    except Exception as e:  # noqa: BLE001 — telemetry, not the capture
+        log(f"{sec}: flight append failed ({type(e).__name__}: {e})")
 
 
 def main() -> int:
