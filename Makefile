@@ -6,7 +6,7 @@
 PY ?= python
 
 .PHONY: test test-cpu lint lint-graft lint-baseline bench bench-tpu report \
-  trace-smoke mem-smoke flight-smoke bench-diff clean
+  trace-smoke mem-smoke flight-smoke chaos-smoke bench-diff clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -71,6 +71,14 @@ mem-smoke:
 # seconds.
 flight-smoke:
 	JAX_PLATFORMS=cpu $(PY) examples/obs_flight_run.py
+
+# Resilience v2 gate (ISSUE 14): one fit survives a chaos-injected
+# level-kill via the sub-build retry rung (levels >= k re-dispatch,
+# fingerprint pinned identical), one survives a clearing OOM via the
+# on-device rescue ladder (priced shrink, zero host failover) —
+# exit-code-validated. CPU-safe, seconds.
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) examples/resilience_run.py
 
 # Regression gate over the committed CPU baselines (tools/benchdiff over
 # BENCH_r*.json): newest round vs the previous parseable one, noise

@@ -229,6 +229,7 @@ RECORD_DIGEST_KEYS = (
     "psum_bytes", "sub_frac", "expansions", "rounds_per_dispatch",
     "events", "wire_bytes", "wire_shard_bytes", "feature_shards",
     "hbm_peak_bytes", "host_peak_bytes", "fingerprint",
+    "level_retries", "oom_rescues",
     "wall_s",
 )
 
@@ -273,6 +274,15 @@ def format_record_digest(d: dict) -> str:
         # The whole-fit build-state fingerprint (v7): two lineage lines
         # with different fp= built DIFFERENT trees — obs.diff bisects.
         line += f" fp={d['fingerprint']}"
+    if d.get("level_retries") or d.get("oom_rescues"):
+        # Resilience v2 (v8): this capture SURVIVED fine-grained
+        # recovery — sub-build re-dispatches and/or on-device OOM
+        # rescues — so its wall clock carries retry time and its plan
+        # may have been shrunk mid-fit.
+        line += (
+            f" level_retries={d.get('level_retries') or 0}"
+            f" oom_rescues={d.get('oom_rescues') or 0}"
+        )
     if d.get("reason"):
         line += f" reason={d['reason']!r}"
     return line

@@ -1,0 +1,123 @@
+"""Resilience v2 smoke: one fit surviving a level-kill, one surviving a
+clearing OOM — exit-code-validated (ISSUE 14, wired as
+``make chaos-smoke``).
+
+The CI gate for the fine-grained recovery rungs, mirroring
+``obs_flight_run``'s role for the flight-recorder contract. Checks,
+each exiting nonzero on failure:
+
+1. **level-kill survival** — a chaos-injected transient UNAVAILABLE at
+   level 2 of a level-wise fit recovers via the SUB-BUILD rung: one
+   typed ``level_retry`` (granularity="level", resume_at=2), exactly
+   one extra per-level dispatch (levels >= 2 re-ran, levels < 2 did
+   not), zero host failovers, and the recovered tree's whole-fit
+   fingerprint equals the uninterrupted twin's;
+2. **clearing-OOM survival** — a chaos-injected RESOURCE_EXHAUSTED that
+   clears after one shrink is rescued ON DEVICE: one typed
+   ``oom_rescue`` naming the binding array (``split_hist_chunk``) and
+   the halved ``max_frontier_chunk``, the re-dispatch re-prices the
+   shrunk plan (the recorded ledger carries the halved chunk), zero
+   ``device_failover`` events, and the tree is still bit-identical
+   (chunk width is batching, not arithmetic).
+
+Run:  python examples/resilience_run.py  (CPU-safe, ~seconds)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import warnings
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Deterministic, fast recovery: no backoff sleeps, levelwise engine (the
+# snapshot-granular loop the smoke exercises).
+os.environ["MPITREE_TPU_BACKOFF_S"] = "0"
+os.environ["MPITREE_TPU_ENGINE"] = "levelwise"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+FAILURES: list[str] = []
+
+
+def check(ok: bool, what: str) -> None:
+    tag = "ok" if ok else "FAIL"
+    print(f"[{tag}] {what}")
+    if not ok:
+        FAILURES.append(what)
+
+
+def main() -> int:
+    from mpitree_tpu import DecisionTreeClassifier
+    from mpitree_tpu.resilience import chaos
+    from mpitree_tpu.resilience.chaos import Fault
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(600, 6)).astype(np.float32)
+    y = rng.integers(0, 4, size=600)  # noise target -> full-depth tree
+    kw = dict(max_depth=5, refine_depth=None, backend="cpu")
+
+    healthy = DecisionTreeClassifier(**kw).fit(X, y)
+    h_rep = healthy.fit_report_
+    levels = h_rep["counters"]["level_dispatches"]
+    h_fp = h_rep["fingerprints"]["fit"]
+    print(f"-- healthy fit: {levels} level dispatches, fp={h_fp}")
+
+    # -- 1. transient kill at level 2: sub-build retry -----------------
+    chaos.install([Fault("level", 1, "unavailable", at_level=2)])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        survived = DecisionTreeClassifier(**kw).fit(X, y)
+    chaos.clear()
+    rep = survived.fit_report_
+    check(rep["counters"].get("level_retries") == 1,
+          "level-kill: one sub-build retry")
+    check(rep["counters"]["level_dispatches"] == levels + 1,
+          "level-kill: only levels >= 2 re-dispatched")
+    check("device_failovers" not in rep["counters"],
+          "level-kill: no host failover")
+    evs = [e for e in rep["events"] if e["kind"] == "level_retry"]
+    check(bool(evs) and evs[0]["granularity"] == "level"
+          and evs[0]["resume_at"] == 2,
+          "level-kill: typed level_retry event (granularity + position)")
+    check(rep["fingerprints"]["fit"] == h_fp,
+          "level-kill: recovered fingerprint fold equals uninterrupted")
+    check(survived.export_text() == healthy.export_text(),
+          "level-kill: recovered tree bit-identical")
+
+    # -- 2. clearing OOM: on-device rescue ladder ----------------------
+    chunk0 = h_rep["memory"]["inputs"]["chunk_slots"]
+    chaos.install([Fault("level", 1, "oom", at_level=1, clears_after=1)])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        rescued = DecisionTreeClassifier(**kw).fit(X, y)
+    chaos.clear()
+    rep = rescued.fit_report_
+    check(rep["counters"].get("oom_rescues") == 1,
+          "oom: one rescue rung")
+    check("device_failover" not in [e["kind"] for e in rep["events"]],
+          "oom: the fit stayed on device (zero failover events)")
+    evs = [e for e in rep["events"] if e["kind"] == "oom_rescue"]
+    check(bool(evs) and evs[0]["knob"] == "max_frontier_chunk"
+          and evs[0]["binding_array"] == "split_hist_chunk"
+          and evs[0]["old_bytes"] > evs[0]["new_bytes"],
+          "oom: typed oom_rescue names knob, binding array, bytes")
+    check(rep["memory"]["inputs"]["chunk_slots"] == chunk0 // 2,
+          "oom: preflight re-priced the shrunk plan (chunk halved)")
+    check(rescued.export_text() == healthy.export_text()
+          and rep["fingerprints"]["fit"] == h_fp,
+          "oom: rescued tree bit-identical")
+
+    if FAILURES:
+        print(f"\n{len(FAILURES)} check(s) FAILED:")
+        for f in FAILURES:
+            print(f"  - {f}")
+        return 1
+    print("\nall resilience-v2 checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,7 +62,12 @@ from mpitree_tpu.core.builder import (
 from mpitree_tpu.ops import sampling as sampling_ops
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.parallel.mesh import DATA_AXIS
-from mpitree_tpu.resilience import chaos, retry_device
+from mpitree_tpu.resilience import (
+    chaos,
+    elastic_enabled,
+    is_oom_failure,
+    retry_device,
+)
 
 DEFAULT_ROUNDS_PER_DISPATCH = 8
 
@@ -363,7 +368,10 @@ def run_fused_rounds(*, binned, y_tr, sw_tr, raw_tr, trees, train_scores,
                      start_round: int, max_iter: int, cfg, mesh, obs,
                      seed: int, ck, lr: float, loss_kind: str,
                      rounds_per_dispatch: int, subsample: float,
-                     checkpoint_every: int, verbose: bool = False) -> int:
+                     checkpoint_every: int,
+                     checkpoint_compact_every=None,
+                     verbose: bool = False,
+                     slot=None, rescue=None) -> int:
     """Drive the boosting fit in K-round fused dispatches.
 
     Mutates ``trees``/``train_scores``/``raw_tr`` in place (the same
@@ -373,6 +381,19 @@ def run_fused_rounds(*, binned, y_tr, sw_tr, raw_tr, trees, train_scores,
     exact margin mirror persist — a killed fit re-run with the same
     params resumes bit-identically (the keyed subsample masks and the
     runtime ``r0`` operand make resumed dispatches replay exactly).
+
+    Resilience v2 (ISSUE 14): ``slot`` marks each dispatch boundary as a
+    resume point — the loop carries the completed rounds' margin mirror
+    on host, so retrying the failed dispatch IS sub-build retry at
+    dispatch granularity (typed ``level_retry`` events with
+    granularity="dispatch"). ``rescue``: an OOM whose ledger postmortem
+    names the fused pool/margin arrays degrades ``rounds_per_dispatch``
+    to 1 and RETURNS EARLY — none of those arrays scale with the
+    dispatch width, so the real shrink is routing the remaining rounds
+    back through gradient_boosting's host per-round loop (bit-identical
+    rounds, chunked working set, per-round re-priced plans).
+    ``checkpoint_compact_every``: merge checkpoint shards past this
+    count at each flush (long-run hygiene).
     """
     N = binned.x_binned.shape[0]
     B = binned.n_bins
@@ -447,6 +468,17 @@ def run_fused_rounds(*, binned, y_tr, sw_tr, raw_tr, trees, train_scores,
     raw32 = np.ascontiguousarray(raw_tr[:, 0], np.float32)
     r = start_round
     while r < max_iter:
+        if rescue is not None and rescue.rounds_per_dispatch:
+            # An OOM rescue named the fused pool/margin arrays as
+            # binding. None of them scale with the dispatch width —
+            # re-dispatching a k=1 FUSED program would allocate the
+            # same pool + donated margin carry + in-program (g, h) and
+            # OOM identically — so the degrade EXITS to the host
+            # per-round loop (gradient_boosting picks up the remaining
+            # rounds; its per-round levelwise builds carry the chunked
+            # split working set instead, record their own re-priced
+            # plans, and are pinned bit-identical to fused rounds).
+            break
         k = min(int(rounds_per_dispatch), max_iter - r)
         fn_kw = dict(
             loss_kind=loss_kind, n_rounds=k, n_bins=B, max_leaves=Pn,
@@ -479,12 +511,34 @@ def run_fused_rounds(*, binned, y_tr, sw_tr, raw_tr, trees, train_scores,
             return fn(xb_d, y_d, raw_d, w_d, cand_d, mcw, mid, lam, msl,
                       msg, lr32, np.int32(r), np.uint32(seed), sub_thresh)
 
+        if slot is not None:
+            # Dispatch-boundary resume point (ISSUE 14): the host margin
+            # mirror already carries rounds < r, so retrying THIS
+            # dispatch is sub-build retry at dispatch granularity — the
+            # ladder's level_retry rung re-invokes the closure and only
+            # rounds r..r+k-1 re-run.
+            slot.save("dispatch", r, {})
         with obs.span("fused_rounds"):
             with obs.compile_attribution("fused_rounds_fn", rounds_fresh):
-                out = retry_device(
-                    dispatch, what=f"gbdt fused rounds {r}..{r + k - 1}",
-                    obs=obs,
-                )
+                try:
+                    out = retry_device(
+                        dispatch,
+                        what=f"gbdt fused rounds {r}..{r + k - 1}",
+                        obs=obs, resume=slot,
+                    )
+                except Exception as e:  # noqa: BLE001 — OOM-rescue seam
+                    # The rescue cannot re-call the SAME closure (the
+                    # shrink changes the program), so it is handled
+                    # here: re-enter the loop, whose rescue check above
+                    # exits to the host per-round loop.
+                    if (rescue is None
+                            or not (elastic_enabled()
+                                    and is_oom_failure(e))
+                            or not rescue.attempt(
+                                e, what=f"gbdt fused rounds "
+                                f"{r}..{r + k - 1}")):
+                        raise
+                    continue
             raw32 = np.ascontiguousarray(fetch_row_nodes(out[0], N))
             (feat_s, bin_s, counts_s, n_s, left_s, parent_s, nn_s, G_s,
              H_s, ls_s, lw_s) = jax.device_get(out[1:])
@@ -570,6 +624,15 @@ def run_fused_rounds(*, binned, y_tr, sw_tr, raw_tr, trees, train_scores,
             }
             with obs.span("checkpoint_flush"):
                 ck.append(trees[len(ck.trees):], state)
+                ck.maybe_compact(checkpoint_compact_every, obs)
         r = new_r
-    raw_tr[:, 0] = raw32
+    if slot is not None:
+        slot.clear()
+    if r > start_round:
+        # The f32 device carry is authoritative only for rounds that
+        # actually dispatched; with zero committed dispatches (an OOM
+        # rescue exiting before round one) writing raw32 back would
+        # round the exact f64 margins through f32 for nothing and break
+        # the host-loop continuation's bit-identity.
+        raw_tr[:, 0] = raw32
     return r

@@ -39,7 +39,6 @@ from __future__ import annotations
 
 import numbers
 import time
-from functools import partial
 
 import numpy as np
 from sklearn.base import BaseEstimator, ClassifierMixin, RegressorMixin
@@ -57,7 +56,13 @@ from mpitree_tpu.ops.sampling import (
     seed_from,
 )
 from mpitree_tpu.parallel import mesh as mesh_lib
-from mpitree_tpu.resilience import BoostCheckpoint, chaos, retry_device
+from mpitree_tpu.resilience import (
+    BoostCheckpoint,
+    OomRescue,
+    SnapshotSlot,
+    chaos,
+    retry_device,
+)
 from mpitree_tpu.serving.tables import note_serving
 from mpitree_tpu.utils.validation import (
     feature_names_of,
@@ -152,7 +157,8 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
                  early_stopping=False, validation_fraction=0.1,
                  n_iter_no_change=10, tol=1e-7, random_state=None,
                  n_devices=None, backend=None, verbose=0,
-                 checkpoint=None, checkpoint_every=10):
+                 checkpoint=None, checkpoint_every=10,
+                 checkpoint_compact_every=None):
         self.loss = loss
         self.learning_rate = learning_rate
         self.max_iter = max_iter
@@ -190,6 +196,12 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
         # bit-identically.
         self.checkpoint = checkpoint
         self.checkpoint_every = checkpoint_every
+        # Long-run hygiene (ISSUE 14): once the checkpoint accumulates
+        # this many shard files, merge them into one
+        # (BuildCheckpoint.compact — manifest-committed, crash-safe).
+        # None disables compaction; very long builds otherwise pay one
+        # file open per shard at every resume.
+        self.checkpoint_compact_every = checkpoint_compact_every
 
     # -- fit ---------------------------------------------------------------
     def _validate_params_(self):
@@ -216,6 +228,12 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
         if int(self.checkpoint_every) < 1:
             raise ValueError(
                 f"checkpoint_every must be >= 1, got {self.checkpoint_every!r}"
+            )
+        cce = self.checkpoint_compact_every
+        if cce is not None and int(cce) < 2:
+            raise ValueError(
+                "checkpoint_compact_every must be >= 2 shards or None, "
+                f"got {cce!r}"
             )
         # Shared grammar + the backend="host" refusal (boosting rounds
         # run the device engines only, same as the tree estimators).
@@ -430,6 +448,13 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
         obs.decision(
             "rounds_per_dispatch", int(k_dispatch), reason=rpd_reason
         )
+        # Resilience v2 (ISSUE 14): one snapshot slot + OOM rescue per
+        # fit — the slot resumes a blipped round build from its failed
+        # level (host loop) and marks dispatch-boundary resume points
+        # (fused loop); the rescue's shrink ladder spans rounds, so a
+        # plan that OOM'd once stays shrunk for the rest of the fit.
+        slot = SnapshotSlot()
+        rescue = OomRescue(obs=obs, snapshot_slot=slot)
         if k_dispatch > 1:
             if not stopped_early and start_round < int(self.max_iter):
                 try:
@@ -444,7 +469,9 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
                         rounds_per_dispatch=int(k_dispatch),
                         subsample=float(self.subsample),
                         checkpoint_every=int(self.checkpoint_every),
+                        checkpoint_compact_every=self.checkpoint_compact_every,
                         verbose=bool(self.verbose),
+                        slot=slot, rescue=rescue,
                     )
                 except FloatingPointError:
                     # The raise aborts _fit before the normal report
@@ -453,7 +480,13 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
                     # (the host loop's guard does the same).
                     self.fit_report_ = obs.report(trees=trees)
                     raise
-            host_rounds = ()
+            # An OOM rescue inside the fused loop degrades
+            # rounds_per_dispatch to 1 and returns early: the fused
+            # pool + donated margin carry don't scale with the dispatch
+            # width, so the real shrink is finishing the remaining
+            # rounds here on the host per-round loop (bit-identical
+            # rounds, chunked split working set, per-round plans).
+            host_rounds = range(int(n_iter), int(self.max_iter))
         else:
             host_rounds = range(start_round, int(self.max_iter))
         for r in host_rounds:
@@ -508,15 +541,26 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
             for k in range(K):
                 g32 = np.ascontiguousarray(g[:, k], np.float32)
                 h32 = np.ascontiguousarray(h[:, k], np.float32)
+
                 # Retry rung only (resilience.retry): boosting has no host
                 # twin of the round build — below retries, the recovery
-                # rung is the round checkpoint.
-                tree, leaf_ids = retry_device(
-                    partial(
-                        build_tree, binned_r, g32, config=cfg, mesh=mesh,
+                # rung is the round checkpoint. Resilience v2: the shared
+                # snapshot slot resumes a transient blip from the failed
+                # LEVEL of this round's build, and the OOM rescue
+                # re-dispatches shrinkable RESOURCE_EXHAUSTED on-device
+                # (rescue.apply reads the accumulated shrinks at every
+                # (re-)dispatch, so the shrunk plan is re-preflighted).
+                def _round_dev(binned_r=binned_r, g32=g32, h32=h32):
+                    return build_tree(
+                        binned_r, g32, config=rescue.apply(cfg), mesh=mesh,
                         sample_weight=h32, return_leaf_ids=True, timer=obs,
-                    ),
+                        snapshot_slot=slot,
+                    )
+
+                tree, leaf_ids = retry_device(
+                    _round_dev,
                     what=f"gbdt round {r} tree build", obs=obs,
+                    resume=slot, rescue=rescue,
                 )
                 if kept is not None:
                     # Back to full-matrix feature ids (the predict surface
@@ -576,6 +620,10 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
                     state["stale"] = np.int64(stale)
                 with obs.span("checkpoint_flush"):
                     ck.append(trees[len(ck.trees):], state)
+                    # Long-run hygiene: merge accumulated shard files
+                    # (manifest-committed — a crash mid-compaction
+                    # recovers to the pre-compaction state).
+                    ck.maybe_compact(self.checkpoint_compact_every, obs)
             if stopped_early:
                 break
         if ck is not None:
@@ -669,7 +717,8 @@ class GradientBoostingRegressor(RegressorMixin, _BaseGradientBoosting):
                  early_stopping=False, validation_fraction=0.1,
                  n_iter_no_change=10, tol=1e-7, random_state=None,
                  n_devices=None, backend=None, verbose=0,
-                 checkpoint=None, checkpoint_every=10):
+                 checkpoint=None, checkpoint_every=10,
+                 checkpoint_compact_every=None):
         super().__init__(
             loss=loss, learning_rate=learning_rate, max_iter=max_iter,
             max_depth=max_depth, max_leaf_nodes=max_leaf_nodes,
@@ -685,6 +734,7 @@ class GradientBoostingRegressor(RegressorMixin, _BaseGradientBoosting):
             random_state=random_state, n_devices=n_devices, backend=backend,
             verbose=verbose, checkpoint=checkpoint,
             checkpoint_every=checkpoint_every,
+            checkpoint_compact_every=checkpoint_compact_every,
         )
 
     def fit(self, X, y, sample_weight=None, *, trace_to=None):
@@ -720,7 +770,8 @@ class GradientBoostingClassifier(ClassifierMixin, _BaseGradientBoosting):
                  early_stopping=False, validation_fraction=0.1,
                  n_iter_no_change=10, tol=1e-7, random_state=None,
                  n_devices=None, backend=None, verbose=0,
-                 checkpoint=None, checkpoint_every=10):
+                 checkpoint=None, checkpoint_every=10,
+                 checkpoint_compact_every=None):
         super().__init__(
             loss=loss, learning_rate=learning_rate, max_iter=max_iter,
             max_depth=max_depth, max_leaf_nodes=max_leaf_nodes,
@@ -736,6 +787,7 @@ class GradientBoostingClassifier(ClassifierMixin, _BaseGradientBoosting):
             random_state=random_state, n_devices=n_devices, backend=backend,
             verbose=verbose, checkpoint=checkpoint,
             checkpoint_every=checkpoint_every,
+            checkpoint_compact_every=checkpoint_compact_every,
         )
 
     def fit(self, X, y, sample_weight=None, *, trace_to=None):

@@ -41,7 +41,7 @@ from mpitree_tpu.obs import fingerprint as fingerprint_lib
 from mpitree_tpu.obs import memory as memory_lib
 from mpitree_tpu.ops.binning import BinnedData
 from mpitree_tpu.parallel import collective, mesh as mesh_lib
-from mpitree_tpu.resilience import chaos
+from mpitree_tpu.resilience import chaos, recovery as recovery_lib
 from mpitree_tpu.utils import importances as imp_utils
 from mpitree_tpu.utils.profiling import PhaseTimer, debug_checks_enabled
 
@@ -138,6 +138,20 @@ class BuildConfig:
     # histogram. MPITREE_TPU_HIST_SUBTRACTION overrides "auto" (see
     # resolve_hist_subtraction).
     hist_subtraction: str = "auto"
+    # Sub-build retry granularity (resilience v2, ISSUE 14): "auto"/"on"
+    # lets the host-stepped engines snapshot their loop carry at each
+    # level/expansion boundary (row->node state, frontier ids, resident
+    # parent histograms + slot maps, fingerprint fold), so a transient
+    # device failure re-dispatches FROM THE LAST COMPLETED boundary
+    # instead of restarting the fit (retry ladder rung 1,
+    # resilience/retry.py). Snapshots are reference captures — no copies
+    # beyond the fingerprint row list — and recovery is pinned
+    # bit-identical to an uninterrupted fit via the PR-13 fingerprint
+    # channels. "off" disables capture (every transient failure restarts
+    # the whole dispatch, the PR-6 behavior). The fused single-program
+    # engines have no host boundary and simply never snapshot.
+    # MPITREE_TPU_LEVEL_RETRY overrides "auto" (resolve_level_retry).
+    level_retry: str = "auto"
     # Frontier-width tiers served by dedicated branches (lax.cond chain in
     # the fused loop): a level whose frontier fits tier S computes an S-slot
     # histogram + gain sweep instead of the full K-slot one. Shallow levels
@@ -669,8 +683,17 @@ def build_tree(
     return_leaf_ids: bool = False,
     feature_sampler=None,
     mono_cst: np.ndarray | None = None,
+    snapshot_slot=None,
 ) -> TreeArrays:
     """Grow one tree level-synchronously; returns host struct-of-arrays.
+
+    ``snapshot_slot`` (:class:`~mpitree_tpu.resilience.recovery.
+    SnapshotSlot`, optional): the sub-build retry handle shared with the
+    retry ladder (ISSUE 14). When ``level_retry`` resolves on, the
+    level loop saves its carry there at every per-level host boundary;
+    a re-invocation with a pending snapshot fast-forwards from the last
+    completed level instead of restarting (sharding included). The
+    fused engine ignores it (no host boundary to snapshot).
 
     ``mono_cst`` ((F,) int8, optional): INTERNAL monotonicity signs
     (sklearn's convention — the estimator flips user signs for
@@ -724,6 +747,7 @@ def build_tree(
             sample_weight=sample_weight, refit_targets=refit_targets,
             timer=timer, return_leaf_ids=return_leaf_ids,
             feature_sampler=feature_sampler, mono_cst=mono_cst,
+            snapshot_slot=snapshot_slot,
         )
     debug = cfg.debug or debug_checks_enabled()
     timer.set_mesh(mesh)
@@ -868,28 +892,53 @@ def build_tree(
             timer=timer, return_leaf_ids=return_leaf_ids,
             feature_sampler=feature_sampler, mono_cst=mono_cst,
         )
-    with timer.phase("shard"):
-        xb_d, y_d, w_d, nid_d, cand_mask_d = mesh_lib.shard_build_inputs(
-            mesh, binned, y, sample_weight
-        )
-
-    tree = _TreeBuffer(
-        n_value_cols=(C if task == "classification" else 1),
-        value_dtype=np.int32 if task == "classification" else np.float32,
-        # Raw class counts stay int64 (the reference's predict_proba
-        # contract) unless fractional sample weights make them non-integral.
-        count_dtype=(
-            np.int64
-            if (task == "classification" and integer_weights(sample_weight))
-            else np.float64
-        ),
+    # Sub-build retry (resilience v2, ISSUE 14): when a snapshot slot is
+    # shared with the retry ladder and level_retry resolves on, the loop
+    # below saves its carry at every per-level host boundary, and a
+    # re-invocation with a pending snapshot restores it here — skipping
+    # the re-shard and fast-forwarding to the last completed level.
+    lr_on = (
+        snapshot_slot is not None
+        and recovery_lib.resolve_level_retry(cfg.level_retry)
     )
-    tree.ensure(1)
-    tree.n = 1  # root
+    resume_state = snapshot_slot.take("level") if lr_on else None
 
-    # Path-derived per-node keys (ops/sampling.py): the root hashes the
-    # tree seed, children hash the parent — engine-invariant.
-    keys = feature_sampler.key_store() if sampling else None
+    if resume_state is not None:
+        xb_d, y_d, w_d, cand_mask_d = resume_state["inputs"]
+        nid_d = resume_state["nid"]
+        # The buffer is shared with the snapshot by reference; rolling
+        # tree.n back un-allocates the failed level's children — its row
+        # ranges are rewritten verbatim when the level re-runs (every
+        # per-level write is a deterministic function of the restored
+        # carry, which is what the fingerprint-equality pins hold).
+        tree = resume_state["tree"]
+        tree.n = resume_state["tree_n"]
+        keys = resume_state["keys"]
+    else:
+        with timer.phase("shard"):
+            xb_d, y_d, w_d, nid_d, cand_mask_d = mesh_lib.shard_build_inputs(
+                mesh, binned, y, sample_weight
+            )
+
+        tree = _TreeBuffer(
+            n_value_cols=(C if task == "classification" else 1),
+            value_dtype=np.int32 if task == "classification" else np.float32,
+            # Raw class counts stay int64 (the reference's predict_proba
+            # contract) unless fractional sample weights make them
+            # non-integral.
+            count_dtype=(
+                np.int64
+                if (task == "classification"
+                    and integer_weights(sample_weight))
+                else np.float64
+            ),
+        )
+        tree.ensure(1)
+        tree.n = 1  # root
+
+        # Path-derived per-node keys (ops/sampling.py): the root hashes
+        # the tree seed, children hash the parent — engine-invariant.
+        keys = feature_sampler.key_store() if sampling else None
 
     # Per-node monotonic value bounds (utils/monotonic.py BoundsStore —
     # the one host-side propagation implementation), grown with the tree.
@@ -897,7 +946,10 @@ def build_tree(
         from mpitree_tpu.utils.monotonic import BoundsStore
 
         mono_cst32 = np.ascontiguousarray(mono_cst, np.int32)
-        bounds = BoundsStore()
+        bounds = (
+            resume_state["bounds"] if resume_state is not None
+            else BoundsStore()
+        )
 
     U = _table_slots(N, cfg)
     int_ok = integer_weights(sample_weight)
@@ -1057,6 +1109,16 @@ def build_tree(
     carry_budget_warned = False
     hist_itemsize = 8 if gbdt64 else 4
 
+    if resume_state is not None:
+        frontier_lo, frontier_size, depth = resume_state["frontier"]
+        if fp_rows is not None and resume_state["fp_rows"] is not None:
+            # The committed prefix of per-level fingerprint rows: levels
+            # < depth hashed exactly once; the failed level re-hashes
+            # when it re-runs.
+            fp_rows = list(resume_state["fp_rows"])
+        sub_parent = resume_state["sub_parent"]
+        carry_budget_warned = resume_state["carry_warned"]
+
     def _sub_ops_for_chunk(sp, base, take, S_lvl):
         """Subtraction operands for the child chunk at frontier offset
         ``base``: ``(parent_hist, slot_map, is_small)``.
@@ -1115,9 +1177,29 @@ def build_tree(
         return buf, pslot, ismall
 
     while frontier_size > 0:
+        if lr_on:
+            # Capture the loop carry at the per-level host boundary —
+            # reference grabs only (nid_d updates are functional, the
+            # tree buffer rolls back via tree.n, in-place level writes
+            # are deterministic re-writes); the one copy is the
+            # fingerprint row list. A failure anywhere below resumes
+            # HERE via the retry ladder's level_retry rung.
+            snapshot_slot.save("level", depth, dict(
+                inputs=(xb_d, y_d, w_d, cand_mask_d), nid=nid_d,
+                tree=tree, tree_n=tree.n, keys=keys,
+                bounds=(bounds if mono else None),
+                fp_rows=(None if fp_rows is None else list(fp_rows)),
+                sub_parent=sub_parent, carry_warned=carry_budget_warned,
+                frontier=(frontier_lo, frontier_size, depth),
+            ))
+        # Per-level dispatch counter: what the recovery-identity tests
+        # pin — a fit resumed at level k re-runs levels >= k only, so
+        # this counts (levels + levels re-dispatched), not 2x levels.
+        timer.counter("level_dispatches")
         # Chaos seam (resilience.chaos): lets tests kill/blip the build at
-        # an exact level; free (one global read) with no plan installed.
-        chaos.step("level")
+        # an exact level (Fault(at_level=depth) arms match the reported
+        # level); free (one global read) with no plan installed.
+        chaos.step("level", level=depth)
         terminal = cfg.max_depth is not None and depth == cfg.max_depth
         t_level = time.perf_counter() if timer.enabled else 0.0
         lvl_new = 0
@@ -1387,6 +1469,16 @@ def build_tree(
                         )
                     update_fresh = False
                     upd_calls += 1
+            if lr_on and upd_calls:
+                # The update dispatch is the level's only async tail: a
+                # deferred failure would otherwise surface at the NEXT
+                # level's device_get and the resume would re-consume a
+                # poisoned row-assignment. Blocking here attributes the
+                # failure to the level that issued it — and costs only
+                # the update/next-split overlap, which the data
+                # dependency (next split consumes nid_d) mostly forbids
+                # anyway.
+                jax.block_until_ready(nid_d)
             if df > 1 and upd_calls:
                 # Owner-broadcast of child ids across feature shards: the
                 # update step's psum over the feature axis reduces each
@@ -1460,6 +1552,11 @@ def build_tree(
         frontier_size = 2 * len(split_ids)
         depth += 1
 
+    if lr_on:
+        # Build complete: drop the snapshot (it holds device buffers) so
+        # any later failure restarts clean rather than resuming into a
+        # finalized build.
+        snapshot_slot.clear()
     out = tree.finalize()
     if fp_rows is not None:
         timer.fingerprint_tree(fp_rows)

@@ -60,7 +60,7 @@ from mpitree_tpu.obs import accounting as obs_acct
 from mpitree_tpu.ops import impurity as imp_ops
 from mpitree_tpu.parallel import collective, mesh as mesh_lib
 from mpitree_tpu.parallel.mesh import DATA_AXIS
-from mpitree_tpu.resilience import chaos
+from mpitree_tpu.resilience import chaos, recovery as recovery_lib
 from mpitree_tpu.utils.profiling import PhaseTimer
 
 
@@ -443,8 +443,15 @@ def build_tree_leafwise(
     return_leaf_ids: bool = False,
     feature_sampler=None,
     mono_cst: np.ndarray | None = None,
+    snapshot_slot=None,
 ):
     """Grow one tree best-first; same contract as ``builder.build_tree``.
+
+    ``snapshot_slot``: the sub-build retry handle (ISSUE 14) — the
+    host-stepped engine snapshots its carry at EXPANSION granularity, so
+    a transient failure at expansion e re-dispatches expansions >= e
+    only. The fused engine (one compiled program, no host boundary)
+    ignores it.
 
     Routed by ``build_tree`` whenever ``BuildConfig.max_leaf_nodes`` is
     set. Engine resolution mirrors the level-wise one: "fused" (default —
@@ -617,6 +624,7 @@ def build_tree_leafwise(
                 pool=Pn, max_nodes=M, sample_weight=sample_weight,
                 exact_ties=exact_ties, gbdt_x64=gbdt_x64, use_sub=use_sub,
                 mcw=mcw, mid=mid, lam=lam, msl=msl, msg=msg, timer=timer,
+                snapshot_slot=snapshot_slot,
             )
         )
         timer.counter("leafwise_stepped_builds")
@@ -670,7 +678,8 @@ def build_tree_leafwise(
 # device_get of packed pair decisions is its deliberate job
 def _build_leafwise_stepped(binned, y, *, cfg, mesh, n_classes, task, pool,
                             max_nodes, sample_weight, exact_ties, gbdt_x64,
-                            use_sub, mcw, mid, lam, msl, msg, timer):
+                            use_sub, mcw, mid, lam, msl, msg, timer,
+                            snapshot_slot=None):
     """Host-orchestrated best-first loop: one expand dispatch per step.
 
     Returns raw expansion-ordered buffers (the shared finalizer
@@ -678,6 +687,14 @@ def _build_leafwise_stepped(binned, y, *, cfg, mesh, n_classes, task, pool,
     open leaf's reduced pair histogram stays DEVICE-resident (a slice of
     the expansion output that created it) and is fed back as the parent
     operand when the leaf is expanded.
+
+    With ``snapshot_slot`` active (resolve_level_retry), the loop carry
+    is snapshotted at every per-expansion host boundary — reference
+    grabs only: the pre-dispatch in-place writes (feat/left/parent/depth
+    of the expanding pair) are deterministic re-writes of the restored
+    carry, and pool mutations happen only after the expansion's
+    device_get succeeded — so a transient blip resumes at the failed
+    expansion instead of restarting the build.
     """
     B = binned.n_bins
     F = binned.x_binned.shape[1]
@@ -689,27 +706,42 @@ def _build_leafwise_stepped(binned, y, *, cfg, mesh, n_classes, task, pool,
     expand_fresh = timer.compile_note(
         "expand_fn", (mesh,) + tuple(sorted(expand_kw.items()))
     )
-    with timer.phase("shard"):
-        xb_d, y_d, w_d, nid_d, cand_d = mesh_lib.shard_build_inputs(
-            mesh, binned, y, sample_weight
+    lr_on = (
+        snapshot_slot is not None
+        and recovery_lib.resolve_level_retry(cfg.level_retry)
+    )
+    resume_state = snapshot_slot.take("expansion") if lr_on else None
+    if resume_state is None:
+        with timer.phase("shard"):
+            xb_d, y_d, w_d, nid_d, cand_d = mesh_lib.shard_build_inputs(
+                mesh, binned, y, sample_weight
+            )
+
+        M = max_nodes
+        feat = np.full(M, -1, np.int32)
+        bins = np.zeros(M, np.int32)
+        counts = np.zeros((M, n_classes), np.float32)
+        nvec = np.zeros(M, np.float32)
+        left = np.full(M, -1, np.int32)
+        parent = np.full(M, -1, np.int32)
+        depth = np.zeros(M, np.int32)
+
+        pool_gain = np.full(pool, -np.inf, np.float32)
+        pool_node = np.zeros(pool, np.int32)
+        pool_feat = np.zeros(pool, np.int32)
+        pool_bin = np.zeros(pool, np.int32)
+        pool_nl = np.zeros(pool, np.float32)
+        # Per-slot (pair_hist device array, 0|1) refs — subtraction only.
+        pool_hist: list = [None] * pool
+    else:
+        xb_d, y_d, w_d, cand_d = resume_state["inputs"]
+        nid_d = resume_state["nid"]
+        (feat, bins, counts, nvec, left, parent, depth) = (
+            resume_state["bufs"]
         )
-
-    M = max_nodes
-    feat = np.full(M, -1, np.int32)
-    bins = np.zeros(M, np.int32)
-    counts = np.zeros((M, n_classes), np.float32)
-    nvec = np.zeros(M, np.float32)
-    left = np.full(M, -1, np.int32)
-    parent = np.full(M, -1, np.int32)
-    depth = np.zeros(M, np.int32)
-
-    pool_gain = np.full(pool, -np.inf, np.float32)
-    pool_node = np.zeros(pool, np.int32)
-    pool_feat = np.zeros(pool, np.int32)
-    pool_bin = np.zeros(pool, np.int32)
-    pool_nl = np.zeros(pool, np.float32)
-    # Per-slot (pair_hist device array, 0|1) refs — subtraction only.
-    pool_hist: list = [None] * pool
+        (pool_gain, pool_node, pool_feat, pool_bin, pool_nl,
+         pool_hist) = resume_state["pool"]
+        n_nodes, n_leaves = resume_state["n"]
 
     if use_sub and gbdt_x64:
         # f32 zeros converted INSIDE the scope — a direct f64 zeros
@@ -729,27 +761,44 @@ def _build_leafwise_stepped(binned, y, *, cfg, mesh, n_classes, task, pool,
             *sub_ops,
         )
 
-    # Root bootstrap: sentinel -2 reroutes nothing (live rows are >= 0,
-    # padding is -1), left_id 0 puts the whole dataset in pair slot 0.
-    with timer.compile_attribution("expand_fn", expand_fresh):
-        res = dispatch(-2, 0, 0, 0, True, zeros_ph if use_sub else None)
-    nid_d = res[0]
-    dec = collective.unpack_decision(np.asarray(jax.device_get(res[1])))
-    n0, _, gain0 = _stop_and_gain_np(dec, 0, task=task, cfg=cfg)
-    counts[0] = dec["counts"][0]
-    nvec[0] = n0[0]
-    pool_gain[0] = gain0[0]
-    pool_feat[0] = dec["feature"][0]
-    pool_bin[0] = dec["bin"][0]
-    pool_nl[0] = dec["n_left"][0]
-    if use_sub:
-        pool_hist[0] = (res[2], 0)
+    if resume_state is None:
+        # Root bootstrap: sentinel -2 reroutes nothing (live rows are
+        # >= 0, padding is -1), left_id 0 puts the whole dataset in pair
+        # slot 0.
+        with timer.compile_attribution("expand_fn", expand_fresh):
+            res = dispatch(
+                -2, 0, 0, 0, True, zeros_ph if use_sub else None
+            )
+        nid_d = res[0]
+        dec = collective.unpack_decision(
+            np.asarray(jax.device_get(res[1]))
+        )
+        n0, _, gain0 = _stop_and_gain_np(dec, 0, task=task, cfg=cfg)
+        counts[0] = dec["counts"][0]
+        nvec[0] = n0[0]
+        pool_gain[0] = gain0[0]
+        pool_feat[0] = dec["feature"][0]
+        pool_bin[0] = dec["bin"][0]
+        pool_nl[0] = dec["n_left"][0]
+        if use_sub:
+            pool_hist[0] = (res[2], 0)
 
-    n_nodes, n_leaves = 1, 1
+        n_nodes, n_leaves = 1, 1
     while n_leaves < pool and pool_gain.max() > -np.inf:
+        if lr_on:
+            snapshot_slot.save("expansion", n_leaves, dict(
+                inputs=(xb_d, y_d, w_d, cand_d), nid=nid_d,
+                bufs=(feat, bins, counts, nvec, left, parent, depth),
+                pool=(pool_gain, pool_node, pool_feat, pool_bin,
+                      pool_nl, pool_hist),
+                n=(n_nodes, n_leaves),
+            ))
+        timer.counter("expansion_dispatches")
         # Chaos seam (resilience.chaos): deterministic kill/blip at an
-        # exact expansion; free (one global read) with no plan installed.
-        chaos.step("expansion")
+        # exact expansion (``level`` reports the 1-based expansion
+        # ordinal for Fault(at_level=...) arms); free (one global read)
+        # with no plan installed.
+        chaos.step("expansion", level=n_leaves)
         t_exp = time.perf_counter() if timer.enabled else 0.0
         p = imp_ops.best_leaf_slot_np(pool_gain, pool_node)
         enode = int(pool_node[p])
@@ -810,4 +859,9 @@ def _build_leafwise_stepped(binned, y, *, cfg, mesh, n_classes, task, pool,
         n_nodes += 2
         n_leaves += 1
 
+    if lr_on:
+        # Loop complete: drop the snapshot (it holds device buffers) so
+        # a later failure restarts clean instead of resuming into a
+        # finished build.
+        snapshot_slot.clear()
     return feat, bins, counts, nvec, left, parent, n_nodes, nid_d

@@ -42,7 +42,12 @@ from mpitree_tpu.ops.predict import (
     predict_mesh,
 )
 from mpitree_tpu.parallel import mesh as mesh_lib
-from mpitree_tpu.resilience import device_failover, retry_device
+from mpitree_tpu.resilience import (
+    OomRescue,
+    SnapshotSlot,
+    device_failover,
+    retry_device,
+)
 from mpitree_tpu.serving.tables import note_serving
 from mpitree_tpu.utils.export import export_tree_text
 from mpitree_tpu.utils.importances import feature_importances
@@ -277,12 +282,20 @@ class DecisionTreeClassifier(ClassifierMixin, ReportMixin, BaseEstimator):
                 backend=self.backend, n_devices=self.n_devices
             )
 
+            # Resilience v2 (ISSUE 14): the snapshot slot lets the engine
+            # resume a transient failure from the last completed level/
+            # expansion; the OOM rescue re-dispatches a shrinkable
+            # RESOURCE_EXHAUSTED on-device under a shrunk, re-preflighted
+            # plan (rescue.apply below) before the host rung.
+            slot = SnapshotSlot()
+            rescue = OomRescue(obs=obs, snapshot_slot=slot)
+
             def _dev():
                 res = build_tree(
-                    binned, y_enc, config=cfg, mesh=mesh,
+                    binned, y_enc, config=rescue.apply(cfg), mesh=mesh,
                     n_classes=len(classes), sample_weight=sw, timer=timer,
                     return_leaf_ids=refine, feature_sampler=sampler,
-                    mono_cst=mono,
+                    mono_cst=mono, snapshot_slot=slot,
                 )
                 # The build maintains row->leaf ids on device; fetching them
                 # here spares the refine a second full-matrix descent (and X
@@ -317,13 +330,13 @@ class DecisionTreeClassifier(ClassifierMixin, ReportMixin, BaseEstimator):
                 self.tree_, leaf_ids = retry_device(
                     _dev,
                     what=f"{type(self).__name__}.fit leaf-wise build",
-                    obs=obs,
+                    obs=obs, resume=slot, rescue=rescue,
                 )
             else:
                 self.tree_, leaf_ids = device_failover(
                     _dev, _host,
                     what=f"{type(self).__name__}.fit device build",
-                    obs=obs,
+                    obs=obs, resume=slot, rescue=rescue,
                 )
         if refine:
             from mpitree_tpu.core.hybrid_builder import apply_refine

@@ -50,7 +50,12 @@ from mpitree_tpu.obs import (
 from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.ops.sampling import NodeFeatureSampler, n_subspace_features
 from mpitree_tpu.parallel import mesh as mesh_lib
-from mpitree_tpu.resilience import ForestCheckpoint, device_failover
+from mpitree_tpu.resilience import (
+    ForestCheckpoint,
+    OomRescue,
+    SnapshotSlot,
+    device_failover,
+)
 from mpitree_tpu.serving.tables import note_serving
 from mpitree_tpu.utils.validation import (
     apply_class_weight,
@@ -369,14 +374,20 @@ class _BaseForest(ReportMixin, BaseEstimator):
             # levelwise engine / debug mode: per-tree builds keep the
             # instrumentation and determinism checks build_tree wires up.
             # A lost accelerator costs wall-clock, not the fit
-            # (utils/elastic.py).
+            # (utils/elastic.py). Resilience v2: each tree gets a
+            # snapshot slot (level-granular resume) and the OOM rescue
+            # ladder (classifier wiring, per-tree).
+            slot = SnapshotSlot()
+            rescue = OomRescue(obs=obs, snapshot_slot=slot)
+
             def dev():
                 res = build_tree(
-                    tree_b[i], y_enc, config=tree_cfg(tree_w[i]), mesh=mesh,
+                    tree_b[i], y_enc,
+                    config=rescue.apply(tree_cfg(tree_w[i])), mesh=mesh,
                     n_classes=n_classes, sample_weight=tree_w[i],
                     refit_targets=refit_targets, return_leaf_ids=refine,
                     feature_sampler=tree_sampler[i], mono_cst=mono,
-                    timer=obs,
+                    timer=obs, snapshot_slot=slot,
                 )
                 return res if refine else (res, None)
 
@@ -390,6 +401,7 @@ class _BaseForest(ReportMixin, BaseEstimator):
             t, ids = device_failover(
                 dev, host,
                 what=f"forest tree {i} device build", obs=obs,
+                resume=slot, rescue=rescue,
             )
             return finish(i, t, ids)
 
@@ -412,9 +424,15 @@ class _BaseForest(ReportMixin, BaseEstimator):
                  for i in idxs], np.uint32
             )
 
+            # Fused group program: no host boundary to snapshot, but the
+            # OOM rescue still applies (a halved chunk / dropped carry
+            # re-dispatches the group on-device under the shrunk plan).
+            rescue = OomRescue(obs=obs)
+
             def dev():
                 return build_forest_fused(
-                    binned, y_enc, config=cfg, mesh=mesh, weights=ws,
+                    binned, y_enc, config=rescue.apply(cfg), mesh=mesh,
+                    weights=ws,
                     cand_masks=cms, n_classes=n_classes,
                     refit_targets=refit_targets,
                     integer_counts=integer_weights(sample_weight),
@@ -439,6 +457,7 @@ class _BaseForest(ReportMixin, BaseEstimator):
 
             res = device_failover(
                 dev, host, what="forest group device build", obs=obs,
+                rescue=rescue,
             )
             if refine:
                 gtrees, nid_all = res

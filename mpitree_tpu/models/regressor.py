@@ -35,7 +35,12 @@ from mpitree_tpu.ops.predict import (
     predict_mesh,
 )
 from mpitree_tpu.parallel import mesh as mesh_lib
-from mpitree_tpu.resilience import device_failover, retry_device
+from mpitree_tpu.resilience import (
+    OomRescue,
+    SnapshotSlot,
+    device_failover,
+    retry_device,
+)
 from mpitree_tpu.serving.tables import note_serving
 from mpitree_tpu.utils.export import export_tree_text
 from mpitree_tpu.utils.importances import feature_importances
@@ -183,11 +188,18 @@ class DecisionTreeRegressor(RegressorMixin, ReportMixin, BaseEstimator):
                 backend=self.backend, n_devices=self.n_devices
             )
 
+            # Resilience v2 (ISSUE 14): sub-build resume + priced OOM
+            # rescue, shared with the retry ladder (classifier twin).
+            slot = SnapshotSlot()
+            rescue = OomRescue(obs=obs, snapshot_slot=slot)
+
             def _dev():
                 res = build_tree(
-                    binned, y_c, config=cfg, mesh=mesh, sample_weight=sw,
+                    binned, y_c, config=rescue.apply(cfg), mesh=mesh,
+                    sample_weight=sw,
                     refit_targets=y64, timer=timer, return_leaf_ids=refine,
                     feature_sampler=sampler, mono_cst=mono,
+                    snapshot_slot=slot,
                 )
                 # Row->leaf ids come straight off the build's device state;
                 # a second full-matrix descent would re-upload X for nothing.
@@ -220,13 +232,13 @@ class DecisionTreeRegressor(RegressorMixin, ReportMixin, BaseEstimator):
                 self.tree_, leaf_ids = retry_device(
                     _dev,
                     what=f"{type(self).__name__}.fit leaf-wise build",
-                    obs=obs,
+                    obs=obs, resume=slot, rescue=rescue,
                 )
             else:
                 self.tree_, leaf_ids = device_failover(
                     _dev, _host,
                     what=f"{type(self).__name__}.fit device build",
-                    obs=obs,
+                    obs=obs, resume=slot, rescue=rescue,
                 )
         if refine:
             from mpitree_tpu.core.hybrid_builder import apply_refine

@@ -34,6 +34,12 @@ Contracts:
 - **telemetry never aborts** — an unwritable store degrades to a warning
   and a ``None`` return; a torn line (SIGKILL mid-append) is skipped on
   read, never poisons the history.
+- **bounded under an ambient RUN_DIR** — ``MPITREE_TPU_RUN_MAX_BYTES``
+  size-caps the store via a per-lineage tail trim (ISSUE 14; see the
+  retention knobs below): every lineage keeps its newest entries, so
+  ``obs.diff``/``benchdiff`` baselines survive rotation (histories
+  shorter than ``MIN_HISTORY`` degrade to the documented threshold
+  floors, never a crash). The append path pays one ``os.stat``.
 """
 
 from __future__ import annotations
@@ -49,12 +55,39 @@ FLIGHT_SCHEMA = 1
 RUN_DIR_ENV = "MPITREE_TPU_RUN_DIR"
 STORE_NAME = "flight.jsonl"
 
+# Long-run hygiene (ISSUE 14): under an ambient RUN_DIR the store grows
+# one envelope per fit forever. When the file exceeds
+# MPITREE_TPU_RUN_MAX_BYTES (0/unset = unbounded), append rotates it
+# through a per-lineage tail trim: keep the newest KEEP_PER_LINEAGE
+# entries of every (kind, section, config_digest, platform) lineage —
+# enough history for obs.diff's noise model (MIN_HISTORY = 3; fewer
+# degrades to the documented floors, never a crash) — dropping only the
+# old interior of each trajectory. The append path stays cheap: one
+# os.stat per append; the full parse happens only on an actual rotate.
+RUN_MAX_BYTES_ENV = "MPITREE_TPU_RUN_MAX_BYTES"
+RUN_KEEP_ENV = "MPITREE_TPU_RUN_KEEP"
+KEEP_PER_LINEAGE = 16
+
 # (kind, section, config_digest, platform): the identity under which two
 # entries are comparable — one lineage, one noise model.
 LINEAGE_KEYS = ("kind", "section", "config_digest", "platform")
 
 _GIT_SHA: str | None = None
 _GIT_PROBED = False
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        warnings.warn(
+            f"ignoring malformed {name}={raw!r} (expected an integer)",
+            stacklevel=3,
+        )
+        return default
 
 
 def enabled() -> bool:
@@ -125,6 +158,16 @@ def config_digest_from_record(record: dict, kind: str = "fit") -> str:
     })
 
 
+# Rotation progress guard, keyed by store path: once a trim fails to get
+# a store under the cap (too many lineages x keep entries for the
+# configured size), stand down instead of re-parsing the whole file on
+# EVERY append forever — the one-os.stat contract. Module-level (not
+# per handle) because the ambient path (``append_record``,
+# bench_tpu's section appends) constructs a FRESH FlightStore per
+# append; per-instance state would re-trim and re-warn on every fit.
+_ROTATION_STUCK: set = set()
+
+
 class FlightStore:
     """Append/query handle over one run directory's ``flight.jsonl``."""
 
@@ -193,7 +236,78 @@ class FlightStore:
                 stacklevel=2,
             )
             return None
+        self._maybe_rotate()
         return env
+
+    # -- retention (ISSUE 14) -----------------------------------------------
+    def _maybe_rotate(self) -> None:
+        """One os.stat; rotate only past the size cap (see module knobs).
+        Telemetry contract holds: any failure degrades to a warning."""
+        cap = _env_int(RUN_MAX_BYTES_ENV, 0)
+        key = os.path.abspath(self.path)
+        if cap <= 0 or key in _ROTATION_STUCK:
+            return
+        try:
+            if os.stat(self.path).st_size <= cap:
+                return
+        except OSError:
+            return
+        try:
+            self.trim(keep=_env_int(RUN_KEEP_ENV, KEEP_PER_LINEAGE))
+            if os.stat(self.path).st_size > cap:
+                # The tail trim alone cannot satisfy this cap (many
+                # lineages x keep entries exceed it). Warn once and stop
+                # rotating this store for the process — re-trimming on
+                # every append would turn each telemetry write into a
+                # full-file rewrite that drops nothing.
+                _ROTATION_STUCK.add(key)
+                warnings.warn(
+                    f"flight store still {os.stat(self.path).st_size} "
+                    f"bytes after a per-lineage tail trim (cap {cap}); "
+                    f"raise {RUN_MAX_BYTES_ENV} or lower {RUN_KEEP_ENV} "
+                    "— rotation stands down for this process",
+                    stacklevel=3,
+                )
+        except OSError as e:
+            warnings.warn(
+                f"flight store rotation failed ({e}); {self.path} keeps "
+                "growing",
+                stacklevel=3,
+            )
+
+    def trim(self, keep: int = KEEP_PER_LINEAGE) -> int:
+        """Per-lineage tail trim: rewrite the store keeping the newest
+        ``keep`` entries of every lineage (file order = append order);
+        returns the number of entries dropped.
+
+        Torn/unparseable lines are dropped with the trim (they are
+        already invisible to every reader), and the rewrite is
+        write-temp + ``os.replace`` so a crash leaves either the old or
+        the new store — never a torn one. Appends from a concurrent
+        process during the rewrite window can be lost; the store is
+        telemetry, and one lost envelope beats an unbounded file.
+        """
+        keep = max(int(keep), 1)
+        entries = self.entries()
+        per: dict = {}
+        for env in entries:
+            key = tuple(env.get(k) for k in LINEAGE_KEYS)
+            per.setdefault(key, []).append(env)
+        kept = {
+            id(env) for rows in per.values() for env in rows[-keep:]
+        }
+        out = [env for env in entries if id(env) in kept]
+        dropped = len(entries) - len(out)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            for env in out:
+                f.write(json.dumps(env, sort_keys=True) + "\n")
+        os.replace(tmp, self.path)
+        # An explicit trim re-arms a stood-down rotation (the caller may
+        # have raised the keep/cap knobs); _maybe_rotate re-stands-down
+        # if the cap is still unsatisfiable.
+        _ROTATION_STUCK.discard(os.path.abspath(self.path))
+        return dropped
 
     # -- query -------------------------------------------------------------
     def entries(self, *, kind: str | None = None,

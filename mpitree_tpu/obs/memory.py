@@ -124,6 +124,52 @@ def pool_hist_bytes(pool_slots: int, n_features: int, n_bins: int) -> int:
     ) * 4
 
 
+# Chunk-scaled array -> the BuildConfig/boosting knob that shrinks it —
+# what the OOM rescue rung (resilience.recovery.OomRescue, ISSUE 14)
+# consults to pick a priced, on-device shrink instead of falling to the
+# host tier. Resident arrays (x_binned, row state, node tables) have no
+# shrink knob: only a wider data axis or the host rung helps there.
+_SHRINK_KNOBS = {
+    # The K-slot split working set halves with the frontier chunk.
+    "split_hist_chunk": "max_frontier_chunk",
+    # The sub-carry slab (kept parent histograms) drops entirely when
+    # the subtraction degrades to direct accumulation.
+    "parent_hist": "hist_subtraction",
+}
+
+# Arrays live only inside the fused multi-round GBDT program: the knob
+# is the dispatch width — rounds_per_dispatch=1 routes the fit back to
+# the host per-round loop (levelwise engine), whose working set is the
+# chunked split sweep instead of the pool + margin carry.
+_FUSED_ROUNDS_KNOBS = {
+    "pool_hist": "rounds_per_dispatch",
+    "pool_nodes": "rounds_per_dispatch",
+    "pool_scalars": "rounds_per_dispatch",
+    "pair_hist": "rounds_per_dispatch",
+    "margin_carry": "rounds_per_dispatch",
+    "grad_hess": "rounds_per_dispatch",
+}
+
+
+def shrink_knob(array_name: str, *, engine=None) -> str | None:
+    """The knob that shrinks ``array_name``, or None (not chunk-scaled).
+
+    ``engine``: the plan's recorded engine — the fused-rounds pool maps
+    to ``rounds_per_dispatch`` only there; a single-tree leaf-wise pool
+    has no shrink knob (its capacity IS the requested leaf budget).
+    """
+    k = _SHRINK_KNOBS.get(array_name)
+    if k is not None:
+        return k
+    if engine == "fused_rounds":
+        return _FUSED_ROUNDS_KNOBS.get(array_name)
+    if array_name == "pool_hist":
+        # A single-tree leaf-wise build's pool-resident histograms are
+        # the subtraction carry — direct pair accumulation drops them.
+        return "hist_subtraction"
+    return None
+
+
 def table_bytes(n_slots: int, n_channels: int) -> int:
     """The per-level update/counts tables: one U-wide bool routing mask,
     four U-wide int32 id/bin columns, and the (U, C) f32 counts slab."""

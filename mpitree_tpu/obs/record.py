@@ -62,7 +62,12 @@ import json
 # multi-round fits may carry ``memory['aggregate']`` (the whole-fit
 # MemoryPlan aggregation that re-arms drift checking, a PR-12
 # follow-up).
-SCHEMA_VERSION = 7
+# v8 (ISSUE 14, resilience v2): digest gains ``level_retries`` /
+# ``oom_rescues`` — the sub-build retry and OOM-rescue rung counters
+# (typed events ``level_retry``/``oom_rescue``), so the watcher's
+# per-section digest line attributes fine-grained recovery without
+# parsing the event list. No record field changed shape.
+SCHEMA_VERSION = 8
 
 # Which mesh axis each collective site reduces/gathers over — the wire
 # ledger's per-axis attribution. Every histogram/counts/y-range reduction
@@ -359,6 +364,13 @@ def digest(report: dict) -> dict:
         # bisects the per-level rows to the first divergent
         # (tree, level, channel). None when no engine committed rows.
         "fingerprint": (report.get("fingerprints") or {}).get("fit"),
+        # Fine-grained recovery counters (v8, resilience v2): sub-build
+        # re-dispatches (level/expansion/dispatch granularity) and
+        # on-device OOM rescues. None when the fit needed neither — a
+        # nonzero value on a bench line says the capture SURVIVED
+        # something, which the noise model should know about.
+        "level_retries": counters.get("level_retries"),
+        "oom_rescues": counters.get("oom_rescues"),
         "wall_s": round(wall, 3),
     }
 

@@ -17,9 +17,17 @@ plus the deterministic fault-injection layer (``chaos``) that proves
 every rung in CI without hardware. ``mpitree_tpu.utils.elastic`` (the
 pre-PR-6 home) re-exports this API for backward compatibility.
 
+Resilience v2 (ISSUE 14) refines rung 1's granularity: engines with a
+host boundary snapshot their loop carry (``recovery.SnapshotSlot``) so a
+transient blip re-dispatches from the last completed level/expansion/
+dispatch instead of restarting the build, and a RESOURCE_EXHAUSTED
+whose memory-ledger postmortem names a chunk-scaled array is rescued
+ON DEVICE by a bounded, priced shrink ladder (``recovery.OomRescue``)
+before the host rung.
+
 Env surface: ``MPITREE_TPU_RETRIES``, ``MPITREE_TPU_BACKOFF_S``,
-``MPITREE_TPU_ELASTIC``, ``MPITREE_TPU_CHAOS`` — see ``config`` and
-``chaos``.
+``MPITREE_TPU_ELASTIC``, ``MPITREE_TPU_LEVEL_RETRY``,
+``MPITREE_TPU_CHAOS`` — see ``config``, ``recovery`` and ``chaos``.
 """
 
 from mpitree_tpu.resilience import chaos
@@ -38,13 +46,20 @@ from mpitree_tpu.resilience.failure import (
     is_oom_failure,
     is_transient_failure,
 )
+from mpitree_tpu.resilience.recovery import (
+    OomRescue,
+    SnapshotSlot,
+    resolve_level_retry,
+)
 from mpitree_tpu.resilience.retry import device_failover, retry_device
 
 __all__ = [
     "BoostCheckpoint",
     "BuildCheckpoint",
     "ForestCheckpoint",
+    "OomRescue",
     "ResilienceConfig",
+    "SnapshotSlot",
     "backoff_delay",
     "chaos",
     "device_failover",
@@ -52,5 +67,6 @@ __all__ = [
     "is_device_failure",
     "is_oom_failure",
     "is_transient_failure",
+    "resolve_level_retry",
     "retry_device",
 ]

@@ -89,13 +89,30 @@ _KINDS = tuple(_STATUS) + ("nan", "hang", "kill", "skew")
 class Fault:
     """One planned fault: fire at the ``at``-th (1-based) step of ``site``.
 
-    ``arg``: seconds for ``kind='hang'``; ignored otherwise.
+    ``arg``: seconds for ``kind='hang'``; skew factor for ``kind='skew'``;
+    ignored otherwise.
+
+    Resilience-v2 arms (ISSUE 14 — the ``level_kill_at``/``oom_until``
+    seams, expressible from the env grammar too):
+
+    - ``at_level``: match only steps whose site reported this level/
+      expansion index (``chaos.step("level", level=depth)``); ``at`` then
+      counts *matching* steps — so ``Fault("level", 1, "unavailable",
+      at_level=4)`` fires the FIRST time level 4 runs and stays quiet
+      when the sub-build retry re-dispatches it. Sites that report no
+      level never match an ``at_level`` fault.
+    - ``clears_after``: the fault fires on ``clears_after`` consecutive
+      matching steps starting at ``at``, then clears — an OOM that stops
+      reproducing once the engine has shrunk its plan ``n`` times
+      (``oom_until=n``). ``None`` keeps the fire-exactly-once semantics.
     """
 
     site: str
     at: int
     kind: str
     arg: float | None = None
+    at_level: int | None = None
+    clears_after: int | None = None
 
     def __post_init__(self):
         if self.kind not in _KINDS:
@@ -104,6 +121,10 @@ class Fault:
             )
         if self.at < 1:
             raise ValueError(f"fault 'at' is 1-based, got {self.at}")
+        if self.clears_after is not None and self.clears_after < 1:
+            raise ValueError(
+                f"fault 'clears_after' must be >= 1, got {self.clears_after}"
+            )
 
 
 class ChaosPlan:
@@ -120,16 +141,35 @@ class ChaosPlan:
             f if isinstance(f, Fault) else Fault(*f) for f in faults
         ]
         self.counts: dict[str, int] = {}
+        # Per-fault matching-step counters: for plain faults every site
+        # step matches (hits == counts[site]); ``at_level`` faults count
+        # only the steps whose reported level matched, so a sub-build
+        # retry re-running earlier levels cannot desynchronize the clock.
+        self.hits: dict[int, int] = {}
         self.fired: list[tuple[str, int, str]] = []
 
-    def step(self, site: str) -> Fault | None:
+    def step(self, site: str, level: int | None = None) -> Fault | None:
         n = self.counts.get(site, 0) + 1
         self.counts[site] = n
-        for f in self.faults:
-            if f.site == site and f.at == n:
-                self.fired.append((site, n, f.kind))
-                return f
-        return None
+        hit = None
+        # Every matching fault's clock advances on every step (no early
+        # return): two faults planned at steps 1 and 2 of one site must
+        # fire on consecutive steps, not drift apart.
+        for i, f in enumerate(self.faults):
+            if f.site != site:
+                continue
+            if f.at_level is not None and level != f.at_level:
+                continue
+            h = self.hits.get(i, 0) + 1
+            self.hits[i] = h
+            if hit is None and (
+                h == f.at if f.clears_after is None
+                else f.at <= h < f.at + f.clears_after
+            ):
+                hit = f
+        if hit is not None:
+            self.fired.append((site, n, hit.kind))
+        return hit
 
 
 _PLAN: ChaosPlan | None = None
@@ -141,20 +181,51 @@ _ENV_PLAN: ChaosPlan | None = None
 
 
 def parse_plan(spec: str) -> ChaosPlan:
-    """Parse ``"site:at:kind[:arg];..."`` into a :class:`ChaosPlan`."""
+    """Parse ``"site:at:kind[:arg][:key=value...];..."`` into a
+    :class:`ChaosPlan`.
+
+    Trailing fields are either ONE positional float ``arg`` or named
+    ``key=value`` pairs (``at_level``, ``clears_after``, ``arg``) — so
+    the v2 seams stay env-expressible:
+    ``level:1:unavailable:at_level=4`` (the ``level_kill_at`` seam) and
+    ``level:1:oom:clears_after=2`` (the ``oom_until`` seam).
+    """
     faults = []
     for part in spec.split(";"):
         part = part.strip()
         if not part:
             continue
         bits = part.split(":")
-        if len(bits) not in (3, 4):
+        if len(bits) < 3:
             raise ValueError(
-                f"malformed chaos fault {part!r}; expected site:at:kind[:arg]"
+                f"malformed chaos fault {part!r}; expected "
+                "site:at:kind[:arg][:key=value...]"
             )
         site, at, kind = bits[0], int(bits[1]), bits[2]
-        arg = float(bits[3]) if len(bits) == 4 else None
-        faults.append(Fault(site, at, kind, arg))
+        arg = None
+        named: dict = {}
+        for bit in bits[3:]:
+            if "=" in bit:
+                key, _, val = bit.partition("=")
+                if key == "arg":
+                    named["arg"] = float(val)
+                elif key in ("at_level", "clears_after"):
+                    named[key] = int(val)
+                else:
+                    raise ValueError(
+                        f"unknown chaos fault option {key!r} in {part!r}; "
+                        "one of arg/at_level/clears_after"
+                    )
+            elif arg is None and not named:
+                arg = float(bit)
+            else:
+                raise ValueError(
+                    f"malformed chaos fault {part!r}: positional arg must "
+                    "come before (and at most once among) key=value options"
+                )
+        if arg is not None:
+            named["arg"] = arg
+        faults.append(Fault(site, at, kind, **named))
     return ChaosPlan(faults)
 
 
@@ -219,16 +290,19 @@ def _fire(f: Fault, site: str, n: int) -> None:
     # a plan mistake, not a crash — ignore it here.
 
 
-def step(site: str) -> None:
+def step(site: str, level: int | None = None) -> None:
     """Advance ``site``'s step counter; fire a matching fault if planned.
 
-    The hook every raise/hang seam calls. No plan installed: one global
-    read, zero allocation — always-on seams cost nothing in production.
+    The hook every raise/hang seam calls. ``level``: the site's current
+    level/expansion index, matched by ``Fault(at_level=...)`` — the
+    level-wise loop reports its depth, the stepped best-first loop its
+    expansion ordinal. No plan installed: one global read, zero
+    allocation — always-on seams cost nothing in production.
     """
     plan = _current()
     if plan is None:
         return
-    f = plan.step(site)
+    f = plan.step(site, level)
     if f is not None:
         _fire(f, site, plan.counts[site])
 

@@ -228,6 +228,74 @@ class BuildCheckpoint:
             except OSError:
                 pass
 
+    @property
+    def shard_count(self) -> int:
+        """Shard files the manifest currently references — what the
+        ``checkpoint_compact_every`` wiring compares against."""
+        return len(self._shards)
+
+    def maybe_compact(self, every, obs=None) -> bool:
+        """The ONE ``checkpoint_compact_every`` trigger both boosting
+        flush paths call: compact once the manifest references ``every``
+        shard files (None = never), counting through ``obs``."""
+        if every is None or self.shard_count < int(every):
+            return False
+        self.compact()
+        if obs is not None:
+            obs.counter("checkpoint_compactions")
+        return True
+
+    def compact(self, min_shards: int = 2) -> bool:
+        """Merge every referenced shard into ONE (long-run hygiene,
+        ISSUE 14); returns whether a compaction happened.
+
+        Very long forest/boosting builds otherwise accumulate one file
+        per flush, and every resume pays one ``np.load`` per shard. The
+        manifest stays the commit point: the merged shard is written
+        first under a FRESH name (never overwriting a referenced file),
+        the manifest flips to it atomically, and only then are the old
+        shards unlinked — a crash at ANY point recovers to either the
+        pre-compaction state (old manifest, merged file an ignored
+        orphan) or the post-compaction state (new manifest, old shards
+        harmless orphans ``done()`` sweeps). No-op below ``min_shards``.
+        """
+        from mpitree_tpu.utils.serialize import _tree_arrays
+
+        if len(self._shards) < max(int(min_shards), 2):
+            return False
+        # Tree-count-salted name: unique across compaction generations
+        # and disjoint from the plain shard-NNNN series, so it can never
+        # collide with a file a (current or previous) manifest references.
+        merged = (
+            f"{os.path.basename(self.path)}"
+            f".shard-merged-{len(self.trees):06d}.npz"
+        )
+        payload: dict = {"header": json.dumps({"n": len(self.trees)})}
+        for i, t in enumerate(self.trees):
+            payload.update(_tree_arrays(f"tree{i}_", t))
+        _atomic_npz(self._sibling(merged), payload)
+
+        old = self._shards
+        self._shards = [{"file": merged, "n": len(self.trees)}]
+        manifest = {
+            "format": _FORMAT,
+            "version": _CKPT_VERSION,
+            "kind": self.kind,
+            "fingerprint": self.fingerprint,
+            "n_items": len(self.trees),
+            "shards": self._shards,
+            "state_file": self._state_file,
+        }
+        _atomic_bytes(self.path, json.dumps(manifest).encode())
+        for sh in old:
+            if sh["file"] == merged:
+                continue
+            try:
+                os.unlink(self._sibling(sh["file"]))
+            except OSError:
+                pass  # a crash-window orphan; done() sweeps
+        return True
+
     def done(self) -> None:
         """Remove manifest, shards, and state once the full fit succeeded
         (orphans from crashed appends included). ``glob.escape``: a

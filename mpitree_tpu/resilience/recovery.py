@@ -1,0 +1,257 @@
+"""Sub-build recovery state: level snapshots and the OOM rescue ladder.
+
+Resilience v2 (ISSUE 14) refines the PR-6 ladder's granularity. PR 6
+retried the *dispatch* — which for the levelwise engine is the whole
+build, so a transient blip at level 17 of a depth-20 fit re-dispatched
+twenty levels to recover one. The two objects here are the shared state
+between an engine and the retry ladder that make recovery *targeted*:
+
+- :class:`SnapshotSlot` — a mutable handle the engine fills with a
+  :class:`LevelSnapshot` of its loop carry at each host boundary (the
+  levelwise per-level boundary, the stepped best-first per-expansion
+  boundary, the fused-GBDT dispatch boundary). On a transient failure,
+  ``retry.py``'s sub-build rung re-invokes the build closure, the engine
+  finds the snapshot and fast-forwards *from the last completed level*
+  instead of restarting. Snapshots are reference captures (the engines'
+  in-place mutations are deterministic re-writes, and functional device
+  updates leave the captured arrays valid), so saving one costs a dict
+  and a few scalars — nothing is copied except the fingerprint row list.
+- :class:`OomRescue` — the rung between "retry on device" and "fall to
+  host" for RESOURCE_EXHAUSTED: when the obs.memory postmortem names a
+  chunk-scaled array, shrink the knob it scales with (halve
+  ``max_frontier_chunk``; degrade ``hist_subtraction``→direct;
+  ``rounds_per_dispatch``→1 — whichever the ledger prices as binding)
+  and re-dispatch ON DEVICE, bounded at :data:`MAX_SHRINKS` shrinks.
+  Every rung is a typed ``oom_rescue`` event naming the knob and the
+  old/new bytes; the re-dispatch re-runs the engine's own
+  ``ledger_and_preflight`` so the shrunk plan is re-priced (and
+  re-refused if still over budget) before any device work commits.
+
+``BuildConfig(level_retry="auto"|"on"|"off")`` /
+``MPITREE_TPU_LEVEL_RETRY`` gate the snapshot capture
+(:func:`resolve_level_retry`); the OOM rescue rides the existing
+``MPITREE_TPU_ELASTIC`` gate — both are recovery behavior, not new
+arithmetic, so neither changes a single fitted tree (the fingerprint
+pins in ``tests/test_resilience_v2.py`` hold recovered == uninterrupted
+bit-identical).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+# OOM rescue ladder bound: three shrinks ~ one chunk halved 8x or every
+# knob class tried once — past that the plan is not the problem and the
+# host rung (which needs no HBM at all) is the honest answer.
+MAX_SHRINKS = 3
+
+LEVEL_RETRY_ENV = "MPITREE_TPU_LEVEL_RETRY"
+
+
+def resolve_level_retry(flag: str) -> bool:
+    """Shared ``level_retry`` resolution (the engine-resolution idiom:
+    ``MPITREE_TPU_LEVEL_RETRY`` steers the default "auto" only; an
+    explicit ``BuildConfig(level_retry=...)`` wins).
+
+    "auto" resolves ON: snapshot capture is reference-grabbing at a host
+    boundary the loop already crosses, and the only added device work is
+    one ``block_until_ready`` on the row-assignment array per level (so
+    an async update failure is attributed to the level that issued it,
+    not discovered one level late). Engines with no host boundary (the
+    fused single-program builds) simply never save a snapshot.
+    """
+    v = flag
+    if v == "auto":
+        v = os.environ.get(LEVEL_RETRY_ENV, "auto")
+    if v not in ("auto", "on", "off"):
+        raise ValueError(f"unknown level_retry {v!r}")
+    return v != "off"
+
+
+@dataclasses.dataclass
+class LevelSnapshot:
+    """One resumable engine boundary.
+
+    ``kind`` names the granularity ("level" | "expansion" | "dispatch"),
+    ``position`` the last completed index (= the next one to run), and
+    ``state`` the engine-owned resume payload — opaque to the ladder,
+    which only reads kind/position for the typed event.
+    """
+
+    kind: str
+    position: int
+    state: dict
+
+
+class SnapshotSlot:
+    """The mutable handle shared between a build closure and the ladder.
+
+    The engine ``save()``s at every boundary and ``clear()``s on
+    success; the retry ladder's sub-build rung checks ``snapshot`` and
+    accounts retries through :meth:`note_retry`. The retry budget is
+    *per position*: progress (a snapshot at a later position than the
+    last retry's) resets the count, so a long fit survives independent
+    blips at many levels, while a dead device exhausts the budget at one
+    position and falls to the next rung — with the slot cleared, so the
+    full-build rungs restart clean instead of resuming into the same
+    failure.
+    """
+
+    def __init__(self):
+        self.snapshot: LevelSnapshot | None = None
+        self.retries = 0          # consecutive retries at one position
+        self.total_retries = 0    # whole-fit (the fit_report_ counter)
+        self._retry_key: tuple | None = None
+
+    def save(self, kind: str, position: int, state: dict) -> None:
+        self.snapshot = LevelSnapshot(kind, int(position), state)
+
+    def take(self, kind: str) -> dict | None:
+        """The resume payload when a snapshot of ``kind`` is pending
+        (None otherwise) — what an engine checks on (re-)entry."""
+        s = self.snapshot
+        return s.state if s is not None and s.kind == kind else None
+
+    def clear(self) -> None:
+        self.snapshot = None
+        # A cleared slot means a completed build or a ladder that gave
+        # up and restarted clean — either way the next build (e.g. the
+        # next boosting round sharing this per-fit slot) deserves a
+        # fresh per-position budget.
+        self._retry_key = None
+        self.retries = 0
+
+    def note_retry(self, budget: int) -> bool:
+        """Account one sub-build retry attempt; False = budget for this
+        position is spent (and the slot is cleared — see class doc)."""
+        s = self.snapshot
+        key = None if s is None else (s.kind, s.position)
+        if key != self._retry_key:
+            self._retry_key = key
+            self.retries = 0
+        if self.retries >= budget:
+            self.clear()
+            return False
+        self.retries += 1
+        self.total_retries += 1
+        return True
+
+
+class OomRescue:
+    """The bounded shrink ladder between "retry on device" and "host".
+
+    Built per fit by the estimator and consulted by ``retry.py`` when
+    ``is_oom_failure`` fires: :meth:`attempt` reads the memory ledger the
+    failed build recorded (``obs.record.memory``), maps the binding
+    chunk-scaled array to its knob (``obs.memory.shrink_knob``), applies
+    the shrink to :attr:`overrides`, and emits the typed ``oom_rescue``
+    event. The build closure applies :meth:`apply` to its BuildConfig on
+    every (re-)dispatch, so the engine's own ``ledger_and_preflight``
+    re-prices — and re-preflights — the shrunk plan before committing.
+
+    ``snapshot_slot``: cleared on every rescue — a level snapshot holds
+    device buffers shaped by the *old* plan (and is itself part of what
+    exhausted the allocator), so a rescued build restarts from scratch
+    under the shrunk config.
+    """
+
+    def __init__(self, obs=None, snapshot_slot: SnapshotSlot | None = None,
+                 max_shrinks: int = MAX_SHRINKS):
+        self.obs = obs
+        self.slot = snapshot_slot
+        self.max_shrinks = int(max_shrinks)
+        self.shrinks = 0
+        self.overrides: dict = {}
+
+    # -- build-closure side -------------------------------------------------
+    def apply(self, cfg):
+        """``cfg`` with the accumulated shrinks applied (BuildConfig
+        fields only — ``rounds_per_dispatch`` is read separately by the
+        fused boosting loop, which owns that knob)."""
+        kw = {
+            k: v for k, v in self.overrides.items()
+            if k in ("max_frontier_chunk", "hist_subtraction")
+        }
+        return dataclasses.replace(cfg, **kw) if kw else cfg
+
+    @property
+    def rounds_per_dispatch(self) -> int | None:
+        return self.overrides.get("rounds_per_dispatch")
+
+    # -- ladder side --------------------------------------------------------
+    def attempt(self, exc: BaseException, *, what: str) -> bool:
+        """Propose and record one shrink; True = re-dispatch on device.
+
+        False when the ladder is spent, the ledger recorded no plan, or
+        no chunk-scaled array is binding (a resident-array OOM — only a
+        wider mesh or the host rung helps there).
+        """
+        from mpitree_tpu.obs import memory as memory_lib
+
+        if self.shrinks >= self.max_shrinks:
+            return False
+        rec = getattr(self.obs, "record", None)
+        mem = getattr(rec, "memory", None) or {}
+        arrays = mem.get("arrays") or []
+        if not arrays:
+            return False
+        # The postmortem's view: the top per-device arrays, largest
+        # first; rescue only when one of them is shrinkable (the ISSUE-12
+        # postmortem "names a chunk-scaled array").
+        top = sorted(
+            arrays, key=lambda a: -int(a.get("bytes_per_device", 0))
+        )[:5]
+        engine = (mem.get("inputs") or {}).get("engine")
+        pick = None
+        for a in top:
+            knob = memory_lib.shrink_knob(str(a.get("name")), engine=engine)
+            if knob is None:
+                continue
+            old_bytes = int(a.get("bytes_per_device", 0))
+            if knob == "max_frontier_chunk":
+                cur = self.overrides.get(
+                    "max_frontier_chunk",
+                    (mem.get("inputs") or {}).get("chunk_slots"),
+                )
+                cur = int(cur) if cur else 0
+                if cur <= 1:
+                    continue  # nothing left to halve — try the next array
+                pick = (knob, a, old_bytes, max(cur // 2, 1),
+                        old_bytes // 2)
+            elif knob == "hist_subtraction":
+                if self.overrides.get("hist_subtraction") == "off":
+                    continue  # carry already dropped
+                pick = (knob, a, old_bytes, "off", 0)
+            else:  # rounds_per_dispatch -> 1
+                if self.overrides.get("rounds_per_dispatch") == 1:
+                    continue
+                pick = (knob, a, old_bytes, 1, None)
+            break
+        if pick is None:
+            return False
+        knob, arr, old_bytes, new_value, new_bytes = pick
+        self.overrides[knob] = new_value
+        self.shrinks += 1
+        if self.slot is not None:
+            self.slot.clear()
+        if self.obs is not None:
+            self.obs.counter("oom_rescues")
+            self.obs.event(
+                "oom_rescue",
+                f"device OOM during {what} ({type(exc).__name__}: "
+                f"{str(exc)[:160]}); the memory ledger prices "
+                f"{arr.get('name')!r} as the binding chunk-scaled array — "
+                f"shrinking {knob} to {new_value!r} and re-dispatching "
+                f"on-device (rung {self.shrinks}/{self.max_shrinks}; "
+                "preflight re-prices the shrunk plan before the next "
+                "dispatch commits)",
+                knob=knob,
+                new_value=new_value,
+                binding_array=arr.get("name"),
+                old_bytes=old_bytes,
+                new_bytes=new_bytes,
+                shrink=self.shrinks,
+                hbm_peak_bytes=mem.get("hbm_peak_bytes"),
+            )
+        return True

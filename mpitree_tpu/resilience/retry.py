@@ -1,9 +1,20 @@
 """The retry/backoff/failover ladder around device-engine dispatches.
 
 The ladder, rung by rung (each rung emits a typed obs event, so
-``fit_report_`` carries the whole recovery story):
+``fit_report_`` carries the whole recovery story). Resilience v2
+(ISSUE 14) inserted rungs 1 and 3:
 
-1. **Retry in place** (:func:`retry_device`, folded into
+1. **Sub-build retry** (``resume=``, a
+   :class:`~mpitree_tpu.resilience.recovery.SnapshotSlot`): a transient
+   loss while a level/expansion/dispatch snapshot is pending re-invokes
+   the build closure, and the engine fast-forwards *from the last
+   completed boundary* instead of restarting the fit — a blip at level
+   17 of a depth-20 build re-dispatches levels >= 17 only. Event:
+   ``level_retry`` (granularity + resume position attached); counter:
+   ``level_retries``. The budget is per position and resets on progress
+   (recovery.SnapshotSlot); when one position keeps failing, the slot
+   clears and the ladder falls through to the full-build rungs below.
+2. **Retry in place** (:func:`retry_device`, folded into
    :func:`device_failover`): a *transient* loss (UNAVAILABLE /
    DEADLINE_EXCEEDED / connection blip — ``failure.is_transient_failure``)
    re-dispatches on the accelerator after exponential backoff with
@@ -11,7 +22,16 @@ The ladder, rung by rung (each rung emits a typed obs event, so
    This is the everyday case on tunneled transports, and before this rung
    existed every blip cliff-dropped the whole fit to the 10-100x slower
    host tier. Event: ``device_retry``; counter: ``device_retries``.
-2. **Host failover** (the final rung of :func:`device_failover`): retry
+3. **OOM rescue** (``rescue=``, a
+   :class:`~mpitree_tpu.resilience.recovery.OomRescue`): a
+   RESOURCE_EXHAUSTED whose memory-ledger postmortem names a
+   chunk-scaled array shrinks the knob it scales with (halved
+   ``max_frontier_chunk`` / subtraction->direct /
+   ``rounds_per_dispatch``->1) and re-dispatches ON DEVICE with the
+   shrunk, re-preflighted plan — bounded at 3 shrinks. Event:
+   ``oom_rescue``; counter: ``oom_rescues``. A non-clearing OOM falls
+   through to the host rung after the ladder, postmortem attached.
+4. **Host failover** (the final rung of :func:`device_failover`): every
    budget exhausted, or a non-transient device failure (INTERNAL compiler
    crash, DATA_LOSS). The host tier consumes the same binned inputs and
    produces the identical tree (the engine-identity contract), so losing
@@ -118,14 +138,74 @@ def _transient_retry(e: BaseException, attempt: int, cfg: ResilienceConfig,
     return True
 
 
+def _subbuild_retry(e: BaseException, resume, cfg: ResilienceConfig,
+                    what: str, obs) -> bool:
+    """The sub-build rung (ISSUE 14): a transient failure with a pending
+    engine snapshot re-invokes the build closure, which fast-forwards
+    from the last completed level/expansion/dispatch.
+
+    True means "re-invoke ``device_fn``" (the engine will find the
+    snapshot; the sleep already happened). False = no snapshot, not
+    transient, or the per-position budget is spent — the slot is then
+    cleared (recovery.SnapshotSlot.note_retry) so the full-build rungs
+    below restart clean instead of resuming into the same failure.
+    """
+    if resume is None or resume.snapshot is None:
+        return False
+    if not (elastic_enabled() and is_transient_failure(e)):
+        return False
+    snap = resume.snapshot
+    if not resume.note_retry(cfg.max_retries):
+        return False
+    delay = backoff_delay(cfg, resume.retries - 1, salt=f"{what}#sub")
+    if obs is not None:
+        obs.counter("level_retries")
+        obs.event(
+            "level_retry",
+            f"transient device failure during {what} "
+            f"({type(e).__name__}: {str(e)[:160]}); re-dispatching from "
+            f"the last completed {snap.kind} ({snap.position}) instead "
+            f"of restarting the build "
+            f"(retry {resume.retries}/{cfg.max_retries} at this position)",
+            granularity=snap.kind, resume_at=int(snap.position),
+            attempt=resume.retries, delay_s=round(delay, 3),
+        )
+    warnings.warn(
+        f"transient device failure during {what} "
+        f"({type(e).__name__}: {str(e)[:160]}); resuming from "
+        f"{snap.kind} {snap.position} in {delay:.2f}s "
+        f"({resume.retries}/{cfg.max_retries})",
+        stacklevel=3,
+    )
+    time.sleep(delay)
+    return True
+
+
+def _oom_rescue(e: BaseException, rescue, what: str, obs) -> bool:
+    """The OOM-rescue rung (ISSUE 14): RESOURCE_EXHAUSTED with a priced,
+    shrinkable plan re-dispatches on-device under the shrunk config
+    (recovery.OomRescue owns the knob choice, the bound, and the typed
+    event). False falls through toward the host rung."""
+    if rescue is None or not (elastic_enabled() and is_oom_failure(e)):
+        return False
+    return rescue.attempt(e, what=what)
+
+
 def retry_device(device_fn, *, what: str, obs=None,
-                 config: ResilienceConfig | None = None):
-    """Run ``device_fn`` with the retry rung only; re-raise when exhausted.
+                 config: ResilienceConfig | None = None,
+                 resume=None, rescue=None):
+    """Run ``device_fn`` with the device-side rungs only (sub-build
+    resume -> transient retry -> OOM rescue); re-raise when exhausted.
 
     For callers with no host twin of the work (the boosting round loop —
     its recovery rung below retries is the round checkpoint, not a host
     rebuild). Transient failures re-dispatch with backoff; everything
     else (including non-transient device failures) raises to the caller.
+
+    ``resume``: a :class:`~mpitree_tpu.resilience.recovery.SnapshotSlot`
+    shared with the build closure; ``rescue`` an
+    :class:`~mpitree_tpu.resilience.recovery.OomRescue` the closure
+    applies to its config on every (re-)dispatch.
     """
     cfg = config if config is not None else ResilienceConfig.from_env()
     attempt = 0
@@ -134,25 +214,33 @@ def retry_device(device_fn, *, what: str, obs=None,
             chaos.step("dispatch")
             return device_fn()
         except Exception as e:  # noqa: BLE001 — classified, not swallowed
-            if not _transient_retry(e, attempt, cfg, what, obs):
-                _oom_postmortem(e, what, obs)
-                raise
-            attempt += 1
+            if _subbuild_retry(e, resume, cfg, what, obs):
+                continue
+            if _transient_retry(e, attempt, cfg, what, obs):
+                attempt += 1
+                continue
+            if _oom_rescue(e, rescue, what, obs):
+                continue
+            _oom_postmortem(e, what, obs)
+            raise
 
 
 def device_failover(device_fn, host_fn, *, what: str, obs=None,
-                    config: ResilienceConfig | None = None):
+                    config: ResilienceConfig | None = None,
+                    resume=None, rescue=None):
     """Run ``device_fn`` through the full ladder; ``host_fn`` is the last
     rung.
 
     The TPU-native answer to the reference's abort-the-job failure mode:
-    transient losses retry on the accelerator (see module docstring);
-    only an exhausted retry budget or a terminal device failure rebuilds
-    on the host tier, which consumes the same binned inputs and produces
-    the identical tree — so losing the accelerator mid-fit costs
-    wall-clock, not the job. User errors re-raise untouched; with
-    elasticity disabled (``MPITREE_TPU_ELASTIC=0``) device failures
-    re-raise too.
+    transient losses retry on the accelerator — from the last completed
+    sub-build boundary when the engine snapshotted one (``resume=``) —
+    and a shrinkable OOM re-dispatches under a shrunk plan (``rescue=``,
+    see module docstring); only exhausted budgets or a terminal device
+    failure rebuild on the host tier, which consumes the same binned
+    inputs and produces the identical tree — so losing the accelerator
+    mid-fit costs wall-clock, not the job. User errors re-raise
+    untouched; with elasticity disabled (``MPITREE_TPU_ELASTIC=0``)
+    device failures re-raise too.
 
     ``obs``: any PhaseTimer/BuildObserver — retry counts and rung events
     land in ``fit_report_`` through it. Callers' ``host_fn`` closures
@@ -168,8 +256,12 @@ def device_failover(device_fn, host_fn, *, what: str, obs=None,
             if not (elastic_enabled() and is_device_failure(e)):
                 _oom_postmortem(e, what, obs)
                 raise
+            if _subbuild_retry(e, resume, cfg, what, obs):
+                continue
             if _transient_retry(e, attempt, cfg, what, obs):
                 attempt += 1
+                continue
+            if _oom_rescue(e, rescue, what, obs):
                 continue
             _oom_postmortem(e, what, obs)
             if obs is not None:

@@ -597,13 +597,17 @@ def test_expand_dispatch_blip_recovers(monkeypatch):
         max_leaf_nodes=6, max_depth=6, backend="cpu", n_devices=8
     ).fit(X, y)
     chaos.install([Fault("expand_dispatch", 3, "unavailable")])
-    with pytest.warns(UserWarning, match="retrying on the device tier"):
+    # Resilience v2 (ISSUE 14): the stepped loop snapshots per
+    # expansion, so the blip resumes at the failed expansion instead of
+    # re-dispatching the whole build (granularity pinned in
+    # tests/test_resilience_v2.py).
+    with pytest.warns(UserWarning, match="resuming from expansion"):
         m = DecisionTreeClassifier(
             max_leaf_nodes=6, max_depth=6, backend="cpu", n_devices=8
         ).fit(X, y)
     chaos.clear()
     assert_trees_identical(healthy.tree_, m.tree_, "expand blip")
-    assert m.fit_report_["counters"]["device_retries"] == 1
+    assert m.fit_report_["counters"]["level_retries"] == 1
 
 
 def test_fused_rounds_blip_recovers():
@@ -611,11 +615,14 @@ def test_fused_rounds_blip_recovers():
     kw = dict(GBF_KW, rounds_per_dispatch=4)
     healthy = GradientBoostingRegressor(**kw).fit(Xr, yr)
     chaos.install([Fault("fused_rounds", 2, "unavailable")])
-    with pytest.warns(UserWarning, match="retrying"):
+    # Resilience v2: the retry is dispatch-granular now — the loop marks
+    # each dispatch boundary as a resume point, so only the failed
+    # K-round window re-runs (typed level_retry, granularity="dispatch").
+    with pytest.warns(UserWarning, match="resuming from dispatch"):
         m = GradientBoostingRegressor(**kw).fit(Xr, yr)
     chaos.clear()
     np.testing.assert_array_equal(healthy.predict(Xr), m.predict(Xr))
-    assert m.fit_report_["counters"]["device_retries"] == 1
+    assert m.fit_report_["counters"]["level_retries"] == 1
 
 
 def test_fused_rounds_nonfinite_grad_fails_fast():

@@ -64,7 +64,9 @@ def test_build_record_schema_golden():
     # v7 (ISSUE 13): top-level fingerprints (per-level u64 build-state
     # fingerprints, obs/fingerprint.py) and the digest's whole-fit
     # fingerprint
-    assert rep["schema"] == SCHEMA_VERSION == 7
+    # v8 (ISSUE 14, resilience v2): digest gains level_retries /
+    # oom_rescues (the sub-build retry + OOM-rescue rung counters)
+    assert rep["schema"] == SCHEMA_VERSION == 8
     # dataclass fields and the pinned tuple must agree too
     assert tuple(
         f.name for f in dataclasses.fields(BuildRecord)
@@ -76,6 +78,7 @@ def test_build_record_schema_golden():
         "psum_bytes", "sub_frac", "expansions", "rounds_per_dispatch",
         "events", "wire_bytes", "wire_shard_bytes", "feature_shards",
         "hbm_peak_bytes", "host_peak_bytes", "fingerprint",
+        "level_retries", "oom_rescues",
         "wall_s",
     )))
 

@@ -97,7 +97,7 @@ def test_flight_envelope_schema_golden(tmp_path, small_cls):
     )))
     assert env["schema"] == obs_flight.FLIGHT_SCHEMA == 1
     assert env["platform"] == "cpu"
-    assert env["record"]["schema"] == 7
+    assert env["record"]["schema"] == 8
     assert env["digest"]["fingerprint"]
 
 
