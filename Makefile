@@ -6,7 +6,8 @@
 PY ?= python
 
 .PHONY: test test-cpu lint lint-graft lint-baseline bench bench-tpu report \
-  trace-smoke mem-smoke flight-smoke chaos-smoke bench-diff clean
+  trace-smoke mem-smoke flight-smoke chaos-smoke ingest-smoke bench-diff \
+  clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -79,6 +80,13 @@ flight-smoke:
 # exit-code-validated. CPU-safe, seconds.
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) examples/resilience_run.py
+
+# Out-of-core ingest gate (ISSUE 15): sketch-merge bit-identity ->
+# chunked bin -> bounded-RSS streamed fit from mmap'd shards ->
+# fingerprint identity vs the in-memory fit across mesh shapes ->
+# planner-derived chunk sizing. Exit-code-validated; CPU-safe, ~a minute.
+ingest-smoke:
+	JAX_PLATFORMS=cpu $(PY) examples/ingest_run.py
 
 # Regression gate over the committed CPU baselines (tools/benchdiff over
 # BENCH_r*.json): newest round vs the previous parseable one, noise

@@ -1174,6 +1174,64 @@ def worker_forest(npz_path: str) -> dict:
     return forest_compare(Xtr, ytr, platform)
 
 
+def worker_ingest(npz_path: str) -> dict:
+    """Out-of-core streaming ingestion at the full workload shape
+    (ISSUE 15): planner-derived chunks, streamed sketch+bin+place, one
+    streamed fit pinned fingerprint-identical to the in-memory fit, and
+    the headline the ROADMAP asks for — rows/s/host plus peak host RSS
+    while the raw matrix never materializes whole in the fit path."""
+    import jax
+
+    from mpitree_tpu import DecisionTreeClassifier
+    from mpitree_tpu.ingest import StreamedDataset
+    from mpitree_tpu.obs import memory as memory_lib
+
+    Xtr, ytr, Xte, yte = _load(npz_path)
+    platform = _device_platform()
+    N, F = Xtr.shape
+    chunk_rows = memory_lib.ingest_chunk_rows(F)
+    ds = StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=chunk_rows)
+
+    rss0 = memory_lib.host_rss_bytes() or 0
+    t0 = time.perf_counter()
+    clf = DecisionTreeClassifier(
+        max_depth=DEPTH, max_bins=256, backend=platform,
+        n_devices="all",
+    ).fit(ds)
+    streamed_s = time.perf_counter() - t0
+    rss1 = memory_lib.host_rss_bytes() or 0
+    stats = clf.ingest_stats_
+
+    # The identity pin: the in-memory fit of the same rows must build
+    # the same tree (refine off — the streamed path has no refine tail).
+    t0 = time.perf_counter()
+    ref = DecisionTreeClassifier(
+        max_depth=DEPTH, max_bins=256, backend=platform,
+        n_devices="all", refine_depth=None,
+    ).fit(Xtr, ytr)
+    inmem_s = time.perf_counter() - t0
+
+    fp_s = (clf.fit_report_.get("fingerprints") or {}).get("fit")
+    fp_m = (ref.fit_report_.get("fingerprints") or {}).get("fit")
+    return {
+        "platform": jax.devices()[0].platform,
+        "rows": int(N), "features": int(F),
+        "chunk_rows": int(chunk_rows),
+        "n_chunks": -(-int(N) // int(chunk_rows)),
+        "streamed_fit_s": round(streamed_s, 3),
+        "inmem_fit_s": round(inmem_s, 3),
+        "sketch_s": stats.get("sketch_s"),
+        "bin_place_s": stats.get("bin_place_s"),
+        "ingest_rows_per_s_host": stats.get("rows_per_s_host"),
+        "host_rss_peak_bytes": int(max(rss0, rss1)),
+        "host_rss_delta_bytes": int(max(rss1 - rss0, 0)),
+        "host_budget_bytes": memory_lib.host_ingest_budget(),
+        "fingerprint_identical": bool(fp_s and fp_s == fp_m),
+        "test_acc": round(float((clf.predict(Xte) == yte).mean()), 4),
+        "record": record_digest(clf.fit_report_),
+    }
+
+
 WORKERS = {
     "north_star": worker_north_star,
     "north_star_fused": worker_north_star_fused,
@@ -1189,6 +1247,7 @@ WORKERS = {
     "gbdt_fusedK": worker_gbdt_fusedK,
     "mesh2d_ab": worker_mesh2d_ab,
     "serving": worker_serving,
+    "ingest": worker_ingest,
 }
 
 
@@ -1424,7 +1483,7 @@ def main() -> int:
     # engine_fused -> boosting -> the rest).
     p.add_argument("--sections", default="hist_tput,north_star,"
                    "engine_fused,boosting,leafwise_ab,gbdt_fusedK,"
-                   "mesh2d_ab,serving,engine_levelwise,forest")
+                   "mesh2d_ab,serving,ingest,engine_levelwise,forest")
     p.add_argument("--timeout", type=int, default=SECTION_TIMEOUT_S)
     p.add_argument("--platform", default="auto",
                    help="jax platform for every section (auto = probe, "

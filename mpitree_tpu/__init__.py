@@ -32,6 +32,7 @@ from mpitree_tpu.boosting import (
     GradientBoostingClassifier,
     GradientBoostingRegressor,
 )
+from mpitree_tpu.ingest import StreamedDataset
 from mpitree_tpu.models.classifier import (
     DecisionTreeClassifier,
     ParallelDecisionTreeClassifier,
@@ -57,6 +58,7 @@ __all__ = [
     "ExtraTreesRegressor",
     "GradientBoostingClassifier",
     "GradientBoostingRegressor",
+    "StreamedDataset",
     "save_model",
     "load_model",
 ]

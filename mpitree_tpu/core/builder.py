@@ -39,7 +39,7 @@ from mpitree_tpu.core.tree_struct import TreeArrays
 from mpitree_tpu.obs import accounting as obs_acct, warn_event
 from mpitree_tpu.obs import fingerprint as fingerprint_lib
 from mpitree_tpu.obs import memory as memory_lib
-from mpitree_tpu.ops.binning import BinnedData
+from mpitree_tpu.ops.binning import BinnedData, StreamedBinnedData
 from mpitree_tpu.parallel import collective, mesh as mesh_lib
 from mpitree_tpu.resilience import chaos, recovery as recovery_lib
 from mpitree_tpu.utils import importances as imp_utils
@@ -522,7 +522,10 @@ def ledger_and_preflight(*, binned, mesh, cfg: BuildConfig, task: str,
     :class:`~mpitree_tpu.obs.memory.MemoryPlanError` on a predicted OOM
     (typed ``oom_predicted`` event attached first).
     """
-    N, F = binned.x_binned.shape
+    # Real extents off the dataclass (a streamed matrix is pre-padded on
+    # device; its host pricing must not claim the full-matrix bytes).
+    N, F = binned.n_samples, binned.n_features
+    streamed = isinstance(binned, StreamedBinnedData)
     total_w = (
         float(N) if sample_weight is None else float(np.sum(sample_weight))
     )
@@ -542,7 +545,10 @@ def ledger_and_preflight(*, binned, mesh, cfg: BuildConfig, task: str,
         max_frontier_chunk=cfg.max_frontier_chunk,
         max_table_slots=cfg.max_table_slots,
         rounds_per_dispatch=rounds_per_dispatch, n_out=n_out,
-        engine=engine,
+        engine=engine, streamed=streamed,
+        streamed_chunk_rows=(
+            getattr(binned, "chunk_rows", 0) or None if streamed else None
+        ),
     )
     d = plan.to_dict()
     timer.memory_plan(d)
@@ -755,7 +761,7 @@ def build_tree(
     platform = mesh.devices.flat[0].platform
     if cfg.task == "classification":
         total_w = (
-            float(binned.x_binned.shape[0]) if sample_weight is None
+            float(binned.n_samples) if sample_weight is None
             else float(np.sum(sample_weight))
         )
         if total_w >= 2**24:
@@ -820,7 +826,11 @@ def build_tree(
             "(data, feature) mesh"
         )
     task = cfg.task
-    N, F = binned.x_binned.shape
+    # Dataclass extents, not array shape: a streamed matrix is pre-padded
+    # on device, and the row-state arithmetic below (weights, leaf-id
+    # fetches) must see the REAL row count (padding shards identically:
+    # ceil(N / dr) == rows_pad / dr).
+    N, F = binned.n_samples, binned.n_features
     B = binned.n_bins
     C = n_classes if task == "classification" else 3
     # 2-D (data, feature) mesh: each device holds only its PADDED

@@ -877,7 +877,9 @@ def build_tree_fused(
     cfg = config
     task = cfg.task
     timer = timer if timer is not None else PhaseTimer(enabled=False)
-    N, F = binned.x_binned.shape
+    # Dataclass extents: a streamed matrix is pre-padded on device and
+    # n_samples/n_features report the real dataset (builder.py twin).
+    N, F = binned.n_samples, binned.n_features
     B = binned.n_bins
     C = n_classes if task == "classification" else 3
 

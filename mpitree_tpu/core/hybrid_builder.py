@@ -153,7 +153,7 @@ def _bin_per_root(Xr: np.ndarray, starts: np.ndarray, ends: np.ndarray):
 def _refine_batched(
     top: TreeArrays, X, y_enc, candidates, rows_per, *, cfg_sub,
     max_depth_total, root_depth, n_classes, sample_weight, refit_targets,
-    feature_mask=None, feature_sampler=None, root_keys=None,
+    feature_mask=None, feature_sampler=None, root_keys=None, obs=None,
 ) -> TreeArrays:
     """Grow every deep subtree together in one multi-root host frontier.
 
@@ -313,6 +313,24 @@ def _refine_batched(
         refit_regression_values(
             bt, nid, w_dense, np.asarray(refit_targets)[rows_all]
         )
+    # Per-subtree fingerprint commits (PR-13 follow-up): slice the
+    # multi-root buffer by root and commit each subtree's rows with ids
+    # remapped to local rank order — byte-identical to what the
+    # per-subtree host path commits for the same subtree, so refine
+    # divergences localize regardless of which tail engine ran.
+    # Single-node roots are skipped to mirror that path's "immediately
+    # stopped: keep the original leaf".
+    if obs is not None and getattr(obs, "wants_fingerprints", False):
+        from mpitree_tpu.obs import fingerprint as fp_mod
+
+        for r in range(R):
+            ids = np.flatnonzero(root_of == r)
+            if len(ids) <= 1:
+                continue
+            obs.fingerprint_tree(fp_mod.subtree_fingerprints(
+                bt.depth, bt.n_node_samples, bt.feature, bt.threshold,
+                bt.left, bt.right, ids=ids,
+            ))
     return _graft_batched(top, bt, candidates, root_depth[root_of])
 
 
@@ -485,6 +503,7 @@ def refine_deep_subtrees(
             n_classes=n_classes, sample_weight=sample_weight,
             refit_targets=refit_targets, feature_mask=feature_mask,
             feature_sampler=feature_sampler, root_keys=root_keys,
+            obs=obs,
         )
 
     subtrees, attach = [], []
@@ -522,4 +541,16 @@ def refine_deep_subtrees(
 
     if not subtrees:
         return tree
+    # Per-subtree fingerprint commits (PR-13 follow-up): each refined
+    # subtree folds into the whole-fit hash as its own tree, so a refine
+    # divergence localizes to (subtree index, level, channel) exactly
+    # like a crown build — the batched tail commits identical rows.
+    if obs is not None and getattr(obs, "wants_fingerprints", False):
+        from mpitree_tpu.obs import fingerprint as fp_mod
+
+        for st in subtrees:
+            obs.fingerprint_tree(fp_mod.subtree_fingerprints(
+                st.depth, st.n_node_samples, st.feature, st.threshold,
+                st.left, st.right,
+            ))
     return _concat_trees(tree, subtrees, attach)

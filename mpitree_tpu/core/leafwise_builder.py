@@ -540,7 +540,9 @@ def build_tree_leafwise(
         )
 
     platform = mesh.devices.flat[0].platform
-    N, F = binned.x_binned.shape
+    # Dataclass extents: a streamed matrix is pre-padded on device and
+    # n_samples/n_features report the real dataset (builder.py twin).
+    N, F = binned.n_samples, binned.n_features
     B = binned.n_bins
     C = n_classes if task == "classification" else 3
     int_ok = integer_weights(sample_weight)
@@ -697,7 +699,7 @@ def _build_leafwise_stepped(binned, y, *, cfg, mesh, n_classes, task, pool,
     expansion instead of restarting the build.
     """
     B = binned.n_bins
-    F = binned.x_binned.shape[1]
+    F = binned.n_features
     expand_kw = dict(
         n_bins=B, n_classes=n_classes, task=task, criterion=cfg.criterion,
         exact_ties=exact_ties, gbdt_x64=gbdt_x64, subtraction=use_sub,

@@ -1,0 +1,54 @@
+"""mpitree_tpu.ingest — out-of-core streaming ingestion (ISSUE 15).
+
+The last single-host bottleneck after the PR-10 2-D mesh was ``fit(X, y)``
+itself: the raw feature matrix had to exist whole in one host's RAM
+before binning. This tier removes it. Input arrives host-chunked (plain
+chunk iterators, in-memory arrays re-chunked for testing, or
+memory-mapped ``.npy``/``.npz`` shards); ONE streaming pass fits a
+mergeable per-feature quantile sketch (``sketch.py`` — bit-identical to
+``ops.binning.bin_dataset``'s edges on shared sizes, documented
+approximate past the sketch capacity); a second pass bins each chunk
+against the packed thresholds and ``device_put``s it DIRECTLY onto its
+mesh slot per ``parallel/partition.py``'s ``x_binned`` rule
+(``place.py``) — the full raw matrix never materializes on any host.
+
+Chunk sizing derives from the ``obs.memory`` planner's host budget
+(``memory.ingest_chunk_rows`` — the priced form of "how many rows fit"),
+never from ad-hoc constants. Multi-host fits ride the existing
+``parallel.distributed.initialize()``: each process streams only its own
+shard of the source and the sketches merge across processes.
+
+Estimator surface: ``DecisionTreeClassifier().fit(StreamedDataset...)``
+(or ``fit(dataset=...)``); construct datasets via
+:meth:`StreamedDataset.from_arrays` / :meth:`~StreamedDataset.from_npy` /
+:meth:`~StreamedDataset.from_npz` / :meth:`~StreamedDataset.from_chunks`.
+"""
+
+from mpitree_tpu.ingest.chunks import (
+    ArrayChunks,
+    IterChunks,
+    NpyShards,
+    NpzShards,
+    shard_for_process,
+)
+from mpitree_tpu.ingest.sketch import FeatureSketch, SketchSet
+from mpitree_tpu.ingest.stream import (
+    IngestResult,
+    StreamedDataset,
+    ingest_dataset,
+    sketch_dataset,
+)
+
+__all__ = [
+    "ArrayChunks",
+    "FeatureSketch",
+    "IngestResult",
+    "IterChunks",
+    "NpyShards",
+    "NpzShards",
+    "SketchSet",
+    "StreamedDataset",
+    "ingest_dataset",
+    "shard_for_process",
+    "sketch_dataset",
+]

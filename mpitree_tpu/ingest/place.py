@@ -1,0 +1,127 @@
+"""Chunk-at-a-time device placement: binned chunks land straight on
+their mesh slot, so no host ever holds the assembled matrix.
+
+Per-slot buffers start as device-resident zeros (``jnp.zeros`` under
+``jax.default_device`` — a host-side zeros + transfer would briefly cost
+a full shard of host RAM, exactly what this tier exists to avoid). Each
+binned chunk is split along the layout's row/column blocks, each piece
+``device_put`` to its slot, and scattered into the buffer with a DONATED
+``dynamic_update_slice`` — per-device residency stays one shard plus one
+in-flight piece. The finished buffers assemble into ONE global
+``jax.Array`` under the partition table's ``x_binned`` sharding
+(``jax.make_array_from_single_device_arrays``), which
+``mesh.shard_build_inputs`` then recognizes as already placed.
+
+Multi-host: every process calls :func:`assemble_binned` with its own
+chunk stream and its global ``row_offset``; each fills only the row
+blocks its addressable devices own (pieces for remote blocks are
+skipped), and the global array spans all processes — the same
+single-controller contract as the build engines. A process's rows must
+cover exactly the row blocks of its local devices (contiguous shard
+deals via ``chunks.shard_for_process`` satisfy this when hosts hold
+equal row counts; the assembler validates coverage and raises
+otherwise, it never silently drops rows).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+from mpitree_tpu.parallel import partition
+
+
+# graftlint: host-fn — ingest orchestration: per-chunk device_put and
+# the donated scatter are its deliberate host-loop job
+def assemble_binned(mesh, binned_chunks, *, n_rows: int, n_features: int,
+                    row_offset: int = 0):
+    """Assemble int32 binned chunks into the global sharded matrix.
+
+    ``binned_chunks`` yields (n_i, F) int32 arrays in row order whose
+    rows total ``n_rows - row_offset`` locally (single-process:
+    ``row_offset=0`` and the stream covers every row). Returns the
+    global (rows_pad, feat_pad) device array, sharded per the rule
+    table.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    layout = partition.ingest_layout(mesh, n_rows, n_features)
+    sr, sc = layout["shard_rows"], layout["shard_cols"]
+    grid = layout["grid"]
+    dr, df = grid.shape
+
+    @partial(jax.jit, donate_argnums=0)
+    def _scatter(buf, piece, r0):
+        return jax.lax.dynamic_update_slice(buf, piece, (r0, 0))
+
+    local = {d.id for d in jax.local_devices()}
+    buffers: dict = {}
+    for di in range(dr):
+        for fi in range(df):
+            dev = grid[di, fi]
+            if dev.id not in local:
+                continue
+            with jax.default_device(dev):
+                buffers[(di, fi)] = jnp.zeros((sr, sc), jnp.int32)
+
+    covered = np.zeros(dr, np.int64)  # rows this process wrote per block
+    cursor = int(row_offset)
+    for xb in binned_chunks:
+        xb = np.ascontiguousarray(xb, np.int32)
+        n = xb.shape[0]
+        if xb.shape[1] != n_features:
+            raise ValueError(
+                f"binned chunk has {xb.shape[1]} features, expected "
+                f"{n_features}"
+            )
+        lo = cursor
+        while lo < cursor + n:
+            di = lo // sr
+            hi = min(cursor + n, (di + 1) * sr)
+            rows = xb[lo - cursor:hi - cursor]
+            if any((di, fi) in buffers for fi in range(df)):
+                for fi in range(df):
+                    if (di, fi) not in buffers:
+                        continue
+                    c0 = fi * sc
+                    w = min(sc, n_features - c0)
+                    piece = rows[:, c0:c0 + w]
+                    if w < sc:  # zero-pad the edge feature block
+                        piece = np.concatenate(
+                            [piece,
+                             np.zeros((len(rows), sc - w), np.int32)],
+                            axis=1,
+                        )
+                    dev = grid[di, fi]
+                    piece_d = jax.device_put(
+                        np.ascontiguousarray(piece), dev
+                    )
+                    buffers[(di, fi)] = _scatter(
+                        buffers[(di, fi)], piece_d,
+                        np.int32(lo - di * sr),
+                    )
+                covered[di] += len(rows)
+            lo = hi
+        cursor += n
+
+    # Coverage check: every LOCAL row block must be exactly full (modulo
+    # the trailing padding rows of the last global block).
+    for di in range(dr):
+        if not any((di, fi) in buffers for fi in range(df)):
+            continue
+        want = min(sr, max(n_rows - di * sr, 0))
+        if int(covered[di]) != want:
+            raise ValueError(
+                f"ingest row block {di} got {int(covered[di])} rows, "
+                f"expected {want}: each process's chunk stream must cover "
+                "exactly its local devices' row blocks (align shard sizes "
+                "or rebalance shard_for_process)"
+            )
+
+    arrays = [buffers[k] for k in sorted(buffers)]
+    return jax.make_array_from_single_device_arrays(
+        (layout["rows_pad"], layout["feat_pad"]),
+        layout["sharding"], arrays,
+    )

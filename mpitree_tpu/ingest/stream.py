@@ -1,0 +1,282 @@
+"""The ingest pipeline: sketch pass → packed edges → bin+place pass.
+
+Two passes over a repeatable chunk source (``chunks.py``):
+
+1. **sketch** — every chunk updates the mergeable per-feature quantile
+   sketches (``sketch.py``) and appends its targets/weights to the
+   host-resident per-row state (the ONE O(N) host cost streaming keeps;
+   pure numpy). Multi-host, sketches then merge across processes so all
+   hosts derive identical edges.
+2. **bin + place** — the merged sketches pack into the same
+   ``(thresholds, n_cand, n_bins)`` table ``bin_dataset`` builds
+   (``ops.binning.pack_edges``); each chunk re-streams, bins against it
+   (``bin_with_thresholds`` — bit-identical ids), and lands directly on
+   its mesh slot (``place.assemble_binned``).
+
+Chunk size resolves through the ``obs.memory`` planner
+(``ingest_chunk_rows`` against the ``MPITREE_TPU_HOST_BYTES`` budget)
+whenever the source lets the pipeline pick; the priced plan
+(``plan_ingest``) rides the observer into ``record.memory``.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from mpitree_tpu.ingest import chunks as chunks_mod
+from mpitree_tpu.ingest import place as place_mod
+from mpitree_tpu.ingest.sketch import SketchSet, resolve_capacity
+from mpitree_tpu.obs import memory as memory_lib
+from mpitree_tpu.ops.binning import StreamedBinnedData, bin_with_thresholds
+
+
+class StreamedDataset:
+    """A host-chunked training set — what ``fit(dataset=...)`` consumes.
+
+    ``chunk_rows=None`` defers to the planner
+    (:func:`obs.memory.ingest_chunk_rows` under the
+    ``MPITREE_TPU_HOST_BYTES`` budget) for sources that support
+    re-chunking; iterator sources own their chunk shapes.
+    """
+
+    def __init__(self, source, *, chunk_rows: int | None = None,
+                 sketch_capacity: int | None = None):
+        if not hasattr(source, "chunks"):
+            raise TypeError(
+                "source must implement .chunks() (see mpitree_tpu.ingest."
+                "chunks); use the from_* constructors for common layouts"
+            )
+        self.source = source
+        self.chunk_rows = chunk_rows
+        self.sketch_capacity = resolve_capacity(sketch_capacity)
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_arrays(cls, X, y, sample_weight=None, *,
+                    chunk_rows: int | None = None, **kw) -> StreamedDataset:
+        """In-memory arrays streamed in ``chunk_rows`` slices (the
+        identity-grid/testing form — real out-of-core inputs come from
+        shards or iterators)."""
+        return cls(
+            chunks_mod.ArrayChunks(X, y, sample_weight),
+            chunk_rows=chunk_rows, **kw,
+        )
+
+    @classmethod
+    def from_npy(cls, x_paths, y_paths, weight_paths=None, *,
+                 chunk_rows: int | None = None, **kw) -> StreamedDataset:
+        """Memory-mapped ``.npy`` shard pairs (globs or path lists)."""
+        return cls(
+            chunks_mod.NpyShards(x_paths, y_paths, weight_paths),
+            chunk_rows=chunk_rows, **kw,
+        )
+
+    @classmethod
+    def from_npz(cls, paths, *, x_key="X", y_key="y", weight_key=None,
+                 **kw) -> StreamedDataset:
+        """``.npz`` shard files, one chunk per file."""
+        return cls(
+            chunks_mod.NpzShards(
+                paths, x_key=x_key, y_key=y_key, weight_key=weight_key
+            ), **kw,
+        )
+
+    @classmethod
+    def from_chunks(cls, chunks_or_factory, **kw) -> StreamedDataset:
+        """A list of ``(X, y[, w])`` tuples, or a zero-arg factory
+        returning a fresh iterator of them per pass (the pipeline
+        streams twice — a bare generator would arrive exhausted)."""
+        return cls(chunks_mod.IterChunks(chunks_or_factory), **kw)
+
+    # -- iteration ---------------------------------------------------------
+    def resolve_chunk_rows(self) -> int | None:
+        """The planner-derived chunk size (None for sources that own
+        their chunking or whose width is unknown before the stream)."""
+        if self.chunk_rows is not None:
+            return int(self.chunk_rows)
+        nf = getattr(self.source, "n_features", None)
+        if nf is None:
+            return None
+        return memory_lib.ingest_chunk_rows(int(nf))
+
+    def chunks(self, *, validate: bool = True):
+        yield from self.source.chunks(
+            self.resolve_chunk_rows(), validate=validate
+        )
+
+
+def sketch_dataset(ds: StreamedDataset) -> tuple:
+    """Pass 1: (SketchSet, y, sample_weight|None) from one stream.
+
+    ``y``/weights accumulate as chunk pieces and concatenate once at the
+    end — per-row host state, not the matrix. Raises on an empty stream
+    (nothing to fit) and on chunks that change width mid-stream.
+    """
+    sketches: SketchSet | None = None
+    y_parts: list = []
+    w_parts: list = []
+    saw_w = None
+    for X, y, w in ds.chunks():
+        if sketches is None:
+            sketches = SketchSet(
+                X.shape[1], capacity=ds.sketch_capacity
+            )
+            saw_w = w is not None
+        if (w is not None) != saw_w:
+            raise ValueError(
+                "chunk stream mixes weighted and unweighted chunks"
+            )
+        sketches.update(X)
+        y_parts.append(np.asarray(y))
+        if w is not None:
+            w_parts.append(w)
+    if sketches is None or sketches.n_rows == 0:
+        raise ValueError("empty chunk stream: nothing to fit")
+    sketches.merge_across_processes()
+    y_all = np.concatenate(y_parts)
+    w_all = np.concatenate(w_parts) if w_parts else None
+    return sketches, y_all, w_all
+
+
+def _allgather_rows(local: np.ndarray, counts: np.ndarray) -> np.ndarray:
+    """Concatenate every process's per-row vector in rank order (the
+    same order the global row offsets assume). Uneven lengths gather
+    through one padded buffer; non-numeric labels cannot ride the
+    collective and are refused with a recipe."""
+    from jax.experimental import multihost_utils
+
+    if not np.issubdtype(np.asarray(local).dtype, np.number):
+        raise TypeError(
+            "multi-host streamed fits need numeric targets/weights (the "
+            f"cross-process gather cannot move dtype {local.dtype!r}); "
+            "encode labels to integers before streaming"
+        )
+    width = int(counts.max(initial=1))
+    buf = np.zeros(width, np.asarray(local).dtype)
+    buf[: len(local)] = local
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    return np.concatenate([
+        gathered[p, : int(c)] for p, c in enumerate(counts)
+    ])
+
+
+class IngestResult:
+    """What one full ingest produces: the device-assembled
+    ``StreamedBinnedData``, host per-row state, and the stats/plan the
+    observer records."""
+
+    def __init__(self, binned, y, sample_weight, stats):
+        self.binned = binned
+        self.y = y
+        self.sample_weight = sample_weight
+        self.stats = stats
+
+
+# graftlint: host-fn — ingest driver: two host streaming passes and the
+# per-chunk device placement are its deliberate job
+def ingest_dataset(ds: StreamedDataset, *, mesh, max_bins: int = 256,
+                   binning: str = "auto", obs=None) -> IngestResult:
+    """Run both passes and assemble the mesh-resident binned matrix.
+
+    Multi-host, each process streams its own shard (build ``ds`` from
+    ``shard_for_process``-dealt paths) and this function computes the
+    process's global row offset from an allgather of local row counts.
+    """
+    import jax
+
+    from mpitree_tpu.parallel import mesh as mesh_lib
+
+    if binning not in ("auto", "exact", "quantile"):
+        raise ValueError(f"unknown binning mode: {binning!r}")
+    t0 = time.perf_counter()
+    sketches, y_local, w_local = sketch_dataset(ds)
+    sketch_s = time.perf_counter() - t0
+
+    n_local = len(y_local)
+    row_offset = 0
+    n_rows = sketches.n_rows  # global after merge_across_processes
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        counts = np.asarray(multihost_utils.process_allgather(
+            np.array([n_local], np.int64)
+        )).reshape(-1)
+        row_offset = int(counts[: jax.process_index()].sum())
+        # Targets/weights must be GLOBAL like the matrix: the build's
+        # per-row host state (and the classifier's label encoding) spans
+        # every process's rows — a process-local y would shape-mismatch
+        # the global placement, and classes_ derived from a local shard
+        # could diverge across hosts when a class is absent from one.
+        y_local = _allgather_rows(y_local, counts)
+        if w_local is not None:
+            w_local = _allgather_rows(w_local, counts)
+
+    thresholds, n_cand, n_bins, quantized = sketches.to_thresholds(
+        max_bins=max_bins, binning=binning
+    )
+    F = sketches.n_features
+    chunk_rows = ds.resolve_chunk_rows() or memory_lib.ingest_chunk_rows(F)
+    plan = memory_lib.plan_ingest(
+        rows=n_rows, features=F, chunk_rows=chunk_rows,
+        sketch_capacity=ds.sketch_capacity,
+        mesh_axes={
+            "data": mesh_lib.data_shards(mesh),
+            "feature": mesh_lib.feature_shards(mesh),
+        },
+        max_bins=max_bins,
+    )
+    if obs is not None:
+        obs.memory_plan(plan)
+
+    t1 = time.perf_counter()
+    # validate=False: the sketch pass already proved every row finite —
+    # a second full finiteness sweep over an out-of-core dataset would
+    # double the host-side scan cost for nothing.
+    xb = place_mod.assemble_binned(
+        mesh,
+        (bin_with_thresholds(X, thresholds, n_cand)
+         for X, _, _ in ds.chunks(validate=False)),
+        n_rows=n_rows, n_features=F, row_offset=row_offset,
+    )
+    place_s = time.perf_counter() - t1
+
+    binned = StreamedBinnedData(
+        x_binned=xb, thresholds=thresholds, n_cand=n_cand,
+        n_bins=n_bins, quantized=quantized, n_rows=n_rows,
+        chunk_rows=int(chunk_rows),
+    )
+    stats = {
+        "rows": int(n_rows),
+        "rows_local": int(n_local),
+        "features": int(F),
+        "chunk_rows": int(chunk_rows),
+        "n_bins": int(n_bins),
+        "quantized": bool(quantized),
+        "sketch_exact": bool(sketches.exact),
+        "sketch_bytes": int(sketches.nbytes()),
+        "sketch_s": round(sketch_s, 4),
+        "bin_place_s": round(place_s, 4),
+        "rows_per_s_host": (
+            round(n_local / (sketch_s + place_s), 1)
+            if sketch_s + place_s > 0 else None
+        ),
+    }
+    if obs is not None:
+        obs.decision(
+            "ingest", "streamed",
+            reason=(
+                "fit(dataset=...): chunked sketch+bin ingest — the raw "
+                "matrix never materializes on host; chunk size derived "
+                f"from the {memory_lib.HOST_BUDGET_ENV} planner budget"
+            ),
+            **{k: stats[k] for k in (
+                "rows", "features", "chunk_rows", "quantized",
+                "sketch_exact",
+            )},
+        )
+        host_rss = memory_lib.host_rss_bytes()
+        if host_rss:
+            stats["host_rss_bytes"] = int(host_rss)
+    return IngestResult(binned, y_local, w_local, stats)

@@ -1,0 +1,197 @@
+"""Streamed-fit driver shared by the tree estimators (ISSUE 15).
+
+``DecisionTreeClassifier``/``DecisionTreeRegressor`` delegate here when
+``fit`` receives a :class:`~mpitree_tpu.ingest.StreamedDataset`: the
+ingest tier sketches + bins + places the matrix chunk-at-a-time
+(``mpitree_tpu.ingest``), then the SAME device engines grow the tree
+from the pre-placed ``StreamedBinnedData`` — fingerprint-identical to an
+in-memory fit of the same rows (pinned in ``tests/test_ingest.py``).
+
+Streamed-path deltas from the in-memory fit, all recorded on the run
+record:
+
+- no host tier and no host failover rung (the numpy builder wants a
+  host-resident matrix; the ladder keeps retry + OOM rescue — the
+  leaf-wise stance);
+- no hybrid refine tail (it re-bins raw rows, which never exist here);
+- device binning is moot (edges come from the sketch pass).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mpitree_tpu.core.builder import BuildConfig, build_tree
+from mpitree_tpu.obs import BuildObserver, note_build_path, note_refine
+from mpitree_tpu.parallel import mesh as mesh_lib
+from mpitree_tpu.resilience import OomRescue, SnapshotSlot, retry_device
+from mpitree_tpu.serving.tables import note_serving
+from mpitree_tpu.utils.validation import (
+    min_child_weight,
+    min_decrease_scaled,
+    record_sklearn_attributes,
+    validate_fit_targets,
+    validate_max_leaf_nodes,
+    validate_sample_weight,
+)
+
+
+def is_streamed(X, dataset) -> bool:
+    """Whether this fit call is a streamed one (``dataset=`` wins; a
+    StreamedDataset passed positionally as X also routes here)."""
+    from mpitree_tpu.ingest import StreamedDataset
+
+    if dataset is not None and not isinstance(dataset, StreamedDataset):
+        raise TypeError(
+            "dataset= must be a mpitree_tpu.ingest.StreamedDataset "
+            f"(got {type(dataset).__name__}); in-memory fits pass X, y"
+        )
+    return isinstance(dataset, StreamedDataset) or isinstance(
+        X, StreamedDataset
+    )
+
+
+# graftlint: host-fn — estimator orchestration: ingest, validation and
+# the retry ladder are deliberate host work
+def streamed_fit(est, X, dataset, y=None, sample_weight=None,
+                 trace_to=None):
+    """Fit ``est`` from a StreamedDataset; returns ``est``."""
+    from mpitree_tpu.ingest import StreamedDataset, ingest_dataset
+
+    ds = dataset if isinstance(dataset, StreamedDataset) else X
+    if dataset is not None and X is not None:
+        raise ValueError("pass the StreamedDataset as X or dataset=, not both")
+    if y is not None:
+        # Silently training on the dataset's embedded targets while the
+        # caller handed different ones would be a wrong model, not an
+        # inconvenience.
+        raise ValueError(
+            "a StreamedDataset carries its own targets; fit(dataset) "
+            "takes no separate y — rebuild the dataset with the labels "
+            "you want"
+        )
+    task = est._task
+    if task == "regression" and est.criterion not in (
+        "squared_error", "mse"
+    ):
+        raise ValueError(
+            f"unknown regression criterion: {est.criterion!r}"
+        )
+    timer = obs = BuildObserver()
+    if trace_to is not None:
+        obs.trace_to(trace_to)
+
+    mln = validate_max_leaf_nodes(est)
+    # Placement needs the mesh BEFORE binning (chunks land on their
+    # slots), so resolve it first — the streamed path is device-only.
+    mesh = mesh_lib.resolve_mesh(
+        backend=est.backend, n_devices=est.n_devices
+    )
+    with timer.phase("bin"):
+        res = ingest_dataset(
+            ds, mesh=mesh, max_bins=est.max_bins, binning=est.binning,
+            obs=obs,
+        )
+    binned = res.binned
+    N, F = binned.n_samples, binned.n_features
+    note_build_path(
+        obs, host=False, backend=est.backend, n_rows=N, n_features=F,
+    )
+    est.ingest_stats_ = res.stats
+
+    y_enc, classes = validate_fit_targets(res.y, task=task)
+    est.n_features_ = F
+    est.n_features_in_ = F
+    record_sklearn_attributes(
+        est, None, F,
+        n_classes=None if classes is None else len(classes),
+    )
+    if classes is not None:
+        est.classes_ = classes
+
+    if sample_weight is not None and res.sample_weight is not None:
+        raise ValueError(
+            "sample weights arrived both per-chunk and as a fit argument; "
+            "pick one"
+        )
+    sw = validate_sample_weight(
+        res.sample_weight if sample_weight is None else sample_weight, N
+    )
+    if task == "classification" and getattr(est, "class_weight", None):
+        from mpitree_tpu.utils.validation import apply_class_weight
+
+        sw = apply_class_weight(est.class_weight, y_enc, classes, sw)
+
+    from mpitree_tpu.utils.monotonic import validate_monotonic_cst
+
+    mono = validate_monotonic_cst(
+        est.monotonic_cst, F, task=task,
+        **({"n_classes": len(classes)} if task == "classification" else {}),
+    )
+    # The hybrid tail re-bins raw rows host-side; a streamed fit has no
+    # raw matrix to re-bin — single-engine full depth, recorded.
+    note_refine(
+        obs, refine=False, rd=None, crown_depth=est.max_depth,
+        refine_depth_param=est.refine_depth, streamed=True,
+    )
+    cfg = BuildConfig(
+        task=task,
+        criterion=est.criterion if task == "classification" else "mse",
+        max_depth=est.max_depth,
+        max_leaf_nodes=mln,
+        min_samples_split=est.min_samples_split,
+        min_child_weight=min_child_weight(
+            est.min_weight_fraction_leaf, sw, N, est.min_samples_leaf,
+        ),
+        min_decrease_scaled=min_decrease_scaled(
+            est.min_impurity_decrease, sw, N
+        ),
+    )
+    if task == "classification":
+        y_build, refit = y_enc, None
+        n_classes = len(classes)
+    else:
+        est._y_mean = float(y_enc.mean()) if len(y_enc) else 0.0
+        y_build = (y_enc - est._y_mean).astype(np.float32)
+        refit = y_enc
+        n_classes = None
+
+    from mpitree_tpu.ops.sampling import sampler_for
+
+    sampler = sampler_for(
+        est.max_features, est.random_state, F,
+        splitter=getattr(est, "splitter", "best"),
+    )
+
+    slot = SnapshotSlot()
+    rescue = OomRescue(obs=obs, snapshot_slot=slot)
+
+    def _dev():
+        return build_tree(
+            binned, y_build, config=rescue.apply(cfg), mesh=mesh,
+            n_classes=n_classes, sample_weight=sw, refit_targets=refit,
+            timer=timer, feature_sampler=sampler, mono_cst=mono,
+            snapshot_slot=slot,
+        )
+
+    # No host rung: the numpy tier wants a host-resident matrix, which a
+    # streamed fit never builds — retry + OOM rescue only (the leaf-wise
+    # ladder stance; re-streaming into a host matrix would defeat the
+    # out-of-core contract).
+    est.tree_ = retry_device(
+        _dev, what=f"{type(est).__name__}.fit streamed build",
+        obs=obs, resume=slot, rescue=rescue,
+    )
+    if est.ccp_alpha:
+        from mpitree_tpu.utils.pruning import ccp_prune
+
+        with timer.phase("prune"):
+            est.tree_ = ccp_prune(est.tree_, est.ccp_alpha, task=task)
+    if mono is not None:
+        from mpitree_tpu.utils.monotonic import clip_tree_values
+
+        clip_tree_values(est.tree_, mono, task)
+    est.fit_stats_ = timer.summary() if timer.enabled else None
+    note_serving(obs, [est.tree_])
+    est.fit_report_ = obs.report(tree=est.tree_)
+    return est

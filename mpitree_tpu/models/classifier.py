@@ -188,7 +188,18 @@ class DecisionTreeClassifier(ClassifierMixin, ReportMixin, BaseEstimator):
         self.monotonic_cst = monotonic_cst
 
     # -- fitting -----------------------------------------------------------
-    def fit(self, X, y, sample_weight=None, *, trace_to=None):
+    def fit(self, X=None, y=None, sample_weight=None, *, trace_to=None,
+            dataset=None):
+        # Out-of-core streamed fits (ISSUE 15): a StreamedDataset — passed
+        # as X or via dataset= — routes through the chunked ingest tier;
+        # the raw matrix never materializes on this host.
+        from mpitree_tpu.models._streamed import is_streamed, streamed_fit
+
+        if is_streamed(X, dataset):
+            return streamed_fit(
+                self, X, dataset, y=y, sample_weight=sample_weight,
+                trace_to=trace_to,
+            )
         names = feature_names_of(X)
         X, y_enc, classes = validate_fit_data(X, y, task="classification")
         self.n_features_ = X.shape[1]

@@ -85,6 +85,7 @@ class _BaseForest(ReportMixin, BaseEstimator):
                  min_samples_leaf=1,
                  random_state=None, n_devices=None,
                  backend=None, refine_depth="auto", checkpoint=None,
+                 checkpoint_compact_every=None,
                  ccp_alpha=0.0, min_impurity_decrease=0.0,
                  splitter="best", monotonic_cst=None, warm_start=False):
         self.n_estimators = n_estimators
@@ -107,6 +108,11 @@ class _BaseForest(ReportMixin, BaseEstimator):
         # manifest) — the recovery story SURVEY §5 lists as absent from
         # the reference.
         self.checkpoint = checkpoint
+        # Compact the checkpoint's shard files once the manifest references
+        # this many (resilience.checkpoint.maybe_compact — the gbdt knob,
+        # wired for forests too; None = never, forests can still call
+        # compact() manually).
+        self.checkpoint_compact_every = checkpoint_compact_every
         self.ccp_alpha = ccp_alpha
         self.min_impurity_decrease = min_impurity_decrease
         self.splitter = splitter
@@ -190,6 +196,14 @@ class _BaseForest(ReportMixin, BaseEstimator):
         n = X.shape[0]
         if self.oob_score and not self.bootstrap:
             raise ValueError("oob_score=True requires bootstrap=True")
+        cce = getattr(self, "checkpoint_compact_every", None)
+        if cce is not None and int(cce) < 2:
+            # The same grammar as the boosting estimators': fewer than
+            # two shards can never compact.
+            raise ValueError(
+                "checkpoint_compact_every must be >= 2 shards or None, "
+                f"got {cce!r}"
+            )
         # The ensemble's structured run record (mpitree_tpu.obs): one
         # observer accumulates phases/counters/collectives across every
         # member build; fit() finalizes it into fit_report_ (post-OOB).
@@ -543,6 +557,9 @@ class _BaseForest(ReportMixin, BaseEstimator):
                 trees.extend(new)
                 if ck is not None:
                     ck.append(new)
+                    ck.maybe_compact(
+                        getattr(self, "checkpoint_compact_every", None), obs
+                    )
         else:
             # Flush the checkpoint per batch of trees, not per tree:
             # appends are O(group) shard writes (resilience.checkpoint),
@@ -561,6 +578,9 @@ class _BaseForest(ReportMixin, BaseEstimator):
                 trees.extend(new)
                 if ck is not None:
                     ck.append(new)
+                    ck.maybe_compact(
+                        getattr(self, "checkpoint_compact_every", None), obs
+                    )
         if ck is not None:
             ck.done()
         return trees
@@ -630,7 +650,8 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
                  min_weight_fraction_leaf=0.0, min_samples_leaf=1,
                  random_state=None,
                  n_devices=None, backend=None, refine_depth="auto",
-                 checkpoint=None, ccp_alpha=0.0,
+                 checkpoint=None, checkpoint_compact_every=None,
+                 ccp_alpha=0.0,
                  min_impurity_decrease=0.0, splitter="best",
                  monotonic_cst=None, warm_start=False):
         super().__init__(
@@ -642,6 +663,7 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
             min_samples_leaf=min_samples_leaf,
             random_state=random_state, n_devices=n_devices, backend=backend,
             refine_depth=refine_depth, checkpoint=checkpoint,
+            checkpoint_compact_every=checkpoint_compact_every,
             ccp_alpha=ccp_alpha, min_impurity_decrease=min_impurity_decrease,
             splitter=splitter, monotonic_cst=monotonic_cst,
             warm_start=warm_start,
@@ -759,7 +781,8 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
                  oob_score=False, min_weight_fraction_leaf=0.0,
                  min_samples_leaf=1, random_state=None,
                  n_devices=None, backend=None, refine_depth="auto",
-                 checkpoint=None, ccp_alpha=0.0,
+                 checkpoint=None, checkpoint_compact_every=None,
+                 ccp_alpha=0.0,
                  min_impurity_decrease=0.0, splitter="best",
                  monotonic_cst=None, warm_start=False):
         super().__init__(
@@ -771,6 +794,7 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
             min_samples_leaf=min_samples_leaf,
             random_state=random_state, n_devices=n_devices, backend=backend,
             refine_depth=refine_depth, checkpoint=checkpoint,
+            checkpoint_compact_every=checkpoint_compact_every,
             ccp_alpha=ccp_alpha, min_impurity_decrease=min_impurity_decrease,
             splitter=splitter, monotonic_cst=monotonic_cst,
             warm_start=warm_start,
@@ -839,7 +863,8 @@ class ExtraTreesClassifier(RandomForestClassifier):
                  max_features_mode="node", oob_score=False, class_weight=None,
                  min_weight_fraction_leaf=0.0, min_samples_leaf=1,
                  random_state=None, n_devices=None, backend=None,
-                 refine_depth="auto", checkpoint=None, ccp_alpha=0.0,
+                 refine_depth="auto", checkpoint=None,
+                 checkpoint_compact_every=None, ccp_alpha=0.0,
                  min_impurity_decrease=0.0, monotonic_cst=None,
                  warm_start=False):
         super().__init__(
@@ -851,7 +876,9 @@ class ExtraTreesClassifier(RandomForestClassifier):
             min_weight_fraction_leaf=min_weight_fraction_leaf,
             min_samples_leaf=min_samples_leaf, random_state=random_state,
             n_devices=n_devices, backend=backend, refine_depth=refine_depth,
-            checkpoint=checkpoint, ccp_alpha=ccp_alpha,
+            checkpoint=checkpoint,
+            checkpoint_compact_every=checkpoint_compact_every,
+            ccp_alpha=ccp_alpha,
             min_impurity_decrease=min_impurity_decrease,
             splitter="random", monotonic_cst=monotonic_cst,
             warm_start=warm_start,
@@ -867,6 +894,7 @@ class ExtraTreesRegressor(RandomForestRegressor):
                  oob_score=False, min_weight_fraction_leaf=0.0,
                  min_samples_leaf=1, random_state=None, n_devices=None,
                  backend=None, refine_depth="auto", checkpoint=None,
+                 checkpoint_compact_every=None,
                  ccp_alpha=0.0, min_impurity_decrease=0.0,
                  monotonic_cst=None, warm_start=False):
         super().__init__(
@@ -877,7 +905,9 @@ class ExtraTreesRegressor(RandomForestRegressor):
             min_weight_fraction_leaf=min_weight_fraction_leaf,
             min_samples_leaf=min_samples_leaf, random_state=random_state,
             n_devices=n_devices, backend=backend, refine_depth=refine_depth,
-            checkpoint=checkpoint, ccp_alpha=ccp_alpha,
+            checkpoint=checkpoint,
+            checkpoint_compact_every=checkpoint_compact_every,
+            ccp_alpha=ccp_alpha,
             min_impurity_decrease=min_impurity_decrease,
             splitter="random", monotonic_cst=monotonic_cst,
             warm_start=warm_start,

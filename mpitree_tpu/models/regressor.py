@@ -93,9 +93,19 @@ class DecisionTreeRegressor(RegressorMixin, ReportMixin, BaseEstimator):
         self.min_impurity_decrease = min_impurity_decrease
         self.monotonic_cst = monotonic_cst
 
-    def fit(self, X, y, sample_weight=None, *, trace_to=None):
+    def fit(self, X=None, y=None, sample_weight=None, *, trace_to=None,
+            dataset=None):
         if self.criterion not in ("squared_error", "mse"):
             raise ValueError(f"unknown regression criterion: {self.criterion!r}")
+        # Out-of-core streamed fits (ISSUE 15): a StreamedDataset — passed
+        # as X or via dataset= — routes through the chunked ingest tier.
+        from mpitree_tpu.models._streamed import is_streamed, streamed_fit
+
+        if is_streamed(X, dataset):
+            return streamed_fit(
+                self, X, dataset, y=y, sample_weight=sample_weight,
+                trace_to=trace_to,
+            )
         names = feature_names_of(X)
         X, y64, _ = validate_fit_data(X, y, task="regression")
         self.n_features_ = X.shape[1]

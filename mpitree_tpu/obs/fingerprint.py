@@ -82,11 +82,19 @@ def level_fingerprint(level: int, n_samples, feature, threshold,
     :func:`tree_fingerprints` re-slices from a finished tree, so the two
     paths can never hash different bytes.
     """
+    # -0.0 -> +0.0 before hashing: a column holding both zeros may yield
+    # either representative depending on which path selected the edge
+    # (the device kernel's sort, the ingest sketch's chunk merge — both
+    # documented non-contracts), and the ``x <= t`` predicate cannot
+    # tell them apart. Hashing raw bytes would flag predicate-identical
+    # trees as divergent. NaN leaf pads are unaffected.
+    thr = np.ascontiguousarray(np.asarray(threshold), "<f4")
+    thr = thr + np.float32(0.0)
     return {
         "level": int(level),
         "nodes": int(len(np.asarray(feature))),
         "hist": _h64(_canon(n_samples, "<i8")),
-        "winner": _h64(_canon(feature, "<i4"), _canon(threshold, "<f4")),
+        "winner": _h64(_canon(feature, "<i4"), _canon(thr, "<f4")),
         "alloc": _h64(_canon(left, "<i4"), _canon(right, "<i4")),
     }
 
@@ -114,6 +122,55 @@ def tree_fingerprints(tree) -> list:
             continue
         rows.append(level_fingerprint(
             d, ns[ids], feat[ids], thr[ids], left[ids], right[ids]
+        ))
+    return rows
+
+
+def subtree_fingerprints(depth, n_samples, feature, threshold, left,
+                         right, ids=None) -> list:
+    """Per-level rows for ONE subtree of a larger node buffer (the
+    hybrid-refine tail, ISSUE 15 satellite).
+
+    ``ids`` selects the subtree's nodes (None = the whole buffer is the
+    subtree, e.g. a standalone per-subtree host build). Node ids are
+    REMAPPED to the subtree's local id-rank order before hashing, so the
+    two tail engines — the batched multi-root native frontier (subtree
+    nodes interleaved in one buffer, buffer-global child ids) and the
+    per-subtree host builds (ids local from 0) — commit byte-identical
+    rows for identical subtrees; depths are likewise re-based at the
+    subtree root. Leaves keep ``-1`` children.
+    """
+    depth = np.asarray(depth, np.int64)
+    feature = np.asarray(feature)
+    threshold = np.asarray(threshold)
+    left = np.asarray(left, np.int64)
+    right = np.asarray(right, np.int64)
+    ns = np.asarray(n_samples)
+    if ids is None:
+        ids = np.arange(len(depth), dtype=np.int64)
+    else:
+        ids = np.asarray(ids, np.int64)
+    if not len(ids):
+        return []
+    # id -> local rank (ids are ascending within a buffer's subtree; the
+    # searchsorted remap keeps -1 leaves at -1).
+    def remap(child):
+        c = child[ids]
+        local = np.searchsorted(ids, np.where(c < 0, ids[0], c))
+        return np.where(c < 0, -1, local).astype(np.int64)
+
+    l_loc, r_loc = remap(left), remap(right)
+    d_loc = depth[ids] - int(depth[ids].min())
+    feat_loc = feature[ids]
+    thr_loc = threshold[ids]
+    ns_loc = ns[ids]
+    rows = []
+    for d in range(int(d_loc.max(initial=0)) + 1):
+        at = np.flatnonzero(d_loc == d)
+        if not len(at):
+            continue
+        rows.append(level_fingerprint(
+            d, ns_loc[at], feat_loc[at], thr_loc[at], l_loc[at], r_loc[at]
         ))
     return rows
 

@@ -54,6 +54,12 @@ MEMORY_SCHEMA = 1
 HBM_BUDGET_ENV = "MPITREE_TPU_HBM_BYTES"       # per-device preflight budget
 MEM_SAMPLE_ENV = "MPITREE_TPU_MEM_SAMPLE"      # "1" = span-boundary sampling
 DRIFT_TOL_ENV = "MPITREE_TPU_MEM_DRIFT_TOL"    # drift-event threshold (x)
+# Host-RAM budget for streamed ingestion (ISSUE 15): the chunk size the
+# ingest tier streams at is DERIVED from this via ingest_chunk_rows —
+# the planner's host_peak_bytes pricing in reverse — never an ad-hoc
+# row constant.
+HOST_BUDGET_ENV = "MPITREE_TPU_HOST_BYTES"
+HOST_INGEST_BUDGET_DEFAULT = 1 << 30
 
 # Ledger-vs-live default drift threshold: the analytical peak prices
 # TRANSIENT working sets (the split chunk histogram) that live sampling
@@ -168,6 +174,102 @@ def shrink_knob(array_name: str, *, engine=None) -> str | None:
         # the subtraction carry — direct pair accumulation drops them.
         return "hist_subtraction"
     return None
+
+
+def host_ingest_budget() -> int:
+    """The host-RAM budget streamed chunk sizing derives from
+    (``MPITREE_TPU_HOST_BYTES``, default 1 GiB)."""
+    env = os.environ.get(HOST_BUDGET_ENV)
+    if env:
+        try:
+            return max(int(env), 1 << 20)
+        except ValueError:
+            pass
+    return HOST_INGEST_BUDGET_DEFAULT
+
+
+def ingest_row_bytes(features: int) -> int:
+    """Peak host bytes ONE streamed row costs while its chunk is live:
+    the raw f32 slice plus its binned int32 twin (both exist during the
+    bin step), doubled for the transpose/ascontiguousarray staging
+    copies the binning pass makes."""
+    return 2 * max(int(features), 1) * (4 + 4)
+
+
+def sketch_budget_bytes(features: int, capacity: int) -> int:
+    """A-priori bound on the merged quantile sketches' host cost:
+    (f32 value, i64 count) pairs at full capacity per feature, doubled
+    for the merge's transient concatenation."""
+    return 2 * max(int(features), 1) * max(int(capacity), 1) * (4 + 8)
+
+
+def ingest_chunk_rows(features: int, *, budget: int | None = None,
+                      floor: int = 1024, cap: int = 1 << 22) -> int:
+    """Streamed chunk size DERIVED from the host budget (ISSUE 15): the
+    widest row count whose per-chunk working set (:func:`ingest_row_bytes`)
+    fits the budget, clamped to [floor, cap]. The ONE sizing formula —
+    ``ingest.StreamedDataset`` resolves ``chunk_rows=None`` through here
+    and :func:`plan_ingest` prices exactly what it returns."""
+    b = int(budget) if budget else host_ingest_budget()
+    rows = b // ingest_row_bytes(features)
+    return int(min(max(rows, int(floor)), int(cap)))
+
+
+def plan_ingest(*, rows: int, features: int, chunk_rows: int,
+                sketch_capacity: int, mesh_axes=None,
+                max_bins: int = 256) -> MemoryPlan:
+    """Price one streamed ingest pass (the ``plan_fit`` twin for the
+    loading path): per-chunk raw/binned staging, the merged sketches,
+    and the host-resident per-row state (targets/weights — the one O(N)
+    host cost streaming keeps), against the per-device cost of the
+    assembled ``x_binned`` (priced per the partition table, plus one
+    in-flight chunk piece)."""
+    axes = _axis_widths(mesh_axes)
+    rows = int(rows)
+    features = int(features)
+    K = int(chunk_rows)
+    rows_pad = _round_up(rows, axes["data"])
+    feat_pad = _round_up(features, axes["feature"])
+    arrays = [
+        {"name": "chunk_raw", "shape": [K, features], "itemsize": 4,
+         "phase": "sketch", "bytes_per_device": 2 * K * features * 4},
+        {"name": "chunk_binned", "shape": [K, features], "itemsize": 4,
+         "phase": "bin_place", "bytes_per_device": 2 * K * features * 4},
+        {"name": "sketch", "shape": [features, int(sketch_capacity)],
+         "itemsize": 12, "phase": RESIDENT,
+         "bytes_per_device": sketch_budget_bytes(
+             features, sketch_capacity)},
+        {"name": "y_host", "shape": [rows], "itemsize": 16,
+         "phase": RESIDENT, "bytes_per_device": rows * 16},
+    ]
+    resident = sum(
+        a["bytes_per_device"] for a in arrays if a["phase"] == RESIDENT
+    )
+    phases = {
+        RESIDENT: resident,
+        "sketch": resident + 2 * K * features * 4,
+        # the bin step holds the raw chunk AND its binned twin
+        "bin_place": resident + 4 * K * features * 4,
+    }
+    peak_phase = max(phases, key=lambda p: phases[p])
+    xb_dev = _per_device_bytes(
+        "x_binned", (rows_pad, feat_pad), 4, axes
+    )
+    return MemoryPlan(
+        kind="ingest",
+        mesh_axes=axes,
+        arrays=arrays,
+        phases=phases,
+        hbm_peak_bytes=int(xb_dev + K * feat_pad * 4),
+        peak_phase=peak_phase,
+        host_peak_bytes=int(phases[peak_phase]),
+        inputs={
+            "rows": rows, "features": features, "chunk_rows": K,
+            "sketch_capacity": int(sketch_capacity),
+            "max_bins": int(max_bins),
+            "host_budget_bytes": host_ingest_budget(),
+        },
+    )
 
 
 def table_bytes(n_slots: int, n_channels: int) -> int:
@@ -457,7 +559,9 @@ def plan_fit(*, rows: int, features: int, classes: int = 2,
              max_table_slots: int = 1 << 17,
              rounds_per_dispatch: int = 1,
              n_out: int = 1,
-             engine: str | None = None) -> MemoryPlan:
+             engine: str | None = None,
+             streamed: bool = False,
+             streamed_chunk_rows: int | None = None) -> MemoryPlan:
     """Price one fit's build-state arrays into a :class:`MemoryPlan`.
 
     Every argument is a workload STATIC (nothing here touches a device):
@@ -576,11 +680,20 @@ def plan_fit(*, rows: int, features: int, classes: int = 2,
         if extra:
             phases[ph] = resident + extra
     peak_phase = max(phases, key=lambda p: phases[p])
-    host_peak = (
-        rows * features * 4      # the raw f32 matrix
-        + rows * features * 4    # the binned int32 copy
-        + rows * 16              # y/weight/node_id/leaf_ids host state
-    )
+    if streamed:
+        # Streamed-ingest pricing mode (ISSUE 15): the raw/binned
+        # matrices never exist on host — the host side is per-row state
+        # plus one live chunk's staging, which is exactly what
+        # ingest_chunk_rows sized against the host budget.
+        K_ing = (int(streamed_chunk_rows) if streamed_chunk_rows
+                 else ingest_chunk_rows(features))
+        host_peak = rows * 16 + K_ing * ingest_row_bytes(features)
+    else:
+        host_peak = (
+            rows * features * 4      # the raw f32 matrix
+            + rows * features * 4    # the binned int32 copy
+            + rows * 16              # y/weight/node_id/leaf_ids host state
+        )
     return MemoryPlan(
         kind="fit",
         mesh_axes=axes,
@@ -600,6 +713,9 @@ def plan_fit(*, rows: int, features: int, classes: int = 2,
             "gbdt_x64": bool(gbdt_x64), "subtraction": bool(subtraction),
             "rounds_per_dispatch": int(rounds_per_dispatch),
             "engine": engine,
+            # Only stamped on streamed fits: absent == in-memory, so
+            # every pre-ISSUE-15 record keeps its lineage digest.
+            **({"streamed": True} if streamed else {}),
         },
     )
 

@@ -190,9 +190,15 @@ def note_build_path(obs, *, host: bool, backend, n_rows: int,
 
 def note_refine(obs, *, refine: bool, rd, crown_depth,
                 refine_depth_param, constrained: bool = False,
-                leafwise: bool = False) -> None:
+                leafwise: bool = False, streamed: bool = False) -> None:
     """Record the hybrid-refine decision (estimator-level routing)."""
-    if leafwise:
+    if streamed:
+        reason = (
+            "streamed ingest: hybrid tail skipped — the refine pass "
+            "re-bins raw rows, and a streamed fit's raw matrix never "
+            "exists on host (single-engine full depth)"
+        )
+    elif leafwise:
         reason = (
             "max_leaf_nodes: hybrid tail skipped — the best-first frontier "
             "owns the leaf budget end to end (a host tail would re-grow "

@@ -81,6 +81,40 @@ class BinnedData:
         return np.arange(B)[None, :] < self.n_cand[:, None]
 
 
+@dataclasses.dataclass(frozen=True)
+class StreamedBinnedData(BinnedData):
+    """BinnedData whose matrix was assembled chunk-at-a-time on device.
+
+    ``x_binned`` is a GLOBAL device array, already padded to the mesh's
+    axis widths (rows to the data-axis width, features to the
+    feature-axis width — padding rows/columns are zeros, made inert by
+    the ``node_id=-1``/zero-candidate contracts) and already placed per
+    ``parallel/partition.py``'s ``x_binned`` rule. The raw feature
+    matrix never existed on any host (ISSUE 15): ``mpitree_tpu.ingest``
+    binned each host chunk against sketch-derived edges and
+    ``device_put`` it straight onto its mesh slot.
+
+    ``n_rows`` is the REAL row count (``len(y)``); the ``n_samples`` /
+    ``n_features`` properties report real extents so consumers that
+    size against the dataset (weight totals, ledger pricing, padding
+    arithmetic in ``mesh.shard_build_inputs``) never see the padding.
+    """
+
+    n_rows: int = 0
+    # The chunk size the stream ACTUALLY used — threaded into the build
+    # ledger's streamed host pricing (``plan_fit(streamed_chunk_rows=)``)
+    # so the recorded bound matches the run, not the default budget.
+    chunk_rows: int = 0
+
+    @property
+    def n_samples(self) -> int:
+        return self.n_rows
+
+    @property
+    def n_features(self) -> int:
+        return self.thresholds.shape[0]
+
+
 def _exact_edges(col: np.ndarray) -> np.ndarray:
     uniq = np.unique(col)
     return uniq[:-1]
@@ -109,6 +143,51 @@ def _quantile_edges_sorted(col_sorted: np.ndarray, max_bins: int) -> np.ndarray:
     # the indices directly lets one sort serve both the uniqueness probe and
     # the edges (np.unique + np.quantile would each sort the column).
     return np.unique(col_sorted[_quantile_indices(len(col_sorted), max_bins)])
+
+
+def pack_edges(
+    per_feature_edges: list, *, quantized: bool = False
+) -> tuple:
+    """Pack per-feature edge arrays into the ``BinnedData`` threshold table.
+
+    The ONE copy of the edge→(thresholds, n_cand, n_bins) packaging both
+    :func:`bin_dataset` and the streaming ingest tier
+    (``mpitree_tpu.ingest``) ride: edges computed from a full column and
+    edges computed from a merged quantile sketch package identically, so
+    the two paths can only diverge in edge SELECTION (which the sketch
+    makes bit-identical on shared sizes — see ``ingest/sketch.py``).
+    Returns ``(thresholds, n_cand, n_bins, quantized)``.
+    """
+    n_features = len(per_feature_edges)
+    n_cand = np.array([len(e) for e in per_feature_edges], dtype=np.int32)
+    n_bins = int(n_cand.max(initial=0)) + 1
+    thresholds = np.full(
+        (n_features, max(n_bins - 1, 1)), np.inf, dtype=np.float32
+    )
+    for f, edges in enumerate(per_feature_edges):
+        thresholds[f, : len(edges)] = edges
+    return thresholds, n_cand, n_bins, quantized
+
+
+def bin_with_thresholds(
+    X: np.ndarray, thresholds: np.ndarray, n_cand: np.ndarray
+) -> np.ndarray:
+    """Bin a raw (N, F) f32 chunk against an existing threshold table.
+
+    Identical arithmetic to :func:`bin_dataset`'s binning pass
+    (``searchsorted(edges, col, side="left")`` per feature), factored
+    out so the streaming ingest tier bins chunk-at-a-time against
+    sketch-derived edges with bit-identical ids.
+    """
+    X = np.ascontiguousarray(X, dtype=np.float32)
+    n_samples, n_features = X.shape
+    Xt = np.ascontiguousarray(X.T)
+    xbt = np.empty((n_features, n_samples), dtype=np.int32)
+    for f in range(n_features):
+        xbt[f] = np.searchsorted(
+            thresholds[f, : n_cand[f]], Xt[f], side="left"
+        )
+    return np.ascontiguousarray(xbt.T)
 
 
 def bin_dataset(
@@ -172,13 +251,11 @@ def bin_dataset(
                 quantized = True
         per_feature_edges.append(edges.astype(np.float32))
 
-    n_cand = np.array([len(e) for e in per_feature_edges], dtype=np.int32)
-    n_bins = int(n_cand.max(initial=0)) + 1
-
-    thresholds = np.full((n_features, max(n_bins - 1, 1)), np.inf, dtype=np.float32)
+    thresholds, n_cand, n_bins, quantized = pack_edges(
+        per_feature_edges, quantized=quantized
+    )
     xbt = np.empty((n_features, n_samples), dtype=np.int32)
     for f, edges in enumerate(per_feature_edges):
-        thresholds[f, : len(edges)] = edges
         xbt[f] = np.searchsorted(edges, Xt[f], side="left")
     x_binned = np.ascontiguousarray(xbt.T)
 

@@ -241,16 +241,18 @@ def pad_row_arrays(xb, y, w, nid, n_shards: int):
     engines rely on: padding rows carry ``node_id=-1`` and weight 0, so
     every kernel masks them out. ``w`` may be 1-D (N,) or a stacked
     (T, N) per-tree weight matrix — padding lands on the row axis either
-    way.
+    way. ``xb=None`` pads only the row-state arrays (the streamed-ingest
+    path, whose matrix was assembled pre-padded on device).
     """
     pad = pad_rows(len(y), n_shards)
     if not pad:
         return xb, y, w, nid
-    # A device-binned matrix (ops/binning.bin_dataset_device) pads in place
-    # on the accelerator; np.concatenate would silently round-trip it to
-    # host through __array__.
-    xp = jnp if isinstance(xb, jax.Array) else np
-    xb = xp.concatenate([xb, xp.zeros((pad, xb.shape[1]), xb.dtype)])
+    if xb is not None:
+        # A device-binned matrix (ops/binning.bin_dataset_device) pads in
+        # place on the accelerator; np.concatenate would silently
+        # round-trip it to host through __array__.
+        xp = jnp if isinstance(xb, jax.Array) else np
+        xb = xp.concatenate([xb, xp.zeros((pad, xb.shape[1]), xb.dtype)])
     y = np.concatenate([y, np.zeros(pad, y.dtype)])
     if w.ndim == 1:
         w = np.concatenate([w, np.zeros(pad, np.float32)])
@@ -278,21 +280,40 @@ def shard_build_inputs(mesh: Mesh, binned, y, sample_weight):
     # partition reads this module's axis constants at load.
     from mpitree_tpu.parallel import partition
 
-    N, F = binned.x_binned.shape
+    # Real extents come from the dataclass, not the array: a streamed
+    # matrix (ops/binning.StreamedBinnedData) arrives PRE-padded and
+    # pre-placed by the ingest tier — its shape already carries the
+    # mesh's axis padding, while n_samples/n_features stay real.
+    from mpitree_tpu.ops.binning import StreamedBinnedData
+
+    N, F = binned.n_samples, binned.n_features
     dr = data_shards(mesh)
     df = feature_shards(mesh)
+    fpad = (-F) % df
     cand = binned.candidate_mask()
     w = (np.ones(N, np.float32) if sample_weight is None
          else sample_weight.astype(np.float32))
-    xb, yy, w, nid = pad_row_arrays(
-        binned.x_binned, y, w, np.zeros(N, np.int32), dr
-    )
-    fpad = (-F) % df
-    if fpad:
-        xp = jnp if isinstance(xb, jax.Array) else np
-        xb = xp.concatenate(
-            [xb, xp.zeros((len(xb), fpad), xp.int32)], axis=1
+    prepadded = isinstance(binned, StreamedBinnedData)
+    if prepadded and binned.x_binned.shape != (
+        N + pad_rows(N, dr), F + fpad
+    ):
+        raise ValueError(
+            f"pre-placed x_binned has shape {binned.x_binned.shape}; this "
+            f"mesh pads ({N}, {F}) to ({N + pad_rows(N, dr)}, {F + fpad}) "
+            "— the ingest assembly and the build must use the same mesh"
         )
+    xb, yy, w, nid = pad_row_arrays(
+        None if prepadded else binned.x_binned,
+        y, w, np.zeros(N, np.int32), dr,
+    )
+    if prepadded:
+        xb = binned.x_binned
+    if fpad:
+        if not prepadded:
+            xp = jnp if isinstance(xb, jax.Array) else np
+            xb = xp.concatenate(
+                [xb, xp.zeros((len(xb), fpad), xp.int32)], axis=1
+            )
         cand = np.concatenate(
             [cand, np.zeros((fpad, cand.shape[1]), bool)], axis=0
         )

@@ -131,6 +131,35 @@ def in_specs_for(mesh, names) -> tuple:
     return tuple(specs)
 
 
+def ingest_layout(mesh, n_rows: int, n_features: int) -> dict:
+    """Mesh-slot layout for streaming ingestion (ISSUE 15) — where each
+    chunk's rows/columns land, derived from the SAME ``x_binned`` rule
+    the engines' in_specs come from (no second placement authority).
+
+    Returns ``{"sharding", "rows_pad", "feat_pad", "shard_rows",
+    "shard_cols", "grid"}``: ``grid`` is the mesh's device array
+    reshaped ``(data_shards, feature_shards)`` so ``grid[di, fi]`` is
+    the device owning row block ``di`` × feature block ``fi``; shard
+    extents are the padded global extents divided by the axis widths
+    (padding rows/columns are zeros — inert under the ``node_id=-1`` /
+    zero-candidate contracts ``mesh.pad_row_arrays`` documents).
+    """
+    from mpitree_tpu.parallel import mesh as mesh_lib
+
+    dr = mesh_lib.data_shards(mesh)
+    df = mesh_lib.feature_shards(mesh)
+    rows_pad = int(n_rows) + (-int(n_rows)) % dr
+    feat_pad = int(n_features) + (-int(n_features)) % df
+    return {
+        "sharding": NamedSharding(mesh, spec_for("x_binned", mesh, ndim=2)),
+        "rows_pad": rows_pad,
+        "feat_pad": feat_pad,
+        "shard_rows": max(rows_pad // dr, 1),
+        "shard_cols": max(feat_pad // df, 1),
+        "grid": mesh.devices.reshape(dr, df),
+    }
+
+
 def sharding_tree(mesh, state: dict) -> dict:
     """``{name: NamedSharding}`` for a named build-state tree (SNIPPETS
     [3] ``get_sharding_tree`` shape). Scalars map to replicated."""

@@ -45,14 +45,29 @@ def validate_fit_data(X, y, *, task: str = "classification"):
     """Returns (X float32 (N,F), y_encoded, classes_ or None)."""
     X, y = check_X_y(X, y, dtype="numeric", y_numeric=(task == "regression"))
     X = np.ascontiguousarray(X, dtype=np.float32)
+    y_enc, classes = validate_fit_targets(y, task=task)
+    return X, y_enc, classes
+
+
+def validate_fit_targets(y, *, task: str = "classification"):
+    """(y_encoded, classes_ or None) — the target half of
+    :func:`validate_fit_data`, factored out for fits whose X never
+    materializes whole (streamed ingestion accumulates y chunk by chunk
+    and validates it here once)."""
+    y = np.asarray(y)
+    if y.ndim != 1:
+        raise ValueError(f"y must be 1-D, got shape {y.shape}")
     if task == "classification":
         check_classification_targets(y)
         classes, y_enc = np.unique(y, return_inverse=True)
-        return X, y_enc.astype(np.int32), classes
+        return y_enc.astype(np.int32), classes
     # Regression targets stay float64 on the host: the estimator centers in
     # f64 (shift invariance) and casts to f32 only for the device moment
     # histograms; leaf values are refit exactly in f64 afterwards.
-    return X, np.ascontiguousarray(y, dtype=np.float64), None
+    y64 = np.ascontiguousarray(y, dtype=np.float64)
+    if not np.isfinite(y64).all():
+        raise ValueError("regression targets must be finite")
+    return y64, None
 
 
 def record_sklearn_attributes(est, names, n_features, *,

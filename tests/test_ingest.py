@@ -1,0 +1,383 @@
+"""Out-of-core streaming ingestion (ISSUE 15).
+
+The contract under test: streamed binning is BIT-identical to
+``ops.binning.bin_dataset`` on shared sizes (exact sketches), streamed
+fits are fingerprint-identical to in-memory fits across chunk sizes,
+mesh shapes, engines and binning modes, host residency is priced and
+bounded by the planner-derived chunk size, and the edge cases of the
+chunk protocol (short last chunk, single chunk, constant features,
+empty streams) neither crash nor diverge.
+"""
+
+import os
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from mpitree_tpu import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    StreamedDataset,
+)
+from mpitree_tpu.ingest import (
+    FeatureSketch,
+    NpyShards,
+    SketchSet,
+    shard_for_process,
+)
+from mpitree_tpu.obs import memory as memory_lib
+from mpitree_tpu.ops.binning import (
+    bin_dataset,
+    bin_with_thresholds,
+)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    N, F = 3000, 9
+    X = rng.normal(size=(N, F)).astype(np.float32)
+    X[:, 2] = np.round(X[:, 2], 1)          # low cardinality
+    X[:, 4] = -1.5                          # constant (empty-feature case)
+    X[:, 6] = rng.integers(0, 3, N)         # tiny cardinality
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] + X[:, 2] > 0.3)).astype(int)
+    return X, y
+
+
+def _fp(est):
+    return est.fit_report_["fingerprints"]["fit"]
+
+
+# ---------------------------------------------------------------------------
+# sketch / edge identity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("binning", ["auto", "quantile", "exact"])
+@pytest.mark.parametrize("chunk", [1, 37, 1000, 3000, 5000])
+def test_sketch_edges_bit_identical(data, binning, chunk):
+    """Edges from chunk-merged sketches == bin_dataset's, every mode,
+    every chunking (incl. single-chunk and short-last-chunk)."""
+    X, _ = data
+    ref = bin_dataset(X, max_bins=32, binning=binning)
+    sk = SketchSet(X.shape[1])
+    for lo in range(0, len(X), chunk):
+        sk.update(X[lo:lo + chunk])
+    thr, n_cand, n_bins, quantized = sk.to_thresholds(
+        max_bins=32, binning=binning
+    )
+    np.testing.assert_array_equal(thr, ref.thresholds)
+    np.testing.assert_array_equal(n_cand, ref.n_cand)
+    assert n_bins == ref.n_bins
+    assert quantized == ref.quantized
+    xb = np.concatenate([
+        bin_with_thresholds(X[lo:lo + chunk], thr, n_cand)
+        for lo in range(0, len(X), chunk)
+    ])
+    np.testing.assert_array_equal(xb, ref.x_binned)
+
+
+def test_sketch_merge_associative(data):
+    """Merging two half-stream sketch banks == one full-stream bank."""
+    X, _ = data
+    full = SketchSet(X.shape[1])
+    full.update(X)
+    a, b = SketchSet(X.shape[1]), SketchSet(X.shape[1])
+    a.update(X[: len(X) // 2])
+    b.update(X[len(X) // 2:])
+    a.merge(b)
+    for s1, s2 in zip(full.sketches, a.sketches):
+        np.testing.assert_array_equal(s1.values, s2.values)
+        np.testing.assert_array_equal(s1.counts, s2.counts)
+    assert a.n_rows == full.n_rows
+
+
+def test_sketch_compaction_fallback():
+    """Past capacity the sketch compacts deterministically: weight is
+    preserved, edges stay real ascending data values, exact mode
+    refuses, and the binned output flags quantized."""
+    sk = FeatureSketch(capacity=32)
+    col = np.arange(5000, dtype=np.float32)
+    for lo in range(0, 5000, 500):
+        sk.update(col[lo:lo + 500])
+    assert not sk.exact
+    assert sk.n == 5000              # total weight preserved
+    assert sk.n_unique <= 32
+    edges, quantized = sk.edges(max_bins=8, binning="auto")
+    assert quantized
+    assert (np.diff(edges) > 0).all()
+    assert np.isin(edges, col).all()  # edges are real data values
+    with pytest.raises(ValueError, match="sketch capacity"):
+        sk.edges(max_bins=8, binning="exact")
+
+
+def test_empty_stream_refused():
+    with pytest.raises(ValueError, match="empty chunk stream"):
+        DecisionTreeClassifier(backend="cpu").fit(
+            StreamedDataset.from_chunks([])
+        )
+
+
+def test_nan_chunk_refused(data):
+    X, y = data
+    Xn = X[:64].copy()
+    Xn[3, 1] = np.nan
+    with pytest.raises(ValueError, match="finite"):
+        DecisionTreeClassifier(backend="cpu").fit(
+            StreamedDataset.from_chunks([(Xn, y[:64])])
+        )
+
+
+def test_shard_for_process_partitions():
+    items = list(range(10))
+    dealt = [
+        shard_for_process(items, p, 3) for p in range(3)
+    ]
+    assert sum(dealt, []) == items
+    assert all(len(d) >= 3 for d in dealt)
+
+
+# ---------------------------------------------------------------------------
+# streamed-vs-in-memory identity grid
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mesh", [None, 8, (4, 2)])
+@pytest.mark.parametrize("chunk", [251, 3000])
+def test_streamed_fit_identity_meshes(data, mesh, chunk):
+    """The acceptance grid's mesh x chunk plane: streamed fits are
+    fingerprint- and prediction-identical to the in-memory fit."""
+    X, y = data
+    ref = DecisionTreeClassifier(
+        max_depth=6, max_bins=32, backend="cpu", n_devices=8,
+        refine_depth=None,
+    ).fit(X, y)
+    clf = DecisionTreeClassifier(
+        max_depth=6, max_bins=32, backend="cpu", n_devices=mesh,
+    ).fit(StreamedDataset.from_arrays(X, y, chunk_rows=chunk))
+    assert _fp(clf) == _fp(ref)
+    np.testing.assert_array_equal(clf.predict(X), ref.predict(X))
+
+
+@pytest.mark.parametrize("engine", ["fused", "levelwise"])
+@pytest.mark.parametrize("binning", ["auto", "quantile"])
+def test_streamed_fit_identity_engines(data, engine, binning, monkeypatch):
+    """The engine x binning plane of the grid."""
+    X, y = data
+    monkeypatch.setenv("MPITREE_TPU_ENGINE", engine)
+    ref = DecisionTreeClassifier(
+        max_depth=5, max_bins=32, binning=binning, backend="cpu",
+        n_devices=8, refine_depth=None,
+    ).fit(X, y)
+    clf = DecisionTreeClassifier(
+        max_depth=5, max_bins=32, binning=binning, backend="cpu",
+        n_devices=8,
+    ).fit(StreamedDataset.from_arrays(X, y, chunk_rows=777))
+    assert _fp(clf) == _fp(ref)
+    assert clf.fit_report_["engine"]["value"] == engine
+
+
+def test_streamed_regressor_identity(data):
+    X, _ = data
+    yr = (2.0 * X[:, 0] + np.sin(X[:, 1])).astype(np.float64)
+    ref = DecisionTreeRegressor(
+        max_depth=5, max_bins=32, backend="cpu", n_devices=8,
+        refine_depth=None,
+    ).fit(X, yr)
+    reg = DecisionTreeRegressor(
+        max_depth=5, max_bins=32, backend="cpu", n_devices=8,
+    ).fit(dataset=StreamedDataset.from_arrays(X, yr, chunk_rows=499))
+    assert _fp(reg) == _fp(ref)
+    np.testing.assert_allclose(reg.predict(X), ref.predict(X))
+
+
+def test_streamed_leafwise_identity(data):
+    """max_leaf_nodes rides the same pre-placed matrix (the leaf-wise
+    engine consumes shard_build_inputs too)."""
+    X, y = data
+    ref = DecisionTreeClassifier(
+        max_leaf_nodes=16, max_bins=32, backend="cpu", n_devices=8,
+    ).fit(X, y)
+    clf = DecisionTreeClassifier(
+        max_leaf_nodes=16, max_bins=32, backend="cpu", n_devices=8,
+    ).fit(StreamedDataset.from_arrays(X, y, chunk_rows=640))
+    assert _fp(clf) == _fp(ref)
+
+
+def test_streamed_npy_shards_identity(data, tmp_path):
+    """mmap'd .npy shards (uneven sizes) == in-memory fit; the chunk
+    iterator slices windows without materializing a shard."""
+    X, y = data
+    cuts = [0, 700, 1701, 3000]
+    xps, yps = [], []
+    for i in range(3):
+        xp, yp = tmp_path / f"x{i}.npy", tmp_path / f"y{i}.npy"
+        np.save(xp, X[cuts[i]:cuts[i + 1]])
+        np.save(yp, y[cuts[i]:cuts[i + 1]])
+        xps.append(str(xp))
+        yps.append(str(yp))
+    ds = StreamedDataset.from_npy(xps, yps, chunk_rows=311)
+    src = NpyShards(xps, yps)
+    assert src.n_rows == len(X) and src.n_features == X.shape[1]
+    ref = DecisionTreeClassifier(
+        max_depth=6, max_bins=32, backend="cpu", n_devices=8,
+        refine_depth=None,
+    ).fit(X, y)
+    clf = DecisionTreeClassifier(
+        max_depth=6, max_bins=32, backend="cpu", n_devices=8,
+    ).fit(ds)
+    assert _fp(clf) == _fp(ref)
+
+
+def test_streamed_sample_weight_identity(data):
+    """Per-chunk weights flow into the same weighted build."""
+    X, y = data
+    rng = np.random.default_rng(3)
+    w = rng.integers(1, 4, len(X)).astype(np.float32)
+    ref = DecisionTreeClassifier(
+        max_depth=5, max_bins=32, backend="cpu", n_devices=8,
+        refine_depth=None,
+    ).fit(X, y, sample_weight=w)
+    chunks = [
+        (X[lo:lo + 500], y[lo:lo + 500], w[lo:lo + 500])
+        for lo in range(0, len(X), 500)
+    ]
+    clf = DecisionTreeClassifier(
+        max_depth=5, max_bins=32, backend="cpu", n_devices=8,
+    ).fit(StreamedDataset.from_chunks(chunks))
+    assert _fp(clf) == _fp(ref)
+
+
+def test_streamed_rejects_double_weights(data):
+    X, y = data
+    w = np.ones(len(X), np.float32)
+    chunks = [(X, y, w)]
+    with pytest.raises(ValueError, match="pick one"):
+        DecisionTreeClassifier(backend="cpu").fit(
+            StreamedDataset.from_chunks(chunks), sample_weight=w
+        )
+
+
+def test_streamed_generator_factory(data):
+    """from_chunks accepts a factory; a bare generator is refused (the
+    pipeline streams twice)."""
+    X, y = data
+
+    def factory():
+        for lo in range(0, len(X), 900):
+            yield X[lo:lo + 900], y[lo:lo + 900]
+
+    clf = DecisionTreeClassifier(
+        max_depth=4, max_bins=32, backend="cpu", n_devices=8,
+    ).fit(StreamedDataset.from_chunks(factory))
+    assert clf.tree_.n_nodes > 1
+    with pytest.raises(TypeError, match="factory"):
+        StreamedDataset.from_chunks(factory())
+
+
+# ---------------------------------------------------------------------------
+# planner-derived chunk sizing + host-peak pin
+# ---------------------------------------------------------------------------
+
+def test_ingest_chunk_rows_derivation(monkeypatch):
+    """The one sizing formula: budget-derived, floored, capped."""
+    monkeypatch.setenv(memory_lib.HOST_BUDGET_ENV, str(4 << 20))
+    rows = memory_lib.ingest_chunk_rows(16)
+    assert rows * memory_lib.ingest_row_bytes(16) <= (4 << 20)
+    monkeypatch.setenv(memory_lib.HOST_BUDGET_ENV, str(1 << 20))
+    assert memory_lib.ingest_chunk_rows(100_000) == 1024  # floor
+    monkeypatch.delenv(memory_lib.HOST_BUDGET_ENV)
+    assert memory_lib.ingest_chunk_rows(1) == 1 << 22     # cap
+
+
+def test_plan_ingest_and_streamed_plan_fit():
+    plan = memory_lib.plan_ingest(
+        rows=1_000_000, features=54, chunk_rows=8192,
+        sketch_capacity=1 << 20, mesh_axes={"data": 8},
+    )
+    assert plan.kind == "ingest"
+    names = {a["name"] for a in plan.arrays}
+    assert {"chunk_raw", "chunk_binned", "sketch", "y_host"} <= names
+    # streamed host pricing undercuts in-memory once rows dwarf chunks
+    streamed = memory_lib.plan_fit(
+        rows=1_000_000, features=54, streamed=True,
+        streamed_chunk_rows=8192,
+    )
+    inmem = memory_lib.plan_fit(rows=1_000_000, features=54)
+    assert streamed.host_peak_bytes < inmem.host_peak_bytes
+    assert streamed.inputs["streamed"] is True
+    assert "streamed" not in inmem.inputs  # lineage digests stay stable
+
+
+def test_streamed_fit_host_peak_pin(monkeypatch):
+    """The obs.memory pin under MPITREE_TPU_MEM_SAMPLE=1: the live host
+    watermark rides the record, the recorded plan carries the streamed
+    host pricing, and a warm fit's python-side working set stays under
+    the full-matrix bytes (chunk+sketch-bounded). Needs a dataset whose
+    matrix dwarfs the interpreter's fixed overhead."""
+    rng = np.random.default_rng(11)
+    N, F = 60_000, 12
+    X = rng.normal(size=(N, F)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    monkeypatch.setenv(memory_lib.MEM_SAMPLE_ENV, "1")
+    ds = StreamedDataset.from_arrays(
+        X, y, chunk_rows=4096, sketch_capacity=1024
+    )
+    fit = lambda: DecisionTreeClassifier(  # noqa: E731
+        max_depth=5, max_bins=32, backend="cpu", n_devices=8,
+    ).fit(ds)
+    fit()  # warm: XLA compilation allocates through the python allocator
+    tracemalloc.start()
+    clf = fit()
+    _, py_peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    live = (clf.fit_report_.get("memory") or {}).get("live") or {}
+    assert int(live.get("host_peak_bytes") or 0) > 0
+    assert py_peak < N * F * 8  # raw f32 + binned i32, never held whole
+    assert clf.ingest_stats_["chunk_rows"] == 4096
+
+
+def test_streamed_record_decision(data):
+    """The run record attributes the ingest route and stats."""
+    X, y = data
+    clf = DecisionTreeClassifier(
+        max_depth=4, max_bins=32, backend="cpu", n_devices=8,
+    ).fit(StreamedDataset.from_arrays(X, y, chunk_rows=1000))
+    dec = clf.fit_report_["decisions"]["ingest"]
+    assert dec["value"] == "streamed"
+    assert dec["inputs"]["chunk_rows"] == 1000
+    assert clf.ingest_stats_["rows"] == len(X)
+    # refine is off with the streamed reason
+    assert "streamed" in clf.fit_report_["decisions"]["refine"]["reason"]
+
+
+def test_streamed_dataset_arg_validation(data):
+    X, y = data
+    ds = StreamedDataset.from_arrays(X, y, chunk_rows=1000)
+    with pytest.raises(ValueError, match="not both"):
+        DecisionTreeClassifier(backend="cpu").fit(X, dataset=ds)
+    with pytest.raises(TypeError, match="StreamedDataset"):
+        DecisionTreeClassifier(backend="cpu").fit(dataset=X)
+
+
+def test_streamed_rejects_separate_y(data):
+    """fit(ds, y) must refuse, not silently train on embedded targets."""
+    X, y = data
+    ds = StreamedDataset.from_arrays(X, y, chunk_rows=1000)
+    with pytest.raises(ValueError, match="no separate y"):
+        DecisionTreeClassifier(backend="cpu").fit(ds, y)
+
+
+def test_streamed_plan_prices_actual_chunk_rows(data):
+    """The recorded streamed plan prices the chunk size the run USED,
+    not the default budget derivation."""
+    X, y = data
+    clf = DecisionTreeClassifier(
+        max_depth=4, max_bins=32, backend="cpu", n_devices=8,
+    ).fit(StreamedDataset.from_arrays(X, y, chunk_rows=123))
+    mem = clf.fit_report_["memory"]
+    expected = memory_lib.plan_fit(
+        rows=len(X), features=X.shape[1], bins=mem["inputs"]["bins"],
+        classes=mem["inputs"]["classes"], max_depth=4,
+        mesh_axes=mem["mesh_axes"], streamed=True, streamed_chunk_rows=123,
+    ).host_peak_bytes
+    assert mem["host_peak_bytes"] == expected

@@ -514,3 +514,82 @@ def test_aggregate_plans_math():
     assert agg["hbm_peak_bytes"] == 130 + 60
     assert agg["host_peak_bytes"] == 9
     assert agg["kind"] == "fit_aggregate"
+
+
+# ---------------------------------------------------------------------------
+# hybrid-refine tail fingerprints (ISSUE 15 satellite — PR-13 follow-up)
+# ---------------------------------------------------------------------------
+
+def _starved(n=4000, seed=0):
+    """Quantile-starved workload so the auto hybrid tail engages."""
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, 5)).astype(np.float64)
+    X[:, 0] = np.where(X[:, 0] > 0, X[:, 0] * 100, X[:, 0])
+    y = ((np.abs(X[:, 0]) < 0.3).astype(int)
+         + 2 * ((X[:, 1] > 0.1) & (X[:, 1] < 0.6)).astype(int))
+    return X, y.astype(np.int64)
+
+
+def test_refine_tail_commits_per_subtree_fingerprints():
+    """A refined fit's record carries the crown PLUS one fingerprint
+    tree per refined subtree, repeatably — so refine divergences
+    localize to (subtree, level, channel) like crown builds."""
+    from mpitree_tpu import DecisionTreeClassifier
+
+    X, y = _starved()
+    kw = dict(max_depth=8, max_bins=8, backend="cpu", refine_depth=3)
+    a = DecisionTreeClassifier(**kw).fit(X, y)
+    b = DecisionTreeClassifier(**kw).fit(X, y)
+    fa = a.fit_report_["fingerprints"]
+    fb = b.fit_report_["fingerprints"]
+    assert len(fa["trees"]) > 1  # crown + refined subtrees
+    assert fa == fb              # repeatable, whole-fit hash included
+    assert obs_diff.localize_divergence(fa, fb) is None
+    # an unrefined fit of the same workload commits ONLY the crown
+    plain = DecisionTreeClassifier(
+        max_depth=8, max_bins=8, backend="cpu", refine_depth=None
+    ).fit(X, y)
+    assert len(plain.fit_report_["fingerprints"]["trees"]) == 1
+
+
+def test_subtree_fingerprints_local_remap():
+    """Slicing a subtree out of a larger buffer hashes the same rows as
+    the standalone subtree (ids remapped to local rank, depth re-based)
+    — the batched and per-subtree tail engines cannot disagree."""
+    # standalone subtree: root(0) -> [1, 2], ids local
+    depth_s = np.array([0, 1, 1])
+    ns_s = np.array([10, 6, 4])
+    feat_s = np.array([2, -1, -1])
+    thr_s = np.array([0.5, np.nan, np.nan], np.float32)
+    left_s = np.array([1, -1, -1])
+    right_s = np.array([2, -1, -1])
+    standalone = obs_fp.subtree_fingerprints(
+        depth_s, ns_s, feat_s, thr_s, left_s, right_s
+    )
+    # the same subtree embedded at ids (3, 7, 9) of a bigger buffer,
+    # rooted at depth 2
+    depth_b = np.array([0, 1, 1, 2, 9, 9, 9, 3, 9, 3])
+    ns_b = np.array([0, 0, 0, 10, 0, 0, 0, 6, 0, 4])
+    feat_b = np.array([0, 0, 0, 2, 0, 0, 0, -1, 0, -1])
+    thr_b = np.full(10, np.nan, np.float32)
+    thr_b[3] = 0.5
+    left_b = np.full(10, -1)
+    right_b = np.full(10, -1)
+    left_b[3], right_b[3] = 7, 9
+    embedded = obs_fp.subtree_fingerprints(
+        depth_b, ns_b, feat_b, thr_b, left_b, right_b,
+        ids=np.array([3, 7, 9]),
+    )
+    assert standalone == embedded
+
+
+def test_fingerprint_zero_thresholds_canonical():
+    """-0.0 and +0.0 thresholds are predicate-identical and must hash
+    identically (the device-bin / ingest-sketch zero non-contract)."""
+    a = obs_fp.level_fingerprint(
+        0, [10], [1], np.array([-0.0], np.float32), [1], [2]
+    )
+    b = obs_fp.level_fingerprint(
+        0, [10], [1], np.array([0.0], np.float32), [1], [2]
+    )
+    assert a == b

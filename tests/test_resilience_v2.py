@@ -601,3 +601,34 @@ def test_checkpoint_compact_every_validated():
         GradientBoostingRegressor(
             checkpoint_compact_every=1
         )._validate_params_()
+
+
+def test_forest_checkpoint_compact_every(tmp_path):
+    """checkpoint_compact_every as a forest-estimator param (the PR-14
+    carried follow-up): the grouped flush path compacts through the same
+    maybe_compact trigger boosting uses, and the compacted fit stays
+    identical to an uncheckpointed one."""
+    from mpitree_tpu import RandomForestClassifier
+
+    X, y = _data(300, seed=4)
+    kw = dict(n_estimators=17, max_depth=3, random_state=0, backend="cpu")
+    ref = RandomForestClassifier(**kw).fit(X, y)
+    path = str(tmp_path / "forest.ckpt")
+    clf = RandomForestClassifier(
+        checkpoint=path, checkpoint_compact_every=2, **kw
+    ).fit(X, y)
+    # 17 trees flush in 3 groups of <= 8; at compact-every-2 the shard
+    # list was merged at least once mid-build.
+    assert clf.fit_report_["counters"].get("checkpoint_compactions", 0) >= 1
+    assert not os.path.exists(path)  # done() swept a completed build
+    np.testing.assert_array_equal(clf.predict(X), ref.predict(X))
+
+
+def test_forest_checkpoint_compact_every_validated():
+    from mpitree_tpu import RandomForestClassifier
+
+    X, y = _data(60, seed=4)
+    with pytest.raises(ValueError, match="checkpoint_compact_every"):
+        RandomForestClassifier(
+            n_estimators=2, checkpoint_compact_every=1, backend="cpu",
+        ).fit(X, y)

@@ -75,6 +75,10 @@ BUDGET = {
     # 2x16 shallow binary-logistic rounds; the host-loop side pays 16
     # levelwise dispatch rounds on the tunnel.
     "gbdt_fusedK": 1200,
+    # Two streaming passes over the covtype training split (host sketch +
+    # chunked bin/placement) plus one streamed and one in-memory fit for
+    # the identity pin.
+    "ingest": 1200,
 }
 
 
@@ -357,6 +361,40 @@ def flight_section(sec: str) -> None:
         log(f"{sec}: flight append failed ({type(e).__name__}: {e})")
 
 
+def stage_round_artifacts() -> None:
+    """Stage the round's committed evidence — including the flight
+    store's verdict trajectories (ISSUE 15 satellite, the PR-13
+    follow-up): ``run_section`` injects ``runs/`` as the default
+    ``MPITREE_TPU_RUN_DIR``, so every capture's envelope lands there,
+    but nothing put the store into the round commit — after a round the
+    operator committed BENCH_TPU.jsonl + the log while the lineage
+    history (what ``--baseline`` and ``flight_section`` verdict against
+    next round) stayed untracked on one box. Best-effort ``git add`` of
+    the four artifact paths; the operator still reviews and commits.
+    """
+    run_dir = os.environ.get("MPITREE_TPU_RUN_DIR") or os.path.join(
+        REPO, "runs"
+    )
+    paths = [JSONL, LOG, TRACE_DIR, run_dir]
+    stage = [p for p in paths if os.path.exists(p)
+             and os.path.abspath(p).startswith(REPO + os.sep)]
+    if not stage:
+        return
+    try:
+        r = subprocess.run(
+            ["git", "add", "--"] + stage,
+            cwd=REPO, capture_output=True, text=True, timeout=30,
+        )
+        if r.returncode == 0:
+            log("round artifacts staged for commit: "
+                + ", ".join(os.path.relpath(s, REPO) for s in stage))
+        else:
+            log(f"git add skipped (rc={r.returncode}): "
+                f"{(r.stderr or '').strip()[:200]}")
+    except (OSError, subprocess.SubprocessError) as e:
+        log(f"git add skipped ({type(e).__name__}: {e})")
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     # Value-ranked queue (the --sections order IS the priority): the
@@ -366,8 +404,8 @@ def main() -> int:
     p.add_argument("--sections",
                    default="hist_tput,north_star,engine_fused,boosting,"
                            "leafwise_ab,gbdt_fusedK,mesh2d_ab,serving,"
-                           "device_bin,north_star_fused,engine_levelwise,"
-                           "forest,refine_sweep")
+                           "ingest,device_bin,north_star_fused,"
+                           "engine_levelwise,forest,refine_sweep")
     p.add_argument("--redo", default="",
                    help="comma-separated sections to re-measure even if "
                         "already captured (appended after the missing "
@@ -395,6 +433,7 @@ def main() -> int:
             # the tunnel dropped again, so back off before reprobing.
             todo.append(todo.pop(0))
             time.sleep(args.probe_every_s)
+    stage_round_artifacts()
     log(f"watcher exit, remaining={todo}")
     return 0 if not todo else 1
 
