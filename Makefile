@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: test test-cpu lint lint-graft lint-baseline bench bench-tpu report \
-  trace-smoke mem-smoke flight-smoke chaos-smoke ingest-smoke bench-diff \
-  clean
+.PHONY: test test-cpu lint lint-graft lint-baseline knob-check bench \
+  bench-tpu report trace-smoke mem-smoke flight-smoke chaos-smoke \
+  ingest-smoke bench-diff clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -20,11 +20,14 @@ lint:
 	ruff check mpitree_tpu tests bench.py
 
 # JAX-aware invariants ruff cannot see: host-sync (GL01), recompile (GL02),
-# collective-axis (GL03), dtype/tiling (GL04), donation (GL05/GL08),
-# host-callback (GL06), Pallas hygiene (GL07) and the GL00 unused-
-# suppression audit — tools/graftlint, dataflow-backed (interprocedural
-# traced-value propagation). Pure-AST: runs on any CPU box, no accelerator
-# (or even jax) needed. Human format here; CI runs --format github against
+# collective-axis (GL03), dtype/tiling (GL04), donation (GL05, path-
+# sensitive use-after-donate GL08), host-callback (GL06), Pallas hygiene
+# with symbolic-dim facts (GL07), project contracts — partition-spec
+# conformance (GL09) and the typed env-knob registry (GL10) — and the GL00
+# unused-suppression audit. tools/graftlint, dataflow-backed
+# (interprocedural traced-value propagation). Pure-AST: runs on any CPU
+# box, no accelerator (or even jax) needed. `--explain GLnn` prints a
+# rule's rationale. Human format here; CI runs --format github against
 # the checked-in baseline so only NEW findings fail a build.
 lint-graft:
 	$(PY) -m tools.graftlint mpitree_tpu --format human \
@@ -36,6 +39,12 @@ lint-graft:
 lint-baseline:
 	$(PY) -m tools.graftlint mpitree_tpu \
 	  --write-baseline tools/graftlint/baseline.json
+
+# README knob-table drift gate: the table between the knob-table markers
+# must match the typed registry (mpitree_tpu/config/knobs.py). After adding
+# or editing a Knob, regenerate with `python -m mpitree_tpu.config --write`.
+knob-check:
+	$(PY) -m mpitree_tpu.config --check
 
 bench:
 	$(PY) bench.py

@@ -186,9 +186,10 @@ def enable_compile_cache(platform: str | None = None) -> str | None:
     if _compat.LEGACY_JAX and platform not in ("tpu", "axon"):
         return None
 
-    path = os.environ.get(
-        "MPITREE_TPU_COMPILE_CACHE", os.path.join(_HERE, ".jax_cache")
-    )
+    from mpitree_tpu.config import knobs
+
+    path = (knobs.raw("MPITREE_TPU_COMPILE_CACHE")
+            or os.path.join(_HERE, ".jax_cache"))
     jax.config.update("jax_compilation_cache_dir", path)
     # Cache every executable (default skips small/fast ones): tunnel
     # round trips make even sub-second compiles worth persisting.

@@ -42,14 +42,12 @@ degrading.
 
 from __future__ import annotations
 
-import os
 from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import PartitionSpec as P
 
 from mpitree_tpu.core import leafwise_builder as leafwise
 from mpitree_tpu.obs import accounting as obs_acct
@@ -60,7 +58,7 @@ from mpitree_tpu.core.builder import (
     resolve_hist_subtraction,
 )
 from mpitree_tpu.ops import sampling as sampling_ops
-from mpitree_tpu.parallel import mesh as mesh_lib
+from mpitree_tpu.parallel import mesh as mesh_lib, partition
 from mpitree_tpu.parallel.mesh import DATA_AXIS
 from mpitree_tpu.resilience import (
     chaos,
@@ -68,6 +66,7 @@ from mpitree_tpu.resilience import (
     is_oom_failure,
     retry_device,
 )
+from mpitree_tpu.config import knobs
 
 DEFAULT_ROUNDS_PER_DISPATCH = 8
 
@@ -157,7 +156,7 @@ def resolve_rounds_per_dispatch(param, *, platform: str, loss_kind,
     from_env = False
     env_note = ""
     if flag == "auto":
-        env = os.environ.get("MPITREE_TPU_ROUNDS_PER_DISPATCH", "auto")
+        env = knobs.value("MPITREE_TPU_ROUNDS_PER_DISPATCH")
         if env != "auto":
             try:
                 ek = int(env)
@@ -311,10 +310,21 @@ def _make_rounds_fn(mesh, *, loss_kind: str, n_rounds: int, n_bins: int,
     sharded = jax.shard_map(
         program,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
-                  P(DATA_AXIS), P(), P(), P(), P(), P(), P(), P(), P(),
-                  P(), P()),
-        out_specs=(P(DATA_AXIS),) + tuple(P() for _ in range(11)),
+        # Specs from the ONE partition-rule table (parallel/partition.py):
+        # row-state operands shard their rows, the margin carry rides the
+        # ``raw_margin`` rule in and out, round-stacked result tables and
+        # the per-leaf (G, H) / loss accumulators replicate.
+        in_specs=partition.in_specs_for(
+            mesh, ("x_binned", "y", "raw_margin", "sample_weight",
+                   "cand_mask", ("mcw", 0), ("mid", 0), ("lam", 0),
+                   ("msl", 0), ("msg", 0), ("lr", 0), ("r0", 0),
+                   ("seed", 0), ("sub_thresh", 0)),
+        ),
+        out_specs=partition.out_specs_for(
+            mesh, ("raw_margin", "feat", "bin", "counts", "n_vec",
+                   "left_id", "parent_id", "n_nodes", "grad_tot",
+                   "hess_tot", "loss_sum", "loss_weight"),
+        ),
     )
     # The margin carry is donated (GL05: jit-of-lax-scan): each dispatch
     # device_puts a FRESH raw shard from the host mirror (GL08-safe — a

@@ -1,0 +1,10 @@
+"""Package configuration: the typed env-knob registry (``config.knobs``).
+
+``python -m mpitree_tpu.config --markdown`` prints the README knob table;
+``--check`` verifies the README section matches the registry (the CI
+drift gate).
+"""
+
+from mpitree_tpu.config import knobs
+
+__all__ = ["knobs"]

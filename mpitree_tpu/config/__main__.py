@@ -1,0 +1,89 @@
+"""Knob-registry doc tooling: ``python -m mpitree_tpu.config``.
+
+- ``--markdown`` prints the registry as the README's knob table.
+- ``--check [README]`` extracts the table between the
+  ``<!-- knob-table:begin -->`` / ``<!-- knob-table:end -->`` markers and
+  exits 1 when it differs from the generated one — the CI drift gate that
+  keeps docs and registry one source.
+- ``--write [README]`` rewrites that section in place (the update path a
+  contributor runs after adding a knob).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from mpitree_tpu.config import knobs
+
+BEGIN = "<!-- knob-table:begin -->"
+END = "<!-- knob-table:end -->"
+_DEFAULT_README = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "README.md"
+)
+
+
+def _split_readme(text: str):
+    try:
+        head, rest = text.split(BEGIN, 1)
+        table, tail = rest.split(END, 1)
+    except ValueError:
+        return None
+    return head, table, tail
+
+
+def main(argv: list | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m mpitree_tpu.config")
+    group = parser.add_mutually_exclusive_group(required=True)
+    group.add_argument(
+        "--markdown", action="store_true",
+        help="print the knob table generated from the registry",
+    )
+    group.add_argument(
+        "--check", nargs="?", const=_DEFAULT_README, metavar="README",
+        help="fail (exit 1) when the README knob table drifts from the "
+        "registry",
+    )
+    group.add_argument(
+        "--write", nargs="?", const=_DEFAULT_README, metavar="README",
+        help="rewrite the README knob-table section from the registry",
+    )
+    args = parser.parse_args(argv)
+
+    table = knobs.markdown_table()
+    if args.markdown:
+        print(table, end="")
+        return 0
+
+    path = args.check or args.write
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    parts = _split_readme(text)
+    if parts is None:
+        print(
+            f"knob-table markers ({BEGIN} / {END}) not found in {path}",
+            file=sys.stderr,
+        )
+        return 1
+    head, current, tail = parts
+
+    if args.write:
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(f"{head}{BEGIN}\n{table}{END}{tail}")
+        print(f"knob table rewritten in {path}", file=sys.stderr)
+        return 0
+
+    if current.strip() != table.strip():
+        print(
+            f"README knob table in {path} drifted from the registry — "
+            "run `python -m mpitree_tpu.config --write` to regenerate",
+            file=sys.stderr,
+        )
+        return 1
+    print("README knob table matches the registry", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

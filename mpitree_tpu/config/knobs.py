@@ -1,0 +1,214 @@
+# graftlint: knob-registry
+"""Typed registry of every ``MPITREE_TPU_*`` environment knob.
+
+This module is the ONE place the package reads ``os.environ`` for its own
+knobs (graftlint GL10 enforces that statically: a direct
+``os.environ.get("MPITREE_TPU_...")`` anywhere else in ``mpitree_tpu/`` is
+a finding). Each knob carries its type, default, parse rule, and the one
+doc line the README table is generated from
+(``python -m mpitree_tpu.config --markdown``) — so the docs can never
+drift from the behavior, and a new knob is a registry entry, not a
+scattered ``getenv`` plus a hand-edited table row.
+
+Two read paths, both registered:
+
+- :func:`value` — the typed read: unset or empty-string raw values resolve
+  to the default; anything else goes through the knob's parse rule. The
+  right call for the common bool/str/int/float knobs.
+- :func:`raw` — the raw string (or None), for the few sites whose parsing
+  is inseparable from site policy (tri-state forces, spec grammars,
+  site-specific fallback-with-warning). Those sites keep their exact
+  error text and fallback semantics; the registry still types and
+  documents the knob.
+
+Deliberately dependency-free (stdlib only): any module in the package —
+including the earliest-imported utils — can read knobs without an import
+cycle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable
+
+
+def _flag(raw: str) -> bool:
+    """The package's boolean convention: everything but "0" enables."""
+    return raw != "0"
+
+
+def _one(raw: str) -> bool:
+    """Strict opt-in: only the literal "1" enables."""
+    return raw == "1"
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One registered env knob: its type, default, parse rule, doc line."""
+
+    name: str
+    kind: str                     # "bool" | "str" | "int" | "float" | "path"
+    default: Any
+    doc: str
+    parse: Callable[[str], Any] | None = None
+    choices: tuple | None = None  # documented domain (informational)
+
+    def read(self) -> Any:
+        raw = os.environ.get(self.name)
+        if raw is None or raw == "":
+            return self.default
+        if self.parse is not None:
+            return self.parse(raw)
+        return raw
+
+
+KNOBS: tuple = (
+    # -- engine / kernel policy -------------------------------------------
+    Knob("MPITREE_TPU_ENGINE", "str", "auto",
+         "build engine when `BuildConfig(engine='auto')`",
+         choices=("auto", "fused", "levelwise")),
+    Knob("MPITREE_TPU_HIST_KERNEL", "str", "auto",
+         "histogram kernel for `hist_kernel='auto'`",
+         choices=("auto", "xla", "pallas")),
+    Knob("MPITREE_TPU_WIDE_HIST", "str", "auto",
+         "force (`1`) / disable (`0`) the sorted window-packed wide"
+         " histogram tier",
+         choices=("auto", "0", "1")),
+    Knob("MPITREE_TPU_WIDE_KERNEL", "str", "scan",
+         "wide-tier kernel: `pallas` forces (fails loudly when"
+         " unsatisfiable), `scan` keeps the XLA sweep",
+         choices=("scan", "pallas", "auto")),
+    Knob("MPITREE_TPU_EXACT_TIES", "str", "auto",
+         "`0` disables the f64 tie-exact cost sweep on CPU meshes",
+         choices=("auto", "0")),
+    Knob("MPITREE_TPU_HIST_SUBTRACTION", "str", "auto",
+         "sibling-subtraction histogram carry override",
+         choices=("auto", "on", "off")),
+    Knob("MPITREE_TPU_GBDT_X64", "str", "auto",
+         "`0` disables scoped-f64 gradient accumulation on CPU (perf"
+         " escape hatch; ceiling-guard tests ride it)",
+         choices=("auto", "0")),
+    Knob("MPITREE_TPU_ROUNDS_PER_DISPATCH", "str", "auto",
+         "boosting rounds fused per dispatch; an integer K forces, `auto`"
+         " prices from the memory planner"),
+    Knob("MPITREE_TPU_DEVICE_BIN", "str", None,
+         "`1` forces on-device binning (raises on failure), `0` disables"
+         " it everywhere; default = real TPUs only",
+         choices=("0", "1")),
+    Knob("MPITREE_TPU_SERVING_KERNEL", "str", "auto",
+         "serving tier: `pallas` forces (degrades gracefully with a typed"
+         " event), `xla` disables the kernel",
+         choices=("auto", "pallas", "xla")),
+    Knob("MPITREE_TPU_FOREST_HBM_BUDGET", "int", 8 << 30,
+         "per-device budget (bytes) for the replicated binned matrix in"
+         " tree-sharded forest builds", parse=int),
+    # -- observability ----------------------------------------------------
+    Knob("MPITREE_TPU_PROFILE", "bool", False,
+         "per-phase timing spans + per-level rows (`fit_stats_`)",
+         parse=_flag),
+    Knob("MPITREE_TPU_DEBUG", "bool", False,
+         "on-device determinism assertions + debug checks", parse=_flag),
+    Knob("MPITREE_TPU_TRACE_DIR", "path", None,
+         "ambient Chrome-trace capture: every observer traces to a unique"
+         " file in this directory"),
+    Knob("MPITREE_TPU_MEM_SAMPLE", "bool", False,
+         "`1` samples live memory watermarks at span boundaries",
+         parse=_one),
+    Knob("MPITREE_TPU_MEM_DRIFT_TOL", "float", 8.0,
+         "ledger-vs-live drift-event threshold (x)", parse=float),
+    Knob("MPITREE_TPU_HBM_BYTES", "int", None,
+         "per-device HBM preflight budget (wins over the backend's"
+         " reported `bytes_limit`)", parse=int),
+    Knob("MPITREE_TPU_HOST_BYTES", "int", 1 << 30,
+         "host-RAM budget streamed-ingest chunk sizing derives from",
+         parse=int),
+    Knob("MPITREE_TPU_OBS_STREAM_DIR", "path", None,
+         "spill directory for long-run level-row streaming"),
+    Knob("MPITREE_TPU_RUN_DIR", "path", None,
+         "ambient flight store: every fit/serve record appends an"
+         " envelope"),
+    Knob("MPITREE_TPU_RUN_MAX_BYTES", "int", 0,
+         "flight-store size cap in bytes (0/unset = unbounded)",
+         parse=int),
+    Knob("MPITREE_TPU_RUN_KEEP", "int", 16,
+         "per-lineage record tail length kept when the store rotates",
+         parse=int),
+    # -- resilience -------------------------------------------------------
+    Knob("MPITREE_TPU_ELASTIC", "bool", True,
+         "`0` turns the whole resilience ladder off — device failures"
+         " raise", parse=_flag),
+    Knob("MPITREE_TPU_RETRIES", "int", 2,
+         "transient re-dispatch budget (also the per-position level-retry"
+         " budget)", parse=int),
+    Knob("MPITREE_TPU_BACKOFF_S", "float", 0.5,
+         "retry backoff base seconds (exponential, deterministic jitter)",
+         parse=float),
+    Knob("MPITREE_TPU_LEVEL_RETRY", "str", "auto",
+         "snapshot the loop carry per level/expansion and resume there on"
+         " a blip (`auto` = on)", choices=("auto", "on", "off")),
+    Knob("MPITREE_TPU_CHAOS", "str", None,
+         "fault-injection plan spec"
+         " (`site:at:kind[:arg][:key=value...];...`)"),
+    # -- ingest / native / caches -----------------------------------------
+    Knob("MPITREE_TPU_SKETCH_CAPACITY", "int", 1 << 20,
+         "per-feature unique-value cap before the quantile sketch"
+         " compacts", parse=int),
+    Knob("MPITREE_TPU_NO_NATIVE", "bool", False,
+         "disable the C++ host split kernel (numpy fallback)",
+         parse=_flag),
+    Knob("MPITREE_TPU_NATIVE_CACHE", "path", None,
+         "build cache directory for the native kernel"
+         " (default: `mpitree_tpu/native/_build`)"),
+    Knob("MPITREE_TPU_COMPILE_CACHE", "path", None,
+         "persistent XLA executable cache directory (`bench_tpu.py`)"),
+)
+
+REGISTRY: dict = {k.name: k for k in KNOBS}
+
+
+def _lookup(name: str) -> Knob:
+    knob = REGISTRY.get(name)
+    if knob is None:
+        raise KeyError(
+            f"unregistered env knob {name!r} — add it to "
+            "mpitree_tpu/config/knobs.py (the registry is the single "
+            "os.environ read path; GL10 enforces it)"
+        )
+    return knob
+
+
+def value(name: str):
+    """Typed read: default when unset/empty, else the knob's parse rule."""
+    return _lookup(name).read()
+
+
+def raw(name: str) -> str | None:
+    """Raw environ string (or None) for a REGISTERED knob — the escape
+    hatch for sites whose parsing is site policy (tri-state forces, spec
+    grammars, fallback-with-warning)."""
+    return os.environ.get(_lookup(name).name)
+
+
+def markdown_table() -> str:
+    """The README knob table, generated from the registry."""
+    lines = [
+        "| knob | type | default | effect |",
+        "|---|---|---|---|",
+    ]
+    for k in KNOBS:
+        if k.default is None:
+            default = "unset"
+        elif k.default is True:
+            default = "on"
+        elif k.default is False:
+            default = "off"
+        elif k.kind == "int" and isinstance(k.default, int):
+            default = f"`{k.default}`"
+        else:
+            default = f"`{k.default}`"
+        doc = k.doc
+        if k.choices:
+            doc = f"{doc} (one of {', '.join(f'`{c}`' for c in k.choices)})"
+        lines.append(f"| `{k.name}` | {k.kind} | {default} | {doc} |")
+    return "\n".join(lines) + "\n"

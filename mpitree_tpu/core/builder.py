@@ -27,7 +27,6 @@ from __future__ import annotations
 
 import dataclasses
 import math
-import os
 import time
 import warnings
 
@@ -35,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from mpitree_tpu.config import knobs
 from mpitree_tpu.core.tree_struct import TreeArrays
 from mpitree_tpu.obs import accounting as obs_acct, warn_event
 from mpitree_tpu.obs import fingerprint as fingerprint_lib
@@ -257,7 +257,7 @@ def resolve_hist_kernel(cfg: BuildConfig, platform: str, task: str, *,
 
     hist_kernel = cfg.hist_kernel
     if hist_kernel == "auto":
-        hist_kernel = os.environ.get("MPITREE_TPU_HIST_KERNEL", "auto")
+        hist_kernel = knobs.value("MPITREE_TPU_HIST_KERNEL")
     if hist_kernel not in ("auto", "xla", "pallas"):
         raise ValueError(f"unknown hist_kernel {hist_kernel!r}")
     if hist_kernel == "xla":
@@ -292,7 +292,7 @@ def resolve_wide_hist(cfg: BuildConfig, platform: str, task: str, *,
     whose summation order differs from the scatter's) — the CPU identity
     tests and the multichip dryrun ride the force flag.
     """
-    flag = os.environ.get("MPITREE_TPU_WIDE_HIST", "auto")
+    flag = knobs.value("MPITREE_TPU_WIDE_HIST")
     if flag == "0":
         return False, False
     exact = task == "classification" and integer_ok
@@ -323,7 +323,7 @@ def resolve_wide_pallas(platform: str, *, use_wide: bool,
     """
     from mpitree_tpu.ops import wide_hist
 
-    flag = os.environ.get("MPITREE_TPU_WIDE_KERNEL", "scan")
+    flag = knobs.value("MPITREE_TPU_WIDE_KERNEL")
     if flag == "pallas":
         if not use_wide:
             raise ValueError(
@@ -372,7 +372,7 @@ def resolve_exact_ties(platform: str) -> bool:
     the exact host tail owns deep small nodes). MPITREE_TPU_EXACT_TIES=0
     opts out (perf escape hatch for CPU-mesh experiments).
     """
-    if os.environ.get("MPITREE_TPU_EXACT_TIES", "auto") == "0":
+    if knobs.value("MPITREE_TPU_EXACT_TIES") == "0":
         return False
     from mpitree_tpu import _compat
 
@@ -454,7 +454,7 @@ def resolve_hist_subtraction(cfg: BuildConfig, platform: str, task: str, *,
     """
     flag = cfg.hist_subtraction
     if flag == "auto":
-        flag = os.environ.get("MPITREE_TPU_HIST_SUBTRACTION", "auto")
+        flag = knobs.value("MPITREE_TPU_HIST_SUBTRACTION")
     if flag not in ("auto", "on", "off"):
         raise ValueError(f"unknown hist_subtraction {flag!r}")
     if flag == "off":
@@ -499,7 +499,7 @@ def resolve_gbdt_x64(platform: str) -> bool:
     surface. ``MPITREE_TPU_GBDT_X64=0`` opts out (perf escape hatch; the
     ceiling-guard tests also ride it to exercise the f32 path on CPU).
     """
-    if os.environ.get("MPITREE_TPU_GBDT_X64", "auto") == "0":
+    if knobs.value("MPITREE_TPU_GBDT_X64") == "0":
         return False
     return platform == "cpu"
 
@@ -783,7 +783,7 @@ def build_tree(
     if engine != "auto":
         engine_reason = f"explicit BuildConfig(engine={engine!r})"
     else:
-        env_engine = os.environ.get("MPITREE_TPU_ENGINE", "auto")
+        env_engine = knobs.value("MPITREE_TPU_ENGINE")
         if env_engine != "auto":
             engine = env_engine
             engine_reason = f"MPITREE_TPU_ENGINE={env_engine}"

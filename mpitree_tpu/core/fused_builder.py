@@ -28,14 +28,12 @@ more (every split has two non-empty sides).
 from __future__ import annotations
 
 import math
-import os
 from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import PartitionSpec as P
 
 from mpitree_tpu.core.builder import (
     _chunk_size,
@@ -66,9 +64,10 @@ from mpitree_tpu.parallel.collective import (
     regression_y_range,
     select_global,
 )
-from mpitree_tpu.parallel.mesh import DATA_AXIS, TREE_AXIS
+from mpitree_tpu.parallel.mesh import DATA_AXIS
 from mpitree_tpu.utils import importances as imp_utils
 from mpitree_tpu.utils.profiling import PhaseTimer
+from mpitree_tpu.config import knobs
 
 
 # Per-device budget for the replicated binned matrix in the tree-sharded
@@ -77,7 +76,7 @@ from mpitree_tpu.utils.profiling import PhaseTimer
 # forest mesh trades tree-axis width for a data axis — rows shard and
 # histograms psum inside each tree group (mesh_lib.tree_data_shape).
 FOREST_HBM_BUDGET_BYTES = int(
-    os.environ.get("MPITREE_TPU_FOREST_HBM_BUDGET", 8 << 30)
+    knobs.value("MPITREE_TPU_FOREST_HBM_BUDGET")
 )
 
 
@@ -726,18 +725,20 @@ def _make_fused_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
         random_split=random_split, monotonic=monotonic,
         subtraction=subtraction,
     )
-    out_specs = (P(), P(), P(), P(), P(), P(), P(DATA_AXIS), P())
     sharded = jax.shard_map(
         build,
         mesh=mesh,
-        # Operand specs from the ONE partition-rule table
+        # Operand AND result specs from the ONE partition-rule table
         # (parallel/partition.py) — trimmed to 1-D meshes automatically.
         in_specs=partition.in_specs_for(
             mesh, ("x_binned", "y", "node_id", "weight", "cand_mask",
                    ("mcw", 0), ("mid", 0), ("root_key", 0),
                    "mono_cst"),
         ),
-        out_specs=out_specs,
+        out_specs=partition.out_specs_for(
+            mesh, ("feat", "bin", "counts", "n_vec", "left_id",
+                   "parent_id", "node_id", ("n_nodes", 0)),
+        ),
         check_vma=feature_axis is None,  # replicated/varying mixes in the 2-D cond
     )
     # Donate the row-assignment input (arg 2, nid0): it is freshly sharded
@@ -816,19 +817,23 @@ def _make_forest_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
             (ws, cand_masks, mcw, mid, root_keys),
         )
 
-    t = P(TREE_AXIS)
-    if data_sharded:
-        in_specs = (P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
-                    P(TREE_AXIS, DATA_AXIS), P(TREE_AXIS, None, None),
-                    P(TREE_AXIS), P(TREE_AXIS), P(TREE_AXIS), P())
-        # tree outputs are replicated across each tree group after the
-        # psum'd decisions; the row assignment stays sharded
-        out_specs = (t, t, t, t, t, t, P(TREE_AXIS, DATA_AXIS), t)
-    else:
-        in_specs = (P(), P(), P(), P(TREE_AXIS, None),
-                    P(TREE_AXIS, None, None), P(TREE_AXIS), P(TREE_AXIS),
-                    P(TREE_AXIS), P())
-        out_specs = (t, t, t, t, t, t, t, t)
+    # One branch-free table derivation serves BOTH forest meshes: on the
+    # 1-D tree-only mesh (data replicated per device) every ``data`` axis
+    # entry trims to None, on the 2-D (tree, data) mesh it stays — the
+    # literal per-branch tuples this replaced were exactly those two
+    # trims of the same rules. Tree outputs replicate across each tree
+    # group after the psum'd decisions; the per-tree row assignment
+    # (``tree_node_id``) keeps its rows sharded for the refit pass.
+    in_specs = partition.in_specs_for(
+        mesh, ("x_binned", "y", "node_id", "tree_weights",
+               "tree_cand_masks", "tree_mcw", "tree_mid",
+               "tree_root_keys", "mono_cst"),
+    )
+    out_specs = partition.out_specs_for(
+        mesh, ("tree_feat", "tree_bin", "tree_counts", "tree_n_vec",
+               "tree_left", "tree_parent", "tree_node_id",
+               "tree_n_nodes"),
+    )
     sharded = jax.shard_map(
         per_device,
         mesh=mesh,
@@ -1237,36 +1242,30 @@ def build_forest_fused(
         rks = np.concatenate([rks, np.broadcast_to(rks[-1:], (T_pad - T,))])
 
     with timer.phase("shard"):
-        from jax.sharding import NamedSharding
-
         xb_h, y_h, ws, nid_h = mesh_lib.pad_row_arrays(
             binned.x_binned, np.asarray(y), ws, np.zeros(N, np.int32), Dd
         )
-        if data_sharded:
-            row_spec, xb_spec = P(DATA_AXIS), P(DATA_AXIS, None)
-            ws_spec = P(TREE_AXIS, DATA_AXIS)
-        else:
-            row_spec, xb_spec = P(), P()
-            ws_spec = P(TREE_AXIS, None)
-        xb_d = jax.device_put(xb_h, NamedSharding(tmesh, xb_spec))
-        y_d = jax.device_put(y_h, NamedSharding(tmesh, row_spec))
-        nid_d = jax.device_put(nid_h, NamedSharding(tmesh, row_spec))
-        ws_d = jax.device_put(ws, NamedSharding(tmesh, ws_spec))
-        cm_d = jax.device_put(
-            cm, NamedSharding(tmesh, P(TREE_AXIS, None, None))
-        )
-        mcw_d = jax.device_put(mcw, NamedSharding(tmesh, P(TREE_AXIS)))
-        mid_d = jax.device_put(mid, NamedSharding(tmesh, P(TREE_AXIS)))
-        rk_d = jax.device_put(rks, NamedSharding(tmesh, P(TREE_AXIS)))
         cst_op = (
             np.zeros(F, np.int32) if mono_cst is None
             else np.ascontiguousarray(mono_cst, np.int32)
         )
-        cst_d = jax.device_put(cst_op, NamedSharding(tmesh, P()))
+        # Placement from the rule table (partition.shard_build_state) —
+        # the same names _make_forest_fn's in_specs consult, trimmed the
+        # same way on both forest meshes, replacing the per-branch
+        # device_put spec tuples this block used to hand-write.
+        placed = partition.shard_build_state(tmesh, {
+            "x_binned": xb_h, "y": y_h, "node_id": nid_h,
+            "tree_weights": ws, "tree_cand_masks": cm,
+            "tree_mcw": mcw, "tree_mid": mid, "tree_root_keys": rks,
+            "mono_cst": cst_op,
+        })
 
     with timer.phase("forest_build"):
         with timer.compile_attribution("forest_fn", forest_fresh):
-            out = fn(xb_d, y_d, nid_d, ws_d, cm_d, mcw_d, mid_d, rk_d, cst_d)
+            out = fn(placed["x_binned"], placed["y"], placed["node_id"],
+                     placed["tree_weights"], placed["tree_cand_masks"],
+                     placed["tree_mcw"], placed["tree_mid"],
+                     placed["tree_root_keys"], placed["mono_cst"])
         feat, bins, counts, nvec, left, parent, nid_out, n_nodes = (
             jax.device_get(out)
         )

@@ -39,7 +39,6 @@ and order-independent), which is what the equivalence pins hold.
 
 from __future__ import annotations
 
-import os
 import time
 from functools import lru_cache
 
@@ -47,7 +46,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import PartitionSpec as P
 
 from mpitree_tpu.core.builder import (
     integer_weights,
@@ -58,10 +56,11 @@ from mpitree_tpu.core.builder import (
 from mpitree_tpu.core.fused_builder import _finalize_tree
 from mpitree_tpu.obs import accounting as obs_acct
 from mpitree_tpu.ops import impurity as imp_ops
-from mpitree_tpu.parallel import collective, mesh as mesh_lib
+from mpitree_tpu.parallel import collective, mesh as mesh_lib, partition
 from mpitree_tpu.parallel.mesh import DATA_AXIS
 from mpitree_tpu.resilience import chaos, recovery as recovery_lib
 from mpitree_tpu.utils.profiling import PhaseTimer
+from mpitree_tpu.config import knobs
 
 
 def _pool_capacity(max_leaf_nodes: int, max_depth, n_samples: int) -> int:
@@ -361,9 +360,14 @@ def _make_leafwise_fn(mesh, *, n_bins: int, n_classes: int, task: str,
     sharded = jax.shard_map(
         build,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS), P(DATA_AXIS),
-                  P(DATA_AXIS), P(), P(), P(), P(), P(), P()),
-        out_specs=(P(), P(), P(), P(), P(), P(), P(), P(DATA_AXIS), P()),
+        in_specs=partition.in_specs_for(mesh, (
+            "x_binned", "y", "node_id", "weight", "cand_mask",
+            ("mcw", 0), ("mid", 0), ("lam", 0), ("msl", 0), ("msg", 0),
+        )),
+        out_specs=partition.out_specs_for(mesh, (
+            "feat", "bin", "counts", "n_vec", "left_id", "parent_id",
+            "depth", "node_id", ("n_nodes", 0),
+        )),
     )
     # nid0 donated (GL05): freshly sharded per build, and the program
     # returns the advanced assignment with identical shape/sharding —
@@ -507,7 +511,7 @@ def build_tree_leafwise(
             "Mosaic tier"
         )
     if (cfg.hist_kernel == "auto"
-            and os.environ.get("MPITREE_TPU_HIST_KERNEL") == "pallas"):
+            and knobs.value("MPITREE_TPU_HIST_KERNEL") == "pallas"):
         # The env var is an ambient preference for level-wise fits and
         # must not crash a fit it cannot apply to (only the explicit
         # BuildConfig raises) — same graceful identity opt-out as the
@@ -524,7 +528,7 @@ def build_tree_leafwise(
     if engine != "auto":
         engine_reason = f"explicit BuildConfig(engine={engine!r})"
     else:
-        env_engine = os.environ.get("MPITREE_TPU_ENGINE", "auto")
+        env_engine = knobs.value("MPITREE_TPU_ENGINE")
         if env_engine != "auto":
             engine = env_engine
             engine_reason = f"MPITREE_TPU_ENGINE={env_engine}"

@@ -32,11 +32,11 @@ Host-side numpy only — no jax import at module level.
 from __future__ import annotations
 
 import dataclasses
-import os
 
 import numpy as np
 
 from mpitree_tpu.ops.binning import _quantile_indices, pack_edges
+from mpitree_tpu.config import knobs
 
 # Per-feature unique-value cap before the sketch compacts (~12 MiB of
 # (f32 value, i64 count) pairs per feature at the default). Overridable
@@ -48,7 +48,7 @@ SKETCH_CAPACITY_ENV = "MPITREE_TPU_SKETCH_CAPACITY"
 def resolve_capacity(capacity: int | None = None) -> int:
     if capacity is not None:
         return max(int(capacity), 2)
-    env = os.environ.get(SKETCH_CAPACITY_ENV)
+    env = knobs.raw(SKETCH_CAPACITY_ENV)
     if env:
         try:
             return max(int(env), 2)

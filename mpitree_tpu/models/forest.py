@@ -589,12 +589,11 @@ class _BaseForest(ReportMixin, BaseEstimator):
     def _per_tree_device_builds() -> bool:
         """True when batched tree-sharding must yield to per-tree builds
         (explicit levelwise engine or debug determinism checks)."""
-        import os
-
+        from mpitree_tpu.config import knobs
         from mpitree_tpu.utils.profiling import debug_checks_enabled
 
         return (
-            os.environ.get("MPITREE_TPU_ENGINE", "") == "levelwise"
+            knobs.value("MPITREE_TPU_ENGINE") == "levelwise"
             or debug_checks_enabled()
         )
 

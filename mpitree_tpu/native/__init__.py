@@ -17,6 +17,7 @@ import subprocess
 import threading
 
 import numpy as np
+from mpitree_tpu.config import knobs
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "split_kernel.cpp")
@@ -53,9 +54,8 @@ def _host_tag() -> str:
 def _build() -> str | None:
     """Compile the kernel; returns the .so path or None (numpy fallback)."""
     try:
-        cache_dir = os.environ.get(
-            "MPITREE_TPU_NATIVE_CACHE", os.path.join(_HERE, "_build")
-        )
+        cache_dir = (knobs.raw("MPITREE_TPU_NATIVE_CACHE")
+                     or os.path.join(_HERE, "_build"))
         os.makedirs(cache_dir, exist_ok=True)
         so_path = os.path.join(cache_dir, f"split_kernel.{_host_tag()}.so")
         if os.path.exists(so_path) and (
@@ -83,7 +83,7 @@ def lib():
     with _LOCK:
         if _LIB:
             return _LIB[0]
-        if os.environ.get("MPITREE_TPU_NO_NATIVE", "") not in ("", "0"):
+        if knobs.value("MPITREE_TPU_NO_NATIVE"):
             _LIB.append(None)
             return None
         so_path = _build()

@@ -50,6 +50,7 @@ import os
 import subprocess
 import time
 import warnings
+from mpitree_tpu.config import knobs
 
 FLIGHT_SCHEMA = 1
 RUN_DIR_ENV = "MPITREE_TPU_RUN_DIR"
@@ -77,7 +78,7 @@ _GIT_PROBED = False
 
 
 def _env_int(name: str, default: int) -> int:
-    raw = os.environ.get(name)
+    raw = knobs.raw(name)
     if not raw:
         return default
     try:
@@ -92,7 +93,7 @@ def _env_int(name: str, default: int) -> int:
 
 def enabled() -> bool:
     """Whether the ambient store is configured (``MPITREE_TPU_RUN_DIR``)."""
-    return bool(os.environ.get(RUN_DIR_ENV))
+    return bool(knobs.raw(RUN_DIR_ENV))
 
 
 def git_sha(cwd: str | None = None) -> str | None:
@@ -172,7 +173,7 @@ class FlightStore:
     """Append/query handle over one run directory's ``flight.jsonl``."""
 
     def __init__(self, root: str | None = None):
-        root = root or os.environ.get(RUN_DIR_ENV)
+        root = root or knobs.raw(RUN_DIR_ENV)
         if not root:
             raise ValueError(
                 f"no flight run dir: pass root= or set {RUN_DIR_ENV}"

@@ -45,6 +45,7 @@ from __future__ import annotations
 import dataclasses
 import math
 import os
+from mpitree_tpu.config import knobs
 
 # record.memory carries its own sub-schema version (the top-level record
 # version is obs.record.SCHEMA_VERSION): bump on any ledger field rename.
@@ -179,7 +180,7 @@ def shrink_knob(array_name: str, *, engine=None) -> str | None:
 def host_ingest_budget() -> int:
     """The host-RAM budget streamed chunk sizing derives from
     (``MPITREE_TPU_HOST_BYTES``, default 1 GiB)."""
-    env = os.environ.get(HOST_BUDGET_ENV)
+    env = knobs.raw(HOST_BUDGET_ENV)
     if env:
         try:
             return max(int(env), 1 << 20)
@@ -947,7 +948,7 @@ def device_hbm_budget(device=None) -> int | None:
     backend's reported ``bytes_limit`` (TPU runtimes provide it; CPU
     backends report nothing → None → no refusal — the planner never
     guesses a budget)."""
-    env = os.environ.get(HBM_BUDGET_ENV)
+    env = knobs.raw(HBM_BUDGET_ENV)
     if env:
         try:
             return int(env)
@@ -1087,7 +1088,7 @@ class MemWatch:
 
 def drift_tolerance() -> float:
     try:
-        return float(os.environ.get(DRIFT_TOL_ENV, DRIFT_TOL_DEFAULT))
+        return float(knobs.raw(DRIFT_TOL_ENV) or DRIFT_TOL_DEFAULT)
     except ValueError:
         return DRIFT_TOL_DEFAULT
 

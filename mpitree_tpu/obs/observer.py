@@ -53,6 +53,7 @@ from mpitree_tpu.obs.record import (
     wire_estimate,
 )
 from mpitree_tpu.utils.profiling import PhaseTimer, profiling_enabled
+from mpitree_tpu.config import knobs
 
 # Per-process spill-file sequence: distinguishes observers sharing a PID
 # without relying on id(self) (heap addresses recycle).
@@ -259,7 +260,7 @@ class BuildObserver(PhaseTimer):
         self._trace_track = f"fit{self._trace_seq}"
         self._trace_window: list | None = None
         self._trace_windows: dict = {}  # phase name -> [t0, t1]
-        tdir = os.environ.get(trace_mod.TRACE_DIR_ENV)
+        tdir = knobs.raw(trace_mod.TRACE_DIR_ENV)
         if tdir:
             self.trace_to(os.path.join(
                 tdir, f"trace_{os.getpid()}_{self._trace_seq}.json"
@@ -269,7 +270,7 @@ class BuildObserver(PhaseTimer):
         # the disabled path pays one `is None` check per span (inside
         # the pinned <5% budget).
         self._memwatch: memory_mod.MemWatch | None = None
-        if os.environ.get(memory_mod.MEM_SAMPLE_ENV) == "1":
+        if knobs.value(memory_mod.MEM_SAMPLE_ENV):
             self.watch_memory()
         # Build-state fingerprints (obs/fingerprint.py, ISSUE 13): the
         # running whole-fit fold plus the per-tree row lists; host-side
@@ -401,7 +402,7 @@ class BuildObserver(PhaseTimer):
         path = self._level_stream_path
         try:
             if path is None:
-                stream_dir = os.environ.get("MPITREE_TPU_OBS_STREAM_DIR")
+                stream_dir = knobs.raw("MPITREE_TPU_OBS_STREAM_DIR")
                 if not stream_dir:
                     return None
                 os.makedirs(stream_dir, exist_ok=True)

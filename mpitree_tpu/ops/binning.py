@@ -37,6 +37,7 @@ from __future__ import annotations
 import dataclasses
 
 import numpy as np
+from mpitree_tpu.config import knobs
 
 
 @dataclasses.dataclass(frozen=True)
@@ -435,9 +436,8 @@ def bin_for_engine(
     outputs) — but a device HANG blocks here exactly as the subsequent
     build would.
     """
-    import os
 
-    flag = os.environ.get("MPITREE_TPU_DEVICE_BIN")
+    flag = knobs.raw("MPITREE_TPU_DEVICE_BIN")
     if device and binning != "exact" and flag != "0":
         if flag == "1":
             # Forced: raise on failure — the identity tests ride this flag,

@@ -18,9 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding
-from jax.sharding import PartitionSpec as P
 
-from mpitree_tpu.parallel import mesh as mesh_lib
+from mpitree_tpu.parallel import mesh as mesh_lib, partition
 from mpitree_tpu.parallel.mesh import DATA_AXIS
 
 
@@ -156,7 +155,9 @@ def shard_rows(X, mesh):
         Xh = np.concatenate(
             [Xh, np.broadcast_to(Xh[-1:], (pad,) + Xh.shape[1:])]
         )
-    return jax.device_put(Xh, NamedSharding(mesh, P(DATA_AXIS))), n
+    return jax.device_put(
+        Xh, NamedSharding(mesh, partition.spec_for("x_rows", mesh))
+    ), n
 
 
 # Device-memory ceiling for one ensemble descent group — kept as the

@@ -27,7 +27,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import PartitionSpec as P
 
 from mpitree_tpu.ops import histogram as hist_ops
 from mpitree_tpu.ops import impurity as imp_ops
@@ -497,12 +496,17 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     if subtraction:
         names += ["parent_hist", "parent_slot", "is_small"]
     in_specs = partition.in_specs_for(mesh, names)
-    # The kept frontier histogram stays feature-sharded on device: each
-    # shard's slab is all the next level's reconstruction reads, so the
-    # carry never materializes feature-complete.
-    hist_spec = partition.spec_for("hist_keep", mesh)
-    out_specs = (P(),) + ((hist_spec,) if keep_hist else ()) \
-        + ((P(),) if debug else ())
+    # Outputs from the same table: the packed decision buffer and the
+    # debug fingerprint replicate; the kept frontier histogram stays
+    # feature-sharded on device — each shard's slab is all the next
+    # level's reconstruction reads, so the carry never materializes
+    # feature-complete.
+    out_names = ["decision"]
+    if keep_hist:
+        out_names += ["hist_keep"]
+    if debug:
+        out_names += ["debug_fp"]
+    out_specs = partition.out_specs_for(mesh, out_names)
     sharded = jax.shard_map(
         local_step,
         mesh=mesh,
@@ -664,7 +668,11 @@ def make_expand_fn(mesh, *, n_bins: int, n_classes: int, task: str,
     if subtraction:
         names += ["parent_hist"]
     in_specs = partition.in_specs_for(mesh, names)
-    out_specs = (P(DATA_AXIS), P()) + ((P(),) if subtraction else ())
+    # ``pair_keep`` (the reduced pair histogram re-entering the host-side
+    # pool) replicates — unlike the fused carry's resident slabs it
+    # leaves the program every expansion.
+    out_names = ["node_id", "decision"] + (["pair_keep"] if subtraction else [])
+    out_specs = partition.out_specs_for(mesh, out_names)
     sharded = jax.shard_map(
         local_expand,
         mesh=mesh,
@@ -703,7 +711,7 @@ def make_counts_fn(mesh, *, n_slots: int, n_classes: int, task: str):
         in_specs=partition.in_specs_for(
             mesh, ("y", "node_id", "weight", ("chunk_lo", 0))
         ),
-        out_specs=P(),
+        out_specs=partition.spec_for("counts", mesh),
     )
     return _chaos_dispatch("counts_dispatch", jax.jit(sharded))
 
@@ -752,7 +760,7 @@ def make_update_fn(mesh, *, n_slots: int):
             mesh, ("node_id", "x_binned", ("chunk_lo", 0), "is_split",
                    "feat", "bin", "left_id", "right_id")
         ),
-        out_specs=P(DATA_AXIS),
+        out_specs=partition.spec_for("node_id", mesh),
         check_vma=feature_axis is None,
     )
     # nid donated: the level loop's canonical `nid_d = update_fn(nid_d, ..)`

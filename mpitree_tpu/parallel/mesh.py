@@ -19,6 +19,10 @@ from functools import lru_cache
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# graftlint: partition-table — axis-generic placement helpers
+# (shard_rows/replicate build rank-generic specs from axis names, not
+# array names; every name-specific spec lives in parallel/partition.py).
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"

@@ -35,6 +35,10 @@ import re
 
 import jax
 import numpy as np
+
+# graftlint: partition-table — THE spec authority: the one module allowed
+# to construct PartitionSpec literals (GL09 flags ad-hoc P(...) anywhere
+# else in the package).
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mpitree_tpu.parallel.mesh import DATA_AXIS, FEATURE_AXIS, TREE_AXIS
@@ -48,8 +52,12 @@ from mpitree_tpu.parallel.mesh import DATA_AXIS, FEATURE_AXIS, TREE_AXIS
 PARTITION_RULES: tuple = (
     # The binned matrix: rows x features, both axes sharded.
     (r"^x_binned$", P(DATA_AXIS, FEATURE_AXIS)),
-    # Per-row state: targets/gradients, weights/hessians, node routing.
-    (r"^(y|weight|sample_weight|node_id|nid\w*)$", P(DATA_AXIS)),
+    # Raw (unbinned) row blocks: inference inputs — rows sharded, the
+    # feature axis rides whole with its rows.
+    (r"^x_rows$", P(DATA_AXIS)),
+    # Per-row state: targets/gradients, weights/hessians, node routing,
+    # boosting margins.
+    (r"^(y|weight|sample_weight|node_id|nid\w*|raw_margin)$", P(DATA_AXIS)),
     # (F, B) candidate mask: feature-major, bins replicated.
     (r"^cand_masks?$", P(FEATURE_AXIS, None)),
     # Resident (S, F, C, B) histogram slabs (the sibling-subtraction
@@ -64,7 +72,7 @@ PARTITION_RULES: tuple = (
     # scalars replicate within a tree group. The forest memory plan
     # (``obs.memory.plan_forest``) prices per-device bytes from exactly
     # these rules.
-    (r"^tree_weights$", P(TREE_AXIS, DATA_AXIS)),
+    (r"^tree_(weights|node_id)$", P(TREE_AXIS, DATA_AXIS)),
     (r"^tree_\w+$", P(TREE_AXIS)),
     # Per-node tables the host builds for the split/update/counts steps:
     # frontier maps, smaller-sibling masks, split routing, monotonic
@@ -72,8 +80,14 @@ PARTITION_RULES: tuple = (
     # and every shard's decision logic reads all of them.
     (r"^(parent_slot|is_small|is_split|feat|bin|left_id|right_id)$", P()),
     (r"^(node_mask|draws|mono_(cst|lo|hi))$", P()),
-    # Decision buffers and everything else (runtime scalars ride the
-    # scalar guard before this table is consulted).
+    # Program OUTPUTS that replicate after the in-program psum/merge:
+    # per-node result tables (counts/value vectors/parent links/depths),
+    # packed decision buffers, replicated histogram keeps, boosting
+    # per-leaf moments and loss accumulators, node-count scalars.
+    (r"^(counts|n_vec|parent_id|depth|n_nodes|decision|pair_keep)$", P()),
+    (r"^(grad_tot|hess_tot|loss_sum|loss_weight|debug_fp)$", P()),
+    # Everything else (runtime scalars ride the scalar guard before this
+    # table is consulted).
     (r".*", P()),
 )
 
@@ -129,6 +143,14 @@ def in_specs_for(mesh, names) -> tuple:
         else:
             specs.append(spec_for(n, mesh))
     return tuple(specs)
+
+
+def out_specs_for(mesh, names) -> tuple:
+    """``shard_map`` out_specs for a named result list — same contract as
+    :func:`in_specs_for` (plain names consult the table, ``(name, 0)``
+    pairs force the scalar ``P()``), so program OUTPUTS come from the one
+    table too (graftlint GL09 holds engine code to exactly that)."""
+    return in_specs_for(mesh, names)
 
 
 def ingest_layout(mesh, n_rows: int, n_features: int) -> dict:

@@ -45,8 +45,8 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import os
 import time
+from mpitree_tpu.config import knobs
 
 
 class ChaosXlaError(Exception):
@@ -262,7 +262,7 @@ def active(*faults):
 def _current() -> ChaosPlan | None:
     if _PLAN is not None:
         return _PLAN
-    spec = os.environ.get("MPITREE_TPU_CHAOS")
+    spec = knobs.raw("MPITREE_TPU_CHAOS")
     if not spec:
         return None
     global _ENV_SPEC, _ENV_PLAN

@@ -20,16 +20,16 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
-import os
 import warnings
+from mpitree_tpu.config import knobs
 
 
 def elastic_enabled() -> bool:
-    return os.environ.get("MPITREE_TPU_ELASTIC", "1") != "0"
+    return knobs.value("MPITREE_TPU_ELASTIC")
 
 
 def _env_number(name: str, cast, default):
-    raw = os.environ.get(name)
+    raw = knobs.raw(name)
     if raw is None or raw == "":
         return default
     try:

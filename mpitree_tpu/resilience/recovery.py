@@ -39,7 +39,7 @@ bit-identical).
 from __future__ import annotations
 
 import dataclasses
-import os
+from mpitree_tpu.config import knobs
 
 # OOM rescue ladder bound: three shrinks ~ one chunk halved 8x or every
 # knob class tried once — past that the plan is not the problem and the
@@ -63,7 +63,7 @@ def resolve_level_retry(flag: str) -> bool:
     """
     v = flag
     if v == "auto":
-        v = os.environ.get(LEVEL_RETRY_ENV, "auto")
+        v = knobs.value(LEVEL_RETRY_ENV)
     if v not in ("auto", "on", "off"):
         raise ValueError(f"unknown level_retry {v!r}")
     return v != "off"

@@ -34,7 +34,6 @@ can't satisfy it — serving must degrade, never die, on a policy mismatch.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +42,7 @@ from jax.experimental import pallas as pl
 
 from mpitree_tpu.obs import memory as memory_lib
 from mpitree_tpu.ops.pallas_hist import _round_up, pallas_available
+from mpitree_tpu.config import knobs
 
 
 def _traverse_kernel(x_ref, tbl_ref, val_ref, out_ref, *, n_steps,
@@ -229,7 +229,7 @@ def resolve_serving_kernel(platform: str, *, n_nodes_max: int,
     event: a serving stack must answer the request, not die, when a model
     outgrows VMEM or fails over to a f64-capable host.
     """
-    flag = os.environ.get("MPITREE_TPU_SERVING_KERNEL", "auto")
+    flag = knobs.value("MPITREE_TPU_SERVING_KERNEL")
     if flag == "xla":
         return False
     if flag not in ("auto", "pallas"):

@@ -20,7 +20,6 @@ correctness invariant. Here:
 from __future__ import annotations
 
 import contextlib
-import os
 import time
 from collections import defaultdict
 
@@ -28,13 +27,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from mpitree_tpu.config import knobs
+
 
 def profiling_enabled() -> bool:
-    return os.environ.get("MPITREE_TPU_PROFILE", "") not in ("", "0")
+    return knobs.value("MPITREE_TPU_PROFILE")
 
 
 def debug_checks_enabled() -> bool:
-    return os.environ.get("MPITREE_TPU_DEBUG", "") not in ("", "0")
+    return knobs.value("MPITREE_TPU_DEBUG")
 
 
 class PhaseTimer:

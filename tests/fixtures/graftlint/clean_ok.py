@@ -7,6 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
+# graftlint: partition-table — fixture scenarios spell specs inline
 from jax.sharding import PartitionSpec as P
 
 from mesh_decl import DATA_AXIS

@@ -66,3 +66,19 @@ def through_call(x):
 def comp_case(xs):
     parts = [p * 2 for p in (xs, xs)]
     return parts[0]
+
+
+def host_sink(arr, n_slots=8):
+    # NON-device helper (no jit root reaches it): tracedness can only
+    # enter through the per-argument call edge. `arr` is traced-eligible;
+    # `n_slots` (defaulted) is heuristically static and must stay clean
+    # even though the call below fills the slot.
+    doubled = arr * 2
+    return doubled
+
+
+def host_driver():
+    dev = jnp.ones((4,))           # a host-held device array
+    out = host_sink(dev, 16)       # slot 0 taints `arr`; slot 1 is static
+    size = len(out)                # laundered
+    return out, size

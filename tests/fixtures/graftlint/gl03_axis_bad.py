@@ -6,6 +6,7 @@ literal, so only the spec-binding rule fires, not the declared-axis one).
 
 import jax
 from jax import lax
+# graftlint: partition-table — fixture scenarios spell specs inline
 from jax.sharding import PartitionSpec as P
 
 from mesh_decl import DATA_AXIS  # noqa: F401 (lint input only)

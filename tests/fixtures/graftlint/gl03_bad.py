@@ -2,6 +2,7 @@
 
 import jax
 from jax import lax
+# graftlint: partition-table — fixture scenarios spell specs inline
 from jax.sharding import PartitionSpec as P
 
 from mesh_decl import DATA_AXIS  # noqa: F401 (lint input only)

@@ -4,6 +4,7 @@ import jax
 import jax.numpy as jnp
 from functools import partial
 from jax import lax
+# graftlint: partition-table — fixture scenarios spell specs inline
 from jax.sharding import PartitionSpec as P
 
 from mesh_decl import DATA_AXIS

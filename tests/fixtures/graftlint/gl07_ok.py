@@ -30,8 +30,9 @@ def grid_covers_exactly():
 
 
 def symbolic_dims_are_skipped(row_tile, n_rows):
-    # graftlint never guesses: symbolic blocks/grids check at runtime via
-    # the kernels' own _round_up/fits_vmem guards
+    # graftlint never guesses: UNGUARDED symbolic dims carry no provable
+    # facts, so every check stays silent (guarded dims live in
+    # gl07_sym_bad.py / gl07_sym_ok.py)
     return pl.pallas_call(
         doubler,
         grid=(n_rows // row_tile,),

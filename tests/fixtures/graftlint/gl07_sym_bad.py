@@ -1,0 +1,62 @@
+"""Seeded GL07 violations on SYMBOLIC dims — provable via the fact domain.
+
+Every site here was invisible to the literal-only rule (symbolic block
+dims forced a bail); symdim's guard/round_up/binding facts make each one
+a proof, not a guess.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _round_up(x, k):
+    return (x + k - 1) // k * k
+
+
+def doubler(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def guarded_rows_blow_vmem(row_tile):
+    # the raise-guard proves row_tile >= 4096, so the in-block alone is
+    # at least 4096 x 1024 x 4 B = 16 MiB — over budget on EVERY path,
+    # exactly the overrun the literal-only rule skipped
+    if row_tile < 4096:
+        raise ValueError("row_tile too small")
+    tile = _round_up(row_tile, 8)
+    bins = 1024
+    return pl.pallas_call(  # expect: GL07
+        doubler,
+        grid=(2,),
+        in_specs=[pl.BlockSpec((tile, bins), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((tile, bins), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((8192, 1024), jnp.float32),
+    )
+
+
+def guarded_grid_cannot_cover(row_tile):
+    # row_tile <= 8 proved by the guard: 2 grid steps x at-most-8 rows
+    # cover at most 16 of the 64 output rows
+    if row_tile > 8:
+        raise ValueError("row_tile too large")
+    return pl.pallas_call(
+        doubler,
+        grid=(2,),
+        in_specs=[pl.BlockSpec((row_tile, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((row_tile, 128), lambda i: (i, 0)),  # expect: GL07
+        out_shape=jax.ShapeDtypeStruct((64, 128), jnp.float32),
+    )
+
+
+def bf16_sublane_via_binding():
+    # the single-assignment binding makes `rows` exactly 24 — passes the
+    # f32 floor but breaks bf16's 16-row sublane tiling
+    rows = 24
+    return pl.pallas_call(
+        doubler,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((rows, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((rows, 128), lambda i: (i, 0)),  # expect: GL07
+        out_shape=jax.ShapeDtypeStruct((96, 128), jnp.bfloat16),
+    )

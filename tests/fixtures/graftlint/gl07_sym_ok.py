@@ -1,0 +1,71 @@
+"""GL07 negative cases on symbolic dims: facts that entail NO violation.
+
+The dual of ``gl07_sym_bad.py`` — same shapes of symbolic reasoning, but
+each site is either provably sane, runtime-gated, or honestly unknown.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _round_up(x, k):
+    return (x + k - 1) // k * k
+
+
+def fits_vmem(*nbytes):
+    return sum(nbytes) < (10 << 20)
+
+
+def doubler(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def vmem_guarded_site(row_tile):
+    # same lower-bound blowout as gl07_sym_bad.guarded_rows_blow_vmem, but
+    # the scope runtime-gates its working set — the fits_vmem raise-guard
+    # subsumes the static bound, so GL07 stays quiet
+    if row_tile < 4096:
+        raise ValueError("row_tile too small")
+    tile = _round_up(row_tile, 8)
+    if not fits_vmem(tile * 1024 * 4 * 3):
+        raise ValueError("working set exceeds VMEM")
+    return pl.pallas_call(
+        doubler,
+        grid=(2,),
+        in_specs=[pl.BlockSpec((tile, 1024), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((tile, 1024), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((8192, 1024), jnp.float32),
+    )
+
+
+def bounded_both_ways(row_tile):
+    # 8 <= row_tile <= 256 and a multiple of 8: the VMEM lower bound is
+    # tiny and 8 grid steps x at-most-256 rows cover the 64-row output
+    if row_tile < 8:
+        raise ValueError("row_tile too small")
+    if row_tile > 256:
+        raise ValueError("row_tile too large")
+    tile = _round_up(row_tile, 8)
+    return pl.pallas_call(
+        doubler,
+        grid=(8,),
+        in_specs=[pl.BlockSpec((tile, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((tile, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((64, 128), jnp.float32),
+    )
+
+
+def rebound_name_stays_unknown(row_tile, wide):
+    # `tile` is bound twice — symdim refuses to guess across branches,
+    # so no fact forms and no check can fire
+    tile = _round_up(row_tile, 8)
+    if wide:
+        tile = _round_up(row_tile, 16)
+    return pl.pallas_call(
+        doubler,
+        grid=(2,),
+        in_specs=[pl.BlockSpec((tile, 1024), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((tile, 1024), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((8192, 1024), jnp.float32),
+    )

@@ -1,0 +1,38 @@
+"""Seeded GL08 violations only a PATH-SENSITIVE scan can pin correctly.
+
+Each case forks control flow after the donating call; the garbage read
+sits on exactly one path. The line-ordered rule either missed these (a
+lexically-earlier rebind on the OTHER branch masked the read) or could
+not tell the branches apart.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def advance(nid, xb):
+    return nid + xb.sum(axis=1).astype(nid.dtype)
+
+
+def read_on_sibling_branch(flag, xb, nid0):
+    # the then-branch rebinds; the else-branch still holds the dead
+    # buffer — lexical order put the rebind first, masking this read
+    # from the old line-ordered scan
+    step = jax.jit(advance, donate_argnums=(0,))
+    out = step(nid0, xb)
+    if flag:
+        nid0 = jnp.zeros_like(out)
+        probe = nid0.sum()
+    else:
+        probe = nid0.sum()  # expect: GL08
+    return out, probe
+
+
+def read_after_partial_rebind(flag, xb, nid0):
+    # only ONE branch rebinds: the fall-through path joins DONATED, so
+    # the read after the `if` is garbage whenever flag is False
+    step = jax.jit(advance, donate_argnums=(0,))
+    out = step(nid0, xb)
+    if flag:
+        nid0 = jnp.zeros_like(out)
+    return out + nid0  # expect: GL08

@@ -1,0 +1,54 @@
+"""GL08 negative cases the path-sensitive scan must NOT flag.
+
+The duals of ``gl08_path_bad.py``: every read here sits on a path where
+the name was rebound first, or on a path that never made the donating
+call at all — the false positives the line-ordered rule produced.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def advance(nid, xb):
+    return nid + xb.sum(axis=1).astype(nid.dtype)
+
+
+def call_on_one_branch(flag, xb, nid0):
+    # the donation happens only on the then-path, which returns; the
+    # else-path's read never saw a donated buffer (the old rule flagged
+    # it purely because it sat on a later line)
+    step = jax.jit(advance, donate_argnums=(0,))
+    if flag:
+        out = step(nid0, xb)
+        return out
+    return nid0 * 2
+
+
+def rebind_path_reads_freely(flag, xb, nid0):
+    # the branch that rebinds may read the fresh binding; the branch
+    # that kept the dead buffer reads nothing
+    step = jax.jit(advance, donate_argnums=(0,))
+    out = step(nid0, xb)
+    if flag:
+        nid0 = jnp.zeros_like(out)
+        out = out + nid0
+    return out
+
+
+def terminating_branch_then_rebind(flag, xb, nid0):
+    # the donated path returns before any read; the fall-through rebinds
+    # before its read — both paths clean
+    step = jax.jit(advance, donate_argnums=(0,))
+    out = step(nid0, xb)
+    if flag:
+        return out
+    nid0 = jax.device_put(out)
+    return out + nid0
+
+
+def loop_rebind_still_sanctioned(xb, nid0):
+    # the canonical level-loop idiom must survive the rewrite untouched
+    step = jax.jit(advance, donate_argnums=(0,))
+    for _ in range(4):
+        nid0 = step(nid0, xb)
+    return nid0

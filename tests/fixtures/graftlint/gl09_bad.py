@@ -1,0 +1,23 @@
+"""Seeded GL09 violations: placements dodging the partition table."""
+
+from jax.sharding import PartitionSpec as P
+
+from mpitree_tpu.parallel import partition
+
+
+def adhoc_literal_spec():
+    # engine code constructing its own placement instead of deriving it
+    # through the table
+    return P("d", None)  # expect: GL09
+
+
+def typo_falls_to_catchall(mesh):
+    # "x_binnedd" matches only the catch-all replicate rule — a silent
+    # full-copy where a (data, feature) shard was intended
+    return partition.spec_for("x_binnedd", mesh)  # expect: GL09
+
+
+def unknown_name_in_specs(mesh):
+    # "nod_id" is a typo of "node_id"; "y" conforms and the ("lam", 0)
+    # scalar pair is the sanctioned replicate spelling
+    return partition.in_specs_for(mesh, ("y", "nod_id", ("lam", 0)))  # expect: GL09

@@ -1,0 +1,24 @@
+"""GL09 negative cases: table-derived placements stay silent."""
+
+from mpitree_tpu.parallel import partition
+
+
+def table_derived(mesh):
+    spec = partition.spec_for("x_binned", mesh)
+    ins = partition.in_specs_for(mesh, ("y", "node_id", ("mcw", 0)))
+    outs = partition.out_specs_for(mesh, ("node_id",))
+    return spec, ins, outs
+
+
+def dynamic_names_never_guessed(mesh, names):
+    # non-literal name lists resolve at runtime; graftlint never guesses
+    return partition.in_specs_for(mesh, names)
+
+
+def unrelated_spec_for(metric):
+    # a LOCAL helper that happens to be called spec_for is not the
+    # partition table (the obs/diff.py idiom) — names are not checked
+    def spec_for(m):
+        return {"name": m}
+
+    return spec_for(metric)

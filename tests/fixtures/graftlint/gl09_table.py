@@ -1,0 +1,24 @@
+"""Fixture partition authority: the table GL09 name checks resolve against.
+
+Carries the ``partition-table`` directive, so its own ``P(...)``
+constructions are sanctioned — the fixture mirror of
+``mpitree_tpu/parallel/partition.py``.
+"""
+
+# graftlint: partition-table
+from jax.sharding import PartitionSpec as P
+
+PARTITION_RULES = [
+    (r"^x_binned$", P("d", "f")),
+    (r"^(y|node_id)$", P("d")),
+    (r".*", P()),
+]
+
+
+def spec_for(name, mesh):
+    for pattern, spec in PARTITION_RULES:
+        import re
+
+        if re.match(pattern, name):
+            return spec
+    return P()

@@ -1,0 +1,25 @@
+"""Seeded GL10 violations: project knobs read outside the registry."""
+
+import os
+
+
+def direct_get():
+    return os.environ.get("MPITREE_TPU_DEBUG")  # expect: GL10
+
+
+def getenv_spelling():
+    return os.getenv("MPITREE_TPU_PROFILE", "0")  # expect: GL10
+
+
+def subscript_read():
+    return os.environ["MPITREE_TPU_ENGINE"]  # expect: GL10
+
+
+def foreign_keys_stay_legal():
+    # non-project env vars are out of GL10's jurisdiction
+    return os.environ.get("COORDINATOR_ADDRESS")
+
+
+def dynamic_keys_never_guessed(name):
+    # a computed key is resolved at runtime; graftlint never guesses
+    return os.environ.get(name)

@@ -1,0 +1,20 @@
+"""GL10 negative cases: the sanctioned registry read path.
+
+Carries the ``knob-registry`` directive — environ reads here ARE the
+single read path, and its registered knob is documented in the real
+README knob table.
+"""
+
+# graftlint: knob-registry
+import os
+
+from mpitree_tpu.config.knobs import Knob
+
+KNOBS = (
+    Knob("MPITREE_TPU_PROFILE", "bool", False,
+         "fixture mirror of a documented knob"),
+)
+
+
+def registry_reads_are_sanctioned():
+    return os.environ.get("MPITREE_TPU_PROFILE")

@@ -1,0 +1,9 @@
+"""Fixture registry with a knob the README never documents (doc drift)."""
+
+# graftlint: knob-registry
+from mpitree_tpu.config.knobs import Knob
+
+KNOBS = (
+    Knob("MPITREE_TPU_NOT_IN_README_XYZZY", "bool", False,  # expect: GL10
+         "fixture-only knob that must trip the README drift leg"),
+)

@@ -1,0 +1,230 @@
+"""Knob-registry contract tests: typed reads, the single-read-path AST
+pin, and the README drift gate.
+
+The registry (``mpitree_tpu/config/knobs.py``) is the package's ONE
+``os.environ`` read path for ``MPITREE_TPU_*`` knobs. graftlint GL10
+enforces that on every lint run; the AST pin here enforces it
+independently of the linter, so disabling graftlint cannot silently
+reopen scattered ``getenv`` calls. The registry module itself is
+stdlib-only, so everything except the CLI subprocess tests runs without
+jax.
+"""
+
+import ast
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "mpitree_tpu"
+REGISTRY_FILE = PACKAGE / "config" / "knobs.py"
+
+from mpitree_tpu.config import knobs  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# registry hygiene
+
+
+def test_registry_names_are_unique_and_project_prefixed():
+    names = [k.name for k in knobs.KNOBS]
+    assert len(names) == len(set(names))
+    assert all(n.startswith("MPITREE_TPU_") for n in names)
+    assert set(knobs.REGISTRY) == set(names)
+
+
+def test_registry_entries_are_fully_described():
+    for k in knobs.KNOBS:
+        assert k.kind in ("bool", "str", "int", "float", "path"), k.name
+        assert k.doc and "\n" not in k.doc, k.name  # one README row each
+        if k.kind == "bool":
+            assert k.parse is not None, k.name
+        if k.choices is not None:
+            # a str default must be a member of its documented domain
+            if isinstance(k.default, str):
+                assert k.default in k.choices, k.name
+
+
+# ---------------------------------------------------------------------------
+# typed reads
+
+
+def test_value_returns_default_when_unset_or_empty(monkeypatch):
+    monkeypatch.delenv("MPITREE_TPU_RETRIES", raising=False)
+    assert knobs.value("MPITREE_TPU_RETRIES") == 2
+    monkeypatch.setenv("MPITREE_TPU_RETRIES", "")
+    assert knobs.value("MPITREE_TPU_RETRIES") == 2
+
+
+def test_value_parses_by_kind(monkeypatch):
+    monkeypatch.setenv("MPITREE_TPU_RETRIES", "7")
+    assert knobs.value("MPITREE_TPU_RETRIES") == 7
+    monkeypatch.setenv("MPITREE_TPU_BACKOFF_S", "0.25")
+    assert knobs.value("MPITREE_TPU_BACKOFF_S") == 0.25
+    # bool convention: everything but "0" enables…
+    monkeypatch.setenv("MPITREE_TPU_PROFILE", "1")
+    assert knobs.value("MPITREE_TPU_PROFILE") is True
+    monkeypatch.setenv("MPITREE_TPU_PROFILE", "0")
+    assert knobs.value("MPITREE_TPU_PROFILE") is False
+    monkeypatch.setenv("MPITREE_TPU_PROFILE", "yes")
+    assert knobs.value("MPITREE_TPU_PROFILE") is True
+    # …except strict opt-ins, where only the literal "1" does
+    monkeypatch.setenv("MPITREE_TPU_MEM_SAMPLE", "yes")
+    assert knobs.value("MPITREE_TPU_MEM_SAMPLE") is False
+    monkeypatch.setenv("MPITREE_TPU_MEM_SAMPLE", "1")
+    assert knobs.value("MPITREE_TPU_MEM_SAMPLE") is True
+
+
+def test_raw_passes_the_string_through(monkeypatch):
+    monkeypatch.setenv("MPITREE_TPU_WIDE_HIST", "1")
+    assert knobs.raw("MPITREE_TPU_WIDE_HIST") == "1"
+    monkeypatch.delenv("MPITREE_TPU_CHAOS", raising=False)
+    assert knobs.raw("MPITREE_TPU_CHAOS") is None
+
+
+def test_unregistered_knob_is_a_loud_keyerror():
+    with pytest.raises(KeyError, match="unregistered env knob"):
+        knobs.value("MPITREE_TPU_NO_SUCH_KNOB")
+    with pytest.raises(KeyError, match="knobs.py"):
+        knobs.raw("MPITREE_TPU_NO_SUCH_KNOB")
+
+
+# ---------------------------------------------------------------------------
+# the single-read-path AST pin
+
+_ENV_CALL_HEADS = {
+    "os.environ.get", "os.getenv", "os.environ.pop",
+    "os.environ.setdefault", "environ.get", "getenv", "environ.pop",
+    "environ.setdefault",
+}
+_ENV_SUBSCRIPT_HEADS = {"os.environ", "environ"}
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _project_env_reads(tree):
+    """Yield nodes reading a literal MPITREE_TPU_* key from os.environ."""
+    for node in ast.walk(tree):
+        key = None
+        if isinstance(node, ast.Call):
+            if _dotted(node.func) in _ENV_CALL_HEADS and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(
+                    arg.value, str
+                ):
+                    key = arg.value
+        elif isinstance(node, ast.Subscript):
+            if _dotted(node.value) in _ENV_SUBSCRIPT_HEADS:
+                sl = node.slice
+                if isinstance(sl, ast.Constant) and isinstance(
+                    sl.value, str
+                ):
+                    key = sl.value
+        if key is not None and key.startswith("MPITREE_TPU_"):
+            yield node, key
+
+
+def test_environ_reads_live_only_in_the_registry():
+    """The contract GL10 lints for, pinned independently of the linter:
+    every literal MPITREE_TPU_* environ read in the package lives in
+    config/knobs.py."""
+    offenders = []
+    for path in sorted(PACKAGE.rglob("*.py")):
+        if path == REGISTRY_FILE:
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node, key in _project_env_reads(tree):
+            offenders.append(
+                f"{path.relative_to(REPO)}:{node.lineno}: {key}"
+            )
+    assert offenders == [], (
+        "MPITREE_TPU_* environ reads outside mpitree_tpu/config/knobs.py "
+        "(route them through knobs.value()/knobs.raw()):\n"
+        + "\n".join(offenders)
+    )
+
+
+def test_registry_file_actually_reads_environ():
+    """Sanity for the pin above: the scanner recognizes the read idiom the
+    registry itself uses, so an all-clean sweep means 'centralized', not
+    'scanner blind'."""
+    tree = ast.parse(REGISTRY_FILE.read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _dotted(node.func) in (
+            "os.environ.get", "environ.get"
+        ):
+            return
+    raise AssertionError(
+        "knobs.py no longer reads os.environ via .get — update the "
+        "AST pin's recognized idioms alongside it"
+    )
+
+
+# ---------------------------------------------------------------------------
+# the README drift gate (CI contract)
+#
+# main() is exercised in-process (a `python -m mpitree_tpu.config`
+# subprocess imports the whole package — seconds each); one subprocess
+# smoke below pins the real CLI entry point CI invokes.
+
+from mpitree_tpu.config.__main__ import main as config_main  # noqa: E402
+
+
+def test_checked_in_readme_table_matches_registry(capsys):
+    assert config_main(["--check"]) == 0
+    assert "matches" in capsys.readouterr().err
+
+
+def test_markdown_output_is_the_generated_table(capsys):
+    assert config_main(["--markdown"]) == 0
+    out = capsys.readouterr().out
+    assert out == knobs.markdown_table()
+    for k in knobs.KNOBS:
+        assert f"`{k.name}`" in out
+
+
+def test_check_fails_on_drift_and_write_repairs_it(tmp_path, capsys):
+    doc = tmp_path / "README.md"
+    doc.write_text(
+        "# doc\n\n<!-- knob-table:begin -->\n| stale |\n"
+        "<!-- knob-table:end -->\ntail prose survives\n"
+    )
+    assert config_main(["--check", str(doc)]) == 1
+    assert "drifted" in capsys.readouterr().err
+
+    assert config_main(["--write", str(doc)]) == 0
+    text = doc.read_text()
+    assert knobs.markdown_table().strip() in text
+    assert "tail prose survives" in text
+    assert "| stale |" not in text
+
+    assert config_main(["--check", str(doc)]) == 0
+
+
+def test_missing_markers_are_a_loud_failure(tmp_path, capsys):
+    doc = tmp_path / "README.md"
+    doc.write_text("# no markers here\n")
+    assert config_main(["--check", str(doc)]) == 1
+    assert "markers" in capsys.readouterr().err
+
+
+def test_cli_entry_point_smoke():
+    """The exact invocation CI runs, as a real subprocess."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpitree_tpu.config", "--check"],
+        cwd=REPO, capture_output=True, text=True,
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": str(REPO), "HOME": "/tmp"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

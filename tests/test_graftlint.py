@@ -64,7 +64,7 @@ def test_each_rule_family_has_fixture_coverage():
     findings, _ = _lint_fixtures()
     fired = {f.rule for f in findings}
     assert {"GL00", "GL01", "GL02", "GL03", "GL04", "GL05",
-            "GL06", "GL07", "GL08"} <= fired
+            "GL06", "GL07", "GL08", "GL09", "GL10"} <= fired
 
 
 def test_clean_fixture_is_silent():
@@ -282,6 +282,45 @@ def test_select_gl00_alone_is_a_usage_error():
     )
     assert combined.returncode == 1
     assert "GL00" in combined.stdout
+
+
+def test_checked_in_baseline_is_empty():
+    """The live package baselines NOTHING: landing a finding means fixing
+    it or suppressing it with a rationale, never parking it in the
+    baseline. This pins the snapshot itself, so a sneaky
+    ``make lint-baseline`` with real findings fails review twice."""
+    data = json.loads(
+        (REPO / "tools" / "graftlint" / "baseline.json").read_text()
+    )
+    assert data["findings"] == []
+
+
+def test_explain_prints_rule_rationale():
+    """``--explain GLnn`` prints the rule's full docstring (multi-line,
+    more than the --list-rules one-liner) and exits 0; unknown ids are
+    usage errors."""
+    from tools.graftlint.rules import RULE_DOCS, RULE_EXPLAIN
+
+    assert sorted(RULE_EXPLAIN) == sorted(RULE_DOCS)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--explain", "GL09"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert proc.returncode == 0
+    assert "partition" in proc.stdout.lower()
+    assert len(proc.stdout.strip().splitlines()) > 3
+    # case-insensitive convenience, same text
+    lower = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--explain", "gl09"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert lower.stdout == proc.stdout
+    unknown = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--explain", "GL99"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert unknown.returncode == 2
+    assert "GL99" in unknown.stderr
 
 
 def test_live_package_has_no_dead_suppressions():

@@ -87,6 +87,23 @@ def test_comprehension_variable_traced_from_iterable():
     assert {"p", "parts"} <= p.dataflow.traced(fn)
 
 
+def test_call_arguments_taint_non_device_helper_params():
+    p = _project()
+    sink = _fn(p, "host_sink")
+    assert not sink.is_device  # nothing jit-reaches it — no param seeds
+    traced = p.dataflow.traced(sink)
+    # the per-argument edge: slot 0 carries the caller's jnp result in
+    assert "arr" in traced and "doubled" in traced
+    # a defaulted (heuristically static) param rejects taint even though
+    # the call site fills its slot with a value
+    assert "n_slots" not in traced
+    # and the taint flows back OUT through the return edge
+    assert p.dataflow.returns_traced(sink)
+    driver = _fn(p, "host_driver")
+    assert "out" in p.dataflow.traced(driver)
+    assert "size" not in p.dataflow.traced(driver)  # len() launders
+
+
 def test_fixture_is_finding_free():
     from tools.graftlint.engine import run_lint
 

@@ -17,6 +17,9 @@ checked-in snapshot (default ``tools/graftlint/baseline.json``) and fails
 only on NEW findings — a strict rule family can land while pre-existing
 annotated sites are burned down. ``--write-baseline [FILE]`` regenerates
 the snapshot from the current findings (``make lint-baseline``).
+
+``--explain GLnn`` prints a rule's full rationale and fix guidance (the
+rule module's docstring) without linting anything.
 """
 
 from __future__ import annotations
@@ -65,7 +68,9 @@ def main(argv: list | None = None) -> int:
             "JAX-aware static analysis for mpitree_tpu: host-sync (GL01), "
             "recompile (GL02), collective (GL03), dtype/tiling (GL04), "
             "donation (GL05/GL08), host-callback (GL06) and Pallas (GL07) "
-            "invariants, plus the GL00 unused-suppression audit."
+            "invariants, project contracts — partition-spec conformance "
+            "(GL09) and the env-knob registry (GL10) — plus the GL00 "
+            "unused-suppression audit."
         ),
     )
     parser.add_argument(
@@ -95,6 +100,10 @@ def main(argv: list | None = None) -> int:
         "--list-rules", action="store_true",
         help="print rule ids and one-line docs, then exit",
     )
+    parser.add_argument(
+        "--explain", metavar="RULE",
+        help="print a rule's full rationale and fix guidance, then exit",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -102,6 +111,21 @@ def main(argv: list | None = None) -> int:
 
         for rid, doc in sorted(RULE_DOCS.items()):
             print(f"{rid}  {doc}")
+        return 0
+
+    if args.explain:
+        from tools.graftlint.rules import RULE_EXPLAIN
+
+        rid = args.explain.strip().upper()
+        text = RULE_EXPLAIN.get(rid)
+        if text is None:
+            print(
+                f"graftlint: unknown rule id: {rid} "
+                f"(known: {', '.join(sorted(RULE_EXPLAIN))})",
+                file=sys.stderr,
+            )
+            return 2
+        print(text)
         return 0
 
     rules = None

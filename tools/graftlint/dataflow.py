@@ -36,9 +36,14 @@ Flow-insensitive on purpose: statement order and branch structure are
 ignored, so a name is traced if ANY binding in the function taints it.
 That over-approximates per-path truth in the one direction rules can
 tolerate — a spurious traced mark surfaces as a finding a human reviews,
-never as a silently skipped check. The known miss: tracedness entering a
-function through *call arguments* of non-device helpers is not modeled
-(device helpers already seed all non-static params).
+never as a silently skipped check.
+
+Call arguments propagate per POSITION: a call of a project function with
+a traced value in argument slot ``i`` (or keyword ``k=``) taints the
+callee's matching parameter — the edge that lets tracedness enter
+non-device helpers the way it enters device functions through their
+seeded params. ``*args``/``**kwargs`` at either end conservatively taint
+nothing (a starred call site cannot be matched to slots statically).
 """
 
 from __future__ import annotations
@@ -105,6 +110,7 @@ class Dataflow:
                 self._free[id(fn)] = astutil.free_names(fn.node)
         for fn in self._fns:
             self._work[id(fn)] = self._body_facts(fn)
+        self._calls = self._collect_calls()
         self._seed_control_flow_params()
         self._run()
 
@@ -180,11 +186,69 @@ class Dataflow:
                     if target is not None:
                         self._sets[id(target)].update(target.params)
 
+    def _collect_calls(self) -> dict:
+        """id(caller FuncInfo) -> [(call, callee FuncInfo, eligible), ...]
+        for every call of a resolvable project function — the per-argument
+        tracedness edges ``_pass_args`` replays each pass. ``eligible`` is
+        the callee's ``traced_params()``, precomputed once."""
+        out: dict = {id(fn): [] for fn in self._fns}
+        for mod in self.project.modules:
+            for scope, call in self.project._walk_calls(mod):
+                if id(scope) not in out:
+                    continue
+                target = self.project.resolve_function(mod, scope, call.func)
+                if target is not None:
+                    out[id(scope)].append(
+                        (call, target, target.traced_params())
+                    )
+        return out
+
+    def _pass_args(self, fn) -> bool:
+        """Taint callee params from this function's traced call arguments.
+
+        Positional args map to ``target.params`` by slot; keywords map by
+        name. Starred args / ``**kwargs`` are skipped — no static slot.
+        Mutates CALLEE sets, so the fixpoint driver treats any growth here
+        as a change like its own-set growth.
+
+        Only parameters the callee's OWN seed policy deems traced-eligible
+        (``traced_params()``: known statics excluded, else the name/default
+        heuristics) accept taint. Flow-insensitive caller sets
+        over-approximate — a ``lax.switch`` tier index, a tuple-unpacked
+        config string — and an unfiltered edge would push that noise into
+        slots the callee declares static by convention (``n_slots``-style
+        names, defaulted flags), surfacing as spurious GL02s on config
+        branches. The filter keeps the edge exactly as strong as device-fn
+        seeding: it adds the interprocedural hop, not a new taint policy.
+        """
+        mod = fn.module
+        traced = self._sets[id(fn)]
+        changed = False
+        for call, target, eligible in self._calls.get(id(fn), ()):
+            params = target.params
+            tset = self._sets[id(target)]
+            for i, arg in enumerate(call.args):
+                if isinstance(arg, ast.Starred) or i >= len(params):
+                    break
+                if (params[i] in eligible and params[i] not in tset
+                        and self.expr_traced(mod, fn, arg, traced)):
+                    tset.add(params[i])
+                    changed = True
+            for kw in call.keywords:
+                if (kw.arg is not None and kw.arg in eligible
+                        and kw.arg not in tset
+                        and self.expr_traced(mod, fn, kw.value, traced)):
+                    tset.add(kw.arg)
+                    changed = True
+        return changed
+
     def _run(self) -> None:
         for _ in range(_MAX_PASSES):
             changed = False
             for fn in self._fns:
                 if self._pass_one(fn):
+                    changed = True
+                if self._pass_args(fn):
                     changed = True
             if not changed:
                 return

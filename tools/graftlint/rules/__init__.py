@@ -15,14 +15,32 @@ from tools.graftlint.rules import (
     gl06_callbacks,
     gl07_pallas,
     gl08_donation_use,
+    gl09_partition,
+    gl10_env_knobs,
 )
 
 ALL_RULES = (gl01_host_sync, gl02_recompile, gl03_collectives, gl04_dtype,
-             gl05_donation, gl06_callbacks, gl07_pallas, gl08_donation_use)
+             gl05_donation, gl06_callbacks, gl07_pallas, gl08_donation_use,
+             gl09_partition, gl10_env_knobs)
 
 RULE_DOCS = {
     r.rule_id: (r.__doc__ or "").strip().splitlines()[0] for r in ALL_RULES
 }
 RULE_DOCS["GL00"] = (
     "GL00 — unused suppression: a disable directive that silences nothing."
+)
+
+# full module docstrings double as the ``--explain GLnn`` text
+RULE_EXPLAIN = {r.rule_id: (r.__doc__ or "").strip() for r in ALL_RULES}
+RULE_EXPLAIN["GL00"] = (
+    "GL00 — unused suppression: a disable directive that silences "
+    "nothing.\n\n"
+    "Every ``# graftlint: disable=RULE`` directive must pay rent: if no\n"
+    "finding of that rule would have fired on the directive's line, the\n"
+    "directive itself becomes a GL00 finding. This keeps suppressions\n"
+    "from outliving the code they excused — delete the stale directive\n"
+    "or re-justify it. GL00 lives in the engine (it needs the\n"
+    "suppression-hit accounting that only exists after resolution), so\n"
+    "``--select GL00`` alone is rejected: it audits the suppressions of\n"
+    "rules that actually ran."
 )

@@ -3,9 +3,15 @@
 The Mosaic failure modes this rule front-runs all share one property: they
 surface only at *hardware compile time* (or worse, as silent padding), so
 CPU CI never sees them. ``ops/pallas_hist.py`` and ``ops/wide_hist.py`` are
-the live targets; their dims are mostly symbolic (row_tile, S*C), which
-this rule skips — every check below fires only on facts it can prove from
-literals, the same conservative stance as the rest of graftlint.
+the live targets; their dims are mostly symbolic (row_tile, S*C). Symbolic
+dims resolve through :mod:`tools.graftlint.symdim` — interval/divisibility
+facts recovered from single-assignment bindings, ``*round_up`` calls, and
+``if <cmp>: raise`` guards — and every check fires only on conclusions the
+facts *entail* (a lower-bound working set already over budget, an
+upper-bound coverage already short). A dim with no provable fact stays
+silent, the same conservative stance as the rest of graftlint; a scope
+that already runtime-gates itself with ``if not fits_vmem(...): raise``
+suppresses the static VMEM bound (the runtime check subsumes it).
 
 1. **Dtype-aware sublane tiling.** GL04 checks the dtype-agnostic f32
    floor — last dim % 128, second-to-last % 8. But packed dtypes tile
@@ -34,7 +40,7 @@ from __future__ import annotations
 import ast
 import math
 
-from tools.graftlint import astutil
+from tools.graftlint import astutil, symdim
 from tools.graftlint.engine import PALLAS_CALL, Finding
 
 rule_id = "GL07"
@@ -140,22 +146,35 @@ def _index_map(spec_call):
 
 
 def _shape_dtype(mod, scope, node):
-    """(literal dims list, dtype info) from jax.ShapeDtypeStruct(...)."""
+    """(shape node, literal dims, dtype info) from jax.ShapeDtypeStruct."""
     if not isinstance(node, ast.Call):
-        return None, None
+        return None, None, None
     name = mod.canonical(node.func)
     if name is None or name.rsplit(".", 1)[-1] != "ShapeDtypeStruct":
-        return None, None
+        return None, None, None
     shape = node.args[0] if node.args else astutil.keyword_arg(node, "shape")
     dtype = (node.args[1] if len(node.args) > 1
              else astutil.keyword_arg(node, "dtype"))
     dims = None
-    if isinstance(shape, (ast.Tuple, ast.List)):
+    if not isinstance(shape, (ast.Tuple, ast.List)):
+        shape = None
+    else:
         dims = []
         for el in shape.elts:
             v = astutil.int_tuple(el)
             dims.append(v[0] if v is not None and len(v) == 1 else None)
-    return dims, (_dtype_info(mod, dtype) if dtype is not None else None)
+    return shape, dims, (
+        _dtype_info(mod, dtype) if dtype is not None else None
+    )
+
+
+def _dim_facts(mod, shape, dims, facts):
+    """Per-dim Facts: literal dims exact, symbolic dims evaluated."""
+    return [
+        symdim.exact(lit) if lit is not None
+        else symdim.eval_expr(mod, el, facts)
+        for el, lit in zip(shape.elts, dims)
+    ]
 
 
 def check(project):
@@ -167,7 +186,7 @@ def check(project):
 
 
 def _gather(mod, scope, call):
-    """(grid dims, in_spec calls, out_spec calls, out dims, out dtype)."""
+    """(grid, in_spec calls, out_spec calls, out shape/dims/dtype)."""
     grid_node = astutil.keyword_arg(call, "grid")
     in_specs = astutil.keyword_arg(call, "in_specs")
     out_specs = astutil.keyword_arg(call, "out_specs")
@@ -181,21 +200,28 @@ def _gather(mod, scope, call):
             out_specs = out_specs or astutil.keyword_arg(gs, "out_specs")
     grid = astutil.int_tuple(grid_node) if grid_node is not None else None
     out_shape = astutil.keyword_arg(call, "out_shape")
-    out_dims, out_dt = _shape_dtype(mod, scope, out_shape)
-    return grid, _spec_list(in_specs), _spec_list(out_specs), out_dims, out_dt
+    out_node, out_dims, out_dt = _shape_dtype(mod, scope, out_shape)
+    return (grid, _spec_list(in_specs), _spec_list(out_specs),
+            out_node, out_dims, out_dt)
 
 
 def _check_site(project, mod, scope, call):
-    grid, in_specs, out_specs, out_dims, out_dt = _gather(mod, scope, call)
+    grid, in_specs, out_specs, out_node, out_dims, out_dt = _gather(
+        mod, scope, call
+    )
+    facts = symdim.scope_facts(mod, scope) if scope is not None else {}
 
-    # 1. dtype-aware sublane tiling on out specs (dtype provable there)
+    # 1. dtype-aware sublane tiling on out specs (dtype provable there).
+    # Symbolic dims participate only with an exact fact — divisibility
+    # alone cannot prove a violation (a multiple of 8 may still be a
+    # multiple of 16).
     if out_dt is not None:
         _itemsize, sublane = out_dt
         for spec in out_specs:
-            _shape, dims = _block_dims(spec)
+            shape, dims = _block_dims(spec)
             if not dims or len(dims) < 2:
                 continue
-            v = dims[-2]
+            v = _dim_facts(mod, shape, dims, facts)[-2].exact_value
             if (v is not None and v != 1 and v % 8 == 0 and v % sublane):
                 yield Finding(
                     rule_id, mod.path, spec.lineno, spec.col_offset,
@@ -204,53 +230,68 @@ def _check_site(project, mod, scope, call):
                     "(packed dtypes tile taller than f32's 8)",
                 )
 
-    # 2. grid x block coverage of the out array
-    if grid is not None and out_dims is not None:
+    # 2. grid x block coverage of the out array: flag when the MOST the
+    # grid can cover (upper bound) is short of the LEAST the array can be
+    # (lower bound) — exact facts reduce this to the literal check
+    if grid is not None and out_node is not None:
+        afacts = _dim_facts(mod, out_node, out_dims, facts)
         for spec in out_specs:
-            _shape, dims = _block_dims(spec)
+            shape, dims = _block_dims(spec)
             imap = _index_map(spec)
             if not dims or imap is None or len(dims) != len(imap):
                 continue
-            if len(dims) != len(out_dims):
+            if len(dims) != len(afacts):
                 continue
-            for d, (bdim, entry, adim) in enumerate(
-                zip(dims, imap, out_dims)
+            bfacts = _dim_facts(mod, shape, dims, facts)
+            for d, (bf, entry, af) in enumerate(
+                zip(bfacts, imap, afacts)
             ):
-                if bdim is None or adim is None or entry is None:
+                if bf.hi is None or af.lo is None or entry is None:
                     continue
                 if entry[0] == "grid":
                     j = entry[1]
                     if j >= len(grid):
                         continue
-                    covered = grid[j] * bdim
+                    covered = grid[j] * bf.hi
                 else:
                     # a constant index writes exactly ONE block; anything
                     # at a nonzero offset leaves the prefix uncovered
-                    covered = bdim if entry[1] == 0 else 0
-                if covered < adim:
+                    covered = bf.hi if entry[1] == 0 else 0
+                if covered < af.lo:
+                    how = ("only" if bf.exact_value is not None
+                           and af.exact_value is not None else "at most")
                     yield Finding(
                         rule_id, mod.path, spec.lineno, spec.col_offset,
-                        f"grid x block covers only {covered} of {adim} "
-                        f"along out dim {d} — the uncovered tail comes "
-                        "back uninitialized",
+                        f"grid x block covers {how} {covered} of "
+                        f"{af.lo} along out dim {d} — the uncovered "
+                        "tail comes back uninitialized",
                     )
 
-    # 3. static VMEM estimate when every block dim is literal
+    # 3. static VMEM budget: sum each block's LOWER-bound size (symbolic
+    # dims contribute their provable lo, or 1); if even that floor blows
+    # the budget the site cannot fit on hardware. A fits_vmem raise-guard
+    # in scope means the site runtime-gates itself — stay quiet.
     specs = [(s, False) for s in in_specs] + [(s, True) for s in out_specs]
-    if not specs:
+    if not specs or symdim.has_vmem_guard(mod, scope):
         return
     total = 0
+    all_exact = True
     for spec, is_out in specs:
-        _shape, dims = _block_dims(spec)
-        if not dims or any(d is None for d in dims):
-            return  # a symbolic dim: no honest estimate possible
+        shape, dims = _block_dims(spec)
+        if not dims:
+            return  # no literal block tuple: rank unknown, no estimate
+        fs = _dim_facts(mod, shape, dims, facts)
+        cells = math.prod(max(f.lo or 1, 1) for f in fs)
+        all_exact = all_exact and all(
+            f.exact_value is not None for f in fs
+        )
         itemsize = (out_dt[0] if is_out and out_dt is not None else 4)
-        nbytes = math.prod(dims) * itemsize
-        total += nbytes * (2 if is_out else 1)  # out double-buffers
+        total += cells * itemsize * (2 if is_out else 1)  # out dbl-buffers
     if total > VMEM_BUDGET_BYTES:
+        kind = "estimate" if all_exact else "lower bound"
         yield Finding(
             rule_id, mod.path, call.lineno, call.col_offset,
-            f"static VMEM estimate {total >> 20} MiB exceeds the "
+            f"static VMEM {kind} {total >> 20} MiB exceeds the "
             f"{VMEM_BUDGET_BYTES >> 20} MiB per-step budget — Mosaic "
             "will fail allocation on hardware (shrink blocks or grid "
             "the dominant axis)",

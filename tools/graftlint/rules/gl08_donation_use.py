@@ -23,8 +23,13 @@ Donating callables are recognized three ways:
 A call site is clean when the donated argument is a fresh expression, is
 rebound by the call's own assignment (``nid = step(nid, ...)`` — the level
 loop's canonical shape), or is re-Stored before any later Load. Analysis
-is per-caller and line-ordered (flow-insensitive, like the dataflow core):
-a Load after the call in ANY syntactic path fires. Calls inside a loop
+is PATH-SENSITIVE at the statement level: a forward scan walks from the
+call site outward through its enclosing blocks, and every ``if`` forks the
+{donated, rebound} state per branch — a read on the branch that kept the
+dead buffer fires on that branch, while a read behind a rebind (or on a
+sibling path that never made the call) stays silent. Branches ending in
+``return``/``raise`` terminate their path and do not pollute the join; a
+join stays *donated* if any surviving path is. Calls inside a loop
 additionally require the donated name to be Stored somewhere in that loop
 body — otherwise iteration 2 re-donates a buffer iteration 1 already
 consumed.
@@ -190,13 +195,140 @@ class _Caller:
         return False
 
 
-def _name_uses(root, var, skip_subtree):
-    """(pos, node, is_store) for ``var`` Names outside ``skip_subtree``."""
-    skip_ids = {id(n) for n in ast.walk(skip_subtree)}
-    for n in ast.walk(root):
-        if id(n) in skip_ids or not isinstance(n, ast.Name) or n.id != var:
-            continue
-        yield (n.lineno, n.col_offset), n, isinstance(n.ctx, ast.Store)
+class _PathScan:
+    """Forward scan of ONE donated name from its call site, per path.
+
+    Two states per path: DONATED (the name still aliases the released
+    buffer) and REBOUND (a Store gave it a fresh value). ``if`` statements
+    recurse per branch with a copy of the state; a branch that terminates
+    (``return``/``raise``) drops out of the join, and the join is DONATED
+    iff any surviving branch is. The first garbage read lands in
+    ``finding_at`` and ends the scan — one finding per (call, name), like
+    the rest of graftlint.
+    """
+
+    DONATED, REBOUND = 0, 1
+
+    def __init__(self, caller, var, call):
+        self.caller = caller
+        self.var = var
+        self.skip = {id(n) for n in ast.walk(call)}
+        self.finding_at = None  # (line, col) of the first garbage read
+
+    def events(self, node):
+        """``var`` Name nodes under ``node``, outside the call subtree."""
+        if node is None:
+            return []
+        return [
+            n for n in ast.walk(node)
+            if isinstance(n, ast.Name) and n.id == self.var
+            and id(n) not in self.skip
+        ]
+
+    def feed(self, nodes, state):
+        """Apply Name events in source order to one path's state."""
+        for n in sorted(nodes, key=lambda n: (n.lineno, n.col_offset)):
+            if self.finding_at is not None:
+                return state
+            if isinstance(n.ctx, ast.Load):
+                if state == self.DONATED and not \
+                        self.caller.is_metadata_read(n):
+                    self.finding_at = (n.lineno, n.col_offset)
+            else:  # Store (fresh binding) or Del (name gone either way)
+                state = self.REBOUND
+        return state
+
+    def scan_block(self, stmts, state):
+        """(state, terminated) after running a statement list."""
+        for stmt in stmts:
+            state, term = self.scan_stmt(stmt, state)
+            if term:
+                return state, True
+            if self.finding_at is not None or state == self.REBOUND:
+                return state, False  # nothing later can change the verdict
+        return state, False
+
+    def scan_stmt(self, stmt, state):
+        if isinstance(stmt, (ast.Return, ast.Raise)):
+            self.feed(self.events(stmt), state)
+            return state, True
+        if isinstance(stmt, ast.If):
+            state = self.feed(self.events(stmt.test), state)
+            s1, t1 = self.scan_block(stmt.body, state)
+            s2, t2 = self.scan_block(stmt.orelse, state)
+            if t1 and t2:
+                return state, True
+            if t1:
+                return s2, False
+            if t2:
+                return s1, False
+            joined = (self.DONATED if self.DONATED in (s1, s2)
+                      else self.REBOUND)
+            return joined, False
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            if isinstance(stmt, ast.While):
+                state = self.feed(self.events(stmt.test), state)
+                entry = state
+            else:
+                state = self.feed(self.events(stmt.iter), state)
+                entry = state
+                state = self.feed(self.events(stmt.target), state)
+            body_state, _term = self.scan_block(
+                stmt.body + stmt.orelse, state
+            )
+            # the zero-iteration path keeps the entry state: a rebind
+            # inside the body does not sanitize the fall-through
+            joined = (self.DONATED if self.DONATED in (entry, body_state)
+                      else self.REBOUND)
+            return joined, False
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                state = self.feed(self.events(item.context_expr), state)
+                state = self.feed(self.events(item.optional_vars), state)
+            return self.scan_block(stmt.body, state)
+        if isinstance(stmt, ast.Assign):
+            state = self.feed(self.events(stmt.value), state)
+            tgt = [n for t in stmt.targets for n in self.events(t)]
+            return self.feed(tgt, state), False
+        if isinstance(stmt, ast.AugAssign):
+            # read-modify-write: the READ hits the dead buffer first
+            state = self.feed(self.events(stmt.value), state)
+            return self.feed(self.events(stmt.target), state), False
+        if isinstance(stmt, ast.AnnAssign):
+            state = self.feed(self.events(stmt.value), state)
+            return self.feed(self.events(stmt.target), state), False
+        # Expr / Assert / Try / nested defs / Delete / ...: positional
+        # feed of every contained event (conservative, like the old rule)
+        return self.feed(self.events(stmt), state), False
+
+
+def _blocks_up(caller, fn, call):
+    """(following statements) per enclosing block, innermost first.
+
+    Walks from the statement containing ``call`` up to the function body,
+    yielding at each level the statements that execute AFTER the current
+    one in its block — the path the donated value actually flows along.
+    """
+    node = call
+    while not isinstance(node, ast.stmt):
+        node = caller.parent[id(node)]
+    stmt = node
+    first = True
+    while stmt is not fn.node:
+        parent = caller.parent.get(id(stmt))
+        if parent is None:
+            break
+        for _field, val in ast.iter_fields(parent):
+            if isinstance(val, list) and stmt in val:
+                yield stmt, val[val.index(stmt) + 1:], parent, first
+                first = False
+                break
+        node = parent
+        while not isinstance(node, ast.stmt) and node is not fn.node:
+            node = caller.parent.get(id(node))
+            if node is None:
+                return
+        stmt = node
 
 
 def check(project):
@@ -273,26 +405,34 @@ def _check_call(mod, fn, caller, call, positions):
         var = arg.id
         if var in rebound:
             continue  # nid = step(nid, ...): the canonical loop shape
+        scan = _PathScan(caller, var, call)
+        state = scan.DONATED
         call_pos = (call.lineno, call.col_offset)
-        uses = sorted(
-            (u for u in _name_uses(fn.node, var, call)
-             if u[0] > call_pos),
-            key=lambda u: u[0],
-        )
-        for pos_, node_, is_store in uses:
-            if is_store:
-                break  # re-Stored before any read: later Loads see the
-                # fresh binding (flow-insensitive approximation)
-            if caller.is_metadata_read(node_):
-                continue  # .shape/.ndim/len() read the aval, not the buffer
+        term = False
+        for stmt, following, _parent, first in _blocks_up(
+            caller, fn, call
+        ):
+            if first:
+                # the call's own statement may read the name after the
+                # call expression (``step(buf) + buf``): positional feed
+                # of the tail, call subtree excluded
+                tail = [
+                    n for n in scan.events(stmt)
+                    if (n.lineno, n.col_offset) > call_pos
+                ]
+                state = scan.feed(tail, state)
+            if (term or scan.finding_at is not None
+                    or state == scan.REBOUND):
+                break
+            state, term = scan.scan_block(following, state)
+        if scan.finding_at is not None:
             yield Finding(
-                rule_id, mod.path, pos_[0], pos_[1],
+                rule_id, mod.path, scan.finding_at[0], scan.finding_at[1],
                 f"'{var}' is read after being donated to "
                 f"'{_callee_label(call)}' at line {call.lineno} — a "
                 "donated buffer aliases memory XLA reuses; on TPU this "
                 "is a silent garbage read",
             )
-            break
         loops = caller.enclosing_loops(call)
         if loops and not _stored_in(loops[0], var):
             yield Finding(

@@ -1,0 +1,124 @@
+"""GL09 — partition-spec conformance: placements come from the one table.
+
+``parallel/partition.py`` is the project's placement authority: a single
+first-match rule table (``PARTITION_RULES``) that every engine resolves
+through ``spec_for`` / ``in_specs_for`` / ``out_specs_for``. An ad-hoc
+``PartitionSpec(...)`` literal in engine code forks that authority — the
+table changes, the literal doesn't, and the divergence ships silently
+(CPU meshes trim every axis away, so tests pass either way). This rule
+holds the package to the contract statically:
+
+1. **Construction siting.** ``jax.sharding.PartitionSpec`` may only be
+   constructed in modules carrying the ``# graftlint: partition-table``
+   directive (the table itself and the axis-generic mesh helpers).
+   Anywhere else, placement must be *derived*, not spelled.
+2. **Name conformance.** A literal name passed to ``spec_for`` /
+   ``in_specs_for`` / ``out_specs_for`` must match a non-catch-all
+   pattern of some module-level ``PARTITION_RULES`` table in the lint
+   set. A name that only the ``.*`` catch-all accepts resolves to
+   replicate — which is exactly how a placement typo (``"x_binnedd"``)
+   ships as a silent full-copy. ``(name, 0)`` scalar pairs are the
+   sanctioned replicate spelling and are skipped; non-literal name lists
+   resolve at runtime and are never guessed.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.graftlint import astutil
+from tools.graftlint.engine import Finding
+
+rule_id = "GL09"
+
+_SPEC_FNS = ("spec_for", "in_specs_for", "out_specs_for")
+
+
+def _is_table_module(mod) -> bool:
+    return any(
+        kind == "partition-table"
+        for kind, _vals in mod.directive_lines.values()
+    )
+
+
+def _table_patterns(project) -> list:
+    """Compiled non-catch-all patterns of every module-level
+    ``PARTITION_RULES`` table in the lint set."""
+    pats = []
+    for mod in project.modules:
+        for stmt in mod.tree.body:
+            if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "PARTITION_RULES"
+                    and isinstance(stmt.value, (ast.List, ast.Tuple))):
+                continue
+            for el in stmt.value.elts:
+                if isinstance(el, (ast.Tuple, ast.List)) and el.elts:
+                    s = astutil.str_const(el.elts[0])
+                    if s is not None and s != ".*":
+                        try:
+                            pats.append(re.compile(s))
+                        except re.error:
+                            continue
+    return pats
+
+
+def _literal_names(call):
+    """(name, node) pairs this table call resolves statically.
+
+    ``spec_for("name", ...)`` checks its first argument;
+    ``in_specs_for(mesh, (...))`` / ``out_specs_for`` check every plain
+    string in a literal name tuple — ``(name, 0)`` pairs force the scalar
+    ``P()`` by contract and are skipped.
+    """
+    short = (astutil.dotted_name(call.func) or "").rsplit(".", 1)[-1]
+    if short == "spec_for":
+        if call.args:
+            s = astutil.str_const(call.args[0])
+            if s is not None:
+                yield s, call.args[0]
+        return
+    names = (call.args[1] if len(call.args) > 1
+             else astutil.keyword_arg(call, "names"))
+    if not isinstance(names, (ast.Tuple, ast.List)):
+        return
+    for el in names.elts:
+        s = astutil.str_const(el)
+        if s is not None:
+            yield s, el
+
+
+def check(project):
+    patterns = _table_patterns(project)
+    for mod in project.modules:
+        table_mod = _is_table_module(mod)
+        for scope, call in project._walk_calls(mod):
+            name = mod.canonical(call.func)
+            if name is None:
+                continue
+            if name.endswith(".PartitionSpec") and not table_mod:
+                yield Finding(
+                    rule_id, mod.path, call.lineno, call.col_offset,
+                    "ad-hoc PartitionSpec(...) outside the partition "
+                    "table — derive the placement via partition.spec_for/"
+                    "in_specs_for/out_specs_for so the rule table stays "
+                    "the one authority",
+                )
+                continue
+            if not patterns:
+                continue  # no table in the lint set: nothing to conform to
+            short = name.rsplit(".", 1)[-1]
+            if short not in _SPEC_FNS or not name.endswith(
+                f"partition.{short}"
+            ):
+                continue
+            for s, node in _literal_names(call):
+                if not any(p.match(s) for p in patterns):
+                    yield Finding(
+                        rule_id, mod.path, node.lineno, node.col_offset,
+                        f"placement name '{s}' matches no PARTITION_RULES "
+                        "pattern — it falls to the catch-all replicate "
+                        "rule, which is how placement typos ship; add a "
+                        "table entry or fix the name",
+                    )

@@ -1,0 +1,108 @@
+"""GL10 — env-knob registry: one typed read path for project knobs.
+
+``mpitree_tpu/config/knobs.py`` is the single ``os.environ`` read path
+for every ``MPITREE_TPU_*`` knob: each entry carries its type, default,
+parse rule, and the doc line the README table is generated from. A direct
+``os.environ.get("MPITREE_TPU_...")`` anywhere else re-opens the drift
+this registry closed — an undocumented knob with ad-hoc parsing and no
+default discipline. Two legs:
+
+1. **Read siting.** Any ``os.environ.get`` / ``os.getenv`` /
+   ``os.environ[...]`` access whose key literal starts with
+   ``MPITREE_TPU_``, in a module not carrying the
+   ``# graftlint: knob-registry`` directive, is a finding. Foreign keys
+   (``COORDINATOR_ADDRESS``, ``JAX_PLATFORMS``) are out of jurisdiction;
+   non-literal keys are never guessed.
+2. **Doc drift.** Inside a registry module, every ``Knob("MPITREE_TPU_*",
+   ...)`` registration must appear in the nearest ``README.md`` (walking
+   up from the module) — the generated knob table is part of the
+   contract, and ``python -m mpitree_tpu.config --write`` regenerates it.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tools.graftlint import astutil
+from tools.graftlint.engine import Finding
+
+rule_id = "GL10"
+
+_PREFIX = "MPITREE_TPU_"
+_ENV_CALLS = (
+    "os.environ.get", "os.getenv", "os.environ.pop",
+    "os.environ.setdefault",
+)
+
+
+def _is_registry_module(mod) -> bool:
+    return any(
+        kind == "knob-registry"
+        for kind, _vals in mod.directive_lines.values()
+    )
+
+
+def _project_key(node) -> str | None:
+    s = astutil.str_const(node)
+    return s if s is not None and s.startswith(_PREFIX) else None
+
+
+def _nearest_readme(path: str) -> Path | None:
+    for parent in Path(path).resolve().parents:
+        cand = parent / "README.md"
+        if cand.is_file():
+            return cand
+    return None
+
+
+def check(project):
+    readme_cache: dict = {}
+    for mod in project.modules:
+        if _is_registry_module(mod):
+            yield from _check_registry(mod, readme_cache)
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                if mod.canonical(node.func) not in _ENV_CALLS:
+                    continue
+                key = _project_key(node.args[0]) if node.args else None
+            elif isinstance(node, ast.Subscript):
+                if astutil.dotted_name(node.value) != "os.environ":
+                    continue
+                key = _project_key(node.slice)
+            else:
+                continue
+            if key is None:
+                continue
+            yield Finding(
+                rule_id, mod.path, node.lineno, node.col_offset,
+                f"direct environ access for '{key}' outside the knob "
+                "registry — read it through mpitree_tpu.config.knobs "
+                "(value()/raw()) so the knob stays typed and documented",
+            )
+
+
+def _check_registry(mod, readme_cache):
+    """Doc-drift leg: registered knobs must appear in the nearest README."""
+    readme = readme_cache.get(mod.path)
+    if readme is None:
+        path = _nearest_readme(mod.path)
+        readme = path.read_text() if path is not None else ""
+        readme_cache[mod.path] = readme
+    if not readme:
+        return
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        fname = (astutil.dotted_name(node.func) or "").rsplit(".", 1)[-1]
+        if fname != "Knob":
+            continue
+        key = _project_key(node.args[0])
+        if key is not None and key not in readme:
+            yield Finding(
+                rule_id, mod.path, node.lineno, node.col_offset,
+                f"registered knob '{key}' is missing from the README "
+                "knob table — regenerate it with "
+                "`python -m mpitree_tpu.config --write`",
+            )

@@ -1,0 +1,330 @@
+"""Symbolic-dimension facts — the interval/divisibility domain GL07 reads.
+
+Pallas call sites in this repo mostly size their blocks from *symbolic*
+dims (``row_tile``, ``S * C``, ``_round_up(n_bins, 128)``), which the
+literal-only checks skipped wholesale. This module recovers what IS
+provable about such dims from three pure-AST sources, so the tiling /
+coverage / VMEM checks can fire on symbolic shapes instead of bailing:
+
+1. **Single-assignment bindings.** A name bound exactly once in a scope
+   takes the fact of its value expression, evaluated over int literals,
+   other facts, ``+ - * //``, ``max``/``min``, and ``*round_up(x, K)``
+   (result ``>= x``, ``<= x + K - 1`` rounded, and a multiple of ``K`` —
+   the one contract every ``_round_up`` helper in ops/ shares). Names
+   bound more than once are unknown — no guessing across branches.
+2. **Guard seeding.** A ``raise``-only ``if`` body whose test compares a
+   name against an int literal proves the complement for all surviving
+   code: ``if row_tile < 2048: raise`` means ``row_tile >= 2048`` below.
+   ``if x % 8: raise`` proves divisibility. Flow-insensitive like the
+   dataflow engine: the guard must dominate in practice, and a raise-only
+   body is exactly the shape that does.
+3. **Lexical chaining.** A free name resolves through enclosing scopes
+   (the kernel-factory closure idiom), outermost facts first.
+
+Every fact field is a PROOF, not an estimate: ``lo``/``hi`` are inclusive
+bounds, ``mult`` a known positive divisor. Checks must only fire on
+conclusions these entail (a lower-bound working set already over budget,
+an upper-bound coverage already short) — unknown stays unknown.
+
+``if not fits_vmem(...): raise`` guards are recognized separately
+(:func:`has_vmem_guard`): a scope that runtime-gates its working set
+already subsumes the static VMEM bound, so GL07 stays quiet there.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import math
+
+from tools.graftlint import astutil
+
+
+@dataclasses.dataclass(frozen=True)
+class Fact:
+    """What is provable about one non-negative integer value."""
+
+    lo: int | None = None   # inclusive lower bound
+    hi: int | None = None   # inclusive upper bound
+    mult: int = 1           # value is a positive multiple of this
+
+    @property
+    def exact_value(self) -> int | None:
+        return self.lo if self.lo is not None and self.lo == self.hi else None
+
+
+UNKNOWN = Fact()
+
+
+def exact(v: int) -> Fact:
+    return Fact(v, v, abs(v) if v else 1)
+
+
+def _gcd(a: int, b: int) -> int:
+    return math.gcd(a, b) or 1
+
+
+def _add(a: Fact, b: Fact) -> Fact:
+    return Fact(
+        a.lo + b.lo if a.lo is not None and b.lo is not None else None,
+        a.hi + b.hi if a.hi is not None and b.hi is not None else None,
+        _gcd(a.mult, b.mult),
+    )
+
+
+def _sub(a: Fact, b: Fact) -> Fact:
+    return Fact(
+        a.lo - b.hi if a.lo is not None and b.hi is not None else None,
+        a.hi - b.lo if a.hi is not None and b.lo is not None else None,
+        _gcd(a.mult, b.mult),
+    )
+
+
+def _mul(a: Fact, b: Fact) -> Fact:
+    # sound only on the non-negative domain dims live in
+    neg = (a.lo is not None and a.lo < 0) or (b.lo is not None and b.lo < 0)
+    if neg:
+        return UNKNOWN
+    return Fact(
+        a.lo * b.lo if a.lo is not None and b.lo is not None else None,
+        a.hi * b.hi if a.hi is not None and b.hi is not None else None,
+        a.mult * b.mult,
+    )
+
+
+def _floordiv(a: Fact, k: int) -> Fact:
+    if k <= 0:
+        return UNKNOWN
+    return Fact(
+        a.lo // k if a.lo is not None else None,
+        a.hi // k if a.hi is not None else None,
+        a.mult // k if a.mult % k == 0 else 1,
+    )
+
+
+def _round_up(x: Fact, k: int) -> Fact:
+    """Fact of ``round_up(x, k)``: >= x, < x + k, multiple of k."""
+    if k <= 0:
+        return UNKNOWN
+    ceil = (lambda v: -(-v // k) * k)
+    return Fact(
+        ceil(x.lo) if x.lo is not None else None,
+        ceil(x.hi) if x.hi is not None else None,
+        k,
+    )
+
+
+def _intersect(a: Fact, b: Fact) -> Fact:
+    """Both facts hold for the same value."""
+    los = [v for v in (a.lo, b.lo) if v is not None]
+    his = [v for v in (a.hi, b.hi) if v is not None]
+    return Fact(
+        max(los) if los else None,
+        min(his) if his else None,
+        a.mult * b.mult // _gcd(a.mult, b.mult),  # lcm
+    )
+
+
+def _is_round_up(mod, func_node) -> bool:
+    name = mod.canonical(func_node)
+    if name is None and isinstance(func_node, ast.Name):
+        name = func_node.id
+    if name is None and isinstance(func_node, ast.Attribute):
+        name = func_node.attr
+    return name is not None and name.rsplit(".", 1)[-1].lstrip("_") in (
+        "round_up", "ceil_to",
+    )
+
+
+def eval_expr(mod, expr: ast.AST, facts: dict) -> Fact:
+    """Fact of ``expr`` under ``facts`` (name -> Fact)."""
+    v = astutil.int_tuple(expr)
+    if v is not None and len(v) == 1:
+        return exact(v[0])
+    if isinstance(expr, ast.Name):
+        return facts.get(expr.id, UNKNOWN)
+    if isinstance(expr, ast.BinOp):
+        left = eval_expr(mod, expr.left, facts)
+        right = eval_expr(mod, expr.right, facts)
+        if isinstance(expr.op, ast.Add):
+            return _add(left, right)
+        if isinstance(expr.op, ast.Sub):
+            return _sub(left, right)
+        if isinstance(expr.op, ast.Mult):
+            return _mul(left, right)
+        if isinstance(expr.op, ast.FloorDiv) and right.exact_value:
+            return _floordiv(left, right.exact_value)
+        return UNKNOWN
+    if isinstance(expr, ast.Call):
+        fname = expr.func.id if isinstance(expr.func, ast.Name) else None
+        if fname in ("max", "min") and expr.args and not expr.keywords:
+            fs = [eval_expr(mod, a, facts) for a in expr.args]
+            mult = fs[0].mult
+            for f in fs[1:]:
+                mult = _gcd(mult, f.mult)
+            if fname == "max":
+                los = [f.lo for f in fs if f.lo is not None]
+                his = [f.hi for f in fs]
+                return Fact(
+                    max(los) if los else None,
+                    max(his) if all(h is not None for h in his) else None,
+                    mult,
+                )
+            his = [f.hi for f in fs if f.hi is not None]
+            los = [f.lo for f in fs]
+            return Fact(
+                min(los) if all(lo is not None for lo in los) else None,
+                min(his) if his else None,
+                mult,
+            )
+        if _is_round_up(mod, expr.func) and len(expr.args) == 2:
+            x = eval_expr(mod, expr.args[0], facts)
+            k = eval_expr(mod, expr.args[1], facts)
+            if k.exact_value:
+                return _round_up(x, k.exact_value)
+            # unknown alignment still preserves the lower bound (>= x)
+            return Fact(x.lo, None, 1)
+    return UNKNOWN
+
+
+def _raise_only(body: list) -> bool:
+    return len(body) == 1 and isinstance(body[0], ast.Raise)
+
+
+def _guard_fact(test: ast.AST):
+    """(name, Fact proved when the raise does NOT fire) or None."""
+    if not isinstance(test, ast.Compare) or len(test.ops) != 1:
+        return None
+    left, op, right = test.left, test.ops[0], test.comparators[0]
+    # name % k [!= 0] -> divisibility (`if x % 8:` and `if x % 8 != 0:`)
+    mod_node = None
+    if (isinstance(left, ast.BinOp) and isinstance(left.op, ast.Mod)
+            and isinstance(op, ast.NotEq)
+            and astutil.int_tuple(right) == (0,)):
+        mod_node = left
+    if mod_node is None:
+        lit = astutil.int_tuple(right)
+        if lit is None or len(lit) != 1:
+            # mirrored literal-on-the-left compare
+            lit = astutil.int_tuple(left)
+            if lit is None or len(lit) != 1 or not isinstance(
+                right, ast.Name
+            ):
+                return None
+            flip = {ast.Lt: ast.Gt, ast.LtE: ast.GtE,
+                    ast.Gt: ast.Lt, ast.GtE: ast.LtE}
+            op_t = flip.get(type(op), type(op))
+            left, lit_v = right, lit[0]
+        else:
+            if not isinstance(left, ast.Name):
+                return None
+            op_t, lit_v = type(op), lit[0]
+        name = left.id
+        # the fact holds on the path where the guard does NOT raise
+        if op_t is ast.Lt:          # if name < C: raise  ->  name >= C
+            return name, Fact(lo=lit_v)
+        if op_t is ast.LtE:         # -> name > C
+            return name, Fact(lo=lit_v + 1)
+        if op_t is ast.Gt:          # -> name <= C
+            return name, Fact(hi=lit_v)
+        if op_t is ast.GtE:         # -> name < C
+            return name, Fact(hi=lit_v - 1)
+        if op_t is ast.NotEq:       # -> name == C
+            return name, exact(lit_v)
+        return None
+    inner = mod_node.left
+    k = astutil.int_tuple(mod_node.right)
+    if isinstance(inner, ast.Name) and k is not None and len(k) == 1:
+        return inner.id, Fact(mult=max(k[0], 1))
+    return None
+
+
+def _bool_guard_fact(test: ast.AST):
+    """``if name % k: raise`` — truthiness form of the divisibility guard."""
+    if (isinstance(test, ast.BinOp) and isinstance(test.op, ast.Mod)
+            and isinstance(test.left, ast.Name)):
+        k = astutil.int_tuple(test.right)
+        if k is not None and len(k) == 1:
+            return test.left.id, Fact(mult=max(k[0], 1))
+    return None
+
+
+def scope_facts(mod, scope) -> dict:
+    """name -> Fact for one function scope, lexical parents included.
+
+    Parents are folded in first so inner bindings shadow; a guard on an
+    already-bound name intersects with its binding fact.
+    """
+    facts: dict = {}
+    if scope is None:
+        return facts
+    if scope.parent is not None:
+        facts.update(scope_facts(mod, scope.parent))
+
+    # single-assignment bindings (two passes: later bindings may reference
+    # earlier ones; a second sweep settles simple chains without a full
+    # fixpoint)
+    counts: dict = {}
+    values: dict = {}
+    for stmt in astutil.own_statements(scope.node):
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            name = stmt.targets[0].id
+            counts[name] = counts.get(name, 0) + 1
+            values[name] = stmt.value
+        elif isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                for name in astutil.target_names(t):
+                    counts[name] = counts.get(name, 0) + 99
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign, ast.For)):
+            for name in astutil.target_names(stmt.target):
+                counts[name] = counts.get(name, 0) + 99
+    single = {n for n, c in counts.items() if c == 1}
+    for name in set(facts) & (set(counts) - single):
+        facts[name] = UNKNOWN  # rebound locally: parent fact is stale
+
+    # guard seeding — BEFORE bindings (a binding like `tile =
+    # round_up(row_tile, 8)` needs row_tile's guard fact), and
+    # re-intersected after (a guard on a bound name refines its binding;
+    # _intersect is idempotent so the double application is safe)
+    guards: dict = {}
+    for stmt in astutil.own_statements(scope.node):
+        if not isinstance(stmt, ast.If) or not _raise_only(stmt.body):
+            continue
+        hit = _guard_fact(stmt.test) or _bool_guard_fact(stmt.test)
+        if hit is not None:
+            name, f = hit
+            guards[name] = _intersect(guards.get(name, UNKNOWN), f)
+    for name, g in guards.items():
+        facts[name] = _intersect(facts.get(name, UNKNOWN), g)
+    for _ in range(2):
+        for name in single:
+            f = eval_expr(mod, values[name], facts)
+            if f != UNKNOWN:
+                facts[name] = f
+    for name, g in guards.items():
+        facts[name] = _intersect(facts.get(name, UNKNOWN), g)
+    return facts
+
+
+def has_vmem_guard(mod, scope) -> bool:
+    """A ``if not *fits_vmem(...): raise`` guard in scope or a lexical
+    parent — the site runtime-gates its working set already."""
+    cur = scope
+    while cur is not None:
+        for stmt in astutil.own_statements(cur.node):
+            if not isinstance(stmt, ast.If) or not _raise_only(stmt.body):
+                continue
+            test = stmt.test
+            if isinstance(test, ast.UnaryOp) and isinstance(
+                test.op, ast.Not
+            ):
+                test = test.operand
+            if isinstance(test, ast.Call):
+                name = mod.canonical(test.func)
+                if name is None and isinstance(test.func, ast.Name):
+                    name = test.func.id
+                if name is not None and "fits_vmem" in name:
+                    return True
+        cur = cur.parent
+    return False
