@@ -7,7 +7,7 @@ PY ?= python
 
 .PHONY: test test-cpu lint lint-graft lint-baseline knob-check bench \
   bench-tpu report trace-smoke mem-smoke flight-smoke chaos-smoke \
-  ingest-smoke bench-diff clean
+  ingest-smoke serve-smoke bench-diff clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -96,6 +96,14 @@ chaos-smoke:
 # planner-derived chunk sizing. Exit-code-validated; CPU-safe, ~a minute.
 ingest-smoke:
 	JAX_PLATFORMS=cpu $(PY) examples/ingest_run.py
+
+# Serving v2 gate (ISSUE 17): publish a quantized (exactness-gated)
+# model -> mixed-QoS burst through the continuous-batching scheduler ->
+# typed shed without starvation -> chaos blip on the dispatch seam
+# requeued + recovered -> merged scheduler/serving metrics asserted.
+# Exit-code-validated; CPU-safe, seconds.
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PY) examples/serving_sched_run.py
 
 # Regression gate over the committed CPU baselines (tools/benchdiff over
 # BENCH_r*.json): newest round vs the previous parseable one, noise

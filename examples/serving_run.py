@@ -1,12 +1,20 @@
-"""Asyncio micro-batching serving loop over the warm model registry.
+"""Continuous-batching serving loop over the warm model registry.
 
-The request-path shape a production front-end would run (ISSUE 7 / ROADMAP
-item 1): clients submit single rows (or small bursts), a micro-batcher
-coalesces everything that arrives within a short window — up to the
-serving bucket size — and ONE traversal dispatch answers the whole batch.
-The registry keeps the model bucket-warmed, so no request ever waits on an
-XLA compile; a background "trainer" republishes a refreshed model mid-run
-to demonstrate the swap-without-recompile contract.
+The request-path shape a production front-end would run (ISSUE 7 /
+ISSUE 17, ROADMAP item 1): clients submit single rows, the serving
+:class:`Scheduler` coalesces everything that arrives within a short
+window — earliest-deadline-first, up to the serving bucket size — and
+ONE traversal dispatch answers the whole batch. Admission control sheds
+overload with typed reasons instead of queueing forever; QoS classes
+give interactive traffic a tighter deadline than bulk scoring. The
+registry keeps the model bucket-warmed, so no request ever waits on an
+XLA compile; a background "trainer" republishes a refreshed model
+mid-run to demonstrate the swap-without-recompile contract.
+
+The scheduler owns the batching loop in its own worker thread; the
+asyncio side here is just the front-end — clients await
+``asyncio.wrap_future`` around the scheduler's concurrent future, and
+the metrics exporter serves the MERGED scheduler + registry exposition.
 
 Run:  python examples/serving_run.py  (CPU-safe, ~seconds)
 """
@@ -14,8 +22,6 @@ Run:  python examples/serving_run.py  (CPU-safe, ~seconds)
 from __future__ import annotations
 
 import asyncio
-import heapq
-import itertools
 import os
 import sys
 import time
@@ -25,11 +31,12 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 MAX_BATCH = 64             # the middle serving bucket
-MAX_WAIT_MS = 2.0          # micro-batch coalescing window
-DEFAULT_DEADLINE_MS = 50.0  # per-request latency budget (batching fairness)
-DISPATCH_MARGIN_MS = 5.0   # window slack reserved for the dispatch itself
 N_CLIENTS = 8
 REQUESTS_PER_CLIENT = 40
+# CPU-scale QoS ladder: interactive requests get the tight budget, batch
+# scoring the loose one. (The knob default targets accelerator latency;
+# an example that must finish on a shared CPU runner picks its own.)
+QOS_SPEC = "interactive:500:256;batch:5000:4096"
 
 
 def fit_models():
@@ -52,115 +59,15 @@ def fit_models():
     return X, gen1, gen2
 
 
-class MicroBatcher:
-    """Coalesce concurrent requests into bucket-sized registry dispatches.
-
-    Batching fairness (ROADMAP item 1 follow-up): the original FIFO
-    coalescer let a large burst occupy every consecutive dispatch, so a
-    single-row request arriving just behind it waited ``burst/MAX_BATCH``
-    full dispatches — starved of its latency budget by other tenants'
-    traffic. Every request now carries a DEADLINE and the batcher serves
-    strictly in earliest-deadline order (a heap, not a FIFO): a
-    tight-deadline request jumps a loose burst's backlog and rides the
-    very next dispatch. The coalescing window also closes early when the
-    head request's deadline (minus a dispatch margin) would otherwise be
-    blown, and ``deadline_misses`` counts requests whose reply landed
-    past their budget — the SLO signal a front-end would alert on.
-    """
-
-    def __init__(self, registry, name: str, *, max_batch: int = MAX_BATCH,
-                 max_wait_ms: float = MAX_WAIT_MS):
-        self.registry = registry
-        self.name = name
-        self.max_batch = max_batch
-        self.max_wait_ms = max_wait_ms
-        self._heap: list = []  # (deadline, seq, row, future)
-        self._seq = itertools.count()
-        self._arrived = asyncio.Event()
-        self.batch_sizes: list[int] = []
-        self.deadline_misses = 0
-
-    async def serve_forever(self):
-        while True:
-            while not self._heap:
-                self._arrived.clear()
-                await self._arrived.wait()
-            # Coalesce up to max_batch, but never hold the HEAD (earliest
-            # deadline) past its budget minus the dispatch margin.
-            window_end = min(
-                time.perf_counter() + self.max_wait_ms / 1e3,
-                self._heap[0][0] - DISPATCH_MARGIN_MS / 1e3,
-            )
-            while len(self._heap) < self.max_batch:
-                timeout = window_end - time.perf_counter()
-                if timeout <= 0:
-                    break
-                self._arrived.clear()
-                try:
-                    await asyncio.wait_for(self._arrived.wait(), timeout)
-                except asyncio.TimeoutError:
-                    break
-            take = min(self.max_batch, len(self._heap))
-            items = [heapq.heappop(self._heap) for _ in range(take)]
-            batch = np.stack([row for _, _, row, _ in items])
-            futures = [f for _, _, _, f in items]
-            self.batch_sizes.append(take)
-            # One bucket-shaped dispatch for the coalesced batch; the
-            # executor keeps the event loop responsive while it runs.
-            # A dispatch failure must land on the waiting futures — an
-            # exception escaping this loop would kill the batcher task
-            # and leave every awaiting client hung forever.
-            try:
-                preds = await asyncio.get_running_loop().run_in_executor(
-                    None, self.registry.predict, self.name, batch
-                )
-            except Exception as exc:
-                for fut in futures:
-                    if not fut.done():
-                        fut.set_exception(exc)
-                continue
-            done_t = time.perf_counter()
-            misses = 0
-            for (deadline, _, _, fut), p in zip(items, preds):
-                if done_t > deadline:
-                    misses += 1
-                if not fut.done():  # a client may have been cancelled
-                    fut.set_result(p)
-            if misses:
-                self.deadline_misses += misses
-                # Promote the SLO signal into obs.metrics (ISSUE 12
-                # satellite / carried ROADMAP obs follow-up): the model's
-                # private registry exposes it under the model label via
-                # registry.metrics_text(), next to the latency histograms
-                # a front-end alerts on.
-                try:
-                    self.registry.get(self.name).note_deadline_miss(misses)
-                except KeyError:
-                    pass  # slot dropped mid-flight; the local count stands
-
-    async def request(self, row, *,
-                      deadline_ms: float = DEFAULT_DEADLINE_MS) -> object:
-        """Submit one row; served within ``deadline_ms`` when capacity
-        allows (earliest-deadline-first — a tighter budget means earlier
-        service relative to looser concurrent traffic)."""
-        fut = asyncio.get_running_loop().create_future()
-        heapq.heappush(
-            self._heap,
-            (time.perf_counter() + deadline_ms / 1e3, next(self._seq),
-             row, fut),
-        )
-        self._arrived.set()
-        return await fut
-
-
-async def start_metrics_exporter(registry, host="127.0.0.1", port=0):
+async def start_metrics_exporter(metrics_text, host="127.0.0.1", port=0):
     """Minimal asyncio Prometheus scrape endpoint (ISSUE 9 metrics half).
 
-    Serves ``ModelRegistry.metrics_text()`` — per-model request counters
-    and log-bucketed latency histograms with ``model=<slot>`` labels — as
-    a plain-text HTTP response on every connection. Zero dependencies;
-    ``port=0`` picks a free port (returned via ``server.sockets``). A
-    production front-end would point its Prometheus scrape job here.
+    Serves ``metrics_text()`` — the scheduler's merged exposition:
+    shed/queue-depth/class-latency series next to every model's request
+    counters and log-bucketed latency histograms — as a plain-text HTTP
+    response on every connection. Zero dependencies; ``port=0`` picks a
+    free port (returned via ``server.sockets``). A production front-end
+    would point its Prometheus scrape job here.
     """
 
     async def handle(reader, writer):
@@ -170,7 +77,7 @@ async def start_metrics_exporter(registry, host="127.0.0.1", port=0):
             # queued response before the scraper reads it.
             while (await reader.readline()) not in (b"\r\n", b"\n", b""):
                 pass
-            body = registry.metrics_text().encode()
+            body = metrics_text().encode()
             writer.write(
                 b"HTTP/1.1 200 OK\r\n"
                 b"Content-Type: text/plain; version=0.0.4\r\n"
@@ -196,26 +103,38 @@ async def scrape_once(host: str, port: int) -> str:
 
 async def main():
     from mpitree_tpu.obs import REGISTRY
-    from mpitree_tpu.serving import ModelRegistry
+    from mpitree_tpu.serving import (
+        ModelRegistry,
+        RejectedRequest,
+        Scheduler,
+    )
 
     X, gen1, gen2 = fit_models()
     registry = ModelRegistry(buckets=(1, MAX_BATCH, 4096))
     print("publishing generation 1 (compiles + bucket warmup)...")
     model1 = registry.publish("clicks", gen1)
-    batcher = MicroBatcher(registry, "clicks")
-    server = asyncio.ensure_future(batcher.serve_forever())
-    exporter = await start_metrics_exporter(registry)
+    sched = Scheduler(registry, qos=QOS_SPEC)
+    exporter = await start_metrics_exporter(sched.metrics_text)
     ex_port = exporter.sockets[0].getsockname()[1]
     print(f"metrics exporter on 127.0.0.1:{ex_port}/metrics")
 
     latencies: list[float] = []
+    shed = 0
 
     async def client(cid: int):
+        nonlocal shed
         rng = np.random.default_rng(cid)
+        qos = "batch" if cid % 4 == 0 else "interactive"
         for _ in range(REQUESTS_PER_CLIENT):
             row = X[int(rng.integers(0, len(X)))]
             t0 = time.perf_counter()
-            await batcher.request(row)
+            try:
+                fut = sched.submit("clicks", row, qos=qos)
+            except RejectedRequest:
+                # Typed shed: a real client would back off / fail over.
+                shed += 1
+                continue
+            await asyncio.wrap_future(fut)
             latencies.append(time.perf_counter() - t0)
             await asyncio.sleep(float(rng.uniform(0, 0.004)))
 
@@ -238,29 +157,29 @@ async def main():
     t0 = time.perf_counter()
     await asyncio.gather(*(client(i) for i in range(N_CLIENTS)), trainer())
     wall = time.perf_counter() - t0
-    server.cancel()
 
     lat_ms = np.sort(np.asarray(latencies)) * 1e3
     n = len(lat_ms)
+    st = sched.stats()
     print(
         f"\n{n} requests in {wall:.2f}s "
         f"({n / wall:.0f} req/s) | "
         f"p50 {lat_ms[n // 2]:.2f}ms  p99 {lat_ms[int(n * 0.99)]:.2f}ms | "
-        f"mean batch {np.mean(batcher.batch_sizes):.1f} rows "
-        f"(max {max(batcher.batch_sizes)}) | "
-        f"{batcher.deadline_misses} past the {DEFAULT_DEADLINE_MS:.0f}ms "
-        "budget"
+        f"{st['dispatches']} dispatches, {shed} shed, "
+        f"{st['deadline_misses']} deadline misses"
     )
+    print("per-class latency:", st["class_latency_ms"])
     print("registry:", registry.models())
 
     # Scrape the exporter once: the Prometheus view of the same traffic —
-    # request counters plus per-bucket log-histogram latency series.
+    # scheduler series merged with per-model request counters.
     text = await scrape_once("127.0.0.1", ex_port)
     served = [
         ln for ln in text.splitlines()
         if ln.startswith(("mpitree_serving_requests_total",
                           "mpitree_serving_request_seconds_count",
-                          "mpitree_serving_deadline_misses_total",
+                          "mpitree_sched_dispatches_total",
+                          "mpitree_sched_shed_total",
                           "mpitree_registry_publish_total"))
     ]
     print("scraped metrics:")
@@ -274,6 +193,7 @@ async def main():
                 f"{gen} bucket {bucket}: p50 {row['p50_ms']}ms "
                 f"p99 {row['p99_ms']}ms ({row['count']} requests)"
             )
+    sched.close()
     exporter.close()
     await exporter.wait_closed()
 

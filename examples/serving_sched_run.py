@@ -1,0 +1,154 @@
+"""Serving v2 smoke — scheduler + quantized tables, exit-code-validated.
+
+The ``make serve-smoke`` CI rung (ISSUE 17): publish a QUANTIZED model
+through the registry, drive a mixed-QoS burst through the
+continuous-batching scheduler, and ASSERT the contract rather than
+print-and-hope —
+
+- the quantize exactness report accepted the tables (and its numbers
+  land in ``serve_report_``);
+- scheduled results match the model's direct ``raw`` outputs;
+- an overload burst SHEDS with typed reasons while every admitted
+  request still resolves (shed-don't-starve);
+- both QoS classes flow after the burst;
+- a chaos blip (transient UNAVAILABLE on the ``sched_dispatch`` seam)
+  is requeued once and recovered;
+- the merged Prometheus exposition carries the scheduler families next
+  to the per-model serving series, one ``# TYPE`` line per family.
+
+Any broken assertion exits non-zero — CI-friendly. CPU-safe, ~seconds.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# CPU-scale QoS ladder (the knob default targets accelerator latency).
+QOS_SPEC = "interactive:500:256;batch:5000:4096"
+BURST = 600
+
+
+def main() -> int:
+    from sklearn.datasets import make_classification
+
+    from mpitree_tpu.models.forest import RandomForestClassifier
+    from mpitree_tpu.resilience import chaos
+    from mpitree_tpu.resilience.chaos import Fault
+    from mpitree_tpu.serving import (
+        ModelRegistry,
+        RejectedRequest,
+        Scheduler,
+    )
+
+    X, y = make_classification(
+        n_samples=400, n_features=12, n_informative=8, random_state=0
+    )
+    X = X.astype(np.float32)
+    rf = RandomForestClassifier(
+        n_estimators=4, max_depth=4, random_state=0
+    ).fit(X, y)
+
+    registry = ModelRegistry()
+    print("publishing quantized model (int8 tables, exactness-gated)...")
+    model = registry.publish("clicks", rf, quantize="int8")
+    qrep = model.serve_report_["quantization"]
+    assert qrep["mode"] == "int8" and qrep["ok"], qrep
+    print(
+        f"  accepted: max calibration delta {qrep['max_abs_delta']:.2e} "
+        f"<= tol {qrep['tolerance']:.0e}, "
+        f"{qrep['rerouted_rows']} rerouted rows"
+    )
+    direct = np.asarray(model.raw(X[:16]))
+
+    with Scheduler(registry, qos=QOS_SPEC) as sched:
+        # Scheduled results == direct dispatch results.
+        futs = [sched.submit("clicks", X[i]) for i in range(16)]
+        got = np.stack([f.result(timeout=30) for f in futs])
+        assert np.allclose(got, direct, atol=1e-6), (
+            np.abs(got - direct).max()
+        )
+        print("scheduled results match direct raw dispatch")
+
+        # Overload burst under a hang fault: admission sheds with typed
+        # reasons, every ADMITTED request still resolves.
+        shed = 0
+        with chaos.active(
+            Fault("sched_dispatch", at=1, kind="hang", arg=0.3)
+        ):
+            futs = []
+            for i in range(BURST):
+                try:
+                    futs.append(
+                        sched.submit(
+                            "clicks", X[i % len(X)], qos="interactive"
+                        )
+                    )
+                except RejectedRequest as e:
+                    assert e.reason in (
+                        "queue_full", "deadline_infeasible"
+                    ), e.reason
+                    shed += 1
+            for f in futs:
+                assert np.asarray(f.result(timeout=30)).shape == (2,)
+        assert shed > 0 and futs, (shed, len(futs))
+        print(
+            f"burst: {len(futs)} admitted+served, {shed} shed "
+            "(typed, no starvation)"
+        )
+
+        # Both QoS classes flow after the burst (the feasibility EWMA
+        # recovers — no permanent lockout from one slow window).
+        for qos in ("interactive", "batch"):
+            fs = [sched.submit("clicks", X[i], qos=qos) for i in range(8)]
+            for f in fs:
+                f.result(timeout=30)
+        print("both QoS classes served after the burst")
+
+        # Chaos blip: transient UNAVAILABLE on dispatch -> requeued
+        # once, request still answered.
+        with chaos.active(Fault("sched_dispatch", at=1, kind="unavailable")):
+            out = np.asarray(
+                sched.submit("clicks", X[0]).result(timeout=30)
+            )
+            assert out.shape == (2,)
+        st = sched.stats()
+        assert st["requeues"] >= 1, st
+        print(f"chaos blip recovered via requeue (requeues={st['requeues']})")
+
+        text = sched.metrics_text()
+        for needle in (
+            "mpitree_sched_shed_total",
+            "mpitree_sched_queue_depth",
+            "mpitree_sched_class_latency_seconds",
+            "mpitree_sched_dispatches_total",
+            "mpitree_serving_request_seconds",
+        ):
+            assert needle in text, needle
+        assert text.count("# TYPE mpitree_sched_shed_total") == 1
+        assert st["shed"].get("queue_full", 0) \
+            + st["shed"].get("deadline_infeasible", 0) == shed, \
+            (st["shed"], shed)
+        print(
+            "metrics: dispatches="
+            f"{st['dispatches']} requeues={st['requeues']} "
+            f"deadline_misses={st['deadline_misses']} shed={st['shed']}"
+        )
+
+    # Closed scheduler refuses with the shutdown reason.
+    try:
+        sched.submit("clicks", X[0])
+        raise AssertionError("expected shutdown reject")
+    except RejectedRequest as e:
+        assert e.reason == "shutdown", e.reason
+    print("closed scheduler sheds with reason='shutdown'")
+    print("serve-smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

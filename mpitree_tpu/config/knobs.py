@@ -100,6 +100,28 @@ KNOBS: tuple = (
          "serving tier: `pallas` forces (degrades gracefully with a typed"
          " event), `xla` disables the kernel",
          choices=("auto", "pallas", "xla")),
+    # -- serving: scheduler + quantization --------------------------------
+    Knob("MPITREE_TPU_SERVING_QUANTIZE", "str", "off",
+         "default table form for `compile_model`/`publish` when the"
+         " caller passes no `quantize=`: `int8` serves bf16-threshold /"
+         " int16-feature / int8-delta-value tables",
+         choices=("off", "int8")),
+    Knob("MPITREE_TPU_SERVING_QUANTIZE_TOL", "float", 1e-2,
+         "max prediction delta vs the f32 tables on the calibration"
+         " batch before quantized compilation REFUSES", parse=float),
+    Knob("MPITREE_TPU_SERVING_QOS", "str",
+         "interactive:50:256;batch:2000:4096",
+         "scheduler QoS classes as `name:deadline_ms:queue_depth;...`"
+         " (first class is the default for unlabeled requests)"),
+    Knob("MPITREE_TPU_SERVING_SHED_DEPTH", "int", 4096,
+         "total in-flight request bound across all scheduler queues;"
+         " admissions past it shed with reason `queue_full`", parse=int),
+    Knob("MPITREE_TPU_SERVING_MARGIN_MS", "float", 5.0,
+         "dispatch-window close margin before the head-of-line deadline"
+         " (the EDF batching budget)", parse=float),
+    Knob("MPITREE_TPU_SERVING_WAIT_MS", "float", 2.0,
+         "max batching window the scheduler holds a non-full bucket open",
+         parse=float),
     Knob("MPITREE_TPU_FOREST_HBM_BUDGET", "int", 8 << 30,
          "per-device budget (bytes) for the replicated binned matrix in"
          " tree-sharded forest builds", parse=int),

@@ -333,24 +333,40 @@ def tree_shards_for_budget(tree_shards: int, dataset_bytes: int,
 
 def serve_kernel_row_tile(n_nodes_max: int, n_features: int, kv: int,
                           n_out: int,
-                          budget: int = SERVE_VMEM_BUDGET_BYTES) -> int | None:
+                          budget: int = SERVE_VMEM_BUDGET_BYTES,
+                          quantized: bool = False) -> int | None:
     """Largest serving-kernel row tile whose VMEM working set fits
     ``budget`` (the persistent out block + one tree's table/value blocks
     + the one-hot working set), or None — the ONE copy of the arithmetic
-    ``serving.pallas_serve.kernel_row_tile``/``fits_vmem`` gate on."""
+    ``serving.pallas_serve.kernel_row_tile``/``fits_vmem`` gate on.
+
+    ``quantized=True`` prices the quantized kernel's residency (ISSUE
+    17): bf16 split-byte tables (2 bytes/cell), RAW int8 lattice value
+    blocks (1 byte/cell — the affine dequant runs after the kernel),
+    and node one-hots in the table dtype (bf16/int8 — exact 0/1 either
+    way), while the query/descent working set stays f32. Per padded
+    node that is 8*2 + kv*1 resident + rt*2 one-hot vs the f32 tier's
+    8*4 + kv*4 + rt*4 — which is why the VMEM tier's node budget
+    stretches PAST 2x under quantization.
+    """
     mp = _round_up(max(n_nodes_max, 1), 128)
     fp = _round_up(max(n_features, 1), 8)
-    blocks = mp * (8 + _round_up(max(kv, 1), 8)) * 4
+    cell_t = 2 if quantized else 4   # table: bf16 vs f32
+    cell_v = 1 if quantized else 4   # values: int8 lattice vs f32
+    cell_o = 2 if quantized else 4   # node one-hot rides the table dtype
+    blocks = mp * (8 * cell_t + _round_up(max(kv, 1), 8) * cell_v)
     for rt in (1024, 512, 256, 128, 64, 8):
-        work = rt * (mp + 2 * fp + 4 + max(n_out, 1)) * 4
+        work = rt * (mp * cell_o + (2 * fp + 4 + max(n_out, 1)) * 4)
         if blocks + work <= budget:
             return rt
     return None
 
 
 def serve_fits_vmem(n_nodes_max: int, n_features: int, kv: int,
-                    n_out: int) -> bool:
-    return serve_kernel_row_tile(n_nodes_max, n_features, kv, n_out) is not None
+                    n_out: int, quantized: bool = False) -> bool:
+    return serve_kernel_row_tile(
+        n_nodes_max, n_features, kv, n_out, quantized=quantized
+    ) is not None
 
 
 # ---------------------------------------------------------------------------
@@ -867,25 +883,39 @@ def aggregate_plans(plans: list) -> dict:
 def plan_serve(*, n_trees: int, n_nodes_total: int, n_nodes_max: int,
                n_features: int, value_channels: int, n_out: int,
                buckets=(1, 64, 4096), x64: bool = False,
-               kernel: bool = False) -> MemoryPlan:
+               kernel: bool = False, quantized: bool = False) -> MemoryPlan:
     """Price a serving model's device residency (the ``plan_fit`` twin
     for the request path): the flat node table + leaf-value channels
     (resident from publish), the largest bucket's query/accumulator
     working set, the optional VMEM-tier stacked tables, and the Pallas
-    VMEM verdict itself (:func:`serve_kernel_row_tile`)."""
+    VMEM verdict itself (:func:`serve_kernel_row_tile`).
+
+    ``quantized=True`` (ISSUE 17) prices the compressed tables: bf16
+    thresholds + int16 feature ids shrink the flat table from 5 f32
+    columns to the 3 f32 id columns plus two 2-byte ones, leaf values
+    ride int8 deltas (+ per-channel f32 scale/base), and the VMEM-tier
+    stacked blocks are bf16."""
     val_item = 8 if x64 else 4
     bmax = max(int(b) for b in buckets) if buckets else 1
     kv = max(int(value_channels), 1)
+    if quantized:
+        # left/right/orig stay int32 (absolute ids outgrow int16);
+        # feature int16 + threshold bf16 compress the other two columns.
+        table_bytes = int(n_nodes_total) * (3 * 4 + 2 * 2)
+        value_bytes = int(n_nodes_total) * kv * 1 + 2 * kv * 4
+    else:
+        table_bytes = int(n_nodes_total) * 5 * 4
+        value_bytes = int(n_nodes_total) * kv * val_item
     arrays = [
         {
             "name": "node_table", "shape": [int(n_nodes_total), 5],
-            "itemsize": 4, "phase": RESIDENT,
-            "bytes_per_device": int(n_nodes_total) * 5 * 4,
+            "itemsize": 2 if quantized else 4, "phase": RESIDENT,
+            "bytes_per_device": table_bytes,
         },
         {
             "name": "leaf_values", "shape": [int(n_nodes_total), kv],
-            "itemsize": val_item, "phase": RESIDENT,
-            "bytes_per_device": int(n_nodes_total) * kv * val_item,
+            "itemsize": 1 if quantized else val_item, "phase": RESIDENT,
+            "bytes_per_device": value_bytes,
         },
         {
             "name": "query_batch", "shape": [bmax, int(n_features)],
@@ -898,15 +928,18 @@ def plan_serve(*, n_trees: int, n_nodes_total: int, n_nodes_max: int,
             "bytes_per_device": bmax * max(int(n_out), 1) * val_item,
         },
     ]
-    rt = serve_kernel_row_tile(n_nodes_max, n_features, kv, n_out)
+    rt = serve_kernel_row_tile(
+        n_nodes_max, n_features, kv, n_out, quantized=quantized
+    )
     if kernel:
         mp = _round_up(max(int(n_nodes_max), 1), 128)
         kvp = _round_up(kv, 8)
+        cell = 2 if quantized else 4
         arrays.append({
             "name": "kernel_tables",
-            "shape": [int(n_trees), 8 + kvp, mp], "itemsize": 4,
+            "shape": [int(n_trees), 8 + kvp, mp], "itemsize": cell,
             "phase": RESIDENT,
-            "bytes_per_device": int(n_trees) * (8 + kvp) * mp * 4,
+            "bytes_per_device": int(n_trees) * (8 + kvp) * mp * cell,
         })
     resident = sum(
         a["bytes_per_device"] for a in arrays if a["phase"] == RESIDENT
@@ -931,6 +964,7 @@ def plan_serve(*, n_trees: int, n_nodes_total: int, n_nodes_max: int,
             "value_channels": kv, "n_out": int(n_out),
             "buckets": [int(b) for b in buckets],
             "x64": bool(x64), "kernel": bool(kernel),
+            "quantized": bool(quantized),
             "vmem_row_tile": rt,
             "vmem_fits": rt is not None,
             "vmem_budget_bytes": SERVE_VMEM_BUDGET_BYTES,

@@ -24,7 +24,11 @@ step per device attempt), ``split_dispatch``/``counts_dispatch``/
 ``parallel/collective.py``), ``level`` (each level of the levelwise
 loop), ``round`` (each boosting round), ``grad_hess`` (the per-round
 gradient payload, via :func:`corrupt`), ``serving_dispatch`` (the
-compiled-inference request path, ``serving/traversal.py``). The fused
+compiled-inference request path, ``serving/traversal.py``), and
+``sched_dispatch`` (the continuous-batching scheduler's coalesced
+dispatch, ``serving/scheduler.py`` — an ``unavailable`` blip exercises
+the requeue-once rung; a ``hang`` stalls the worker so the backlog
+grows and admissions shed: the deterministic overload burst). The fused
 single-program engines (ISSUE 8) add: ``leafwise_build`` (immediately
 before the one-dispatch best-first build,
 ``core/leafwise_builder.py``), ``expansion`` (each step of the

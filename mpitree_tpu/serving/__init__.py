@@ -17,6 +17,14 @@ millions of users lives or dies on ``predict``. The serving stack:
   estimator-equivalent predict surface plus ``serve_report_``;
 - **registry** — named slots with bucket-warmed publish, so swapping a
   freshly trained model never compiles on the request path;
+- **scheduler** — EDF continuous batching with admission control and
+  QoS classes in front of the registry (ISSUE 17): deadline-heaped
+  requests coalesce into the warm bucket shapes, overload sheds with
+  typed reject reasons instead of melting every SLO;
+- **quantize** — compressed node tables (bf16 thresholds / int16
+  feature ids / int8-delta leaf values) behind
+  ``compile_model(quantize=)``, with a per-model exactness report that
+  REFUSES past tolerance — the Pallas VMEM tier stretches ~2x;
 - **staging** — donated double-buffered input staging for streaming.
 
 The estimators' own ensemble predicts ride the same tables:
@@ -30,7 +38,14 @@ from mpitree_tpu.serving.model import (
     compile_model,
 )
 from mpitree_tpu.serving.pallas_serve import resolve_serving_kernel
+from mpitree_tpu.serving.quantize import QuantizationError
 from mpitree_tpu.serving.registry import ModelRegistry
+from mpitree_tpu.serving.scheduler import (
+    QoSClass,
+    RejectedRequest,
+    Scheduler,
+    parse_qos,
+)
 from mpitree_tpu.serving.staging import StreamStage
 from mpitree_tpu.serving.tables import NodeTable, note_serving, tables_for
 
@@ -39,9 +54,14 @@ __all__ = [
     "CompiledModel",
     "ModelRegistry",
     "NodeTable",
+    "QoSClass",
+    "QuantizationError",
+    "RejectedRequest",
+    "Scheduler",
     "StreamStage",
     "compile_model",
     "note_serving",
+    "parse_qos",
     "resolve_serving_kernel",
     "tables_for",
 ]

@@ -37,12 +37,14 @@ import numpy as np
 
 import jax
 
+from mpitree_tpu.config import knobs
 from mpitree_tpu.obs import BuildObserver
 from mpitree_tpu.obs import fingerprint as fingerprint_lib
 from mpitree_tpu.obs import memory as memory_lib
 from mpitree_tpu.obs.metrics import MetricsRegistry
 from mpitree_tpu.resilience import chaos, retry_device
 from mpitree_tpu.serving import pallas_serve, traversal
+from mpitree_tpu.serving import quantize as quantize_lib
 from mpitree_tpu.serving.tables import table_notes, tables_for
 
 DEFAULT_BUCKETS = (1, 64, 4096)
@@ -71,7 +73,8 @@ class CompiledModel:
     def __init__(self, trees, *, kind, n_features, n_out, values_fn,
                  classes=None, loss=None, scale=1.0, baseline=None,
                  buckets=DEFAULT_BUCKETS, value_dtype=None,
-                 channel_salt=""):
+                 channel_salt="", quantize=None, quantize_tol=None,
+                 calibration=None):
         self._state_lock = threading.Lock()
         self._obs = BuildObserver()
         # Request-path telemetry (obs/metrics.py): per-bucket latency
@@ -120,10 +123,28 @@ class CompiledModel:
         self._int_channel = (
             value_dtype is not None and np.dtype(value_dtype).kind in "iu"
         )
-        self.exact = self._int_channel or (
-            platform == "cpu" and value_dtype is None
+        # Quantized node tables (ISSUE 17): explicit argument wins, the
+        # knob is the fleet default. Integer channels (single-tree
+        # label/count gathers) are already exact AND minimal — an int8
+        # affine would only add error, so they pass through unquantized
+        # with the decision recorded.
+        qmode = quantize_lib.resolve_quantize(
+            knobs.value("MPITREE_TPU_SERVING_QUANTIZE")
+            if quantize is None else quantize
         )
-        dtype = (value_dtype if value_dtype is not None
+        if qmode is not None and self._int_channel:
+            self._obs.decision(
+                "serving_quantize", "skip",
+                reason="integer leaf channel is exact and minimal "
+                       "already; serving it unquantized",
+            )
+            qmode = None
+        self.quantize = qmode
+        self.exact = qmode is None and (
+            self._int_channel or (platform == "cpu" and value_dtype is None)
+        )
+        dtype = (np.float32 if qmode is not None
+                 else value_dtype if value_dtype is not None
                  else (np.float64 if platform == "cpu" else np.float32))
         self._x64 = np.dtype(dtype) == np.float64
 
@@ -136,12 +157,44 @@ class CompiledModel:
         # outlives this CompiledModel via the trees_ anchor, so without
         # it a recompile after a hyperparameter edit would silently
         # reuse the stale channel.
-        self._values = self.table.dev_values(
-            f"serve:{kind}{channel_salt}", lambda tb: _channel(
-                self.trees, values_fn, tb, dtype
-            ), dtype=dtype,
-        )
-        kv = int(self._values.shape[1])
+        self._quant = None
+        if qmode is not None:
+            # Quantized tier: the f32/f64 value channel is never device-
+            # put (pinning it would defeat the compression); the int8
+            # state carries its own compressed columns. build_state
+            # REFUSES (typed QuantizationError) past the exactness
+            # tolerance — a badly quantizing model must fail at compile,
+            # not drift under traffic.
+            flat = _channel(self.trees, values_fn, self.table, np.float64)
+            prepared = quantize_lib.prepare_channel(kind, flat)
+            tol = float(
+                quantize_tol if quantize_tol is not None
+                else knobs.value("MPITREE_TPU_SERVING_QUANTIZE_TOL")
+            )
+            self._quant = quantize_lib.build_state(
+                self.table, prepared, kind=kind, scale=scale,
+                n_steps=self.table.n_steps, tol=tol,
+                calibration=calibration, n_features=self.n_features,
+            )
+            self._values = None
+            kv = int(prepared.shape[1])
+            rep = self._quant.report
+            self._obs.decision(
+                "serving_quantize", qmode,
+                reason=(
+                    "bf16 thresholds / int16 feature ids / int8-delta "
+                    f"values; max calibration prediction delta "
+                    f"{rep['max_abs_delta']:.2e} <= tol {tol:.2e}"
+                ),
+                **rep,
+            )
+        else:
+            self._values = self.table.dev_values(
+                f"serve:{kind}{channel_salt}", lambda tb: _channel(
+                    self.trees, values_fn, tb, dtype
+                ), dtype=dtype,
+            )
+            kv = int(self._values.shape[1])
         if self._x64:
             with jax.enable_x64(True):
                 self._scale = jax.device_put(np.float64(scale))
@@ -168,12 +221,12 @@ class CompiledModel:
             **table_notes(self.trees),
         )
         self._use_kernel = kind in (
-            "forest_proba", "forest_mean", "margin"
+            "forest_proba", "forest_mean", "margin", "forest_values"
         ) and pallas_serve.resolve_serving_kernel(
             platform,
             n_nodes_max=max(t.n_nodes for t in self.trees),
             n_features=self.n_features, kv=kv, n_out=self.n_out,
-            obs=self._obs,
+            quantized=qmode is not None, obs=self._obs,
         )
         self._kernel_state = None
         self._obs.decision(
@@ -195,7 +248,7 @@ class CompiledModel:
             n_nodes_max=max(t.n_nodes for t in self.trees),
             n_features=self.n_features, value_channels=kv,
             n_out=self.n_out, buckets=self.buckets, x64=self._x64,
-            kernel=self._use_kernel,
+            kernel=self._use_kernel, quantized=qmode is not None,
         ))
         # Per-request deadline tracking (carried ROADMAP obs follow-up):
         # schedulers report misses here so metrics_text() exposes them
@@ -248,6 +301,12 @@ class CompiledModel:
                     self._acc_row[None, :],
                     (Xp.shape[0], self._acc_row.shape[0]),
                 ).copy()
+            if self._quant is not None:
+                return quantize_lib.dispatch(
+                    Xp, self._quant, kind=self.kind,
+                    n_steps=self.table.n_steps, acc0=acc0,
+                    scale=self._scale, obs=self._obs,
+                )
             return traversal.dispatch(
                 Xp, self.table.dev_arrays()[:5], self._values,
                 kind=self.kind, n_steps=self.table.n_steps,
@@ -270,32 +329,81 @@ class CompiledModel:
         """The Mosaic tier: VMEM-resident stacked tables, f32 aggregate,
         per-kind post-scale as two eager element-wise ops over device-
         cached constants — nothing but the query batch transfers."""
+        quantized = self._quant is not None
         with self._state_lock:
             # Locked lazy init: the registry's contract is concurrent
             # dispatch, and a racing double-build would transiently pin
             # two device copies of the kernel tables.
             if self._kernel_state is None:
-                tbl, _ = pallas_serve.build_kernel_tables(self.trees)
-                agg = {"forest_proba": "norm", "forest_mean": "sum",
-                       "margin": "percls"}[self.kind]
-                kv = self.n_out if self.kind == "forest_proba" else 1
-                vals = pallas_serve.build_kernel_values(
-                    self.trees, self._values_fn, kv
-                )
+                if quantized:
+                    # bf16 split-byte tables + RAW int8 lattice value
+                    # blocks; the kernel accumulates integer q-sums and
+                    # the affine dequant lands HERE, once, after the
+                    # kernel (linear across the ensemble sum: column k
+                    # collects T_k trees, so true_k = T_k*base_k +
+                    # scale_k*raw_k). Exactly the int8-affine values the
+                    # XLA quantized tier serves — the exactness report
+                    # covers both. forest_proba rows are pre-normalized
+                    # at build -> plain "sum".
+                    tbl, _ = pallas_serve.build_kernel_tables_quantized(
+                        self.trees
+                    )
+                    agg = {"forest_proba": "sum", "forest_mean": "sum",
+                           "margin": "percls",
+                           "forest_values": "sum"}[self.kind]
+                    kv = (self.n_out
+                          if self.kind in ("forest_proba", "forest_values")
+                          else 1)
+                    per = self._quant.q_rows_per_tree(
+                        self.trees, self.table
+                    )
+                    vals = pallas_serve.build_kernel_values(
+                        self.trees, lambda t: per[id(t)], kv,
+                        dtype=np.int8,
+                    )
+                    vs = np.asarray(self._quant.vscale, np.float32)
+                    vb = np.asarray(self._quant.vbase, np.float32)
+                    T = len(self.trees)
+                    if agg == "percls":
+                        # Round-major margin layout: each class column
+                        # collects exactly T/n_out trees' channel 0.
+                        qscale = np.full(self.n_out, vs[0], np.float32)
+                        qbase = np.full(
+                            self.n_out,
+                            (T // self.n_out) * vb[0], np.float32,
+                        )
+                    else:
+                        qscale = vs[:kv].astype(np.float32)
+                        qbase = (T * vb[:kv]).astype(np.float32)
+                    qaff = (jax.device_put(qscale), jax.device_put(qbase))
+                else:
+                    tbl, _ = pallas_serve.build_kernel_tables(self.trees)
+                    agg = {"forest_proba": "norm", "forest_mean": "sum",
+                           "margin": "percls",
+                           "forest_values": "sum"}[self.kind]
+                    kv = (self.n_out
+                          if self.kind in ("forest_proba", "forest_values")
+                          else 1)
+                    vals = pallas_serve.build_kernel_values(
+                        self.trees, self._values_fn, kv
+                    )
+                    qaff = None
                 rt = pallas_serve.kernel_row_tile(
                     max(t.n_nodes for t in self.trees), self.n_features,
-                    kv, self.n_out,
+                    kv, self.n_out, quantized=quantized,
                 )
                 self._kernel_state = (
                     jax.device_put(tbl), jax.device_put(vals), agg, kv, rt,
                     jax.device_put(np.float32(self._scale_host)),
-                    jax.device_put(self._baseline_host),
+                    jax.device_put(self._baseline_host), qaff,
                 )
-        tbl, vals, agg, kv, rt, dscale, dbase = self._kernel_state
+        tbl, vals, agg, kv, rt, dscale, dbase, qaff = self._kernel_state
         out = pallas_serve.traverse_batch_pallas(
             Xp, tbl, vals, n_steps=self.table.n_steps, agg=agg,
-            n_out=self.n_out, kv=kv, row_tile=rt,
+            n_out=self.n_out, kv=kv, row_tile=rt, quantized=quantized,
         )
+        if qaff is not None:
+            out = out * qaff[0][None, :] + qaff[1][None, :]
         if agg == "percls":
             return out * dscale + dbase[None, :]
         return out / dscale
@@ -388,7 +496,7 @@ class CompiledModel:
             if self.classes is not None:  # monotonic classifier labels
                 return self.classes[out.astype(np.int64)]
             return out
-        if self.kind == "forest_proba":
+        if self.kind in ("forest_proba", "forest_values"):
             return self.classes[out.argmax(axis=1)]
         if self.kind == "forest_mean":
             return out
@@ -404,7 +512,7 @@ class CompiledModel:
         if self.kind == "gather_counts":
             # The reference quirk, preserved: RAW leaf counts.
             return out.astype(np.int64)
-        if self.kind == "forest_proba":
+        if self.kind in ("forest_proba", "forest_values"):
             return out
         if self.kind == "margin" and self.classes is not None:
             return self._loss.proba(out.astype(np.float64))
@@ -503,11 +611,25 @@ class CompiledModel:
         self._sync_metrics()
         rep = self._obs.report()
         rep["latency"] = self.latency_summary()
+        # The quantization decision + exactness report (ISSUE 17): what
+        # mode the tables serve in, and how far the calibration batch's
+        # predictions sit from the f32 tables.
+        rep["quantization"] = (
+            dict(self._quant.report) if self._quant is not None
+            else {"mode": "off"}
+        )
         return rep
 
 
-def compile_model(estimator, *, buckets=DEFAULT_BUCKETS) -> CompiledModel:
-    """Flatten a FITTED estimator into a :class:`CompiledModel`."""
+def compile_model(estimator, *, buckets=DEFAULT_BUCKETS, quantize=None,
+                  quantize_tol=None, calibration=None) -> CompiledModel:
+    """Flatten a FITTED estimator into a :class:`CompiledModel`.
+
+    ``quantize`` ("int8", or None to follow the
+    ``MPITREE_TPU_SERVING_QUANTIZE`` knob) serves compressed node tables
+    with an exactness report, refusing past ``quantize_tol`` (knob
+    ``MPITREE_TPU_SERVING_QUANTIZE_TOL``) on the ``calibration`` batch
+    (synthesized around the table's thresholds when omitted)."""
     from mpitree_tpu.boosting.gradient_boosting import (
         _BaseGradientBoosting,
     )
@@ -515,6 +637,8 @@ def compile_model(estimator, *, buckets=DEFAULT_BUCKETS) -> CompiledModel:
     from mpitree_tpu.models.forest import _BaseForest
     from mpitree_tpu.models.regressor import DecisionTreeRegressor
 
+    q_kw = dict(quantize=quantize, quantize_tol=quantize_tol,
+                calibration=calibration)
     if isinstance(estimator, _BaseGradientBoosting):
         classes = getattr(estimator, "classes_", None)
         K = int(estimator.n_trees_per_iteration_)
@@ -531,29 +655,57 @@ def compile_model(estimator, *, buckets=DEFAULT_BUCKETS) -> CompiledModel:
             classes=classes,
             loss=estimator._loss() if classes is not None else None,
             baseline=np.asarray(estimator._baseline_raw, np.float64),
-            buckets=buckets,
+            buckets=buckets, **q_kw,
         )
     if isinstance(estimator, _BaseForest):
-        if getattr(estimator, "monotonic_cst", None) is not None:
-            raise NotImplementedError(
-                "serving tables for monotonic-constrained forests are a "
-                "ROADMAP follow-up (clipped per-tree probabilities need "
-                "their own value channel); serve the estimator directly"
-            )
         T = len(estimator.trees_)
+        mono = getattr(estimator, "monotonic_cst", None)
         if hasattr(estimator, "classes_"):
             C = len(estimator.classes_)
+            if mono is not None:
+                # Constrained forests average their trees' bound-CLIPPED
+                # class-0 fractions (forest.predict_proba's mono path), a
+                # per-NODE quantity — so the rows are final at build time
+                # and ride the pure-add forest_values kind; the raw-count
+                # forest_proba channel would re-derive the UNCLIPPED
+                # distribution. Salted: the clip depends on the cst,
+                # which isn't part of the trees_ cache anchor.
+                from mpitree_tpu.utils.monotonic import (
+                    clipped_class0,
+                    validate_monotonic_cst,
+                )
+                cst = validate_monotonic_cst(
+                    mono, estimator.n_features_, task="classification",
+                    n_classes=C,
+                )
+
+                def _mono_rows(t, cst=cst):
+                    p0 = clipped_class0(t, cst).astype(np.float64)
+                    return np.stack([p0, 1.0 - p0], axis=1)
+
+                return CompiledModel(
+                    estimator.trees_, kind="forest_values",
+                    n_features=estimator.n_features_, n_out=C,
+                    values_fn=_mono_rows,
+                    channel_salt=f":cst={np.asarray(cst).tolist()!r}",
+                    classes=estimator.classes_, scale=float(T),
+                    buckets=buckets, **q_kw,
+                )
             return CompiledModel(
                 estimator.trees_, kind="forest_proba",
                 n_features=estimator.n_features_, n_out=C,
                 values_fn=lambda t: np.asarray(t.count, np.float64),
-                classes=estimator.classes_, scale=float(T), buckets=buckets,
+                classes=estimator.classes_, scale=float(T),
+                buckets=buckets, **q_kw,
             )
+        # Regressor: monotonic clipping is baked into count[:, 0] IN
+        # PLACE at fit time (clip_tree_values), so the constrained and
+        # unconstrained forests serve the same mean channel.
         return CompiledModel(
             estimator.trees_, kind="forest_mean",
             n_features=estimator.n_features_, n_out=1,
             values_fn=lambda t: np.asarray(t.count[:, 0], np.float64),
-            scale=float(T), buckets=buckets,
+            scale=float(T), buckets=buckets, **q_kw,
         )
     if isinstance(estimator, DecisionTreeClassifier):
         tree = estimator.tree_
@@ -566,7 +718,7 @@ def compile_model(estimator, *, buckets=DEFAULT_BUCKETS) -> CompiledModel:
                 n_features=estimator.n_features_, n_out=1,
                 values_fn=lambda t: np.asarray(t.value, np.int32),
                 classes=estimator.classes_, buckets=buckets,
-                value_dtype=np.int32,
+                value_dtype=np.int32, **q_kw,
             )
         counts = np.asarray(tree.count)
         if counts.max(initial=0) >= 2**31:
@@ -579,14 +731,14 @@ def compile_model(estimator, *, buckets=DEFAULT_BUCKETS) -> CompiledModel:
             n_out=len(estimator.classes_),
             values_fn=lambda t: np.asarray(t.count, np.int32),
             classes=estimator.classes_, buckets=buckets,
-            value_dtype=np.int32,
+            value_dtype=np.int32, **q_kw,
         )
     if isinstance(estimator, DecisionTreeRegressor):
         return CompiledModel(
             [estimator.tree_], kind="gather_value",
             n_features=estimator.n_features_, n_out=1,
             values_fn=lambda t: np.asarray(t.count[:, 0], np.float64),
-            buckets=buckets,
+            buckets=buckets, **q_kw,
         )
     raise TypeError(
         f"compile_model: unsupported estimator {type(estimator).__name__}"

@@ -43,17 +43,29 @@ from jax.experimental import pallas as pl
 from mpitree_tpu.obs import memory as memory_lib
 from mpitree_tpu.ops.pallas_hist import _round_up, pallas_available
 from mpitree_tpu.config import knobs
+from mpitree_tpu.serving import quantize as quantize_lib
 
 
 def _traverse_kernel(x_ref, tbl_ref, val_ref, out_ref, *, n_steps,
-                     agg, n_out, kv):
+                     agg, n_out, kv, quantized=False):
     """One grid step: descend one row tile through one tree, accumulate.
 
     x_ref   : (Rt, Fp) f32 — query rows (features padded to Fp).
     tbl_ref : (1, 8, Mp) f32 — this tree's (feature, threshold, left,
               right, pad...) rows, node axis on lanes; pad nodes carry
-              feature = -1 (leaves).
-    val_ref : (1, Kvp, Mp) f32 — this tree's leaf-value channels.
+              feature = -1 (leaves). Quantized tier: bf16 with the
+              SPLIT-BYTE id layout (``build_kernel_tables_quantized``) —
+              bf16's 8-bit mantissa can't hold ids past 256 exactly, so
+              each id rides as an exact (lo, hi) byte pair recombined
+              ``hi*256 + lo`` after the contraction (both bytes and the
+              recombined id are integers < 2^24, exact in f32).
+    val_ref : (1, Kvp, Mp) f32 — this tree's leaf-value channels. The
+              quantized tier stores the RAW int8 lattice instead: the
+              leaf selection contracts int8 x int8 into an exact int32,
+              the f32 out block accumulates integer q-sums, and the
+              caller applies the affine dequant ONCE after the kernel
+              (it is linear across the ensemble sum) — a 4x smaller
+              resident value block with zero added error.
     out_ref : (Rt, Kop) f32 — ensemble accumulation (persists over T).
     """
     Rt, Fp = x_ref.shape
@@ -70,31 +82,49 @@ def _traverse_kernel(x_ref, tbl_ref, val_ref, out_ref, *, n_steps,
     f_iota = jax.lax.broadcasted_iota(jnp.int32, (Rt, Fp), 1)
     node = jnp.zeros((Rt,), jnp.int32)  # stacked layout: every root is 0
     for _ in range(n_steps):
-        onehot = (node[:, None] == m_iota).astype(jnp.float32)
+        onehot = (node[:, None] == m_iota).astype(tbl.dtype)
         # HIGHEST precision on both contractions: the MXU's default
         # truncates the f32 table operand to bf16, which corrupts child
         # ids above 256 and rounds thresholds — silent misrouting on
         # exactly the real-TPU tier this kernel exists for. Cheap: the
-        # one-hot operand is exact 0/1 either way.
+        # one-hot operand is exact 0/1 either way. (The quantized tier's
+        # operands are bf16 BY CONSTRUCTION — every stored value is a
+        # byte or a bf16 threshold, so the selection is still exact.)
         props = jax.lax.dot_general(
             onehot, tbl,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST,
         )  # (Rt, 8): feature, threshold, left, right, pad
-        f = props[:, 0].astype(jnp.int32)
+        if quantized:
+            f = (props[:, 4] * 256.0 + props[:, 0]).astype(jnp.int32)
+            thr = props[:, 1]
+            left = props[:, 5] * 256.0 + props[:, 2]
+            right = props[:, 6] * 256.0 + props[:, 3]
+        else:
+            f = props[:, 0].astype(jnp.int32)
+            thr, left, right = props[:, 1], props[:, 2], props[:, 3]
         xf = jnp.sum(
             jnp.where(f[:, None] == f_iota, x, 0.0), axis=1
         )
-        nxt = jnp.where(xf <= props[:, 1], props[:, 2], props[:, 3])
+        nxt = jnp.where(xf <= thr, left, right)
         node = jnp.where(f < 0, node, nxt.astype(jnp.int32))
-    onehot = (node[:, None] == m_iota).astype(jnp.float32)
-    vals = jax.lax.dot_general(
-        onehot, val_ref[0],
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST,
-    )  # (Rt, Kvp)
+    if quantized:
+        # int8 one-hot x int8 lattice -> int32: exact by construction
+        # (one nonzero per row, |q| <= 127), no precision knob needed.
+        vals = jax.lax.dot_general(
+            (node[:, None] == m_iota).astype(jnp.int8), val_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.float32)  # (Rt, Kvp) raw q
+    else:
+        onehot = (node[:, None] == m_iota).astype(val_ref.dtype)
+        vals = jax.lax.dot_general(
+            onehot, val_ref[0],
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )  # (Rt, Kvp)
     if agg == "norm":
         # Per-tree normalized count rows (forest predict_proba): the pad
         # channels are zero, so the kv-wide row sum is the true one.
@@ -115,16 +145,22 @@ def _traverse_kernel(x_ref, tbl_ref, val_ref, out_ref, *, n_steps,
 @functools.partial(
     jax.jit,
     static_argnames=("n_steps", "agg", "n_out", "kv", "row_tile",
-                     "interpret"),
+                     "interpret", "quantized"),
 )
 def traverse_batch_pallas(X, tables, values, *, n_steps: int, agg: str,
                           n_out: int, kv: int, row_tile: int = 256,
-                          interpret: bool = False):
+                          interpret: bool = False, quantized: bool = False):
     """(N, F) rows + stacked per-tree tables -> (N, n_out) f32 aggregate.
 
     ``tables``: (T, 8, Mp) f32 (property axis sublane-padded, nodes on
     lanes); ``values``: (T, Kvp, Mp) f32 — both built by
-    :func:`build_kernel_tables`. ``interpret=True`` runs the Pallas
+    :func:`build_kernel_tables`. ``quantized=True`` serves the bf16
+    split-byte tables (:func:`build_kernel_tables_quantized`) + RAW
+    int8 lattice value blocks; the returned aggregate is then the
+    integer q-sum and the CALLER owns the affine dequant (one
+    elementwise op — linear across the ensemble sum). Tables halve,
+    values quarter, one-hots ride bf16/int8 — the VMEM tier stretches
+    past 2x the ensemble. ``interpret=True`` runs the Pallas
     interpreter (the CPU parity tests); on hardware the caller gates on
     :func:`fits_vmem`.
     """
@@ -137,6 +173,7 @@ def traverse_batch_pallas(X, tables, values, *, n_steps: int, agg: str,
     out = pl.pallas_call(
         functools.partial(
             _traverse_kernel, n_steps=n_steps, agg=agg, n_out=n_out, kv=kv,
+            quantized=quantized,
         ),
         # Trees innermost (TPU grids iterate the last axis fastest): each
         # row tile's out block accumulates across the full ensemble before
@@ -180,17 +217,64 @@ def build_kernel_tables(trees) -> tuple:
     return tbl, Mp
 
 
-def build_kernel_values(trees, channel_fn, kv: int) -> np.ndarray:
-    """(T, Kvp, Mp) f32 leaf-value channels (channels padded to the
-    8-sublane tile, node axis on lanes)."""
+def build_kernel_values(trees, channel_fn, kv: int,
+                        dtype=np.float32) -> np.ndarray:
+    """(T, Kvp, Mp) leaf-value channels (channels padded to the
+    8-sublane tile, node axis on lanes). The quantized tier passes
+    ``dtype=jnp.bfloat16`` — value blocks halve alongside the tables."""
     T = len(trees)
     Mp = _round_up(max(t.n_nodes for t in trees), 128)
     kvp = _round_up(max(kv, 1), 8)
-    vals = np.zeros((T, kvp, Mp), np.float32)
+    vals = np.zeros((T, kvp, Mp), dtype)
     for i, t in enumerate(trees):
         ch = np.asarray(channel_fn(t), np.float32).reshape(t.n_nodes, -1)
-        vals[i, : ch.shape[1], : t.n_nodes] = ch.T
+        vals[i, : ch.shape[1], : t.n_nodes] = ch.T.astype(dtype)
     return vals
+
+
+# Split-byte id ceiling: (lo, hi) byte pairs recombine to hi*256 + lo,
+# so tree-relative node ids must fit two bytes.
+QUANTIZED_KERNEL_MAX_NODES = 65536
+
+
+def build_kernel_tables_quantized(trees) -> tuple:
+    """Stacked bf16 kernel layout with split-byte ids: ((T, 8, Mp), Mp).
+
+    bf16 holds every integer in [0, 256] exactly but nothing certain past
+    it, so feature/left/right ids each ride as an exact byte pair::
+
+        row 0: feature lo   row 4: feature hi
+        row 2: left lo      row 5: left hi
+        row 3: right lo     row 6: right hi
+        row 1: threshold (bf16 — the SAME rounding the XLA quantized
+               tier compares against, so the tiers route identically)
+
+    Leaves/pad keep the ``feature = -1`` hold marker as (lo=-1, hi=0).
+    Requires ``n_nodes < QUANTIZED_KERNEL_MAX_NODES`` — the resolver
+    refuses larger tables back to the XLA tier.
+    """
+    T = len(trees)
+    n_max = max(t.n_nodes for t in trees)
+    if n_max >= QUANTIZED_KERNEL_MAX_NODES:
+        raise ValueError(
+            f"split-byte kernel ids cap at {QUANTIZED_KERNEL_MAX_NODES} "
+            f"nodes per tree (got {n_max})"
+        )
+    Mp = _round_up(n_max, 128)
+    tbl = np.zeros((T, 8, Mp), jnp.bfloat16)
+    tbl[:, 0, :] = -1.0
+    for i, t in enumerate(trees):
+        m = t.n_nodes
+        f = np.asarray(t.feature, np.int32)
+        lo = np.where(f < 0, -1, f % 256)
+        tbl[i, 0, :m] = lo.astype(np.float32)
+        tbl[i, 4, :m] = np.maximum(f // 256, 0).astype(np.float32)
+        tbl[i, 1, :m] = quantize_lib.quantize_thresholds(t.threshold)
+        for prop, (lo_row, hi_row) in (("left", (2, 5)), ("right", (3, 6))):
+            c = np.maximum(np.asarray(getattr(t, prop), np.int32), 0)
+            tbl[i, lo_row, :m] = (c % 256).astype(np.float32)
+            tbl[i, hi_row, :m] = (c // 256).astype(np.float32)
+    return tbl, Mp
 
 
 # Conservative VMEM ceiling (same stance as pallas_hist): the persistent
@@ -203,21 +287,24 @@ _VMEM_BUDGET_BYTES = memory_lib.SERVE_VMEM_BUDGET_BYTES
 
 
 def kernel_row_tile(n_nodes_max: int, n_features: int, kv: int,
-                    n_out: int) -> int | None:
+                    n_out: int, quantized: bool = False) -> int | None:
     """Largest row tile whose working set fits the VMEM budget, or None."""
     return memory_lib.serve_kernel_row_tile(
-        n_nodes_max, n_features, kv, n_out, budget=_VMEM_BUDGET_BYTES
+        n_nodes_max, n_features, kv, n_out, budget=_VMEM_BUDGET_BYTES,
+        quantized=quantized,
     )
 
 
 def fits_vmem(n_nodes_max: int, n_features: int, kv: int,
-              n_out: int) -> bool:
-    return kernel_row_tile(n_nodes_max, n_features, kv, n_out) is not None
+              n_out: int, quantized: bool = False) -> bool:
+    return kernel_row_tile(
+        n_nodes_max, n_features, kv, n_out, quantized
+    ) is not None
 
 
 def resolve_serving_kernel(platform: str, *, n_nodes_max: int,
                            n_features: int, kv: int, n_out: int,
-                           obs=None) -> bool:
+                           quantized: bool = False, obs=None) -> bool:
     """Whether the fused serving path runs the Mosaic kernel.
 
     Policy shape mirrors ``resolve_wide_hist``: ``MPITREE_TPU_SERVING_
@@ -235,9 +322,17 @@ def resolve_serving_kernel(platform: str, *, n_nodes_max: int,
     if flag not in ("auto", "pallas"):
         raise ValueError(f"unknown MPITREE_TPU_SERVING_KERNEL {flag!r}")
     ok = pallas_available(platform)
-    fits = fits_vmem(n_nodes_max, n_features, kv, n_out)
+    # The quantized tier's split-byte ids cap a tree at 65536 nodes; a
+    # bigger table refuses back to XLA like a VMEM overflow would.
+    ids_ok = (not quantized
+              or n_nodes_max < QUANTIZED_KERNEL_MAX_NODES)
+    fits = ids_ok and fits_vmem(
+        n_nodes_max, n_features, kv, n_out, quantized
+    )
     if flag == "pallas" and not (ok and fits):
         why = ("needs a TPU backend" if not ok
+               else "split-byte ids cap at 65536 nodes/tree"
+               if not ids_ok
                else "table working set exceeds the VMEM budget")
         if obs is not None:
             obs.event(

@@ -1,0 +1,482 @@
+"""Quantized node tables — compressed serving state (ISSUE 17).
+
+The serving tier's per-row cost is dominated by the device residency the
+flat table pins: five f32/int32 structural columns plus f64/f32 leaf-value
+channels. For the ensembles production actually serves, most of that
+precision is head-room: thresholds route identically at bf16 for almost
+every query row, feature ids fit int16, and leaf values — once expressed
+as per-channel affine deltas — fit int8. This module is the ONE copy of
+the compression scheme both serving tiers ride:
+
+- **thresholds** ride bf16 (upcast-exact f32 compare: every bf16 value is
+  an exact f32, so the descent stays a deterministic ``x <= thr``);
+- **feature ids** ride int16 (refused past 32767 features);
+- **leaf values** ride int8 deltas with per-channel affine dequant
+  ``v = base + q * scale`` (scale spans the channel's [min, max] over 254
+  steps). Channels are PREPARED per serving kind first
+  (:func:`prepare_channel`): forest count rows normalize at build time so
+  the int8 grid spans [0, 1] probabilities instead of raw counts — the
+  accumulation then becomes a plain sum, numerically identical in shape
+  to the margin/mean kinds;
+- children (and roots) stay int32: absolute flat-table ids outgrow int16
+  on exactly the large ensembles quantization exists for.
+
+Quantization is lossy BY CONTRACT, so every compiled quantized model
+carries an exactness report (:func:`exactness_report`): the max absolute
+prediction delta vs the f32 tables on a calibration batch (caller-provided
+or synthesized around the table's own thresholds, where routing flips
+live). A delta past the tolerance REFUSES compilation with a typed
+``quantize_refused`` event and :class:`QuantizationError` — a model that
+quantizes badly must fail at publish time, never drift silently under
+traffic.
+
+The dispatch path mirrors ``serving.traversal``: one jitted program per
+(model, bucket), compile-noted under the SAME ``serving_traverse`` entry
+(distinct key element ``"int8"``) so the registry's zero-new-compile-keys
+audit covers quantized models unchanged. The f64 CPU exactness contract
+does NOT extend here — quantized models are ``exact=False`` everywhere,
+with the report quantifying the divergence instead of hiding it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from mpitree_tpu.obs import REGISTRY
+from mpitree_tpu.serving.traversal import _NOTE_LOCK
+
+# int8 delta grid: 254 steps across the channel span, symmetric around 0
+# (the -128 code is unused so dequant never needs an asymmetric clamp).
+_Q_STEPS = 254.0
+_Q_LO = -127
+
+# The one quantized-mode spelling ``compile_model(quantize=)`` accepts
+# (beyond the off-values None/False/"off"/"0"/"none").
+QUANTIZE_MODES = ("int8",)
+
+
+class QuantizationError(ValueError):
+    """Exactness refusal: the quantized tables' max prediction delta on
+    the calibration batch exceeded the tolerance. Carries the full
+    report so the publish site (and the typed ``quantize_refused``
+    event) can say exactly how far off it was."""
+
+    def __init__(self, message: str, *, report: dict):
+        super().__init__(message)
+        self.report = report
+
+
+def resolve_quantize(mode) -> str | None:
+    """Normalize a ``quantize=`` argument / knob value to ``"int8"`` or
+    None. Unknown spellings are loud — a typo'd mode silently serving
+    f32 would defeat the capacity planning built on it."""
+    if mode in (None, False, "", "off", "0", "none"):
+        return None
+    if mode in QUANTIZE_MODES or mode is True:
+        return "int8"
+    raise ValueError(
+        f"unknown serving quantize mode {mode!r} (expected one of "
+        f"{QUANTIZE_MODES} or an off-value)"
+    )
+
+
+def prepare_channel(kind: str, flat: np.ndarray) -> np.ndarray:
+    """Per-kind host f64 value transform applied BEFORE quantization.
+
+    ``forest_proba`` rows normalize here (the f32 tier normalizes inside
+    the per-tree loop): probabilities span [0, 1], so the int8 grid
+    resolves ~0.004 per channel instead of being wasted on raw-count
+    dynamic range — and the quantized accumulation for every kind
+    becomes the same plain row sum."""
+    flat = np.asarray(flat, np.float64).reshape(flat.shape[0], -1)
+    if kind == "forest_proba":
+        return flat / np.maximum(flat.sum(axis=1, keepdims=True), 1.0)
+    return flat
+
+
+def affine_int8(prepared: np.ndarray):
+    """(M, K) prepared f64 channel -> (q int8, scale f32, base f32).
+
+    Per-channel affine: ``q = round((v - lo)/scale) + _Q_LO``,
+    ``dequant = base + q*scale`` with ``base = lo - _Q_LO*scale``.
+    Constant channels get scale 0 and dequant exactly to their value."""
+    lo = prepared.min(axis=0)
+    hi = prepared.max(axis=0)
+    span = hi - lo
+    scale = np.where(span > 0, span / _Q_STEPS, 1.0)
+    q = np.clip(
+        np.rint((prepared - lo[None, :]) / scale[None, :]) + _Q_LO,
+        -127, 127,
+    ).astype(np.int8)
+    scale = np.where(span > 0, scale, 0.0).astype(np.float32)
+    base = (lo - _Q_LO * scale).astype(np.float32)
+    # Constant channels: scale 0 makes dequant = base = the value.
+    base = np.where(span > 0, base, lo).astype(np.float32)
+    return q, scale, base
+
+
+def dequantize(q: np.ndarray, scale: np.ndarray,
+               base: np.ndarray) -> np.ndarray:
+    """Host f32 dequant — the numpy twin of the in-program dequant (same
+    ops, same order) the exactness report and the kernel-tier value
+    blocks read."""
+    return (base[None, :]
+            + q.astype(np.float32) * scale[None, :]).astype(np.float32)
+
+
+def quantize_thresholds(threshold: np.ndarray) -> np.ndarray:
+    """f32 thresholds -> bf16, rounded toward -inf (leaf NaNs
+    neutralized like the kernel tables — they never route).
+
+    FLOOR rounding is load-bearing, not a style choice. The descent
+    compares ``x <= thr``; a rounded threshold ``t_q != thr`` misroutes
+    exactly the x in the open-closed gap between them. Rounding DOWN
+    puts that gap at ``(t_q, thr]`` with ``t_q`` the largest bf16 value
+    <= thr — an interval that by construction contains NO bf16 lattice
+    point. Hence the theorem the synthesized calibration (and the
+    routing property test) rides: every query whose features are bf16
+    values routes IDENTICALLY to the f32 tables; only sub-bf16-ulp query
+    detail can reroute, which a full-precision calibration batch
+    honestly measures. Round-to-nearest would instead put the lattice
+    point ``t_q`` itself inside the gap — reroutes on essentially every
+    real model."""
+    t = np.nan_to_num(np.asarray(threshold, np.float32), nan=0.0)
+    q = t.astype(jnp.bfloat16)
+    qf = q.astype(np.float32)
+    bits = q.view(np.uint16).copy()
+    over = qf > t  # rounded up: step down one bf16 ulp
+    bits[over & (qf > 0)] -= 1
+    bits[over & (qf < 0)] += 1
+    # q == +/-0 but t < 0: next below zero is the smallest-magnitude
+    # negative bf16.
+    bits[over & (qf == 0)] = np.uint16(0x8001)
+    return bits.view(jnp.bfloat16)
+
+
+@dataclasses.dataclass
+class QuantizedState:
+    """Device-resident quantized model state (built once at compile)."""
+
+    feature: jax.Array    # (M,) int16
+    threshold: jax.Array  # (M,) bf16
+    left: jax.Array       # (M,) int32 (shared with the f32 table)
+    right: jax.Array      # (M,) int32
+    root: jax.Array       # (T,) int32
+    qvals: jax.Array      # (M, K) int8
+    vscale: jax.Array     # (K,) f32
+    vbase: jax.Array      # (K,) f32
+    report: dict          # the exactness report recorded in serve_report_
+    rows_host: np.ndarray  # (M, K) f32 dequantized, flat-table order
+    q_host: np.ndarray     # (M, K) int8 raw lattice, flat-table order
+
+    def _per_tree(self, flat: np.ndarray, trees, table) -> dict:
+        """Invert the flat table's depth-pack scatter: ``id(tree) ->
+        (n_nodes, K)`` rows in per-tree node order."""
+        order = table.scatter_order()
+        concat = np.empty_like(flat)
+        concat[order] = flat
+        offs = np.cumsum([0] + [t.n_nodes for t in trees])
+        return {
+            id(t): concat[offs[i]:offs[i + 1]]
+            for i, t in enumerate(trees)
+        }
+
+    def rows_per_tree(self, trees, table) -> dict:
+        """Dequantized f32 value rows per tree (host oracle / debugging
+        view of what the tiers serve)."""
+        return self._per_tree(self.rows_host, trees, table)
+
+    def q_rows_per_tree(self, trees, table) -> dict:
+        """RAW int8 lattice rows per tree — what the Pallas tier's value
+        blocks store. The kernel accumulates the integer lattice and the
+        dispatch applies the affine once at the end (the affine is
+        linear across the ensemble sum), so the kernel serves exactly
+        the int8-affine values the XLA quantized tier serves and the
+        exactness report covers both."""
+        return self._per_tree(self.q_host, trees, table)
+
+
+def build_state(table, prepared: np.ndarray, *, kind: str, scale,
+                n_steps: int, tol: float, calibration=None,
+                n_features: int | None = None) -> QuantizedState:
+    """Quantize one flat table + prepared channel; refuse past ``tol``.
+
+    ``table``: a ``serving.tables.NodeTable`` (its cached int32
+    left/right/root device copies are SHARED — quantization must not
+    double-pin them). Raises :class:`QuantizationError` when the
+    calibration delta exceeds ``tol``."""
+    if n_features is None:
+        n_features = int(table.feature.max(initial=0)) + 1
+    if n_features > np.iinfo(np.int16).max:
+        raise QuantizationError(
+            f"int16 feature ids cannot address {n_features} features",
+            report={"ok": False, "reason": "n_features"},
+        )
+    q, vscale, vbase = affine_int8(prepared)
+    thr_q = quantize_thresholds(table.threshold)
+    rep = exactness_report(
+        table, prepared, (q, vscale, vbase), kind=kind,
+        scale=scale, n_steps=n_steps, tol=tol,
+        calibration=calibration, n_features=n_features,
+    )
+    if not rep["ok"]:
+        raise QuantizationError(
+            f"quantized tables diverge past tolerance: max prediction "
+            f"delta {rep['max_abs_delta']:.3e} > {tol:.3e} on "
+            f"{rep['rows']} calibration rows",
+            report=rep,
+        )
+    _f, _t, left_d, right_d, root_d, _o = table.dev_arrays()
+    return QuantizedState(
+        feature=jax.device_put(table.feature.astype(np.int16)),
+        threshold=jax.device_put(thr_q),
+        left=left_d, right=right_d, root=root_d,
+        qvals=jax.device_put(q),
+        vscale=jax.device_put(vscale),
+        vbase=jax.device_put(vbase),
+        report=rep,
+        rows_host=dequantize(q, vscale, vbase),
+        q_host=q,
+    )
+
+
+# ---------------------------------------------------------------------------
+# host reference (numpy) — the exactness oracle
+# ---------------------------------------------------------------------------
+
+def _host_descend(X, feature, threshold, left, right, root,
+                  n_steps: int) -> np.ndarray:
+    """(N, T) absolute leaf ids — the numpy twin of the unrolled descent
+    (rows parked on leaves hold their id; children never read at -1)."""
+    node = np.broadcast_to(
+        root[None, :].astype(np.int64), (len(X), len(root))
+    ).copy()
+    for _ in range(n_steps):
+        f = feature[node]
+        thr = threshold[node]
+        xf = np.take_along_axis(X, np.maximum(f, 0).astype(np.int64), axis=1)
+        nxt = np.where(xf <= thr, left[node], right[node])
+        node = np.where(f < 0, node, nxt)
+    return node
+
+
+def _host_apply(kind: str, node: np.ndarray, rows: np.ndarray,
+                scale: float, n_out: int) -> np.ndarray:
+    """Apply a prepared f32 channel at leaf ids, per serving kind —
+    BASELINE-FREE for margins (the baseline is identical on both sides
+    of the delta and cancels)."""
+    N, T = node.shape
+    if kind == "margin":
+        K = int(n_out)
+        acc = np.zeros((N, K), np.float32)
+        for r in range(T // K):
+            ids = node[:, r * K:(r + 1) * K]
+            acc = acc + rows[ids, 0]
+        return acc
+    if kind == "gather_value":
+        return rows[node[:, 0], 0:1]
+    acc = np.zeros((N, rows.shape[1]), np.float32)
+    for t in range(T):
+        acc = acc + rows[node[:, t]]
+    if kind == "forest_mean":
+        acc = acc[:, 0:1]
+    return acc / np.float32(scale)
+
+
+def synthesize_calibration(table, n_features: int, rows: int = 256,
+                           seed: int = 0) -> np.ndarray:
+    """A deterministic calibration batch when the caller has no data:
+    per-feature uniform draws spanning (and 10% past) that feature's own
+    threshold range, SNAPPED to the bf16 lattice. On-lattice rows route
+    identically through the floor-rounded thresholds (see
+    :func:`quantize_thresholds`), so the default report isolates VALUE
+    quantization error — the quantity the tolerance gate is calibrated
+    for. Sub-ulp routing sensitivity is a property of the caller's real
+    query distribution; measuring it honestly needs the caller's own
+    full-precision ``calibration`` batch. Features the table never
+    splits on get [0, 1] (they route nothing)."""
+    rng = np.random.default_rng(seed)
+    lo = np.zeros(n_features, np.float64)
+    hi = np.ones(n_features, np.float64)
+    inner = table.feature >= 0
+    for f in range(n_features):
+        thrs = table.threshold[inner & (table.feature == f)]
+        if thrs.size:
+            t_lo, t_hi = float(thrs.min()), float(thrs.max())
+            pad = 0.1 * max(t_hi - t_lo, 1.0)
+            lo[f], hi[f] = t_lo - pad, t_hi + pad
+    X = rng.uniform(lo, hi, size=(rows, n_features)).astype(np.float32)
+    return X.astype(jnp.bfloat16).astype(np.float32)
+
+
+def exactness_report(table, prepared: np.ndarray, quant, *, kind: str,
+                     scale, n_steps: int, tol: float, calibration=None,
+                     n_features: int | None = None) -> dict:
+    """Max prediction delta of the quantized tables vs the f32 tables on
+    a calibration batch (numpy on both sides — same descent, same value
+    application, so the delta isolates QUANTIZATION, not tier noise)."""
+    q, vscale, vbase = quant
+    if n_features is None:
+        n_features = int(table.feature.max(initial=0)) + 1
+    X = (np.ascontiguousarray(np.asarray(calibration, np.float32))
+         if calibration is not None
+         else synthesize_calibration(table, n_features))
+    rows_ref = np.asarray(prepared, np.float32)
+    rows_q = dequantize(q, np.asarray(vscale), np.asarray(vbase))
+    thr_ref = np.nan_to_num(
+        np.asarray(table.threshold, np.float32), nan=0.0
+    )
+    thr_q = np.asarray(
+        quantize_thresholds(table.threshold), np.float32
+    )
+    n_out = rows_ref.shape[1]
+    ids_ref = _host_descend(
+        X, table.feature, thr_ref, table.left, table.right, table.root,
+        n_steps,
+    )
+    ids_q = _host_descend(
+        X, table.feature, thr_q, table.left, table.right, table.root,
+        n_steps,
+    )
+    ref = _host_apply(kind, ids_ref, rows_ref, float(scale), n_out)
+    got = _host_apply(kind, ids_q, rows_q, float(scale), n_out)
+    max_abs = float(np.max(np.abs(ref - got))) if len(X) else 0.0
+    denom = float(np.max(np.abs(ref))) if len(X) else 0.0
+    return {
+        "mode": "int8",
+        "max_abs_delta": max_abs,
+        "max_rel_delta": round(max_abs / denom, 6) if denom > 0 else 0.0,
+        "rows": int(len(X)),
+        "rerouted_rows": int((ids_ref != ids_q).any(axis=1).sum()),
+        "tolerance": float(tol),
+        "ok": bool(max_abs <= tol),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the jitted quantized traversal (the XLA tier's compressed twin)
+# ---------------------------------------------------------------------------
+
+def _descend_q(X, feature, threshold, left, right, root, n_steps: int):
+    """The unrolled descent over compressed columns: int16 feature ids
+    and bf16 thresholds upcast in-program (both upcasts exact), children
+    int32 as ever. Same clip-mode gathers, same leaf-hold rule as
+    ``traversal._descend``."""
+    node = jnp.broadcast_to(
+        root[None, :], (X.shape[0], root.shape[0])
+    ).astype(jnp.int32)
+    for _ in range(n_steps):
+        f = jnp.take(feature, node, mode="clip").astype(jnp.int32)
+        thr = jnp.take(threshold, node, mode="clip").astype(jnp.float32)
+        xf = jnp.take_along_axis(X, jnp.maximum(f, 0), axis=1)
+        nxt = jnp.where(
+            xf <= thr,
+            jnp.take(left, node, mode="clip"),
+            jnp.take(right, node, mode="clip"),
+        )
+        node = jnp.where(f < 0, node, nxt)
+    return node
+
+
+def _dequant_rows(qvals, ids, vscale, vbase):
+    """Gather int8 rows at ``ids`` then dequant the GATHERED slice (the
+    full-table dequant would materialize the f32 table this module
+    exists to avoid pinning)."""
+    g = jnp.take(qvals, ids, axis=0, mode="clip").astype(jnp.float32)
+    return vbase[None, :] + g * vscale[None, :]
+
+
+@partial(
+    jax.jit,
+    static_argnames=("kind", "n_steps"),
+    donate_argnums=(6,),
+)
+def q_traverse_accumulate(X, feature, threshold, left, right, root, acc0,
+                          qvals, vscale, vbase, scale, *, kind: str,
+                          n_steps: int):
+    """Descent + dequantized sequential ensemble reduction into the
+    donated ``acc0`` (same caller contract as
+    ``traversal.traverse_accumulate``: acc0 is staged fresh per
+    dispatch). Channels arrive PREPARED (forest count rows normalized at
+    build), so every kind reduces to a plain dequantized row sum."""
+    node = _descend_q(X, feature, threshold, left, right, root, n_steps)
+    if kind == "margin":
+        N, K = acc0.shape
+        rounds = node.shape[1] // K
+
+        def mbody(r, raw):
+            ids = lax.dynamic_slice(node, (0, r * K), (N, K))
+            g = jnp.take(qvals[:, 0], ids, mode="clip").astype(jnp.float32)
+            return raw + vbase[0] + g * vscale[0]
+
+        return lax.fori_loop(0, rounds, mbody, acc0)
+    if kind == "forest_mean":
+        def vbody(t, acc):
+            ids = jnp.take(node, t, axis=1, mode="clip")
+            g = jnp.take(qvals[:, 0], ids, mode="clip").astype(jnp.float32)
+            return acc + (vbase[0] + g * vscale[0])[:, None]
+
+        return lax.fori_loop(0, node.shape[1], vbody, acc0) / scale
+    if kind not in ("forest_proba", "forest_values"):
+        raise ValueError(f"unknown quantized accumulate kind {kind!r}")
+
+    def body(t, acc):
+        ids = jnp.take(node, t, axis=1, mode="clip")
+        return acc + _dequant_rows(qvals, ids, vscale, vbase)
+
+    return lax.fori_loop(0, node.shape[1], body, acc0) / scale
+
+
+@partial(jax.jit, static_argnames=("n_steps",))
+def q_traverse_gather(X, feature, threshold, left, right, root, qvals,
+                      vscale, vbase, *, n_steps: int):
+    """Single-tree float channel: descend, gather int8, dequant."""
+    node = _descend_q(X, feature, threshold, left, right, root, n_steps)
+    g = jnp.take(qvals[:, 0], node[:, 0], mode="clip").astype(jnp.float32)
+    return vbase[0] + g * vscale[0]
+
+
+def dispatch(Xp, state: QuantizedState, *, kind: str, n_steps: int,
+             acc0=None, scale=None, obs=None):
+    """One quantized request-path dispatch — the compile-note/attribution
+    twin of ``traversal.dispatch``, keyed under the SAME
+    ``serving_traverse`` entry (distinct ``"int8"`` element) so the
+    zero-new-compile-keys audit spans both table forms."""
+    key = (
+        kind, n_steps, "int8", Xp.shape,
+        state.qvals.shape, state.root.shape,
+        None if acc0 is None else acc0.shape,
+    )
+    with _NOTE_LOCK:
+        if obs is not None:
+            fresh = obs.compile_note("serving_traverse", key, cache_size=64)
+        else:
+            fresh = REGISTRY.note("serving_traverse", key, cache_size=64)
+
+    def run():
+        if kind == "gather_value":
+            return q_traverse_gather(
+                Xp, state.feature, state.threshold, state.left,
+                state.right, state.root, state.qvals, state.vscale,
+                state.vbase, n_steps=n_steps,
+            )
+        return q_traverse_accumulate(
+            Xp, state.feature, state.threshold, state.left, state.right,
+            state.root, acc0, state.qvals, state.vscale, state.vbase,
+            scale, kind=kind, n_steps=n_steps,
+        )
+
+    attr = (
+        obs.compile_attribution("serving_traverse", fresh)
+        if obs is not None else contextlib.nullcontext()
+    )
+    with attr:
+        return run()

@@ -36,18 +36,27 @@ class ModelRegistry:
         # registry under a model=<name> label for one scrape surface.
         self.metrics = MetricsRegistry()
 
-    def publish(self, name: str, model, *, warm: bool = True) -> CompiledModel:
+    def publish(self, name: str, model, *, warm: bool = True,
+                quantize=None, quantize_tol=None,
+                calibration=None) -> CompiledModel:
         """Compile (if needed) + warm ``model``, then swap it live.
 
         ``model``: a fitted estimator or an already-compiled
         :class:`CompiledModel`. Everything expensive happens BEFORE the
         pointer flip; requests racing the publish keep hitting the old
-        slot until the new one is warm.
+        slot until the new one is warm. ``quantize``/``quantize_tol``/
+        ``calibration`` pass through to ``compile_model`` — a
+        quantization REFUSAL (exactness past tolerance) therefore fails
+        the publish before the slot flips, leaving the old model
+        serving.
         """
         if not isinstance(model, CompiledModel):
             from mpitree_tpu.serving.model import compile_model
 
-            model = compile_model(model, buckets=self.buckets)
+            model = compile_model(
+                model, buckets=self.buckets, quantize=quantize,
+                quantize_tol=quantize_tol, calibration=calibration,
+            )
         t0 = time.perf_counter()
         if warm:
             model.warmup()
@@ -94,22 +103,28 @@ class ModelRegistry:
         with self._lock:
             return {k: dict(v) for k, v in self._meta.items()}
 
+    def metrics_families(self) -> list:
+        """The registry's family maps: its own publish/warm metrics plus
+        every published model's request-path registry stamped with a
+        ``model=<slot>`` label. The building blocks ``metrics_text``
+        renders — exposed so the scheduler can merge ITS families into
+        the same exposition (one ``# TYPE`` line per name)."""
+        with self._lock:
+            slots = dict(self._slots)
+        maps = [self.metrics.render_families()]
+        for name in sorted(slots):
+            maps.append(slots[name].metrics_families({"model": name}))
+        return maps
+
     def metrics_text(self) -> str:
-        """One Prometheus exposition for the whole registry: its own
-        publish/warm metrics plus every published model's request-path
-        registry stamped with a ``model=<slot>`` label (the scrape
+        """One Prometheus exposition for the whole registry (the scrape
         surface ``examples/serving_run.py``'s asyncio exporter serves).
         Families merge under ONE ``# TYPE`` line per name — the
         Prometheus parser rejects duplicates, so two published slots
         must share each family header (``obs.metrics.render_text``)."""
         from mpitree_tpu.obs.metrics import render_text
 
-        with self._lock:
-            slots = dict(self._slots)
-        maps = [self.metrics.render_families()]
-        for name in sorted(slots):
-            maps.append(slots[name].metrics_families({"model": name}))
-        return render_text(maps)
+        return render_text(self.metrics_families())
 
     # Request-path conveniences — one slot read, then the model's own
     # bucketed single-dispatch path.

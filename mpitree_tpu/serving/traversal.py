@@ -127,8 +127,16 @@ def flat_leaf_ids(X, feature, threshold, left, right, root, orig, *,
 #   margin        — boosting: staged baseline tile + lr * per-round
 #                   (N, K) value blocks, in round order (``_staged_raw``'s
 #                   accumulation, verbatim in f64).
+#   forest_values — per-tree PRE-NORMALIZED value rows, sequentially
+#                   accumulated then divided by T. Monotonic-constrained
+#                   forest classifiers ride this: the estimator gathers
+#                   each tree's clipped class-0 fraction (a per-NODE
+#                   quantity — ``clipped_class0``), so the row is final
+#                   at build time and the reduction is a pure add; the
+#                   forest_proba in-program normalization would re-derive
+#                   a DIFFERENT (unclipped) distribution from raw counts.
 GATHER_KINDS = ("gather_counts", "gather_value")
-ACC_KINDS = ("forest_proba", "forest_mean", "margin")
+ACC_KINDS = ("forest_proba", "forest_mean", "margin", "forest_values")
 
 
 @partial(jax.jit, static_argnames=("kind", "n_steps"))
@@ -181,10 +189,19 @@ def _margin(node, values, acc0, scale):
     return lax.fori_loop(0, rounds, body, acc0)
 
 
+def _forest_values(node, values, acc0, scale):
+    def body(t, acc):
+        ids = jnp.take(node, t, axis=1, mode="clip")
+        return acc + jnp.take(values, ids, axis=0, mode="clip")
+
+    return lax.fori_loop(0, node.shape[1], body, acc0) / scale
+
+
 _ACC_FNS = {
     "forest_proba": _forest_proba,
     "forest_mean": _forest_mean,
     "margin": _margin,
+    "forest_values": _forest_values,
 }
 
 

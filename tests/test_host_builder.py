@@ -114,7 +114,13 @@ def test_forest_host_equals_device():
 
 def test_host_is_fast_on_reference_sweep():
     """The reference's benchmark regime (degenerate tiny data,
-    experiments.ipynb cell 5) must run in milliseconds per fit."""
+    experiments.ipynb cell 5) must run in milliseconds per fit.
+
+    Median over interleaved repeats (the ISSUE 9 technique,
+    tests/test_obs.py): a one-shot wall bound flaked whenever the CI
+    runner descheduled the single timed fit — the median of repeats
+    shrugs off an asymmetric load spike without loosening the bound."""
+    import statistics
     import time
 
     from mpitree_tpu import native
@@ -123,9 +129,16 @@ def test_host_is_fast_on_reference_sweep():
     for n in (41, 141, 241):
         X = np.arange(n, dtype=np.float64).reshape(-1, 1)
         y = np.arange(n)
-        t0 = time.perf_counter()
-        DecisionTreeClassifier().fit(X, y)
-        assert time.perf_counter() - t0 < 0.5
+        DecisionTreeClassifier().fit(X, y)  # warm caches off the clock
+        walls = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            DecisionTreeClassifier().fit(X, y)
+            walls.append(time.perf_counter() - t0)
+        assert statistics.median(walls) < 0.5, (
+            f"n={n}: median fit {statistics.median(walls):.3f}s "
+            f"({sorted(walls)})"
+        )
 
 
 def test_native_kernel_thread_count_does_not_change_trees():

@@ -376,15 +376,40 @@ def test_serve_report_counters_and_decisions():
     assert "serving_traverse" in rep["compile"]
 
 
-def test_monotonic_forest_compile_refused():
+def test_monotonic_forest_classifier_parity():
+    # ISSUE 17 satellite: the constrained-forest serving channel is OPEN
+    # (it used to refuse). The clipped class-0 fraction is a per-NODE
+    # quantity — rows are final at build, ride the pure-add
+    # ``forest_values`` kind, and the estimator equivalence is
+    # bit-identical on the CPU tier.
     X, y = _cls_data(c=2)
     cst = np.zeros(X.shape[1], int)
     cst[0] = 1
     f = RandomForestClassifier(
         n_estimators=3, max_depth=4, random_state=0, monotonic_cst=cst
     ).fit(X, y)
-    with pytest.raises(NotImplementedError, match="monotonic"):
-        compile_model(f)
+    cm = compile_model(f)
+    assert cm.kind == "forest_values"
+    np.testing.assert_array_equal(cm.predict(X), f.predict(X))
+    np.testing.assert_allclose(
+        np.asarray(cm.predict_proba(X)), f.predict_proba(X),
+        rtol=0, atol=0,
+    )
+
+
+def test_monotonic_forest_regressor_parity():
+    # Regressor clipping is baked into count[:, 0] at fit time, so the
+    # constrained forest serves the ordinary mean channel bit-identically.
+    X, y = _reg_data()
+    cst = np.zeros(X.shape[1], int)
+    cst[0] = 1
+    f = RandomForestRegressor(
+        n_estimators=3, max_depth=4, random_state=0, monotonic_cst=cst
+    ).fit(X, y)
+    cm = compile_model(f)
+    np.testing.assert_allclose(
+        np.asarray(cm.predict(X)).ravel(), f.predict(X), rtol=0, atol=0
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -544,94 +569,8 @@ def test_serving_bench_headline_consumer(tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# ISSUE 10 satellite: batching fairness — per-request deadlines in the
-# example micro-batcher (ROADMAP item 1 follow-up). A large loose-deadline
-# burst must not starve a tight-deadline single-row request: the batcher
-# serves earliest-deadline-first, so the tight request rides the next
-# dispatch instead of waiting out the burst's backlog.
+# Batching fairness moved to the serving scheduler (ISSUE 17): the EDF
+# ordering / burst-cannot-starve / deadline-miss pins now live at
+# subsystem level in tests/test_serving_sched.py — the example
+# micro-batcher they exercised was replaced by serving.scheduler.
 # ---------------------------------------------------------------------------
-
-def _example_batcher():
-    import importlib
-    import os
-    import sys
-
-    path = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "examples",
-    )
-    if path not in sys.path:
-        sys.path.insert(0, path)
-    return importlib.import_module("serving_run")
-
-
-def test_microbatcher_deadline_respected_under_burst():
-    import asyncio
-    import time
-
-    sr = _example_batcher()
-
-    class SlowRegistry:
-        """Stub registry whose dispatch costs a fixed wall slice, so a
-        burst of many batches takes many slices to drain."""
-
-        def predict(self, name, batch):
-            time.sleep(0.02)
-            return [0] * len(batch)
-
-    async def scenario():
-        batcher = sr.MicroBatcher(
-            SlowRegistry(), "m", max_batch=8, max_wait_ms=1.0
-        )
-        server = asyncio.ensure_future(batcher.serve_forever())
-        burst_done: list[int] = []
-
-        async def burst_req(i):
-            # loose budget: the burst tolerates queueing behind itself
-            await batcher.request(np.zeros(4), deadline_ms=5000.0)
-            burst_done.append(i)
-
-        burst = [asyncio.ensure_future(burst_req(i)) for i in range(160)]
-        await asyncio.sleep(0.05)  # burst enqueued, several batches in
-        t0 = time.perf_counter()
-        await batcher.request(np.zeros(4), deadline_ms=60.0)
-        tight_latency = time.perf_counter() - t0
-        resolved_at_tight = len(burst_done)
-        await asyncio.gather(*burst)
-        server.cancel()
-        return tight_latency, resolved_at_tight, batcher
-
-    tight_latency, resolved_at_tight, batcher = asyncio.run(scenario())
-    # Scheduling-order pin (robust under machine load): when the tight
-    # request resolved, most of the 160-row burst was still queued behind
-    # it — 160 rows at 8/dispatch need 20 dispatches (>= 0.4s of 20ms
-    # slices), and FIFO would have served them all first.
-    assert resolved_at_tight < 80
-    # And the latency budget itself held with generous slack: one in-
-    # flight dispatch + its own dispatch, nowhere near the FIFO drain.
-    assert tight_latency < 0.25
-    assert max(batcher.batch_sizes) <= 8
-
-
-def test_microbatcher_counts_deadline_misses():
-    import asyncio
-    import time
-
-    sr = _example_batcher()
-
-    class SlowRegistry:
-        def predict(self, name, batch):
-            time.sleep(0.05)
-            return [0] * len(batch)
-
-    async def scenario():
-        batcher = sr.MicroBatcher(
-            SlowRegistry(), "m", max_batch=4, max_wait_ms=1.0
-        )
-        server = asyncio.ensure_future(batcher.serve_forever())
-        # an impossible budget: the dispatch alone exceeds it
-        await batcher.request(np.zeros(4), deadline_ms=1.0)
-        server.cancel()
-        return batcher.deadline_misses
-
-    assert asyncio.run(scenario()) == 1

@@ -1,0 +1,546 @@
+"""Serving v2 subsystem tests (ISSUE 17): the EDF continuous-batching
+scheduler with admission control + QoS classes, and the quantized node
+tables behind ``compile_model(quantize=)``.
+
+Scheduler pins (the ones the deleted example ``MicroBatcher`` tests
+carried now live here, at subsystem level):
+
+- **EDF ordering**: a tight-deadline arrival jumps a queued loose
+  backlog — deterministic via a gate-held worker, no sleeps-as-sync.
+- **Burst cannot starve**: under a ``sched_dispatch`` hang, admissions
+  shed loudly (typed ``RejectedRequest``) but every ADMITTED future
+  still resolves.
+- **Admission control**: all five typed reject reasons, per-(model, qos)
+  depth isolation, EWMA-feasibility shedding AND its idle-queue
+  recovery.
+- **PR-7 pins with the scheduler + quantize on**: zero new compile keys,
+  zero explicit device transfers on the warmed request path.
+
+Quantize pins: floor-rounded bf16 thresholds route lattice inputs
+identically to f32; the exactness report against an independent numpy
+oracle; refusal leaves the old registry slot serving; integer channels
+pass through; the Pallas int8-lattice tier matches the XLA quantized
+tier; the VMEM tier fits >2x the f32 ensemble.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from mpitree_tpu import (
+    DecisionTreeClassifier,
+    GradientBoostingClassifier,
+    RandomForestClassifier,
+)
+from mpitree_tpu.obs import REGISTRY
+from mpitree_tpu.obs import memory as memory_lib
+from mpitree_tpu.resilience import chaos
+from mpitree_tpu.resilience.chaos import Fault
+from mpitree_tpu.serving import (
+    ModelRegistry,
+    RejectedRequest,
+    Scheduler,
+    compile_model,
+    parse_qos,
+)
+from mpitree_tpu.serving import pallas_serve
+from mpitree_tpu.serving import quantize as quantize_lib
+from mpitree_tpu.serving.quantize import QuantizationError
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos(monkeypatch):
+    chaos.clear()
+    monkeypatch.delenv("MPITREE_TPU_CHAOS", raising=False)
+    monkeypatch.setenv("MPITREE_TPU_BACKOFF_S", "0")
+    yield
+    chaos.clear()
+
+
+def _cls_data(n=300, f=8, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] ** 2 + rng.normal(scale=0.3, size=n) > 0.4
+         ).astype(int)
+    if c > 2:
+        y = y + (X[:, 2] > 0.8).astype(int)
+    return X, y
+
+
+# CPU-scale QoS spec: the knob default targets accelerator latency and
+# would (honestly) shed on a CPU test runner.
+_QOS = "interactive:10000:64;batch:60000:64"
+
+
+# ---------------------------------------------------------------------------
+# deterministic scheduler harness: a gate-held stub model
+# ---------------------------------------------------------------------------
+
+class _GateModel:
+    """Stub CompiledModel: echoes row ids, blocks in raw() while the
+    gate is cleared — the deterministic 'worker is busy' lever."""
+
+    n_features = 2
+
+    def __init__(self, buckets=(1, 2), delay=0.0, n_out=1):
+        self.buckets = tuple(buckets)
+        self.delay = delay
+        self.n_out = n_out
+        self.gate = threading.Event()
+        self.gate.set()
+        self.entered = threading.Event()
+        self.calls = []   # list of per-dispatch row-id lists
+        self.missed = 0
+
+    def raw(self, X):
+        self.entered.set()
+        self.gate.wait(10)
+        if self.delay:
+            time.sleep(self.delay)
+        self.calls.append([int(r[0]) for r in X])
+        return np.repeat(
+            np.asarray(X[:, :1], np.float32), self.n_out, axis=1
+        )
+
+    def note_deadline_miss(self, n=1):
+        self.missed += n
+
+
+class _StubRegistry:
+    def __init__(self, models):
+        self._models = dict(models)
+
+    def get(self, name):
+        if name not in self._models:
+            raise KeyError(f"no model published as {name!r}")
+        return self._models[name]
+
+    def metrics_families(self):
+        return []
+
+
+def _hold(sched, model, mid=0.0):
+    """Park the worker inside model.raw: clear the gate, submit one
+    request, wait until the worker has actually entered raw()."""
+    model.gate.clear()
+    model.entered.clear()
+    f = sched.submit("m", [mid, 0.0], deadline_ms=30000)
+    assert model.entered.wait(10), "worker never reached raw()"
+    return f
+
+
+# ---------------------------------------------------------------------------
+# QoS grammar
+# ---------------------------------------------------------------------------
+
+def test_parse_qos_grammar_and_errors():
+    classes = parse_qos("interactive:50:256; batch:2000:4096;")
+    assert [c.name for c in classes] == ["interactive", "batch"]
+    assert classes[0].deadline_ms == 50.0
+    assert classes[1].queue_depth == 4096
+    for bad in ("", "a:b:c", "a:10", "a:-5:4", "a:10:0"):
+        with pytest.raises(ValueError):
+            parse_qos(bad)
+
+
+# ---------------------------------------------------------------------------
+# EDF ordering (migrated microbatcher pin, now deterministic)
+# ---------------------------------------------------------------------------
+
+def test_edf_tight_deadline_jumps_queued_backlog():
+    m = _GateModel(buckets=(1, 2))
+    with Scheduler(_StubRegistry({"m": m}), qos=_QOS, shed_depth=64,
+                   margin_ms=5, wait_ms=1) as s:
+        f0 = _hold(s, m)
+        # Loose backlog queues behind the held worker...
+        loose = [
+            s.submit("m", [i, 0.0], deadline_ms=20000 - i * 1000)
+            for i in (1, 2, 3, 4)
+        ]
+        # ...then a tight deadline arrives LAST.
+        tight = s.submit("m", [9, 0.0], deadline_ms=1000)
+        m.gate.set()
+        for f in [f0, tight, *loose]:
+            assert f.result(timeout=10).shape == (1,)
+        order = [i for batch in m.calls for i in batch]
+        # Dispatch order is EDF, not FIFO: the tight request leads the
+        # first post-hold batch, and the loose ones drain by deadline.
+        assert order == [0, 9, 4, 3, 2, 1]
+        assert s.stats()["dispatches"] == len(m.calls)
+
+
+def test_qos_depth_bound_sheds_only_that_class():
+    m = _GateModel(buckets=(1, 64))
+    spec = "interactive:10000:3;batch:60000:64"
+    with Scheduler(_StubRegistry({"m": m}), qos=spec, shed_depth=64,
+                   margin_ms=5, wait_ms=1) as s:
+        f0 = _hold(s, m)
+        admitted = [
+            s.submit("m", [i, 0.0], qos="interactive") for i in (1, 2, 3)
+        ]
+        with pytest.raises(RejectedRequest) as ei:
+            s.submit("m", [4, 0.0], qos="interactive")
+        assert ei.value.reason == "queue_full"
+        # Isolation: the flooded class sheds against ITS OWN bound; the
+        # other class still admits.
+        b = s.submit("m", [5, 0.0], qos="batch")
+        m.gate.set()
+        for f in [f0, b, *admitted]:
+            f.result(timeout=10)
+        assert s.stats()["shed"] == {"queue_full": 1}
+
+
+def test_typed_rejects_global_depth_unknowns_shutdown():
+    m = _GateModel(buckets=(1, 2))
+    s = Scheduler(_StubRegistry({"m": m}), qos=_QOS, shed_depth=2,
+                  margin_ms=5, wait_ms=1)
+    with pytest.raises(RejectedRequest) as ei:
+        s.submit("ghost", [0.0, 0.0])
+    assert ei.value.reason == "unknown_model"
+    with pytest.raises(RejectedRequest) as ei:
+        s.submit("m", [0.0, 0.0], qos="premium")
+    assert ei.value.reason == "unknown_class"
+    f0 = _hold(s, m)
+    f1 = s.submit("m", [1, 0.0])
+    f2 = s.submit("m", [2, 0.0])
+    with pytest.raises(RejectedRequest) as ei:  # global in-flight bound
+        s.submit("m", [3, 0.0])
+    assert ei.value.reason == "queue_full"
+    m.gate.set()
+    for f in (f0, f1, f2):
+        f.result(timeout=10)
+    s.close()
+    with pytest.raises(RejectedRequest) as ei:
+        s.submit("m", [0.0, 0.0])
+    assert ei.value.reason == "shutdown"
+    shed = s.stats()["shed"]
+    assert shed["queue_full"] == 1 and shed["shutdown"] == 1
+
+
+def test_deadline_feasibility_sheds_and_recovers():
+    m = _GateModel(buckets=(1, 2), delay=0.05)
+    with Scheduler(_StubRegistry({"m": m}), qos=_QOS, shed_depth=64,
+                   margin_ms=25, wait_ms=1) as s:
+        # Inside the close margin: infeasible even on an idle queue.
+        with pytest.raises(RejectedRequest) as ei:
+            s.submit("m", [0, 0.0], deadline_ms=10)
+        assert ei.value.reason == "deadline_infeasible"
+        # Teach the EWMA the model is ~50ms.
+        s.submit("m", [1, 0.0]).result(timeout=10)
+        f0 = _hold(s, m, mid=2)
+        q = s.submit("m", [3, 0.0])  # backlog ahead of the next arrival
+        with pytest.raises(RejectedRequest) as ei:
+            s.submit("m", [4, 0.0], deadline_ms=30)  # 30ms < ~50ms EWMA
+        assert ei.value.reason == "deadline_infeasible"
+        m.gate.set()
+        f0.result(timeout=10)
+        q.result(timeout=10)
+        assert s.drain(10)
+        # RECOVERY: the same 30ms deadline on an IDLE queue is admitted
+        # (dispatching is the only way the estimate corrects itself —
+        # worst case is one recorded miss, never a permanent lockout).
+        m.delay = 0.0
+        out = s.submit("m", [5, 0.0], deadline_ms=30).result(timeout=10)
+        assert out[0] == 5.0
+        assert s.stats()["shed"]["deadline_infeasible"] == 2
+
+
+def test_deadline_miss_counted_and_reported_to_model():
+    m = _GateModel(buckets=(1, 2), delay=0.08)
+    with Scheduler(_StubRegistry({"m": m}), qos=_QOS, shed_depth=8,
+                   margin_ms=5, wait_ms=1) as s:
+        # No estimate yet -> admitted (never guess); the dispatch then
+        # overruns the deadline and the miss is counted on BOTH sides.
+        s.submit("m", [0, 0.0], deadline_ms=20).result(timeout=10)
+        st = s.stats()
+    assert st["deadline_misses"] == 1
+    assert m.missed == 1
+    assert st["class_latency_ms"]["interactive"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# real registry: burst/hang, blip requeue, raw parity, PR-7 pins
+# ---------------------------------------------------------------------------
+
+def _registry(quantize=None, buckets=(1, 8, 64)):
+    X, y = _cls_data()
+    clf = RandomForestClassifier(
+        n_estimators=4, max_depth=4, random_state=0
+    ).fit(X, y)
+    reg = ModelRegistry(buckets=buckets)
+    reg.publish("rf", clf, quantize=quantize)
+    return reg, X
+
+
+def test_burst_sheds_loudly_but_cannot_starve_admitted():
+    reg, X = _registry()
+    spec = "interactive:10000:16;batch:60000:24"
+    with Scheduler(reg, qos=spec, shed_depth=32, margin_ms=5,
+                   wait_ms=1) as s:
+        with chaos.active(
+            Fault("sched_dispatch", 1, "hang", 0.3)
+        ) as plan:
+            admitted, shed = [], 0
+            for i in range(200):
+                try:
+                    admitted.append(s.submit(
+                        "rf", X[i % len(X)],
+                        qos="interactive" if i % 2 else "batch",
+                    ))
+                except RejectedRequest as e:
+                    assert e.reason in ("queue_full",
+                                        "deadline_infeasible")
+                    shed += 1
+            assert shed > 0, "burst never hit the admission bounds"
+            # The starvation pin: every ADMITTED future resolves.
+            for f in admitted:
+                out = f.result(timeout=30)
+                assert out.shape == (3,) and np.isfinite(out).all()
+        assert plan.fired == [("sched_dispatch", 1, "hang")]
+        st = s.stats()
+        assert sum(st["shed"].values()) == shed
+        # Both classes recover admission after the burst drains.
+        for q in ("interactive", "batch"):
+            s.submit("rf", X[0], qos=q).result(timeout=10)
+
+
+def test_dispatch_blip_requeues_once_with_correct_results():
+    reg, X = _registry()
+    cm = reg.get("rf")
+    with Scheduler(reg, qos=_QOS, shed_depth=64, margin_ms=5,
+                   wait_ms=1) as s:
+        with chaos.active(Fault("sched_dispatch", 1, "unavailable")):
+            futs = [s.submit("rf", X[i]) for i in range(3)]
+            got = np.stack([f.result(timeout=30) for f in futs])
+        assert s.stats()["requeues"] >= 1
+    np.testing.assert_allclose(got, cm.raw(X[:3]), rtol=0, atol=1e-6)
+
+
+def test_scheduled_results_match_direct_raw():
+    reg, X = _registry(quantize="int8")
+    cm = reg.get("rf")
+    assert cm.quantize == "int8"
+    with Scheduler(reg, qos=_QOS, shed_depth=256, margin_ms=5,
+                   wait_ms=2) as s:
+        futs = [
+            s.submit("rf", X[i], qos="interactive" if i % 3 else "batch")
+            for i in range(40)
+        ]
+        got = np.stack([f.result(timeout=30) for f in futs])
+    # Coalescing must be invisible: per-row results equal the direct
+    # whole-batch dispatch regardless of how the scheduler batched them.
+    np.testing.assert_allclose(got, cm.raw(X[:40]), rtol=0, atol=1e-6)
+
+
+def test_scheduler_quantized_zero_new_keys_zero_transfers(monkeypatch):
+    """The PR-7 pins with BOTH ISSUE-17 features on: scheduler batches
+    ride the warm bucket shapes (zero new compile keys) and touch no
+    explicit device_put on the request path."""
+    reg, X = _registry(quantize="int8")
+    with Scheduler(reg, qos=_QOS, shed_depth=256, margin_ms=5,
+                   wait_ms=2) as s:
+        s.submit("rf", X[0]).result(timeout=30)  # scheduler warm pass
+        n0 = REGISTRY.count("serving_traverse")
+        calls = []
+        real = jax.device_put
+        monkeypatch.setattr(
+            jax, "device_put",
+            lambda *a, **k: calls.append(a) or real(*a, **k),
+        )
+        futs = [s.submit("rf", X[i % len(X)]) for i in range(30)]
+        for f in futs:
+            f.result(timeout=30)
+        assert s.drain(10)
+    assert REGISTRY.count("serving_traverse") == n0
+    assert calls == []
+
+
+def test_metrics_text_merges_families_under_single_type_lines():
+    reg, X = _registry()
+    with Scheduler(reg, qos=_QOS, shed_depth=64, margin_ms=5,
+                   wait_ms=1) as s:
+        s.submit("rf", X[0]).result(timeout=30)
+        with pytest.raises(RejectedRequest):
+            s.submit("ghost", X[0])
+        text = s.metrics_text()
+    for needle in (
+        'mpitree_sched_shed_total{reason="unknown_model"} 1',
+        "mpitree_sched_dispatches_total 1",
+        "mpitree_sched_queue_depth{",
+        "mpitree_sched_class_latency_seconds",
+        "mpitree_serving_request_seconds",  # the registry's family
+    ):
+        assert needle in text, f"missing {needle!r}"
+    types = [ln.split()[2] for ln in text.splitlines()
+             if ln.startswith("# TYPE")]
+    assert len(types) == len(set(types)), "duplicate # TYPE families"
+
+
+# ---------------------------------------------------------------------------
+# quantized node tables
+# ---------------------------------------------------------------------------
+
+def test_quantize_thresholds_floor_property():
+    rng = np.random.default_rng(3)
+    t = np.concatenate([
+        rng.normal(scale=30.0, size=4000).astype(np.float32),
+        np.float32([0.0, 1.0, -1.0, 2.5, 1e-30, -1e-30, 3.1e38]),
+    ])
+    q = quantize_lib.quantize_thresholds(t)
+    qf = np.asarray(q, np.float32)
+    # Floor semantics: q is the largest bf16 <= t...
+    assert (qf <= t).all()
+    bits = np.asarray(q).view(np.uint16).astype(np.int64)
+    # One bf16 ulp toward +inf: magnitude up for positives, magnitude
+    # down for negatives, smallest positive subnormal from zero.
+    up = np.where(qf > 0, bits + 1, np.where(qf < 0, bits - 1, 0x0001))
+    nxt = up.astype(np.uint16).view(np.asarray(q).dtype).astype(
+        np.float32
+    )
+    # ...so the misroute gap (q, t] holds NO bf16 lattice point: the
+    # next representable above q already overshoots t.
+    assert (nxt > t).all()
+
+
+def test_lattice_inputs_route_identically_after_quantization():
+    X, y = _cls_data(n=400)
+    clf = RandomForestClassifier(
+        n_estimators=3, max_depth=6, random_state=1
+    ).fit(X, y)
+    cm = compile_model(clf, quantize="int8", buckets=(64,))
+    tb = cm.table
+    Xc = quantize_lib.synthesize_calibration(tb, cm.n_features, rows=512)
+    assert np.array_equal(
+        Xc, Xc.astype(np.dtype("float32")).astype(
+            np.float32))  # sanity: f32
+    thr_ref = np.nan_to_num(np.asarray(tb.threshold, np.float32), nan=0.0)
+    thr_q = np.asarray(
+        quantize_lib.quantize_thresholds(tb.threshold), np.float32
+    )
+    args = (tb.feature, tb.left, tb.right, tb.root, tb.n_steps)
+    ids_ref = quantize_lib._host_descend(
+        Xc, args[0], thr_ref, args[1], args[2], args[3], args[4]
+    )
+    ids_q = quantize_lib._host_descend(
+        Xc, args[0], thr_q, args[1], args[2], args[3], args[4]
+    )
+    # bf16-lattice inputs route IDENTICALLY (the floor theorem): the
+    # default calibration isolates VALUE error, and the report says so.
+    assert np.array_equal(ids_ref, ids_q)
+    assert cm._quant.report["rerouted_rows"] == 0
+
+
+def test_quantized_exactness_vs_independent_host_oracle():
+    reg, X = _registry(quantize="int8")
+    cm = reg.get("rf")
+    rep = cm.serve_report_["quantization"]
+    assert rep["mode"] == "int8" and rep["ok"]
+    assert rep["max_abs_delta"] <= rep["tolerance"]
+    # Independent oracle: numpy descent over the QUANTIZED arrays +
+    # dequantized rows must reproduce what the XLA tier serves.
+    st = cm._quant
+    ids = quantize_lib._host_descend(
+        X[:64], np.asarray(st.feature, np.int64),
+        np.asarray(st.threshold, np.float32), np.asarray(st.left),
+        np.asarray(st.right), np.asarray(st.root), cm.table.n_steps,
+    )
+    want = quantize_lib._host_apply(
+        cm.kind, ids, st.rows_host, cm._scale_host, cm.n_out
+    )
+    np.testing.assert_allclose(cm.raw(X[:64]), want, rtol=0, atol=2e-6)
+
+
+def test_quantize_refusal_is_typed_and_keeps_old_slot_serving():
+    X, y = _cls_data()
+    clf = RandomForestClassifier(
+        n_estimators=4, max_depth=4, random_state=0
+    ).fit(X, y)
+    reg = ModelRegistry(buckets=(64,))
+    reg.publish("rf", clf)
+    before = reg.predict("rf", X[:8])
+    with pytest.raises(QuantizationError) as ei:
+        reg.publish("rf", clf, quantize="int8", quantize_tol=1e-12)
+    assert ei.value.report["ok"] is False
+    assert ei.value.report["max_abs_delta"] > 1e-12
+    # The refusal failed the publish BEFORE the slot flip: generation 1
+    # (f32 tables) still serves.
+    assert reg.models()["rf"]["generation"] == 1
+    assert reg.get("rf").quantize is None
+    np.testing.assert_array_equal(reg.predict("rf", X[:8]), before)
+
+
+def test_integer_channel_passes_through_unquantized():
+    X, y = _cls_data()
+    t = DecisionTreeClassifier(max_depth=4).fit(X, y)
+    cm = compile_model(t, quantize="int8", buckets=(64,))
+    # Single-tree label gathers are exact AND minimal already: an int8
+    # affine could only add error, so quantize resolves to off.
+    assert cm.quantize is None and cm.exact
+    assert cm.serve_report_["quantization"] == {"mode": "off"}
+    np.testing.assert_array_equal(cm.predict(X[:32]), t.predict(X[:32]))
+
+
+def test_quantized_pallas_kernel_matches_xla_tier():
+    """The Mosaic tier's int8 raw-lattice value blocks + ONE post-kernel
+    affine serve exactly what the XLA quantized tier serves (the affine
+    is linear across the ensemble sum — only f32 rounding remains)."""
+    reg, X = _registry(quantize="int8", buckets=(64,))
+    cm = reg.get("rf")
+    trees = cm.trees
+    tbl, _ = pallas_serve.build_kernel_tables_quantized(trees)
+    per = cm._quant.q_rows_per_tree(trees, cm.table)
+    kv = cm.n_out
+    vals = pallas_serve.build_kernel_values(
+        trees, lambda t: per[id(t)], kv, dtype=np.int8
+    )
+    raw = pallas_serve.traverse_batch_pallas(
+        X[:40], tbl, vals, n_steps=cm.table.n_steps, agg="sum",
+        n_out=kv, kv=kv, row_tile=64, interpret=True, quantized=True,
+    )
+    vs = np.asarray(cm._quant.vscale, np.float32)
+    vb = np.asarray(cm._quant.vbase, np.float32)
+    T = len(trees)
+    got = (np.asarray(raw)[:40, :kv] * vs[None, :kv]
+           + T * vb[None, :kv]) / np.float32(cm._scale_host)
+    np.testing.assert_allclose(got, cm.raw(X[:40]), rtol=0, atol=1e-6)
+
+
+def test_quantized_vmem_tier_fits_over_2x_the_ensemble():
+    """The capacity claim, priced through the ONE source
+    (obs.memory.serve_kernel_row_tile): at the bench shape the int8
+    tier admits >2x the nodes the f32 tier does."""
+
+    def max_nodes(quantized):
+        lo, hi = 128, 1 << 22
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            if memory_lib.serve_kernel_row_tile(
+                mid, 54, 1, 7, quantized=quantized
+            ) is not None:
+                lo = mid
+            else:
+                hi = mid - 1
+        return lo
+
+    f32, int8 = max_nodes(False), max_nodes(True)
+    assert int8 / f32 > 2.0, f"capacity ratio {int8 / f32:.2f} <= 2"
+
+
+def test_affine_int8_roundtrip_error_bounded_by_half_step():
+    rng = np.random.default_rng(7)
+    prep = np.concatenate([
+        rng.normal(scale=4.0, size=(200, 3)).astype(np.float32),
+        np.full((8, 3), 2.5, np.float32),     # constant block
+    ])
+    prep[:, 2] = 1.25                          # constant CHANNEL: exact
+    q, scale, base = quantize_lib.affine_int8(prep)
+    assert q.dtype == np.int8
+    deq = quantize_lib.dequantize(q, scale, base)
+    err = np.abs(deq - prep)
+    assert (err <= scale[None, :] / 2 + 1e-7).all()
+    np.testing.assert_array_equal(deq[:, 2], prep[:, 2])
