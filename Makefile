@@ -7,7 +7,7 @@ PY ?= python
 
 .PHONY: test test-cpu lint lint-graft lint-baseline knob-check bench \
   bench-tpu report trace-smoke mem-smoke flight-smoke chaos-smoke \
-  ingest-smoke serve-smoke bench-diff clean
+  ingest-smoke serve-smoke cost-smoke bench-diff clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -104,6 +104,14 @@ ingest-smoke:
 # Exit-code-validated; CPU-safe, seconds.
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PY) examples/serving_sched_run.py
+
+# Observability v5 gate (ISSUE 18): priced fit -> per-entry utilization
+# + roofline verdict + util trace track, honest None on unknown
+# platforms, and the evidence loop (seeded flight store flips an auto
+# policy with a typed advisor decision; off-gate restores the static
+# one). Exit-code-validated; CPU-safe, seconds.
+cost-smoke:
+	JAX_PLATFORMS=cpu $(PY) examples/obs_cost_run.py
 
 # Regression gate over the committed CPU baselines (tools/benchdiff over
 # BENCH_r*.json): newest round vs the previous parseable one, noise

@@ -117,7 +117,18 @@ def baseline_verdict(sec: str, payload: dict, prior_lines: list):
         return None, "no stored baseline for this section yet"
     diff_mod = _obs_module("diff")
     d = diff_mod.diff_payloads(history[-1], payload, history=history)
-    return d, diff_mod.summary_line(d, label=sec)
+    # Threshold provenance in the self-report line: whether the verdict
+    # was gated by this lineage's own measured dispersion or (thin
+    # history) by the documented floors — a floor-gated "ok" is a weaker
+    # claim and should read like one.
+    n = len(history)
+    prov = (
+        f"thresholds from stored dispersion (n={n})"
+        if n >= diff_mod.MIN_HISTORY else
+        f"thin history (n={n} < {diff_mod.MIN_HISTORY}): floor "
+        "thresholds only"
+    )
+    return d, f"{diff_mod.summary_line(d, label=sec)} [{prov}]"
 
 
 def flight_append_section(sec: str, payload: dict, platform: str) -> None:
@@ -130,7 +141,8 @@ def flight_append_section(sec: str, payload: dict, platform: str) -> None:
         if not flight.enabled():
             return
         diff_mod = _obs_module("diff")
-        flight.FlightStore().append(
+        store = flight.FlightStore()
+        store.append(
             kind="bench", section=sec,
             metrics=diff_mod.scalar_metrics(payload),
             digest=(payload.get("record") or {}),
@@ -138,6 +150,29 @@ def flight_append_section(sec: str, payload: dict, platform: str) -> None:
                     "refine_depth": REFINE_DEPTH},
             platform=platform, git=_git_head(),
         )
+        # The north-star sections embed their sibling-subtraction A/B as
+        # a NESTED dict, which scalar_metrics (top-level only) cannot
+        # see — append it as its own section="subtraction_ab" envelope
+        # so the advisor (obs/advisor.py) has a queryable lineage. The
+        # parent payload's shape keys ride along for nearest-workload
+        # matching.
+        sub = payload.get("subtraction_ab")
+        if isinstance(sub, dict):
+            shape = {
+                k: payload[k]
+                for k in ("n_samples", "n_features", "n_bins")
+                if isinstance(payload.get(k), (int, float))
+            }
+            store.append(
+                kind="bench", section="subtraction_ab",
+                metrics={**diff_mod.scalar_metrics(sub), **shape},
+                digest=(
+                    (sub.get("main") or {}).get("record") or {}
+                ),
+                config={"section": "subtraction_ab", "depth": DEPTH,
+                        "refine_depth": REFINE_DEPTH},
+                platform=platform, git=_git_head(),
+            )
     except Exception as e:  # noqa: BLE001 — telemetry, not the capture
         print(f"[bench-tpu] {sec}: flight append failed "
               f"({type(e).__name__}: {e})", file=sys.stderr)
@@ -231,6 +266,7 @@ RECORD_DIGEST_KEYS = (
     "events", "wire_bytes", "wire_shard_bytes", "feature_shards",
     "hbm_peak_bytes", "host_peak_bytes", "fingerprint",
     "level_retries", "oom_rescues",
+    "util_pct", "roofline",
     "wall_s",
 )
 
@@ -351,6 +387,10 @@ def _north_star(npz_path: str, engine_env: str | None) -> dict:
         engine_env=engine_env,
     )
     out["platform"] = platform
+    # Workload shape keys: land in the flight envelope's metrics, where
+    # the advisor's nearest-workload matching reads them.
+    out["n_samples"] = int(Xtr.shape[0])
+    out["n_features"] = int(Xtr.shape[1])
     if engine_env:
         out["engine"] = engine_env
     out["test_acc"] = round(float((clf.predict(Xte) == yte).mean()), 4)
@@ -943,6 +983,7 @@ def worker_gbdt_fusedK(npz_path: str) -> dict:
     iters, K = 16, 8
     out: dict = {
         "platform": platform, "max_iter": iters, "max_depth": 4, "K": K,
+        "n_samples": int(Xtr.shape[0]), "n_features": int(Xtr.shape[1]),
     }
 
     def side(rpd):
@@ -1020,6 +1061,7 @@ def worker_serving(npz_path: str) -> dict:
         "platform": platform,
         "n_trees": len(clf.trees_),
         "fit_rows": fit_rows,
+        "n_features": int(Xtr.shape[1]),
         "fit_s": round(fit_s, 3),
         "record": record_digest(clf.fit_report_),
     }
@@ -1040,6 +1082,10 @@ def worker_serving(npz_path: str) -> dict:
     out["publish_warm_s"] = round(time.perf_counter() - t0, 3)
     out["serving_exact"] = bool(model.exact)
     out["kernel"] = "pallas" if model._use_kernel else "xla"
+    # Numeric twin of the kernel string: strings never reach the flight
+    # envelope's metrics (scalar_metrics skips them), and the advisor's
+    # serving consultation groups rows by this 0/1.
+    out["kernel_pallas"] = int(model._use_kernel)
 
     lowerings0 = REGISTRY.count("serving_traverse")
     rng = np.random.default_rng(0)
@@ -1170,7 +1216,9 @@ def worker_mesh2d_ab(npz_path: str) -> dict:
         return {"skipped": f"needs >= 2 devices, have {D}",
                 "platform": platform}
     D = D if D % 2 == 0 else D - 1
-    out: dict = {"platform": platform, "n_devices": D, "depth": DEPTH}
+    out: dict = {"platform": platform, "n_devices": D, "depth": DEPTH,
+                 "n_samples": int(Xtr.shape[0]),
+                 "n_features": int(Xtr.shape[1])}
     for name, shape in (("mesh_1d", (D, 1)), ("mesh_2d", (D // 2, 2))):
         def once():
             clf = DecisionTreeClassifier(
@@ -1546,11 +1594,16 @@ def main() -> int:
     p.add_argument("--platform", default="auto",
                    help="jax platform for every section (auto = probe, "
                         "falling back to cpu when the accelerator hangs)")
-    p.add_argument("--baseline", action="store_true",
+    p.add_argument("--baseline", action="store_true", default=True,
                    help="diff each captured section against its newest "
                         "stored capture (obs.diff; noise thresholds from "
                         "the section's stored dispersion) and self-report "
-                        "regressions per section")
+                        "regressions per section (DEFAULT ON since "
+                        "ISSUE 18 — a perf harness that does not read "
+                        "its own history is a logger, not a sentinel)")
+    p.add_argument("--no-baseline", dest="baseline", action="store_false",
+                   help="capture without the self-diff (the pre-18 "
+                        "default)")
     args = p.parse_args()
 
     if args.report:

@@ -1,0 +1,201 @@
+"""obs.cost + obs.advisor smoke: price a fit -> roofline -> close the loop.
+
+The CI gate for the observability-v5 contract (ISSUE 18, wired as
+``make cost-smoke``), mirroring ``obs_flight_run``'s role for the
+flight-recorder schema. Checks, each exiting nonzero on failure:
+
+1. **priced fit** — with the peak knobs set, a device-engine fit carries
+   ``record.compute``: per-entry flops/bytes from the XLA cost model,
+   dispatch counts joined from the record's own channels, achieved
+   utilization against the optimal-seconds floor, and a roofline
+   verdict; the digest carries ``util_pct``/``roofline``.
+2. **honest unknown** — without peak knobs on this CPU smoke box the
+   ledger prices to ``None`` everywhere (source="unknown"), never a
+   guessed number and never a crash.
+3. **util trace track** — the priced record synthesizes a ``util``
+   counter track that passes the golden Chrome-trace validation.
+4. **evidence loop** — a flight store seeded with ``subtraction_ab``
+   A/B history (measured winner: on) flips the CPU ``auto`` policy to
+   ``hist_subtraction=on`` with a typed ``advisor_hist_subtraction``
+   decision; ``policy_evidence="off"`` restores the static resolution
+   with no consultation recorded.
+
+Run:  python examples/obs_cost_run.py  (CPU-safe, ~seconds)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the device engine: the auto router sends this smoke workload to
+# the pure-host tier, which dispatches no XLA program to price.
+os.environ.setdefault("MPITREE_TPU_ENGINE", "levelwise")
+os.environ.setdefault("MPITREE_TPU_PROFILE", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+FAILURES: list[str] = []
+
+
+def check(ok: bool, what: str) -> None:
+    tag = "ok" if ok else "FAIL"
+    print(f"[{tag}] {what}")
+    if not ok:
+        FAILURES.append(what)
+
+
+def _data(n=800, f=8, seed=7):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = ((X[:, 0] > 0) + (X[:, 1] > 0.5)).astype(np.int64)
+    return X, y
+
+
+def priced_fit_checks(tmp: str) -> None:
+    from mpitree_tpu.models.classifier import DecisionTreeClassifier
+    from mpitree_tpu.obs import digest
+    from mpitree_tpu.obs import trace as trace_mod
+
+    # Modest synthetic peaks: a real part's peak would round this smoke
+    # workload's utilization to 0.00 at two decimals.
+    os.environ["MPITREE_TPU_PEAK_FLOPS"] = "1e9"
+    os.environ["MPITREE_TPU_PEAK_HBM_GBPS"] = "1"
+    trace_path = os.path.join(tmp, "cost.trace.json")
+    try:
+        clf = DecisionTreeClassifier(
+            max_depth=4, max_bins=32, backend="cpu"
+        ).fit(*_data(), trace_to=trace_path)
+    finally:
+        del os.environ["MPITREE_TPU_PEAK_FLOPS"]
+        del os.environ["MPITREE_TPU_PEAK_HBM_GBPS"]
+
+    comp = clf.fit_report_.get("compute") or {}
+    entries = comp.get("entries") or {}
+    check(bool(entries), "priced fit carries record.compute entries")
+    split = entries.get("split_fn") or {}
+    check(
+        (split.get("flops") or 0) > 0 and (split.get("bytes") or 0) > 0,
+        "split_fn carries XLA cost-model flops + bytes",
+    )
+    check(
+        isinstance(split.get("util_pct"), float)
+        and split["util_pct"] > 0
+        and split.get("dispatches"),
+        "split_fn joins dispatches x floor against its measured wall",
+    )
+    check(
+        comp.get("roofline") in ("compute", "hbm", "ici"),
+        f"roofline verdict present ({comp.get('roofline')!r})",
+    )
+    d = digest(clf.fit_report_)
+    check(
+        d.get("util_pct") == comp.get("util_pct")
+        and d.get("roofline") == comp.get("roofline"),
+        "digest carries util_pct + roofline",
+    )
+
+    with open(trace_path) as f:
+        tr = json.load(f)
+    check(
+        trace_mod.validate_trace(tr) == [],
+        "priced trace passes the golden Chrome-trace validation",
+    )
+    utils = [
+        e for e in tr["traceEvents"]
+        if e.get("ph") == "C" and e.get("name") == "util_pct"
+    ]
+    check(len(utils) >= 2, "util counter track synthesized in the trace")
+
+
+def honest_unknown_checks() -> None:
+    from mpitree_tpu.models.classifier import DecisionTreeClassifier
+    from mpitree_tpu.obs import platform_peaks
+
+    peaks = platform_peaks("Strange Accelerator 9000")
+    check(
+        peaks["source"] == "unknown" and peaks["flops"] is None,
+        "unknown platform prices to honest None",
+    )
+    clf = DecisionTreeClassifier(
+        max_depth=3, max_bins=16, backend="cpu"
+    ).fit(*_data(400, 6))
+    comp = clf.fit_report_.get("compute") or {}
+    check(
+        comp.get("util_pct") is None and comp.get("roofline") is None,
+        "unpriced CPU fit keeps util/roofline None (no guessing)",
+    )
+
+
+def evidence_loop_checks(run_dir: str) -> None:
+    from mpitree_tpu.models.classifier import DecisionTreeClassifier
+    from mpitree_tpu.obs import FlightStore
+
+    X, y = _data()
+    store = FlightStore(run_dir)
+    shape = {"n_samples": X.shape[0], "n_features": X.shape[1],
+             "n_bins": 32}
+    for v in (1.38, 1.42, 1.40, 1.45):
+        store.append(
+            kind="bench", section="subtraction_ab", platform="cpu",
+            metrics={"warm_speedup_on_vs_off": v, **shape},
+        )
+
+    os.environ["MPITREE_TPU_RUN_DIR"] = run_dir
+    try:
+        clf = DecisionTreeClassifier(
+            max_depth=4, max_bins=32, backend="cpu"
+        ).fit(X, y)
+    finally:
+        del os.environ["MPITREE_TPU_RUN_DIR"]
+    dec = clf.fit_report_["decisions"]
+    adv = dec.get("advisor_hist_subtraction") or {}
+    check(
+        adv.get("value") == "on"
+        and (adv.get("inputs") or {}).get("fallback") is None,
+        "seeded A/B evidence picks the measured winner (typed decision)",
+    )
+    check(
+        dec.get("hist_subtraction", {}).get("value") == "on",
+        "evidence flips the CPU static policy to subtraction=on",
+    )
+
+    # the off gate restores the static resolution, no consultation
+    os.environ["MPITREE_TPU_RUN_DIR"] = run_dir
+    os.environ["MPITREE_TPU_POLICY_EVIDENCE"] = "off"
+    try:
+        clf_off = DecisionTreeClassifier(
+            max_depth=4, max_bins=32, backend="cpu"
+        ).fit(X, y)
+    finally:
+        del os.environ["MPITREE_TPU_RUN_DIR"]
+        del os.environ["MPITREE_TPU_POLICY_EVIDENCE"]
+    dec_off = clf_off.fit_report_["decisions"]
+    check(
+        "advisor_hist_subtraction" not in dec_off
+        and dec_off.get("hist_subtraction", {}).get("value") == "off",
+        "policy_evidence=off restores the static policy bit-for-bit",
+    )
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory() as tmp:
+        priced_fit_checks(tmp)
+        honest_unknown_checks()
+        evidence_loop_checks(os.path.join(tmp, "runs"))
+    if FAILURES:
+        print(f"\n{len(FAILURES)} check(s) failed:")
+        for f in FAILURES:
+            print(f"  - {f}")
+        return 1
+    print("\nall obs.cost / obs.advisor checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -85,7 +85,9 @@ def resolve_rounds_per_dispatch(param, *, platform: str, loss_kind,
                                 max_leaf_nodes, n_samples=None,
                                 n_features=None, n_bins=None,
                                 hist_budget_bytes=None,
-                                feature_shards: int = 1) -> tuple:
+                                feature_shards: int = 1,
+                                policy_evidence: str = "auto",
+                                obs=None) -> tuple:
     """Resolve the estimator's ``rounds_per_dispatch`` into (K, reason).
 
     Follows the engine-resolution idiom: the env var steers the "auto"
@@ -175,6 +177,35 @@ def resolve_rounds_per_dispatch(param, *, platform: str, loss_kind,
     if flag == "auto":
         if blockers:
             return 1, env_note + "auto: " + "; ".join(blockers)
+        # Evidence consultation (obs/advisor.py, ISSUE 18): stored
+        # gbdt_fusedK A/Bs on this platform may replace the static
+        # platform preference — AFTER the blockers, which are hard
+        # eligibility constraints no measurement overrides.
+        from mpitree_tpu.obs import advisor
+
+        adv = advisor.advise_rounds_per_dispatch(
+            platform=platform, policy_evidence=policy_evidence,
+            shape={
+                k: int(v) for k, v in (
+                    ("n_samples", n_samples), ("n_features", n_features),
+                    ("n_bins", n_bins),
+                ) if v is not None
+            },
+        )
+        advisor.record_advice(obs, adv)
+        if adv is not None and adv["value"] == "host":
+            return 1, env_note + (
+                "evidence: the host per-round loop measured faster on "
+                f"this platform (gbdt_fusedK history, n="
+                f"{adv['evidence_n']}, median speedup {adv['median']}x)"
+            )
+        if adv is not None and adv["value"] == "fused":
+            k_ev = int(adv.get("K") or DEFAULT_ROUNDS_PER_DISPATCH)
+            return k_ev, env_note + (
+                f"evidence: K={k_ev} fused rounds measured "
+                f"{adv['median']}x faster than the host loop "
+                f"(gbdt_fusedK history, n={adv['evidence_n']})"
+            )
         if platform not in ("tpu", "axon"):
             return 1, env_note + (
                 "auto: host-per-round on XLA-CPU — dispatch is cheap "
@@ -420,6 +451,9 @@ def run_fused_rounds(*, binned, y_tr, sw_tr, raw_tr, trees, train_scores,
     use_sub = resolve_hist_subtraction(
         cfg, platform, "gbdt", integer_ok=False, gbdt_x64=gbdt_x64,
         total_weight=total_w, obs=obs,
+        shape={"n_samples": int(N),
+               "n_features": int(binned.x_binned.shape[1]),
+               "n_bins": int(binned.n_bins)},
     )
     Pn = leafwise._pool_capacity(
         cfg.max_leaf_nodes if cfg.max_leaf_nodes is not None else 1 << 30,
@@ -518,6 +552,11 @@ def run_fused_rounds(*, binned, y_tr, sw_tr, raw_tr, trees, train_scores,
                 if pad else raw_c
             )
             raw_d = mesh_lib.shard_rows(mesh, raw_p)
+            if rounds_fresh:
+                obs.price_compile("fused_rounds_fn", lambda: fn.lower(
+                    xb_d, y_d, raw_d, w_d, cand_d, mcw, mid, lam, msl,
+                    msg, lr32, np.int32(r), np.uint32(seed), sub_thresh,
+                ))
             return fn(xb_d, y_d, raw_d, w_d, cand_d, mcw, mid, lam, msl,
                       msg, lr32, np.int32(r), np.uint32(seed), sub_thresh)
 

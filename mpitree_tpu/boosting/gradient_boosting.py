@@ -444,6 +444,7 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
             n_features=binned.x_binned.shape[1], n_bins=binned.n_bins,
             hist_budget_bytes=cfg.hist_budget_bytes,
             feature_shards=mesh_lib.feature_shards(mesh),
+            policy_evidence=cfg.policy_evidence, obs=obs,
         )
         obs.decision(
             "rounds_per_dispatch", int(k_dispatch), reason=rpd_reason

@@ -156,6 +156,24 @@ KNOBS: tuple = (
     Knob("MPITREE_TPU_RUN_KEEP", "int", 16,
          "per-lineage record tail length kept when the store rotates",
          parse=int),
+    Knob("MPITREE_TPU_PEAK_FLOPS", "float", None,
+         "per-device peak f32 FLOP/s the compute ledger prices"
+         " optimal-seconds floors from (overrides the obs.cost platform"
+         " table; unset + unknown platform = honest `None` floors)",
+         parse=float),
+    Knob("MPITREE_TPU_PEAK_HBM_GBPS", "float", None,
+         "per-device peak HBM bandwidth (GB/s) for the compute ledger's"
+         " memory-bound floor (overrides the obs.cost platform table)",
+         parse=float),
+    Knob("MPITREE_TPU_POLICY_EVIDENCE", "str", "auto",
+         "evidence-driven `resolve_*` auto policies (obs.advisor): `auto`"
+         " consults the ambient flight store's A/B lineage history when"
+         " one exists, `off` keeps every static policy",
+         choices=("auto", "off")),
+    Knob("MPITREE_TPU_METRICS_EXEMPLARS", "int", 0,
+         "per-bucket exemplar reservoir size K for obs.metrics"
+         " histograms (surfaced as `metrics_text()` comments; 0 = off,"
+         " zero cost)", parse=int),
     # -- resilience -------------------------------------------------------
     Knob("MPITREE_TPU_ELASTIC", "bool", True,
          "`0` turns the whole resilience ladder off — device failures"

@@ -163,6 +163,12 @@ class BuildConfig:
     # instead of the 512-slot scatter. 512 stays the scatter tier that
     # bounds the gain-sweep width below the K=4096 chunk.
     frontier_tiers: tuple = (8, 64, 128, 512)
+    # Evidence-driven auto policies (obs/advisor.py, ISSUE 18): "auto"
+    # lets an auto-mode resolver consult the flight store's recorded A/B
+    # history and pick the measured winner (noise-gated; static policy on
+    # thin or inconclusive history); "off" pins every resolution to the
+    # static heuristics. Ambient twin: MPITREE_TPU_POLICY_EVIDENCE.
+    policy_evidence: str = "auto"
 
 
 # Below this many matrix cells, per-level device dispatch latency dominates
@@ -413,7 +419,7 @@ def warn_exact_ties_gap(K: int, n_features: int,
 def resolve_hist_subtraction(cfg: BuildConfig, platform: str, task: str, *,
                              integer_ok: bool, gbdt_x64: bool = False,
                              total_weight: float | None = None,
-                             obs=None) -> bool:
+                             obs=None, shape: dict | None = None) -> bool:
     """Shared sibling-subtraction resolution for both device engines.
 
     Follows the engine-resolution idiom: the env var
@@ -463,10 +469,26 @@ def resolve_hist_subtraction(cfg: BuildConfig, platform: str, task: str, *,
         (task == "classification" and integer_ok)
         or (task == "gbdt" and gbdt_x64)
     )
-    if flag == "auto" and not (
-        exact and platform in ("tpu", "axon")
-    ):
-        return False
+    if flag == "auto":
+        # Evidence consultation (obs/advisor.py, ISSUE 18): stored
+        # subtraction_ab history on this platform may replace the static
+        # platform preference — a measured loser turns it off even on
+        # accelerators, a measured winner engages it where exactness
+        # holds. Exactness and the f32-ceiling guard below are hard
+        # constraints the evidence never overrides.
+        from mpitree_tpu.obs import advisor
+
+        adv = advisor.advise_hist_subtraction(
+            platform=platform, shape=shape,
+            policy_evidence=cfg.policy_evidence,
+        )
+        advisor.record_advice(obs, adv)
+        verdict = adv["value"] if adv is not None else None
+        if verdict == "off":
+            return False
+        if not (exact and (verdict == "on"
+                           or platform in ("tpu", "axon"))):
+            return False
     f64_path = task == "gbdt" and gbdt_x64
     if (not f64_path and total_weight is not None
             and total_weight >= 2**24):
@@ -535,6 +557,8 @@ def ledger_and_preflight(*, binned, mesh, cfg: BuildConfig, task: str,
             cfg, platform, task,
             integer_ok=integer_weights(sample_weight),
             gbdt_x64=gbdt_x64, total_weight=total_w, obs=None,
+            shape={"n_samples": int(N), "n_features": int(F),
+                   "n_bins": int(binned.n_bins)},
         )
     plan = obs_acct.build_memory_plan(
         mesh=mesh, rows=int(N), features=int(F),
@@ -1015,6 +1039,8 @@ def build_tree(
     use_sub = resolve_hist_subtraction(
         cfg, platform, task, integer_ok=int_ok, gbdt_x64=gbdt64,
         total_weight=total_w_all, obs=timer,
+        shape={"n_samples": int(N), "n_features": int(F),
+               "n_bins": int(B)},
     )
     timer.decision(
         "hist_subtraction", "on" if use_sub else "off",
@@ -1228,6 +1254,12 @@ def build_tree(
         if terminal:
             with timer.phase("counts"):
                 with timer.compile_attribution("counts_fn", counts_fresh):
+                    if counts_fresh:
+                        timer.price_compile("counts_fn", lambda: (
+                            counts_fn.lower(
+                                y_d, nid_d, w_d, np.int32(frontier_lo)
+                            )
+                        ))
                     futures = [
                         (min(U, frontier_lo + frontier_size - lo),
                          counts_fn(y_d, nid_d, w_d, np.int32(lo)))
@@ -1287,6 +1319,22 @@ def build_tree(
                     ismall_lvl = sub_parent["is_small"]
                 n_extra = int(keep_now) + int(debug)
                 with timer.compile_attribution("split_fn", bool(new_fn)):
+                    if new_fn:
+                        # Compute ledger (obs/cost.py): price the fresh
+                        # variant's XLA cost once per cache key — the
+                        # lowering is trace-cache work the dispatch
+                        # below reuses, nothing runs twice.
+                        lo0, take0 = chunks[0]
+                        timer.price_compile("split_fn", lambda: (
+                            split_fn.lower(
+                                xb_d, y_d, nid_d, w_d, cand_mask_d,
+                                *split_args(lo0, take0, S_lvl),
+                                *(_sub_ops_for_chunk(
+                                    sub_parent, lo0 - frontier_lo, take0,
+                                    S_lvl,
+                                ) if sub_now else ()),
+                            )
+                        ))
                     futures = [
                         (take,
                          split_fn(xb_d, y_d, nid_d, w_d, cand_mask_d,
@@ -1473,6 +1521,13 @@ def build_tree(
                     left_t[:take] = lr[sl]
                     right_t[:take] = rr[sl]
                     with timer.compile_attribution("update_fn", update_fresh):
+                        if update_fresh:
+                            timer.price_compile("update_fn", lambda: (
+                                update_fn.lower(
+                                    nid_d, xb_d, np.int32(lo), is_split,
+                                    feat_t, bin_t, left_t, right_t,
+                                )
+                            ))
                         nid_d = update_fn(
                             nid_d, xb_d, np.int32(lo),
                             is_split, feat_t, bin_t, left_t, right_t,

@@ -923,6 +923,8 @@ def build_tree_fused(
     use_sub = resolve_hist_subtraction(
         cfg, mesh.devices.flat[0].platform, task, integer_ok=int_ok,
         total_weight=total_w_all, obs=timer,
+        shape={"n_samples": int(N), "n_features": int(F),
+               "n_bins": int(B)},
     )
 
     timer.set_mesh(mesh)
@@ -962,6 +964,15 @@ def build_tree_fused(
         )
     with timer.phase("fused_build"):
         with timer.compile_attribution("fused_fn", fused_fresh):
+            if fused_fresh:
+                # Compute ledger: price the fresh whole-tree program once
+                # per cache key (trace-cache work the call below reuses).
+                timer.price_compile("fused_fn", lambda: fn.lower(
+                    xb_d, y_d, nid_d, w_d, cand_d,
+                    np.float32(cfg.min_child_weight),
+                    np.float32(cfg.min_decrease_scaled),
+                    root_key, cst_op,
+                ))
             out = fn(xb_d, y_d, nid_d, w_d, cand_d,
                      np.float32(cfg.min_child_weight),
                      np.float32(cfg.min_decrease_scaled),
@@ -1159,6 +1170,8 @@ def build_forest_fused(
     use_sub = resolve_hist_subtraction(
         cfg, mesh.devices.flat[0].platform, task, integer_ok=integer_counts,
         total_weight=tree_totals_max, obs=timer,
+        shape={"n_samples": int(N), "n_features": int(F),
+               "n_bins": int(B)},
     )
     timer.decision(
         "hist_subtraction", "on" if use_sub else "off",
@@ -1262,6 +1275,13 @@ def build_forest_fused(
 
     with timer.phase("forest_build"):
         with timer.compile_attribution("forest_fn", forest_fresh):
+            if forest_fresh:
+                timer.price_compile("forest_fn", lambda: fn.lower(
+                    placed["x_binned"], placed["y"], placed["node_id"],
+                    placed["tree_weights"], placed["tree_cand_masks"],
+                    placed["tree_mcw"], placed["tree_mid"],
+                    placed["tree_root_keys"], placed["mono_cst"],
+                ))
             out = fn(placed["x_binned"], placed["y"], placed["node_id"],
                      placed["tree_weights"], placed["tree_cand_masks"],
                      placed["tree_mcw"], placed["tree_mid"],

@@ -558,6 +558,8 @@ def build_tree_leafwise(
     use_sub = resolve_hist_subtraction(
         cfg, platform, task, integer_ok=int_ok, gbdt_x64=gbdt_x64,
         total_weight=total_w, obs=timer,
+        shape={"n_samples": int(N), "n_features": int(F),
+               "n_bins": int(B)},
     )
     Pn = _pool_capacity(cfg.max_leaf_nodes, cfg.max_depth, N)
     M = 2 * Pn - 1
@@ -614,6 +616,11 @@ def build_tree_leafwise(
         with timer.phase("leafwise_build"):
             chaos.step("leafwise_build")
             with timer.compile_attribution("leafwise_fn", lw_fresh):
+                if lw_fresh:
+                    timer.price_compile("leafwise_fn", lambda: fn.lower(
+                        xb_d, y_d, nid_d, w_d, cand_d, mcw, mid, lam, msl,
+                        msg,
+                    ))
                 out = fn(
                     xb_d, y_d, nid_d, w_d, cand_d, mcw, mid, lam, msl, msg
                 )
@@ -772,6 +779,12 @@ def _build_leafwise_stepped(binned, y, *, cfg, mesh, n_classes, task, pool,
         # >= 0, padding is -1), left_id 0 puts the whole dataset in pair
         # slot 0.
         with timer.compile_attribution("expand_fn", expand_fresh):
+            if expand_fresh:
+                timer.price_compile("expand_fn", lambda: expand.lower(
+                    xb_d, y_d, nid_d, w_d, cand_d, np.int32(-2),
+                    np.int32(0), np.int32(0), np.int32(0), True, mcw, lam,
+                    msl, *((zeros_ph,) if use_sub else ()),
+                ))
             res = dispatch(
                 -2, 0, 0, 0, True, zeros_ph if use_sub else None
             )

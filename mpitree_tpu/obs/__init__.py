@@ -32,8 +32,28 @@ finalized record to a persistent run store under ``MPITREE_TPU_RUN_DIR``
 compares two runs with noise-aware verdicts seeded from run-history
 dispersion, bisecting fingerprint divergences to the first divergent
 (tree, level, channel).
+Observability v5 (ISSUE 18): ``obs.cost`` is the compute ledger —
+``record.compute`` joins each fresh program's XLA ``cost_analysis()``
+(flops, bytes accessed, once per compile cache key) against a published
+per-platform peak table into optimal-seconds floors, achieved
+utilization, and a compute-/HBM-/ICI-bound roofline verdict; and
+``obs.advisor`` turns the flight store's recorded A/B history into
+evidence-driven ``auto`` policy resolutions (noise-gated, typed
+``advisor_<policy>`` decisions, static fallback on thin history).
 """
 
+from mpitree_tpu.obs.advisor import (
+    advise_hist_subtraction,
+    advise_mesh_2d,
+    advise_rounds_per_dispatch,
+    advise_serving_kernel,
+)
+from mpitree_tpu.obs.cost import (
+    ENTRY_JOIN,
+    PEAK_TABLE,
+    compute_section,
+    platform_peaks,
+)
 from mpitree_tpu.obs.diff import (
     diff_envelopes,
     diff_payloads,
@@ -78,7 +98,9 @@ from mpitree_tpu.obs.trace import (
 )
 
 __all__ = [
+    "ENTRY_JOIN",
     "FINGERPRINT_VERSION",
+    "PEAK_TABLE",
     "RUN_DIR_ENV",
     "SCHEMA_VERSION",
     "TOP_LEVEL_FIELDS",
@@ -94,6 +116,11 @@ __all__ = [
     "REGISTRY",
     "ReportMixin",
     "TraceSink",
+    "advise_hist_subtraction",
+    "advise_mesh_2d",
+    "advise_rounds_per_dispatch",
+    "advise_serving_kernel",
+    "compute_section",
     "diff_envelopes",
     "diff_payloads",
     "digest",
@@ -104,6 +131,7 @@ __all__ = [
     "metrics_text",
     "note_build_path",
     "note_refine",
+    "platform_peaks",
     "plan_fit",
     "plan_serve",
     "tree_fingerprints",

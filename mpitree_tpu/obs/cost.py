@@ -1,0 +1,317 @@
+"""obs.cost — the XLA cost-model compute ledger (observability v5).
+
+Third sibling of the wire ledger (``record.wire``, ISSUE 9) and the
+memory ledger (``record.memory``, ISSUE 12): the repo could price ICI
+bytes and HBM bytes but not COMPUTE, so "runs as fast as the hardware
+allows" was an aspiration nothing measured. This module closes that gap
+with the compiler's own numbers:
+
+- :func:`capture` reads a fresh lowering's ``cost_analysis()`` (flops,
+  bytes accessed) — the XLA client-side HLO cost model, no backend
+  compile, ~10 ms host work. It runs ONCE per fresh compile cache key
+  (the PR-9 ``CompileRegistry`` seam: ``BuildObserver.price_compile``
+  fires only when ``compile_note`` returned fresh), so the warm dispatch
+  path — including the serving request path — never re-traces and the
+  disabled-observability budget is untouched.
+- :func:`platform_peaks` maps the live device to a published peak table
+  (f32 FLOP/s, HBM GB/s, aggregate ICI GB/s per device).
+  ``MPITREE_TPU_PEAK_FLOPS`` / ``MPITREE_TPU_PEAK_HBM_GBPS`` override
+  for parts the table does not know. Unknown platforms (XLA-CPU smoke
+  runs, new TPU generations) price to honest ``None`` — a typed
+  ``cost_unavailable`` event, never a guess and never a crash.
+- :func:`compute_section` joins the captured per-dispatch costs against
+  the measured span walls the record already carries (live phase
+  seconds for the host-stepped engines, the PR-9 replay rows for the
+  fused programs) into ``record.compute``: per-entry optimal-seconds
+  floors, achieved utilization, per-level floors, and a roofline
+  verdict (compute- / HBM- / ICI-bound, the ICI leg priced from the
+  existing wire ledger).
+
+Honesty contract: every derived number is a FLOOR joined against a
+measured wall — ``util_pct`` can only be computed where both sides
+exist (a cost capture, a peak table entry, a dispatch count, a measured
+span). Anything unpriceable is ``None``, with the reason recorded.
+
+The capture path imports jax lazily and defensively: a legacy wheel
+whose ``Lowered`` has no ``cost_analysis`` degrades to the same typed
+``cost_unavailable`` event as an unknown platform.
+"""
+
+from __future__ import annotations
+
+from mpitree_tpu.config import knobs
+
+PEAK_FLOPS_ENV = "MPITREE_TPU_PEAK_FLOPS"
+PEAK_HBM_ENV = "MPITREE_TPU_PEAK_HBM_GBPS"
+
+# Published per-device peaks, keyed by a lowercase substring of
+# ``device.device_kind``. FLOP/s is the f32 vector/matrix peak (the
+# histogram and traversal programs run f32 — quoting the bf16 MXU number
+# would flatter every utilization figure by ~2x); HBM is the memory
+# bandwidth the vendor quotes; ICI is the per-device aggregate across
+# links. First match wins; order specific kinds before generic ones.
+PEAK_TABLE: tuple = (
+    ("tpu v5 lite", dict(flops=98.5e12, hbm_gbps=819.0, ici_gbps=179.2)),
+    ("tpu v5e", dict(flops=98.5e12, hbm_gbps=819.0, ici_gbps=179.2)),
+    ("tpu v5p", dict(flops=229.5e12, hbm_gbps=2765.0, ici_gbps=537.6)),
+    ("tpu v4", dict(flops=137.5e12, hbm_gbps=1228.0, ici_gbps=268.8)),
+    ("tpu v6", dict(flops=229.0e12, hbm_gbps=1640.0, ici_gbps=358.4)),
+)
+
+# Where each jit entry point's measured wall lives in the record: the
+# phase name its dispatches run under (PhaseTimer seconds), and the
+# channel its dispatch COUNT can be recovered from without new plumbing
+# — "collective:<site>" reads ``record.collectives[site]['calls']``
+# (exact chunk counts for the host-stepped split/counts loops),
+# "phase" reads the phase's own call count (the fused single-program
+# engines run one dispatch per span), "counter:<name>" reads an
+# always-on counter. ``None`` means the count is not recoverable and
+# utilization stays honestly un-computed for that entry.
+ENTRY_JOIN: dict = {
+    "split_fn": ("split", "collective:split_hist_psum"),
+    "counts_fn": ("counts", "collective:counts_psum"),
+    "update_fn": ("update", None),
+    "fused_fn": ("fused_build", "phase"),
+    "forest_fn": ("forest_build", "phase"),
+    "leafwise_fn": ("leafwise_build", "phase"),
+    "expand_fn": (None, "counter:expansions"),
+    "fused_rounds_fn": ("fused_rounds", "counter:fused_round_dispatches"),
+    "serving_traverse": (None, None),
+}
+
+
+def capture(lower) -> dict | None:
+    """Cost-analyze one fresh lowering; None when the wheel cannot.
+
+    ``lower``: a zero-arg callable returning the jitted entry's
+    ``Lowered`` stage for the arguments about to dispatch — sites pass
+    ``lambda: fn.lower(*args)``. Called right after a fresh
+    ``compile_note``, the trace is either not yet cached (this call
+    primes the jaxpr cache the real dispatch then reuses) or already
+    cached (sub-millisecond re-lower); either way no work is duplicated
+    on the device and nothing runs on the warm path.
+
+    Returns ``{"flops", "bytes"}`` (floats, whole-program, pre-division)
+    or ``None`` on any failure — legacy wheels without
+    ``cost_analysis``, backends whose analysis raises, non-jit entries.
+    """
+    try:
+        lowered = lower()
+        analysis = lowered.cost_analysis()
+        # Newer wheels return one dict; some return a per-device list.
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else None
+        if not analysis:
+            return None
+        flops = analysis.get("flops")
+        nbytes = analysis.get("bytes accessed")
+        if flops is None and nbytes is None:
+            return None
+        return {
+            "flops": float(flops or 0.0),
+            "bytes": float(nbytes or 0.0),
+        }
+    except Exception:  # noqa: BLE001 — telemetry never aborts a dispatch
+        return None
+
+
+def device_kind() -> str | None:
+    """The live backend's device kind string, or None off-jax."""
+    try:
+        import jax
+
+        return str(jax.devices()[0].device_kind)
+    except Exception:  # noqa: BLE001 — uninitialized/absent backend
+        return None
+
+
+def platform_peaks(kind: str | None = None) -> dict:
+    """Peak table row for the live (or named) device kind.
+
+    Returns ``{"flops", "hbm_gbps", "ici_gbps", "source"}`` where the
+    numeric fields are ``None`` for unknown parts. The env knobs
+    override field-wise — a knob set on an unknown platform yields a
+    partially-priced row (flops floors without HBM floors, or vice
+    versa), each leg honest about what it knows.
+    """
+    if kind is None:
+        kind = device_kind()
+    row = {"flops": None, "hbm_gbps": None, "ici_gbps": None}
+    source = "unknown"
+    if kind:
+        low = kind.lower()
+        for sub, peaks in PEAK_TABLE:
+            if sub in low:
+                row.update(peaks)
+                source = "table"
+                break
+    env_flops = knobs.value(PEAK_FLOPS_ENV)
+    env_hbm = knobs.value(PEAK_HBM_ENV)
+    if env_flops is not None:
+        row["flops"] = float(env_flops)
+        source = "env"
+    if env_hbm is not None:
+        row["hbm_gbps"] = float(env_hbm)
+        source = "env"
+    row["device_kind"] = kind
+    row["source"] = source
+    return row
+
+
+def _dispatches(source: str | None, entry_phase, report: dict):
+    """Recover an entry's dispatch count from the record (see ENTRY_JOIN)."""
+    if source is None:
+        return None
+    if source == "phase":
+        if entry_phase is None:
+            return None
+        calls = (report.get("phases", {}).get(entry_phase) or {}).get("calls")
+        return int(calls) if calls else None
+    kind, _, name = source.partition(":")
+    if kind == "collective":
+        calls = (report.get("collectives", {}).get(name) or {}).get("calls")
+        return int(calls) if calls else None
+    if kind == "counter":
+        n = report.get("counters", {}).get(name)
+        return int(n) if n else None
+    return None
+
+
+def _floor_seconds(flops, nbytes, peaks: dict):
+    """(t_compute, t_hbm) floors for one dispatch; None legs unpriced."""
+    t_c = (
+        flops / peaks["flops"]
+        if peaks.get("flops") and flops is not None else None
+    )
+    t_h = (
+        nbytes / (peaks["hbm_gbps"] * 1e9)
+        if peaks.get("hbm_gbps") and nbytes is not None else None
+    )
+    return t_c, t_h
+
+
+def compute_section(report: dict, captures: dict, peaks: dict) -> dict:
+    """Assemble ``record.compute`` from raw captures + the live record.
+
+    ``captures``: ``{entry: {"flops", "bytes", "variants"}}`` — the raw
+    per-dispatch whole-program costs ``BuildObserver.price_compile``
+    collected (latest fresh variant per entry; ``variants`` counts how
+    many lowered). ``report``: the record dict built so far (phases /
+    collectives / counters / levels / wire / mesh already final).
+    Pure host arithmetic; recomputed identically on repeated
+    ``report()`` calls.
+    """
+    n_shards = max(int(report.get("wire", {}).get("n_shards") or 1), 1)
+    entries: dict = {}
+    opt_total = 0.0
+    measured_total = 0.0
+    flops_pd_total = 0.0
+    bytes_pd_total = 0.0
+    joined = False
+    for entry, cap in sorted(captures.items()):
+        phase, count_src = ENTRY_JOIN.get(entry, (None, None))
+        # The partition-rule division: the lowered module is the GLOBAL
+        # program, each shard executes 1/n of its row-parallel work —
+        # same convention as the wire ledger's per-shard figures.
+        flops_pd = cap["flops"] / n_shards
+        bytes_pd = cap["bytes"] / n_shards
+        t_c, t_h = _floor_seconds(flops_pd, bytes_pd, peaks)
+        floors = [t for t in (t_c, t_h) if t is not None]
+        optimal = max(floors) if floors else None
+        dispatches = _dispatches(count_src, phase, report)
+        measured = (
+            (report.get("phases", {}).get(phase) or {}).get("seconds")
+            if phase is not None else None
+        )
+        util = None
+        if (optimal is not None and dispatches and measured):
+            total_floor = optimal * dispatches
+            util = round(100.0 * total_floor / measured, 2)
+            opt_total += total_floor
+            measured_total += measured
+            flops_pd_total += flops_pd * dispatches
+            bytes_pd_total += bytes_pd * dispatches
+            joined = True
+        bound = None
+        if t_c is not None and t_h is not None:
+            bound = "compute" if t_c >= t_h else "hbm"
+        entries[entry] = {
+            "flops": cap["flops"],
+            "bytes": cap["bytes"],
+            "flops_per_shard": flops_pd,
+            "bytes_per_shard": bytes_pd,
+            "variants": cap.get("variants", 1),
+            "optimal_s": optimal,
+            "dispatches": dispatches,
+            "measured_s": measured,
+            "util_pct": util,
+            "bound": bound,
+        }
+    # Per-level floors: the live host-stepped rows carry seconds +
+    # hist/psum bytes; the fused engines' replay rows carry the bytes
+    # with seconds=None — floors are priced either way, utilization only
+    # where a wall exists. HBM leg from the level's histogram slab
+    # traffic, ICI leg from its psum payload over the data-axis ring.
+    axes = report.get("mesh", {}).get("axes") or {}
+    dr = max(int(axes.get("data", n_shards) or 1), 1)
+    levels = []
+    for row in report.get("levels", []):
+        hist_b = row.get("hist_bytes") or 0
+        psum_b = row.get("psum_bytes") or 0
+        t_h = (
+            hist_b / (peaks["hbm_gbps"] * 1e9)
+            if peaks.get("hbm_gbps") else None
+        )
+        t_i = (
+            psum_b * (dr - 1) / dr / (peaks["ici_gbps"] * 1e9)
+            if peaks.get("ici_gbps") and dr > 1 else None
+        )
+        floors = [t for t in (t_h, t_i) if t is not None]
+        floor = max(floors) if floors else None
+        sec = row.get("seconds")
+        levels.append({
+            "level": row.get("level"),
+            "floor_s": floor,
+            "seconds": sec,
+            "util_pct": (
+                round(100.0 * floor / sec, 2)
+                if floor is not None and sec else None
+            ),
+        })
+    # Roofline verdict: which resource the whole fit's floor sits on.
+    # Compute and HBM legs from the joined per-entry totals; the ICI leg
+    # from the existing wire ledger's per-shard fabric bytes.
+    wire_shard = report.get("wire", {}).get("wire_bytes_per_shard") or 0
+    t_compute = (
+        flops_pd_total / peaks["flops"]
+        if peaks.get("flops") and joined else None
+    )
+    t_hbm = (
+        bytes_pd_total / (peaks["hbm_gbps"] * 1e9)
+        if peaks.get("hbm_gbps") and joined else None
+    )
+    t_ici = (
+        wire_shard / (peaks["ici_gbps"] * 1e9)
+        if peaks.get("ici_gbps") and joined else None
+    )
+    roofline = None
+    legs = [("compute", t_compute), ("hbm", t_hbm), ("ici", t_ici)]
+    priced = [(n, t) for n, t in legs if t is not None]
+    if priced:
+        roofline = max(priced, key=lambda nt: nt[1])[0]
+    return {
+        "peak": dict(peaks),
+        "n_shards": n_shards,
+        "entries": entries,
+        "levels": levels,
+        "optimal_s": round(opt_total, 6) if joined else None,
+        "measured_s": round(measured_total, 6) if joined else None,
+        "util_pct": (
+            round(100.0 * opt_total / measured_total, 2)
+            if joined and measured_total else None
+        ),
+        "roofline": roofline,
+        "bounds_s": {
+            "compute": t_compute, "hbm": t_hbm, "ici": t_ici,
+        },
+    }

@@ -354,6 +354,20 @@ class FlightStore:
             platform=envelope.get("platform"), limit=limit,
         )
 
+    def sibling_lineage(self, envelope: dict, *,
+                        platform: str,
+                        limit: int | None = None) -> list:
+        """The envelope's lineage AS RUN ON ``platform`` — same kind /
+        section / config digest, different backend. The cross-platform
+        comparison base (benchdiff ``--cross-platform``): only
+        *structural* channels (psum/wire bytes, node counts,
+        fingerprints) are comparable across it; wall-clock never is."""
+        return self.entries(
+            kind=envelope.get("kind"), section=envelope.get("section"),
+            config_digest=envelope.get("config_digest"),
+            platform=platform, limit=limit,
+        )
+
     def latest(self, **filters) -> dict | None:
         rows = self.entries(**filters, limit=1)
         return rows[-1] if rows else None

@@ -15,6 +15,12 @@ Design constraints, in order:
   (geometric buckets, ratio ``2**0.25`` ≈ 1.19), so p50/p95/p99 come out
   with bounded ~9% relative error (geometric-midpoint estimate; the
   oracle test pins it against ``numpy.percentile``) at constant memory.
+  The one opt-in exception: ``MPITREE_TPU_METRICS_EXEMPLARS=K`` keeps the
+  K most recent raw values per bucket (a bounded ring, still O(buckets)
+  memory), surfaced as ``# exemplars`` comment lines in the exposition —
+  concrete latencies to chase when a tail bucket grows. Off (0) by
+  default: no reservoir is allocated and ``observe`` pays one ``is None``
+  check.
 - **Lock-safe under the registry's concurrent-dispatch contract.** One
   registry lock covers metric creation AND every update — serving
   dispatches run from many threads (``ModelRegistry`` publishes into a
@@ -36,6 +42,8 @@ from __future__ import annotations
 
 import math
 import threading
+
+from mpitree_tpu.config import knobs
 
 # Geometric bucket ratio: 2**(1/4) per bucket = 4 buckets per octave.
 # Quantile estimates use the geometric midpoint of the winning bucket, so
@@ -113,6 +121,12 @@ class Histogram:
         self.sum = 0.0
         self._min = math.inf
         self._max = -math.inf
+        # Exemplar reservoir (knob read once at creation): K most recent
+        # raw values per bucket, overwritten ring-style by the bucket's
+        # own count. None = off, and observe() pays a single None check.
+        k = knobs.value("MPITREE_TPU_METRICS_EXEMPLARS")
+        self._exemplar_k = max(0, int(k or 0))
+        self._exemplars: dict | None = {} if self._exemplar_k else None
 
     def observe(self, v) -> None:
         v = float(v)
@@ -120,11 +134,19 @@ class Histogram:
             math.log(v) / _LOG_RATIO - 1e-9
         )
         with self._lock:
-            self._buckets[idx] = self._buckets.get(idx, 0) + 1
+            n = self._buckets[idx] = self._buckets.get(idx, 0) + 1
             self.count += 1
             self.sum += v
             self._min = min(self._min, v)
             self._max = max(self._max, v)
+            if self._exemplars is not None:
+                ring = self._exemplars.get(idx)
+                if ring is None:
+                    ring = self._exemplars[idx] = []
+                if len(ring) < self._exemplar_k:
+                    ring.append(v)
+                else:
+                    ring[(n - 1) % self._exemplar_k] = v
 
     def quantile(self, q: float) -> float | None:
         """Estimated q-quantile (q in [0, 1]); None with no observations."""
@@ -157,13 +179,21 @@ class Histogram:
         with self._lock:
             cum = 0
             bounds = {}
+            exemplars = {}
             for idx in sorted(
                 self._buckets, key=lambda i: -math.inf if i is None else i
             ):
                 cum += self._buckets[idx]
                 bound = 0.0 if idx is None else _BUCKET_RATIO ** idx
                 bounds[bound] = cum
-            return {"buckets": bounds, "count": self.count, "sum": self.sum}
+                if self._exemplars is not None and self._exemplars.get(idx):
+                    exemplars[bound] = list(self._exemplars[idx])
+            snap = {"buckets": bounds, "count": self.count, "sum": self.sum}
+            if self._exemplars is not None:
+                # Key only present when the knob is on — snapshot shape
+                # (and every golden pinning it) is unchanged by default.
+                snap["exemplars"] = exemplars
+            return snap
 
 
 def _esc(v) -> str:
@@ -243,6 +273,7 @@ class MetricsRegistry:
                 labels = dict(key)
                 if cls is Histogram:
                     snap = metric.snapshot()
+                    exemplars = snap.get("exemplars") or {}
                     c = 0
                     for bound, c in snap["buckets"].items():
                         le = _label_str(
@@ -250,6 +281,16 @@ class MetricsRegistry:
                                      "le": f"{bound:.9g}"}
                         )
                         lines.append(f"{name}_bucket{le} {c}")
+                        if bound in exemplars:
+                            # Comment lines (not TYPE/HELP) are ignored
+                            # by exposition parsers — the scrape stays
+                            # valid with exemplars on.
+                            vals = ",".join(
+                                f"{v:.9g}" for v in exemplars[bound]
+                            )
+                            lines.append(
+                                f"# exemplars {name}_bucket{le} [{vals}]"
+                            )
                     inf = _label_str(
                         labels, {**(extra_labels or {}), "le": "+Inf"}
                     )

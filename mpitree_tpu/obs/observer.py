@@ -42,6 +42,7 @@ import time
 import warnings
 from collections import OrderedDict
 
+from mpitree_tpu.obs import cost as cost_mod
 from mpitree_tpu.obs import fingerprint as fingerprint_mod
 from mpitree_tpu.obs import flight as flight_mod
 from mpitree_tpu.obs import memory as memory_mod
@@ -92,6 +93,12 @@ class CompileRegistry:
         # unlocked read-modify-write would drop addends.
         self._seconds_lock = threading.Lock()
         self._warned: set = set()
+        # Compute-ledger cost captures (obs/cost.py, ISSUE 18): one
+        # representative {flops, bytes, variants} per entry point,
+        # priced at FRESH cache-key registration and reused by every
+        # later (warm) fit in the process — the once-per-cache-key
+        # contract rides this registry exactly like the lru mirror.
+        self._costs: dict = {}
 
     def note(self, entry: str, key, cache_size: int = 64) -> bool:
         """Record one factory resolution; True when ``key`` lowers fresh
@@ -136,6 +143,24 @@ class CompileRegistry:
         """Total cold-dispatch wall attributed to ``entry`` process-wide."""
         with self._seconds_lock:
             return self._seconds.get(entry, 0.0)
+
+    def price(self, entry: str, info: dict) -> None:
+        """Store one fresh lowering's cost capture for ``entry`` (the
+        latest variant is the representative per-dispatch cost; the
+        ``variants`` count stays honest about how many were priced)."""
+        with self._seconds_lock:
+            cap = self._costs.setdefault(
+                entry, {"flops": 0.0, "bytes": 0.0, "variants": 0}
+            )
+            cap["flops"] = float(info["flops"])
+            cap["bytes"] = float(info["bytes"])
+            cap["variants"] += 1
+
+    def cost(self, entry: str) -> dict | None:
+        """The entry's stored cost capture (a copy), or None."""
+        with self._seconds_lock:
+            cap = self._costs.get(entry)
+            return dict(cap) if cap else None
 
 
 REGISTRY = CompileRegistry()
@@ -289,6 +314,11 @@ class BuildObserver(PhaseTimer):
         self.flight_kind = "fit"
         if flight_mod.enabled():
             self.enabled = True
+        # Compute ledger (obs/cost.py, ISSUE 18): cost captures live in
+        # the process REGISTRY (priced once per FRESH cache key at the
+        # dispatch sites via price_compile, reused by warm fits); this
+        # set only dedups the per-fit cost_unavailable event.
+        self._cost_unavailable: set = set()
 
     def watch_memory(self, watch=None) -> None:
         """Enable span-boundary live-memory sampling (the ambient form is
@@ -561,6 +591,34 @@ class BuildObserver(PhaseTimer):
             rec["new"] += 1
         return new
 
+    def price_compile(self, entry: str, lower) -> None:
+        """Capture a FRESH lowering's XLA cost analysis (obs/cost.py).
+
+        ``lower``: zero-arg callable returning the jitted entry's
+        ``Lowered`` for the arguments about to dispatch (sites pass
+        ``lambda: fn.lower(*args)``). Call ONLY when ``compile_note``
+        returned fresh — that is the once-per-cache-key contract: the
+        warm path (including every serving request) never re-traces,
+        and the ~10 ms host-side analysis rides the cold path that
+        already pays the full XLA compile. A wheel or backend that
+        cannot price degrades to one typed ``cost_unavailable`` event
+        per entry, never a crash.
+        """
+        info = cost_mod.capture(lower)
+        if info is None:
+            if entry not in self._cost_unavailable:
+                self._cost_unavailable.add(entry)
+                self.event(
+                    "cost_unavailable",
+                    f"XLA cost analysis unavailable for entry {entry!r} "
+                    "(legacy wheel without cost_analysis(), or the "
+                    "backend's analysis failed); compute-ledger floors "
+                    "for this entry stay None",
+                    entry=entry,
+                )
+            return
+        REGISTRY.price(entry, info)
+
     def round(self, **row) -> None:
         r = self.record.rounds
         if len(r) >= self.MAX_ROUNDS:
@@ -626,6 +684,26 @@ class BuildObserver(PhaseTimer):
             rec.collectives,
             rec.mesh.get("axes") or rec.mesh.get("n_devices"),
         )
+        # The compute ledger (v9, obs/cost.py): join this fit's dispatched
+        # entry points (everything compile_note saw — warm keys reuse the
+        # registry's stored capture, the once-per-cache-key contract)
+        # against the measured span walls and the platform peak table.
+        # Pure host arithmetic, idempotent across repeated report() calls.
+        captures = {}
+        for entry in rec.compile:
+            cap = REGISTRY.cost(entry)
+            if cap:
+                captures[entry] = cap
+        if captures:
+            rec.compute = cost_mod.compute_section(
+                {
+                    "phases": rec.phases, "collectives": rec.collectives,
+                    "counters": rec.counters, "levels": rec.levels,
+                    "wire": rec.wire, "mesh": rec.mesh,
+                },
+                captures,
+                cost_mod.platform_peaks(),
+            )
         if self._fp_hash is not None:
             # Whole-fit fold over every committed tree (obs/fingerprint):
             # hexdigest() is non-destructive, so repeated report() calls

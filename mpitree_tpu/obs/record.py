@@ -67,7 +67,15 @@ import json
 # (typed events ``level_retry``/``oom_rescue``), so the watcher's
 # per-section digest line attributes fine-grained recovery without
 # parsing the event list. No record field changed shape.
-SCHEMA_VERSION = 8
+# v9 (ISSUE 18, obs.cost): top-level ``compute`` — the XLA cost-model
+# compute ledger (``obs/cost.py``): per-entry flops/bytes captured once
+# per fresh compile cache key, optimal-seconds floors from the
+# per-platform peak table, achieved utilization joined against the
+# measured span walls, per-level floors, and the roofline verdict
+# (compute-/HBM-/ICI-bound, the ICI leg from the v4 wire ledger).
+# Digest gains ``util_pct``/``roofline``; unpriceable entries are
+# honest ``None`` with a typed ``cost_unavailable`` event.
+SCHEMA_VERSION = 9
 
 # Which mesh axis each collective site reduces/gathers over — the wire
 # ledger's per-axis attribution. Every histogram/counts/y-range reduction
@@ -99,6 +107,7 @@ TOP_LEVEL_FIELDS = (
     "wire",
     "memory",
     "fingerprints",
+    "compute",
 )
 
 
@@ -193,6 +202,18 @@ class BuildRecord:
       the whole-fit fold; ``{}`` when no engine committed any (plain
       PhaseTimer callers). ``obs.diff.localize_divergence`` bisects two
       records' trees to the first divergent (tree, level, channel).
+    - ``compute`` (v9): the XLA cost-model compute ledger
+      (``obs/cost.py``) — ``{"peak", "n_shards", "entries", "levels",
+      "optimal_s", "measured_s", "util_pct", "roofline", "bounds_s"}``.
+      ``entries`` maps each jit entry point to its captured whole-program
+      flops/bytes (once per fresh compile cache key), the per-shard
+      division, the optimal-seconds floor from the platform peak table,
+      and achieved utilization joined against the measured span wall;
+      ``levels`` carries per-level HBM/ICI floors against the per-level
+      walls; ``roofline`` names the resource the fit's floor sits on
+      (``"compute"``/``"hbm"``/``"ici"``). Everything unpriceable
+      (unknown platform, legacy wheel, missing dispatch counts) is
+      ``None``; ``{}`` when no entry was captured.
     """
 
     schema: int = SCHEMA_VERSION
@@ -212,6 +233,7 @@ class BuildRecord:
     wire: dict = dataclasses.field(default_factory=dict)
     memory: dict = dataclasses.field(default_factory=dict)
     fingerprints: dict = dataclasses.field(default_factory=dict)
+    compute: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return _jsonable(dataclasses.asdict(self))
@@ -371,6 +393,13 @@ def digest(report: dict) -> dict:
         # something, which the noise model should know about.
         "level_retries": counters.get("level_retries"),
         "oom_rescues": counters.get("oom_rescues"),
+        # The compute ledger's headline pair (v9, obs/cost.py): achieved
+        # utilization of the optimal-seconds floor and the roofline
+        # verdict naming which resource that floor sits on. None where
+        # the platform/wheel could not be priced — a None here on a TPU
+        # capture is itself a signal (cost_unavailable event).
+        "util_pct": (report.get("compute") or {}).get("util_pct"),
+        "roofline": (report.get("compute") or {}).get("roofline"),
         "wall_s": round(wall, 3),
     }
 

@@ -277,6 +277,50 @@ def synthesize_record_tracks(sink: TraceSink, owner: str, track: str,
                 "args": _plain(r),
             })
 
+    # Utilization counter track (v9, obs/cost.py) next to the ici/mem
+    # tracks: the whole-fit achieved utilization at the window edges plus
+    # one sample per priced level, laid on the same replay layout as the
+    # level spans. Only priced values are emitted (C-event args must be
+    # numeric — the golden validate_trace rule); an unpriced record adds
+    # no track at all.
+    compute = report.get("compute") or {}
+    fit_util = compute.get("util_pct")
+    level_utils = {
+        r.get("level"): r.get("util_pct")
+        for r in compute.get("levels") or []
+        if isinstance(r.get("util_pct"), (int, float))
+    }
+    if isinstance(fit_util, (int, float)) or level_utils:
+        util_tid = sink.tid("util")
+        if isinstance(fit_util, (int, float)):
+            events.append({
+                "ph": "C", "pid": sink.pid, "tid": util_tid,
+                "name": "util_pct", "cat": "counter",
+                "ts": sink.ts(t0), "args": {"pct": float(fit_util)},
+            })
+        t_last = t0
+        if level_utils and levels:
+            for start, dur, r in _layout(levels, t0, t1, "psum_bytes"):
+                u = level_utils.get(r.get("level"))
+                if u is None:
+                    continue
+                events.append({
+                    "ph": "C", "pid": sink.pid, "tid": util_tid,
+                    "name": "util_pct", "cat": "counter",
+                    "ts": sink.ts(start + dur), "args": {"pct": float(u)},
+                })
+                t_last = max(t_last, start + dur)
+        if isinstance(fit_util, (int, float)):
+            # The closing sample sits at the window edge — or past it
+            # when live level seconds overran the span window (the
+            # monotonic-per-track golden rule wins over the edge).
+            events.append({
+                "ph": "C", "pid": sink.pid, "tid": util_tid,
+                "name": "util_pct", "cat": "counter",
+                "ts": sink.ts(max(t1, t_last)),
+                "args": {"pct": float(fit_util)},
+            })
+
     sink.set_synth(owner, events)
     return len(events)
 

@@ -48,6 +48,11 @@ def _chaos_dispatch(site: str, fn):
         chaos.step(site)
         return fn(*args)
 
+    # The compute ledger (obs/cost.py) prices fresh programs via
+    # ``fn.lower(*args).cost_analysis()``; forward the jit's lower so the
+    # wrapper stays transparent to it (no chaos step: pricing is a
+    # host-side analysis, not a dispatch).
+    dispatch.lower = fn.lower
     return dispatch
 
 

@@ -173,7 +173,9 @@ def resolve_mesh_2d(*, n_features: int, hist_bytes: int = 0,
                     backend: str | None = None, n_devices=None,
                     chunk_slots: int | None = None,
                     n_classes: int | None = None,
-                    n_bins: int | None = None) -> Mesh:
+                    n_bins: int | None = None,
+                    policy_evidence: str = "auto",
+                    obs=None) -> Mesh:
     """2-D ``(data, feature)`` mesh factory with the shape policy applied.
 
     ``n_devices`` follows :func:`resolve_mesh`'s grammar for a TOTAL
@@ -201,6 +203,27 @@ def resolve_mesh_2d(*, n_features: int, hist_bytes: int = 0,
         n = len(devs)
     else:
         n = int(n_devices)
+    # Evidence consultation (obs/advisor.py, ISSUE 18): stored mesh2d_ab
+    # A/Bs on this platform may override the budget-driven split — a
+    # measured 1-D winner collapses the feature axis, a measured 2-D
+    # winner keeps the policy split. An explicit (dr, df) tuple above
+    # bypasses this like it bypasses the policy.
+    if n > 1:
+        from mpitree_tpu.obs import advisor
+
+        adv = advisor.advise_mesh_2d(
+            platform=devs[0].platform if devs else None,
+            policy_evidence=policy_evidence,
+            shape={"n_features": int(n_features), "n_devices": int(n)},
+        )
+        advisor.record_advice(obs, adv)
+        if adv is not None and adv["value"] == "1d":
+            return resolve_mesh(backend=backend, n_devices=(n, 1))
+        if (adv is not None and adv["value"] == "2d"
+                and n % 2 == 0 and n_features >= 2):
+            # The A/B measured (D, 1) vs (D/2, 2); a 2-D verdict applies
+            # the measured shape, not a deeper untested feature split.
+            return resolve_mesh(backend=backend, n_devices=(n // 2, 2))
     shape = data_feature_shape(
         n, n_features, hist_bytes=hist_bytes, hist_budget=hist_budget
     )

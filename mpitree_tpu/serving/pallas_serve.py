@@ -322,6 +322,22 @@ def resolve_serving_kernel(platform: str, *, n_nodes_max: int,
     if flag not in ("auto", "pallas"):
         raise ValueError(f"unknown MPITREE_TPU_SERVING_KERNEL {flag!r}")
     ok = pallas_available(platform)
+    if flag == "auto":
+        # Evidence consultation (obs/advisor.py, ISSUE 18): stored
+        # serving sections on this platform — grouped by the kernel each
+        # run resolved — may override the tier preference. The VMEM fit
+        # and node-id cap below stay hard constraints: a "pallas"
+        # verdict still needs the table to fit; an "xla" verdict turns
+        # the kernel off outright.
+        from mpitree_tpu.obs import advisor
+
+        adv = advisor.advise_serving_kernel(
+            platform=platform,
+            shape={"n_features": int(n_features)},
+        )
+        advisor.record_advice(obs, adv)
+        if adv is not None and adv["value"] == "xla":
+            return False
     # The quantized tier's split-byte ids cap a tree at 65536 nodes; a
     # bigger table refuses back to XLA like a VMEM overflow would.
     ids_ok = (not quantized

@@ -479,4 +479,27 @@ def dispatch(Xp, state: QuantizedState, *, kind: str, n_steps: int,
         if obs is not None else contextlib.nullcontext()
     )
     with attr:
-        return run()
+        out = run()
+        if fresh and obs is not None:
+            # Compute ledger (obs/cost.py): price the fresh int8 bucket
+            # once; the warm request path never reaches this branch.
+            if kind == "gather_value":
+                obs.price_compile(
+                    "serving_traverse",
+                    lambda: q_traverse_gather.lower(
+                        Xp, state.feature, state.threshold, state.left,
+                        state.right, state.root, state.qvals,
+                        state.vscale, state.vbase, n_steps=n_steps,
+                    ),
+                )
+            else:
+                obs.price_compile(
+                    "serving_traverse",
+                    lambda: q_traverse_accumulate.lower(
+                        Xp, state.feature, state.threshold, state.left,
+                        state.right, state.root, acc0, state.qvals,
+                        state.vscale, state.vbase, scale, kind=kind,
+                        n_steps=n_steps,
+                    ),
+                )
+        return out

@@ -274,8 +274,35 @@ def dispatch(X, table_args, values, *, kind: str, n_steps: int,
         obs.compile_attribution("serving_traverse", fresh)
         if obs is not None else contextlib.nullcontext()
     )
+    def price():
+        # Compute ledger (obs/cost.py): price the fresh bucket once, off
+        # the warm request path (zero new compile keys there). Called
+        # inside the same enable_x64 context ``run`` dispatches under so
+        # the lowering hits the cached trace instead of forking a twin.
+        if kind in GATHER_KINDS:
+            obs.price_compile(
+                "serving_traverse",
+                lambda: traverse_gather.lower(
+                    X, *table_args, values, kind=kind, n_steps=n_steps
+                ),
+            )
+        else:
+            obs.price_compile(
+                "serving_traverse",
+                lambda: traverse_accumulate.lower(
+                    X, *table_args, acc0, values, scale, kind=kind,
+                    n_steps=n_steps,
+                ),
+            )
+
     with attr:
         if x64:
             with jax.enable_x64(True):
-                return run()
-        return run()
+                out = run()
+                if fresh and obs is not None:
+                    price()
+                return out
+        out = run()
+        if fresh and obs is not None:
+            price()
+        return out

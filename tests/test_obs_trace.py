@@ -248,6 +248,36 @@ def test_metrics_text_exposition_format():
         reg.gauge("req_total")
 
 
+def test_histogram_exemplar_reservoir(monkeypatch):
+    """ISSUE 18 satellite: MPITREE_TPU_METRICS_EXEMPLARS=K keeps the K
+    most recent raw values per bucket, surfaced as exposition comments;
+    off (default) allocates nothing and changes no output shape."""
+    # off: no reservoir, no snapshot key, no comment lines
+    reg = metrics_mod.MetricsRegistry()
+    h = reg.histogram("lat_seconds")
+    h.observe(1.05)
+    assert h._exemplars is None
+    assert "exemplars" not in h.snapshot()
+    assert "# exemplars" not in reg.metrics_text()
+
+    monkeypatch.setenv("MPITREE_TPU_METRICS_EXEMPLARS", "2")
+    reg2 = metrics_mod.MetricsRegistry()
+    h2 = reg2.histogram("lat_seconds", bucket="64")
+    # 1.05/1.1/1.15 share the (1, 1.19] bucket: the K=2 ring keeps the
+    # two most recent; 5.0 and the zero bucket get their own rings
+    for v in (1.05, 1.1, 1.15, 5.0, 0.0):
+        h2.observe(v)
+    ex = h2.snapshot()["exemplars"]
+    rings = sorted(v for ring in ex.values() for v in ring)
+    assert rings == [0.0, 1.1, 1.15, 5.0]  # 1.05 evicted, ring bounded
+    text = reg2.metrics_text()
+    assert "# exemplars lat_seconds_bucket" in text
+    # comment lines never break the exposition grammar
+    for ln in text.splitlines():
+        if not ln.startswith("#"):
+            assert len(ln.rsplit(" ", 1)) == 2
+
+
 def test_counter_monotonic_and_mirror():
     reg = metrics_mod.MetricsRegistry()
     c = reg.counter("c_total")

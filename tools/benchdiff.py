@@ -12,7 +12,10 @@ comparison sources, one verdict grammar:
 - ``--jsonl BENCH_TPU.jsonl --section north_star`` — the newest stored
   section payload vs the previous capture of the same section.
 - ``--store <run_dir> [--kind fit] [--section S]`` — the newest flight
-  envelope vs its lineage baseline (``obs.flight.FlightStore``).
+  envelope vs its lineage baseline (``obs.flight.FlightStore``). With
+  ``--cross-platform tpu``: vs its sibling lineage on another backend
+  instead — structural metrics only (psum/wire/nodes/fingerprint),
+  advisory warnings, always exit 0.
 - two positional paths — ``dump_report(path)`` JSON files (full
   BuildRecords): digest metrics compare AND fingerprint divergence
   bisects to the first divergent (tree, level, channel).
@@ -31,6 +34,11 @@ import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# flight.py reaches env through mpitree_tpu.config.knobs (the GL10 single
+# read path — itself stdlib-only, no jax); keep the script-entry form
+# (``python tools/benchdiff.py``) working alongside ``-m tools.benchdiff``.
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 
 def _load(name: str):
@@ -167,6 +175,57 @@ def diff_store(root: str, diff_mod, flight_mod, *, kind=None,
     return d, label
 
 
+def _structural_env(env: dict, diff_mod) -> dict:
+    """The envelope with every non-structural metric stripped. Across
+    platforms only deterministic channels compare (psum/wire bytes, node
+    counts, fingerprints); walls and rates measure different silicon."""
+    def keep(d: dict | None) -> dict:
+        return {
+            k: v for k, v in (d or {}).items()
+            if k == "fingerprint"
+            or (diff_mod.spec_for(k) or {}).get("kind") == "structural"
+        }
+    return {"metrics": keep(env.get("metrics")),
+            "digest": keep(env.get("digest")),
+            "record": env.get("record")}
+
+
+def diff_cross_platform(root: str, diff_mod, flight_mod, *, kind=None,
+                        section=None, platform=None, other: str) -> tuple:
+    """Newest flight envelope vs its sibling lineage on ``other``
+    (same kind/section/config digest, different backend). Structural
+    metrics only — advisory, never the gate: a CPU-smoke lineage warns
+    about wire/psum/fingerprint drift before TPU hardware sees it."""
+    store = flight_mod.FlightStore(root)
+    rows = store.entries(kind=kind, section=section, platform=platform)
+    if not rows:
+        return None, f"no entries in {store.path} match the filters"
+    cand = rows[-1]
+    if cand.get("platform") == other:
+        return None, (
+            f"newest entry is already on {other!r}; pass --platform to "
+            "pick the candidate side"
+        )
+    siblings = store.sibling_lineage(cand, platform=other)
+    if not siblings:
+        return None, (
+            f"no {other!r} sibling lineage for the newest "
+            f"{cand.get('platform')!r} entry "
+            f"(kind={cand.get('kind')}, section={cand.get('section')}) "
+            "— capture the same config there first"
+        )
+    d = diff_mod.diff_envelopes(
+        _structural_env(siblings[-1], diff_mod),
+        _structural_env(cand, diff_mod),
+        history=[_structural_env(e, diff_mod) for e in siblings],
+    )
+    label = (
+        f"{cand.get('kind')}:{cand.get('section') or cand.get('config_digest')}"
+        f" @ {other} -> {cand.get('platform')} (structural only)"
+    )
+    return d, label
+
+
 def diff_reports(base_path: str, cand_path: str, diff_mod) -> tuple:
     """Two dump_report(path) JSON files — full BuildRecord diff."""
     try:
@@ -204,6 +263,10 @@ def main(argv=None) -> int:
     p.add_argument("--kind", default=None,
                    help="flight envelope kind filter (fit/serve/bench)")
     p.add_argument("--platform", default=None)
+    p.add_argument("--cross-platform", metavar="PLATFORM", default=None,
+                   help="with --store: compare the newest envelope "
+                        "against its sibling lineage on PLATFORM "
+                        "(structural metrics only; warns, exit 0)")
     p.add_argument("--format", choices=("human", "github"),
                    default="human")
     p.add_argument("--json", action="store_true",
@@ -218,6 +281,27 @@ def main(argv=None) -> int:
             print("benchdiff: --jsonl needs --section", file=sys.stderr)
             return 2
         d, label = diff_jsonl(args.jsonl, args.section, diff_mod)
+    elif args.store and args.cross_platform:
+        d, label = diff_cross_platform(
+            args.store, diff_mod, _load("flight"), kind=args.kind,
+            section=args.section, platform=args.platform,
+            other=args.cross_platform,
+        )
+        if d is None:
+            print(f"benchdiff: {label}", file=sys.stderr)
+            return 2
+        print(f"benchdiff {label}")
+        print(diff_mod.format_diff(d, args.format))
+        if args.json:
+            print(json.dumps(d, indent=2, sort_keys=True))
+        if diff_mod.exit_code(d):
+            # Advisory by contract: cross-backend divergence is a heads-up
+            # for the hardware run, not a CI failure.
+            print(
+                "benchdiff: cross-platform divergence is advisory "
+                "(warning, not a gate)"
+            )
+        return 0
     elif args.store:
         d, label = diff_store(
             args.store, diff_mod, _load("flight"), kind=args.kind,
