@@ -5,9 +5,9 @@
 
 PY ?= python
 
-.PHONY: test test-cpu lint lint-graft lint-baseline knob-check bench \
-  bench-tpu report trace-smoke mem-smoke flight-smoke chaos-smoke \
-  ingest-smoke serve-smoke cost-smoke bench-diff clean
+.PHONY: test test-cpu lint lint-graft lint-baseline knob-check \
+  event-check bench bench-tpu report trace-smoke mem-smoke flight-smoke \
+  chaos-smoke ingest-smoke serve-smoke cost-smoke bench-diff clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -23,8 +23,10 @@ lint:
 # collective-axis (GL03), dtype/tiling (GL04), donation (GL05, path-
 # sensitive use-after-donate GL08), host-callback (GL06), Pallas hygiene
 # with symbolic-dim facts (GL07), project contracts — partition-spec
-# conformance (GL09) and the typed env-knob registry (GL10) — and the GL00
-# unused-suppression audit. tools/graftlint, dataflow-backed
+# conformance (GL09), the typed env-knob registry (GL10), lock discipline
+# for the threaded serving tier (GL11), wire/event ledger congruence
+# (GL12) — and the GL00 unused-suppression audit. tools/graftlint,
+# dataflow-backed
 # (interprocedural traced-value propagation). Pure-AST: runs on any CPU
 # box, no accelerator (or even jax) needed. `--explain GLnn` prints a
 # rule's rationale. Human format here; CI runs --format github against
@@ -45,6 +47,14 @@ lint-baseline:
 # or editing a Knob, regenerate with `python -m mpitree_tpu.config --write`.
 knob-check:
 	$(PY) -m mpitree_tpu.config --check
+
+# README events-section drift gate: the tables between the event-table
+# markers must match the typed registry (mpitree_tpu/obs/events.py) —
+# the same contract as knob-check, for event kinds and decision keys
+# (GL12 checks call-site congruence statically). Regenerate with
+# `python -m mpitree_tpu.obs --write`.
+event-check:
+	$(PY) -m mpitree_tpu.obs --check
 
 bench:
 	$(PY) bench.py

@@ -284,6 +284,10 @@ def _make_rounds_fn(mesh, *, loss_kind: str, n_rounds: int, n_bins: int,
         j = lax.axis_index(DATA_AXIS).astype(jnp.uint32)
         gidx = j * jnp.uint32(R) + jnp.arange(R, dtype=jnp.uint32)
 
+        # The round's leaf refit (G/H) and training-loss reductions —
+        # priced together as collective.gbdt_leaf_psum_bytes. The
+        # histogram psums live in the leafwise body, not here.
+        # graftlint: wire=gbdt_leaf_psum
         def round_step(raw, r):
             g, h = _grad_hess_jnp(loss_kind, raw, y)
             g = g * sw
@@ -629,7 +633,7 @@ def run_fused_rounds(*, binned, y_tr, sw_tr, raw_tr, trees, train_scores,
             rows_i, coll_i, counters_i = obs_acct.leafwise_scan_rows(
                 tree, n_features=binned.x_binned.shape[1], n_bins=B,
                 n_channels=3, task="gbdt", subtraction=use_sub,
-                gbdt_x64=gbdt_x64,
+                gbdt_x64=gbdt_x64, gbdt_leaf_slots=2 * Pn - 1,
             )
             for name, v in counters_i.items():
                 obs.counter(name, v)

@@ -1360,6 +1360,14 @@ def build_tree(
                             f"errs={errs})"
                         )
                     timer.counter("determinism_checks_passed", len(errs))
+                    # The probe's two scalar psums per chunk are real
+                    # fabric traffic — priced so a debug run's wire
+                    # ledger stays honest.
+                    timer.collective(
+                        "replication_check", calls=len(errs),
+                        nbytes=len(errs)
+                        * collective.replication_check_bytes(),
+                    )
                 # One packed buffer per chunk = one host transfer, not one
                 # per decision field (8x fewer round trips on the tunnel).
                 decs = [

@@ -210,6 +210,9 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
             "monotonic_cst is not supported on a (data, feature) mesh"
         )
 
+    # Histogram all-reduce helper — same priced site as the levelwise
+    # split step (collective.split_psum_bytes).
+    # graftlint: wire=split_hist_psum
     def psum(x):
         return lax.psum(x, psum_axis) if psum_axis is not None else x
 
@@ -630,7 +633,7 @@ def _make_build_body(*, n_slots: int, n_bins: int, n_classes: int,
             if feature_axis is None:
                 nid = jnp.where(active, child, nid)
             else:
-                child_all = lax.psum(
+                child_all = lax.psum(  # graftlint: wire=route_psum
                     jnp.where(active & owner, child, 0), feature_axis
                 )
                 nid = jnp.where(active, child_all, nid)

@@ -1,0 +1,90 @@
+"""Event-registry doc tooling: ``python -m mpitree_tpu.obs``.
+
+- ``--markdown`` prints the registry as the README's events section.
+- ``--check [README]`` extracts the section between the
+  ``<!-- event-table:begin -->`` / ``<!-- event-table:end -->`` markers
+  and exits 1 when it differs from the generated one — the CI drift gate
+  (``make event-check``) that keeps docs and registry one source, the
+  knob-table gate's twin.
+- ``--write [README]`` rewrites that section in place (the update path a
+  contributor runs after registering an event or decision).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from mpitree_tpu.obs import events
+
+BEGIN = "<!-- event-table:begin -->"
+END = "<!-- event-table:end -->"
+_DEFAULT_README = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(__file__))), "README.md"
+)
+
+
+def _split_readme(text: str):
+    try:
+        head, rest = text.split(BEGIN, 1)
+        table, tail = rest.split(END, 1)
+    except ValueError:
+        return None
+    return head, table, tail
+
+
+def main(argv: list | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m mpitree_tpu.obs")
+    group = parser.add_mutually_exclusive_group(required=True)
+    group.add_argument(
+        "--markdown", action="store_true",
+        help="print the events section generated from the registry",
+    )
+    group.add_argument(
+        "--check", nargs="?", const=_DEFAULT_README, metavar="README",
+        help="fail (exit 1) when the README events section drifts from "
+        "the registry",
+    )
+    group.add_argument(
+        "--write", nargs="?", const=_DEFAULT_README, metavar="README",
+        help="rewrite the README events section from the registry",
+    )
+    args = parser.parse_args(argv)
+
+    table = events.markdown_table()
+    if args.markdown:
+        print(table, end="")
+        return 0
+
+    path = args.check or args.write
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    parts = _split_readme(text)
+    if parts is None:
+        print(
+            f"event-table markers ({BEGIN} / {END}) not found in {path}",
+            file=sys.stderr,
+        )
+        return 1
+    head, current, tail = parts
+
+    if args.write:
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(f"{head}{BEGIN}\n{table}{END}{tail}")
+        print(f"events section rewritten in {path}", file=sys.stderr)
+        return 0
+
+    if current.strip() != table.strip():
+        print(
+            f"README events section in {path} drifted from the registry "
+            "— run `python -m mpitree_tpu.obs --write` to regenerate",
+            file=sys.stderr,
+        )
+        return 1
+    print("README events section matches the registry", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

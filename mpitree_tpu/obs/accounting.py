@@ -22,6 +22,7 @@ from mpitree_tpu.obs import fingerprint as fingerprint_mod
 from mpitree_tpu.obs import memory as memory_mod
 from mpitree_tpu.parallel.collective import (
     counts_psum_bytes,
+    gbdt_leaf_psum_bytes,
     select_global_bytes,
     split_psum_bytes,
 )
@@ -270,7 +271,8 @@ def fused_scan_rows(tree, **kwargs) -> tuple:
 
 def leafwise_scan_rows(tree, *, n_features: int, n_bins: int,
                        n_channels: int, task: str, subtraction: bool,
-                       gbdt_x64: bool = False) -> tuple:
+                       gbdt_x64: bool = False,
+                       gbdt_leaf_slots: int | None = None) -> tuple:
     """(rows, collectives, counters) replayed from a leaf-wise build.
 
     Unlike the level-wise replay, the finished tree carries EXACT
@@ -307,6 +309,16 @@ def leafwise_scan_rows(tree, *, n_features: int, n_bins: int,
     coll = {"split_hist_psum": {"calls": calls, "bytes": calls * per_pair}}
     if task == "regression":
         coll["y_range_pminmax"] = {"calls": calls, "bytes": calls * 2 * 2 * 4}
+    if gbdt_leaf_slots is not None:
+        # The fused-rounds engine refits leaf values and reduces the
+        # training loss in-program once per round tree (G/H over the
+        # padded M node slots + two loss scalars).
+        coll["gbdt_leaf_psum"] = {
+            "calls": 1,
+            "bytes": gbdt_leaf_psum_bytes(
+                n_slots=gbdt_leaf_slots, itemsize=8 if gbdt_x64 else 4
+            ),
+        }
 
     rows = []
     depths = tree.depth[tree.left[exp_ids]] if len(exp_ids) else np.zeros(0)

@@ -77,6 +77,7 @@ def counts_psum_bytes(*, n_slots: int, n_channels: int,
     return n_slots * n_channels * itemsize
 
 
+# graftlint: wire=counts_psum
 def node_counts_local(y, nid, w, chunk_lo, *, n_slots, n_classes, task,
                       axis=DATA_AXIS):
     """Per-slot class counts (or regression moments), psum'd over ``axis``.
@@ -112,6 +113,7 @@ def node_counts_local(y, nid, w, chunk_lo, *, n_slots, n_classes, task,
     return lax.psum(h, axis) if axis is not None else h
 
 
+# graftlint: wire=y_range_pminmax
 def regression_y_range(y, nid, w, chunk_lo, *, n_slots, axis=DATA_AXIS):
     """Exact per-slot max(y)-min(y) purity signal over the mesh.
 
@@ -134,6 +136,7 @@ def regression_y_range(y, nid, w, chunk_lo, *, n_slots, axis=DATA_AXIS):
     return lax.pmin(ymin, axis), lax.pmax(ymax, axis)
 
 
+# graftlint: wire=feature_merge_all_gather
 def select_global(dec, feature_axis, f_local: int):
     """Merge per-feature-shard split winners into the global decision.
 
@@ -190,11 +193,29 @@ def select_global(dec, feature_axis, f_local: int):
 
 
 def select_global_bytes(*, n_slots: int) -> int:
-    """Logical payload of one :func:`select_global` stacked all_gather
-    (bytes): the (4, K) f32 winner pack each feature shard contributes.
-    Static shapes, same accounting contract as :func:`split_psum_bytes`.
+    """Logical payload of one :func:`select_global` merge (bytes): the
+    (4, K) f32 winner pack each feature shard contributes to the stacked
+    all_gather, plus the (K,) f32 non-constant-candidate ``psum`` that
+    decides the merged ``constant`` flag. Static shapes, same accounting
+    contract as :func:`split_psum_bytes`.
     """
-    return 4 * n_slots * 4
+    return 5 * n_slots * 4
+
+
+def gbdt_leaf_psum_bytes(*, n_slots: int, itemsize: int = 4) -> int:
+    """Logical payload of one fused-rounds leaf refit + loss reduction
+    (bytes): the per-round (M,) leaf G and H sums (``itemsize=8`` on the
+    scoped-x64 path, ``resolve_gbdt_x64``) plus the two scalar f32
+    training-loss terms. ``n_slots`` is the padded node-slot count
+    M = 2*max_leaves - 1."""
+    return 2 * n_slots * itemsize + 2 * 4
+
+
+def replication_check_bytes() -> int:
+    """Logical payload of one :func:`profiling.assert_replicated` probe
+    (bytes): the scalar f32 participant count plus the scalar f32
+    fingerprint psum the debug determinism check issues."""
+    return 2 * 4
 
 
 def _pack_decision(dec) -> jax.Array:
@@ -332,6 +353,9 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     repl_axes = hist_vma
     n_acc = n_slots // 2 if subtraction else n_slots
 
+    # Every histogram all-reduce in this step body is the split-step psum
+    # the obs ledger prices as one site (split_psum_bytes).
+    # graftlint: wire=split_hist_psum
     def local_step(xb, y, nid, w, cand_mask, chunk_lo, mcw, *nm):
         nm = list(nm)
         if subtraction:  # last three operands, popped in reverse
@@ -525,6 +549,9 @@ def make_split_fn(mesh, *, n_slots: int, n_bins: int, n_classes: int,
     return _chaos_dispatch("split_dispatch", jax.jit(sharded))
 
 
+# Pair-granularity histogram all-reduces — priced as the same
+# split-step site as the levelwise program (split_psum_bytes).
+# graftlint: wire=split_hist_psum
 def pair_split_stats(xb, y, nid, w, cand_mask, base_id, is_small, phist,
                      mcw, lam, msl, *, task: str, criterion: str,
                      n_bins: int, n_classes: int, exact_ties: bool,
@@ -739,6 +766,9 @@ def make_update_fn(mesh, *, n_slots: int):
     """
     feature_axis = FEATURE_AXIS if feature_shards(mesh) > 1 else None
 
+    # The owner-broadcast child-id psum over the feature axis — the
+    # routing hop the obs ledger prices as route_psum.
+    # graftlint: wire=route_psum
     def local_update(nid, xb, chunk_lo, is_split, feat, bin_, left_id, right_id):
         slot = nid - chunk_lo
         in_chunk = (slot >= 0) & (slot < n_slots)

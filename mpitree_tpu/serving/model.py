@@ -397,7 +397,10 @@ class CompiledModel:
                     jax.device_put(np.float32(self._scale_host)),
                     jax.device_put(self._baseline_host), qaff,
                 )
-        tbl, vals, agg, kv, rt, dscale, dbase, qaff = self._kernel_state
+            # Unpack under the same lock: a concurrent swap_ensemble may
+            # replace the tuple wholesale, and reading it outside the
+            # critical section could observe a half-published rebuild.
+            tbl, vals, agg, kv, rt, dscale, dbase, qaff = self._kernel_state
         out = pallas_serve.traverse_batch_pallas(
             Xp, tbl, vals, n_steps=self.table.n_steps, agg=agg,
             n_out=self.n_out, kv=kv, row_tile=rt, quantized=quantized,

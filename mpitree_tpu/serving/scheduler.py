@@ -392,12 +392,16 @@ class Scheduler:
             return
         done = time.perf_counter()
         self._m_dispatch.inc()
-        # EWMA service estimate for the feasibility gate.
-        prev = self._service_s.get(model)
-        self._service_s[model] = (
-            done - t0 if prev is None
-            else (1 - _EWMA_ALPHA) * prev + _EWMA_ALPHA * (done - t0)
-        )
+        # EWMA service estimate for the feasibility gate. The read-
+        # modify-write must hold the lock: the admission path reads
+        # _service_s concurrently, and two racing dispatch threads would
+        # otherwise drop one sample's worth of smoothing.
+        with self._lock:
+            prev = self._service_s.get(model)
+            self._service_s[model] = (
+                done - t0 if prev is None
+                else (1 - _EWMA_ALPHA) * prev + _EWMA_ALPHA * (done - t0)
+            )
         misses = 0
         for i, r in enumerate(batch):
             if not _resolve(r.future, out[i]):

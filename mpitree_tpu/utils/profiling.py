@@ -176,6 +176,9 @@ def replication_fingerprint(*arrays) -> jax.Array:
     return (acc % jnp.uint32(1 << 16)).astype(jnp.float32)
 
 
+# Two scalar psums per probe — priced by collective.replication_check_bytes
+# and recorded by the builder's determinism check.
+# graftlint: wire=replication_check
 def assert_replicated(fingerprint: jax.Array, axis) -> jax.Array:
     """Inside shard_map: returns |psum(fp) - n*fp|, which must be 0 when the
     value is truly replicated. The caller checks the hostside result.

@@ -35,6 +35,7 @@ def good_contract(a, b):
 
 
 def make_good_collective(mesh):
+    # graftlint: wire=hist_psum
     def local_step(x, y):
         h = jnp.zeros(x.shape, jnp.float32) + x * y
         return lax.psum(h, DATA_AXIS)

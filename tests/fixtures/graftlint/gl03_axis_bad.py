@@ -13,6 +13,7 @@ from mesh_decl import DATA_AXIS  # noqa: F401 (lint input only)
 
 
 def make_unbound_body_psum(mesh):
+    # graftlint: wire=hist_psum
     def local_step(x, y):
         h = lax.psum(x * y, DATA_AXIS)  # bound by the in_specs — fine
         return lax.psum(h, "model")  # expect: GL03
@@ -26,6 +27,7 @@ def make_unbound_body_psum(mesh):
 
 
 def make_unbound_nested_gather(mesh):
+    # graftlint: wire=winner_gather
     def body(x):
         def merge(v):
             return lax.all_gather(v, "model")  # expect: GL03

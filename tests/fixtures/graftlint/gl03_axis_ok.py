@@ -12,6 +12,7 @@ from mesh_decl import DATA_AXIS  # noqa: F401 (lint input only)
 def make_two_axis_program(mesh):
     """Feature-axis collectives are fine when the specs bind the axis."""
 
+    # graftlint: wire=hist_psum, winner_gather
     def local_step(x, y):
         h = lax.psum(x * y, DATA_AXIS)
         j = lax.axis_index("model")
@@ -29,6 +30,7 @@ def make_two_axis_program(mesh):
 def make_dynamic_axis_program(mesh, axis):
     """Parameterized axes are invisible to the static check — skipped."""
 
+    # graftlint: wire=hist_psum
     def local_step(x):
         return lax.psum(x, axis)
 
@@ -40,6 +42,7 @@ def make_dynamic_axis_program(mesh, axis):
 def make_dynamic_specs_program(mesh, in_specs):
     """Dynamically built specs (the partition-rule table) — skipped."""
 
+    # graftlint: wire=hist_psum
     def local_step(x):
         return lax.psum(x, "model")
 

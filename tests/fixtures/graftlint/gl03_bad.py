@@ -9,6 +9,7 @@ from mesh_decl import DATA_AXIS  # noqa: F401 (lint input only)
 
 
 def make_bad_axis(mesh):
+    # graftlint: wire=hist_psum
     def local_step(x, y):
         h = x + y
         return lax.psum(h, "rows")  # expect: GL03

@@ -49,6 +49,41 @@ def guarded_grid_cannot_cover(row_tile):
     )
 
 
+def loop_carried_round_up_blows_vmem(row_tile):
+    # v4: the retry loop re-rounds the SAME name each pass. The widening
+    # fixpoint joins the init fact with the loop rebind — round_up never
+    # shrinks, so the >= 4096 lower bound survives the hull and the
+    # in-block stays provably over budget on every iteration
+    if row_tile < 4096:
+        raise ValueError("row_tile too small")
+    tile = _round_up(row_tile, 8)
+    for _ in range(3):
+        tile = _round_up(tile, 128)
+    return pl.pallas_call(  # expect: GL07
+        doubler,
+        grid=(2,),
+        in_specs=[pl.BlockSpec((tile, 1024), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((tile, 1024), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((8192, 1024), jnp.float32),
+    )
+
+
+def tuple_unpacked_dims_blow_vmem(row_tile):
+    # v4: both block dims land through ONE literal tuple unpack — each
+    # element is its own single assignment, so `tile` carries the guard's
+    # >= 4096 bound and `bins` is exactly 1024: 16 MiB per block
+    if row_tile < 4096:
+        raise ValueError("row_tile too small")
+    tile, bins = _round_up(row_tile, 8), 1024
+    return pl.pallas_call(  # expect: GL07
+        doubler,
+        grid=(2,),
+        in_specs=[pl.BlockSpec((tile, bins), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((tile, bins), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((8192, 1024), jnp.float32),
+    )
+
+
 def bf16_sublane_via_binding():
     # the single-assignment binding makes `rows` exactly 24 — passes the
     # f32 floor but breaks bf16's 16-row sublane tiling

@@ -56,6 +56,38 @@ def bounded_both_ways(row_tile):
     )
 
 
+def loop_carried_round_up_stays_bounded(passes):
+    # v4 loop-carried fixpoint: init 8, each pass re-rounds to 128 — the
+    # join settles at the 8..128 hull with divisor 8, so the block is
+    # provably small and 8 grid steps x at-most-128 rows cover 64
+    tile = 8
+    for _ in range(passes):
+        tile = _round_up(tile, 128)
+    return pl.pallas_call(
+        doubler,
+        grid=(8,),
+        in_specs=[pl.BlockSpec((tile, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((tile, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((64, 128), jnp.float32),
+    )
+
+
+def loop_doubling_widens_to_divisor_only(steps):
+    # v4: `grow * 2` never stabilizes inside the pass budget — the bounds
+    # widen away and only the divisor chain (gcd-monotone, guaranteed to
+    # settle) survives. No bound, no finding: honest unknown, not a guess
+    grow = 8
+    for _ in range(steps):
+        grow = grow * 2
+    return pl.pallas_call(
+        doubler,
+        grid=(2,),
+        in_specs=[pl.BlockSpec((grow, 1024), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((grow, 1024), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((8192, 1024), jnp.float32),
+    )
+
+
 def rebound_name_stays_unknown(row_tile, wide):
     # `tile` is bound twice — symdim refuses to guess across branches,
     # so no fact forms and no check can fire

@@ -1,0 +1,13 @@
+"""Seeded GL09 axis-conformance violation: a sanctioned partition-table
+module whose spec spells an axis no static mesh metadata declares — the
+spec silently replicates on every real mesh."""
+
+# graftlint: partition-table
+from jax.sharding import PartitionSpec as P
+
+GHOST_RULES_DOC = "the axis below is declared by no Mesh/*_AXIS constant"
+
+PARTITION_RULES = [
+    (r"^ghost_rows$", P("ghost")),  # expect: GL09
+    (r".*", P()),
+]

@@ -8,9 +8,14 @@ constructions are sanctioned — the fixture mirror of
 # graftlint: partition-table
 from jax.sharding import PartitionSpec as P
 
+# Declared axis constants: the v4 axis-conformance leg checks every axis
+# a sanctioned spec spells against the lint set's mesh metadata.
+D_AXIS = "d"
+F_AXIS = "f"
+
 PARTITION_RULES = [
-    (r"^x_binned$", P("d", "f")),
-    (r"^(y|node_id)$", P("d")),
+    (r"^x_binned$", P(D_AXIS, F_AXIS)),
+    (r"^(y|node_id)$", P(D_AXIS)),
     (r".*", P()),
 ]
 

@@ -1,0 +1,70 @@
+"""Seeded GL11 violations: unlocked guarded access (plain write, mutator
+call, torn read), a condition op outside the owning lock, a bare
+``lock-free`` escape with no justification, and an ABBA acquisition-order
+inversion."""
+
+import threading
+
+
+class BadCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+        self._items = []
+
+    def locked_add(self, n):
+        with self._lock:
+            self._total += n
+            self._items.append(n)
+
+    def racy_add(self, n):
+        self._total += n  # expect: GL11
+        self._items.append(n)  # expect: GL11
+
+    def racy_read(self):
+        return self._total  # expect: GL11
+
+
+class BadWaiter:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._ready = False
+
+    def set_ready(self):
+        with self._cond:
+            self._ready = True
+            self._cond.notify_all()
+
+    def wait_ready(self):
+        self._cond.wait()  # expect: GL11
+
+
+class BadEscape:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hits = 0
+
+    def bump(self):
+        with self._lock:
+            self._hits += 1
+
+    def peek(self):
+        # expect: GL11 # graftlint: lock-free
+        return self._hits
+
+
+class BadOrder:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+        self._n = 0
+
+    def forward(self):
+        with self._alock:
+            with self._block:
+                self._n += 1
+
+    def backward(self):
+        with self._block:
+            with self._alock:  # expect: GL11
+                self._n -= 1

@@ -1,0 +1,16 @@
+"""Background flusher with a documented-but-unimplemented discipline.
+
+Concurrency: a daemon thread flushes the buffer while callers append
+concurrently — every touch of the shared buffer is supposed to be
+serialized.
+"""
+
+import threading
+
+_BUF = []
+
+
+def start_flusher():
+    t = threading.Thread(target=_BUF.clear, daemon=True)  # expect: GL11
+    t.start()
+    return t

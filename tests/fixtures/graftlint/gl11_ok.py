@@ -1,0 +1,70 @@
+"""GL11-clean twins: locked discipline throughout, an injected lock, a
+helper that inherits the lock from its only (locked) call site, a
+justified ``lock-free`` escape, condition ops under the owning lock, and
+one consistent two-lock acquisition order."""
+
+import threading
+
+
+class GoodCounter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._total = 0
+        self._items = []
+
+    def add(self, n):
+        with self._lock:
+            self._total += n
+            self._bump(n)
+
+    def _bump(self, n):
+        # only ever called under the lock — inherits it (held fixpoint)
+        self._items.append(n)
+
+    def snapshot(self):
+        # graftlint: lock-free — monitoring read of one int; a torn read
+        # only skews a gauge, never corrupts state
+        return self._total
+
+
+class InjectedLock:
+    def __init__(self, lock):
+        self._lock = lock
+        self._rows = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._rows[k] = v
+
+
+class GoodWaiter:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._ready = False
+
+    def set_ready(self):
+        with self._cond:
+            self._ready = True
+            self._cond.notify_all()
+
+    def wait_ready(self):
+        with self._cond:
+            while not self._ready:
+                self._cond.wait()
+
+
+class GoodOrder:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+        self._n = 0
+
+    def one(self):
+        with self._alock:
+            with self._block:
+                self._n += 1
+
+    def two(self):
+        with self._alock:
+            with self._block:
+                self._n -= 1

@@ -1,0 +1,45 @@
+"""Seeded GL12 violations: unpriced device collectives, a wire= naming no
+priced site, and event/decision names absent from the registry
+(``gl12_ledger_decl.py``)."""
+
+import jax
+from jax import lax
+# graftlint: partition-table — fixture scenarios spell specs inline
+from jax.sharding import PartitionSpec as P
+
+from mesh_decl import DATA_AXIS  # noqa: F401 (lint input only)
+
+
+def make_unpriced(mesh):
+    def local_step(x, y):
+        h = x * y
+        return lax.psum(h, DATA_AXIS)  # expect: GL12
+
+    return jax.jit(jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(),
+    ))
+
+
+def make_ghost_site(mesh):
+    # graftlint: wire=ghost_site
+    def local_step(x):
+        return lax.psum(x, DATA_AXIS)  # expect: GL12
+
+    return jax.jit(jax.shard_map(
+        local_step, mesh=mesh, in_specs=(P(DATA_AXIS),), out_specs=P()
+    ))
+
+
+def emit_unregistered(obs):
+    # host code: only the NAME congruence leg applies here
+    obs.event("fallback_firedd", "typo'd kind")  # expect: GL12
+    obs.decision("engine_pickk", "typo'd key")  # expect: GL12
+    warn_event(obs, "mystery_kind", "never registered")  # expect: GL12
+
+
+def warn_event(obs, kind, message):
+    """Fixture stand-in so the module is self-contained (lint-only)."""
+    obs.event(kind, message)

@@ -1,0 +1,45 @@
+"""Fixture ledger authority: the priced-site vocabulary and event
+registry GL12's congruence checks resolve against — the fixture mirror of
+``mpitree_tpu/obs/record.py`` (wire sites) + ``mpitree_tpu/obs/events.py``
+(event/decision names). Its presence is what ACTIVATES both GL12 legs
+over the fixture set, so every device collective in the other fixtures
+carries a ``wire=`` annotation and every literal event name used by the
+gl12 twins must appear here.
+"""
+
+# graftlint: event-registry
+
+
+# Wire authority: dict keys are priced sites (axis attribution rides the
+# values, irrelevant to the lint).
+COLLECTIVE_AXES = {
+    "hist_psum": "data",
+    "winner_gather": "feature",
+}
+
+
+# A payload helper also names a priced site (its ``_bytes`` stem).
+def counts_psum_bytes(*, n_slots: int) -> int:
+    return n_slots * 4
+
+
+class Event:
+    def __init__(self, kind, doc=""):
+        self.kind = kind
+        self.doc = doc
+
+
+class Decision:
+    def __init__(self, key, doc=""):
+        self.key = key
+        self.doc = doc
+
+
+EVENTS = (
+    Event("fallback_fired", "kernel tier degraded to the XLA path"),
+    Event("budget_exceeded", "a priced plan crossed its byte budget"),
+)
+
+DECISIONS = (
+    Decision("engine_pick", "which engine the resolver chose"),
+)

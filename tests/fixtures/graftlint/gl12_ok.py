@@ -1,0 +1,45 @@
+"""GL12-clean twins: every device collective maps to a priced site (via
+call-line, comment-block, and enclosing-def annotations — all three
+placements) and every event/decision name is registered."""
+
+import jax
+from jax import lax
+# graftlint: partition-table — fixture scenarios spell specs inline
+from jax.sharding import PartitionSpec as P
+
+from mesh_decl import DATA_AXIS  # noqa: F401 (lint input only)
+
+
+def make_priced_def_level(mesh):
+    # Factory whose every collective belongs to one site: annotate once
+    # on the enclosing def.
+    # graftlint: wire=hist_psum
+    def local_step(x, y):
+        return lax.psum(x * y, DATA_AXIS)
+
+    return jax.jit(jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(),
+    ))
+
+
+def make_priced_call_line(mesh):
+    def local_step(x):
+        g = lax.all_gather(x, "model")  # graftlint: wire=winner_gather
+        # The *_bytes helper stem is also a priced site:
+        # graftlint: wire=counts_psum
+        return lax.psum(g.sum(), DATA_AXIS)
+
+    return jax.jit(jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, "model"),),
+        out_specs=P(),
+    ))
+
+
+def emit_registered(obs):
+    obs.event("fallback_fired", "registered kind")
+    obs.decision("engine_pick", "fused")

@@ -63,8 +63,8 @@ def test_fixture_findings_match_markers_exactly():
 def test_each_rule_family_has_fixture_coverage():
     findings, _ = _lint_fixtures()
     fired = {f.rule for f in findings}
-    assert {"GL00", "GL01", "GL02", "GL03", "GL04", "GL05",
-            "GL06", "GL07", "GL08", "GL09", "GL10"} <= fired
+    assert {"GL00", "GL01", "GL02", "GL03", "GL04", "GL05", "GL06",
+            "GL07", "GL08", "GL09", "GL10", "GL11", "GL12"} <= fired
 
 
 def test_clean_fixture_is_silent():
@@ -264,6 +264,33 @@ def test_unused_suppression_audit(tmp_path):
     assert suppressed == 1
 
 
+def test_gl00_audits_v4_rule_suppressions(tmp_path):
+    """The audit follows the rule registry, not a hand-kept id list: a
+    live ``disable=GL11`` suppresses and a dead ``disable=GL12`` fires
+    GL00, same as the v1 families."""
+    mod = tmp_path / "dead_v4.py"
+    mod.write_text(
+        "import threading\n\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._n = 0\n\n"
+        "    def add(self):\n"
+        "        with self._lock:\n"
+        "            self._n += 1\n\n"
+        "    def peek(self):\n"
+        "        return self._n  # graftlint: disable=GL11\n\n"
+        "    def quiet(self):\n"
+        "        return None  # graftlint: disable=GL12\n"
+    )
+    findings, suppressed = run_lint([str(mod)])
+    assert [f.rule for f in findings] == ["GL00"], [
+        f.format_human() for f in findings
+    ]
+    assert "GL12" in findings[0].message
+    assert suppressed == 1
+
+
 def test_select_gl00_alone_is_a_usage_error():
     """GL00 audits the suppressions of rules that RAN — selecting it alone
     could only produce a guaranteed-empty green result, so the CLI refuses
@@ -302,6 +329,9 @@ def test_explain_prints_rule_rationale():
     from tools.graftlint.rules import RULE_DOCS, RULE_EXPLAIN
 
     assert sorted(RULE_EXPLAIN) == sorted(RULE_DOCS)
+    # the v4 families ship a real rationale, not a stub one-liner
+    assert "lock" in RULE_EXPLAIN["GL11"].lower()
+    assert "wire" in RULE_EXPLAIN["GL12"].lower()
     proc = subprocess.run(
         [sys.executable, "-m", "tools.graftlint", "--explain", "GL09"],
         cwd=REPO, capture_output=True, text=True,
@@ -321,6 +351,20 @@ def test_explain_prints_rule_rationale():
     )
     assert unknown.returncode == 2
     assert "GL99" in unknown.stderr
+
+
+def test_v4_race_fixes_stay_locked():
+    """The two live races GL11 caught on its first sweep stay fixed: the
+    scheduler's EWMA read-modify-write and the model's kernel-state tuple
+    unpack (vs a concurrent ``swap_ensemble``) both moved under their
+    locks. Linting just those modules with GL11 must stay silent — remove
+    either lock and this fails before any flaky runtime repro could."""
+    findings, _ = run_lint(
+        [str(REPO / "mpitree_tpu" / "serving" / "scheduler.py"),
+         str(REPO / "mpitree_tpu" / "serving" / "model.py")],
+        rules=["GL11"],
+    )
+    assert findings == [], [f.format_human() for f in findings]
 
 
 def test_live_package_has_no_dead_suppressions():

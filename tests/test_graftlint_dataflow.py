@@ -109,3 +109,75 @@ def test_fixture_is_finding_free():
 
     findings, _ = run_lint([str(FIXTURE)])
     assert findings == [], [f.format_human() for f in findings]
+
+
+# --- symdim v4: the fact domain itself, pinned value by value -----------
+
+_SYMDIM_SRC = '''\
+def _round_up(x, k):
+    return (x + k - 1) // k * k
+
+
+def unpack_case(row_tile):
+    if row_tile < 16:
+        raise ValueError("too small")
+    a, b = row_tile * 2, 3
+    return a + b
+
+
+def loop_case(passes):
+    tile = 8
+    for _ in range(passes):
+        tile = _round_up(tile, 128)
+    return tile
+
+
+def widen_case(steps):
+    grow = 8
+    while steps > 0:
+        grow = grow * 2
+        steps -= 1
+    return grow
+'''
+
+
+def _symdim_facts(tmp_path, qualname):
+    from tools.graftlint import symdim
+    from tools.graftlint.engine import Project
+
+    mod_path = tmp_path / "symdim_cases.py"
+    mod_path.write_text(_SYMDIM_SRC)
+    p = Project([str(mod_path)])
+    mod = p.modules[0]
+    return symdim.scope_facts(mod, mod.functions[qualname])
+
+
+def test_symdim_tuple_unpack_is_elementwise(tmp_path):
+    """``a, b = row_tile * 2, 3`` is element-wise single assignment: `a`
+    carries the guard's bound through the arithmetic, `b` is exact."""
+    from tools.graftlint.symdim import Fact, exact
+
+    facts = _symdim_facts(tmp_path, "unpack_case")
+    assert facts["row_tile"] == Fact(lo=16)
+    assert facts["a"] == Fact(lo=32, mult=2)
+    assert facts["b"] == exact(3)
+
+
+def test_symdim_loop_carried_round_up_fixpoint(tmp_path):
+    """init 8, re-rounded to 128 each pass: the join fixpoint settles at
+    the 8..128 interval hull with the gcd divisor — an inductive
+    invariant, not a single-iteration guess."""
+    from tools.graftlint.symdim import Fact
+
+    facts = _symdim_facts(tmp_path, "loop_case")
+    assert facts["tile"] == Fact(lo=8, hi=128, mult=8)
+
+
+def test_symdim_nonstabilizing_loop_widens_bounds_only(tmp_path):
+    """``grow * 2`` climbs past the pass budget: the bounds widen to
+    unknown (soundness over reach) while the gcd-monotone divisor chain
+    iterates to ITS fixpoint and survives."""
+    from tools.graftlint.symdim import Fact
+
+    facts = _symdim_facts(tmp_path, "widen_case")
+    assert facts["grow"] == Fact(lo=None, hi=None, mult=8)

@@ -32,6 +32,9 @@ from mpitree_tpu.ops.binning import bin_dataset
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.parallel.collective import (
     counts_psum_bytes,
+    gbdt_leaf_psum_bytes,
+    replication_check_bytes,
+    select_global_bytes,
     split_psum_bytes,
 )
 from mpitree_tpu.utils.profiling import PhaseTimer, trace
@@ -247,6 +250,65 @@ def test_collective_byte_helpers():
         n_slots=8, n_features=4, n_bins=16, n_channels=3, itemsize=8
     ) == 8 * 4 * 16 * 3 * 8
     assert counts_psum_bytes(n_slots=64, n_channels=7) == 64 * 7 * 4
+    # winner merge: the (4, K) f32 pack PLUS the (K,) non-constant psum
+    # that decides the merged `constant` flag — 5 f32 per slot total
+    assert select_global_bytes(n_slots=16) == 5 * 16 * 4
+    # GBDT leaf refit: G and H over the padded M node slots + two loss
+    # scalars, widened on scoped-x64
+    assert gbdt_leaf_psum_bytes(n_slots=63) == 2 * 63 * 4 + 8
+    assert gbdt_leaf_psum_bytes(n_slots=63, itemsize=8) == 2 * 63 * 8 + 8
+    # determinism probe: scalar participant count + scalar fingerprint
+    assert replication_check_bytes() == 2 * 4
+
+
+def test_leafwise_replay_prices_gbdt_leaf_psum():
+    """The fused-rounds replay must carry the per-round leaf G/H + loss
+    psums in the wire ledger — present exactly when the engine passes the
+    padded slot count, absent for plain (non-GBDT) leaf-wise builds."""
+    import types
+
+    tree = types.SimpleNamespace(
+        n_node_samples=np.array([10, 6, 4]),
+        left=np.array([1, -1, -1]),
+        right=np.array([2, -1, -1]),
+        depth=np.array([0, 1, 1]),
+    )
+    common = dict(n_features=4, n_bins=16, n_channels=2,
+                  task="regression", subtraction=False)
+    _, coll, _ = accounting.leafwise_scan_rows(tree, **common)
+    assert "gbdt_leaf_psum" not in coll
+    _, coll, _ = accounting.leafwise_scan_rows(
+        tree, gbdt_leaf_slots=63, gbdt_x64=True, **common
+    )
+    assert coll["gbdt_leaf_psum"] == {"calls": 1, "bytes": 2 * 63 * 8 + 8}
+
+
+def test_debug_build_prices_replication_check():
+    """The determinism probe's scalar psums are real fabric traffic: a
+    debug build must surface a ``replication_check`` ledger entry whose
+    bytes match calls x the static per-probe payload (and a non-debug
+    build must not invent one)."""
+    X, y = _data(400, f=4)
+    binned = bin_dataset(X, max_bins=16, binning="quantile")
+    mesh = mesh_lib.resolve_mesh(n_devices=None)
+    n_classes = int(y.max()) + 1
+
+    obs = BuildObserver(timing=False)
+    build_tree(
+        binned, y, config=BuildConfig(max_depth=3, debug=True), mesh=mesh,
+        n_classes=n_classes, timer=obs,
+    )
+    entry = obs.record.collectives.get("replication_check")
+    assert entry is not None, sorted(obs.record.collectives)
+    assert entry["calls"] >= 1
+    assert entry["bytes"] == entry["calls"] * replication_check_bytes()
+
+    plain = BuildObserver(timing=False)
+    build_tree(
+        binned, y, config=BuildConfig(max_depth=3), mesh=mesh,
+        n_classes=n_classes, timer=plain,
+    )
+    assert "replication_check" not in plain.record.collectives
 
 
 def test_fused_level_rows_replay_matches_depth_histogram():

@@ -69,7 +69,8 @@ def main(argv: list | None = None) -> int:
             "recompile (GL02), collective (GL03), dtype/tiling (GL04), "
             "donation (GL05/GL08), host-callback (GL06) and Pallas (GL07) "
             "invariants, project contracts — partition-spec conformance "
-            "(GL09) and the env-knob registry (GL10) — plus the GL00 "
+            "(GL09), the env-knob registry (GL10), lock discipline (GL11) "
+            "and ledger congruence (GL12) — plus the GL00 "
             "unused-suppression audit."
         ),
     )

@@ -17,11 +17,13 @@ from tools.graftlint.rules import (
     gl08_donation_use,
     gl09_partition,
     gl10_env_knobs,
+    gl11_locks,
+    gl12_ledger,
 )
 
 ALL_RULES = (gl01_host_sync, gl02_recompile, gl03_collectives, gl04_dtype,
              gl05_donation, gl06_callbacks, gl07_pallas, gl08_donation_use,
-             gl09_partition, gl10_env_knobs)
+             gl09_partition, gl10_env_knobs, gl11_locks, gl12_ledger)
 
 RULE_DOCS = {
     r.rule_id: (r.__doc__ or "").strip().splitlines()[0] for r in ALL_RULES

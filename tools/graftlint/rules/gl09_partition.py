@@ -20,6 +20,14 @@ holds the package to the contract statically:
    ships as a silent full-copy. ``(name, 0)`` scalar pairs are the
    sanctioned replicate spelling and are skipped; non-literal name lists
    resolve at runtime and are never guessed.
+3. **Axis conformance.** Inside the sanctioned table modules, every
+   axis name a ``PartitionSpec(...)`` spells — literally or through a
+   module constant (``DATA_AXIS``) — must be declared by the lint set's
+   static mesh metadata (``*_AXIS`` constants and literal ``Mesh``
+   axis tuples, the same set GL03 checks collective axes against). A
+   spec naming an axis no mesh declares shards nothing: the name
+   silently trims away on every real mesh and the placement ships as
+   replicate. Skipped when no mesh metadata is in the lint set.
 """
 
 from __future__ import annotations
@@ -89,6 +97,27 @@ def _literal_names(call):
             yield s, el
 
 
+def _check_spec_axes(project, mod, call):
+    """Axis-conformance leg: every axis name this spec resolves statically
+    must be declared by the lint set's mesh metadata. A tuple element
+    shards one dim over several axes — each member is checked; names that
+    resolve only at runtime are never guessed."""
+    operands = list(call.args) + [kw.value for kw in call.keywords]
+    for el in operands:
+        members = el.elts if isinstance(el, (ast.Tuple, ast.List)) else [el]
+        for member in members:
+            s = project.resolve_str(mod, member)
+            if s is not None and s not in project.mesh_axes:
+                yield Finding(
+                    rule_id, mod.path, member.lineno, member.col_offset,
+                    f"PartitionSpec axis '{s}' is not declared by any "
+                    "static mesh metadata in the lint set "
+                    f"({', '.join(sorted(project.mesh_axes))}) — an "
+                    "undeclared axis trims away on every real mesh, so "
+                    "this spec silently replicates",
+                )
+
+
 def check(project):
     patterns = _table_patterns(project)
     for mod in project.modules:
@@ -97,14 +126,17 @@ def check(project):
             name = mod.canonical(call.func)
             if name is None:
                 continue
-            if name.endswith(".PartitionSpec") and not table_mod:
-                yield Finding(
-                    rule_id, mod.path, call.lineno, call.col_offset,
-                    "ad-hoc PartitionSpec(...) outside the partition "
-                    "table — derive the placement via partition.spec_for/"
-                    "in_specs_for/out_specs_for so the rule table stays "
-                    "the one authority",
-                )
+            if name.endswith(".PartitionSpec"):
+                if not table_mod:
+                    yield Finding(
+                        rule_id, mod.path, call.lineno, call.col_offset,
+                        "ad-hoc PartitionSpec(...) outside the partition "
+                        "table — derive the placement via partition."
+                        "spec_for/in_specs_for/out_specs_for so the rule "
+                        "table stays the one authority",
+                    )
+                elif project.mesh_axes:
+                    yield from _check_spec_axes(project, mod, call)
                 continue
             if not patterns:
                 continue  # no table in the lint set: nothing to conform to

@@ -1,0 +1,401 @@
+"""GL11 — lock discipline: the guarded-attribute contract, statically.
+
+The serving tier is genuinely multithreaded (scheduler worker thread,
+registry swap-under-load, lock-safe metrics), and its classes follow one
+convention: shared mutable attributes are touched only inside ``with
+self._lock:`` blocks. A single forgotten lock is the bug class unit
+tests are worst at catching — the race reproduces under production
+concurrency and never under a single-threaded test. This rule recovers
+the convention from source and holds every class to it:
+
+1. **Guarded-set inference + unlocked access.** A class's lock attributes
+   are the instance attributes holding ``threading.Lock``/``RLock``/
+   ``Condition`` objects (by constructor, or by name for locks injected
+   through a parameter — the metrics ``lock=`` idiom). The guarded set is
+   every attribute *written* inside a with-lock region (plain/augmented/
+   subscript assignment or a mutating method call: ``append``, ``pop``,
+   ``setdefault``, ...), plus every attribute *read* under the lock that
+   is also written anywhere outside ``__init__`` — the read-under-lock
+   half of a torn read/write pair. Any touch of a guarded attribute
+   outside a with-lock region is a finding. ``__init__``/``__post_init__``
+   run before the object is shared and are exempt; a private method whose
+   every intra-class call site is inside a locked region (or another
+   lock-held method) inherits the lock.
+2. **Acquisition-order inversion.** Acquiring lock B inside a region that
+   holds lock A records the order (A, B); a site elsewhere acquiring them
+   as (B, A) is the classic ABBA deadlock shape and is flagged at the
+   sites of the later-introduced order.
+3. **Condition discipline.** ``wait``/``wait_for``/``notify``/
+   ``notify_all`` on a lock attribute require that same lock held —
+   calling them unlocked raises at run time only when the race timing
+   cooperates.
+4. **Contract modules.** A module whose docstring declares a
+   ``Concurrency:`` contract but starts ``threading.Thread``s while
+   constructing no lock anywhere has documented an intent the code does
+   not implement.
+5. **The escape.** ``# graftlint: lock-free — <why>`` on the access line,
+   the comment block above it, or the enclosing ``def`` silences leg 1/3
+   for deliberate lock-free touches (monitoring reads, single-writer
+   fields) — but only with a non-empty justification; a bare escape is
+   itself a finding. An intentional race must say why it is benign.
+
+Everything is per-class and name-based — graftlint never imports the
+linted code. Module-level locks guarding module globals, cross-thread
+happens-before through queue handoff, and RLock reentrancy depth are
+deliberate non-goals (see ROADMAP).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.graftlint.engine import Finding
+
+rule_id = "GL11"
+
+_LOCK_CTORS = frozenset({
+    "threading.Lock", "threading.RLock", "threading.Condition",
+})
+_THREAD_CTORS = frozenset({"threading.Thread", "threading.Timer"})
+# method calls that mutate the container an attribute holds
+_MUTATORS = frozenset({
+    "append", "extend", "insert", "remove", "pop", "popleft", "popitem",
+    "clear", "update", "setdefault", "add", "discard", "appendleft",
+})
+_CONDITION_OPS = frozenset({"wait", "wait_for", "notify", "notify_all"})
+_EXEMPT_METHODS = frozenset({"__init__", "__post_init__"})
+_LOCK_NAME = re.compile(r"lock|cond|mutex", re.IGNORECASE)
+_CONTRACT = re.compile(r"\bconcurrency\s*:", re.IGNORECASE)
+_JUSTIFICATION = re.compile(r"lock-free[\s—\-–:]*(.*)")
+
+
+class _Access:
+    __slots__ = ("attr", "write", "held", "node", "method")
+
+    def __init__(self, attr, write, held, node, method):
+        self.attr = attr
+        self.write = write
+        self.held = held      # lock attr held at the site, or None
+        self.node = node
+        self.method = method  # enclosing method name
+
+
+class _ClassReport:
+    """One class's lock model: lock attrs, classified attribute accesses,
+    condition-op sites, nested acquisition orders, intra-class call sites."""
+
+    def __init__(self, node):
+        self.node = node
+        self.locks: set = set()
+        self.accesses: list = []
+        self.cond_ops: list = []   # (lock_attr, held, node, method)
+        self.pairs: dict = {}      # (outer, inner) -> [node, ...]
+        self.calls: dict = {}      # method -> [(caller, held), ...]
+        self.methods: set = set()
+
+
+def _self_attr(node, self_name):
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == self_name):
+        return node.attr
+    return None
+
+
+def _find_locks(mod, cls_node, self_name, report):
+    """Lock attributes: ``self.X = threading.Lock()`` anywhere, class-level
+    ``X = threading.Lock()``, or a lock-named attr bound from a lock-named
+    parameter (the injected ``self._lock = lock`` idiom in obs/metrics)."""
+    for stmt in cls_node.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Call)
+                and mod.canonical(stmt.value.func) in _LOCK_CTORS):
+            report.locks.add(stmt.targets[0].id)
+    for node in ast.walk(cls_node):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        attr = _self_attr(node.targets[0], self_name)
+        if attr is None:
+            continue
+        v = node.value
+        if isinstance(v, ast.Call) and mod.canonical(v.func) in _LOCK_CTORS:
+            report.locks.add(attr)
+        elif (_LOCK_NAME.search(attr) and isinstance(v, ast.Name)
+              and _LOCK_NAME.search(v.id)):
+            report.locks.add(attr)
+
+
+def _classify(report, method_node, method_name, self_name, parents):
+    """Walk one method, tracking the innermost held lock; nested def/lambda
+    bodies are separate execution contexts and are skipped (conservative:
+    their accesses are neither flagged nor used for inference)."""
+
+    def base_write(attr_node):
+        """Climb subscript chains: ``self._heaps[k][j] = v`` writes the
+        base attribute for discipline purposes."""
+        cur = attr_node
+        while True:
+            p = parents.get(id(cur))
+            if isinstance(p, ast.Subscript) and p.value is cur:
+                if isinstance(p.ctx, (ast.Store, ast.Del)):
+                    return True
+                cur = p
+                continue
+            return False
+
+    def visit(node, held):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            h = held
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                for item in child.items:
+                    la = _self_attr(item.context_expr, self_name)
+                    if la in report.locks:
+                        if held is not None and la != held:
+                            report.pairs.setdefault(
+                                (held, la), []
+                            ).append(item.context_expr)
+                        h = la
+            elif isinstance(child, ast.Call):
+                fa = _self_attr(child.func, self_name)
+                if fa is not None:
+                    report.calls.setdefault(fa, []).append(
+                        (method_name, held)
+                    )
+            elif isinstance(child, ast.Attribute):
+                attr = _self_attr(child, self_name)
+                if attr is not None and attr not in report.locks:
+                    if isinstance(child.ctx, (ast.Store, ast.Del)):
+                        kind = True
+                    elif base_write(child):
+                        kind = True
+                    else:
+                        kind = False
+                        p = parents.get(id(child))
+                        if (isinstance(p, ast.Attribute)
+                                and p.value is child):
+                            gp = parents.get(id(p))
+                            if (isinstance(gp, ast.Call)
+                                    and gp.func is p
+                                    and p.attr in _MUTATORS):
+                                kind = True
+                    report.accesses.append(
+                        _Access(attr, kind, held, child, method_name)
+                    )
+                elif attr in report.locks:
+                    p = parents.get(id(child))
+                    if (isinstance(p, ast.Attribute) and p.value is child
+                            and p.attr in _CONDITION_OPS):
+                        gp = parents.get(id(p))
+                        if isinstance(gp, ast.Call) and gp.func is p:
+                            report.cond_ops.append(
+                                (attr, held, child, method_name)
+                            )
+            visit(child, h)
+
+    visit(method_node, None)
+
+
+def _analyze_class(mod, cls_node):
+    report = _ClassReport(cls_node)
+    methods = [
+        stmt for stmt in cls_node.body
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and stmt.args.args and stmt.args.args[0].arg == "self"
+    ]
+    if not methods:
+        return report
+    _find_locks(mod, cls_node, "self", report)
+    if not report.locks:
+        return report
+    for m in methods:
+        report.methods.add(m.name)
+        parents = {}
+        for n in ast.walk(m):
+            for c in ast.iter_child_nodes(n):
+                parents[id(c)] = n
+        _classify(report, m, m.name, "self", parents)
+    return report
+
+
+def _held_methods(report):
+    """Methods whose every recorded intra-class call site runs with a lock
+    held (directly or through another held caller) inherit the lock."""
+    held: set = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, sites in report.calls.items():
+            if name in held or name not in report.methods or not sites:
+                continue
+            if all(h is not None or caller in held for caller, h in sites):
+                held.add(name)
+                changed = True
+    return held
+
+
+def _lock_free_line(mod, lineno):
+    """Line carrying a ``lock-free`` directive covering ``lineno``: the
+    line itself or the contiguous standalone-comment block above it."""
+    d = mod.directive_lines.get(lineno)
+    if d and d[0] == "lock-free":
+        return lineno
+    line = lineno - 1
+    while line >= 1 and mod.lines[line - 1].lstrip().startswith("#"):
+        d = mod.directive_lines.get(line)
+        if d and d[0] == "lock-free":
+            return line
+        line -= 1
+    return None
+
+
+def _lock_free_at(mod, node, method_node):
+    """('ok'|'bare', line) when a lock-free escape covers this access —
+    on its line, above it, or on/above the enclosing def — else None."""
+    lines = [node.lineno]
+    if method_node is not None:
+        lines.append(method_node.lineno)
+        lines.extend(d.lineno for d in method_node.decorator_list)
+    for lineno in lines:
+        hit = _lock_free_line(mod, lineno)
+        if hit is None:
+            continue
+        m = _JUSTIFICATION.search(mod.lines[hit - 1])
+        text = (m.group(1) if m else "").strip()
+        return ("ok" if text else "bare"), hit
+    return None
+
+
+def _method_node(report, name):
+    for stmt in report.node.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and stmt.name == name:
+            return stmt
+    return None
+
+
+def _class_findings(mod, report):
+    locked_writes: set = set()
+    locked_reads: set = set()
+    outside_init_writes: set = set()
+    guard_lock: dict = {}
+    for a in report.accesses:
+        if a.held is not None:
+            (locked_writes if a.write else locked_reads).add(a.attr)
+            guard_lock.setdefault(a.attr, a.held)
+        if a.write and a.method not in _EXEMPT_METHODS:
+            outside_init_writes.add(a.attr)
+    guarded = locked_writes | (locked_reads & outside_init_writes)
+    held = _held_methods(report)
+    cls = report.node.name
+
+    def covered(node, method):
+        esc = _lock_free_at(mod, node, _method_node(report, method))
+        if esc is None:
+            return False
+        kind, line = esc
+        if kind == "bare":
+            yield_bare.add(line)
+        return True
+
+    yield_bare: set = set()
+    for a in report.accesses:
+        if (a.attr not in guarded or a.held is not None
+                or a.method in _EXEMPT_METHODS or a.method in held):
+            continue
+        lock = guard_lock.get(a.attr, sorted(report.locks)[0])
+        if covered(a.node, a.method):
+            continue
+        verb = "written" if a.write else "read"
+        yield Finding(
+            rule_id, mod.path, a.node.lineno, a.node.col_offset,
+            f"'{cls}.{a.attr}' is guarded by 'self.{lock}' (touched under "
+            f"the lock elsewhere in the class) but {verb} here without it "
+            "— wrap the access in the lock or annotate the deliberate "
+            "race: `# graftlint: lock-free — <why it is benign>`",
+        )
+    for lock, h, node, method in report.cond_ops:
+        if h == lock or method in held:
+            continue
+        if covered(node, method):
+            continue
+        yield Finding(
+            rule_id, mod.path, node.lineno, node.col_offset,
+            f"condition operation on 'self.{lock}' outside `with "
+            f"self.{lock}:` — wait/notify require the underlying lock "
+            "held and raise RuntimeError only when the race timing "
+            "cooperates",
+        )
+    for line in sorted(yield_bare):
+        yield Finding(
+            rule_id, mod.path, line, 0,
+            "bare `# graftlint: lock-free` escape — an intentional "
+            "unlocked access must say why it is benign: "
+            "`# graftlint: lock-free — <justification>`",
+        )
+    # acquisition-order inversions: the direction introduced later (by
+    # first-occurrence line) is the inversion and carries the findings
+    for (a, b), nodes in sorted(report.pairs.items()):
+        if (b, a) not in report.pairs or a >= b:
+            continue
+        fwd = min(n.lineno for n in nodes)
+        rev = min(n.lineno for n in report.pairs[(b, a)])
+        bad = nodes if fwd > rev else report.pairs[(b, a)]
+        first, second = (b, a) if fwd > rev else (a, b)
+        for n in bad:
+            yield Finding(
+                rule_id, mod.path, n.lineno, n.col_offset,
+                f"acquires 'self.{second}' while holding "
+                f"'self.{first}', but the class elsewhere acquires them "
+                "in the opposite order — the ABBA deadlock shape; pick "
+                "one acquisition order",
+            )
+
+
+def _module_contract_findings(mod):
+    doc = ast.get_docstring(mod.tree) or ""
+    if not _CONTRACT.search(doc):
+        return
+    has_lock = any(
+        isinstance(n, ast.Call) and mod.canonical(n.func) in _LOCK_CTORS
+        for n in ast.walk(mod.tree)
+    )
+    if has_lock:
+        return
+    for n in ast.walk(mod.tree):
+        if isinstance(n, ast.Call) and \
+                mod.canonical(n.func) in _THREAD_CTORS:
+            yield Finding(
+                rule_id, mod.path, n.lineno, n.col_offset,
+                "module docstring declares a Concurrency: contract and "
+                "this starts a thread, but no lock is constructed "
+                "anywhere in the module — the documented discipline is "
+                "not implemented",
+            )
+
+
+def _module_reports(mod):
+    """Per-class lock reports, memoized on the ModuleInfo (the lock-scope
+    cache: the GL00 audit re-runs rule families, and re-walking every
+    method body would double the full-lint wall time)."""
+    cached = getattr(mod, "_lock_reports", None)
+    if cached is not None:
+        return cached
+    reports = [
+        _analyze_class(mod, node)
+        for node in ast.walk(mod.tree) if isinstance(node, ast.ClassDef)
+    ]
+    mod._lock_reports = reports
+    return reports
+
+
+def check(project):
+    for mod in project.modules:
+        yield from _module_contract_findings(mod)
+        for report in _module_reports(mod):
+            if report.locks:
+                yield from _class_findings(mod, report)

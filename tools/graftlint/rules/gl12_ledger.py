@@ -1,0 +1,227 @@
+"""GL12 — ledger congruence: collectives are priced, event names are real.
+
+The obs wire and compute ledgers (cost explains, advisor evidence) are
+only honest if every collective a device program actually issues maps to
+a priced site, and the structured event stream is only greppable if
+event names cannot drift from the registry the docs are generated from.
+Both halves are congruence checks between code and a declarative
+authority, the GL09/GL10 stance applied to the ledgers:
+
+1. **Wire pricing.** Every byte-moving collective call site reachable
+   from device code (``lax.psum``/``pmean``/``pmin``/``pmax``/
+   ``all_gather``/``psum_scatter``/``ppermute``/``pshuffle`` —
+   ``axis_index``/``axis_size``/``pcast`` move no payload; ``pcast``
+   only retags varying-manual-axes metadata) must carry a
+   ``# graftlint: wire=<site>`` annotation (on the call line, the
+   comment block above it, or the enclosing ``def`` chain) naming a
+   priced site. The priced-site vocabulary is derived statically from
+   the ledger authorities in the lint set: keys of the module-level
+   ``COLLECTIVE_AXES`` table (obs/record.py), the ``<site>_bytes``
+   payload helpers (parallel/collective.py), and literal sites handed
+   to ``.collective("<site>", ...)`` recorders. An unannotated device
+   collective is invisible fabric traffic — the cost explain
+   undercounts and the advisor reasons from wrong evidence. The check
+   activates only when a ``COLLECTIVE_AXES`` authority is in the lint
+   set (linting a single file must not cry wolf).
+2. **Event-name congruence.** Every literal event kind passed to
+   ``warn_event(obs, "<kind>", ...)`` or ``<obs>.event("<kind>", ...)``
+   and every literal decision key passed to ``<obs>.decision("<key>",
+   ...)`` must be registered in the central event registry — a module
+   carrying the ``# graftlint: event-registry`` directive whose
+   ``Event("<kind>", ...)`` / ``Decision("<key>", ...)`` entries are the
+   single source the README events table is generated from (the
+   knob-registry idiom, GL10's twin). An unregistered name is exactly
+   how a misspelled event kind ships: it traces, logs, and never
+   matches the documented schema. Dynamic names are never guessed;
+   the check activates only when a registry module is in the lint set.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint import astutil
+from tools.graftlint.engine import Finding
+
+rule_id = "GL12"
+
+# byte-moving collectives (GL03's set minus the payload-free members:
+# axis_index/axis_size are index queries and pcast only retags vma
+# metadata — none of them put a byte on the wire)
+_PRICED = frozenset({
+    "jax.lax.psum", "jax.lax.pmean", "jax.lax.pmin", "jax.lax.pmax",
+    "jax.lax.all_gather", "jax.lax.psum_scatter", "jax.lax.ppermute",
+    "jax.lax.pshuffle",
+})
+
+
+def _is_registry_module(mod) -> bool:
+    return any(
+        kind == "event-registry"
+        for kind, _vals in mod.directive_lines.values()
+    )
+
+
+def _wire_vocabulary(project):
+    """(has_authority, site names) — the priced-site vocabulary.
+
+    Authority: a module-level ``COLLECTIVE_AXES`` dict literal. The
+    vocabulary joins its keys with ``<site>_bytes`` helper stems and
+    literal ``.collective("<site>", ...)`` recorder arguments, uppercased
+    (directive values arrive uppercased from the engine).
+    """
+    has_authority = False
+    vocab: set = set()
+    for mod in project.modules:
+        for stmt in mod.tree.body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "COLLECTIVE_AXES"
+                    and isinstance(stmt.value, ast.Dict)):
+                has_authority = True
+                for key in stmt.value.keys:
+                    s = astutil.str_const(key)
+                    if s is not None:
+                        vocab.add(s.upper())
+            elif (isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  and stmt.name.endswith("_bytes")):
+                vocab.add(stmt.name[: -len("_bytes")].upper())
+        for _scope, call in project._walk_calls(mod):
+            if (isinstance(call.func, ast.Attribute)
+                    and call.func.attr == "collective" and call.args):
+                s = astutil.str_const(call.args[0])
+                if s is not None:
+                    vocab.add(s.upper())
+    return has_authority, vocab
+
+
+def _wire_values_at(mod, lineno):
+    """Uppercased ``wire=`` directive values on ``lineno`` or the
+    contiguous standalone-comment block directly above it."""
+    out: set = set()
+    d = mod.directive_lines.get(lineno)
+    if d and d[0] == "wire":
+        out |= d[1]
+    line = lineno - 1
+    while line >= 1 and mod.lines[line - 1].lstrip().startswith("#"):
+        d = mod.directive_lines.get(line)
+        if d and d[0] == "wire":
+            out |= d[1]
+        line -= 1
+    return out
+
+
+def _wire_sites(mod, call, scope):
+    """All ``wire=`` values covering a call: its own line/comment block,
+    then each enclosing ``def`` (and decorators) outward — a factory
+    whose every collective belongs to one site annotates once."""
+    out = _wire_values_at(mod, call.lineno)
+    cur = scope
+    while cur is not None:
+        if not cur.is_lambda:
+            for lineno in [cur.node.lineno] + [
+                d.lineno for d in cur.node.decorator_list
+            ]:
+                out |= _wire_values_at(mod, lineno)
+        cur = cur.parent
+    return out
+
+
+def _check_wire(project):
+    has_authority, vocab = _wire_vocabulary(project)
+    if not has_authority:
+        return
+    for mod in project.modules:
+        for scope, call in project._walk_calls(mod):
+            if scope is None or not scope.is_device:
+                continue
+            name = mod.canonical(call.func)
+            if name not in _PRICED:
+                continue
+            sites = _wire_sites(mod, call, scope)
+            short = name.rsplit(".", 1)[-1]
+            if not sites:
+                yield Finding(
+                    rule_id, mod.path, call.lineno, call.col_offset,
+                    f"device-reachable {short} has no `# graftlint: "
+                    "wire=<site>` annotation — every byte-moving "
+                    "collective must map to a priced site "
+                    "(COLLECTIVE_AXES / *_bytes helpers) or the wire "
+                    "ledger undercounts fabric traffic",
+                )
+                continue
+            for site in sorted(sites - vocab):
+                yield Finding(
+                    rule_id, mod.path, call.lineno, call.col_offset,
+                    f"wire={site.lower()} names no priced site — known "
+                    "sites come from COLLECTIVE_AXES keys, *_bytes "
+                    "helpers and .collective(...) recorders; add the "
+                    "pricing entry or fix the site name",
+                )
+
+
+def _registry_names(project):
+    """(event kinds, decision keys) registered across every
+    event-registry module in the lint set."""
+    events: set = set()
+    decisions: set = set()
+    for mod in project.modules:
+        if not _is_registry_module(mod):
+            continue
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            ctor = (astutil.dotted_name(node.func) or "").rsplit(".", 1)[-1]
+            s = astutil.str_const(node.args[0])
+            if s is None:
+                continue
+            if ctor == "Event":
+                events.add(s)
+            elif ctor == "Decision":
+                decisions.add(s)
+    return events, decisions
+
+
+def _check_events(project):
+    events, decisions = _registry_names(project)
+    if not events and not decisions:
+        return  # no registry in the lint set: nothing to conform to
+    for mod in project.modules:
+        if _is_registry_module(mod):
+            continue
+        for _scope, call in project._walk_calls(mod):
+            short = (astutil.dotted_name(call.func) or "").rsplit(".", 1)[-1]
+            if short == "warn_event" and len(call.args) > 1:
+                kind = astutil.str_const(call.args[1])
+                if kind is not None and kind not in events:
+                    yield Finding(
+                        rule_id, mod.path, call.args[1].lineno,
+                        call.args[1].col_offset,
+                        f"event kind '{kind}' is not in the central event "
+                        "registry — register it (obs/events.py) so the "
+                        "README events table and log consumers can't "
+                        "drift from the code",
+                    )
+            elif (isinstance(call.func, ast.Attribute)
+                  and call.func.attr in ("event", "decision")
+                  and call.args):
+                lit = astutil.str_const(call.args[0])
+                if lit is None:
+                    continue
+                known = events if call.func.attr == "event" else decisions
+                if lit not in known:
+                    what = ("event kind" if call.func.attr == "event"
+                            else "decision key")
+                    yield Finding(
+                        rule_id, mod.path, call.args[0].lineno,
+                        call.args[0].col_offset,
+                        f"{what} '{lit}' is not in the central event "
+                        "registry — register it (obs/events.py) so the "
+                        "README events table and log consumers can't "
+                        "drift from the code",
+                    )
+
+
+def check(project):
+    yield from _check_wire(project)
+    yield from _check_events(project)

@@ -10,8 +10,14 @@ coverage / VMEM checks can fire on symbolic shapes instead of bailing:
    takes the fact of its value expression, evaluated over int literals,
    other facts, ``+ - * //``, ``max``/``min``, and ``*round_up(x, K)``
    (result ``>= x``, ``<= x + K - 1`` rounded, and a multiple of ``K`` —
-   the one contract every ``_round_up`` helper in ops/ shares). Names
-   bound more than once are unknown — no guessing across branches.
+   the one contract every ``_round_up`` helper in ops/ shares). A
+   same-length literal tuple unpack (``a, b = x * 2, 3``) is element-wise
+   single assignment. A name initialized once outside a loop and rebound
+   inside ``for``/``while`` bodies gets a bounded widening fixpoint:
+   join (interval hull, gcd of divisors) the init fact with each loop
+   rebind until stable, widening bounds that keep moving to unknown
+   while a settled divisor survives. Everything else is unknown — no
+   guessing across branches.
 2. **Guard seeding.** A ``raise``-only ``if`` body whose test compares a
    name against an int literal proves the complement for all surviving
    code: ``if row_tile < 2048: raise`` means ``row_tile >= 2048`` below.
@@ -54,6 +60,10 @@ class Fact:
 
 
 UNKNOWN = Fact()
+
+# Join-fixpoint pass budget for loop-carried bindings; chains that have
+# not stabilized by then widen their bounds away (soundness over reach).
+_LOOP_PASSES = 4
 
 
 def exact(v: int) -> Fact:
@@ -122,6 +132,16 @@ def _intersect(a: Fact, b: Fact) -> Fact:
         max(los) if los else None,
         min(his) if his else None,
         a.mult * b.mult // _gcd(a.mult, b.mult),  # lcm
+    )
+
+
+def _join(a: Fact, b: Fact) -> Fact:
+    """Either fact may hold (the loop-carried union): interval hull, gcd
+    of divisors — the dual of :func:`_intersect`."""
+    return Fact(
+        min(a.lo, b.lo) if a.lo is not None and b.lo is not None else None,
+        max(a.hi, b.hi) if a.hi is not None and b.hi is not None else None,
+        _gcd(a.mult, b.mult),
     )
 
 
@@ -261,25 +281,68 @@ def scope_facts(mod, scope) -> dict:
     if scope.parent is not None:
         facts.update(scope_facts(mod, scope.parent))
 
-    # single-assignment bindings (two passes: later bindings may reference
-    # earlier ones; a second sweep settles simple chains without a full
-    # fixpoint)
+    # bindings (three fact-producing shapes; everything else is unknown):
+    # single-assignment names, same-length literal tuple unpacks
+    # (element-wise single assignment), and loop-carried rebinds of a
+    # singly-initialized name (widening fixpoint below)
     counts: dict = {}
     values: dict = {}
-    for stmt in astutil.own_statements(scope.node):
-        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
-                and isinstance(stmt.targets[0], ast.Name)):
-            name = stmt.targets[0].id
+    loop_values: dict = {}  # name -> [rebind exprs inside for/while bodies]
+
+    def bind(name, value, in_loop):
+        if in_loop:
+            counts.setdefault(name, 0)
+            loop_values.setdefault(name, []).append(value)
+        else:
             counts[name] = counts.get(name, 0) + 1
-            values[name] = stmt.value
-        elif isinstance(stmt, ast.Assign):
-            for t in stmt.targets:
-                for name in astutil.target_names(t):
+            values[name] = value
+
+    def collect(stmts, in_loop):
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, ast.Assign):
+                tgt = stmt.targets[0] if len(stmt.targets) == 1 else None
+                if isinstance(tgt, ast.Name):
+                    bind(tgt.id, stmt.value, in_loop)
+                elif (isinstance(tgt, ast.Tuple)
+                      and all(isinstance(e, ast.Name) for e in tgt.elts)
+                      and isinstance(stmt.value, ast.Tuple)
+                      and len(stmt.value.elts) == len(tgt.elts)):
+                    for e, v in zip(tgt.elts, stmt.value.elts):
+                        bind(e.id, v, in_loop)
+                else:
+                    for t in stmt.targets:
+                        for name in astutil.target_names(t):
+                            counts[name] = counts.get(name, 0) + 99
+            elif (isinstance(stmt, ast.AugAssign)
+                  and isinstance(stmt.target, ast.Name) and in_loop):
+                # `tile *= 2` in a loop: desugar to the equivalent rebind
+                bind(stmt.target.id, ast.BinOp(
+                    left=ast.Name(id=stmt.target.id, ctx=ast.Load()),
+                    op=stmt.op, right=stmt.value,
+                ), in_loop)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                for name in astutil.target_names(stmt.target):
                     counts[name] = counts.get(name, 0) + 99
-        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign, ast.For)):
-            for name in astutil.target_names(stmt.target):
-                counts[name] = counts.get(name, 0) + 99
-    single = {n for n, c in counts.items() if c == 1}
+            elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    for name in astutil.target_names(stmt.target):
+                        counts[name] = counts.get(name, 0) + 99
+                collect(stmt.body, True)
+                collect(stmt.orelse, True)
+                continue
+            for _field, sub in ast.iter_fields(stmt):
+                if isinstance(sub, list):
+                    collect(
+                        [s for s in sub if isinstance(s, ast.stmt)], in_loop
+                    )
+
+    collect(list(getattr(scope.node, "body", [])), False)
+    single = {n for n, c in counts.items()
+              if c == 1 and n not in loop_values}
+    carried = {n for n in loop_values
+               if counts.get(n) == 1 and n in values}
     for name in set(facts) & (set(counts) - single):
         facts[name] = UNKNOWN  # rebound locally: parent fact is stale
 
@@ -302,6 +365,43 @@ def scope_facts(mod, scope) -> dict:
             f = eval_expr(mod, values[name], facts)
             if f != UNKNOWN:
                 facts[name] = f
+
+    # loop-carried bindings: ascending join fixpoint from the init fact,
+    # each loop rebind evaluated under the current candidate. On early
+    # stabilization the candidate is an inductive invariant; past the
+    # pass budget the still-moving bounds widen to unknown and only the
+    # divisor chain — monotone under gcd, so guaranteed to settle — is
+    # iterated to ITS fixpoint (`tile = 8` then `tile = _round_up(tile,
+    # 128)` keeps mult 8 and gains the 8..128 hull).
+    for name in carried:
+        f = eval_expr(mod, values[name], facts)
+
+        def step(cur):
+            facts[name] = cur
+            nxt = cur
+            for expr in loop_values[name]:
+                nxt = _join(nxt, eval_expr(mod, expr, facts))
+            return nxt
+
+        for _ in range(_LOOP_PASSES):
+            nxt = step(f)
+            if nxt == f:
+                break
+            f = nxt
+        else:
+            f = Fact(None, None, f.mult)
+            while True:
+                nxt = Fact(None, None, step(f).mult)
+                if nxt == f:
+                    break
+                f = nxt
+        facts[name] = f
+
+    # one more settle pass: singles downstream of a loop-carried name
+    for name in single:
+        f = eval_expr(mod, values[name], facts)
+        if f != UNKNOWN:
+            facts[name] = f
     for name, g in guards.items():
         facts[name] = _intersect(facts.get(name, UNKNOWN), g)
     return facts
