@@ -7,7 +7,8 @@ PY ?= python
 
 .PHONY: test test-cpu lint lint-graft lint-baseline knob-check \
   event-check bench bench-tpu report trace-smoke mem-smoke flight-smoke \
-  chaos-smoke ingest-smoke serve-smoke cost-smoke bench-diff clean
+  chaos-smoke ingest-smoke serve-smoke cost-smoke stream-smoke \
+  bench-diff clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -122,6 +123,15 @@ serve-smoke:
 # one). Exit-code-validated; CPU-safe, seconds.
 cost-smoke:
 	JAX_PLATFORMS=cpu $(PY) examples/obs_cost_run.py
+
+# Streamed-ensemble gate (ISSUE 20): out-of-core boosting (host loop +
+# fused scan) and keyed-bootstrap forests fingerprint-identical to their
+# in-memory twins, streamed working set chunk-bounded where the
+# in-memory twin's is not, refine tail replayed from the chunk stream,
+# one-shot iterators through the spill rung. Exit-code-validated;
+# CPU-safe, ~a minute.
+stream-smoke:
+	JAX_PLATFORMS=cpu $(PY) examples/stream_gbdt_run.py
 
 # Regression gate over the committed CPU baselines (tools/benchdiff over
 # BENCH_r*.json): newest round vs the previous parseable one, noise

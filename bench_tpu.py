@@ -1309,17 +1309,18 @@ def worker_ingest(npz_path: str) -> dict:
     stats = clf.ingest_stats_
 
     # The identity pin: the in-memory fit of the same rows must build
-    # the same tree (refine off — the streamed path has no refine tail).
+    # the same tree — refine included, since the streamed tail now
+    # replays the chunk stream for its raw rows (ISSUE 20).
     t0 = time.perf_counter()
     ref = DecisionTreeClassifier(
         max_depth=DEPTH, max_bins=256, backend=platform,
-        n_devices="all", refine_depth=None,
+        n_devices="all",
     ).fit(Xtr, ytr)
     inmem_s = time.perf_counter() - t0
 
     fp_s = (clf.fit_report_.get("fingerprints") or {}).get("fit")
     fp_m = (ref.fit_report_.get("fingerprints") or {}).get("fit")
-    return {
+    out = {
         "platform": jax.devices()[0].platform,
         "rows": int(N), "features": int(F),
         "chunk_rows": int(chunk_rows),
@@ -1336,6 +1337,47 @@ def worker_ingest(npz_path: str) -> dict:
         "test_acc": round(float((clf.predict(Xte) == yte).mean()), 4),
         "record": record_digest(clf.fit_report_),
     }
+
+    # ISSUE 20: the whole estimator surface streams — time the GBDT
+    # round loop and the keyed-bootstrap forest over the same stream,
+    # each pinned fingerprint-identical to its in-memory twin (the
+    # forest twin opts in to the keyed draws the streamed path uses).
+    from mpitree_tpu import GradientBoostingClassifier, RandomForestClassifier
+
+    def ab(name, make, ref_env=None):
+        t0 = time.perf_counter()
+        s = make().fit(
+            StreamedDataset.from_arrays(Xtr, ytr, chunk_rows=chunk_rows)
+        )
+        sec = {"streamed_fit_s": round(time.perf_counter() - t0, 3)}
+        sec["host_rss_peak_bytes"] = memory_lib.host_rss_bytes() or 0
+        old = {k: os.environ.get(k) for k in (ref_env or {})}
+        os.environ.update(ref_env or {})
+        try:
+            t0 = time.perf_counter()
+            m = make().fit(Xtr, ytr)
+        finally:
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        sec["inmem_fit_s"] = round(time.perf_counter() - t0, 3)
+        a = (s.fit_report_.get("fingerprints") or {}).get("fit")
+        b = (m.fit_report_.get("fingerprints") or {}).get("fit")
+        sec["fingerprint_identical"] = bool(a and a == b)
+        sec["test_acc"] = round(float((s.predict(Xte) == yte).mean()), 4)
+        out[name] = sec
+
+    ab("gbdt", lambda: GradientBoostingClassifier(
+        max_iter=10, max_depth=6, max_bins=256, backend=platform,
+        random_state=0,
+    ))
+    ab("forest", lambda: RandomForestClassifier(
+        n_estimators=8, max_depth=DEPTH, max_bins=256, backend=platform,
+        n_devices="all", random_state=0, refine_depth=None,
+    ), ref_env={"MPITREE_TPU_KEYED_BOOTSTRAP": "1"})
+    return out
 
 
 WORKERS = {

@@ -440,7 +440,12 @@ def run_fused_rounds(*, binned, y_tr, sw_tr, raw_tr, trees, train_scores,
     ``checkpoint_compact_every``: merge checkpoint shards past this
     count at each flush (long-run hygiene).
     """
-    N = binned.x_binned.shape[0]
+    # Real extents from the dataclass, not the buffer: a streamed matrix
+    # arrives pre-padded to the mesh axes (StreamedBinnedData), and the
+    # margin mirror / leaf fetch / pool pricing below must all see the
+    # true row count.
+    N = binned.n_samples
+    F = binned.n_features
     B = binned.n_bins
     platform = mesh.devices.flat[0].platform
     gbdt_x64 = resolve_gbdt_x64(platform)
@@ -456,7 +461,7 @@ def run_fused_rounds(*, binned, y_tr, sw_tr, raw_tr, trees, train_scores,
         cfg, platform, "gbdt", integer_ok=False, gbdt_x64=gbdt_x64,
         total_weight=total_w, obs=obs,
         shape={"n_samples": int(N),
-               "n_features": int(binned.x_binned.shape[1]),
+               "n_features": int(F),
                "n_bins": int(binned.n_bins)},
     )
     Pn = leafwise._pool_capacity(
@@ -471,7 +476,7 @@ def run_fused_rounds(*, binned, y_tr, sw_tr, raw_tr, trees, train_scores,
     # its own analytical plan — pool histograms, the donated margin
     # carry, the (g, h) recompute — BEFORE the first device placement.
     plan = obs_acct.build_memory_plan(
-        mesh=mesh, rows=int(N), features=int(binned.x_binned.shape[1]),
+        mesh=mesh, rows=int(N), features=int(F),
         classes=2, bins=int(B), task="gbdt", max_depth=cfg.max_depth,
         max_leaf_nodes=int(Pn), gbdt_x64=gbdt_x64, subtraction=use_sub,
         hist_budget_bytes=cfg.hist_budget_bytes,
@@ -631,7 +636,7 @@ def run_fused_rounds(*, binned, y_tr, sw_tr, raw_tr, trees, train_scores,
             # rows_scanned / psum payload / expansions stay comparable
             # with the host per-round loop's live numbers.
             rows_i, coll_i, counters_i = obs_acct.leafwise_scan_rows(
-                tree, n_features=binned.x_binned.shape[1], n_bins=B,
+                tree, n_features=F, n_bins=B,
                 n_channels=3, task="gbdt", subtraction=use_sub,
                 gbdt_x64=gbdt_x64, gbdt_leaf_slots=2 * Pn - 1,
             )

@@ -68,6 +68,7 @@ from mpitree_tpu.utils.validation import (
     feature_names_of,
     resolve_min_samples_leaf,
     validate_fit_data,
+    validate_fit_targets,
     validate_max_leaf_nodes,
     validate_predict_data,
     validate_sample_weight,
@@ -249,17 +250,92 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
                     f"'auto', got {rpd!r}"
                 )
 
-    def _fit(self, X, y, sample_weight, *, task, trace_to=None):
+    def _streamed_refusals_(self, X, y, dataset):
+        """Typed refusals for ``fit(dataset=...)`` combinations the
+        streamed round loop cannot honor."""
+        if dataset is not None and X is not None:
+            raise ValueError(
+                "pass the StreamedDataset as X or dataset=, not both"
+            )
+        if y is not None:
+            raise ValueError(
+                "a StreamedDataset carries its own targets; fit(dataset) "
+                "takes no separate y — rebuild the dataset with the labels "
+                "you want"
+            )
+        if self.early_stopping:
+            raise ValueError(
+                "early_stopping scores a held-out raw-feature slice by "
+                "host descent every round; a streamed fit never "
+                "materializes raw rows — disable early_stopping or fit "
+                "in memory"
+            )
+        if float(self.colsample_bytree) < 1.0:
+            raise ValueError(
+                "colsample_bytree < 1 re-slices the binned matrix on "
+                "host every round; the streamed matrix lives sharded on "
+                "device — use subsample (keyed row masks stay streamed) "
+                "or fit in memory"
+            )
+
+    def _fit(self, X, y, sample_weight, *, task, dataset=None,
+             trace_to=None):
         self._validate_params_()
-        names = feature_names_of(X)
-        X, y_t, classes = validate_fit_data(X, y, task=task)
-        sw = validate_sample_weight(sample_weight, X.shape[0])
-        if names is not None:
-            self.feature_names_in_ = names
-        elif hasattr(self, "feature_names_in_"):
-            del self.feature_names_in_
-        self.n_features_ = X.shape[1]
-        self.n_features_in_ = X.shape[1]
+        from mpitree_tpu.models._streamed import is_streamed
+
+        streamed = is_streamed(X, dataset)
+        # Structured run record (mpitree_tpu.obs): per-round rows always
+        # on (losses are already computed); phases/levels profile-gated.
+        obs = BuildObserver()
+        if trace_to is not None:
+            # Chrome-trace timeline (obs/trace.py): a path, or a shared
+            # TraceSink when one file should cover several fits + serving.
+            obs.trace_to(trace_to)
+        res = None
+        if streamed:
+            from mpitree_tpu.ingest import ingest_dataset
+
+            self._streamed_refusals_(
+                None if dataset is None else X, y, dataset
+            )
+            ds = dataset if dataset is not None else X
+            # Placement needs the mesh BEFORE binning (chunks land on
+            # their slots) — the reverse of the in-memory order below.
+            mesh = mesh_lib.resolve_mesh(
+                backend=self.backend, n_devices=self.n_devices
+            )
+            obs.set_mesh(mesh)
+            with obs.span("bin"):
+                res = ingest_dataset(
+                    ds, mesh=mesh, max_bins=self.max_bins,
+                    binning=self.binning, obs=obs,
+                )
+            binned = res.binned
+            y_t, classes = validate_fit_targets(res.y, task=task)
+            if sample_weight is not None and res.sample_weight is not None:
+                raise ValueError(
+                    "sample weights arrived both per-chunk and as a fit "
+                    "argument; pick one"
+                )
+            sw = validate_sample_weight(
+                res.sample_weight if sample_weight is None
+                else sample_weight, binned.n_samples,
+            )
+            self.ingest_stats_ = res.stats
+            if hasattr(self, "feature_names_in_"):
+                del self.feature_names_in_
+            self.n_features_ = binned.n_features
+            self.n_features_in_ = binned.n_features
+        else:
+            names = feature_names_of(X)
+            X, y_t, classes = validate_fit_data(X, y, task=task)
+            sw = validate_sample_weight(sample_weight, X.shape[0])
+            if names is not None:
+                self.feature_names_in_ = names
+            elif hasattr(self, "feature_names_in_"):
+                del self.feature_names_in_
+            self.n_features_ = X.shape[1]
+            self.n_features_in_ = X.shape[1]
         self.n_outputs_ = 1
         if task == "classification":
             if len(classes) < 2:
@@ -275,44 +351,47 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
         self.n_trees_per_iteration_ = K
         seed = seed_from(self.random_state)
 
-        # Held-out rows for early stopping come off the top of a keyed
-        # permutation BEFORE binning: the validation slice must not leak
-        # into the bin edges any more than into the trees.
-        if self.early_stopping:
-            if not 0.0 < float(self.validation_fraction) < 1.0:
-                raise ValueError(
-                    "validation_fraction must be in (0, 1), got "
-                    f"{self.validation_fraction!r}"
-                )
-            perm = np.random.default_rng(seed).permutation(X.shape[0])
-            n_val = max(1, int(round(self.validation_fraction * X.shape[0])))
-            if n_val >= X.shape[0]:
-                raise ValueError("validation_fraction leaves no training rows")
-            val_idx, tr_idx = perm[:n_val], perm[n_val:]
-            X_tr, X_val = X[tr_idx], X[val_idx]
-            y_tr, y_val = y_t[tr_idx], y_t[val_idx]
-            sw_tr = sw[tr_idx] if sw is not None else None
-            sw_val = sw[val_idx] if sw is not None else None
+        if streamed:
+            # early_stopping was refused above: every row trains.
+            X_tr = X_val = y_val = sw_val = None
+            y_tr, sw_tr = y_t, sw
+            n_tr = binned.n_samples
         else:
-            X_tr, y_tr, sw_tr = X, y_t, sw
-            X_val = y_val = sw_val = None
+            # Held-out rows for early stopping come off the top of a keyed
+            # permutation BEFORE binning: the validation slice must not
+            # leak into the bin edges any more than into the trees.
+            if self.early_stopping:
+                if not 0.0 < float(self.validation_fraction) < 1.0:
+                    raise ValueError(
+                        "validation_fraction must be in (0, 1), got "
+                        f"{self.validation_fraction!r}"
+                    )
+                perm = np.random.default_rng(seed).permutation(X.shape[0])
+                n_val = max(
+                    1, int(round(self.validation_fraction * X.shape[0]))
+                )
+                if n_val >= X.shape[0]:
+                    raise ValueError(
+                        "validation_fraction leaves no training rows"
+                    )
+                val_idx, tr_idx = perm[:n_val], perm[n_val:]
+                X_tr, X_val = X[tr_idx], X[val_idx]
+                y_tr, y_val = y_t[tr_idx], y_t[val_idx]
+                sw_tr = sw[tr_idx] if sw is not None else None
+                sw_val = sw[val_idx] if sw is not None else None
+            else:
+                X_tr, y_tr, sw_tr = X, y_t, sw
+                X_val = y_val = sw_val = None
 
-        n_tr = X_tr.shape[0]
-        # Structured run record (mpitree_tpu.obs): per-round rows always
-        # on (losses are already computed); phases/levels profile-gated.
-        obs = BuildObserver()
-        if trace_to is not None:
-            # Chrome-trace timeline (obs/trace.py): a path, or a shared
-            # TraceSink when one file should cover several fits + serving.
-            obs.trace_to(trace_to)
-        with obs.span("bin"):
-            binned = bin_dataset(
-                X_tr, max_bins=self.max_bins, binning=self.binning
+            n_tr = X_tr.shape[0]
+            with obs.span("bin"):
+                binned = bin_dataset(
+                    X_tr, max_bins=self.max_bins, binning=self.binning
+                )
+            mesh = mesh_lib.resolve_mesh(
+                backend=self.backend, n_devices=self.n_devices
             )
-        mesh = mesh_lib.resolve_mesh(
-            backend=self.backend, n_devices=self.n_devices
-        )
-        obs.set_mesh(mesh)
+            obs.set_mesh(mesh)
         cfg = BuildConfig(
             task="gbdt",
             max_depth=self.max_depth,
@@ -354,9 +433,24 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
                     if k_ not in ("checkpoint", "checkpoint_every")
                 }
                 ck_params["task"] = task
-                ck = BoostCheckpoint.open(
-                    self.checkpoint, ck_params, X, y_t, sw
-                )
+                if streamed:
+                    # No raw matrix ever exists to hash: the sketch-derived
+                    # bin table (same stream -> same edges, bit-identical)
+                    # plus the real row count stand in for X; y/weights
+                    # hash as usual. A resumed streamed fit re-ingests and
+                    # must land on the identical table or resume refuses.
+                    ck_params["streamed_rows"] = int(binned.n_samples)
+                    ck_params["streamed_n_cand"] = (
+                        np.asarray(binned.n_cand).tolist()
+                    )
+                    ck = BoostCheckpoint.open(
+                        self.checkpoint, ck_params,
+                        np.ascontiguousarray(binned.thresholds), y_t, sw,
+                    )
+                else:
+                    ck = BoostCheckpoint.open(
+                        self.checkpoint, ck_params, X, y_t, sw
+                    )
 
         baseline = loss.init_raw(y_tr, sw_tr)  # (K,) f64
         self._baseline_raw = np.asarray(baseline, np.float64)
@@ -440,8 +534,10 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
             early_stopping=bool(self.early_stopping),
             colsample=float(self.colsample_bytree),
             max_depth=self.max_depth, max_leaf_nodes=self.max_leaf_nodes,
-            n_samples=binned.x_binned.shape[0],
-            n_features=binned.x_binned.shape[1], n_bins=binned.n_bins,
+            # Real extents, not buffer shapes: a streamed matrix is
+            # pre-padded to the mesh axes and would mis-price the pool.
+            n_samples=binned.n_samples,
+            n_features=binned.n_features, n_bins=binned.n_bins,
             hist_budget_bytes=cfg.hist_budget_bytes,
             feature_shards=mesh_lib.feature_shards(mesh),
             policy_evidence=cfg.policy_evidence, obs=obs,
@@ -654,6 +750,8 @@ class _BaseGradientBoosting(ReportMixin, BaseEstimator):
         # Always-on structured run record (mpitree_tpu.obs): per-round
         # rows, engine decision, compile/collective accounting.
         self.fit_report_ = obs.report(trees=self.trees_)
+        if res is not None:
+            res.close()  # release the spill store, if the ingest made one
         return self
 
     # -- predict -----------------------------------------------------------
@@ -738,9 +836,11 @@ class GradientBoostingRegressor(RegressorMixin, _BaseGradientBoosting):
             checkpoint_compact_every=checkpoint_compact_every,
         )
 
-    def fit(self, X, y, sample_weight=None, *, trace_to=None):
+    def fit(self, X=None, y=None, sample_weight=None, *, dataset=None,
+            trace_to=None):
         return self._fit(
-            X, y, sample_weight, task="regression", trace_to=trace_to
+            X, y, sample_weight, task="regression", dataset=dataset,
+            trace_to=trace_to,
         )
 
     def predict(self, X):
@@ -791,9 +891,11 @@ class GradientBoostingClassifier(ClassifierMixin, _BaseGradientBoosting):
             checkpoint_compact_every=checkpoint_compact_every,
         )
 
-    def fit(self, X, y, sample_weight=None, *, trace_to=None):
+    def fit(self, X=None, y=None, sample_weight=None, *, dataset=None,
+            trace_to=None):
         return self._fit(
-            X, y, sample_weight, task="classification", trace_to=trace_to
+            X, y, sample_weight, task="classification", dataset=dataset,
+            trace_to=trace_to,
         )
 
     def decision_function(self, X):

@@ -194,6 +194,18 @@ KNOBS: tuple = (
     Knob("MPITREE_TPU_SKETCH_CAPACITY", "int", 1 << 20,
          "per-feature unique-value cap before the quantile sketch"
          " compacts", parse=int),
+    Knob("MPITREE_TPU_SPILL_DIR", "path", None,
+         "spill rung for one-shot chunk iterators: the first ingest pass"
+         " tees every chunk here (atomic files, manifest-last commit) so"
+         " later passes replay from disk; unset = one-shot sources are"
+         " refused"),
+    Knob("MPITREE_TPU_SPILL_BYTES", "int", 16 << 30,
+         "spill-store size cap in bytes; a stream that would exceed it"
+         " raises before the offending chunk is kept", parse=int),
+    Knob("MPITREE_TPU_KEYED_BOOTSTRAP", "bool", False,
+         "`1` switches in-memory forest bootstrap/feature draws to the"
+         " keyed counter-based sampler streamed forests always use —"
+         " the fingerprint twin of a streamed forest fit", parse=_one),
     Knob("MPITREE_TPU_NO_NATIVE", "bool", False,
          "disable the C++ host split kernel (numpy fallback)",
          parse=_flag),

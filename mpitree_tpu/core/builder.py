@@ -869,6 +869,57 @@ def build_tree(
     f_shard = (F + ((-F) % df)) // df
     K = _chunk_size(N, f_shard, B, C, cfg)
     if engine == "auto" and not debug:
+        # Evidence-driven engine choice (ISSUE 20 satellite, the PR-18
+        # advisor widened): stored leafwise_ab A/Bs may route the build
+        # through the best-first frontier INSTEAD of the static fused
+        # pick — with the leaf budget pinned at the level-wise node
+        # bound (2^max_depth) the finished tree is bit-identical, so
+        # only wall-clock is at stake. Hard constraints the evidence
+        # cannot override: a finite depth small enough for that budget,
+        # no feature axis, no monotonic constraints, no per-node
+        # sampling (the keyed-draw threading differs per engine).
+        adv = None
+        budget = (
+            2 ** int(cfg.max_depth)
+            if cfg.max_depth is not None and 1 <= int(cfg.max_depth) <= 12
+            else None
+        )
+        if (task != "gbdt" and budget is not None and df == 1
+                and mono_cst is None and not sampling):
+            from mpitree_tpu.obs import advisor
+
+            adv = advisor.advise_engine(
+                platform=platform,
+                shape={
+                    "n_samples": int(N), "n_features": int(F),
+                    "n_bins": int(B), "max_depth": int(cfg.max_depth),
+                },
+                policy_evidence=cfg.policy_evidence,
+            )
+            advisor.record_advice(timer, adv)
+        if adv is not None and adv["value"] == "leafwise":
+            # The best-first engine records its own engine/frontier
+            # decisions; the advisor_engine decision above carries the
+            # evidence that routed here.
+            ledger_and_preflight(
+                binned=binned, mesh=mesh, cfg=cfg, task=task,
+                n_classes=n_classes, sample_weight=sample_weight,
+                platform=platform, gbdt_x64=gbdt64, timer=timer,
+                engine="leafwise",
+            )
+            from mpitree_tpu.core.leafwise_builder import (
+                build_tree_leafwise,
+            )
+
+            return build_tree_leafwise(
+                binned, y,
+                config=dataclasses.replace(cfg, max_leaf_nodes=budget),
+                mesh=mesh, n_classes=n_classes,
+                sample_weight=sample_weight, refit_targets=refit_targets,
+                timer=timer, return_leaf_ids=return_leaf_ids,
+                feature_sampler=feature_sampler, mono_cst=mono_cst,
+                snapshot_slot=snapshot_slot,
+            )
         # One compiled program beats per-level dispatch on the committed
         # evidence (BENCH_TPU.jsonl r4 line 1): the fused engine built the
         # full depth-20 covtype tree in 17.5s warm (0.88s/level including

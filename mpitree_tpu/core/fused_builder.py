@@ -1130,15 +1130,23 @@ def build_forest_fused(
     Trees are bit-identical to sequential single-device builds with the same
     weights/masks: the per-device build body is the same program.
     """
+    from mpitree_tpu.ops.binning import StreamedBinnedData
+
     cfg = config
     task = cfg.task
     timer = timer if timer is not None else PhaseTimer(enabled=False)
+    # A streamed matrix arrives PRE-padded and pre-placed by the ingest
+    # tier: real extents come from the dataclass, the program width from
+    # the buffer (ingest feature padding stays inert — its candidate-mask
+    # columns are force-zeroed below, so no split ever lands there).
+    streamed = isinstance(binned, StreamedBinnedData)
     T, N = weights.shape
-    F = binned.x_binned.shape[1]
+    F = binned.n_features if streamed else binned.x_binned.shape[1]
+    Fb = binned.x_binned.shape[1]
     B = binned.n_bins
     C = n_classes if task == "classification" else 3
 
-    K = _chunk_size(N, F, B, C, cfg)
+    K = _chunk_size(N, Fb, B, C, cfg)
     M = _node_capacity(N, cfg.max_depth)
     Dt, Dd = mesh_lib.tree_data_shape(
         mesh.size, T, dataset_bytes=binned.x_binned.nbytes,
@@ -1232,6 +1240,13 @@ def build_forest_fused(
 
     ws = weights.astype(np.float32)
     cm = np.asarray(cand_masks)
+    if Fb != F:
+        # Ingest feature padding: zero candidate columns keep the padded
+        # features inert inside the program.
+        cm = np.concatenate(
+            [cm, np.zeros((cm.shape[0], Fb - F, cm.shape[2]), bool)],
+            axis=1,
+        )
     # Per-tree leaf floors (sklearn recomputes min_weight_fraction_leaf per
     # bootstrap); a shared scalar floor broadcasts when none are given.
     mcw = (
@@ -1251,20 +1266,52 @@ def build_forest_fused(
     if T_pad != T:  # pad with repeats; surplus trees are dropped after build
         ws = np.concatenate([ws, np.broadcast_to(ws[-1:], (T_pad - T, N))])
         cm = np.concatenate(
-            [cm, np.broadcast_to(cm[-1:], (T_pad - T, F, cm.shape[2]))]
+            [cm, np.broadcast_to(cm[-1:], (T_pad - T, Fb, cm.shape[2]))]
         )
         mcw = np.concatenate([mcw, np.broadcast_to(mcw[-1:], (T_pad - T,))])
         mid = np.concatenate([mid, np.broadcast_to(mid[-1:], (T_pad - T,))])
         rks = np.concatenate([rks, np.broadcast_to(rks[-1:], (T_pad - T,))])
 
     with timer.phase("shard"):
-        xb_h, y_h, ws, nid_h = mesh_lib.pad_row_arrays(
-            binned.x_binned, np.asarray(y), ws, np.zeros(N, np.int32), Dd
-        )
+        if streamed:
+            # The matrix is already device-resident, padded for the
+            # ingest mesh's data axis (pad rows at the global END). That
+            # padding carries over: pad rows ride as node_id=-1 /
+            # weight-0 rows exactly like pad_row_arrays', contributing
+            # +0.0f to every histogram — bit-inert whatever the width
+            # mismatch between the ingest data axis and this forest
+            # mesh's Dd. Only the row-axis divisibility must be
+            # re-established when Dd does not divide the buffer rows.
+            xb_h = binned.x_binned
+            R = int(xb_h.shape[0])
+            extra = (-R) % Dd
+            if extra:
+                xb_h = jnp.concatenate(
+                    [xb_h, jnp.zeros((extra, Fb), xb_h.dtype)]
+                )
+                R += extra
+            pad = R - N
+            y_np = np.asarray(y)
+            y_h = np.concatenate([y_np, np.zeros(pad, y_np.dtype)])
+            ws = np.concatenate(
+                [ws, np.zeros((ws.shape[0], pad), np.float32)], axis=1
+            )
+            nid_h = np.concatenate(
+                [np.zeros(N, np.int32), np.full(pad, -1, np.int32)]
+            )
+        else:
+            xb_h, y_h, ws, nid_h = mesh_lib.pad_row_arrays(
+                binned.x_binned, np.asarray(y), ws, np.zeros(N, np.int32),
+                Dd,
+            )
         cst_op = (
-            np.zeros(F, np.int32) if mono_cst is None
+            np.zeros(Fb, np.int32) if mono_cst is None
             else np.ascontiguousarray(mono_cst, np.int32)
         )
+        if mono_cst is not None and len(cst_op) != Fb:
+            cst_op = np.concatenate(
+                [cst_op, np.zeros(Fb - len(cst_op), np.int32)]
+            )
         # Placement from the rule table (partition.shard_build_state) —
         # the same names _make_forest_fn's in_specs consult, trimmed the
         # same way on both forest meshes, replacing the per-branch

@@ -44,6 +44,27 @@ import numpy as np
 from mpitree_tpu.core.tree_struct import TreeArrays
 
 
+class _GatheredRows:
+    """A gathered raw-row block masquerading as the training matrix.
+
+    The tail engines only ever *fancy row-index* ``X`` with training-row
+    arrays (``X[rows_all]`` / ``X[rows]``), so a streamed fit — whose raw
+    matrix never materializes — satisfies them with one chunk-stream
+    replay: the sorted union of every candidate's rows gathers into a
+    dense block (``ingest.stream.StreamRowProvider``), and ``__getitem__``
+    maps global row ids onto it. Candidate row sets are disjoint, so the
+    block is exactly the tail's working set — host residency stays
+    O(refine rows), not O(N).
+    """
+
+    def __init__(self, rows: np.ndarray, block: np.ndarray):
+        self._rows = rows          # sorted global row ids
+        self._block = block        # (len(rows), F) f32
+
+    def __getitem__(self, idx):
+        return self._block[np.searchsorted(self._rows, idx)]
+
+
 def _alloc_extended(top: TreeArrays, n_total: int) -> TreeArrays:
     """Copy ``top`` into freshly allocated arrays of ``n_total`` nodes.
 
@@ -470,6 +491,17 @@ def refine_deep_subtrees(
     candidates, starts, ends = candidates[keep], starts[keep], ends[keep]
     if obs is not None:
         obs.counter("refine_candidates", len(candidates))
+
+    if hasattr(X, "gather"):
+        # Streamed fit: the raw matrix never materialized. Replay the
+        # chunk stream ONCE for the sorted union of every candidate's
+        # rows; both tail engines below then index the gathered block
+        # transparently. Candidate row sets are disjoint, so the union
+        # is duplicate-free and np.searchsorted is exact.
+        needed = np.sort(
+            np.concatenate([order[s:e] for s, e in zip(starts, ends)])
+        )
+        X = _GatheredRows(needed, X.gather(needed))
 
     sampling = feature_sampler is not None and feature_sampler.active
     batched = native.lib() is not None and not (

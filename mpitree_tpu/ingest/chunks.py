@@ -163,8 +163,15 @@ class NpzShards:
 
 class IterChunks:
     """A re-iterable wrapped as a source: a zero-arg FACTORY returning a
-    fresh ``(X, y[, w])`` iterator per pass (generators are one-shot, and
-    the pipeline streams twice), or a list/tuple of chunk tuples."""
+    fresh ``(X, y[, w])`` iterator per pass (the pipeline streams more
+    than once), or a list/tuple of chunk tuples. A bare one-shot
+    iterator (a generator, a cursor) is accepted too, flagged
+    ``one_shot`` — the ingest pipeline then requires the spill rung
+    (``MPITREE_TPU_SPILL_DIR``) so later passes replay from disk."""
+
+    n_features = None  # discovered from the first chunk
+    n_rows = None
+    one_shot = False
 
     def __init__(self, chunks_or_factory):
         if callable(chunks_or_factory):
@@ -172,15 +179,27 @@ class IterChunks:
         elif isinstance(chunks_or_factory, (list, tuple)):
             items = list(chunks_or_factory)
             self._factory = lambda: iter(items)
+        elif hasattr(chunks_or_factory, "__next__"):
+            self._iter = chunks_or_factory
+            self.one_shot = True
+            self._factory = self._drain_once
         else:
             raise TypeError(
                 "from_chunks wants a zero-arg factory returning a fresh "
-                "iterator, or a list of (X, y[, w]) tuples — a bare "
-                "generator would be exhausted after the sketch pass"
+                "iterator, a list of (X, y[, w]) tuples, or a one-shot "
+                "iterator (which needs MPITREE_TPU_SPILL_DIR set so the "
+                "pipeline's later passes can replay it from disk)"
             )
 
-    n_features = None  # discovered from the first chunk
-    n_rows = None
+    def _drain_once(self):
+        it, self._iter = self._iter, None
+        if it is None:
+            raise RuntimeError(
+                "one-shot chunk iterator already consumed — the ingest "
+                "pipeline streams its source more than once; spill was "
+                "expected to replay this pass (MPITREE_TPU_SPILL_DIR)"
+            )
+        return it
 
     def chunks(self, chunk_rows=None, *, validate=True):
         for item in self._factory():

@@ -27,6 +27,7 @@ import numpy as np
 
 from mpitree_tpu.ingest import chunks as chunks_mod
 from mpitree_tpu.ingest import place as place_mod
+from mpitree_tpu.ingest import spill as spill_mod
 from mpitree_tpu.ingest.sketch import SketchSet, resolve_capacity
 from mpitree_tpu.obs import memory as memory_lib
 from mpitree_tpu.ops.binning import StreamedBinnedData, bin_with_thresholds
@@ -162,16 +163,78 @@ def _allgather_rows(local: np.ndarray, counts: np.ndarray) -> np.ndarray:
     ])
 
 
+class StreamRowProvider:
+    """Raw-row gather over the chunk stream — the hybrid refine tail's
+    data source when no materialized matrix exists.
+
+    ``gather(rows)`` makes ONE pass over the source and returns the
+    requested global rows as a dense f32 block in ``rows`` order
+    (``rows`` must be sorted ascending; refine candidates' row sets are
+    disjoint, so their sorted union qualifies). Host residency is one
+    chunk plus the gathered block — the refine tail's candidates are a
+    small fraction of the training set by construction.
+    """
+
+    def __init__(self, ds: StreamedDataset, *, n_rows: int,
+                 row_offset: int = 0):
+        self._ds = ds
+        self.n_rows = int(n_rows)
+        self.row_offset = int(row_offset)
+
+    def gather(self, rows: np.ndarray) -> np.ndarray:
+        rows = np.asarray(rows, np.int64)
+        out = None
+        pos = self.row_offset
+        found = 0
+        for X, _, _ in self._ds.chunks(validate=False):
+            n = X.shape[0]
+            lo, hi = np.searchsorted(rows, [pos, pos + n])
+            if hi > lo:
+                if out is None:
+                    out = np.empty((len(rows), X.shape[1]), np.float32)
+                out[lo:hi] = X[rows[lo:hi] - pos]
+                found += hi - lo
+            pos += n
+        if found != len(rows):
+            raise ValueError(
+                f"streamed refine gather found {found}/{len(rows)} rows "
+                "in the local chunk stream — multi-host streamed refine "
+                "needs every process's rows and is not supported; set "
+                "refine_depth=None for multi-host streamed fits"
+            )
+        return out
+
+
 class IngestResult:
     """What one full ingest produces: the device-assembled
     ``StreamedBinnedData``, host per-row state, and the stats/plan the
-    observer records."""
+    observer records. ``close()`` releases the spill store (no-op when
+    the source was re-iterable)."""
 
-    def __init__(self, binned, y, sample_weight, stats):
+    def __init__(self, binned, y, sample_weight, stats, *, dataset=None,
+                 spill=None, row_offset: int = 0):
         self.binned = binned
         self.y = y
         self.sample_weight = sample_weight
         self.stats = stats
+        self.dataset = dataset
+        self.spill = spill
+        self.row_offset = int(row_offset)
+
+    def row_provider(self) -> StreamRowProvider | None:
+        """A raw-row gather handle for the refine tail (None when the
+        source is unknown)."""
+        if self.dataset is None:
+            return None
+        return StreamRowProvider(
+            self.dataset, n_rows=int(self.binned.n_rows),
+            row_offset=self.row_offset,
+        )
+
+    def close(self) -> None:
+        if self.spill is not None:
+            self.spill.close()
+            self.spill = None
 
 
 # graftlint: host-fn — ingest driver: two host streaming passes and the
@@ -190,6 +253,9 @@ def ingest_dataset(ds: StreamedDataset, *, mesh, max_bins: int = 256,
 
     if binning not in ("auto", "exact", "quantile"):
         raise ValueError(f"unknown binning mode: {binning!r}")
+    # One-shot sources ride the spill rung (or are refused with the
+    # knob named) BEFORE the first pass consumes them.
+    ds.source, spill_store = spill_mod.resolve_spill(ds.source, obs=obs)
     t0 = time.perf_counter()
     sketches, y_local, w_local = sketch_dataset(ds)
     sketch_s = time.perf_counter() - t0
@@ -226,6 +292,9 @@ def ingest_dataset(ds: StreamedDataset, *, mesh, max_bins: int = 256,
             "feature": mesh_lib.feature_shards(mesh),
         },
         max_bins=max_bins,
+        spill_bytes=(
+            None if spill_store is None else int(spill_store.bytes)
+        ),
     )
     if obs is not None:
         obs.memory_plan(plan)
@@ -279,4 +348,10 @@ def ingest_dataset(ds: StreamedDataset, *, mesh, max_bins: int = 256,
         host_rss = memory_lib.host_rss_bytes()
         if host_rss:
             stats["host_rss_bytes"] = int(host_rss)
-    return IngestResult(binned, y_local, w_local, stats)
+    if spill_store is not None:
+        stats["spill_bytes"] = int(spill_store.bytes)
+        stats["spill_chunks"] = len(spill_store.names)
+    return IngestResult(
+        binned, y_local, w_local, stats,
+        dataset=ds, spill=spill_store, row_offset=row_offset,
+    )

@@ -13,7 +13,10 @@ record:
 - no host tier and no host failover rung (the numpy builder wants a
   host-resident matrix; the ladder keeps retry + OOM rescue — the
   leaf-wise stance);
-- no hybrid refine tail (it re-bins raw rows, which never exist here);
+- the hybrid refine tail gathers its candidates' raw rows by replaying
+  the chunk stream once (``ingest.stream.StreamRowProvider``) instead of
+  fancy-indexing a matrix that never materializes; multi-host fits stay
+  crown-only (each process streams only its own shard);
 - device binning is moot (edges come from the sketch pass).
 """
 
@@ -30,6 +33,7 @@ from mpitree_tpu.utils.validation import (
     min_child_weight,
     min_decrease_scaled,
     record_sklearn_attributes,
+    resolve_refine,
     validate_fit_targets,
     validate_max_leaf_nodes,
     validate_sample_weight,
@@ -128,16 +132,30 @@ def streamed_fit(est, X, dataset, y=None, sample_weight=None,
         est.monotonic_cst, F, task=task,
         **({"n_classes": len(classes)} if task == "classification" else {}),
     )
-    # The hybrid tail re-bins raw rows host-side; a streamed fit has no
-    # raw matrix to re-bin — single-engine full depth, recorded.
+    # The hybrid tail gathers its candidates' RAW rows by replaying the
+    # chunk stream once (ingest.stream.StreamRowProvider), so streamed
+    # single-tree fits refine exactly like in-memory ones. Multi-host
+    # fits cannot (each process streams only its own shard — the gather
+    # would miss remote rows): crown-only, recorded as the streamed skip.
+    import jax
+
+    multihost = jax.process_count() > 1
+    rd, refine, crown_depth = resolve_refine(
+        est.max_depth, est.refine_depth,
+        n_rows=N, quantized=binned.quantized,
+    )
+    if multihost or mono is not None or mln is not None:
+        rd, refine, crown_depth = None, False, est.max_depth
     note_refine(
-        obs, refine=False, rd=None, crown_depth=est.max_depth,
-        refine_depth_param=est.refine_depth, streamed=True,
+        obs, refine=refine, rd=rd, crown_depth=crown_depth,
+        refine_depth_param=est.refine_depth,
+        constrained=mono is not None, leafwise=mln is not None,
+        streamed=multihost,
     )
     cfg = BuildConfig(
         task=task,
         criterion=est.criterion if task == "classification" else "mse",
-        max_depth=est.max_depth,
+        max_depth=crown_depth,
         max_leaf_nodes=mln,
         min_samples_split=est.min_samples_split,
         min_child_weight=min_child_weight(
@@ -171,17 +189,27 @@ def streamed_fit(est, X, dataset, y=None, sample_weight=None,
             binned, y_build, config=rescue.apply(cfg), mesh=mesh,
             n_classes=n_classes, sample_weight=sw, refit_targets=refit,
             timer=timer, feature_sampler=sampler, mono_cst=mono,
-            snapshot_slot=slot,
+            snapshot_slot=slot, return_leaf_ids=refine,
         )
 
     # No host rung: the numpy tier wants a host-resident matrix, which a
     # streamed fit never builds — retry + OOM rescue only (the leaf-wise
     # ladder stance; re-streaming into a host matrix would defeat the
     # out-of-core contract).
-    est.tree_ = retry_device(
+    out = retry_device(
         _dev, what=f"{type(est).__name__}.fit streamed build",
         obs=obs, resume=slot, rescue=rescue,
     )
+    est.tree_, leaf_ids = out if refine else (out, None)
+    if refine:
+        from mpitree_tpu.core.hybrid_builder import apply_refine
+
+        est.tree_ = apply_refine(
+            est.tree_, leaf_ids, res.row_provider(), y_build, cfg=cfg,
+            max_depth=est.max_depth, rd=rd, timer=timer,
+            n_classes=n_classes, sample_weight=sw, refit_targets=refit,
+            feature_sampler=sampler,
+        )
     if est.ccp_alpha:
         from mpitree_tpu.utils.pruning import ccp_prune
 
@@ -194,4 +222,5 @@ def streamed_fit(est, X, dataset, y=None, sample_weight=None,
     est.fit_stats_ = timer.summary() if timer.enabled else None
     note_serving(obs, [est.tree_])
     est.fit_report_ = obs.report(tree=est.tree_)
+    res.close()  # release the spill store, if the ingest opened one
     return est

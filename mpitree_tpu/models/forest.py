@@ -32,6 +32,7 @@ import numpy as np
 from sklearn.base import BaseEstimator, ClassifierMixin, RegressorMixin
 from sklearn.utils.validation import check_is_fitted
 
+from mpitree_tpu.config import knobs
 from mpitree_tpu.core.builder import (
     BuildConfig,
     build_tree,
@@ -48,13 +49,21 @@ from mpitree_tpu.obs import (
     warn_event,
 )
 from mpitree_tpu.ops.binning import bin_dataset
-from mpitree_tpu.ops.sampling import NodeFeatureSampler, n_subspace_features
+from mpitree_tpu.ops.sampling import (
+    NodeFeatureSampler,
+    bootstrap_weights,
+    feature_subset,
+    n_subspace_features,
+    seed_from,
+    tree_seed,
+)
 from mpitree_tpu.parallel import mesh as mesh_lib
 from mpitree_tpu.resilience import (
     ForestCheckpoint,
     OomRescue,
     SnapshotSlot,
     device_failover,
+    retry_device,
 )
 from mpitree_tpu.serving.tables import note_serving
 from mpitree_tpu.utils.validation import (
@@ -65,6 +74,7 @@ from mpitree_tpu.utils.validation import (
     record_sklearn_attributes,
     resolve_refine,
     validate_fit_data,
+    validate_fit_targets,
     validate_predict_data,
     validate_sample_weight,
 )
@@ -191,9 +201,86 @@ class _BaseForest(ReportMixin, BaseEstimator):
             )
         return prev
 
+    # graftlint: host-fn — streamed-fit preamble: refusals, mesh-first
+    # resolve and the two host ingest passes are deliberate host work
+    def _open_stream(self, X, dataset, y, *, trace_to=None):
+        """Streamed-fit preamble shared by both forest tasks: refusals,
+        mesh-first resolve, ingest. Returns ``(IngestResult, mesh)`` with
+        ``self._fit_obs`` opened (the ingest decision and memory plan
+        already recorded on it)."""
+        from mpitree_tpu.ingest import StreamedDataset, ingest_dataset
+
+        ds = dataset if isinstance(dataset, StreamedDataset) else X
+        if dataset is not None and X is not None:
+            raise ValueError(
+                "pass the StreamedDataset as X or dataset=, not both"
+            )
+        if y is not None:
+            raise ValueError(
+                "a StreamedDataset carries its own targets; fit(dataset) "
+                "takes no separate y — rebuild the dataset with the "
+                "labels you want"
+            )
+        if self.oob_score:
+            raise ValueError(
+                "oob_score=True needs a raw-X descent over the training "
+                "rows, which a streamed fit never materializes — score "
+                "on a held-out stream instead"
+            )
+        obs = self._fit_obs = BuildObserver()
+        if trace_to is not None:
+            obs.trace_to(trace_to)
+        # Placement needs the mesh BEFORE binning (chunks land on their
+        # slots), so resolve it first — the streamed path is device-only.
+        mesh = mesh_lib.resolve_mesh(
+            backend=self.backend, n_devices=self.n_devices
+        )
+        obs.set_mesh(mesh)
+        with obs.span("bin"):
+            res = ingest_dataset(
+                ds, mesh=mesh, max_bins=self.max_bins,
+                binning=self.binning, obs=obs,
+            )
+        self.ingest_stats_ = res.stats
+        return res, mesh
+
+    def _stream_weight(self, res, sample_weight):
+        """Merge per-chunk and fit-argument sample weights (at most one)."""
+        if sample_weight is not None and res.sample_weight is not None:
+            raise ValueError(
+                "sample weights arrived both per-chunk and as a fit "
+                "argument; pick one"
+            )
+        return validate_sample_weight(
+            res.sample_weight if sample_weight is None else sample_weight,
+            res.binned.n_samples,
+        )
+
+    def _finish_fit(self):
+        """Common fit tail: finalize the observer into the run record."""
+        obs = self._fit_obs
+        del self._fit_obs
+        self.fit_stats_ = obs.summary() if obs.enabled else None
+        # Serving-table notes (mpitree_tpu.serving): the flat-table plan
+        # the compiled inference path will serve this forest from; then
+        # the ensemble run record aggregating per-tree child summaries
+        # plus the shared phases/counters/collectives (mpitree_tpu.obs).
+        note_serving(obs, self.trees_)
+        self.fit_report_ = obs.report(trees=self.trees_)
+        return self
+
     def _fit_forest(self, X, y_enc, *, task, criterion, n_classes=None,
-                    refit_targets=None, sample_weight=None, trace_to=None):
-        n = X.shape[0]
+                    refit_targets=None, sample_weight=None, trace_to=None,
+                    stream=None):
+        streamed = stream is not None
+        if streamed:
+            # fit() already ran the ingest passes (_open_stream): the
+            # matrix is mesh-resident StreamedBinnedData, X is None.
+            _res, mesh = stream
+            binned = _res.binned
+            n, F = binned.n_samples, binned.n_features
+        else:
+            n, F = X.shape
         if self.oob_score and not self.bootstrap:
             raise ValueError("oob_score=True requires bootstrap=True")
         cce = getattr(self, "checkpoint_compact_every", None)
@@ -207,40 +294,75 @@ class _BaseForest(ReportMixin, BaseEstimator):
         # The ensemble's structured run record (mpitree_tpu.obs): one
         # observer accumulates phases/counters/collectives across every
         # member build; fit() finalizes it into fit_report_ (post-OOB).
-        obs = self._fit_obs = BuildObserver()
-        if trace_to is not None:
-            # Chrome-trace timeline (obs/trace.py): a path, or a shared
-            # TraceSink covering several fits + serving in one file.
-            obs.trace_to(trace_to)
+        # A streamed fit's observer already exists (the ingest decision
+        # and memory plan landed on it during _open_stream).
+        if streamed:
+            obs = self._fit_obs
+        else:
+            obs = self._fit_obs = BuildObserver()
+            if trace_to is not None:
+                # Chrome-trace timeline (obs/trace.py): a path, or a shared
+                # TraceSink covering several fits + serving in one file.
+                obs.trace_to(trace_to)
         prev_trees = self._warm_start_trees()
         sample_weight = validate_sample_weight(sample_weight, n)
         rng = np.random.default_rng(self.random_state)
-        # Host binning on purpose (vs the tree estimators' bin_for_engine):
-        # a forest bins ONCE for T tree builds, so the device-binning win is
-        # amortized away, while the host copy feeds every per-tree failover
-        # without an ensure-host seam through the tree_b replaces.
-        with obs.span("bin"):
-            binned = bin_dataset(
-                X, max_bins=self.max_bins, binning=self.binning
-            )
-        use_host = prefer_host_path(*X.shape, self.n_devices, self.backend)
+        # Keyed counter-based draws (ops/sampling): every per-tree draw a
+        # pure function of (seed, tree, row/feature). Always on for
+        # streamed fits — a host-RNG replay has no defined order over a
+        # chunk stream — and opt-in for in-memory fits, which makes an
+        # in-memory fit the fingerprint twin of its streamed form.
+        keyed = streamed or bool(knobs.value("MPITREE_TPU_KEYED_BOOTSTRAP"))
+        if keyed:
+            import numbers
+
+            if self.random_state is not None and not isinstance(
+                self.random_state, numbers.Integral
+            ):
+                raise ValueError(
+                    "keyed bootstrap draws (streamed fits and "
+                    "MPITREE_TPU_KEYED_BOOTSTRAP=1) are a pure function "
+                    "of (seed, tree, row); random_state must be None or "
+                    "an int"
+                )
+            kseed = seed_from(self.random_state)
+        if not streamed:
+            # Host binning on purpose (vs the tree estimators'
+            # bin_for_engine): a forest bins ONCE for T tree builds, so the
+            # device-binning win is amortized away, while the host copy
+            # feeds every per-tree failover without an ensure-host seam
+            # through the tree_b replaces.
+            with obs.span("bin"):
+                binned = bin_dataset(
+                    X, max_bins=self.max_bins, binning=self.binning
+                )
+        use_host = (
+            False if streamed
+            else prefer_host_path(n, F, self.n_devices, self.backend)
+        )
         note_build_path(
             obs, host=use_host, backend=self.backend,
-            n_rows=n, n_features=X.shape[1],
+            n_rows=n, n_features=F,
         )
-        mesh = None if use_host else mesh_lib.resolve_mesh(
-            backend=self.backend, n_devices=self.n_devices
-        )
+        if not streamed:
+            mesh = None if use_host else mesh_lib.resolve_mesh(
+                backend=self.backend, n_devices=self.n_devices
+            )
         if mesh is not None:
             obs.set_mesh(mesh)
-        rd, refine, crown_depth = resolve_refine(
-            self.max_depth, self.refine_depth,
-            n_rows=n, quantized=binned.quantized,
-        )
+        if streamed:
+            # T hybrid tails would each replay the raw chunk stream once
+            # per tree: streamed ensembles stay crown-only, full depth.
+            rd, refine, crown_depth = None, False, self.max_depth
+        else:
+            rd, refine, crown_depth = resolve_refine(
+                self.max_depth, self.refine_depth,
+                n_rows=n, quantized=binned.quantized,
+            )
         from mpitree_tpu.utils.monotonic import validate_monotonic_cst
 
         mono = validate_monotonic_cst(
-            self.monotonic_cst, X.shape[1], task=task, n_classes=n_classes
+            self.monotonic_cst, F, task=task, n_classes=n_classes
         )
         if mono is not None:
             # Single-engine full-depth builds under constraints (same
@@ -249,7 +371,7 @@ class _BaseForest(ReportMixin, BaseEstimator):
         note_refine(
             obs, refine=refine, rd=rd, crown_depth=crown_depth,
             refine_depth_param=self.refine_depth,
-            constrained=mono is not None,
+            constrained=mono is not None, streamed=streamed,
         )
         cfg = BuildConfig(
             task=task, criterion=criterion, max_depth=crown_depth,
@@ -281,7 +403,7 @@ class _BaseForest(ReportMixin, BaseEstimator):
                     self.min_impurity_decrease, w, n
                 ),
             )
-        k = n_subspace_features(self.max_features, X.shape[1])
+        k = n_subspace_features(self.max_features, F)
         if self.max_features_mode not in ("node", "tree"):
             raise ValueError(
                 f"max_features_mode must be 'node' or 'tree', "
@@ -298,7 +420,20 @@ class _BaseForest(ReportMixin, BaseEstimator):
         # splitter="random" trees, whose per-node candidate draws ride the
         # same keys — build in the fused tree-sharded program too (the jnp
         # key arithmetic runs inside its while_loop body).
-        node_sampling = self.max_features_mode == "node" and k < X.shape[1]
+        node_sampling = self.max_features_mode == "node" and k < F
+        if self.bootstrap:
+            obs.decision(
+                "bootstrap", "keyed" if keyed else "host-rng",
+                reason=(
+                    "Poisson(1) multiplicities keyed by (seed, tree, row) "
+                    "— pure counter draws that any chunking, mesh, or "
+                    "resume replays identically (Oza–Russell online "
+                    "bagging)" if keyed else
+                    "host-RNG multinomial draw (the in-memory default; "
+                    "MPITREE_TPU_KEYED_BOOTSTRAP=1 opts into the keyed "
+                    "scheme streamed fits always use)"
+                ),
+            )
 
         # ---- phase A: every per-tree RNG draw happens up front -----------
         # (bootstrap multiplicities, OOB masks, feature subspaces). The
@@ -307,12 +442,17 @@ class _BaseForest(ReportMixin, BaseEstimator):
         # a resumed run replays the same draws and skips finished trees.
         tree_w, tree_b, tree_mask, tree_sampler = [], [], [], []
         self._oob_masks = [] if self.oob_score else None
-        for _ in range(self.n_estimators):
+        for i in range(self.n_estimators):
             # Bootstrap multiplicities compose multiplicatively with any
             # user-provided per-sample weights.
             w = sample_weight
             if self.bootstrap:
-                boot = rng.multinomial(n, np.full(n, 1.0 / n)).astype(np.float32)
+                boot = (
+                    bootstrap_weights(kseed, i, n) if keyed
+                    else rng.multinomial(
+                        n, np.full(n, 1.0 / n)
+                    ).astype(np.float32)
+                )
                 if self._oob_masks is not None:
                     self._oob_masks.append(boot == 0)
                 w = boot if w is None else boot * w
@@ -321,8 +461,9 @@ class _BaseForest(ReportMixin, BaseEstimator):
             sampler = None
             if node_sampling:
                 sampler = NodeFeatureSampler(
-                    k=k, n_features=X.shape[1],
-                    seed=int(rng.integers(2**32)),
+                    k=k, n_features=F,
+                    seed=(tree_seed(kseed, i) if keyed
+                          else int(rng.integers(2**32))),
                     random_split=rand_split,
                 )
             elif rand_split:
@@ -330,12 +471,17 @@ class _BaseForest(ReportMixin, BaseEstimator):
                 # (the fmask branch below); the sampler only carries the
                 # candidate draws.
                 sampler = NodeFeatureSampler(
-                    k=X.shape[1], n_features=X.shape[1],
-                    seed=int(rng.integers(2**32)), random_split=True,
+                    k=F, n_features=F,
+                    seed=(tree_seed(kseed, i) if keyed
+                          else int(rng.integers(2**32))),
+                    random_split=True,
                 )
-            if not node_sampling and k < X.shape[1]:
-                keep = np.sort(rng.choice(X.shape[1], size=k, replace=False))
-                fmask = np.zeros(X.shape[1], bool)
+            if not node_sampling and k < F:
+                keep = (
+                    feature_subset(kseed, i, F, k) if keyed
+                    else np.sort(rng.choice(F, size=k, replace=False))
+                )
+                fmask = np.zeros(F, bool)
                 fmask[keep] = True
                 n_cand = np.zeros_like(binned.n_cand)
                 n_cand[keep] = binned.n_cand[keep]
@@ -405,6 +551,16 @@ class _BaseForest(ReportMixin, BaseEstimator):
                 )
                 return res if refine else (res, None)
 
+            if streamed:
+                # No host rung: the numpy tier wants a host-resident
+                # matrix a streamed fit never builds — retry + OOM
+                # rescue only (the single-tree streamed ladder stance).
+                t, ids = retry_device(
+                    dev, what=f"forest tree {i} streamed device build",
+                    obs=obs, resume=slot, rescue=rescue,
+                )
+                return finish(i, t, ids)
+
             def host():
                 obs.event(
                     "device_failover",
@@ -469,10 +625,16 @@ class _BaseForest(ReportMixin, BaseEstimator):
                     return [o[0] for o in out], [o[1] for o in out]
                 return [o[0] for o in out]
 
-            res = device_failover(
-                dev, host, what="forest group device build", obs=obs,
-                rescue=rescue,
-            )
+            if streamed:
+                res = retry_device(
+                    dev, what="forest group streamed device build",
+                    obs=obs, rescue=rescue,
+                )
+            else:
+                res = device_failover(
+                    dev, host, what="forest group device build", obs=obs,
+                    rescue=rescue,
+                )
             if refine:
                 gtrees, nid_all = res
                 return [
@@ -490,11 +652,15 @@ class _BaseForest(ReportMixin, BaseEstimator):
         if getattr(self, "checkpoint", None):
             import numbers
 
-            if not isinstance(self.random_state, numbers.Integral):
+            if not keyed and not isinstance(
+                self.random_state, numbers.Integral
+            ):
                 # Resume replays phase A's draws; with random_state=None
                 # (fresh entropy) or a stateful Generator the re-run's
                 # draws differ, and resuming would silently mix two
-                # forests (and mispair OOB masks with trees).
+                # forests (and mispair OOB masks with trees). Keyed draws
+                # are pure functions of (seed, tree, row) — they replay
+                # under any of the seeds the keyed gate admits.
                 warn_event(
                     obs, "checkpoint_disabled",
                     "forest checkpointing requires a fixed integer "
@@ -508,8 +674,21 @@ class _BaseForest(ReportMixin, BaseEstimator):
                     if k_ != "checkpoint"  # moving the file must not restart
                 }
                 params["task"] = task
+                if streamed:
+                    # No raw matrix exists to fingerprint; the sketch
+                    # edges are a pure function of the stream, so
+                    # thresholds + row/candidate extents pin the same
+                    # data-identity contract (the boosting streamed
+                    # checkpoint's basis).
+                    params["streamed_rows"] = int(n)
+                    params["streamed_n_cand"] = np.asarray(
+                        binned.n_cand
+                    ).tolist()
+                    X_basis = np.ascontiguousarray(binned.thresholds)
+                else:
+                    X_basis = X
                 ck = ForestCheckpoint.open(
-                    self.checkpoint, params, X, y_enc, sample_weight
+                    self.checkpoint, params, X_basis, y_enc, sample_weight
                 )
                 start = min(len(ck.trees), self.n_estimators)
                 trees = list(ck.trees[:start])
@@ -670,7 +849,32 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
         self.criterion = criterion
         self.class_weight = class_weight
 
-    def fit(self, X, y, sample_weight=None, *, trace_to=None):
+    def fit(self, X=None, y=None, sample_weight=None, *, dataset=None,
+            trace_to=None):
+        from mpitree_tpu.models._streamed import is_streamed
+
+        if is_streamed(X, dataset):
+            res, mesh = self._open_stream(X, dataset, y, trace_to=trace_to)
+            y_enc, classes = validate_fit_targets(
+                res.y, task="classification"
+            )
+            F = res.binned.n_features
+            self.n_features_ = F
+            self.n_features_in_ = F
+            self.classes_ = classes
+            record_sklearn_attributes(self, None, F, n_classes=len(classes))
+            sample_weight = apply_class_weight(
+                self.class_weight, y_enc, classes,
+                self._stream_weight(res, sample_weight),
+            )
+            self.trees_ = _TreeList(self._fit_forest(
+                None, y_enc, task="classification", criterion=self.criterion,
+                n_classes=len(classes), sample_weight=sample_weight,
+                stream=(res, mesh),
+            ))
+            self._mono_p0 = None
+            res.close()
+            return self._finish_fit()
         names = feature_names_of(X)
         X, y_enc, classes = validate_fit_data(X, y, task="classification")
         self.n_features_ = X.shape[1]
@@ -715,16 +919,7 @@ class RandomForestClassifier(ClassifierMixin, _BaseForest):
                 self.oob_score_ = float(
                     (votes[seen].argmax(axis=1) == y_enc[seen]).mean()
                 )
-        obs = self._fit_obs
-        del self._fit_obs
-        self.fit_stats_ = obs.summary() if obs.enabled else None
-        # Serving-table notes (mpitree_tpu.serving): the flat-table plan
-        # the compiled inference path will serve this forest from.
-        note_serving(obs, self.trees_)
-        # Ensemble run record: aggregates per-tree child summaries plus the
-        # shared phases/counters/collectives (mpitree_tpu.obs).
-        self.fit_report_ = obs.report(trees=self.trees_)
-        return self
+        return self._finish_fit()
 
     def predict_proba(self, X):
         """Mean of per-tree leaf class distributions (normalized — unlike the
@@ -799,7 +994,26 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
             warm_start=warm_start,
         )
 
-    def fit(self, X, y, sample_weight=None, *, trace_to=None):
+    def fit(self, X=None, y=None, sample_weight=None, *, dataset=None,
+            trace_to=None):
+        from mpitree_tpu.models._streamed import is_streamed
+
+        if is_streamed(X, dataset):
+            res, mesh = self._open_stream(X, dataset, y, trace_to=trace_to)
+            y64, _ = validate_fit_targets(res.y, task="regression")
+            F = res.binned.n_features
+            self.n_features_ = F
+            self.n_features_in_ = F
+            record_sklearn_attributes(self, None, F)
+            self._y_mean = float(y64.mean()) if len(y64) else 0.0
+            sample_weight = self._stream_weight(res, sample_weight)
+            self.trees_ = _TreeList(self._fit_forest(
+                None, (y64 - self._y_mean).astype(np.float32),
+                task="regression", criterion="mse", refit_targets=y64,
+                sample_weight=sample_weight, stream=(res, mesh),
+            ))
+            res.close()
+            return self._finish_fit()
         names = feature_names_of(X)
         X, y64, _ = validate_fit_data(X, y, task="regression")
         self.n_features_ = X.shape[1]
@@ -829,12 +1043,7 @@ class RandomForestRegressor(RegressorMixin, _BaseForest):
                 self.oob_score_ = float(
                     1.0 - (resid @ resid) / max(tot @ tot, 1e-300)
                 )
-        obs = self._fit_obs
-        del self._fit_obs
-        self.fit_stats_ = obs.summary() if obs.enabled else None
-        note_serving(obs, self.trees_)
-        self.fit_report_ = obs.report(trees=self.trees_)
-        return self
+        return self._finish_fit()
 
     def predict(self, X):
         check_is_fitted(self)

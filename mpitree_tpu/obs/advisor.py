@@ -217,6 +217,31 @@ def advise_hist_subtraction(*, platform: str, shape: dict | None = None,
     )
 
 
+def advise_engine(*, platform: str, shape: dict | None = None,
+                  policy_evidence: str = "auto",
+                  store=None) -> dict | None:
+    """"leafwise" / "levelwise" from stored ``leafwise_ab`` A/Bs, or None.
+
+    Evidence metric: ``warm_speedup_x`` (level-wise warm wall over
+    leaf-wise warm wall on the same workload — >1 means the best-first
+    frontier won). The caller owns the hard admissibility constraints a
+    measured win can never override (leaf budget fits the level-wise
+    node bound so trees stay bit-identical, no feature axis, no
+    monotonic constraints); the consultation only replaces the "one
+    fused program beats per-level dispatch" preference heuristic.
+    """
+    if not enabled(policy_evidence):
+        return None
+    store = store if store is not None else _store()
+    if store is None:
+        return None
+    return _advise_ratio(
+        store, policy="engine", section="leafwise_ab",
+        metric="warm_speedup_x", platform=platform, shape=shape,
+        hi="leafwise", lo="levelwise",
+    )
+
+
 def advise_rounds_per_dispatch(*, platform: str, shape: dict | None = None,
                                policy_evidence: str = "auto",
                                store=None) -> dict | None:

@@ -79,6 +79,17 @@ ENTRY_JOIN: dict = {
     "serving_traverse": (None, None),
 }
 
+# Host-tier work the XLA cost model never sees (the numpy/C++ builders
+# and the hybrid refine tail): each entry joins an always-on dispatch
+# counter and, where one exists, a measured phase wall. Floors price to
+# an honest ``None`` — the point (ISSUE 20 satellite) is that the
+# ledger's coverage GAP shows up as counted-but-unpriced entries instead
+# of silently missing from ``record.compute``.
+HOST_ENTRIES: dict = {
+    "host_build": ("host_build", "counter:host_builds"),
+    "refine_tail": ("refine", "counter:refine_candidates"),
+}
+
 
 def capture(lower) -> dict | None:
     """Cost-analyze one fresh lowering; None when the wheel cannot.
@@ -314,4 +325,58 @@ def compute_section(report: dict, captures: dict, peaks: dict) -> dict:
         "bounds_s": {
             "compute": t_compute, "hbm": t_hbm, "ici": t_ici,
         },
+    }
+
+
+def host_entries(report: dict) -> dict:
+    """Priced-to-None entries for host-tier dispatches (honesty fix).
+
+    Returns ``{entry: row}`` in the per-entry shape of
+    :func:`compute_section`, for every :data:`HOST_ENTRIES` source whose
+    dispatch counter fired this fit. Floors, utilization, and bound are
+    ``None`` with the reason recorded — the host tier runs numpy/C++
+    the XLA cost model cannot capture, and a visible unpriced row beats
+    a section that pretends the work did not happen.
+    """
+    rows: dict = {}
+    for entry, (phase, count_src) in sorted(HOST_ENTRIES.items()):
+        dispatches = _dispatches(count_src, phase, report)
+        if not dispatches:
+            continue
+        measured = (
+            (report.get("phases", {}).get(phase) or {}).get("seconds")
+            if phase is not None else None
+        )
+        rows[entry] = {
+            "flops": None,
+            "bytes": None,
+            "flops_per_shard": None,
+            "bytes_per_shard": None,
+            "variants": 0,
+            "optimal_s": None,
+            "dispatches": dispatches,
+            "measured_s": measured,
+            "util_pct": None,
+            "bound": None,
+            "unpriced": (
+                "host-tier numpy/C++ dispatch: no XLA cost capture"
+            ),
+        }
+    return rows
+
+
+def host_only_section(rows: dict) -> dict:
+    """A ``record.compute`` section for a fit with NO priced captures —
+    the whole-fit aggregates are honestly ``None``; only the host-tier
+    dispatch counts ride."""
+    return {
+        "peak": {},
+        "n_shards": 1,
+        "entries": rows,
+        "levels": [],
+        "optimal_s": None,
+        "measured_s": None,
+        "util_pct": None,
+        "roofline": None,
+        "bounds_s": {"compute": None, "hbm": None, "ici": None},
     }

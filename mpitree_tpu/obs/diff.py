@@ -42,8 +42,10 @@ import statistics
 DIFF_SCHEMA = 1
 
 # Mirrors obs/fingerprint.CHANNELS (kept literal here: stdlib-only, and
-# the order IS the bisect's upstream-first report order).
-CHANNELS = ("hist", "winner", "alloc")
+# the order IS the bisect's upstream-first report order). "refine" (v2)
+# rides only refine-tail rows — crown rows omit it, and absent channels
+# compare equal below — so a refine divergence reports by name.
+CHANNELS = ("hist", "winner", "alloc", "refine")
 
 # Robust z-score a noisy metric must exceed (vs lineage dispersion), and
 # the minimum history depth before dispersion supersedes the floor.

@@ -155,6 +155,12 @@ DECISIONS: tuple = (
     Decision("ingest",
              "ingest path: streamed chunked sketch+bin vs materialized"
              " host matrix"),
+    Decision("ingest_spill",
+             "spill-to-disk rung engaged for a one-shot chunk iterator"
+             " (store directory and size cap recorded)"),
+    Decision("bootstrap",
+             "forest bootstrap draw scheme: keyed counter-based"
+             " per-chunk masks vs the host RNG multinomial"),
     Decision("ensemble_path",
              "forest build sharding: tree-parallel vs data-parallel (and"
              " the HBM budget verdict)"),

@@ -52,12 +52,18 @@ import numpy as np
 
 # Bump on any change to which bytes a channel hashes — stored
 # fingerprints are only comparable within one version.
-FINGERPRINT_VERSION = 1
+# v2 (ISSUE 20): refine-tail subtrees commit under their own "refine"
+# channel instead of reusing hist/winner/alloc, so a streamed-vs-
+# in-memory divergence localizes INTO the refine tail by name.
+FINGERPRINT_VERSION = 2
 
 # Data-flow order: histogram stats feed the winner sweep, winners feed
-# child allocation — the bisect reports the FIRST divergent channel in
-# this order, which names the most upstream divergent state.
-CHANNELS = ("hist", "winner", "alloc")
+# child allocation, and the refine tail re-grows below all three — the
+# bisect reports the FIRST divergent channel in this order, which names
+# the most upstream divergent state. Crown rows carry the first three
+# channels; refine-tail rows carry only "refine" (absent channels
+# compare equal in the bisect), so mixed row lists never false-positive.
+CHANNELS = ("hist", "winner", "alloc", "refine")
 
 
 def _h64(*chunks: bytes) -> str:
@@ -139,6 +145,13 @@ def subtree_fingerprints(depth, n_samples, feature, threshold, left,
     per-subtree host builds (ids local from 0) — commit byte-identical
     rows for identical subtrees; depths are likewise re-based at the
     subtree root. Leaves keep ``-1`` children.
+
+    Rows carry the ``refine`` channel (v2): the per-level hist/winner/
+    alloc states fold into ONE hash, so the bisect reports a refine-tail
+    divergence as channel ``"refine"`` — "the tails re-grew differently"
+    — instead of mislabeling it a histogram bug at some crown level. A
+    streamed fit's tail consumes a gathered replay of the chunk stream;
+    this channel is what proves the replay fed the same bytes.
     """
     depth = np.asarray(depth, np.int64)
     feature = np.asarray(feature)
@@ -169,9 +182,15 @@ def subtree_fingerprints(depth, n_samples, feature, threshold, left,
         at = np.flatnonzero(d_loc == d)
         if not len(at):
             continue
-        rows.append(level_fingerprint(
+        r = level_fingerprint(
             d, ns_loc[at], feat_loc[at], thr_loc[at], l_loc[at], r_loc[at]
-        ))
+        )
+        rows.append({
+            "level": r["level"], "nodes": r["nodes"],
+            "refine": _h64(
+                f"{r['hist']}:{r['winner']}:{r['alloc']}".encode()
+            ),
+        })
     return rows
 
 
@@ -185,10 +204,13 @@ def fold(rows: list, into=None):
     """
     h = into if into is not None else hashlib.blake2b(digest_size=8)
     for r in rows:
-        h.update(
-            f"{r['level']}:{r['hist']}:{r['winner']}:{r['alloc']};"
-            .encode()
-        )
+        if "refine" in r:  # refine-tail row (v2): one channel
+            h.update(f"{r['level']}:{r['refine']};".encode())
+        else:
+            h.update(
+                f"{r['level']}:{r['hist']}:{r['winner']}:{r['alloc']};"
+                .encode()
+            )
     return h
 
 

@@ -218,13 +218,22 @@ def ingest_chunk_rows(features: int, *, budget: int | None = None,
 
 def plan_ingest(*, rows: int, features: int, chunk_rows: int,
                 sketch_capacity: int, mesh_axes=None,
-                max_bins: int = 256) -> MemoryPlan:
+                max_bins: int = 256,
+                spill_bytes: int | None = None) -> MemoryPlan:
     """Price one streamed ingest pass (the ``plan_fit`` twin for the
     loading path): per-chunk raw/binned staging, the merged sketches,
     and the host-resident per-row state (targets/weights — the one O(N)
     host cost streaming keeps), against the per-device cost of the
     assembled ``x_binned`` (priced per the partition table, plus one
-    in-flight chunk piece)."""
+    in-flight chunk piece).
+
+    ``spill_bytes`` (ISSUE 20): bytes the spill rung wrote to disk for a
+    one-shot source. Priced as its own ``"disk"``-phase array row — disk
+    residency, deliberately OUTSIDE the host-RAM watermarks — and every
+    extra stream pass over it (the second binning pass, the hybrid
+    tail's raw-row replay, a per-round forest re-read) re-pays only the
+    per-chunk staging cost (``replay_pass_bytes`` in ``inputs``), never
+    an O(N) host residency: that is the whole out-of-core contract."""
     axes = _axis_widths(mesh_axes)
     rows = int(rows)
     features = int(features)
@@ -243,6 +252,12 @@ def plan_ingest(*, rows: int, features: int, chunk_rows: int,
         {"name": "y_host", "shape": [rows], "itemsize": 16,
          "phase": RESIDENT, "bytes_per_device": rows * 16},
     ]
+    if spill_bytes:
+        arrays.append(
+            {"name": "spill_store", "shape": [int(spill_bytes)],
+             "itemsize": 1, "phase": "disk",
+             "bytes_per_device": int(spill_bytes)}
+        )
     resident = sum(
         a["bytes_per_device"] for a in arrays if a["phase"] == RESIDENT
     )
@@ -269,6 +284,10 @@ def plan_ingest(*, rows: int, features: int, chunk_rows: int,
             "sketch_capacity": int(sketch_capacity),
             "max_bins": int(max_bins),
             "host_budget_bytes": host_ingest_budget(),
+            # what each EXTRA pass over the stream costs the host (the
+            # refine replay, a spill re-read): chunk staging only.
+            "replay_pass_bytes": 2 * K * features * 4,
+            **({"spill_bytes": int(spill_bytes)} if spill_bytes else {}),
         },
     )
 

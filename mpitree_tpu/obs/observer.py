@@ -220,9 +220,11 @@ def note_refine(obs, *, refine: bool, rd, crown_depth,
     """Record the hybrid-refine decision (estimator-level routing)."""
     if streamed:
         reason = (
-            "streamed ingest: hybrid tail skipped — the refine pass "
-            "re-bins raw rows, and a streamed fit's raw matrix never "
-            "exists on host (single-engine full depth)"
+            "streamed ingest: hybrid tail skipped — single-tree fits "
+            "replay the chunk stream to gather refine rows, but "
+            "ensembles would replay it once per tree and multi-host "
+            "fits only stream their own shard (single-engine full "
+            "depth)"
         )
     elif leafwise:
         reason = (
@@ -704,6 +706,19 @@ class BuildObserver(PhaseTimer):
                 captures,
                 cost_mod.platform_peaks(),
             )
+        # Host-tier honesty (ISSUE 20 satellite): the numpy/C++ builders
+        # and the hybrid refine tail dispatch no XLA programs, so the
+        # join above cannot see them — merge priced-to-None entries
+        # carrying their dispatch counts, creating the section when the
+        # whole fit ran on the host tier. Idempotent like the join.
+        host_rows = cost_mod.host_entries(
+            {"phases": rec.phases, "counters": rec.counters}
+        )
+        if host_rows:
+            if rec.compute:
+                rec.compute["entries"].update(host_rows)
+            else:
+                rec.compute = cost_mod.host_only_section(host_rows)
         if self._fp_hash is not None:
             # Whole-fit fold over every committed tree (obs/fingerprint):
             # hexdigest() is non-destructive, so repeated report() calls

@@ -123,6 +123,7 @@ _FEAT_SALT = np.uint32(0x85EBCA6B)
 _DRAW_SALT = np.uint32(0x27D4EB2F)  # random-split bin draws (ExtraTrees)
 _ROW_SALT = np.uint32(0x51ED270B)  # per-round row subsampling (boosting)
 _COL_SALT = np.uint32(0x6C62272E)  # per-round feature subsampling (boosting)
+_BOOT_SALT = np.uint32(0x94D049BB)  # per-tree bootstrap draws (forests)
 
 
 def pcg_hash(x: np.ndarray) -> np.ndarray:
@@ -192,6 +193,86 @@ def feature_subsample_mask(seed: int, round_idx: int, n_features: int,
     mask = np.zeros(n_features, bool)
     mask[order[:k]] = True
     return mask
+
+
+def _poisson1_cutoffs() -> np.ndarray:
+    """u32 inverse-CDF cutoffs for Poisson(1) multiplicities.
+
+    ``cutoffs[k] = round(CDF(k) * 2^32)``; a uniform u32 draw ``u`` maps
+    to multiplicity ``searchsorted(cutoffs, u, side='right')``. The tail
+    past k=12 carries < 1e-12 mass and the float64 CDF rounds to 2^32
+    there, so multiplicities cap at the table length — exact for every
+    representable draw.
+    """
+    pmf = np.empty(13, np.float64)
+    pmf[0] = np.exp(-1.0)
+    for k in range(1, 13):
+        pmf[k] = pmf[k - 1] / k
+    return np.minimum(
+        np.round(np.cumsum(pmf) * 4294967296.0), 4294967296.0 - 1
+    ).astype(np.uint64)
+
+
+_POISSON1_CUTOFFS = _poisson1_cutoffs()
+
+
+def bootstrap_weights(seed: int, tree_idx: int, n_rows: int) -> np.ndarray:
+    """(n_rows,) f32 keyed bootstrap multiplicities for one forest tree.
+
+    The streamed forest's bootstrap: each row's in-bag count is a
+    Poisson(1) draw keyed by (seed, tree, row) — the online-bagging
+    approximation of the with-replacement multinomial (Oza & Russell),
+    and like :func:`row_subsample_mask` a pure function of global row
+    index, so any chunking of the stream, any mesh, and a resumed fit
+    all draw the identical bootstrap. In-memory fits opt in with
+    ``MPITREE_TPU_KEYED_BOOTSTRAP=1`` to become a streamed fit's
+    fingerprint twin (the default host-RNG multinomial draw is kept for
+    backward-reproducibility).
+    """
+    with np.errstate(over="ignore"):
+        base = np.uint32(
+            pcg_hash(np.uint32(seed))
+            ^ pcg_hash((np.uint32(tree_idx) + _BOOT_SALT).astype(np.uint32))
+        )
+        keys = pcg_hash(base + np.arange(n_rows, dtype=np.uint32))
+    return np.searchsorted(
+        _POISSON1_CUTOFFS, keys.astype(np.uint64), side="right"
+    ).astype(np.float32)
+
+
+def tree_seed(seed: int, tree_idx: int) -> int:
+    """Per-tree u32 sampler seed in keyed-bootstrap mode.
+
+    A pure function of (forest seed, tree index): the in-memory path
+    draws sampler seeds from a stateful host RNG interleaved with the
+    bootstrap draws, which a streamed fit cannot replay — keyed mode
+    derives both from the same counter scheme instead.
+    """
+    with np.errstate(over="ignore"):
+        return int(pcg_hash(
+            pcg_hash(np.uint32(seed))
+            ^ ((np.uint32(tree_idx) + np.uint32(1)) * _BOOT_SALT)
+            .astype(np.uint32)
+        ))
+
+
+def feature_subset(seed: int, tree_idx: int, n_features: int,
+                   k: int) -> np.ndarray:
+    """Sorted k-feature subset for one tree in keyed-bootstrap mode.
+
+    The keyed twin of ``rng.choice(F, k, replace=False)`` for
+    ``max_features_mode="tree"``: per-feature hashed scores keyed by
+    (seed, tree, feature), stable-argsorted, lowest k kept — without
+    replacement by construction and, like every draw in this module, a
+    pure function of its key tuple.
+    """
+    with np.errstate(over="ignore"):
+        base = np.uint32(
+            pcg_hash(np.uint32(seed))
+            ^ pcg_hash((np.uint32(tree_idx) + _FEAT_SALT).astype(np.uint32))
+        )
+        scores = pcg_hash(base + np.arange(n_features, dtype=np.uint32))
+    return np.sort(np.argsort(scores, kind="stable")[:k])
 
 
 def subsample_threshold_u32(fraction: float) -> np.uint32:

@@ -149,7 +149,6 @@ def test_streamed_fit_identity_meshes(data, mesh, chunk):
     X, y = data
     ref = DecisionTreeClassifier(
         max_depth=6, max_bins=32, backend="cpu", n_devices=8,
-        refine_depth=None,
     ).fit(X, y)
     clf = DecisionTreeClassifier(
         max_depth=6, max_bins=32, backend="cpu", n_devices=mesh,
@@ -166,7 +165,7 @@ def test_streamed_fit_identity_engines(data, engine, binning, monkeypatch):
     monkeypatch.setenv("MPITREE_TPU_ENGINE", engine)
     ref = DecisionTreeClassifier(
         max_depth=5, max_bins=32, binning=binning, backend="cpu",
-        n_devices=8, refine_depth=None,
+        n_devices=8,
     ).fit(X, y)
     clf = DecisionTreeClassifier(
         max_depth=5, max_bins=32, binning=binning, backend="cpu",
@@ -181,7 +180,6 @@ def test_streamed_regressor_identity(data):
     yr = (2.0 * X[:, 0] + np.sin(X[:, 1])).astype(np.float64)
     ref = DecisionTreeRegressor(
         max_depth=5, max_bins=32, backend="cpu", n_devices=8,
-        refine_depth=None,
     ).fit(X, yr)
     reg = DecisionTreeRegressor(
         max_depth=5, max_bins=32, backend="cpu", n_devices=8,
@@ -220,7 +218,6 @@ def test_streamed_npy_shards_identity(data, tmp_path):
     assert src.n_rows == len(X) and src.n_features == X.shape[1]
     ref = DecisionTreeClassifier(
         max_depth=6, max_bins=32, backend="cpu", n_devices=8,
-        refine_depth=None,
     ).fit(X, y)
     clf = DecisionTreeClassifier(
         max_depth=6, max_bins=32, backend="cpu", n_devices=8,
@@ -235,7 +232,6 @@ def test_streamed_sample_weight_identity(data):
     w = rng.integers(1, 4, len(X)).astype(np.float32)
     ref = DecisionTreeClassifier(
         max_depth=5, max_bins=32, backend="cpu", n_devices=8,
-        refine_depth=None,
     ).fit(X, y, sample_weight=w)
     chunks = [
         (X[lo:lo + 500], y[lo:lo + 500], w[lo:lo + 500])
@@ -257,21 +253,36 @@ def test_streamed_rejects_double_weights(data):
         )
 
 
-def test_streamed_generator_factory(data):
-    """from_chunks accepts a factory; a bare generator is refused (the
-    pipeline streams twice)."""
+def test_streamed_generator_factory(data, tmp_path, monkeypatch):
+    """from_chunks accepts a factory; a bare generator is one-shot —
+    refused with the spill knob named unless the spill rung is
+    configured, in which case the fit matches the factory fit."""
     X, y = data
 
     def factory():
         for lo in range(0, len(X), 900):
             yield X[lo:lo + 900], y[lo:lo + 900]
 
-    clf = DecisionTreeClassifier(
-        max_depth=4, max_bins=32, backend="cpu", n_devices=8,
-    ).fit(StreamedDataset.from_chunks(factory))
+    kw = dict(max_depth=4, max_bins=32, backend="cpu", n_devices=8)
+    clf = DecisionTreeClassifier(**kw).fit(
+        StreamedDataset.from_chunks(factory)
+    )
     assert clf.tree_.n_nodes > 1
-    with pytest.raises(TypeError, match="factory"):
+    # one-shot without the spill rung: typed refusal naming the knob
+    with pytest.raises(ValueError, match="MPITREE_TPU_SPILL_DIR"):
+        DecisionTreeClassifier(**kw).fit(
+            StreamedDataset.from_chunks(factory())
+        )
+    # with the rung configured, the one-shot fit rides the spill replay
+    # and builds the identical tree
+    monkeypatch.setenv("MPITREE_TPU_SPILL_DIR", str(tmp_path))
+    spilled = DecisionTreeClassifier(**kw).fit(
         StreamedDataset.from_chunks(factory())
+    )
+    assert _fp(spilled) == _fp(clf)
+    dec = spilled.fit_report_["decisions"]["ingest_spill"]
+    assert dec["value"] == "spill"
+    assert spilled.ingest_stats_["spill_bytes"] > 0
 
 
 # ---------------------------------------------------------------------------
@@ -346,8 +357,13 @@ def test_streamed_record_decision(data):
     assert dec["value"] == "streamed"
     assert dec["inputs"]["chunk_rows"] == 1000
     assert clf.ingest_stats_["rows"] == len(X)
-    # refine is off with the streamed reason
-    assert "streamed" in clf.fit_report_["decisions"]["refine"]["reason"]
+    # single-host streamed fits resolve refine exactly like the
+    # in-memory twin (the tail replays the chunk stream)
+    ref = DecisionTreeClassifier(
+        max_depth=4, max_bins=32, backend="cpu", n_devices=8,
+    ).fit(X, y)
+    assert (clf.fit_report_["decisions"]["refine"]
+            == ref.fit_report_["decisions"]["refine"])
 
 
 def test_streamed_dataset_arg_validation(data):

@@ -302,6 +302,83 @@ def test_advisor_nearest_shape_outvotes_foreign_workloads(evidence):
     assert adv["value"] == "on"
 
 
+def test_host_tier_and_refine_emit_unpriced_compute():
+    """The numpy/C++ builders and the refine tail show up in
+    record.compute as priced-to-None entries with dispatch counts — a
+    visible coverage gap, not a silent one (ISSUE 20 satellite)."""
+    from mpitree_tpu import DecisionTreeClassifier
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(400, 5)).astype(np.float64)
+    y = (X[:, 0] > 0).astype(np.int64)
+    host = DecisionTreeClassifier(
+        max_depth=4, max_bins=16, backend="host", refine_depth=None,
+    ).fit(X, y)
+    comp = host.fit_report_["compute"]
+    row = comp["entries"]["host_build"]
+    assert row["dispatches"] == 1
+    assert row["optimal_s"] is None and row["util_pct"] is None
+    assert "unpriced" in row
+    assert comp["optimal_s"] is None and comp["roofline"] is None
+    json.dumps(comp)
+    # a refined device fit merges the refine_tail row next to the
+    # (possibly priced) device entries
+    X2 = X.copy()
+    X2[:, 0] = np.where(X2[:, 0] > 0, X2[:, 0] * 100, X2[:, 0])
+    y2 = ((np.abs(X2[:, 0]) < 0.3).astype(int)
+          + (X2[:, 1] > 0.2).astype(int)).astype(np.int64)
+    refined = DecisionTreeClassifier(
+        max_depth=8, max_bins=8, backend="cpu", refine_depth=2,
+    ).fit(X2, y2)
+    comp2 = refined.fit_report_["compute"]
+    tail = comp2["entries"]["refine_tail"]
+    assert tail["dispatches"] >= 1
+    assert tail["optimal_s"] is None and "unpriced" in tail
+    json.dumps(comp2)
+
+
+def test_advisor_engine_consults_leafwise_ab(evidence):
+    _seed(evidence, "leafwise_ab", "warm_speedup_x", [1.5, 1.6, 1.55, 1.5])
+    adv = advisor_mod.advise_engine(
+        platform="cpu", shape=SHAPE, store=evidence,
+    )
+    assert adv["value"] == "leafwise" and adv["fallback"] is None
+    # the inverse lineage prefers the level-wise engines (static pick)
+    _seed(evidence, "leafwise_ab", "warm_speedup_x",
+          [0.6, 0.62, 0.61, 0.6, 0.6, 0.61, 0.62, 0.6])
+    adv2 = advisor_mod.advise_engine(
+        platform="cpu", shape=SHAPE, store=evidence,
+    )
+    assert adv2["value"] == "levelwise"
+
+
+def test_advisor_engine_routes_fit_bit_identical(evidence, monkeypatch):
+    """Measured leafwise_ab wins route an engine='auto' fit through the
+    best-first frontier at the 2^max_depth budget — same tree, and the
+    advisor_engine decision explains the flip."""
+    from mpitree_tpu import DecisionTreeClassifier
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(500, 6)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.int64)
+    kw = dict(max_depth=4, max_bins=16, backend="cpu", refine_depth=None)
+    _seed(evidence, "leafwise_ab", "warm_speedup_x",
+          [1.5, 1.6, 1.55, 1.5],
+          extra={"n_samples": 500, "n_features": 6, "max_depth": 4})
+    routed = DecisionTreeClassifier(**kw).fit(X, y)
+    dec = routed.fit_report_["decisions"]
+    assert dec["advisor_engine"]["value"] == "leafwise"
+    assert dec["frontier"]["value"] == "leafwise"
+    monkeypatch.setenv(advisor_mod.POLICY_ENV, "off")
+    static = DecisionTreeClassifier(**kw).fit(X, y)
+    assert "advisor_engine" not in static.fit_report_["decisions"]
+    np.testing.assert_array_equal(routed.tree_.feature, static.tree_.feature)
+    np.testing.assert_array_equal(
+        routed.tree_.threshold, static.tree_.threshold
+    )
+    np.testing.assert_array_equal(routed.tree_.count, static.tree_.count)
+
+
 def test_record_advice_emits_typed_decision(evidence):
     _seed(evidence, "subtraction_ab", "warm_speedup_on_vs_off",
           [1.4, 1.4, 1.4, 1.4])

@@ -69,13 +69,13 @@ def test_fingerprint_row_schema_golden(small_cls):
     clf = _tree_clf(X, y, engine="levelwise")
     fp = clf.fit_report_["fingerprints"]
     assert tuple(sorted(fp)) == ("fit", "trees", "version")
-    assert fp["version"] == obs_fp.FINGERPRINT_VERSION == 1
+    assert fp["version"] == obs_fp.FINGERPRINT_VERSION == 2
     assert len(fp["fit"]) == 16  # u64 as 16 hex chars
     row = fp["trees"][0][0]
     assert tuple(sorted(row)) == (
         "alloc", "hist", "level", "nodes", "winner",
     )
-    assert obs_fp.CHANNELS == ("hist", "winner", "alloc")
+    assert obs_fp.CHANNELS == ("hist", "winner", "alloc", "refine")
     # the digest carries the whole-fit fold
     assert digest(clf.fit_report_)["fingerprint"] == fp["fit"]
     # rows are JSON-clean (they ride fit_report_ and the flight store)
@@ -612,6 +612,19 @@ def test_refine_tail_commits_per_subtree_fingerprints():
     assert len(fa["trees"]) > 1  # crown + refined subtrees
     assert fa == fb              # repeatable, whole-fit hash included
     assert obs_diff.localize_divergence(fa, fb) is None
+    # subtree rows carry the v2 "refine" channel only; crown rows carry
+    # hist/winner/alloc — and a refine-tail divergence reports BY NAME
+    sub_row = fa["trees"][1][0]
+    assert tuple(sorted(sub_row)) == ("level", "nodes", "refine")
+    import copy
+
+    fc = copy.deepcopy(fb)
+    fc["trees"][1][0]["refine"] = "0" * 16
+    loc = obs_diff.localize_divergence(fa, fc)
+    assert loc == {
+        "tree": 1, "level": sub_row["level"], "channel": "refine",
+        "channels": ["refine"],
+    }
     # an unrefined fit of the same workload commits ONLY the crown
     plain = DecisionTreeClassifier(
         max_depth=8, max_bins=8, backend="cpu", refine_depth=None

@@ -1,0 +1,275 @@
+"""Streamed ensemble fits (ISSUE 20): every family, same trees.
+
+The contract under test: a ``fit(dataset=StreamedDataset(...))`` is the
+fingerprint twin of its in-memory fit for every estimator family —
+boosting (host round loop AND the fused K-rounds-per-dispatch scan),
+bootstrap forests (keyed per-chunk masks vs the keyed in-memory twin),
+and the hybrid refine tail (candidate rows replayed from the chunk
+stream) — plus the checkpoint/resume seam: a streamed boosting fit
+killed at a round boundary resumes to a bit-identical ensemble.
+"""
+
+import numpy as np
+import pytest
+
+from mpitree_tpu import (
+    DecisionTreeClassifier,
+    DecisionTreeRegressor,
+    GradientBoostingClassifier,
+    GradientBoostingRegressor,
+    StreamedDataset,
+)
+from mpitree_tpu.models.forest import (
+    ExtraTreesClassifier,
+    RandomForestClassifier,
+    RandomForestRegressor,
+)
+from mpitree_tpu.resilience import chaos
+from mpitree_tpu.resilience.chaos import ChaosKilled, Fault
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    N, F = 3000, 9
+    X = rng.normal(size=(N, F)).astype(np.float32)
+    X[:, 2] = np.round(X[:, 2], 1)          # low cardinality
+    X[:, 4] = -1.5                          # constant (empty-feature case)
+    X[:, 6] = rng.integers(0, 3, N)         # tiny cardinality
+    y = ((X[:, 0] > 0).astype(int) + (X[:, 1] + X[:, 2] > 0.3)).astype(int)
+    return X, y
+
+
+def _fp(est):
+    return est.fit_report_["fingerprints"]
+
+
+def _trees_equal(a, b):
+    assert len(a.trees_) == len(b.trees_)
+    for ta, tb in zip(a.trees_, b.trees_):
+        np.testing.assert_array_equal(ta.feature, tb.feature)
+        np.testing.assert_array_equal(ta.threshold, tb.threshold)
+        np.testing.assert_array_equal(ta.count, tb.count)
+
+
+# ---------------------------------------------------------------------------
+# boosting: host round loop and fused multi-round dispatches
+# ---------------------------------------------------------------------------
+
+GB_KW = dict(max_iter=6, max_depth=3, max_bins=32, backend="cpu",
+             n_devices=8, random_state=0)
+
+
+@pytest.mark.parametrize("rpd", [1, 3])
+@pytest.mark.parametrize("chunk", [251, 1000])
+def test_streamed_gbdt_identity(data, rpd, chunk):
+    """Streamed boosting == in-memory boosting, both the per-round host
+    loop (K=1) and the fused scan (K>1) over the same streamed matrix."""
+    X, y3 = data
+    y = (y3 > 0).astype(int)  # fused K>1 needs the binary in-device loss
+    ref = GradientBoostingClassifier(
+        rounds_per_dispatch=rpd, **GB_KW,
+    ).fit(X, y)
+    clf = GradientBoostingClassifier(
+        rounds_per_dispatch=rpd, **GB_KW,
+    ).fit(dataset=StreamedDataset.from_arrays(X, y, chunk_rows=chunk))
+    _trees_equal(ref, clf)
+    assert _fp(clf) == _fp(ref)
+    np.testing.assert_array_equal(clf.predict_proba(X), ref.predict_proba(X))
+
+
+def test_streamed_gbdt_subsample_identity(data):
+    """Keyed Bernoulli row masks are a pure function of (seed, round,
+    row), so subsampled rounds stay bit-identical under streaming."""
+    X, y = data
+    kw = dict(subsample=0.7, **GB_KW)
+    ref = GradientBoostingClassifier(**kw).fit(X, y)
+    clf = GradientBoostingClassifier(**kw).fit(
+        dataset=StreamedDataset.from_arrays(X, y, chunk_rows=499)
+    )
+    _trees_equal(ref, clf)
+    np.testing.assert_array_equal(clf.predict_proba(X), ref.predict_proba(X))
+
+
+def test_streamed_gbdt_regressor_identity(data):
+    X, _ = data
+    yr = (2.0 * X[:, 0] + np.sin(X[:, 1])).astype(np.float64)
+    ref = GradientBoostingRegressor(**GB_KW).fit(X, yr)
+    reg = GradientBoostingRegressor(**GB_KW).fit(
+        dataset=StreamedDataset.from_arrays(X, yr, chunk_rows=997)
+    )
+    _trees_equal(ref, reg)
+    np.testing.assert_array_equal(reg.predict(X), ref.predict(X))
+
+
+def test_streamed_gbdt_refusals(data):
+    """Combinations the streamed round loop cannot honor are typed."""
+    X, y = data
+    ds = StreamedDataset.from_arrays(X, y, chunk_rows=500)
+    with pytest.raises(ValueError, match="early_stopping"):
+        GradientBoostingClassifier(
+            early_stopping=True, **GB_KW
+        ).fit(dataset=ds)
+    with pytest.raises(ValueError, match="colsample_bytree"):
+        GradientBoostingClassifier(
+            colsample_bytree=0.5, **GB_KW
+        ).fit(dataset=ds)
+    with pytest.raises(ValueError, match="separate y"):
+        GradientBoostingClassifier(**GB_KW).fit(dataset=ds, y=y)
+
+
+# ---------------------------------------------------------------------------
+# boosting: checkpoint/resume at a round boundary (satellite)
+# ---------------------------------------------------------------------------
+
+def test_streamed_gbdt_resume_bit_identical(data, tmp_path, monkeypatch):
+    """Kill a checkpointed STREAMED boosting fit at round k, resume from
+    the flushed rounds, and the final ensemble is bit-identical to an
+    uninterrupted streamed fit — predict AND every staged prediction."""
+    X, y = data
+    monkeypatch.setenv("MPITREE_TPU_BACKOFF_S", "0")
+    chaos.clear()
+    path = str(tmp_path / "gb.ckpt")
+    kw = dict(subsample=0.8, checkpoint_every=2, **GB_KW)
+    ds = lambda: StreamedDataset.from_arrays(  # noqa: E731
+        X, y, chunk_rows=499
+    )
+    ref = GradientBoostingClassifier(**kw).fit(dataset=ds())
+
+    kill_round = 3
+    chaos.install([Fault("round", kill_round + 1, "kill")])
+    try:
+        with pytest.raises(ChaosKilled):
+            GradientBoostingClassifier(
+                checkpoint=path, **kw
+            ).fit(dataset=ds())
+    finally:
+        chaos.clear()
+
+    resumed = GradientBoostingClassifier(
+        checkpoint=path, **kw
+    ).fit(dataset=ds())
+    assert resumed.n_iter_ == ref.n_iter_
+    _trees_equal(ref, resumed)
+    np.testing.assert_array_equal(
+        resumed.predict_proba(X), ref.predict_proba(X)
+    )
+    for a, b in zip(resumed.staged_predict_proba(X),
+                    ref.staged_predict_proba(X)):
+        np.testing.assert_array_equal(a, b)
+    kinds = [ev["kind"] for ev in resumed.fit_report_["events"]]
+    assert "checkpoint_resume" in kinds
+
+
+# ---------------------------------------------------------------------------
+# forests: keyed per-chunk bootstrap, fused and per-tree engines
+# ---------------------------------------------------------------------------
+
+RF_KW = dict(n_estimators=6, max_depth=5, max_bins=32, backend="cpu",
+             n_devices=8, random_state=3, refine_depth=None)
+
+
+def _keyed_ref(cls, X, y, monkeypatch, **kw):
+    """The in-memory twin: keyed bootstrap draws opt in via the knob, so
+    the host-RNG legacy path never enters the comparison."""
+    monkeypatch.setenv("MPITREE_TPU_KEYED_BOOTSTRAP", "1")
+    ref = cls(**kw).fit(X, y)
+    monkeypatch.delenv("MPITREE_TPU_KEYED_BOOTSTRAP")
+    return ref
+
+
+@pytest.mark.parametrize("engine", ["fused", "levelwise"])
+def test_streamed_forest_identity(data, engine, monkeypatch):
+    """Streamed forest == keyed in-memory forest in both the tree-sharded
+    fused program and the per-tree level-wise loop."""
+    X, y = data
+    monkeypatch.setenv("MPITREE_TPU_ENGINE", engine)
+    ref = _keyed_ref(RandomForestClassifier, X, y, monkeypatch, **RF_KW)
+    clf = RandomForestClassifier(**RF_KW).fit(
+        dataset=StreamedDataset.from_arrays(X, y, chunk_rows=251)
+    )
+    _trees_equal(ref, clf)
+    assert _fp(clf) == _fp(ref)
+    np.testing.assert_array_equal(clf.predict_proba(X), ref.predict_proba(X))
+    assert clf.fit_report_["decisions"]["bootstrap"]["value"] == "keyed"
+
+
+def test_streamed_forest_regressor_identity(data, monkeypatch):
+    X, _ = data
+    yr = (2.0 * X[:, 0] + np.sin(X[:, 1])).astype(np.float64)
+    ref = _keyed_ref(RandomForestRegressor, X, yr, monkeypatch, **RF_KW)
+    reg = RandomForestRegressor(**RF_KW).fit(
+        dataset=StreamedDataset.from_arrays(X, yr, chunk_rows=997)
+    )
+    _trees_equal(ref, reg)
+    np.testing.assert_array_equal(reg.predict(X), ref.predict(X))
+
+
+def test_streamed_extratrees_identity(data, monkeypatch):
+    """No bootstrap, random splits, per-node sqrt subsets — all keyed."""
+    X, y = data
+    ref = _keyed_ref(ExtraTreesClassifier, X, y, monkeypatch, **RF_KW)
+    clf = ExtraTreesClassifier(**RF_KW).fit(
+        dataset=StreamedDataset.from_arrays(X, y, chunk_rows=640)
+    )
+    _trees_equal(ref, clf)
+
+
+def test_streamed_forest_tree_subspaces_identity(data, monkeypatch):
+    """max_features_mode='tree' exercises the keyed feature_subset draw."""
+    X, y = data
+    kw = dict(max_features="sqrt", max_features_mode="tree", **RF_KW)
+    ref = _keyed_ref(RandomForestClassifier, X, y, monkeypatch, **kw)
+    clf = RandomForestClassifier(**kw).fit(
+        dataset=StreamedDataset.from_arrays(X, y, chunk_rows=499)
+    )
+    _trees_equal(ref, clf)
+
+
+def test_streamed_forest_refusals(data):
+    X, y = data
+    with pytest.raises(ValueError, match="oob_score"):
+        RandomForestClassifier(oob_score=True, **RF_KW).fit(
+            dataset=StreamedDataset.from_arrays(X, y, chunk_rows=499)
+        )
+    with pytest.raises(ValueError, match="separate y"):
+        RandomForestClassifier(**RF_KW).fit(
+            dataset=StreamedDataset.from_arrays(X, y, chunk_rows=499), y=y
+        )
+
+
+# ---------------------------------------------------------------------------
+# hybrid refine tail: candidate rows replayed from the chunk stream
+# ---------------------------------------------------------------------------
+
+TREE_KW = dict(max_depth=8, max_bins=16, backend="cpu", n_devices=8,
+               refine_depth=3)
+
+
+def test_streamed_refine_identity(data):
+    """An explicit refine tail gathers its candidates' raw rows from one
+    replay of the chunk stream and commits identical subtrees."""
+    X, y = data
+    ref = DecisionTreeClassifier(**TREE_KW).fit(X, y)
+    clf = DecisionTreeClassifier(**TREE_KW).fit(
+        StreamedDataset.from_arrays(X, y, chunk_rows=251)
+    )
+    np.testing.assert_array_equal(clf.tree_.feature, ref.tree_.feature)
+    np.testing.assert_array_equal(clf.tree_.threshold, ref.tree_.threshold)
+    assert _fp(clf) == _fp(ref)
+    assert clf.fit_report_["decisions"]["refine"]["value"] == 3
+
+
+def test_streamed_refine_per_subtree_identity(data):
+    """splitter='random' routes the tail through the per-subtree engine
+    (node-local RNG) — the stream-gathered block must index identically."""
+    X, _ = data
+    yr = (2.0 * X[:, 0] + np.sin(X[:, 1])).astype(np.float64)
+    kw = dict(splitter="random", random_state=5, **TREE_KW)
+    ref = DecisionTreeRegressor(**kw).fit(X, yr)
+    reg = DecisionTreeRegressor(**kw).fit(
+        StreamedDataset.from_arrays(X, yr, chunk_rows=777)
+    )
+    np.testing.assert_array_equal(reg.tree_.feature, ref.tree_.feature)
+    np.testing.assert_array_equal(reg.tree_.threshold, ref.tree_.threshold)
+    assert _fp(reg) == _fp(ref)
